@@ -1,0 +1,119 @@
+"""Port of the reference application
+(`DataQuality4MachineLearningApp.java:28-155`) to the TPU-native framework —
+same phases, same banners, same observable outputs: session init, UDF
+registration, CSV load (bare-CR), two DQ rules + SQL cleanups, label column,
+VectorAssembler, Lasso LinearRegression (maxIter=40, regParam=1,
+elasticNetParam=1), transform/show, training summary, and the prediction for
+40 guests.
+
+Run:  python examples/dq4ml_pipeline.py [path/to/dataset.csv]
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import sparkdq4ml_tpu as dq
+from sparkdq4ml_tpu.models import LinearRegression, Vectors, VectorAssembler
+from sparkdq4ml_tpu.utils import PhaseTimer, configure_logging
+
+
+def start(filename: str) -> None:
+    timer = PhaseTimer()
+
+    # Session init (`App.java:38-41`): device discovery + mesh construction
+    # replaces the driver JVM / executor pool.
+    spark = dq.TpuSession.builder().app_name("DQ4ML").master("local[*]").get_or_create()
+
+    # DQ Section (`App.java:44-95`)
+    # ----------
+    spark.udf.register("minimumPriceRule", dq.minimum_price_rule, "double")
+    spark.udf.register("priceCorrelationRule", dq.price_correlation_rule, "double")
+
+    with timer.phase("load"):
+        df = (spark.read.format("csv")
+              .option("inferSchema", "true").option("header", "false")
+              .load(filename))
+
+    df = df.with_column_renamed("_c0", "guest")
+    df = df.with_column_renamed("_c1", "price")
+
+    print("----")
+    print("Load & Format")
+    df.show()
+    print("----")
+
+    with timer.phase("dq_rules"):
+        df = df.with_column("price_no_min",
+                            dq.call_udf("minimumPriceRule", df.col("price")))
+        print("----")
+        print("1st DQ rule")
+        df.print_schema()
+        df.show(50)
+        print("----")
+
+        df.create_or_replace_temp_view("price")
+        df = spark.sql("SELECT cast(guest as int) guest, price_no_min AS price "
+                       "FROM price WHERE price_no_min > 0")
+        print("----")
+        print("1st DQ rule - clean-up")
+        df.print_schema()
+        df.show(50)
+        print("----")
+
+        df = df.with_column("price_correct_correl",
+                            dq.call_udf("priceCorrelationRule",
+                                        df.col("price"), df.col("guest")))
+        df.create_or_replace_temp_view("price")
+        df = spark.sql("SELECT guest, price_correct_correl AS price "
+                       "FROM price WHERE price_correct_correl > 0")
+
+    print("----")
+    print("2nd DQ rule")
+    df.show(50)
+    print("----")
+
+    # ML Section (`App.java:98-126`)
+    # ----------
+    df = df.with_column("label", df.col("price"))
+
+    assembler = VectorAssembler().setInputCols(["guest"]).setOutputCol("features")
+    df = assembler.transform(df)
+    df.print_schema()
+    df.show()
+
+    lr = LinearRegression().setMaxIter(40).setRegParam(1).setElasticNetParam(1)
+
+    with timer.phase("fit"):
+        model = lr.fit(df)
+
+    model.transform(df).show()
+
+    # Summary (`App.java:132-146`)
+    trainingSummary = model.summary
+    print("numIterations: " + str(trainingSummary.totalIterations))
+    print("objectiveHistory: [" +
+          ",".join(str(v) for v in trainingSummary.objectiveHistory) + "]")
+    trainingSummary.residuals.show()
+    print("RMSE: " + str(trainingSummary.rootMeanSquaredError))
+    print("r2: " + str(trainingSummary.r2))
+
+    print("Intersection: " + str(model.intercept))
+    print("Regression parameter: " + str(model.getRegParam()))
+    print("Tol: " + str(model.getTol()))
+
+    # Prediction (`App.java:148-154`)
+    feature = 40.0
+    features = Vectors.dense(40.0)
+    p = model.predict(features)
+    print(f"Prediction for {feature} guests is {p}")
+
+    print("phase wall-clock (s):", {k: round(v, 4) for k, v in timer.report().items()})
+
+
+if __name__ == "__main__":
+    configure_logging()
+    default = os.path.join(os.path.dirname(__file__), "..", "data",
+                           "dataset-abstract.csv")
+    start(sys.argv[1] if len(sys.argv) > 1 else default)

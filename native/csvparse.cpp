@@ -1,0 +1,147 @@
+// Native CSV tokenizer — the data-loader fast path.
+//
+// Role (SURVEY.md §2.2 "CSV reader"): the analogue of the Univocity parser
+// inside Spark's CSV source, for the common all-numeric feature-matrix case.
+// Parses a whole file into column-major float64 with NaN for empty fields,
+// handling bare-CR / CRLF / LF record separators in one pass, and tracks per
+// column whether every value is integral (so Python can choose int32/float).
+//
+// Contract (see sparkdq4ml_tpu/frame/native_csv.py):
+//   dq_parse_numeric_csv(path, delim, skip_header, &data, &ncols, &int_flags)
+//     -> n_rows >= 0 on success; -1 if any field is non-numeric (caller
+//        falls back to the Python parser); -2 on IO error.
+//   data: column-major [ncols * n_rows] doubles, malloc'd; caller frees via
+//   dq_free. int_flags: ncols bytes, 1 = column is integral with no nulls.
+//
+// Build: make -C native
+
+#include <cerrno>
+#include <cmath>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+
+// Parse one field; returns false if non-numeric. Empty -> NaN.
+bool parse_field(const char* begin, const char* end, double* out) {
+  while (begin < end && (*begin == ' ' || *begin == '\t')) ++begin;
+  while (end > begin && (end[-1] == ' ' || end[-1] == '\t')) --end;
+  if (begin == end) {
+    *out = std::nan("");
+    return true;
+  }
+  std::string buf(begin, end);  // strtod needs NUL termination
+  char* stop = nullptr;
+  errno = 0;
+  double v = std::strtod(buf.c_str(), &stop);
+  if (stop != buf.c_str() + buf.size() || errno == ERANGE) return false;
+  *out = v;
+  return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+long long dq_parse_numeric_csv(const char* path, char delim, int skip_header,
+                               double** out_data, long long* out_ncols,
+                               char** out_int_flags) {
+  *out_data = nullptr;
+  *out_ncols = 0;
+  *out_int_flags = nullptr;
+
+  std::FILE* f = std::fopen(path, "rb");
+  if (f == nullptr) return -2;
+  std::fseek(f, 0, SEEK_END);
+  long size = std::ftell(f);
+  std::fseek(f, 0, SEEK_SET);
+  std::string text(static_cast<size_t>(size), '\0');
+  size_t got = size > 0 ? std::fread(&text[0], 1, static_cast<size_t>(size), f) : 0;
+  std::fclose(f);
+  text.resize(got);
+
+  // Row-major parse into a growing buffer; transpose at the end.
+  std::vector<double> values;
+  size_t ncols = 0;
+  long long nrows = 0;
+  bool first_record = true;
+
+  const char* p = text.data();
+  const char* const file_end = p + text.size();
+  while (p < file_end) {
+    // Find the record terminator: \r\n, \r, or \n.
+    const char* rec_end = p;
+    while (rec_end < file_end && *rec_end != '\r' && *rec_end != '\n') ++rec_end;
+    const char* next = rec_end;
+    if (next < file_end) {
+      if (*next == '\r' && next + 1 < file_end && next[1] == '\n') next += 2;
+      else next += 1;
+    }
+    // Skip blank records (and the header if requested).
+    const char* q = p;
+    while (q < rec_end && (*q == ' ' || *q == '\t')) ++q;
+    bool blank = (q == rec_end);
+    bool skip = blank || (first_record && skip_header);
+    if (!blank) first_record = false;
+    if (!skip) {
+      size_t col = 0;
+      const char* field = p;
+      for (const char* c = p;; ++c) {
+        if (c == rec_end || *c == delim) {
+          double v;
+          if (!parse_field(field, c, &v)) return -1;
+          if (nrows == 0) {
+            values.push_back(v);
+            ++ncols;
+          } else {
+            if (col >= ncols) return -1;  // ragged wide row -> python path
+            values.push_back(v);
+          }
+          ++col;
+          field = c + 1;
+          if (c == rec_end) break;
+        }
+      }
+      // Ragged short row: pad with NaN (python parser does the same).
+      for (; col < ncols && nrows > 0; ++col) values.push_back(std::nan(""));
+      ++nrows;
+    }
+    p = next;
+  }
+
+  if (nrows == 0 || ncols == 0) {
+    *out_ncols = 0;
+    return 0;
+  }
+
+  double* data = static_cast<double*>(std::malloc(sizeof(double) * ncols * nrows));
+  char* int_flags = static_cast<char*>(std::malloc(ncols));
+  if (data == nullptr || int_flags == nullptr) {
+    std::free(data);
+    std::free(int_flags);
+    return -2;
+  }
+  for (size_t j = 0; j < ncols; ++j) {
+    bool integral = true;
+    for (long long i = 0; i < nrows; ++i) {
+      double v = values[static_cast<size_t>(i) * ncols + j];
+      data[j * nrows + i] = v;  // column-major
+      if (std::isnan(v) || v != std::floor(v) ||
+          v < -2147483648.0 || v > 2147483647.0) {
+        integral = false;
+      }
+    }
+    int_flags[j] = integral ? 1 : 0;
+  }
+  *out_data = data;
+  *out_ncols = static_cast<long long>(ncols);
+  *out_int_flags = int_flags;
+  return nrows;
+}
+
+void dq_free(void* p) { std::free(p); }
+
+}  // extern "C"

@@ -1,0 +1,35 @@
+// Minimal C++-side smoke test for the native CSV tokenizer: parses the file
+// given on argv[1] and prints shape + first values. Exercised by `make test`;
+// the authoritative behavior tests live in tests/test_native_csv.py.
+#include <cstdio>
+#include <cstdlib>
+
+extern "C" {
+long long dq_parse_numeric_csv(const char*, char, int, double**, long long*, char**);
+void dq_free(void*);
+}
+
+int main(int argc, char** argv) {
+  if (argc < 2) {
+    std::fprintf(stderr, "usage: %s file.csv\n", argv[0]);
+    return 2;
+  }
+  double* data = nullptr;
+  long long ncols = 0;
+  char* flags = nullptr;
+  long long nrows = dq_parse_numeric_csv(argv[1], ',', 0, &data, &ncols, &flags);
+  if (nrows < 0) {
+    std::fprintf(stderr, "parse failed: %lld\n", nrows);
+    return 1;
+  }
+  std::printf("rows=%lld cols=%lld first=[", nrows, ncols);
+  for (long long j = 0; j < ncols; ++j)
+    std::printf("%s%g", j ? "," : "", data[j * nrows]);
+  std::printf("] int_flags=[");
+  for (long long j = 0; j < ncols; ++j)
+    std::printf("%s%d", j ? "," : "", flags[j]);
+  std::printf("]\n");
+  dq_free(data);
+  dq_free(flags);
+  return 0;
+}

@@ -1,0 +1,13 @@
+"""sparkdq4ml_tpu: TPU-native framework with the capabilities of
+net.jgp.labs.sparkdq4ml (see SURVEY.md). Columnar frame engine + DQ rule/UDF
+layer + SQL subset + MLlib-convention estimators, distributed via
+jax.sharding meshes and XLA collectives."""
+
+from .config import config
+from .frame import Frame, read_csv
+from .ops import (col, lit, call_udf, callUDF, register_udf,
+                  minimum_price_rule, price_correlation_rule,
+                  register_builtin_rules)
+from .session import TpuSession
+
+__version__ = "0.1.0"

@@ -1,0 +1,36 @@
+"""Global configuration for the framework.
+
+The reference hard-codes every constant (thresholds, paths, LR params — see
+SURVEY.md §5 "Config / flag system"); its only knobs are MLlib's ``setX``
+builder pattern, which the estimators here reproduce. This module holds the
+few framework-level defaults that Spark keeps in ``SparkConf``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass
+class _Config:
+    # Default floating dtype for frame columns and solvers. float32 rides the
+    # TPU MXU/VPU natively; tests may select float64 (with jax_enable_x64) for
+    # tight golden-number parity on CPU.
+    default_float_dtype: jnp.dtype = jnp.float32
+    # Default integer dtype (Spark CSV inference yields IntegerType → int32).
+    default_int_dtype: jnp.dtype = jnp.int32
+    # Rows shown by Frame.show() when no argument is given (Spark default: 20).
+    default_show_rows: int = 20
+
+
+config = _Config()
+
+
+def float_dtype() -> jnp.dtype:
+    return config.default_float_dtype
+
+
+def int_dtype() -> jnp.dtype:
+    return config.default_int_dtype

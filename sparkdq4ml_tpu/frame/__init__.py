@@ -1,0 +1,2 @@
+from .frame import Frame
+from .csv import DataFrameReader, read_csv

@@ -1,0 +1,331 @@
+"""Columnar Frame: the framework's ``Dataset<Row>`` equivalent.
+
+Design (SURVEY.md §7 step 1, TPU-first):
+
+* a Frame is a dict of named columns — device arrays of shape ``(n,)`` (scalar
+  columns) or ``(n, d)`` (vector columns, e.g. VectorAssembler output) — plus
+  a boolean **validity mask** of shape ``(n,)``.
+* ``filter`` ANDs into the mask instead of gathering rows, so every array keeps
+  a static shape and everything downstream stays jit/XLA-friendly. All
+  reductions (count, means, fit statistics) are mask-weighted; the golden DQ
+  row counts (SURVEY.md §2.3: 40→34→24 etc.) are the regression tests that the
+  mask never leaks.
+* Spark's lazy DAG is deliberately **not** replicated: XLA's jit tracing and
+  fusion provide the equivalent optimization, so eager column ops are the
+  idiomatic design (SURVEY.md §7 preamble).
+
+String columns are host-side numpy object arrays (TPUs do not hold strings);
+numeric columns live in device memory.
+
+Covers the Dataset API surface the reference app exercises:
+``withColumnRenamed`` (`DataQuality4MachineLearningApp.java:58-59`),
+``withColumn`` + ``callUDF`` (`:68-69,86-87`), ``show``/``printSchema``
+(`:63,72-73,81-83,93-95,114-115`), temp views + SQL filtering (`:76-78,88-90`),
+label-column copy (`:101`).
+"""
+
+from __future__ import annotations
+
+import io
+from typing import Iterable, Mapping, Optional, Sequence, Union
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import config, float_dtype, int_dtype
+from ..ops.expressions import Col, Expr, spark_type_name
+
+ColumnLike = Union[Expr, jnp.ndarray, np.ndarray, Sequence]
+
+
+def _is_string_col(arr) -> bool:
+    return isinstance(arr, np.ndarray) and arr.dtype == object
+
+
+def _as_column(values, n: Optional[int] = None):
+    """Coerce raw values into a column array (device array, or host object array)."""
+    if isinstance(values, np.ndarray) and values.dtype == object:
+        arr = values
+    elif isinstance(values, (jnp.ndarray, np.ndarray)):
+        arr = jnp.asarray(values)
+    else:
+        values = list(values)
+        if values and isinstance(values[0], str):
+            arr = np.asarray(values, dtype=object)
+        else:
+            np_arr = np.asarray(values)
+            if np_arr.dtype == np.float64:
+                np_arr = np_arr.astype(np.dtype(float_dtype()))
+            elif np_arr.dtype == np.int64:
+                np_arr = np_arr.astype(np.dtype(int_dtype()))
+            arr = jnp.asarray(np_arr)
+    if n is not None and arr.shape[0] != n:
+        raise ValueError(f"column length {arr.shape[0]} != frame length {n}")
+    return arr
+
+
+class Frame:
+    """Immutable columnar frame with a validity mask (see module docstring)."""
+
+    def __init__(self, columns: Mapping[str, ColumnLike], mask=None):
+        self._data: dict[str, object] = {}
+        n = None
+        for name, values in columns.items():
+            arr = _as_column(values, n)
+            n = arr.shape[0] if n is None else n
+            self._data[name] = arr
+        self._n = 0 if n is None else int(n)
+        if mask is None:
+            self._mask = jnp.ones((self._n,), dtype=jnp.bool_)
+        else:
+            self._mask = jnp.asarray(mask, jnp.bool_)
+            if self._mask.shape != (self._n,):
+                raise ValueError("mask shape mismatch")
+
+    # -- construction ------------------------------------------------------
+    @classmethod
+    def from_rows(cls, rows: Iterable[Sequence], names: Sequence[str]) -> "Frame":
+        rows = list(rows)  # an exhausted iterator must still yield named cols
+        cols = list(zip(*rows)) if rows else [[] for _ in names]
+        return cls({name: list(vals) for name, vals in zip(names, cols)})
+
+    def _with(self, data=None, mask=None) -> "Frame":
+        f = Frame.__new__(Frame)
+        f._data = dict(self._data if data is None else data)
+        f._mask = self._mask if mask is None else mask
+        f._n = self._n
+        return f
+
+    # -- basic introspection ----------------------------------------------
+    @property
+    def columns(self) -> list[str]:
+        return list(self._data)
+
+    @property
+    def num_slots(self) -> int:
+        """Physical row slots (including masked-out rows). Static under jit."""
+        return self._n
+
+    @property
+    def mask(self) -> jnp.ndarray:
+        return self._mask
+
+    def dtypes(self) -> list[tuple[str, str]]:
+        return [(name, spark_type_name(np.dtype(arr.dtype)) if not _is_string_col(arr)
+                 else "string") for name, arr in self._data.items()]
+
+    def schema_string(self) -> str:
+        """``printSchema`` text, matching Spark's output shape."""
+        out = io.StringIO()
+        out.write("root\n")
+        for name, arr in self._data.items():
+            if _is_string_col(arr):
+                tname = "string"
+            elif arr.ndim == 2:
+                tname = "vector"
+            else:
+                tname = spark_type_name(np.dtype(arr.dtype))
+            out.write(f" |-- {name}: {tname} (nullable = true)\n")
+        return out.getvalue()
+
+    def print_schema(self) -> None:
+        print(self.schema_string(), end="")
+
+    printSchema = print_schema  # Spark-style alias
+
+    # -- column access -----------------------------------------------------
+    def _column_values(self, name: str):
+        try:
+            return self._data[name]
+        except KeyError:
+            raise KeyError(
+                f"no column {name!r}; columns: {self.columns}") from None
+
+    def col(self, name: str) -> Col:
+        self._column_values(name)  # raise early on unknown names, like Spark's analyzer
+        return Col(name)
+
+    def __getitem__(self, name: str) -> Col:
+        return self.col(name)
+
+    def _eval(self, expr_or_values):
+        if isinstance(expr_or_values, Expr):
+            return expr_or_values.eval(self)
+        return _as_column(expr_or_values, self._n)
+
+    # -- transformations (each returns a new Frame) ------------------------
+    def with_column(self, name: str, values: ColumnLike) -> "Frame":
+        """``withColumn`` — add or replace a column from an expression/array."""
+        data = dict(self._data)
+        data[name] = self._eval(values)
+        return self._with(data=data)
+
+    withColumn = with_column
+
+    def with_column_renamed(self, old: str, new: str) -> "Frame":
+        """``withColumnRenamed`` — no-op if ``old`` is absent (Spark semantics)."""
+        if old not in self._data:
+            return self
+        data = {(new if k == old else k): v for k, v in self._data.items()}
+        return self._with(data=data)
+
+    withColumnRenamed = with_column_renamed
+
+    def select(self, *exprs: Union[str, Expr]) -> "Frame":
+        data: dict[str, object] = {}
+        for e in exprs:
+            if isinstance(e, str):
+                if e == "*":
+                    data.update(self._data)
+                    continue
+                e = Col(e)
+            data[e.name] = e.eval(self)
+        return self._with(data=data)
+
+    def drop(self, *names: str) -> "Frame":
+        data = {k: v for k, v in self._data.items() if k not in names}
+        return self._with(data=data)
+
+    def filter(self, condition: Union[Expr, jnp.ndarray]) -> "Frame":
+        """AND a predicate into the validity mask (static shapes preserved)."""
+        cond = condition.eval(self) if isinstance(condition, Expr) else jnp.asarray(condition)
+        return self._with(mask=jnp.logical_and(self._mask, cond.astype(jnp.bool_)))
+
+    where = filter
+
+    def limit(self, n: int) -> "Frame":
+        keep = jnp.cumsum(self._mask.astype(jnp.int32)) <= n
+        return self._with(mask=jnp.logical_and(self._mask, keep))
+
+    def union(self, other: "Frame") -> "Frame":
+        if self.columns != other.columns:
+            raise ValueError("union requires identical column lists")
+        data = {}
+        for name in self.columns:
+            a, b = self._data[name], other._data[name]
+            if _is_string_col(a) or _is_string_col(b):
+                data[name] = np.concatenate([np.asarray(a, object), np.asarray(b, object)])
+            else:
+                data[name] = jnp.concatenate([jnp.asarray(a), jnp.asarray(b)])
+        f = Frame(data)
+        f._mask = jnp.concatenate([self._mask, other._mask])
+        return f
+
+    # -- actions -----------------------------------------------------------
+    def count(self) -> int:
+        """Number of valid (unmasked) rows."""
+        return int(jnp.sum(self._mask))
+
+    def is_empty(self) -> bool:
+        return self.count() == 0
+
+    def _host_mask(self) -> np.ndarray:
+        return np.asarray(self._mask)
+
+    def to_pydict(self, limit: Optional[int] = None) -> dict[str, np.ndarray]:
+        """Materialize valid rows on host (the gather happens here, once, at
+        the host boundary — never inside the compute path).
+
+        ``limit`` gathers only the first N valid rows — ``take``/``show``
+        use it so peeking at a large device-resident frame does not transfer
+        the whole dataset.
+        """
+        m = self._host_mask()
+        if limit is not None:
+            keep = np.cumsum(m) <= limit
+            m = m & keep
+            upto = int(np.argmax(~keep)) if not keep.all() else len(m)
+            m = m[:upto]
+        out = {}
+        for name, arr in self._data.items():
+            if _is_string_col(arr):
+                host = arr[: len(m)]
+            else:
+                host = np.asarray(arr[: len(m)])
+            out[name] = host[m]
+        return out
+
+    def collect(self, limit: Optional[int] = None) -> list[tuple]:
+        d = self.to_pydict(limit)
+        cols = [d[name] for name in self.columns]
+        return [tuple(row) for row in zip(*cols)] if cols else []
+
+    def take(self, n: int) -> list[tuple]:
+        return self.collect(limit=n)
+
+    def head(self, n: int = 1):
+        rows = self.take(n)
+        return rows if n != 1 else (rows[0] if rows else None)
+
+    def first(self):
+        return self.head(1)
+
+    # -- display -----------------------------------------------------------
+    def _format_cell(self, v, truncate: int) -> str:
+        if isinstance(v, (np.floating, float)):
+            if np.isnan(v):
+                s = "NaN"
+            elif isinstance(v, np.floating):
+                # shortest round-trip repr at the column's own precision, so
+                # float32 23.1 prints "23.1" (as Spark's double toString would)
+                s = np.format_float_positional(v, unique=True, trim="0")
+            else:
+                s = repr(float(v))
+        elif isinstance(v, (np.bool_, bool)):
+            s = "true" if v else "false"
+        elif isinstance(v, (np.integer, int)):
+            s = str(int(v))
+        elif isinstance(v, np.ndarray):  # vector cell, shown Spark-style: [40.0]
+            s = "[" + ",".join(
+                np.format_float_positional(x, unique=True, trim="0")
+                if isinstance(x, np.floating) else str(x) for x in v) + "]"
+        elif v is None:
+            s = "null"
+        else:
+            s = str(v)
+        if truncate > 0 and len(s) > truncate:
+            s = s[: truncate - 3] + "..." if truncate > 3 else s[:truncate]
+        return s
+
+    def show_string(self, n: int = None, truncate: Union[bool, int] = True) -> str:
+        """Spark-format ASCII table (right-aligned cells, +---+ borders,
+        ``only showing top N rows`` footer)."""
+        if n is None:
+            n = config.default_show_rows
+        tr = 20 if truncate is True else (0 if truncate is False else int(truncate))
+        total = int(self._host_mask().sum())
+        d = self.to_pydict(limit=n)  # gather only what is displayed
+        names = self.columns
+        rows = []
+        shown = len(next(iter(d.values()))) if d else 0
+        for i in range(shown):
+            rows.append([self._format_cell(d[name][i], tr) for name in names])
+        headers = [name if tr <= 0 or len(name) <= tr else name[: tr - 3] + "..."
+                   for name in names]
+        widths = [max([len(h)] + [len(r[j]) for r in rows]) for j, h in enumerate(headers)]
+        sep = "+" + "+".join("-" * w for w in widths) + "+"
+        out = [sep, "|" + "|".join(h.rjust(w) for h, w in zip(headers, widths)) + "|", sep]
+        for r in rows:
+            out.append("|" + "|".join(c.rjust(w) for c, w in zip(r, widths)) + "|")
+        out.append(sep)
+        text = "\n".join(out) + "\n"
+        if total > n:
+            text += f"only showing top {n} rows\n"
+        return text
+
+    def show(self, n: int = None, truncate: Union[bool, int] = True) -> None:
+        print(self.show_string(n, truncate))
+
+    def __repr__(self):
+        fields = ", ".join(f"{name}: {t}" for name, t in self.dtypes())
+        return f"Frame[{fields}]"
+
+    # -- temp views --------------------------------------------------------
+    def create_or_replace_temp_view(self, name: str) -> None:
+        """Register this frame in the session catalog for SQL access
+        (`DataQuality4MachineLearningApp.java:76,88`)."""
+        from ..sql.catalog import default_catalog
+
+        default_catalog().register(name, self)
+
+    createOrReplaceTempView = create_or_replace_temp_view

@@ -1,0 +1,117 @@
+"""ctypes binding to the native C++ CSV tokenizer (``native/csvparse.cpp``).
+
+Role: the data-loader fast path — the analogue of the Univocity parser inside
+Spark's CSV source (SURVEY.md §2.2 "CSV reader"). The native tokenizer handles
+the common all-numeric case (which is what feature matrices are); anything
+else returns ``None`` here and the pure-Python reader takes over, so the
+framework works identically whether or not the shared library is built
+(``make -C native``).
+
+The C side parses the file into column-major float64 with NaN for empty
+fields, handling bare-CR/CRLF/LF records; Python decides integer-vs-double per
+column exactly like ``csv.infer_column`` and uploads to device once.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import Optional
+
+import numpy as np
+
+from ..config import float_dtype, int_dtype
+
+_LIB = None
+_LIB_TRIED = False
+
+_SO_PATHS = [
+    os.path.join(os.path.dirname(__file__), "..", "..", "native", "libdqcsv.so"),
+    os.path.join(os.path.dirname(__file__), "_native", "libdqcsv.so"),
+]
+
+
+def _load():
+    global _LIB, _LIB_TRIED
+    if _LIB_TRIED:
+        return _LIB
+    _LIB_TRIED = True
+    for p in _SO_PATHS:
+        p = os.path.abspath(p)
+        if os.path.exists(p):
+            try:
+                lib = ctypes.CDLL(p)
+            except OSError:
+                continue
+            lib.dq_parse_numeric_csv.restype = ctypes.c_longlong
+            lib.dq_parse_numeric_csv.argtypes = [
+                ctypes.c_char_p,                      # path
+                ctypes.c_char,                        # delimiter
+                ctypes.c_int,                         # skip_header
+                ctypes.POINTER(ctypes.POINTER(ctypes.c_double)),  # out data
+                ctypes.POINTER(ctypes.c_longlong),    # out ncols
+                ctypes.POINTER(ctypes.POINTER(ctypes.c_char)),    # out int_flags
+            ]
+            lib.dq_free.restype = None
+            lib.dq_free.argtypes = [ctypes.c_void_p]
+            _LIB = lib
+            break
+    return _LIB
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def try_read_csv(path: str, header: bool, infer_schema: bool, delimiter: str,
+                 required: bool = False):
+    """Native read; returns a Frame or None (fallback to python engine)."""
+    lib = _load()
+    if lib is None:
+        if required:
+            raise RuntimeError(
+                "native CSV engine requested but native/libdqcsv.so is not "
+                "built (run `make -C native`)")
+        return None
+    if len(delimiter) != 1:
+        return None
+    if not infer_schema or header:
+        # Native fast path only covers the inferred all-numeric, headerless
+        # shape (the reference's shape); let python handle the rest.
+        if required:
+            raise RuntimeError("native CSV engine only supports "
+                               "header=False, infer_schema=True")
+        return None
+
+    data_p = ctypes.POINTER(ctypes.c_double)()
+    ncols = ctypes.c_longlong(0)
+    intf_p = ctypes.POINTER(ctypes.c_char)()
+    nrows = lib.dq_parse_numeric_csv(
+        path.encode(), delimiter.encode(), 1 if header else 0,
+        ctypes.byref(data_p), ctypes.byref(ncols), ctypes.byref(intf_p))
+    if nrows < 0:
+        if nrows == -2:
+            raise FileNotFoundError(path)
+        return None  # non-numeric content → python engine
+    try:
+        nc = ncols.value
+        if nc == 0 or nrows == 0:
+            from .frame import Frame
+            return Frame({})
+        flat = np.ctypeslib.as_array(data_p, shape=(nc * nrows,)).copy()
+        cols = flat.reshape(nc, nrows)  # column-major from C
+        int_flags = bytes(ctypes.cast(intf_p, ctypes.POINTER(ctypes.c_char * nc)).contents)
+    finally:
+        lib.dq_free(data_p)
+        lib.dq_free(intf_p)
+
+    from .frame import Frame
+
+    data = {}
+    for j in range(nc):
+        col = cols[j]
+        if int_flags[j]:
+            data[f"_c{j}"] = col.astype(np.dtype(int_dtype()))
+        else:
+            data[f"_c{j}"] = col.astype(np.dtype(float_dtype()))
+    return Frame(data)

@@ -1,0 +1,6 @@
+from .base import Estimator, Model, Pipeline, PipelineModel, Transformer
+from .feature import VectorAssembler
+from .linalg import Vectors
+from .regression import (LinearRegression, LinearRegressionModel,
+                         LinearRegressionSummary,
+                         LinearRegressionTrainingSummary)

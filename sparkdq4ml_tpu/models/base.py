@@ -1,0 +1,81 @@
+"""Estimator/Transformer/Model/Pipeline base classes (the MLlib ``ml``
+pipeline contracts that ``VectorAssembler`` and ``LinearRegression``
+implement — `DataQuality4MachineLearningApp.java:110-126` uses exactly the
+Transformer and Estimator halves)."""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Sequence
+
+
+class Transformer:
+    def transform(self, frame):
+        raise NotImplementedError
+
+    def __call__(self, frame):
+        return self.transform(frame)
+
+
+class Estimator:
+    def fit(self, frame):
+        raise NotImplementedError
+
+
+class Model(Transformer):
+    pass
+
+
+class Pipeline(Estimator):
+    """Chain of stages; each Estimator stage is fit on the running frame and
+    replaced by its Model."""
+
+    def __init__(self, stages: Sequence = ()):
+        self._stages = list(stages)
+
+    def set_stages(self, stages: Sequence) -> "Pipeline":
+        self._stages = list(stages)
+        return self
+
+    setStages = set_stages
+
+    def get_stages(self):
+        return list(self._stages)
+
+    getStages = get_stages
+
+    def fit(self, frame) -> "PipelineModel":
+        fitted = []
+        cur = frame
+        for stage in self._stages:
+            if isinstance(stage, Estimator):
+                model = stage.fit(cur)
+                fitted.append(model)
+                cur = model.transform(cur)
+            else:
+                fitted.append(stage)
+                cur = stage.transform(cur)
+        return PipelineModel(fitted)
+
+
+class PipelineModel(Model):
+    def __init__(self, stages: Sequence):
+        self.stages = list(stages)
+
+    def transform(self, frame):
+        cur = frame
+        for stage in self.stages:
+            cur = stage.transform(cur)
+        return cur
+
+
+def write_json(path: str, obj) -> None:
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(obj, f, indent=2, default=float)
+
+
+def read_json(path: str):
+    with open(path) as f:
+        return json.load(f)

@@ -1,0 +1,55 @@
+"""VectorAssembler — packs input columns into one ``(n, d)`` feature matrix
+column (`DataQuality4MachineLearningApp.java:110-113`).
+
+TPU-first: the "vector column" is literally the feature matrix in HBM, laid
+out densely so the fit's Gramian is a single MXU matmul — there is no per-row
+vector object.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax.numpy as jnp
+
+from ..config import float_dtype
+from .base import Transformer
+
+
+class VectorAssembler(Transformer):
+    def __init__(self, input_cols: Optional[Sequence[str]] = None,
+                 output_col: str = "features"):
+        self.input_cols = list(input_cols) if input_cols else []
+        self.output_col = output_col
+
+    def set_input_cols(self, cols: Sequence[str]) -> "VectorAssembler":
+        self.input_cols = list(cols)
+        return self
+
+    setInputCols = set_input_cols
+
+    def set_output_col(self, name: str) -> "VectorAssembler":
+        self.output_col = name
+        return self
+
+    setOutputCol = set_output_col
+
+    def get_input_cols(self):
+        return list(self.input_cols)
+
+    getInputCols = get_input_cols
+
+    def get_output_col(self):
+        return self.output_col
+
+    getOutputCol = get_output_col
+
+    def transform(self, frame):
+        if not self.input_cols:
+            raise ValueError("VectorAssembler: input_cols not set")
+        dt = float_dtype()
+        parts = []
+        for name in self.input_cols:
+            arr = jnp.asarray(frame._column_values(name), dt)
+            parts.append(arr[:, None] if arr.ndim == 1 else arr)
+        return frame.with_column(self.output_col, jnp.concatenate(parts, axis=1))

@@ -1,0 +1,16 @@
+"""``org.apache.spark.ml.linalg.Vectors`` equivalent — host-side helpers for
+single-point inference (`DataQuality4MachineLearningApp.java:150`)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..config import float_dtype
+
+
+class Vectors:
+    @staticmethod
+    def dense(*values) -> np.ndarray:
+        if len(values) == 1 and isinstance(values[0], (list, tuple, np.ndarray)):
+            values = values[0]
+        return np.asarray(values, dtype=np.dtype(float_dtype()))

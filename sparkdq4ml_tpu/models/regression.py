@@ -1,0 +1,347 @@
+"""LinearRegression estimator/model/summary — the MLlib surface the
+reference app exercises (`DataQuality4MachineLearningApp.java:120-154`):
+``setMaxIter/setRegParam/setElasticNetParam``, ``fit``, ``transform``,
+``summary`` (totalIterations, objectiveHistory, residuals, RMSE, r²),
+``intercept``/``getRegParam``/``getTol``, and host-side ``predict``.
+
+The fit path is the TPU-native design from :mod:`~sparkdq4ml_tpu.models.solvers`:
+one masked-Gramian data pass (sharded over the session mesh with a ``psum``
+when it has >1 device) + an on-device solver loop on the replicated
+statistics. MLlib parameter defaults are preserved: ``maxIter=100``,
+``regParam=0``, ``elasticNetParam=0``, ``tol=1e-6``, ``fitIntercept=True``,
+``standardization=True``, ``solver="auto"``.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import float_dtype
+from ..frame.frame import Frame
+from ..ops.expressions import col
+from ..parallel.distributed import (fused_linear_fit_fn, place_sharded)
+from .base import Estimator, Model, read_json, write_json
+from .solvers import FitResult, resolve_solver
+
+
+def _extract_xy(frame: Frame, features_col: str, label_col: str):
+    X = jnp.asarray(frame._column_values(features_col), float_dtype())
+    if X.ndim == 1:
+        X = X[:, None]
+    y = jnp.asarray(frame._column_values(label_col), float_dtype())
+    return X, y, frame.mask
+
+
+class LinearRegression(Estimator):
+    """Elastic-net linear regression, MLlib numeric convention."""
+
+    def __init__(self, max_iter: int = 100, reg_param: float = 0.0,
+                 elastic_net_param: float = 0.0, tol: float = 1e-6,
+                 fit_intercept: bool = True, standardization: bool = True,
+                 solver: str = "auto", features_col: str = "features",
+                 label_col: str = "label", prediction_col: str = "prediction",
+                 aggregation_depth: int = 2):
+        self.max_iter = max_iter
+        self.reg_param = reg_param
+        self.elastic_net_param = elastic_net_param
+        self.tol = tol
+        self.fit_intercept = fit_intercept
+        self.standardization = standardization
+        self.solver = solver
+        self.features_col = features_col
+        self.label_col = label_col
+        self.prediction_col = prediction_col
+        # treeAggregate tree depth in MLlib; meaningless under psum (the ICI
+        # all-reduce is already log-depth in hardware). Accepted for API parity.
+        self.aggregation_depth = aggregation_depth
+
+    # -- MLlib-style fluent setters/getters --------------------------------
+    def set_max_iter(self, v: int):
+        self.max_iter = int(v); return self
+
+    def set_reg_param(self, v: float):
+        self.reg_param = float(v); return self
+
+    def set_elastic_net_param(self, v: float):
+        self.elastic_net_param = float(v); return self
+
+    def set_tol(self, v: float):
+        self.tol = float(v); return self
+
+    def set_fit_intercept(self, v: bool):
+        self.fit_intercept = bool(v); return self
+
+    def set_standardization(self, v: bool):
+        self.standardization = bool(v); return self
+
+    def set_solver(self, v: str):
+        self.solver = v; return self
+
+    def set_features_col(self, v: str):
+        self.features_col = v; return self
+
+    def set_label_col(self, v: str):
+        self.label_col = v; return self
+
+    def set_prediction_col(self, v: str):
+        self.prediction_col = v; return self
+
+    def set_aggregation_depth(self, v: int):
+        self.aggregation_depth = int(v); return self
+
+    setMaxIter = set_max_iter
+    setRegParam = set_reg_param
+    setElasticNetParam = set_elastic_net_param
+    setTol = set_tol
+    setFitIntercept = set_fit_intercept
+    setStandardization = set_standardization
+    setSolver = set_solver
+    setFeaturesCol = set_features_col
+    setLabelCol = set_label_col
+    setPredictionCol = set_prediction_col
+    setAggregationDepth = set_aggregation_depth
+
+    def get_max_iter(self): return self.max_iter
+    def get_reg_param(self): return self.reg_param
+    def get_elastic_net_param(self): return self.elastic_net_param
+    def get_tol(self): return self.tol
+    def get_fit_intercept(self): return self.fit_intercept
+    def get_standardization(self): return self.standardization
+    def get_solver(self): return self.solver
+
+    getMaxIter = get_max_iter
+    getRegParam = get_reg_param
+    getElasticNetParam = get_elastic_net_param
+    getTol = get_tol
+    getFitIntercept = get_fit_intercept
+    getStandardization = get_standardization
+    getSolver = get_solver
+
+    def _params_dict(self) -> dict:
+        return {k: getattr(self, k) for k in (
+            "max_iter", "reg_param", "elastic_net_param", "tol",
+            "fit_intercept", "standardization", "solver", "features_col",
+            "label_col", "prediction_col", "aggregation_depth")}
+
+    # -- fit ----------------------------------------------------------------
+    def fit(self, frame: Frame, mesh=None) -> "LinearRegressionModel":
+        """Fit on the frame's valid rows. ``mesh`` defaults to the active
+        session's device mesh (row-sharded psum path when >1 device)."""
+        if mesh is None:
+            from ..session import TpuSession
+
+            active = TpuSession.active()
+            mesh = active.mesh if active is not None else None
+        X, y, mask = _extract_xy(frame, self.features_col, self.label_col)
+        solver_name = resolve_solver(self.solver, self.reg_param,
+                                     self.elastic_net_param)
+        if mesh is not None and mesh.devices.size <= 1:
+            mesh = None  # unify the single-device cache key
+        fit_fn = fused_linear_fit_fn(mesh, solver_name, self.max_iter,
+                                     self.tol, self.fit_intercept,
+                                     self.standardization)
+        Xd, yd, md = place_sharded(X, y, mask, mesh)
+        result = fit_fn(Xd, yd, md, self.reg_param, self.elastic_net_param)
+        model = LinearRegressionModel(
+            coefficients=np.asarray(result.coefficients),
+            intercept=float(result.intercept),
+            params=self._params_dict())
+        # Summary is constructed lazily on first access: it needs a full
+        # batch transform + host gather, which sweep-style callers that only
+        # read coefficients should never pay for.
+        model._summary_source = (frame, result)
+        return model
+
+
+class LinearRegressionModel(Model):
+    def __init__(self, coefficients: np.ndarray, intercept: float,
+                 params: Optional[dict] = None):
+        self.coefficients = np.asarray(coefficients)
+        self.intercept = float(intercept)
+        self._params = dict(params or {})
+        self._training_summary: Optional[LinearRegressionTrainingSummary] = None
+        self._summary_source = None  # (frame, FitResult) until first access
+
+    # Parameter read-back used by the app (`App.java:141-146`)
+    def get_reg_param(self): return self._params.get("reg_param", 0.0)
+    def get_tol(self): return self._params.get("tol", 1e-6)
+    def get_max_iter(self): return self._params.get("max_iter", 100)
+    def get_elastic_net_param(self): return self._params.get("elastic_net_param", 0.0)
+
+    getRegParam = get_reg_param
+    getTol = get_tol
+    getMaxIter = get_max_iter
+    getElasticNetParam = get_elastic_net_param
+
+    @property
+    def features_col(self):
+        return self._params.get("features_col", "features")
+
+    @property
+    def prediction_col(self):
+        return self._params.get("prediction_col", "prediction")
+
+    @property
+    def label_col(self):
+        return self._params.get("label_col", "label")
+
+    @property
+    def num_features(self) -> int:
+        return int(self.coefficients.shape[0])
+
+    # -- inference ----------------------------------------------------------
+    def transform(self, frame: Frame) -> Frame:
+        """Append the prediction column (batch inference, one fused matvec —
+        `App.java:129`)."""
+        X = jnp.asarray(frame._column_values(self.features_col), float_dtype())
+        if X.ndim == 1:
+            X = X[:, None]
+        pred = X @ jnp.asarray(self.coefficients, X.dtype) + self.intercept
+        return frame.with_column(self.prediction_col, pred)
+
+    def predict(self, features) -> float:
+        """Host-side single-point inference (`App.java:149-151`) — a dot+add
+        with no device round-trip, like MLlib's driver-local predict."""
+        v = np.asarray(features, dtype=np.float64).reshape(-1)
+        return float(v @ self.coefficients.astype(np.float64) + self.intercept)
+
+    # -- summaries -----------------------------------------------------------
+    @property
+    def summary(self) -> "LinearRegressionTrainingSummary":
+        if self._training_summary is None:
+            if self._summary_source is None:
+                raise RuntimeError("model was not fit with summary (loaded model?)")
+            frame, result = self._summary_source
+            self._training_summary = LinearRegressionTrainingSummary(
+                self, frame, result)
+        return self._training_summary
+
+    @property
+    def has_summary(self) -> bool:
+        return self._training_summary is not None or self._summary_source is not None
+
+    hasSummary = has_summary
+
+    def evaluate(self, frame: Frame) -> "LinearRegressionSummary":
+        return LinearRegressionSummary(self, frame)
+
+    # -- persistence (capability upgrade over the reference; SURVEY.md §5
+    #    "Checkpoint / resume") ---------------------------------------------
+    def save(self, path: str) -> None:
+        os.makedirs(path, exist_ok=True)
+        write_json(os.path.join(path, "metadata.json"), {
+            "class": "LinearRegressionModel",
+            "intercept": self.intercept,
+            "params": self._params,
+        })
+        np.save(os.path.join(path, "coefficients.npy"), self.coefficients)
+
+    @classmethod
+    def load(cls, path: str) -> "LinearRegressionModel":
+        meta = read_json(os.path.join(path, "metadata.json"))
+        if meta.get("class") != "LinearRegressionModel":
+            raise ValueError(f"not a LinearRegressionModel checkpoint: {path}")
+        coef = np.load(os.path.join(path, "coefficients.npy"))
+        return cls(coef, meta["intercept"], meta.get("params"))
+
+
+class LinearRegressionSummary:
+    """Evaluation metrics over a frame's valid rows (mask-weighted — the
+    masked-filter semantics of SURVEY.md §7 never leak into the stats)."""
+
+    def __init__(self, model: LinearRegressionModel, frame: Frame):
+        self._model = model
+        self._frame = frame
+        pred_frame = model.transform(frame)
+        d = pred_frame.to_pydict()
+        self._label = d[model.label_col].astype(np.float64)
+        self._pred = d[model.prediction_col].astype(np.float64)
+        self._predictions_frame = pred_frame
+
+    @property
+    def predictions(self) -> Frame:
+        return self._predictions_frame
+
+    @property
+    def num_instances(self) -> int:
+        return int(self._label.shape[0])
+
+    numInstances = num_instances
+
+    @property
+    def residuals(self) -> Frame:
+        return Frame({"residuals": self._label - self._pred})
+
+    @property
+    def mean_squared_error(self) -> float:
+        return float(np.mean((self._label - self._pred) ** 2))
+
+    meanSquaredError = mean_squared_error
+
+    @property
+    def root_mean_squared_error(self) -> float:
+        return float(np.sqrt(self.mean_squared_error))
+
+    rootMeanSquaredError = root_mean_squared_error
+
+    @property
+    def mean_absolute_error(self) -> float:
+        return float(np.mean(np.abs(self._label - self._pred)))
+
+    meanAbsoluteError = mean_absolute_error
+
+    @property
+    def explained_variance(self) -> float:
+        return float(np.var(self._pred))
+
+    explainedVariance = explained_variance
+
+    @property
+    def r2(self) -> float:
+        ss_res = float(np.sum((self._label - self._pred) ** 2))
+        ss_tot = float(np.sum((self._label - np.mean(self._label)) ** 2))
+        if ss_tot == 0.0:  # constant label: undefined, like MLlib's 0/0 → NaN
+            return float("nan")
+        return 1.0 - ss_res / ss_tot
+
+    @property
+    def r2adj(self) -> float:
+        n = self.num_instances
+        d = self._model.num_features
+        return 1.0 - (1.0 - self.r2) * (n - 1) / (n - d - 1)
+
+    @property
+    def degrees_of_freedom(self) -> int:
+        extra = 1 if self._model._params.get("fit_intercept", True) else 0
+        return self.num_instances - self._model.num_features - extra
+
+    degreesOfFreedom = degrees_of_freedom
+
+
+class LinearRegressionTrainingSummary(LinearRegressionSummary):
+    """Training summary: evaluation metrics + solver trajectory
+    (`App.java:132-139`)."""
+
+    def __init__(self, model: LinearRegressionModel, frame: Frame,
+                 result: FitResult):
+        super().__init__(model, frame)
+        self._iterations = int(result.iterations)
+        hist = np.asarray(result.objective_history, dtype=np.float64)
+        # history[0] is the initial objective; keep entries up to convergence.
+        self._objective_history = hist[: self._iterations + 1]
+
+    @property
+    def total_iterations(self) -> int:
+        return self._iterations
+
+    totalIterations = total_iterations
+
+    @property
+    def objective_history(self) -> np.ndarray:
+        return self._objective_history
+
+    objectiveHistory = objective_history

@@ -1,0 +1,240 @@
+"""Elastic-net linear solvers on sufficient statistics — the MLlib
+``LinearRegression.train`` replacement, designed TPU-first.
+
+MLlib's fit (SURVEY.md §3.3) is: one ``treeAggregate`` pass for feature/label
+moments, then OWLQN iterations where every step broadcasts coefficients,
+computes per-partition gradients row-by-row, and reduces over netty RPC — two
+executor barriers per iteration.
+
+The TPU design collapses all data passes into **one augmented Gramian**:
+``A = ZᵀZ`` with ``Z = [X, y, 1] · mask`` — a single fused masked matmul on
+the MXU (+ one ``psum`` over the mesh when sharded; see
+``parallel/distributed.py``). Every quantity the solver needs — counts, means,
+sample variances, the centered/standardized Gram matrix ``G``, the correlation
+vector ``b``, and the label energy — unpacks from ``A`` on device. The whole
+iteration loop (FISTA proximal gradient, or orthant-wise L-BFGS) then runs on
+the tiny replicated ``(d×d)`` statistics inside one ``lax.scan`` — zero host
+round-trips, zero per-iteration data passes, vs. Spark's 40×2 RPC barriers
+(SURVEY.md §6 "Hard parts").
+
+Numeric convention (validated against SURVEY.md §2.3 golden tables):
+
+* sample std (n−1 denominator) for features and label (MLlib summarizer),
+* solve in standardized space: ``x̂ = (x − x̄)/σ_x``, ``ŷ = (y − ȳ)/σ_y``
+  (centering is implicit — it happens in the moment algebra, never on data),
+* ``effectiveRegParam = regParam/σ_y``; L1/L2 split by ``elasticNetParam``,
+* with ``standardization=False`` the per-feature L1/L2 weight becomes
+  ``1/σ_xj`` (penalty effectively on raw coefficients, MLlib semantics),
+* unscale: ``w_j = ŵ_j σ_y/σ_xj``; ``intercept = ȳ − w·x̄``.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class Moments(NamedTuple):
+    """Unpacked sufficient statistics (all device scalars/vectors)."""
+    n: jnp.ndarray           # valid-row count
+    mean_x: jnp.ndarray      # (d,)
+    mean_y: jnp.ndarray      # ()
+    std_x: jnp.ndarray       # (d,) sample std
+    std_y: jnp.ndarray       # ()
+    G: jnp.ndarray           # (d,d) standardized (centered) Gram / n
+    b: jnp.ndarray           # (d,)  standardized X'y / n
+    yy: jnp.ndarray          # ()    standardized y'y / n  (≈ (n-1)/n)
+    valid: jnp.ndarray       # (d,) bool — feature has nonzero variance
+
+
+class FitResult(NamedTuple):
+    coefficients: jnp.ndarray      # (d,) original scale
+    intercept: jnp.ndarray         # ()
+    iterations: jnp.ndarray        # () int32 — solver iterations run
+    objective_history: jnp.ndarray  # (max_iter+1,) scaled-objective trace
+    converged: jnp.ndarray         # () bool
+
+
+def augmented_gram(X: jnp.ndarray, y: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
+    """One-pass masked statistics: ``A = ZᵀZ``, ``Z = [X, y, 1]·mask``.
+
+    Shape ``(d+2, d+2)``. This is the entire data touch of a linear fit — the
+    ``treeAggregate`` analogue, as one MXU matmul per shard.
+    """
+    w = mask.astype(X.dtype)
+    ones = jnp.ones_like(y)
+    Z = jnp.concatenate([X, y[:, None], ones[:, None]], axis=1) * w[:, None]
+    return Z.T @ Z
+
+
+def unpack_moments(A: jnp.ndarray, fit_intercept: bool = True) -> Moments:
+    """A → means/stds/standardized Gram. Pure device algebra, no data."""
+    d = A.shape[0] - 2
+    n = A[d + 1, d + 1]
+    sum_x = A[:d, d + 1]
+    sum_y = A[d, d + 1]
+    mean_x = sum_x / n
+    mean_y = sum_y / n
+    # Centered second moments (always centered for std computation)
+    Cxx = A[:d, :d] - n * jnp.outer(mean_x, mean_x)
+    Cxy = A[:d, d] - n * mean_x * mean_y
+    Cyy = A[d, d] - n * mean_y * mean_y
+    denom = jnp.maximum(n - 1.0, 1.0)
+    var_x = jnp.clip(jnp.diag(Cxx), 0.0) / denom
+    var_y = jnp.clip(Cyy, 0.0) / denom
+    std_x = jnp.sqrt(var_x)
+    std_y = jnp.sqrt(var_y)
+    valid = std_x > 0
+    sx = jnp.where(valid, std_x, 1.0)
+    sy = jnp.where(std_y > 0, std_y, 1.0)
+    if not fit_intercept:
+        # MLlib without intercept: no centering in the objective (std still
+        # computed from centered moments above).
+        Cxx = A[:d, :d]
+        Cxy = A[:d, d]
+        Cyy = A[d, d]
+    G = Cxx / (n * jnp.outer(sx, sx))
+    b = jnp.where(valid, Cxy / (n * sx * sy), 0.0)
+    yy = Cyy / (n * sy * sy)
+    # Zero out invalid (constant) features so they never move off 0.
+    G = jnp.where(jnp.outer(valid, valid), G, jnp.where(
+        jnp.eye(d, dtype=bool), 1.0, 0.0))
+    return Moments(n, mean_x, mean_y, std_x, std_y, G, b, yy, valid)
+
+
+def _penalty_weights(m: Moments, standardization: bool) -> jnp.ndarray:
+    """Per-feature multiplier on the regularization in standardized space."""
+    if standardization:
+        return jnp.ones_like(m.std_x)
+    sx = jnp.where(m.valid, m.std_x, 1.0)
+    return jnp.where(m.valid, 1.0 / sx, 0.0)
+
+
+def _objective(w, m: Moments, lam1, lam2):
+    f = 0.5 * (m.yy - 2.0 * jnp.dot(m.b, w) + w @ m.G @ w)
+    return f + jnp.sum(lam1 * jnp.abs(w)) + 0.5 * jnp.sum(lam2 * w * w)
+
+
+def _soft(x, t):
+    return jnp.sign(x) * jnp.maximum(jnp.abs(x) - t, 0.0)
+
+
+@functools.partial(jax.jit, static_argnames=("max_iter", "fit_intercept",
+                                             "standardization"))
+def fista_solve(A: jnp.ndarray, reg_param, elastic_net_param,
+                max_iter: int = 100, tol: float = 1e-6,
+                fit_intercept: bool = True,
+                standardization: bool = True) -> FitResult:
+    """Accelerated proximal gradient (FISTA) on the standardized objective.
+
+    Reaches the same optimum as MLlib's OWLQN on the convex elastic net
+    (parity is defined on the solution, SURVEY.md §7 "Hard parts"); the whole
+    loop is one ``lax.scan`` with static shapes. ``objective_history[0]`` is
+    the loss at w=0 (≈0.5), matching MLlib's convention of recording the
+    initial objective.
+    """
+    m = unpack_moments(A, fit_intercept=fit_intercept)
+    dt = A.dtype
+    d = m.b.shape[0]
+    eff = jnp.asarray(reg_param, dt) / jnp.where(m.std_y > 0, m.std_y, 1.0)
+    alpha = jnp.asarray(elastic_net_param, dt)
+    u = _penalty_weights(m, standardization)
+    lam1 = alpha * eff * u
+    lam2 = (1.0 - alpha) * eff * u
+    # Lipschitz bound: ‖G‖₂ ≤ ‖G‖_F for PSD G; + max ridge term.
+    L = jnp.linalg.norm(m.G) + jnp.max(lam2, initial=0.0) + jnp.asarray(1e-12, dt)
+    step = 1.0 / L
+
+    w0 = jnp.zeros((d,), dt)
+    obj0 = _objective(w0, m, lam1, lam2)
+
+    def body(state, _):
+        w, w_prev, t, done, iters, last_obj = state
+        tn = 0.5 * (1.0 + jnp.sqrt(1.0 + 4.0 * t * t))
+        v = w + ((t - 1.0) / tn) * (w - w_prev)
+        grad = m.G @ v - m.b + lam2 * v
+        w_new = _soft(v - step * grad, step * lam1)
+        w_new = jnp.where(m.valid, w_new, 0.0)
+        obj = _objective(w_new, m, lam1, lam2)
+        # MLlib-style relative-improvement convergence test
+        rel = jnp.abs(obj - last_obj) / jnp.maximum(jnp.abs(last_obj), 1e-12)
+        now_done = jnp.logical_or(done, rel < tol)
+        w_out = jnp.where(done, w, w_new)
+        w_prev_out = jnp.where(done, w_prev, w)
+        t_out = jnp.where(done, t, tn)
+        obj_out = jnp.where(done, last_obj, obj)
+        iters_out = iters + jnp.where(done, 0, 1).astype(jnp.int32)
+        return (w_out, w_prev_out, t_out, now_done, iters_out, obj_out), obj_out
+
+    init = (w0, w0, jnp.asarray(1.0, dt), jnp.asarray(False), jnp.asarray(0, jnp.int32), obj0)
+    (w, _, _, done, iters, _), history = jax.lax.scan(body, init, None, length=max_iter)
+
+    sx = jnp.where(m.valid, m.std_x, 1.0)
+    sy = jnp.where(m.std_y > 0, m.std_y, 1.0)
+    coef = jnp.where(m.valid, w * sy / sx, 0.0)
+    intercept = (m.mean_y - jnp.dot(coef, m.mean_x)) if fit_intercept else jnp.asarray(0.0, dt)
+    history = jnp.concatenate([obj0[None], history])
+    return FitResult(coef, intercept, iters, history, done)
+
+
+@functools.partial(jax.jit, static_argnames=("fit_intercept", "standardization"))
+def normal_solve(A: jnp.ndarray, reg_param, elastic_net_param=0.0,
+                 fit_intercept: bool = True,
+                 standardization: bool = True) -> FitResult:
+    """Closed-form (normal-equations) path — MLlib's ``solver="normal"``,
+    valid when there is no L1 term. One small Cholesky solve on device."""
+    m = unpack_moments(A, fit_intercept=fit_intercept)
+    dt = A.dtype
+    d = m.b.shape[0]
+    eff = jnp.asarray(reg_param, dt) / jnp.where(m.std_y > 0, m.std_y, 1.0)
+    lam2 = (1.0 - jnp.asarray(elastic_net_param, dt)) * eff * _penalty_weights(m, standardization)
+    H = m.G + jnp.diag(lam2)
+    w = jnp.linalg.solve(H, m.b)
+    w = jnp.where(m.valid, w, 0.0)
+    sx = jnp.where(m.valid, m.std_x, 1.0)
+    sy = jnp.where(m.std_y > 0, m.std_y, 1.0)
+    coef = jnp.where(m.valid, w * sy / sx, 0.0)
+    intercept = (m.mean_y - jnp.dot(coef, m.mean_x)) if fit_intercept else jnp.asarray(0.0, dt)
+    history = jnp.zeros((1,), dt)
+    return FitResult(coef, intercept, jnp.asarray(0, jnp.int32), history,
+                     jnp.asarray(True))
+
+
+def resolve_solver(solver: str, reg_param: float, elastic_net_param: float) -> str:
+    """Map MLlib's ``solver`` param to a concrete solver name, with
+    ``auto`` semantics: normal equations when no L1 term is active, else the
+    iterative proximal path."""
+    has_l1 = (reg_param > 0.0) and (elastic_net_param > 0.0)
+    if solver == "normal" or (solver == "auto" and not has_l1):
+        if has_l1:
+            raise ValueError("solver='normal' cannot apply an L1 penalty")
+        return "normal"
+    if solver in ("auto", "fista", "proximal"):
+        return "fista"
+    if solver in ("owlqn", "l-bfgs", "lbfgs"):
+        return "owlqn"
+    raise ValueError(f"unknown solver {solver!r}")
+
+
+def solve(A: jnp.ndarray, reg_param: float, elastic_net_param: float,
+          max_iter: int, tol: float, fit_intercept: bool, standardization: bool,
+          solver: str = "auto") -> FitResult:
+    """Solver dispatch on a precomputed Gramian (see :func:`resolve_solver`)."""
+    name = resolve_solver(solver, reg_param, elastic_net_param)
+    if name == "normal":
+        return normal_solve(A, reg_param, elastic_net_param,
+                            fit_intercept=fit_intercept,
+                            standardization=standardization)
+    if name == "fista":
+        return fista_solve(A, reg_param, elastic_net_param, max_iter=max_iter,
+                           tol=tol, fit_intercept=fit_intercept,
+                           standardization=standardization)
+    from .owlqn import owlqn_solve
+
+    return owlqn_solve(A, reg_param, elastic_net_param, max_iter=max_iter,
+                       tol=tol, fit_intercept=fit_intercept,
+                       standardization=standardization)

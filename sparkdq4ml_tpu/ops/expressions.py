@@ -1,0 +1,306 @@
+"""Column expression trees.
+
+This is the framework's equivalent of the Spark column-expression surface the
+reference app exercises (``df.col``, ``callUDF``, ``cast``, comparisons in SQL
+``WHERE`` — `DataQuality4MachineLearningApp.java:68-90`). An ``Expr`` is a
+small host-side tree; evaluating it against a :class:`~sparkdq4ml_tpu.frame.Frame`
+produces a device array over *all* row slots (filtering is a validity mask, so
+shapes stay static for XLA — see SURVEY.md §7 step 1).
+
+Unlike Spark, where a UDF crosses the codegen→JVM-object boundary per row (the
+"UDF tax", SURVEY.md §3.2), every expression here is a vectorized jnp op that
+XLA fuses — the per-row boundary does not exist.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import float_dtype, int_dtype
+
+# Spark SQL type name → dtype factory. Mirrors the names printSchema uses.
+_TYPE_NAMES: dict[str, Callable[[], Any]] = {
+    "int": int_dtype,
+    "integer": int_dtype,
+    "long": lambda: jnp.int64 if jnp.zeros((), jnp.int64).dtype == jnp.int64 else jnp.int32,
+    "float": lambda: jnp.float32,
+    "double": float_dtype,
+    "boolean": lambda: jnp.bool_,
+    "string": lambda: np.dtype(object),
+}
+
+
+def spark_type_name(dtype) -> str:
+    """dtype → Spark printSchema type name (integer/long/float/double/boolean/string)."""
+    dt = np.dtype(dtype) if not isinstance(dtype, np.dtype) else dtype
+    if dt == np.int32 or dt == np.int16 or dt == np.int8:
+        return "integer"
+    if dt == np.int64:
+        return "long"
+    if dt == np.float32:
+        return "float"
+    if dt == np.float64:
+        return "double"
+    if dt == np.bool_:
+        return "boolean"
+    return "string"
+
+
+def resolve_type_name(name: str):
+    try:
+        return _TYPE_NAMES[name.lower()]()
+    except KeyError:
+        raise ValueError(f"unknown SQL type name: {name!r}") from None
+
+
+class Expr:
+    """Base column expression. Supports Python operators like Spark's Column."""
+
+    def eval(self, frame):  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    @property
+    def name(self) -> str:
+        """Default output-column name (Spark derives one from the expr string)."""
+        return str(self)
+
+    # -- fluent API (Spark Column methods) --------------------------------
+    def alias(self, name: str) -> "Alias":
+        return Alias(self, name)
+
+    def cast(self, type_name: str) -> "Cast":
+        return Cast(self, type_name)
+
+    def is_null(self) -> "Expr":
+        return UnaryOp("isnull", self)
+
+    def is_not_null(self) -> "Expr":
+        return UnaryOp("isnotnull", self)
+
+    # -- operators --------------------------------------------------------
+    def _bin(self, op, other, reverse=False):
+        other = other if isinstance(other, Expr) else Lit(other)
+        return BinOp(op, other, self) if reverse else BinOp(op, self, other)
+
+    def __add__(self, o):  return self._bin("+", o)
+    def __radd__(self, o): return self._bin("+", o, True)
+    def __sub__(self, o):  return self._bin("-", o)
+    def __rsub__(self, o): return self._bin("-", o, True)
+    def __mul__(self, o):  return self._bin("*", o)
+    def __rmul__(self, o): return self._bin("*", o, True)
+    def __truediv__(self, o):  return self._bin("/", o)
+    def __rtruediv__(self, o): return self._bin("/", o, True)
+    def __neg__(self):     return UnaryOp("-", self)
+    def __lt__(self, o):   return self._bin("<", o)
+    def __le__(self, o):   return self._bin("<=", o)
+    def __gt__(self, o):   return self._bin(">", o)
+    def __ge__(self, o):   return self._bin(">=", o)
+    def __eq__(self, o):   return self._bin("==", o)  # type: ignore[override]
+    def __ne__(self, o):   return self._bin("!=", o)  # type: ignore[override]
+    def __and__(self, o):  return self._bin("&", o)
+    def __rand__(self, o): return self._bin("&", o, True)
+    def __or__(self, o):   return self._bin("|", o)
+    def __ror__(self, o):  return self._bin("|", o, True)
+    def __invert__(self):  return UnaryOp("!", self)
+
+    __hash__ = object.__hash__  # __eq__ is overloaded; keep Exprs hashable
+
+
+class Col(Expr):
+    def __init__(self, name: str):
+        self._name = name
+
+    def eval(self, frame):
+        return frame._column_values(self._name)
+
+    @property
+    def name(self) -> str:
+        return self._name
+
+    def __str__(self):
+        return self._name
+
+
+class Lit(Expr):
+    def __init__(self, value):
+        self.value = value
+
+    def eval(self, frame):
+        n = frame.num_slots
+        if isinstance(self.value, bool):
+            return jnp.full((n,), self.value, dtype=jnp.bool_)
+        if isinstance(self.value, int):
+            return jnp.full((n,), self.value, dtype=int_dtype())
+        if isinstance(self.value, float):
+            return jnp.full((n,), self.value, dtype=float_dtype())
+        return np.full((n,), self.value, dtype=object)
+
+    def __str__(self):
+        return repr(self.value)
+
+
+class Alias(Expr):
+    def __init__(self, child: Expr, name: str):
+        self.child = child
+        self._name = name
+
+    def eval(self, frame):
+        return self.child.eval(frame)
+
+    @property
+    def name(self) -> str:
+        return self._name
+
+    def __str__(self):
+        return f"{self.child} AS {self._name}"
+
+
+_BIN_FNS = {
+    "+": jnp.add,
+    "-": jnp.subtract,
+    "*": jnp.multiply,
+    "/": jnp.divide,
+    "<": jnp.less,
+    "<=": jnp.less_equal,
+    ">": jnp.greater,
+    ">=": jnp.greater_equal,
+    "==": jnp.equal,
+    "!=": jnp.not_equal,
+    "&": jnp.logical_and,
+    "|": jnp.logical_or,
+}
+
+
+def _is_object(a) -> bool:
+    return isinstance(a, np.ndarray) and a.dtype == object
+
+
+def _promote(a, b):
+    """Numeric promotion for mixed host/device operands."""
+    return jnp.asarray(a), jnp.asarray(b)
+
+
+class BinOp(Expr):
+    def __init__(self, op: str, left: Expr, right: Expr):
+        self.op, self.left, self.right = op, left, right
+
+    def eval(self, frame):
+        a, b = self.left.eval(frame), self.right.eval(frame)
+        if _is_object(a) or _is_object(b):
+            # String columns live on host; comparisons stay in numpy.
+            np_fns = {"==": np.equal, "!=": np.not_equal}
+            if self.op not in np_fns:
+                raise TypeError(f"operator {self.op!r} unsupported on strings")
+            return np_fns[self.op](np.asarray(a, object), np.asarray(b, object)
+                                   ).astype(bool)
+        a, b = _promote(a, b)
+        if self.op == "/":
+            # Spark's / always yields double
+            a = jnp.asarray(a, float_dtype())
+            b = jnp.asarray(b, float_dtype())
+        return _BIN_FNS[self.op](a, b)
+
+    def __str__(self):
+        return f"({self.left} {self.op} {self.right})"
+
+
+class UnaryOp(Expr):
+    def __init__(self, op: str, child: Expr):
+        self.op, self.child = op, child
+
+    def eval(self, frame):
+        v = self.child.eval(frame)
+        if self.op == "-":
+            return jnp.negative(v)
+        if self.op == "!":
+            return jnp.logical_not(v)
+        if self.op in ("isnull", "isnotnull"):
+            if _is_object(v):  # string columns: None marks null
+                nulls = np.asarray([x is None for x in v], dtype=bool)
+                nulls = jnp.asarray(nulls)
+            elif hasattr(v, "dtype") and np.issubdtype(np.dtype(v.dtype), np.floating):
+                nulls = jnp.isnan(v)
+            else:
+                nulls = jnp.zeros(v.shape[:1], jnp.bool_)
+            return nulls if self.op == "isnull" else jnp.logical_not(nulls)
+        raise ValueError(self.op)
+
+    def __str__(self):
+        return f"({self.op}{self.child})"
+
+
+class Cast(Expr):
+    """CAST(expr AS type) — Spark semantics: double→int truncates toward zero."""
+
+    def __init__(self, child: Expr, type_name: str):
+        self.child = child
+        self.type_name = type_name
+
+    def eval(self, frame):
+        v = self.child.eval(frame)
+        dt = resolve_type_name(self.type_name)
+        if isinstance(dt, np.dtype) and dt == object:
+            return np.asarray([str(x) for x in np.asarray(v)], dtype=object)
+        return jnp.asarray(v).astype(dt)
+
+    @property
+    def name(self) -> str:
+        return f"CAST({self.child} AS {self.type_name.upper()})"
+
+    def __str__(self):
+        return self.name
+
+
+class UdfCall(Expr):
+    """Invocation of a registered UDF by name — ``callUDF`` equivalent.
+
+    Resolution happens at eval time against the registry, matching Spark's
+    name-based lookup (`DataQuality4MachineLearningApp.java:68-69,86-87`).
+    """
+
+    def __init__(self, udf_name: str, args: Sequence[Expr], registry=None):
+        self.udf_name = udf_name
+        self.args = list(args)
+        self._registry = registry
+
+    def eval(self, frame):
+        from .udf import default_registry
+
+        reg = self._registry if self._registry is not None else default_registry()
+        fn, return_dtype = reg.lookup(self.udf_name)
+        vals = [a.eval(frame) for a in self.args]
+        out = fn(*vals)
+        if return_dtype is not None:
+            out = jnp.asarray(out, return_dtype)
+        return out
+
+    @property
+    def name(self) -> str:
+        return f"{self.udf_name}({', '.join(str(a) for a in self.args)})"
+
+    def __str__(self):
+        return self.name
+
+
+# -- public constructors (mirrors org.apache.spark.sql.functions) ----------
+
+def col(name: str) -> Col:
+    return Col(name)
+
+
+def lit(value) -> Lit:
+    return Lit(value)
+
+
+def call_udf(name: str, *args) -> UdfCall:
+    """``functions.callUDF`` equivalent; accepts Exprs or column names."""
+    exprs = [a if isinstance(a, Expr) else Col(a) if isinstance(a, str) else Lit(a)
+             for a in args]
+    return UdfCall(name, exprs)
+
+
+# Spark naming alias
+callUDF = call_udf

@@ -1,0 +1,63 @@
+"""UDF registry — the engine capability behind ``spark.udf().register``.
+
+The reference registers two data-quality UDFs with an explicit return dtype
+(`DataQuality4MachineLearningApp.java:46-49`); registered names are callable
+from column expressions (``call_udf``) and from the SQL subset. Functions must
+be vectorized array→array (jnp) functions: the per-row boxed-object UDF call
+path of Spark (SURVEY.md §3.2) is replaced by whole-column ops XLA can fuse.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import numpy as np
+
+from ..config import float_dtype
+from .expressions import resolve_type_name
+
+
+class UDFRegistry:
+    """Name → (vectorized fn, return dtype). One per session; a process-wide
+    default registry backs sessions and bare ``call_udf`` use."""
+
+    def __init__(self):
+        self._fns: dict[str, tuple[Callable, Optional[np.dtype]]] = {}
+
+    def register(self, name: str, fn: Callable, return_type=None) -> Callable:
+        """Register ``fn`` under ``name``.
+
+        ``return_type`` may be a Spark SQL type name ("double", "integer", …)
+        — mirroring ``DataTypes.DoubleType`` at the registration site — or a
+        numpy/jnp dtype, or None to keep the fn's natural dtype.
+        """
+        if isinstance(return_type, str):
+            return_type = resolve_type_name(return_type)
+        self._fns[name] = (fn, return_type)
+        return fn
+
+    def lookup(self, name: str):
+        try:
+            return self._fns[name]
+        except KeyError:
+            raise KeyError(
+                f"UDF {name!r} is not registered "
+                f"(registered: {sorted(self._fns)})") from None
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._fns
+
+    def names(self):
+        return sorted(self._fns)
+
+
+_DEFAULT = UDFRegistry()
+
+
+def default_registry() -> UDFRegistry:
+    return _DEFAULT
+
+
+def register_udf(name: str, fn: Callable, return_type=None) -> Callable:
+    """Module-level convenience mirroring ``spark.udf().register(name, fn, type)``."""
+    return _DEFAULT.register(name, fn, return_type)

@@ -1,0 +1,2 @@
+from .mesh import (DATA_AXIS, data_sharding, make_mesh, parse_master,
+                   replicated_sharding)

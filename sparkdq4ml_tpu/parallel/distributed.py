@@ -1,0 +1,136 @@
+"""Distributed statistics: row sharding + ICI collectives.
+
+This is the ``treeAggregate``-over-netty replacement (SURVEY.md §3.3, §5
+"Distributed communication backend"): rows are sharded over the mesh's
+``data`` axis; each device computes its local augmented Gramian with one
+masked matmul; ``jax.lax.psum`` reduces over ICI. Coefficient "broadcast" is
+implicit in SPMD replication — the solver then runs identically on every
+device on the replicated statistics, so there is no driver↔executor boundary
+at all (zero host syncs per iteration vs. Spark's two).
+
+Padding: row counts rarely divide the mesh size; rows are padded with
+``mask=False`` slots, which the mask-weighted statistics ignore by
+construction — the same mechanism that makes DQ filtering static-shaped
+(SURVEY.md §7 "Masked-filter semantics").
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..models.solvers import augmented_gram
+from .mesh import DATA_AXIS
+
+
+def pad_rows(X: np.ndarray, y: np.ndarray, mask: np.ndarray, multiple: int):
+    """Pad the row dimension to a multiple of the shard count (mask=False)."""
+    n = X.shape[0]
+    rem = (-n) % multiple
+    if rem == 0:
+        return X, y, mask
+    Xp = np.concatenate([X, np.zeros((rem, X.shape[1]), X.dtype)])
+    yp = np.concatenate([y, np.zeros((rem,), y.dtype)])
+    mp = np.concatenate([mask, np.zeros((rem,), bool)])
+    return Xp, yp, mp
+
+
+@jax.jit
+def _gram_single(X, y, mask):
+    return augmented_gram(X, y, mask)
+
+
+@functools.lru_cache(maxsize=None)
+def _gram_sharded_fn(mesh: Mesh):
+    """Build (once per mesh) the jitted sharded Gramian: local matmul + psum."""
+
+    def local(X, y, mask):
+        return jax.lax.psum(augmented_gram(X, y, mask), DATA_AXIS)
+
+    sharded = jax.shard_map(
+        local, mesh=mesh,
+        in_specs=(P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS)),
+        out_specs=P())
+    return jax.jit(sharded)
+
+
+@functools.lru_cache(maxsize=None)
+def fused_linear_fit_fn(mesh: Optional[Mesh], solver: str, max_iter: int,
+                        tol: float, fit_intercept: bool, standardization: bool):
+    """ONE jitted program for the whole fit: sharded masked Gramian (+psum)
+    feeding the solver loop — a single dispatch, zero host round-trips.
+
+    This is the fit hot path ``LinearRegression.fit`` uses; Spark's
+    equivalent is 1 + 2·maxIter RPC barriers (SURVEY.md §3.3).
+    """
+    from ..models.owlqn import owlqn_solve
+    from ..models.solvers import fista_solve, normal_solve
+
+    if solver == "normal":
+        def solve_A(A, reg, alpha):
+            return normal_solve(A, reg, alpha, fit_intercept=fit_intercept,
+                                standardization=standardization)
+    elif solver == "owlqn":
+        def solve_A(A, reg, alpha):
+            return owlqn_solve(A, reg, alpha, max_iter=max_iter, tol=tol,
+                               fit_intercept=fit_intercept,
+                               standardization=standardization)
+    else:
+        def solve_A(A, reg, alpha):
+            return fista_solve(A, reg, alpha, max_iter=max_iter, tol=tol,
+                               fit_intercept=fit_intercept,
+                               standardization=standardization)
+
+    if mesh is None or mesh.devices.size <= 1:
+        def fit(X, y, mask, reg, alpha):
+            return solve_A(augmented_gram(X, y, mask), reg, alpha)
+    else:
+        sharded_gram = jax.shard_map(
+            lambda Xs, ys, ms: jax.lax.psum(augmented_gram(Xs, ys, ms), DATA_AXIS),
+            mesh=mesh,
+            in_specs=(P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS)),
+            out_specs=P())
+
+        def fit(X, y, mask, reg, alpha):
+            return solve_A(sharded_gram(X, y, mask), reg, alpha)
+
+    return jax.jit(fit)
+
+
+def place_sharded(X, y, mask, mesh: Optional[Mesh]):
+    """Pad rows to the shard count and device_put with row sharding.
+    Single-device/no-mesh inputs pass through as device arrays."""
+    if mesh is None or mesh.devices.size <= 1:
+        return (jnp.asarray(X), jnp.asarray(y), jnp.asarray(mask, jnp.bool_))
+    Xh, yh, mh = pad_rows(np.asarray(X), np.asarray(y), np.asarray(mask, bool),
+                          mesh.devices.size)
+    shard = NamedSharding(mesh, P(DATA_AXIS))
+    return (jax.device_put(Xh, shard), jax.device_put(yh, shard),
+            jax.device_put(mh, shard))
+
+
+def compute_gram(X, y, mask, mesh: Optional[Mesh] = None):
+    """Augmented Gramian ``A``, sharded over ``mesh`` when it has >1 device.
+
+    Accepts host or device arrays; on the sharded path, inputs are placed with
+    a row-sharded ``NamedSharding`` so each device holds only its shard (HBM
+    never sees the replicated matrix).
+    """
+    if mesh is None or mesh.devices.size <= 1:
+        return _gram_single(jnp.asarray(X), jnp.asarray(y),
+                            jnp.asarray(mask, jnp.bool_))
+    nshards = mesh.devices.size
+    Xh = np.asarray(X)
+    yh = np.asarray(y)
+    mh = np.asarray(mask, bool)
+    Xh, yh, mh = pad_rows(Xh, yh, mh, nshards)
+    shard = NamedSharding(mesh, P(DATA_AXIS))
+    Xd = jax.device_put(Xh, shard)
+    yd = jax.device_put(yh, shard)
+    md = jax.device_put(mh, shard)
+    return _gram_sharded_fn(mesh)(Xd, yd, md)

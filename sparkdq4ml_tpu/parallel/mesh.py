@@ -1,0 +1,65 @@
+"""Device mesh construction — the cluster-runtime init analogue.
+
+Spark's ``master("local[*]")`` (`DataQuality4MachineLearningApp.java:40`)
+spins up one in-process executor with task parallelism = host cores. The TPU
+equivalent (SURVEY.md §3.1) is device discovery + a 1-D ``jax.sharding.Mesh``
+over the chips; the data axis is named ``"data"`` because row-sharded data
+parallelism is the reference stack's only parallelism strategy (SURVEY.md §5
+"Parallelism strategies" — the model is two scalars; TP/PP/SP have nothing to
+act on and are deliberately not invented).
+
+Multi-host: ``jax.devices()`` already enumerates the global device set under
+``jax.distributed``; the same 1-D mesh then spans hosts, and the psum in the
+fit path rides ICI within a slice and DCN across slices — no framework code
+changes (that is the point of SPMD).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+DATA_AXIS = "data"
+
+
+def parse_master(master: Optional[str]) -> Optional[int]:
+    """Spark master string → device count (None = all available).
+
+    ``local[*]``/``local``/``tpu``/None → all devices; ``local[N]`` → N.
+    """
+    if master is None:
+        return None
+    m = master.strip().lower()
+    if m in ("local", "local[*]", "tpu", "tpu[*]", "*"):
+        return None
+    match = re.fullmatch(r"(?:local|tpu)\[(\d+)\]", m)
+    if match:
+        return int(match.group(1))
+    raise ValueError(f"unsupported master string {master!r}")
+
+
+def make_mesh(num_devices: Optional[int] = None,
+              devices: Optional[Sequence] = None,
+              axis_name: str = DATA_AXIS) -> Mesh:
+    """Build a 1-D data-parallel mesh over the first ``num_devices`` devices."""
+    if devices is None:
+        devices = jax.devices()
+    if num_devices is not None:
+        if num_devices > len(devices):
+            raise ValueError(
+                f"requested {num_devices} devices, only {len(devices)} present")
+        devices = devices[:num_devices]
+    return Mesh(np.asarray(devices), (axis_name,))
+
+
+def data_sharding(mesh: Mesh, axis_name: str = DATA_AXIS) -> NamedSharding:
+    """Rows sharded over the data axis (leading-dim sharding)."""
+    return NamedSharding(mesh, PartitionSpec(axis_name))
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec())

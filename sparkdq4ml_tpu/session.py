@@ -1,0 +1,119 @@
+"""TpuSession — the ``SparkSession`` equivalent.
+
+Covers the session surface the reference exercises
+(`DataQuality4MachineLearningApp.java:38-49`): builder with
+``appName``/``master``/``getOrCreate``, the UDF registry, the reader, SQL over
+temp views, and — the TPU-native part — the device mesh that replaces Spark's
+executor pool (SURVEY.md §3.1). There is no session daemon: "starting" a
+session is discovering devices and building a ``jax.sharding.Mesh``.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+import jax
+
+from .frame.csv import DataFrameReader
+from .ops.rules import register_builtin_rules
+from .ops.udf import UDFRegistry, default_registry
+from .parallel.mesh import make_mesh, parse_master
+from .sql.catalog import Catalog, default_catalog
+from .sql.parser import execute as _sql_execute
+
+logger = logging.getLogger("sparkdq4ml_tpu.session")
+
+_ACTIVE: Optional["TpuSession"] = None
+
+
+class TpuSession:
+    """Entry point: device mesh + catalog + UDF registry + reader."""
+
+    def __init__(self, app_name: str = "sparkdq4ml-tpu",
+                 master: Optional[str] = None,
+                 conf: Optional[dict] = None,
+                 register_rules: bool = False):
+        self.app_name = app_name
+        self.master = master
+        self.conf: dict[str, str] = dict(conf or {})
+        n = parse_master(master)
+        self.mesh = make_mesh(n)
+        self.catalog: Catalog = default_catalog()
+        self.udf: UDFRegistry = default_registry()
+        if register_rules:
+            register_builtin_rules(self.udf)
+        logger.debug("session %r: %d device(s), platform=%s", app_name,
+                     self.num_devices, jax.devices()[0].platform)
+
+    # -- builder (mirrors SparkSession.builder()...getOrCreate()) ----------
+    class Builder:
+        def __init__(self):
+            self._app_name = "sparkdq4ml-tpu"
+            self._master: Optional[str] = None
+            self._conf: dict[str, str] = {}
+
+        def app_name(self, name: str) -> "TpuSession.Builder":
+            self._app_name = name
+            return self
+
+        appName = app_name
+
+        def master(self, master: str) -> "TpuSession.Builder":
+            self._master = master
+            return self
+
+        def config(self, key: str, value) -> "TpuSession.Builder":
+            self._conf[key] = str(value)
+            return self
+
+        def get_or_create(self) -> "TpuSession":
+            global _ACTIVE
+            if _ACTIVE is None:
+                _ACTIVE = TpuSession(self._app_name, self._master, self._conf)
+            else:
+                _ACTIVE.conf.update(self._conf)  # Spark getOrCreate semantics
+            return _ACTIVE
+
+        getOrCreate = get_or_create
+
+    @classmethod
+    def builder(cls) -> "TpuSession.Builder":
+        return cls.Builder()
+
+    @classmethod
+    def active(cls) -> Optional["TpuSession"]:
+        return _ACTIVE
+
+    # -- surface ------------------------------------------------------------
+    @property
+    def devices(self):
+        return list(self.mesh.devices.flat)
+
+    @property
+    def num_devices(self) -> int:
+        return self.mesh.devices.size
+
+    @property
+    def read(self) -> DataFrameReader:
+        return DataFrameReader(self)
+
+    def sql(self, query: str):
+        """Run the SQL subset against this session's temp views
+        (`DataQuality4MachineLearningApp.java:77,89`)."""
+        return _sql_execute(query, self.catalog)
+
+    def create_data_frame(self, data, names=None):
+        from .frame.frame import Frame
+
+        if isinstance(data, dict):
+            return Frame(data)
+        return Frame.from_rows(data, names)
+
+    createDataFrame = create_data_frame
+
+    def stop(self) -> None:
+        global _ACTIVE
+        if _ACTIVE is self:
+            _ACTIVE = None
+        self.catalog.clear()

@@ -1,0 +1,2 @@
+from .catalog import Catalog, default_catalog
+from .parser import execute, parse

@@ -1,0 +1,35 @@
+"""Temp-view catalog backing ``createOrReplaceTempView`` + ``spark.sql``
+(`DataQuality4MachineLearningApp.java:76-78,88-90`)."""
+
+from __future__ import annotations
+
+
+class Catalog:
+    def __init__(self):
+        self._views: dict[str, object] = {}
+
+    def register(self, name: str, frame) -> None:
+        self._views[name.lower()] = frame
+
+    def lookup(self, name: str):
+        try:
+            return self._views[name.lower()]
+        except KeyError:
+            raise KeyError(f"temp view {name!r} not found "
+                           f"(views: {sorted(self._views)})") from None
+
+    def drop(self, name: str) -> bool:
+        return self._views.pop(name.lower(), None) is not None
+
+    def list_views(self):
+        return sorted(self._views)
+
+    def clear(self) -> None:
+        self._views.clear()
+
+
+_DEFAULT = Catalog()
+
+
+def default_catalog() -> Catalog:
+    return _DEFAULT

@@ -1,0 +1,256 @@
+"""Minimal SQL subset: SELECT / alias / CAST / function calls / WHERE.
+
+Exactly the surface the reference app exercises (SURVEY.md §2.2 "SQL over
+temp view"):
+
+    SELECT cast(guest as int) guest, price_no_min AS price
+    FROM price WHERE price_no_min > 0
+
+plus the obvious closures of that grammar (arithmetic, AND/OR/NOT, comparison
+chains, parentheses, literals, registered UDF calls). Queries compile to the
+same :mod:`~sparkdq4ml_tpu.ops.expressions` trees the fluent API builds, so SQL
+filtering is mask-AND like ``Frame.filter`` — one fused XLA predicate, not a
+row interpreter.
+
+Grammar (recursive descent):
+
+    query      := SELECT select_list FROM ident [WHERE or_expr]
+    select_list:= '*' | item (',' item)*
+    item       := expr [[AS] ident]
+    or_expr    := and_expr (OR and_expr)*
+    and_expr   := not_expr (AND not_expr)*
+    not_expr   := NOT not_expr | cmp
+    cmp        := add ((= | == | != | <> | < | <= | > | >=) add)?
+    add        := mul (('+'|'-') mul)*
+    mul        := unary (('*'|'/') unary)*
+    unary      := '-' unary | atom
+    atom       := number | 'string' | TRUE | FALSE | NULL
+                | CAST '(' expr AS ident ')'
+                | ident '(' [expr (',' expr)*] ')'     -- UDF call
+                | ident | '(' or_expr ')'
+"""
+
+from __future__ import annotations
+
+import math
+import re
+from typing import Optional
+
+from ..ops import expressions as E
+
+_TOKEN_RE = re.compile(
+    r"\s*(?:"
+    r"(?P<number>\d+\.\d*(?:[eE][+-]?\d+)?|\.\d+(?:[eE][+-]?\d+)?|\d+(?:[eE][+-]?\d+)?)"
+    r"|(?P<string>'(?:[^']|'')*')"
+    r"|(?P<ident>[A-Za-z_][A-Za-z_0-9]*)"
+    r"|(?P<op><=|>=|<>|!=|==|=|<|>|\+|-|\*|/|\(|\)|,)"
+    r")")
+
+_KEYWORDS = {"select", "from", "where", "as", "and", "or", "not", "cast",
+             "true", "false", "null"}
+
+
+class _Token:
+    __slots__ = ("kind", "value")
+
+    def __init__(self, kind: str, value: str):
+        self.kind = kind
+        self.value = value
+
+    def __repr__(self):
+        return f"{self.kind}:{self.value}"
+
+
+def tokenize(sql: str) -> list[_Token]:
+    tokens, pos = [], 0
+    while pos < len(sql):
+        if sql[pos:].strip() == "":
+            break
+        m = _TOKEN_RE.match(sql, pos)
+        if m is None or m.end() == pos:
+            raise ValueError(f"SQL syntax error near: {sql[pos:pos+20]!r}")
+        pos = m.end()
+        if m.group("number") is not None:
+            tokens.append(_Token("number", m.group("number")))
+        elif m.group("string") is not None:
+            tokens.append(_Token("string", m.group("string")[1:-1].replace("''", "'")))
+        elif m.group("ident") is not None:
+            ident = m.group("ident")
+            kind = "kw" if ident.lower() in _KEYWORDS else "ident"
+            tokens.append(_Token(kind, ident))
+        else:
+            tokens.append(_Token("op", m.group("op")))
+    tokens.append(_Token("eof", ""))
+    return tokens
+
+
+class _Parser:
+    def __init__(self, tokens: list[_Token]):
+        self.toks = tokens
+        self.i = 0
+
+    def peek(self) -> _Token:
+        return self.toks[self.i]
+
+    def next(self) -> _Token:
+        t = self.toks[self.i]
+        self.i += 1
+        return t
+
+    def accept(self, kind: str, value: Optional[str] = None) -> Optional[_Token]:
+        t = self.peek()
+        if t.kind == kind and (value is None or t.value.lower() == value):
+            return self.next()
+        return None
+
+    def expect(self, kind: str, value: Optional[str] = None) -> _Token:
+        t = self.accept(kind, value)
+        if t is None:
+            raise ValueError(f"SQL parse error: expected {value or kind}, "
+                             f"got {self.peek().value!r}")
+        return t
+
+    # -- query -------------------------------------------------------------
+    def parse_query(self):
+        self.expect("kw", "select")
+        items = self.parse_select_list()
+        self.expect("kw", "from")
+        view = self.expect("ident").value
+        where = None
+        if self.accept("kw", "where"):
+            where = self.parse_or()
+        self.expect("eof")
+        return items, view, where
+
+    def parse_select_list(self):
+        if self.accept("op", "*"):
+            return ["*"]
+        items = [self.parse_item()]
+        while self.accept("op", ","):
+            items.append(self.parse_item())
+        return items
+
+    def parse_item(self):
+        expr = self.parse_or()
+        if self.accept("kw", "as"):
+            return expr.alias(self.expect("ident").value)
+        alias = self.accept("ident")
+        if alias is not None:  # bare alias: `cast(guest as int) guest`
+            return expr.alias(alias.value)
+        return expr
+
+    # -- expressions (precedence climbing) ----------------------------------
+    def parse_or(self):
+        left = self.parse_and()
+        while self.accept("kw", "or"):
+            left = E.BinOp("|", left, self.parse_and())
+        return left
+
+    def parse_and(self):
+        left = self.parse_not()
+        while self.accept("kw", "and"):
+            left = E.BinOp("&", left, self.parse_not())
+        return left
+
+    def parse_not(self):
+        if self.accept("kw", "not"):
+            return E.UnaryOp("!", self.parse_not())
+        return self.parse_cmp()
+
+    _CMP = {"=": "==", "==": "==", "!=": "!=", "<>": "!=",
+            "<": "<", "<=": "<=", ">": ">", ">=": ">="}
+
+    def parse_cmp(self):
+        left = self.parse_add()
+        t = self.peek()
+        if t.kind == "op" and t.value in self._CMP:
+            self.next()
+            return E.BinOp(self._CMP[t.value], left, self.parse_add())
+        return left
+
+    def parse_add(self):
+        left = self.parse_mul()
+        while True:
+            if self.accept("op", "+"):
+                left = E.BinOp("+", left, self.parse_mul())
+            elif self.accept("op", "-"):
+                left = E.BinOp("-", left, self.parse_mul())
+            else:
+                return left
+
+    def parse_mul(self):
+        left = self.parse_unary()
+        while True:
+            if self.accept("op", "*"):
+                left = E.BinOp("*", left, self.parse_unary())
+            elif self.accept("op", "/"):
+                left = E.BinOp("/", left, self.parse_unary())
+            else:
+                return left
+
+    def parse_unary(self):
+        if self.accept("op", "-"):
+            return E.UnaryOp("-", self.parse_unary())
+        return self.parse_atom()
+
+    def parse_atom(self):
+        t = self.peek()
+        if t.kind == "number":
+            self.next()
+            text = t.value
+            if re.fullmatch(r"\d+", text):
+                return E.Lit(int(text))
+            return E.Lit(float(text))
+        if t.kind == "string":
+            self.next()
+            return E.Lit(t.value)
+        if self.accept("kw", "true"):
+            return E.Lit(True)
+        if self.accept("kw", "false"):
+            return E.Lit(False)
+        if self.accept("kw", "null"):
+            return E.Lit(math.nan)
+        if self.accept("kw", "cast"):
+            self.expect("op", "(")
+            inner = self.parse_or()
+            self.expect("kw", "as")
+            tname = self.expect("ident").value
+            self.expect("op", ")")
+            return E.Cast(inner, tname)
+        if t.kind == "ident":
+            self.next()
+            if self.accept("op", "("):
+                args = []
+                if not self.accept("op", ")"):
+                    args.append(self.parse_or())
+                    while self.accept("op", ","):
+                        args.append(self.parse_or())
+                    self.expect("op", ")")
+                return E.UdfCall(t.value, args)
+            return E.Col(t.value)
+        if self.accept("op", "("):
+            inner = self.parse_or()
+            self.expect("op", ")")
+            return inner
+        raise ValueError(f"SQL parse error at {t.value!r}")
+
+
+def parse(sql: str):
+    """Parse a query → (select items, view name, where Expr|None)."""
+    return _Parser(tokenize(sql)).parse_query()
+
+
+def execute(sql: str, catalog=None):
+    """Run a query against the catalog and return a Frame."""
+    from .catalog import default_catalog
+
+    cat = catalog if catalog is not None else default_catalog()
+    items, view, where = parse(sql)
+    frame = cat.lookup(view)
+    if where is not None:
+        frame = frame.filter(where)
+    # NB: Expr overloads ==, so compare with identity-safe checks, never
+    # `items == ["*"]` (a single-Expr list would compare truthy).
+    if len(items) == 1 and isinstance(items[0], str) and items[0] == "*":
+        return frame
+    return frame.select(*items)

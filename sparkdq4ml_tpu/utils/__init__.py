@@ -1,0 +1,2 @@
+from .logging import configure_logging
+from .profiling import PhaseTimer, block_until_ready, timed, trace

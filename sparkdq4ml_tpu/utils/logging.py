@@ -1,0 +1,28 @@
+"""Logging configuration mirroring the reference's log4j tiering.
+
+`log4j.properties:1-11`: root INFO → console with a timestamped pattern,
+``net.jgp`` at DEBUG, Spark/engine namespaces squelched to WARN/ERROR. The
+analogue here: framework namespace at DEBUG, root INFO, jax noise at WARN.
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+
+# log4j pattern was "%d{yyyy-MM-dd HH:mm:ss} %-5p %c{1}:%L - %m%n"
+_FORMAT = "%(asctime)s %(levelname)-5s %(name)s:%(lineno)d - %(message)s"
+_DATEFMT = "%Y-%m-%d %H:%M:%S"
+
+
+def configure_logging(framework_level: int = logging.DEBUG,
+                      root_level: int = logging.INFO,
+                      stream=None) -> None:
+    handler = logging.StreamHandler(stream or sys.stderr)
+    handler.setFormatter(logging.Formatter(_FORMAT, _DATEFMT))
+    root = logging.getLogger()
+    root.handlers = [handler]
+    root.setLevel(root_level)
+    logging.getLogger("sparkdq4ml_tpu").setLevel(framework_level)
+    for noisy in ("jax", "jax._src", "absl"):
+        logging.getLogger(noisy).setLevel(logging.WARNING)

@@ -1,0 +1,66 @@
+"""Tracing / profiling utilities (SURVEY.md §5 "Tracing / profiling").
+
+The reference exposes nothing beyond post-hoc ``objectiveHistory`` prints
+(`DataQuality4MachineLearningApp.java:133-136`). Here:
+
+* :class:`PhaseTimer` — per-phase wall-clock for the pipeline runner (the
+  observability the reference approximates with stdout banners),
+* :func:`trace` — context manager around ``jax.profiler`` emitting an XLA
+  trace viewable in TensorBoard/Perfetto, for the fit hot loop,
+* :func:`block_until_ready` — honest timing helper (JAX dispatch is async;
+  timings without a sync measure nothing).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import time
+from typing import Optional
+
+import jax
+
+logger = logging.getLogger("sparkdq4ml_tpu.profiling")
+
+
+def block_until_ready(tree):
+    return jax.block_until_ready(tree)
+
+
+class PhaseTimer:
+    """Collects named phase durations; ``report()`` returns a dict."""
+
+    def __init__(self):
+        self.phases: dict[str, float] = {}
+
+    @contextlib.contextmanager
+    def phase(self, name: str, sync=None):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            if sync is not None:
+                jax.block_until_ready(sync)
+            dt = time.perf_counter() - t0
+            self.phases[name] = self.phases.get(name, 0.0) + dt
+            logger.debug("phase %-20s %8.3f ms", name, dt * 1e3)
+
+    def report(self) -> dict[str, float]:
+        return dict(self.phases)
+
+
+@contextlib.contextmanager
+def trace(log_dir: Optional[str] = None):
+    """XLA profiler trace; no-op when log_dir is None."""
+    if log_dir is None:
+        yield
+        return
+    with jax.profiler.trace(log_dir):
+        yield
+
+
+@contextlib.contextmanager
+def timed(label: str = "block"):
+    t0 = time.perf_counter()
+    yield
+    logger.info("%s took %.3f ms", label, (time.perf_counter() - t0) * 1e3)

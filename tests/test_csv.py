@@ -1,0 +1,123 @@
+"""CSV reader: bare-CR records, inference ladder, options (SURVEY.md §2.2)."""
+
+import numpy as np
+import pytest
+
+from conftest import dataset_path
+from sparkdq4ml_tpu.frame.csv import (infer_column, read_csv, split_fields,
+                                      split_records)
+
+
+class TestSplitRecords:
+    def test_bare_cr(self):
+        assert split_records("a\rb\rc\r") == ["a", "b", "c"]
+
+    def test_crlf(self):
+        assert split_records("a\r\nb\r\nc") == ["a", "b", "c"]
+
+    def test_lf(self):
+        assert split_records("a\nb\n") == ["a", "b"]
+
+    def test_mixed_and_blank(self):
+        assert split_records("a\r\n\nb\r\rc\n") == ["a", "b", "c"]
+
+
+class TestSplitFields:
+    def test_plain(self):
+        assert split_fields("1,23.1") == ["1", "23.1"]
+
+    def test_quoted_comma(self):
+        assert split_fields('a,"b,c",d') == ["a", "b,c", "d"]
+
+    def test_escaped_quote(self):
+        assert split_fields('"say ""hi""",x') == ['say "hi"', "x"]
+
+
+class TestInference:
+    def test_int(self):
+        col = infer_column(["1", "2", "3"])
+        assert col.dtype == np.int32
+        assert list(col) == [1, 2, 3]
+
+    def test_long(self):
+        col = infer_column(["1", str(2**40)])
+        assert col.dtype == np.int64
+
+    def test_double(self):
+        col = infer_column(["1.5", "2"])
+        assert col.dtype == np.float64
+        assert list(col) == [1.5, 2.0]
+
+    def test_int_with_null_promotes_to_double(self):
+        col = infer_column(["1", "", "3"])
+        assert col.dtype == np.float64
+        assert np.isnan(col[1])
+
+    def test_boolean(self):
+        col = infer_column(["true", "False", "TRUE"])
+        assert col.dtype == np.bool_
+        assert list(col) == [True, False, True]
+
+    def test_string(self):
+        col = infer_column(["a", "1"])
+        assert col.dtype == object
+
+    def test_scientific_notation(self):
+        assert infer_column(["1e3", "2.5e-2"]).dtype == np.float64
+
+
+class TestReadReferenceDatasets:
+    """The bare-CR edge case on the actual fixtures — a naive \\n split would
+    yield one giant record (SURVEY.md §2.2)."""
+
+    @pytest.mark.parametrize("name,rows", [("abstract", 40), ("small", 27),
+                                           ("full", 1040)])
+    def test_row_counts(self, name, rows):
+        df = read_csv(dataset_path(name), header=False, infer_schema=True)
+        assert df.count() == rows
+
+    def test_schema_and_names(self):
+        df = read_csv(dataset_path("abstract"))
+        assert df.columns == ["_c0", "_c1"]
+        assert dict(df.dtypes())["_c0"] == "integer"
+        assert dict(df.dtypes())["_c1"] == "double"
+
+    def test_first_row(self):
+        df = read_csv(dataset_path("small"))
+        rows = df.take(1)
+        assert rows[0] == (1, 23.1)
+
+
+class TestReaderBuilder:
+    def test_spark_call_shape(self, session):
+        df = (session.read.format("csv").option("inferSchema", "true")
+              .option("header", "false").load(dataset_path("abstract")))
+        assert df.count() == 40
+
+    def test_header_option(self, tmp_path):
+        p = tmp_path / "h.csv"
+        p.write_text("guest,price\n1,23.1\n")
+        df = read_csv(str(p), header=True, infer_schema=True)
+        assert df.columns == ["guest", "price"]
+        assert df.count() == 1
+
+    def test_no_infer_keeps_strings(self, tmp_path):
+        p = tmp_path / "s.csv"
+        p.write_text("1,2\n")
+        df = read_csv(str(p), header=False, infer_schema=False)
+        assert dict(df.dtypes())["_c0"] == "string"
+
+    def test_missing_file_raises(self, session):
+        with pytest.raises(FileNotFoundError):
+            session.read.format("csv").load("/nonexistent.csv")
+
+    def test_unsupported_format(self, session):
+        with pytest.raises(ValueError):
+            session.read.format("parquet").load(dataset_path("small"))
+
+    def test_ragged_rows_pad_with_null(self, tmp_path):
+        p = tmp_path / "r.csv"
+        p.write_text("1,2.0\n3\n")
+        df = read_csv(str(p), header=False, infer_schema=True)
+        d = df.to_pydict()
+        assert np.isnan(d["_c1"][1])

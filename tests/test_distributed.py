@@ -1,0 +1,99 @@
+"""Distributed fit: sharded-psum path ≡ single-device path on a fake 8-device
+CPU mesh (SURVEY.md §4 'Distributed-without-a-cluster', §7 step 5)."""
+
+import jax
+import numpy as np
+import pytest
+
+from conftest import (assert_devices, dataset_path, prepare_features,
+                      run_dq_pipeline)
+from sparkdq4ml_tpu.models import LinearRegression
+from sparkdq4ml_tpu.models.solvers import augmented_gram
+from sparkdq4ml_tpu.parallel.distributed import compute_gram, pad_rows
+from sparkdq4ml_tpu.parallel.mesh import make_mesh, parse_master
+
+
+class TestMesh:
+    def test_eight_fake_devices(self):
+        assert_devices(8)
+
+    def test_parse_master(self):
+        assert parse_master("local[*]") is None
+        assert parse_master("local[4]") == 4
+        assert parse_master("tpu[2]") == 2
+        assert parse_master(None) is None
+        with pytest.raises(ValueError):
+            parse_master("yarn")
+
+    def test_make_mesh_sizes(self):
+        assert make_mesh().devices.size == len(jax.devices())
+        assert make_mesh(4).devices.size == 4
+        with pytest.raises(ValueError):
+            make_mesh(10**6)
+
+
+class TestPadding:
+    def test_pad_rows(self):
+        X = np.ones((10, 1))
+        y = np.ones(10)
+        m = np.ones(10, bool)
+        Xp, yp, mp = pad_rows(X, y, m, 8)
+        assert Xp.shape == (16, 1)
+        assert mp.sum() == 10  # pad slots are masked out
+
+    def test_no_pad_when_divisible(self):
+        X = np.ones((16, 1))
+        Xp, _, _ = pad_rows(X, np.ones(16), np.ones(16, bool), 8)
+        assert Xp is X
+
+
+class TestShardedGram:
+    def test_sharded_equals_single(self):
+        rng = np.random.default_rng(0)
+        X = rng.normal(size=(103, 3))
+        y = rng.normal(size=103)
+        mask = rng.random(103) > 0.2
+        mesh = make_mesh(8)
+        A_sharded = np.asarray(compute_gram(X, y, mask, mesh=mesh))
+        A_single = np.asarray(compute_gram(X, y, mask, mesh=None))
+        np.testing.assert_allclose(A_sharded, A_single, rtol=1e-10)
+
+    def test_gram_contents(self):
+        X = np.asarray([[1.0], [2.0], [3.0]])
+        y = np.asarray([1.0, 2.0, 4.0])
+        mask = np.asarray([True, True, False])
+        A = np.asarray(augmented_gram(jax.numpy.asarray(X),
+                                      jax.numpy.asarray(y),
+                                      jax.numpy.asarray(mask)))
+        assert A[2, 2] == 2.0            # n
+        assert A[0, 2] == 3.0            # sum x
+        assert A[1, 2] == 3.0            # sum y
+        assert A[0, 0] == 5.0            # sum x²
+        assert A[0, 1] == 5.0            # sum xy
+
+
+class TestShardedFit:
+    @pytest.mark.parametrize("n_dev", [2, 8])
+    def test_sharded_fit_equals_single(self, session, n_dev):
+        df = prepare_features(run_dq_pipeline(session, dataset_path("full")))
+        lr = LinearRegression(max_iter=40, reg_param=1.0, elastic_net_param=1.0)
+        m_single = lr.fit(df, mesh=make_mesh(1))
+        m_shard = lr.fit(df, mesh=make_mesh(n_dev))
+        assert float(m_shard.coefficients[0]) == pytest.approx(
+            float(m_single.coefficients[0]), rel=1e-10)
+        assert m_shard.intercept == pytest.approx(m_single.intercept, rel=1e-10)
+
+    def test_session_mesh_used_by_default(self):
+        """A session with master local[8] row-shards fits over 8 devices and
+        still reproduces the golden result."""
+        from sparkdq4ml_tpu import TpuSession
+
+        s = TpuSession.builder().app_name("dist").master("local[8]").get_or_create()
+        try:
+            assert s.num_devices == 8
+            df = prepare_features(run_dq_pipeline(s, dataset_path("full")))
+            model = LinearRegression(max_iter=40, reg_param=1.0,
+                                     elastic_net_param=1.0).fit(df)
+            assert float(model.coefficients[0]) == pytest.approx(4.878392, abs=2e-5)
+        finally:
+            s.stop()

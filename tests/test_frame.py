@@ -1,0 +1,151 @@
+"""Frame engine: mask-based filtering, column ops, Spark-shaped display."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sparkdq4ml_tpu import Frame, col, lit
+
+
+@pytest.fixture
+def df():
+    # plain lists exercise _as_column's default-dtype path (int32/double)
+    return Frame({"guest": [1, 2, 3, 4],
+                  "price": [23.1, 30.0, 15.0, 40.0]})
+
+
+class TestBasics:
+    def test_columns_and_count(self, df):
+        assert df.columns == ["guest", "price"]
+        assert df.count() == 4
+        assert df.num_slots == 4
+
+    def test_with_column_expr(self, df):
+        out = df.with_column("double_price", col("price") * 2)
+        assert out.collect()[0][2] == pytest.approx(46.2)
+
+    def test_with_column_replaces(self, df):
+        out = df.with_column("price", col("price") + 1)
+        assert out.columns == ["guest", "price"]
+        assert out.collect()[0][1] == pytest.approx(24.1)
+
+    def test_rename(self, df):
+        out = df.with_column_renamed("guest", "g")
+        assert out.columns == ["g", "price"]
+        # Spark semantics: renaming a missing column is a no-op
+        assert df.with_column_renamed("nope", "x").columns == df.columns
+
+    def test_select(self, df):
+        out = df.select("price", (col("guest") + 1).alias("g1"))
+        assert out.columns == ["price", "g1"]
+        assert out.collect()[0] == pytest.approx((23.1, 2))
+
+    def test_drop(self, df):
+        assert df.drop("guest").columns == ["price"]
+
+    def test_unknown_column_raises(self, df):
+        with pytest.raises(KeyError):
+            df.col("nope")
+
+
+class TestMaskFiltering:
+    """Filtering is mask-AND; shapes stay static (SURVEY.md §7 step 1)."""
+
+    def test_filter_keeps_slots(self, df):
+        out = df.filter(col("price") >= 20)
+        assert out.num_slots == 4      # static shape preserved
+        assert out.count() == 3        # logical rows filtered
+
+    def test_filter_chains_and(self, df):
+        out = df.filter(col("price") >= 20).filter(col("guest") < 4)
+        assert out.count() == 2
+
+    def test_collect_applies_mask(self, df):
+        out = df.filter(col("price") < 20)
+        assert out.collect() == [(3, 15.0)]
+
+    def test_limit(self, df):
+        assert df.filter(col("price") >= 20).limit(2).count() == 2
+
+    def test_union(self, df):
+        both = df.union(df.filter(col("guest") == 1))
+        assert both.count() == 5
+
+
+class TestDisplay:
+    def test_show_string_format(self, df):
+        s = df.show_string(2)
+        lines = s.splitlines()
+        assert lines[0] == "+-----+-----+"
+        assert lines[1] == "|guest|price|"
+        assert lines[3] == "|    1| 23.1|"
+        assert "only showing top 2 rows" in s
+
+    def test_show_all_rows_no_footer(self, df):
+        assert "only showing" not in df.show_string(50)
+
+    def test_truncate_long_strings(self):
+        f = Frame({"s": np.asarray(["x" * 30], dtype=object)})
+        s = f.show_string()
+        assert "x" * 17 + "..." in s
+        assert "x" * 21 not in s
+
+    def test_print_schema(self, df):
+        txt = df.schema_string()
+        assert txt.splitlines()[0] == "root"
+        assert " |-- guest: integer (nullable = true)" in txt
+        assert " |-- price: double (nullable = true)" in txt
+
+    def test_vector_column_display(self, df):
+        from sparkdq4ml_tpu.models import VectorAssembler
+
+        out = VectorAssembler(["guest"], "features").transform(df)
+        assert "[1.0]" in out.show_string()
+        assert " |-- features: vector (nullable = true)" in out.schema_string()
+
+    def test_nan_displays_as_NaN(self):
+        f = Frame({"x": jnp.asarray([float("nan")])})
+        assert "NaN" in f.show_string()
+
+
+class TestActions:
+    def test_take_head_first(self, df):
+        assert df.take(2) == [(1, 23.1), (2, 30.0)]
+        assert df.head() == (1, 23.1)
+        assert df.first() == (1, 23.1)
+
+    def test_to_pydict(self, df):
+        d = df.to_pydict()
+        assert list(d["guest"]) == [1, 2, 3, 4]
+
+    def test_from_rows(self):
+        f = Frame.from_rows([(1, "a"), (2, "b")], ["n", "s"])
+        assert f.collect() == [(1, "a"), (2, "b")]
+
+    def test_empty_frame(self):
+        assert Frame({}).count() == 0
+
+    def test_from_rows_exhausted_iterator_keeps_names(self):
+        f = Frame.from_rows(iter([]), ["a", "b"])
+        assert f.columns == ["a", "b"]
+        assert f.count() == 0
+
+
+class TestNullSemantics:
+    def test_is_null_on_string_column_detects_none(self):
+        f = Frame({"s": np.asarray(["a", None, "b"], dtype=object)})
+        out = f.filter(col("s").is_null())
+        assert out.count() == 1
+        assert f.filter(col("s").is_not_null()).count() == 2
+
+    def test_is_null_on_float_column_detects_nan(self):
+        f = Frame({"x": [1.0, float("nan")]})
+        assert f.filter(col("x").is_null()).count() == 1
+
+    def test_constant_label_r2_is_nan(self):
+        from sparkdq4ml_tpu.models import LinearRegression, VectorAssembler
+
+        f = Frame({"x": [1.0, 2.0, 3.0], "label": [5.0, 5.0, 5.0]})
+        f = VectorAssembler(["x"], "features").transform(f)
+        m = LinearRegression().fit(f)
+        assert np.isnan(m.summary.r2)

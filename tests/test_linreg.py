@@ -1,0 +1,210 @@
+"""LinearRegression golden-number parity (SURVEY.md §2.3 tables) and API."""
+
+import numpy as np
+import pytest
+
+from conftest import dataset_path, prepare_features, run_dq_pipeline
+from sparkdq4ml_tpu.models import (LinearRegression, LinearRegressionModel,
+                                   Vectors)
+
+# SURVEY.md §2.3: Lasso under the app's config (maxIter=40, regParam=1,
+# elasticNetParam=1) — (coef, intercept, rmse, r2, predict40)
+LASSO_GOLDEN = {
+    "abstract": (4.923331, 21.010309, 2.809940, 0.996515, 217.9436),
+    "small": (4.902938, 21.391522, 2.731280, 0.996407, 217.5090),
+    "full": (4.878392, 23.964108, 1.805140, 0.998743, 219.0998),
+}
+# SURVEY.md §2.3: OLS (no regularization) — (slope, intercept, rmse, r2, predict40)
+OLS_GOLDEN = {
+    "abstract": (5.0315, 19.5323, 2.6177, 0.9970, 220.79),
+    "small": (5.0161, 19.7173, 2.5313, 0.9969, 220.36),
+    "full": (4.9762, 22.2180, 1.5025, 0.9991, 221.27),
+}
+
+
+def _fit(session, name, **lr_kwargs):
+    df = prepare_features(run_dq_pipeline(session, dataset_path(name)))
+    defaults = dict(max_iter=40, reg_param=1.0, elastic_net_param=1.0)
+    defaults.update(lr_kwargs)
+    return df, LinearRegression(**defaults).fit(df)
+
+
+@pytest.mark.parametrize("name", ["abstract", "small", "full"])
+class TestLassoGolden:
+    def test_fista_matches_golden(self, session, name):
+        _, model = _fit(session, name)
+        coef, intercept, rmse, r2, p40 = LASSO_GOLDEN[name]
+        assert float(model.coefficients[0]) == pytest.approx(coef, abs=2e-5)
+        assert model.intercept == pytest.approx(intercept, abs=2e-4)
+        s = model.summary
+        assert s.root_mean_squared_error == pytest.approx(rmse, abs=2e-5)
+        assert s.r2 == pytest.approx(r2, abs=1e-5)
+        assert model.predict(Vectors.dense(40.0)) == pytest.approx(p40, abs=2e-3)
+
+    def test_owlqn_matches_golden(self, session, name):
+        _, model = _fit(session, name, solver="owlqn")
+        coef, intercept, *_ = LASSO_GOLDEN[name]
+        assert float(model.coefficients[0]) == pytest.approx(coef, abs=2e-5)
+        assert model.intercept == pytest.approx(intercept, abs=2e-4)
+
+    def test_ols_matches_golden(self, session, name):
+        _, model = _fit(session, name, reg_param=0.0, elastic_net_param=0.0)
+        slope, intercept, rmse, r2, p40 = OLS_GOLDEN[name]
+        assert float(model.coefficients[0]) == pytest.approx(slope, abs=1e-4)
+        assert model.intercept == pytest.approx(intercept, abs=1e-3)
+        assert model.summary.root_mean_squared_error == pytest.approx(rmse, abs=1e-3)
+        assert model.predict([40.0]) == pytest.approx(p40, abs=0.02)
+
+
+class TestSklearnParity:
+    """Independent oracle (SURVEY.md §4 'Parity oracle'), ≤1% RMSE budget."""
+
+    def test_lasso_vs_sklearn(self, session):
+        sklearn = pytest.importorskip("sklearn.linear_model")
+        df, model = _fit(session, "full")
+        d = df.to_pydict()
+        X = d["guest"].astype(np.float64).reshape(-1, 1)
+        y = d["label"].astype(np.float64)
+        # sklearn objective: 1/(2n)||y-Xw||² + α||w||₁ on *raw* data; MLlib
+        # standardizes, so map α = regParam·σ_y⁻¹·σ_y·(σ_x-stdized) — instead
+        # fit sklearn on standardized data with α=regParam/σ_y and unscale.
+        sx, sy = X.std(ddof=1), y.std(ddof=1)
+        las = sklearn.Lasso(alpha=1.0 / sy, max_iter=10000, tol=1e-10)
+        las.fit((X - X.mean()) / sx, (y - y.mean()) / sy)
+        coef_sklearn = las.coef_[0] * sy / sx
+        assert float(model.coefficients[0]) == pytest.approx(coef_sklearn, rel=1e-4)
+        rmse_sklearn = np.sqrt(np.mean(
+            (y - (coef_sklearn * X[:, 0] + (y.mean() - coef_sklearn * X.mean()))) ** 2))
+        assert model.summary.root_mean_squared_error == pytest.approx(
+            rmse_sklearn, rel=0.01)  # the ≤1% budget
+
+
+class TestSolverPaths:
+    def test_auto_without_l1_uses_normal(self, session):
+        _, model = _fit(session, "small", reg_param=0.0, elastic_net_param=0.0)
+        assert model.summary.total_iterations == 0  # normal-equations path
+
+    def test_normal_solver_rejects_l1(self, session):
+        df = prepare_features(run_dq_pipeline(session, dataset_path("small")))
+        lr = LinearRegression(reg_param=1.0, elastic_net_param=1.0, solver="normal")
+        with pytest.raises(ValueError):
+            lr.fit(df)
+
+    def test_unknown_solver(self, session):
+        df = prepare_features(run_dq_pipeline(session, dataset_path("small")))
+        with pytest.raises(ValueError):
+            LinearRegression(solver="quantum").fit(df)
+
+    def test_ridge(self, session):
+        """elastic_net_param=0, reg_param>0 → pure L2, closed form vs manual."""
+        df, model = _fit(session, "small", reg_param=0.5, elastic_net_param=0.0)
+        d = df.to_pydict()
+        x = d["guest"].astype(np.float64)
+        y = d["label"].astype(np.float64)
+        n = len(x)
+        sx, sy = x.std(ddof=1), y.std(ddof=1)
+        xc, yc = (x - x.mean()) / sx, (y - y.mean()) / sy
+        lam = 0.5 / sy
+        w = (xc @ yc / n) / (xc @ xc / n + lam)
+        coef = w * sy / sx
+        assert float(model.coefficients[0]) == pytest.approx(coef, rel=1e-6)
+
+    def test_elastic_net_mixed(self, session):
+        """α=0.5 mixed penalty: FISTA and OWLQN must agree on the optimum."""
+        _, m1 = _fit(session, "small", reg_param=0.8, elastic_net_param=0.5)
+        _, m2 = _fit(session, "small", reg_param=0.8, elastic_net_param=0.5,
+                     solver="owlqn")
+        assert float(m1.coefficients[0]) == pytest.approx(
+            float(m2.coefficients[0]), rel=1e-5)
+        assert m1.intercept == pytest.approx(m2.intercept, rel=1e-5)
+
+    def test_fit_intercept_false(self, session):
+        _, model = _fit(session, "small", reg_param=0.0, elastic_net_param=0.0,
+                        fit_intercept=False)
+        assert model.intercept == 0.0
+        df = prepare_features(run_dq_pipeline(session, dataset_path("small")))
+        d = df.to_pydict()
+        x = d["guest"].astype(np.float64)
+        y = d["label"].astype(np.float64)
+        w = (x @ y) / (x @ x)  # no-intercept OLS
+        assert float(model.coefficients[0]) == pytest.approx(w, rel=1e-5)
+
+
+class TestSummary:
+    def test_objective_history_convention(self, session):
+        _, model = _fit(session, "abstract")
+        hist = model.summary.objective_history
+        # loss at w=0 is ½·(n−1)/n (standardized label energy)
+        assert hist[0] == pytest.approx(0.5 * 23 / 24, abs=1e-9)
+        assert len(hist) == model.summary.total_iterations + 1
+        assert hist[-1] <= hist[0]
+
+    def test_residuals_frame(self, session):
+        df, model = _fit(session, "abstract")
+        res = model.summary.residuals
+        assert res.columns == ["residuals"]
+        assert res.count() == 24
+        d = res.to_pydict()["residuals"]
+        assert np.sqrt(np.mean(d ** 2)) == pytest.approx(
+            model.summary.root_mean_squared_error, rel=1e-9)
+
+    def test_num_instances_masked(self, session):
+        _, model = _fit(session, "abstract")
+        assert model.summary.num_instances == 24  # not 40 — mask never leaks
+
+    def test_param_readback(self, session):
+        _, model = _fit(session, "small")
+        assert model.get_reg_param() == 1.0
+        assert model.getTol() == 1e-6
+        assert model.getElasticNetParam() == 1.0
+
+    def test_evaluate_on_new_frame(self, session):
+        df, model = _fit(session, "small")
+        s = model.evaluate(df)
+        assert s.root_mean_squared_error == pytest.approx(
+            model.summary.root_mean_squared_error, rel=1e-12)
+
+    def test_r2adj_and_dof(self, session):
+        _, model = _fit(session, "abstract")
+        s = model.summary
+        assert s.degrees_of_freedom == 24 - 1 - 1
+        assert s.r2adj == pytest.approx(1 - (1 - s.r2) * 23 / 22, rel=1e-12)
+
+
+class TestModelApi:
+    def test_transform_adds_prediction(self, session):
+        df, model = _fit(session, "abstract")
+        out = model.transform(df)
+        assert "prediction" in out.columns
+        d = out.to_pydict()
+        expected = model.coefficients[0] * d["guest"].astype(float) + model.intercept
+        np.testing.assert_allclose(d["prediction"], expected, rtol=1e-6)
+
+    def test_predict_scalar_and_vector(self, session):
+        _, model = _fit(session, "abstract")
+        assert model.predict(Vectors.dense(40.0)) == pytest.approx(
+            model.predict([40.0]))
+
+    def test_save_load_roundtrip(self, session, tmp_path):
+        _, model = _fit(session, "small")
+        path = str(tmp_path / "model")
+        model.save(path)
+        loaded = LinearRegressionModel.load(path)
+        assert loaded.intercept == model.intercept
+        np.testing.assert_array_equal(loaded.coefficients, model.coefficients)
+        assert loaded.get_reg_param() == 1.0
+        assert not loaded.has_summary
+        with pytest.raises(RuntimeError):
+            _ = loaded.summary
+
+    def test_setters_fluent_and_camel(self):
+        lr = (LinearRegression().setMaxIter(7).setRegParam(0.3)
+              .setElasticNetParam(0.7).setTol(1e-4).setSolver("fista"))
+        assert (lr.max_iter, lr.reg_param, lr.elastic_net_param, lr.tol,
+                lr.solver) == (7, 0.3, 0.7, 1e-4, "fista")
+
+    def test_mllib_defaults(self):
+        lr = LinearRegression()
+        assert (lr.max_iter, lr.reg_param, lr.elastic_net_param, lr.tol,
+                lr.fit_intercept, lr.standardization, lr.solver) == (
+            100, 0.0, 0.0, 1e-6, True, True, "auto")

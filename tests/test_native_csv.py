@@ -1,0 +1,83 @@
+"""Native C++ CSV engine: parity with the Python parser on the reference
+fixtures, fallback behavior, and the ctypes contract. Skipped when
+native/libdqcsv.so is not built (`make -C native`)."""
+
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from conftest import dataset_path
+from sparkdq4ml_tpu.frame import native_csv
+from sparkdq4ml_tpu.frame.csv import read_csv
+
+needs_native = pytest.mark.skipif(not native_csv.available(),
+                                  reason="native/libdqcsv.so not built")
+
+
+@needs_native
+class TestNativeParity:
+    @pytest.mark.parametrize("name,rows", [("abstract", 40), ("small", 27),
+                                           ("full", 1040)])
+    def test_reference_datasets_match_python_engine(self, name, rows):
+        py = read_csv(dataset_path(name), engine="python")
+        nat = read_csv(dataset_path(name), engine="native")
+        assert nat.count() == py.count() == rows
+        assert nat.columns == py.columns
+        for col in py.columns:
+            np.testing.assert_allclose(
+                np.asarray(nat.to_pydict()[col], np.float64),
+                np.asarray(py.to_pydict()[col], np.float64), rtol=1e-12)
+        assert dict(nat.dtypes()) == dict(py.dtypes())
+
+    def test_bare_cr_handled(self, tmp_path):
+        p = tmp_path / "cr.csv"
+        p.write_bytes(b"1,2.5\r3,4.5\r")
+        df = read_csv(str(p), engine="native")
+        assert df.count() == 2
+        assert df.collect() == [(1, 2.5), (3, 4.5)]
+
+    def test_empty_field_is_nan_and_promotes(self, tmp_path):
+        p = tmp_path / "n.csv"
+        p.write_bytes(b"1,2\n,3\n")
+        df = read_csv(str(p), engine="native")
+        d = df.to_pydict()
+        assert np.isnan(d["_c0"][1])
+        assert dict(df.dtypes())["_c0"] in ("double", "float")
+
+    def test_non_numeric_falls_back_to_python(self, tmp_path):
+        p = tmp_path / "s.csv"
+        p.write_bytes(b"a,1\nb,2\n")
+        df = read_csv(str(p), engine="auto")  # native returns -1 -> python
+        assert dict(df.dtypes())["_c0"] == "string"
+        assert df.count() == 2
+
+    def test_native_engine_rejects_non_numeric_when_forced(self, tmp_path):
+        p = tmp_path / "s.csv"
+        p.write_bytes(b"a,1\n")
+        # engine="native" means "use the native tokenizer when the content
+        # allows"; non-numeric content degrades to the python parser rather
+        # than failing the read.
+        df = read_csv(str(p), engine="native")
+        assert dict(df.dtypes())["_c0"] == "string"
+
+    def test_missing_file(self):
+        with pytest.raises(FileNotFoundError):
+            read_csv("/nonexistent-file.csv", engine="native")
+
+
+def test_engine_native_unavailable_raises(monkeypatch):
+    monkeypatch.setattr(native_csv, "_LIB", None)
+    monkeypatch.setattr(native_csv, "_LIB_TRIED", True)
+    with pytest.raises(RuntimeError):
+        native_csv.try_read_csv("x.csv", header=False, infer_schema=True,
+                                delimiter=",", required=True)
+
+
+def test_python_engine_never_touches_native(monkeypatch):
+    calls = []
+    monkeypatch.setattr(native_csv, "try_read_csv",
+                        lambda *a, **k: calls.append(1) or None)
+    read_csv(dataset_path("small"), engine="python")
+    assert calls == []

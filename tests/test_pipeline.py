@@ -1,0 +1,104 @@
+"""End-to-end golden pipeline: the full reference app flow
+(`DataQuality4MachineLearningApp.java:37-155`) through this framework,
+asserted against SURVEY.md §2.3 fixtures — including the bare-CR CSV parse,
+both DQ rules via registered UDFs + SQL, VectorAssembler, Lasso fit, summary,
+and single-point prediction."""
+
+import pytest
+
+from conftest import dataset_path, prepare_features, run_dq_pipeline
+from sparkdq4ml_tpu.models import LinearRegression, Vectors
+
+ROW_COUNTS = {"abstract": (40, 34, 24), "small": (27, 24, 20),
+              "full": (1040, 1034, 1024)}
+
+
+@pytest.mark.parametrize("name", ["abstract", "small", "full"])
+def test_dq_row_counts(session, name):
+    import sparkdq4ml_tpu as dq
+
+    raw, after1, after2 = ROW_COUNTS[name]
+    dq.register_builtin_rules()
+    df = (session.read.format("csv").option("inferSchema", "true")
+          .option("header", "false").load(dataset_path(name)))
+    assert df.count() == raw
+    df = df.with_column_renamed("_c0", "guest").with_column_renamed("_c1", "price")
+    df = df.with_column("price_no_min",
+                        dq.call_udf("minimumPriceRule", dq.col("price")))
+    df.create_or_replace_temp_view("price")
+    df = session.sql("SELECT cast(guest as int) guest, price_no_min AS price "
+                     "FROM price WHERE price_no_min > 0")
+    assert df.count() == after1
+    df = df.with_column("price_correct_correl",
+                        dq.call_udf("priceCorrelationRule", dq.col("price"),
+                                    dq.col("guest")))
+    df.create_or_replace_temp_view("price")
+    df = session.sql("SELECT guest, price_correct_correl AS price "
+                     "FROM price WHERE price_correct_correl > 0")
+    assert df.count() == after2
+
+
+def test_full_app_flow_abstract(session):
+    """The dataset the app actually loads (`App.java:52`): end-state checks."""
+    df = run_dq_pipeline(session, dataset_path("abstract"))
+    df = prepare_features(df)
+    assert df.columns == ["guest", "price", "label", "features"]
+
+    lr = (LinearRegression().setMaxIter(40).setRegParam(1)
+          .setElasticNetParam(1))
+    model = lr.fit(df)
+
+    predicted = model.transform(df)
+    assert "prediction" in predicted.columns
+    assert predicted.count() == 24
+
+    s = model.summary
+    assert s.total_iterations >= 1
+    assert len(s.objective_history) == s.total_iterations + 1
+    assert s.residuals.count() == 24
+    assert s.root_mean_squared_error == pytest.approx(2.809940, abs=1e-4)
+    assert s.r2 == pytest.approx(0.996515, abs=1e-5)
+
+    assert model.intercept == pytest.approx(21.010309, abs=1e-3)
+    assert model.get_reg_param() == 1.0
+    assert model.get_tol() == 1e-6
+
+    p = model.predict(Vectors.dense(40.0))
+    assert p == pytest.approx(217.9436, abs=5e-3)
+
+
+def test_pipeline_api_equivalent(session):
+    """Same flow as a Pipeline(stages=[assembler, lr]) — the MLlib pipeline
+    contract generalized beyond what the app hand-rolls."""
+    from sparkdq4ml_tpu.models import Pipeline, VectorAssembler
+
+    df = run_dq_pipeline(session, dataset_path("abstract"))
+    df = df.with_column("label", df.col("price"))
+    pipe = Pipeline([
+        VectorAssembler(["guest"], "features"),
+        LinearRegression(max_iter=40, reg_param=1.0, elastic_net_param=1.0),
+    ])
+    pm = pipe.fit(df)
+    out = pm.transform(df)
+    assert "prediction" in out.columns
+    assert out.count() == 24
+
+
+def test_float32_precision_envelope(session):
+    """TPU default dtype (float32) stays within the ≤1% RMSE budget
+    (BASELINE.md target row)."""
+    import jax.numpy as jnp
+
+    from sparkdq4ml_tpu.config import config
+
+    saved = config.default_float_dtype
+    config.default_float_dtype = jnp.float32
+    try:
+        df = prepare_features(run_dq_pipeline(session, dataset_path("full")))
+        model = LinearRegression(max_iter=40, reg_param=1.0,
+                                 elastic_net_param=1.0).fit(df)
+        assert model.summary.root_mean_squared_error == pytest.approx(
+            1.805140, rel=0.01)
+        assert float(model.coefficients[0]) == pytest.approx(4.878392, rel=0.005)
+    finally:
+        config.default_float_dtype = saved

@@ -1,0 +1,82 @@
+"""DQ rule semantics — thresholds, sentinel, and the null-handling asymmetry
+(SURVEY.md §2.1: UDF1 NPEs on null, UDF2 maps null→−1)."""
+
+import math
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sparkdq4ml_tpu.ops.rules import (MIN_PRICE, minimum_price_rule,
+                                      price_correlation_rule,
+                                      register_builtin_rules)
+from sparkdq4ml_tpu.ops.udf import UDFRegistry
+
+
+class TestMinimumPriceRule:
+    """`MinimumPriceDataQualityService.java:7-13`: price < 20 → −1."""
+
+    def test_below_threshold(self):
+        assert float(minimum_price_rule(19.99)) == -1.0
+
+    def test_at_threshold_kept(self):
+        assert float(minimum_price_rule(20.0)) == 20.0
+
+    def test_above_threshold(self):
+        assert float(minimum_price_rule(150.0)) == 150.0
+
+    def test_vectorized(self):
+        out = minimum_price_rule(jnp.asarray([5.0, 20.0, 25.0]))
+        assert list(np.asarray(out)) == [-1.0, 20.0, 25.0]
+
+    def test_nan_propagates(self):
+        """No null guard in the reference UDF1 — NaN (our null analogue)
+        poisons the output instead of being mapped to −1."""
+        assert math.isnan(float(minimum_price_rule(float("nan"))))
+
+    def test_threshold_constant(self):
+        assert MIN_PRICE == 20.0
+
+
+class TestPriceCorrelationRule:
+    """`PriceCorrelationDataQualityService.java:5-10`: guest<14 ∧ price>90 → −1."""
+
+    def test_implausible_combo_flagged(self):
+        assert float(price_correlation_rule(95.0, 10)) == -1.0
+
+    def test_boundaries_kept(self):
+        assert float(price_correlation_rule(90.0, 10)) == 90.0   # price not > 90
+        assert float(price_correlation_rule(95.0, 14)) == 95.0   # guest not < 14
+
+    def test_plausible_kept(self):
+        assert float(price_correlation_rule(200.0, 30)) == 200.0
+
+    def test_null_price_maps_to_sentinel(self):
+        """UDF2 is null-safe (`PriceCorrelationDataQualityUdf.java:12-14`)."""
+        assert float(price_correlation_rule(float("nan"), 10)) == -1.0
+
+    def test_null_guest_maps_to_sentinel(self):
+        assert float(price_correlation_rule(50.0, float("nan"))) == -1.0
+
+    def test_vectorized(self):
+        out = price_correlation_rule(jnp.asarray([95.0, 50.0]), jnp.asarray([10, 10]))
+        assert list(np.asarray(out)) == [-1.0, 50.0]
+
+
+class TestRegistration:
+    def test_registers_reference_names(self):
+        reg = UDFRegistry()
+        register_builtin_rules(reg)
+        assert "minimumPriceRule" in reg
+        assert "priceCorrelationRule" in reg
+
+    def test_registry_lookup_unknown(self):
+        reg = UDFRegistry()
+        with pytest.raises(KeyError):
+            reg.lookup("nope")
+
+    def test_return_dtype_applied(self):
+        reg = UDFRegistry()
+        reg.register("toInt", lambda x: x, "integer")
+        fn, dtype = reg.lookup("toInt")
+        assert np.dtype(dtype) == np.int32

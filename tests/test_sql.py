@@ -1,0 +1,98 @@
+"""SQL subset: the reference's exact queries plus grammar closure."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import sparkdq4ml_tpu as dq
+from sparkdq4ml_tpu import Frame
+from sparkdq4ml_tpu.sql.parser import execute, parse, tokenize
+
+
+@pytest.fixture
+def view(session):
+    f = Frame({"guest": jnp.asarray([1.0, 2.0, 3.0]),
+               "price": jnp.asarray([10.0, -1.0, 30.0])})
+    f.create_or_replace_temp_view("price")
+    return f
+
+
+class TestReferenceQueries:
+    """The two statements at `DataQuality4MachineLearningApp.java:77-78,89-90`."""
+
+    def test_first_cleanup_query(self, session, view):
+        out = session.sql("SELECT cast(guest as int) guest, price AS price_x "
+                          "FROM price WHERE price > 0")
+        assert out.columns == ["guest", "price_x"]
+        assert out.count() == 2
+        assert dict(out.dtypes())["guest"] == "integer"
+
+    def test_second_cleanup_query(self, session, view):
+        out = session.sql("SELECT guest, price FROM price WHERE price > 0")
+        assert out.count() == 2
+
+
+class TestGrammar:
+    def test_select_star(self, session, view):
+        assert session.sql("SELECT * FROM price").count() == 3
+
+    def test_where_and_or_not(self, session, view):
+        assert session.sql("SELECT * FROM price WHERE price > 0 AND guest < 3").count() == 1
+        assert session.sql("SELECT * FROM price WHERE price < 0 OR guest = 1").count() == 2
+        assert session.sql("SELECT * FROM price WHERE NOT price > 0").count() == 1
+
+    def test_arithmetic(self, session, view):
+        out = session.sql("SELECT price * 2 + 1 AS p2 FROM price")
+        assert out.to_pydict()["p2"][0] == pytest.approx(21.0)
+
+    def test_comparison_operators(self, session, view):
+        for op, n in [("=", 1), ("==", 1), ("!=", 2), ("<>", 2), ("<=", 2),
+                      (">=", 2), ("<", 1), (">", 1)]:
+            assert session.sql(f"SELECT * FROM price WHERE guest {op} 2").count() == n, op
+
+    def test_parentheses(self, session, view):
+        q = "SELECT * FROM price WHERE (guest = 1 OR guest = 3) AND price > 0"
+        assert session.sql(q).count() == 2
+
+    def test_string_literal(self, session):
+        Frame({"s": np.asarray(["a", "b"], dtype=object)}).create_or_replace_temp_view("t")
+        # string equality is host-side numpy compare
+        out = execute("SELECT * FROM t WHERE s = 'a'")
+        assert out.count() == 1
+
+    def test_udf_call_in_sql(self, session, view):
+        dq.register_builtin_rules()
+        out = session.sql("SELECT minimumPriceRule(price) AS p FROM price")
+        assert list(out.to_pydict()["p"]) == [-1.0, -1.0, 30.0]
+
+    def test_negative_literal(self, session, view):
+        assert session.sql("SELECT * FROM price WHERE price = -1").count() == 1
+
+    def test_float_literals(self):
+        items, view_name, where = parse("SELECT 1.5 AS x FROM t WHERE y > 1e3")
+        assert view_name == "t"
+
+    def test_bare_alias(self, session, view):
+        out = session.sql("SELECT cast(guest as int) g FROM price")
+        assert out.columns == ["g"]
+
+
+class TestErrors:
+    def test_unknown_view(self, session):
+        with pytest.raises(KeyError):
+            session.sql("SELECT * FROM nope")
+
+    def test_syntax_error(self, session, view):
+        with pytest.raises(ValueError):
+            session.sql("SELECT FROM price")
+
+    def test_garbage(self):
+        with pytest.raises(ValueError):
+            tokenize("SELECT ยง FROM x")
+
+    def test_trailing_tokens(self, session, view):
+        with pytest.raises(ValueError):
+            session.sql("SELECT * FROM price WHERE price > 0 extra nonsense")
+
+    def test_case_insensitive_keywords(self, session, view):
+        assert execute("select * from PRICE where price > 0").count() == 2
