@@ -1,6 +1,14 @@
 from .base import Estimator, Model, Pipeline, PipelineModel, Transformer
+from .classification import (BinaryLogisticRegressionSummary,
+                             BinaryLogisticRegressionTrainingSummary,
+                             LogisticRegression, LogisticRegressionModel)
+from .evaluation import (BinaryClassificationEvaluator, Evaluator,
+                         MulticlassClassificationEvaluator,
+                         RegressionEvaluator)
 from .feature import VectorAssembler
 from .linalg import Vectors
 from .regression import (LinearRegression, LinearRegressionModel,
                          LinearRegressionSummary,
                          LinearRegressionTrainingSummary)
+from .tuning import (CrossValidator, CrossValidatorModel, ParamGridBuilder,
+                     TrainValidationSplit, TrainValidationSplitModel)

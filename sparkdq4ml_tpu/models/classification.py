@@ -1,0 +1,410 @@
+"""LogisticRegression — binary elastic-net classifier, MLlib convention
+(BASELINE.json config: "LogisticRegression binary classifier on DQ-filtered
+rows"; the reference app itself has no classifier, so the API mirrors the
+estimator surface its LinearRegression exercises at
+`DataQuality4MachineLearningApp.java:120-151`).
+
+TPU-first fit path: unlike the linear case (one Gramian suffices —
+solvers.py), logistic loss needs per-iteration data passes. The whole FISTA
+loop therefore runs inside ONE jitted ``lax.scan`` over the row-sharded data:
+each iteration computes the local masked gradient and reduces the ``(d+2)``
+gradient/loss vector with a single ``psum`` over the mesh — this is the true
+per-iteration ``treeAggregate`` analogue (SURVEY.md §3.3), with the
+coefficient "broadcast" implicit in SPMD replication and zero host syncs for
+the entire optimization.
+
+Numeric convention (MLlib LogisticRegression):
+
+* features scaled by sample std (no centering — matches MLlib's
+  sparsity-preserving choice); intercept fit unpenalized,
+* mean log-loss objective; ``effectiveRegParam = regParam`` (no label
+  scaling, unlike linear regression),
+* with ``standardization=False`` the penalty lands on the raw coefficients:
+  L1 weight ``1/σ_j``, L2 weight ``1/σ_j²``, as in the linear case.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..config import float_dtype
+from ..frame.frame import Frame
+from ..parallel.mesh import DATA_AXIS
+from .base import Estimator, Model, read_json, write_json
+from .regression import _extract_xy
+from .solvers import _soft
+
+
+class LogisticFitResult(NamedTuple):
+    coefficients: jnp.ndarray
+    intercept: jnp.ndarray
+    iterations: jnp.ndarray
+    objective_history: jnp.ndarray
+    converged: jnp.ndarray
+
+
+def _feature_stats(X, y, mask):
+    """Masked n, feature std (sample), for standardization — one pass."""
+    w = mask.astype(X.dtype)
+    n = jnp.sum(w)
+    mean = (w @ X) / n
+    var = (w @ (X * X)) / n - mean * mean
+    denom = jnp.maximum(n - 1.0, 1.0)
+    std = jnp.sqrt(jnp.clip(var * n / denom, 0.0))
+    return n, std
+
+
+def _logistic_core(X, y, mask, reg_param, alpha, n, std,
+                   max_iter, tol, fit_intercept, standardization, axis=None):
+    """FISTA on mean log-loss over (possibly sharded) rows.
+
+    When ``axis`` is set (inside shard_map), every per-row reduction is
+    followed by a psum over that axis; n/std are passed in already global.
+    """
+    dt = X.dtype
+    d = X.shape[1]
+    valid = std > 0
+    sx = jnp.where(valid, std, 1.0)
+    Xs = (X / sx) * mask.astype(dt)[:, None]   # standardized, masked rows
+    yv = y.astype(dt) * mask.astype(dt)
+    wm = mask.astype(dt)
+
+    # penalty on raw coefficients when standardization=False: u1=1/sigma for
+    # L1, u2=1/sigma^2 for L2 (see solvers._penalty_weights)
+    u1 = jnp.ones((d,), dt) if standardization else jnp.where(valid, 1.0 / sx, 0.0)
+    lam1 = alpha * reg_param * u1
+    lam2 = (1.0 - alpha) * reg_param * (u1 if standardization else u1 * u1)
+
+    def reduce_(v):
+        return jax.lax.psum(v, axis) if axis is not None else v
+
+    # Lipschitz bound: λmax(XᵀX/n)/4 ≤ ‖Xs‖_F²/(4n)
+    sq = reduce_(jnp.sum(Xs * Xs))
+    L = sq / (4.0 * n) + jnp.max(lam2, initial=0.0) + jnp.asarray(1e-12, dt)
+    step = 1.0 / L
+
+    def loss_grad(wb):
+        w, b = wb[:d], wb[d]
+        margin = Xs @ w + b * wm
+        # stable log(1+exp(-z)) with z = (2y-1)*margin
+        z = (2.0 * yv - wm) * margin
+        ll = jnp.where(mask, jnp.logaddexp(0.0, -z), 0.0)
+        p = jax.nn.sigmoid(margin)
+        resid = (p - yv) * wm
+        g_w = Xs.T @ resid
+        g_b = jnp.sum(resid)
+        packed = jnp.concatenate([g_w, jnp.array([g_b, jnp.sum(ll)])])
+        packed = reduce_(packed)
+        grad = packed[: d + 1] / n
+        # ridge term belongs to the smooth part (L1 is handled by the prox)
+        grad = grad.at[:d].add(lam2 * wb[:d])
+        loss = packed[d + 1] / n
+        if not fit_intercept:
+            grad = grad.at[d].set(0.0)
+        return loss, grad
+
+    def objective(wb, loss):
+        w = wb[:d]
+        return loss + jnp.sum(lam1 * jnp.abs(w)) + 0.5 * jnp.sum(lam2 * w * w)
+
+    wb0 = jnp.zeros((d + 1,), dt)
+    loss0, _ = loss_grad(wb0)
+    obj0 = objective(wb0, loss0)
+
+    def body(state, _):
+        wb, wb_prev, t, done, iters, last_obj = state
+        tn = 0.5 * (1.0 + jnp.sqrt(1.0 + 4.0 * t * t))
+        v = wb + ((t - 1.0) / tn) * (wb - wb_prev)
+        loss_v, grad = loss_grad(v)
+        cand = v - step * grad
+        w_new = jnp.where(valid, _soft(cand[:d], step * lam1), 0.0)
+        b_new = jnp.where(fit_intercept, cand[d], 0.0)
+        wb_new = jnp.concatenate([w_new, b_new[None]])
+        loss_new, _ = loss_grad(wb_new)
+        obj = objective(wb_new, loss_new)
+        rel = jnp.abs(obj - last_obj) / jnp.maximum(jnp.abs(last_obj), 1e-12)
+        now_done = jnp.logical_or(done, rel < tol)
+        wb_out = jnp.where(done, wb, wb_new)
+        wb_prev_out = jnp.where(done, wb_prev, wb)
+        t_out = jnp.where(done, t, tn)
+        obj_out = jnp.where(done, last_obj, obj)
+        iters_out = iters + jnp.where(done, 0, 1).astype(jnp.int32)
+        return (wb_out, wb_prev_out, t_out, now_done, iters_out, obj_out), obj_out
+
+    init = (wb0, wb0, jnp.asarray(1.0, dt), jnp.asarray(False),
+            jnp.asarray(0, jnp.int32), obj0)
+    (wb, _, _, done, iters, _), history = jax.lax.scan(body, init, None,
+                                                       length=max_iter)
+    coef = jnp.where(valid, wb[:d] / sx, 0.0)   # unscale to raw features
+    intercept = wb[d]
+    history = jnp.concatenate([obj0[None], history])
+    return LogisticFitResult(coef, intercept, iters, history, done)
+
+
+@functools.lru_cache(maxsize=None)
+def fused_logistic_fit_fn(mesh: Optional[Mesh], max_iter: int, tol: float,
+                          fit_intercept: bool, standardization: bool):
+    """One jitted program: stats pass + FISTA scan (+ per-iteration psum when
+    sharded). Mirrors ``fused_linear_fit_fn``."""
+
+    if mesh is None or mesh.devices.size <= 1:
+        def fit(X, y, mask, reg, alpha):
+            n, std = _feature_stats(X, y, mask)
+            return _logistic_core(X, y, mask, reg, alpha, n, std, max_iter,
+                                  tol, fit_intercept, standardization)
+    else:
+        def local(X, y, mask, reg, alpha):
+            w = mask.astype(X.dtype)
+            parts = jnp.concatenate([w @ X, w @ (X * X), jnp.sum(w)[None]])
+            parts = jax.lax.psum(parts, DATA_AXIS)
+            d = X.shape[1]
+            n = parts[2 * d]
+            mean = parts[:d] / n
+            var = parts[d: 2 * d] / n - mean * mean
+            std = jnp.sqrt(jnp.clip(var * n / jnp.maximum(n - 1.0, 1.0), 0.0))
+            return _logistic_core(X, y, mask, reg, alpha, n, std, max_iter,
+                                  tol, fit_intercept, standardization,
+                                  axis=DATA_AXIS)
+
+        fit = jax.shard_map(
+            local, mesh=mesh,
+            in_specs=(P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P(), P()),
+            out_specs=P())
+
+    return jax.jit(fit)
+
+
+class LogisticRegression(Estimator):
+    """Binary logistic regression with elastic-net regularization."""
+
+    def __init__(self, max_iter: int = 100, reg_param: float = 0.0,
+                 elastic_net_param: float = 0.0, tol: float = 1e-6,
+                 fit_intercept: bool = True, standardization: bool = True,
+                 threshold: float = 0.5, family: str = "binomial",
+                 features_col: str = "features", label_col: str = "label",
+                 prediction_col: str = "prediction",
+                 probability_col: str = "probability",
+                 raw_prediction_col: str = "rawPrediction"):
+        if family not in ("auto", "binomial"):
+            raise ValueError("only binomial (binary) family is supported")
+        self.max_iter = max_iter
+        self.reg_param = reg_param
+        self.elastic_net_param = elastic_net_param
+        self.tol = tol
+        self.fit_intercept = fit_intercept
+        self.standardization = standardization
+        self.threshold = threshold
+        self.family = family
+        self.features_col = features_col
+        self.label_col = label_col
+        self.prediction_col = prediction_col
+        self.probability_col = probability_col
+        self.raw_prediction_col = raw_prediction_col
+
+    # fluent setters (snake + camel)
+    def set_max_iter(self, v): self.max_iter = int(v); return self
+    def set_reg_param(self, v): self.reg_param = float(v); return self
+    def set_elastic_net_param(self, v): self.elastic_net_param = float(v); return self
+    def set_tol(self, v): self.tol = float(v); return self
+    def set_fit_intercept(self, v): self.fit_intercept = bool(v); return self
+    def set_standardization(self, v): self.standardization = bool(v); return self
+    def set_threshold(self, v): self.threshold = float(v); return self
+    def set_features_col(self, v): self.features_col = v; return self
+    def set_label_col(self, v): self.label_col = v; return self
+
+    setMaxIter = set_max_iter
+    setRegParam = set_reg_param
+    setElasticNetParam = set_elastic_net_param
+    setTol = set_tol
+    setFitIntercept = set_fit_intercept
+    setStandardization = set_standardization
+    setThreshold = set_threshold
+    setFeaturesCol = set_features_col
+    setLabelCol = set_label_col
+
+    def get_reg_param(self): return self.reg_param
+    def get_tol(self): return self.tol
+    def get_threshold(self): return self.threshold
+
+    getRegParam = get_reg_param
+    getTol = get_tol
+    getThreshold = get_threshold
+
+    def _params_dict(self) -> dict:
+        return {k: getattr(self, k) for k in (
+            "max_iter", "reg_param", "elastic_net_param", "tol",
+            "fit_intercept", "standardization", "threshold", "features_col",
+            "label_col", "prediction_col", "probability_col",
+            "raw_prediction_col")}
+
+    def fit(self, frame: Frame, mesh=None) -> "LogisticRegressionModel":
+        if mesh is None:
+            from ..session import TpuSession
+
+            active = TpuSession.active()
+            mesh = active.mesh if active is not None else None
+        if mesh is not None and mesh.devices.size <= 1:
+            mesh = None
+        X, y, mask = _extract_xy(frame, self.features_col, self.label_col)
+        fit_fn = fused_logistic_fit_fn(mesh, self.max_iter, self.tol,
+                                       self.fit_intercept, self.standardization)
+        from ..parallel.distributed import place_sharded
+
+        Xd, yd, md = place_sharded(X, y, mask, mesh)
+        result = fit_fn(Xd, yd, md, self.reg_param, self.elastic_net_param)
+        model = LogisticRegressionModel(
+            coefficients=np.asarray(result.coefficients),
+            intercept=float(result.intercept),
+            params=self._params_dict())
+        model._summary_source = (frame, result)
+        return model
+
+
+class LogisticRegressionModel(Model):
+    def __init__(self, coefficients: np.ndarray, intercept: float,
+                 params: Optional[dict] = None):
+        self.coefficients = np.asarray(coefficients)
+        self.intercept = float(intercept)
+        self._params = dict(params or {})
+        self._training_summary = None
+        self._summary_source = None
+
+    @property
+    def num_features(self) -> int:
+        return int(self.coefficients.shape[0])
+
+    @property
+    def threshold(self) -> float:
+        return self._params.get("threshold", 0.5)
+
+    def _margin(self, X):
+        return X @ jnp.asarray(self.coefficients, X.dtype) + self.intercept
+
+    def transform(self, frame: Frame) -> Frame:
+        """Append rawPrediction (margin), probability, and prediction columns
+        — MLlib's classifier transform contract."""
+        p = self._params
+        X = jnp.asarray(frame._column_values(p.get("features_col", "features")),
+                        float_dtype())
+        if X.ndim == 1:
+            X = X[:, None]
+        margin = self._margin(X)
+        prob = jax.nn.sigmoid(margin)
+        pred = (prob > self.threshold).astype(float_dtype())
+        out = frame.with_column(p.get("raw_prediction_col", "rawPrediction"), margin)
+        out = out.with_column(p.get("probability_col", "probability"), prob)
+        return out.with_column(p.get("prediction_col", "prediction"), pred)
+
+    def predict_raw(self, features) -> float:
+        v = np.asarray(features, np.float64).reshape(-1)
+        return float(v @ self.coefficients.astype(np.float64) + self.intercept)
+
+    def predict_probability(self, features) -> float:
+        return float(1.0 / (1.0 + np.exp(-self.predict_raw(features))))
+
+    predictProbability = predict_probability
+
+    def predict(self, features) -> float:
+        return 1.0 if self.predict_probability(features) > self.threshold else 0.0
+
+    @property
+    def summary(self) -> "BinaryLogisticRegressionTrainingSummary":
+        if self._training_summary is None:
+            if self._summary_source is None:
+                raise RuntimeError("model was not fit with summary (loaded model?)")
+            frame, result = self._summary_source
+            self._training_summary = BinaryLogisticRegressionTrainingSummary(
+                self, frame, result)
+        return self._training_summary
+
+    @property
+    def has_summary(self) -> bool:
+        return self._training_summary is not None or self._summary_source is not None
+
+    hasSummary = has_summary
+
+    def evaluate(self, frame: Frame) -> "BinaryLogisticRegressionSummary":
+        return BinaryLogisticRegressionSummary(self, frame)
+
+    def save(self, path: str) -> None:
+        os.makedirs(path, exist_ok=True)
+        write_json(os.path.join(path, "metadata.json"), {
+            "class": "LogisticRegressionModel",
+            "intercept": self.intercept,
+            "params": self._params,
+        })
+        np.save(os.path.join(path, "coefficients.npy"), self.coefficients)
+
+    @classmethod
+    def load(cls, path: str) -> "LogisticRegressionModel":
+        meta = read_json(os.path.join(path, "metadata.json"))
+        if meta.get("class") != "LogisticRegressionModel":
+            raise ValueError(f"not a LogisticRegressionModel checkpoint: {path}")
+        return cls(np.load(os.path.join(path, "coefficients.npy")),
+                   meta["intercept"], meta.get("params"))
+
+
+class BinaryLogisticRegressionSummary:
+    """Evaluation over a frame's valid rows: accuracy, ROC, areaUnderROC."""
+
+    def __init__(self, model: LogisticRegressionModel, frame: Frame):
+        self._model = model
+        pred_frame = model.transform(frame)
+        d = pred_frame.to_pydict()
+        p = model._params
+        self._label = d[p.get("label_col", "label")].astype(np.float64)
+        self._prob = d[p.get("probability_col", "probability")].astype(np.float64)
+        self._pred = d[p.get("prediction_col", "prediction")].astype(np.float64)
+        self._predictions_frame = pred_frame
+
+    @property
+    def predictions(self) -> Frame:
+        return self._predictions_frame
+
+    @property
+    def accuracy(self) -> float:
+        return float(np.mean(self._pred == self._label))
+
+    @property
+    def area_under_roc(self) -> float:
+        """Exact AUC — delegates to the shared O(n log n) helper."""
+        from .evaluation import area_under_roc
+
+        return area_under_roc(self._label, self._prob)
+
+    areaUnderROC = area_under_roc
+
+    @property
+    def roc(self) -> Frame:
+        """(FPR, TPR) curve frame, MLlib's ``summary.roc()`` analogue."""
+        from .evaluation import roc_points
+
+        fpr, tpr = roc_points(self._label, self._prob)
+        return Frame({"FPR": fpr, "TPR": tpr})
+
+
+class BinaryLogisticRegressionTrainingSummary(BinaryLogisticRegressionSummary):
+    def __init__(self, model, frame, result: LogisticFitResult):
+        super().__init__(model, frame)
+        self._iterations = int(result.iterations)
+        hist = np.asarray(result.objective_history, np.float64)
+        self._objective_history = hist[: self._iterations + 1]
+
+    @property
+    def total_iterations(self) -> int:
+        return self._iterations
+
+    totalIterations = total_iterations
+
+    @property
+    def objective_history(self) -> np.ndarray:
+        return self._objective_history
+
+    objectiveHistory = objective_history

@@ -1,0 +1,171 @@
+"""Evaluators — the MLlib ``ml.evaluation`` surface CrossValidator needs
+(BASELINE.json config: "CrossValidator grid (regParam × elasticNetParam)")."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..frame.frame import Frame
+
+
+def roc_points(labels: np.ndarray, scores: np.ndarray):
+    """(FPR, TPR) arrays over descending score thresholds, O(n log n).
+
+    Shared by the evaluators and the classifier summaries — one cumsum over
+    the label vector sorted by score, keeping only threshold boundaries.
+    """
+    order = np.argsort(-scores, kind="mergesort")
+    y = labels[order]
+    s = scores[order]
+    tps = np.cumsum(y)
+    fps = np.cumsum(1.0 - y)
+    # keep the last index of each tied score run
+    boundary = np.r_[s[1:] != s[:-1], True]
+    tps = tps[boundary]
+    fps = fps[boundary]
+    npos = max(tps[-1], 1.0) if len(tps) else 1.0
+    nneg = max(fps[-1], 1.0) if len(fps) else 1.0
+    tpr = np.r_[0.0, tps / npos]
+    fpr = np.r_[0.0, fps / nneg]
+    return fpr, tpr
+
+
+def area_under_roc(labels: np.ndarray, scores: np.ndarray) -> float:
+    """Exact AUC (rank statistic with tie handling) via the trapezoid over
+    the ROC boundary points — O(n log n)."""
+    pos = labels == 1.0
+    if pos.sum() == 0 or (~pos).sum() == 0:
+        return float("nan")
+    fpr, tpr = roc_points(labels, scores)
+    return float(np.trapezoid(tpr, fpr))
+
+
+def area_under_pr(labels: np.ndarray, scores: np.ndarray) -> float:
+    """Precision-recall AUC over threshold boundaries, O(n log n)."""
+    pos = labels == 1.0
+    npos = pos.sum()
+    if npos == 0 or (~pos).sum() == 0:
+        return float("nan")
+    order = np.argsort(-scores, kind="mergesort")
+    y = labels[order]
+    s = scores[order]
+    tps = np.cumsum(y)
+    preds = np.arange(1, len(y) + 1)
+    boundary = np.r_[s[1:] != s[:-1], True]
+    precision = np.r_[1.0, (tps / preds)[boundary]]
+    recall = np.r_[0.0, (tps / npos)[boundary]]
+    return float(np.trapezoid(precision, recall))
+
+
+class Evaluator:
+    def evaluate(self, frame: Frame) -> float:
+        raise NotImplementedError
+
+    def is_larger_better(self) -> bool:
+        return True
+
+    isLargerBetter = is_larger_better
+
+
+class RegressionEvaluator(Evaluator):
+    """Metrics: rmse (default), mse, mae, r2."""
+
+    def __init__(self, metric_name: str = "rmse", label_col: str = "label",
+                 prediction_col: str = "prediction"):
+        if metric_name not in ("rmse", "mse", "mae", "r2"):
+            raise ValueError(f"unknown metric {metric_name!r}")
+        self.metric_name = metric_name
+        self.label_col = label_col
+        self.prediction_col = prediction_col
+
+    def set_metric_name(self, v: str):
+        self.metric_name = v
+        return self
+
+    setMetricName = set_metric_name
+
+    def is_larger_better(self) -> bool:
+        return self.metric_name == "r2"
+
+    isLargerBetter = is_larger_better
+
+    def evaluate(self, frame: Frame) -> float:
+        d = frame.to_pydict()
+        y = d[self.label_col].astype(np.float64)
+        p = d[self.prediction_col].astype(np.float64)
+        return self.compute(y, p)
+
+    def compute(self, y: np.ndarray, p: np.ndarray) -> float:
+        if self.metric_name == "rmse":
+            return float(np.sqrt(np.mean((y - p) ** 2)))
+        if self.metric_name == "mse":
+            return float(np.mean((y - p) ** 2))
+        if self.metric_name == "mae":
+            return float(np.mean(np.abs(y - p)))
+        ss_res = float(np.sum((y - p) ** 2))
+        ss_tot = float(np.sum((y - y.mean()) ** 2))
+        return float("nan") if ss_tot == 0 else 1.0 - ss_res / ss_tot
+
+
+class BinaryClassificationEvaluator(Evaluator):
+    """Metrics: areaUnderROC (default), areaUnderPR. Reads the probability
+    column when present (falls back to rawPrediction)."""
+
+    def __init__(self, metric_name: str = "areaUnderROC",
+                 label_col: str = "label",
+                 raw_prediction_col: str = "rawPrediction"):
+        if metric_name not in ("areaUnderROC", "areaUnderPR"):
+            raise ValueError(f"unknown metric {metric_name!r}")
+        self.metric_name = metric_name
+        self.label_col = label_col
+        self.raw_prediction_col = raw_prediction_col
+
+    def set_metric_name(self, v: str):
+        self.metric_name = v
+        return self
+
+    setMetricName = set_metric_name
+
+    def evaluate(self, frame: Frame) -> float:
+        d = frame.to_pydict()
+        y = d[self.label_col].astype(np.float64)
+        score_col = self.raw_prediction_col
+        if score_col not in d and "probability" in d:
+            score_col = "probability"
+        s = d[score_col].astype(np.float64)
+        return self.compute(y, s)
+
+    def compute(self, y: np.ndarray, s: np.ndarray) -> float:
+        if self.metric_name == "areaUnderROC":
+            return area_under_roc(y, s)
+        return area_under_pr(y, s)
+
+
+class MulticlassClassificationEvaluator(Evaluator):
+    """Metrics: accuracy (default), f1 (binary-weighted)."""
+
+    def __init__(self, metric_name: str = "accuracy", label_col: str = "label",
+                 prediction_col: str = "prediction"):
+        if metric_name not in ("accuracy", "f1"):
+            raise ValueError(f"unknown metric {metric_name!r}")
+        self.metric_name = metric_name
+        self.label_col = label_col
+        self.prediction_col = prediction_col
+
+    def evaluate(self, frame: Frame) -> float:
+        d = frame.to_pydict()
+        y = d[self.label_col].astype(np.float64)
+        p = d[self.prediction_col].astype(np.float64)
+        if self.metric_name == "accuracy":
+            return float(np.mean(y == p))
+        classes = np.unique(y)
+        f1s, weights = [], []
+        for c in classes:
+            tp = float(((p == c) & (y == c)).sum())
+            fp = float(((p == c) & (y != c)).sum())
+            fn = float(((p != c) & (y == c)).sum())
+            prec = tp / max(tp + fp, 1.0)
+            rec = tp / max(tp + fn, 1.0)
+            f1s.append(0.0 if prec + rec == 0 else 2 * prec * rec / (prec + rec))
+            weights.append((y == c).mean())
+        return float(np.average(f1s, weights=weights))

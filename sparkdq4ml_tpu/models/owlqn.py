@@ -48,9 +48,9 @@ def owlqn_solve(A: jnp.ndarray, reg_param, elastic_net_param,
     d = m.b.shape[0]
     eff = jnp.asarray(reg_param, dt) / jnp.where(m.std_y > 0, m.std_y, 1.0)
     alpha = jnp.asarray(elastic_net_param, dt)
-    u = _penalty_weights(m, standardization)
-    lam1 = alpha * eff * u
-    lam2 = (1.0 - alpha) * eff * u
+    u1, u2 = _penalty_weights(m, standardization)
+    lam1 = alpha * eff * u1
+    lam2 = (1.0 - alpha) * eff * u2
 
     def smooth_grad(w):
         return m.G @ w - m.b + lam2 * w

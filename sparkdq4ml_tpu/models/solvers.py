@@ -23,8 +23,8 @@ Numeric convention (validated against SURVEY.md §2.3 golden tables):
 * solve in standardized space: ``x̂ = (x − x̄)/σ_x``, ``ŷ = (y − ȳ)/σ_y``
   (centering is implicit — it happens in the moment algebra, never on data),
 * ``effectiveRegParam = regParam/σ_y``; L1/L2 split by ``elasticNetParam``,
-* with ``standardization=False`` the per-feature L1/L2 weight becomes
-  ``1/σ_xj`` (penalty effectively on raw coefficients, MLlib semantics),
+* with ``standardization=False`` the penalty lands on the *raw* coefficients:
+  L1 weight ``1/σ_xj``, L2 weight ``1/σ_xj²`` (MLlib semantics),
 * unscale: ``w_j = ŵ_j σ_y/σ_xj``; ``intercept = ȳ − w·x̄``.
 """
 
@@ -106,12 +106,19 @@ def unpack_moments(A: jnp.ndarray, fit_intercept: bool = True) -> Moments:
     return Moments(n, mean_x, mean_y, std_x, std_y, G, b, yy, valid)
 
 
-def _penalty_weights(m: Moments, standardization: bool) -> jnp.ndarray:
-    """Per-feature multiplier on the regularization in standardized space."""
+def _penalty_weights(m: Moments, standardization: bool):
+    """Per-feature multipliers (u1 for L1, u2 for L2) in standardized space.
+
+    With ``standardization=False`` the penalty applies to the *raw*
+    coefficient ``w_raw = ŵ/σ``: ``|w_raw| = |ŵ|/σ`` gives u1 = 1/σ, while
+    ``w_raw² = ŵ²/σ²`` gives u2 = 1/σ² (MLlib's L2Regularization divides by
+    std twice)."""
     if standardization:
-        return jnp.ones_like(m.std_x)
+        ones = jnp.ones_like(m.std_x)
+        return ones, ones
     sx = jnp.where(m.valid, m.std_x, 1.0)
-    return jnp.where(m.valid, 1.0 / sx, 0.0)
+    u1 = jnp.where(m.valid, 1.0 / sx, 0.0)
+    return u1, u1 * u1
 
 
 def _objective(w, m: Moments, lam1, lam2):
@@ -142,9 +149,9 @@ def fista_solve(A: jnp.ndarray, reg_param, elastic_net_param,
     d = m.b.shape[0]
     eff = jnp.asarray(reg_param, dt) / jnp.where(m.std_y > 0, m.std_y, 1.0)
     alpha = jnp.asarray(elastic_net_param, dt)
-    u = _penalty_weights(m, standardization)
-    lam1 = alpha * eff * u
-    lam2 = (1.0 - alpha) * eff * u
+    u1, u2 = _penalty_weights(m, standardization)
+    lam1 = alpha * eff * u1
+    lam2 = (1.0 - alpha) * eff * u2
     # Lipschitz bound: ‖G‖₂ ≤ ‖G‖_F for PSD G; + max ridge term.
     L = jnp.linalg.norm(m.G) + jnp.max(lam2, initial=0.0) + jnp.asarray(1e-12, dt)
     step = 1.0 / L
@@ -191,7 +198,7 @@ def normal_solve(A: jnp.ndarray, reg_param, elastic_net_param=0.0,
     dt = A.dtype
     d = m.b.shape[0]
     eff = jnp.asarray(reg_param, dt) / jnp.where(m.std_y > 0, m.std_y, 1.0)
-    lam2 = (1.0 - jnp.asarray(elastic_net_param, dt)) * eff * _penalty_weights(m, standardization)
+    lam2 = (1.0 - jnp.asarray(elastic_net_param, dt)) * eff * _penalty_weights(m, standardization)[1]
     H = m.G + jnp.diag(lam2)
     w = jnp.linalg.solve(H, m.b)
     w = jnp.where(m.valid, w, 0.0)

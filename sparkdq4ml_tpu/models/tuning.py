@@ -1,0 +1,316 @@
+"""Model selection: ParamGridBuilder, CrossValidator, TrainValidationSplit
+(BASELINE.json config: "CrossValidator grid (regParam × elasticNetParam)
+pmapped across TPU cores").
+
+TPU-first design — the grid axis is *grid-parallel* (SURVEY.md §5
+"Parallelism strategies"): for linear regression every (fold × param) fit is
+a tiny solve on sufficient statistics, so the whole cross-validation runs as
+
+1. ONE data pass building per-fold augmented Gramians (``vmap`` over fold
+   masks; sharded with a psum when a mesh is active),
+2. train-fold Gramians by subtraction (``A_train = A_all − A_fold`` — the
+   Gramian is additive, so k-fold CV needs no second data pass),
+3. a single ``vmap`` over the flattened (param × fold) axis of the FISTA
+   solver — every grid cell optimized simultaneously on the MXU/VPU,
+4. held-out metrics (rmse/mse/r2) computed from the fold Gramians directly.
+
+Estimators without a sufficient-statistics path (LogisticRegression, custom)
+take the generic fit-per-cell path, which still shares the session mesh.
+"""
+
+from __future__ import annotations
+
+import copy
+import itertools
+import re
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..frame.frame import Frame
+from .base import Estimator, Model
+from .evaluation import Evaluator, RegressionEvaluator
+from .regression import LinearRegression, _extract_xy
+from .solvers import augmented_gram, fista_solve, resolve_solver
+
+
+def _snake(name: str) -> str:
+    return re.sub(r"(?<!^)(?=[A-Z])", "_", name).lower()
+
+
+class ParamGridBuilder:
+    """``addGrid(param, values)`` builder; params are attribute names
+    (snake_case or MLlib camelCase)."""
+
+    def __init__(self):
+        self._grids: dict[str, Sequence] = {}
+
+    def add_grid(self, param: str, values: Sequence) -> "ParamGridBuilder":
+        self._grids[_snake(param)] = list(values)
+        return self
+
+    addGrid = add_grid
+
+    def base_on(self, params: dict) -> "ParamGridBuilder":
+        for k, v in params.items():
+            self._grids[_snake(k)] = [v]
+        return self
+
+    baseOn = base_on
+
+    def build(self) -> list[dict]:
+        names = list(self._grids)
+        out = []
+        for combo in itertools.product(*(self._grids[n] for n in names)):
+            out.append(dict(zip(names, combo)))
+        return out or [{}]
+
+
+def _apply_params(estimator: Estimator, params: dict) -> Estimator:
+    est = copy.copy(estimator)
+    for k, v in params.items():
+        if not hasattr(est, k):
+            raise AttributeError(f"{type(est).__name__} has no param {k!r}")
+        setattr(est, k, v)
+    return est
+
+
+def _best_index(metrics: np.ndarray, larger_better: bool) -> int:
+    if np.all(np.isnan(metrics)):
+        raise ValueError(
+            "all cross-validation metrics are NaN — typically a fold with "
+            "only one class (binary metrics) or an empty fold; use more data, "
+            "fewer folds, or a different seed")
+    return int(np.nanargmax(metrics) if larger_better else np.nanargmin(metrics))
+
+
+def _fold_ids(n_slots: int, num_folds: int, seed: int) -> np.ndarray:
+    rng = np.random.default_rng(seed)
+    return rng.integers(0, num_folds, size=n_slots)
+
+
+# --- fast path: linear regression on per-fold Gramians ----------------------
+
+_FAST_METRICS = ("rmse", "mse", "r2")
+
+
+def _holdout_metric_from_gram(A, coef, intercept, metric: str):
+    """rmse/mse/r2 on a fold, from its Gramian and a raw-space model."""
+    d = A.shape[0] - 2
+    XtX = A[:d, :d]
+    Xty = A[:d, d]
+    sum_x = A[:d, d + 1]
+    sum_y = A[d, d + 1]
+    yy = A[d, d]
+    n = A[d + 1, d + 1]
+    sse = (yy - 2.0 * coef @ Xty - 2.0 * intercept * sum_y
+           + 2.0 * intercept * (coef @ sum_x) + coef @ XtX @ coef
+           + n * intercept * intercept)
+    mse = sse / n
+    if metric == "mse":
+        return mse
+    if metric == "rmse":
+        return jnp.sqrt(jnp.maximum(mse, 0.0))
+    ss_tot = yy - n * (sum_y / n) ** 2
+    return 1.0 - sse / ss_tot
+
+
+def _linear_cv_fast(frame: Frame, estimator: LinearRegression,
+                    param_maps: list[dict], metric: str, num_folds: int,
+                    seed: int, mesh):
+    """The vmapped sufficient-stats CV described in the module docstring.
+    Returns (metrics[num_params], A_all) — A_all lets the caller refit the
+    best model with zero extra data passes."""
+    from ..parallel.distributed import compute_gram
+
+    X, y, mask = _extract_xy(frame, estimator.features_col, estimator.label_col)
+    fold = jnp.asarray(_fold_ids(X.shape[0], num_folds, seed))
+
+    # Per-fold Gramians: one vmapped masked pass (sharded Gramian per fold
+    # when a mesh is active — still one logical data pass each).
+    if mesh is not None and mesh.devices.size > 1:
+        A_folds = jnp.stack([
+            compute_gram(X, y, jnp.logical_and(mask, fold == f), mesh=mesh)
+            for f in range(num_folds)])
+    else:
+        fold_masks = jax.vmap(
+            lambda f: jnp.logical_and(mask, fold == f))(jnp.arange(num_folds))
+        A_folds = jax.vmap(lambda m: augmented_gram(X, y, m))(fold_masks)
+    A_all = jnp.sum(A_folds, axis=0)
+    A_train = A_all[None] - A_folds                      # (k, d+2, d+2)
+
+    regs = jnp.asarray([p.get("reg_param", estimator.reg_param)
+                        for p in param_maps], X.dtype)
+    alphas = jnp.asarray([p.get("elastic_net_param", estimator.elastic_net_param)
+                          for p in param_maps], X.dtype)
+
+    # Flatten (param × fold) and solve every cell simultaneously.
+    k = num_folds
+    m = len(param_maps)
+    A_rep = jnp.tile(A_train, (m, 1, 1))                 # (m*k, d+2, d+2)
+    A_hold = jnp.tile(A_folds, (m, 1, 1))
+    reg_rep = jnp.repeat(regs, k)
+    alpha_rep = jnp.repeat(alphas, k)
+
+    def cell(A_tr, A_te, reg, alpha):
+        r = fista_solve(A_tr, reg, alpha, max_iter=estimator.max_iter,
+                        tol=estimator.tol,
+                        fit_intercept=estimator.fit_intercept,
+                        standardization=estimator.standardization)
+        return _holdout_metric_from_gram(A_te, r.coefficients, r.intercept,
+                                         metric)
+
+    metrics_cells = jax.jit(jax.vmap(cell))(A_rep, A_hold, reg_rep, alpha_rep)
+    metrics = np.asarray(metrics_cells).reshape(m, k).mean(axis=1)
+    return metrics, A_all
+
+
+# --- public API --------------------------------------------------------------
+
+class CrossValidatorModel(Model):
+    def __init__(self, best_model: Model, avg_metrics: np.ndarray,
+                 best_index: int, sub_models=None):
+        self.best_model = best_model
+        self.avg_metrics = np.asarray(avg_metrics)
+        self.best_index = int(best_index)
+        self.sub_models = sub_models
+
+    bestModel = property(lambda self: self.best_model)
+    avgMetrics = property(lambda self: self.avg_metrics)
+
+    def transform(self, frame: Frame) -> Frame:
+        return self.best_model.transform(frame)
+
+
+class CrossValidator(Estimator):
+    def __init__(self, estimator: Optional[Estimator] = None,
+                 estimator_param_maps: Optional[list[dict]] = None,
+                 evaluator: Optional[Evaluator] = None,
+                 num_folds: int = 3, seed: int = 0,
+                 collect_sub_models: bool = False,
+                 parallelism: int = 1):
+        self.estimator = estimator
+        self.estimator_param_maps = estimator_param_maps or [{}]
+        self.evaluator = evaluator or RegressionEvaluator()
+        self.num_folds = num_folds
+        self.seed = seed
+        self.collect_sub_models = collect_sub_models
+        # MLlib's thread-pool width; meaningless here because the grid is
+        # vmapped (all cells run at once). Accepted for API parity.
+        self.parallelism = parallelism
+
+    def set_estimator(self, e): self.estimator = e; return self
+    def set_estimator_param_maps(self, m): self.estimator_param_maps = m; return self
+    def set_evaluator(self, e): self.evaluator = e; return self
+    def set_num_folds(self, k): self.num_folds = int(k); return self
+    def set_seed(self, s): self.seed = int(s); return self
+
+    setEstimator = set_estimator
+    setEstimatorParamMaps = set_estimator_param_maps
+    setEvaluator = set_evaluator
+    setNumFolds = set_num_folds
+    setSeed = set_seed
+
+    def _use_fast_path(self) -> bool:
+        if not isinstance(self.estimator, LinearRegression):
+            return False
+        if self.collect_sub_models:
+            return False  # per-fold models only exist on the generic path
+        if not isinstance(self.evaluator, RegressionEvaluator):
+            return False
+        if self.evaluator.metric_name not in _FAST_METRICS:
+            return False
+        # fast path solves every cell with FISTA; exact for any elastic net
+        try:
+            for p in self.estimator_param_maps:
+                est = _apply_params(self.estimator, p)
+                resolve_solver(est.solver, est.reg_param, est.elastic_net_param)
+        except (ValueError, AttributeError):
+            return False
+        # grid must only vary solver-vmappable params
+        varied = {k for p in self.estimator_param_maps for k in p}
+        return varied <= {"reg_param", "elastic_net_param"}
+
+    def fit(self, frame: Frame, mesh=None) -> CrossValidatorModel:
+        if self.estimator is None:
+            raise ValueError("CrossValidator: estimator not set")
+        if mesh is None:
+            from ..session import TpuSession
+
+            active = TpuSession.active()
+            mesh = active.mesh if active is not None else None
+
+        larger_better = self.evaluator.is_larger_better()
+        if self._use_fast_path():
+            metrics, A_all = _linear_cv_fast(
+                frame, self.estimator, self.estimator_param_maps,
+                self.evaluator.metric_name, self.num_folds, self.seed, mesh)
+            best = _best_index(metrics, larger_better)
+            best_est = _apply_params(self.estimator,
+                                     self.estimator_param_maps[best])
+            best_model = best_est.fit(frame, mesh=mesh)
+            return CrossValidatorModel(best_model, metrics, best)
+
+        # generic path: fit/evaluate each (param, fold) cell
+        fold = _fold_ids(frame.num_slots, self.num_folds, self.seed)
+        fold_arr = jnp.asarray(fold)
+        metrics = np.zeros(len(self.estimator_param_maps))
+        sub_models = [] if self.collect_sub_models else None
+        for pi, params in enumerate(self.estimator_param_maps):
+            est = _apply_params(self.estimator, params)
+            scores = []
+            for f in range(self.num_folds):
+                train = frame.filter(fold_arr != f)
+                test = frame.filter(fold_arr == f)
+                model = est.fit(train) if mesh is None else est.fit(train, mesh=mesh)
+                scores.append(self.evaluator.evaluate(model.transform(test)))
+                if sub_models is not None:
+                    sub_models.append(model)
+            metrics[pi] = float(np.mean(scores))
+        best = _best_index(metrics, larger_better)
+        best_est = _apply_params(self.estimator, self.estimator_param_maps[best])
+        best_model = (best_est.fit(frame) if mesh is None
+                      else best_est.fit(frame, mesh=mesh))
+        return CrossValidatorModel(best_model, metrics, best, sub_models)
+
+
+class TrainValidationSplitModel(CrossValidatorModel):
+    @property
+    def validation_metrics(self):
+        return self.avg_metrics
+
+    validationMetrics = validation_metrics
+
+
+class TrainValidationSplit(CrossValidator):
+    """Single random train/validation split (MLlib TrainValidationSplit);
+    implemented as 1-fold holdout with ``train_ratio``."""
+
+    def __init__(self, estimator=None, estimator_param_maps=None,
+                 evaluator=None, train_ratio: float = 0.75, seed: int = 0):
+        super().__init__(estimator, estimator_param_maps, evaluator,
+                         num_folds=2, seed=seed)
+        self.train_ratio = train_ratio
+
+    def set_train_ratio(self, r): self.train_ratio = float(r); return self
+
+    setTrainRatio = set_train_ratio
+
+    def fit(self, frame: Frame, mesh=None) -> TrainValidationSplitModel:
+        rng = np.random.default_rng(self.seed)
+        is_val = jnp.asarray(rng.random(frame.num_slots) >= self.train_ratio)
+        train = frame.filter(jnp.logical_not(is_val))
+        val = frame.filter(is_val)
+        larger_better = self.evaluator.is_larger_better()
+        metrics = np.zeros(len(self.estimator_param_maps))
+        for pi, params in enumerate(self.estimator_param_maps):
+            est = _apply_params(self.estimator, params)
+            model = est.fit(train) if mesh is None else est.fit(train, mesh=mesh)
+            metrics[pi] = self.evaluator.evaluate(model.transform(val))
+        best = _best_index(metrics, larger_better)
+        best_est = _apply_params(self.estimator, self.estimator_param_maps[best])
+        best_model = (best_est.fit(frame) if mesh is None
+                      else best_est.fit(frame, mesh=mesh))
+        return TrainValidationSplitModel(best_model, metrics, best)

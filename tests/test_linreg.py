@@ -118,6 +118,39 @@ class TestSolverPaths:
             float(m2.coefficients[0]), rel=1e-5)
         assert m1.intercept == pytest.approx(m2.intercept, rel=1e-5)
 
+    def test_standardization_false_ridge_matches_sklearn_raw(self, session):
+        """standardization=False puts the penalty on raw coefficients: the
+        MLlib objective reduces to sklearn Ridge(alpha=n·λ/σ_y) on raw X."""
+        sk = pytest.importorskip("sklearn.linear_model")
+        df, model = _fit(session, "small", reg_param=2.0,
+                         elastic_net_param=0.0, standardization=False)
+        d = df.to_pydict()
+        x = d["guest"].astype(np.float64).reshape(-1, 1)
+        y = d["label"].astype(np.float64)
+        n, sy = len(y), y.std(ddof=1)
+        ref = sk.Ridge(alpha=n * 2.0 / sy, fit_intercept=True)
+        ref.fit(x, y)
+        assert float(model.coefficients[0]) == pytest.approx(ref.coef_[0],
+                                                             rel=1e-6)
+        assert model.intercept == pytest.approx(ref.intercept_, rel=1e-6)
+
+    def test_standardization_false_lasso_penalizes_raw_coef(self, session):
+        """L1 with standardization=False: objective·σy² ≡ (1/2n)‖r‖² +
+        (λ/σy... ) — assert against a direct 1-D prox solve on raw data."""
+        df, model = _fit(session, "small", reg_param=2.0,
+                         elastic_net_param=1.0, standardization=False)
+        d = df.to_pydict()
+        x = d["guest"].astype(np.float64)
+        y = d["label"].astype(np.float64)
+        n = len(y)
+        xc, yc = x - x.mean(), y - y.mean()
+        # raw-space objective: (1/2n)Σ(yc−w·xc)² + (λ/σy)·σy·|w| → soft-threshold
+        lam_raw = 2.0  # λ'·u1·(σy/σx)·σx = λ  (works out to regParam itself)
+        h = (xc @ xc) / n
+        c = (xc @ yc) / n
+        w = np.sign(c) * max(abs(c) - lam_raw, 0.0) / h
+        assert float(model.coefficients[0]) == pytest.approx(w, rel=1e-6)
+
     def test_fit_intercept_false(self, session):
         _, model = _fit(session, "small", reg_param=0.0, elastic_net_param=0.0,
                         fit_intercept=False)

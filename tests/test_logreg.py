@@ -1,0 +1,165 @@
+"""LogisticRegression: sklearn parity oracle, DQ-pipeline integration
+(BASELINE.json config d), distributed equality, API surface."""
+
+import numpy as np
+import pytest
+
+from conftest import dataset_path, run_dq_pipeline
+from sparkdq4ml_tpu import Frame
+from sparkdq4ml_tpu.models import (LogisticRegression, LogisticRegressionModel,
+                                   VectorAssembler)
+from sparkdq4ml_tpu.parallel.mesh import make_mesh
+
+
+def _synth(n=300, d=3, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, d))
+    w = np.asarray([1.5, -2.0, 0.8])[:d]
+    logits = X @ w + 0.5
+    y = (rng.random(n) < 1 / (1 + np.exp(-logits))).astype(float)
+    f = Frame({"features": X, "label": y})
+    return f, X, y
+
+
+class TestSklearnParity:
+    def test_unregularized_matches_sklearn(self):
+        sk = pytest.importorskip("sklearn.linear_model")
+        f, X, y = _synth()
+        model = LogisticRegression(max_iter=800, tol=1e-12).fit(f)
+        ref = sk.LogisticRegression(penalty=None, tol=1e-10, max_iter=2000)
+        ref.fit(X, y)
+        np.testing.assert_allclose(model.coefficients, ref.coef_[0], atol=2e-3)
+        assert model.intercept == pytest.approx(ref.intercept_[0], abs=2e-3)
+
+    def test_l1_matches_sklearn_on_standardized(self):
+        sk = pytest.importorskip("sklearn.linear_model")
+        f, X, y = _synth()
+        lam = 0.05
+        model = LogisticRegression(reg_param=lam, elastic_net_param=1.0,
+                                   max_iter=3000, tol=1e-13).fit(f)
+        # sklearn: min (1/C)·(‖w‖₁) + Σ logloss on pre-standardized features
+        sx = X.std(axis=0, ddof=1)
+        ref = sk.LogisticRegression(C=1.0 / (len(y) * lam), l1_ratio=1.0,
+                                    solver="saga", tol=1e-12,
+                                    max_iter=50000)
+        ref.fit(X / sx, y)
+        np.testing.assert_allclose(model.coefficients, ref.coef_[0] / sx,
+                                   atol=3e-3)
+
+    def test_ridge_matches_sklearn(self):
+        sk = pytest.importorskip("sklearn.linear_model")
+        f, X, y = _synth()
+        lam = 0.1
+        model = LogisticRegression(reg_param=lam, elastic_net_param=0.0,
+                                   max_iter=2000, tol=1e-13).fit(f)
+        sx = X.std(axis=0, ddof=1)
+        ref = sk.LogisticRegression(C=1.0 / (len(y) * lam), l1_ratio=0.0,
+                                    tol=1e-12, max_iter=10000)
+        ref.fit(X / sx, y)
+        np.testing.assert_allclose(model.coefficients, ref.coef_[0] / sx,
+                                   atol=2e-3)
+
+
+class TestStandardizationFalse:
+    def test_l2_penalizes_raw_coefficients(self):
+        """standardization=False L2 must equal sklearn ridge-logistic on RAW
+        features with C = 1/(n·λ) (penalty weight 1/σ² in scaled space)."""
+        sk = pytest.importorskip("sklearn.linear_model")
+        f, X, y = _synth()
+        lam = 0.1
+        model = LogisticRegression(reg_param=lam, elastic_net_param=0.0,
+                                   standardization=False, max_iter=3000,
+                                   tol=1e-13).fit(f)
+        ref = sk.LogisticRegression(C=1.0 / (len(y) * lam), l1_ratio=0.0,
+                                    tol=1e-12, max_iter=10000)
+        ref.fit(X, y)
+        np.testing.assert_allclose(model.coefficients, ref.coef_[0], atol=2e-3)
+
+
+class TestDqPipelineClassifier:
+    """BASELINE.json config (d): binary classifier on the DQ-filtered rows —
+    label = 'is this a premium-priced event' (price above the per-guest
+    trend), a plausible catering business question."""
+
+    def test_classifier_on_dq_rows(self, session):
+        import sparkdq4ml_tpu as dq
+
+        df = run_dq_pipeline(session, dataset_path("full"))
+        df = df.with_column("label",
+                            (dq.col("price") > dq.col("guest") * 5.0 + 20.0)
+                            .cast("double"))
+        df = VectorAssembler(["guest", "price"], "features").transform(df)
+        model = LogisticRegression(max_iter=400).fit(df)
+        s = model.summary
+        assert s.accuracy > 0.8          # separable up to the data's noise band
+        assert s.area_under_roc > 0.9
+        assert s.total_iterations >= 1
+        assert len(s.objective_history) == s.total_iterations + 1
+        # objective history starts at log(2) (w=0) and decreases
+        assert s.objective_history[0] == pytest.approx(np.log(2), abs=1e-6)
+        assert s.objective_history[-1] < s.objective_history[0]
+
+    def test_transform_columns(self, session):
+        f, X, y = _synth(80)
+        model = LogisticRegression(max_iter=200).fit(f)
+        out = model.transform(f)
+        assert {"rawPrediction", "probability", "prediction"} <= set(out.columns)
+        d = out.to_pydict()
+        np.testing.assert_allclose(
+            d["probability"], 1 / (1 + np.exp(-d["rawPrediction"])), rtol=1e-5)
+        assert set(np.unique(d["prediction"])) <= {0.0, 1.0}
+
+
+class TestDistributed:
+    def test_sharded_equals_single(self):
+        f, X, y = _synth(200)
+        m1 = LogisticRegression(max_iter=300, reg_param=0.05,
+                                elastic_net_param=0.5).fit(f, mesh=make_mesh(1))
+        m8 = LogisticRegression(max_iter=300, reg_param=0.05,
+                                elastic_net_param=0.5).fit(f, mesh=make_mesh(8))
+        np.testing.assert_allclose(m8.coefficients, m1.coefficients, rtol=1e-8)
+        assert m8.intercept == pytest.approx(m1.intercept, rel=1e-8)
+
+    def test_sharded_with_masked_rows(self):
+        f, X, y = _synth(203)  # odd row count forces padding
+        import jax.numpy as jnp
+        f = f.filter(jnp.asarray(np.arange(203) % 7 != 0))
+        m1 = LogisticRegression(max_iter=200).fit(f, mesh=make_mesh(1))
+        m8 = LogisticRegression(max_iter=200).fit(f, mesh=make_mesh(8))
+        np.testing.assert_allclose(m8.coefficients, m1.coefficients, rtol=1e-8)
+
+
+class TestApi:
+    def test_predict_scalar(self):
+        f, X, y = _synth(60)
+        m = LogisticRegression(max_iter=100).fit(f)
+        p = m.predict_probability(X[0])
+        assert 0.0 <= p <= 1.0
+        assert m.predict(X[0]) in (0.0, 1.0)
+
+    def test_threshold(self):
+        f, X, y = _synth(60)
+        m = LogisticRegression(max_iter=100, threshold=0.99).fit(f)
+        d = m.transform(f).to_pydict()
+        assert (d["prediction"] == 1.0).sum() <= (d["probability"] > 0.5).sum()
+
+    def test_save_load(self, tmp_path):
+        f, X, y = _synth(60)
+        m = LogisticRegression(max_iter=100).fit(f)
+        m.save(str(tmp_path / "lr"))
+        loaded = LogisticRegressionModel.load(str(tmp_path / "lr"))
+        np.testing.assert_array_equal(loaded.coefficients, m.coefficients)
+        assert loaded.predict(X[0]) == m.predict(X[0])
+
+    def test_family_validation(self):
+        with pytest.raises(ValueError):
+            LogisticRegression(family="multinomial")
+
+    def test_evaluate_and_roc(self):
+        f, X, y = _synth(100)
+        m = LogisticRegression(max_iter=200).fit(f)
+        s = m.evaluate(f)
+        roc = s.roc
+        d = roc.to_pydict()
+        assert d["FPR"][0] == 0.0 and d["TPR"][-1] == 1.0
+        assert 0.5 < s.area_under_roc <= 1.0

@@ -1,0 +1,159 @@
+"""CrossValidator / ParamGridBuilder / evaluators — BASELINE.json config (e):
+grid over regParam × elasticNetParam, vmapped fast path vs generic path."""
+
+import numpy as np
+import pytest
+
+from conftest import dataset_path, prepare_features, run_dq_pipeline
+from sparkdq4ml_tpu import Frame
+from sparkdq4ml_tpu.models import (BinaryClassificationEvaluator,
+                                   CrossValidator, LinearRegression,
+                                   LogisticRegression,
+                                   MulticlassClassificationEvaluator,
+                                   ParamGridBuilder, RegressionEvaluator,
+                                   TrainValidationSplit, VectorAssembler)
+from sparkdq4ml_tpu.parallel.mesh import make_mesh
+
+
+class TestParamGridBuilder:
+    def test_cartesian_product(self):
+        grid = (ParamGridBuilder()
+                .add_grid("reg_param", [0.1, 1.0])
+                .add_grid("elastic_net_param", [0.0, 0.5, 1.0]).build())
+        assert len(grid) == 6
+        assert {"reg_param", "elastic_net_param"} == set(grid[0])
+
+    def test_camel_case_accepted(self):
+        grid = ParamGridBuilder().addGrid("regParam", [1.0]).build()
+        assert grid == [{"reg_param": 1.0}]
+
+    def test_empty_grid(self):
+        assert ParamGridBuilder().build() == [{}]
+
+
+class TestEvaluators:
+    def test_regression_metrics(self):
+        f = Frame({"label": [1.0, 2.0, 3.0], "prediction": [1.0, 2.0, 5.0]})
+        assert RegressionEvaluator("rmse").evaluate(f) == pytest.approx(np.sqrt(4 / 3))
+        assert RegressionEvaluator("mse").evaluate(f) == pytest.approx(4 / 3)
+        assert RegressionEvaluator("mae").evaluate(f) == pytest.approx(2 / 3)
+        assert RegressionEvaluator("r2").evaluate(f) == pytest.approx(1 - 4 / 2)
+
+    def test_binary_auc(self):
+        f = Frame({"label": [1.0, 1.0, 0.0, 0.0],
+                   "rawPrediction": [0.9, 0.8, 0.7, 0.1]})
+        # one of four pos/neg pairs misordered? no: 0.9,0.8 > 0.7? 0.8>0.7 yes
+        assert BinaryClassificationEvaluator().evaluate(f) == pytest.approx(1.0)
+        f2 = Frame({"label": [1.0, 0.0], "rawPrediction": [0.2, 0.8]})
+        assert BinaryClassificationEvaluator().evaluate(f2) == pytest.approx(0.0)
+
+    def test_multiclass_accuracy(self):
+        f = Frame({"label": [1.0, 0.0, 1.0], "prediction": [1.0, 0.0, 0.0]})
+        assert MulticlassClassificationEvaluator().evaluate(f) == pytest.approx(2 / 3)
+
+    def test_unknown_metric_rejected(self):
+        with pytest.raises(ValueError):
+            RegressionEvaluator("wat")
+        with pytest.raises(ValueError):
+            BinaryClassificationEvaluator("wat")
+
+    def test_larger_better_flags(self):
+        assert not RegressionEvaluator("rmse").is_larger_better()
+        assert RegressionEvaluator("r2").is_larger_better()
+        assert BinaryClassificationEvaluator().is_larger_better()
+
+
+@pytest.fixture
+def reg_frame(session):
+    return prepare_features(run_dq_pipeline(session, dataset_path("full")))
+
+
+class TestCrossValidatorLinear:
+    GRID = (ParamGridBuilder()
+            .add_grid("reg_param", [0.01, 1.0, 50.0])
+            .add_grid("elastic_net_param", [0.5, 1.0]).build())
+
+    def test_fast_path_selected(self, reg_frame):
+        cv = CrossValidator(LinearRegression(max_iter=60), self.GRID,
+                            RegressionEvaluator("rmse"), num_folds=3)
+        assert cv._use_fast_path()
+        model = cv.fit(reg_frame)
+        assert model.avg_metrics.shape == (6,)
+        # tiny regularization must beat the absurd reg_param=50
+        best = model.best_index
+        assert self.GRID[best]["reg_param"] < 50.0
+        assert "prediction" in model.transform(reg_frame).columns
+
+    def test_fast_path_matches_generic_path(self, reg_frame):
+        """The vmapped Gramian CV must agree with literal per-fold fitting."""
+        grid = (ParamGridBuilder().add_grid("reg_param", [0.1, 5.0]).build())
+        ev = RegressionEvaluator("rmse")
+        fast = CrossValidator(LinearRegression(max_iter=80,
+                                               elastic_net_param=1.0),
+                              grid, ev, num_folds=3, seed=7)
+        assert fast._use_fast_path()
+        fast_model = fast.fit(reg_frame)
+
+        generic = CrossValidator(LinearRegression(max_iter=80,
+                                                  elastic_net_param=1.0),
+                                 grid, ev, num_folds=3, seed=7)
+        generic._use_fast_path = lambda: False
+        generic_model = generic.fit(reg_frame)
+
+        np.testing.assert_allclose(fast_model.avg_metrics,
+                                   generic_model.avg_metrics, rtol=1e-5)
+        assert fast_model.best_index == generic_model.best_index
+
+    def test_r2_metric_larger_is_better(self, reg_frame):
+        cv = CrossValidator(LinearRegression(max_iter=60, elastic_net_param=1.0),
+                            ParamGridBuilder().add_grid("reg_param",
+                                                        [0.01, 100.0]).build(),
+                            RegressionEvaluator("r2"), num_folds=3)
+        model = cv.fit(reg_frame)
+        assert model.best_index == 0  # light reg wins on r2
+
+    def test_mae_falls_back_to_generic(self, reg_frame):
+        cv = CrossValidator(LinearRegression(max_iter=40, elastic_net_param=1.0),
+                            ParamGridBuilder().add_grid("reg_param",
+                                                        [0.1, 1.0]).build(),
+                            RegressionEvaluator("mae"), num_folds=2)
+        assert not cv._use_fast_path()
+        model = cv.fit(reg_frame)
+        assert model.avg_metrics.shape == (2,)
+
+    def test_fast_path_on_mesh(self, reg_frame):
+        cv = CrossValidator(LinearRegression(max_iter=60, elastic_net_param=1.0),
+                            ParamGridBuilder().add_grid("reg_param",
+                                                        [0.1, 1.0]).build(),
+                            RegressionEvaluator("rmse"), num_folds=2)
+        m_single = cv.fit(reg_frame, mesh=make_mesh(1))
+        m_mesh = cv.fit(reg_frame, mesh=make_mesh(8))
+        np.testing.assert_allclose(m_mesh.avg_metrics, m_single.avg_metrics,
+                                   rtol=1e-8)
+
+
+class TestCrossValidatorLogistic:
+    def test_generic_path_with_classifier(self):
+        rng = np.random.default_rng(3)
+        X = rng.normal(size=(240, 2))
+        y = (X @ np.asarray([2.0, -1.0]) + 0.3 * rng.normal(size=240) > 0)
+        f = Frame({"features": X, "label": y.astype(float)})
+        cv = CrossValidator(
+            LogisticRegression(max_iter=150),
+            ParamGridBuilder().add_grid("reg_param", [0.001, 5.0]).build(),
+            BinaryClassificationEvaluator(), num_folds=3)
+        assert not cv._use_fast_path()
+        model = cv.fit(f)
+        assert model.best_index == 0  # heavy L2 wrecks AUC
+        assert model.avg_metrics[0] > 0.9
+
+
+class TestTrainValidationSplit:
+    def test_selects_reasonable_param(self, reg_frame):
+        tvs = TrainValidationSplit(
+            LinearRegression(max_iter=60, elastic_net_param=1.0),
+            ParamGridBuilder().add_grid("reg_param", [0.1, 200.0]).build(),
+            RegressionEvaluator("rmse"), train_ratio=0.75, seed=5)
+        model = tvs.fit(reg_frame)
+        assert model.best_index == 0
+        assert model.validation_metrics.shape == (2,)
