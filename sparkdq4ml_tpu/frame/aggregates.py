@@ -1,0 +1,237 @@
+"""Aggregations: global (device, mask-weighted) and grouped (host boundary).
+
+Design note: global aggregates (``df.agg``, ``describe``) are masked device
+reductions — one fused kernel per call, honoring the validity mask exactly
+like the fit statistics. Grouped aggregation keys are data-dependent
+(dynamic shapes), which XLA cannot compile statically; group discovery
+therefore happens at the host boundary (numpy) and per-group reductions use
+vectorized numpy — the same "gather at the boundary, never in the compute
+path" rule as ``Frame.to_pydict``. For this framework's workload scale
+(SURVEY.md §0: the engine's rows are catering records, not tokens) this is
+the honest design; the device path is reserved for the numeric hot loops.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Union
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.expressions import Expr
+
+_AGGS = ("count", "sum", "avg", "mean", "min", "max", "stddev", "variance")
+
+
+class AggExpr:
+    """An aggregate over a column, e.g. ``F.avg("price")`` or SQL ``AVG(price)``."""
+
+    def __init__(self, fn: str, column: Optional[str], alias: Optional[str] = None):
+        fn = fn.lower()
+        if fn not in _AGGS:
+            raise ValueError(f"unknown aggregate {fn!r} (supported: {_AGGS})")
+        self.fn = "avg" if fn == "mean" else fn
+        self.column = column  # None = count(*)
+        self._alias = alias
+
+    def alias(self, name: str) -> "AggExpr":
+        return AggExpr(self.fn, self.column, name)
+
+    @property
+    def name(self) -> str:
+        if self._alias:
+            return self._alias
+        target = "1" if self.column is None else self.column
+        if self.fn == "count" and self.column is None:
+            return "count"
+        return f"{self.fn}({target})"
+
+    def __repr__(self):
+        return self.name
+
+
+# functions-module-style constructors (org.apache.spark.sql.functions)
+def count(col: Optional[str] = None) -> AggExpr:
+    return AggExpr("count", None if col in (None, "*") else col)
+
+
+def sum(col: str) -> AggExpr:       # noqa: A001 - mirrors Spark's name
+    return AggExpr("sum", col)
+
+
+def avg(col: str) -> AggExpr:
+    return AggExpr("avg", col)
+
+
+mean = avg
+
+
+def min(col: str) -> AggExpr:       # noqa: A001
+    return AggExpr("min", col)
+
+
+def max(col: str) -> AggExpr:       # noqa: A001
+    return AggExpr("max", col)
+
+
+def stddev(col: str) -> AggExpr:
+    return AggExpr("stddev", col)
+
+
+def variance(col: str) -> AggExpr:
+    return AggExpr("variance", col)
+
+
+def _drop_nulls(values: np.ndarray) -> np.ndarray:
+    if values.dtype == object:
+        return values[np.asarray([x is not None for x in values], bool)]
+    if np.issubdtype(values.dtype, np.floating):
+        return values[~np.isnan(values)]
+    return values
+
+
+def _np_agg(fn: str, values: np.ndarray):
+    values = _drop_nulls(values)  # SQL semantics: aggregates skip nulls
+    if fn == "count":
+        return len(values)
+    if len(values) == 0:
+        return float("nan")
+    if fn == "sum":
+        return values.sum()
+    if fn == "avg":
+        return float(np.mean(values))
+    if fn == "min":
+        return values.min()
+    if fn == "max":
+        return values.max()
+    if fn == "stddev":
+        return float(np.std(values, ddof=1)) if len(values) > 1 else float("nan")
+    if fn == "variance":
+        return float(np.var(values, ddof=1)) if len(values) > 1 else float("nan")
+    raise ValueError(fn)
+
+
+def global_agg(frame, aggs: list[AggExpr]):
+    """Masked device reductions over the whole frame → 1-row Frame."""
+    from .frame import Frame
+
+    mask = frame.mask
+    w = mask.astype(jnp.float32)
+    out = {}
+    for agg in aggs:
+        if agg.fn == "count" and agg.column is None:
+            out[agg.name] = jnp.sum(mask, dtype=jnp.int32)[None]
+            continue
+        col = frame._column_values(agg.column)
+        if isinstance(col, np.ndarray) and col.dtype == object:
+            # string column: host path (count only meaningful)
+            vals = col[np.asarray(mask)]
+            out[agg.name] = np.asarray([_np_agg(agg.fn, vals)])
+            continue
+        v = jnp.asarray(col)
+        if agg.fn in ("count", "sum") and jnp.issubdtype(v.dtype, jnp.integer):
+            # exact integer arithmetic on host (Spark widens SUM to long;
+            # a float32 device accumulation would round/saturate)
+            vals = np.asarray(v)[np.asarray(mask)]
+            out[agg.name] = np.asarray(
+                [len(vals) if agg.fn == "count" else int(vals.sum(dtype=np.int64))],
+                dtype=np.int64)
+            continue
+        vf = v.astype(jnp.float64 if v.dtype == jnp.float64 else jnp.float32)
+        wf = w.astype(vf.dtype)
+        # SQL semantics: aggregates over a column skip nulls (NaN)
+        null = jnp.isnan(vf)
+        valid = jnp.logical_and(mask, jnp.logical_not(null))
+        wf = wf * jnp.logical_not(null).astype(vf.dtype)
+        nv = jnp.sum(wf)
+        vf = jnp.where(null, 0.0, vf)
+        if agg.fn == "count":
+            out[agg.name] = jnp.sum(valid, dtype=jnp.int32)[None]
+        elif agg.fn == "sum":
+            out[agg.name] = jnp.sum(vf * wf)[None]
+        elif agg.fn == "avg":
+            out[agg.name] = (jnp.sum(vf * wf) / nv)[None]
+        elif agg.fn == "min":
+            big = jnp.asarray(jnp.inf, vf.dtype)
+            out[agg.name] = jnp.min(jnp.where(valid, vf, big))[None].astype(v.dtype)
+        elif agg.fn == "max":
+            small = jnp.asarray(-jnp.inf, vf.dtype)
+            out[agg.name] = jnp.max(jnp.where(valid, vf, small))[None].astype(v.dtype)
+        else:  # stddev / variance: sample (n-1); NaN when n < 2 (Spark)
+            mu = jnp.sum(vf * wf) / nv
+            ss = jnp.sum(wf * (vf - mu) ** 2)
+            var = jnp.where(nv > 1.0, ss / jnp.maximum(nv - 1.0, 1.0),
+                            jnp.asarray(jnp.nan, vf.dtype))
+            out[agg.name] = (var if agg.fn == "variance" else jnp.sqrt(var))[None]
+    return Frame(out)
+
+
+class GroupedFrame:
+    """Result of ``Frame.group_by`` — terminal agg methods mirror Spark's
+    ``RelationalGroupedDataset``."""
+
+    def __init__(self, frame, keys: list[str]):
+        if not keys:
+            raise ValueError("group_by requires at least one key column")
+        self._frame = frame
+        self._keys = keys
+        for k in keys:
+            frame._column_values(k)  # validate early
+
+    def agg(self, *aggs: Union[AggExpr, str]):
+        from .frame import Frame
+
+        agg_list = []
+        for a in aggs:
+            if isinstance(a, str):
+                a = AggExpr(a, None)
+            agg_list.append(a)
+        if not agg_list:
+            raise ValueError("agg() needs at least one aggregate")
+
+        d = self._frame.to_pydict()  # host boundary: one gather
+        key_cols = [np.asarray(d[k]) for k in self._keys]
+        # lexicographic group ids
+        order = np.lexsort(key_cols[::-1])
+        sorted_keys = [k[order] for k in key_cols]
+        if len(order) == 0:
+            data = {k: [] for k in self._keys}
+            data.update({a.name: [] for a in agg_list})
+            return Frame(data)
+        boundary = np.zeros(len(order), bool)
+        boundary[0] = True
+        for k in sorted_keys:
+            boundary[1:] |= k[1:] != k[:-1]
+        group_starts = np.flatnonzero(boundary)
+        group_ends = np.r_[group_starts[1:], len(order)]
+
+        data: dict[str, list] = {k: [] for k in self._keys}
+        for a in agg_list:
+            data[a.name] = []
+        for s, e in zip(group_starts, group_ends):
+            idx = order[s:e]
+            for k, kc in zip(self._keys, key_cols):
+                data[k].append(kc[idx[0]])
+            for a in agg_list:
+                if a.fn == "count" and a.column is None:
+                    data[a.name].append(len(idx))
+                else:
+                    data[a.name].append(_np_agg(a.fn, np.asarray(d[a.column])[idx]))
+        return Frame(data)
+
+    def count(self):
+        return self.agg(AggExpr("count", None))
+
+    def sum(self, *cols: str):
+        return self.agg(*[AggExpr("sum", c) for c in cols])
+
+    def avg(self, *cols: str):
+        return self.agg(*[AggExpr("avg", c) for c in cols])
+
+    mean = avg
+
+    def min(self, *cols: str):
+        return self.agg(*[AggExpr("min", c) for c in cols])
+
+    def max(self, *cols: str):
+        return self.agg(*[AggExpr("max", c) for c in cols])

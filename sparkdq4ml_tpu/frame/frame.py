@@ -320,6 +320,144 @@ class Frame:
         fields = ", ".join(f"{name}: {t}" for name, t in self.dtypes())
         return f"Frame[{fields}]"
 
+    # -- aggregation / reshaping ------------------------------------------
+    def group_by(self, *keys: str):
+        """``groupBy`` — returns a GroupedFrame with agg/count/avg/... ."""
+        from .aggregates import GroupedFrame
+
+        return GroupedFrame(self, list(keys))
+
+    groupBy = group_by
+
+    def agg(self, *aggs):
+        """Global aggregates (no grouping): masked device reductions."""
+        from .aggregates import AggExpr, global_agg
+
+        agg_list = [a if isinstance(a, AggExpr) else AggExpr(a, None)
+                    for a in aggs]
+        return global_agg(self, agg_list)
+
+    def sort(self, *cols: str, ascending=True) -> "Frame":
+        """``orderBy`` — reorders valid rows (host argsort at the boundary),
+        dropping masked slots (the result is compact)."""
+        if not cols:
+            raise ValueError("sort requires at least one column")
+        d = self.to_pydict()
+        asc = ([ascending] * len(cols) if isinstance(ascending, bool)
+               else list(ascending))
+        if len(asc) != len(cols):
+            raise ValueError("ascending list must match columns")
+        keys = []
+        for c, a in zip(reversed(cols), reversed(asc)):
+            k = np.asarray(d[c])
+            if k.dtype == object:
+                if not a:
+                    raise ValueError("descending sort on string columns is "
+                                     "not supported")
+                # nulls first (Spark's NULLS FIRST for ascending order):
+                # secondary key = value with None mapped to "", primary
+                # (appended later = higher priority) = null flag
+                null_flag = np.asarray([x is None for x in k], bool)
+                keys.append(np.asarray([x if x is not None else "" for x in k],
+                                       dtype=object))
+                keys.append(~null_flag)
+                continue
+            if not a:
+                k = -k
+            keys.append(k)
+        order = np.lexsort(keys)
+        return Frame({name: (vals[order] if vals.dtype == object
+                             else np.asarray(vals)[order])
+                      for name, vals in d.items()})
+
+    orderBy = sort
+    order_by = sort
+
+    def distinct(self) -> "Frame":
+        """Unique valid rows (host boundary; result compact, order of first
+        occurrence)."""
+        rows = self.collect()
+        seen = set()
+        out = []
+        for r in rows:
+            key = tuple(
+                tuple(x.tolist()) if isinstance(x, np.ndarray)  # vector cell
+                else (x.item() if hasattr(x, "item") else x)
+                for x in r)
+            if key not in seen:
+                seen.add(key)
+                out.append(r)
+        return Frame.from_rows(out, self.columns)
+
+    def drop_duplicates(self) -> "Frame":
+        return self.distinct()
+
+    dropDuplicates = drop_duplicates
+
+    def dropna(self, subset=None) -> "Frame":
+        """Mask out rows with NaN (float) / None (string) in any [subset]
+        column — stays static-shaped like ``filter``."""
+        cols = subset if subset is not None else self.columns
+        keep = jnp.ones((self._n,), jnp.bool_)
+        for name in cols:
+            arr = self._column_values(name)
+            if _is_string_col(arr):
+                keep = jnp.logical_and(
+                    keep, jnp.asarray([x is not None for x in arr]))
+            elif np.issubdtype(np.dtype(arr.dtype), np.floating):
+                flat_nan = jnp.isnan(arr)
+                if flat_nan.ndim > 1:
+                    flat_nan = flat_nan.any(axis=tuple(range(1, flat_nan.ndim)))
+                keep = jnp.logical_and(keep, jnp.logical_not(flat_nan))
+        return self._with(mask=jnp.logical_and(self._mask, keep))
+
+    def fillna(self, value, subset=None) -> "Frame":
+        """Replace NaN/None with ``value`` in [subset] columns."""
+        cols = subset if subset is not None else self.columns
+        data = dict(self._data)
+        for name in cols:
+            arr = self._data[name]
+            if _is_string_col(arr):
+                if isinstance(value, str):
+                    data[name] = np.asarray(
+                        [value if x is None else x for x in arr], dtype=object)
+            elif np.issubdtype(np.dtype(arr.dtype), np.floating) and \
+                    isinstance(value, (int, float)):
+                data[name] = jnp.where(jnp.isnan(arr),
+                                       jnp.asarray(value, arr.dtype), arr)
+        return self._with(data=data)
+
+    def describe(self, *cols: str) -> "Frame":
+        """Spark's ``describe``: count/mean/stddev/min/max summary rows for
+        numeric columns (all numeric columns when none named)."""
+        from .aggregates import AggExpr, global_agg
+
+        if not cols:
+            cols = tuple(name for name, arr in self._data.items()
+                         if not _is_string_col(arr) and arr.ndim == 1)
+        stats = ["count", "mean", "stddev", "min", "max"]
+        fns = [{"mean": "avg"}.get(s, s) for s in stats]
+        data: dict[str, object] = {"summary": np.asarray(stats, dtype=object)}
+        for c in cols:
+            aggs = [AggExpr(fn, c).alias(fn) for fn in fns]
+            row = global_agg(self, aggs).to_pydict()  # one sync per column
+            data[c] = np.asarray([str(row[fn][0]) for fn in fns], dtype=object)
+        return Frame(data)
+
+    # -- writer ------------------------------------------------------------
+    @property
+    def write(self):
+        """``df.write.format("csv").option("header", True).save(path)``."""
+        from .writer import DataFrameWriter
+
+        return DataFrameWriter(self)
+
+    def to_csv(self, path: str, header: bool = False,
+               delimiter: str = ",") -> None:
+        from .writer import write_csv
+
+        write_csv(self, path, header=header, delimiter=delimiter)
+
     # -- temp views --------------------------------------------------------
     def create_or_replace_temp_view(self, name: str) -> None:
         """Register this frame in the session catalog for SQL access

@@ -1,0 +1,11 @@
+"""``org.apache.spark.sql.functions`` equivalent — one import surface for
+column constructors, UDF invocation (the reference's
+``import static ...functions.callUDF``, `DataQuality4MachineLearningApp.java:3`),
+and aggregate constructors."""
+
+from .frame.aggregates import (avg, count, max, mean, min, stddev, sum,
+                               variance)
+from .ops.expressions import call_udf, callUDF, col, lit
+
+__all__ = ["col", "lit", "call_udf", "callUDF", "count", "sum", "avg",
+           "mean", "min", "max", "stddev", "variance"]

@@ -157,6 +157,25 @@ class LinearRegression(Estimator):
         return model
 
 
+    def fit_from_gram(self, A, frame: Frame) -> "LinearRegressionModel":
+        """Fit from a precomputed augmented Gramian — zero data passes.
+        Used by CrossValidator's fast path to refit the best model from the
+        already-reduced statistics."""
+        from .solvers import solve
+
+        result = solve(A, self.reg_param, self.elastic_net_param,
+                       max_iter=self.max_iter, tol=self.tol,
+                       fit_intercept=self.fit_intercept,
+                       standardization=self.standardization,
+                       solver=self.solver)
+        model = LinearRegressionModel(
+            coefficients=np.asarray(result.coefficients),
+            intercept=float(result.intercept),
+            params=self._params_dict())
+        model._summary_source = (frame, result)
+        return model
+
+
 class LinearRegressionModel(Model):
     def __init__(self, coefficients: np.ndarray, intercept: float,
                  params: Optional[dict] = None):

@@ -250,7 +250,8 @@ class CrossValidator(Estimator):
             best = _best_index(metrics, larger_better)
             best_est = _apply_params(self.estimator,
                                      self.estimator_param_maps[best])
-            best_model = best_est.fit(frame, mesh=mesh)
+            # refit from the already-reduced statistics — no extra data pass
+            best_model = best_est.fit_from_gram(A_all, frame)
             return CrossValidatorModel(best_model, metrics, best)
 
         # generic path: fit/evaluate each (param, fold) cell

@@ -47,7 +47,10 @@ _TOKEN_RE = re.compile(
     r")")
 
 _KEYWORDS = {"select", "from", "where", "as", "and", "or", "not", "cast",
-             "true", "false", "null"}
+             "true", "false", "null", "group", "by", "order", "limit",
+             "asc", "desc"}
+
+_AGG_FNS = {"count", "sum", "avg", "mean", "min", "max", "stddev", "variance"}
 
 
 class _Token:
@@ -119,8 +122,32 @@ class _Parser:
         where = None
         if self.accept("kw", "where"):
             where = self.parse_or()
+        group_by = []
+        if self.accept("kw", "group"):
+            self.expect("kw", "by")
+            group_by.append(self.expect("ident").value)
+            while self.accept("op", ","):
+                group_by.append(self.expect("ident").value)
+        order_by = []
+        if self.accept("kw", "order"):
+            self.expect("kw", "by")
+            order_by.append(self.parse_order_item())
+            while self.accept("op", ","):
+                order_by.append(self.parse_order_item())
+        limit = None
+        if self.accept("kw", "limit"):
+            limit = int(self.expect("number").value)
         self.expect("eof")
-        return items, view, where
+        return Query(items, view, where, group_by, order_by, limit)
+
+    def parse_order_item(self):
+        name = self.expect("ident").value
+        ascending = True
+        if self.accept("kw", "desc"):
+            ascending = False
+        else:
+            self.accept("kw", "asc")
+        return (name, ascending)
 
     def parse_select_list(self):
         if self.accept("op", "*"):
@@ -131,6 +158,27 @@ class _Parser:
         return items
 
     def parse_item(self):
+        # aggregate at top level: COUNT(*), AVG(price), ...
+        t = self.peek()
+        if (t.kind == "ident" and t.value.lower() in _AGG_FNS
+                and self.toks[self.i + 1].kind == "op"
+                and self.toks[self.i + 1].value == "("):
+            from ..frame.aggregates import AggExpr
+
+            fn = self.next().value
+            self.expect("op", "(")
+            if self.accept("op", "*"):
+                col = None
+            else:
+                col = self.expect("ident").value
+            self.expect("op", ")")
+            expr = AggExpr(fn, col)
+            if self.accept("kw", "as"):
+                return expr.alias(self.expect("ident").value)
+            alias = self.accept("ident")
+            if alias is not None:
+                return expr.alias(alias.value)
+            return expr
         expr = self.parse_or()
         if self.accept("kw", "as"):
             return expr.alias(self.expect("ident").value)
@@ -235,22 +283,73 @@ class _Parser:
         raise ValueError(f"SQL parse error at {t.value!r}")
 
 
-def parse(sql: str):
-    """Parse a query → (select items, view name, where Expr|None)."""
+class Query:
+    """Parsed query: select items, view, where, group_by, order_by, limit."""
+
+    def __init__(self, items, view, where, group_by=(), order_by=(), limit=None):
+        self.items = items
+        self.view = view
+        self.where = where
+        self.group_by = list(group_by)
+        self.order_by = list(order_by)
+        self.limit = limit
+
+
+def parse(sql: str) -> Query:
+    """Parse a query into a Query plan object."""
     return _Parser(tokenize(sql)).parse_query()
 
 
 def execute(sql: str, catalog=None):
     """Run a query against the catalog and return a Frame."""
+    from ..frame.aggregates import AggExpr
     from .catalog import default_catalog
 
     cat = catalog if catalog is not None else default_catalog()
-    items, view, where = parse(sql)
-    frame = cat.lookup(view)
-    if where is not None:
-        frame = frame.filter(where)
-    # NB: Expr overloads ==, so compare with identity-safe checks, never
-    # `items == ["*"]` (a single-Expr list would compare truthy).
-    if len(items) == 1 and isinstance(items[0], str) and items[0] == "*":
-        return frame
-    return frame.select(*items)
+    q = parse(sql)
+    frame = cat.lookup(q.view)
+    if q.where is not None:
+        frame = frame.filter(q.where)
+
+    aggs = [it for it in q.items if isinstance(it, AggExpr)]
+    if aggs or q.group_by:
+        non_aggs = [it for it in q.items
+                    if not isinstance(it, (AggExpr, str))]
+        for it in non_aggs:
+            if not isinstance(it, E.Col) or (q.group_by
+                                             and it.name not in q.group_by):
+                raise ValueError(
+                    f"non-aggregate select item {it} must be a GROUP BY key")
+        if q.group_by:
+            frame = frame.group_by(*q.group_by).agg(*aggs)
+            keep = [it.name for it in q.items
+                    if isinstance(it, (E.Col, AggExpr))]
+            frame = frame.select(*keep)
+        else:
+            if non_aggs:
+                raise ValueError("plain columns in an aggregate query "
+                                 "require GROUP BY")
+            frame = frame.agg(*aggs)
+    else:
+        # NB: Expr overloads ==, so compare with identity-safe checks, never
+        # `items == ["*"]` (a single-Expr list would compare truthy).
+        star = (len(q.items) == 1 and isinstance(q.items[0], str)
+                and q.items[0] == "*")
+        if q.order_by and not star:
+            # SQL sorts before projecting, so ORDER BY may reference columns
+            # the SELECT drops — sort first when the source has them all
+            # (otherwise fall through: the key must be a SELECT alias).
+            if all(c in frame.columns for c, _ in q.order_by):
+                frame = frame.sort(*[c for c, _ in q.order_by],
+                                   ascending=[a for _, a in q.order_by])
+                q = Query(q.items, q.view, None, [], [], q.limit)
+        if not star:
+            frame = frame.select(*q.items)
+
+    if q.order_by:
+        cols = [c for c, _ in q.order_by]
+        asc = [a for _, a in q.order_by]
+        frame = frame.sort(*cols, ascending=asc)
+    if q.limit is not None:
+        frame = frame.limit(q.limit)
+    return frame

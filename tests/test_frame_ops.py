@@ -1,0 +1,264 @@
+"""Frame wide-surface ops: groupBy/agg, sort, distinct, dropna/fillna,
+describe, CSV writer, SQL aggregates/ORDER BY/LIMIT, functions module."""
+
+import os
+
+import numpy as np
+import pytest
+
+import sparkdq4ml_tpu.functions as F
+from sparkdq4ml_tpu import Frame
+from sparkdq4ml_tpu.frame.csv import read_csv
+from sparkdq4ml_tpu.sql.parser import execute
+
+
+@pytest.fixture
+def df():
+    return Frame({"g": [1, 1, 2, 2, 3],
+                  "p": [10.0, 20.0, 30.0, 40.0, 50.0],
+                  "s": np.asarray(["a", "b", "a", None, "c"], dtype=object)})
+
+
+class TestGlobalAgg:
+    def test_agg_basics(self, df):
+        out = df.agg(F.count(), F.sum("p"), F.avg("p"), F.min("p"),
+                     F.max("p"), F.stddev("p"))
+        d = out.to_pydict()
+        assert d["count"][0] == 5
+        assert d["sum(p)"][0] == pytest.approx(150.0)
+        assert d["avg(p)"][0] == pytest.approx(30.0)
+        assert d["min(p)"][0] == 10.0
+        assert d["max(p)"][0] == 50.0
+        assert d["stddev(p)"][0] == pytest.approx(np.std([10, 20, 30, 40, 50],
+                                                         ddof=1))
+
+    def test_agg_respects_mask(self, df):
+        out = df.filter(F.col("p") > 20).agg(F.count(), F.avg("p"))
+        d = out.to_pydict()
+        assert d["count"][0] == 3
+        assert d["avg(p)"][0] == pytest.approx(40.0)
+
+    def test_unknown_aggregate(self):
+        from sparkdq4ml_tpu.frame.aggregates import AggExpr
+
+        with pytest.raises(ValueError):
+            AggExpr("median", "p")
+
+
+class TestGroupBy:
+    def test_group_count_avg(self, df):
+        out = df.group_by("g").agg(F.count(), F.avg("p")).sort("g")
+        d = out.to_pydict()
+        assert list(d["g"]) == [1, 2, 3]
+        assert list(d["count"]) == [2, 2, 1]
+        assert list(d["avg(p)"]) == [15.0, 35.0, 50.0]
+
+    def test_group_by_respects_mask(self, df):
+        out = df.filter(F.col("p") >= 20).group_by("g").count().sort("g")
+        assert list(out.to_pydict()["count"]) == [1, 2, 1]
+
+    def test_group_by_string_key(self, df):
+        out = df.filter(F.col("s").is_not_null()).group_by("s").count().sort("s")
+        d = out.to_pydict()
+        assert list(d["s"]) == ["a", "b", "c"]
+        assert list(d["count"]) == [2, 1, 1]
+
+    def test_terminal_helpers(self, df):
+        assert "sum(p)" in df.group_by("g").sum("p").columns
+        assert "max(p)" in df.group_by("g").max("p").columns
+
+    def test_missing_key_raises(self, df):
+        with pytest.raises(KeyError):
+            df.group_by("nope")
+
+    def test_empty_group_frame(self, df):
+        out = df.filter(F.col("p") > 1000).group_by("g").count()
+        assert out.count() == 0
+
+
+class TestSortDistinctNa:
+    def test_sort_asc_desc(self, df):
+        assert [r[1] for r in df.sort("p", ascending=False).collect()] == [
+            50.0, 40.0, 30.0, 20.0, 10.0]
+        assert [r[0] for r in df.sort("g").collect()] == [1, 1, 2, 2, 3]
+
+    def test_sort_multi_key(self):
+        f = Frame({"a": [2, 1, 2, 1], "b": [1.0, 2.0, 0.0, 1.0]})
+        out = f.sort("a", "b")
+        assert out.collect() == [(1, 1.0), (1, 2.0), (2, 0.0), (2, 1.0)]
+
+    def test_sort_drops_masked_rows(self, df):
+        out = df.filter(F.col("p") > 20).sort("p")
+        assert out.count() == 3
+        assert out.num_slots == 3  # compacted
+
+    def test_distinct(self):
+        f = Frame({"x": [1, 2, 1, 3, 2]})
+        assert sorted(r[0] for r in f.distinct().collect()) == [1, 2, 3]
+
+    def test_dropna_float_and_string(self, df):
+        f = df.with_column("p2", [1.0, float("nan"), 3.0, 4.0, 5.0])
+        assert f.dropna(["p2"]).count() == 4
+        assert df.dropna(["s"]).count() == 4
+        assert df.dropna().count() == 4
+
+    def test_fillna(self, df):
+        f = df.with_column("p2", [1.0, float("nan"), 3.0, 4.0, 5.0])
+        d = f.fillna(0.0, ["p2"]).to_pydict()
+        assert d["p2"][1] == 0.0
+        d2 = df.fillna("?", ["s"]).to_pydict()
+        assert d2["s"][3] == "?"
+
+    def test_describe(self, df):
+        out = df.describe("p")
+        d = out.to_pydict()
+        assert list(d["summary"]) == ["count", "mean", "stddev", "min", "max"]
+        assert float(d["p"][1]) == pytest.approx(30.0)
+
+
+class TestWriter:
+    def test_roundtrip(self, df, tmp_path):
+        path = str(tmp_path / "out.csv")
+        num = df.select("g", "p").filter(F.col("p") > 15)
+        num.write.format("csv").option("header", "true").save(path)
+        back = read_csv(path, header=True, infer_schema=True)
+        assert back.count() == num.count()
+        assert back.columns == ["g", "p"]
+        np.testing.assert_allclose(back.to_pydict()["p"],
+                                   num.to_pydict()["p"])
+
+    def test_mode_errorifexists(self, df, tmp_path):
+        path = str(tmp_path / "x.csv")
+        df.select("g").to_csv(path)
+        with pytest.raises(FileExistsError):
+            df.select("g").write.save(path)
+        df.select("g").write.mode("overwrite").save(path)  # no raise
+
+    def test_quoting_and_nulls(self, tmp_path):
+        f = Frame({"s": np.asarray(['a,b', 'q"q', None], dtype=object),
+                   "x": [1.0, float("nan"), 3.0]})
+        path = str(tmp_path / "q.csv")
+        f.to_csv(path)
+        text = open(path).read()
+        assert '"a,b"' in text
+        assert '"q""q"' in text
+        back = read_csv(path, infer_schema=True)
+        assert back.count() == 3
+
+    def test_masked_rows_not_written(self, df, tmp_path):
+        path = str(tmp_path / "m.csv")
+        df.select("g", "p").filter(F.col("g") == 1).to_csv(path)
+        assert len(open(path).read().strip().splitlines()) == 2
+
+
+class TestSqlAggregates:
+    @pytest.fixture(autouse=True)
+    def _view(self, session, df):
+        df.create_or_replace_temp_view("t")
+
+    def test_group_by(self, session):
+        out = session.sql("SELECT g, COUNT(*) AS n, AVG(p) AS m FROM t "
+                          "GROUP BY g ORDER BY g")
+        d = out.to_pydict()
+        assert list(d["n"]) == [2, 2, 1]
+        assert list(d["m"]) == [15.0, 35.0, 50.0]
+
+    def test_global_agg(self, session):
+        d = session.sql("SELECT SUM(p) AS s, MIN(p) AS lo FROM t "
+                        "WHERE g < 3").to_pydict()
+        assert d["s"][0] == pytest.approx(100.0)
+        assert d["lo"][0] == 10.0
+
+    def test_order_by_desc_limit(self, session):
+        out = session.sql("SELECT g, p FROM t ORDER BY p DESC LIMIT 2")
+        assert [r[1] for r in out.collect()] == [50.0, 40.0]
+
+    def test_plain_col_without_group_by_rejected(self, session):
+        with pytest.raises(ValueError):
+            session.sql("SELECT g, COUNT(*) FROM t")
+
+    def test_non_key_col_rejected(self, session):
+        with pytest.raises(ValueError):
+            session.sql("SELECT p, COUNT(*) FROM t GROUP BY g")
+
+    def test_count_star_where(self, session):
+        d = session.sql("SELECT COUNT(*) AS n FROM t WHERE p >= 30").to_pydict()
+        assert d["n"][0] == 3
+
+
+class TestAggNullAndOverflowSemantics:
+    def test_int_sum_exact_beyond_float32(self):
+        f = Frame({"x": np.arange(1, 3_000_001, dtype=np.int32)})
+        d = f.agg(F.sum("x")).to_pydict()
+        assert int(d["sum(x)"][0]) == 4_500_001_500_000  # Spark widens to long
+
+    def test_count_col_skips_nulls(self, df):
+        f = df.with_column("p2", [1.0, float("nan"), 3.0, 4.0, 5.0])
+        d = f.agg(F.count("p2"), F.count("s"), F.count()).to_pydict()
+        assert int(d["count(p2)"][0]) == 4
+        assert int(d["count(s)"][0]) == 4      # one None
+        assert int(d["count"][0]) == 5         # COUNT(*) keeps all rows
+
+    def test_avg_skips_nans(self, df):
+        f = df.with_column("p2", [2.0, float("nan"), 4.0, float("nan"), 6.0])
+        assert f.agg(F.avg("p2")).to_pydict()["avg(p2)"][0] == pytest.approx(4.0)
+
+    def test_stddev_single_row_is_nan(self):
+        f = Frame({"x": [5.0]})
+        assert np.isnan(f.agg(F.stddev("x")).to_pydict()["stddev(x)"][0])
+
+    def test_grouped_agg_skips_nulls(self):
+        f = Frame({"g": [1, 1, 2], "x": [1.0, float("nan"), 2.0]})
+        out = f.group_by("g").agg(F.count("x"), F.avg("x")).sort("g")
+        d = out.to_pydict()
+        assert list(d["count(x)"]) == [1, 1]
+        assert d["avg(x)"][0] == pytest.approx(1.0)
+
+    def test_distinct_with_vector_column(self):
+        from sparkdq4ml_tpu.models import VectorAssembler
+
+        f = Frame({"x": [1.0, 1.0, 2.0]})
+        f = VectorAssembler(["x"], "features").transform(f)
+        assert f.distinct().count() == 2
+
+    def test_sort_string_nulls_first(self):
+        f = Frame({"s": np.asarray(["b", None, "a"], dtype=object)})
+        assert [r[0] for r in f.sort("s").collect()] == [None, "a", "b"]
+
+    def test_sql_order_by_unprojected_column(self, session):
+        Frame({"name": np.asarray(["x", "y"], dtype=object),
+               "age": [30, 20]}).create_or_replace_temp_view("people")
+        out = session.sql("SELECT name FROM people ORDER BY age")
+        assert [r[0] for r in out.collect()] == ["y", "x"]
+
+    def test_cv_fast_path_refit_uses_gram(self, session):
+        """fit_from_gram must equal a regular fit on the same frame."""
+        from conftest import dataset_path, prepare_features, run_dq_pipeline
+        from sparkdq4ml_tpu.models import LinearRegression
+        from sparkdq4ml_tpu.models.solvers import augmented_gram
+        from sparkdq4ml_tpu.models.regression import _extract_xy
+        import jax.numpy as jnp
+
+        frame = prepare_features(run_dq_pipeline(session, dataset_path("small")))
+        lr = LinearRegression(max_iter=40, reg_param=1.0, elastic_net_param=1.0)
+        X, y, mask = _extract_xy(frame, "features", "label")
+        A = augmented_gram(jnp.asarray(X), jnp.asarray(y), mask)
+        m1 = lr.fit_from_gram(A, frame)
+        m2 = lr.fit(frame)
+        np.testing.assert_allclose(m1.coefficients, m2.coefficients, rtol=1e-12)
+        assert m1.summary.root_mean_squared_error == pytest.approx(
+            m2.summary.root_mean_squared_error, rel=1e-12)
+
+
+class TestDebugUtils:
+    def test_nan_checks_context(self):
+        import jax
+        import jax.numpy as jnp
+
+        from sparkdq4ml_tpu.utils.debug import nan_checks
+
+        with nan_checks():
+            with pytest.raises(FloatingPointError):
+                jax.jit(lambda x: jnp.log(x))(jnp.asarray(-1.0)).block_until_ready()
+        # restored afterwards
+        jax.jit(lambda x: jnp.log(x))(jnp.asarray(-1.0)).block_until_ready()

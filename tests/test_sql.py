@@ -69,8 +69,9 @@ class TestGrammar:
         assert session.sql("SELECT * FROM price WHERE price = -1").count() == 1
 
     def test_float_literals(self):
-        items, view_name, where = parse("SELECT 1.5 AS x FROM t WHERE y > 1e3")
-        assert view_name == "t"
+        q = parse("SELECT 1.5 AS x FROM t WHERE y > 1e3")
+        assert q.view == "t"
+        assert q.where is not None
 
     def test_bare_alias(self, session, view):
         out = session.sql("SELECT cast(guest as int) g FROM price")
