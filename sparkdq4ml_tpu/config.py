@@ -23,6 +23,14 @@ class _Config:
     default_int_dtype: jnp.dtype = jnp.int32
     # Rows shown by Frame.show() when no argument is given (Spark default: 20).
     default_show_rows: int = 20
+    # Pallas fast-path selection for the hot ops (ops/pallas_kernels.py):
+    # the single-device Gramian in solvers.augmented_gram and the fused DQ
+    # chain entry point ops/rules.py:dq_rules_fused. "off" = plain XLA
+    # (default; XLA fuses these well), "on" = compiled Pallas kernels,
+    # "auto" = Pallas when the backend is TPU, "interpret" = Pallas
+    # interpreter (CPU tests/CI of the kernel code). shard_map/vmap traces
+    # always use XLA (see pallas_kernels.dispatch_to_pallas).
+    pallas: str = "off"
 
 
 config = _Config()

@@ -1,0 +1,210 @@
+"""Pallas kernel fast paths (ops/pallas_kernels.py), run through the Pallas
+interpreter on the CPU test backend — the same kernel code that compiles via
+Mosaic on a real TPU. Parity oracle: the plain-XLA implementations."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from sparkdq4ml_tpu.config import config
+from sparkdq4ml_tpu.models.solvers import augmented_gram
+from sparkdq4ml_tpu.ops import pallas_kernels
+from sparkdq4ml_tpu.ops.rules import minimum_price_rule, price_correlation_rule
+
+from conftest import dataset_path
+
+
+@pytest.fixture(autouse=True)
+def _interpret_mode():
+    config.pallas = "interpret"
+    yield
+    config.pallas = "off"
+
+
+def _xla_gram(X, y, mask):
+    w = mask.astype(X.dtype)
+    Z = jnp.concatenate([X, y[:, None], jnp.ones_like(y)[:, None]], axis=1)
+    Zm = Z * w[:, None]
+    return Zm.T @ Zm
+
+
+class TestMaskedGramPallas:
+    def test_matches_xla_small(self):
+        rng = np.random.default_rng(0)
+        X = jnp.asarray(rng.normal(size=(37, 3)))
+        y = jnp.asarray(rng.normal(size=(37,)))
+        mask = jnp.asarray(rng.random(37) > 0.3)
+        A = pallas_kernels.masked_gram_pallas(X, y, mask)
+        np.testing.assert_allclose(np.asarray(A), np.asarray(_xla_gram(X, y, mask)),
+                                   rtol=1e-10)
+
+    def test_matches_xla_multi_tile(self):
+        """Rows > BLOCK_ROWS exercise the grid accumulation."""
+        rng = np.random.default_rng(1)
+        n = pallas_kernels.BLOCK_ROWS * 2 + 100
+        X = jnp.asarray(rng.normal(size=(n, 2)))
+        y = jnp.asarray(rng.normal(size=(n,)))
+        mask = jnp.asarray(rng.random(n) > 0.1)
+        A = pallas_kernels.masked_gram_pallas(X, y, mask)
+        np.testing.assert_allclose(np.asarray(A), np.asarray(_xla_gram(X, y, mask)),
+                                   rtol=1e-9)
+
+    def test_all_masked_rows_drop_out(self):
+        X = jnp.asarray(np.ones((16, 1)))
+        y = jnp.asarray(np.ones((16,)))
+        mask = jnp.zeros((16,), bool)
+        A = pallas_kernels.masked_gram_pallas(X, y, mask)
+        np.testing.assert_allclose(np.asarray(A), 0.0)
+
+    def test_dispatch_through_augmented_gram(self):
+        """config.pallas='interpret' routes solvers.augmented_gram here."""
+        rng = np.random.default_rng(2)
+        X = jnp.asarray(rng.normal(size=(20, 1)))
+        y = jnp.asarray(rng.normal(size=(20,)))
+        mask = jnp.asarray(np.ones(20, bool))
+        A = augmented_gram(X, y, mask)
+        np.testing.assert_allclose(np.asarray(A), np.asarray(_xla_gram(X, y, mask)),
+                                   rtol=1e-10)
+
+    def test_fit_end_to_end_matches_xla_path(self, session):
+        """Full Lasso fit over the Pallas Gramian reproduces the golden fit.
+
+        Single-device mesh: the sharded (shard_map) path deliberately keeps
+        the XLA Gramian — Pallas state-discharge has no vma support — so the
+        Pallas dispatch only triggers outside shard_map."""
+        import jax
+        from jax.sharding import Mesh
+
+        from conftest import prepare_features, run_dq_pipeline
+        from sparkdq4ml_tpu.models import LinearRegression
+
+        df = prepare_features(run_dq_pipeline(session, dataset_path("abstract")))
+        lr = (LinearRegression().set_max_iter(40).set_reg_param(1.0)
+              .set_elastic_net_param(1.0))
+        one_dev = Mesh(np.asarray(jax.devices()[:1]), ("data",))
+        model = lr.fit(df, mesh=one_dev)
+        assert abs(model.coefficients[0] - 4.923331) < 1e-3
+        assert abs(model.intercept - 21.010309) < 5e-3
+
+    def test_sharded_fit_falls_back_cleanly(self, session):
+        """With the full 8-device session mesh the same config still fits
+        correctly (XLA fallback inside shard_map)."""
+        from conftest import prepare_features, run_dq_pipeline
+        from sparkdq4ml_tpu.models import LinearRegression
+
+        df = prepare_features(run_dq_pipeline(session, dataset_path("abstract")))
+        lr = (LinearRegression().set_max_iter(40).set_reg_param(1.0)
+              .set_elastic_net_param(1.0))
+        model = lr.fit(df)
+        assert abs(model.coefficients[0] - 4.923331) < 1e-3
+
+
+class TestFusedDqRulesPallas:
+    def test_rule_columns_match_reference_rules(self):
+        rng = np.random.default_rng(3)
+        price = jnp.asarray(rng.uniform(0, 120, size=300))
+        guest = jnp.asarray(rng.integers(1, 40, size=300).astype(np.float64))
+        pnm, pcc, keep = pallas_kernels.dq_rules_pallas(price, guest)
+        np.testing.assert_allclose(np.asarray(pnm),
+                                   np.asarray(minimum_price_rule(price)))
+        np.testing.assert_allclose(np.asarray(pcc),
+                                   np.asarray(price_correlation_rule(price, guest)))
+        expect_keep = (np.asarray(pnm) > 0) & (np.asarray(pcc) > 0)
+        np.testing.assert_array_equal(np.asarray(keep), expect_keep)
+
+    @pytest.mark.parametrize("name,n_clean", [("abstract", 24), ("small", 20),
+                                              ("full", 1024)])
+    def test_golden_row_counts(self, name, n_clean):
+        """SURVEY.md §2.3: fused keep-mask reproduces the two-stage filter."""
+        from sparkdq4ml_tpu.frame.csv import read_csv
+
+        df = read_csv(dataset_path(name), infer_schema=True, header=False)
+        price = jnp.asarray(df._column_values("_c1"))
+        guest = jnp.asarray(df._column_values("_c0"))
+        _, _, keep = pallas_kernels.dq_rules_pallas(price, guest)
+        assert int(np.asarray(keep).sum()) == n_clean
+
+    def test_padding_slots_not_kept(self):
+        """n not a multiple of 128: padded tail must never enter the mask."""
+        price = jnp.asarray(np.full(5, 50.0))
+        guest = jnp.asarray(np.full(5, 20.0))
+        _, _, keep = pallas_kernels.dq_rules_pallas(price, guest)
+        assert keep.shape == (5,)
+        assert int(np.asarray(keep).sum()) == 5
+
+    def test_nan_null_asymmetry(self):
+        """NaN price propagates through rule 1 (NPE analogue) but rule 2's
+        null guard maps NaN→sentinel; both cases drop from the keep-mask —
+        identical to the XLA rule chain."""
+        price = jnp.asarray([np.nan, 50.0, 50.0])
+        guest = jnp.asarray([20.0, np.nan, 20.0])
+        pnm, pcc, keep = pallas_kernels.dq_rules_pallas(price, guest)
+        pnm, pcc, keep = map(np.asarray, (pnm, pcc, keep))
+        assert np.isnan(pnm[0])            # rule 1 propagates NaN
+        assert pcc[0] == -1.0              # rule 2 null guard (price NaN)
+        assert pcc[1] == -1.0              # rule 2 null guard (guest NaN)
+        np.testing.assert_array_equal(keep, [False, False, True])
+        # parity with the XLA fused expression
+        config.pallas = "off"
+        from sparkdq4ml_tpu.ops.rules import dq_rules_fused
+        pnm2, pcc2, keep2 = map(np.asarray, dq_rules_fused(price, guest))
+        np.testing.assert_array_equal(keep2, keep)
+        np.testing.assert_allclose(pcc2, pcc)
+
+    def test_multi_tile_rows(self):
+        """Column longer than one DQ row tile exercises the grid."""
+        n = pallas_kernels.DQ_BLOCK_ROWS * 128 + 777
+        rng = np.random.default_rng(7)
+        price = jnp.asarray(rng.uniform(0, 120, size=n))
+        guest = jnp.asarray(rng.integers(1, 40, size=n).astype(np.float64))
+        _, _, keep = pallas_kernels.dq_rules_pallas(price, guest)
+        expect = (np.asarray(minimum_price_rule(price)) > 0) & (
+            np.asarray(price_correlation_rule(price, guest)) > 0)
+        np.testing.assert_array_equal(np.asarray(keep), expect)
+
+
+class TestDispatchGates:
+    def test_zero_rows_returns_zero_gram(self):
+        X = jnp.zeros((0, 2))
+        y = jnp.zeros((0,))
+        mask = jnp.zeros((0,), bool)
+        A = pallas_kernels.masked_gram_pallas(X, y, mask)
+        assert A.shape == (4, 4)
+        np.testing.assert_allclose(np.asarray(A), 0.0)
+
+    def test_vmap_falls_back_to_xla(self):
+        """CrossValidator vmaps augmented_gram over fold masks; the Pallas
+        dispatch must decline BatchTracers (batching would corrupt the
+        grid-step-0 accumulator init)."""
+        import jax
+
+        rng = np.random.default_rng(4)
+        X = jnp.asarray(rng.normal(size=(40, 2)))
+        y = jnp.asarray(rng.normal(size=(40,)))
+        masks = jnp.asarray(rng.random((3, 40)) > 0.4)
+        grams = jax.vmap(lambda m: augmented_gram(X, y, m))(masks)
+        for k in range(3):
+            np.testing.assert_allclose(np.asarray(grams[k]),
+                                       np.asarray(_xla_gram(X, y, masks[k])),
+                                       rtol=1e-9)
+
+    def test_cross_validator_grid_with_pallas_enabled(self, session):
+        """End-to-end CV grid search runs correctly with config.pallas set
+        (the vmapped fold path must silently use XLA)."""
+        from conftest import prepare_features, run_dq_pipeline
+        from sparkdq4ml_tpu.models import LinearRegression
+        from sparkdq4ml_tpu.models.tuning import (CrossValidator,
+                                                  ParamGridBuilder)
+        from sparkdq4ml_tpu.models.evaluation import RegressionEvaluator
+
+        df = prepare_features(run_dq_pipeline(session, dataset_path("abstract")))
+        lr = LinearRegression().set_max_iter(20)
+        grid = (ParamGridBuilder()
+                .add_grid("reg_param", [0.0, 1.0])
+                .add_grid("elastic_net_param", [0.0, 1.0])
+                .build())
+        cv = CrossValidator(estimator=lr, estimator_param_maps=grid,
+                            evaluator=RegressionEvaluator(metric_name="rmse"),
+                            num_folds=3, seed=7)
+        model = cv.fit(df)
+        assert np.isfinite(model.avg_metrics).all()
