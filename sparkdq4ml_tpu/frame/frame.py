@@ -394,6 +394,133 @@ class Frame:
 
     dropDuplicates = drop_duplicates
 
+    def join(self, other: "Frame", on, how: str = "inner") -> "Frame":
+        """Relational join on key column(s) present in both frames.
+
+        ``how``: ``inner`` | ``left`` | ``right`` | ``outer``/``full`` |
+        ``left_semi`` | ``left_anti`` | ``cross``. Key columns appear once in
+        the result (Spark's ``USING`` semantics); a non-key column name
+        present on both sides keeps the left column and surfaces the right
+        one as ``<name>_right`` (explicit, instead of Spark's ambiguous
+        duplicate).
+
+        Design: only valid (mask=True) rows participate. The match *plan*
+        (row-index pairs) is computed host-side with a hash join — the
+        analogue of Spark's driver/shuffle planning, and unavoidable for
+        host-resident string keys — while column *materialization* is device
+        gathers (``jnp.take``), so numeric data never leaves HBM. Unmatched
+        slots in outer joins fill with NaN (numeric, int promotes to float)
+        or None (string).
+        """
+        how = how.lower().replace("fullouter", "outer").replace("full", "outer")
+        valid = ("inner", "left", "right", "outer", "left_semi", "left_anti",
+                 "cross")
+        if how not in valid:
+            raise ValueError(f"unknown join type {how!r}; expected one of {valid}")
+        keys = [on] if isinstance(on, str) else list(on or [])
+        if how != "cross":
+            if not keys:
+                raise ValueError("join requires `on` key column(s)")
+            for k in keys:
+                if k not in self.columns or k not in other.columns:
+                    raise ValueError(f"join key {k!r} must exist in both frames")
+
+        li = np.nonzero(self._host_mask())[0]
+        ri = np.nonzero(other._host_mask())[0]
+
+        def key_tuples(frame, idx):
+            cols = [np.asarray(frame._column_values(k))[idx] for k in keys]
+            return list(zip(*[c.tolist() for c in cols])) if keys else []
+
+        if how == "cross":
+            lpairs = np.repeat(li, len(ri))
+            rpairs = np.tile(ri, len(li))
+        else:
+            rkeys = key_tuples(other, ri)
+            table: dict = {}
+            for pos, kt in zip(ri, rkeys):
+                table.setdefault(kt, []).append(pos)
+            lkeys = key_tuples(self, li)
+            lp, rp = [], []
+            matched_r = set()
+            for pos, kt in zip(li, lkeys):
+                hits = table.get(kt)
+                if hits:
+                    if how == "left_anti":
+                        continue
+                    if how == "left_semi":
+                        lp.append(pos)
+                        rp.append(hits[0])
+                        continue
+                    for rpos in hits:
+                        lp.append(pos)
+                        rp.append(rpos)
+                        matched_r.add(rpos)
+                elif how in ("left", "outer", "left_anti"):
+                    lp.append(pos)
+                    rp.append(-1)
+            if how in ("right", "outer"):
+                for pos in ri:
+                    if pos not in matched_r:
+                        lp.append(-1)
+                        rp.append(pos)
+            lpairs = np.asarray(lp, np.int64)
+            rpairs = np.asarray(rp, np.int64)
+
+        def gather(frame, idx, fill_missing):
+            """Materialize frame columns at idx; idx == -1 ⇒ null fill."""
+            missing = idx < 0
+            safe = np.where(missing, 0, idx)
+            out = {}
+            for name in frame.columns:
+                arr = frame._data[name]
+                if _is_string_col(arr):
+                    col = arr[safe]
+                    if fill_missing and missing.any():
+                        col = col.copy()
+                        col[missing] = None
+                    out[name] = col
+                else:
+                    col = jnp.take(jnp.asarray(arr), jnp.asarray(safe), axis=0)
+                    if fill_missing and missing.any():
+                        if not np.issubdtype(np.dtype(col.dtype), np.floating):
+                            col = col.astype(float_dtype())
+                        nan = jnp.asarray(np.nan, col.dtype)
+                        m = jnp.asarray(missing)
+                        col = jnp.where(m[(...,) + (None,) * (col.ndim - 1)],
+                                        nan, col)
+                    out[name] = col
+            return out
+
+        left_cols = gather(self, lpairs, how in ("right", "outer"))
+        if how in ("left_semi", "left_anti"):
+            return Frame(left_cols)
+        right_cols = gather(other, rpairs, how in ("left", "outer", "left_anti"))
+        data = dict(left_cols)
+        if how in ("right", "outer") and lpairs.size and (lpairs < 0).any():
+            # USING semantics: one key column, coalesced from the non-null
+            # side (rows appended for unmatched right rows have lpairs == -1).
+            miss = lpairs < 0
+            for k in keys:
+                lk, rk = data[k], right_cols[k]
+                if _is_string_col(lk) or _is_string_col(rk):
+                    data[k] = np.where(miss, np.asarray(rk, dtype=object),
+                                       np.asarray(lk, dtype=object))
+                else:
+                    data[k] = jnp.where(jnp.asarray(miss),
+                                        jnp.asarray(rk).astype(lk.dtype), lk)
+        for name, col in right_cols.items():
+            if name in keys:
+                continue
+            out_name = name + "_right" if name in data else name
+            data[out_name] = col
+        return Frame(data)
+
+    def cross_join(self, other: "Frame") -> "Frame":
+        return self.join(other, on=None, how="cross")
+
+    crossJoin = cross_join
+
     def dropna(self, subset=None) -> "Frame":
         """Mask out rows with NaN (float) / None (string) in any [subset]
         column — stays static-shaped like ``filter``."""

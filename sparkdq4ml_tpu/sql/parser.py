@@ -14,7 +14,10 @@ row interpreter.
 
 Grammar (recursive descent):
 
-    query      := SELECT select_list FROM ident [WHERE or_expr]
+    query      := SELECT select_list FROM ident join* [WHERE or_expr]
+                  [GROUP BY ...] [ORDER BY ...] [LIMIT n]
+    join       := [INNER|LEFT [OUTER]|RIGHT [OUTER]|FULL [OUTER]|CROSS]
+                  JOIN ident (ON ident '=' ident | USING '(' ident,* ')')
     select_list:= '*' | item (',' item)*
     item       := expr [[AS] ident]
     or_expr    := and_expr (OR and_expr)*
@@ -48,7 +51,8 @@ _TOKEN_RE = re.compile(
 
 _KEYWORDS = {"select", "from", "where", "as", "and", "or", "not", "cast",
              "true", "false", "null", "group", "by", "order", "limit",
-             "asc", "desc"}
+             "asc", "desc", "join", "inner", "left", "right", "full",
+             "outer", "cross", "on", "using"}
 
 _AGG_FNS = {"count", "sum", "avg", "mean", "min", "max", "stddev", "variance"}
 
@@ -119,6 +123,12 @@ class _Parser:
         items = self.parse_select_list()
         self.expect("kw", "from")
         view = self.expect("ident").value
+        joins = []
+        while True:
+            join = self.parse_join()
+            if join is None:
+                break
+            joins.append(join)
         where = None
         if self.accept("kw", "where"):
             where = self.parse_or()
@@ -138,7 +148,43 @@ class _Parser:
         if self.accept("kw", "limit"):
             limit = int(self.expect("number").value)
         self.expect("eof")
-        return Query(items, view, where, group_by, order_by, limit)
+        return Query(items, view, where, group_by, order_by, limit, joins)
+
+    def parse_join(self):
+        """``[INNER|LEFT [OUTER]|RIGHT [OUTER]|FULL [OUTER]|CROSS] JOIN view
+        (ON a = b | USING (k, ...))`` → ``(view, how, keys)``."""
+        how = None
+        for kw in ("inner", "left", "right", "full", "cross"):
+            if self.accept("kw", kw):
+                how = {"full": "outer"}.get(kw, kw)
+                self.accept("kw", "outer")
+                break
+        if how is None:
+            if not self.accept("kw", "join"):
+                return None
+            how = "inner"
+        else:
+            self.expect("kw", "join")
+        view = self.expect("ident").value
+        keys: list[str] = []
+        if how != "cross":
+            if self.accept("kw", "using"):
+                self.expect("op", "(")
+                keys.append(self.expect("ident").value)
+                while self.accept("op", ","):
+                    keys.append(self.expect("ident").value)
+                self.expect("op", ")")
+            else:
+                self.expect("kw", "on")
+                a = self.expect("ident").value
+                self.expect("op", "=")
+                b = self.expect("ident").value
+                if a != b:
+                    raise ValueError(
+                        f"JOIN ON supports equi-join on a shared column name; "
+                        f"got {a!r} = {b!r} (use USING or rename first)")
+                keys.append(a)
+        return (view, how, keys)
 
     def parse_order_item(self):
         name = self.expect("ident").value
@@ -284,15 +330,17 @@ class _Parser:
 
 
 class Query:
-    """Parsed query: select items, view, where, group_by, order_by, limit."""
+    """Parsed query: select items, view, joins, where, group/order/limit."""
 
-    def __init__(self, items, view, where, group_by=(), order_by=(), limit=None):
+    def __init__(self, items, view, where, group_by=(), order_by=(),
+                 limit=None, joins=()):
         self.items = items
         self.view = view
         self.where = where
         self.group_by = list(group_by)
         self.order_by = list(order_by)
         self.limit = limit
+        self.joins = list(joins)
 
 
 def parse(sql: str) -> Query:
@@ -308,6 +356,8 @@ def execute(sql: str, catalog=None):
     cat = catalog if catalog is not None else default_catalog()
     q = parse(sql)
     frame = cat.lookup(q.view)
+    for view, how, keys in q.joins:
+        frame = frame.join(cat.lookup(view), on=keys or None, how=how)
     if q.where is not None:
         frame = frame.filter(q.where)
 

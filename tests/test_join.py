@@ -1,0 +1,172 @@
+"""Frame.join + SQL JOIN: relational joins over the masked columnar engine.
+
+Oracle: hand-computed row sets; unmatched slots are NaN (numeric) / None
+(string) — the framework's null analogue (Frame uses NaN-as-null throughout,
+see frame.py dropna/fillna)."""
+
+import numpy as np
+import pytest
+
+from sparkdq4ml_tpu.frame import Frame
+
+
+@pytest.fixture
+def orders():
+    return Frame({
+        "order_id": [1, 2, 3, 4, 5],
+        "customer": ["ada", "bob", "ada", "cid", "eve"],
+        "amount": [10.0, 20.0, 30.0, 40.0, 50.0],
+    })
+
+
+@pytest.fixture
+def customers():
+    return Frame({
+        "customer": ["ada", "bob", "cid", "dan"],
+        "city": ["paris", "oslo", "rome", "kyiv"],
+        "amount": [1.0, 2.0, 3.0, 4.0],  # name-collides with orders.amount
+    })
+
+
+def rows(frame, *cols):
+    d = frame.to_pydict()
+    return list(zip(*[[x.item() if hasattr(x, "item") else x for x in d[c]]
+                      for c in cols]))
+
+
+class TestJoinTypes:
+    def test_inner(self, orders, customers):
+        j = orders.join(customers, on="customer", how="inner")
+        assert j.count() == 4
+        got = set(rows(j, "order_id", "city"))
+        assert got == {(1, "paris"), (2, "oslo"), (3, "paris"), (4, "rome")}
+
+    def test_inner_duplicate_nonkey_column_suffixed(self, orders, customers):
+        j = orders.join(customers, on="customer")
+        assert "amount" in j.columns and "amount_right" in j.columns
+        for oid, lamt, ramt in rows(j, "order_id", "amount", "amount_right"):
+            assert lamt == oid * 10.0
+            assert ramt in (1.0, 2.0, 3.0)
+
+    def test_left(self, orders, customers):
+        j = orders.join(customers, on="customer", how="left")
+        assert j.count() == 5
+        by_order = dict(rows(j, "order_id", "city"))
+        assert by_order[5] is None  # eve unmatched
+        assert by_order[1] == "paris"
+        amt = dict(rows(j, "order_id", "amount_right"))
+        assert np.isnan(amt[5])
+
+    def test_right(self, orders, customers):
+        j = orders.join(customers, on="customer", how="right")
+        assert j.count() == 5  # 4 matches + dan
+        cities = [c for _, c in rows(j, "customer", "city")]
+        assert "kyiv" in cities
+        by_city = {c: o for o, c in rows(j, "order_id", "city")}
+        assert np.isnan(by_city["kyiv"])  # no left order for dan
+        # key column coalesced from the right side
+        assert "dan" in [k for k, in rows(j, "customer")]
+
+    def test_outer(self, orders, customers):
+        j = orders.join(customers, on="customer", how="outer")
+        assert j.count() == 6  # 4 matches + eve + dan
+        keys = sorted(k for k, in rows(j, "customer"))
+        assert keys == ["ada", "ada", "bob", "cid", "dan", "eve"]
+
+    def test_left_semi(self, orders, customers):
+        j = orders.join(customers, on="customer", how="left_semi")
+        assert j.columns == orders.columns  # left columns only
+        assert sorted(o for o, in rows(j, "order_id")) == [1, 2, 3, 4]
+
+    def test_left_anti(self, orders, customers):
+        j = orders.join(customers, on="customer", how="left_anti")
+        assert sorted(o for o, in rows(j, "order_id")) == [5]
+        assert j.columns == orders.columns
+
+    def test_cross(self, orders, customers):
+        j = orders.cross_join(customers)
+        assert j.count() == 5 * 4
+
+    def test_unknown_how_raises(self, orders, customers):
+        with pytest.raises(ValueError, match="unknown join type"):
+            orders.join(customers, on="customer", how="sideways")
+
+    def test_missing_key_raises(self, orders, customers):
+        with pytest.raises(ValueError, match="must exist in both"):
+            orders.join(customers, on="order_id")
+
+
+class TestJoinSemantics:
+    def test_masked_rows_do_not_join(self, orders, customers):
+        filtered = orders.filter(orders["amount"] < 35.0)  # drops 4, 5
+        j = filtered.join(customers, on="customer", how="inner")
+        assert sorted(o for o, in rows(j, "order_id")) == [1, 2, 3]
+
+    def test_duplicate_right_keys_multiply(self):
+        left = Frame({"k": [1, 2], "a": [10.0, 20.0]})
+        right = Frame({"k": [1, 1, 3], "b": [1.0, 2.0, 3.0]})
+        j = left.join(right, on="k", how="inner")
+        assert sorted(rows(j, "k", "b")) == [(1, 1.0), (1, 2.0)]
+
+    def test_multi_key_join(self):
+        left = Frame({"a": [1, 1, 2], "b": [1, 2, 1], "x": [1.0, 2.0, 3.0]})
+        right = Frame({"a": [1, 2], "b": [2, 1], "y": [9.0, 8.0]})
+        j = left.join(right, on=["a", "b"], how="inner")
+        assert sorted(rows(j, "x", "y")) == [(2.0, 9.0), (3.0, 8.0)]
+
+    def test_int_keys_unmatched_promote_to_float_nan(self):
+        left = Frame({"k": [1, 9], "n": [7, 8]})
+        right = Frame({"k": [1], "m": [5]})
+        j = left.join(right, on="k", how="left")
+        d = j.to_pydict()
+        m = {k: v for k, v in zip(d["k"], d["m"])}
+        assert m[1] == 5.0
+        assert np.isnan(m[9])
+
+    def test_empty_result_inner(self):
+        left = Frame({"k": [1], "a": [1.0]})
+        right = Frame({"k": [2], "b": [2.0]})
+        j = left.join(right, on="k", how="inner")
+        assert j.count() == 0
+
+
+class TestSqlJoin:
+    @pytest.fixture(autouse=True)
+    def views(self, orders, customers):
+        orders.create_or_replace_temp_view("orders")
+        customers.create_or_replace_temp_view("customers")
+
+    def test_sql_inner_join_using(self, session):
+        j = session.sql("SELECT order_id, city FROM orders "
+                        "JOIN customers USING (customer)")
+        assert j.count() == 4
+
+    def test_sql_left_join_on(self, session):
+        j = session.sql("SELECT order_id, city FROM orders "
+                        "LEFT JOIN customers ON customer = customer")
+        assert j.count() == 5
+
+    def test_sql_join_then_where(self, session):
+        j = session.sql("SELECT order_id FROM orders "
+                        "JOIN customers USING (customer) WHERE amount > 25")
+        assert sorted(o for o, in rows(j, "order_id")) == [3, 4]
+
+    def test_sql_cross_join(self, session):
+        j = session.sql("SELECT order_id FROM orders CROSS JOIN customers")
+        assert j.count() == 20
+
+    def test_sql_full_outer(self, session):
+        j = session.sql("SELECT customer FROM orders "
+                        "FULL OUTER JOIN customers USING (customer)")
+        assert j.count() == 6
+
+    def test_sql_join_aggregate(self, session):
+        j = session.sql("SELECT city, sum(amount) AS total FROM orders "
+                        "JOIN customers USING (customer) GROUP BY city "
+                        "ORDER BY total DESC")
+        got = rows(j, "city", "total")
+        assert got[0] == ("paris", 40.0)
+
+    def test_sql_on_mismatched_names_raises(self, session):
+        with pytest.raises(ValueError, match="shared column name"):
+            session.sql("SELECT * FROM orders JOIN customers ON customer = city")
