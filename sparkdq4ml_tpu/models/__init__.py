@@ -5,7 +5,9 @@ from .classification import (BinaryLogisticRegressionSummary,
 from .evaluation import (BinaryClassificationEvaluator, Evaluator,
                          MulticlassClassificationEvaluator,
                          RegressionEvaluator)
-from .feature import VectorAssembler
+from .feature import (MaxAbsScaler, MaxAbsScalerModel, MinMaxScaler,
+                      MinMaxScalerModel, StandardScaler, StandardScalerModel,
+                      VectorAssembler)
 from .linalg import Vectors
 from .regression import (LinearRegression, LinearRegressionModel,
                          LinearRegressionSummary,

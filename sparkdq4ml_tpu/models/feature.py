@@ -1,19 +1,30 @@
-"""VectorAssembler — packs input columns into one ``(n, d)`` feature matrix
-column (`DataQuality4MachineLearningApp.java:110-113`).
+"""Feature-layer transformers.
 
-TPU-first: the "vector column" is literally the feature matrix in HBM, laid
-out densely so the fit's Gramian is a single MXU matmul — there is no per-row
-vector object.
+``VectorAssembler`` packs input columns into one ``(n, d)`` feature-matrix
+column (`DataQuality4MachineLearningApp.java:110-113`). TPU-first: the
+"vector column" is literally the feature matrix in HBM, laid out densely so
+the fit's Gramian is a single MXU matmul — there is no per-row vector object.
+
+``StandardScaler`` / ``MinMaxScaler`` / ``MaxAbsScaler`` are the adjacent
+MLlib feature estimators (same ``spark.ml.feature`` package the reference's
+VectorAssembler comes from, pom.xml:29-32 mllib dependency). Statistics are
+mask-weighted one-pass device reductions — filtered rows never leak into the
+moments (SURVEY.md §7 "Masked-filter semantics") — and MLlib conventions are
+kept: StandardScaler uses the *sample* (n−1) std, defaults
+``with_mean=False, with_std=True``, and maps zero-variance features to 0;
+MinMaxScaler maps constant features to ``(min+max)/2``.
 """
 
 from __future__ import annotations
 
 from typing import Optional, Sequence
 
+import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..config import float_dtype
-from .base import Transformer
+from .base import Estimator, Model, Transformer
 
 
 class VectorAssembler(Transformer):
@@ -53,3 +64,199 @@ class VectorAssembler(Transformer):
             arr = jnp.asarray(frame._column_values(name), dt)
             parts.append(arr[:, None] if arr.ndim == 1 else arr)
         return frame.with_column(self.output_col, jnp.concatenate(parts, axis=1))
+
+
+class _ScalerBase(Estimator):
+    """Shared input/output-col builder surface for the feature scalers."""
+
+    def __init__(self, input_col: str = "features",
+                 output_col: str = "scaled_features"):
+        self.input_col = input_col
+        self.output_col = output_col
+
+    def set_input_col(self, name: str):
+        self.input_col = name
+        return self
+
+    setInputCol = set_input_col
+
+    def set_output_col(self, name: str):
+        self.output_col = name
+        return self
+
+    setOutputCol = set_output_col
+
+    def _masked_feature_matrix(self, frame):
+        """(n, d) feature matrix + (n,) mask weights on device."""
+        X = jnp.asarray(frame._column_values(self.input_col), float_dtype())
+        if X.ndim == 1:
+            X = X[:, None]
+        w = frame.mask.astype(X.dtype)
+        return X, w
+
+
+@jax.jit
+def _masked_moments(X, w):
+    """Mask-weighted count, mean, and sample variance — one fused pass."""
+    n = jnp.sum(w)
+    wc = w[:, None]
+    mean = jnp.sum(X * wc, axis=0) / n
+    centered = (X - mean) * wc
+    var = jnp.sum(centered * centered, axis=0) / jnp.maximum(n - 1.0, 1.0)
+    return n, mean, var
+
+
+@jax.jit
+def _masked_min_max(X, w):
+    big = jnp.asarray(jnp.finfo(X.dtype).max, X.dtype)
+    wc = w[:, None] > 0
+    lo = jnp.min(jnp.where(wc, X, big), axis=0)
+    hi = jnp.max(jnp.where(wc, X, -big), axis=0)
+    return lo, hi
+
+
+class StandardScaler(_ScalerBase):
+    """MLlib ``StandardScaler``: defaults ``with_mean=False, with_std=True``;
+    sample (n−1) std; zero-variance features scale to 0.0."""
+
+    def __init__(self, input_col: str = "features",
+                 output_col: str = "scaled_features",
+                 with_mean: bool = False, with_std: bool = True):
+        super().__init__(input_col, output_col)
+        self.with_mean = with_mean
+        self.with_std = with_std
+
+    def set_with_mean(self, v: bool):
+        self.with_mean = v
+        return self
+
+    setWithMean = set_with_mean
+
+    def set_with_std(self, v: bool):
+        self.with_std = v
+        return self
+
+    setWithStd = set_with_std
+
+    def fit(self, frame) -> "StandardScalerModel":
+        X, w = self._masked_feature_matrix(frame)
+        _, mean, var = _masked_moments(X, w)
+        return StandardScalerModel(np.asarray(mean), np.asarray(jnp.sqrt(var)),
+                                   self.with_mean, self.with_std,
+                                   self.input_col, self.output_col)
+
+
+class StandardScalerModel(Model):
+    def __init__(self, mean, std, with_mean, with_std, input_col, output_col):
+        self.mean = np.asarray(mean)
+        self.std = np.asarray(std)
+        self.with_mean = with_mean
+        self.with_std = with_std
+        self.input_col = input_col
+        self.output_col = output_col
+
+    def transform(self, frame):
+        X = jnp.asarray(frame._column_values(self.input_col), float_dtype())
+        squeeze = X.ndim == 1
+        if squeeze:
+            X = X[:, None]
+        if self.with_mean:
+            X = X - jnp.asarray(self.mean, X.dtype)
+        if self.with_std:
+            # MLlib: features with std == 0 map to 0.0 (scale factor 0).
+            inv = np.where(self.std > 0, 1.0 / np.where(self.std > 0,
+                                                        self.std, 1.0), 0.0)
+            X = X * jnp.asarray(inv, X.dtype)
+        return frame.with_column(self.output_col,
+                                 X[:, 0] if squeeze else X)
+
+
+class MinMaxScaler(_ScalerBase):
+    """MLlib ``MinMaxScaler``: rescale to [min, max] per feature; constant
+    features map to ``(min+max)/2``."""
+
+    def __init__(self, input_col: str = "features",
+                 output_col: str = "scaled_features",
+                 min: float = 0.0, max: float = 1.0):
+        super().__init__(input_col, output_col)
+        self.min = float(min)
+        self.max = float(max)
+
+    def set_min(self, v: float):
+        self.min = float(v)
+        return self
+
+    setMin = set_min
+
+    def set_max(self, v: float):
+        self.max = float(v)
+        return self
+
+    setMax = set_max
+
+    def fit(self, frame) -> "MinMaxScalerModel":
+        X, w = self._masked_feature_matrix(frame)
+        lo, hi = _masked_min_max(X, w)
+        return MinMaxScalerModel(np.asarray(lo), np.asarray(hi),
+                                 self.min, self.max,
+                                 self.input_col, self.output_col)
+
+
+class MinMaxScalerModel(Model):
+    def __init__(self, original_min, original_max, min, max,
+                 input_col, output_col):
+        self.original_min = np.asarray(original_min)
+        self.original_max = np.asarray(original_max)
+        self.min = min
+        self.max = max
+        self.input_col = input_col
+        self.output_col = output_col
+
+    originalMin = property(lambda self: self.original_min)
+    originalMax = property(lambda self: self.original_max)
+
+    def transform(self, frame):
+        X = jnp.asarray(frame._column_values(self.input_col), float_dtype())
+        squeeze = X.ndim == 1
+        if squeeze:
+            X = X[:, None]
+        rng = self.original_max - self.original_min
+        constant = rng == 0
+        inv = np.where(constant, 0.0, 1.0 / np.where(constant, 1.0, rng))
+        scaled = (X - jnp.asarray(self.original_min, X.dtype)) \
+            * jnp.asarray(inv, X.dtype) * (self.max - self.min) + self.min
+        half = 0.5 * (self.max + self.min)
+        scaled = jnp.where(jnp.asarray(constant), jnp.asarray(half, X.dtype),
+                           scaled)
+        return frame.with_column(self.output_col,
+                                 scaled[:, 0] if squeeze else scaled)
+
+
+class MaxAbsScaler(_ScalerBase):
+    """MLlib ``MaxAbsScaler``: divide by per-feature max |x| (sparsity
+    preserving); all-zero features stay 0."""
+
+    def fit(self, frame) -> "MaxAbsScalerModel":
+        X, w = self._masked_feature_matrix(frame)
+        lo, hi = _masked_min_max(X, w)
+        max_abs = np.maximum(np.abs(np.asarray(lo)), np.abs(np.asarray(hi)))
+        return MaxAbsScalerModel(max_abs, self.input_col, self.output_col)
+
+
+class MaxAbsScalerModel(Model):
+    def __init__(self, max_abs, input_col, output_col):
+        self.max_abs = np.asarray(max_abs)
+        self.input_col = input_col
+        self.output_col = output_col
+
+    maxAbs = property(lambda self: self.max_abs)
+
+    def transform(self, frame):
+        X = jnp.asarray(frame._column_values(self.input_col), float_dtype())
+        squeeze = X.ndim == 1
+        if squeeze:
+            X = X[:, None]
+        inv = np.where(self.max_abs > 0,
+                       1.0 / np.where(self.max_abs > 0, self.max_abs, 1.0), 0.0)
+        X = X * jnp.asarray(inv, X.dtype)
+        return frame.with_column(self.output_col, X[:, 0] if squeeze else X)

@@ -1,0 +1,131 @@
+"""Feature scalers (models/feature.py) — MLlib conventions, sklearn as the
+independent parity oracle, mask-weighting as the framework-specific check."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from sparkdq4ml_tpu.frame import Frame
+from sparkdq4ml_tpu.models import (MaxAbsScaler, MinMaxScaler, Pipeline,
+                                   StandardScaler, VectorAssembler)
+
+
+@pytest.fixture
+def xframe():
+    rng = np.random.default_rng(11)
+    X = rng.normal(loc=5.0, scale=3.0, size=(40, 3))
+    f = Frame({"a": X[:, 0], "b": X[:, 1], "c": X[:, 2]})
+    return VectorAssembler(["a", "b", "c"], "features").transform(f), X
+
+
+def scaled(frame, col="scaled_features"):
+    return np.asarray(frame._column_values(col))
+
+
+class TestStandardScaler:
+    def test_defaults_divide_by_sample_std_only(self, xframe):
+        frame, X = xframe
+        model = StandardScaler().fit(frame)
+        out = scaled(model.transform(frame))
+        np.testing.assert_allclose(out, X / X.std(axis=0, ddof=1), rtol=1e-6)
+
+    def test_with_mean_matches_sklearn(self, xframe):
+        from sklearn.preprocessing import StandardScaler as SkScaler
+
+        frame, X = xframe
+        model = StandardScaler(with_mean=True).fit(frame)
+        out = scaled(model.transform(frame))
+        # sklearn uses population std; rescale to compare the centering+std
+        sk = SkScaler().fit_transform(X) * (X.std(axis=0, ddof=0)
+                                            / X.std(axis=0, ddof=1))
+        np.testing.assert_allclose(out, sk, rtol=1e-6)
+
+    def test_zero_variance_feature_maps_to_zero(self):
+        f = Frame({"a": [2.0, 2.0, 2.0], "b": [1.0, 2.0, 3.0]})
+        f = VectorAssembler(["a", "b"], "features").transform(f)
+        out = scaled(StandardScaler().fit(f).transform(f))
+        np.testing.assert_allclose(out[:, 0], 0.0)
+        assert np.all(np.isfinite(out))
+
+    def test_mask_excluded_rows_do_not_shift_stats(self):
+        f = Frame({"a": [1.0, 2.0, 3.0, 1e6]})
+        f = VectorAssembler(["a"], "features").transform(f)
+        f = f.filter(f["a"] < 100.0)
+        model = StandardScaler(with_mean=True).fit(f)
+        np.testing.assert_allclose(model.mean, [2.0])
+        np.testing.assert_allclose(model.std, [1.0])
+
+
+class TestMinMaxScaler:
+    def test_matches_sklearn(self, xframe):
+        from sklearn.preprocessing import MinMaxScaler as SkMinMax
+
+        frame, X = xframe
+        out = scaled(MinMaxScaler().fit(frame).transform(frame))
+        np.testing.assert_allclose(out, SkMinMax().fit_transform(X), rtol=1e-5)
+
+    def test_custom_range(self, xframe):
+        frame, X = xframe
+        out = scaled(MinMaxScaler(min=-1.0, max=1.0).fit(frame).transform(frame))
+        assert out.min() >= -1.0 - 1e-6 and out.max() <= 1.0 + 1e-6
+        np.testing.assert_allclose(out.min(axis=0), -1.0, atol=1e-6)
+
+    def test_constant_feature_maps_to_midrange(self):
+        f = Frame({"a": [7.0, 7.0], "b": [0.0, 1.0]})
+        f = VectorAssembler(["a", "b"], "features").transform(f)
+        out = scaled(MinMaxScaler().fit(f).transform(f))
+        np.testing.assert_allclose(out[:, 0], 0.5)
+
+    def test_model_exposes_original_range(self, xframe):
+        frame, X = xframe
+        model = MinMaxScaler().fit(frame)
+        np.testing.assert_allclose(model.originalMin, X.min(axis=0), rtol=1e-6)
+        np.testing.assert_allclose(model.originalMax, X.max(axis=0), rtol=1e-6)
+
+
+class TestMaxAbsScaler:
+    def test_matches_sklearn(self, xframe):
+        from sklearn.preprocessing import MaxAbsScaler as SkMaxAbs
+
+        frame, X = xframe
+        out = scaled(MaxAbsScaler().fit(frame).transform(frame))
+        np.testing.assert_allclose(out, SkMaxAbs().fit_transform(X), rtol=1e-6)
+
+    def test_zero_feature_stays_zero(self):
+        f = Frame({"a": [0.0, 0.0], "b": [2.0, -4.0]})
+        f = VectorAssembler(["a", "b"], "features").transform(f)
+        out = scaled(MaxAbsScaler().fit(f).transform(f))
+        np.testing.assert_allclose(out[:, 0], 0.0)
+        np.testing.assert_allclose(out[:, 1], [0.5, -1.0])
+
+
+class TestScalerPipeline:
+    def test_assembler_scaler_regression_pipeline(self, session):
+        """Scaler composes into the Pipeline stage chain with the estimator
+        (assemble → scale → fit), MLlib-style."""
+        from conftest import dataset_path, run_dq_pipeline
+        from sparkdq4ml_tpu.models import LinearRegression
+
+        df = run_dq_pipeline(session, dataset_path("abstract"))
+        df = df.with_column("label", df.col("price"))
+        pipe = Pipeline([
+            VectorAssembler(["guest"], "features"),
+            StandardScaler("features", "scaled", with_mean=True),
+            LinearRegression(max_iter=50).set_features_col("scaled"),
+        ])
+        model = pipe.fit(df)
+        out = model.transform(df)
+        pred = np.asarray(out._column_values("prediction"))
+        label = np.asarray(out._column_values("label"))
+        mask = np.asarray(out.mask)
+        rmse = float(np.sqrt(np.mean((pred - label)[mask] ** 2)))
+        assert rmse < 3.0  # OLS-quality fit straight through the scaler
+
+    def test_scalar_column_input(self):
+        """Scalers accept a plain (n,) numeric column, not only vectors."""
+        f = Frame({"x": [1.0, 2.0, 3.0]})
+        out = StandardScaler("x", "xs").fit(f).transform(f)
+        np.testing.assert_allclose(np.asarray(out._column_values("xs")),
+                                   np.asarray([1.0, 2.0, 3.0]) / 1.0,
+                                   rtol=1e-6)
+        assert np.asarray(out._column_values("xs")).ndim == 1
