@@ -1,11 +1,22 @@
 """``org.apache.spark.sql.functions`` equivalent — one import surface for
 column constructors, UDF invocation (the reference's
 ``import static ...functions.callUDF``, `DataQuality4MachineLearningApp.java:3`),
-and aggregate constructors."""
+scalar builtins, CASE WHEN, and aggregate constructors."""
 
 from .frame.aggregates import (avg, count, max, mean, min, stddev, sum,
                                variance)
-from .ops.expressions import call_udf, callUDF, col, lit
+from .ops.expressions import (call_udf, callUDF, ceil, coalesce, col, concat,
+                              exp, floor, fn, greatest, isnan, isnull, least,
+                              length, lit, log, log10, lower, ltrim, pow,
+                              rtrim, signum, sqrt, substring, trim, upper,
+                              when)
+from .ops.expressions import sql_abs as abs  # noqa: A001 - Spark name
+from .ops.expressions import sql_round as round  # noqa: A001 - Spark name
 
 __all__ = ["col", "lit", "call_udf", "callUDF", "count", "sum", "avg",
-           "mean", "min", "max", "stddev", "variance"]
+           "mean", "min", "max", "stddev", "variance",
+           "abs", "sqrt", "exp", "log", "log10", "pow", "floor", "ceil",
+           "round", "signum", "greatest", "least", "isnan", "isnull",
+           "coalesce", "when", "fn",
+           "upper", "lower", "trim", "ltrim", "rtrim", "length", "concat",
+           "substring"]

@@ -14,6 +14,7 @@ XLA fuses — the per-row boundary does not exist.
 
 from __future__ import annotations
 
+import functools
 from typing import Any, Callable, Sequence
 
 import jax.numpy as jnp
@@ -270,7 +271,16 @@ class UdfCall(Expr):
         from .udf import default_registry
 
         reg = self._registry if self._registry is not None else default_registry()
-        fn, return_dtype = reg.lookup(self.udf_name)
+        try:
+            fn, return_dtype = reg.lookup(self.udf_name)
+        except KeyError:
+            # Name-based fallback to the builtin function table, so SQL
+            # `abs(x)`, `upper(s)` etc. resolve without UDF registration
+            # (Spark's FunctionRegistry builtins behave the same way).
+            key = self.udf_name.lower()
+            if key in _BUILTIN_FNS:
+                return Func(key, self.args).eval(frame)
+            raise
         vals = [a.eval(frame) for a in self.args]
         out = fn(*vals)
         if return_dtype is not None:
@@ -280,6 +290,172 @@ class UdfCall(Expr):
     @property
     def name(self) -> str:
         return f"{self.udf_name}({', '.join(str(a) for a in self.args)})"
+
+    def __str__(self):
+        return self.name
+
+
+def _null_mask(v):
+    """Per-row null indicator: None for strings, NaN for floats."""
+    if _is_object(v):
+        return np.asarray([x is None for x in v], dtype=bool)
+    if hasattr(v, "dtype") and np.issubdtype(np.dtype(v.dtype), np.floating):
+        return jnp.isnan(v)
+    return jnp.zeros(np.shape(v)[:1], jnp.bool_)
+
+
+def _str_map(fn, *arrays):
+    """Apply a per-row Python fn over host string columns (None-safe)."""
+    out = []
+    for row in zip(*[np.asarray(a, object) for a in arrays]):
+        out.append(None if any(x is None for x in row) else fn(*row))
+    return np.asarray(out, dtype=object)
+
+
+def _fn_coalesce(*vals):
+    out = vals[-1]
+    for v in reversed(vals[:-1]):
+        m = _null_mask(v)
+        if _is_object(v) or _is_object(out):
+            out = np.where(np.asarray(m), np.asarray(out, object),
+                           np.asarray(v, object))
+        else:
+            out = jnp.where(m, jnp.asarray(out, float_dtype()),
+                            jnp.asarray(v, float_dtype()))
+    return out
+
+
+def _fn_round(v, digits=None):
+    # Spark's round() is HALF_UP; jnp.round is half-even. Implement half-up
+    # on device: floor(x * 10^d + 0.5 * sign(x)) / 10^d.
+    d = int(np.asarray(digits)[0]) if digits is not None else 0
+    v = jnp.asarray(v, float_dtype())
+    scale = 10.0 ** d
+    scaled = v * scale
+    return jnp.where(v >= 0, jnp.floor(scaled + 0.5),
+                     jnp.ceil(scaled - 0.5)) / scale
+
+
+def _fn_substring(s, pos, length):
+    # Spark substring is 1-based; pos 0 behaves like 1.
+    p = int(np.asarray(pos)[0])
+    ln = int(np.asarray(length)[0])
+    start = max(p - 1, 0)
+    return _str_map(lambda x: x[start:start + ln], s)
+
+
+_BUILTIN_FNS = {
+    # numeric (device, elementwise — XLA fuses into neighbors)
+    "abs": lambda v: jnp.abs(v),
+    "sqrt": lambda v: jnp.sqrt(jnp.asarray(v, float_dtype())),
+    "exp": lambda v: jnp.exp(jnp.asarray(v, float_dtype())),
+    "log": lambda v: jnp.log(jnp.asarray(v, float_dtype())),
+    "log10": lambda v: jnp.log10(jnp.asarray(v, float_dtype())),
+    "pow": lambda a, b: jnp.power(jnp.asarray(a, float_dtype()),
+                                  jnp.asarray(b, float_dtype())),
+    "power": lambda a, b: jnp.power(jnp.asarray(a, float_dtype()),
+                                    jnp.asarray(b, float_dtype())),
+    "floor": lambda v: jnp.floor(jnp.asarray(v, float_dtype())),
+    "ceil": lambda v: jnp.ceil(jnp.asarray(v, float_dtype())),
+    "round": _fn_round,
+    "sign": lambda v: jnp.sign(jnp.asarray(v, float_dtype())),
+    "signum": lambda v: jnp.sign(jnp.asarray(v, float_dtype())),
+    "greatest": lambda *vs: functools.reduce(jnp.maximum,
+                                             [jnp.asarray(v) for v in vs]),
+    "least": lambda *vs: functools.reduce(jnp.minimum,
+                                          [jnp.asarray(v) for v in vs]),
+    "isnan": lambda v: jnp.isnan(jnp.asarray(v, float_dtype())),
+    "coalesce": _fn_coalesce,
+    # string (host object arrays; TPUs do not hold strings)
+    "upper": lambda s: _str_map(str.upper, s),
+    "lower": lambda s: _str_map(str.lower, s),
+    "trim": lambda s: _str_map(str.strip, s),
+    "ltrim": lambda s: _str_map(str.lstrip, s),
+    "rtrim": lambda s: _str_map(str.rstrip, s),
+    "length": lambda s: jnp.asarray(
+        np.asarray([-1 if x is None else len(x) for x in np.asarray(s, object)],
+                   np.int32) if _is_object(np.asarray(s, object)) else s),
+    "concat": lambda *ss: _str_map(lambda *xs: "".join(str(x) for x in xs), *ss),
+    "substring": _fn_substring,
+    "substr": _fn_substring,
+}
+
+
+class Func(Expr):
+    """Builtin scalar function call (the ``org.apache.spark.sql.functions``
+    scalar set). Numeric fns are jnp ops XLA fuses into neighboring
+    expressions; string fns run host-side on object columns."""
+
+    def __init__(self, fn_name: str, args: Sequence[Expr]):
+        key = fn_name.lower()
+        if key not in _BUILTIN_FNS:
+            raise ValueError(f"unknown function {fn_name!r}")
+        self.fn_name = key
+        self.args = list(args)
+
+    def eval(self, frame):
+        vals = [a.eval(frame) for a in self.args]
+        return _BUILTIN_FNS[self.fn_name](*vals)
+
+    @property
+    def name(self) -> str:
+        return f"{self.fn_name}({', '.join(str(a) for a in self.args)})"
+
+    def __str__(self):
+        return self.name
+
+
+class CaseWhen(Expr):
+    """``when(cond, value).when(...).otherwise(value)`` / SQL CASE WHEN.
+
+    Folds into nested ``jnp.where`` (one fused select chain on device).
+    A missing ELSE yields null (NaN for numeric, None for strings) —
+    Spark semantics.
+    """
+
+    def __init__(self, branches, otherwise=None):
+        self.branches = list(branches)  # [(cond Expr, value Expr), ...]
+        self.otherwise_expr = otherwise
+
+    def when(self, condition: Expr, value) -> "CaseWhen":
+        value = value if isinstance(value, Expr) else Lit(value)
+        return CaseWhen(self.branches + [(condition, value)],
+                        self.otherwise_expr)
+
+    def otherwise(self, value) -> "CaseWhen":
+        value = value if isinstance(value, Expr) else Lit(value)
+        return CaseWhen(self.branches, value)
+
+    def eval(self, frame):
+        conds = [c.eval(frame) for c, _ in self.branches]
+        vals = [v.eval(frame) for _, v in self.branches]
+        stringy = any(_is_object(v) for v in vals)
+        if self.otherwise_expr is not None:
+            out = self.otherwise_expr.eval(frame)
+            stringy = stringy or _is_object(out)
+        elif stringy:
+            out = np.full((frame.num_slots,), None, dtype=object)
+        else:
+            out = jnp.full((frame.num_slots,), jnp.nan, float_dtype())
+        if stringy:
+            out = np.asarray(out, object)
+            for c, v in zip(reversed(conds), reversed(vals)):
+                out = np.where(np.asarray(c, bool), np.asarray(v, object), out)
+            return out
+        for c, v in zip(reversed(conds), reversed(vals)):
+            v = jnp.asarray(v)
+            if jnp.issubdtype(jnp.asarray(out).dtype, jnp.floating) or \
+                    jnp.issubdtype(v.dtype, jnp.floating):
+                v = jnp.asarray(v, float_dtype())
+                out = jnp.asarray(out, float_dtype())
+            out = jnp.where(jnp.asarray(c), v, out)
+        return out
+
+    @property
+    def name(self) -> str:
+        parts = " ".join(f"WHEN {c} THEN {v}" for c, v in self.branches)
+        tail = f" ELSE {self.otherwise_expr}" if self.otherwise_expr is not None else ""
+        return f"CASE {parts}{tail} END"
 
     def __str__(self):
         return self.name
@@ -304,3 +480,56 @@ def call_udf(name: str, *args) -> UdfCall:
 
 # Spark naming alias
 callUDF = call_udf
+
+
+def _coerce(a) -> Expr:
+    return a if isinstance(a, Expr) else Col(a) if isinstance(a, str) else Lit(a)
+
+
+def fn(name: str, *args) -> Func:
+    """Builtin scalar function by name (``functions.expr``-style escape)."""
+    return Func(name, [_coerce(a) for a in args])
+
+
+def when(condition: Expr, value) -> CaseWhen:
+    """``functions.when`` — start a CASE chain; extend with ``.when`` and
+    close with ``.otherwise`` (missing otherwise ⇒ null)."""
+    return CaseWhen([]).when(condition, value)
+
+
+def _make_fn(fname: str):
+    def f(*args):
+        return fn(fname, *args)
+
+    f.__name__ = fname
+    f.__qualname__ = fname
+    f.__doc__ = f"``functions.{fname}`` equivalent (builtin scalar fn)."
+    return f
+
+
+sql_abs = _make_fn("abs")
+sqrt = _make_fn("sqrt")
+exp = _make_fn("exp")
+log = _make_fn("log")
+log10 = _make_fn("log10")
+pow = _make_fn("pow")
+floor = _make_fn("floor")
+ceil = _make_fn("ceil")
+sql_round = _make_fn("round")
+signum = _make_fn("signum")
+greatest = _make_fn("greatest")
+least = _make_fn("least")
+isnan = _make_fn("isnan")
+coalesce = _make_fn("coalesce")
+upper = _make_fn("upper")
+lower = _make_fn("lower")
+trim = _make_fn("trim")
+ltrim = _make_fn("ltrim")
+rtrim = _make_fn("rtrim")
+length = _make_fn("length")
+concat = _make_fn("concat")
+substring = _make_fn("substring")
+
+
+def isnull(c) -> Expr:
+    return _coerce(c).is_null()

@@ -29,7 +29,8 @@ Grammar (recursive descent):
     unary      := '-' unary | atom
     atom       := number | 'string' | TRUE | FALSE | NULL
                 | CAST '(' expr AS ident ')'
-                | ident '(' [expr (',' expr)*] ')'     -- UDF call
+                | CASE (WHEN or_expr THEN or_expr)+ [ELSE or_expr] END
+                | ident '(' [expr (',' expr)*] ')'     -- UDF or builtin fn
                 | ident | '(' or_expr ')'
 """
 
@@ -52,7 +53,8 @@ _TOKEN_RE = re.compile(
 _KEYWORDS = {"select", "from", "where", "as", "and", "or", "not", "cast",
              "true", "false", "null", "group", "by", "order", "limit",
              "asc", "desc", "join", "inner", "left", "right", "full",
-             "outer", "cross", "on", "using"}
+             "outer", "cross", "on", "using", "case", "when", "then",
+             "else", "end", "is"}
 
 _AGG_FNS = {"count", "sum", "avg", "mean", "min", "max", "stddev", "variance"}
 
@@ -260,6 +262,10 @@ class _Parser:
         if t.kind == "op" and t.value in self._CMP:
             self.next()
             return E.BinOp(self._CMP[t.value], left, self.parse_add())
+        if self.accept("kw", "is"):
+            negated = bool(self.accept("kw", "not"))
+            self.expect("kw", "null")
+            return left.is_not_null() if negated else left.is_null()
         return left
 
     def parse_add(self):
@@ -311,6 +317,17 @@ class _Parser:
             tname = self.expect("ident").value
             self.expect("op", ")")
             return E.Cast(inner, tname)
+        if self.accept("kw", "case"):
+            branches = []
+            while self.accept("kw", "when"):
+                cond = self.parse_or()
+                self.expect("kw", "then")
+                branches.append((cond, self.parse_or()))
+            if not branches:
+                raise ValueError("CASE requires at least one WHEN branch")
+            otherwise = self.parse_or() if self.accept("kw", "else") else None
+            self.expect("kw", "end")
+            return E.CaseWhen(branches, otherwise)
         if t.kind == "ident":
             self.next()
             if self.accept("op", "("):

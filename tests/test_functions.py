@@ -1,0 +1,126 @@
+"""Scalar builtin functions, CASE WHEN, and their SQL spellings
+(ops/expressions.py Func/CaseWhen + functions.py surface)."""
+
+import numpy as np
+import pytest
+
+import sparkdq4ml_tpu.functions as F
+from sparkdq4ml_tpu.frame import Frame
+
+
+@pytest.fixture
+def df():
+    return Frame({
+        "x": [-2.5, 0.0, 1.4, 9.0],
+        "n": [1, 2, 3, 4],
+        "s": ["  Ada ", "bob", None, "Cid"],
+    })
+
+
+def vals(frame, col):
+    """Valid (mask-respecting) column values, as list[str|None] or ndarray."""
+    arr = frame.to_pydict()[col]
+    return (list(arr) if isinstance(arr, np.ndarray) and arr.dtype == object
+            else np.asarray(arr))
+
+
+class TestNumericFunctions:
+    def test_abs_sqrt_floor_ceil(self, df):
+        out = df.with_column("a", F.abs(F.col("x")))
+        np.testing.assert_allclose(vals(out, "a"), [2.5, 0.0, 1.4, 9.0])
+        out = df.with_column("r", F.sqrt(F.col("n")))
+        np.testing.assert_allclose(vals(out, "r"), np.sqrt([1, 2, 3, 4]))
+        out = df.with_column("f", F.floor(F.col("x"))) \
+                .with_column("c", F.ceil(F.col("x")))
+        np.testing.assert_allclose(vals(out, "f"), [-3.0, 0.0, 1.0, 9.0])
+        np.testing.assert_allclose(vals(out, "c"), [-2.0, 0.0, 2.0, 9.0])
+
+    def test_round_is_half_up_like_spark(self):
+        f = Frame({"x": [0.5, 1.5, 2.5, -0.5, -2.5]})
+        out = f.with_column("r", F.round(F.col("x")))
+        # Spark HALF_UP: 0.5→1, 1.5→2, 2.5→3 (np.round would give 0, 2, 2)
+        np.testing.assert_allclose(vals(out, "r"), [1.0, 2.0, 3.0, -1.0, -3.0])
+
+    def test_round_digits(self):
+        f = Frame({"x": [1.245, 2.344]})
+        out = f.with_column("r", F.round(F.col("x"), 2))
+        np.testing.assert_allclose(vals(out, "r"), [1.25, 2.34], atol=1e-9)
+
+    def test_pow_greatest_least(self, df):
+        out = df.with_column("p", F.pow(F.col("n"), 2)) \
+                .with_column("g", F.greatest(F.col("x"), F.col("n"))) \
+                .with_column("l", F.least(F.col("x"), F.col("n")))
+        np.testing.assert_allclose(vals(out, "p"), [1.0, 4.0, 9.0, 16.0])
+        np.testing.assert_allclose(vals(out, "g"), [1.0, 2.0, 3.0, 9.0])
+        np.testing.assert_allclose(vals(out, "l"), [-2.5, 0.0, 1.4, 4.0])
+
+    def test_isnan_coalesce(self):
+        f = Frame({"a": [1.0, np.nan, 3.0], "b": [9.0, 8.0, np.nan]})
+        out = f.with_column("nan", F.isnan(F.col("a"))) \
+               .with_column("c", F.coalesce(F.col("a"), F.col("b")))
+        np.testing.assert_array_equal(vals(out, "nan"), [False, True, False])
+        np.testing.assert_allclose(vals(out, "c"), [1.0, 8.0, 3.0])
+
+
+class TestStringFunctions:
+    def test_upper_lower_trim_length(self, df):
+        out = df.with_column("u", F.upper(F.col("s"))) \
+                .with_column("t", F.trim(F.col("s")))
+        assert vals(out, "u") == ["  ADA ", "BOB", None, "CID"]
+        assert vals(out, "t") == ["Ada", "bob", None, "Cid"]
+
+    def test_concat_substring(self, df):
+        out = df.with_column("c", F.concat(F.trim(F.col("s")), F.lit("!")))
+        assert vals(out, "c") == ["Ada!", "bob!", None, "Cid!"]
+        out = df.with_column("sub", F.substring(F.trim(F.col("s")), 1, 2))
+        assert vals(out, "sub") == ["Ad", "bo", None, "Ci"]
+
+
+class TestCaseWhen:
+    def test_when_otherwise(self, df):
+        expr = F.when(F.col("x") > 1.0, F.lit(1.0)) \
+                .when(F.col("x") < 0.0, F.lit(-1.0)).otherwise(0.0)
+        out = df.with_column("sign", expr)
+        np.testing.assert_allclose(vals(out, "sign"), [-1.0, 0.0, 1.0, 1.0])
+
+    def test_missing_otherwise_yields_nan(self, df):
+        out = df.with_column("v", F.when(F.col("x") > 1.0, F.col("x")))
+        got = vals(out, "v")
+        np.testing.assert_allclose(got[2:], [1.4, 9.0])
+        assert np.isnan(got[0]) and np.isnan(got[1])
+
+    def test_string_branches(self, df):
+        expr = F.when(F.col("n") < 3, F.lit("low")).otherwise("high")
+        out = df.with_column("band", expr)
+        assert vals(out, "band") == ["low", "low", "high", "high"]
+
+
+class TestSqlSpellings:
+    @pytest.fixture(autouse=True)
+    def view(self, df):
+        df.create_or_replace_temp_view("t")
+
+    def test_sql_builtin_functions(self, session):
+        out = session.sql("SELECT abs(x) AS a, round(x) AS r FROM t")
+        np.testing.assert_allclose(vals(out, "a"), [2.5, 0.0, 1.4, 9.0])
+        np.testing.assert_allclose(vals(out, "r"), [-3.0, 0.0, 1.0, 9.0])
+
+    def test_sql_case_when(self, session):
+        out = session.sql(
+            "SELECT n, CASE WHEN x > 1 THEN 'pos' WHEN x < 0 THEN 'neg' "
+            "ELSE 'zero' END AS band FROM t")
+        assert vals(out, "band") == ["neg", "zero", "pos", "pos"]
+
+    def test_sql_case_when_in_where(self, session):
+        out = session.sql(
+            "SELECT n FROM t WHERE CASE WHEN x > 1 THEN true ELSE false END")
+        assert sorted(int(v) for v in vals(out, "n")) == [3, 4]
+
+    def test_sql_string_functions(self, session):
+        out = session.sql("SELECT upper(trim(s)) AS u, length(trim(s)) AS n "
+                          "FROM t WHERE s IS NOT NULL")
+        assert vals(out, "u") == ["ADA", "BOB", "CID"]
+
+    def test_sql_unknown_function_raises(self, session):
+        with pytest.raises(KeyError, match="not registered"):
+            session.sql("SELECT frobnicate(x) AS y FROM t").to_pydict()
