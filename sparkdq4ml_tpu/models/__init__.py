@@ -5,8 +5,10 @@ from .classification import (BinaryLogisticRegressionSummary,
 from .evaluation import (BinaryClassificationEvaluator, Evaluator,
                          MulticlassClassificationEvaluator,
                          RegressionEvaluator)
-from .feature import (MaxAbsScaler, MaxAbsScalerModel, MinMaxScaler,
-                      MinMaxScalerModel, StandardScaler, StandardScalerModel,
+from .feature import (Bucketizer, IndexToString, MaxAbsScaler,
+                      MaxAbsScalerModel, MinMaxScaler, MinMaxScalerModel,
+                      OneHotEncoder, OneHotEncoderModel, StandardScaler,
+                      StandardScalerModel, StringIndexer, StringIndexerModel,
                       VectorAssembler)
 from .linalg import Vectors
 from .regression import (LinearRegression, LinearRegressionModel,

@@ -23,7 +23,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..config import float_dtype
+from ..config import float_dtype, int_dtype
 from .base import Estimator, Model, Transformer
 
 
@@ -64,6 +64,191 @@ class VectorAssembler(Transformer):
             arr = jnp.asarray(frame._column_values(name), dt)
             parts.append(arr[:, None] if arr.ndim == 1 else arr)
         return frame.with_column(self.output_col, jnp.concatenate(parts, axis=1))
+
+
+class StringIndexer(Estimator):
+    """MLlib ``StringIndexer``: map string categories to double indices,
+    most-frequent-first (``frequencyDesc``; ties broken alphabetically, as
+    Spark does). ``handle_invalid``: ``"error"`` (default) | ``"keep"``
+    (unseen → numLabels) | ``"skip"`` (unseen → masked out on transform).
+
+    The index *fit* is host-side (categories are host strings); the
+    transformed column is a device array ready for VectorAssembler.
+    """
+
+    def __init__(self, input_col: str = None, output_col: str = None,
+                 handle_invalid: str = "error"):
+        self.input_col = input_col
+        self.output_col = output_col
+        if handle_invalid not in ("error", "keep", "skip"):
+            raise ValueError(f"handle_invalid={handle_invalid!r}")
+        self.handle_invalid = handle_invalid
+
+    def set_input_col(self, v):
+        self.input_col = v
+        return self
+
+    setInputCol = set_input_col
+
+    def set_output_col(self, v):
+        self.output_col = v
+        return self
+
+    setOutputCol = set_output_col
+
+    def set_handle_invalid(self, v):
+        self.handle_invalid = v
+        return self
+
+    setHandleInvalid = set_handle_invalid
+
+    def fit(self, frame) -> "StringIndexerModel":
+        col = frame._column_values(self.input_col)
+        mask = np.asarray(frame.mask)
+        values = [str(v) for v, m in zip(np.asarray(col, object), mask)
+                  if m and v is not None]
+        from collections import Counter
+
+        counts = Counter(values)
+        labels = sorted(counts, key=lambda k: (-counts[k], k))
+        return StringIndexerModel(labels, self.input_col, self.output_col,
+                                  self.handle_invalid)
+
+
+class StringIndexerModel(Model):
+    def __init__(self, labels, input_col, output_col, handle_invalid="error"):
+        self.labels = list(labels)
+        self.input_col = input_col
+        self.output_col = output_col
+        self.handle_invalid = handle_invalid
+        self._index = {l: i for i, l in enumerate(self.labels)}
+
+    labelsArray = property(lambda self: [list(self.labels)])
+
+    def transform(self, frame):
+        col = np.asarray(frame._column_values(self.input_col), object)
+        n_labels = len(self.labels)
+        idx = np.empty(len(col), dtype=np.dtype(float_dtype()))
+        invalid = np.zeros(len(col), bool)
+        host_mask = np.asarray(frame.mask)
+        for i, v in enumerate(col):
+            j = self._index.get(str(v)) if v is not None else None
+            if j is None:
+                invalid[i] = True
+                idx[i] = n_labels
+            else:
+                idx[i] = j
+        if self.handle_invalid == "error" and bool((invalid & host_mask).any()):
+            bad = sorted({str(col[i]) for i in np.nonzero(invalid & host_mask)[0]})
+            raise ValueError(f"StringIndexer: unseen labels {bad}; set "
+                             f"handle_invalid='keep' or 'skip'")
+        out = frame.with_column(self.output_col, jnp.asarray(idx))
+        if self.handle_invalid == "skip":
+            out = out.filter(jnp.asarray(~invalid))
+        return out
+
+
+class IndexToString(Transformer):
+    """Inverse of StringIndexer: indices → label strings (host column)."""
+
+    def __init__(self, input_col: str = None, output_col: str = None,
+                 labels=None):
+        self.input_col = input_col
+        self.output_col = output_col
+        self.labels = list(labels) if labels is not None else None
+
+    def transform(self, frame):
+        idx = np.asarray(frame._column_values(self.input_col))
+        labels = self.labels
+        out = np.asarray([labels[int(i)] if 0 <= int(i) < len(labels) else None
+                          for i in idx], dtype=object)
+        return frame.with_column(self.output_col, out)
+
+
+class OneHotEncoder(Estimator):
+    """MLlib ``OneHotEncoder``: index column → one-hot vector column.
+
+    ``drop_last=True`` (Spark default) omits the last category so the
+    encoding stays linearly independent with an intercept. The encode is a
+    device comparison against an iota — one fused op, no host loop.
+    """
+
+    def __init__(self, input_col: str = None, output_col: str = None,
+                 drop_last: bool = True):
+        self.input_col = input_col
+        self.output_col = output_col
+        self.drop_last = drop_last
+
+    def set_drop_last(self, v: bool):
+        self.drop_last = v
+        return self
+
+    setDropLast = set_drop_last
+
+    def fit(self, frame) -> "OneHotEncoderModel":
+        idx = frame._column_values(self.input_col)
+        w = frame.mask
+        size = int(np.asarray(jnp.max(jnp.where(w, jnp.asarray(idx), -1)))) + 1
+        return OneHotEncoderModel(size, self.input_col, self.output_col,
+                                  self.drop_last)
+
+
+class OneHotEncoderModel(Model):
+    def __init__(self, category_size, input_col, output_col, drop_last=True):
+        self.category_size = int(category_size)
+        self.input_col = input_col
+        self.output_col = output_col
+        self.drop_last = drop_last
+
+    categorySizes = property(lambda self: [self.category_size])
+
+    def transform(self, frame):
+        idx = jnp.asarray(frame._column_values(self.input_col), int_dtype())
+        width = self.category_size - (1 if self.drop_last else 0)
+        eye = jnp.arange(width, dtype=int_dtype())
+        onehot = (idx[:, None] == eye[None, :]).astype(float_dtype())
+        return frame.with_column(self.output_col, onehot)
+
+
+class Bucketizer(Transformer):
+    """MLlib ``Bucketizer``: continuous column → bucket index by split
+    points (``splits`` of length b+1, monotonic; use ±inf for open ends).
+    One device ``searchsorted``; values outside the splits raise unless
+    ``handle_invalid='keep'`` (→ NaN) or ``'skip'`` (→ masked)."""
+
+    def __init__(self, splits=None, input_col: str = None,
+                 output_col: str = None, handle_invalid: str = "error"):
+        self.splits = list(splits) if splits is not None else None
+        self.input_col = input_col
+        self.output_col = output_col
+        self.handle_invalid = handle_invalid
+
+    def set_splits(self, v):
+        self.splits = list(v)
+        return self
+
+    setSplits = set_splits
+
+    def transform(self, frame):
+        s = np.asarray(self.splits, np.dtype(float_dtype()))
+        if s.ndim != 1 or len(s) < 3 or not np.all(np.diff(s) > 0):
+            raise ValueError("splits must be >=3 strictly increasing values")
+        x = jnp.asarray(frame._column_values(self.input_col), float_dtype())
+        # right-closed last bucket, Spark semantics: x == splits[-1] falls in
+        # the last bucket; outside [splits[0], splits[-1]] is invalid.
+        idx = jnp.clip(jnp.searchsorted(jnp.asarray(s), x, side="right") - 1,
+                       0, len(s) - 2).astype(float_dtype())
+        invalid = jnp.logical_or(x < s[0], x > s[-1])
+        if self.handle_invalid == "error":
+            if bool(np.asarray(jnp.logical_and(invalid, frame.mask)).any()):
+                raise ValueError("Bucketizer: values outside splits; set "
+                                 "handle_invalid='keep' or 'skip'")
+        elif self.handle_invalid == "keep":
+            idx = jnp.where(invalid, jnp.asarray(jnp.nan, float_dtype()), idx)
+        out = frame.with_column(self.output_col, idx)
+        if self.handle_invalid == "skip":
+            out = out.filter(jnp.logical_not(invalid))
+        return out
 
 
 class _ScalerBase(Estimator):

@@ -1,0 +1,129 @@
+"""Categorical feature stages: StringIndexer / IndexToString / OneHotEncoder /
+Bucketizer (models/feature.py) — MLlib ordering and invalid-handling
+semantics."""
+
+import numpy as np
+import pytest
+
+from sparkdq4ml_tpu.frame import Frame
+from sparkdq4ml_tpu.models import (Bucketizer, IndexToString, LinearRegression,
+                                   OneHotEncoder, Pipeline, StringIndexer,
+                                   VectorAssembler)
+
+
+@pytest.fixture
+def cats():
+    return Frame({
+        "city": ["oslo", "paris", "oslo", "rome", "paris", "oslo"],
+        "y": [1.0, 2.0, 1.5, 3.0, 2.5, 0.5],
+    })
+
+
+class TestStringIndexer:
+    def test_frequency_desc_order(self, cats):
+        model = StringIndexer("city", "city_idx").fit(cats)
+        assert model.labels == ["oslo", "paris", "rome"]  # 3, 2, 1 occurrences
+        out = model.transform(cats)
+        np.testing.assert_allclose(
+            np.asarray(out._column_values("city_idx")),
+            [0, 1, 0, 2, 1, 0])
+
+    def test_ties_break_alphabetically(self):
+        f = Frame({"c": ["b", "a", "b", "a"]})
+        model = StringIndexer("c", "i").fit(f)
+        assert model.labels == ["a", "b"]
+
+    def test_masked_rows_do_not_count(self, cats):
+        f = cats.filter(cats["y"] < 2.9)  # drops the only "rome" row
+        model = StringIndexer("city", "i").fit(f)
+        assert model.labels == ["oslo", "paris"]
+
+    def test_unseen_label_error(self, cats):
+        model = StringIndexer("city", "i").fit(cats)
+        other = Frame({"city": ["kyiv"], "y": [1.0]})
+        with pytest.raises(ValueError, match="unseen labels"):
+            model.transform(other)
+
+    def test_unseen_label_keep_and_skip(self, cats):
+        model = StringIndexer("city", "i", handle_invalid="keep").fit(cats)
+        other = Frame({"city": ["kyiv", "oslo"], "y": [1.0, 2.0]})
+        out = model.transform(other)
+        np.testing.assert_allclose(np.asarray(out._column_values("i")), [3, 0])
+        model.handle_invalid = "skip"
+        out = model.transform(other)
+        assert out.count() == 1
+
+    def test_round_trip_index_to_string(self, cats):
+        model = StringIndexer("city", "i").fit(cats)
+        out = model.transform(cats)
+        back = IndexToString("i", "city2", labels=model.labels).transform(out)
+        assert list(back.to_pydict()["city2"]) == list(cats.to_pydict()["city"])
+
+
+class TestOneHotEncoder:
+    def test_drop_last_default(self, cats):
+        idx = StringIndexer("city", "i").fit(cats).transform(cats)
+        model = OneHotEncoder("i", "vec").fit(idx)
+        out = model.transform(idx)
+        vec = np.asarray(out._column_values("vec"))
+        assert vec.shape == (6, 2)  # 3 categories, last dropped
+        np.testing.assert_allclose(vec[0], [1, 0])   # oslo
+        np.testing.assert_allclose(vec[1], [0, 1])   # paris
+        np.testing.assert_allclose(vec[3], [0, 0])   # rome (dropped cat)
+
+    def test_keep_all_categories(self, cats):
+        idx = StringIndexer("city", "i").fit(cats).transform(cats)
+        out = OneHotEncoder("i", "vec", drop_last=False).fit(idx).transform(idx)
+        vec = np.asarray(out._column_values("vec"))
+        assert vec.shape == (6, 3)
+        np.testing.assert_allclose(vec.sum(axis=1), 1.0)
+
+    def test_categorical_regression_pipeline(self, cats):
+        """index → one-hot → assemble → fit composes end-to-end."""
+        pipe = Pipeline([
+            StringIndexer("city", "ci"),
+            OneHotEncoder("ci", "cv", drop_last=False),
+            VectorAssembler(["cv"], "features"),
+            LinearRegression(max_iter=100).set_label_col("y"),
+        ])
+        model = pipe.fit(cats)
+        out = model.transform(cats)
+        pred = np.asarray(out._column_values("prediction"))
+        # per-city means: oslo 1.0, paris 2.25, rome 3.0
+        np.testing.assert_allclose(pred[3], 3.0, atol=1e-3)
+        np.testing.assert_allclose(pred[1], 2.25, atol=1e-3)
+
+
+class TestBucketizer:
+    def test_basic_buckets(self):
+        f = Frame({"x": [-0.5, 0.2, 1.0, 1.5, 2.0]})
+        b = Bucketizer(splits=[-1.0, 0.0, 1.0, 2.0], input_col="x",
+                       output_col="b")
+        out = b.transform(f)
+        # right-closed last bucket: 2.0 → bucket 2; 1.0 → bucket 2 boundary
+        np.testing.assert_allclose(np.asarray(out._column_values("b")),
+                                   [0, 1, 2, 2, 2])
+
+    def test_out_of_range_error_keep_skip(self):
+        f = Frame({"x": [0.5, 9.0]})
+        b = Bucketizer(splits=[0.0, 1.0, 2.0], input_col="x", output_col="b")
+        with pytest.raises(ValueError, match="outside splits"):
+            b.transform(f)
+        b.handle_invalid = "keep"
+        got = np.asarray(b.transform(f)._column_values("b"))
+        assert got[0] == 0.0 and np.isnan(got[1])
+        b.handle_invalid = "skip"
+        assert b.transform(f).count() == 1
+
+    def test_infinite_ends(self):
+        f = Frame({"x": [-100.0, 0.5, 100.0]})
+        b = Bucketizer(splits=[-np.inf, 0.0, 1.0, np.inf], input_col="x",
+                       output_col="b")
+        np.testing.assert_allclose(
+            np.asarray(b.transform(f)._column_values("b")), [0, 1, 2])
+
+    def test_bad_splits_raise(self):
+        f = Frame({"x": [1.0]})
+        with pytest.raises(ValueError, match="strictly increasing"):
+            Bucketizer(splits=[0.0, 0.0, 1.0], input_col="x",
+                       output_col="b").transform(f)
