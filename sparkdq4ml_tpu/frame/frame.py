@@ -571,6 +571,21 @@ class Frame:
             data[c] = np.asarray([str(row[fn][0]) for fn in fns], dtype=object)
         return Frame(data)
 
+    # -- statistics --------------------------------------------------------
+    @property
+    def stat(self):
+        """``df.stat`` — corr/cov/approxQuantile/crosstab/freqItems
+        (Spark's DataFrameStatFunctions)."""
+        from .stat import FrameStatFunctions
+
+        return FrameStatFunctions(self)
+
+    def corr(self, col1: str, col2: str, method: str = "pearson") -> float:
+        return self.stat.corr(col1, col2, method)
+
+    def cov(self, col1: str, col2: str) -> float:
+        return self.stat.cov(col1, col2)
+
     # -- writer ------------------------------------------------------------
     @property
     def write(self):

@@ -1,0 +1,120 @@
+"""``Frame.stat`` — Spark's ``DataFrameStatFunctions`` equivalent.
+
+Thematically this is the reference's own subject: its second DQ rule is a
+*price correlation* plausibility check (`PriceCorrelationDataQualityService
+.java:5-10`), and Spark users inspect exactly these statistics
+(``df.stat.corr("guest", "price")``) when designing such rules.
+
+All statistics are mask-weighted single-pass device reductions — filtered
+rows never contribute (SURVEY.md §7 "Masked-filter semantics")."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import float_dtype
+
+
+@jax.jit
+def _corr_cov(a, b, w):
+    """Mask-weighted Pearson correlation and sample covariance, one pass."""
+    n = jnp.sum(w)
+    ma = jnp.sum(a * w) / n
+    mb = jnp.sum(b * w) / n
+    da = (a - ma) * w
+    db = (b - mb) * w
+    cov = jnp.sum(da * db) / jnp.maximum(n - 1.0, 1.0)
+    va = jnp.sum(da * da) / jnp.maximum(n - 1.0, 1.0)
+    vb = jnp.sum(db * db) / jnp.maximum(n - 1.0, 1.0)
+    denom = jnp.sqrt(va * vb)
+    corr = jnp.where(denom > 0, cov / denom, jnp.nan)
+    return corr, cov
+
+
+class FrameStatFunctions:
+    def __init__(self, frame):
+        self._frame = frame
+
+    def _pair(self, col1: str, col2: str):
+        dt = float_dtype()
+        a = jnp.asarray(self._frame._column_values(col1), dt)
+        b = jnp.asarray(self._frame._column_values(col2), dt)
+        w = self._frame.mask.astype(dt)
+        return a, b, w
+
+    def corr(self, col1: str, col2: str, method: str = "pearson") -> float:
+        """Pearson (or Spearman rank) correlation of two numeric columns."""
+        a, b, w = self._pair(col1, col2)
+        if method == "spearman":
+            a, b = _rank(a, w), _rank(b, w)
+        elif method != "pearson":
+            raise ValueError(f"unknown correlation method {method!r}")
+        return float(_corr_cov(a, b, w)[0])
+
+    def cov(self, col1: str, col2: str) -> float:
+        """Sample covariance (n−1 denominator, like Spark)."""
+        a, b, w = self._pair(col1, col2)
+        return float(_corr_cov(a, b, w)[1])
+
+    def approx_quantile(self, col: str, probabilities, relative_error=0.0):
+        """Quantiles of a numeric column. Spark sketches (Greenwald-Khanna)
+        to bound executor memory; here an exact device sort is both cheaper
+        and exact at any size XLA can sort, so ``relative_error`` is
+        accepted for API compatibility and ignored."""
+        a = jnp.asarray(self._frame._column_values(col), float_dtype())
+        keep = np.asarray(self._frame.mask)
+        vals = np.sort(np.asarray(a)[keep])
+        if len(vals) == 0:
+            return [float("nan") for _ in np.atleast_1d(probabilities)]
+        qs = [float(vals[min(int(p * len(vals)), len(vals) - 1)])
+              for p in np.atleast_1d(probabilities)]
+        return qs
+
+    approxQuantile = approx_quantile
+
+    def crosstab(self, col1: str, col2: str):
+        """Contingency table of two columns (Spark's ``stat.crosstab``)."""
+        from .frame import Frame
+
+        d = self._frame.to_pydict()
+        a = [str(v) for v in d[col1]]
+        b = [str(v) for v in d[col2]]
+        rows = sorted(set(a))
+        cols = sorted(set(b))
+        counts = {(x, y): 0 for x in rows for y in cols}
+        for x, y in zip(a, b):
+            counts[(x, y)] += 1
+        data = {f"{col1}_{col2}": np.asarray(rows, dtype=object)}
+        for y in cols:
+            data[y] = np.asarray([counts[(x, y)] for x in rows], np.int64)
+        return Frame(data)
+
+    def freq_items(self, cols, support: float = 0.01):
+        """Per-column items with frequency ≥ support (Spark ``freqItems``)."""
+        from .frame import Frame
+
+        d = self._frame.to_pydict()
+        out = {}
+        n = max(len(next(iter(d.values()))), 1) if d else 1
+        for c in cols:
+            vals, counts = np.unique(np.asarray([str(v) for v in d[c]]),
+                                     return_counts=True)
+            keep = [v for v, k in zip(vals, counts) if k / n >= support]
+            out[c + "_freqItems"] = np.asarray([keep], dtype=object)
+        return Frame(out)
+
+    freqItems = freq_items
+
+
+def _rank(x, w):
+    """Average ranks of the valid entries (invalid slots get rank 0 and are
+    zero-weighted by the caller anyway)."""
+    xn = np.asarray(x)
+    keep = np.asarray(w) > 0
+    import scipy.stats  # available via sklearn dependency
+
+    ranks = np.zeros_like(xn)
+    ranks[keep] = scipy.stats.rankdata(xn[keep])
+    return jnp.asarray(ranks, x.dtype)

@@ -11,6 +11,7 @@ from .feature import (Bucketizer, IndexToString, MaxAbsScaler,
                       StandardScalerModel, StringIndexer, StringIndexerModel,
                       VectorAssembler)
 from .linalg import Vectors
+from .stat import Correlation, Summarizer
 from .regression import (LinearRegression, LinearRegressionModel,
                          LinearRegressionSummary,
                          LinearRegressionTrainingSummary)

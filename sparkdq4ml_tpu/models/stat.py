@@ -1,0 +1,108 @@
+"""``spark.ml.stat`` equivalents: Correlation and Summarizer.
+
+``Correlation.corr`` produces the full (d×d) correlation matrix of a vector
+column in ONE masked Gramian pass — the same ``A = ZᵀZ`` statistic the
+solvers consume (models/solvers.py), unpacked into correlations instead of
+a standardized Gram. ``Summarizer`` exposes MLlib's per-feature summary
+metrics from the same single pass.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import float_dtype
+
+
+@jax.jit
+def _moment_pass(X, w):
+    """One masked pass: count, per-feature sum/mean, centered second moments,
+    min/max, L1/L2 norms."""
+    wc = w[:, None]
+    n = jnp.sum(w)
+    mean = jnp.sum(X * wc, axis=0) / n
+    C = ((X - mean) * wc).T @ ((X - mean) * wc)  # centered scatter
+    big = jnp.asarray(jnp.finfo(X.dtype).max, X.dtype)
+    mn = jnp.min(jnp.where(wc > 0, X, big), axis=0)
+    mx = jnp.max(jnp.where(wc > 0, X, -big), axis=0)
+    l1 = jnp.sum(jnp.abs(X) * wc, axis=0)
+    l2 = jnp.sqrt(jnp.sum(X * X * wc, axis=0))
+    nnz = jnp.sum((X != 0) * wc, axis=0)
+    return n, mean, C, mn, mx, l1, l2, nnz
+
+
+def _extract(frame, col):
+    X = jnp.asarray(frame._column_values(col), float_dtype())
+    if X.ndim == 1:
+        X = X[:, None]
+    w = frame.mask.astype(X.dtype)
+    return X, w
+
+
+class Correlation:
+    """``org.apache.spark.ml.stat.Correlation`` equivalent."""
+
+    @staticmethod
+    def corr(frame, column: str = "features", method: str = "pearson"):
+        """(d×d) correlation matrix of a vector column as a numpy array.
+
+        ``pearson`` runs fully on device from one scatter-matrix pass;
+        ``spearman`` ranks host-side first (ranking is a data-dependent
+        permutation — not a static-shape XLA op) then reuses the same pass.
+        """
+        X, w = _extract(frame, column)
+        if method == "spearman":
+            import scipy.stats
+
+            Xn = np.asarray(X)
+            keep = np.asarray(w) > 0
+            ranked = np.zeros_like(Xn)
+            ranked[keep] = scipy.stats.rankdata(Xn[keep], axis=0)
+            X = jnp.asarray(ranked, X.dtype)
+        elif method != "pearson":
+            raise ValueError(f"unknown correlation method {method!r}")
+        _, _, C, *_ = _moment_pass(X, w)
+        d = np.sqrt(np.diag(np.asarray(C)))
+        denom = np.outer(d, d)
+        with np.errstate(invalid="ignore", divide="ignore"):
+            out = np.asarray(C) / denom
+        out[denom == 0] = np.nan
+        np.fill_diagonal(out, 1.0)
+        return out
+
+
+class Summarizer:
+    """``org.apache.spark.ml.stat.Summarizer`` equivalent: one-pass
+    per-feature metrics of a vector column. ``metrics(...)`` selects named
+    metrics; ``summary(frame, col)`` returns them all as a dict."""
+
+    METRICS = ("mean", "variance", "std", "count", "numNonZeros", "min",
+               "max", "normL1", "normL2")
+
+    def __init__(self, metrics=("mean", "variance")):
+        unknown = set(metrics) - set(self.METRICS)
+        if unknown:
+            raise ValueError(f"unknown metrics {sorted(unknown)}")
+        self._metrics = tuple(metrics)
+
+    @classmethod
+    def metrics(cls, *names) -> "Summarizer":
+        return cls(names)
+
+    def summary(self, frame, column: str = "features") -> dict:
+        X, w = _extract(frame, column)
+        n, mean, C, mn, mx, l1, l2, nnz = map(np.asarray, _moment_pass(X, w))
+        var = np.diag(C) / max(float(n) - 1.0, 1.0)
+        all_metrics = {
+            "mean": mean, "variance": var, "std": np.sqrt(var),
+            "count": int(n), "numNonZeros": nnz, "min": mn, "max": mx,
+            "normL1": l1, "normL2": l2,
+        }
+        return {k: all_metrics[k] for k in self._metrics}
+
+
+def summary(frame, column: str = "features") -> dict:
+    """All Summarizer metrics of a vector column in one pass."""
+    return Summarizer(Summarizer.METRICS).summary(frame, column)

@@ -1,0 +1,123 @@
+"""Statistics: Frame.stat (corr/cov/quantiles/crosstab/freqItems) and
+ml.stat Correlation/Summarizer. Oracle: numpy/scipy on the same valid rows;
+reference-data fixture: guest↔price correlation on the DQ-cleaned datasets
+(the quantity the reference's second rule is written around)."""
+
+import numpy as np
+import pytest
+
+from sparkdq4ml_tpu.frame import Frame
+from sparkdq4ml_tpu.models import Correlation, Summarizer, VectorAssembler
+from sparkdq4ml_tpu.models.stat import summary
+
+from conftest import dataset_path, run_dq_pipeline
+
+
+@pytest.fixture
+def xy():
+    rng = np.random.default_rng(5)
+    x = rng.normal(size=60)
+    y = 2.0 * x + rng.normal(scale=0.5, size=60)
+    z = rng.normal(size=60)
+    return Frame({"x": x, "y": y, "z": z}), x, y, z
+
+
+class TestFrameStat:
+    def test_pearson_corr_matches_numpy(self, xy):
+        f, x, y, _ = xy
+        got = f.stat.corr("x", "y")
+        np.testing.assert_allclose(got, np.corrcoef(x, y)[0, 1], rtol=1e-9)
+
+    def test_cov_matches_numpy(self, xy):
+        f, x, y, _ = xy
+        np.testing.assert_allclose(f.stat.cov("x", "y"),
+                                   np.cov(x, y, ddof=1)[0, 1], rtol=1e-9)
+
+    def test_spearman(self, xy):
+        import scipy.stats
+
+        f, x, y, _ = xy
+        np.testing.assert_allclose(f.stat.corr("x", "y", "spearman"),
+                                   scipy.stats.spearmanr(x, y).statistic,
+                                   rtol=1e-9)
+
+    def test_mask_respected(self, xy):
+        f, x, y, _ = xy
+        g = f.filter(f["x"] > 0)
+        keep = x > 0
+        np.testing.assert_allclose(g.stat.corr("x", "y"),
+                                   np.corrcoef(x[keep], y[keep])[0, 1],
+                                   rtol=1e-9)
+
+    def test_dq_pipeline_correlation(self, session):
+        """After DQ cleaning, guest and price are near-perfectly correlated
+        (the linear pattern price ≈ 5·guest + 20, SURVEY.md §2.1)."""
+        df = run_dq_pipeline(session, dataset_path("full"))
+        c = df.stat.corr("guest", "price")
+        assert c > 0.999
+
+    def test_approx_quantile(self, xy):
+        f, x, *_ = xy
+        qs = f.stat.approx_quantile("x", [0.0, 0.5, 1.0])
+        assert qs[0] == pytest.approx(float(np.min(x)))
+        assert qs[2] == pytest.approx(float(np.max(x)))
+        assert abs(qs[1] - float(np.median(x))) < 0.2
+
+    def test_crosstab(self):
+        f = Frame({"a": ["x", "x", "y"], "b": ["1", "2", "1"]})
+        ct = f.stat.crosstab("a", "b").to_pydict()
+        assert list(ct["a_b"]) == ["x", "y"]
+        assert list(ct["1"]) == [1, 1]
+        assert list(ct["2"]) == [1, 0]
+
+    def test_freq_items(self):
+        f = Frame({"a": ["x"] * 9 + ["y"]})
+        out = f.stat.freq_items(["a"], support=0.5).to_pydict()
+        assert out["a_freqItems"][0] == ["x"]
+
+
+class TestMlStat:
+    def test_correlation_matrix(self, xy):
+        f, x, y, z = xy
+        f = VectorAssembler(["x", "y", "z"], "features").transform(f)
+        got = Correlation.corr(f, "features")
+        expect = np.corrcoef(np.stack([x, y, z]))
+        np.testing.assert_allclose(got, expect, rtol=1e-8, atol=1e-10)
+
+    def test_correlation_spearman(self, xy):
+        import scipy.stats
+
+        f, x, y, z = xy
+        f = VectorAssembler(["x", "y", "z"], "features").transform(f)
+        got = Correlation.corr(f, "features", method="spearman")
+        expect = scipy.stats.spearmanr(np.stack([x, y, z], axis=1)).statistic
+        np.testing.assert_allclose(got, expect, rtol=1e-8)
+
+    def test_constant_feature_nan_off_diagonal(self):
+        f = Frame({"a": [1.0, 1.0, 1.0], "b": [1.0, 2.0, 3.0]})
+        f = VectorAssembler(["a", "b"], "features").transform(f)
+        got = Correlation.corr(f, "features")
+        assert np.isnan(got[0, 1])
+        assert got[0, 0] == 1.0 and got[1, 1] == 1.0
+
+    def test_summarizer(self, xy):
+        f, x, y, z = xy
+        f = VectorAssembler(["x", "y", "z"], "features").transform(f)
+        s = summary(f, "features")
+        X = np.stack([x, y, z], axis=1)
+        assert s["count"] == 60
+        np.testing.assert_allclose(s["mean"], X.mean(axis=0), rtol=1e-9)
+        np.testing.assert_allclose(s["variance"], X.var(axis=0, ddof=1),
+                                   rtol=1e-8)
+        np.testing.assert_allclose(s["min"], X.min(axis=0), rtol=1e-9)
+        np.testing.assert_allclose(s["max"], X.max(axis=0), rtol=1e-9)
+        np.testing.assert_allclose(s["normL2"],
+                                   np.sqrt((X ** 2).sum(axis=0)), rtol=1e-9)
+
+    def test_summarizer_metric_selection(self, xy):
+        f, *_ = xy
+        f = VectorAssembler(["x"], "features").transform(f)
+        s = Summarizer.metrics("mean", "count").summary(f, "features")
+        assert set(s) == {"mean", "count"}
+        with pytest.raises(ValueError, match="unknown metrics"):
+            Summarizer.metrics("median")
