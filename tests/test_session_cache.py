@@ -1,0 +1,35 @@
+"""Persistent XLA compilation cache wiring (session._init_compilation_cache)."""
+
+import os
+
+import jax
+import numpy as np
+
+from sparkdq4ml_tpu import TpuSession
+
+
+def test_cache_dir_created_and_configured(tmp_path):
+    cache = os.path.join(str(tmp_path), "xla-cache")
+    s = (TpuSession.builder().app_name("t")
+         .config("spark.compilation.cacheDir", cache).get_or_create())
+    try:
+        assert os.path.isdir(cache)
+        assert jax.config.jax_compilation_cache_dir == cache
+        # A fresh compile lands an entry on disk.
+        jax.jit(lambda x: x * 3.0 + 1.0)(np.arange(8.0)).block_until_ready()
+        assert len(os.listdir(cache)) >= 1
+    finally:
+        s.stop()
+
+
+def test_cache_opt_out(tmp_path):
+    before = jax.config.jax_compilation_cache_dir
+    cache = os.path.join(str(tmp_path), "unused")
+    s = (TpuSession.builder().app_name("t")
+         .config("spark.compilation.cache", "off")
+         .config("spark.compilation.cacheDir", cache).get_or_create())
+    try:
+        assert not os.path.exists(cache)
+        assert jax.config.jax_compilation_cache_dir == before
+    finally:
+        s.stop()
