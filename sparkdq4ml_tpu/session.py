@@ -54,11 +54,26 @@ class TpuSession:
         this workload's wall-clock). Opt out with
         ``.config("spark.compilation.cache", "off")``; override the
         directory with ``.config("spark.compilation.cacheDir", path)``."""
-        if str(self.conf.get("spark.compilation.cache", "on")).lower() in (
-                "off", "false", "0"):
-            return
         import os
 
+        from jax.experimental.compilation_cache import compilation_cache as _cc
+
+        if str(self.conf.get("spark.compilation.cache", "on")).lower() in (
+                "off", "false", "0"):
+            try:
+                # A previous session may have pointed the process-global
+                # cache at its directory; opting out must actually stop
+                # caching, not just skip re-enabling it. Restore jax's
+                # stock thresholds too (we force-cache every compile below).
+                jax.config.update("jax_compilation_cache_dir", None)
+                jax.config.update(
+                    "jax_persistent_cache_min_compile_time_secs", 1.0)
+                jax.config.update(
+                    "jax_persistent_cache_min_entry_size_bytes", 0)
+                _cc.reset_cache()
+            except Exception as e:
+                logger.debug("compilation cache opt-out: %s", e)
+            return
         default_dir = os.path.join(
             os.path.expanduser("~"), ".cache", "sparkdq4ml_tpu", "xla")
         cache_dir = self.conf.get("spark.compilation.cacheDir", default_dir)
@@ -68,6 +83,10 @@ class TpuSession:
             # Cache every compile (the default only caches "long" ones).
             jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
             jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+            # jax latches "is the cache enabled?" process-globally at the
+            # first compile; a compile before this session was built would
+            # have pinned it to off. Reset the latch so our dir takes effect.
+            _cc.reset_cache()
         except Exception as e:  # cache is an optimization, never fatal
             logger.debug("compilation cache disabled: %s", e)
 
@@ -98,6 +117,8 @@ class TpuSession:
                 _ACTIVE = TpuSession(self._app_name, self._master, self._conf)
             else:
                 _ACTIVE.conf.update(self._conf)  # Spark getOrCreate semantics
+                if any(k.startswith("spark.compilation.") for k in self._conf):
+                    _ACTIVE._init_compilation_cache()
             return _ACTIVE
 
         getOrCreate = get_or_create

@@ -4,8 +4,25 @@ import os
 
 import jax
 import numpy as np
+import pytest
 
 from sparkdq4ml_tpu import TpuSession
+
+
+@pytest.fixture(autouse=True)
+def _restore_jax_cache_config():
+    """These tests mutate process-global jax config; restore it so the rest
+    of the suite compiles with its original cache behavior."""
+    saved = {k: getattr(jax.config, k) for k in (
+        "jax_compilation_cache_dir",
+        "jax_persistent_cache_min_compile_time_secs",
+        "jax_persistent_cache_min_entry_size_bytes")}
+    yield
+    for k, v in saved.items():
+        jax.config.update(k, v)
+    from jax.experimental.compilation_cache import compilation_cache as cc
+
+    cc.reset_cache()
 
 
 def test_cache_dir_created_and_configured(tmp_path):
@@ -23,13 +40,30 @@ def test_cache_dir_created_and_configured(tmp_path):
 
 
 def test_cache_opt_out(tmp_path):
-    before = jax.config.jax_compilation_cache_dir
     cache = os.path.join(str(tmp_path), "unused")
     s = (TpuSession.builder().app_name("t")
          .config("spark.compilation.cache", "off")
          .config("spark.compilation.cacheDir", cache).get_or_create())
     try:
         assert not os.path.exists(cache)
-        assert jax.config.jax_compilation_cache_dir == before
+        # Opt-out actively disables caching, including a dir left over from
+        # an earlier session in the same process.
+        assert jax.config.jax_compilation_cache_dir is None
+    finally:
+        s.stop()
+
+
+def test_cache_reconfigured_on_get_or_create(tmp_path):
+    first = os.path.join(str(tmp_path), "a")
+    second = os.path.join(str(tmp_path), "b")
+    s = (TpuSession.builder().app_name("t")
+         .config("spark.compilation.cacheDir", first).get_or_create())
+    try:
+        assert jax.config.jax_compilation_cache_dir == first
+        s2 = (TpuSession.builder()
+              .config("spark.compilation.cacheDir", second).get_or_create())
+        assert s2 is s
+        assert jax.config.jax_compilation_cache_dir == second
+        assert os.path.isdir(second)
     finally:
         s.stop()
