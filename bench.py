@@ -48,8 +48,9 @@ def main():
 
     import sparkdq4ml_tpu as dq
     from sparkdq4ml_tpu.models import LinearRegression, VectorAssembler
-    from sparkdq4ml_tpu.parallel.distributed import (fused_linear_fit_fn,
-                                                     place_sharded)
+    from sparkdq4ml_tpu.parallel.distributed import (fused_linear_fit_packed,
+                                                     pack_design, place_packed,
+                                                     unpack_fit_result)
 
     path = os.path.join(REPO, "data", "dataset-full.csv")
     session = dq.TpuSession.builder().app_name("bench").master("local[*]").get_or_create()
@@ -74,20 +75,24 @@ def main():
 
     import jax.numpy as jnp
 
+    # Device arrays throughout — no np.asarray before timing (host-read trap).
     X = jnp.asarray(df._column_values("features"))
     y = jnp.asarray(df._column_values("label"))
     mask = df.mask
 
-    # --- accelerator fit: ONE jitted program (masked Gramian + FISTA loop),
-    # the same fused path LinearRegression.fit dispatches. NO device→host
-    # fetch may happen before/inside the loop (see module docstring);
-    # block_until_ready syncs without reading.
+    # --- accelerator fit: ONE jitted program (packed Gramian + FISTA loop),
+    # the same fused packed path LinearRegression.fit dispatches: one input
+    # buffer, one output buffer (per-buffer dispatch cost dominates this
+    # problem size — see pack_design). NO device→host fetch may happen
+    # before/inside the loop (see module docstring); block_until_ready syncs
+    # without reading.
     mesh = None if session.mesh.devices.size <= 1 else session.mesh
-    fit_fn = fused_linear_fit_fn(mesh, "fista", 40, 1e-6, True, True)
-    Xd, yd, md = place_sharded(X, y, mask, mesh)
+    fit_fn = fused_linear_fit_packed(mesh, "fista", 40, 1e-6, True, True)
+    Zd = place_packed(pack_design(X, y, mask), mesh)
+    hyper = jnp.asarray([1.0, 1.0], Zd.dtype)
 
     def device_fit():
-        return fit_fn(Xd, yd, md, 1.0, 1.0)
+        return fit_fn(Zd, hyper)
 
     result = jax.block_until_ready(device_fit())   # compile (excluded; cached after)
     times = []
@@ -100,7 +105,8 @@ def main():
     # ---- timing done; host reads are safe from here on --------------------
     n_rows = df.count()
     log(f"DQ-clean rows: {n_rows} (expect 1024)")
-    coef = float(np.asarray(result.coefficients)[0])
+    result = unpack_fit_result(result, X.shape[1] if X.ndim > 1 else 1)
+    coef = float(result.coefficients[0])
     intercept = float(result.intercept)
     d = df.to_pydict()
     yv = d["label"].astype(np.float64)

@@ -152,7 +152,7 @@ def _logistic_core(X, y, mask, reg_param, alpha, n, std,
 def fused_logistic_fit_fn(mesh: Optional[Mesh], max_iter: int, tol: float,
                           fit_intercept: bool, standardization: bool):
     """One jitted program: stats pass + FISTA scan (+ per-iteration psum when
-    sharded). Mirrors ``fused_linear_fit_fn``."""
+    sharded). Mirrors the linear path's ``fused_linear_fit_packed``."""
 
     if mesh is None or mesh.devices.size <= 1:
         def fit(X, y, mask, reg, alpha):

@@ -23,7 +23,6 @@ import numpy as np
 from ..config import float_dtype
 from ..frame.frame import Frame
 from ..ops.expressions import col
-from ..parallel.distributed import (fused_linear_fit_fn, place_sharded)
 from .base import Estimator, Model, read_json, write_json
 from .solvers import FitResult, resolve_solver
 
@@ -136,16 +135,25 @@ class LinearRegression(Estimator):
 
             active = TpuSession.active()
             mesh = active.mesh if active is not None else None
+        # Imported here, not at module top: parallel.distributed imports
+        # models.solvers, so a top-level import would make package init
+        # order-sensitive (importing parallel first used to crash).
+        from ..parallel.distributed import (fused_linear_fit_packed,
+                                            pack_design, place_packed,
+                                            unpack_fit_result)
+
         X, y, mask = _extract_xy(frame, self.features_col, self.label_col)
         solver_name = resolve_solver(self.solver, self.reg_param,
                                      self.elastic_net_param)
         if mesh is not None and mesh.devices.size <= 1:
             mesh = None  # unify the single-device cache key
-        fit_fn = fused_linear_fit_fn(mesh, solver_name, self.max_iter,
-                                     self.tol, self.fit_intercept,
-                                     self.standardization)
-        Xd, yd, md = place_sharded(X, y, mask, mesh)
-        result = fit_fn(Xd, yd, md, self.reg_param, self.elastic_net_param)
+        fit_fn = fused_linear_fit_packed(mesh, solver_name, self.max_iter,
+                                         self.tol, self.fit_intercept,
+                                         self.standardization)
+        Zd = place_packed(pack_design(X, y, mask), mesh)
+        hyper = jnp.asarray([self.reg_param, self.elastic_net_param],
+                            float_dtype())
+        result = unpack_fit_result(fit_fn(Zd, hyper), X.shape[1])
         model = LinearRegressionModel(
             coefficients=np.asarray(result.coefficients),
             intercept=float(result.intercept),

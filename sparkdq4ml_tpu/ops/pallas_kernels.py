@@ -157,6 +157,54 @@ def masked_gram_pallas(X: jnp.ndarray, y: jnp.ndarray, mask: jnp.ndarray,
     return _masked_gram_call(Z, w[:, None], block, _interpret())
 
 
+def _packed_gram_kernel(z_ref, out_ref):
+    """One row tile of the pre-masked design: out += ZᵀZ."""
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    z = z_ref[:]
+    out_ref[:] += jax.lax.dot_general(
+        z, z,
+        dimension_numbers=(((0,), (0,)), ((), ())),
+        preferred_element_type=out_ref.dtype,
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("block_rows", "interpret"))
+def _packed_gram_call(Z, block_rows: int, interpret: bool):
+    n, D = Z.shape
+    return pl.pallas_call(
+        _packed_gram_kernel,
+        grid=(pl.cdiv(n, block_rows),),
+        in_specs=[pl.BlockSpec((block_rows, D), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((D, D), lambda i: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((D, D), Z.dtype),
+        interpret=interpret,
+    )(Z)
+
+
+def packed_gram_pallas(Z: jnp.ndarray,
+                       block_rows: int = BLOCK_ROWS) -> jnp.ndarray:
+    """Gramian of a pre-masked packed design ``Z = [X, y, 1]·mask``
+    (``parallel/distributed.py:pack_design``): ``A = ZᵀZ``, rows streamed
+    HBM→VMEM through a fixed footprint. Same contract as
+    ``masked_gram_pallas`` with the mask-multiply already folded into ``Z``
+    — one fewer input buffer."""
+    n, D = Z.shape
+    if n == 0:
+        return jnp.zeros((D, D), Z.dtype)
+    block = min(block_rows, max(8, -(-n // 8) * 8))
+    pad = (-n) % block
+    if pad:
+        # Out-of-bounds block slots are undefined in Pallas; zero rows
+        # contribute nothing to ZᵀZ.
+        Z = jnp.concatenate([Z, jnp.zeros((pad, D), Z.dtype)])
+    return _packed_gram_call(Z, block, _interpret())
+
+
 # ---------------------------------------------------------------------------
 # Fused DQ rule chain
 # ---------------------------------------------------------------------------

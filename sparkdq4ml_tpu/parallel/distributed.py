@@ -59,15 +59,10 @@ def _gram_sharded_fn(mesh: Mesh):
     return jax.jit(sharded)
 
 
-@functools.lru_cache(maxsize=None)
-def fused_linear_fit_fn(mesh: Optional[Mesh], solver: str, max_iter: int,
-                        tol: float, fit_intercept: bool, standardization: bool):
-    """ONE jitted program for the whole fit: sharded masked Gramian (+psum)
-    feeding the solver loop — a single dispatch, zero host round-trips.
-
-    This is the fit hot path ``LinearRegression.fit`` uses; Spark's
-    equivalent is 1 + 2·maxIter RPC barriers (SURVEY.md §3.3).
-    """
+def _resolve_solve_A(solver: str, max_iter: int, tol: float,
+                     fit_intercept: bool, standardization: bool):
+    """Solver-loop factory on the augmented Gramian ``A`` (shared by the
+    packed and unpacked fused fit paths)."""
     from ..models.owlqn import owlqn_solve
     from ..models.solvers import fista_solve, normal_solve
 
@@ -85,21 +80,109 @@ def fused_linear_fit_fn(mesh: Optional[Mesh], solver: str, max_iter: int,
             return fista_solve(A, reg, alpha, max_iter=max_iter, tol=tol,
                                fit_intercept=fit_intercept,
                                standardization=standardization)
+    return solve_A
+
+
+def pack_design(X, y, mask) -> np.ndarray:
+    """Pack ``Z = [X, y, 1]·mask`` into ONE array — the single transfer unit
+    of the packed fit path.
+
+    Why packing matters here: every device argument of a dispatch costs a
+    fixed per-buffer overhead (~10 µs each through the axon tunnel — measured;
+    5 args ≈ 74 µs, 1 arg ≈ 33 µs floor). The masked augmented Gramian only
+    ever consumes ``Z`` (``A = ZᵀZ``, solvers.augmented_gram), so pre-masking
+    on the host collapses (X, y, mask) into one buffer with zero information
+    loss: the mask column *is* the masked ones-column, and all-zero padding
+    rows contribute nothing to ``ZᵀZ`` — no mask bookkeeping needed.
+
+    Device arrays are packed ON DEVICE (jnp ops, async): ``np.asarray`` on a
+    device array is a device→host read, and the first such read permanently
+    drops the process into ~67 ms-per-dispatch synchronous mode on the
+    tunneled TPU (bench.py module docstring) — packing must never be the
+    first reader.
+    """
+    xp = jnp if any(isinstance(a, jax.Array) for a in (X, y, mask)) else np
+    X = xp.asarray(X)
+    if X.ndim == 1:
+        X = X[:, None]
+    y = xp.asarray(y, X.dtype)
+    w = xp.asarray(mask, X.dtype)
+    Z = xp.concatenate([X, y[:, None], xp.ones_like(y)[:, None]], axis=1)
+    return Z * w[:, None]
+
+
+def place_packed(Z, mesh: Optional[Mesh]):
+    """Pad packed rows to the shard count and device_put row-sharded.
+    Zero padding rows are mask=0 rows by construction (see pack_design)."""
+    if mesh is None or mesh.devices.size <= 1:
+        return jnp.asarray(Z)
+    xp = jnp if isinstance(Z, jax.Array) else np  # never read device→host
+    Z = xp.asarray(Z)
+    rem = (-Z.shape[0]) % mesh.devices.size
+    if rem:
+        Z = xp.concatenate([Z, xp.zeros((rem, Z.shape[1]), Z.dtype)])
+    return jax.device_put(Z, NamedSharding(mesh, P(DATA_AXIS)))
+
+
+@functools.lru_cache(maxsize=None)
+def fused_linear_fit_packed(mesh: Optional[Mesh], solver: str, max_iter: int,
+                            tol: float, fit_intercept: bool,
+                            standardization: bool):
+    """Packed-I/O variant of :func:`fused_linear_fit_fn` — the dispatch-lean
+    hot path ``LinearRegression.fit`` and ``bench.py`` use.
+
+    Signature: ``fit(Z, hyper) -> flat`` where ``Z = pack_design(X, y, mask)``
+    (row-sharded over the mesh), ``hyper = [regParam, elasticNetParam]`` as a
+    device array, and ``flat`` is one buffer:
+    ``[coef(d) | intercept | iterations | converged | objective_history]``
+    (decode with :func:`unpack_fit_result`). One input buffer + one output
+    buffer ≈ the minimum possible dispatch cost; the compute is identical to
+    the unpacked path (local ``ZᵀZ`` on the MXU, ``psum`` over ICI, solver
+    loop on replicated statistics).
+    """
+    solve_A = _resolve_solve_A(solver, max_iter, tol, fit_intercept,
+                               standardization)
+
+    def local_gram(Z):
+        # Honors config.pallas like the unpacked augmented_gram; inside
+        # shard_map the dispatch gate sees the varying mesh axes and falls
+        # back to the XLA matmul.
+        from ..ops import pallas_kernels
+
+        if pallas_kernels.dispatch_to_pallas(Z):
+            return pallas_kernels.packed_gram_pallas(Z)
+        return Z.T @ Z
 
     if mesh is None or mesh.devices.size <= 1:
-        def fit(X, y, mask, reg, alpha):
-            return solve_A(augmented_gram(X, y, mask), reg, alpha)
+        gram = local_gram
     else:
-        sharded_gram = jax.shard_map(
-            lambda Xs, ys, ms: jax.lax.psum(augmented_gram(Xs, ys, ms), DATA_AXIS),
-            mesh=mesh,
-            in_specs=(P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS)),
-            out_specs=P())
+        gram = jax.shard_map(
+            lambda Zs: jax.lax.psum(local_gram(Zs), DATA_AXIS),
+            mesh=mesh, in_specs=(P(DATA_AXIS),), out_specs=P())
 
-        def fit(X, y, mask, reg, alpha):
-            return solve_A(sharded_gram(X, y, mask), reg, alpha)
+    def fit(Z, hyper):
+        r = solve_A(gram(Z), hyper[0], hyper[1])
+        dt = r.coefficients.dtype
+        scalars = jnp.stack([r.intercept.astype(dt),
+                             r.iterations.astype(dt),
+                             r.converged.astype(dt)])
+        return jnp.concatenate(
+            [r.coefficients, scalars, r.objective_history.astype(dt)])
 
     return jax.jit(fit)
+
+
+def unpack_fit_result(flat, d: int):
+    """Decode the packed fit output (host side) into a ``FitResult``."""
+    from ..models.solvers import FitResult
+
+    flat = np.asarray(flat)
+    return FitResult(
+        coefficients=flat[:d],
+        intercept=flat[d],
+        iterations=np.int32(flat[d + 1]),
+        objective_history=flat[d + 3:],
+        converged=bool(flat[d + 2]))
 
 
 def place_sharded(X, y, mask, mesh: Optional[Mesh]):

@@ -66,6 +66,40 @@ class TestMaskedGramPallas:
         np.testing.assert_allclose(np.asarray(A), np.asarray(_xla_gram(X, y, mask)),
                                    rtol=1e-10)
 
+    def test_packed_gram_matches_xla(self):
+        """packed_gram_pallas on a pre-masked design ≡ masked XLA Gramian."""
+        from sparkdq4ml_tpu.parallel.distributed import pack_design
+
+        rng = np.random.default_rng(7)
+        n = pallas_kernels.BLOCK_ROWS + 33  # multi-tile grid
+        X = rng.normal(size=(n, 3))
+        y = rng.normal(size=(n,))
+        mask = rng.random(n) > 0.25
+        Z = jnp.asarray(pack_design(X, y, mask))
+        A = pallas_kernels.packed_gram_pallas(Z)
+        expect = _xla_gram(jnp.asarray(X), jnp.asarray(y), jnp.asarray(mask))
+        np.testing.assert_allclose(np.asarray(A), np.asarray(expect), rtol=1e-9)
+
+    def test_packed_fit_path_dispatches_to_pallas(self, monkeypatch):
+        """fused_linear_fit_packed (the LinearRegression.fit hot path) routes
+        its Gramian through packed_gram_pallas when config.pallas selects it."""
+        from sparkdq4ml_tpu.parallel import distributed
+
+        calls = []
+        real = pallas_kernels.packed_gram_pallas
+        monkeypatch.setattr(pallas_kernels, "packed_gram_pallas",
+                            lambda Z: calls.append(1) or real(Z))
+        distributed.fused_linear_fit_packed.cache_clear()
+        fit = distributed.fused_linear_fit_packed(None, "fista", 5, 1e-6,
+                                                  True, True)
+        rng = np.random.default_rng(8)
+        Z = jnp.asarray(distributed.pack_design(
+            rng.normal(size=(32, 1)), rng.normal(size=(32,)),
+            np.ones(32, bool)))
+        fit(Z, jnp.asarray([0.0, 0.0]))
+        assert calls, "packed fit did not dispatch to the Pallas Gramian"
+        distributed.fused_linear_fit_packed.cache_clear()
+
     def test_fit_end_to_end_matches_xla_path(self, session):
         """Full Lasso fit over the Pallas Gramian reproduces the golden fit.
 
