@@ -148,19 +148,50 @@ def _logistic_core(X, y, mask, reg_param, alpha, n, std,
     return LogisticFitResult(coef, intercept, iters, history, done)
 
 
+def _unpack_z(Z):
+    """Split the packed design ``Z = [X, y, 1]·mask`` (pack_design layout).
+
+    The pre-masked columns are exactly what the logistic core consumes —
+    it only ever reads X, y masked, and ``w² = w`` for a boolean mask, so
+    masked moments (w@(X·w) = w@X etc.) are unchanged.
+    """
+    d = Z.shape[1] - 2
+    X = Z[:, :d]
+    y = Z[:, d]
+    mask = Z[:, d + 1] > 0
+    return X, y, mask
+
+
+def _pack_logistic_result(r: "LogisticFitResult"):
+    """One output buffer: [coef(d) | intercept | iters | converged | history]
+    (same layout as the linear path; decode with
+    distributed.unpack_fit_result)."""
+    dt = r.coefficients.dtype
+    scalars = jnp.stack([r.intercept.astype(dt), r.iterations.astype(dt),
+                         r.converged.astype(dt)])
+    return jnp.concatenate([r.coefficients, scalars,
+                            r.objective_history.astype(dt)])
+
+
 @functools.lru_cache(maxsize=None)
-def fused_logistic_fit_fn(mesh: Optional[Mesh], max_iter: int, tol: float,
-                          fit_intercept: bool, standardization: bool):
+def fused_logistic_fit_packed(mesh: Optional[Mesh], max_iter: int, tol: float,
+                              fit_intercept: bool, standardization: bool):
     """One jitted program: stats pass + FISTA scan (+ per-iteration psum when
-    sharded). Mirrors the linear path's ``fused_linear_fit_packed``."""
+    sharded). Mirrors the linear path's ``fused_linear_fit_packed``,
+    including its single-input/single-output dispatch discipline:
+    ``fit(Z, hyper) -> flat`` with ``Z = pack_design(X, y, mask)`` and
+    ``hyper = [regParam, elasticNetParam]``."""
 
     if mesh is None or mesh.devices.size <= 1:
-        def fit(X, y, mask, reg, alpha):
+        def fit(Z, hyper):
+            X, y, mask = _unpack_z(Z)
             n, std = _feature_stats(X, y, mask)
-            return _logistic_core(X, y, mask, reg, alpha, n, std, max_iter,
-                                  tol, fit_intercept, standardization)
+            return _pack_logistic_result(_logistic_core(
+                X, y, mask, hyper[0], hyper[1], n, std, max_iter,
+                tol, fit_intercept, standardization))
     else:
-        def local(X, y, mask, reg, alpha):
+        def local(Z, hyper):
+            X, y, mask = _unpack_z(Z)
             w = mask.astype(X.dtype)
             parts = jnp.concatenate([w @ X, w @ (X * X), jnp.sum(w)[None]])
             parts = jax.lax.psum(parts, DATA_AXIS)
@@ -169,13 +200,13 @@ def fused_logistic_fit_fn(mesh: Optional[Mesh], max_iter: int, tol: float,
             mean = parts[:d] / n
             var = parts[d: 2 * d] / n - mean * mean
             std = jnp.sqrt(jnp.clip(var * n / jnp.maximum(n - 1.0, 1.0), 0.0))
-            return _logistic_core(X, y, mask, reg, alpha, n, std, max_iter,
-                                  tol, fit_intercept, standardization,
-                                  axis=DATA_AXIS)
+            return _pack_logistic_result(_logistic_core(
+                X, y, mask, hyper[0], hyper[1], n, std, max_iter,
+                tol, fit_intercept, standardization, axis=DATA_AXIS))
 
         fit = jax.shard_map(
             local, mesh=mesh,
-            in_specs=(P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P(), P()),
+            in_specs=(P(DATA_AXIS), P()),
             out_specs=P())
 
     return jax.jit(fit)
@@ -253,12 +284,18 @@ class LogisticRegression(Estimator):
         if mesh is not None and mesh.devices.size <= 1:
             mesh = None
         X, y, mask = _extract_xy(frame, self.features_col, self.label_col)
-        fit_fn = fused_logistic_fit_fn(mesh, self.max_iter, self.tol,
-                                       self.fit_intercept, self.standardization)
-        from ..parallel.distributed import place_sharded
+        fit_fn = fused_logistic_fit_packed(mesh, self.max_iter, self.tol,
+                                           self.fit_intercept,
+                                           self.standardization)
+        from ..config import float_dtype
+        from ..parallel.distributed import (pack_design, place_packed,
+                                            unpack_fit_result)
 
-        Xd, yd, md = place_sharded(X, y, mask, mesh)
-        result = fit_fn(Xd, yd, md, self.reg_param, self.elastic_net_param)
+        Zd = place_packed(pack_design(X, y, mask), mesh)
+        hyper = jnp.asarray([self.reg_param, self.elastic_net_param],
+                            float_dtype())
+        result = LogisticFitResult(
+            *unpack_fit_result(fit_fn(Zd, hyper), X.shape[1]))
         model = LogisticRegressionModel(
             coefficients=np.asarray(result.coefficients),
             intercept=float(result.intercept),
