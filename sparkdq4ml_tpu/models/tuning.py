@@ -6,12 +6,14 @@ TPU-first design — the grid axis is *grid-parallel* (SURVEY.md §5
 "Parallelism strategies"): for linear regression every (fold × param) fit is
 a tiny solve on sufficient statistics, so the whole cross-validation runs as
 
-1. ONE data pass building per-fold augmented Gramians (``vmap`` over fold
-   masks; sharded with a psum when a mesh is active),
+1. ONE data pass building ALL per-fold augmented Gramians from the packed
+   design (``vmap`` over folds inside ``shard_map`` + one psum when a mesh
+   is active),
 2. train-fold Gramians by subtraction (``A_train = A_all − A_fold`` — the
    Gramian is additive, so k-fold CV needs no second data pass),
 3. a single ``vmap`` over the flattened (param × fold) axis of the FISTA
-   solver — every grid cell optimized simultaneously on the MXU/VPU,
+   solver, with that cell axis SHARDED over the mesh — every core solves
+   its slice of the grid simultaneously (the grid-parallel axis),
 4. held-out metrics (rmse/mse/r2) computed from the fold Gramians directly.
 
 Estimators without a sufficient-statistics path (LogisticRegression, custom)
@@ -21,6 +23,7 @@ take the generic fit-per-cell path, which still shares the session mesh.
 from __future__ import annotations
 
 import copy
+import functools
 import itertools
 import re
 from typing import Optional, Sequence
@@ -33,7 +36,7 @@ from ..frame.frame import Frame
 from .base import Estimator, Model
 from .evaluation import Evaluator, RegressionEvaluator
 from .regression import LinearRegression, _extract_xy
-from .solvers import augmented_gram, fista_solve, resolve_solver
+from .solvers import fista_solve, resolve_solver
 
 
 def _snake(name: str) -> str:
@@ -117,34 +120,65 @@ def _holdout_metric_from_gram(A, coef, intercept, metric: str):
     return 1.0 - sse / ss_tot
 
 
+@functools.lru_cache(maxsize=None)
+def _fold_grams_fn(mesh, num_folds: int):
+    """ONE data pass building ALL per-fold Gramians from the packed design
+    ``Z = [X, y, 1]·mask``: for 0/1 fold weight ``w``, ``(Z·w)ᵀZ = ZᵀWZ``
+    is the fold's masked Gramian (invalid rows are already zero in Z).
+    Sharded over the mesh: each device grams its row shard for every fold
+    (vmap over the fold axis), then one psum reduces over ICI."""
+    def local(Zs, fs):
+        def one(f):
+            w = (fs == f).astype(Zs.dtype)
+            return (Zs * w[:, None]).T @ Zs
+        return jax.vmap(one)(jnp.arange(num_folds))
+
+    if mesh is None or mesh.devices.size <= 1:
+        return jax.jit(local)
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.mesh import DATA_AXIS
+
+    return jax.jit(jax.shard_map(
+        lambda Zs, fs: jax.lax.psum(local(Zs, fs), DATA_AXIS),
+        mesh=mesh, in_specs=(P(DATA_AXIS), P(DATA_AXIS)), out_specs=P()))
+
+
 def _linear_cv_fast(frame: Frame, estimator: LinearRegression,
                     param_maps: list[dict], metric: str, num_folds: int,
                     seed: int, mesh):
     """The vmapped sufficient-stats CV described in the module docstring.
     Returns (metrics[num_params], A_all) — A_all lets the caller refit the
     best model with zero extra data passes."""
-    from ..parallel.distributed import compute_gram
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ..parallel.distributed import pack_design
+    from ..parallel.mesh import DATA_AXIS
 
     X, y, mask = _extract_xy(frame, estimator.features_col, estimator.label_col)
-    fold = jnp.asarray(_fold_ids(X.shape[0], num_folds, seed))
+    Z = pack_design(X, y, mask)                          # device-side, packed
+    fold = _fold_ids(Z.shape[0], num_folds, seed)
 
-    # Per-fold Gramians: one vmapped masked pass (sharded Gramian per fold
-    # when a mesh is active — still one logical data pass each).
-    if mesh is not None and mesh.devices.size > 1:
-        A_folds = jnp.stack([
-            compute_gram(X, y, jnp.logical_and(mask, fold == f), mesh=mesh)
-            for f in range(num_folds)])
-    else:
-        fold_masks = jax.vmap(
-            lambda f: jnp.logical_and(mask, fold == f))(jnp.arange(num_folds))
-        A_folds = jax.vmap(lambda m: augmented_gram(X, y, m))(fold_masks)
+    ndev = 1 if mesh is None else mesh.devices.size
+    rem = (-Z.shape[0]) % ndev
+    if rem:
+        # Padding rows: zero in Z (no contribution) and fold −1 (no fold).
+        Z = jnp.concatenate([Z, jnp.zeros((rem, Z.shape[1]), Z.dtype)])
+        fold = np.concatenate([fold, np.full(rem, -1, fold.dtype)])
+    fold_d = jnp.asarray(fold)
+    if ndev > 1:
+        shard = NamedSharding(mesh, P(DATA_AXIS))
+        Z = jax.device_put(Z, shard)
+        fold_d = jax.device_put(fold_d, shard)
+    A_folds = _fold_grams_fn(mesh if ndev > 1 else None, num_folds)(Z, fold_d)
     A_all = jnp.sum(A_folds, axis=0)
     A_train = A_all[None] - A_folds                      # (k, d+2, d+2)
 
+    dt = Z.dtype
     regs = jnp.asarray([p.get("reg_param", estimator.reg_param)
-                        for p in param_maps], X.dtype)
+                        for p in param_maps], dt)
     alphas = jnp.asarray([p.get("elastic_net_param", estimator.elastic_net_param)
-                          for p in param_maps], X.dtype)
+                          for p in param_maps], dt)
 
     # Flatten (param × fold) and solve every cell simultaneously.
     k = num_folds
@@ -153,6 +187,23 @@ def _linear_cv_fast(frame: Frame, estimator: LinearRegression,
     A_hold = jnp.tile(A_folds, (m, 1, 1))
     reg_rep = jnp.repeat(regs, k)
     alpha_rep = jnp.repeat(alphas, k)
+
+    n_cells = m * k
+    if ndev > 1:
+        # Grid-parallel axis (BASELINE.json config e): shard the cell axis
+        # over the mesh so every core solves its slice of the grid.
+        cell_pad = (-n_cells) % ndev
+        if cell_pad:
+            # Wrap-around duplicates (works even when pad > n_cells, e.g. a
+            # 3-cell grid on 8 devices); duplicates are trimmed after fetch.
+            idx = jnp.arange(n_cells + cell_pad) % n_cells
+            A_rep, A_hold = A_rep[idx], A_hold[idx]
+            reg_rep, alpha_rep = reg_rep[idx], alpha_rep[idx]
+        cell_shard = NamedSharding(mesh, P(DATA_AXIS))
+        A_rep = jax.device_put(A_rep, cell_shard)
+        A_hold = jax.device_put(A_hold, cell_shard)
+        reg_rep = jax.device_put(reg_rep, cell_shard)
+        alpha_rep = jax.device_put(alpha_rep, cell_shard)
 
     def cell(A_tr, A_te, reg, alpha):
         r = fista_solve(A_tr, reg, alpha, max_iter=estimator.max_iter,
@@ -163,7 +214,8 @@ def _linear_cv_fast(frame: Frame, estimator: LinearRegression,
                                          metric)
 
     metrics_cells = jax.jit(jax.vmap(cell))(A_rep, A_hold, reg_rep, alpha_rep)
-    metrics = np.asarray(metrics_cells).reshape(m, k).mean(axis=1)
+    metrics = (np.asarray(metrics_cells)[:n_cells]
+               .reshape(m, k).mean(axis=1))
     return metrics, A_all
 
 
