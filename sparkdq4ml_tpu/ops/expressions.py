@@ -75,6 +75,34 @@ class Expr:
     def cast(self, type_name: str) -> "Cast":
         return Cast(self, type_name)
 
+    def isin(self, *values) -> "Expr":
+        """Membership test — ``col.isin(1, 2, 3)`` / SQL ``IN (…)``."""
+        if len(values) == 1 and isinstance(values[0], (list, tuple, set)):
+            values = tuple(values[0])
+        return InList(self, [v if isinstance(v, Expr) else Lit(v)
+                             for v in values])
+
+    def between(self, lower, upper) -> "Expr":
+        """``lower <= col <= upper`` (inclusive) — SQL ``BETWEEN``."""
+        return (self >= lower) & (self <= upper)
+
+    def like(self, pattern: str) -> "Expr":
+        """SQL LIKE: ``%`` any run, ``_`` one char (string columns)."""
+        return StringMatch("like", self, pattern)
+
+    def rlike(self, pattern: str) -> "Expr":
+        """Regex search (Spark ``rlike``)."""
+        return StringMatch("rlike", self, pattern)
+
+    def contains(self, sub: str) -> "Expr":
+        return StringMatch("contains", self, sub)
+
+    def startswith(self, prefix: str) -> "Expr":
+        return StringMatch("startswith", self, prefix)
+
+    def endswith(self, suffix: str) -> "Expr":
+        return StringMatch("endswith", self, suffix)
+
     def is_null(self) -> "Expr":
         return UnaryOp("isnull", self)
 
@@ -253,6 +281,95 @@ class Cast(Expr):
 
     def __str__(self):
         return self.name
+
+
+class InList(Expr):
+    """``expr IN (v1, v2, …)`` — vectorized membership, no row loop.
+
+    Numeric columns fold to an OR-reduction of equalities on device; string
+    columns test with host numpy. Null rows (None / NaN) are never members
+    (SQL three-valued logic collapses to False in a WHERE mask).
+    """
+
+    def __init__(self, child: Expr, values: Sequence[Expr],
+                 negated: bool = False):
+        self.child = child
+        self.values = list(values)
+        self.negated = negated
+
+    def eval(self, frame):
+        v = self.child.eval(frame)
+        vals = [x.eval(frame) for x in self.values]
+        if _is_object(v) or any(_is_object(x) for x in vals):
+            va = np.asarray(v, object)
+            hit = np.zeros(va.shape[0], bool)
+            for x in vals:
+                hit |= np.equal(va, np.asarray(x, object)).astype(bool)
+            hit = jnp.asarray(hit)
+            notnull = jnp.asarray(
+                np.asarray([x is not None for x in va], bool))
+        else:
+            v = jnp.asarray(v)
+            hit = functools.reduce(
+                jnp.logical_or, [jnp.equal(v, jnp.asarray(x)) for x in vals])
+            notnull = (jnp.logical_not(jnp.isnan(v))
+                       if jnp.issubdtype(v.dtype, jnp.floating)
+                       else jnp.ones(v.shape[:1], jnp.bool_))
+        # NULL [NOT] IN (...) is NULL — False in a WHERE mask either way.
+        out = jnp.logical_not(hit) if self.negated else hit
+        return jnp.logical_and(out, notnull)
+
+    def __str__(self):
+        op = "NOT IN" if self.negated else "IN"
+        return f"({self.child} {op} ({', '.join(map(str, self.values))}))"
+
+
+class StringMatch(Expr):
+    """LIKE / RLIKE / contains / startswith / endswith on string columns.
+
+    Strings live host-side (object arrays), so matching runs in numpy; null
+    (None) rows are False, mirroring SQL null semantics in WHERE.
+    """
+
+    def __init__(self, kind: str, child: Expr, pattern: str,
+                 negated: bool = False):
+        self.kind = kind
+        self.child = child
+        self.pattern = pattern
+        self.negated = negated
+
+    def _matcher(self):
+        import re as _re
+
+        if self.kind == "like":
+            # Escape regex metachars, then translate SQL wildcards.
+            pat = _re.escape(self.pattern).replace("%", ".*").replace("_", ".")
+            rx = _re.compile(pat, _re.DOTALL)
+            return lambda s: rx.fullmatch(s) is not None
+        if self.kind == "rlike":
+            rx = _re.compile(self.pattern)
+            return lambda s: rx.search(s) is not None
+        if self.kind == "contains":
+            return lambda s: self.pattern in s
+        if self.kind == "startswith":
+            return lambda s: s.startswith(self.pattern)
+        if self.kind == "endswith":
+            return lambda s: s.endswith(self.pattern)
+        raise ValueError(self.kind)
+
+    def eval(self, frame):
+        v = self.child.eval(frame)
+        va = np.asarray(v, object) if not _is_object(v) else v
+        match = self._matcher()
+        notnull = np.asarray([x is not None for x in va], bool)
+        hit = np.asarray([x is not None and match(str(x)) for x in va], bool)
+        # NULL [NOT] LIKE ... is NULL — False in a WHERE mask either way.
+        out = (~hit if self.negated else hit) & notnull
+        return jnp.asarray(out)
+
+    def __str__(self):
+        neg = "NOT " if self.negated else ""
+        return f"({self.child} {neg}{self.kind.upper()} {self.pattern!r})"
 
 
 class UdfCall(Expr):

@@ -14,8 +14,10 @@ row interpreter.
 
 Grammar (recursive descent):
 
-    query      := SELECT select_list FROM ident join* [WHERE or_expr]
-                  [GROUP BY ...] [ORDER BY ...] [LIMIT n]
+    query      := select (UNION [ALL] select)*
+    select     := SELECT [DISTINCT] select_list FROM ident join*
+                  [WHERE or_expr] [GROUP BY ...] [HAVING or_expr]
+                  [ORDER BY ...] [LIMIT n]
     join       := [INNER|LEFT [OUTER]|RIGHT [OUTER]|FULL [OUTER]|CROSS]
                   JOIN ident (ON ident '=' ident | USING '(' ident,* ')')
     select_list:= '*' | item (',' item)*
@@ -24,6 +26,10 @@ Grammar (recursive descent):
     and_expr   := not_expr (AND not_expr)*
     not_expr   := NOT not_expr | cmp
     cmp        := add ((= | == | != | <> | < | <= | > | >=) add)?
+                | add IS [NOT] NULL
+                | add [NOT] IN '(' or_expr,* ')'
+                | add [NOT] BETWEEN add AND add
+                | add [NOT] LIKE 'pattern'
     add        := mul (('+'|'-') mul)*
     mul        := unary (('*'|'/') unary)*
     unary      := '-' unary | atom
@@ -54,7 +60,8 @@ _KEYWORDS = {"select", "from", "where", "as", "and", "or", "not", "cast",
              "true", "false", "null", "group", "by", "order", "limit",
              "asc", "desc", "join", "inner", "left", "right", "full",
              "outer", "cross", "on", "using", "case", "when", "then",
-             "else", "end", "is"}
+             "else", "end", "is", "in", "between", "like", "having",
+             "distinct", "union", "all"}
 
 _AGG_FNS = {"count", "sum", "avg", "mean", "min", "max", "stddev", "variance"}
 
@@ -122,6 +129,7 @@ class _Parser:
     # -- query -------------------------------------------------------------
     def parse_query(self):
         self.expect("kw", "select")
+        distinct = bool(self.accept("kw", "distinct"))
         items = self.parse_select_list()
         self.expect("kw", "from")
         view = self.expect("ident").value
@@ -140,6 +148,9 @@ class _Parser:
             group_by.append(self.expect("ident").value)
             while self.accept("op", ","):
                 group_by.append(self.expect("ident").value)
+        having = None
+        if self.accept("kw", "having"):
+            having = self.parse_or()
         order_by = []
         if self.accept("kw", "order"):
             self.expect("kw", "by")
@@ -149,8 +160,17 @@ class _Parser:
         limit = None
         if self.accept("kw", "limit"):
             limit = int(self.expect("number").value)
+        return Query(items, view, where, group_by, order_by, limit, joins,
+                     distinct=distinct, having=having)
+
+    def parse_union_query(self):
+        """query (UNION [ALL] query)* — set union over identical schemas."""
+        q = self.parse_query()
+        while self.accept("kw", "union"):
+            dedup = not self.accept("kw", "all")
+            q.unions.append((self.parse_query(), dedup))
         self.expect("eof")
-        return Query(items, view, where, group_by, order_by, limit, joins)
+        return q
 
     def parse_join(self):
         """``[INNER|LEFT [OUTER]|RIGHT [OUTER]|FULL [OUTER]|CROSS] JOIN view
@@ -266,6 +286,30 @@ class _Parser:
             negated = bool(self.accept("kw", "not"))
             self.expect("kw", "null")
             return left.is_not_null() if negated else left.is_null()
+        # [NOT] IN / BETWEEN / LIKE
+        negated = False
+        if (self.peek().kind == "kw" and self.peek().value.lower() == "not"
+                and self.toks[self.i + 1].kind == "kw"
+                and self.toks[self.i + 1].value.lower() in ("in", "between",
+                                                            "like")):
+            self.next()
+            negated = True
+        if self.accept("kw", "in"):
+            self.expect("op", "(")
+            values = [self.parse_or()]
+            while self.accept("op", ","):
+                values.append(self.parse_or())
+            self.expect("op", ")")
+            return E.InList(left, values, negated=negated)
+        if self.accept("kw", "between"):
+            lo = self.parse_add()
+            self.expect("kw", "and")
+            hi = self.parse_add()
+            expr = left.between(lo, hi)
+            return E.UnaryOp("!", expr) if negated else expr
+        if self.accept("kw", "like"):
+            pat = self.expect("string").value
+            return E.StringMatch("like", left, pat, negated=negated)
         return left
 
     def parse_add(self):
@@ -331,6 +375,10 @@ class _Parser:
         if t.kind == "ident":
             self.next()
             if self.accept("op", "("):
+                # COUNT(*) in expression position (e.g. HAVING COUNT(*) > 2)
+                if t.value.lower() in _AGG_FNS and self.accept("op", "*"):
+                    self.expect("op", ")")
+                    return E.UdfCall(t.value, [E.Lit("*")])
                 args = []
                 if not self.accept("op", ")"):
                     args.append(self.parse_or())
@@ -347,10 +395,12 @@ class _Parser:
 
 
 class Query:
-    """Parsed query: select items, view, joins, where, group/order/limit."""
+    """Parsed query: select items, view, joins, where, group/having/order/
+    limit, distinct flag, and trailing UNION branches."""
 
     def __init__(self, items, view, where, group_by=(), order_by=(),
-                 limit=None, joins=()):
+                 limit=None, joins=(), distinct=False, having=None,
+                 unions=()):
         self.items = items
         self.view = view
         self.where = where
@@ -358,20 +408,64 @@ class Query:
         self.order_by = list(order_by)
         self.limit = limit
         self.joins = list(joins)
+        self.distinct = distinct
+        self.having = having
+        self.unions = list(unions)  # [(Query, dedup: bool), ...]
 
 
 def parse(sql: str) -> Query:
     """Parse a query into a Query plan object."""
-    return _Parser(tokenize(sql)).parse_query()
+    return _Parser(tokenize(sql)).parse_union_query()
+
+
+def _rewrite_having(expr, extra_aggs: list):
+    """HAVING may reference aggregates directly (``HAVING COUNT(*) > 2``).
+    Rewrite agg-function calls into references to the aggregated output
+    column, collecting aggs that must be computed but aren't in SELECT."""
+    from ..frame.aggregates import AggExpr
+
+    if isinstance(expr, E.UdfCall) and expr.udf_name.lower() in _AGG_FNS:
+        arg = expr.args[0] if expr.args else None
+        if arg is None or (isinstance(arg, E.Lit) and arg.value == "*"):
+            col = None
+        elif isinstance(arg, E.Col):
+            col = arg.name
+        else:
+            raise ValueError(
+                f"HAVING aggregate over an expression is not supported: {expr}")
+        agg = AggExpr(expr.udf_name, col)
+        extra_aggs.append(agg)
+        return E.Col(agg.name)
+    if isinstance(expr, E.BinOp):
+        return E.BinOp(expr.op, _rewrite_having(expr.left, extra_aggs),
+                       _rewrite_having(expr.right, extra_aggs))
+    if isinstance(expr, E.UnaryOp):
+        return E.UnaryOp(expr.op, _rewrite_having(expr.child, extra_aggs))
+    if isinstance(expr, E.InList):
+        return E.InList(_rewrite_having(expr.child, extra_aggs),
+                        [_rewrite_having(v, extra_aggs) for v in expr.values],
+                        expr.negated)
+    return expr
 
 
 def execute(sql: str, catalog=None):
-    """Run a query against the catalog and return a Frame."""
-    from ..frame.aggregates import AggExpr
+    """Run a query (including trailing UNION branches) against the catalog."""
     from .catalog import default_catalog
 
     cat = catalog if catalog is not None else default_catalog()
     q = parse(sql)
+    frame = _execute_single(q, cat)
+    for sub, dedup in q.unions:
+        frame = frame.union(_execute_single(sub, cat))
+        if dedup:
+            frame = frame.distinct()
+    return frame
+
+
+def _execute_single(q: Query, cat):
+    """Run one SELECT (no union handling) and return a Frame."""
+    from ..frame.aggregates import AggExpr
+
     frame = cat.lookup(q.view)
     for view, how, keys in q.joins:
         frame = frame.join(cat.lookup(view), on=keys or None, how=how)
@@ -379,6 +473,9 @@ def execute(sql: str, catalog=None):
         frame = frame.filter(q.where)
 
     aggs = [it for it in q.items if isinstance(it, AggExpr)]
+    having = q.having
+    if having is not None and not q.group_by:
+        raise ValueError("HAVING requires GROUP BY")
     if aggs or q.group_by:
         non_aggs = [it for it in q.items
                     if not isinstance(it, (AggExpr, str))]
@@ -388,7 +485,14 @@ def execute(sql: str, catalog=None):
                 raise ValueError(
                     f"non-aggregate select item {it} must be a GROUP BY key")
         if q.group_by:
-            frame = frame.group_by(*q.group_by).agg(*aggs)
+            extra_aggs: list = []
+            if having is not None:
+                having = _rewrite_having(having, extra_aggs)
+                known = {a.name for a in aggs}
+                extra_aggs = [a for a in extra_aggs if a.name not in known]
+            frame = frame.group_by(*q.group_by).agg(*aggs, *extra_aggs)
+            if having is not None:
+                frame = frame.filter(having)
             keep = [it.name for it in q.items
                     if isinstance(it, (E.Col, AggExpr))]
             frame = frame.select(*keep)
@@ -409,10 +513,15 @@ def execute(sql: str, catalog=None):
             if all(c in frame.columns for c, _ in q.order_by):
                 frame = frame.sort(*[c for c, _ in q.order_by],
                                    ascending=[a for _, a in q.order_by])
-                q = Query(q.items, q.view, None, [], [], q.limit)
+                q = Query(q.items, q.view, None, [], [], q.limit,
+                          distinct=q.distinct)
         if not star:
             frame = frame.select(*q.items)
 
+    if q.distinct:
+        # SELECT DISTINCT dedups the projected rows (mask-based: keeps the
+        # first occurrence, so any pre-projection sort order is preserved).
+        frame = frame.distinct()
     if q.order_by:
         cols = [c for c, _ in q.order_by]
         asc = [a for _, a in q.order_by]

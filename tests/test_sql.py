@@ -97,3 +97,117 @@ class TestErrors:
 
     def test_case_insensitive_keywords(self, session, view):
         assert execute("select * from PRICE where price > 0").count() == 2
+
+
+class TestPredicateExtensions:
+    """IN / BETWEEN / LIKE / NOT variants (cmp grammar extensions)."""
+
+    @pytest.fixture
+    def tbl(self, session):
+        f = Frame({"g": jnp.asarray([1.0, 2.0, 3.0, 4.0, 5.0]),
+                   "name": np.asarray(["alice", "bob", "carol", None, "abe"],
+                                      object)})
+        f.create_or_replace_temp_view("t")
+        return f
+
+    def test_in_list(self, session, tbl):
+        out = execute("SELECT g FROM t WHERE g IN (1, 3, 5)", session.catalog)
+        assert sorted(r[0] for r in out.collect()) == [1.0, 3.0, 5.0]
+
+    def test_not_in_list(self, session, tbl):
+        out = execute("SELECT g FROM t WHERE g NOT IN (1, 3, 5)",
+                      session.catalog)
+        assert sorted(r[0] for r in out.collect()) == [2.0, 4.0]
+
+    def test_in_strings(self, session, tbl):
+        out = execute("SELECT name FROM t WHERE name IN ('bob', 'abe')",
+                      session.catalog)
+        assert sorted(r[0] for r in out.collect()) == ["abe", "bob"]
+
+    def test_between(self, session, tbl):
+        out = execute("SELECT g FROM t WHERE g BETWEEN 2 AND 4",
+                      session.catalog)
+        assert sorted(r[0] for r in out.collect()) == [2.0, 3.0, 4.0]
+
+    def test_not_between(self, session, tbl):
+        out = execute("SELECT g FROM t WHERE g NOT BETWEEN 2 AND 4",
+                      session.catalog)
+        assert sorted(r[0] for r in out.collect()) == [1.0, 5.0]
+
+    def test_like_prefix(self, session, tbl):
+        out = execute("SELECT name FROM t WHERE name LIKE 'a%'",
+                      session.catalog)
+        assert sorted(r[0] for r in out.collect()) == ["abe", "alice"]
+
+    def test_like_underscore(self, session, tbl):
+        out = execute("SELECT name FROM t WHERE name LIKE '_ob'",
+                      session.catalog)
+        assert [r[0] for r in out.collect()] == ["bob"]
+
+    def test_not_like_null_is_dropped(self, session, tbl):
+        # SQL: NULL NOT LIKE ... is NULL -> row filtered out of WHERE
+        out = execute("SELECT name FROM t WHERE name NOT LIKE 'a%'",
+                      session.catalog)
+        assert sorted(r[0] for r in out.collect()) == ["bob", "carol"]
+
+    def test_fluent_isin_between(self, session, tbl):
+        assert tbl.filter(tbl.col("g").isin(2, 5)).count() == 2
+        assert tbl.filter(tbl.col("g").between(1, 2)).count() == 2
+        assert tbl.filter(tbl.col("name").contains("o")).count() == 2
+        assert tbl.filter(tbl.col("name").startswith("a")).count() == 2
+        assert tbl.filter(tbl.col("name").endswith("e")).count() == 2
+        assert tbl.filter(tbl.col("name").rlike("^[ab]")).count() == 3
+
+
+class TestDistinctHavingUnion:
+    @pytest.fixture
+    def sales(self, session):
+        f = Frame({"dept": np.asarray(["a", "a", "b", "b", "b", "c"], object),
+                   "amt": jnp.asarray([10.0, 20.0, 5.0, 5.0, 10.0, 7.0])})
+        f.create_or_replace_temp_view("sales")
+        return f
+
+    def test_select_distinct(self, session, sales):
+        out = execute("SELECT DISTINCT dept FROM sales", session.catalog)
+        assert sorted(r[0] for r in out.collect()) == ["a", "b", "c"]
+
+    def test_select_distinct_multi_col(self, session, sales):
+        out = execute("SELECT DISTINCT dept, amt FROM sales", session.catalog)
+        assert out.count() == 5  # (b, 5.0) dup collapses
+
+    def test_having_on_select_agg(self, session, sales):
+        out = execute("SELECT dept, SUM(amt) AS total FROM sales "
+                      "GROUP BY dept HAVING SUM(amt) > 15", session.catalog)
+        rows = dict(out.collect())
+        assert rows == {"a": 30.0, "b": 20.0}
+
+    def test_having_count_star(self, session, sales):
+        out = execute("SELECT dept FROM sales GROUP BY dept "
+                      "HAVING COUNT(*) >= 2", session.catalog)
+        assert sorted(r[0] for r in out.collect()) == ["a", "b"]
+
+    def test_having_without_group_by_rejected(self, session, sales):
+        with pytest.raises(ValueError, match="HAVING requires GROUP BY"):
+            execute("SELECT SUM(amt) FROM sales HAVING SUM(amt) > 0",
+                    session.catalog)
+
+    def test_union_all(self, session, sales):
+        out = execute("SELECT dept FROM sales WHERE amt > 15 "
+                      "UNION ALL SELECT dept FROM sales WHERE amt > 15",
+                      session.catalog)
+        assert [r[0] for r in out.collect()] == ["a", "a"]
+
+    def test_union_dedups(self, session, sales):
+        out = execute("SELECT dept FROM sales UNION SELECT dept FROM sales",
+                      session.catalog)
+        assert sorted(r[0] for r in out.collect()) == ["a", "b", "c"]
+
+    def test_not_in_null_semantics(self, session):
+        f = Frame({"x": jnp.asarray([1.0, float("nan"), 3.0]),
+                   "s": np.asarray(["a", None, "c"], object)})
+        f.create_or_replace_temp_view("nulls")
+        out = execute("SELECT x FROM nulls WHERE x NOT IN (1)", session.catalog)
+        assert [r[0] for r in out.collect()] == [3.0]  # NaN row drops
+        out = execute("SELECT s FROM nulls WHERE s NOT IN ('a')",
+                      session.catalog)
+        assert [r[0] for r in out.collect()] == ["c"]  # None row drops
