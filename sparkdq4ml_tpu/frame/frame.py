@@ -211,6 +211,73 @@ class Frame:
         f._mask = jnp.concatenate([self._mask, other._mask])
         return f
 
+    def sample(self, fraction: float, seed: int = 0,
+               with_replacement: bool = False) -> "Frame":
+        """Bernoulli row sample (mask-based — shapes stay static).
+        ``with_replacement`` is accepted for API parity but unsupported
+        (mask semantics cannot duplicate rows)."""
+        if with_replacement:
+            raise NotImplementedError(
+                "sampling with replacement is not supported by the "
+                "mask-based row model; use sample(fraction) without it")
+        if not 0.0 <= fraction <= 1.0:
+            raise ValueError(f"fraction must be in [0, 1], got {fraction}")
+        rng = np.random.default_rng(seed)
+        keep = jnp.asarray(rng.random(self.num_slots) < fraction)
+        return self._with(mask=jnp.logical_and(self._mask, keep))
+
+    def random_split(self, weights: Sequence[float],
+                     seed: int = 0) -> list["Frame"]:
+        """Split rows into disjoint frames with the given relative weights —
+        ``df.randomSplit([0.8, 0.2], seed)``, the MLlib train/test idiom.
+        Each split shares the column arrays; only the masks differ."""
+        w = np.asarray(weights, np.float64)
+        if w.ndim != 1 or len(w) < 1 or np.any(w < 0) or w.sum() == 0:
+            raise ValueError(f"invalid split weights {weights!r}")
+        edges = np.cumsum(w / w.sum())
+        rng = np.random.default_rng(seed)
+        u = rng.random(self.num_slots)
+        out = []
+        lo = 0.0
+        for hi in edges:
+            pick = jnp.asarray((u >= lo) & (u < hi))
+            out.append(self._with(mask=jnp.logical_and(self._mask, pick)))
+            lo = hi
+        return out
+
+    randomSplit = random_split
+
+    def cache(self) -> "Frame":
+        """No-op for API parity: columns are already materialized device
+        arrays (this engine is eager; there is no lazy plan to pin)."""
+        return self
+
+    persist = cache
+
+    def unpersist(self, blocking: bool = False) -> "Frame":
+        return self
+
+    def explain(self, extended: bool = False) -> None:
+        """Describe the physical representation (the eager-engine analogue
+        of Spark's plan dump): columns, dtypes, placement, mask stats."""
+        n_valid = self.count()
+        lines = ["== Physical Frame =="]
+        lines.append(f"row slots: {self.num_slots} (valid: {n_valid}, "
+                     f"masked: {self.num_slots - n_valid})")
+        for name in self.columns:
+            arr = self._data[name]
+            kind = ("host/object" if _is_string_col(arr)
+                    else f"device/{jnp.asarray(arr).dtype}")
+            lines.append(f"  {name}: {kind}")
+        if extended:
+            devs = {getattr(d, "platform", "?")
+                    for c in self._data.values() if hasattr(c, "devices")
+                    for d in c.devices()}
+            lines.append(f"devices: {sorted(devs) or ['host']}")
+            lines.append("execution: eager columnar; filters are validity-"
+                         "mask AND; XLA fuses expression chains under jit")
+        print("\n".join(lines))
+
     # -- actions -----------------------------------------------------------
     def count(self) -> int:
         """Number of valid (unmasked) rows."""

@@ -262,3 +262,62 @@ class TestDebugUtils:
                 jax.jit(lambda x: jnp.log(x))(jnp.asarray(-1.0)).block_until_ready()
         # restored afterwards
         jax.jit(lambda x: jnp.log(x))(jnp.asarray(-1.0)).block_until_ready()
+
+
+class TestSampleSplitCache:
+    def _frame(self):
+        import jax.numpy as jnp
+
+        from sparkdq4ml_tpu import Frame
+
+        return Frame({"x": jnp.arange(1000.0)})
+
+    def test_random_split_partitions_rows(self):
+        f = self._frame()
+        parts = f.random_split([0.7, 0.3], seed=42)
+        assert len(parts) == 2
+        n = [p.count() for p in parts]
+        assert sum(n) == 1000          # disjoint and exhaustive
+        assert 600 < n[0] < 800        # roughly 70/30
+        # disjointness: no row valid in both
+        import jax.numpy as jnp
+        assert not bool(jnp.any(jnp.logical_and(parts[0].mask, parts[1].mask)))
+
+    def test_random_split_normalizes_weights(self):
+        f = self._frame()
+        a, b = f.random_split([8, 2], seed=0)
+        assert a.count() + b.count() == 1000
+        assert a.count() > b.count()
+
+    def test_random_split_respects_existing_mask(self):
+        f = self._frame().filter(self._frame().col("x") < 100)
+        parts = f.random_split([0.5, 0.5], seed=1)
+        assert sum(p.count() for p in parts) == 100
+
+    def test_random_split_rejects_bad_weights(self):
+        import pytest
+
+        with pytest.raises(ValueError):
+            self._frame().random_split([0.5, -0.5])
+
+    def test_sample_fraction(self):
+        f = self._frame()
+        s = f.sample(0.25, seed=7)
+        assert 150 < s.count() < 350
+        import pytest
+
+        with pytest.raises(NotImplementedError):
+            f.sample(0.5, with_replacement=True)
+        with pytest.raises(ValueError):
+            f.sample(1.5)
+
+    def test_cache_and_explain(self, capsys):
+        f = self._frame()
+        assert f.cache() is f
+        assert f.persist() is f
+        assert f.unpersist() is f
+        f.explain(extended=True)
+        out = capsys.readouterr().out
+        assert "Physical Frame" in out
+        assert "row slots: 1000" in out
+        assert "x: device/" in out
