@@ -1,7 +1,16 @@
 """Estimator/Transformer/Model/Pipeline base classes (the MLlib ``ml``
 pipeline contracts that ``VectorAssembler`` and ``LinearRegression``
 implement — `DataQuality4MachineLearningApp.java:110-126` uses exactly the
-Transformer and Estimator halves)."""
+Transformer and Estimator halves), plus the generic stage-persistence layer
+(MLlib's MLWritable/MLReadable analogue; SURVEY.md §5 "Checkpoint / resume"
+— a capability upgrade over the reference, which never saves models).
+
+Persistence model: every stage class declares ``_persist_attrs`` (the
+attributes that fully determine it) and registers itself with
+``@persistable``; ``save_stage``/``load_stage`` write/read one JSON file per
+stage (numpy arrays embedded with a dtype tag). ``Pipeline`` and
+``PipelineModel`` save stages into numbered subdirectories.
+"""
 
 from __future__ import annotations
 
@@ -9,8 +18,109 @@ import json
 import os
 from typing import Sequence
 
+import numpy as np
 
-class Transformer:
+_STAGE_REGISTRY: dict[str, type] = {}
+
+
+def persistable(cls):
+    """Class decorator: register for name-based load_stage resolution."""
+    _STAGE_REGISTRY[cls.__name__] = cls
+    return cls
+
+
+def _to_jsonable(v):
+    if isinstance(v, np.ndarray):
+        dt = "object" if v.dtype == object else str(v.dtype)
+        return {"__ndarray__": v.tolist(), "dtype": dt}
+    if isinstance(v, (np.integer,)):
+        return int(v)
+    if isinstance(v, (np.floating,)):
+        return float(v)
+    if isinstance(v, dict):
+        return {k: _to_jsonable(x) for k, x in v.items()}
+    return v
+
+
+def _from_jsonable(v):
+    if isinstance(v, dict) and "__ndarray__" in v:
+        dt = v["dtype"]
+        return np.asarray(v["__ndarray__"],
+                          object if dt == "object" else np.dtype(dt))
+    if isinstance(v, dict):
+        return {k: _from_jsonable(x) for k, x in v.items()}
+    return v
+
+
+def save_stage(stage, path: str) -> None:
+    """Persist one stage (transformer/estimator/model) to ``path/``."""
+    if hasattr(stage, "_save_to_dir"):  # composite stages (Pipeline, ...)
+        stage._save_to_dir(path)
+        return
+    attrs = getattr(stage, "_persist_attrs", None)
+    if attrs is None:
+        raise TypeError(f"{type(stage).__name__} is not persistable "
+                        f"(no _persist_attrs)")
+    payload = {"class": type(stage).__name__,
+               "data": {k: _to_jsonable(getattr(stage, k)) for k in attrs}}
+    write_json(os.path.join(path, "stage.json"), payload)
+
+
+def load_stage(path: str):
+    """Load any persisted stage; dispatches on the recorded class name."""
+    meta_path = os.path.join(path, "stage.json")
+    if not os.path.exists(meta_path):  # composite stage directory
+        comp = read_json(os.path.join(path, "metadata.json"))
+        cls = _STAGE_REGISTRY.get(comp["class"])
+        if cls is None or not hasattr(cls, "_load_from_dir"):
+            raise ValueError(f"unknown composite stage {comp['class']!r}")
+        return cls._load_from_dir(path, comp)
+    meta = read_json(meta_path)
+    cls = _STAGE_REGISTRY.get(meta["class"])
+    if cls is None:
+        raise ValueError(f"unknown stage class {meta['class']!r}; known: "
+                         f"{sorted(_STAGE_REGISTRY)}")
+    obj = cls.__new__(cls)
+    for k, v in meta["data"].items():
+        setattr(obj, k, _from_jsonable(v))
+    post = getattr(obj, "_post_load", None)
+    if post is not None:
+        post()
+    return obj
+
+
+class _Persist:
+    """save()/load() surface shared by all stage kinds."""
+
+    def save(self, path: str) -> None:
+        save_stage(self, path)
+
+    def write(self):  # MLlib: model.write().overwrite().save(path)
+        return _Writer(self)
+
+    @classmethod
+    def load(cls, path: str):
+        obj = load_stage(path)
+        if not isinstance(obj, cls):
+            raise TypeError(f"{path} holds a {type(obj).__name__}, "
+                            f"not a {cls.__name__}")
+        return obj
+
+    read = load
+
+
+class _Writer:
+    def __init__(self, stage):
+        self._stage = stage
+
+    def overwrite(self) -> "_Writer":
+        return self
+
+    def save(self, path: str) -> None:
+        save_stage(self._stage, path)
+
+
+class Transformer(_Persist):
     def transform(self, frame):
         raise NotImplementedError
 
@@ -18,7 +128,7 @@ class Transformer:
         return self.transform(frame)
 
 
-class Estimator:
+class Estimator(_Persist):
     def fit(self, frame):
         raise NotImplementedError
 
@@ -27,12 +137,26 @@ class Model(Transformer):
     pass
 
 
+@persistable
 class Pipeline(Estimator):
     """Chain of stages; each Estimator stage is fit on the running frame and
     replaced by its Model."""
 
     def __init__(self, stages: Sequence = ()):
         self._stages = list(stages)
+
+    def _save_to_dir(self, path: str) -> None:
+        write_json(os.path.join(path, "metadata.json"),
+                   {"class": type(self).__name__,
+                    "n_stages": len(self._stages)})
+        for i, st in enumerate(self._stages):
+            save_stage(st, os.path.join(path, f"stage_{i:02d}"))
+
+    @classmethod
+    def _load_from_dir(cls, path: str, meta: dict):
+        stages = [load_stage(os.path.join(path, f"stage_{i:02d}"))
+                  for i in range(meta["n_stages"])]
+        return cls(stages)
 
     def set_stages(self, stages: Sequence) -> "Pipeline":
         self._stages = list(stages)
@@ -59,6 +183,7 @@ class Pipeline(Estimator):
         return PipelineModel(fitted)
 
 
+@persistable
 class PipelineModel(Model):
     def __init__(self, stages: Sequence):
         self.stages = list(stages)
@@ -68,6 +193,18 @@ class PipelineModel(Model):
         for stage in self.stages:
             cur = stage.transform(cur)
         return cur
+
+    def _save_to_dir(self, path: str) -> None:
+        write_json(os.path.join(path, "metadata.json"),
+                   {"class": type(self).__name__,
+                    "n_stages": len(self.stages)})
+        for i, st in enumerate(self.stages):
+            save_stage(st, os.path.join(path, f"stage_{i:02d}"))
+
+    @classmethod
+    def _load_from_dir(cls, path: str, meta: dict):
+        return cls([load_stage(os.path.join(path, f"stage_{i:02d}"))
+                    for i in range(meta["n_stages"])])
 
 
 def write_json(path: str, obj) -> None:

@@ -37,7 +37,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from ..config import float_dtype
 from ..frame.frame import Frame
 from ..parallel.mesh import DATA_AXIS
-from .base import Estimator, Model, read_json, write_json
+from .base import Estimator, Model, persistable, read_json, write_json
 from .regression import _extract_xy
 from .solvers import _soft
 
@@ -212,8 +212,14 @@ def fused_logistic_fit_packed(mesh: Optional[Mesh], max_iter: int, tol: float,
     return jax.jit(fit)
 
 
+@persistable
 class LogisticRegression(Estimator):
     """Binary logistic regression with elastic-net regularization."""
+
+    _persist_attrs = ("max_iter", "reg_param", "elastic_net_param", "tol",
+                      "fit_intercept", "standardization", "threshold",
+                      "family", "features_col", "label_col", "prediction_col",
+                      "probability_col", "raw_prediction_col")
 
     def __init__(self, max_iter: int = 100, reg_param: float = 0.0,
                  elastic_net_param: float = 0.0, tol: float = 1e-6,
@@ -304,6 +310,7 @@ class LogisticRegression(Estimator):
         return model
 
 
+@persistable
 class LogisticRegressionModel(Model):
     def __init__(self, coefficients: np.ndarray, intercept: float,
                  params: Optional[dict] = None):
@@ -386,6 +393,14 @@ class LogisticRegressionModel(Model):
             raise ValueError(f"not a LogisticRegressionModel checkpoint: {path}")
         return cls(np.load(os.path.join(path, "coefficients.npy")),
                    meta["intercept"], meta.get("params"))
+
+    # Pipeline-persistence hooks (base.save_stage/load_stage dispatch here).
+    def _save_to_dir(self, path: str) -> None:
+        self.save(path)
+
+    @classmethod
+    def _load_from_dir(cls, path: str, meta: dict):
+        return cls.load(path)
 
 
 class BinaryLogisticRegressionSummary:

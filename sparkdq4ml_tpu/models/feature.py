@@ -24,10 +24,12 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..config import float_dtype, int_dtype
-from .base import Estimator, Model, Transformer
+from .base import Estimator, Model, Transformer, persistable
 
 
+@persistable
 class VectorAssembler(Transformer):
+    _persist_attrs = ('input_cols', 'output_col')
     def __init__(self, input_cols: Optional[Sequence[str]] = None,
                  output_col: str = "features"):
         self.input_cols = list(input_cols) if input_cols else []
@@ -66,6 +68,7 @@ class VectorAssembler(Transformer):
         return frame.with_column(self.output_col, jnp.concatenate(parts, axis=1))
 
 
+@persistable
 class StringIndexer(Estimator):
     """MLlib ``StringIndexer``: map string categories to double indices,
     most-frequent-first (``frequencyDesc``; ties broken alphabetically, as
@@ -75,6 +78,8 @@ class StringIndexer(Estimator):
     The index *fit* is host-side (categories are host strings); the
     transformed column is a device array ready for VectorAssembler.
     """
+
+    _persist_attrs = ('input_col', 'output_col', 'handle_invalid')
 
     def __init__(self, input_col: str = None, output_col: str = None,
                  handle_invalid: str = "error"):
@@ -115,12 +120,19 @@ class StringIndexer(Estimator):
                                   self.handle_invalid)
 
 
+@persistable
 class StringIndexerModel(Model):
+    _persist_attrs = ('labels', 'input_col', 'output_col', 'handle_invalid')
+
     def __init__(self, labels, input_col, output_col, handle_invalid="error"):
         self.labels = list(labels)
         self.input_col = input_col
         self.output_col = output_col
         self.handle_invalid = handle_invalid
+        self._index = {l: i for i, l in enumerate(self.labels)}
+
+    def _post_load(self):
+        self.labels = list(self.labels)
         self._index = {l: i for i, l in enumerate(self.labels)}
 
     labelsArray = property(lambda self: [list(self.labels)])
@@ -148,8 +160,11 @@ class StringIndexerModel(Model):
         return out
 
 
+@persistable
 class IndexToString(Transformer):
     """Inverse of StringIndexer: indices → label strings (host column)."""
+
+    _persist_attrs = ('input_col', 'output_col', 'labels')
 
     def __init__(self, input_col: str = None, output_col: str = None,
                  labels=None):
@@ -165,6 +180,7 @@ class IndexToString(Transformer):
         return frame.with_column(self.output_col, out)
 
 
+@persistable
 class OneHotEncoder(Estimator):
     """MLlib ``OneHotEncoder``: index column → one-hot vector column.
 
@@ -172,6 +188,8 @@ class OneHotEncoder(Estimator):
     encoding stays linearly independent with an intercept. The encode is a
     device comparison against an iota — one fused op, no host loop.
     """
+
+    _persist_attrs = ('input_col', 'output_col', 'drop_last')
 
     def __init__(self, input_col: str = None, output_col: str = None,
                  drop_last: bool = True):
@@ -193,7 +211,9 @@ class OneHotEncoder(Estimator):
                                   self.drop_last)
 
 
+@persistable
 class OneHotEncoderModel(Model):
+    _persist_attrs = ('category_size', 'input_col', 'output_col', 'drop_last')
     def __init__(self, category_size, input_col, output_col, drop_last=True):
         self.category_size = int(category_size)
         self.input_col = input_col
@@ -210,11 +230,14 @@ class OneHotEncoderModel(Model):
         return frame.with_column(self.output_col, onehot)
 
 
+@persistable
 class Bucketizer(Transformer):
     """MLlib ``Bucketizer``: continuous column → bucket index by split
     points (``splits`` of length b+1, monotonic; use ±inf for open ends).
     One device ``searchsorted``; values outside the splits raise unless
     ``handle_invalid='keep'`` (→ NaN) or ``'skip'`` (→ masked)."""
+
+    _persist_attrs = ('splits', 'input_col', 'output_col', 'handle_invalid')
 
     def __init__(self, splits=None, input_col: str = None,
                  output_col: str = None, handle_invalid: str = "error"):
@@ -300,9 +323,12 @@ def _masked_min_max(X, w):
     return lo, hi
 
 
+@persistable
 class StandardScaler(_ScalerBase):
     """MLlib ``StandardScaler``: defaults ``with_mean=False, with_std=True``;
     sample (n−1) std; zero-variance features scale to 0.0."""
+
+    _persist_attrs = ('input_col', 'output_col', 'with_mean', 'with_std')
 
     def __init__(self, input_col: str = "features",
                  output_col: str = "scaled_features",
@@ -331,7 +357,9 @@ class StandardScaler(_ScalerBase):
                                    self.input_col, self.output_col)
 
 
+@persistable
 class StandardScalerModel(Model):
+    _persist_attrs = ('mean', 'std', 'with_mean', 'with_std', 'input_col', 'output_col')
     def __init__(self, mean, std, with_mean, with_std, input_col, output_col):
         self.mean = np.asarray(mean)
         self.std = np.asarray(std)
@@ -356,9 +384,12 @@ class StandardScalerModel(Model):
                                  X[:, 0] if squeeze else X)
 
 
+@persistable
 class MinMaxScaler(_ScalerBase):
     """MLlib ``MinMaxScaler``: rescale to [min, max] per feature; constant
     features map to ``(min+max)/2``."""
+
+    _persist_attrs = ('input_col', 'output_col', 'min', 'max')
 
     def __init__(self, input_col: str = "features",
                  output_col: str = "scaled_features",
@@ -387,7 +418,9 @@ class MinMaxScaler(_ScalerBase):
                                  self.input_col, self.output_col)
 
 
+@persistable
 class MinMaxScalerModel(Model):
+    _persist_attrs = ('original_min', 'original_max', 'min', 'max', 'input_col', 'output_col')
     def __init__(self, original_min, original_max, min, max,
                  input_col, output_col):
         self.original_min = np.asarray(original_min)
@@ -417,9 +450,12 @@ class MinMaxScalerModel(Model):
                                  scaled[:, 0] if squeeze else scaled)
 
 
+@persistable
 class MaxAbsScaler(_ScalerBase):
     """MLlib ``MaxAbsScaler``: divide by per-feature max |x| (sparsity
     preserving); all-zero features stay 0."""
+
+    _persist_attrs = ('input_col', 'output_col')
 
     def fit(self, frame) -> "MaxAbsScalerModel":
         X, w = self._masked_feature_matrix(frame)
@@ -428,7 +464,9 @@ class MaxAbsScaler(_ScalerBase):
         return MaxAbsScalerModel(max_abs, self.input_col, self.output_col)
 
 
+@persistable
 class MaxAbsScalerModel(Model):
+    _persist_attrs = ('max_abs', 'input_col', 'output_col')
     def __init__(self, max_abs, input_col, output_col):
         self.max_abs = np.asarray(max_abs)
         self.input_col = input_col

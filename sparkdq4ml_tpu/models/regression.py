@@ -23,7 +23,7 @@ import numpy as np
 from ..config import float_dtype
 from ..frame.frame import Frame
 from ..ops.expressions import col
-from .base import Estimator, Model, read_json, write_json
+from .base import Estimator, Model, persistable, read_json, write_json
 from .solvers import FitResult, resolve_solver
 
 
@@ -35,8 +35,14 @@ def _extract_xy(frame: Frame, features_col: str, label_col: str):
     return X, y, frame.mask
 
 
+@persistable
 class LinearRegression(Estimator):
     """Elastic-net linear regression, MLlib numeric convention."""
+
+    _persist_attrs = ("max_iter", "reg_param", "elastic_net_param", "tol",
+                      "fit_intercept", "standardization", "solver",
+                      "features_col", "label_col", "prediction_col",
+                      "aggregation_depth")
 
     def __init__(self, max_iter: int = 100, reg_param: float = 0.0,
                  elastic_net_param: float = 0.0, tol: float = 1e-6,
@@ -184,6 +190,7 @@ class LinearRegression(Estimator):
         return model
 
 
+@persistable
 class LinearRegressionModel(Model):
     def __init__(self, coefficients: np.ndarray, intercept: float,
                  params: Optional[dict] = None):
@@ -274,6 +281,14 @@ class LinearRegressionModel(Model):
             raise ValueError(f"not a LinearRegressionModel checkpoint: {path}")
         coef = np.load(os.path.join(path, "coefficients.npy"))
         return cls(coef, meta["intercept"], meta.get("params"))
+
+    # Pipeline-persistence hooks (base.save_stage/load_stage dispatch here).
+    def _save_to_dir(self, path: str) -> None:
+        self.save(path)
+
+    @classmethod
+    def _load_from_dir(cls, path: str, meta: dict):
+        return cls.load(path)
 
 
 class LinearRegressionSummary:

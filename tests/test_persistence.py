@@ -1,0 +1,122 @@
+"""Stage/pipeline persistence (models/base.py save_stage/load_stage) —
+the MLlib MLWritable/MLReadable analogue (SURVEY.md §5 "Checkpoint/resume")."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sparkdq4ml_tpu import Frame
+from sparkdq4ml_tpu.models import (Bucketizer, LinearRegression,
+                                   LogisticRegression, OneHotEncoder,
+                                   Pipeline, PipelineModel, StandardScaler,
+                                   StringIndexer, VectorAssembler)
+from sparkdq4ml_tpu.models.base import load_stage, save_stage
+
+
+def _frame():
+    return Frame({
+        "city": np.asarray(["nyc", "sf", "nyc", "la", "sf", "nyc"], object),
+        "guest": jnp.asarray([10.0, 20.0, 15.0, 30.0, 25.0, 12.0]),
+        "label": jnp.asarray([70.0, 120.0, 95.0, 170.0, 145.0, 80.0]),
+    })
+
+
+class TestSimpleStageRoundTrip:
+    def test_vector_assembler(self, tmp_path):
+        va = VectorAssembler(["guest"], "features")
+        va.save(str(tmp_path / "va"))
+        back = VectorAssembler.load(str(tmp_path / "va"))
+        assert back.input_cols == ["guest"]
+        assert back.output_col == "features"
+
+    def test_bucketizer(self, tmp_path):
+        b = Bucketizer([0.0, 15.0, 25.0, 100.0], "guest", "bucket",
+                       handle_invalid="keep")
+        b.save(str(tmp_path / "b"))
+        back = Bucketizer.load(str(tmp_path / "b"))
+        f = _frame()
+        np.testing.assert_array_equal(
+            back.transform(f).to_pydict()["bucket"],
+            b.transform(f).to_pydict()["bucket"])
+
+    def test_scaler_model_arrays_roundtrip(self, tmp_path):
+        f = VectorAssembler(["guest"], "features").transform(_frame())
+        m = StandardScaler(with_mean=True).set_input_col("features").fit(f)
+        m.save(str(tmp_path / "sc"))
+        back = load_stage(str(tmp_path / "sc"))
+        np.testing.assert_allclose(back.mean, m.mean)
+        np.testing.assert_allclose(back.std, m.std)
+        np.testing.assert_allclose(
+            np.asarray(back.transform(f)._column_values("scaled_features")),
+            np.asarray(m.transform(f)._column_values("scaled_features")))
+
+    def test_string_indexer_model_rebuilds_index(self, tmp_path):
+        m = StringIndexer("city", "city_idx").fit(_frame())
+        m.save(str(tmp_path / "si"))
+        back = load_stage(str(tmp_path / "si"))
+        assert back.labels == m.labels
+        assert back._index == m._index
+        np.testing.assert_array_equal(
+            back.transform(_frame()).to_pydict()["city_idx"],
+            m.transform(_frame()).to_pydict()["city_idx"])
+
+    def test_estimator_roundtrip(self, tmp_path):
+        lr = LinearRegression(max_iter=17, reg_param=0.3,
+                              elastic_net_param=0.7)
+        lr.save(str(tmp_path / "lr"))
+        back = LinearRegression.load(str(tmp_path / "lr"))
+        assert back.max_iter == 17
+        assert back.reg_param == 0.3
+        assert back.elastic_net_param == 0.7
+
+    def test_load_type_mismatch_rejected(self, tmp_path):
+        VectorAssembler(["guest"]).save(str(tmp_path / "va"))
+        with pytest.raises(TypeError, match="not a Bucketizer"):
+            Bucketizer.load(str(tmp_path / "va"))
+
+    def test_writer_surface(self, tmp_path):
+        va = VectorAssembler(["guest"], "f")
+        va.write().overwrite().save(str(tmp_path / "w"))
+        assert VectorAssembler.load(str(tmp_path / "w")).output_col == "f"
+
+
+class TestPipelinePersistence:
+    def _pipeline(self):
+        return Pipeline([
+            StringIndexer("city", "city_idx"),
+            OneHotEncoder("city_idx", "city_vec"),
+            VectorAssembler(["guest", "city_vec"], "features"),
+            LinearRegression(max_iter=30),
+        ])
+
+    def test_unfitted_pipeline_roundtrip(self, tmp_path):
+        p = self._pipeline()
+        p.save(str(tmp_path / "p"))
+        back = Pipeline.load(str(tmp_path / "p"))
+        kinds = [type(s).__name__ for s in back.get_stages()]
+        assert kinds == ["StringIndexer", "OneHotEncoder", "VectorAssembler",
+                         "LinearRegression"]
+        assert back.get_stages()[3].max_iter == 30
+
+    def test_fitted_pipeline_model_roundtrip(self, tmp_path):
+        f = _frame()
+        pm = self._pipeline().fit(f)
+        pred = pm.transform(f).to_pydict()["prediction"]
+        pm.save(str(tmp_path / "pm"))
+        back = PipelineModel.load(str(tmp_path / "pm"))
+        kinds = [type(s).__name__ for s in back.stages]
+        assert kinds == ["StringIndexerModel", "OneHotEncoderModel",
+                         "VectorAssembler", "LinearRegressionModel"]
+        np.testing.assert_allclose(
+            back.transform(f).to_pydict()["prediction"], pred, rtol=1e-6)
+
+    def test_logistic_model_in_pipeline(self, tmp_path):
+        f = _frame().with_column(
+            "label", jnp.asarray([0.0, 1.0, 0.0, 1.0, 1.0, 0.0]))
+        pm = Pipeline([VectorAssembler(["guest"], "features"),
+                       LogisticRegression(max_iter=25)]).fit(f)
+        pm.save(str(tmp_path / "pm"))
+        back = PipelineModel.load(str(tmp_path / "pm"))
+        np.testing.assert_allclose(
+            back.transform(f).to_pydict()["prediction"],
+            pm.transform(f).to_pydict()["prediction"])
