@@ -34,9 +34,9 @@ def parse_master(master: Optional[str]) -> Optional[int]:
     if master is None:
         return None
     m = master.strip().lower()
-    if m in ("local", "local[*]", "tpu", "tpu[*]", "*"):
+    if m in ("local", "local[*]", "tpu", "tpu[*]", "*", "pod", "pod[*]"):
         return None
-    match = re.fullmatch(r"(?:local|tpu)\[(\d+)\]", m)
+    match = re.fullmatch(r"(?:local|tpu|pod)\[(\d+)\]", m)
     if match:
         return int(match.group(1))
     raise ValueError(f"unsupported master string {master!r}")

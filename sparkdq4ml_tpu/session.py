@@ -37,6 +37,7 @@ class TpuSession:
         self.app_name = app_name
         self.master = master
         self.conf: dict[str, str] = dict(conf or {})
+        self._init_distributed()
         n = parse_master(master)
         self.mesh = make_mesh(n)
         self.catalog: Catalog = default_catalog()
@@ -46,6 +47,43 @@ class TpuSession:
         self._init_compilation_cache()
         logger.debug("session %r: %d device(s), platform=%s", app_name,
                      self.num_devices, jax.devices()[0].platform)
+
+    def _init_distributed(self) -> None:
+        """Multi-host runtime init — the cluster-master analogue of Spark's
+        ``master("spark://host:port")``. After ``jax.distributed.initialize``
+        the session mesh spans every host's devices and the fit-path psum
+        rides ICI within a slice / DCN across slices (parallel/mesh.py).
+
+        Triggered by ``master("pod")`` (TPU pod auto-bootstrap: coordinator
+        and process ranks come from the TPU metadata/env) or explicitly:
+
+            .master("pod")
+            .config("spark.distributed.coordinator", "host:1234")
+            .config("spark.distributed.numProcesses", 4)
+            .config("spark.distributed.processId", 0)
+
+        Idempotent: a no-op when the distributed client already exists.
+        """
+        coord = self.conf.get("spark.distributed.coordinator")
+        is_pod = (self.master or "").strip().lower() in ("pod", "pod[*]")
+        if not (is_pod or coord):
+            return
+        try:
+            from jax._src import distributed as _dist
+
+            if getattr(_dist.global_state, "client", None) is not None:
+                return  # already initialized (e.g. a prior session)
+        except Exception:
+            pass
+        kwargs = {}
+        if coord:
+            kwargs["coordinator_address"] = coord
+        if "spark.distributed.numProcesses" in self.conf:
+            kwargs["num_processes"] = int(
+                self.conf["spark.distributed.numProcesses"])
+        if "spark.distributed.processId" in self.conf:
+            kwargs["process_id"] = int(self.conf["spark.distributed.processId"])
+        jax.distributed.initialize(**kwargs)
 
     def _init_compilation_cache(self) -> None:
         """Enable XLA's persistent compilation cache (the TPU analogue of a

@@ -67,3 +67,49 @@ def test_cache_reconfigured_on_get_or_create(tmp_path):
         assert os.path.isdir(second)
     finally:
         s.stop()
+
+
+class TestDistributedInit:
+    """Multi-host bootstrap wiring (session._init_distributed). The real
+    jax.distributed.initialize needs a pod; assert the dispatch logic."""
+
+    def test_local_master_does_not_initialize(self, monkeypatch):
+        calls = []
+        monkeypatch.setattr(jax.distributed, "initialize",
+                            lambda **kw: calls.append(kw))
+        s = TpuSession.builder().master("local[*]").get_or_create()
+        try:
+            assert calls == []
+        finally:
+            s.stop()
+
+    def test_pod_master_initializes(self, monkeypatch):
+        calls = []
+        monkeypatch.setattr(jax.distributed, "initialize",
+                            lambda **kw: calls.append(kw))
+        # force the "not yet initialized" branch
+        from jax._src import distributed as dist
+        monkeypatch.setattr(dist.global_state, "client", None,
+                            raising=False)
+        s = TpuSession.builder().master("pod").get_or_create()
+        try:
+            assert calls == [{}]  # pod auto-bootstrap: env-derived
+        finally:
+            s.stop()
+
+    def test_explicit_coordinator_conf(self, monkeypatch):
+        calls = []
+        monkeypatch.setattr(jax.distributed, "initialize",
+                            lambda **kw: calls.append(kw))
+        from jax._src import distributed as dist
+        monkeypatch.setattr(dist.global_state, "client", None,
+                            raising=False)
+        s = (TpuSession.builder().master("local[*]")
+             .config("spark.distributed.coordinator", "10.0.0.1:8476")
+             .config("spark.distributed.numProcesses", 4)
+             .config("spark.distributed.processId", 2).get_or_create())
+        try:
+            assert calls == [{"coordinator_address": "10.0.0.1:8476",
+                              "num_processes": 4, "process_id": 2}]
+        finally:
+            s.stop()
