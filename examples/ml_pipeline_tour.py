@@ -1,0 +1,92 @@
+"""Tour of the wider ML surface on the reference's own data: DQ pipeline →
+train/test split → Pipeline(assembler → Lasso) → persistence round-trip →
+cross-validated grid search → logistic classifier on a derived label.
+
+Run: python examples/ml_pipeline_tour.py [csv_path]
+(defaults to data/dataset-full.csv; golden numbers in SURVEY.md §2.3)
+"""
+
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import sparkdq4ml_tpu as dq
+from sparkdq4ml_tpu.models import (BinaryClassificationEvaluator,
+                                   CrossValidator, LinearRegression,
+                                   LogisticRegression, ParamGridBuilder,
+                                   Pipeline, PipelineModel,
+                                   RegressionEvaluator, VectorAssembler)
+
+
+def main() -> None:
+    path = sys.argv[1] if len(sys.argv) > 1 else os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "data", "dataset-full.csv")
+
+    session = (dq.TpuSession.builder().app_name("ml-tour")
+               .master("local[*]").get_or_create())
+    dq.register_builtin_rules()
+
+    # --- DQ phase (the reference's cleanup chain, SURVEY.md §3.2) ----------
+    df = (session.read.format("csv").option("inferSchema", "true")
+          .option("header", "false").load(path))
+    df = (df.with_column_renamed("_c0", "guest")
+            .with_column_renamed("_c1", "price"))
+    df = df.with_column("price_no_min",
+                        dq.call_udf("minimumPriceRule", dq.col("price")))
+    df.create_or_replace_temp_view("price")
+    df = session.sql("SELECT cast(guest as int) guest, price_no_min AS price "
+                     "FROM price WHERE price_no_min > 0")
+    df = df.with_column(
+        "price_correct_correl",
+        dq.call_udf("priceCorrelationRule", dq.col("price"), dq.col("guest")))
+    df.create_or_replace_temp_view("price")
+    df = session.sql("SELECT guest, price_correct_correl AS price "
+                     "FROM price WHERE price_correct_correl > 0")
+    df = df.with_column("label", df.col("price"))
+    print(f"DQ-clean rows: {df.count()}")
+
+    # --- train/test split + Pipeline fit -----------------------------------
+    train, test = df.random_split([0.8, 0.2], seed=7)
+    pipe = Pipeline([
+        VectorAssembler(["guest"], "features"),
+        LinearRegression(max_iter=40, reg_param=1.0, elastic_net_param=1.0),
+    ])
+    model = pipe.fit(train)
+    rmse = RegressionEvaluator(metric_name="rmse").evaluate(
+        model.transform(test))
+    print(f"held-out RMSE (train {train.count()} / test {test.count()}): "
+          f"{rmse:.4f}")
+
+    # --- persistence round-trip --------------------------------------------
+    with tempfile.TemporaryDirectory() as tmp:
+        ckpt = os.path.join(tmp, "pipeline_model")
+        model.save(ckpt)
+        restored = PipelineModel.load(ckpt)
+        r2 = RegressionEvaluator(metric_name="r2").evaluate(
+            restored.transform(test))
+        print(f"restored model r2 on test: {r2:.4f}")
+
+    # --- cross-validated grid over (regParam x elasticNetParam) ------------
+    grid = (ParamGridBuilder()
+            .add_grid("reg_param", [0.01, 0.1, 1.0])
+            .add_grid("elastic_net_param", [0.0, 0.5, 1.0]).build())
+    fdf = VectorAssembler(["guest"], "features").transform(df)
+    cv = CrossValidator(LinearRegression(max_iter=40), grid,
+                        RegressionEvaluator(metric_name="rmse"), num_folds=3)
+    cv_model = cv.fit(fdf)
+    best = cv_model.best_index
+    print(f"CV best params: {grid[best]}  avg RMSE {cv_model.avg_metrics[best]:.4f}")
+
+    # --- logistic classifier: is this a "large party" booking? -------------
+    ldf = fdf.with_column("label", (fdf.col("guest") > 25).cast("double"))
+    lmodel = LogisticRegression(max_iter=50, reg_param=0.01).fit(ldf)
+    auc = BinaryClassificationEvaluator().evaluate(lmodel.transform(ldf))
+    print(f"large-party classifier AUC: {auc:.4f} "
+          f"(iterations: {lmodel.summary.total_iterations})")
+
+
+if __name__ == "__main__":
+    main()
