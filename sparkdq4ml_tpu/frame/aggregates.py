@@ -49,6 +49,16 @@ class AggExpr:
     def __repr__(self):
         return self.name
 
+    def over(self, spec) -> "Expr":
+        """Bind as a window aggregate: ``F.sum("x").over(Window...)``.
+        stddev/variance have no windowed form here (as in Spark ≤2.x SQL)."""
+        from .window import window_agg
+
+        if self.fn in ("stddev", "variance"):
+            raise ValueError(f"windowed {self.fn}() is not supported")
+        expr = window_agg(self.fn, self.column).over(spec)
+        return expr.alias(self._alias) if self._alias else expr
+
 
 # functions-module-style constructors (org.apache.spark.sql.functions)
 def count(col: Optional[str] = None) -> AggExpr:

@@ -1,0 +1,441 @@
+"""Window functions: ``Window.partitionBy(...).orderBy(...)`` + ranking /
+offset / windowed-aggregate expressions, mirroring Spark's
+``pyspark.sql.Window`` and ``F.row_number().over(w)`` surface (a capability
+upgrade over the reference app, which exercises no window functions —
+SURVEY.md §2.2; provided so groupBy/sort/SQL users find the full relational
+toolkit).
+
+Design, consistent with the engine's host-boundary rule (frame.py: sort/join/
+groupBy plan on host, numeric data stays in device arrays): the window *plan*
+(partitioning + intra-partition order) is computed host-side with lexsort —
+order-dependent by nature, like ``Frame.sort`` — then each function is
+evaluated vectorized per partition and scattered back to the frame's original
+row slots, so the result is an ordinary aligned column and masked rows stay
+masked. Numeric results return as device arrays.
+
+Frame semantics for ordered windows follow Spark's default frame
+``RANGE BETWEEN UNBOUNDED PRECEDING AND CURRENT ROW``: running aggregates
+include all *peer* rows (ties in the order key). Unordered windows aggregate
+the whole partition.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Union
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import float_dtype, int_dtype
+from ..ops.expressions import Col, Expr
+
+_RANKING_FNS = ("row_number", "rank", "dense_rank", "percent_rank",
+                "cume_dist", "ntile")
+_OFFSET_FNS = ("lag", "lead")
+_AGG_FNS = ("count", "sum", "avg", "mean", "min", "max")
+
+
+class WindowSpec:
+    """Immutable partition/order specification."""
+
+    def __init__(self, partition_cols: Sequence[str] = (),
+                 order_cols: Sequence[tuple[str, bool]] = ()):
+        self.partition_cols = tuple(partition_cols)
+        self.order_cols = tuple(order_cols)
+
+    def partition_by(self, *cols: str) -> "WindowSpec":
+        return WindowSpec(self.partition_cols + tuple(_colname(c) for c in cols),
+                          self.order_cols)
+
+    partitionBy = partition_by
+
+    def order_by(self, *cols) -> "WindowSpec":
+        return WindowSpec(self.partition_cols,
+                          self.order_cols + tuple(_order_item(c) for c in cols))
+
+    orderBy = order_by
+
+    def describe(self) -> str:
+        parts = []
+        if self.partition_cols:
+            parts.append("PARTITION BY " + ", ".join(self.partition_cols))
+        if self.order_cols:
+            parts.append("ORDER BY " + ", ".join(
+                f"{c}{'' if asc else ' DESC'}" for c, asc in self.order_cols))
+        return " ".join(parts)
+
+    def __repr__(self):
+        return f"WindowSpec({self.describe()})"
+
+
+def _key_parts(k: np.ndarray) -> list[np.ndarray]:
+    """Decompose one sort/group key into lexsort component arrays, highest
+    priority first. Object (string) keys become (not-null flag, value with
+    None→"") so nulls form their own group — distinct from the empty string —
+    and sort first (Spark's NULLS FIRST); bool keys cast to int8 (numpy
+    forbids unary minus on bool, needed for DESC)."""
+    if k.dtype == object:
+        flag = np.asarray([x is not None for x in k], np.int8)
+        vals = np.asarray([x if x is not None else "" for x in k],
+                          dtype=object)
+        return [flag, vals]
+    if k.dtype == np.bool_:
+        return [k.astype(np.int8)]
+    if np.issubdtype(k.dtype, np.floating):
+        # NaN = SQL NULL: the not-null flag makes NaN keys sort first
+        # ascending (NULLS FIRST) and, negated for DESC, last (NULLS LAST)
+        return [(~np.isnan(k)).astype(np.int8), k]
+    return [k]
+
+
+def _neq(ks: np.ndarray) -> np.ndarray:
+    """Adjacent-row "value changed" flags for a sorted key component, with
+    SQL NULL grouping: NaN equals NaN (nulls form one group, as Spark's
+    windows treat them)."""
+    if ks.dtype == object:
+        return np.asarray([ks[i] != ks[i - 1] for i in range(1, len(ks))],
+                          bool)
+    neq = ks[1:] != ks[:-1]
+    if np.issubdtype(ks.dtype, np.floating):
+        neq &= ~(np.isnan(ks[1:]) & np.isnan(ks[:-1]))
+    return neq
+
+
+def _peer_upto(peer: np.ndarray, s: int, e: int) -> np.ndarray:
+    """For each sorted row in partition [s, e), the count of partition rows
+    up to and including its last peer (ties in the order key) — the row set
+    of the default RANGE ...CURRENT ROW frame."""
+    pk = peer[s:e].copy()
+    pk[0] = True
+    block_id = np.cumsum(pk) - 1
+    block_end = np.r_[np.flatnonzero(pk)[1:], e - s]
+    return block_end[block_id]
+
+
+def _colname(c) -> str:
+    if isinstance(c, str):
+        return c
+    if isinstance(c, Col):
+        return c.name
+    raise TypeError(f"window partition key must be a column name, got {c!r}")
+
+
+def _order_item(c) -> tuple[str, bool]:
+    """Accept "name", ("name", ascending), or a Col."""
+    if isinstance(c, tuple) and len(c) == 2:
+        return (_colname(c[0]), bool(c[1]))
+    return (_colname(c), True)
+
+
+class Window:
+    """Entry points, Spark-style: ``Window.partitionBy("k").orderBy("v")``."""
+
+    @staticmethod
+    def partition_by(*cols: str) -> WindowSpec:
+        return WindowSpec().partition_by(*cols)
+
+    partitionBy = partition_by
+
+    @staticmethod
+    def order_by(*cols) -> WindowSpec:
+        return WindowSpec().order_by(*cols)
+
+    orderBy = order_by
+
+
+class WindowFunction:
+    """An unbound window function (``row_number()``); ``.over(spec)`` binds it.
+
+    Spark raises at analysis time when a ranking function is used without an
+    OVER clause; evaluating an unbound WindowFunction raises equivalently.
+    """
+
+    def __init__(self, fn: str, column: Optional[str] = None,
+                 offset: int = 1, default=None, n: Optional[int] = None):
+        self.fn = fn
+        self.column = column
+        self.offset = offset
+        self.default = default
+        self.n = n
+
+    def over(self, spec: WindowSpec) -> "WindowExpr":
+        return WindowExpr(self, spec)
+
+    def __repr__(self):
+        return f"{self.fn}({self.column or ''})"
+
+
+class WindowExpr(Expr):
+    """A window function bound to a WindowSpec — a regular column Expr, usable
+    in ``withColumn``/``select`` and produced by SQL ``fn(...) OVER (...)``."""
+
+    def __init__(self, func: WindowFunction, spec: WindowSpec):
+        if func.fn in _RANKING_FNS + _OFFSET_FNS and not spec.order_cols:
+            raise ValueError(f"{func.fn}() requires an ORDER BY in its window")
+        self.func = func
+        self.spec = spec
+
+    @property
+    def name(self) -> str:
+        # Descriptive like Spark's generated names, so two different window
+        # expressions in one select never collide in the output columns.
+        return f"{self.func!r} OVER ({self.spec.describe()})"
+
+    def __str__(self):
+        return self.name
+
+    # -- evaluation --------------------------------------------------------
+    def eval(self, frame):
+        func, spec = self.func, self.spec
+        m = np.asarray(frame.mask)
+        idx = np.flatnonzero(m)                      # valid slots only
+        nv = len(idx)
+
+        def host(name):
+            arr = frame._column_values(name)
+            a = arr if (isinstance(arr, np.ndarray) and arr.dtype == object) \
+                else np.asarray(arr)
+            return a[idx]
+
+        # -- plan: lexsort by (partition keys, then order keys) ------------
+        pkeys = [_key_parts(host(c)) for c in spec.partition_cols]
+        okeys = []
+        for cname, asc in spec.order_cols:
+            parts = _key_parts(host(cname))
+            if not asc:
+                if parts[-1].dtype == object:
+                    raise ValueError("descending window order on string "
+                                     "columns is not supported")
+                parts = [-p for p in parts]
+            okeys.append(parts)
+        # np.lexsort: primary key LAST → flatten in reverse priority order
+        # (order keys before partitions, secondary components before primary)
+        lex = [comp for parts in reversed(pkeys + okeys)
+               for comp in reversed(parts)]
+        order = (np.lexsort(lex) if lex else np.arange(nv))
+
+        # partition boundaries in sorted domain (null grouping: _key_parts
+        # separates nulls via the flag component, _neq folds NaN with NaN)
+        boundary = np.zeros(nv, bool)
+        if nv:
+            boundary[0] = True
+        for parts in pkeys:
+            for comp in parts:
+                boundary[1:] |= _neq(comp[order])
+
+        # peer boundaries: partition boundary OR any order-key change
+        peer = boundary.copy()
+        for parts in okeys:
+            for comp in parts:
+                peer[1:] |= _neq(comp[order])
+
+        starts = np.flatnonzero(boundary)
+        ends = np.r_[starts[1:], nv]
+
+        # -- evaluate per partition (vectorized inside each slice) ---------
+        vals_sorted, fill, is_string = self._compute(
+            frame, func, host, order, starts, ends, peer, nv)
+
+        # -- scatter back to original slots --------------------------------
+        if is_string:
+            out = np.full(frame.num_slots, None, dtype=object)
+            tmp = np.empty(nv, dtype=object)
+            tmp[order] = vals_sorted
+            out[idx] = tmp
+            return out
+        tmp = np.empty(nv, dtype=vals_sorted.dtype)
+        tmp[order] = vals_sorted
+        out = np.full(frame.num_slots, fill, dtype=vals_sorted.dtype)
+        out[idx] = tmp
+        return jnp.asarray(out)
+
+    def _compute(self, frame, func, host, order, starts, ends, peer, nv):
+        """Returns (values in sorted domain, masked-slot fill, is_string)."""
+        fn = func.fn
+        fdt = np.dtype(float_dtype())
+        idt = np.dtype(int_dtype())
+
+        if fn in _RANKING_FNS:
+            pos = np.arange(nv)
+            gstart = np.zeros(nv, idt)
+            for s, e in zip(starts, ends):
+                gstart[s:e] = s
+            if fn == "row_number":
+                return (pos - gstart + 1).astype(idt), 0, False
+            # index of first row of the current peer group
+            peer_start = np.maximum.accumulate(np.where(peer, pos, 0))
+            if fn == "rank":
+                return (peer_start - gstart + 1).astype(idt), 0, False
+            if fn == "dense_rank":
+                cp = np.cumsum(peer)
+                return (cp - cp[gstart] + 1).astype(idt), 0, False
+            npart = np.zeros(nv, idt)
+            for s, e in zip(starts, ends):
+                npart[s:e] = e - s
+            if fn == "percent_rank":
+                r = (peer_start - gstart).astype(fdt)
+                denom = np.maximum(npart - 1, 1).astype(fdt)
+                return np.where(npart > 1, r / denom, 0.0).astype(fdt), \
+                    np.nan, False
+            if fn == "cume_dist":
+                # rows ≤ current peer group = index just past the last peer
+                out = np.empty(nv, fdt)
+                for s, e in zip(starts, ends):
+                    out[s:e] = _peer_upto(peer, s, e) / (e - s)
+                return out, np.nan, False
+            if fn == "ntile":
+                k = int(func.n)
+                if k < 1:
+                    raise ValueError("ntile requires a positive bucket count")
+                out = np.empty(nv, idt)
+                for s, e in zip(starts, ends):
+                    n = e - s
+                    base, rem = divmod(n, min(k, n) if n else 1)
+                    # Spark: first `rem` buckets get base+1 rows
+                    sizes = np.full(min(k, n), base, np.int64)
+                    sizes[:rem] += 1
+                    out[s:e] = np.repeat(np.arange(1, len(sizes) + 1), sizes)
+                return out, 0, False
+
+        if fn in _OFFSET_FNS:
+            v = host(func.column)[order]
+            off = func.offset if fn == "lag" else -func.offset
+            is_string = v.dtype == object
+            if is_string:
+                out = np.full(nv, None, dtype=object)
+                default = func.default
+            else:
+                if not np.issubdtype(v.dtype, np.floating):
+                    v = v.astype(fdt)  # int lag needs a null (NaN) slot
+                out = np.full(nv, np.nan, dtype=v.dtype)
+                default = np.nan if func.default is None else func.default
+            for s, e in zip(starts, ends):
+                seg = v[s:e]
+                if off == 0:           # lag/lead 0 = the current row (Spark)
+                    out[s:e] = seg
+                    continue
+                shifted = np.full(e - s, default,
+                                  dtype=object if is_string else seg.dtype)
+                if off > 0 and e - s > off:
+                    shifted[off:] = seg[:-(off)]
+                elif off < 0 and e - s > -off:
+                    shifted[:off] = seg[-off:]
+                out[s:e] = shifted
+            return out, (None if is_string else np.nan), is_string
+
+        if fn in _AGG_FNS:
+            agg = {"mean": "avg"}.get(fn, fn)
+            counting_all = agg == "count" and func.column is None
+            if counting_all:
+                v = np.ones(nv, fdt)
+                null = np.zeros(nv, bool)
+            else:
+                v = host(func.column)[order]
+                if v.dtype == object:
+                    if agg != "count":   # COUNT alone is dtype-agnostic
+                        raise ValueError(
+                            f"windowed {fn}() over a string column is not "
+                            "supported")
+                    null = np.asarray([x is None for x in v], bool)
+                    v = np.ones(nv, np.float64)
+                else:
+                    v = v.astype(np.float64)
+                    null = np.isnan(v)
+            ordered = bool(self.spec.order_cols)
+            out = np.empty(nv, np.float64)
+            for s, e in zip(starts, ends):
+                seg = np.where(null[s:e], 0.0, v[s:e])
+                cnt = (~null[s:e]).astype(np.float64)
+                if not ordered:          # whole-partition aggregate
+                    out[s:e] = _segment_agg(agg, seg, cnt, v[s:e], null[s:e])
+                    continue
+                # running aggregate incl. peers (RANGE ... CURRENT ROW)
+                upto = _peer_upto(peer, s, e)       # rows included per row
+                cs, cc = np.cumsum(seg), np.cumsum(cnt)
+                if agg == "count":
+                    out[s:e] = cc[upto - 1]
+                elif agg == "sum":
+                    out[s:e] = cs[upto - 1]
+                elif agg == "avg":
+                    c = cc[upto - 1]
+                    out[s:e] = np.where(c > 0, cs[upto - 1] / np.maximum(c, 1),
+                                        np.nan)
+                else:  # min / max: accumulate with nulls neutralized
+                    neutral = np.inf if agg == "min" else -np.inf
+                    acc = np.where(null[s:e], neutral, v[s:e])
+                    run = (np.minimum if agg == "min" else np.maximum) \
+                        .accumulate(acc)
+                    # all-null-so-far → NaN; decided by the non-null count,
+                    # so legitimate ±inf values pass through untouched
+                    out[s:e] = np.where(cc[upto - 1] > 0, run[upto - 1],
+                                        np.nan)
+            if agg == "count":
+                return out.astype(idt), 0, False
+            return out.astype(fdt), np.nan, False
+
+        raise ValueError(f"unknown window function {fn!r}")
+
+
+def _segment_agg(agg, seg, cnt, raw, null):
+    n = cnt.sum()
+    if agg == "count":
+        return n
+    if n == 0:
+        return np.nan
+    if agg == "sum":
+        return seg.sum()
+    if agg == "avg":
+        return seg.sum() / n
+    vals = raw[~null]
+    return vals.min() if agg == "min" else vals.max()
+
+
+# -- function constructors (exported via sparkdq4ml_tpu.functions) ----------
+
+def row_number() -> WindowFunction:
+    """Sequential number within the partition, by window order (1-based)."""
+    return WindowFunction("row_number")
+
+
+def rank() -> WindowFunction:
+    """Rank with gaps after ties (SQL RANK)."""
+    return WindowFunction("rank")
+
+
+def dense_rank() -> WindowFunction:
+    """Rank without gaps (SQL DENSE_RANK)."""
+    return WindowFunction("dense_rank")
+
+
+def percent_rank() -> WindowFunction:
+    """(rank - 1) / (partition size - 1); 0 for single-row partitions."""
+    return WindowFunction("percent_rank")
+
+
+def cume_dist() -> WindowFunction:
+    """Fraction of partition rows ≤ the current row's order key."""
+    return WindowFunction("cume_dist")
+
+
+def ntile(n: int) -> WindowFunction:
+    """Partition rows into ``n`` ordered buckets (1-based), sizes differing
+    by at most one (Spark/SQL NTILE)."""
+    return WindowFunction("ntile", n=n)
+
+
+def lag(col: Union[str, Col], offset: int = 1, default=None) -> WindowFunction:
+    """Value of ``col`` ``offset`` rows before the current row in the window
+    order; ``default`` (null if omitted) beyond the partition edge."""
+    return WindowFunction("lag", column=_colname(col), offset=offset,
+                          default=default)
+
+
+def lead(col: Union[str, Col], offset: int = 1, default=None) -> WindowFunction:
+    """Value of ``col`` ``offset`` rows after the current row."""
+    return WindowFunction("lead", column=_colname(col), offset=offset,
+                          default=default)
+
+
+def window_agg(fn: str, column: Optional[str]) -> WindowFunction:
+    """Windowed aggregate builder — ``sum("x").over(w)`` routes here."""
+    return WindowFunction(fn, column=column)

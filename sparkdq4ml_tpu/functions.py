@@ -5,6 +5,8 @@ scalar builtins, CASE WHEN, and aggregate constructors."""
 
 from .frame.aggregates import (avg, count, max, mean, min, stddev, sum,
                                variance)
+from .frame.window import (Window, WindowSpec, cume_dist, dense_rank, lag,
+                           lead, ntile, percent_rank, rank, row_number)
 from .ops.expressions import (call_udf, callUDF, ceil, coalesce, col, concat,
                               exp, floor, fn, greatest, isnan, isnull, least,
                               length, lit, log, log10, lower, ltrim, pow,
@@ -19,4 +21,6 @@ __all__ = ["col", "lit", "call_udf", "callUDF", "count", "sum", "avg",
            "round", "signum", "greatest", "least", "isnan", "isnull",
            "coalesce", "when", "fn",
            "upper", "lower", "trim", "ltrim", "rtrim", "length", "concat",
-           "substring"]
+           "substring",
+           "Window", "WindowSpec", "row_number", "rank", "dense_rank",
+           "percent_rank", "cume_dist", "ntile", "lag", "lead"]

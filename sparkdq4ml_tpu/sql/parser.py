@@ -21,7 +21,11 @@ Grammar (recursive descent):
     join       := [INNER|LEFT [OUTER]|RIGHT [OUTER]|FULL [OUTER]|CROSS]
                   JOIN ident (ON ident '=' ident | USING '(' ident,* ')')
     select_list:= '*' | item (',' item)*
-    item       := expr [[AS] ident]
+    item       := expr [OVER window] [[AS] ident]
+    window     := '(' [PARTITION BY ident,*] [ORDER BY ident [ASC|DESC],*] ')'
+                  -- after a ranking fn (ROW_NUMBER/RANK/DENSE_RANK/
+                  -- PERCENT_RANK/CUME_DIST/NTILE/LAG/LEAD) or an aggregate;
+                  -- default frame RANGE UNBOUNDED PRECEDING..CURRENT ROW
     or_expr    := and_expr (OR and_expr)*
     and_expr   := not_expr (AND not_expr)*
     not_expr   := NOT not_expr | cmp
@@ -62,8 +66,30 @@ _KEYWORDS = {"select", "from", "where", "as", "and", "or", "not", "cast",
              "outer", "cross", "on", "using", "case", "when", "then",
              "else", "end", "is", "in", "between", "like", "having",
              "distinct", "union", "all"}
+# OVER / PARTITION are contextual (recognized only after a function call /
+# inside a window spec), so columns named "over"/"partition" keep working.
 
 _AGG_FNS = {"count", "sum", "avg", "mean", "min", "max", "stddev", "variance"}
+_WINDOW_FNS = {"row_number", "rank", "dense_rank", "percent_rank",
+               "cume_dist", "ntile", "lag", "lead"}
+
+
+def _lit_value(expr, what: str):
+    """Extract a literal value, accepting a leading unary minus (``-1``
+    parses as UnaryOp('-', Lit) — still a literal to the user)."""
+    if isinstance(expr, E.Lit):
+        return expr.value
+    if (isinstance(expr, E.UnaryOp) and expr.op == "-"
+            and isinstance(expr.child, E.Lit)):
+        return -expr.child.value
+    raise ValueError(f"{what} must be a literal")
+
+
+def _check_agg_args(fn: str, col, args) -> None:
+    """Aggregate argument rule, shared by the plain and windowed (OVER)
+    paths: a single column name, or bare ``*``/no args for COUNT only."""
+    if col is None and not (fn.lower() == "count" and not args):
+        raise ValueError(f"{fn} argument must be * or a column name")
 
 
 class _Token:
@@ -225,22 +251,89 @@ class _Parser:
             items.append(self.parse_item())
         return items
 
+    def parse_window_spec(self):
+        """``( [PARTITION BY ident,*] [ORDER BY item,*] )`` after OVER.
+        The default frame (RANGE UNBOUNDED PRECEDING..CURRENT ROW) applies;
+        explicit ROWS/RANGE clauses are not in the grammar."""
+        from ..frame.window import WindowSpec
+
+        self.expect("op", "(")
+        partition, order = [], []
+        if self.accept("ident", "partition"):
+            self.expect("kw", "by")
+            partition.append(self.expect("ident").value)
+            while self.accept("op", ","):
+                partition.append(self.expect("ident").value)
+        if self.accept("kw", "order"):
+            self.expect("kw", "by")
+            order.append(self.parse_order_item())
+            while self.accept("op", ","):
+                order.append(self.parse_order_item())
+        self.expect("op", ")")
+        return WindowSpec(partition, order)
+
+    def _build_window_fn(self, fn: str, col, args: list):
+        """Bind a parsed ``fn(args...)`` to a WindowFunction (pre-OVER)."""
+        from ..frame import window as W
+
+        fl = fn.lower()
+        if fl in _AGG_FNS:
+            from ..frame.aggregates import AggExpr
+
+            _check_agg_args(fn, col, args)
+            return AggExpr(fn, col).over  # bound later by caller
+        if fl == "ntile":
+            if len(args) != 1 or not isinstance(args[0], E.Lit):
+                raise ValueError("ntile(n) requires an integer literal")
+            return W.ntile(int(args[0].value)).over
+        if fl in ("lag", "lead"):
+            if not args or not isinstance(args[0], E.Col):
+                raise ValueError(f"{fl}(col[, offset[, default]]) requires a "
+                                 "column first argument")
+            offset = 1
+            default = None
+            if len(args) > 1:
+                offset = int(_lit_value(args[1], f"{fl} offset"))
+            if len(args) > 2:
+                default = _lit_value(args[2], f"{fl} default")
+            builder = W.lag if fl == "lag" else W.lead
+            return builder(args[0].name, offset, default).over
+        if args:
+            raise ValueError(f"{fl}() takes no arguments")
+        return getattr(W, fl)().over
+
     def parse_item(self):
-        # aggregate at top level: COUNT(*), AVG(price), ...
+        # aggregate or window fn at top level: COUNT(*), AVG(price),
+        # ROW_NUMBER() OVER (...), SUM(price) OVER (...), ...
         t = self.peek()
-        if (t.kind == "ident" and t.value.lower() in _AGG_FNS
+        if (t.kind == "ident" and t.value.lower() in (_AGG_FNS | _WINDOW_FNS)
                 and self.toks[self.i + 1].kind == "op"
                 and self.toks[self.i + 1].value == "("):
             from ..frame.aggregates import AggExpr
 
             fn = self.next().value
             self.expect("op", "(")
-            if self.accept("op", "*"):
-                col = None
+            col = None
+            args: list = []
+            if not self.accept("op", ")"):
+                if self.accept("op", "*"):
+                    pass
+                else:
+                    args.append(self.parse_or())
+                    while self.accept("op", ","):
+                        args.append(self.parse_or())
+                self.expect("op", ")")
+            if len(args) == 1 and isinstance(args[0], E.Col):
+                col = args[0].name
+            if self.accept("ident", "over"):
+                make = self._build_window_fn(fn, col, args)
+                expr = make(self.parse_window_spec())
+            elif fn.lower() in _AGG_FNS:
+                _check_agg_args(fn, col, args)
+                expr = AggExpr(fn, col)
             else:
-                col = self.expect("ident").value
-            self.expect("op", ")")
-            expr = AggExpr(fn, col)
+                raise ValueError(f"window function {fn}() requires an "
+                                 "OVER clause")
             if self.accept("kw", "as"):
                 return expr.alias(self.expect("ident").value)
             alias = self.accept("ident")

@@ -1,0 +1,271 @@
+"""Window functions: ranking, offsets, windowed aggregates, SQL OVER.
+
+Cross-checked against Spark/SQL window semantics: default frame for ordered
+windows is RANGE UNBOUNDED PRECEDING..CURRENT ROW (running aggregates include
+peer rows); ranking functions follow SQL RANK/DENSE_RANK tie rules.
+"""
+
+import numpy as np
+import pytest
+
+from sparkdq4ml_tpu import Frame
+from sparkdq4ml_tpu import functions as F
+
+
+@pytest.fixture
+def sales():
+    # dept, name, amount — with a tie inside dept "a" (30 twice)
+    return Frame({
+        "dept": np.asarray(["a", "a", "a", "b", "b", "a"], dtype=object),
+        "name": np.asarray(["u", "v", "w", "x", "y", "z"], dtype=object),
+        "amount": [10.0, 30.0, 30.0, 5.0, 7.0, 50.0],
+    })
+
+
+def _by_name(frame, value_col):
+    d = frame.to_pydict()
+    return {n: v for n, v in zip(d["name"], d[value_col])}
+
+
+class TestRanking:
+    def test_row_number(self, sales):
+        w = F.Window.partitionBy("dept").orderBy("amount")
+        out = sales.withColumn("rn", F.row_number().over(w))
+        got = _by_name(out, "rn")
+        assert got["u"] == 1 and got["z"] == 4          # dept a: 10,30,30,50
+        assert {got["v"], got["w"]} == {2, 3}           # tie broken arbitrarily
+        assert got["x"] == 1 and got["y"] == 2          # dept b: 5,7
+
+    def test_rank_and_dense_rank_ties(self, sales):
+        w = F.Window.partitionBy("dept").orderBy("amount")
+        out = sales.withColumn("r", F.rank().over(w)) \
+                   .withColumn("dr", F.dense_rank().over(w))
+        r, dr = _by_name(out, "r"), _by_name(out, "dr")
+        assert r["u"] == 1 and r["v"] == 2 and r["w"] == 2 and r["z"] == 4
+        assert dr["u"] == 1 and dr["v"] == 2 and dr["w"] == 2 and dr["z"] == 3
+
+    def test_percent_rank(self, sales):
+        w = F.Window.partitionBy("dept").orderBy("amount")
+        out = sales.withColumn("pr", F.percent_rank().over(w))
+        pr = _by_name(out, "pr")
+        assert pr["u"] == pytest.approx(0.0)
+        assert pr["v"] == pytest.approx(1 / 3) == pr["w"]
+        assert pr["z"] == pytest.approx(1.0)
+
+    def test_cume_dist(self, sales):
+        w = F.Window.partitionBy("dept").orderBy("amount")
+        cd = _by_name(sales.withColumn("cd", F.cume_dist().over(w)), "cd")
+        assert cd["u"] == pytest.approx(0.25)
+        assert cd["v"] == pytest.approx(0.75) == cd["w"]  # peers included
+        assert cd["z"] == pytest.approx(1.0)
+        assert cd["x"] == pytest.approx(0.5) and cd["y"] == pytest.approx(1.0)
+
+    def test_ntile(self):
+        f = Frame({"k": np.asarray(["g"] * 5, dtype=object),
+                   "v": [1.0, 2.0, 3.0, 4.0, 5.0]})
+        w = F.Window.partitionBy("k").orderBy("v")
+        out = f.withColumn("t", F.ntile(2).over(w)).to_pydict()
+        assert out["t"].tolist() == [1, 1, 1, 2, 2]  # first bucket gets extra
+
+    def test_desc_order(self, sales):
+        w = F.Window.partitionBy("dept").orderBy(("amount", False))
+        rn = _by_name(sales.withColumn("rn", F.row_number().over(w)), "rn")
+        assert rn["z"] == 1 and rn["u"] == 4
+
+    def test_ranking_requires_order(self):
+        with pytest.raises(ValueError, match="ORDER BY"):
+            F.row_number().over(F.Window.partitionBy("dept"))
+
+    def test_no_partition_is_one_global_partition(self, sales):
+        w = F.Window.orderBy("amount")
+        rn = _by_name(sales.withColumn("rn", F.row_number().over(w)), "rn")
+        assert sorted(rn.values()) == [1, 2, 3, 4, 5, 6]
+        assert rn["x"] == 1 and rn["z"] == 6
+
+
+class TestOffsets:
+    def test_lag_lead(self, sales):
+        w = F.Window.partitionBy("dept").orderBy("amount")
+        out = sales.withColumn("prev", F.lag("amount").over(w)) \
+                   .withColumn("next", F.lead("amount").over(w))
+        prev, nxt = _by_name(out, "prev"), _by_name(out, "next")
+        assert np.isnan(prev["u"]) and np.isnan(prev["x"])  # partition edge
+        assert prev["z"] == pytest.approx(30.0)
+        assert nxt["u"] == pytest.approx(30.0)
+        assert np.isnan(nxt["z"]) and np.isnan(nxt["y"])
+
+    def test_lag_default_and_offset(self, sales):
+        w = F.Window.partitionBy("dept").orderBy("amount")
+        out = sales.withColumn("p2", F.lag("amount", 2, -1.0).over(w))
+        p2 = _by_name(out, "p2")
+        assert p2["u"] == pytest.approx(-1.0)   # beyond edge → default
+        assert p2["v"] == pytest.approx(-1.0) or p2["w"] == pytest.approx(-1.0)
+        assert p2["z"] == pytest.approx(30.0)   # two rows back from 50
+
+    def test_lag_string_column(self, sales):
+        w = F.Window.partitionBy("dept").orderBy("amount")
+        out = sales.withColumn("pn", F.lag("name").over(w))
+        pn = _by_name(out, "pn")
+        assert pn["u"] is None
+        assert pn["y"] == "x"
+
+
+class TestWindowedAggregates:
+    def test_running_sum_includes_peers(self, sales):
+        w = F.Window.partitionBy("dept").orderBy("amount")
+        rs = _by_name(sales.withColumn("rs", F.sum("amount").over(w)), "rs")
+        assert rs["u"] == pytest.approx(10.0)
+        # RANGE frame: both 30-peers see 10+30+30
+        assert rs["v"] == pytest.approx(70.0) == rs["w"]
+        assert rs["z"] == pytest.approx(120.0)
+
+    def test_unordered_whole_partition(self, sales):
+        w = F.Window.partitionBy("dept")
+        tot = _by_name(sales.withColumn("tot", F.sum("amount").over(w)), "tot")
+        assert tot["u"] == pytest.approx(120.0) == tot["z"]
+        assert tot["x"] == pytest.approx(12.0) == tot["y"]
+
+    def test_running_min_max_avg_count(self, sales):
+        w = F.Window.partitionBy("dept").orderBy("amount")
+        out = sales.withColumn("mn", F.min("amount").over(w)) \
+                   .withColumn("mx", F.max("amount").over(w)) \
+                   .withColumn("av", F.avg("amount").over(w)) \
+                   .withColumn("ct", F.count("amount").over(w))
+        mn, mx = _by_name(out, "mn"), _by_name(out, "mx")
+        av, ct = _by_name(out, "av"), _by_name(out, "ct")
+        assert mn["z"] == pytest.approx(10.0) and mx["v"] == pytest.approx(30.0)
+        assert av["v"] == pytest.approx(70.0 / 3)
+        assert ct["v"] == 3 and ct["z"] == 4
+
+    def test_masked_rows_excluded(self, sales):
+        from sparkdq4ml_tpu import col
+
+        w = F.Window.partitionBy("dept").orderBy("amount")
+        filtered = sales.filter(col("amount") > 9.0)  # drops x(5), y(7)
+        out = filtered.withColumn("rn", F.row_number().over(w))
+        rn = _by_name(out, "rn")
+        assert "x" not in rn and "y" not in rn
+        assert sorted(v for k, v in rn.items()) == [1, 2, 3, 4]
+
+    def test_null_values_skipped_in_agg(self):
+        f = Frame({"k": np.asarray(["g", "g", "g"], dtype=object),
+                   "t": [1.0, 2.0, 3.0],
+                   "v": [5.0, float("nan"), 7.0]})
+        w = F.Window.partitionBy("k").orderBy("t")
+        out = f.withColumn("s", F.sum("v").over(w)).to_pydict()
+        assert out["s"].tolist() == pytest.approx([5.0, 5.0, 12.0])
+
+
+class TestEdgeCases:
+    def test_two_unaliased_window_exprs_do_not_collide(self, sales):
+        w = F.Window.partitionBy("dept").orderBy("amount")
+        out = sales.select("name", F.lag("amount").over(w),
+                           F.lag("name").over(w))
+        assert len(out.columns) == 3  # distinct generated names
+
+    def test_nan_partition_keys_form_one_group(self):
+        f = Frame({"k": [1.0, float("nan"), float("nan")],
+                   "x": [1.0, 2.0, 3.0]})
+        w = F.Window.partitionBy("k")
+        out = f.withColumn("s", F.sum("x").over(w)).to_pydict()
+        assert out["s"].tolist() == pytest.approx([1.0, 5.0, 5.0])
+
+    def test_null_and_empty_string_keys_are_distinct_groups(self):
+        # Spark groups nulls separately from the empty string
+        f = Frame({"k": np.asarray(["", None, "", None], dtype=object),
+                   "x": [1.0, 2.0, 4.0, 8.0]})
+        w = F.Window.partitionBy("k")
+        out = f.withColumn("s", F.sum("x").over(w)).to_pydict()
+        assert out["s"].tolist() == pytest.approx([5.0, 10.0, 5.0, 10.0])
+
+    def test_running_max_with_legit_infinity(self):
+        f = Frame({"v": [1.0, 2.0], "x": [float("inf"), 5.0]})
+        w = F.Window.orderBy("v")
+        out = f.withColumn("m", F.max("x").over(w)).to_pydict()
+        assert out["m"].tolist() == [float("inf"), float("inf")]
+
+    def test_nan_order_key_sorts_first_ascending(self):
+        # SQL NULLS FIRST for ascending order, both dtypes
+        f = Frame({"v": [float("nan"), 1.0, 2.0]})
+        w = F.Window.orderBy("v")
+        out = f.withColumn("rn", F.row_number().over(w)).to_pydict()
+        assert out["rn"].tolist()[0] == 1     # the NaN row
+        f2 = Frame({"v": [2.0, float("nan"), 1.0]})
+        w2 = F.Window.orderBy(("v", False))   # DESC → NULLS LAST
+        out2 = f2.withColumn("rn", F.row_number().over(w2)).to_pydict()
+        assert out2["rn"].tolist() == [1, 3, 2]
+
+    def test_descending_bool_order_key(self):
+        f = Frame({"b": np.asarray([True, False, True]),
+                   "x": [1.0, 2.0, 3.0]})
+        w = F.Window.orderBy(("b", False))
+        out = f.withColumn("rn", F.row_number().over(w)).to_pydict()
+        # True rows first under DESC
+        by_x = dict(zip(out["x"].tolist(), out["rn"].tolist()))
+        assert by_x[2.0] == 3 and {by_x[1.0], by_x[3.0]} == {1, 2}
+
+    def test_lag_offset_zero_is_current_row(self):
+        f = Frame({"x": [1.0, 2.0, 3.0]})
+        w = F.Window.orderBy("x")
+        out = f.withColumn("c", F.lag("x", 0).over(w)).to_pydict()
+        assert out["c"].tolist() == pytest.approx([1.0, 2.0, 3.0])
+
+    def test_windowed_count_over_string_column(self, sales):
+        w = F.Window.partitionBy("dept").orderBy("amount")
+        ct = _by_name(sales.withColumn("ct", F.count("name").over(w)), "ct")
+        assert ct["z"] == 4 and ct["y"] == 2
+
+
+class TestSqlOver:
+    def test_sql_row_number(self, sales, session):
+        s = session
+        sales.createOrReplaceTempView("sales")
+        out = s.sql("SELECT name, ROW_NUMBER() OVER "
+                    "(PARTITION BY dept ORDER BY amount) AS rn FROM sales")
+        rn = _by_name(out, "rn")
+        assert rn["u"] == 1 and rn["z"] == 4 and rn["x"] == 1
+
+    def test_sql_windowed_agg_and_lag(self, sales, session):
+        s = session
+        sales.createOrReplaceTempView("sales")
+        out = s.sql("SELECT name, SUM(amount) OVER (PARTITION BY dept "
+                    "ORDER BY amount) AS rs, LAG(amount, 1) OVER "
+                    "(PARTITION BY dept ORDER BY amount) AS prev FROM sales")
+        rs, prev = _by_name(out, "rs"), _by_name(out, "prev")
+        assert rs["z"] == pytest.approx(120.0)
+        assert np.isnan(prev["u"]) and prev["z"] == pytest.approx(30.0)
+
+    def test_sql_desc_and_where(self, sales, session):
+        s = session
+        sales.createOrReplaceTempView("sales")
+        out = s.sql("SELECT name, RANK() OVER (PARTITION BY dept ORDER BY "
+                    "amount DESC) AS r FROM sales WHERE amount > 9")
+        r = _by_name(out, "r")
+        assert r["z"] == 1 and r["u"] == 4 and "x" not in r
+
+    def test_sql_window_fn_without_over_errors(self, sales, session):
+        s = session
+        sales.createOrReplaceTempView("sales")
+        with pytest.raises(ValueError, match="OVER"):
+            s.sql("SELECT ROW_NUMBER() FROM sales")
+
+    def test_sql_zero_arg_aggregate_is_a_parse_error(self, sales, session):
+        sales.createOrReplaceTempView("sales")
+        with pytest.raises(ValueError, match="column name"):
+            session.sql("SELECT SUM() FROM sales")
+
+    def test_sql_negative_lag_offset_and_default(self, sales, session):
+        sales.createOrReplaceTempView("sales")
+        out = session.sql("SELECT name, LAG(amount, -1, -1.0) OVER "
+                          "(PARTITION BY dept ORDER BY amount) AS nxt "
+                          "FROM sales")
+        nxt = _by_name(out, "nxt")
+        assert nxt["u"] == pytest.approx(30.0)   # lag -1 ≡ lead 1
+        assert nxt["z"] == pytest.approx(-1.0)   # edge → default
+
+    def test_over_and_partition_are_not_reserved(self, session):
+        f = Frame({"partition": [1.0, 2.0], "over": [3.0, 4.0]})
+        f.createOrReplaceTempView("weird")
+        out = session.sql("SELECT partition, over FROM weird "
+                          "WHERE partition > 1")
+        assert out.to_pydict()["over"].tolist() == [4.0]
