@@ -92,6 +92,30 @@ def variance(col: str) -> AggExpr:
     return AggExpr("variance", col)
 
 
+def _group_plan(key_cols: list[np.ndarray], n: int):
+    """Null-safe lexicographic group discovery shared by groupBy/pivot:
+    returns (order, group_starts, group_ends) over the n rows. Delegates key
+    decomposition to window._key_parts/_neq so None string keys don't crash
+    lexsort and NaN float keys form one group, exactly like window
+    partitioning."""
+    from .window import _key_parts, _neq
+
+    parts_list = [_key_parts(np.asarray(k)) for k in key_cols]
+    # np.lexsort: primary key LAST → reverse keys, and components within one
+    lex = [comp for parts in reversed(parts_list)
+           for comp in reversed(parts)]
+    order = np.lexsort(lex) if lex else np.arange(n)
+    boundary = np.zeros(len(order), bool)
+    if len(order):
+        boundary[0] = True
+    for parts in parts_list:
+        for comp in parts:
+            boundary[1:] |= _neq(comp[order])
+    starts = np.flatnonzero(boundary)
+    ends = np.r_[starts[1:], len(order)]
+    return order, starts, ends
+
+
 def _drop_nulls(values: np.ndarray) -> np.ndarray:
     if values.dtype == object:
         return values[np.asarray([x is not None for x in values], bool)]
@@ -201,19 +225,12 @@ class GroupedFrame:
 
         d = self._frame.to_pydict()  # host boundary: one gather
         key_cols = [np.asarray(d[k]) for k in self._keys]
-        # lexicographic group ids
-        order = np.lexsort(key_cols[::-1])
-        sorted_keys = [k[order] for k in key_cols]
+        order, group_starts, group_ends = _group_plan(
+            key_cols, len(key_cols[0]) if key_cols else 0)
         if len(order) == 0:
             data = {k: [] for k in self._keys}
             data.update({a.name: [] for a in agg_list})
             return Frame(data)
-        boundary = np.zeros(len(order), bool)
-        boundary[0] = True
-        for k in sorted_keys:
-            boundary[1:] |= k[1:] != k[:-1]
-        group_starts = np.flatnonzero(boundary)
-        group_ends = np.r_[group_starts[1:], len(order)]
 
         data: dict[str, list] = {k: [] for k in self._keys}
         for a in agg_list:
@@ -227,6 +244,93 @@ class GroupedFrame:
                     data[a.name].append(len(idx))
                 else:
                     data[a.name].append(_np_agg(a.fn, np.asarray(d[a.column])[idx]))
+        return Frame(data)
+
+    def pivot(self, pivot_col: str, values=None) -> "PivotedFrame":
+        """``groupBy(keys).pivot(col[, values]).agg(...)`` — rotate the
+        distinct values of ``pivot_col`` into output columns (Spark's
+        RelationalGroupedDataset.pivot). When ``values`` is omitted the
+        distinct values are discovered from the data and sorted, as Spark
+        does; passing them explicitly skips that pass and fixes the column
+        order."""
+        self._frame._column_values(pivot_col)
+        return PivotedFrame(self._frame, self._keys, pivot_col, values)
+
+    def count(self):
+        return self.agg(AggExpr("count", None))
+
+    def sum(self, *cols: str):
+        return self.agg(*[AggExpr("sum", c) for c in cols])
+
+    def avg(self, *cols: str):
+        return self.agg(*[AggExpr("avg", c) for c in cols])
+
+    mean = avg
+
+    def min(self, *cols: str):
+        return self.agg(*[AggExpr("min", c) for c in cols])
+
+    def max(self, *cols: str):
+        return self.agg(*[AggExpr("max", c) for c in cols])
+
+
+class PivotedFrame:
+    """Result of ``GroupedFrame.pivot`` — terminal agg methods produce one
+    output column per (pivot value × aggregate), Spark column naming:
+    just the value for a single aggregate, ``value_aggname`` for several."""
+
+    def __init__(self, frame, keys: list[str], pivot_col: str, values):
+        self._frame = frame
+        self._keys = keys
+        self._pivot_col = pivot_col
+        self._values = list(values) if values is not None else None
+
+    def agg(self, *aggs: Union[AggExpr, str]):
+        from .frame import Frame
+
+        agg_list = [AggExpr(a, None) if isinstance(a, str) else a
+                    for a in aggs]
+        if not agg_list:
+            raise ValueError("agg() needs at least one aggregate")
+
+        d = self._frame.to_pydict()  # host boundary: one gather
+        pcol = np.asarray(d[self._pivot_col])
+        if self._values is None:
+            uniq = [x for x in set(pcol.tolist()) if x is not None]
+            values = sorted(uniq)
+        else:
+            values = self._values
+
+        key_cols = [np.asarray(d[k]) for k in self._keys]
+        order, group_starts, group_ends = _group_plan(key_cols, len(pcol))
+
+        def col_name(value, agg):
+            base = str(value) if len(agg_list) == 1 else f"{value}_{agg.name}"
+            while base in self._keys:   # a pivot value may shadow a key name
+                base += "_pivot"
+            return base
+
+        data: dict[str, list] = {k: [] for k in self._keys}
+        for v in values:
+            for a in agg_list:
+                data[col_name(v, a)] = []
+        for s, e in zip(group_starts, group_ends):
+            idx = order[s:e]
+            for k, kc in zip(self._keys, key_cols):
+                data[k].append(kc[idx[0]])
+            grp_pivot = pcol[idx]
+            for v in values:
+                sub = idx[np.asarray([x == v for x in grp_pivot], bool)]
+                for a in agg_list:
+                    if a.fn == "count" and a.column is None:
+                        data[col_name(v, a)].append(len(sub))
+                    elif len(sub) == 0:
+                        # no rows for this cell → null (Spark), even for
+                        # COUNT over a column (Spark yields null there too)
+                        data[col_name(v, a)].append(float("nan"))
+                    else:
+                        data[col_name(v, a)].append(
+                            _np_agg(a.fn, np.asarray(d[a.column])[sub]))
         return Frame(data)
 
     def count(self):

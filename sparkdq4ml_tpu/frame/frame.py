@@ -50,15 +50,19 @@ def _as_column(values, n: Optional[int] = None):
         arr = jnp.asarray(values)
     else:
         values = list(values)
-        if values and isinstance(values[0], str):
+        if values and any(isinstance(v, str) for v in values):
             arr = np.asarray(values, dtype=object)
         else:
             np_arr = np.asarray(values)
-            if np_arr.dtype == np.float64:
-                np_arr = np_arr.astype(np.dtype(float_dtype()))
-            elif np_arr.dtype == np.int64:
-                np_arr = np_arr.astype(np.dtype(int_dtype()))
-            arr = jnp.asarray(np_arr)
+            if np_arr.dtype == object:
+                # e.g. [None, "a"] (null-first string groups) — host column
+                arr = np_arr
+            else:
+                if np_arr.dtype == np.float64:
+                    np_arr = np_arr.astype(np.dtype(float_dtype()))
+                elif np_arr.dtype == np.int64:
+                    np_arr = np_arr.astype(np.dtype(int_dtype()))
+                arr = jnp.asarray(np_arr)
     if n is not None and arr.shape[0] != n:
         raise ValueError(f"column length {arr.shape[0]} != frame length {n}")
     return arr

@@ -1,0 +1,111 @@
+"""groupBy().pivot().agg() — Spark RelationalGroupedDataset.pivot parity."""
+
+import numpy as np
+import pytest
+
+from sparkdq4ml_tpu import Frame
+from sparkdq4ml_tpu import functions as F
+
+
+@pytest.fixture
+def orders():
+    return Frame({
+        "year": [2024, 2024, 2024, 2025, 2025, 2025],
+        "quarter": np.asarray(["q1", "q2", "q1", "q1", "q1", "q3"],
+                              dtype=object),
+        "amount": [10.0, 20.0, 30.0, 5.0, 7.0, 9.0],
+    })
+
+
+def _rows(frame):
+    d = frame.to_pydict()
+    return {int(y): {c: d[c][i] for c in d if c != "year"}
+            for i, y in enumerate(d["year"])}
+
+
+class TestPivot:
+    def test_pivot_sum_discovers_sorted_values(self, orders):
+        out = orders.groupBy("year").pivot("quarter").sum("amount")
+        assert out.columns == ["year", "q1", "q2", "q3"]  # sorted discovery
+        r = _rows(out)
+        assert r[2024]["q1"] == pytest.approx(40.0)
+        assert r[2024]["q2"] == pytest.approx(20.0)
+        assert np.isnan(r[2024]["q3"])          # empty cell → null
+        assert r[2025]["q1"] == pytest.approx(12.0)
+        assert r[2025]["q3"] == pytest.approx(9.0)
+
+    def test_pivot_explicit_values_fix_columns(self, orders):
+        out = orders.groupBy("year").pivot("quarter", ["q2", "q1"]) \
+                    .sum("amount")
+        assert out.columns == ["year", "q2", "q1"]
+        r = _rows(out)
+        assert r[2025]["q2"] != r[2025]["q2"] or r[2025]["q2"] is None  # NaN
+
+    def test_pivot_count(self, orders):
+        out = orders.groupBy("year").pivot("quarter").count()
+        r = _rows(out)
+        assert r[2024]["q1"] == 2 and r[2024]["q2"] == 1 and r[2024]["q3"] == 0
+
+    def test_pivot_multiple_aggs_names(self, orders):
+        out = orders.groupBy("year").pivot("quarter", ["q1"]).agg(
+            F.sum("amount"), F.avg("amount"))
+        assert set(out.columns) == {"year", "q1_sum(amount)",
+                                    "q1_avg(amount)"}
+        r = _rows(out)
+        assert r[2024]["q1_sum(amount)"] == pytest.approx(40.0)
+        assert r[2024]["q1_avg(amount)"] == pytest.approx(20.0)
+
+    def test_pivot_respects_mask(self, orders):
+        from sparkdq4ml_tpu import col
+
+        out = orders.filter(col("amount") > 8.0) \
+                    .groupBy("year").pivot("quarter").sum("amount")
+        r = _rows(out)
+        assert 2025 in r and r[2025]["q3"] == pytest.approx(9.0)
+        assert np.isnan(r[2025]["q1"])          # 5 and 7 filtered out
+
+    def test_null_group_keys(self):
+        # None string keys form one group (no crash); NaN float keys too
+        f = Frame({"year": np.asarray(["a", None, None], dtype=object),
+                   "quarter": np.asarray(["q1", "q1", "q1"], dtype=object),
+                   "amount": [1.0, 2.0, 4.0]})
+        out = f.groupBy("year").pivot("quarter").sum("amount")
+        d = out.to_pydict()
+        assert len(d["year"]) == 2
+        got = {k: v for k, v in zip(d["year"], d["q1"])}
+        assert got["a"] == pytest.approx(1.0)
+        assert got[None] == pytest.approx(6.0)
+        g = Frame({"k": [1.0, float("nan"), float("nan")],
+                   "p": np.asarray(["x"] * 3, dtype=object),
+                   "v": [1.0, 2.0, 4.0]})
+        d2 = g.groupBy("k").pivot("p").sum("v").to_pydict()
+        assert len(d2["k"]) == 2  # one NaN group, not two
+
+    def test_pivot_value_shadowing_key_name(self):
+        f = Frame({"k": np.asarray(["a", "b"], dtype=object),
+                   "p": np.asarray(["k", "k"], dtype=object),
+                   "v": [1.0, 2.0]})
+        out = f.groupBy("k").pivot("p").sum("v")
+        assert len(out.columns) == 2 and "k_pivot" in out.columns
+        d = out.to_pydict()
+        assert d["k"].tolist() == ["a", "b"]
+        assert d["k_pivot"].tolist() == pytest.approx([1.0, 2.0])
+
+    def test_groupby_null_keys(self):
+        # same null-safety for plain groupBy (shared plan)
+        f = Frame({"k": np.asarray(["a", None, None], dtype=object),
+                   "v": [1.0, 2.0, 4.0]})
+        d = f.groupBy("k").sum("v").to_pydict()
+        got = {k: v for k, v in zip(d["k"], d["sum(v)"])}
+        assert got["a"] == pytest.approx(1.0)
+        assert got[None] == pytest.approx(6.0)
+
+    def test_pivot_numeric_pivot_column(self):
+        f = Frame({"k": np.asarray(["a", "a", "b"], dtype=object),
+                   "p": [1, 2, 1], "v": [10.0, 20.0, 30.0]})
+        out = f.groupBy("k").pivot("p").sum("v")
+        assert out.columns == ["k", "1", "2"]
+        d = out.to_pydict()
+        row_a = {d["k"][i]: (d["1"][i], d["2"][i]) for i in range(2)}["a"]
+        assert row_a[0] == pytest.approx(10.0)
+        assert row_a[1] == pytest.approx(20.0)
