@@ -2,12 +2,15 @@ from .base import Estimator, Model, Pipeline, PipelineModel, Transformer
 from .classification import (BinaryLogisticRegressionSummary,
                              BinaryLogisticRegressionTrainingSummary,
                              LogisticRegression, LogisticRegressionModel)
-from .evaluation import (BinaryClassificationEvaluator, Evaluator,
-                         MulticlassClassificationEvaluator,
+from .clustering import KMeans, KMeansModel, KMeansSummary
+from .evaluation import (BinaryClassificationEvaluator, ClusteringEvaluator,
+                         Evaluator, MulticlassClassificationEvaluator,
                          RegressionEvaluator)
-from .feature import (Bucketizer, IndexToString, MaxAbsScaler,
-                      MaxAbsScalerModel, MinMaxScaler, MinMaxScalerModel,
-                      OneHotEncoder, OneHotEncoderModel, StandardScaler,
+from .feature import (Binarizer, Bucketizer, Imputer, ImputerModel,
+                      IndexToString, MaxAbsScaler, MaxAbsScalerModel,
+                      MinMaxScaler, MinMaxScalerModel, Normalizer,
+                      OneHotEncoder, OneHotEncoderModel, PolynomialExpansion,
+                      QuantileDiscretizer, StandardScaler,
                       StandardScalerModel, StringIndexer, StringIndexerModel,
                       VectorAssembler)
 from .linalg import Vectors

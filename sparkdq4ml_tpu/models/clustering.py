@@ -1,0 +1,341 @@
+"""Clustering: TPU-native KMeans (MLlib ``org.apache.spark.ml.clustering``
+equivalent — a capability upgrade; the reference app itself fits only
+LinearRegression, `DataQuality4MachineLearningApp.java:120-126`, but its
+MLlib dependency ships the clustering package and an estimator/model surface
+identical to this one).
+
+TPU-first design:
+
+* **Lloyd's step is matmuls.** Squared distances use the expansion
+  ‖x−c‖² = ‖x‖² − 2·x·cᵀ + ‖c‖², so the (n, k) distance matrix is one MXU
+  matmul per iteration; the center update is the transposed one-hot matmul
+  ``assignᵀ·X`` — also MXU. No per-row Python, no dynamic shapes.
+* **The whole fit is one jit.** The iteration loop is a
+  ``lax.while_loop`` (converged-or-max-iter) carrying the (k, d) centers;
+  zero host round-trips per iteration — MLlib's per-iteration
+  ``collectAsMap``/broadcast barrier disappears.
+* **Distributed = psum.** Under a mesh, rows are sharded on the data axis
+  inside ``shard_map``; the per-iteration sufficient statistics (one-hot
+  sums and counts) reduce with ``jax.lax.psum`` over ICI — the
+  ``treeAggregate`` replacement, same shape as the linear fit's Gramian
+  reduction (SURVEY.md §3.3).
+* **Masked rows never vote.** All statistics are mask-weighted; empty
+  clusters keep their previous center (Spark keeps stale centers likewise).
+
+Init: ``k-means++`` greedy seeding on the host (a one-time, data-dependent
+sequential scan — not a device hot loop), or ``random`` distinct rows.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..config import float_dtype
+from ..frame import Frame
+from ..parallel.mesh import DATA_AXIS
+from .base import Estimator, Model, persistable
+
+
+def _lloyd_step(X, w, centers):
+    """One Lloyd iteration's local sufficient statistics.
+
+    Returns (per-cluster weighted coordinate sums, per-cluster weights,
+    local weighted SSE) for masked rows X with weights w against the
+    replicated (k, d) centers. All matmul-shaped for the MXU.
+    """
+    x_sq = jnp.sum(X * X, axis=1, keepdims=True)          # (n, 1)
+    c_sq = jnp.sum(centers * centers, axis=1)             # (k,)
+    d2 = x_sq - 2.0 * (X @ centers.T) + c_sq[None, :]     # (n, k) one matmul
+    assign = jnp.argmin(d2, axis=1)                       # (n,)
+    onehot = jax.nn.one_hot(assign, centers.shape[0],
+                            dtype=X.dtype) * w[:, None]   # (n, k) masked
+    sums = onehot.T @ X                                   # (k, d) MXU
+    counts = jnp.sum(onehot, axis=0)                      # (k,)
+    best = jnp.min(d2, axis=1)
+    cost = jnp.sum(jnp.maximum(best, 0.0) * w)
+    return sums, counts, cost
+
+
+def _make_fit(mesh, k, max_iter, tol):
+    """Build the jitted full KMeans fit: while_loop of psum'd Lloyd steps."""
+
+    if mesh is None:
+        def stats(X, w, centers):
+            return _lloyd_step(X, w, centers)
+    else:
+        def local(X, w, centers):
+            s, c, cost = _lloyd_step(X, w, centers)
+            return (jax.lax.psum(s, DATA_AXIS), jax.lax.psum(c, DATA_AXIS),
+                    jax.lax.psum(cost, DATA_AXIS))
+
+        stats = jax.shard_map(
+            local, mesh=mesh,
+            in_specs=(P(DATA_AXIS), P(DATA_AXIS), P()),
+            out_specs=(P(), P(), P()))
+
+    def fit(X, w, centers0):
+        def body(carry):
+            centers, _, it, _ = carry
+            sums, counts, cost = stats(X, w, centers)
+            safe = jnp.maximum(counts, 1e-12)[:, None]
+            new = jnp.where(counts[:, None] > 0, sums / safe, centers)
+            shift = jnp.max(jnp.sum((new - centers) ** 2, axis=1))
+            return (new, cost, it + 1, shift)
+
+        def cond(carry):
+            _, _, it, shift = carry
+            return jnp.logical_and(it < max_iter, shift > tol * tol)
+
+        init = (centers0, jnp.asarray(jnp.inf, X.dtype),
+                jnp.asarray(0, jnp.int32),
+                jnp.asarray(jnp.inf, X.dtype))
+        centers, cost, iters, _ = jax.lax.while_loop(cond, body, init)
+        # one final stats pass so the reported cost matches the final centers
+        _, counts, cost = stats(X, w, centers)
+        return centers, cost, iters, counts
+
+    return jax.jit(fit)
+
+
+@functools.lru_cache(maxsize=None)
+def _fit_cached(mesh, k, max_iter, tol):
+    return _make_fit(mesh, k, max_iter, tol)
+
+
+def _kmeans_pp_init(X, w, k, rng):
+    """Greedy k-means++ seeding (host): first center uniform over valid
+    rows, then each next center sampled ∝ current squared distance."""
+    valid = np.flatnonzero(w > 0)
+    if len(valid) < k:
+        raise ValueError(f"k={k} exceeds the {len(valid)} valid rows")
+    centers = [X[rng.choice(valid)]]
+    d2 = None
+    for _ in range(k - 1):
+        diff = X[valid] - centers[-1]
+        nd2 = np.sum(diff * diff, axis=1)
+        d2 = nd2 if d2 is None else np.minimum(d2, nd2)
+        total = d2.sum()
+        if total <= 0:          # all remaining mass at existing centers
+            extra = rng.choice(valid, size=k - len(centers), replace=False)
+            centers.extend(X[i] for i in extra)
+            break
+        centers.append(X[valid[rng.choice(len(valid), p=d2 / total)]])
+    return np.stack(centers[:k])
+
+
+@persistable
+class KMeans(Estimator):
+    """MLlib ``KMeans`` surface: ``setK/setMaxIter/setTol/setSeed/
+    setInitMode/setFeaturesCol/setPredictionCol`` + ``fit(frame[, mesh])``."""
+
+    _persist_attrs = ('k', 'max_iter', 'tol', 'seed', 'init_mode',
+                      'features_col', 'prediction_col')
+
+    def __init__(self, k: int = 2, max_iter: int = 20, tol: float = 1e-4,
+                 seed: int = 0, init_mode: str = "k-means||",
+                 features_col: str = "features",
+                 prediction_col: str = "prediction"):
+        if k < 1:
+            raise ValueError("k must be >= 1")
+        if init_mode not in ("k-means||", "k-means++", "random"):
+            raise ValueError(f"init_mode={init_mode!r}")
+        self.k = int(k)
+        self.max_iter = int(max_iter)
+        self.tol = float(tol)
+        self.seed = int(seed)
+        self.init_mode = init_mode
+        self.features_col = features_col
+        self.prediction_col = prediction_col
+
+    def set_k(self, v):
+        if v < 1:
+            raise ValueError("k must be >= 1")
+        self.k = int(v)
+        return self
+
+    setK = set_k
+
+    def set_max_iter(self, v):
+        self.max_iter = int(v)
+        return self
+
+    setMaxIter = set_max_iter
+
+    def set_tol(self, v):
+        self.tol = float(v)
+        return self
+
+    setTol = set_tol
+
+    def set_seed(self, v):
+        self.seed = int(v)
+        return self
+
+    setSeed = set_seed
+
+    def set_init_mode(self, v):
+        if v not in ("k-means||", "k-means++", "random"):
+            raise ValueError(f"init_mode={v!r}")
+        self.init_mode = v
+        return self
+
+    setInitMode = set_init_mode
+
+    def set_features_col(self, v):
+        self.features_col = v
+        return self
+
+    setFeaturesCol = set_features_col
+
+    def set_prediction_col(self, v):
+        self.prediction_col = v
+        return self
+
+    setPredictionCol = set_prediction_col
+
+    def get_k(self):
+        return self.k
+
+    getK = get_k
+
+    def fit(self, frame: Frame, mesh=None) -> "KMeansModel":
+        dt = np.dtype(float_dtype())
+        X = np.asarray(frame._column_values(self.features_col), dt)
+        if X.ndim == 1:
+            X = X[:, None]
+        w = np.asarray(frame.mask, dt)
+
+        rng = np.random.default_rng(self.seed)
+        if self.init_mode == "random":
+            valid = np.flatnonzero(w > 0)
+            if len(valid) < self.k:
+                raise ValueError(
+                    f"k={self.k} exceeds the {len(valid)} valid rows")
+            centers0 = X[rng.choice(valid, size=self.k, replace=False)]
+        else:  # k-means|| / k-means++ → greedy k-means++ seeding
+            centers0 = _kmeans_pp_init(X, w, self.k, rng)
+
+        if mesh is not None:
+            n_shards = mesh.devices.size
+            rem = (-X.shape[0]) % n_shards
+            if rem:
+                X = np.concatenate([X, np.zeros((rem, X.shape[1]), dt)])
+                w = np.concatenate([w, np.zeros((rem,), dt)])
+            shard = NamedSharding(mesh, P(DATA_AXIS))
+            Xd = jax.device_put(X, shard)
+            wd = jax.device_put(w, shard)
+        else:
+            Xd, wd = jnp.asarray(X), jnp.asarray(w)
+
+        fit_fn = _fit_cached(mesh, self.k, self.max_iter, self.tol)
+        centers, cost, iters, counts = jax.block_until_ready(
+            fit_fn(Xd, wd, jnp.asarray(centers0)))
+        return KMeansModel(np.asarray(centers), self.features_col,
+                           self.prediction_col, float(cost), int(iters),
+                           np.asarray(counts).astype(np.int64).tolist())
+
+
+@persistable
+class KMeansModel(Model):
+    """Fitted centers + the MLlib model surface: ``transform`` (nearest
+    center as the prediction column), ``clusterCenters``, ``summary``
+    (cluster sizes, training cost, iterations), ``predict`` (host scalar
+    path, like ``LinearRegressionModel.predict``)."""
+
+    _persist_attrs = ('centers', 'features_col', 'prediction_col',
+                      'training_cost', 'num_iters', 'cluster_sizes')
+
+    def __init__(self, centers, features_col, prediction_col,
+                 training_cost=float("nan"), num_iters=0,
+                 cluster_sizes=None):
+        self.centers = np.asarray(centers)
+        self.features_col = features_col
+        self.prediction_col = prediction_col
+        self.training_cost = training_cost
+        self.num_iters = num_iters
+        self.cluster_sizes = cluster_sizes or []
+
+    def cluster_centers(self):
+        return [c for c in self.centers]
+
+    clusterCenters = cluster_centers
+
+    @property
+    def k(self):
+        return self.centers.shape[0]
+
+    def _distances(self, X):
+        C = jnp.asarray(self.centers, X.dtype)
+        x_sq = jnp.sum(X * X, axis=1, keepdims=True)
+        c_sq = jnp.sum(C * C, axis=1)
+        return x_sq - 2.0 * (X @ C.T) + c_sq[None, :]
+
+    def transform(self, frame: Frame) -> Frame:
+        X = jnp.asarray(frame._column_values(self.features_col),
+                        float_dtype())
+        if X.ndim == 1:
+            X = X[:, None]
+        pred = jnp.argmin(self._distances(X), axis=1).astype(float_dtype())
+        return frame.with_column(self.prediction_col, pred)
+
+    def predict(self, features) -> int:
+        x = np.asarray(features, np.dtype(float_dtype())).reshape(1, -1)
+        return int(np.asarray(jnp.argmin(self._distances(jnp.asarray(x)))))
+
+    def compute_cost(self, frame: Frame) -> float:
+        """Weighted SSE to nearest center over valid rows (MLlib 2.x
+        ``computeCost``)."""
+        X = jnp.asarray(frame._column_values(self.features_col),
+                        float_dtype())
+        if X.ndim == 1:
+            X = X[:, None]
+        w = frame.mask.astype(X.dtype)
+        best = jnp.min(self._distances(X), axis=1)
+        return float(jnp.sum(jnp.maximum(best, 0.0) * w))
+
+    computeCost = compute_cost
+
+    @property
+    def summary(self):
+        return KMeansSummary(self)
+
+    @property
+    def has_summary(self):
+        return True
+
+    hasSummary = has_summary
+
+
+class KMeansSummary:
+    """MLlib ``KMeansSummary``: k, cluster sizes, training cost, iterations."""
+
+    def __init__(self, model: KMeansModel):
+        self._model = model
+
+    @property
+    def k(self):
+        return self._model.k
+
+    @property
+    def cluster_sizes(self):
+        return list(self._model.cluster_sizes)
+
+    clusterSizes = cluster_sizes
+
+    @property
+    def training_cost(self):
+        return self._model.training_cost
+
+    trainingCost = training_cost
+
+    @property
+    def num_iter(self):
+        return self._model.num_iters
+
+    numIter = num_iter

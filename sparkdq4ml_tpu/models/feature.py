@@ -261,7 +261,10 @@ class Bucketizer(Transformer):
         # the last bucket; outside [splits[0], splits[-1]] is invalid.
         idx = jnp.clip(jnp.searchsorted(jnp.asarray(s), x, side="right") - 1,
                        0, len(s) - 2).astype(float_dtype())
-        invalid = jnp.logical_or(x < s[0], x > s[-1])
+        # NaN is invalid too (it compares false to both bounds, and Spark
+        # routes it through handleInvalid rather than into a bucket)
+        invalid = jnp.logical_or(jnp.logical_or(x < s[0], x > s[-1]),
+                                 jnp.isnan(x))
         if self.handle_invalid == "error":
             if bool(np.asarray(jnp.logical_and(invalid, frame.mask)).any()):
                 raise ValueError("Bucketizer: values outside splits; set "
@@ -483,3 +486,333 @@ class MaxAbsScalerModel(Model):
                        1.0 / np.where(self.max_abs > 0, self.max_abs, 1.0), 0.0)
         X = X * jnp.asarray(inv, X.dtype)
         return frame.with_column(self.output_col, X[:, 0] if squeeze else X)
+
+
+@persistable
+class Imputer(Estimator):
+    """MLlib ``Imputer``: replace missing values (NaN by default, or a
+    configured ``missing_value`` sentinel) in numeric columns with the
+    column's mean / median / mode, learned over valid rows only.
+
+    Statistics are computed at the host boundary (median/mode are sort- and
+    histogram-shaped, not device hot loops); the transform itself is a device
+    ``jnp.where`` per column, fused by XLA with downstream ops.
+    """
+
+    _persist_attrs = ('input_cols', 'output_cols', 'strategy',
+                      'missing_value')
+
+    def __init__(self, input_cols: Optional[Sequence[str]] = None,
+                 output_cols: Optional[Sequence[str]] = None,
+                 strategy: str = "mean", missing_value: float = float("nan")):
+        self.input_cols = list(input_cols) if input_cols else []
+        self.output_cols = list(output_cols) if output_cols else []
+        if strategy not in ("mean", "median", "mode"):
+            raise ValueError(f"strategy={strategy!r} (mean|median|mode)")
+        self.strategy = strategy
+        self.missing_value = float(missing_value)
+
+    def set_input_cols(self, v):
+        self.input_cols = list(v)
+        return self
+
+    setInputCols = set_input_cols
+
+    def set_output_cols(self, v):
+        self.output_cols = list(v)
+        return self
+
+    setOutputCols = set_output_cols
+
+    def set_strategy(self, v):
+        if v not in ("mean", "median", "mode"):
+            raise ValueError(f"strategy={v!r}")
+        self.strategy = v
+        return self
+
+    setStrategy = set_strategy
+
+    def set_missing_value(self, v):
+        self.missing_value = float(v)
+        return self
+
+    setMissingValue = set_missing_value
+
+    def _out_cols(self):
+        return self.output_cols or self.input_cols
+
+    def fit(self, frame) -> "ImputerModel":
+        if not self.input_cols:
+            raise ValueError("Imputer: input_cols not set")
+        if self.output_cols and len(self.output_cols) != len(self.input_cols):
+            raise ValueError("output_cols length must match input_cols")
+        mask = np.asarray(frame.mask)
+        surrogates = []
+        for name in self.input_cols:
+            x = np.asarray(frame._column_values(name), np.float64)[mask]
+            miss = np.isnan(x) if np.isnan(self.missing_value) \
+                else (x == self.missing_value)
+            vals = x[~miss & ~np.isnan(x)]
+            if len(vals) == 0:
+                raise ValueError(f"Imputer: column {name!r} has no valid "
+                                 "values to learn a surrogate from")
+            if self.strategy == "mean":
+                s = float(vals.mean())
+            elif self.strategy == "median":
+                s = float(np.median(vals))
+            else:  # mode: most frequent, smallest on ties (Spark)
+                uniq, cnt = np.unique(vals, return_counts=True)
+                s = float(uniq[np.argmax(cnt)])
+            surrogates.append(s)
+        return ImputerModel(self.input_cols, self._out_cols(),
+                            surrogates, self.missing_value)
+
+
+@persistable
+class ImputerModel(Model):
+    _persist_attrs = ('input_cols', 'output_cols', 'surrogates',
+                      'missing_value')
+
+    def __init__(self, input_cols, output_cols, surrogates, missing_value):
+        self.input_cols = list(input_cols)
+        self.output_cols = list(output_cols)
+        self.surrogates = [float(s) for s in surrogates]
+        self.missing_value = float(missing_value)
+
+    @property
+    def surrogate_df(self):
+        """The learned surrogates as a 1-row Frame (MLlib surrogateDF)."""
+        from ..frame import Frame
+
+        return Frame({c: [s] for c, s in zip(self.input_cols,
+                                             self.surrogates)})
+
+    surrogateDF = surrogate_df
+
+    def transform(self, frame):
+        for name, out, s in zip(self.input_cols, self.output_cols,
+                                self.surrogates):
+            x = jnp.asarray(frame._column_values(name), float_dtype())
+            # NaN (the engine's null) is always missing — Spark imputes
+            # nulls regardless of the configured missingValue sentinel
+            miss = jnp.isnan(x)
+            if not np.isnan(self.missing_value):
+                miss = jnp.logical_or(miss, x == self.missing_value)
+            frame = frame.with_column(out,
+                                      jnp.where(miss, jnp.asarray(s, x.dtype),
+                                                x))
+        return frame
+
+
+@persistable
+class Normalizer(Transformer):
+    """MLlib ``Normalizer``: scale each row of a vector column to unit
+    p-norm (default p=2). Zero rows stay zero. Pure device elementwise —
+    XLA fuses the norm and the divide into one kernel."""
+
+    _persist_attrs = ('input_col', 'output_col', 'p')
+
+    def __init__(self, input_col: str = "features",
+                 output_col: str = "normalized_features", p: float = 2.0):
+        self.input_col = input_col
+        self.output_col = output_col
+        if not p >= 1.0:
+            raise ValueError("p must be >= 1")
+        self.p = float(p)
+
+    def set_input_col(self, v):
+        self.input_col = v
+        return self
+
+    setInputCol = set_input_col
+
+    def set_output_col(self, v):
+        self.output_col = v
+        return self
+
+    setOutputCol = set_output_col
+
+    def set_p(self, v):
+        if not v >= 1.0:
+            raise ValueError("p must be >= 1")
+        self.p = float(v)
+        return self
+
+    setP = set_p
+
+    def transform(self, frame):
+        X = jnp.asarray(frame._column_values(self.input_col), float_dtype())
+        squeeze = X.ndim == 1
+        if squeeze:
+            X = X[:, None]
+        if self.p == float("inf"):
+            norm = jnp.max(jnp.abs(X), axis=1, keepdims=True)
+        elif self.p == 2.0:
+            norm = jnp.sqrt(jnp.sum(X * X, axis=1, keepdims=True))
+        elif self.p == 1.0:
+            norm = jnp.sum(jnp.abs(X), axis=1, keepdims=True)
+        else:
+            norm = jnp.sum(jnp.abs(X) ** self.p, axis=1,
+                           keepdims=True) ** (1.0 / self.p)
+        out = jnp.where(norm > 0, X / jnp.where(norm > 0, norm, 1.0), X)
+        return frame.with_column(self.output_col,
+                                 out[:, 0] if squeeze else out)
+
+
+@persistable
+class Binarizer(Transformer):
+    """MLlib ``Binarizer``: 1.0 where x > threshold else 0.0, on a scalar
+    or vector column (NaN compares false → 0.0, as Spark's codegen does)."""
+
+    _persist_attrs = ('threshold', 'input_col', 'output_col')
+
+    def __init__(self, threshold: float = 0.0, input_col: str = None,
+                 output_col: str = None):
+        self.threshold = float(threshold)
+        self.input_col = input_col
+        self.output_col = output_col
+
+    def set_threshold(self, v):
+        self.threshold = float(v)
+        return self
+
+    setThreshold = set_threshold
+
+    def set_input_col(self, v):
+        self.input_col = v
+        return self
+
+    setInputCol = set_input_col
+
+    def set_output_col(self, v):
+        self.output_col = v
+        return self
+
+    setOutputCol = set_output_col
+
+    def transform(self, frame):
+        x = jnp.asarray(frame._column_values(self.input_col), float_dtype())
+        out = jnp.where(x > self.threshold,
+                        jnp.asarray(1.0, x.dtype), jnp.asarray(0.0, x.dtype))
+        return frame.with_column(self.output_col, out)
+
+
+@persistable
+class PolynomialExpansion(Transformer):
+    """MLlib ``PolynomialExpansion``: expand an (n, d) vector column into
+    all monomials of total degree 1..``degree`` over the d features.
+
+    The monomial *plan* (which feature-index multisets to multiply) is a
+    tiny host-side enumeration; the expansion itself is one stacked device
+    product per monomial, fused by XLA — the MXU-friendly dense layout is
+    preserved (output is a single (n, D) matrix). Ordering: grouped by
+    degree, lexicographic within a degree (MLlib interleaves; the *set* of
+    monomials is identical, only column order differs — documented because
+    downstream fits are order-insensitive)."""
+
+    _persist_attrs = ('degree', 'input_col', 'output_col')
+
+    def __init__(self, degree: int = 2, input_col: str = "features",
+                 output_col: str = "poly_features"):
+        if degree < 1:
+            raise ValueError("degree must be >= 1")
+        self.degree = int(degree)
+        self.input_col = input_col
+        self.output_col = output_col
+
+    def set_degree(self, v):
+        if v < 1:
+            raise ValueError("degree must be >= 1")
+        self.degree = int(v)
+        return self
+
+    setDegree = set_degree
+
+    def set_input_col(self, v):
+        self.input_col = v
+        return self
+
+    setInputCol = set_input_col
+
+    def set_output_col(self, v):
+        self.output_col = v
+        return self
+
+    setOutputCol = set_output_col
+
+    def transform(self, frame):
+        from itertools import combinations_with_replacement
+
+        X = jnp.asarray(frame._column_values(self.input_col), float_dtype())
+        if X.ndim == 1:
+            X = X[:, None]
+        d = X.shape[1]
+        cols = []
+        for deg in range(1, self.degree + 1):
+            for combo in combinations_with_replacement(range(d), deg):
+                term = X[:, combo[0]]
+                for j in combo[1:]:
+                    term = term * X[:, j]
+                cols.append(term)
+        return frame.with_column(self.output_col, jnp.stack(cols, axis=1))
+
+
+@persistable
+class QuantileDiscretizer(Estimator):
+    """MLlib ``QuantileDiscretizer``: learn ``num_buckets`` quantile split
+    points over the valid rows and return a :class:`Bucketizer` with open
+    (±inf) outer splits. Exact quantiles (the reference engine's
+    approxQuantile relative-error knob is unnecessary at this scale);
+    duplicate quantiles collapse, so the fitted bucketizer may have fewer
+    buckets, exactly like Spark."""
+
+    _persist_attrs = ('num_buckets', 'input_col', 'output_col',
+                      'handle_invalid')
+
+    def __init__(self, num_buckets: int = 2, input_col: str = None,
+                 output_col: str = None, handle_invalid: str = "error"):
+        if num_buckets < 2:
+            raise ValueError("num_buckets must be >= 2")
+        self.num_buckets = int(num_buckets)
+        self.input_col = input_col
+        self.output_col = output_col
+        self.handle_invalid = handle_invalid
+
+    def set_num_buckets(self, v):
+        if v < 2:
+            raise ValueError("num_buckets must be >= 2")
+        self.num_buckets = int(v)
+        return self
+
+    setNumBuckets = set_num_buckets
+
+    def set_input_col(self, v):
+        self.input_col = v
+        return self
+
+    setInputCol = set_input_col
+
+    def set_output_col(self, v):
+        self.output_col = v
+        return self
+
+    setOutputCol = set_output_col
+
+    def set_handle_invalid(self, v):
+        self.handle_invalid = v
+        return self
+
+    setHandleInvalid = set_handle_invalid
+
+    def fit(self, frame) -> "Bucketizer":
+        mask = np.asarray(frame.mask)
+        x = np.asarray(frame._column_values(self.input_col),
+                       np.float64)[mask]
+        x = x[~np.isnan(x)]
+        if len(x) == 0:
+            raise ValueError("QuantileDiscretizer: no valid rows to fit on")
+        qs = np.quantile(x, np.linspace(0, 1, self.num_buckets + 1)[1:-1])
+        inner = np.unique(qs)  # duplicate quantiles collapse (Spark)
+        splits = [-float("inf"), *inner.tolist(), float("inf")]
+        return Bucketizer(splits, self.input_col, self.output_col,
+                          self.handle_invalid)

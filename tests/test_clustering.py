@@ -1,0 +1,147 @@
+"""KMeans + ClusteringEvaluator: single-device, sharded, masked, persisted.
+
+Parity oracle: sklearn.cluster.KMeans on the same data (SURVEY.md §4's
+cross-check pattern); sharded ≡ single-device on the fake 8-device CPU mesh.
+"""
+
+import numpy as np
+import pytest
+
+from sparkdq4ml_tpu import Frame, col
+from sparkdq4ml_tpu.models import (ClusteringEvaluator, KMeans, KMeansModel,
+                                   VectorAssembler)
+
+
+def three_blobs(n_per=50, seed=7):
+    rng = np.random.default_rng(seed)
+    centers = np.asarray([[0.0, 0.0], [10.0, 0.0], [0.0, 10.0]])
+    pts = np.concatenate([c + 0.5 * rng.normal(size=(n_per, 2))
+                          for c in centers])
+    f = Frame({"x": pts[:, 0].astype(np.float32),
+               "y": pts[:, 1].astype(np.float32)})
+    return VectorAssembler(["x", "y"], "features").transform(f), centers
+
+
+class TestKMeansFit:
+    def test_recovers_blob_centers(self):
+        f, true_centers = three_blobs()
+        model = KMeans(k=3, seed=1).fit(f)
+        got = np.sort(np.asarray(model.clusterCenters()), axis=0)
+        want = np.sort(true_centers, axis=0)
+        assert np.allclose(got, want, atol=0.5)
+
+    def test_summary_and_cost(self):
+        f, _ = three_blobs()
+        model = KMeans(k=3, seed=1).fit(f)
+        s = model.summary
+        assert s.k == 3
+        assert sorted(s.cluster_sizes) == [50, 50, 50]
+        assert s.training_cost == pytest.approx(model.compute_cost(f),
+                                                rel=1e-3)
+        assert 0 < s.num_iter <= 20
+
+    def test_transform_and_predict_agree(self):
+        f, _ = three_blobs()
+        model = KMeans(k=3, seed=1).fit(f)
+        out = model.transform(f).to_pydict()
+        assert set(np.unique(out["prediction"])) == {0.0, 1.0, 2.0}
+        i = 5
+        assert model.predict([out["x"][i], out["y"][i]]) == \
+            int(out["prediction"][i])
+
+    def test_masked_rows_do_not_vote(self):
+        f = Frame({"x": [0.0, 0.1, 5.0, 1000.0],
+                   "y": [0.0, 0.1, 5.0, 1000.0]})
+        f = VectorAssembler(["x", "y"], "features").transform(f)
+        f = f.filter(col("x") < 100.0)
+        model = KMeans(k=2, seed=0).fit(f)
+        centers = np.asarray(model.clusterCenters())
+        assert np.abs(centers).max() < 100.0  # outlier never pulled a center
+
+    def test_k_exceeds_rows_raises(self):
+        f = Frame({"x": [1.0, 2.0]})
+        f = VectorAssembler(["x"], "features").transform(f)
+        with pytest.raises(ValueError, match="exceeds"):
+            KMeans(k=5).fit(f)
+
+    def test_random_init_mode(self):
+        f, _ = three_blobs()
+        model = KMeans(k=3, seed=3, init_mode="random").fit(f)
+        assert len(model.clusterCenters()) == 3
+
+    def test_sklearn_parity_on_cost(self):
+        pytest.importorskip("sklearn")
+        from sklearn.cluster import KMeans as SkKMeans
+
+        f, _ = three_blobs()
+        d = f.to_pydict()
+        X = np.stack([d["x"], d["y"]], axis=1).astype(np.float64)
+        sk = SkKMeans(n_clusters=3, n_init=5, random_state=0).fit(X)
+        model = KMeans(k=3, seed=1, max_iter=50).fit(f)
+        assert model.compute_cost(f) == pytest.approx(sk.inertia_, rel=0.05)
+
+
+class TestShardedKMeans:
+    def test_sharded_equals_single_device(self):
+        from sparkdq4ml_tpu.parallel.mesh import make_mesh
+
+        f, _ = three_blobs(n_per=33)  # 99 rows: exercises shard padding
+        single = KMeans(k=3, seed=1).fit(f)
+        sharded = KMeans(k=3, seed=1).fit(f, mesh=make_mesh(8))
+        got = np.sort(np.asarray(sharded.clusterCenters()), axis=0)
+        want = np.sort(np.asarray(single.clusterCenters()), axis=0)
+        assert np.allclose(got, want, atol=1e-3)
+        assert sharded.training_cost == pytest.approx(single.training_cost,
+                                                      rel=1e-3)
+
+
+class TestClusteringEvaluator:
+    def test_good_clustering_scores_high(self):
+        f, _ = three_blobs()
+        model = KMeans(k=3, seed=1).fit(f)
+        score = ClusteringEvaluator().evaluate(model.transform(f))
+        assert score > 0.8
+
+    def test_bad_clustering_scores_lower(self):
+        f, _ = three_blobs()
+        good = ClusteringEvaluator().evaluate(
+            KMeans(k=3, seed=1).fit(f).transform(f))
+        bad = ClusteringEvaluator().evaluate(
+            KMeans(k=2, seed=1).fit(f).transform(f))
+        assert good > bad
+
+    def test_sklearn_silhouette_parity(self):
+        pytest.importorskip("sklearn")
+        from sklearn.metrics import silhouette_score
+
+        f, _ = three_blobs()
+        model = KMeans(k=3, seed=1).fit(f)
+        out = model.transform(f)
+        d = out.to_pydict()
+        X = np.stack([d["x"], d["y"]], axis=1).astype(np.float64)
+        # sklearn uses euclidean; Spark (and we) use squared euclidean —
+        # both should agree the clustering is strong, not numerically equal
+        ours = ClusteringEvaluator().evaluate(out)
+        theirs = silhouette_score(X, d["prediction"].astype(int))
+        assert ours > 0.8 and theirs > 0.7
+
+    def test_single_cluster_is_nan(self):
+        f, _ = three_blobs()
+        out = KMeans(k=1, seed=1).fit(f).transform(f)
+        assert np.isnan(ClusteringEvaluator().evaluate(out))
+
+
+class TestKMeansPersistence:
+    def test_model_roundtrip(self, tmp_path):
+        from sparkdq4ml_tpu.models.base import load_stage
+
+        f, _ = three_blobs()
+        model = KMeans(k=3, seed=1).fit(f)
+        path = str(tmp_path / "km")
+        model.save(path)
+        loaded = load_stage(path)
+        assert isinstance(loaded, KMeansModel)
+        assert np.allclose(np.asarray(loaded.clusterCenters()),
+                           np.asarray(model.clusterCenters()))
+        out = loaded.transform(f).to_pydict()
+        assert set(np.unique(out["prediction"])) == {0.0, 1.0, 2.0}

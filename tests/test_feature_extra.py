@@ -1,0 +1,162 @@
+"""Imputer, Normalizer, Binarizer, PolynomialExpansion, QuantileDiscretizer.
+
+Cross-checked against MLlib conventions: mean/median/mode surrogates over
+non-missing valid rows, unit p-norm rows (zero rows unchanged), x > threshold
+binarization, total-degree monomial expansion, quantile splits with ±inf ends.
+"""
+
+import numpy as np
+import pytest
+
+from sparkdq4ml_tpu import Frame, col
+from sparkdq4ml_tpu.models import (Binarizer, Imputer, Normalizer,
+                                   PolynomialExpansion, QuantileDiscretizer,
+                                   VectorAssembler)
+
+nan = float("nan")
+
+
+class TestImputer:
+    def test_mean_imputation(self):
+        f = Frame({"a": [1.0, nan, 3.0], "b": [10.0, 20.0, nan]})
+        model = Imputer(["a", "b"]).fit(f)
+        assert model.surrogates == pytest.approx([2.0, 15.0])
+        out = model.transform(f).to_pydict()
+        assert out["a"].tolist() == pytest.approx([1.0, 2.0, 3.0])
+        assert out["b"].tolist() == pytest.approx([10.0, 20.0, 15.0])
+
+    def test_median_and_mode(self):
+        f = Frame({"a": [1.0, 2.0, 100.0, nan]})
+        assert Imputer(["a"], strategy="median").fit(f).surrogates == \
+            pytest.approx([2.0])
+        g = Frame({"a": [5.0, 5.0, 7.0, 7.0, 3.0]})
+        # tie 5 vs 7 → smallest (Spark)
+        assert Imputer(["a"], strategy="mode").fit(g).surrogates == \
+            pytest.approx([5.0])
+
+    def test_sentinel_missing_value(self):
+        f = Frame({"a": [1.0, -1.0, 3.0]})
+        model = Imputer(["a"], missing_value=-1.0).fit(f)
+        out = model.transform(f).to_pydict()
+        assert out["a"].tolist() == pytest.approx([1.0, 2.0, 3.0])
+
+    def test_sentinel_still_imputes_nan(self):
+        # Spark imputes nulls regardless of the missingValue sentinel
+        f = Frame({"a": [1.0, -1.0, nan, 3.0]})
+        out = Imputer(["a"], missing_value=-1.0).fit(f).transform(f) \
+            .to_pydict()
+        assert out["a"].tolist() == pytest.approx([1.0, 2.0, 2.0, 3.0])
+
+    def test_output_cols_and_masked_rows(self):
+        f = Frame({"a": [1.0, nan, 99.0]}).filter(
+            np.asarray([True, True, False]))
+        model = Imputer(["a"], ["a_imp"]).fit(f)
+        assert model.surrogates == pytest.approx([1.0])  # 99 is masked out
+        out = model.transform(f)
+        assert "a_imp" in out.columns and "a" in out.columns
+
+    def test_surrogate_df(self):
+        f = Frame({"a": [2.0, 4.0]})
+        sdf = Imputer(["a"]).fit(f).surrogate_df
+        assert sdf.to_pydict()["a"].tolist() == pytest.approx([3.0])
+
+    def test_all_missing_raises(self):
+        f = Frame({"a": [nan, nan]})
+        with pytest.raises(ValueError, match="no valid"):
+            Imputer(["a"]).fit(f)
+
+
+class TestNormalizer:
+    def test_l2_rows(self):
+        f = Frame({"x": [3.0, 0.0], "y": [4.0, 0.0]})
+        f = VectorAssembler(["x", "y"], "v").transform(f)
+        out = Normalizer("v", "nv").transform(f).to_pydict()
+        assert out["nv"][0].tolist() == pytest.approx([0.6, 0.8])
+        assert out["nv"][1].tolist() == pytest.approx([0.0, 0.0])  # zero row
+
+    def test_l1_and_inf(self):
+        f = Frame({"x": [1.0], "y": [-3.0]})
+        f = VectorAssembler(["x", "y"], "v").transform(f)
+        l1 = Normalizer("v", "o", p=1.0).transform(f).to_pydict()["o"][0]
+        assert l1.tolist() == pytest.approx([0.25, -0.75])
+        linf = Normalizer("v", "o", p=float("inf")).transform(f) \
+            .to_pydict()["o"][0]
+        assert linf.tolist() == pytest.approx([1 / 3, -1.0])
+
+
+class TestBinarizer:
+    def test_threshold(self):
+        f = Frame({"x": [0.1, 0.5, 0.9, nan]})
+        out = Binarizer(0.5, "x", "b").transform(f).to_pydict()
+        assert out["b"].tolist() == [0.0, 0.0, 1.0, 0.0]  # NaN → 0 (Spark)
+
+
+class TestPolynomialExpansion:
+    def test_degree2_two_features(self):
+        f = Frame({"x": [2.0], "y": [3.0]})
+        f = VectorAssembler(["x", "y"], "v").transform(f)
+        out = PolynomialExpansion(2, "v", "p").transform(f).to_pydict()
+        # degree 1: x, y; degree 2: x², xy, y²
+        assert sorted(out["p"][0].tolist()) == pytest.approx(
+            sorted([2.0, 3.0, 4.0, 6.0, 9.0]))
+
+    def test_degree3_count(self):
+        f = Frame({"x": [1.0], "y": [1.0]})
+        f = VectorAssembler(["x", "y"], "v").transform(f)
+        out = PolynomialExpansion(3, "v", "p").transform(f).to_pydict()
+        # C(2+1-1,1)+C(2+2-1,2)+C(2+3-1,3) = 2+3+4 = 9 monomials
+        assert len(out["p"][0]) == 9
+
+    def test_scalar_column(self):
+        f = Frame({"x": [2.0]})
+        out = PolynomialExpansion(3, "x", "p").transform(f).to_pydict()
+        assert out["p"][0].tolist() == pytest.approx([2.0, 4.0, 8.0])
+
+
+class TestQuantileDiscretizer:
+    def test_buckets(self):
+        f = Frame({"x": [float(i) for i in range(100)]})
+        bucketizer = QuantileDiscretizer(4, "x", "q").fit(f)
+        out = bucketizer.transform(f).to_pydict()
+        counts = np.bincount(out["q"].astype(int))
+        assert len(counts) == 4 and all(20 <= c <= 30 for c in counts)
+
+    def test_open_ends_cover_unseen_values(self):
+        f = Frame({"x": [1.0, 2.0, 3.0, 4.0]})
+        b = QuantileDiscretizer(2, "x", "q").fit(f)
+        far = Frame({"x": [-1000.0, 1000.0]})
+        out = b.transform(far).to_pydict()
+        assert out["q"].tolist() == [0.0, 1.0]
+
+    def test_duplicate_quantiles_collapse(self):
+        f = Frame({"x": [1.0] * 50 + [2.0]})
+        b = QuantileDiscretizer(4, "x", "q").fit(f)
+        assert len(b.splits) < 6  # fewer buckets than requested
+
+    def test_fit_ignores_masked_rows(self):
+        f = Frame({"x": [1.0, 2.0, 3.0, 1000.0]}).filter(
+            col("x") < 100.0)
+        b = QuantileDiscretizer(2, "x", "q").fit(f)
+        assert b.splits[1] == pytest.approx(2.0)
+
+
+class TestPersistence:
+    def test_imputer_model_roundtrip(self, tmp_path):
+        from sparkdq4ml_tpu.models.base import load_stage
+
+        f = Frame({"a": [1.0, nan, 3.0]})
+        model = Imputer(["a"]).fit(f)
+        path = str(tmp_path / "imp")
+        model.save(path)
+        loaded = load_stage(path)
+        out = loaded.transform(f).to_pydict()
+        assert out["a"].tolist() == pytest.approx([1.0, 2.0, 3.0])
+
+    def test_normalizer_roundtrip(self, tmp_path):
+        from sparkdq4ml_tpu.models.base import load_stage
+
+        t = Normalizer("v", "nv", p=1.0)
+        path = str(tmp_path / "norm")
+        t.save(path)
+        loaded = load_stage(path)
+        assert loaded.p == 1.0 and loaded.input_col == "v"
