@@ -1,7 +1,8 @@
 from .base import Estimator, Model, Pipeline, PipelineModel, Transformer
 from .classification import (BinaryLogisticRegressionSummary,
                              BinaryLogisticRegressionTrainingSummary,
-                             LogisticRegression, LogisticRegressionModel)
+                             LogisticRegression, LogisticRegressionModel,
+                             NaiveBayes, NaiveBayesModel)
 from .clustering import KMeans, KMeansModel, KMeansSummary
 from .evaluation import (BinaryClassificationEvaluator, ClusteringEvaluator,
                          Evaluator, MulticlassClassificationEvaluator,
@@ -9,7 +10,8 @@ from .evaluation import (BinaryClassificationEvaluator, ClusteringEvaluator,
 from .feature import (Binarizer, Bucketizer, Imputer, ImputerModel,
                       IndexToString, MaxAbsScaler, MaxAbsScalerModel,
                       MinMaxScaler, MinMaxScalerModel, Normalizer,
-                      OneHotEncoder, OneHotEncoderModel, PolynomialExpansion,
+                      OneHotEncoder, OneHotEncoderModel, PCA, PCAModel,
+                      PolynomialExpansion,
                       QuantileDiscretizer, StandardScaler,
                       StandardScalerModel, StringIndexer, StringIndexerModel,
                       VectorAssembler)

@@ -460,3 +460,184 @@ class BinaryLogisticRegressionTrainingSummary(BinaryLogisticRegressionSummary):
         return self._objective_history
 
     objectiveHistory = objective_history
+
+
+# ---------------------------------------------------------------------------
+# NaiveBayes (MLlib org.apache.spark.ml.classification.NaiveBayes)
+# ---------------------------------------------------------------------------
+
+@jax.jit
+def _nb_sufficient_stats(X, y, w, num_classes_onehot):
+    """Per-class label counts and feature sums — one masked one-hot matmul
+    (MXU), the whole NaiveBayes 'fit pass' in a single fused kernel."""
+    onehot = jax.nn.one_hot(y.astype(jnp.int32), num_classes_onehot.shape[0],
+                            dtype=X.dtype) * w[:, None]    # (n, k)
+    class_count = jnp.sum(onehot, axis=0)                  # (k,)
+    feat_sum = onehot.T @ X                                # (k, d)
+    return class_count, feat_sum
+
+
+@persistable
+class NaiveBayes(Estimator):
+    """MLlib ``NaiveBayes``: multinomial (default) or bernoulli model with
+    Laplace ``smoothing`` (default 1.0). Labels must be 0..k-1 doubles (the
+    StringIndexer convention); multinomial requires nonnegative features,
+    bernoulli requires 0/1 features — both validated like Spark.
+
+    TPU-first: the entire fit is one one-hot matmul for the per-class
+    sufficient statistics (no per-row loop), and prediction is
+    ``pi + X @ thetaᵀ`` — a single MXU matmul batched over rows."""
+
+    _persist_attrs = ('smoothing', 'model_type', 'features_col', 'label_col',
+                      'prediction_col', 'probability_col',
+                      'raw_prediction_col')
+
+    def __init__(self, smoothing: float = 1.0, model_type: str = "multinomial",
+                 features_col: str = "features", label_col: str = "label",
+                 prediction_col: str = "prediction",
+                 probability_col: str = "probability",
+                 raw_prediction_col: str = "rawPrediction"):
+        if model_type not in ("multinomial", "bernoulli"):
+            raise ValueError(f"model_type={model_type!r}")
+        if smoothing < 0:
+            raise ValueError("smoothing must be >= 0")
+        self.smoothing = float(smoothing)
+        self.model_type = model_type
+        self.features_col = features_col
+        self.label_col = label_col
+        self.prediction_col = prediction_col
+        self.probability_col = probability_col
+        self.raw_prediction_col = raw_prediction_col
+
+    def set_smoothing(self, v):
+        if v < 0:
+            raise ValueError("smoothing must be >= 0")
+        self.smoothing = float(v)
+        return self
+
+    setSmoothing = set_smoothing
+
+    def set_model_type(self, v):
+        if v not in ("multinomial", "bernoulli"):
+            raise ValueError(f"model_type={v!r}")
+        self.model_type = v
+        return self
+
+    setModelType = set_model_type
+
+    def set_features_col(self, v):
+        self.features_col = v
+        return self
+
+    setFeaturesCol = set_features_col
+
+    def set_label_col(self, v):
+        self.label_col = v
+        return self
+
+    setLabelCol = set_label_col
+
+    def fit(self, frame: Frame) -> "NaiveBayesModel":
+        dt = np.dtype(float_dtype())
+        X = np.asarray(frame._column_values(self.features_col), dt)
+        if X.ndim == 1:
+            X = X[:, None]
+        y = np.asarray(frame._column_values(self.label_col), dt)
+        mask = np.asarray(frame.mask)
+        yv = y[mask]
+        if len(yv) == 0:
+            raise ValueError("NaiveBayes: no valid rows")
+        if np.any(yv < 0) or np.any(yv != np.floor(yv)):
+            raise ValueError("labels must be nonnegative integers 0..k-1")
+        num_classes = int(yv.max()) + 1
+        Xv = X[mask]
+        if self.model_type == "multinomial":
+            if not np.all(Xv >= 0):   # NaN fails >= too (Spark rejects it)
+                raise ValueError("multinomial NaiveBayes requires "
+                                 "nonnegative features")
+        else:
+            if not np.all((Xv == 0) | (Xv == 1)):
+                raise ValueError("bernoulli NaiveBayes requires 0/1 features")
+
+        Xd = jnp.asarray(X) if self.model_type == "multinomial" \
+            else jnp.asarray((X > 0).astype(dt))
+        w = frame.mask.astype(Xd.dtype)
+        class_count, feat_sum = _nb_sufficient_stats(
+            Xd, jnp.asarray(y), w, jnp.zeros((num_classes,)))
+        class_count = np.asarray(class_count, np.float64)
+        feat_sum = np.asarray(feat_sum, np.float64)
+        lam = self.smoothing
+        n = class_count.sum()
+        pi = np.log(class_count + lam) - np.log(n + num_classes * lam)
+        if self.model_type == "multinomial":
+            # log P(feature j | class c), normalized over the feature axis
+            row_tot = feat_sum.sum(axis=1, keepdims=True)
+            theta = np.log(feat_sum + lam) \
+                - np.log(row_tot + lam * X.shape[1])
+        else:
+            # log P(x_j = 1 | class c); the complement handled at predict
+            theta = np.log(feat_sum + lam) \
+                - np.log(class_count[:, None] + 2.0 * lam)
+        return NaiveBayesModel(pi, theta, self.model_type,
+                               self._params_dict())
+
+    def _params_dict(self):
+        return {k: getattr(self, k) for k in (
+            "smoothing", "model_type", "features_col", "label_col",
+            "prediction_col", "probability_col", "raw_prediction_col")}
+
+
+@persistable
+class NaiveBayesModel(Model):
+    """``pi`` (k,) log class priors; ``theta`` (k, d) log feature
+    likelihoods. Prediction is one matmul; bernoulli adds the complement
+    term exactly as MLlib's BernoulliNB does."""
+
+    _persist_attrs = ('pi', 'theta', 'model_type', '_params')
+
+    def __init__(self, pi, theta, model_type, params=None):
+        self.pi = np.asarray(pi)
+        self.theta = np.asarray(theta)
+        self.model_type = model_type
+        self._params = dict(params or {})
+
+    @property
+    def num_classes(self):
+        return int(self.pi.shape[0])
+
+    numClasses = num_classes
+
+    @property
+    def num_features(self):
+        return int(self.theta.shape[1])
+
+    numFeatures = num_features
+
+    def _raw(self, X):
+        pi = jnp.asarray(self.pi, X.dtype)
+        theta = jnp.asarray(self.theta, X.dtype)
+        if self.model_type == "multinomial":
+            return pi + X @ theta.T
+        Xb = (X > 0).astype(X.dtype)
+        neg = jnp.log1p(-jnp.exp(jnp.minimum(theta, -1e-7)))   # log(1-p)
+        return pi + jnp.sum(neg, axis=1) + Xb @ (theta - neg).T
+
+    def transform(self, frame: Frame) -> Frame:
+        p = self._params
+        X = jnp.asarray(frame._column_values(p.get("features_col",
+                                                   "features")),
+                        float_dtype())
+        if X.ndim == 1:
+            X = X[:, None]
+        raw = self._raw(X)
+        prob = jax.nn.softmax(raw, axis=1)
+        pred = jnp.argmax(raw, axis=1).astype(float_dtype())
+        out = frame.with_column(p.get("raw_prediction_col", "rawPrediction"),
+                                raw)
+        out = out.with_column(p.get("probability_col", "probability"), prob)
+        return out.with_column(p.get("prediction_col", "prediction"), pred)
+
+    def predict(self, features) -> float:
+        x = jnp.asarray(np.asarray(features,
+                                   np.dtype(float_dtype())).reshape(1, -1))
+        return float(np.asarray(jnp.argmax(self._raw(x), axis=1))[0])

@@ -270,7 +270,11 @@ class Bucketizer(Transformer):
                 raise ValueError("Bucketizer: values outside splits; set "
                                  "handle_invalid='keep' or 'skip'")
         elif self.handle_invalid == "keep":
-            idx = jnp.where(invalid, jnp.asarray(jnp.nan, float_dtype()), idx)
+            # Spark's 'keep': invalid values land in a special extra bucket
+            # with index numBuckets (= len(splits) - 1)
+            idx = jnp.where(invalid,
+                            jnp.asarray(float(len(s) - 1), float_dtype()),
+                            idx)
         out = frame.with_column(self.output_col, idx)
         if self.handle_invalid == "skip":
             out = out.filter(jnp.logical_not(invalid))
@@ -816,3 +820,92 @@ class QuantileDiscretizer(Estimator):
         splits = [-float("inf"), *inner.tolist(), float("inf")]
         return Bucketizer(splits, self.input_col, self.output_col,
                           self.handle_invalid)
+
+
+@persistable
+class PCA(Estimator):
+    """MLlib ``PCA``: learn the top-k principal components of a vector
+    column. Fit is one masked covariance (a single MXU matmul over the
+    row-sharded data, psum-reduced under a mesh) + a device ``eigh`` on the
+    tiny (d, d) matrix. Transform follows MLlib exactly: rows are projected
+    onto the components **without** mean subtraction (Spark's documented
+    behavior — the components themselves come from the centered covariance,
+    but ``transform`` multiplies raw rows)."""
+
+    _persist_attrs = ('k', 'input_col', 'output_col')
+
+    def __init__(self, k: int = None, input_col: str = "features",
+                 output_col: str = "pca_features"):
+        self.k = k
+        self.input_col = input_col
+        self.output_col = output_col
+
+    def set_k(self, v):
+        self.k = int(v)
+        return self
+
+    setK = set_k
+
+    def set_input_col(self, v):
+        self.input_col = v
+        return self
+
+    setInputCol = set_input_col
+
+    def set_output_col(self, v):
+        self.output_col = v
+        return self
+
+    setOutputCol = set_output_col
+
+    def fit(self, frame) -> "PCAModel":
+        if not self.k or self.k < 1:
+            raise ValueError("PCA: k must be a positive integer")
+        X = jnp.asarray(frame._column_values(self.input_col), float_dtype())
+        if X.ndim == 1:
+            X = X[:, None]
+        d = X.shape[1]
+        if self.k > d:
+            raise ValueError(f"k={self.k} exceeds the {d} input features")
+        if int(np.asarray(frame.mask).sum()) == 0:
+            raise ValueError("PCA: no valid rows to fit on")
+        w = frame.mask.astype(X.dtype)
+        n = jnp.sum(w)
+        mean = jnp.sum(X * w[:, None], axis=0) / n
+        C = (X - mean) * w[:, None]
+        cov = (C.T @ C) / jnp.maximum(n - 1.0, 1.0)      # sample covariance
+        vals, vecs = jnp.linalg.eigh(cov)                # ascending order
+        vals = vals[::-1][: self.k]
+        vecs = vecs[:, ::-1][:, : self.k]                # (d, k) columns
+        # deterministic sign: largest-|.| element of each component positive
+        vecs_np = np.asarray(vecs)
+        signs = np.sign(vecs_np[np.argmax(np.abs(vecs_np), axis=0),
+                                np.arange(self.k)])
+        signs[signs == 0] = 1.0
+        total = float(jnp.sum(jnp.clip(jnp.diagonal(cov), 0.0, None)))
+        ev = np.clip(np.asarray(vals), 0.0, None)
+        ratios = ev / total if total > 0 else np.zeros_like(ev)
+        return PCAModel(vecs_np * signs, ratios, self.k,
+                        self.input_col, self.output_col)
+
+
+@persistable
+class PCAModel(Model):
+    _persist_attrs = ('pc', 'explained_variance', 'k', 'input_col',
+                      'output_col')
+
+    def __init__(self, pc, explained_variance, k, input_col, output_col):
+        self.pc = np.asarray(pc)                         # (d, k)
+        self.explained_variance = np.asarray(explained_variance)
+        self.k = int(k)
+        self.input_col = input_col
+        self.output_col = output_col
+
+    explainedVariance = property(lambda self: self.explained_variance)
+
+    def transform(self, frame):
+        X = jnp.asarray(frame._column_values(self.input_col), float_dtype())
+        if X.ndim == 1:
+            X = X[:, None]
+        return frame.with_column(self.output_col,
+                                 X @ jnp.asarray(self.pc, X.dtype))

@@ -111,7 +111,11 @@ class TestBucketizer:
             b.transform(f)
         b.handle_invalid = "keep"
         got = np.asarray(b.transform(f)._column_values("b"))
-        assert got[0] == 0.0 and np.isnan(got[1])
+        # Spark 'keep': invalid → the extra bucket numBuckets (=2 here)
+        assert got[0] == 0.0 and got[1] == 2.0
+        nan_in = Frame({"x": [float("nan")]})
+        got_nan = np.asarray(b.transform(nan_in)._column_values("b"))
+        assert got_nan[0] == 2.0
         b.handle_invalid = "skip"
         assert b.transform(f).count() == 1
 

@@ -1,0 +1,179 @@
+"""PCA and NaiveBayes — sklearn as the independent parity oracle
+(SURVEY.md §4 cross-check pattern)."""
+
+import numpy as np
+import pytest
+
+from sparkdq4ml_tpu import Frame, col
+from sparkdq4ml_tpu.models import (NaiveBayes, NaiveBayesModel, PCA,
+                                   PCAModel, VectorAssembler)
+
+
+def correlated_frame(n=200, seed=3):
+    rng = np.random.default_rng(seed)
+    t = rng.normal(size=n)
+    x = t + 0.1 * rng.normal(size=n)
+    y = 2 * t + 0.1 * rng.normal(size=n)
+    z = rng.normal(size=n) * 0.5
+    f = Frame({"x": x.astype(np.float32), "y": y.astype(np.float32),
+               "z": z.astype(np.float32)})
+    return VectorAssembler(["x", "y", "z"], "features").transform(f)
+
+
+class TestPCA:
+    def test_sklearn_parity(self):
+        pytest.importorskip("sklearn")
+        from sklearn.decomposition import PCA as SkPCA
+
+        f = correlated_frame()
+        model = PCA(k=2).fit(f)
+        d = f.to_pydict()
+        X = np.stack([d["x"], d["y"], d["z"]], axis=1).astype(np.float64)
+        sk = SkPCA(n_components=2).fit(X)
+        ours = np.asarray(model.pc)                  # (d, k) columns
+        theirs = sk.components_.T                    # (d, k)
+        for j in range(2):                           # sign-invariant compare
+            assert min(np.abs(ours[:, j] - theirs[:, j]).max(),
+                       np.abs(ours[:, j] + theirs[:, j]).max()) < 2e-3
+        assert np.allclose(model.explained_variance /
+                           model.explained_variance.sum(),
+                           sk.explained_variance_ratio_ /
+                           sk.explained_variance_ratio_.sum(), atol=1e-3)
+
+    def test_transform_projects_raw_rows(self):
+        # MLlib convention: no mean subtraction in transform
+        f = correlated_frame(n=50)
+        model = PCA(k=2).fit(f)
+        out = model.transform(f).to_pydict()
+        d = f.to_pydict()
+        X = np.stack([d["x"], d["y"], d["z"]], axis=1)
+        want = X @ np.asarray(model.pc)
+        assert np.allclose(np.stack(out["pca_features"]), want, atol=1e-4)
+
+    def test_masked_rows_excluded_from_fit(self):
+        f = Frame({"x": [0.0, 1.0, 2.0, 1e6],
+                   "y": [0.0, 1.0, 2.0, -1e6]})
+        f = VectorAssembler(["x", "y"], "features").transform(f)
+        f = f.filter(col("x") < 100.0)
+        model = PCA(k=1).fit(f)
+        # without the outlier, x and y are perfectly correlated → pc ∝ (1,1)
+        pc = np.abs(np.asarray(model.pc)[:, 0])
+        assert pc[0] == pytest.approx(pc[1], abs=1e-3)
+
+    def test_k_validation(self):
+        f = correlated_frame(n=10)
+        with pytest.raises(ValueError, match="k"):
+            PCA(k=7).fit(f)
+        with pytest.raises(ValueError, match="k"):
+            PCA().fit(f)
+
+    def test_no_valid_rows_raises(self):
+        f = correlated_frame(n=10).filter(col("x") > 1e9)
+        with pytest.raises(ValueError, match="no valid"):
+            PCA(k=1).fit(f)
+
+    def test_roundtrip(self, tmp_path):
+        from sparkdq4ml_tpu.models.base import load_stage
+
+        f = correlated_frame(n=40)
+        model = PCA(k=2).fit(f)
+        model.save(str(tmp_path / "pca"))
+        loaded = load_stage(str(tmp_path / "pca"))
+        assert isinstance(loaded, PCAModel)
+        assert np.allclose(loaded.pc, model.pc)
+
+
+def count_frame(n=300, seed=11):
+    """Two classes with distinct multinomial feature profiles."""
+    rng = np.random.default_rng(seed)
+    y = (rng.random(n) < 0.4).astype(np.float64)
+    p0 = np.asarray([0.6, 0.3, 0.1])
+    p1 = np.asarray([0.1, 0.3, 0.6])
+    X = np.stack([rng.multinomial(20, p1 if c else p0) for c in y]) \
+        .astype(np.float32)
+    f = Frame({"f0": X[:, 0], "f1": X[:, 1], "f2": X[:, 2],
+               "label": y.astype(np.float32)})
+    return VectorAssembler(["f0", "f1", "f2"], "features").transform(f), X, y
+
+
+class TestNaiveBayes:
+    def test_multinomial_sklearn_parity(self):
+        pytest.importorskip("sklearn")
+        from sklearn.naive_bayes import MultinomialNB
+
+        f, X, y = count_frame()
+        model = NaiveBayes().fit(f)
+        sk = MultinomialNB(alpha=1.0).fit(X, y)
+        # MLlib smooths the class prior (unlike sklearn): log((n_c+λ)/(n+kλ))
+        counts = np.bincount(y.astype(int)).astype(np.float64)
+        want_pi = np.log(counts + 1.0) - np.log(counts.sum() + 2.0)
+        assert np.allclose(model.pi, want_pi, atol=1e-6)
+        assert np.allclose(model.theta, sk.feature_log_prob_, atol=1e-5)
+        out = model.transform(f).to_pydict()
+        agree = np.mean(out["prediction"] == sk.predict(X))
+        assert agree >= 0.98  # priors differ only by smoothing
+
+    def test_bernoulli_sklearn_parity(self):
+        pytest.importorskip("sklearn")
+        from sklearn.naive_bayes import BernoulliNB
+
+        rng = np.random.default_rng(5)
+        y = (rng.random(200) < 0.5).astype(np.float64)
+        X = (rng.random((200, 4)) < np.where(y[:, None], 0.8, 0.2)) \
+            .astype(np.float32)
+        f = Frame({f"f{j}": X[:, j] for j in range(4)})
+        f = f.with_column("label", np.asarray(y, np.float32))
+        f = VectorAssembler([f"f{j}" for j in range(4)],
+                            "features").transform(f)
+        model = NaiveBayes(model_type="bernoulli").fit(f)
+        sk = BernoulliNB(alpha=1.0).fit(X, y)
+        counts = np.bincount(y.astype(int)).astype(np.float64)
+        want_pi = np.log(counts + 1.0) - np.log(counts.sum() + 2.0)
+        assert np.allclose(model.pi, want_pi, atol=1e-6)
+        assert np.allclose(model.theta, sk.feature_log_prob_, atol=1e-5)
+        out = model.transform(f).to_pydict()
+        agree = np.mean(out["prediction"] == sk.predict(X))
+        assert agree >= 0.98
+
+    def test_probability_and_predict(self):
+        f, X, y = count_frame(n=100)
+        model = NaiveBayes().fit(f)
+        out = model.transform(f).to_pydict()
+        probs = np.stack(out["probability"])
+        assert np.allclose(probs.sum(axis=1), 1.0, atol=1e-5)
+        assert model.predict(X[0]) == out["prediction"][0]
+        assert model.num_classes == 2 and model.num_features == 3
+
+    def test_masked_rows_do_not_count(self):
+        f = Frame({"f0": [1.0, 1.0, 50.0], "label": [0.0, 1.0, 1.0]})
+        f = VectorAssembler(["f0"], "features").transform(f)
+        masked = f.filter(col("f0") < 10.0)
+        m1 = NaiveBayes().fit(masked)
+        f2 = Frame({"f0": [1.0, 1.0], "label": [0.0, 1.0]})
+        f2 = VectorAssembler(["f0"], "features").transform(f2)
+        m2 = NaiveBayes().fit(f2)
+        assert np.allclose(m1.pi, m2.pi) and np.allclose(m1.theta, m2.theta)
+
+    def test_validation(self):
+        f = Frame({"f0": [-1.0, 2.0], "label": [0.0, 1.0]})
+        f = VectorAssembler(["f0"], "features").transform(f)
+        with pytest.raises(ValueError, match="nonnegative"):
+            NaiveBayes().fit(f)
+        h = Frame({"f0": [1.0, float("nan")], "label": [0.0, 1.0]})
+        h = VectorAssembler(["f0"], "features").transform(h)
+        with pytest.raises(ValueError, match="nonnegative"):
+            NaiveBayes().fit(h)  # NaN must not slip through validation
+        g = Frame({"f0": [0.5, 1.0], "label": [0.0, 1.0]})
+        g = VectorAssembler(["f0"], "features").transform(g)
+        with pytest.raises(ValueError, match="0/1"):
+            NaiveBayes(model_type="bernoulli").fit(g)
+
+    def test_roundtrip(self, tmp_path):
+        from sparkdq4ml_tpu.models.base import load_stage
+
+        f, X, _ = count_frame(n=60)
+        model = NaiveBayes().fit(f)
+        model.save(str(tmp_path / "nb"))
+        loaded = load_stage(str(tmp_path / "nb"))
+        assert isinstance(loaded, NaiveBayesModel)
+        assert loaded.predict(X[0]) == model.predict(X[0])
