@@ -215,6 +215,192 @@ class Frame:
         f._mask = jnp.concatenate([self._mask, other._mask])
         return f
 
+    def union_by_name(self, other: "Frame",
+                      allow_missing_columns: bool = False) -> "Frame":
+        """``unionByName`` — union resolving columns by name, not position.
+        With ``allow_missing_columns`` the asymmetric columns null-fill."""
+        if allow_missing_columns:
+            both = list(dict.fromkeys(self.columns + other.columns))
+
+            def widen(frame):
+                out = frame
+                for name in both:
+                    if name not in frame.columns:
+                        ref_arr = (other if name in other.columns
+                                   else self)._data[name]
+                        if _is_string_col(ref_arr):
+                            fill = np.full((frame.num_slots,), None,
+                                           dtype=object)
+                        else:
+                            fill = jnp.full((frame.num_slots,), jnp.nan,
+                                            float_dtype())
+                        out = out.with_column(name, fill)
+                return out.select(*both)
+
+            return widen(self).union(widen(other))
+        if set(self.columns) != set(other.columns):
+            raise ValueError(
+                f"unionByName: column sets differ {self.columns} vs "
+                f"{other.columns}; pass allow_missing_columns=True")
+        return self.union(other.select(*self.columns))
+
+    unionByName = union_by_name
+
+    _NULL_KEY = "\0__null__"  # NaN stand-in so null rows hash/compare equal
+
+    def _keyed_rows(self):
+        """One host gather → [(hashable null-safe key, row), ...]. NaN (the
+        engine's null) maps to a sentinel so null rows match each other, as
+        Spark's null-safe set ops do."""
+        def norm(x):
+            if isinstance(x, np.ndarray):                 # vector cell
+                return tuple(norm(v) for v in x.tolist())
+            if hasattr(x, "item"):
+                x = x.item()
+            if isinstance(x, float) and x != x:
+                return Frame._NULL_KEY
+            return x
+
+        rows = self.collect()
+        return [(tuple(norm(x) for x in r), r) for r in rows]
+
+    def intersect(self, other: "Frame") -> "Frame":
+        """Distinct rows present in both frames (SQL INTERSECT, null-safe)."""
+        if self.columns != other.columns:
+            raise ValueError("intersect requires identical column lists")
+        theirs = {k for k, _ in other._keyed_rows()}
+        seen = set()
+        rows = []
+        for key, row in self._keyed_rows():
+            if key in theirs and key not in seen:
+                seen.add(key)
+                rows.append(row)
+        return Frame.from_rows(rows, self.columns)
+
+    def except_all(self, other: "Frame") -> "Frame":
+        """Rows of self not in other, preserving duplicates (EXCEPT ALL)."""
+        if self.columns != other.columns:
+            raise ValueError("exceptAll requires identical column lists")
+        from collections import Counter
+
+        budget = Counter(k for k, _ in other._keyed_rows())
+        rows = []
+        for key, row in self._keyed_rows():
+            if budget[key] > 0:
+                budget[key] -= 1
+            else:
+                rows.append(row)
+        return Frame.from_rows(rows, self.columns)
+
+    exceptAll = except_all
+
+    def subtract(self, other: "Frame") -> "Frame":
+        """Distinct rows of self not in other (SQL EXCEPT [DISTINCT])."""
+        if self.columns != other.columns:
+            raise ValueError("subtract requires identical column lists")
+        theirs = {k for k, _ in other._keyed_rows()}
+        seen = set()
+        rows = []
+        for key, row in self._keyed_rows():
+            if key not in theirs and key not in seen:
+                seen.add(key)
+                rows.append(row)
+        return Frame.from_rows(rows, self.columns)
+
+    def replace(self, to_replace, value=None, subset=None) -> "Frame":
+        """``df.replace`` — substitute exact values in [subset] columns.
+        Accepts a scalar pair, a list + scalar, or a {old: new} dict."""
+        if isinstance(to_replace, dict):
+            mapping = to_replace
+        elif isinstance(to_replace, (list, tuple)):
+            mapping = {v: value for v in to_replace}
+        else:
+            mapping = {to_replace: value}
+        cols = subset if subset is not None else self.columns
+        data = dict(self._data)
+        for name in cols:
+            arr = self._data[name]
+            if _is_string_col(arr):
+                str_map = {k: v for k, v in mapping.items()
+                           if isinstance(k, str)}
+                if str_map:
+                    data[name] = np.asarray(
+                        [str_map.get(x, x) for x in arr], dtype=object)
+            else:
+                num_map = {k: v for k, v in mapping.items()
+                           if isinstance(k, (int, float))
+                           and not isinstance(k, bool)}
+                if num_map:
+                    col = jnp.asarray(arr)
+                    # replacing with None (null) or a float widens ints
+                    if any(v is None or isinstance(v, float)
+                           for v in num_map.values()) \
+                            and not jnp.issubdtype(col.dtype, jnp.floating):
+                        col = col.astype(float_dtype())
+                    for old, new in num_map.items():
+                        if new is None:
+                            new = float("nan")
+                        col = jnp.where(jnp.asarray(arr) == old,
+                                        jnp.asarray(new, col.dtype), col)
+                    data[name] = col
+        return self._with(data=data)
+
+    def with_columns(self, cols_map: Mapping[str, ColumnLike]) -> "Frame":
+        """``withColumns`` — add/replace several columns at once. Every
+        expression resolves against the *input* frame (Spark semantics), so
+        a map that replaces a column and references it elsewhere sees the
+        original values."""
+        evaluated = {name: self._eval(values)
+                     for name, values in cols_map.items()}
+        data = dict(self._data)
+        data.update(evaluated)
+        return self._with(data=data)
+
+    withColumns = with_columns
+
+    def to_df(self, *names: str) -> "Frame":
+        """``toDF`` — rename all columns positionally. Duplicate names are
+        rejected (the columnar dict cannot represent them, unlike Spark)."""
+        if len(names) != len(self.columns):
+            raise ValueError(f"toDF expects {len(self.columns)} names, "
+                             f"got {len(names)}")
+        if len(set(names)) != len(names):
+            raise ValueError(f"toDF names must be unique, got {list(names)}")
+        data = {new: self._data[old]
+                for new, old in zip(names, self.columns)}
+        return self._with(data=data)
+
+    toDF = to_df
+
+    def summary(self, *stats: str) -> "Frame":
+        """Spark's ``summary``: describe + percentiles. Default statistics:
+        count, mean, stddev, min, 25%, 50%, 75%, max."""
+        from .aggregates import AggExpr, global_agg
+
+        if not stats:
+            stats = ("count", "mean", "stddev", "min", "25%", "50%", "75%",
+                     "max")
+        cols = [name for name, arr in self._data.items()
+                if not _is_string_col(arr) and arr.ndim == 1]
+        data: dict[str, object] = {
+            "summary": np.asarray(list(stats), dtype=object)}
+        m = self._host_mask()
+        for c in cols:
+            vals = np.asarray(self._data[c], np.float64)[m]
+            vals = vals[~np.isnan(vals)]
+            out = []
+            for s in stats:
+                if s.endswith("%"):
+                    q = float(s[:-1]) / 100.0
+                    out.append(str(np.quantile(vals, q)) if len(vals)
+                               else "NaN")
+                else:
+                    fn = {"mean": "avg"}.get(s, s)
+                    row = global_agg(self, [AggExpr(fn, c).alias("v")])
+                    out.append(str(row.to_pydict()["v"][0]))
+            data[c] = np.asarray(out, dtype=object)
+        return Frame(data)
+
     def sample(self, fraction: float, seed: int = 0,
                with_replacement: bool = False) -> "Frame":
         """Bernoulli row sample (mask-based — shapes stay static).

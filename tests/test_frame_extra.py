@@ -1,0 +1,133 @@
+"""unionByName, intersect/exceptAll/subtract, replace, withColumns, toDF,
+summary — the Dataset API completeness batch."""
+
+import numpy as np
+import pytest
+
+from sparkdq4ml_tpu import Frame, col
+
+
+@pytest.fixture
+def ab():
+    return Frame({"a": [1.0, 2.0], "b": np.asarray(["x", "y"], dtype=object)})
+
+
+class TestUnionByName:
+    def test_reorders_columns(self, ab):
+        other = Frame({"b": np.asarray(["z"], dtype=object), "a": [3.0]})
+        out = ab.union_by_name(other)
+        d = out.to_pydict()
+        assert d["a"].tolist() == pytest.approx([1.0, 2.0, 3.0])
+        assert d["b"].tolist() == ["x", "y", "z"]
+
+    def test_mismatch_raises(self, ab):
+        with pytest.raises(ValueError, match="column sets differ"):
+            ab.union_by_name(Frame({"a": [1.0]}))
+
+    def test_allow_missing_null_fills(self, ab):
+        other = Frame({"a": [3.0], "c": [9.0]})
+        out = ab.union_by_name(other, allow_missing_columns=True)
+        d = out.to_pydict()
+        assert d["a"].tolist() == pytest.approx([1.0, 2.0, 3.0])
+        assert d["b"].tolist() == ["x", "y", None]
+        assert np.isnan(d["c"][:2]).all() and d["c"][2] == pytest.approx(9.0)
+
+
+class TestSetOps:
+    def test_intersect(self):
+        x = Frame({"v": [1.0, 2.0, 2.0, 3.0]})
+        y = Frame({"v": [2.0, 3.0, 4.0]})
+        assert sorted(r[0] for r in x.intersect(y).collect()) == [2.0, 3.0]
+
+    def test_except_all_keeps_duplicates(self):
+        x = Frame({"v": [1.0, 1.0, 1.0, 2.0]})
+        y = Frame({"v": [1.0, 2.0]})
+        assert sorted(r[0] for r in x.except_all(y).collect()) == [1.0, 1.0]
+
+    def test_subtract_distinct(self):
+        x = Frame({"v": [1.0, 1.0, 2.0, 3.0]})
+        y = Frame({"v": [2.0]})
+        assert sorted(r[0] for r in x.subtract(y).collect()) == [1.0, 3.0]
+
+    def test_respects_mask(self):
+        x = Frame({"v": [1.0, 2.0, 3.0]}).filter(col("v") < 3.0)
+        y = Frame({"v": [1.0]})
+        assert [r[0] for r in x.subtract(y).collect()] == [2.0]
+
+    def test_null_safe(self):
+        # Spark set ops are null-safe: NaN rows match each other
+        nan = float("nan")
+        f = Frame({"a": [1.0, nan]})
+        got = [r[0] for r in f.intersect(f).collect()]
+        assert len(got) == 2
+        assert f.subtract(f).count() == 0
+        assert f.except_all(f).count() == 0
+
+
+class TestReplace:
+    def test_scalar_numeric(self):
+        f = Frame({"v": [1.0, 2.0, 1.0]})
+        out = f.replace(1.0, 9.0).to_pydict()
+        assert out["v"].tolist() == pytest.approx([9.0, 2.0, 9.0])
+
+    def test_dict_and_strings(self):
+        f = Frame({"s": np.asarray(["a", "b"], dtype=object),
+                   "v": [1.0, 2.0]})
+        out = f.replace({"a": "z", 2.0: 0.0}).to_pydict()
+        assert out["s"].tolist() == ["z", "b"]
+        assert out["v"].tolist() == pytest.approx([1.0, 0.0])
+
+    def test_list_form_and_subset(self):
+        f = Frame({"u": [1.0, 2.0], "v": [1.0, 2.0]})
+        out = f.replace([1.0, 2.0], 0.0, subset=["u"]).to_pydict()
+        assert out["u"].tolist() == pytest.approx([0.0, 0.0])
+        assert out["v"].tolist() == pytest.approx([1.0, 2.0])
+
+    def test_int_column_widens_for_float_replacement(self):
+        f = Frame({"v": np.asarray([1, 2], np.int32)})
+        out = f.replace(1, 0.5).to_pydict()
+        assert out["v"].tolist() == pytest.approx([0.5, 2.0])
+
+    def test_replace_with_null(self):
+        f = Frame({"v": [1.0, 2.0]})
+        out = f.replace(2.0, None).to_pydict()
+        assert out["v"][0] == pytest.approx(1.0) and np.isnan(out["v"][1])
+        g = Frame({"v": np.asarray([1, 2], np.int32)})
+        out2 = g.replace(2, None).to_pydict()
+        assert np.isnan(out2["v"][1])  # int widens to float for the null
+
+
+class TestMisc:
+    def test_with_columns(self, ab):
+        out = ab.with_columns({"c": col("a") * 2, "d": col("a") + 1})
+        d = out.to_pydict()
+        assert d["c"].tolist() == pytest.approx([2.0, 4.0])
+        assert d["d"].tolist() == pytest.approx([2.0, 3.0])
+
+    def test_with_columns_resolves_against_input(self):
+        # Spark: every expr sees the ORIGINAL columns, not earlier entries
+        f = Frame({"a": [1.0]})
+        d = f.with_columns({"a": col("a") + 1, "b": col("a")}).to_pydict()
+        assert d["a"].tolist() == pytest.approx([2.0])
+        assert d["b"].tolist() == pytest.approx([1.0])
+
+    def test_to_df(self, ab):
+        out = ab.to_df("x", "y")
+        assert out.columns == ["x", "y"]
+        with pytest.raises(ValueError, match="expects 2"):
+            ab.to_df("only_one")
+        with pytest.raises(ValueError, match="unique"):
+            ab.to_df("a", "a")
+
+    def test_summary_percentiles(self):
+        f = Frame({"v": [float(i) for i in range(1, 101)]})
+        d = f.summary().to_pydict()
+        row = {s: v for s, v in zip(d["summary"], d["v"])}
+        assert float(row["50%"]) == pytest.approx(50.5)
+        assert float(row["count"]) == 100
+        assert float(row["max"]) == pytest.approx(100.0)
+
+    def test_summary_custom_stats(self):
+        f = Frame({"v": [1.0, 2.0, 3.0]})
+        d = f.summary("min", "90%").to_pydict()
+        assert d["summary"].tolist() == ["min", "90%"]
