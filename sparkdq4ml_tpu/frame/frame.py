@@ -313,7 +313,14 @@ class Frame:
         if isinstance(to_replace, dict):
             mapping = to_replace
         elif isinstance(to_replace, (list, tuple)):
-            mapping = {v: value for v in to_replace}
+            if isinstance(value, (list, tuple)):  # PySpark list-to-list form
+                if len(value) != len(to_replace):
+                    raise ValueError(
+                        f"replace: value list length {len(value)} != "
+                        f"to_replace length {len(to_replace)}")
+                mapping = dict(zip(to_replace, value))
+            else:
+                mapping = {v: value for v in to_replace}
         else:
             mapping = {to_replace: value}
         cols = subset if subset is not None else self.columns
@@ -385,9 +392,16 @@ class Frame:
         data: dict[str, object] = {
             "summary": np.asarray(list(stats), dtype=object)}
         m = self._host_mask()
+        plain = [s for s in stats if not s.endswith("%")]
         for c in cols:
             vals = np.asarray(self._data[c], np.float64)[m]
             vals = vals[~np.isnan(vals)]
+            agg_row = {}
+            if plain:  # one batched device reduction per column (cf describe)
+                aggs = [AggExpr({"mean": "avg"}.get(s, s), c).alias(s)
+                        for s in plain]
+                d = global_agg(self, aggs).to_pydict()
+                agg_row = {s: d[s][0] for s in plain}
             out = []
             for s in stats:
                 if s.endswith("%"):
@@ -395,9 +409,7 @@ class Frame:
                     out.append(str(np.quantile(vals, q)) if len(vals)
                                else "NaN")
                 else:
-                    fn = {"mean": "avg"}.get(s, s)
-                    row = global_agg(self, [AggExpr(fn, c).alias("v")])
-                    out.append(str(row.to_pydict()["v"][0]))
+                    out.append(str(agg_row[s]))
             data[c] = np.asarray(out, dtype=object)
         return Frame(data)
 

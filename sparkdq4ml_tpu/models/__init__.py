@@ -15,6 +15,8 @@ from .feature import (Binarizer, Bucketizer, Imputer, ImputerModel,
                       QuantileDiscretizer, StandardScaler,
                       StandardScalerModel, StringIndexer, StringIndexerModel,
                       VectorAssembler)
+from .glm import (GeneralizedLinearRegression,
+                  GeneralizedLinearRegressionModel, GlmTrainingSummary)
 from .linalg import Vectors
 from .stat import Correlation, Summarizer
 from .regression import (LinearRegression, LinearRegressionModel,

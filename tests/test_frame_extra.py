@@ -88,6 +88,13 @@ class TestReplace:
         out = f.replace(1, 0.5).to_pydict()
         assert out["v"].tolist() == pytest.approx([0.5, 2.0])
 
+    def test_list_to_list_zips_pairwise(self):
+        f = Frame({"v": [2.0, 1.0, 3.0]})
+        out = f.replace([1.0, 2.0], [9.0, 8.0]).to_pydict()
+        assert out["v"].tolist() == pytest.approx([8.0, 9.0, 3.0])
+        with pytest.raises(ValueError, match="length"):
+            f.replace([1.0, 2.0], [9.0])
+
     def test_replace_with_null(self):
         f = Frame({"v": [1.0, 2.0]})
         out = f.replace(2.0, None).to_pydict()

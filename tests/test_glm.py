@@ -1,0 +1,251 @@
+"""GeneralizedLinearRegression: IRLS across families/links, parity against
+statsmodels-convention results computed via sklearn/scipy closed checks, and
+sharded ≡ single-device (SURVEY.md §4 patterns)."""
+
+import numpy as np
+import pytest
+
+from sparkdq4ml_tpu import Frame, col
+from sparkdq4ml_tpu.models import (GeneralizedLinearRegression,
+                                   VectorAssembler)
+
+
+def make_frame(X, y, w=None):
+    cols = {f"x{j}": X[:, j].astype(np.float32) for j in range(X.shape[1])}
+    cols["label"] = y.astype(np.float32)
+    if w is not None:
+        cols["w"] = w.astype(np.float32)
+    f = Frame(cols)
+    return VectorAssembler([f"x{j}" for j in range(X.shape[1])],
+                           "features").transform(f)
+
+
+class TestGaussian:
+    def test_identity_matches_ols(self):
+        rng = np.random.default_rng(0)
+        X = rng.normal(size=(200, 2))
+        y = X @ [2.0, -1.0] + 0.5 + 0.01 * rng.normal(size=200)
+        f = make_frame(X, y)
+        model = GeneralizedLinearRegression().fit(f)
+        assert np.allclose(model.coefficients, [2.0, -1.0], atol=0.01)
+        assert model.intercept == pytest.approx(0.5, abs=0.01)
+        assert model.summary.converged
+
+    def test_log_link(self):
+        rng = np.random.default_rng(1)
+        X = rng.normal(size=(300, 1)) * 0.3
+        y = np.exp(1.0 + 2.0 * X[:, 0]) + 0.01 * rng.normal(size=300)
+        model = GeneralizedLinearRegression(link="log").fit(make_frame(X, y))
+        assert model.coefficients[0] == pytest.approx(2.0, abs=0.05)
+        assert model.intercept == pytest.approx(1.0, abs=0.05)
+
+
+class TestBinomial:
+    def test_logit_matches_sklearn_unregularized(self):
+        pytest.importorskip("sklearn")
+        from sklearn.linear_model import LogisticRegression as SkLR
+
+        rng = np.random.default_rng(2)
+        X = rng.normal(size=(400, 2))
+        p = 1 / (1 + np.exp(-(X @ [1.5, -1.0] + 0.3)))
+        y = (rng.random(400) < p).astype(np.float64)
+        f = make_frame(X, y)
+        model = GeneralizedLinearRegression(family="binomial").fit(f)
+        sk = SkLR(penalty=None, tol=1e-8, max_iter=200).fit(X, y)
+        assert np.allclose(model.coefficients, sk.coef_[0], atol=1e-3)
+        assert model.intercept == pytest.approx(sk.intercept_[0], abs=1e-3)
+
+    def test_probit_and_cloglog_run(self):
+        rng = np.random.default_rng(3)
+        X = rng.normal(size=(300, 1))
+        y = (rng.random(300) < 0.5).astype(np.float64)
+        for link in ("probit", "cloglog"):
+            m = GeneralizedLinearRegression(family="binomial", link=link) \
+                .fit(make_frame(X, y))
+            assert np.isfinite(m.coefficients).all()
+
+    def test_label_validation(self):
+        f = make_frame(np.ones((3, 1)), np.asarray([0.0, 1.0, 2.0]))
+        with pytest.raises(ValueError, match=r"\[0, 1\]"):
+            GeneralizedLinearRegression(family="binomial").fit(f)
+
+
+class TestPoisson:
+    def test_log_link_matches_sklearn(self):
+        pytest.importorskip("sklearn")
+        from sklearn.linear_model import PoissonRegressor
+
+        rng = np.random.default_rng(4)
+        X = rng.normal(size=(500, 1)) * 0.5
+        lam = np.exp(0.8 + 1.2 * X[:, 0])
+        y = rng.poisson(lam).astype(np.float64)
+        model = GeneralizedLinearRegression(family="poisson") \
+            .fit(make_frame(X, y))
+        sk = PoissonRegressor(alpha=0.0, max_iter=1000, tol=1e-10).fit(X, y)
+        assert model.coefficients[0] == pytest.approx(sk.coef_[0], abs=1e-3)
+        assert model.intercept == pytest.approx(sk.intercept_, abs=1e-3)
+        assert model.summary.dispersion == 1.0
+
+    def test_negative_labels_rejected(self):
+        f = make_frame(np.ones((2, 1)), np.asarray([1.0, -1.0]))
+        with pytest.raises(ValueError, match="nonnegative"):
+            GeneralizedLinearRegression(family="poisson").fit(f)
+
+
+class TestGamma:
+    def test_log_link(self):
+        rng = np.random.default_rng(5)
+        X = rng.normal(size=(600, 1)) * 0.4
+        mu = np.exp(1.0 + 0.7 * X[:, 0])
+        shape = 5.0
+        y = rng.gamma(shape, mu / shape)
+        model = GeneralizedLinearRegression(family="gamma", link="log") \
+            .fit(make_frame(X, y))
+        assert model.coefficients[0] == pytest.approx(0.7, abs=0.1)
+        assert model.intercept == pytest.approx(1.0, abs=0.1)
+        assert model.summary.dispersion == pytest.approx(1 / shape, abs=0.1)
+
+    def test_positive_labels_required(self):
+        f = make_frame(np.ones((2, 1)), np.asarray([1.0, 0.0]))
+        with pytest.raises(ValueError, match="positive"):
+            GeneralizedLinearRegression(family="gamma").fit(f)
+
+
+class TestSurface:
+    def test_invalid_family_link_combo(self):
+        with pytest.raises(ValueError, match="not supported"):
+            GeneralizedLinearRegression(family="gamma", link="logit")
+        with pytest.raises(ValueError, match="unknown family"):
+            GeneralizedLinearRegression(family="tweedie")
+
+    def test_transform_and_link_prediction(self):
+        rng = np.random.default_rng(6)
+        X = rng.normal(size=(50, 1))
+        y = np.exp(0.5 + X[:, 0])
+        model = GeneralizedLinearRegression(
+            family="poisson", link_prediction_col="linkPred") \
+            .fit(make_frame(X, y))
+        out = model.transform(make_frame(X, y)).to_pydict()
+        assert np.allclose(out["prediction"],
+                           np.exp(out["linkPred"]), rtol=1e-4)
+        assert model.predict(X[0]) == pytest.approx(out["prediction"][0],
+                                                    rel=1e-5)
+
+    def test_weight_col(self):
+        # duplicating a row ≡ weighting it 2x
+        X = np.asarray([[0.0], [1.0], [2.0], [1.0]])
+        y = np.asarray([1.0, 3.0, 5.0, 3.0])
+        dup = GeneralizedLinearRegression().fit(make_frame(X, y))
+        Xw = np.asarray([[0.0], [1.0], [2.0]])
+        yw = np.asarray([1.0, 3.0, 5.0])
+        w = np.asarray([1.0, 2.0, 1.0])
+        weighted = GeneralizedLinearRegression(weight_col="w") \
+            .fit(make_frame(Xw, yw, w))
+        assert np.allclose(weighted.coefficients, dup.coefficients,
+                           atol=1e-5)
+        assert weighted.intercept == pytest.approx(dup.intercept, abs=1e-5)
+
+    def test_masked_rows_excluded(self):
+        X = np.asarray([[0.0], [1.0], [2.0], [50.0]])
+        y = np.asarray([1.0, 3.0, 5.0, 999.0])
+        f = make_frame(X, y).filter(col("x0") < 10.0)
+        model = GeneralizedLinearRegression().fit(f)
+        assert model.coefficients[0] == pytest.approx(2.0, abs=1e-4)
+
+    def test_no_intercept(self):
+        X = np.asarray([[1.0], [2.0], [3.0]])
+        y = np.asarray([2.0, 4.0, 6.0])
+        model = GeneralizedLinearRegression(fit_intercept=False) \
+            .fit(make_frame(X, y))
+        assert model.intercept == 0.0
+        assert model.coefficients[0] == pytest.approx(2.0, abs=1e-5)
+
+    def test_persistence_roundtrip(self, tmp_path):
+        from sparkdq4ml_tpu.models.base import load_stage
+
+        rng = np.random.default_rng(7)
+        X = rng.normal(size=(40, 1))
+        y = X[:, 0] * 2 + 1
+        model = GeneralizedLinearRegression().fit(make_frame(X, y))
+        model.save(str(tmp_path / "glm"))
+        loaded = load_stage(str(tmp_path / "glm"))
+        assert loaded.predict(X[0]) == pytest.approx(model.predict(X[0]),
+                                                     rel=1e-6)
+        assert loaded.has_summary is False  # summary lives only on fit()
+        with pytest.raises(ValueError, match="after load"):
+            _ = loaded.summary
+
+    def test_nan_label_in_masked_slot_is_harmless(self):
+        # dropna is mask-based: the NaN stays in the slot with mask=False
+        f = Frame({"x0": [0.0, 1.0, 2.0, 3.0],
+                   "label": [1.0, 3.0, 5.0, float("nan")]})
+        f = VectorAssembler(["x0"], "features").transform(f)
+        f = f.dropna(subset=["label"])
+        model = GeneralizedLinearRegression().fit(f)
+        assert np.isfinite(model.coefficients).all()
+        assert model.coefficients[0] == pytest.approx(2.0, abs=1e-4)
+
+    def test_gamma_inverse_link_sharded_padding(self):
+        # padded shard rows have eta=0 → inverse link 1/0; must not poison
+        from sparkdq4ml_tpu.parallel.mesh import make_mesh
+
+        rng = np.random.default_rng(10)
+        X = rng.normal(size=(13, 1)) * 0.1  # 13 rows: heavy padding on 8
+        mu = 1.0 / (0.5 + 0.2 * X[:, 0])
+        y = rng.gamma(20.0, mu / 20.0)
+        f = make_frame(X, y)
+        single = GeneralizedLinearRegression(family="gamma").fit(f)
+        sharded = GeneralizedLinearRegression(family="gamma") \
+            .fit(f, mesh=make_mesh(8))
+        assert np.isfinite(sharded.coefficients).all()
+        assert np.allclose(sharded.coefficients, single.coefficients,
+                           atol=1e-4)
+
+
+class TestSummaryStats:
+    @pytest.fixture
+    def fitted(self):
+        rng = np.random.default_rng(8)
+        X = rng.normal(size=(150, 2))
+        y = X @ [2.0, 0.0] + 1.0 + 0.3 * rng.normal(size=150)
+        f = make_frame(X, y)
+        return GeneralizedLinearRegression().fit(f)
+
+    def test_statsmodels_convention_stats(self, fitted):
+        s = fitted.summary
+        assert s.deviance > 0 and s.null_deviance > s.deviance
+        assert s.degrees_of_freedom == 150 - 3
+        assert s.dispersion == pytest.approx(0.09, rel=0.5)
+        assert np.isfinite(s.aic)
+
+    def test_pvalues_flag_the_null_coefficient(self, fitted):
+        pytest.importorskip("scipy")
+        p = fitted.summary.p_values
+        # order: [x0, x1, intercept]; x1 has true coefficient 0
+        assert p[0] < 1e-6 and p[2] < 1e-6
+        assert p[1] > 0.01
+
+    def test_residual_types(self, fitted):
+        s = fitted.summary
+        for kind in ("deviance", "pearson", "working", "response"):
+            r = s.residuals(kind)
+            vals = r.to_pydict()[f"{kind}Residuals"]
+            assert len(vals) == 150 and np.isfinite(vals).all()
+
+
+class TestShardedGlm:
+    def test_sharded_equals_single_device(self):
+        from sparkdq4ml_tpu.parallel.mesh import make_mesh
+
+        rng = np.random.default_rng(9)
+        X = rng.normal(size=(101, 2))  # odd count exercises shard padding
+        lam = np.exp(0.5 + X @ [0.8, -0.4])
+        y = rng.poisson(lam).astype(np.float64)
+        f = make_frame(X, y)
+        single = GeneralizedLinearRegression(family="poisson").fit(f)
+        sharded = GeneralizedLinearRegression(family="poisson") \
+            .fit(f, mesh=make_mesh(8))
+        assert np.allclose(sharded.coefficients, single.coefficients,
+                           atol=1e-4)
+        assert sharded.intercept == pytest.approx(single.intercept,
+                                                  abs=1e-4)
