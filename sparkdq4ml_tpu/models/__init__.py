@@ -19,6 +19,12 @@ from .glm import (GeneralizedLinearRegression,
                   GeneralizedLinearRegressionModel, GlmTrainingSummary)
 from .linalg import Vectors
 from .stat import Correlation, Summarizer
+from .tree import (DecisionTreeClassificationModel, DecisionTreeClassifier,
+                   DecisionTreeRegressionModel, DecisionTreeRegressor,
+                   GBTClassificationModel, GBTClassifier,
+                   GBTRegressionModel, GBTRegressor,
+                   RandomForestClassificationModel, RandomForestClassifier,
+                   RandomForestRegressionModel, RandomForestRegressor)
 from .regression import (LinearRegression, LinearRegressionModel,
                          LinearRegressionSummary,
                          LinearRegressionTrainingSummary)

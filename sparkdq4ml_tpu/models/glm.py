@@ -446,6 +446,9 @@ class GlmTrainingSummary:
         self._m = model
         self._info = info
         self._frame = info["frame"]
+        self._cache: dict = {}  # summary is immutable after fit; memoize
+        # the data extraction and dispersion so chained properties
+        # (p_values → t_values → std errors → dispersion) do one data pass
 
     @property
     def deviance(self) -> float:
@@ -462,6 +465,8 @@ class GlmTrainingSummary:
         return self._info["converged"]
 
     def _xyw(self):
+        if "xyw" in self._cache:
+            return self._cache["xyw"]
         m = self._m
         dt = np.float64
         X = np.asarray(self._frame._column_values(
@@ -474,13 +479,17 @@ class GlmTrainingSummary:
         w = np.ones_like(y)
         if m._p("weight_col"):
             w = np.asarray(self._frame._column_values(m._p("weight_col")), dt)
-        return X[mask], y[mask], w[mask]
+        self._cache["xyw"] = (X[mask], y[mask], w[mask])
+        return self._cache["xyw"]
 
     def _mu(self, X):
+        if "mu" in self._cache:
+            return self._cache["mu"]
         _, link_inv, _ = _link_fns(self._m._p("link"))
         eta = X @ self._m.coefficients + self._m.intercept
-        return np.asarray(_clip_mu(self._m._p("family"),
-                                   link_inv(jnp.asarray(eta))))
+        self._cache["mu"] = np.asarray(_clip_mu(self._m._p("family"),
+                                                link_inv(jnp.asarray(eta))))
+        return self._cache["mu"]
 
     @property
     def degrees_of_freedom(self) -> int:
@@ -503,11 +512,15 @@ class GlmTrainingSummary:
         family = self._m._p("family")
         if family in ("binomial", "poisson"):
             return 1.0
+        if "dispersion" in self._cache:
+            return self._cache["dispersion"]
         X, y, w = self._xyw()
         mu = self._mu(X)
         var = np.asarray(_variance_fn(family)(jnp.asarray(mu)))
         pearson = np.sum(w * (y - mu) ** 2 / np.maximum(var, _EPS))
-        return float(pearson / max(self.degrees_of_freedom, 1))
+        self._cache["dispersion"] = float(
+            pearson / max(self.degrees_of_freedom, 1))
+        return self._cache["dispersion"]
 
     @property
     def null_deviance(self) -> float:

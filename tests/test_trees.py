@@ -1,0 +1,264 @@
+"""Tree family: DecisionTree/RandomForest/GBT × classifier/regressor.
+
+Quality oracles (SURVEY.md §4 pattern): sklearn trees on the same data —
+exact split parity is not expected (histogram binning vs exact splits), so
+assertions are on fit quality, structure, and invariants (masked rows,
+determinism, persistence)."""
+
+import numpy as np
+import pytest
+
+from sparkdq4ml_tpu import Frame, col
+from sparkdq4ml_tpu.models import (DecisionTreeClassifier,
+                                   DecisionTreeRegressor, GBTClassifier,
+                                   GBTRegressor, RandomForestClassifier,
+                                   RandomForestRegressor, VectorAssembler)
+
+
+def reg_frame(n=400, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.uniform(-2, 2, size=(n, 3))
+    y = np.where(X[:, 0] > 0, 5.0, -5.0) + X[:, 1] ** 2 \
+        + 0.1 * rng.normal(size=n)
+    cols = {f"x{j}": X[:, j].astype(np.float32) for j in range(3)}
+    cols["label"] = y.astype(np.float32)
+    f = Frame(cols)
+    return VectorAssembler([f"x{j}" for j in range(3)],
+                           "features").transform(f), X, y
+
+
+def clf_frame(n=400, seed=1):
+    rng = np.random.default_rng(seed)
+    X = rng.uniform(-2, 2, size=(n, 3))
+    y = ((X[:, 0] > 0) ^ (X[:, 1] > 0.5)).astype(np.float64)
+    cols = {f"x{j}": X[:, j].astype(np.float32) for j in range(3)}
+    cols["label"] = y.astype(np.float32)
+    f = Frame(cols)
+    return VectorAssembler([f"x{j}" for j in range(3)],
+                           "features").transform(f), X, y
+
+
+def r2(y, p):
+    return 1 - np.sum((y - p) ** 2) / np.sum((y - y.mean()) ** 2)
+
+
+class TestDecisionTreeRegressor:
+    def test_fits_step_function(self):
+        f, X, y = reg_frame()
+        model = DecisionTreeRegressor(max_depth=4).fit(f)
+        pred = model.transform(f).to_pydict()["prediction"]
+        assert r2(y, pred) > 0.9
+        # the dominant split must be on feature 0
+        assert np.argmax(model.feature_importances) == 0
+
+    def test_sklearn_quality_parity(self):
+        pytest.importorskip("sklearn")
+        from sklearn.tree import DecisionTreeRegressor as SkDT
+
+        f, X, y = reg_frame()
+        ours = DecisionTreeRegressor(max_depth=4).fit(f)
+        sk = SkDT(max_depth=4).fit(X, y)
+        ours_r2 = r2(y, ours.transform(f).to_pydict()["prediction"])
+        sk_r2 = r2(y, sk.predict(X))
+        assert ours_r2 > sk_r2 - 0.05  # binning costs at most a little
+
+    def test_predict_matches_transform(self):
+        f, X, _ = reg_frame(n=50)
+        model = DecisionTreeRegressor(max_depth=3).fit(f)
+        out = model.transform(f).to_pydict()["prediction"]
+        assert model.predict(X[7]) == pytest.approx(out[7], rel=1e-5)
+
+    def test_masked_rows_do_not_vote(self):
+        f = Frame({"x0": [0.0, 1.0, 2.0, 3.0],
+                   "label": [1.0, 1.0, 5.0, 500.0]})
+        f = VectorAssembler(["x0"], "features").transform(f)
+        model = DecisionTreeRegressor(max_depth=2).fit(
+            f.filter(col("label") < 100.0))
+        assert model.predict([3.0]) < 100.0
+
+    def test_min_instances_limits_splits(self):
+        f, _, _ = reg_frame(n=100)
+        stump = DecisionTreeRegressor(max_depth=5,
+                                      min_instances_per_node=60).fit(f)
+        deep = DecisionTreeRegressor(max_depth=5).fit(f)
+        assert np.asarray(stump.is_leaf).sum() > np.asarray(deep.is_leaf).sum()
+
+    def test_nan_label_in_masked_slot_is_harmless(self):
+        # dropna is mask-based: the NaN stays in the slot with mask=False
+        f = Frame({"x0": [0.0, 1.0, 2.0, 3.0],
+                   "label": [1.0, 3.0, 5.0, float("nan")]})
+        f = VectorAssembler(["x0"], "features").transform(f)
+        f = f.dropna(subset=["label"])
+        model = DecisionTreeRegressor(max_depth=2).fit(f)
+        assert np.isfinite(model.predict([1.0]))
+        gbt = GBTRegressor(max_iter=3, max_depth=2).fit(f)
+        assert np.isfinite(gbt.predict([1.0]))
+
+    def test_nan_label_in_valid_row_raises(self):
+        f = Frame({"x0": [0.0, 1.0], "label": [1.0, float("nan")]})
+        f = VectorAssembler(["x0"], "features").transform(f)
+        with pytest.raises(ValueError, match="NaN"):
+            DecisionTreeRegressor().fit(f)
+
+    def test_persistence(self, tmp_path):
+        from sparkdq4ml_tpu.models.base import load_stage
+
+        f, X, _ = reg_frame(n=80)
+        model = DecisionTreeRegressor(max_depth=3).fit(f)
+        model.save(str(tmp_path / "dt"))
+        loaded = load_stage(str(tmp_path / "dt"))
+        assert loaded.predict(X[3]) == pytest.approx(model.predict(X[3]),
+                                                     rel=1e-6)
+
+
+class TestDecisionTreeClassifier:
+    def test_fits_xor(self):
+        f, X, y = clf_frame()
+        model = DecisionTreeClassifier(max_depth=4).fit(f)
+        out = model.transform(f).to_pydict()
+        assert np.mean(out["prediction"] == y) > 0.95
+        probs = np.stack(out["probability"])
+        assert np.allclose(probs.sum(axis=1), 1.0, atol=1e-5)
+
+    def test_entropy_impurity(self):
+        f, X, y = clf_frame()
+        model = DecisionTreeClassifier(max_depth=4, impurity="entropy").fit(f)
+        out = model.transform(f).to_pydict()
+        assert np.mean(out["prediction"] == y) > 0.95
+
+    def test_multiclass(self):
+        rng = np.random.default_rng(2)
+        X = rng.normal(size=(300, 2))
+        y = (X[:, 0] > 0).astype(int) + 2 * (X[:, 1] > 0).astype(int) % 3
+        f = Frame({"x0": X[:, 0].astype(np.float32),
+                   "x1": X[:, 1].astype(np.float32),
+                   "label": y.astype(np.float32)})
+        f = VectorAssembler(["x0", "x1"], "features").transform(f)
+        model = DecisionTreeClassifier(max_depth=4).fit(f)
+        assert model.num_classes == int(y.max()) + 1
+        out = model.transform(f).to_pydict()
+        assert np.mean(out["prediction"] == y) > 0.9
+
+    def test_label_validation(self):
+        f = Frame({"x0": [1.0, 2.0], "label": [0.5, 1.0]})
+        f = VectorAssembler(["x0"], "features").transform(f)
+        with pytest.raises(ValueError, match="integers"):
+            DecisionTreeClassifier().fit(f)
+
+    def test_masked_out_of_range_label_is_harmless(self):
+        f = Frame({"x0": [0.0, 1.0, 2.0, 3.0],
+                   "label": [0.0, 1.0, 0.0, 5.0]})
+        f = VectorAssembler(["x0"], "features").transform(f)
+        model = DecisionTreeClassifier(max_depth=2).fit(
+            f.filter(col("label") < 2.0))
+        assert model.num_classes == 2  # the masked 5 never entered the fit
+
+
+class TestRandomForest:
+    def test_regression_beats_single_tree_oob_style(self):
+        f, X, y = reg_frame(n=300, seed=5)
+        test_f, Xt, yt = reg_frame(n=200, seed=99)
+        tree = DecisionTreeRegressor(max_depth=6).fit(f)
+        # "all" isolates the bagging effect; "auto" (Spark: d/3 per node)
+        # would also decorrelate features, a different comparison
+        forest = RandomForestRegressor(num_trees=30, max_depth=6,
+                                       feature_subset_strategy="all",
+                                       seed=7).fit(f)
+        assert forest.num_trees == 30
+        t_r2 = r2(yt, tree.transform(test_f).to_pydict()["prediction"])
+        f_r2 = r2(yt, forest.transform(test_f).to_pydict()["prediction"])
+        assert f_r2 > t_r2 - 0.02  # ensemble at least matches one tree
+
+    def test_classification_soft_vote(self):
+        f, X, y = clf_frame()
+        model = RandomForestClassifier(num_trees=15, max_depth=5,
+                                       seed=3).fit(f)
+        out = model.transform(f).to_pydict()
+        assert np.mean(out["prediction"] == y) > 0.93
+        probs = np.stack(out["probability"])
+        assert np.allclose(probs.sum(axis=1), 1.0, atol=1e-5)
+
+    def test_deterministic_given_seed(self):
+        f, X, _ = clf_frame(n=120)
+        a = RandomForestClassifier(num_trees=5, seed=11).fit(f)
+        b = RandomForestClassifier(num_trees=5, seed=11).fit(f)
+        assert np.array_equal(np.asarray(a.value), np.asarray(b.value))
+
+    def test_feature_subset_strategies(self):
+        f, _, _ = clf_frame(n=100)
+        for strat in ("auto", "sqrt", "log2", "all", "0.5", "2"):
+            m = RandomForestClassifier(num_trees=3, max_depth=3,
+                                       feature_subset_strategy=strat,
+                                       seed=1).fit(f)
+            assert m.num_trees == 3
+        with pytest.raises(ValueError, match="featureSubsetStrategy"):
+            RandomForestClassifier(feature_subset_strategy="bogus").fit(f)
+
+    def test_subset_counts_follow_spark_table(self):
+        from sparkdq4ml_tpu.models.tree import _n_subset_features
+
+        # auto: all for one tree; sqrt / onethird for forests
+        assert _n_subset_features("auto", 9, True, 1) == 9
+        assert _n_subset_features("auto", 9, True, 10) == 3
+        assert _n_subset_features("auto", 9, False, 10) == 3
+        assert _n_subset_features("auto", 12, False, 10) == 4
+        assert _n_subset_features("2", 10, True, 5) == 2   # integer form
+        assert _n_subset_features("0.5", 10, True, 5) == 5  # fraction form
+
+    def test_persistence(self, tmp_path):
+        from sparkdq4ml_tpu.models.base import load_stage
+
+        f, X, _ = clf_frame(n=100)
+        model = RandomForestClassifier(num_trees=4, max_depth=3,
+                                       seed=2).fit(f)
+        model.save(str(tmp_path / "rf"))
+        loaded = load_stage(str(tmp_path / "rf"))
+        assert loaded.predict(X[5]) == model.predict(X[5])
+        assert loaded.num_trees == 4
+
+
+class TestGBT:
+    def test_regression_quality(self):
+        f, X, y = reg_frame(n=300, seed=8)
+        model = GBTRegressor(max_iter=40, step_size=0.2, max_depth=3,
+                             seed=4).fit(f)
+        pred = model.transform(f).to_pydict()["prediction"]
+        assert r2(y, pred) > 0.95
+        assert model.num_trees == 40
+
+    def test_boosting_improves_with_rounds(self):
+        f, X, y = reg_frame(n=250, seed=9)
+        weak = GBTRegressor(max_iter=2, step_size=0.2, max_depth=2,
+                            seed=4).fit(f)
+        strong = GBTRegressor(max_iter=30, step_size=0.2, max_depth=2,
+                              seed=4).fit(f)
+        r_weak = r2(y, weak.transform(f).to_pydict()["prediction"])
+        r_strong = r2(y, strong.transform(f).to_pydict()["prediction"])
+        assert r_strong > r_weak
+
+    def test_classification(self):
+        f, X, y = clf_frame(n=300, seed=10)
+        model = GBTClassifier(max_iter=30, step_size=0.3, max_depth=3,
+                              seed=5).fit(f)
+        out = model.transform(f).to_pydict()
+        assert np.mean(out["prediction"] == y) > 0.95
+        probs = np.stack(out["probability"])
+        assert np.allclose(probs.sum(axis=1), 1.0, atol=1e-5)
+        raw = np.stack(out["rawPrediction"])
+        assert np.allclose(raw[:, 0], -raw[:, 1], atol=1e-5)
+
+    def test_binary_label_validation(self):
+        f = Frame({"x0": [1.0, 2.0], "label": [0.0, 2.0]})
+        f = VectorAssembler(["x0"], "features").transform(f)
+        with pytest.raises(ValueError, match="binary"):
+            GBTClassifier().fit(f)
+
+    def test_persistence(self, tmp_path):
+        from sparkdq4ml_tpu.models.base import load_stage
+
+        f, X, _ = reg_frame(n=80)
+        model = GBTRegressor(max_iter=5, max_depth=2, seed=1).fit(f)
+        model.save(str(tmp_path / "gbt"))
+        loaded = load_stage(str(tmp_path / "gbt"))
+        assert loaded.predict(X[2]) == pytest.approx(model.predict(X[2]),
+                                                     rel=1e-5)
