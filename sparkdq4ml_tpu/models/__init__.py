@@ -2,7 +2,8 @@ from .base import Estimator, Model, Pipeline, PipelineModel, Transformer
 from .classification import (BinaryLogisticRegressionSummary,
                              BinaryLogisticRegressionTrainingSummary,
                              LogisticRegression, LogisticRegressionModel,
-                             NaiveBayes, NaiveBayesModel)
+                             NaiveBayes, NaiveBayesModel, OneVsRest,
+                             OneVsRestModel)
 from .clustering import KMeans, KMeansModel, KMeansSummary
 from .evaluation import (BinaryClassificationEvaluator, ClusteringEvaluator,
                          Evaluator, MulticlassClassificationEvaluator,
@@ -19,6 +20,9 @@ from .glm import (GeneralizedLinearRegression,
                   GeneralizedLinearRegressionModel, GlmTrainingSummary)
 from .linalg import Vectors
 from .stat import Correlation, Summarizer
+from .text import (CountVectorizer, CountVectorizerModel, HashingTF, IDF,
+                   IDFModel, NGram, RegexTokenizer, StopWordsRemover,
+                   Tokenizer)
 from .tree import (DecisionTreeClassificationModel, DecisionTreeClassifier,
                    DecisionTreeRegressionModel, DecisionTreeRegressor,
                    GBTClassificationModel, GBTClassifier,

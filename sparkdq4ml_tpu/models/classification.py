@@ -641,3 +641,143 @@ class NaiveBayesModel(Model):
         x = jnp.asarray(np.asarray(features,
                                    np.dtype(float_dtype())).reshape(1, -1))
         return float(np.asarray(jnp.argmax(self._raw(x), axis=1))[0])
+
+
+# ---------------------------------------------------------------------------
+# OneVsRest (MLlib org.apache.spark.ml.classification.OneVsRest)
+# ---------------------------------------------------------------------------
+
+@persistable
+class OneVsRest(Estimator):
+    """MLlib ``OneVsRest``: reduce multiclass to k independent binary fits
+    of any binary classifier estimator. The k fits are embarrassingly
+    parallel and share the feature matrix already resident in HBM."""
+
+    def __init__(self, classifier=None, features_col: str = "features",
+                 label_col: str = "label",
+                 prediction_col: str = "prediction"):
+        self.classifier = classifier
+        self.features_col = features_col
+        self.label_col = label_col
+        self.prediction_col = prediction_col
+
+    def set_classifier(self, v):
+        self.classifier = v
+        return self
+
+    setClassifier = set_classifier
+
+    # composite persistence: the inner classifier is itself a stage
+    def _save_to_dir(self, path: str) -> None:
+        from .base import save_stage
+
+        write_json(os.path.join(path, "metadata.json"),
+                   {"class": "OneVsRest",
+                    "features_col": self.features_col,
+                    "label_col": self.label_col,
+                    "prediction_col": self.prediction_col,
+                    "has_classifier": self.classifier is not None})
+        if self.classifier is not None:
+            save_stage(self.classifier, os.path.join(path, "classifier"))
+
+    @classmethod
+    def _load_from_dir(cls, path: str, meta: dict) -> "OneVsRest":
+        from .base import load_stage
+
+        clf = load_stage(os.path.join(path, "classifier")) \
+            if meta.get("has_classifier") else None
+        return cls(clf, meta["features_col"], meta["label_col"],
+                   meta["prediction_col"])
+
+    def fit(self, frame: Frame, mesh=None) -> "OneVsRestModel":
+        if self.classifier is None:
+            raise ValueError("OneVsRest: classifier not set")
+        import copy
+        import inspect
+
+        y = np.asarray(frame._column_values(self.label_col), np.float64)
+        mask = np.asarray(frame.mask)
+        yv = y[mask]
+        if len(yv) == 0:
+            raise ValueError("OneVsRest: no valid rows")
+        if np.any(yv < 0) or np.any(yv != np.floor(yv)):
+            raise ValueError("labels must be nonnegative integers 0..k-1")
+        k = int(yv.max()) + 1
+        models = []
+        for c in range(k):
+            binary = frame.with_column(
+                self.label_col,
+                jnp.asarray((y == c).astype(np.dtype(float_dtype()))))
+            est = copy.deepcopy(self.classifier)
+            if hasattr(est, "set_features_col"):
+                est.set_features_col(self.features_col)
+            if hasattr(est, "set_label_col"):
+                est.set_label_col(self.label_col)
+            # pass mesh only to estimators whose fit accepts it (a bare
+            # try/except would swallow TypeErrors raised inside fit)
+            if "mesh" in inspect.signature(est.fit).parameters:
+                models.append(est.fit(binary, mesh=mesh))
+            else:
+                models.append(est.fit(binary))
+        return OneVsRestModel(models, self.features_col,
+                              self.prediction_col)
+
+
+@persistable
+class OneVsRestModel(Model):
+    """k fitted binary models; prediction = argmax of their scores (the
+    probability-of-positive column when available, else rawPrediction)."""
+
+    def __init__(self, models, features_col="features",
+                 prediction_col="prediction"):
+        self.models = list(models)
+        self.features_col = features_col
+        self.prediction_col = prediction_col
+
+    @property
+    def num_classes(self):
+        return len(self.models)
+
+    numClasses = num_classes
+
+    def _scores(self, frame: Frame):
+        cols = []
+        for m in self.models:
+            out = m.transform(frame)
+            p = getattr(m, "_params", {})
+            prob_col = p.get("probability_col", "probability")
+            raw_col = p.get("raw_prediction_col", "rawPrediction")
+            name = prob_col if prob_col in out.columns else raw_col
+            v = jnp.asarray(out._column_values(name))
+            if v.ndim == 2:   # [P(neg), P(pos)] or [-margin, margin]
+                v = v[:, -1]
+            cols.append(v)
+        return jnp.stack(cols, axis=1)
+
+    def transform(self, frame: Frame) -> Frame:
+        scores = self._scores(frame)
+        pred = jnp.argmax(scores, axis=1).astype(float_dtype())
+        return frame.with_column(self.prediction_col, pred)
+
+    def _save_to_dir(self, path: str) -> None:
+        import os
+
+        from .base import save_stage, write_json
+
+        write_json(os.path.join(path, "metadata.json"),
+                   {"class": "OneVsRestModel",
+                    "n": len(self.models),
+                    "features_col": self.features_col,
+                    "prediction_col": self.prediction_col})
+        for i, m in enumerate(self.models):
+            save_stage(m, os.path.join(path, f"model_{i}"))
+
+    @classmethod
+    def _load_from_dir(cls, path: str, meta: dict) -> "OneVsRestModel":
+        import os
+
+        from .base import load_stage
+
+        models = [load_stage(os.path.join(path, f"model_{i}"))
+                  for i in range(meta["n"])]
+        return cls(models, meta["features_col"], meta["prediction_col"])

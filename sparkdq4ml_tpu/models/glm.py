@@ -482,9 +482,13 @@ class GlmTrainingSummary:
         self._cache["xyw"] = (X[mask], y[mask], w[mask])
         return self._cache["xyw"]
 
-    def _mu(self, X):
+    def _mu(self):
+        """Fitted means over the training rows (memoized — always derived
+        from the cached _xyw features, so the cache is safe by
+        construction)."""
         if "mu" in self._cache:
             return self._cache["mu"]
+        X, _, _ = self._xyw()
         _, link_inv, _ = _link_fns(self._m._p("link"))
         eta = X @ self._m.coefficients + self._m.intercept
         self._cache["mu"] = np.asarray(_clip_mu(self._m._p("family"),
@@ -515,7 +519,7 @@ class GlmTrainingSummary:
         if "dispersion" in self._cache:
             return self._cache["dispersion"]
         X, y, w = self._xyw()
-        mu = self._mu(X)
+        mu = self._mu()
         var = np.asarray(_variance_fn(family)(jnp.asarray(mu)))
         pearson = np.sum(w * (y - mu) ** 2 / np.maximum(var, _EPS))
         self._cache["dispersion"] = float(
@@ -543,7 +547,7 @@ class GlmTrainingSummary:
         """deviance | pearson | working | response residual column."""
         X, y, w = self._xyw()
         family = self._m._p("family")
-        mu = self._mu(X)
+        mu = self._mu()
         if residuals_type == "response":
             r = y - mu
         elif residuals_type == "pearson":
@@ -567,7 +571,7 @@ class GlmTrainingSummary:
     def aic(self) -> float:
         X, y, w = self._xyw()
         family = self._m._p("family")
-        mu = self._mu(X)
+        mu = self._mu()
         n = len(y)
         p = self._m.num_features + (1 if self._m._p("fit_intercept", True)
                                     else 0)

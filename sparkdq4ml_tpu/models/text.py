@@ -1,0 +1,408 @@
+"""Text feature pipeline: Tokenizer → StopWordsRemover / NGram →
+HashingTF / CountVectorizer → IDF (the ``spark.ml.feature`` text stages
+shipped by the reference's mllib dependency, pom.xml:29-32).
+
+Design: token columns are host-side object arrays of string lists (TPUs do
+not hold strings — same rule as Frame's string columns); the moment text
+becomes *counts* (HashingTF / CountVectorizerModel) the data lands in a
+dense device matrix, and everything after (IDF scaling, any estimator) is
+device math. IDF's document-frequency statistic is one masked device
+reduction; its transform is a broadcast multiply fused by XLA.
+
+HashingTF uses Python's stable string hash (md5-based here, process-stable,
+documented) modulo ``num_features`` — the same trick as Spark's
+murmur3-mod hashing; hash values differ from Spark's, the semantics (fixed
+dimension, collision-tolerant bag-of-words) are identical.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import re
+from typing import Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import float_dtype
+from .base import Estimator, Model, Transformer, persistable
+
+# Spark's english default list (abridged to the common core; the full list
+# is data, not behavior — users can pass their own)
+_ENGLISH_STOP_WORDS = [
+    "a", "about", "above", "after", "again", "against", "all", "am", "an",
+    "and", "any", "are", "as", "at", "be", "because", "been", "before",
+    "being", "below", "between", "both", "but", "by", "could", "did", "do",
+    "does", "doing", "down", "during", "each", "few", "for", "from",
+    "further", "had", "has", "have", "having", "he", "her", "here", "hers",
+    "herself", "him", "himself", "his", "how", "i", "if", "in", "into",
+    "is", "it", "its", "itself", "me", "more", "most", "my", "myself",
+    "no", "nor", "not", "of", "off", "on", "once", "only", "or", "other",
+    "ought", "our", "ours", "ourselves", "out", "over", "own", "same",
+    "she", "should", "so", "some", "such", "than", "that", "the", "their",
+    "theirs", "them", "themselves", "then", "there", "these", "they",
+    "this", "those", "through", "to", "too", "under", "until", "up",
+    "very", "was", "we", "were", "what", "when", "where", "which", "while",
+    "who", "whom", "why", "with", "would", "you", "your", "yours",
+    "yourself", "yourselves"]
+
+
+def _obj_array(items) -> np.ndarray:
+    """1-D object array of token lists. np.asarray would collapse
+    equal-length lists into a 2-D array; explicit slot assignment keeps
+    one list per row."""
+    arr = np.empty(len(items), dtype=object)
+    for i, it in enumerate(items):
+        arr[i] = it
+    return arr
+
+
+def _token_col(frame, name):
+    col = frame._column_values(name)
+    if not (isinstance(col, np.ndarray) and col.dtype == object):
+        raise ValueError(f"column {name!r} must be a string/token column")
+    return col
+
+
+@persistable
+class Tokenizer(Transformer):
+    """MLlib ``Tokenizer``: lowercase + split on whitespace."""
+
+    _persist_attrs = ('input_col', 'output_col')
+
+    def __init__(self, input_col: str = None, output_col: str = None):
+        self.input_col = input_col
+        self.output_col = output_col
+
+    def set_input_col(self, v):
+        self.input_col = v
+        return self
+
+    setInputCol = set_input_col
+
+    def set_output_col(self, v):
+        self.output_col = v
+        return self
+
+    setOutputCol = set_output_col
+
+    def transform(self, frame):
+        col = _token_col(frame, self.input_col)
+        out = _obj_array(
+            [None if s is None else str(s).lower().split() for s in col])
+        return frame.with_column(self.output_col, out)
+
+
+@persistable
+class RegexTokenizer(Tokenizer):
+    """MLlib ``RegexTokenizer``: split by ``pattern`` (gaps=True, default
+    ``\\s+``) or match tokens (gaps=False); optional lowercase,
+    ``min_token_length`` filter."""
+
+    _persist_attrs = ('input_col', 'output_col', 'pattern', 'gaps',
+                      'to_lowercase', 'min_token_length')
+
+    def __init__(self, input_col: str = None, output_col: str = None,
+                 pattern: str = r"\s+", gaps: bool = True,
+                 to_lowercase: bool = True, min_token_length: int = 1):
+        super().__init__(input_col, output_col)
+        self.pattern = pattern
+        self.gaps = gaps
+        self.to_lowercase = to_lowercase
+        self.min_token_length = int(min_token_length)
+
+    def set_pattern(self, v):
+        self.pattern = v
+        return self
+
+    setPattern = set_pattern
+
+    def transform(self, frame):
+        col = _token_col(frame, self.input_col)
+        rx = re.compile(self.pattern)
+
+        def tok(s):
+            if s is None:
+                return None
+            if self.to_lowercase:
+                s = s.lower()
+            toks = rx.split(s) if self.gaps else rx.findall(s)
+            return [t for t in toks if len(t) >= self.min_token_length]
+
+        out = _obj_array([tok(s) for s in col])
+        return frame.with_column(self.output_col, out)
+
+
+@persistable
+class StopWordsRemover(Transformer):
+    """MLlib ``StopWordsRemover``: drop stop words from a token column."""
+
+    _persist_attrs = ('input_col', 'output_col', 'stop_words',
+                      'case_sensitive')
+
+    def __init__(self, input_col: str = None, output_col: str = None,
+                 stop_words: Optional[Sequence[str]] = None,
+                 case_sensitive: bool = False):
+        self.input_col = input_col
+        self.output_col = output_col
+        self.stop_words = list(stop_words) if stop_words is not None \
+            else list(_ENGLISH_STOP_WORDS)
+        self.case_sensitive = case_sensitive
+
+    @staticmethod
+    def load_default_stop_words(language: str = "english"):
+        if language != "english":
+            raise ValueError("only the english default list ships here")
+        return list(_ENGLISH_STOP_WORDS)
+
+    loadDefaultStopWords = load_default_stop_words
+
+    def set_stop_words(self, v):
+        self.stop_words = list(v)
+        return self
+
+    setStopWords = set_stop_words
+
+    def transform(self, frame):
+        col = _token_col(frame, self.input_col)
+        if self.case_sensitive:
+            stop = set(self.stop_words)
+
+            def keep(t):
+                return t not in stop
+        else:
+            stop = {w.lower() for w in self.stop_words}
+
+            def keep(t):
+                return t.lower() not in stop
+
+        out = _obj_array(
+            [None if toks is None else [t for t in toks if keep(t)]
+             for toks in col])
+        return frame.with_column(self.output_col, out)
+
+
+@persistable
+class NGram(Transformer):
+    """MLlib ``NGram``: sliding n-grams (space-joined) over a token column."""
+
+    _persist_attrs = ('input_col', 'output_col', 'n')
+
+    def __init__(self, n: int = 2, input_col: str = None,
+                 output_col: str = None):
+        if n < 1:
+            raise ValueError("n must be >= 1")
+        self.n = int(n)
+        self.input_col = input_col
+        self.output_col = output_col
+
+    def set_n(self, v):
+        if v < 1:
+            raise ValueError("n must be >= 1")
+        self.n = int(v)
+        return self
+
+    setN = set_n
+
+    def transform(self, frame):
+        col = _token_col(frame, self.input_col)
+        n = self.n
+        out = _obj_array(
+            [None if toks is None else
+             [" ".join(toks[i:i + n]) for i in range(len(toks) - n + 1)]
+             for toks in col])
+        return frame.with_column(self.output_col, out)
+
+
+def _stable_hash(token: str, mod: int) -> int:
+    return int.from_bytes(hashlib.md5(token.encode()).digest()[:8],
+                          "little") % mod
+
+
+@persistable
+class HashingTF(Transformer):
+    """MLlib ``HashingTF``: hashed term-frequency vectors of a fixed
+    dimension. Token → bucket via a process-stable hash (md5-based; Spark
+    uses murmur3 — bucket assignments differ, semantics match). Output is
+    a DENSE device matrix ready for any estimator — hence the default
+    dimension is 1024, not Spark's sparse-vector 2^18 (which would allocate
+    n_docs x 262144 floats); raise it explicitly when the corpus warrants
+    the memory."""
+
+    _persist_attrs = ('num_features', 'input_col', 'output_col', 'binary')
+
+    def __init__(self, num_features: int = 1024, input_col: str = None,
+                 output_col: str = None, binary: bool = False):
+        if num_features < 1:
+            raise ValueError("num_features must be >= 1")
+        self.num_features = int(num_features)
+        self.input_col = input_col
+        self.output_col = output_col
+        self.binary = binary
+
+    def set_num_features(self, v):
+        if v < 1:
+            raise ValueError("num_features must be >= 1")
+        self.num_features = int(v)
+        return self
+
+    setNumFeatures = set_num_features
+
+    def set_binary(self, v):
+        self.binary = bool(v)
+        return self
+
+    setBinary = set_binary
+
+    def transform(self, frame):
+        col = _token_col(frame, self.input_col)
+        M = np.zeros((len(col), self.num_features),
+                     np.dtype(float_dtype()))
+        for i, toks in enumerate(col):
+            if toks is None:
+                continue
+            for t in toks:
+                j = _stable_hash(t, self.num_features)
+                if self.binary:
+                    M[i, j] = 1.0
+                else:
+                    M[i, j] += 1.0
+        return frame.with_column(self.output_col, jnp.asarray(M))
+
+
+@persistable
+class CountVectorizer(Estimator):
+    """MLlib ``CountVectorizer``: learn a vocabulary (top ``vocab_size`` by
+    corpus frequency, ties alphabetical) with ``min_df`` document-frequency
+    and ``min_tf`` in-document filters; transform to dense count vectors."""
+
+    _persist_attrs = ('vocab_size', 'min_df', 'min_tf', 'binary',
+                      'input_col', 'output_col')
+
+    def __init__(self, vocab_size: int = 262144, min_df: float = 1.0,
+                 min_tf: float = 1.0, binary: bool = False,
+                 input_col: str = None, output_col: str = None):
+        self.vocab_size = int(vocab_size)
+        self.min_df = float(min_df)
+        self.min_tf = float(min_tf)
+        self.binary = binary
+        self.input_col = input_col
+        self.output_col = output_col
+
+    def set_vocab_size(self, v):
+        self.vocab_size = int(v)
+        return self
+
+    setVocabSize = set_vocab_size
+
+    def set_min_df(self, v):
+        self.min_df = float(v)
+        return self
+
+    setMinDF = set_min_df
+
+    def fit(self, frame) -> "CountVectorizerModel":
+        col = _token_col(frame, self.input_col)
+        mask = np.asarray(frame.mask)
+        df: dict = {}
+        n_docs = 0
+        for toks, m in zip(col, mask):
+            if not m or toks is None:
+                continue
+            n_docs += 1
+            for t in set(toks):
+                df[t] = df.get(t, 0) + 1
+        # min_df: absolute count if >= 1, else fraction of documents
+        thresh = self.min_df if self.min_df >= 1.0 \
+            else self.min_df * max(n_docs, 1)
+        terms = [(t, c) for t, c in df.items() if c >= thresh]
+        terms.sort(key=lambda tc: (-tc[1], tc[0]))
+        vocab = [t for t, _ in terms[: self.vocab_size]]
+        return CountVectorizerModel(vocab, self.min_tf, self.binary,
+                                    self.input_col, self.output_col)
+
+
+@persistable
+class CountVectorizerModel(Model):
+    _persist_attrs = ('vocabulary', 'min_tf', 'binary', 'input_col',
+                      'output_col')
+
+    def __init__(self, vocabulary, min_tf=1.0, binary=False,
+                 input_col=None, output_col=None):
+        self.vocabulary = list(vocabulary)
+        self.min_tf = float(min_tf)
+        self.binary = binary
+        self.input_col = input_col
+        self.output_col = output_col
+        self._index = {t: i for i, t in enumerate(self.vocabulary)}
+
+    def _post_load(self):
+        self.vocabulary = list(self.vocabulary)
+        self._index = {t: i for i, t in enumerate(self.vocabulary)}
+
+    def transform(self, frame):
+        col = _token_col(frame, self.input_col)
+        M = np.zeros((len(col), len(self.vocabulary)),
+                     np.dtype(float_dtype()))
+        for i, toks in enumerate(col):
+            if toks is None:
+                continue
+            for t in toks:
+                j = self._index.get(t)
+                if j is not None:
+                    M[i, j] += 1.0
+            if self.min_tf >= 1.0:
+                M[i][M[i] < self.min_tf] = 0.0
+            elif len(toks):
+                M[i][M[i] / len(toks) < self.min_tf] = 0.0
+            if self.binary:
+                M[i] = (M[i] > 0).astype(M.dtype)
+        return frame.with_column(self.output_col, jnp.asarray(M))
+
+
+@persistable
+class IDF(Estimator):
+    """MLlib ``IDF``: log((n+1)/(df+1)) weights over a TF vector column;
+    document frequency is ONE masked device reduction, the transform a
+    fused broadcast multiply."""
+
+    _persist_attrs = ('min_doc_freq', 'input_col', 'output_col')
+
+    def __init__(self, min_doc_freq: int = 0, input_col: str = None,
+                 output_col: str = None):
+        self.min_doc_freq = int(min_doc_freq)
+        self.input_col = input_col
+        self.output_col = output_col
+
+    def set_min_doc_freq(self, v):
+        self.min_doc_freq = int(v)
+        return self
+
+    setMinDocFreq = set_min_doc_freq
+
+    def fit(self, frame) -> "IDFModel":
+        tf = jnp.asarray(frame._column_values(self.input_col),
+                         float_dtype())
+        w = frame.mask.astype(tf.dtype)
+        df = jnp.sum((tf > 0).astype(tf.dtype) * w[:, None], axis=0)
+        n = jnp.sum(w)
+        idf = jnp.log((n + 1.0) / (df + 1.0))
+        if self.min_doc_freq > 0:
+            idf = jnp.where(df >= self.min_doc_freq, idf, 0.0)
+        return IDFModel(np.asarray(idf), self.input_col, self.output_col)
+
+
+@persistable
+class IDFModel(Model):
+    _persist_attrs = ('idf', 'input_col', 'output_col')
+
+    def __init__(self, idf, input_col=None, output_col=None):
+        self.idf = np.asarray(idf)
+        self.input_col = input_col
+        self.output_col = output_col
+
+    def transform(self, frame):
+        tf = jnp.asarray(frame._column_values(self.input_col),
+                         float_dtype())
+        return frame.with_column(self.output_col,
+                                 tf * jnp.asarray(self.idf, tf.dtype))

@@ -585,14 +585,12 @@ class RandomForestRegressor(DecisionTreeRegressor):
         return self.feature_subset_strategy
 
     def _make_model(self, trees, d):
-        m = DecisionTreeRegressionModel.__new__(RandomForestRegressionModel)
-        DecisionTreeRegressionModel.__init__(
-            m, np.asarray(trees.feature), np.asarray(trees.threshold),
+        return RandomForestRegressionModel(
+            np.asarray(trees.feature), np.asarray(trees.threshold),
             np.asarray(trees.is_leaf), np.asarray(trees.value),
             np.asarray(trees.gain), d, self.max_depth,
             {"features_col": self.features_col,
              "prediction_col": self.prediction_col})
-        return m
 
 
 @persistable
@@ -698,18 +696,22 @@ class DecisionTreeClassificationModel(_TreeModelBase):
 
     numClasses = property(lambda self: self.num_classes)
 
-    def _proba(self, X):
+    def _counts_and_proba(self, X):
         vals = self._leaf_values(X)                  # (T, n, k) class counts
         per_tree = vals / jnp.maximum(
             jnp.sum(vals, axis=2, keepdims=True), 1e-12)
-        return jnp.mean(per_tree, axis=0)            # soft vote (Spark)
+        # rawPrediction = summed leaf counts (MLlib), probability = soft vote
+        return jnp.sum(vals, axis=0), jnp.mean(per_tree, axis=0)
+
+    def _proba(self, X):
+        return self._counts_and_proba(X)[1]
 
     def transform(self, frame: Frame) -> Frame:
         p = self._params
-        prob = self._proba(self._frame_X(frame))
+        raw, prob = self._counts_and_proba(self._frame_X(frame))
         pred = jnp.argmax(prob, axis=1).astype(float_dtype())
         out = frame.with_column(p.get("raw_prediction_col", "rawPrediction"),
-                                prob)
+                                raw)
         out = out.with_column(p.get("probability_col", "probability"), prob)
         return out.with_column(p.get("prediction_col", "prediction"), pred)
 
@@ -760,14 +762,11 @@ class RandomForestClassifier(DecisionTreeClassifier):
         return self.feature_subset_strategy
 
     def _make_model(self, trees, d, k):
-        m = DecisionTreeClassificationModel.__new__(
-            RandomForestClassificationModel)
-        DecisionTreeClassificationModel.__init__(
-            m, np.asarray(trees.feature), np.asarray(trees.threshold),
+        return RandomForestClassificationModel(
+            np.asarray(trees.feature), np.asarray(trees.threshold),
             np.asarray(trees.is_leaf), np.asarray(trees.value),
             np.asarray(trees.gain), d, self.max_depth, k,
             self._params_for_model())
-        return m
 
 
 @persistable

@@ -1,0 +1,183 @@
+"""Text feature pipeline (Tokenizer → StopWordsRemover/NGram →
+HashingTF/CountVectorizer → IDF) and OneVsRest multiclass reduction."""
+
+import numpy as np
+import pytest
+
+from sparkdq4ml_tpu import Frame, col
+from sparkdq4ml_tpu.models import (CountVectorizer, HashingTF, IDF,
+                                   LogisticRegression, NGram, OneVsRest,
+                                   Pipeline, RegexTokenizer,
+                                   StopWordsRemover, Tokenizer,
+                                   VectorAssembler)
+
+
+@pytest.fixture
+def docs():
+    return Frame({"text": np.asarray(
+        ["the TPU runs Fast", "the cpu runs slow", None,
+         "fast tpu fast"], dtype=object)})
+
+
+class TestTokenizers:
+    def test_tokenizer_lowercases_and_splits(self, docs):
+        out = Tokenizer("text", "words").transform(docs).to_pydict()
+        assert out["words"][0] == ["the", "tpu", "runs", "fast"]
+        assert out["words"][2] is None
+
+    def test_regex_tokenizer_match_mode(self):
+        f = Frame({"text": np.asarray(["a1 b2 c3"], dtype=object)})
+        out = RegexTokenizer("text", "t", pattern=r"[a-z]+",
+                             gaps=False).transform(f).to_pydict()
+        assert out["t"][0] == ["a", "b", "c"]
+
+    def test_regex_min_token_length(self):
+        f = Frame({"text": np.asarray(["a bb ccc"], dtype=object)})
+        out = RegexTokenizer("text", "t",
+                             min_token_length=2).transform(f).to_pydict()
+        assert out["t"][0] == ["bb", "ccc"]
+
+
+class TestStopWordsAndNGram:
+    def test_stop_words_removed(self, docs):
+        f = Tokenizer("text", "words").transform(docs)
+        out = StopWordsRemover("words", "clean").transform(f).to_pydict()
+        assert out["clean"][0] == ["tpu", "runs", "fast"]
+
+    def test_custom_case_sensitive(self):
+        w = np.empty(1, dtype=object)
+        w[0] = ["Foo", "foo", "bar"]
+        f = Frame({"w": w})
+        out = StopWordsRemover("w", "c", stop_words=["foo"],
+                               case_sensitive=True).transform(f).to_pydict()
+        assert out["c"][0] == ["Foo", "bar"]
+
+    def test_ngram(self):
+        w = np.empty(1, dtype=object)
+        w[0] = ["a", "b", "c"]
+        f = Frame({"w": w})
+        out = NGram(2, "w", "g").transform(f).to_pydict()
+        assert out["g"][0] == ["a b", "b c"]
+        out3 = NGram(4, "w", "g").transform(f).to_pydict()
+        assert out3["g"][0] == []
+
+
+class TestVectorizers:
+    def test_hashing_tf_counts(self, docs):
+        f = Tokenizer("text", "words").transform(docs)
+        out = HashingTF(64, "words", "tf").transform(f)
+        M = np.stack(out.to_pydict()["tf"])
+        assert M.shape == (4, 64)
+        assert M[3].sum() == 3.0          # "fast tpu fast"
+        assert M[3].max() == 2.0          # "fast" hashed twice
+        assert M[2].sum() == 0.0          # None doc
+
+    def test_hashing_tf_binary(self, docs):
+        f = Tokenizer("text", "words").transform(docs)
+        M = np.stack(HashingTF(64, "words", "tf", binary=True)
+                     .transform(f).to_pydict()["tf"])
+        assert M[3].max() == 1.0
+
+    def test_count_vectorizer_vocab_order(self, docs):
+        f = Tokenizer("text", "words").transform(docs)
+        model = CountVectorizer(input_col="words", output_col="cv").fit(f)
+        # corpus doc-frequencies: the=2, runs=2, fast=2, tpu=2, cpu=1, slow=1
+        assert set(model.vocabulary[:4]) == {"the", "runs", "fast", "tpu"}
+        M = np.stack(model.transform(f).to_pydict()["cv"])
+        fast_idx = model.vocabulary.index("fast")
+        assert M[3, fast_idx] == 2.0
+
+    def test_count_vectorizer_min_df_and_vocab_size(self, docs):
+        f = Tokenizer("text", "words").transform(docs)
+        model = CountVectorizer(vocab_size=3, min_df=2.0,
+                                input_col="words", output_col="cv").fit(f)
+        assert len(model.vocabulary) == 3
+        assert "cpu" not in model.vocabulary  # df=1 < 2
+
+    def test_count_vectorizer_respects_mask(self, docs):
+        f = Tokenizer("text", "words").transform(docs)
+        f2 = f.filter(np.asarray([True, False, True, True]))
+        model = CountVectorizer(input_col="words", output_col="cv").fit(f2)
+        assert "cpu" not in model.vocabulary  # its only doc is masked
+
+    def test_idf(self, docs):
+        f = Tokenizer("text", "words").transform(docs)
+        f = HashingTF(32, "words", "tf").transform(f)
+        model = IDF(input_col="tf", output_col="tfidf").fit(f)
+        out = np.stack(model.transform(f).to_pydict()["tfidf"])
+        assert out.shape == (4, 32)
+        # a term in every valid doc gets the smallest idf
+        assert np.asarray(model.idf).min() >= 0.0
+
+    def test_text_pipeline_end_to_end(self, docs):
+        pipe = Pipeline([
+            Tokenizer("text", "words"),
+            StopWordsRemover("words", "clean"),
+            HashingTF(128, "clean", "tf"),
+            IDF(input_col="tf", output_col="features"),
+        ])
+        model = pipe.fit(docs)
+        out = model.transform(docs)
+        assert np.stack(out.to_pydict()["features"]).shape == (4, 128)
+
+    def test_persistence(self, tmp_path):
+        from sparkdq4ml_tpu.models.base import load_stage
+
+        w = np.empty(2, dtype=object)
+        w[0] = ["x", "y"]
+        w[1] = ["x"]
+        f = Frame({"w": w})
+        model = CountVectorizer(input_col="w", output_col="cv").fit(f)
+        model.save(str(tmp_path / "cv"))
+        loaded = load_stage(str(tmp_path / "cv"))
+        assert loaded.vocabulary == model.vocabulary
+        M = np.stack(loaded.transform(f).to_pydict()["cv"])
+        assert M.shape == (2, 2)
+
+
+class TestOneVsRest:
+    def three_class_frame(self, n=240, seed=0):
+        rng = np.random.default_rng(seed)
+        X = rng.normal(size=(n, 2))
+        y = np.argmax(X @ np.asarray([[2.0, -1.0, -1.0],
+                                      [-1.0, 2.0, -1.0]]), axis=1)
+        f = Frame({"x0": X[:, 0].astype(np.float32),
+                   "x1": X[:, 1].astype(np.float32),
+                   "label": y.astype(np.float32)})
+        return VectorAssembler(["x0", "x1"], "features").transform(f), y
+
+    def test_multiclass_accuracy(self):
+        f, y = self.three_class_frame()
+        ovr = OneVsRest(classifier=LogisticRegression(max_iter=60))
+        model = ovr.fit(f)
+        assert model.num_classes == 3
+        out = model.transform(f).to_pydict()
+        assert np.mean(out["prediction"] == y) > 0.9
+
+    def test_classifier_required(self):
+        f, _ = self.three_class_frame(n=30)
+        with pytest.raises(ValueError, match="classifier"):
+            OneVsRest().fit(f)
+
+    def test_estimator_roundtrip_keeps_classifier(self, tmp_path):
+        from sparkdq4ml_tpu.models.base import load_stage
+
+        est = OneVsRest(classifier=LogisticRegression(max_iter=25))
+        est.save(str(tmp_path / "ovr_est"))
+        loaded = load_stage(str(tmp_path / "ovr_est"))
+        assert isinstance(loaded.classifier, LogisticRegression)
+        f, y = self.three_class_frame(n=90)
+        model = loaded.fit(f)  # a loaded estimator must still be fittable
+        assert model.num_classes == 3
+
+    def test_persistence(self, tmp_path):
+        from sparkdq4ml_tpu.models.base import load_stage
+
+        f, y = self.three_class_frame(n=90)
+        model = OneVsRest(classifier=LogisticRegression(max_iter=30)).fit(f)
+        model.save(str(tmp_path / "ovr"))
+        loaded = load_stage(str(tmp_path / "ovr"))
+        assert loaded.num_classes == 3
+        a = model.transform(f).to_pydict()["prediction"]
+        b = loaded.transform(f).to_pydict()["prediction"]
+        assert np.array_equal(a, b)

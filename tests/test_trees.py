@@ -119,6 +119,14 @@ class TestDecisionTreeClassifier:
         assert np.mean(out["prediction"] == y) > 0.95
         probs = np.stack(out["probability"])
         assert np.allclose(probs.sum(axis=1), 1.0, atol=1e-5)
+        # rawPrediction = leaf class counts (MLlib), not the probabilities:
+        # row sums are leaf sizes (≥ 1), and for a single tree the
+        # normalized counts reproduce the probability column
+        raw = np.stack(out["rawPrediction"])
+        assert raw.sum(axis=1).min() >= 1.0
+        assert not np.allclose(raw, probs)
+        assert np.allclose(raw / raw.sum(axis=1, keepdims=True), probs,
+                           atol=1e-5)
 
     def test_entropy_impurity(self):
         f, X, y = clf_frame()
