@@ -87,6 +87,33 @@ def main() -> None:
     print(f"large-party classifier AUC: {auc:.4f} "
           f"(iterations: {lmodel.summary.total_iterations})")
 
+    # --- the wider model zoo on the same catering data ----------------------
+    import numpy as np
+
+    from sparkdq4ml_tpu.models import (ClusteringEvaluator, GBTRegressor,
+                                       GeneralizedLinearRegression, KMeans,
+                                       RandomForestClassifier)
+
+    glm = GeneralizedLinearRegression(family="gamma", link="log").fit(fdf)
+    print(f"gamma-GLM price fit: deviance {glm.summary.deviance:.1f}, "
+          f"AIC {glm.summary.aic:.1f}")
+
+    gbt = GBTRegressor(max_iter=20, max_depth=3, step_size=0.2).fit(fdf)
+    gbt_rmse = RegressionEvaluator(metric_name="rmse").evaluate(
+        gbt.transform(fdf))
+    print(f"GBT price fit RMSE: {gbt_rmse:.4f}")
+
+    rf = RandomForestClassifier(num_trees=10, max_depth=4).fit(ldf)
+    rf_out = rf.transform(ldf).to_pydict()
+    rf_acc = float(np.mean(rf_out["prediction"] == rf_out["label"]))
+    print(f"random-forest large-party accuracy: {rf_acc:.3f}")
+
+    km = KMeans(k=3, seed=7, features_col="features").fit(fdf)
+    sil = ClusteringEvaluator(features_col="features").evaluate(
+        km.transform(fdf))
+    print(f"k=3 guest clustering silhouette: {sil:.3f} "
+          f"(sizes {sorted(km.summary.cluster_sizes)})")
+
 
 if __name__ == "__main__":
     main()

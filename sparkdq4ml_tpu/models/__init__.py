@@ -29,6 +29,7 @@ from .tree import (DecisionTreeClassificationModel, DecisionTreeClassifier,
                    GBTRegressionModel, GBTRegressor,
                    RandomForestClassificationModel, RandomForestClassifier,
                    RandomForestRegressionModel, RandomForestRegressor)
+from .recommendation import ALS, ALSModel
 from .regression import (LinearRegression, LinearRegressionModel,
                          LinearRegressionSummary,
                          LinearRegressionTrainingSummary)

@@ -1,0 +1,308 @@
+"""ALS collaborative filtering (MLlib ``org.apache.spark.ml.recommendation``
+— shipped by the reference's mllib dependency, pom.xml:29-32).
+
+TPU-first design — not a port of Spark's block-partitioned ALS:
+
+* Each half-step solves every user's (or item's) k×k ridge system AT ONCE:
+  the per-user normal matrices ``Σ v_i v_iᵀ`` are one ``segment_sum`` over
+  the ratings' factor outer products, and the solves are one *batched*
+  ``jnp.linalg.solve`` over all users — XLA turns both into large fused
+  batch ops. Spark instead shuffles factor blocks between executors per
+  step; here the whole alternation loop is a single jitted ``lax.scan``
+  with zero host round-trips.
+* Regularization follows Spark's ALS-WR convention: λ scaled by each
+  user's/item's rating count (``regParam * n_u``).
+* ``recommend_for_all_users`` is one ``U @ Vᵀ`` MXU matmul + ``top_k``.
+
+Explicit feedback only (``implicit_prefs=True`` raises — documented gap;
+the reference stack's headline ALS mode is explicit ratings).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import float_dtype
+from ..frame import Frame
+from .base import Estimator, Model, persistable
+
+
+def _als_half_step(factors_other, idx_self, idx_other, ratings, n_self,
+                   rank, reg):
+    """Solve all of one side's factors given the other side's.
+
+    For every entity e on the solving side:
+        (Σ_{r∈R(e)} v_r v_rᵀ + λ·n_e·I) x_e = Σ_{r∈R(e)} rating_r · v_r
+    computed as two segment_sums + one batched solve.
+    """
+    V = factors_other[idx_other]                       # (nnz, k)
+    outer = V[:, :, None] * V[:, None, :]              # (nnz, k, k)
+    A = jax.ops.segment_sum(outer, idx_self, num_segments=n_self)
+    b = jax.ops.segment_sum(V * ratings[:, None], idx_self,
+                            num_segments=n_self)
+    cnt = jax.ops.segment_sum(jnp.ones_like(ratings), idx_self,
+                              num_segments=n_self)
+    eye = jnp.eye(rank, dtype=V.dtype)
+    # ALS-WR: λ scaled by the entity's rating count; entities with no
+    # ratings get the identity system → zero factors
+    lam = reg * jnp.maximum(cnt, 1.0)
+    A = A + lam[:, None, None] * eye
+    x = jnp.linalg.solve(A, b[:, :, None])[:, :, 0]
+    return jnp.where(cnt[:, None] > 0, x, 0.0)
+
+
+@functools.lru_cache(maxsize=None)
+def _als_fit_fn(rank, max_iter, reg, n_users, n_items):
+    def fit(u_idx, i_idx, ratings, U0, V0):
+        def body(carry, _):
+            U, V = carry
+            U = _als_half_step(V, u_idx, i_idx, ratings, n_users, rank, reg)
+            V = _als_half_step(U, i_idx, u_idx, ratings, n_items, rank, reg)
+            # loss (for the scan output): masked squared error
+            pred = jnp.sum(U[u_idx] * V[i_idx], axis=1)
+            mse = jnp.mean((ratings - pred) ** 2)
+            return (U, V), mse
+        (U, V), history = jax.lax.scan(body, (U0, V0), None, length=max_iter)
+        return U, V, history
+
+    return jax.jit(fit)
+
+
+@persistable
+class ALS(Estimator):
+    """MLlib ``ALS`` builder surface: setRank/setMaxIter/setRegParam/
+    setUserCol/setItemCol/setRatingCol/setColdStartStrategy/setSeed."""
+
+    _persist_attrs = ('rank', 'max_iter', 'reg_param', 'user_col',
+                      'item_col', 'rating_col', 'prediction_col',
+                      'cold_start_strategy', 'seed')
+
+    def __init__(self, rank: int = 10, max_iter: int = 10,
+                 reg_param: float = 0.1, user_col: str = "user",
+                 item_col: str = "item", rating_col: str = "rating",
+                 prediction_col: str = "prediction",
+                 cold_start_strategy: str = "nan",
+                 implicit_prefs: bool = False, seed: int = 0):
+        if implicit_prefs:
+            raise NotImplementedError(
+                "implicit-preference ALS is not implemented; explicit "
+                "ratings only")
+        if rank < 1:
+            raise ValueError("rank must be >= 1")
+        if cold_start_strategy not in ("nan", "drop"):
+            raise ValueError(f"cold_start_strategy={cold_start_strategy!r}")
+        self.rank = int(rank)
+        self.max_iter = int(max_iter)
+        self.reg_param = float(reg_param)
+        self.user_col = user_col
+        self.item_col = item_col
+        self.rating_col = rating_col
+        self.prediction_col = prediction_col
+        self.cold_start_strategy = cold_start_strategy
+        self.seed = int(seed)
+
+    def set_rank(self, v):
+        if v < 1:
+            raise ValueError("rank must be >= 1")
+        self.rank = int(v)
+        return self
+
+    setRank = set_rank
+
+    def set_max_iter(self, v):
+        self.max_iter = int(v)
+        return self
+
+    setMaxIter = set_max_iter
+
+    def set_reg_param(self, v):
+        self.reg_param = float(v)
+        return self
+
+    setRegParam = set_reg_param
+
+    def set_user_col(self, v):
+        self.user_col = v
+        return self
+
+    setUserCol = set_user_col
+
+    def set_item_col(self, v):
+        self.item_col = v
+        return self
+
+    setItemCol = set_item_col
+
+    def set_rating_col(self, v):
+        self.rating_col = v
+        return self
+
+    setRatingCol = set_rating_col
+
+    def set_cold_start_strategy(self, v):
+        if v not in ("nan", "drop"):
+            raise ValueError(f"cold_start_strategy={v!r}")
+        self.cold_start_strategy = v
+        return self
+
+    setColdStartStrategy = set_cold_start_strategy
+
+    def set_seed(self, v):
+        self.seed = int(v)
+        return self
+
+    setSeed = set_seed
+
+    def fit(self, frame: Frame) -> "ALSModel":
+        dt = np.dtype(float_dtype())
+        mask = np.asarray(frame.mask)
+        if mask.sum() == 0:
+            raise ValueError("ALS: no valid rows")
+        users = np.asarray(frame._column_values(self.user_col))[mask]
+        items = np.asarray(frame._column_values(self.item_col))[mask]
+        ratings = np.asarray(frame._column_values(self.rating_col),
+                             dt)[mask]
+        if not np.all(np.isfinite(ratings)):
+            raise ValueError("ALS: rating column has NaN/inf in valid rows")
+
+        # dense id maps (hosts the analogue of Spark's in/out block mapping)
+        u_ids, u_idx = np.unique(np.asarray(users, np.int64),
+                                 return_inverse=True)
+        i_ids, i_idx = np.unique(np.asarray(items, np.int64),
+                                 return_inverse=True)
+        n_users, n_items = len(u_ids), len(i_ids)
+
+        rng = np.random.default_rng(self.seed)
+        # Spark seeds factors with scaled |N(0,1)|; plain N(0,1)/sqrt(k)
+        # reaches the same optimum on this convex-per-block problem
+        U0 = (rng.normal(size=(n_users, self.rank)) / np.sqrt(self.rank)) \
+            .astype(dt)
+        V0 = (rng.normal(size=(n_items, self.rank)) / np.sqrt(self.rank)) \
+            .astype(dt)
+
+        fit_fn = _als_fit_fn(self.rank, self.max_iter, self.reg_param,
+                             n_users, n_items)
+        U, V, history = jax.block_until_ready(fit_fn(
+            jnp.asarray(u_idx, jnp.int32), jnp.asarray(i_idx, jnp.int32),
+            jnp.asarray(ratings), jnp.asarray(U0), jnp.asarray(V0)))
+        return ALSModel(np.asarray(U), np.asarray(V), u_ids.tolist(),
+                        i_ids.tolist(), self._params_dict(),
+                        np.asarray(history, np.float64).tolist())
+
+    def _params_dict(self):
+        return {k: getattr(self, k) for k in self._persist_attrs}
+
+
+@persistable
+class ALSModel(Model):
+    """User/item factor matrices + the MLlib surface: ``transform`` (rating
+    prediction per (user, item) row), ``recommendForAllUsers/Items`` (one
+    MXU matmul + top_k), ``userFactors``/``itemFactors`` frames."""
+
+    _persist_attrs = ('user_factors_arr', 'item_factors_arr', 'user_ids',
+                      'item_ids', '_params', 'loss_history')
+
+    def __init__(self, user_factors, item_factors, user_ids, item_ids,
+                 params=None, loss_history=None):
+        self.user_factors_arr = np.asarray(user_factors)
+        self.item_factors_arr = np.asarray(item_factors)
+        self.user_ids = list(user_ids)
+        self.item_ids = list(item_ids)
+        self._params = dict(params or {})
+        self.loss_history = list(loss_history or [])
+        self._build_index()
+
+    def _post_load(self):
+        self.user_ids = list(self.user_ids)
+        self.item_ids = list(self.item_ids)
+        self._build_index()
+
+    def _build_index(self):
+        self._u_map = {int(u): i for i, u in enumerate(self.user_ids)}
+        self._i_map = {int(v): i for i, v in enumerate(self.item_ids)}
+
+    @property
+    def rank(self):
+        return int(self.user_factors_arr.shape[1])
+
+    def _p(self, key, default=None):
+        return self._params.get(key, default)
+
+    @property
+    def user_factors(self) -> Frame:
+        return Frame({"id": np.asarray(self.user_ids, np.int64),
+                      "features": jnp.asarray(self.user_factors_arr,
+                                              float_dtype())})
+
+    userFactors = user_factors
+
+    @property
+    def item_factors(self) -> Frame:
+        return Frame({"id": np.asarray(self.item_ids, np.int64),
+                      "features": jnp.asarray(self.item_factors_arr,
+                                              float_dtype())})
+
+    itemFactors = item_factors
+
+    def transform(self, frame: Frame) -> Frame:
+        users = np.asarray(frame._column_values(self._p("user_col", "user")),
+                           np.int64)
+        items = np.asarray(frame._column_values(self._p("item_col", "item")),
+                           np.int64)
+        u_pos = np.asarray([self._u_map.get(int(u), -1) for u in users])
+        i_pos = np.asarray([self._i_map.get(int(v), -1) for v in items])
+        known = (u_pos >= 0) & (i_pos >= 0)
+        U = jnp.asarray(self.user_factors_arr, float_dtype())
+        V = jnp.asarray(self.item_factors_arr, float_dtype())
+        pred = jnp.sum(U[jnp.asarray(np.where(known, u_pos, 0))] *
+                       V[jnp.asarray(np.where(known, i_pos, 0))], axis=1)
+        pred = jnp.where(jnp.asarray(known), pred,
+                         jnp.asarray(np.nan, pred.dtype))
+        out = frame.with_column(self._p("prediction_col", "prediction"),
+                                pred)
+        if self._p("cold_start_strategy", "nan") == "drop":
+            out = out.filter(jnp.asarray(known))
+        return out
+
+    def predict(self, user: int, item: int) -> float:
+        u = self._u_map.get(int(user))
+        v = self._i_map.get(int(item))
+        if u is None or v is None:
+            return float("nan")
+        return float(self.user_factors_arr[u] @ self.item_factors_arr[v])
+
+    def _recommend(self, F_for, F_items, ids_for, ids_items, num: int,
+                   col_for: str, col_items: str) -> Frame:
+        scores = jnp.asarray(F_for, float_dtype()) @ \
+            jnp.asarray(F_items, float_dtype()).T
+        k = min(num, scores.shape[1])
+        top_scores, top_idx = jax.lax.top_k(scores, k)    # (n, k)
+        top_idx = np.asarray(top_idx)
+        top_scores = np.asarray(top_scores)
+        ids_items_arr = np.asarray(ids_items, np.int64)
+        recs = np.empty(len(ids_for), dtype=object)
+        for i in range(len(ids_for)):
+            recs[i] = [(int(ids_items_arr[j]), float(s))
+                       for j, s in zip(top_idx[i], top_scores[i])]
+        return Frame({col_for: np.asarray(ids_for, np.int64),
+                      "recommendations": recs})
+
+    def recommend_for_all_users(self, num_items: int) -> Frame:
+        """Top ``num_items`` items per user — one U @ Vᵀ matmul + top_k."""
+        return self._recommend(self.user_factors_arr, self.item_factors_arr,
+                               self.user_ids, self.item_ids, num_items,
+                               self._p("user_col", "user"), "item")
+
+    recommendForAllUsers = recommend_for_all_users
+
+    def recommend_for_all_items(self, num_users: int) -> Frame:
+        return self._recommend(self.item_factors_arr, self.user_factors_arr,
+                               self.item_ids, self.user_ids, num_users,
+                               self._p("item_col", "item"), "user")
+
+    recommendForAllItems = recommend_for_all_items

@@ -258,11 +258,14 @@ class HashingTF(Transformer):
         col = _token_col(frame, self.input_col)
         M = np.zeros((len(col), self.num_features),
                      np.dtype(float_dtype()))
+        bucket: dict = {}  # hash once per unique token, not per occurrence
         for i, toks in enumerate(col):
             if toks is None:
                 continue
             for t in toks:
-                j = _stable_hash(t, self.num_features)
+                j = bucket.get(t)
+                if j is None:
+                    j = bucket[t] = _stable_hash(t, self.num_features)
                 if self.binary:
                     M[i, j] = 1.0
                 else:

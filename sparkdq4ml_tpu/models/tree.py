@@ -700,8 +700,13 @@ class DecisionTreeClassificationModel(_TreeModelBase):
         vals = self._leaf_values(X)                  # (T, n, k) class counts
         per_tree = vals / jnp.maximum(
             jnp.sum(vals, axis=2, keepdims=True), 1e-12)
-        # rawPrediction = summed leaf counts (MLlib), probability = soft vote
-        return jnp.sum(vals, axis=0), jnp.mean(per_tree, axis=0)
+        if vals.shape[0] == 1:
+            # single tree (MLlib): rawPrediction = the leaf's class counts
+            return vals[0], per_tree[0]
+        # forest (MLlib): rawPrediction = summed per-tree probability votes,
+        # so argmax(rawPrediction) == argmax(probability) always holds
+        raw = jnp.sum(per_tree, axis=0)
+        return raw, raw / vals.shape[0]
 
     def _proba(self, X):
         return self._counts_and_proba(X)[1]

@@ -185,6 +185,10 @@ class TestRandomForest:
         assert np.mean(out["prediction"] == y) > 0.93
         probs = np.stack(out["probability"])
         assert np.allclose(probs.sum(axis=1), 1.0, atol=1e-5)
+        # MLlib contract: prediction == argmax(rawPrediction) for forests
+        raw = np.stack(out["rawPrediction"])
+        assert np.array_equal(np.argmax(raw, axis=1),
+                              np.asarray(out["prediction"]).astype(int))
 
     def test_deterministic_given_seed(self):
         f, X, _ = clf_frame(n=120)
