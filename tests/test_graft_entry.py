@@ -1,0 +1,36 @@
+"""The driver contract: ``entry()`` compiles, ``dryrun_multichip`` runs.
+
+``dryrun_multichip`` must work from any host — with enough devices it runs
+in-process; with fewer it must *self-provision* a virtual CPU mesh in a
+subprocess (the analogue of the reference's one-machine multi-partition
+``master("local[*]")``, `DataQuality4MachineLearningApp.java:40`).
+"""
+
+import os
+import sys
+
+import jax
+import pytest
+
+sys.path.insert(0, os.path.abspath(os.path.join(os.path.dirname(__file__), "..")))
+
+import __graft_entry__ as graft
+
+
+def test_entry_jits():
+    fn, args = graft.entry()
+    out = jax.jit(fn)(*args)
+    assert out.shape == (args[2].shape[0],)
+
+
+def test_dryrun_inprocess_path():
+    # conftest provisions 8 fake CPU devices, so n=4 runs in-process.
+    graft.dryrun_multichip(4)
+
+
+@pytest.mark.slow
+def test_dryrun_self_provisions_subprocess():
+    # More devices than this process has → must re-exec with a bigger
+    # virtual mesh rather than raising "need N devices".
+    assert len(jax.devices()) < 16
+    graft.dryrun_multichip(16)
