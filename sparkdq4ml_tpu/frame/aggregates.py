@@ -297,40 +297,53 @@ class PivotedFrame:
         pcol = np.asarray(d[self._pivot_col])
         if self._values is None:
             uniq = [x for x in set(pcol.tolist()) if x is not None]
-            values = sorted(uniq)
+            try:
+                values = sorted(uniq)       # natural order (Spark parity)
+            except TypeError:
+                # mixed incomparable types (e.g. int + str): group by type,
+                # natural order within each type
+                values = sorted(uniq, key=lambda x: (str(type(x)), x))
         else:
             values = self._values
 
         key_cols = [np.asarray(d[k]) for k in self._keys]
         order, group_starts, group_ends = _group_plan(key_cols, len(pcol))
 
-        def col_name(value, agg):
-            base = str(value) if len(agg_list) == 1 else f"{value}_{agg.name}"
-            while base in self._keys:   # a pivot value may shadow a key name
-                base += "_pivot"
-            return base
+        # Output names are precomputed, de-colliding against group keys AND
+        # each other (two pivot values may stringify identically, 1 vs "1").
+        taken = set(self._keys)
+        names: dict[tuple, str] = {}
+        for vi, v in enumerate(values):
+            for ai, a in enumerate(agg_list):
+                base = str(v) if len(agg_list) == 1 else f"{v}_{a.name}"
+                while base in taken:
+                    base += "_pivot"
+                taken.add(base)
+                names[(vi, ai)] = base
+
+        agg_arrays = {a.column: np.asarray(d[a.column])
+                      for a in agg_list if a.column is not None}
 
         data: dict[str, list] = {k: [] for k in self._keys}
-        for v in values:
-            for a in agg_list:
-                data[col_name(v, a)] = []
+        for nm in names.values():
+            data[nm] = []
         for s, e in zip(group_starts, group_ends):
             idx = order[s:e]
             for k, kc in zip(self._keys, key_cols):
                 data[k].append(kc[idx[0]])
             grp_pivot = pcol[idx]
-            for v in values:
+            for vi, v in enumerate(values):
                 sub = idx[np.asarray([x == v for x in grp_pivot], bool)]
-                for a in agg_list:
+                for ai, a in enumerate(agg_list):
                     if a.fn == "count" and a.column is None:
-                        data[col_name(v, a)].append(len(sub))
+                        data[names[(vi, ai)]].append(len(sub))
                     elif len(sub) == 0:
                         # no rows for this cell → null (Spark), even for
                         # COUNT over a column (Spark yields null there too)
-                        data[col_name(v, a)].append(float("nan"))
+                        data[names[(vi, ai)]].append(float("nan"))
                     else:
-                        data[col_name(v, a)].append(
-                            _np_agg(a.fn, np.asarray(d[a.column])[sub]))
+                        data[names[(vi, ai)]].append(
+                            _np_agg(a.fn, agg_arrays[a.column][sub]))
         return Frame(data)
 
     def count(self):

@@ -600,6 +600,13 @@ class GlmTrainingSummary:
 
     @property
     def coefficient_standard_errors(self):
+        if self._m._p("reg_param", 0.0) > 0:
+            # The Wald covariance pinv(XtWX)·φ is only valid for the
+            # unpenalized MLE; Spark likewise refuses these stats for
+            # regularized fits.
+            raise ValueError(
+                "standard errors are not available for regularized fits "
+                "(reg_param > 0); refit with reg_param=0 for Wald inference")
         cov = np.linalg.pinv(self._info["xtwx"]) * self.dispersion
         return np.sqrt(np.clip(np.diag(cov), 0.0, None))
 

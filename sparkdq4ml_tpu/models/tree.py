@@ -318,6 +318,9 @@ class _TreeParams:
         if not np.all(np.isfinite(y[mask])):
             raise ValueError(f"{type(self).__name__}: label column has "
                              "NaN/inf in valid rows")
+        if not np.all(np.isfinite(X[mask])):
+            raise ValueError(f"{type(self).__name__}: feature matrix has "
+                             "NaN/inf in valid rows")
         # masked slots may hold NaN (dropna/filter keep values in place);
         # zero them so 0-weighted stats stay finite (0 * NaN = NaN otherwise)
         y = np.where(mask, y, 0.0)
@@ -525,8 +528,10 @@ class DecisionTreeRegressionModel(_TreeModelBase):
 
     def _predict_array(self, X):
         vals = self._leaf_values(X)                  # (T, n, 3): [w, wy, wy²]
-        w = jnp.maximum(jnp.sum(vals[:, :, 0], axis=0), 1e-12)
-        return jnp.sum(vals[:, :, 1], axis=0) / w    # forest-weighted mean
+        # MLlib averages per-tree leaf predictions with equal tree weight —
+        # NOT pooled leaf stats, which would weight trees by bootstrap count.
+        per_tree = vals[:, :, 1] / jnp.maximum(vals[:, :, 0], 1e-12)
+        return jnp.mean(per_tree, axis=0)
 
     def transform(self, frame: Frame) -> Frame:
         pred = self._predict_array(self._frame_X(frame))

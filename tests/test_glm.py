@@ -249,3 +249,17 @@ class TestShardedGlm:
                            atol=1e-4)
         assert sharded.intercept == pytest.approx(single.intercept,
                                                   abs=1e-4)
+
+
+class TestRegularizedInference:
+    def test_standard_errors_refused_for_regularized_fit(self):
+        rng = np.random.default_rng(0)
+        X = rng.normal(size=(60, 2))
+        y = X @ np.array([1.5, -2.0]) + 0.5 + 0.1 * rng.normal(size=60)
+        f = Frame({"x0": X[:, 0], "x1": X[:, 1], "label": y})
+        f = VectorAssembler(["x0", "x1"], "features").transform(f)
+        model = GeneralizedLinearRegression(reg_param=0.5).fit(f)
+        with pytest.raises(ValueError, match="regularized"):
+            model.summary.coefficient_standard_errors
+        with pytest.raises(ValueError, match="regularized"):
+            model.summary.p_values

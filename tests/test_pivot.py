@@ -109,3 +109,26 @@ class TestPivot:
         row_a = {d["k"][i]: (d["1"][i], d["2"][i]) for i in range(2)}["a"]
         assert row_a[0] == pytest.approx(10.0)
         assert row_a[1] == pytest.approx(20.0)
+
+
+class TestPivotEdgeCases:
+    def test_mixed_type_pivot_values_sort(self):
+        # ints and strings in one pivot column must not raise on sort
+        f = Frame({"k": np.asarray(["a", "a", "a"], dtype=object),
+                   "p": np.asarray([1, "z", 2], dtype=object),
+                   "v": [10.0, 20.0, 30.0]})
+        out = f.groupBy("k").pivot("p").sum("v")
+        d = out.to_pydict()
+        assert set(out.columns) == {"k", "1", "2", "z"}
+        assert d["z"][0] == pytest.approx(20.0)
+
+    def test_pivot_values_stringify_identically(self):
+        # 1 (int) and "1" (str) must yield two distinct output columns
+        f = Frame({"k": np.asarray(["a", "a"], dtype=object),
+                   "p": np.asarray([1, "1"], dtype=object),
+                   "v": [10.0, 20.0]})
+        out = f.groupBy("k").pivot("p").sum("v")
+        assert len(out.columns) == 3          # k + two de-collided pivots
+        d = out.to_pydict()
+        vals = sorted(d[c][0] for c in out.columns if c != "k")
+        assert vals == [pytest.approx(10.0), pytest.approx(20.0)]

@@ -274,3 +274,24 @@ class TestGBT:
         loaded = load_stage(str(tmp_path / "gbt"))
         assert loaded.predict(X[2]) == pytest.approx(model.predict(X[2]),
                                                      rel=1e-5)
+
+
+class TestAdvisorFindings:
+    def test_nan_feature_in_valid_row_rejected(self):
+        f = Frame({"x0": [1.0, float("nan"), 3.0, 4.0],
+                   "label": [1.0, 2.0, 3.0, 4.0]})
+        f = VectorAssembler(["x0"], "features").transform(f)
+        with pytest.raises(ValueError, match="feature matrix"):
+            DecisionTreeRegressor(max_depth=2).fit(f)
+
+    def test_forest_prediction_is_equal_tree_average(self):
+        # MLlib semantics: average per-tree leaf means with equal weight,
+        # not pooled [w, wy] leaf stats (which would weight by leaf size).
+        f, X, _ = reg_frame(n=120, seed=3)
+        model = RandomForestRegressor(num_trees=5, max_depth=3,
+                                      seed=7).fit(f)
+        vals = np.asarray(model._leaf_values(X[:10]))   # (T, n, 3)
+        per_tree = vals[:, :, 1] / np.maximum(vals[:, :, 0], 1e-12)
+        expected = per_tree.mean(axis=0)
+        got = np.asarray(model._predict_array(X[:10]))
+        np.testing.assert_allclose(got, expected, rtol=1e-6)
