@@ -2,6 +2,8 @@ from .base import Estimator, Model, Pipeline, PipelineModel, Transformer
 from .classification import (BinaryLogisticRegressionSummary,
                              BinaryLogisticRegressionTrainingSummary,
                              LogisticRegression, LogisticRegressionModel,
+                             LogisticRegressionSummary,
+                             LogisticRegressionTrainingSummary,
                              NaiveBayes, NaiveBayesModel, OneVsRest,
                              OneVsRestModel)
 from .clustering import KMeans, KMeansModel, KMeansSummary

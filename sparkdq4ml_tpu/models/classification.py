@@ -61,6 +61,20 @@ def _feature_stats(X, y, mask):
     return n, std
 
 
+def _sharded_feature_stats(X, mask):
+    """Global masked n / sample std from inside shard_map — one fused psum
+    of the [Σx, Σx², n] moment vector over the data axis."""
+    w = mask.astype(X.dtype)
+    parts = jnp.concatenate([w @ X, w @ (X * X), jnp.sum(w)[None]])
+    parts = jax.lax.psum(parts, DATA_AXIS)
+    d = X.shape[1]
+    n = parts[2 * d]
+    mean = parts[:d] / n
+    var = parts[d: 2 * d] / n - mean * mean
+    std = jnp.sqrt(jnp.clip(var * n / jnp.maximum(n - 1.0, 1.0), 0.0))
+    return n, std
+
+
 def _logistic_core(X, y, mask, reg_param, alpha, n, std,
                    max_iter, tol, fit_intercept, standardization, axis=None):
     """FISTA on mean log-loss over (possibly sharded) rows.
@@ -148,6 +162,111 @@ def _logistic_core(X, y, mask, reg_param, alpha, n, std,
     return LogisticFitResult(coef, intercept, iters, history, done)
 
 
+class SoftmaxFitResult(NamedTuple):
+    coefficient_matrix: jnp.ndarray     # (K, d)
+    intercept_vector: jnp.ndarray       # (K,)
+    iterations: jnp.ndarray
+    objective_history: jnp.ndarray
+    converged: jnp.ndarray
+
+
+def _softmax_core(X, y, mask, reg_param, alpha, n, std, num_classes,
+                  max_iter, tol, fit_intercept, standardization, axis=None):
+    """FISTA on the mean softmax cross-entropy over (possibly sharded) rows.
+
+    MLlib ``family="multinomial"`` conventions: features scaled by sample
+    std without centering; the (K, d) coefficient matrix penalized
+    elementwise with the same elastic-net weights as the binary path; the
+    K intercepts unpenalized. The whole loop is one ``lax.scan`` with a
+    single fused ``(K·d + K + 1)`` psum per iteration when sharded — the
+    per-iteration ``treeAggregate`` analogue, exactly like the binary path.
+    """
+    dt = X.dtype
+    d = X.shape[1]
+    K = num_classes
+    valid = std > 0
+    sx = jnp.where(valid, std, 1.0)
+    Xs = (X / sx) * mask.astype(dt)[:, None]   # standardized, masked rows
+    wm = mask.astype(dt)
+    Y1 = jax.nn.one_hot(y.astype(jnp.int32), K, dtype=dt) * wm[:, None]
+
+    u1 = jnp.ones((d,), dt) if standardization \
+        else jnp.where(valid, 1.0 / sx, 0.0)
+    lam1 = alpha * reg_param * u1                       # (d,), same per class
+    lam2 = (1.0 - alpha) * reg_param * (u1 if standardization else u1 * u1)
+
+    def reduce_(v):
+        return jax.lax.psum(v, axis) if axis is not None else v
+
+    # Softmax Hessian w.r.t. margins is diag(p) − ppᵀ ⪯ ½·I, so
+    # L ≤ ½‖Xs‖_F²/n (vs ¼ for the binary sigmoid).
+    sq = reduce_(jnp.sum(Xs * Xs))
+    L = 0.5 * sq / n + jnp.max(lam2, initial=0.0) + jnp.asarray(1e-12, dt)
+    step = 1.0 / L
+
+    m = K * d     # wb layout: [W.ravel() | b] with W (K, d), b (K,)
+
+    def loss_grad(wb):
+        W = wb[:m].reshape(K, d)
+        b = wb[m:]
+        margin = Xs @ W.T + b[None, :] * wm[:, None]        # (n, K)
+        lse = jax.nn.logsumexp(margin, axis=1)
+        ll = jnp.where(mask, lse - jnp.sum(margin * Y1, axis=1), 0.0)
+        p = jax.nn.softmax(margin, axis=1)
+        resid = (p - Y1) * wm[:, None]                      # (n, K)
+        g_W = resid.T @ Xs                                  # (K, d)
+        g_b = jnp.sum(resid, axis=0)                        # (K,)
+        packed = jnp.concatenate([g_W.ravel(), g_b, jnp.sum(ll)[None]])
+        packed = reduce_(packed)
+        grad = packed[: m + K] / n
+        grad = grad.at[:m].add((lam2[None, :] * W).ravel())
+        loss = packed[m + K] / n
+        if not fit_intercept:
+            grad = grad.at[m:].set(0.0)
+        return loss, grad
+
+    def objective(wb, loss):
+        W = wb[:m].reshape(K, d)
+        return (loss + jnp.sum(lam1[None, :] * jnp.abs(W))
+                + 0.5 * jnp.sum(lam2[None, :] * W * W))
+
+    wb0 = jnp.zeros((m + K,), dt)
+    loss0, _ = loss_grad(wb0)
+    obj0 = objective(wb0, loss0)
+
+    lam1_full = jnp.concatenate([jnp.tile(lam1, K), jnp.zeros((K,), dt)])
+    valid_full = jnp.concatenate([jnp.tile(valid, K),
+                                  jnp.full((K,), fit_intercept)])
+
+    def body(state, _):
+        wb, wb_prev, t, done, iters, last_obj = state
+        tn = 0.5 * (1.0 + jnp.sqrt(1.0 + 4.0 * t * t))
+        v = wb + ((t - 1.0) / tn) * (wb - wb_prev)
+        _, grad = loss_grad(v)
+        cand = v - step * grad
+        wb_new = jnp.where(valid_full, _soft(cand, step * lam1_full), 0.0)
+        loss_new, _ = loss_grad(wb_new)
+        obj = objective(wb_new, loss_new)
+        rel = jnp.abs(obj - last_obj) / jnp.maximum(jnp.abs(last_obj), 1e-12)
+        now_done = jnp.logical_or(done, rel < tol)
+        wb_out = jnp.where(done, wb, wb_new)
+        wb_prev_out = jnp.where(done, wb_prev, wb)
+        t_out = jnp.where(done, t, tn)
+        obj_out = jnp.where(done, last_obj, obj)
+        iters_out = iters + jnp.where(done, 0, 1).astype(jnp.int32)
+        return (wb_out, wb_prev_out, t_out, now_done, iters_out,
+                obj_out), obj_out
+
+    init = (wb0, wb0, jnp.asarray(1.0, dt), jnp.asarray(False),
+            jnp.asarray(0, jnp.int32), obj0)
+    (wb, _, _, done, iters, _), history = jax.lax.scan(body, init, None,
+                                                       length=max_iter)
+    W = jnp.where(valid[None, :], wb[:m].reshape(K, d) / sx[None, :], 0.0)
+    b = wb[m:]
+    history = jnp.concatenate([obj0[None], history])
+    return SoftmaxFitResult(W, b, iters, history, done)
+
+
 def _unpack_z(Z):
     """Split the packed design ``Z = [X, y, 1]·mask`` (pack_design layout).
 
@@ -192,14 +311,7 @@ def fused_logistic_fit_packed(mesh: Optional[Mesh], max_iter: int, tol: float,
     else:
         def local(Z, hyper):
             X, y, mask = _unpack_z(Z)
-            w = mask.astype(X.dtype)
-            parts = jnp.concatenate([w @ X, w @ (X * X), jnp.sum(w)[None]])
-            parts = jax.lax.psum(parts, DATA_AXIS)
-            d = X.shape[1]
-            n = parts[2 * d]
-            mean = parts[:d] / n
-            var = parts[d: 2 * d] / n - mean * mean
-            std = jnp.sqrt(jnp.clip(var * n / jnp.maximum(n - 1.0, 1.0), 0.0))
+            n, std = _sharded_feature_stats(X, mask)
             return _pack_logistic_result(_logistic_core(
                 X, y, mask, hyper[0], hyper[1], n, std, max_iter,
                 tol, fit_intercept, standardization, axis=DATA_AXIS))
@@ -212,9 +324,63 @@ def fused_logistic_fit_packed(mesh: Optional[Mesh], max_iter: int, tol: float,
     return jax.jit(fit)
 
 
+def _pack_softmax_result(r: "SoftmaxFitResult"):
+    """One output buffer: [W.ravel() | b | iters | converged | history]."""
+    dt = r.coefficient_matrix.dtype
+    scalars = jnp.stack([r.iterations.astype(dt), r.converged.astype(dt)])
+    return jnp.concatenate([r.coefficient_matrix.ravel(),
+                            r.intercept_vector.astype(dt), scalars,
+                            r.objective_history.astype(dt)])
+
+
+def unpack_softmax_result(flat, num_classes: int, d: int):
+    """Host-side decode of the packed softmax fit output."""
+    flat = np.asarray(flat)
+    m = num_classes * d
+    return SoftmaxFitResult(
+        coefficient_matrix=flat[:m].reshape(num_classes, d),
+        intercept_vector=flat[m: m + num_classes],
+        iterations=np.int32(flat[m + num_classes]),
+        objective_history=flat[m + num_classes + 2:],
+        converged=bool(flat[m + num_classes + 1]))
+
+
+@functools.lru_cache(maxsize=None)
+def fused_softmax_fit_packed(mesh: Optional[Mesh], num_classes: int,
+                             max_iter: int, tol: float,
+                             fit_intercept: bool, standardization: bool):
+    """Multinomial analogue of ``fused_logistic_fit_packed`` — same
+    single-input/single-output dispatch discipline and per-iteration psum."""
+
+    if mesh is None or mesh.devices.size <= 1:
+        def fit(Z, hyper):
+            X, y, mask = _unpack_z(Z)
+            n, std = _feature_stats(X, y, mask)
+            return _pack_softmax_result(_softmax_core(
+                X, y, mask, hyper[0], hyper[1], n, std, num_classes,
+                max_iter, tol, fit_intercept, standardization))
+    else:
+        def local(Z, hyper):
+            X, y, mask = _unpack_z(Z)
+            n, std = _sharded_feature_stats(X, mask)
+            return _pack_softmax_result(_softmax_core(
+                X, y, mask, hyper[0], hyper[1], n, std, num_classes,
+                max_iter, tol, fit_intercept, standardization,
+                axis=DATA_AXIS))
+
+        fit = jax.shard_map(
+            local, mesh=mesh,
+            in_specs=(P(DATA_AXIS), P()),
+            out_specs=P())
+
+    return jax.jit(fit)
+
+
 @persistable
 class LogisticRegression(Estimator):
-    """Binary logistic regression with elastic-net regularization."""
+    """Binary or multinomial logistic regression with elastic-net
+    regularization (MLlib ``family`` semantics: auto / binomial /
+    multinomial)."""
 
     _persist_attrs = ("max_iter", "reg_param", "elastic_net_param", "tol",
                       "fit_intercept", "standardization", "threshold",
@@ -224,13 +390,13 @@ class LogisticRegression(Estimator):
     def __init__(self, max_iter: int = 100, reg_param: float = 0.0,
                  elastic_net_param: float = 0.0, tol: float = 1e-6,
                  fit_intercept: bool = True, standardization: bool = True,
-                 threshold: float = 0.5, family: str = "binomial",
+                 threshold: float = 0.5, family: str = "auto",
                  features_col: str = "features", label_col: str = "label",
                  prediction_col: str = "prediction",
                  probability_col: str = "probability",
                  raw_prediction_col: str = "rawPrediction"):
-        if family not in ("auto", "binomial"):
-            raise ValueError("only binomial (binary) family is supported")
+        if family not in ("auto", "binomial", "multinomial"):
+            raise ValueError(f"unknown family {family!r}")
         self.max_iter = max_iter
         self.reg_param = reg_param
         self.elastic_net_param = elastic_net_param
@@ -256,6 +422,14 @@ class LogisticRegression(Estimator):
     def set_features_col(self, v): self.features_col = v; return self
     def set_label_col(self, v): self.label_col = v; return self
 
+    def set_family(self, v):
+        if v not in ("auto", "binomial", "multinomial"):
+            raise ValueError(f"unknown family {v!r}")
+        self.family = v
+        return self
+
+    setFamily = set_family
+
     setMaxIter = set_max_iter
     setRegParam = set_reg_param
     setElasticNetParam = set_elastic_net_param
@@ -277,8 +451,8 @@ class LogisticRegression(Estimator):
     def _params_dict(self) -> dict:
         return {k: getattr(self, k) for k in (
             "max_iter", "reg_param", "elastic_net_param", "tol",
-            "fit_intercept", "standardization", "threshold", "features_col",
-            "label_col", "prediction_col", "probability_col",
+            "fit_intercept", "standardization", "threshold", "family",
+            "features_col", "label_col", "prediction_col", "probability_col",
             "raw_prediction_col")}
 
     def fit(self, frame: Frame, mesh=None) -> "LogisticRegressionModel":
@@ -290,9 +464,21 @@ class LogisticRegression(Estimator):
         if mesh is not None and mesh.devices.size <= 1:
             mesh = None
         X, y, mask = _extract_xy(frame, self.features_col, self.label_col)
-        fit_fn = fused_logistic_fit_packed(mesh, self.max_iter, self.tol,
-                                           self.fit_intercept,
-                                           self.standardization)
+
+        yv = np.asarray(y)[np.asarray(mask)]
+        if len(yv) == 0:
+            raise ValueError("LogisticRegression: no valid rows")
+        if np.any(yv < 0) or np.any(yv != np.floor(yv)):
+            raise ValueError("labels must be nonnegative integers 0..k-1")
+        num_classes = int(yv.max()) + 1
+        family = self.family
+        if family == "auto":
+            family = "binomial" if num_classes <= 2 else "multinomial"
+        if family == "binomial" and num_classes > 2:
+            raise ValueError(
+                f"binomial family requires binary labels, found "
+                f"{num_classes} classes; use family='multinomial'")
+
         from ..config import float_dtype
         from ..parallel.distributed import (pack_design, place_packed,
                                             unpack_fit_result)
@@ -300,6 +486,35 @@ class LogisticRegression(Estimator):
         Zd = place_packed(pack_design(X, y, mask), mesh)
         hyper = jnp.asarray([self.reg_param, self.elastic_net_param],
                             float_dtype())
+
+        if family == "multinomial":
+            K = max(num_classes, 2)
+            fit_fn = fused_softmax_fit_packed(mesh, K, self.max_iter,
+                                              self.tol, self.fit_intercept,
+                                              self.standardization)
+            result = unpack_softmax_result(fit_fn(Zd, hyper), K, X.shape[1])
+            W = np.asarray(result.coefficient_matrix, np.float64)
+            b = np.asarray(result.intercept_vector, np.float64)
+            # Identifiability pivot (MLlib convention): the softmax loss is
+            # invariant to a per-feature shift across classes; intercepts
+            # are never penalized so they are always centered, coefficients
+            # only when the fit was unpenalized.
+            if self.fit_intercept:
+                b = b - b.mean()
+            if self.reg_param == 0.0:
+                W = W - W.mean(axis=0, keepdims=True)
+            result = SoftmaxFitResult(W, b, result.iterations,
+                                      result.objective_history,
+                                      result.converged)
+            model = LogisticRegressionModel(
+                coefficient_matrix=W, intercept_vector=b,
+                params=self._params_dict())
+            model._summary_source = (frame, result)
+            return model
+
+        fit_fn = fused_logistic_fit_packed(mesh, self.max_iter, self.tol,
+                                           self.fit_intercept,
+                                           self.standardization)
         result = LogisticFitResult(
             *unpack_fit_result(fit_fn(Zd, hyper), X.shape[1]))
         model = LogisticRegressionModel(
@@ -312,17 +527,74 @@ class LogisticRegression(Estimator):
 
 @persistable
 class LogisticRegressionModel(Model):
-    def __init__(self, coefficients: np.ndarray, intercept: float,
-                 params: Optional[dict] = None):
-        self.coefficients = np.asarray(coefficients)
-        self.intercept = float(intercept)
+    """Fitted logistic model. Binary fits expose ``coefficients`` /
+    ``intercept``; multinomial fits expose ``coefficient_matrix`` (K, d) /
+    ``intercept_vector`` (K,) — accessing the vector accessors on a
+    multinomial model raises, exactly like MLlib."""
+
+    def __init__(self, coefficients: Optional[np.ndarray] = None,
+                 intercept: float = 0.0, params: Optional[dict] = None,
+                 coefficient_matrix: Optional[np.ndarray] = None,
+                 intercept_vector: Optional[np.ndarray] = None):
+        if coefficient_matrix is not None:
+            self._matrix = np.asarray(coefficient_matrix)
+            self._intercepts = np.asarray(intercept_vector, np.float64)
+            self._binary = False
+        else:
+            self._matrix = None
+            self._intercepts = None
+            self._binary = True
+            self._coefficients = np.asarray(coefficients)
+            self._intercept = float(intercept)
         self._params = dict(params or {})
         self._training_summary = None
         self._summary_source = None
 
     @property
+    def is_multinomial(self) -> bool:
+        return not self._binary
+
+    @property
+    def coefficients(self) -> np.ndarray:
+        if not self._binary:
+            raise RuntimeError(
+                "coefficients is undefined for a multinomial model; "
+                "use coefficient_matrix")
+        return self._coefficients
+
+    @property
+    def intercept(self) -> float:
+        if not self._binary:
+            raise RuntimeError(
+                "intercept is undefined for a multinomial model; "
+                "use intercept_vector")
+        return self._intercept
+
+    @property
+    def coefficient_matrix(self) -> np.ndarray:
+        if self._binary:
+            return self._coefficients[None, :]
+        return self._matrix
+
+    coefficientMatrix = coefficient_matrix
+
+    @property
+    def intercept_vector(self) -> np.ndarray:
+        if self._binary:
+            return np.asarray([self._intercept])
+        return self._intercepts
+
+    interceptVector = intercept_vector
+
+    @property
+    def num_classes(self) -> int:
+        return 2 if self._binary else int(self._matrix.shape[0])
+
+    numClasses = num_classes
+
+    @property
     def num_features(self) -> int:
-        return int(self.coefficients.shape[0])
+        return int(self.coefficient_matrix.shape[1])
 
     @property
     def threshold(self) -> float:
@@ -330,6 +602,11 @@ class LogisticRegressionModel(Model):
 
     def _margin(self, X):
         return X @ jnp.asarray(self.coefficients, X.dtype) + self.intercept
+
+    def _margins_multi(self, X):
+        W = jnp.asarray(self._matrix, X.dtype)
+        b = jnp.asarray(self._intercepts, X.dtype)
+        return X @ W.T + b[None, :]
 
     def transform(self, frame: Frame) -> Frame:
         """Append rawPrediction (margin), probability, and prediction columns
@@ -339,6 +616,16 @@ class LogisticRegressionModel(Model):
                         float_dtype())
         if X.ndim == 1:
             X = X[:, None]
+        if not self._binary:
+            raw = self._margins_multi(X)
+            prob = jax.nn.softmax(raw, axis=1)
+            pred = jnp.argmax(raw, axis=1).astype(float_dtype())
+            out = frame.with_column(
+                p.get("raw_prediction_col", "rawPrediction"), raw)
+            out = out.with_column(p.get("probability_col", "probability"),
+                                  prob)
+            return out.with_column(p.get("prediction_col", "prediction"),
+                                   pred)
         margin = self._margin(X)
         prob = jax.nn.sigmoid(margin)
         pred = (prob > self.threshold).astype(float_dtype())
@@ -346,26 +633,39 @@ class LogisticRegressionModel(Model):
         out = out.with_column(p.get("probability_col", "probability"), prob)
         return out.with_column(p.get("prediction_col", "prediction"), pred)
 
-    def predict_raw(self, features) -> float:
+    def predict_raw(self, features):
         v = np.asarray(features, np.float64).reshape(-1)
+        if not self._binary:
+            return self._matrix.astype(np.float64) @ v + self._intercepts
         return float(v @ self.coefficients.astype(np.float64) + self.intercept)
 
-    def predict_probability(self, features) -> float:
-        return float(1.0 / (1.0 + np.exp(-self.predict_raw(features))))
+    def predict_probability(self, features):
+        raw = self.predict_raw(features)
+        if not self._binary:
+            e = np.exp(raw - raw.max())
+            return e / e.sum()
+        return float(1.0 / (1.0 + np.exp(-raw)))
 
     predictProbability = predict_probability
 
     def predict(self, features) -> float:
+        if not self._binary:
+            return float(np.argmax(self.predict_raw(features)))
         return 1.0 if self.predict_probability(features) > self.threshold else 0.0
 
     @property
-    def summary(self) -> "BinaryLogisticRegressionTrainingSummary":
+    def summary(self):
         if self._training_summary is None:
             if self._summary_source is None:
                 raise RuntimeError("model was not fit with summary (loaded model?)")
             frame, result = self._summary_source
-            self._training_summary = BinaryLogisticRegressionTrainingSummary(
-                self, frame, result)
+            if self._binary:
+                self._training_summary = \
+                    BinaryLogisticRegressionTrainingSummary(self, frame,
+                                                            result)
+            else:
+                self._training_summary = \
+                    LogisticRegressionTrainingSummary(self, frame, result)
         return self._training_summary
 
     @property
@@ -374,25 +674,34 @@ class LogisticRegressionModel(Model):
 
     hasSummary = has_summary
 
-    def evaluate(self, frame: Frame) -> "BinaryLogisticRegressionSummary":
+    def evaluate(self, frame: Frame):
+        if not self._binary:
+            return LogisticRegressionSummary(self, frame)
         return BinaryLogisticRegressionSummary(self, frame)
 
     def save(self, path: str) -> None:
         os.makedirs(path, exist_ok=True)
         write_json(os.path.join(path, "metadata.json"), {
             "class": "LogisticRegressionModel",
-            "intercept": self.intercept,
+            "multinomial": not self._binary,
+            "intercept": (self._intercept if self._binary
+                          else self._intercepts.tolist()),
             "params": self._params,
         })
-        np.save(os.path.join(path, "coefficients.npy"), self.coefficients)
+        np.save(os.path.join(path, "coefficients.npy"),
+                self._coefficients if self._binary else self._matrix)
 
     @classmethod
     def load(cls, path: str) -> "LogisticRegressionModel":
         meta = read_json(os.path.join(path, "metadata.json"))
         if meta.get("class") != "LogisticRegressionModel":
             raise ValueError(f"not a LogisticRegressionModel checkpoint: {path}")
-        return cls(np.load(os.path.join(path, "coefficients.npy")),
-                   meta["intercept"], meta.get("params"))
+        coef = np.load(os.path.join(path, "coefficients.npy"))
+        if meta.get("multinomial"):
+            return cls(coefficient_matrix=coef,
+                       intercept_vector=np.asarray(meta["intercept"]),
+                       params=meta.get("params"))
+        return cls(coef, meta["intercept"], meta.get("params"))
 
     # Pipeline-persistence hooks (base.save_stage/load_stage dispatch here).
     def _save_to_dir(self, path: str) -> None:
@@ -444,6 +753,111 @@ class BinaryLogisticRegressionSummary:
 
 class BinaryLogisticRegressionTrainingSummary(BinaryLogisticRegressionSummary):
     def __init__(self, model, frame, result: LogisticFitResult):
+        super().__init__(model, frame)
+        self._iterations = int(result.iterations)
+        hist = np.asarray(result.objective_history, np.float64)
+        self._objective_history = hist[: self._iterations + 1]
+
+    @property
+    def total_iterations(self) -> int:
+        return self._iterations
+
+    totalIterations = total_iterations
+
+    @property
+    def objective_history(self) -> np.ndarray:
+        return self._objective_history
+
+    objectiveHistory = objective_history
+
+
+class LogisticRegressionSummary:
+    """Multiclass evaluation over a frame's valid rows — MLlib's
+    ``LogisticRegressionSummary``: accuracy, per-label precision/recall/F,
+    weighted averages."""
+
+    def __init__(self, model: "LogisticRegressionModel", frame: Frame):
+        self._model = model
+        pred_frame = model.transform(frame)
+        d = pred_frame.to_pydict()
+        p = model._params
+        self._label = np.asarray(d[p.get("label_col", "label")], np.float64)
+        self._pred = np.asarray(d[p.get("prediction_col", "prediction")],
+                                np.float64)
+        self._predictions_frame = pred_frame
+        self._k = model.num_classes
+        self._confusion_cache = None
+
+    @property
+    def predictions(self) -> Frame:
+        return self._predictions_frame
+
+    @property
+    def labels(self) -> np.ndarray:
+        return np.arange(self._k, dtype=np.float64)
+
+    @property
+    def accuracy(self) -> float:
+        return float(np.mean(self._pred == self._label))
+
+    def _confusion(self):
+        if self._confusion_cache is None:
+            k = self._k
+            pred_i = self._pred.astype(np.int64)
+            true_i = self._label.astype(np.int64)
+            tp = np.bincount(pred_i[pred_i == true_i],
+                             minlength=k)[:k].astype(np.float64)
+            pred_c = np.bincount(pred_i, minlength=k)[:k].astype(np.float64)
+            true_c = np.bincount(true_i, minlength=k)[:k].astype(np.float64)
+            self._confusion_cache = (tp, pred_c, true_c)
+        return self._confusion_cache
+
+    @property
+    def precision_by_label(self) -> np.ndarray:
+        tp, pred_c, _ = self._confusion()
+        return np.where(pred_c > 0, tp / np.maximum(pred_c, 1), 0.0)
+
+    precisionByLabel = precision_by_label
+
+    @property
+    def recall_by_label(self) -> np.ndarray:
+        tp, _, true_c = self._confusion()
+        return np.where(true_c > 0, tp / np.maximum(true_c, 1), 0.0)
+
+    recallByLabel = recall_by_label
+
+    @property
+    def f_measure_by_label(self) -> np.ndarray:
+        p, r = self.precision_by_label, self.recall_by_label
+        return np.where(p + r > 0, 2 * p * r / np.maximum(p + r, 1e-300), 0.0)
+
+    fMeasureByLabel = f_measure_by_label
+
+    def _weights(self):
+        _, _, true_c = self._confusion()
+        return true_c / max(true_c.sum(), 1.0)
+
+    @property
+    def weighted_precision(self) -> float:
+        return float(self._weights() @ self.precision_by_label)
+
+    weightedPrecision = weighted_precision
+
+    @property
+    def weighted_recall(self) -> float:
+        return float(self._weights() @ self.recall_by_label)
+
+    weightedRecall = weighted_recall
+
+    @property
+    def weighted_f_measure(self) -> float:
+        return float(self._weights() @ self.f_measure_by_label)
+
+    weightedFMeasure = weighted_f_measure
+
+
+class LogisticRegressionTrainingSummary(LogisticRegressionSummary):
+    def __init__(self, model, frame, result: "SoftmaxFitResult"):
         super().__init__(model, frame)
         self._iterations = int(result.iterations)
         hist = np.asarray(result.objective_history, np.float64)

@@ -31,6 +31,26 @@ from sparkdq4ml_tpu.config import config
 config.default_float_dtype = jnp.float64
 
 DATA_DIR = os.path.join(os.path.dirname(__file__), "..", "data")
+NATIVE_DIR = os.path.abspath(os.path.join(os.path.dirname(__file__), "..",
+                                          "native"))
+
+
+def _ensure_native_built():
+    """Build native/libdqcsv.so once so the C++ fast path is exercised in
+    every test run (graceful fallback: missing toolchain → tests that need
+    it skip exactly as before)."""
+    if os.path.exists(os.path.join(NATIVE_DIR, "libdqcsv.so")):
+        return
+    import subprocess
+
+    try:
+        subprocess.run(["make", "-C", NATIVE_DIR], check=True,
+                       capture_output=True, timeout=120)
+    except (OSError, subprocess.SubprocessError):
+        pass
+
+
+_ensure_native_built()
 
 
 def dataset_path(name: str) -> str:

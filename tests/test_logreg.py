@@ -153,7 +153,7 @@ class TestApi:
 
     def test_family_validation(self):
         with pytest.raises(ValueError):
-            LogisticRegression(family="multinomial")
+            LogisticRegression(family="gaussian")
 
     def test_evaluate_and_roc(self):
         f, X, y = _synth(100)
@@ -163,3 +163,156 @@ class TestApi:
         d = roc.to_pydict()
         assert d["FPR"][0] == 0.0 and d["TPR"][-1] == 1.0
         assert 0.5 < s.area_under_roc <= 1.0
+
+
+def _synth_multi(n=400, d=4, k=3, seed=1):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, d))
+    W = rng.normal(size=(k, d)) * 1.5
+    b = rng.normal(size=k)
+    logits = X @ W.T + b
+    p = np.exp(logits - logits.max(axis=1, keepdims=True))
+    p /= p.sum(axis=1, keepdims=True)
+    y = np.array([rng.choice(k, p=p[i]) for i in range(n)], np.float64)
+    f = Frame({"features": X, "label": y})
+    return f, X, y
+
+
+class TestMultinomial:
+    def test_auto_family_selects_multinomial(self):
+        f, X, y = _synth_multi(120)
+        m = LogisticRegression(max_iter=200).fit(f)
+        assert m.is_multinomial
+        assert m.num_classes == 3
+        assert m.coefficient_matrix.shape == (3, 4)
+        assert m.intercept_vector.shape == (3,)
+        with pytest.raises(RuntimeError):
+            m.coefficients
+        with pytest.raises(RuntimeError):
+            m.intercept
+
+    def test_binomial_family_rejects_multiclass(self):
+        f, X, y = _synth_multi(60)
+        with pytest.raises(ValueError, match="binomial"):
+            LogisticRegression(family="binomial").fit(f)
+
+    def test_unregularized_matches_sklearn(self):
+        sk = pytest.importorskip("sklearn.linear_model")
+        f, X, y = _synth_multi()
+        model = LogisticRegression(max_iter=3000, tol=1e-13).fit(f)
+        ref = sk.LogisticRegression(penalty=None, tol=1e-10, max_iter=5000)
+        ref.fit(X, y)
+        # both solutions are centered across classes (zero init preserves
+        # the sum-to-zero manifold; ours pivots explicitly)
+        np.testing.assert_allclose(model.coefficient_matrix, ref.coef_,
+                                   atol=5e-3)
+        np.testing.assert_allclose(
+            model.intercept_vector,
+            ref.intercept_ - ref.intercept_.mean(), atol=5e-3)
+
+    def test_ridge_matches_sklearn(self):
+        sk = pytest.importorskip("sklearn.linear_model")
+        f, X, y = _synth_multi()
+        lam = 0.05
+        model = LogisticRegression(reg_param=lam, elastic_net_param=0.0,
+                                   standardization=False, max_iter=4000,
+                                   tol=1e-14).fit(f)
+        ref = sk.LogisticRegression(C=1.0 / (len(y) * lam), tol=1e-12,
+                                    max_iter=20000)
+        ref.fit(X, y)
+        np.testing.assert_allclose(model.coefficient_matrix, ref.coef_,
+                                   atol=3e-3)
+
+    def test_l1_produces_sparsity(self):
+        f, X, y = _synth_multi(300)
+        dense = LogisticRegression(max_iter=500).fit(f)
+        sparse = LogisticRegression(reg_param=0.3, elastic_net_param=1.0,
+                                    max_iter=500).fit(f)
+        assert np.sum(sparse.coefficient_matrix == 0.0) \
+            > np.sum(dense.coefficient_matrix == 0.0)
+
+    def test_transform_columns(self):
+        f, X, y = _synth_multi(100)
+        m = LogisticRegression(max_iter=300).fit(f)
+        out = m.transform(f)
+        d = out.to_pydict()
+        probs = np.stack(d["probability"])
+        assert probs.shape == (100, 3)
+        np.testing.assert_allclose(probs.sum(axis=1), 1.0, atol=1e-5)
+        raw = np.stack(d["rawPrediction"])
+        np.testing.assert_array_equal(d["prediction"], raw.argmax(axis=1))
+
+    def test_predict_scalar(self):
+        f, X, y = _synth_multi(100)
+        m = LogisticRegression(max_iter=300).fit(f)
+        pred = m.predict(X[0])
+        assert pred in (0.0, 1.0, 2.0)
+        p = m.predict_probability(X[0])
+        assert p.shape == (3,)
+        assert p.sum() == pytest.approx(1.0, abs=1e-6)
+        assert pred == float(np.argmax(p))
+
+    def test_summary(self):
+        f, X, y = _synth_multi(300)
+        m = LogisticRegression(max_iter=400).fit(f)
+        s = m.summary
+        assert s.accuracy > 0.7
+        assert s.objective_history[0] == pytest.approx(np.log(3), abs=1e-6)
+        assert s.objective_history[-1] < s.objective_history[0]
+        assert len(s.objective_history) == s.total_iterations + 1
+        assert 0.0 < s.weighted_precision <= 1.0
+        assert 0.0 < s.weighted_recall <= 1.0
+        assert 0.0 < s.weighted_f_measure <= 1.0
+        assert s.precision_by_label.shape == (3,)
+
+    def test_sharded_equals_single(self):
+        f, X, y = _synth_multi(200)
+        m1 = LogisticRegression(max_iter=300, reg_param=0.05,
+                                elastic_net_param=0.5).fit(f, mesh=make_mesh(1))
+        m8 = LogisticRegression(max_iter=300, reg_param=0.05,
+                                elastic_net_param=0.5).fit(f, mesh=make_mesh(8))
+        np.testing.assert_allclose(m8.coefficient_matrix,
+                                   m1.coefficient_matrix, rtol=1e-8, atol=1e-12)
+        np.testing.assert_allclose(m8.intercept_vector, m1.intercept_vector,
+                                   rtol=1e-8, atol=1e-12)
+
+    def test_sharded_with_masked_rows(self):
+        f, X, y = _synth_multi(203)
+        import jax.numpy as jnp
+        f = f.filter(jnp.asarray(np.arange(203) % 7 != 0))
+        m1 = LogisticRegression(max_iter=200).fit(f, mesh=make_mesh(1))
+        m8 = LogisticRegression(max_iter=200).fit(f, mesh=make_mesh(8))
+        np.testing.assert_allclose(m8.coefficient_matrix,
+                                   m1.coefficient_matrix, rtol=1e-8, atol=1e-12)
+
+    def test_save_load_roundtrip(self, tmp_path):
+        f, X, y = _synth_multi(100)
+        m = LogisticRegression(max_iter=200).fit(f)
+        m.save(str(tmp_path / "mlr"))
+        loaded = LogisticRegressionModel.load(str(tmp_path / "mlr"))
+        assert loaded.is_multinomial
+        np.testing.assert_array_equal(loaded.coefficient_matrix,
+                                      m.coefficient_matrix)
+        np.testing.assert_array_equal(loaded.intercept_vector,
+                                      m.intercept_vector)
+        assert loaded.predict(X[3]) == m.predict(X[3])
+
+    def test_binary_via_multinomial_family(self):
+        """K=2 with family='multinomial' → 2-row pivoted matrix whose margin
+        difference reproduces the binomial fit (MLlib's documented
+        relationship)."""
+        f, X, y = _synth(200)
+        mb = LogisticRegression(max_iter=2000, tol=1e-13).fit(f)
+        mm = LogisticRegression(family="multinomial", max_iter=4000,
+                                tol=1e-13).fit(f)
+        assert mm.coefficient_matrix.shape == (2, X.shape[1])
+        np.testing.assert_allclose(
+            mm.coefficient_matrix[1] - mm.coefficient_matrix[0],
+            mb.coefficients, atol=5e-3)
+
+    def test_evaluate_multiclass(self):
+        f, X, y = _synth_multi(150)
+        m = LogisticRegression(max_iter=300).fit(f)
+        s = m.evaluate(f)
+        assert 0.0 <= s.accuracy <= 1.0
+        assert s.labels.tolist() == [0.0, 1.0, 2.0]
