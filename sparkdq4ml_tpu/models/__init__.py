@@ -6,7 +6,10 @@ from .classification import (BinaryLogisticRegressionSummary,
                              LogisticRegressionTrainingSummary,
                              NaiveBayes, NaiveBayesModel, OneVsRest,
                              OneVsRestModel)
-from .clustering import KMeans, KMeansModel, KMeansSummary
+from .clustering import (BisectingKMeans, BisectingKMeansModel,
+                         GaussianMixture, GaussianMixtureModel,
+                         GaussianMixtureSummary, KMeans, KMeansModel,
+                         KMeansSummary)
 from .evaluation import (BinaryClassificationEvaluator, ClusteringEvaluator,
                          Evaluator, MulticlassClassificationEvaluator,
                          RegressionEvaluator)
@@ -21,7 +24,8 @@ from .feature import (Binarizer, Bucketizer, Imputer, ImputerModel,
 from .glm import (GeneralizedLinearRegression,
                   GeneralizedLinearRegressionModel, GlmTrainingSummary)
 from .linalg import Vectors
-from .stat import Correlation, Summarizer
+from .stat import (ChiSquareTest, Correlation, KolmogorovSmirnovTest,
+                   Summarizer)
 from .text import (CountVectorizer, CountVectorizerModel, HashingTF, IDF,
                    IDFModel, NGram, RegexTokenizer, StopWordsRemover,
                    Tokenizer)

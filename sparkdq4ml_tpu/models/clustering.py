@@ -42,6 +42,28 @@ from ..parallel.mesh import DATA_AXIS
 from .base import Estimator, Model, persistable
 
 
+def _normalize_mesh(mesh):
+    """Treat a trivial (≤1-device) mesh as no mesh."""
+    return None if mesh is None or mesh.devices.size <= 1 else mesh
+
+
+def _pad_and_shard(X, w, mesh, dt):
+    """Zero-pad rows to the shard count and place (X, w) row-sharded.
+
+    Zero-weight padding rows contribute nothing to any masked statistic.
+    With no mesh the arrays pass through as plain device arrays. Shared by
+    every clustering fit (the analogue of ``distributed.place_packed``).
+    """
+    if mesh is None:
+        return jnp.asarray(X), jnp.asarray(w)
+    rem = (-X.shape[0]) % mesh.devices.size
+    if rem:
+        X = np.concatenate([X, np.zeros((rem, X.shape[1]), dt)])
+        w = np.concatenate([w, np.zeros((rem,), dt)])
+    shard = NamedSharding(mesh, P(DATA_AXIS))
+    return jax.device_put(X, shard), jax.device_put(w, shard)
+
+
 def _lloyd_step(X, w, centers):
     """One Lloyd iteration's local sufficient statistics.
 
@@ -221,18 +243,8 @@ class KMeans(Estimator):
         else:  # k-means|| / k-means++ → greedy k-means++ seeding
             centers0 = _kmeans_pp_init(X, w, self.k, rng)
 
-        if mesh is not None:
-            n_shards = mesh.devices.size
-            rem = (-X.shape[0]) % n_shards
-            if rem:
-                X = np.concatenate([X, np.zeros((rem, X.shape[1]), dt)])
-                w = np.concatenate([w, np.zeros((rem,), dt)])
-            shard = NamedSharding(mesh, P(DATA_AXIS))
-            Xd = jax.device_put(X, shard)
-            wd = jax.device_put(w, shard)
-        else:
-            Xd, wd = jnp.asarray(X), jnp.asarray(w)
-
+        mesh = _normalize_mesh(mesh)
+        Xd, wd = _pad_and_shard(X, w, mesh, dt)
         fit_fn = _fit_cached(mesh, self.k, self.max_iter, self.tol)
         centers, cost, iters, counts = jax.block_until_ready(
             fit_fn(Xd, wd, jnp.asarray(centers0)))
@@ -339,3 +351,558 @@ class KMeansSummary:
         return self._model.num_iters
 
     numIter = num_iter
+
+
+# ---------------------------------------------------------------------------
+# GaussianMixture (MLlib org.apache.spark.ml.clustering.GaussianMixture)
+# ---------------------------------------------------------------------------
+
+def _gmm_log_prob(X, means, chols):
+    """(n, k) log N(x | mean_j, cov_j) via per-component Cholesky solves.
+
+    ``chols`` (k, d, d) lower Cholesky factors. vmapped over components:
+    each solve is a batched triangular solve + reduction — all XLA-native,
+    no per-row work.
+    """
+    d = X.shape[1]
+    log2pi = jnp.log(2.0 * jnp.pi).astype(X.dtype)
+
+    def one(mean, chol):
+        diff = (X - mean[None, :]).T                       # (d, n)
+        z = jax.scipy.linalg.solve_triangular(chol, diff, lower=True)
+        maha = jnp.sum(z * z, axis=0)                      # (n,)
+        logdet = 2.0 * jnp.sum(jnp.log(jnp.diagonal(chol)))
+        return -0.5 * (d * log2pi + logdet + maha)
+
+    return jax.vmap(one)(means, chols).T                   # (n, k)
+
+
+def _gmm_estep(X, w, weights, means, chols):
+    """Local E-step sufficient statistics for one shard.
+
+    Returns (Nk (k,), Sk (k, d), Ck (k, d, d) raw scatter Σ r·x·xᵀ,
+    weighted log-likelihood). Responsibilities never leave the device.
+    """
+    logp = _gmm_log_prob(X, means, chols) + jnp.log(weights)[None, :]
+    lse = jax.nn.logsumexp(logp, axis=1)                   # (n,)
+    resp = jnp.exp(logp - lse[:, None]) * w[:, None]       # masked (n, k)
+    Nk = jnp.sum(resp, axis=0)
+    Sk = resp.T @ X                                        # (k, d) MXU
+    # per-component scatter: k MXU matmuls via vmap over the component axis
+    Ck = jax.vmap(lambda r: (X * r[:, None]).T @ X)(resp.T)
+    ll = jnp.sum(lse * w)
+    return Nk, Sk, Ck, ll
+
+
+def _make_gmm_fit(mesh, k, max_iter, tol, reg):
+    if mesh is None:
+        def stats(X, w, weights, means, chols):
+            return _gmm_estep(X, w, weights, means, chols)
+    else:
+        def local(X, w, weights, means, chols):
+            Nk, Sk, Ck, ll = _gmm_estep(X, w, weights, means, chols)
+            return (jax.lax.psum(Nk, DATA_AXIS), jax.lax.psum(Sk, DATA_AXIS),
+                    jax.lax.psum(Ck, DATA_AXIS), jax.lax.psum(ll, DATA_AXIS))
+
+        stats = jax.shard_map(
+            local, mesh=mesh,
+            in_specs=(P(DATA_AXIS), P(DATA_AXIS), P(), P(), P()),
+            out_specs=(P(), P(), P(), P()))
+
+    def chol_of(covs):
+        d = covs.shape[-1]
+        return jnp.linalg.cholesky(
+            covs + reg * jnp.eye(d, dtype=covs.dtype)[None])
+
+    def fit(X, w, n, weights0, means0, covs0):
+        def body(carry):
+            weights, means, covs, last_ll, it, _ = carry
+            Nk, Sk, Ck, ll = stats(X, w, weights, means, chol_of(covs))
+            safe = jnp.maximum(Nk, 1e-12)
+            new_means = Sk / safe[:, None]
+            new_covs = (Ck / safe[:, None, None]
+                        - new_means[:, :, None] * new_means[:, None, :])
+            new_weights = Nk / n
+            return (new_weights, new_means, new_covs, ll, it + 1,
+                    jnp.abs(ll - last_ll))
+
+        def cond(carry):
+            _, _, _, _, it, delta = carry
+            return jnp.logical_and(it < max_iter, delta > tol)
+
+        init = (weights0, means0, covs0,
+                jnp.asarray(-jnp.inf, X.dtype), jnp.asarray(0, jnp.int32),
+                jnp.asarray(jnp.inf, X.dtype))
+        weights, means, covs, ll, iters, _ = jax.lax.while_loop(
+            cond, body, init)
+        return weights, means, covs, ll, iters
+
+    return jax.jit(fit)
+
+
+@functools.lru_cache(maxsize=None)
+def _gmm_fit_cached(mesh, k, max_iter, tol, reg):
+    return _make_gmm_fit(mesh, k, max_iter, tol, reg)
+
+
+@persistable
+class GaussianMixture(Estimator):
+    """MLlib ``GaussianMixture``: full-covariance GMM fit by EM.
+
+    TPU-first: the E-step is one fused (n, k) log-prob computation (batched
+    triangular solves + an MXU matmul per component for the scatter); the
+    whole EM loop runs inside one ``lax.while_loop`` with zero host
+    round-trips, and under a mesh the (k + k·d + k·d²+1) sufficient
+    statistics reduce with one fused psum — the ``treeAggregate`` analogue
+    (SURVEY.md §3.3). MLlib dependency surface: `/root/reference/pom.xml:29-32`.
+    """
+
+    _persist_attrs = ('k', 'max_iter', 'tol', 'seed', 'reg',
+                      'features_col', 'prediction_col', 'probability_col')
+
+    def __init__(self, k: int = 2, max_iter: int = 100, tol: float = 0.01,
+                 seed: int = 0, reg: float = 1e-6,
+                 features_col: str = "features",
+                 prediction_col: str = "prediction",
+                 probability_col: str = "probability"):
+        if k < 1:
+            raise ValueError("k must be >= 1")
+        self.k = int(k)
+        self.max_iter = int(max_iter)
+        self.tol = float(tol)
+        self.seed = int(seed)
+        self.reg = float(reg)
+        self.features_col = features_col
+        self.prediction_col = prediction_col
+        self.probability_col = probability_col
+
+    def set_k(self, v):
+        if v < 1:
+            raise ValueError("k must be >= 1")
+        self.k = int(v)
+        return self
+
+    setK = set_k
+
+    def set_max_iter(self, v):
+        self.max_iter = int(v)
+        return self
+
+    setMaxIter = set_max_iter
+
+    def set_tol(self, v):
+        self.tol = float(v)
+        return self
+
+    setTol = set_tol
+
+    def set_seed(self, v):
+        self.seed = int(v)
+        return self
+
+    setSeed = set_seed
+
+    def set_features_col(self, v):
+        self.features_col = v
+        return self
+
+    setFeaturesCol = set_features_col
+
+    def fit(self, frame: Frame, mesh=None) -> "GaussianMixtureModel":
+        dt = np.dtype(float_dtype())
+        X = np.asarray(frame._column_values(self.features_col), dt)
+        if X.ndim == 1:
+            X = X[:, None]
+        w = np.asarray(frame.mask, dt)
+        n_valid = float(w.sum())
+        if n_valid < self.k:
+            raise ValueError(f"k={self.k} exceeds the {int(n_valid)} valid rows")
+
+        # init: k-means++ means, shared diagonal covariance of the data,
+        # uniform weights (deterministic given seed)
+        rng = np.random.default_rng(self.seed)
+        means0 = _kmeans_pp_init(X, w, self.k, rng).astype(dt)
+        mu = (w @ X) / n_valid
+        var = (w @ (X * X)) / n_valid - mu * mu
+        covs0 = np.tile(np.diag(np.maximum(var, 1e-6)).astype(dt),
+                        (self.k, 1, 1))
+        weights0 = np.full((self.k,), 1.0 / self.k, dt)
+
+        mesh = _normalize_mesh(mesh)
+        Xd, wd = _pad_and_shard(X, w, mesh, dt)
+        fit_fn = _gmm_fit_cached(mesh, self.k, self.max_iter, self.tol,
+                                 self.reg)
+        weights, means, covs, ll, iters = jax.block_until_ready(
+            fit_fn(Xd, wd, jnp.asarray(n_valid, dt), jnp.asarray(weights0),
+                   jnp.asarray(means0), jnp.asarray(covs0)))
+        return GaussianMixtureModel(
+            np.asarray(weights, np.float64), np.asarray(means, np.float64),
+            np.asarray(covs, np.float64), self._params_dict(),
+            log_likelihood=float(ll), num_iters=int(iters))
+
+    def _params_dict(self):
+        return {k: getattr(self, k) for k in (
+            "k", "max_iter", "tol", "seed", "reg", "features_col",
+            "prediction_col", "probability_col")}
+
+
+@persistable
+class GaussianMixtureModel(Model):
+    """Fitted mixture: ``weights`` (k,), per-component ``gaussians``
+    (mean, cov). ``transform`` appends probability (posterior vector) and
+    prediction (argmax posterior) columns, like MLlib."""
+
+    _persist_attrs = ('weights', 'means', 'covs', '_params',
+                      'log_likelihood', 'num_iters')
+
+    def __init__(self, weights, means, covs, params=None,
+                 log_likelihood=float("nan"), num_iters=0):
+        self.weights = np.asarray(weights)
+        self.means = np.asarray(means)
+        self.covs = np.asarray(covs)
+        self._params = dict(params or {})
+        self.log_likelihood = log_likelihood
+        self.num_iters = num_iters
+
+    @property
+    def k(self):
+        return int(self.weights.shape[0])
+
+    getK = k
+
+    @property
+    def gaussians(self):
+        return [{"mean": self.means[j], "cov": self.covs[j]}
+                for j in range(self.k)]
+
+    @property
+    def gaussians_df(self) -> Frame:
+        """MLlib's ``gaussiansDF``: one row per component."""
+        return Frame({
+            "mean": np.asarray([m for m in self.means], object),
+            "cov": np.asarray([c for c in self.covs], object),
+        })
+
+    gaussiansDF = gaussians_df
+
+    def _posterior(self, X):
+        dt = X.dtype
+        reg = self._params.get("reg", 1e-6)
+        chols = jnp.linalg.cholesky(
+            jnp.asarray(self.covs, dt)
+            + reg * jnp.eye(self.covs.shape[-1], dtype=dt)[None])
+        logp = _gmm_log_prob(X, jnp.asarray(self.means, dt), chols) \
+            + jnp.log(jnp.asarray(self.weights, dt))[None, :]
+        return jax.nn.softmax(logp, axis=1)
+
+    def transform(self, frame: Frame) -> Frame:
+        p = self._params
+        X = jnp.asarray(frame._column_values(p.get("features_col",
+                                                   "features")),
+                        float_dtype())
+        if X.ndim == 1:
+            X = X[:, None]
+        post = self._posterior(X)
+        pred = jnp.argmax(post, axis=1).astype(float_dtype())
+        out = frame.with_column(p.get("probability_col", "probability"),
+                                post)
+        return out.with_column(p.get("prediction_col", "prediction"), pred)
+
+    def predict(self, features) -> int:
+        x = jnp.asarray(np.asarray(features, np.float64).reshape(1, -1),
+                        float_dtype())
+        return int(np.asarray(jnp.argmax(self._posterior(x), axis=1))[0])
+
+    def predict_probability(self, features) -> np.ndarray:
+        x = jnp.asarray(np.asarray(features, np.float64).reshape(1, -1),
+                        float_dtype())
+        return np.asarray(self._posterior(x))[0]
+
+    predictProbability = predict_probability
+
+    @property
+    def summary(self):
+        return GaussianMixtureSummary(self)
+
+    @property
+    def has_summary(self):
+        return True
+
+    hasSummary = has_summary
+
+
+class GaussianMixtureSummary:
+    """MLlib ``GaussianMixtureSummary``: logLikelihood + iterations."""
+
+    def __init__(self, model: GaussianMixtureModel):
+        self._model = model
+
+    @property
+    def log_likelihood(self):
+        return self._model.log_likelihood
+
+    logLikelihood = log_likelihood
+
+    @property
+    def num_iter(self):
+        return self._model.num_iters
+
+    numIter = num_iter
+
+    @property
+    def k(self):
+        return self._model.k
+
+
+# ---------------------------------------------------------------------------
+# BisectingKMeans (MLlib org.apache.spark.ml.clustering.BisectingKMeans)
+# ---------------------------------------------------------------------------
+
+@persistable
+class BisectingKMeans(Estimator):
+    """MLlib ``BisectingKMeans``: divisive hierarchical clustering — start
+    from one cluster, repeatedly bisect (larger clusters first, MLlib's
+    priority order) with a 2-means run until there are ``k`` leaves.
+
+    TPU-first: every bisection reuses the jitted masked 2-means program
+    (``_fit_cached``) on the FULL row set with a per-cluster weight vector —
+    subsetting by weights instead of gathers keeps one static shape for all
+    splits, so the 2-means program compiles once and every split is a pure
+    device dispatch. The split loop itself is host-side (≤ k−1 steps over a
+    data-dependent tree — not a device hot loop). MLlib dependency surface:
+    `/root/reference/pom.xml:29-32`.
+    """
+
+    _persist_attrs = ('k', 'max_iter', 'tol', 'seed',
+                      'min_divisible_cluster_size', 'features_col',
+                      'prediction_col')
+
+    def __init__(self, k: int = 4, max_iter: int = 20, tol: float = 1e-4,
+                 seed: int = 0, min_divisible_cluster_size: float = 1.0,
+                 features_col: str = "features",
+                 prediction_col: str = "prediction"):
+        if k < 1:
+            raise ValueError("k must be >= 1")
+        self.k = int(k)
+        self.max_iter = int(max_iter)
+        self.tol = float(tol)
+        self.seed = int(seed)
+        self.min_divisible_cluster_size = float(min_divisible_cluster_size)
+        self.features_col = features_col
+        self.prediction_col = prediction_col
+
+    def set_k(self, v):
+        if v < 1:
+            raise ValueError("k must be >= 1")
+        self.k = int(v)
+        return self
+
+    setK = set_k
+
+    def set_max_iter(self, v):
+        self.max_iter = int(v)
+        return self
+
+    setMaxIter = set_max_iter
+
+    def set_seed(self, v):
+        self.seed = int(v)
+        return self
+
+    setSeed = set_seed
+
+    def set_min_divisible_cluster_size(self, v):
+        self.min_divisible_cluster_size = float(v)
+        return self
+
+    setMinDivisibleClusterSize = set_min_divisible_cluster_size
+
+    def set_features_col(self, v):
+        self.features_col = v
+        return self
+
+    setFeaturesCol = set_features_col
+
+    def fit(self, frame: Frame, mesh=None) -> "BisectingKMeansModel":
+        dt = np.dtype(float_dtype())
+        X = np.asarray(frame._column_values(self.features_col), dt)
+        if X.ndim == 1:
+            X = X[:, None]
+        w = np.asarray(frame.mask, dt)
+        n_valid = int(w.sum())
+        if n_valid < self.k:
+            raise ValueError(f"k={self.k} exceeds the {n_valid} valid rows")
+        rng = np.random.default_rng(self.seed)
+
+        mesh = _normalize_mesh(mesh)
+        Xd, _ = _pad_and_shard(X, w, mesh, dt)
+        if mesh is not None and Xd.shape[0] != X.shape[0]:
+            # keep the host-side copies in the padded shape too, so the
+            # per-split weight vectors built below line up with Xd
+            pad_rows = Xd.shape[0] - X.shape[0]
+            X = np.concatenate([X, np.zeros((pad_rows, X.shape[1]), dt)])
+            w = np.concatenate([w, np.zeros((pad_rows,), dt)])
+        two_means = _fit_cached(mesh, 2, self.max_iter, self.tol)
+
+        # tree arrays: center per node, children (−1 = leaf)
+        centers = [np.asarray((w @ X) / max(w.sum(), 1e-12))]
+        left, right = [-1], [-1]
+        assign = np.zeros(X.shape[0], np.int64)       # row → node id
+        leaf_sizes = {0: n_valid}
+        min_size = self.min_divisible_cluster_size
+        if min_size <= 1.0:
+            min_size = min_size * n_valid if min_size < 1.0 else 1.0
+        undivisible: set[int] = set()
+
+        while len(leaf_sizes) < self.k:
+            divisible = [(sz, nid) for nid, sz in leaf_sizes.items()
+                         if nid not in undivisible and sz >= max(min_size, 2)]
+            if not divisible:
+                break
+            _, nid = max(divisible)                    # largest first
+            sel = (assign == nid) & (w > 0)
+            wc = np.where(sel, w, 0.0).astype(dt)
+            try:
+                c0 = _kmeans_pp_init(X, wc, 2, rng)
+            except ValueError:
+                undivisible.add(nid)
+                continue
+            if mesh is not None:
+                wd = jax.device_put(wc, NamedSharding(mesh, P(DATA_AXIS)))
+            else:
+                wd = jnp.asarray(wc)
+            c, _, _, counts = jax.block_until_ready(
+                two_means(Xd, wd, jnp.asarray(c0)))
+            counts = np.asarray(counts)
+            if counts.min() < 1:                       # degenerate split
+                undivisible.add(nid)
+                continue
+            c = np.asarray(c)
+            # children assignment for this cluster's rows
+            d2 = ((X[sel][:, None, :] - c[None, :, :]) ** 2).sum(-1)
+            child = np.argmin(d2, axis=1)
+            lid, rid = len(centers), len(centers) + 1
+            centers.extend([c[0], c[1]])
+            left.extend([-1, -1])
+            right.extend([-1, -1])
+            left[nid], right[nid] = lid, rid
+            assign[np.flatnonzero(sel)] = np.where(child == 0, lid, rid)
+            del leaf_sizes[nid]
+            leaf_sizes[lid] = int((child == 0).sum())
+            leaf_sizes[rid] = int((child == 1).sum())
+
+        model = BisectingKMeansModel(
+            np.stack(centers), np.asarray(left, np.int64),
+            np.asarray(right, np.int64), self.features_col,
+            self.prediction_col)
+        # training cost: SSE of valid rows to their leaf center
+        leaf_center = np.stack(centers)[assign]
+        model.training_cost = float(
+            np.sum(((X - leaf_center) ** 2).sum(-1) * w))
+        model.cluster_sizes = [leaf_sizes[nid]
+                               for nid in sorted(leaf_sizes)]
+        return model
+
+
+@persistable
+class BisectingKMeansModel(Model):
+    """Binary cluster tree: prediction walks root→leaf picking the nearer
+    child center at each internal node (MLlib's traversal), vectorized —
+    one gather + distance comparison per tree level."""
+
+    _persist_attrs = ('node_centers', 'left', 'right', 'features_col',
+                      'prediction_col', 'training_cost', 'cluster_sizes')
+
+    def __init__(self, node_centers, left, right, features_col="features",
+                 prediction_col="prediction", training_cost=float("nan"),
+                 cluster_sizes=None):
+        self.node_centers = np.asarray(node_centers)
+        self.left = np.asarray(left, np.int64)
+        self.right = np.asarray(right, np.int64)
+        self.features_col = features_col
+        self.prediction_col = prediction_col
+        self.training_cost = training_cost
+        self.cluster_sizes = list(cluster_sizes or [])
+        self.num_iters = 0          # tree build has no single iteration count
+        self._post_load()
+
+    def _post_load(self):
+        """Rebuild the leaf index (derived state) after load_stage."""
+        self.left = np.asarray(self.left, np.int64)
+        self.right = np.asarray(self.right, np.int64)
+        self.node_centers = np.asarray(self.node_centers)
+        if not hasattr(self, "num_iters"):
+            self.num_iters = 0
+        # leaf ids in stable order → cluster index 0..k−1
+        self._leaves = np.flatnonzero(self.left < 0)
+        self._leaf_index = np.full(len(self.left), -1, np.int64)
+        self._leaf_index[self._leaves] = np.arange(len(self._leaves))
+        # actual tree depth (descent steps needed), computed once from the
+        # static child arrays — the predict loop runs exactly this many
+        # rounds, not k−1
+        depth = np.zeros(len(self.left), np.int64)
+        for nid in range(len(self.left) - 1, -1, -1):   # children have
+            if self.left[nid] >= 0:                     # larger ids
+                depth[nid] = 1 + max(depth[self.left[nid]],
+                                     depth[self.right[nid]])
+        self._depth = int(depth[0]) if len(depth) else 0
+
+    @property
+    def k(self):
+        return len(self._leaves)
+
+    def cluster_centers(self):
+        return [self.node_centers[i] for i in self._leaves]
+
+    clusterCenters = cluster_centers
+
+    def _predict_nodes(self, X):
+        """(n,) leaf node id per row — root→leaf descent, ≤ depth steps."""
+        C = jnp.asarray(self.node_centers, X.dtype)
+        L = jnp.asarray(self.left)
+        R = jnp.asarray(self.right)
+        node = jnp.zeros(X.shape[0], jnp.int64)
+        for _ in range(self._depth):
+            l, r = L[node], R[node]
+            is_leaf = l < 0
+            dl = jnp.sum((X - C[jnp.maximum(l, 0)]) ** 2, axis=1)
+            dr = jnp.sum((X - C[jnp.maximum(r, 0)]) ** 2, axis=1)
+            nxt = jnp.where(dl <= dr, l, r)
+            node = jnp.where(is_leaf, node, nxt)
+        return node
+
+    def transform(self, frame: Frame) -> Frame:
+        X = jnp.asarray(frame._column_values(self.features_col),
+                        float_dtype())
+        if X.ndim == 1:
+            X = X[:, None]
+        nodes = np.asarray(self._predict_nodes(X))
+        pred = self._leaf_index[nodes].astype(np.dtype(float_dtype()))
+        return frame.with_column(self.prediction_col, jnp.asarray(pred))
+
+    def predict(self, features) -> int:
+        x = jnp.asarray(np.asarray(features, np.float64).reshape(1, -1),
+                        float_dtype())
+        return int(self._leaf_index[int(np.asarray(self._predict_nodes(x))[0])])
+
+    def compute_cost(self, frame: Frame) -> float:
+        X = jnp.asarray(frame._column_values(self.features_col),
+                        float_dtype())
+        if X.ndim == 1:
+            X = X[:, None]
+        w = frame.mask.astype(X.dtype)
+        nodes = self._predict_nodes(X)
+        C = jnp.asarray(self.node_centers, X.dtype)
+        return float(jnp.sum(jnp.sum((X - C[nodes]) ** 2, axis=1) * w))
+
+    computeCost = compute_cost
+
+    @property
+    def summary(self):
+        return KMeansSummary(self)
+
+    @property
+    def has_summary(self):
+        return True
+
+    hasSummary = has_summary

@@ -106,3 +106,111 @@ class Summarizer:
 def summary(frame, column: str = "features") -> dict:
     """All Summarizer metrics of a vector column in one pass."""
     return Summarizer(Summarizer.METRICS).summary(frame, column)
+
+
+class ChiSquareTest:
+    """``org.apache.spark.ml.stat.ChiSquareTest`` equivalent: Pearson
+    χ² independence test of every (categorical) feature against the label.
+
+    TPU-first: each feature's contingency table is ONE one-hot matmul
+    (``onehot(feature)ᵀ @ onehot(label)``, MXU-shaped) over masked rows —
+    no per-row host work; only the (c_f × c_l) table comes back to the host
+    for the χ² tail probability (scipy).
+    """
+
+    @staticmethod
+    def test(frame, features_col: str = "features",
+             label_col: str = "label"):
+        from scipy import stats as sstats
+
+        from ..frame import Frame
+
+        X, w = _extract(frame, features_col)
+        y = jnp.asarray(frame._column_values(label_col), X.dtype)
+
+        Xh = np.asarray(X)
+        yh = np.asarray(y)
+        keep = np.asarray(w) > 0
+        if not keep.any():
+            raise ValueError("ChiSquareTest: no valid rows")
+        if np.any(Xh[keep] != np.floor(Xh[keep])) or np.any(Xh[keep] < 0):
+            raise ValueError("ChiSquareTest requires nonnegative integer "
+                             "(categorical) features")
+        yv = yh[keep]
+        if np.any(yv != np.floor(yv)) or np.any(yv < 0):
+            raise ValueError("ChiSquareTest requires nonnegative integer "
+                             "labels")
+        n_label = int(yv.max()) + 1
+        ly = jax.nn.one_hot(y.astype(jnp.int32), n_label,
+                            dtype=X.dtype) * w[:, None]
+
+        p_values, dofs, statistics = [], [], []
+        for j in range(Xh.shape[1]):
+            n_feat = int(Xh[keep, j].max()) + 1
+            fx = jax.nn.one_hot(X[:, j].astype(jnp.int32), n_feat,
+                                dtype=X.dtype)
+            table = np.asarray(fx.T @ ly)          # (c_f, c_l) contingency
+            # drop empty rows/cols (Spark's degrees of freedom use observed
+            # categories only)
+            table = table[table.sum(axis=1) > 0][:, table.sum(axis=0) > 0]
+            if table.shape[0] < 2 or table.shape[1] < 2:
+                statistics.append(0.0)
+                dofs.append(0)
+                p_values.append(1.0)
+                continue
+            row = table.sum(axis=1, keepdims=True)
+            col = table.sum(axis=0, keepdims=True)
+            expected = row @ col / table.sum()
+            stat = float(((table - expected) ** 2 / expected).sum())
+            dof = (table.shape[0] - 1) * (table.shape[1] - 1)
+            statistics.append(stat)
+            dofs.append(dof)
+            p_values.append(float(sstats.chi2.sf(stat, dof)))
+
+        return Frame({
+            "pValues": np.asarray([np.asarray(p_values)], object),
+            "degreesOfFreedom": np.asarray([np.asarray(dofs)], object),
+            "statistics": np.asarray([np.asarray(statistics)], object),
+        })
+
+
+class KolmogorovSmirnovTest:
+    """``org.apache.spark.ml.stat.KolmogorovSmirnovTest`` equivalent:
+    one-sample, two-sided KS test of a sample column against a theoretical
+    distribution (``"norm"``, with optional mean/std params like MLlib, or
+    any ``scipy.stats`` distribution name).
+
+    The valid-row subset is a data-dependent gather, so the sort + D
+    statistic run host-side (numpy); the p-value is the asymptotic
+    Kolmogorov tail probability (scipy), matching MLlib's two-sided test.
+    """
+
+    @staticmethod
+    def test(frame, sample_col: str, dist: str = "norm", *params):
+        from scipy import stats as sstats
+
+        from ..frame import Frame
+
+        x = jnp.asarray(frame._column_values(sample_col), float_dtype())
+        w = frame.mask
+        xh = np.asarray(x)[np.asarray(w)]
+        n = xh.size
+        if n == 0:
+            raise ValueError("KolmogorovSmirnovTest: no valid rows")
+
+        dist_obj = getattr(sstats, dist, None)
+        if dist_obj is None:
+            raise ValueError(f"unknown distribution {dist!r}")
+        if dist == "norm" and not params:
+            params = (0.0, 1.0)    # MLlib default: standard normal
+
+        xs = np.sort(xh)
+        cdf = dist_obj.cdf(xs, *params)
+        i = np.arange(1, n + 1, dtype=np.float64)
+        d_plus = np.max(i / n - cdf)
+        d_minus = np.max(cdf - (i - 1) / n)
+        statistic = float(max(d_plus, d_minus))
+        p_value = float(
+            sstats.distributions.kstwobign.sf(np.sqrt(n) * statistic))
+        return Frame({"pValue": np.asarray([p_value]),
+                      "statistic": np.asarray([statistic])})

@@ -4,6 +4,7 @@ Parity oracle: sklearn.cluster.KMeans on the same data (SURVEY.md §4's
 cross-check pattern); sharded ≡ single-device on the fake 8-device CPU mesh.
 """
 
+import jax.numpy as jnp
 import numpy as np
 import pytest
 
@@ -145,3 +146,175 @@ class TestKMeansPersistence:
                            np.asarray(model.clusterCenters()))
         out = loaded.transform(f).to_pydict()
         assert set(np.unique(out["prediction"])) == {0.0, 1.0, 2.0}
+
+
+# ---------------------------------------------------------------------------
+# GaussianMixture
+# ---------------------------------------------------------------------------
+
+def _blobs(n=300, k=3, d=2, seed=0, spread=6.0):
+    rng = np.random.default_rng(seed)
+    centers = rng.normal(size=(k, d)) * spread
+    y = rng.integers(0, k, size=n)
+    X = centers[y] + rng.normal(size=(n, d))
+    return X, y, centers
+
+
+class TestGaussianMixture:
+    def test_recovers_separated_components(self):
+        from sparkdq4ml_tpu.models import GaussianMixture
+
+        X, y, centers = _blobs(seed=3)
+        f = Frame({"features": X})
+        m = GaussianMixture(k=3, max_iter=200, tol=1e-9, seed=0).fit(f)
+        # each true center has a fitted mean nearby
+        for c in centers:
+            assert np.min(np.linalg.norm(m.means - c, axis=1)) < 0.5
+        assert m.weights.sum() == pytest.approx(1.0, abs=1e-6)
+        assert m.k == 3
+
+    def test_sklearn_loglik_parity(self):
+        sk = pytest.importorskip("sklearn.mixture")
+        from sparkdq4ml_tpu.models import GaussianMixture
+
+        X, y, _ = _blobs(n=400, seed=5)
+        f = Frame({"features": X})
+        m = GaussianMixture(k=3, max_iter=300, tol=1e-10, seed=0).fit(f)
+        ref = sk.GaussianMixture(n_components=3, covariance_type="full",
+                                 tol=1e-10, max_iter=300, n_init=5,
+                                 random_state=0).fit(X)
+        # per-sample average log-likelihood should match the sklearn
+        # optimum closely on well-separated data
+        ours = m.summary.log_likelihood / len(X)
+        assert ours == pytest.approx(ref.score(X), abs=0.02)
+
+    def test_posterior_and_transform(self):
+        from sparkdq4ml_tpu.models import GaussianMixture
+
+        X, y, _ = _blobs(n=200, seed=7)
+        f = Frame({"features": X})
+        m = GaussianMixture(k=3, max_iter=100, seed=0).fit(f)
+        out = m.transform(f).to_pydict()
+        probs = np.stack(out["probability"])
+        np.testing.assert_allclose(probs.sum(axis=1), 1.0, atol=1e-5)
+        np.testing.assert_array_equal(out["prediction"],
+                                      probs.argmax(axis=1))
+        p0 = m.predict_probability(X[0])
+        assert m.predict(X[0]) == int(np.argmax(p0))
+
+    def test_masked_rows_do_not_vote(self):
+        from sparkdq4ml_tpu.models import GaussianMixture
+
+        X, y, _ = _blobs(n=200, seed=11)
+        Xbad = X.copy()
+        bad = np.arange(len(X)) % 5 == 0
+        Xbad[bad] = 1e6          # absurd rows that must be ignored
+        f = Frame({"features": Xbad}).filter(jnp.asarray(~bad))
+        fclean = Frame({"features": X[~bad]})
+        m1 = GaussianMixture(k=3, max_iter=150, seed=0).fit(f)
+        m2 = GaussianMixture(k=3, max_iter=150, seed=0).fit(fclean)
+        order1 = np.argsort(m1.means[:, 0])
+        order2 = np.argsort(m2.means[:, 0])
+        np.testing.assert_allclose(m1.means[order1], m2.means[order2],
+                                   atol=1e-4)
+
+    def test_sharded_equals_single(self):
+        from sparkdq4ml_tpu.models import GaussianMixture
+        from sparkdq4ml_tpu.parallel.mesh import make_mesh
+
+        X, y, _ = _blobs(n=240, seed=13)
+        f = Frame({"features": X})
+        m1 = GaussianMixture(k=3, max_iter=100, seed=0).fit(
+            f, mesh=make_mesh(1))
+        m8 = GaussianMixture(k=3, max_iter=100, seed=0).fit(
+            f, mesh=make_mesh(8))
+        np.testing.assert_allclose(m8.means, m1.means, rtol=1e-7, atol=1e-9)
+        np.testing.assert_allclose(m8.weights, m1.weights, rtol=1e-7)
+
+    def test_persistence(self, tmp_path):
+        from sparkdq4ml_tpu.models import GaussianMixture
+        from sparkdq4ml_tpu.models.base import load_stage
+
+        X, y, _ = _blobs(n=150, seed=17)
+        f = Frame({"features": X})
+        m = GaussianMixture(k=2, max_iter=50, seed=0).fit(f)
+        m.save(str(tmp_path / "gmm"))
+        loaded = load_stage(str(tmp_path / "gmm"))
+        np.testing.assert_allclose(loaded.means, m.means)
+        assert loaded.predict(X[0]) == m.predict(X[0])
+
+
+# ---------------------------------------------------------------------------
+# BisectingKMeans
+# ---------------------------------------------------------------------------
+
+class TestBisectingKMeans:
+    def test_k_leaves_on_blobs(self):
+        from sparkdq4ml_tpu.models import BisectingKMeans
+
+        X, y, centers = _blobs(n=300, k=4, seed=21)
+        f = Frame({"features": X})
+        m = BisectingKMeans(k=4, seed=0).fit(f)
+        assert m.k == 4
+        assert len(m.cluster_centers()) == 4
+        assert sum(m.cluster_sizes) == 300
+        for c in centers:
+            got = np.stack(m.cluster_centers())
+            assert np.min(np.linalg.norm(got - c, axis=1)) < 1.0
+
+    def test_transform_and_predict_consistent(self):
+        from sparkdq4ml_tpu.models import BisectingKMeans
+
+        X, y, _ = _blobs(n=200, k=3, seed=23)
+        f = Frame({"features": X})
+        m = BisectingKMeans(k=3, seed=0).fit(f)
+        d = m.transform(f).to_pydict()
+        preds = d["prediction"]
+        assert set(np.unique(preds)) <= {0.0, 1.0, 2.0}
+        for i in (0, 7, 42):
+            assert m.predict(X[i]) == int(preds[i])
+
+    def test_compute_cost_positive_and_small_on_tight_blobs(self):
+        from sparkdq4ml_tpu.models import BisectingKMeans, KMeans
+
+        X, y, _ = _blobs(n=300, k=3, seed=29)
+        f = Frame({"features": X})
+        m = BisectingKMeans(k=3, seed=0).fit(f)
+        km = KMeans(k=3, seed=0, max_iter=50).fit(f)
+        # bisecting should be in the same cost ballpark as flat k-means
+        assert m.compute_cost(f) < 3.0 * km.compute_cost(f)
+
+    def test_k1_returns_mean(self):
+        from sparkdq4ml_tpu.models import BisectingKMeans
+
+        X, y, _ = _blobs(n=50, k=2, seed=31)
+        f = Frame({"features": X})
+        m = BisectingKMeans(k=1).fit(f)
+        assert m.k == 1
+        np.testing.assert_allclose(m.cluster_centers()[0], X.mean(axis=0),
+                                   atol=1e-5)
+
+    def test_persistence(self, tmp_path):
+        from sparkdq4ml_tpu.models import BisectingKMeans
+        from sparkdq4ml_tpu.models.base import load_stage
+
+        X, y, _ = _blobs(n=120, k=3, seed=37)
+        f = Frame({"features": X})
+        m = BisectingKMeans(k=3, seed=0).fit(f)
+        m.save(str(tmp_path / "bkm"))
+        loaded = load_stage(str(tmp_path / "bkm"))
+        for i in (0, 5, 11):
+            assert loaded.predict(X[i]) == m.predict(X[i])
+        assert loaded.k == 3
+
+    def test_respects_mask(self):
+        from sparkdq4ml_tpu.models import BisectingKMeans
+
+        X, y, _ = _blobs(n=200, k=3, seed=41)
+        Xbad = X.copy()
+        bad = np.arange(len(X)) % 4 == 0
+        Xbad[bad] = 500.0
+        f = Frame({"features": Xbad}).filter(jnp.asarray(~bad))
+        m = BisectingKMeans(k=3, seed=0).fit(f)
+        centers = np.stack(m.cluster_centers())
+        assert np.all(np.abs(centers) < 100.0)

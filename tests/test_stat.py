@@ -3,6 +3,7 @@ ml.stat Correlation/Summarizer. Oracle: numpy/scipy on the same valid rows;
 reference-data fixture: guest↔price correlation on the DQ-cleaned datasets
 (the quantity the reference's second rule is written around)."""
 
+import jax.numpy as jnp
 import numpy as np
 import pytest
 
@@ -121,3 +122,99 @@ class TestMlStat:
         assert set(s) == {"mean", "count"}
         with pytest.raises(ValueError, match="unknown metrics"):
             Summarizer.metrics("median")
+
+
+class TestChiSquareTest:
+    def test_scipy_parity(self):
+        from scipy import stats as sstats
+
+        from sparkdq4ml_tpu.models import ChiSquareTest
+
+        rng = np.random.default_rng(0)
+        n = 500
+        # feature 0 depends on the label; feature 1 is independent
+        y = rng.integers(0, 3, size=n).astype(float)
+        x0 = ((y + rng.integers(0, 2, size=n)) % 4).astype(float)
+        x1 = rng.integers(0, 5, size=n).astype(float)
+        X = np.stack([x0, x1], axis=1)
+        f = Frame({"features": X, "label": y})
+        out = ChiSquareTest.test(f, "features", "label").to_pydict()
+        pv = out["pValues"][0]
+        st = out["statistics"][0]
+        dof = out["degreesOfFreedom"][0]
+        for j, xj in enumerate([x0, x1]):
+            table = np.zeros((int(xj.max()) + 1, 3))
+            for a, b in zip(xj.astype(int), y.astype(int)):
+                table[a, b] += 1
+            table = table[table.sum(1) > 0][:, table.sum(0) > 0]
+            ref = sstats.chi2_contingency(table, correction=False)
+            assert st[j] == pytest.approx(ref.statistic, rel=1e-9)
+            assert pv[j] == pytest.approx(ref.pvalue, abs=1e-12)
+            assert dof[j] == ref.dof
+        # dependent feature rejects, independent doesn't
+        assert pv[0] < 1e-6
+        assert pv[1] > 0.01
+
+    def test_respects_mask(self):
+        from sparkdq4ml_tpu.models import ChiSquareTest
+
+        y = np.asarray([0, 0, 1, 1, 0, 1] * 20, float)
+        x = np.asarray([0, 1, 0, 1, 0, 1] * 20, float)
+        f = Frame({"features": x[:, None], "label": y})
+        keep = np.arange(len(y)) % 3 != 0
+        fm = f.filter(jnp.asarray(keep))
+        out = ChiSquareTest.test(fm, "features", "label").to_pydict()
+        from scipy import stats as sstats
+        table = np.zeros((2, 2))
+        for a, b in zip(x[keep].astype(int), y[keep].astype(int)):
+            table[a, b] += 1
+        ref = sstats.chi2_contingency(table, correction=False)
+        assert out["statistics"][0][0] == pytest.approx(ref.statistic,
+                                                        rel=1e-9)
+
+    def test_rejects_continuous_features(self):
+        from sparkdq4ml_tpu.models import ChiSquareTest
+
+        f = Frame({"features": np.asarray([[0.5], [1.2]]),
+                   "label": np.asarray([0.0, 1.0])})
+        with pytest.raises(ValueError, match="categorical"):
+            ChiSquareTest.test(f)
+
+
+class TestKolmogorovSmirnovTest:
+    def test_scipy_parity_norm(self):
+        from scipy import stats as sstats
+
+        from sparkdq4ml_tpu.models import KolmogorovSmirnovTest
+
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=400)
+        f = Frame({"x": x})
+        out = KolmogorovSmirnovTest.test(f, "x", "norm", 0.0, 1.0).to_pydict()
+        ref = sstats.kstest(x, "norm", args=(0.0, 1.0), mode="asymp")
+        assert out["statistic"][0] == pytest.approx(ref.statistic, rel=1e-9)
+        assert out["pValue"][0] == pytest.approx(ref.pvalue, abs=1e-6)
+
+    def test_shifted_sample_rejected(self):
+        from sparkdq4ml_tpu.models import KolmogorovSmirnovTest
+
+        rng = np.random.default_rng(1)
+        x = rng.normal(loc=1.0, size=300)
+        f = Frame({"x": x})
+        out = KolmogorovSmirnovTest.test(f, "x", "norm").to_pydict()
+        assert out["pValue"][0] < 1e-6
+        out2 = KolmogorovSmirnovTest.test(f, "x", "norm", 1.0, 1.0).to_pydict()
+        assert out2["pValue"][0] > 1e-3    # this draw sits at p≈0.007
+
+    def test_respects_mask_and_default_params(self):
+        from sparkdq4ml_tpu.models import KolmogorovSmirnovTest
+        from scipy import stats as sstats
+
+        rng = np.random.default_rng(2)
+        x = rng.normal(size=300)
+        x[::5] = 1e3                      # masked out below
+        keep = np.arange(300) % 5 != 0
+        f = Frame({"x": x}).filter(jnp.asarray(keep))
+        out = KolmogorovSmirnovTest.test(f, "x").to_pydict()
+        ref = sstats.kstest(x[keep], "norm", mode="asymp")
+        assert out["statistic"][0] == pytest.approx(ref.statistic, rel=1e-9)
