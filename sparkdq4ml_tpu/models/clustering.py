@@ -48,20 +48,11 @@ def _normalize_mesh(mesh):
 
 
 def _pad_and_shard(X, w, mesh, dt):
-    """Zero-pad rows to the shard count and place (X, w) row-sharded.
+    """Zero-pad rows to the shard count and place (X, w) row-sharded —
+    thin wrapper over the shared ``distributed.pad_and_shard_rows``."""
+    from ..parallel.distributed import pad_and_shard_rows
 
-    Zero-weight padding rows contribute nothing to any masked statistic.
-    With no mesh the arrays pass through as plain device arrays. Shared by
-    every clustering fit (the analogue of ``distributed.place_packed``).
-    """
-    if mesh is None:
-        return jnp.asarray(X), jnp.asarray(w)
-    rem = (-X.shape[0]) % mesh.devices.size
-    if rem:
-        X = np.concatenate([X, np.zeros((rem, X.shape[1]), dt)])
-        w = np.concatenate([w, np.zeros((rem,), dt)])
-    shard = NamedSharding(mesh, P(DATA_AXIS))
-    return jax.device_put(X, shard), jax.device_put(w, shard)
+    return pad_and_shard_rows(mesh, X, w)
 
 
 def _lloyd_step(X, w, centers):
@@ -232,6 +223,9 @@ class KMeans(Estimator):
         if X.ndim == 1:
             X = X[:, None]
         w = np.asarray(frame.mask, dt)
+        # masked slots may hold NaN (dropna/filter keep values in place);
+        # zero them so 0-weighted statistics stay finite (0·NaN = NaN)
+        X = np.where(w[:, None] > 0, X, 0.0)
 
         rng = np.random.default_rng(self.seed)
         if self.init_mode == "random":
@@ -514,6 +508,9 @@ class GaussianMixture(Estimator):
         if X.ndim == 1:
             X = X[:, None]
         w = np.asarray(frame.mask, dt)
+        # masked slots may hold NaN (dropna/filter keep values in place);
+        # zero them so 0-weighted statistics stay finite (0·NaN = NaN)
+        X = np.where(w[:, None] > 0, X, 0.0)
         n_valid = float(w.sum())
         if n_valid < self.k:
             raise ValueError(f"k={self.k} exceeds the {int(n_valid)} valid rows")
@@ -729,6 +726,9 @@ class BisectingKMeans(Estimator):
         if X.ndim == 1:
             X = X[:, None]
         w = np.asarray(frame.mask, dt)
+        # masked slots may hold NaN (dropna/filter keep values in place);
+        # zero them so 0-weighted statistics stay finite (0·NaN = NaN)
+        X = np.where(w[:, None] > 0, X, 0.0)
         n_valid = int(w.sum())
         if n_valid < self.k:
             raise ValueError(f"k={self.k} exceeds the {n_valid} valid rows")

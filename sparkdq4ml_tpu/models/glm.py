@@ -37,10 +37,39 @@ _FAMILY_LINKS = {
     "binomial": ("logit", "probit", "cloglog"),
     "poisson": ("log", "identity", "sqrt"),
     "gamma": ("inverse", "identity", "log"),
+    # tweedie accepts any power link; validated separately
+    "tweedie": (),
 }
 _DEFAULT_LINK = {"gaussian": "identity", "binomial": "logit",
                  "poisson": "log", "gamma": "inverse"}
 _EPS = 1e-12
+
+
+def _power_link(lp: float):
+    """Tweedie power link g(μ)=μ^lp (lp=0 ⇒ log), MLlib's ``linkPower``.
+
+    lp 1 / −1 reduce to identity / inverse exactly; other powers clamp to
+    the positive domain (fractional powers of a negative η are undefined).
+    """
+    if lp == 0.0:
+        return (lambda mu: jnp.log(jnp.maximum(mu, _EPS)), jnp.exp,
+                lambda eta: jnp.exp(eta))
+    if lp == 1.0:
+        return (lambda mu: mu, lambda eta: eta,
+                lambda eta: jnp.ones_like(eta))
+    if lp == -1.0:
+        return (lambda mu: 1.0 / mu, lambda eta: 1.0 / eta,
+                lambda eta: -1.0 / (eta * eta))
+    inv_p = 1.0 / lp
+    # Fractional powers need a positive η domain. The floor must be far
+    # above denormal range: η clamped to 1e-12 with inv_p = −2 would give
+    # μ = 1e24 and IRLS weights ~ η⁻³ = 1e36, overflowing float32 matmuls
+    # to inf → NaN solves. 1e-3 keeps every derived quantity f32-finite
+    # while being far below any realistic linear-predictor magnitude.
+    floor = 1e-3
+    return (lambda mu: jnp.maximum(mu, _EPS) ** lp,
+            lambda eta: jnp.maximum(eta, floor) ** inv_p,
+            lambda eta: inv_p * jnp.maximum(eta, floor) ** (inv_p - 1.0))
 
 
 # -- link functions: eta = g(mu); inv: mu = g⁻¹(eta); deriv: dmu/deta --------
@@ -67,10 +96,25 @@ def _link_fns(link: str):
         return (lambda mu: jnp.log(-jnp.log1p(-mu)),
                 lambda eta: -jnp.expm1(-jnp.exp(eta)),
                 lambda eta: jnp.exp(eta - jnp.exp(eta)))
+    if link.startswith("power(") and link.endswith(")"):
+        return _power_link(float(link[6:-1]))
     raise ValueError(f"unknown link {link!r}")
 
 
+def _tweedie_power(family: str):
+    """``"tweedie:<p>"`` → p, else None (the string keeps family usable as
+    an lru_cache key for the jitted fit builders)."""
+    if family.startswith("tweedie:"):
+        return float(family.split(":", 1)[1])
+    return None
+
+
 def _variance_fn(family: str):
+    p = _tweedie_power(family)
+    if p is not None:
+        if p == 0.0:
+            return lambda mu: jnp.ones_like(mu)
+        return lambda mu: jnp.maximum(mu, _EPS) ** p
     return {"gaussian": lambda mu: jnp.ones_like(mu),
             "binomial": lambda mu: mu * (1.0 - mu),
             "poisson": lambda mu: mu,
@@ -82,11 +126,33 @@ def _clip_mu(family: str, mu):
         return jnp.clip(mu, _EPS, 1.0 - _EPS)
     if family in ("poisson", "gamma"):
         return jnp.maximum(mu, _EPS)
+    p = _tweedie_power(family)
+    if p is not None and p != 0.0:
+        # two-sided: the upper cap keeps μ^p and the IRLS weights finite in
+        # float32 when the power link wanders toward its domain boundary
+        return jnp.clip(mu, _EPS, 1e8)
     return mu
 
 
 def _unit_deviance(family: str, y, mu):
     """Elementwise per-row deviance contribution (before weighting)."""
+    p = _tweedie_power(family)
+    if p is not None:
+        if p == 0.0:
+            family = "gaussian"
+        elif p == 1.0:
+            family = "poisson"
+        elif p == 2.0:
+            family = "gamma"
+        else:
+            # general Tweedie deviance (p ≠ 1, 2); y = 0 is fine for
+            # 1 < p < 2 (both y-terms vanish)
+            yp = jnp.maximum(y, 0.0)
+            t1 = jnp.where(yp > 0,
+                           yp ** (2.0 - p) / ((1.0 - p) * (2.0 - p)), 0.0)
+            t2 = y * mu ** (1.0 - p) / (1.0 - p)
+            t3 = mu ** (2.0 - p) / (2.0 - p)
+            return 2.0 * (t1 - t2 + t3)
     if family == "gaussian":
         return (y - mu) ** 2
     if family == "binomial":
@@ -120,36 +186,39 @@ def _build_fit(mesh, family: str, link: str, max_iter: int, tol: float,
     link_f, link_inv, dmu_deta = _link_fns(link)
     var_f = _variance_fn(family)
 
-    def wls_stats(X1, y, w, beta):
+    def wls_stats(X1, y, w, off, beta):
         # w == 0 marks masked rows and shard padding; their y may be NaN and
         # their eta may push the inverse link to ±inf, so every statistic is
         # sanitized through jnp.where (0 * NaN would poison the matmuls).
+        # ``off`` is the fixed offset added to the linear predictor
+        # (MLlib's offsetCol); the WLS regresses (z − off) on X.
         valid = w > 0
-        eta = X1 @ beta
+        eta = X1 @ beta + off
         mu = jnp.where(valid, _clip_mu(family, link_inv(eta)), 1.0)
         yv = jnp.where(valid, y, 1.0)   # yv == mu == 1 ⇒ zero unit deviance
         d = jnp.where(valid, dmu_deta(eta), 1.0)
         d = jnp.where(jnp.abs(d) < _EPS, jnp.sign(d) * _EPS + (d == 0) * _EPS,
                       d)
-        z = jnp.where(valid, eta + (yv - mu) / d, 0.0)
+        z = jnp.where(valid, eta - off + (yv - mu) / d, 0.0)
         ww = jnp.where(valid, w * d * d / jnp.maximum(var_f(mu), _EPS), 0.0)
         Xw = X1 * ww[:, None]
         return X1.T @ Xw, Xw.T @ z, _deviance(family, yv, mu, w)
 
     if mesh is not None:
-        def sharded_stats(X1, y, w, beta):
-            a, b, dev = wls_stats(X1, y, w, beta)
+        def sharded_stats(X1, y, w, off, beta):
+            a, b, dev = wls_stats(X1, y, w, off, beta)
             return (jax.lax.psum(a, DATA_AXIS), jax.lax.psum(b, DATA_AXIS),
                     jax.lax.psum(dev, DATA_AXIS))
 
         stats = jax.shard_map(
             sharded_stats, mesh=mesh,
-            in_specs=(P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P()),
+            in_specs=(P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS),
+                      P(DATA_AXIS), P()),
             out_specs=(P(), P(), P()))
     else:
         stats = wls_stats
 
-    def fit(X1, y, w, beta0):
+    def fit(X1, y, w, off, beta0):
         p = X1.shape[1]
         ridge = jnp.eye(p, dtype=X1.dtype) * reg_param
         if fit_intercept:
@@ -157,7 +226,7 @@ def _build_fit(mesh, family: str, link: str, max_iter: int, tol: float,
 
         def body(carry):
             beta, _, it, _, _ = carry
-            xtwx, xtwz, dev = stats(X1, y, w, beta)
+            xtwx, xtwz, dev = stats(X1, y, w, off, beta)
             new = jnp.linalg.solve(xtwx + ridge, xtwz)
             delta = jnp.max(jnp.abs(new - beta)) / \
                 jnp.maximum(jnp.max(jnp.abs(new)), 1.0)
@@ -172,7 +241,7 @@ def _build_fit(mesh, family: str, link: str, max_iter: int, tol: float,
                 jnp.zeros((p, p), X1.dtype))
         beta, _, iters, delta, _ = jax.lax.while_loop(cond, body, init)
         # final pass: deviance + XᵀWX at the converged beta
-        xtwx, _, dev = stats(X1, y, w, beta)
+        xtwx, _, dev = stats(X1, y, w, off, beta)
         return GlmFit(beta, iters, delta <= tol, dev, xtwx)
 
     return jax.jit(fit)
@@ -193,7 +262,8 @@ class GeneralizedLinearRegression(Estimator):
 
     _persist_attrs = ('family', 'link', 'max_iter', 'tol', 'reg_param',
                       'fit_intercept', 'features_col', 'label_col',
-                      'prediction_col', 'link_prediction_col', 'weight_col')
+                      'prediction_col', 'link_prediction_col', 'weight_col',
+                      'offset_col', 'variance_power', 'link_power')
 
     def __init__(self, family: str = "gaussian", link: Optional[str] = None,
                  max_iter: int = 25, tol: float = 1e-6,
@@ -201,15 +271,34 @@ class GeneralizedLinearRegression(Estimator):
                  features_col: str = "features", label_col: str = "label",
                  prediction_col: str = "prediction",
                  link_prediction_col: Optional[str] = None,
-                 weight_col: Optional[str] = None):
+                 weight_col: Optional[str] = None,
+                 offset_col: Optional[str] = None,
+                 variance_power: float = 0.0,
+                 link_power: Optional[float] = None):
         family = family.lower()
         if family not in _FAMILY_LINKS:
             raise ValueError(f"unknown family {family!r} "
                              f"(supported: {sorted(_FAMILY_LINKS)})")
-        link = link.lower() if link else _DEFAULT_LINK[family]
-        if link not in _FAMILY_LINKS[family]:
-            raise ValueError(f"link {link!r} not supported by family "
-                             f"{family!r} (supported: {_FAMILY_LINKS[family]})")
+        if family == "tweedie":
+            # MLlib: the tweedie link is the power link, configured via
+            # linkPower (default 1 − variancePower), never via ``link``
+            if link is not None:
+                raise ValueError("tweedie uses link_power, not link")
+            if 0.0 < variance_power < 1.0:
+                raise ValueError("variance_power must be 0 or >= 1 "
+                                 "(no Tweedie distribution exists in (0,1))")
+            if link_power is None:
+                link_power = 1.0 - variance_power
+            link = f"power({float(link_power)})"
+        else:
+            if link_power is not None:
+                raise ValueError("link_power is only valid for the tweedie "
+                                 "family")
+            link = link.lower() if link else _DEFAULT_LINK[family]
+            if link not in _FAMILY_LINKS[family]:
+                raise ValueError(
+                    f"link {link!r} not supported by family "
+                    f"{family!r} (supported: {_FAMILY_LINKS[family]})")
         if reg_param < 0:
             raise ValueError("reg_param must be >= 0")
         self.family = family
@@ -223,28 +312,60 @@ class GeneralizedLinearRegression(Estimator):
         self.prediction_col = prediction_col
         self.link_prediction_col = link_prediction_col
         self.weight_col = weight_col
+        self.offset_col = offset_col
+        self.variance_power = float(variance_power)
+        self.link_power = (None if link_power is None else float(link_power))
+
+    def _family_key(self) -> str:
+        """Family string for the jitted-fit cache (tweedie carries its
+        variance power so the builder closes over it)."""
+        if self.family == "tweedie":
+            return f"tweedie:{self.variance_power}"
+        return self.family
 
     def _set(self, name, v):
         setattr(self, name, v)
         return self
 
+    def _reinit(self, family, link, variance_power=None, link_power=None):
+        """Re-run __init__ to re-validate a family/link combination while
+        preserving every other configured parameter."""
+        if variance_power is None:
+            variance_power = self.variance_power
+        return GeneralizedLinearRegression.__init__(
+            self, family, link, self.max_iter, self.tol, self.reg_param,
+            self.fit_intercept, self.features_col, self.label_col,
+            self.prediction_col, self.link_prediction_col, self.weight_col,
+            self.offset_col, variance_power, link_power) or self
+
     def set_family(self, v):
-        return GeneralizedLinearRegression.__init__(  # re-validate combo
-            self, v, self.link if v.lower() == self.family else None,
-            self.max_iter, self.tol, self.reg_param, self.fit_intercept,
-            self.features_col, self.label_col, self.prediction_col,
-            self.link_prediction_col, self.weight_col) or self
+        v = v.lower()
+        if v == "tweedie":
+            return self._reinit(v, None, link_power=self.link_power)
+        return self._reinit(v, self.link if v == self.family else None)
 
     setFamily = set_family
 
     def set_link(self, v):
-        return GeneralizedLinearRegression.__init__(
-            self, self.family, v, self.max_iter, self.tol, self.reg_param,
-            self.fit_intercept, self.features_col, self.label_col,
-            self.prediction_col, self.link_prediction_col,
-            self.weight_col) or self
+        return self._reinit(self.family, v)
 
     setLink = set_link
+
+    def set_variance_power(self, v):
+        return self._reinit("tweedie", None, variance_power=float(v),
+                            link_power=self.link_power)
+
+    setVariancePower = set_variance_power
+
+    def set_link_power(self, v):
+        return self._reinit("tweedie", None, link_power=float(v))
+
+    setLinkPower = set_link_power
+
+    def set_offset_col(self, v):
+        return self._set("offset_col", v)
+
+    setOffsetCol = set_offset_col
 
     def set_max_iter(self, v):
         return self._set("max_iter", int(v))
@@ -298,6 +419,16 @@ class GeneralizedLinearRegression(Estimator):
         elif self.family == "gamma":
             if not np.all(y[~np.isnan(y)] > 0):
                 raise ValueError("gamma family requires positive labels")
+        elif self.family == "tweedie":
+            p = self.variance_power
+            if 1.0 <= p < 2.0:
+                if not np.all(y[~np.isnan(y)] >= 0):
+                    raise ValueError("tweedie with 1 <= variance_power < 2 "
+                                     "requires nonnegative labels")
+            elif p >= 2.0:
+                if not np.all(y[~np.isnan(y)] > 0):
+                    raise ValueError("tweedie with variance_power >= 2 "
+                                     "requires positive labels")
 
     def fit(self, frame: Frame, mesh=None) -> "GeneralizedLinearRegressionModel":
         if mesh is None:
@@ -321,6 +452,10 @@ class GeneralizedLinearRegression(Estimator):
         if self.weight_col is not None:
             prior_w = np.asarray(frame._column_values(self.weight_col), dt)
         w = np.where(mask, prior_w, 0.0).astype(dt)
+        off = np.zeros_like(y)
+        if self.offset_col is not None:
+            off = np.where(mask, np.asarray(
+                frame._column_values(self.offset_col), dt), 0.0).astype(dt)
         d = X.shape[1]
 
         # intercept carried as a final all-ones column (dropped when
@@ -337,28 +472,21 @@ class GeneralizedLinearRegression(Estimator):
         beta0 = np.zeros((p,), dt)
         if self.fit_intercept:
             link_f, _, _ = _link_fns(self.link)
+            positive = self.family in ("poisson", "gamma") or (
+                self.family == "tweedie" and self.variance_power != 0.0)
             mu0 = {"binomial": min(max(mu_bar, 0.01), 0.99)}.get(
-                self.family, max(mu_bar, 0.1) if self.family in
-                ("poisson", "gamma") else mu_bar)
+                self.family, max(mu_bar, 0.1) if positive else mu_bar)
             beta0[p - 1] = float(np.asarray(link_f(jnp.asarray(mu0, dt))))
 
-        if mesh is not None:
-            shards = mesh.devices.size
-            rem = (-X1.shape[0]) % shards
-            if rem:
-                X1 = np.concatenate([X1, np.zeros((rem, p), dt)])
-                y = np.concatenate([y, np.zeros((rem,), dt)])
-                w = np.concatenate([w, np.zeros((rem,), dt)])
-            sh = NamedSharding(mesh, P(DATA_AXIS))
-            X1d = jax.device_put(X1, sh)
-            yd = jax.device_put(y, sh)
-            wd = jax.device_put(w, sh)
-        else:
-            X1d, yd, wd = jnp.asarray(X1), jnp.asarray(y), jnp.asarray(w)
+        from ..parallel.distributed import pad_and_shard_rows
 
-        fit_fn = _fit_cached(mesh, self.family, self.link, self.max_iter,
-                             self.tol, self.reg_param, self.fit_intercept)
-        res = jax.block_until_ready(fit_fn(X1d, yd, wd, jnp.asarray(beta0)))
+        X1d, yd, wd, offd = pad_and_shard_rows(mesh, X1, y, w, off)
+
+        fit_fn = _fit_cached(mesh, self._family_key(), self.link,
+                             self.max_iter, self.tol, self.reg_param,
+                             self.fit_intercept)
+        res = jax.block_until_ready(fit_fn(X1d, yd, wd, offd,
+                                           jnp.asarray(beta0)))
         beta = np.asarray(res.beta, np.float64)
         coef = beta[:d] if self.fit_intercept else beta
         intercept = float(beta[d]) if self.fit_intercept else 0.0
@@ -376,7 +504,11 @@ class GeneralizedLinearRegression(Estimator):
         return model
 
     def _params_dict(self):
-        return {k: getattr(self, k) for k in self._persist_attrs}
+        d = {k: getattr(self, k) for k in self._persist_attrs}
+        # the model/summary helpers key deviance/variance/link math off the
+        # params dict; the encoded family carries the tweedie power
+        d["family"] = self._family_key()
+        return d
 
 
 @persistable
@@ -408,6 +540,11 @@ class GeneralizedLinearRegressionModel(Model):
         if X.ndim == 1:
             X = X[:, None]
         eta = self._eta(X)
+        oc = self._p("offset_col")
+        if oc:
+            # missing offset column must fail loudly (predictions would
+            # silently be off by the exposure factor) — MLlib does the same
+            eta = eta + jnp.asarray(frame._column_values(oc), eta.dtype)
         _, link_inv, _ = _link_fns(self._p("link", "identity"))
         out = frame.with_column(self._p("prediction_col", "prediction"),
                                 link_inv(eta))
@@ -482,6 +619,20 @@ class GlmTrainingSummary:
         self._cache["xyw"] = (X[mask], y[mask], w[mask])
         return self._cache["xyw"]
 
+    def _offset(self):
+        """Offset over the training rows (zeros unless offset_col set)."""
+        if "offset" in self._cache:
+            return self._cache["offset"]
+        mask = np.asarray(self._frame.mask)
+        oc = self._m._p("offset_col")
+        if oc:
+            off = np.asarray(self._frame._column_values(oc),
+                             np.float64)[mask]
+        else:
+            off = np.zeros(int(mask.sum()), np.float64)
+        self._cache["offset"] = off
+        return off
+
     def _mu(self):
         """Fitted means over the training rows (memoized — always derived
         from the cached _xyw features, so the cache is safe by
@@ -490,7 +641,7 @@ class GlmTrainingSummary:
             return self._cache["mu"]
         X, _, _ = self._xyw()
         _, link_inv, _ = _link_fns(self._m._p("link"))
-        eta = X @ self._m.coefficients + self._m.intercept
+        eta = X @ self._m.coefficients + self._m.intercept + self._offset()
         self._cache["mu"] = np.asarray(_clip_mu(self._m._p("family"),
                                                 link_inv(jnp.asarray(eta))))
         return self._cache["mu"]
@@ -530,10 +681,27 @@ class GlmTrainingSummary:
     def null_deviance(self) -> float:
         X, y, w = self._xyw()
         family = self._m._p("family")
-        if self._m._p("fit_intercept", True):
+        link = self._m._p("link")
+        off = self._offset()
+        _, link_inv, _ = _link_fns(link)
+        if np.any(off != 0.0):
+            # with an offset the null model's linear predictor is
+            # β₀ + offset_i (row-varying) — an intercept-only IRLS fit
+            if self._m._p("fit_intercept", True):
+                link_f, _, _ = _link_fns(link)
+                mu_bar = float(np.sum(y * w) / max(w.sum(), _EPS))
+                b0 = float(np.asarray(link_f(jnp.asarray(
+                    _clip_mu(family, jnp.asarray(mu_bar, jnp.float64))))))
+                fit_fn = _fit_cached(None, family, link, 50, 1e-10, 0.0,
+                                     False)
+                ones = jnp.ones((len(y), 1), jnp.float64)
+                res = fit_fn(ones, jnp.asarray(y), jnp.asarray(w),
+                             jnp.asarray(off), jnp.asarray([b0]))
+                return float(res.deviance)
+            mu0 = np.asarray(_clip_mu(family, link_inv(jnp.asarray(off))))
+        elif self._m._p("fit_intercept", True):
             mu0 = np.full_like(y, np.sum(y * w) / w.sum())
         else:
-            _, link_inv, _ = _link_fns(self._m._p("link"))
             mu0 = np.full_like(y, float(np.asarray(link_inv(
                 jnp.asarray(0.0, jnp.float64)))))
         mu0 = np.asarray(_clip_mu(family, jnp.asarray(mu0)))
@@ -571,6 +739,10 @@ class GlmTrainingSummary:
     def aic(self) -> float:
         X, y, w = self._xyw()
         family = self._m._p("family")
+        if _tweedie_power(family) is not None:
+            # the Tweedie log-likelihood has no closed form for general
+            # variance powers; Spark likewise refuses AIC for tweedie
+            raise ValueError("AIC is not supported for the tweedie family")
         mu = self._mu()
         n = len(y)
         p = self._m.num_features + (1 if self._m._p("fit_intercept", True)

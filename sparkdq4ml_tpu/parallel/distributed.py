@@ -185,6 +185,26 @@ def unpack_fit_result(flat, d: int):
         converged=bool(flat[d + 2]))
 
 
+def pad_and_shard_rows(mesh: Optional[Mesh], *arrays):
+    """Zero-pad every array's leading axis to the shard count and
+    device_put them row-sharded; with no (or a trivial) mesh, pass through
+    as plain device arrays. The generic variadic variant of
+    ``place_sharded``, shared by the GLM/clustering fits — zero padding
+    rows carry zero weight by construction in every masked statistic."""
+    if mesh is None or mesh.devices.size <= 1:
+        return tuple(jnp.asarray(a) for a in arrays)
+    rem = (-arrays[0].shape[0]) % mesh.devices.size
+    shard = NamedSharding(mesh, P(DATA_AXIS))
+    out = []
+    for a in arrays:
+        a = np.asarray(a)
+        if rem:
+            a = np.concatenate(
+                [a, np.zeros((rem,) + a.shape[1:], a.dtype)])
+        out.append(jax.device_put(a, shard))
+    return tuple(out)
+
+
 def place_sharded(X, y, mask, mesh: Optional[Mesh]):
     """Pad rows to the shard count and device_put with row sharding.
     Single-device/no-mesh inputs pass through as device arrays."""

@@ -56,9 +56,10 @@ class TestALSFit:
         model = ALS(rank=3, max_iter=10, reg_param=0.01, seed=1).fit(g)
         assert np.abs(model.user_factors_arr).max() < 100
 
-    def test_implicit_not_supported(self):
-        with pytest.raises(NotImplementedError, match="implicit"):
-            ALS(implicit_prefs=True)
+    def test_implicit_param_validation(self):
+        assert ALS(implicit_prefs=True).implicit_prefs is True
+        with pytest.raises(ValueError, match="alpha"):
+            ALS(implicit_prefs=True, alpha=-1.0)
 
 
 class TestColdStart:
@@ -121,3 +122,86 @@ class TestPersistence:
                                                      rel=1e-6)
         out = loaded.transform(f)
         assert out.count() == f.count()
+
+
+class TestImplicitALS:
+    def _implicit_data(self, n_users=40, n_items=30, rank=3, seed=0):
+        """Synthetic implicit feedback: confidence counts from latent
+        affinities; ~25% of the positive-affinity pairs observed."""
+        rng = np.random.default_rng(seed)
+        U = rng.normal(size=(n_users, rank))
+        V = rng.normal(size=(n_items, rank))
+        affinity = U @ V.T
+        prob = 1 / (1 + np.exp(-2.0 * affinity))
+        observed = rng.random((n_users, n_items)) < prob * 0.4
+        counts = rng.poisson(3.0, size=(n_users, n_items)) + 1
+        u, i = np.nonzero(observed)
+        r = counts[u, i].astype(float)
+        return u.astype(float), i.astype(float), r, observed
+
+    def test_ranking_quality(self):
+        """Observed items must rank above unobserved ones per user (AUC)."""
+        u, i, r, observed = self._implicit_data()
+        f = Frame({"user": u, "item": i, "rating": r})
+        model = ALS(rank=8, max_iter=15, reg_param=0.05,
+                    implicit_prefs=True, alpha=10.0, seed=0).fit(f)
+        scores = model.user_factors_arr @ model.item_factors_arr.T
+        aucs = []
+        for uu in range(observed.shape[0]):
+            pos = scores[uu][observed[uu]]
+            neg = scores[uu][~observed[uu]]
+            if len(pos) == 0 or len(neg) == 0:
+                continue
+            # pairwise AUC
+            aucs.append(np.mean(pos[:, None] > neg[None, :]))
+        assert np.mean(aucs) > 0.75
+
+    def test_scores_are_preferences_not_counts(self):
+        u, i, r, _ = self._implicit_data(seed=1)
+        f = Frame({"user": u, "item": i, "rating": r})
+        model = ALS(rank=6, max_iter=10, implicit_prefs=True,
+                    alpha=5.0, seed=0).fit(f)
+        out = model.transform(f).to_pydict()
+        preds = np.asarray(out["prediction"])
+        # implicit predictions approximate p∈[0,1], not the raw counts
+        assert np.nanmean(preds) < 2.0
+        assert np.nanmean(preds) > 0.2
+
+    def test_loss_history_decreases(self):
+        u, i, r, _ = self._implicit_data(seed=2)
+        f = Frame({"user": u, "item": i, "rating": r})
+        model = ALS(rank=5, max_iter=12, implicit_prefs=True,
+                    alpha=5.0, seed=0).fit(f)
+        hist = model.loss_history
+        assert hist[-1] < hist[0]
+
+    def test_alpha_zero_ignores_confidence(self):
+        """α=0 ⇒ every observation has confidence 1; still a valid fit."""
+        u, i, r, _ = self._implicit_data(seed=3)
+        f = Frame({"user": u, "item": i, "rating": r})
+        model = ALS(rank=4, max_iter=8, implicit_prefs=True, alpha=0.0,
+                    seed=0).fit(f)
+        assert np.all(np.isfinite(model.user_factors_arr))
+
+    def test_persistence_roundtrip(self, tmp_path):
+        from sparkdq4ml_tpu.models.base import load_stage
+
+        u, i, r, _ = self._implicit_data(seed=4)
+        f = Frame({"user": u, "item": i, "rating": r})
+        model = ALS(rank=4, max_iter=6, implicit_prefs=True, seed=0).fit(f)
+        model.save(str(tmp_path / "ials"))
+        loaded = load_stage(str(tmp_path / "ials"))
+        np.testing.assert_allclose(loaded.user_factors_arr,
+                                   model.user_factors_arr)
+        assert loaded._params["implicit_prefs"] is True
+
+    def test_negative_ratings_zero_preference(self):
+        """r < 0 ⇒ p = 0 with confidence 1 + α|r| (HKV/MLlib semantics)."""
+        u = np.asarray([0.0, 0.0, 1.0, 1.0])
+        i = np.asarray([0.0, 1.0, 0.0, 1.0])
+        r = np.asarray([5.0, -5.0, -5.0, 5.0])
+        f = Frame({"user": u, "item": i, "rating": r})
+        model = ALS(rank=2, max_iter=20, implicit_prefs=True, alpha=20.0,
+                    reg_param=0.01, seed=0).fit(f)
+        assert model.predict(0, 0) > model.predict(0, 1)
+        assert model.predict(1, 1) > model.predict(1, 0)

@@ -318,3 +318,43 @@ class TestBisectingKMeans:
         m = BisectingKMeans(k=3, seed=0).fit(f)
         centers = np.stack(m.cluster_centers())
         assert np.all(np.abs(centers) < 100.0)
+
+
+class TestMaskedNanRows:
+    """Masked slots may hold NaN (dropna/filter keep values in place);
+    every clustering fit must zero them out of the statistics."""
+
+    def _nan_frame(self, n=120, k=2, seed=51):
+        X, y, _ = _blobs(n=n, k=k, seed=seed)
+        bad = np.arange(n) % 4 == 0
+        Xbad = X.copy()
+        Xbad[bad] = np.nan
+        return (Frame({"features": Xbad}).filter(jnp.asarray(~bad)),
+                Frame({"features": X[~bad]}))
+
+    def test_kmeans_ignores_nan_masked_rows(self):
+        from sparkdq4ml_tpu.models import KMeans
+
+        f, fclean = self._nan_frame()
+        m = KMeans(k=2, seed=0, max_iter=30).fit(f)
+        mc = KMeans(k=2, seed=0, max_iter=30).fit(fclean)
+        assert np.all(np.isfinite(np.stack(m.cluster_centers())))
+        got = np.stack(sorted(m.cluster_centers(), key=lambda c: c[0]))
+        want = np.stack(sorted(mc.cluster_centers(), key=lambda c: c[0]))
+        np.testing.assert_allclose(got, want, atol=1e-5)
+
+    def test_gmm_ignores_nan_masked_rows(self):
+        from sparkdq4ml_tpu.models import GaussianMixture
+
+        f, fclean = self._nan_frame(seed=53)
+        m = GaussianMixture(k=2, seed=0, max_iter=60).fit(f)
+        assert np.all(np.isfinite(m.means))
+        assert np.all(np.isfinite(m.covs))
+
+    def test_bisecting_ignores_nan_masked_rows(self):
+        from sparkdq4ml_tpu.models import BisectingKMeans
+
+        f, fclean = self._nan_frame(seed=55)
+        m = BisectingKMeans(k=2, seed=0).fit(f)
+        assert m.k == 2
+        assert np.all(np.isfinite(np.stack(m.cluster_centers())))

@@ -2,6 +2,7 @@
 statsmodels-convention results computed via sklearn/scipy closed checks, and
 sharded ≡ single-device (SURVEY.md §4 patterns)."""
 
+import jax.numpy as jnp
 import numpy as np
 import pytest
 
@@ -116,7 +117,7 @@ class TestSurface:
         with pytest.raises(ValueError, match="not supported"):
             GeneralizedLinearRegression(family="gamma", link="logit")
         with pytest.raises(ValueError, match="unknown family"):
-            GeneralizedLinearRegression(family="tweedie")
+            GeneralizedLinearRegression(family="negbinomial")
 
     def test_transform_and_link_prediction(self):
         rng = np.random.default_rng(6)
@@ -263,3 +264,220 @@ class TestRegularizedInference:
             model.summary.coefficient_standard_errors
         with pytest.raises(ValueError, match="regularized"):
             model.summary.p_values
+
+
+class TestTweedie:
+    def _claims(self, n=400, seed=0):
+        """Tweedie-ish synthetic insurance severity data."""
+        rng = np.random.default_rng(seed)
+        X = rng.normal(size=(n, 2))
+        mu = np.exp(0.5 * X[:, 0] - 0.3 * X[:, 1] + 1.0)
+        # compound poisson-gamma draw (p ~ 1.5): many exact zeros
+        counts = rng.poisson(mu / 2.0)
+        y = np.array([rng.gamma(2.0, m / 4.0) if c > 0 else 0.0
+                      for c, m in zip(counts, mu)])
+        f = Frame({"x0": X[:, 0], "x1": X[:, 1], "label": y})
+        return VectorAssembler(["x0", "x1"], "features").transform(f), X, y
+
+    def test_sklearn_parity_p15_log_link(self):
+        from sklearn.linear_model import TweedieRegressor
+
+        f, X, y = self._claims()
+        m = GeneralizedLinearRegression(
+            family="tweedie", variance_power=1.5, link_power=0.0,
+            max_iter=100, tol=1e-10).fit(f)
+        ref = TweedieRegressor(power=1.5, alpha=0.0, link="log",
+                               max_iter=10000, tol=1e-10).fit(X, y)
+        np.testing.assert_allclose(m.coefficients, ref.coef_, atol=2e-4)
+        assert m.intercept == pytest.approx(ref.intercept_, abs=2e-4)
+
+    def test_variance_power_0_equals_gaussian(self):
+        f, X, y = self._claims(seed=1)
+        tw = GeneralizedLinearRegression(family="tweedie",
+                                         variance_power=0.0,
+                                         link_power=1.0, max_iter=50).fit(f)
+        ga = GeneralizedLinearRegression(family="gaussian",
+                                         max_iter=50).fit(f)
+        np.testing.assert_allclose(tw.coefficients, ga.coefficients,
+                                   atol=1e-8)
+
+    def test_variance_power_validation(self):
+        with pytest.raises(ValueError, match="variance_power"):
+            GeneralizedLinearRegression(family="tweedie",
+                                        variance_power=0.5)
+        with pytest.raises(ValueError, match="link_power"):
+            GeneralizedLinearRegression(family="gaussian", link_power=1.0)
+        with pytest.raises(ValueError, match="link"):
+            GeneralizedLinearRegression(family="tweedie", link="log")
+
+    def test_default_link_power(self):
+        est = GeneralizedLinearRegression(family="tweedie",
+                                          variance_power=1.5)
+        assert est.link == "power(-0.5)"   # 1 − p
+
+    def test_aic_refused(self):
+        f, X, y = self._claims(seed=2)
+        m = GeneralizedLinearRegression(family="tweedie",
+                                        variance_power=1.5,
+                                        link_power=0.0, max_iter=50).fit(f)
+        with pytest.raises(ValueError, match="tweedie"):
+            m.summary.aic
+        assert np.isfinite(m.summary.deviance)
+        assert np.isfinite(m.summary.dispersion)
+
+    def test_sharded_equals_single(self):
+        from sparkdq4ml_tpu.parallel.mesh import make_mesh
+
+        f, X, y = self._claims(seed=3)
+        m1 = GeneralizedLinearRegression(
+            family="tweedie", variance_power=1.5, link_power=0.0,
+            max_iter=50).fit(f, mesh=make_mesh(1))
+        m8 = GeneralizedLinearRegression(
+            family="tweedie", variance_power=1.5, link_power=0.0,
+            max_iter=50).fit(f, mesh=make_mesh(8))
+        np.testing.assert_allclose(m8.coefficients, m1.coefficients,
+                                   rtol=1e-9)
+
+    def test_persistence_roundtrip(self, tmp_path):
+        from sparkdq4ml_tpu.models.base import load_stage
+
+        f, X, y = self._claims(seed=4)
+        m = GeneralizedLinearRegression(family="tweedie",
+                                        variance_power=1.5,
+                                        link_power=0.0, max_iter=40).fit(f)
+        m.save(str(tmp_path / "tw"))
+        loaded = load_stage(str(tmp_path / "tw"))
+        np.testing.assert_allclose(loaded.coefficients, m.coefficients)
+        assert loaded.predict(X[0]) == pytest.approx(m.predict(X[0]),
+                                                     rel=1e-9)
+
+
+class TestOffset:
+    def test_zero_offset_equals_no_offset(self):
+        rng = np.random.default_rng(0)
+        X = rng.normal(size=(200, 2))
+        y = rng.poisson(np.exp(0.4 * X[:, 0] + 0.2 * X[:, 1] + 0.5)) \
+            .astype(float)
+        f = Frame({"x0": X[:, 0], "x1": X[:, 1], "label": y,
+                   "off": np.zeros(200)})
+        f = VectorAssembler(["x0", "x1"], "features").transform(f)
+        m0 = GeneralizedLinearRegression(family="poisson",
+                                         max_iter=50, tol=1e-12).fit(f)
+        m1 = GeneralizedLinearRegression(family="poisson", offset_col="off",
+                                         max_iter=50, tol=1e-12).fit(f)
+        np.testing.assert_allclose(m1.coefficients, m0.coefficients,
+                                   atol=1e-10)
+
+    def test_constant_offset_shifts_intercept_exactly(self):
+        """η = Xβ + c + b ⇒ the fit with offset c has intercept b − c."""
+        rng = np.random.default_rng(1)
+        X = rng.normal(size=(300, 2))
+        y = rng.poisson(np.exp(0.4 * X[:, 0] - 0.3 * X[:, 1] + 1.0)) \
+            .astype(float)
+        f = Frame({"x0": X[:, 0], "x1": X[:, 1], "label": y,
+                   "off": np.full(300, 0.7)})
+        f = VectorAssembler(["x0", "x1"], "features").transform(f)
+        m0 = GeneralizedLinearRegression(family="poisson",
+                                         max_iter=80, tol=1e-12).fit(f)
+        m1 = GeneralizedLinearRegression(family="poisson", offset_col="off",
+                                         max_iter=80, tol=1e-12).fit(f)
+        np.testing.assert_allclose(m1.coefficients, m0.coefficients,
+                                   atol=1e-7)
+        assert m1.intercept == pytest.approx(m0.intercept - 0.7, abs=1e-7)
+
+    def test_exposure_offset_recovers_rate_model(self):
+        """Classic exposure model: y ~ Poisson(E·exp(Xβ)), offset log E."""
+        rng = np.random.default_rng(2)
+        n = 2000
+        X = rng.normal(size=(n, 2))
+        expo = rng.uniform(0.5, 4.0, size=n)
+        beta = np.array([0.5, -0.4])
+        y = rng.poisson(expo * np.exp(X @ beta + 0.3)).astype(float)
+        f = Frame({"x0": X[:, 0], "x1": X[:, 1], "label": y,
+                   "log_e": np.log(expo)})
+        f = VectorAssembler(["x0", "x1"], "features").transform(f)
+        m = GeneralizedLinearRegression(family="poisson",
+                                        offset_col="log_e",
+                                        max_iter=80, tol=1e-10).fit(f)
+        np.testing.assert_allclose(m.coefficients, beta, atol=0.06)
+        assert m.intercept == pytest.approx(0.3, abs=0.06)
+
+    def test_transform_uses_offset_when_present(self):
+        rng = np.random.default_rng(3)
+        X = rng.normal(size=(50, 1))
+        f = Frame({"x0": X[:, 0], "label": np.exp(X[:, 0]),
+                   "off": np.full(50, 2.0)})
+        f = VectorAssembler(["x0"], "features").transform(f)
+        m = GeneralizedLinearRegression(family="poisson", offset_col="off",
+                                        max_iter=50).fit(f)
+        with_off = np.asarray(m.transform(f).to_pydict()["prediction"])
+        f_nooff = f.with_column("off", jnp.zeros(50))
+        without = np.asarray(m.transform(f_nooff).to_pydict()["prediction"])
+        np.testing.assert_allclose(with_off, without * np.exp(2.0),
+                                   rtol=1e-6)
+
+
+class TestTweedieDefaultLinkF32:
+    def test_default_power_link_finite_in_float32(self):
+        """The default link (power(1−p), fractional negative) must survive
+        float32: a tiny η floor once overflowed μ^p and the IRLS weights,
+        yielding all-NaN coefficients."""
+        from sparkdq4ml_tpu.config import config as dqconfig
+
+        rng = np.random.default_rng(0)
+        X = rng.normal(size=(300, 2))
+        mu = np.exp(0.5 * X[:, 0] - 0.3 * X[:, 1] + 1.0)
+        counts = rng.poisson(mu / 2.0)
+        y = np.array([rng.gamma(2.0, m / 4.0) if c > 0 else 0.0
+                      for c, m in zip(counts, mu)])
+        f = Frame({"x0": X[:, 0], "x1": X[:, 1], "label": y})
+        f = VectorAssembler(["x0", "x1"], "features").transform(f)
+        saved = dqconfig.default_float_dtype
+        try:
+            dqconfig.default_float_dtype = jnp.float32
+            m32 = GeneralizedLinearRegression(
+                family="tweedie", variance_power=1.5, max_iter=60).fit(f)
+        finally:
+            dqconfig.default_float_dtype = saved
+        m64 = GeneralizedLinearRegression(
+            family="tweedie", variance_power=1.5, max_iter=60).fit(f)
+        assert np.all(np.isfinite(m32.coefficients))
+        np.testing.assert_allclose(m32.coefficients, m64.coefficients,
+                                   atol=1e-3)
+
+
+class TestOffsetSummary:
+    def test_null_deviance_accounts_for_offset(self):
+        rng = np.random.default_rng(5)
+        n = 400
+        X = rng.normal(size=(n, 1))
+        expo = rng.uniform(0.5, 4.0, size=n)
+        y = rng.poisson(expo * np.exp(0.5 * X[:, 0] + 0.2)).astype(float)
+        f = Frame({"x0": X[:, 0], "label": y, "log_e": np.log(expo)})
+        f = VectorAssembler(["x0"], "features").transform(f)
+        m = GeneralizedLinearRegression(family="poisson",
+                                        offset_col="log_e",
+                                        max_iter=80, tol=1e-10).fit(f)
+        nd = m.summary.null_deviance
+        # null (intercept+offset) must fit worse than the full model but
+        # better than the no-offset null against the same data
+        assert nd > m.summary.deviance
+        mu_naive = np.full_like(y, y.mean())
+        from sparkdq4ml_tpu.models.glm import _deviance
+        naive = float(np.asarray(_deviance(
+            "poisson", jnp.asarray(y), jnp.asarray(mu_naive),
+            jnp.asarray(np.ones_like(y)))))
+        assert nd < naive
+
+    def test_transform_missing_offset_column_raises(self):
+        rng = np.random.default_rng(6)
+        X = rng.normal(size=(50, 1))
+        f = Frame({"x0": X[:, 0], "label": np.exp(X[:, 0]),
+                   "off": np.zeros(50)})
+        f = VectorAssembler(["x0"], "features").transform(f)
+        m = GeneralizedLinearRegression(family="poisson", offset_col="off",
+                                        max_iter=30).fit(f)
+        f2 = Frame({"x0": X[:, 0]})
+        f2 = VectorAssembler(["x0"], "features").transform(f2)
+        with pytest.raises(KeyError):
+            m.transform(f2)
