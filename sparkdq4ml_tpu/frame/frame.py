@@ -415,13 +415,27 @@ class Frame:
 
     def sample(self, fraction: float, seed: int = 0,
                with_replacement: bool = False) -> "Frame":
-        """Bernoulli row sample (mask-based — shapes stay static).
-        ``with_replacement`` is accepted for API parity but unsupported
-        (mask semantics cannot duplicate rows)."""
+        """Row sample. Without replacement: Bernoulli mask — shapes stay
+        static and the column arrays are shared. With replacement: Poisson
+        counts per valid row (Spark's semantics; ``fraction`` is the
+        expected copy count and may exceed 1), materialized by ONE gather
+        into a NEW frame — this breaks mask/array sharing with the source
+        and the result's row count is data-dependent."""
         if with_replacement:
-            raise NotImplementedError(
-                "sampling with replacement is not supported by the "
-                "mask-based row model; use sample(fraction) without it")
+            if fraction < 0.0:
+                raise ValueError(f"fraction must be >= 0, got {fraction}")
+            rng = np.random.default_rng(seed)
+            counts = rng.poisson(fraction, self.num_slots)
+            counts = np.where(np.asarray(self._mask), counts, 0)
+            idx = np.repeat(np.arange(self.num_slots), counts)
+            data = {}
+            for name, arr in self._data.items():
+                if _is_string_col(arr):
+                    data[name] = np.asarray(arr, object)[idx]
+                else:
+                    data[name] = jnp.take(jnp.asarray(arr),
+                                          jnp.asarray(idx), axis=0)
+            return Frame(data)
         if not 0.0 <= fraction <= 1.0:
             raise ValueError(f"fraction must be in [0, 1], got {fraction}")
         rng = np.random.default_rng(seed)

@@ -13,14 +13,16 @@ from .clustering import (BisectingKMeans, BisectingKMeansModel,
 from .evaluation import (BinaryClassificationEvaluator, ClusteringEvaluator,
                          Evaluator, MulticlassClassificationEvaluator,
                          RegressionEvaluator)
-from .feature import (Binarizer, Bucketizer, Imputer, ImputerModel,
-                      IndexToString, MaxAbsScaler, MaxAbsScalerModel,
-                      MinMaxScaler, MinMaxScalerModel, Normalizer,
-                      OneHotEncoder, OneHotEncoderModel, PCA, PCAModel,
-                      PolynomialExpansion,
-                      QuantileDiscretizer, StandardScaler,
-                      StandardScalerModel, StringIndexer, StringIndexerModel,
-                      VectorAssembler)
+from .feature import (Binarizer, Bucketizer, ChiSqSelector,
+                      ChiSqSelectorModel, Imputer, ImputerModel,
+                      IndexToString, Interaction, MaxAbsScaler,
+                      MaxAbsScalerModel, MinMaxScaler, MinMaxScalerModel,
+                      Normalizer, OneHotEncoder, OneHotEncoderModel, PCA,
+                      PCAModel, PolynomialExpansion, QuantileDiscretizer,
+                      RFormula, RFormulaModel, SQLTransformer,
+                      StandardScaler, StandardScalerModel, StringIndexer,
+                      StringIndexerModel, VectorAssembler, VectorIndexer,
+                      VectorIndexerModel)
 from .glm import (GeneralizedLinearRegression,
                   GeneralizedLinearRegressionModel, GlmTrainingSummary)
 from .linalg import Vectors

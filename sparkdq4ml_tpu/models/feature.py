@@ -909,3 +909,515 @@ class PCAModel(Model):
             X = X[:, None]
         return frame.with_column(self.output_col,
                                  X @ jnp.asarray(self.pc, X.dtype))
+
+
+@persistable
+class Interaction(Transformer):
+    """MLlib ``Interaction``: the per-row tensor (Kronecker) product of the
+    input columns — scalars or vectors — as one output vector of dimension
+    ∏ dᵢ. TPU-first: built as a chain of broadcasted outer products
+    reshaped flat, one fused elementwise kernel, no per-row work.
+    (spark.ml.feature surface, `/root/reference/pom.xml:29-32`.)"""
+
+    _persist_attrs = ('input_cols', 'output_col')
+
+    def __init__(self, input_cols: Optional[Sequence[str]] = None,
+                 output_col: str = "interacted"):
+        self.input_cols = list(input_cols) if input_cols else []
+        self.output_col = output_col
+
+    def set_input_cols(self, v):
+        self.input_cols = list(v)
+        return self
+
+    setInputCols = set_input_cols
+
+    def set_output_col(self, v):
+        self.output_col = v
+        return self
+
+    setOutputCol = set_output_col
+
+    def transform(self, frame):
+        if len(self.input_cols) < 2:
+            raise ValueError("Interaction needs at least two input columns")
+        dt = float_dtype()
+        out = None
+        for name in self.input_cols:
+            arr = jnp.asarray(frame._column_values(name), dt)
+            if arr.ndim == 1:
+                arr = arr[:, None]
+            if out is None:
+                out = arr
+            else:
+                n = out.shape[0]
+                out = (out[:, :, None] * arr[:, None, :]).reshape(n, -1)
+        return frame.with_column(self.output_col, out)
+
+
+@persistable
+class SQLTransformer(Transformer):
+    """MLlib ``SQLTransformer``: a SQL statement over the placeholder view
+    ``__THIS__`` — wired straight into the framework's own SQL engine
+    (sql/parser.py), so the full supported SELECT surface (CAST, WHERE,
+    CASE, window functions, ...) is available in pipelines."""
+
+    _persist_attrs = ('statement',)
+
+    def __init__(self, statement: Optional[str] = None):
+        self.statement = statement
+
+    def set_statement(self, v):
+        self.statement = v
+        return self
+
+    setStatement = set_statement
+
+    def get_statement(self):
+        return self.statement
+
+    getStatement = get_statement
+
+    def transform(self, frame):
+        if not self.statement:
+            raise ValueError("SQLTransformer: statement not set")
+        import uuid
+
+        from ..sql.catalog import default_catalog
+        from ..sql.parser import execute
+
+        # run against the session catalog (so joins against registered
+        # temp views work, like Spark), registering the placeholder under
+        # a collision-free name and always dropping it afterwards
+        view = f"sql_transformer_{uuid.uuid4().hex[:12]}"
+        cat = default_catalog()
+        cat.register(view, frame)
+        try:
+            return execute(self.statement.replace("__THIS__", view), cat)
+        finally:
+            cat.drop(view)
+
+
+@persistable
+class VectorIndexer(Estimator):
+    """MLlib ``VectorIndexer``: scan a vector column; every feature with
+    ≤ ``max_categories`` distinct values becomes categorical and is
+    re-encoded to 0..k−1 category indices (by value order); the rest pass
+    through. The scan is one host pass over the fitted column; transform
+    is a vectorized ``searchsorted`` per categorical feature."""
+
+    _persist_attrs = ('input_col', 'output_col', 'max_categories',
+                      'handle_invalid')
+
+    def __init__(self, max_categories: int = 20,
+                 input_col: str = "features",
+                 output_col: str = "indexed",
+                 handle_invalid: str = "error"):
+        if max_categories < 2:
+            raise ValueError("max_categories must be >= 2")
+        if handle_invalid not in ("error", "keep"):
+            raise ValueError(f"handle_invalid={handle_invalid!r}")
+        self.max_categories = int(max_categories)
+        self.input_col = input_col
+        self.output_col = output_col
+        self.handle_invalid = handle_invalid
+
+    def set_max_categories(self, v):
+        if v < 2:
+            raise ValueError("max_categories must be >= 2")
+        self.max_categories = int(v)
+        return self
+
+    setMaxCategories = set_max_categories
+
+    def set_input_col(self, v):
+        self.input_col = v
+        return self
+
+    setInputCol = set_input_col
+
+    def set_output_col(self, v):
+        self.output_col = v
+        return self
+
+    setOutputCol = set_output_col
+
+    def fit(self, frame) -> "VectorIndexerModel":
+        X = np.asarray(frame._column_values(self.input_col), np.float64)
+        if X.ndim == 1:
+            X = X[:, None]
+        mask = np.asarray(frame.mask)
+        Xv = X[mask]
+        category_maps = {}
+        for j in range(X.shape[1]):
+            uniq = np.unique(Xv[:, j])
+            uniq = uniq[~np.isnan(uniq)]
+            # 0 observed values (all-NaN/all-masked) ⇒ treat as continuous
+            # passthrough rather than an empty, untransformable map
+            if 0 < len(uniq) <= self.max_categories:
+                category_maps[j] = uniq.tolist()
+        return VectorIndexerModel(X.shape[1], category_maps,
+                                  self.input_col, self.output_col,
+                                  self.handle_invalid)
+
+
+@persistable
+class VectorIndexerModel(Model):
+    _persist_attrs = ('num_features', '_category_maps_json', 'input_col',
+                      'output_col', 'handle_invalid')
+
+    def __init__(self, num_features, category_maps, input_col="features",
+                 output_col="indexed", handle_invalid="error"):
+        self.num_features = int(num_features)
+        self.category_maps = {int(k): list(v)
+                              for k, v in category_maps.items()}
+        # JSON keys are strings; persist through a string-keyed mirror
+        self._category_maps_json = {str(k): list(v)
+                                    for k, v in self.category_maps.items()}
+        self.input_col = input_col
+        self.output_col = output_col
+        self.handle_invalid = handle_invalid
+
+    def _post_load(self):
+        self.category_maps = {int(k): list(v)
+                              for k, v in self._category_maps_json.items()}
+
+    @property
+    def category_maps_(self):
+        return dict(self.category_maps)
+
+    categoryMaps = category_maps_
+
+    def transform(self, frame):
+        X = np.asarray(frame._column_values(self.input_col), np.float64)
+        if X.ndim == 1:
+            X = X[:, None]
+        mask = np.asarray(frame.mask)
+        out = X.copy()
+        for j, cats in self.category_maps.items():
+            cats_arr = np.asarray(cats, np.float64)
+            idx = np.searchsorted(cats_arr, X[:, j])
+            idx_c = np.clip(idx, 0, len(cats_arr) - 1)
+            known = cats_arr[idx_c] == X[:, j]
+            is_nan = np.isnan(X[:, j])
+            if self.handle_invalid == "error":
+                bad = mask & ~known & ~is_nan
+                if bad.any():
+                    raise ValueError(
+                        f"VectorIndexer: unseen category "
+                        f"{X[bad, j][0]!r} in feature {j}")
+                # NaN stays NaN (it is not a category), never index k−1
+                out[:, j] = np.where(is_nan, np.nan, idx_c)
+            else:   # keep → unseen (incl. NaN) gets index k
+                out[:, j] = np.where(known & ~is_nan, idx_c, len(cats_arr))
+        return frame.with_column(self.output_col,
+                                 jnp.asarray(out, float_dtype()))
+
+
+@persistable
+class ChiSqSelector(Estimator):
+    """MLlib ``ChiSqSelector``: pick features by the χ² independence test
+    against a categorical label. ``selector_type``: ``numTopFeatures``
+    (default, smallest p-values first), ``percentile``, or ``fpr``.
+    The per-feature contingency tables are one-hot matmuls (see
+    ``stat.ChiSquareTest``)."""
+
+    _persist_attrs = ('num_top_features', 'selector_type', 'percentile',
+                      'fpr', 'features_col', 'label_col', 'output_col')
+
+    def __init__(self, num_top_features: int = 50,
+                 selector_type: str = "numTopFeatures",
+                 percentile: float = 0.1, fpr: float = 0.05,
+                 features_col: str = "features", label_col: str = "label",
+                 output_col: str = "selected"):
+        if selector_type not in ("numTopFeatures", "percentile", "fpr"):
+            raise ValueError(f"selector_type={selector_type!r}")
+        self.num_top_features = int(num_top_features)
+        self.selector_type = selector_type
+        self.percentile = float(percentile)
+        self.fpr = float(fpr)
+        self.features_col = features_col
+        self.label_col = label_col
+        self.output_col = output_col
+
+    def set_num_top_features(self, v):
+        self.num_top_features = int(v)
+        return self
+
+    setNumTopFeatures = set_num_top_features
+
+    def set_selector_type(self, v):
+        if v not in ("numTopFeatures", "percentile", "fpr"):
+            raise ValueError(f"selector_type={v!r}")
+        self.selector_type = v
+        return self
+
+    setSelectorType = set_selector_type
+
+    def set_percentile(self, v):
+        self.percentile = float(v)
+        return self
+
+    setPercentile = set_percentile
+
+    def set_fpr(self, v):
+        self.fpr = float(v)
+        return self
+
+    setFpr = set_fpr
+
+    def set_features_col(self, v):
+        self.features_col = v
+        return self
+
+    setFeaturesCol = set_features_col
+
+    def set_label_col(self, v):
+        self.label_col = v
+        return self
+
+    setLabelCol = set_label_col
+
+    def set_output_col(self, v):
+        self.output_col = v
+        return self
+
+    setOutputCol = set_output_col
+
+    def fit(self, frame) -> "ChiSqSelectorModel":
+        from .stat import ChiSquareTest
+
+        res = ChiSquareTest.test(frame, self.features_col,
+                                 self.label_col).to_pydict()
+        p_values = np.asarray(res["pValues"][0], np.float64)
+        d = len(p_values)
+        order = np.argsort(p_values, kind="stable")
+        if self.selector_type == "numTopFeatures":
+            chosen = order[: self.num_top_features]
+        elif self.selector_type == "percentile":
+            chosen = order[: max(1, int(d * self.percentile))]
+        else:   # fpr
+            chosen = np.flatnonzero(p_values < self.fpr)
+        return ChiSqSelectorModel(sorted(int(i) for i in chosen),
+                                  self.features_col, self.output_col)
+
+
+@persistable
+class ChiSqSelectorModel(Model):
+    _persist_attrs = ('selected_features', 'features_col', 'output_col')
+
+    def __init__(self, selected_features, features_col="features",
+                 output_col="selected"):
+        self.selected_features = [int(i) for i in selected_features]
+        self.features_col = features_col
+        self.output_col = output_col
+
+    selectedFeatures = property(lambda self: list(self.selected_features))
+
+    def transform(self, frame):
+        X = jnp.asarray(frame._column_values(self.features_col),
+                        float_dtype())
+        if X.ndim == 1:
+            X = X[:, None]
+        sel = jnp.asarray(self.selected_features, jnp.int32)
+        return frame.with_column(self.output_col, X[:, sel])
+
+
+def _is_string_col(arr) -> bool:
+    """The frame's canonical string-column test, tolerant of raw lists and
+    numpy 'U'/'S' arrays that have not passed through Frame normalization."""
+    from ..frame.frame import _is_string_col as _frame_is_string
+
+    a = np.asarray(arr) if not isinstance(arr, np.ndarray) else arr
+    if getattr(a, "dtype", None) is not None and a.dtype.kind in ("U", "S"):
+        return True
+    try:
+        return _frame_is_string(a)
+    except TypeError:
+        return a.dtype == object
+
+
+def _parse_r_formula(formula: str):
+    """``label ~ term + term - term`` → (label, include_terms,
+    exclude_terms); a term is a tuple of column names (len > 1 ⇒ ``:``
+    interaction). ``.`` means "all other columns"."""
+    if "~" not in formula:
+        raise ValueError(f"RFormula: missing '~' in {formula!r}")
+    lhs, rhs = formula.split("~", 1)
+    label = lhs.strip()
+    include, exclude = [], []
+    # split on +/- at top level, tracking sign
+    sign, token = 1, ""
+    tokens = []
+    for ch in rhs + "+":
+        if ch in "+-":
+            if token.strip():
+                tokens.append((sign, token.strip()))
+            sign = 1 if ch == "+" else -1
+            token = ""
+        else:
+            token += ch
+    for sg, tok in tokens:
+        term = tuple(t.strip() for t in tok.split(":"))
+        if any(not t for t in term):
+            raise ValueError(f"RFormula: empty term in {formula!r}")
+        (include if sg > 0 else exclude).append(term)
+    return label, include, exclude
+
+
+@persistable
+class RFormula(Estimator):
+    """MLlib ``RFormula``: R-style model formulas — ``label ~ col1 + col2``,
+    ``.`` (all other columns), ``-`` (exclusion), ``:`` (interaction).
+    Numeric terms pass through; string terms are StringIndexed
+    (frequencyDesc) and dummy-coded with the last category dropped, exactly
+    Spark's encoding. Produces ``features`` + ``label`` columns.
+    (spark.ml.feature surface, `/root/reference/pom.xml:29-32`.)"""
+
+    _persist_attrs = ('formula', 'features_col', 'label_col',
+                      'force_index_label')
+
+    def __init__(self, formula: Optional[str] = None,
+                 features_col: str = "features", label_col: str = "label",
+                 force_index_label: bool = False):
+        self.formula = formula
+        self.features_col = features_col
+        self.label_col = label_col
+        self.force_index_label = bool(force_index_label)
+
+    def set_formula(self, v):
+        self.formula = v
+        return self
+
+    setFormula = set_formula
+
+    def set_features_col(self, v):
+        self.features_col = v
+        return self
+
+    setFeaturesCol = set_features_col
+
+    def set_label_col(self, v):
+        self.label_col = v
+        return self
+
+    setLabelCol = set_label_col
+
+    def set_force_index_label(self, v):
+        self.force_index_label = bool(v)
+        return self
+
+    setForceIndexLabel = set_force_index_label
+
+    def _encode_col(self, frame, col):
+        """One column → encoder spec: ("num", col) or ("cat", col, labels)."""
+        values = frame._column_values(col)
+        if not _is_string_col(values):
+            return ("num", col)
+        model = StringIndexer(input_col=col, output_col="_idx").fit(frame)
+        return ("cat", col, model.labels)
+
+    def fit(self, frame) -> "RFormulaModel":
+        if not self.formula:
+            raise ValueError("RFormula: formula not set")
+        label, include, exclude = _parse_r_formula(self.formula)
+        excluded = {t[0] for t in exclude if len(t) == 1}
+        terms = []
+        for term in include:
+            if term == (".",):
+                for c in frame.columns:
+                    if c != label and c not in excluded and \
+                            (c,) not in terms:
+                        terms.append((c,))
+            elif term not in terms:
+                terms.append(term)
+        terms = [t for t in terms if t not in exclude]
+
+        encoders = [[self._encode_col(frame, c) for c in t] for t in terms]
+        label_labels = None
+        if label:
+            lv = frame._column_values(label)
+            if _is_string_col(lv) or self.force_index_label:
+                label_labels = StringIndexer(
+                    input_col=label, output_col="_l").fit(frame).labels
+        return RFormulaModel(encoders, label, label_labels,
+                             self.features_col, self.label_col)
+
+
+@persistable
+class RFormulaModel(Model):
+    _persist_attrs = ('_encoders_json', 'label_source', 'label_labels',
+                      'features_col', 'label_col')
+
+    def __init__(self, encoders=None, label_source="", label_labels=None,
+                 features_col="features", label_col="label"):
+        self.encoders = encoders or []
+        self._encoders_json = [[list(e) for e in term]
+                               for term in self.encoders]
+        self.label_source = label_source
+        self.label_labels = (None if label_labels is None
+                             else list(label_labels))
+        self.features_col = features_col
+        self.label_col = label_col
+
+    def _post_load(self):
+        self.encoders = [[tuple(e) for e in term]
+                         for term in self._encoders_json]
+
+    def _encode_one(self, frame, enc):
+        """Encoder spec → (n, k) float matrix."""
+        kind = enc[0]
+        if kind == "num":
+            arr = np.asarray(frame._column_values(enc[1]), np.float64)
+            return arr[:, None] if arr.ndim == 1 else arr
+        _, col, labels = enc
+        values = np.asarray(frame._column_values(col), object)
+        lut = {l: i for i, l in enumerate(labels)}
+        k = len(labels)
+        idx = np.asarray([lut.get(str(v) if v is not None else None, k)
+                          for v in values])
+        mask = np.asarray(frame.mask)
+        unseen = mask & (idx == k)
+        if unseen.any():
+            # an unseen category would otherwise dummy-code identically to
+            # the dropped reference level; Spark's RFormula errors too
+            bad = sorted({str(values[i])
+                          for i in np.flatnonzero(unseen)})[:5]
+            raise ValueError(f"RFormula: unseen categories {bad} in "
+                             f"column {col!r}")
+        onehot = np.zeros((len(values), max(k - 1, 1)), np.float64)
+        known = idx < k - 1   # last category → all-zero row (dropLast)
+        onehot[np.arange(len(values))[known], idx[known]] = 1.0
+        if k == 1:            # single category: dropLast leaves zero width
+            return onehot[:, :0]
+        return onehot
+
+    def transform(self, frame):
+        mats = []
+        for term in self.encoders:
+            mat = None
+            for enc in term:
+                m = self._encode_one(frame, enc)
+                if mat is None:
+                    mat = m
+                else:   # ':' interaction = per-row outer product, flattened
+                    n = mat.shape[0]
+                    mat = (mat[:, :, None] * m[:, None, :]).reshape(n, -1)
+            if mat is not None and mat.shape[1] > 0:
+                mats.append(mat)
+        if not mats:
+            raise ValueError("RFormula produced no feature columns")
+        X = np.concatenate(mats, axis=1)
+        out = frame.with_column(self.features_col,
+                                jnp.asarray(X, float_dtype()))
+        if self.label_source:
+            lv = frame._column_values(self.label_source)
+            if self.label_labels is not None:
+                lut = {l: i for i, l in enumerate(self.label_labels)}
+                y = np.asarray([float(lut.get(str(v), np.nan))
+                                for v in np.asarray(lv, object)])
+            else:
+                y = np.asarray(lv, np.float64)
+            out = out.with_column(self.label_col,
+                                  jnp.asarray(y, float_dtype()))
+        return out

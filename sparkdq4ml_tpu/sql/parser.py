@@ -244,12 +244,16 @@ class _Parser:
         return (name, ascending)
 
     def parse_select_list(self):
-        if self.accept("op", "*"):
-            return ["*"]
-        items = [self.parse_item()]
+        items = [self.parse_select_item()]
         while self.accept("op", ","):
-            items.append(self.parse_item())
+            items.append(self.parse_select_item())
         return items
+
+    def parse_select_item(self):
+        # ``*`` may appear alongside other items (``SELECT *, a+b AS c``)
+        if self.accept("op", "*"):
+            return "*"
+        return self.parse_item()
 
     def parse_window_spec(self):
         """``( [PARTITION BY ident,*] [ORDER BY item,*] )`` after OVER.
@@ -570,6 +574,10 @@ def _execute_single(q: Query, cat):
     if having is not None and not q.group_by:
         raise ValueError("HAVING requires GROUP BY")
     if aggs or q.group_by:
+        if any(isinstance(it, str) and it == "*" for it in q.items):
+            raise ValueError(
+                "SELECT * cannot be combined with aggregates/GROUP BY; "
+                "list the grouped columns explicitly")
         non_aggs = [it for it in q.items
                     if not isinstance(it, (AggExpr, str))]
         for it in non_aggs:
@@ -597,6 +605,18 @@ def _execute_single(q: Query, cat):
     else:
         # NB: Expr overloads ==, so compare with identity-safe checks, never
         # `items == ["*"]` (a single-Expr list would compare truthy).
+        if (len(q.items) > 1
+                and any(isinstance(it, str) and it == "*" for it in q.items)):
+            # ``SELECT *, expr`` — expand the star against the (joined,
+            # filtered) source columns in place
+            expanded: list = []
+            for it in q.items:
+                if isinstance(it, str) and it == "*":
+                    expanded.extend(E.Col(c) for c in frame.columns)
+                else:
+                    expanded.append(it)
+            q = Query(expanded, q.view, None, [], q.order_by, q.limit,
+                      distinct=q.distinct)
         star = (len(q.items) == 1 and isinstance(q.items[0], str)
                 and q.items[0] == "*")
         if q.order_by and not star:

@@ -306,8 +306,6 @@ class TestSampleSplitCache:
         assert 150 < s.count() < 350
         import pytest
 
-        with pytest.raises(NotImplementedError):
-            f.sample(0.5, with_replacement=True)
         with pytest.raises(ValueError):
             f.sample(1.5)
 
@@ -321,3 +319,46 @@ class TestSampleSplitCache:
         assert "Physical Frame" in out
         assert "row slots: 1000" in out
         assert "x: device/" in out
+
+
+class TestSampleWithReplacement:
+    def test_poisson_bootstrap_counts(self):
+        f = Frame({"x": np.arange(1000, dtype=np.float64)})
+        out = f.sample(1.0, seed=7, with_replacement=True)
+        # expected count ≈ n, and duplicates must exist
+        assert 850 < out.count() < 1150
+        xs = out.to_pydict()["x"]
+        assert len(np.unique(xs)) < len(xs)
+
+    def test_fraction_above_one(self):
+        f = Frame({"x": np.arange(200, dtype=np.float64)})
+        out = f.sample(3.0, seed=1, with_replacement=True)
+        assert 450 < out.count() < 750
+
+    def test_masked_rows_never_sampled(self):
+        import jax.numpy as jnp
+
+        x = np.arange(100, dtype=np.float64)
+        f = Frame({"x": x}).filter(jnp.asarray(x < 50))
+        out = f.sample(2.0, seed=3, with_replacement=True)
+        assert out.count() > 0
+        assert np.max(out.to_pydict()["x"]) < 50
+
+    def test_string_columns_gather(self):
+        f = Frame({"x": np.asarray([1.0, 2.0, 3.0]),
+                   "s": np.asarray(["a", "b", "c"], object)})
+        out = f.sample(2.0, seed=5, with_replacement=True)
+        d = out.to_pydict()
+        lut = {1.0: "a", 2.0: "b", 3.0: "c"}
+        assert all(lut[v] == s for v, s in zip(d["x"], d["s"]))
+
+    def test_deterministic_by_seed(self):
+        f = Frame({"x": np.arange(50, dtype=np.float64)})
+        a = f.sample(1.0, seed=9, with_replacement=True).to_pydict()["x"]
+        b = f.sample(1.0, seed=9, with_replacement=True).to_pydict()["x"]
+        np.testing.assert_array_equal(a, b)
+
+    def test_negative_fraction_rejected(self):
+        f = Frame({"x": [1.0]})
+        with pytest.raises(ValueError):
+            f.sample(-0.5, with_replacement=True)
