@@ -74,12 +74,17 @@ def bin_features(X: np.ndarray, mask: np.ndarray, max_bins: int):
 # jitted level builder
 # ---------------------------------------------------------------------------
 
-def _level_histogram(binned, node_pos, targets, n_nodes, B):
+def _level_histogram(binned, node_pos, targets, n_nodes, B, psum_axis=None):
     """(d, n_nodes, B, s) sufficient statistics for one level.
 
     ``binned`` (n, d) int32; ``node_pos`` (n,) int32 position of the row's
     node within the level (n_nodes slot = parked/leaf rows — excluded);
     ``targets`` (n, s) already mask/bootstrap-weighted stat rows.
+
+    ``psum_axis``: mesh axis name when rows are sharded — the local
+    segment_sum histograms reduce with ONE ``lax.psum`` over ICI, the exact
+    analogue of MLlib's per-level ``aggregateByKey`` shuffle
+    (`findBestSplits`, implied by the reference's mllib dep pom.xml:29-32).
     """
     s = targets.shape[1]
     idx = node_pos[:, None] * B + binned                     # (n, d)
@@ -91,6 +96,8 @@ def _level_histogram(binned, node_pos, targets, n_nodes, B):
         return jax.ops.segment_sum(t, safe, num_segments=n_nodes * B)
 
     hist = jax.vmap(per_feature, in_axes=1)(idx)             # (d, nodes*B, s)
+    if psum_axis is not None:
+        hist = jax.lax.psum(hist, psum_axis)
     return hist.reshape((-1, n_nodes, B, s))
 
 
@@ -164,13 +171,20 @@ class TreeArrays(NamedTuple):
 
 
 def build_tree(binned, edges, targets, max_depth, max_bins, impurity,
-               min_instances, min_info_gain, feat_masks=None):
+               min_instances, min_info_gain, feat_masks=None,
+               psum_axis=None):
     """Level-wise histogram tree build (jit-compatible; vmappable over a
     leading bootstrap axis via ``targets``/``feat_masks``).
 
     ``targets`` (n, s): weighted stat rows ([w, wy, wy²] or class one-hots).
     ``feat_masks`` optional (levels, max_nodes_at_level..) — supplied as a
     (2^max_depth - 1 + ..., d) per-heap-node mask, indexed by heap id.
+
+    ``psum_axis``: set inside ``shard_map`` when rows are sharded over a
+    mesh axis. Each device histograms its row shard and the level stats
+    psum over ICI; the (replicated) split decisions are then identical on
+    every device, so each device descends only its own rows and the final
+    tree arrays come out replicated — zero host syncs per level.
     """
     n, d = binned.shape
     N = 2 ** (max_depth + 1) - 1
@@ -190,7 +204,8 @@ def build_tree(binned, edges, targets, max_depth, max_bins, impurity,
         m = 2 ** depth
         base = m - 1                            # first heap id of this level
         node_pos = jnp.where(alive, heap - base, m)  # m = parked sentinel
-        hist = _level_histogram(binned, node_pos, targets, m, max_bins)
+        hist = _level_histogram(binned, node_pos, targets, m, max_bins,
+                                psum_axis)
         # every feature's bins partition the same rows; feature 0's
         # histogram summed over bins is the exact node total
         total = jnp.sum(hist[0], axis=1)                     # (m, s)
@@ -358,11 +373,15 @@ def _n_subset_features(strategy, d, is_classification, n_trees=1):
 
 def _fit_forest(binned, edges, y, w, *, n_trees, max_depth, max_bins,
                 impurity, min_instances, min_info_gain, n_classes,
-                subsample, n_feat, seed):
+                subsample, n_feat, seed, mesh=None):
     """Build n_trees trees in one vmapped XLA program.
 
     Regression (n_classes=0): targets [w, wy, wy²]; leaf value = wy/w.
     Classification: targets = per-class weighted one-hots.
+
+    Under a ``mesh``, rows shard over the data axis and each level's
+    histogram psums over ICI (see :func:`build_tree`); zero-padded rows
+    carry zero target weight so they never vote.
     """
     n, d = binned.shape
     dt = np.dtype(float_dtype())
@@ -391,30 +410,82 @@ def _fit_forest(binned, edges, y, w, *, n_trees, max_depth, max_bins,
         kth = np.partition(scores, n_feat - 1, axis=2)[:, :, n_feat - 1]
         feat_masks = scores <= kth[:, :, None]
 
+    if mesh is not None and mesh.devices.size <= 1:
+        mesh = None
     fn = _forest_builder(max_depth, max_bins, impurity, min_instances,
-                         min_info_gain, feat_masks is not None)
-    args = (jnp.asarray(binned), jnp.asarray(edges, dt),
-            jnp.asarray(targets))
+                         min_info_gain, feat_masks is not None, mesh)
+    if mesh is None:
+        args = (jnp.asarray(binned), jnp.asarray(edges, dt),
+                jnp.asarray(targets))
+        if feat_masks is not None:
+            args += (jnp.asarray(feat_masks),)
+        return jax.block_until_ready(fn(*args))
+
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ..parallel.mesh import DATA_AXIS
+
+    nsh = mesh.devices.size
+    rem = (-n) % nsh
+    if rem:  # zero-weight pad rows (bin 0, target 0) never vote
+        binned = np.concatenate([binned, np.zeros((rem, d), np.int32)])
+        targets = np.concatenate(
+            [targets, np.zeros((n_trees, rem, targets.shape[2]), dt)],
+            axis=1)
+    args = (jax.device_put(binned, NamedSharding(mesh, P(DATA_AXIS, None))),
+            jax.device_put(np.asarray(edges, dt), NamedSharding(mesh, P())),
+            jax.device_put(targets,
+                           NamedSharding(mesh, P(None, DATA_AXIS, None))))
     if feat_masks is not None:
-        args += (jnp.asarray(feat_masks),)
+        args += (jax.device_put(feat_masks, NamedSharding(mesh, P())),)
     return jax.block_until_ready(fn(*args))
 
 
 @functools.lru_cache(maxsize=None)
 def _forest_builder(max_depth, max_bins, impurity, min_instances,
-                    min_info_gain, with_masks):
-    """Jitted vmapped tree builder, cached per hyperparameter combination so
+                    min_info_gain, with_masks, mesh=None):
+    """Jitted vmapped tree builder, cached per (hyperparameters, mesh) so
     repeated fits (cross-validation grids, boosting rounds) reuse the
-    compiled XLA program instead of re-tracing (cf glm._fit_cached)."""
+    compiled XLA program instead of re-tracing (cf glm._fit_cached).
 
-    def one_tree(binned, edges, t, fm):
+    With a mesh: ``shard_map`` over the data axis — per-shard descent,
+    psum'd level histograms, replicated tree outputs."""
+
+    def one_tree(binned, edges, t, fm, axis=None):
         return build_tree(binned, edges, t, max_depth, max_bins, impurity,
-                          min_instances, min_info_gain, fm)
+                          min_instances, min_info_gain, fm, psum_axis=axis)
+
+    if mesh is None:
+        if with_masks:
+            return jax.jit(jax.vmap(one_tree, in_axes=(None, None, 0, 0)))
+        return jax.jit(jax.vmap(lambda b, e, t: one_tree(b, e, t, None),
+                                in_axes=(None, None, 0)))
+
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.mesh import DATA_AXIS
 
     if with_masks:
-        return jax.jit(jax.vmap(one_tree, in_axes=(None, None, 0, 0)))
-    return jax.jit(jax.vmap(lambda b, e, t: one_tree(b, e, t, None),
-                            in_axes=(None, None, 0)))
+        def local(b, e, t, fm):
+            return jax.vmap(
+                lambda tt, ff: one_tree(b, e, tt, ff, DATA_AXIS),
+                in_axes=(0, 0))(t, fm)
+
+        fn = jax.shard_map(
+            local, mesh=mesh,
+            in_specs=(P(DATA_AXIS, None), P(), P(None, DATA_AXIS, None),
+                      P()),
+            out_specs=P())
+    else:
+        def local(b, e, t):
+            return jax.vmap(
+                lambda tt: one_tree(b, e, tt, None, DATA_AXIS))(t)
+
+        fn = jax.shard_map(
+            local, mesh=mesh,
+            in_specs=(P(DATA_AXIS, None), P(), P(None, DATA_AXIS, None)),
+            out_specs=P())
+    return jax.jit(fn)
 
 
 class _TreeModelBase(Model):
@@ -485,7 +556,7 @@ class DecisionTreeRegressor(Estimator, _TreeParams):
     _subsample = 1.0
     _feature_subset = "all"
 
-    def fit(self, frame: Frame) -> "DecisionTreeRegressionModel":
+    def fit(self, frame: Frame, mesh=None) -> "DecisionTreeRegressionModel":
         X, y, mask = self._extract(frame)
         edges, binned = bin_features(X, mask, self.max_bins)
         w = mask.astype(np.float64)
@@ -498,7 +569,7 @@ class DecisionTreeRegressor(Estimator, _TreeParams):
             subsample=self._subsample,
             n_feat=_n_subset_features(self._feature_subset, X.shape[1],
                                       False, self._n_trees),
-            seed=self.seed)
+            seed=self.seed, mesh=mesh)
         return self._make_model(trees, X.shape[1])
 
     def _make_model(self, trees, d):
@@ -648,7 +719,8 @@ class DecisionTreeClassifier(Estimator, _TreeParams):
     _subsample = 1.0
     _feature_subset = "all"
 
-    def fit(self, frame: Frame) -> "DecisionTreeClassificationModel":
+    def fit(self, frame: Frame, mesh=None) \
+            -> "DecisionTreeClassificationModel":
         X, y, mask = self._extract(frame)
         yv = y[mask]
         if np.any(yv < 0) or np.any(yv != np.floor(yv)):
@@ -665,7 +737,7 @@ class DecisionTreeClassifier(Estimator, _TreeParams):
             subsample=self._subsample,
             n_feat=_n_subset_features(self._feature_subset, X.shape[1],
                                       True, self._n_trees),
-            seed=self.seed)
+            seed=self.seed, mesh=mesh)
         return self._make_model(trees, X.shape[1], k)
 
     def _params_for_model(self):
@@ -793,15 +865,30 @@ class RandomForestClassificationModel(DecisionTreeClassificationModel):
 # ---------------------------------------------------------------------------
 
 @functools.lru_cache(maxsize=None)
-def _gbt_round_builder(max_depth, max_bins, min_instances, min_info_gain):
+def _gbt_round_builder(max_depth, max_bins, min_instances, min_info_gain,
+                       mesh=None):
     """Jitted single-round GBT tree build, cached per hyperparameters so
-    every boosting round (and every refit) reuses one compiled program."""
+    every boosting round (and every refit) reuses one compiled program.
+    With a mesh, rows shard over the data axis exactly like
+    :func:`_forest_builder` (psum'd level histograms)."""
 
-    def one_round(binned, edges, targets):
+    def one_round(binned, edges, targets, axis=None):
         return build_tree(binned, edges, targets, max_depth, max_bins,
-                          "variance", min_instances, min_info_gain)
+                          "variance", min_instances, min_info_gain,
+                          psum_axis=axis)
 
-    return jax.jit(one_round)
+    if mesh is None:
+        return jax.jit(one_round)
+
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.mesh import DATA_AXIS
+
+    fn = jax.shard_map(
+        lambda b, e, t: one_round(b, e, t, DATA_AXIS), mesh=mesh,
+        in_specs=(P(DATA_AXIS, None), P(), P(DATA_AXIS, None)),
+        out_specs=P())
+    return jax.jit(fn)
 
 
 @functools.lru_cache(maxsize=None)
@@ -817,17 +904,40 @@ def _gbt_leaf_fn(max_depth):
 
 
 def _gbt_fit(X, y, w, *, loss, max_iter, step, max_depth, max_bins,
-             min_instances, min_info_gain, subsample, seed):
+             min_instances, min_info_gain, subsample, seed, mesh=None):
     """Returns (F0, stacked TreeArrays). Stats rows per tree:
     [w, w·g, w·g², w·h] — variance-of-gradient splits (Friedman), Newton
     leaf values Σg/Σh. For squared loss h ≡ 1 so the leaf is the residual
-    mean; for logistic h = p(1−p)."""
+    mean; for logistic h = p(1−p).
+
+    Under a ``mesh`` each boosting round's tree builds row-sharded
+    (psum'd level histograms); the replicated tree then scores the full
+    rows for the next round's gradients."""
     dt = np.dtype(float_dtype())
     edges, binned = bin_features(X, w > 0, max_bins)
-    binned_d = jnp.asarray(binned)
-    edges_d = jnp.asarray(edges, dt)
     rng = np.random.default_rng(seed)
     n = len(y)
+
+    if mesh is not None and mesh.devices.size <= 1:
+        mesh = None
+    if mesh is None:
+        pad = 0
+        binned_d = jnp.asarray(binned)
+        edges_d = jnp.asarray(edges, dt)
+        row_shard = None
+    else:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ..parallel.mesh import DATA_AXIS
+
+        pad = (-n) % mesh.devices.size
+        if pad:
+            binned = np.concatenate(
+                [binned, np.zeros((pad, binned.shape[1]), np.int32)])
+        row_shard = NamedSharding(mesh, P(DATA_AXIS, None))
+        binned_d = jax.device_put(binned, row_shard)
+        edges_d = jax.device_put(np.asarray(edges, dt),
+                                 NamedSharding(mesh, P()))
 
     wsum = max(w.sum(), 1e-12)
     if loss == "squared":
@@ -837,7 +947,7 @@ def _gbt_fit(X, y, w, *, loss, max_iter, step, max_depth, max_bins,
         F0 = float(np.log(p0 / (1 - p0)))
 
     one_round = _gbt_round_builder(max_depth, max_bins, min_instances,
-                                   min_info_gain)
+                                   min_info_gain, mesh)
     tree_leaf_stats = _gbt_leaf_fn(max_depth)
 
     Xd = jnp.asarray(X, dt)
@@ -855,7 +965,11 @@ def _gbt_fit(X, y, w, *, loss, max_iter, step, max_depth, max_bins,
             w * (rng.random(n) < subsample).astype(np.float64)
         targets = np.stack([ww, ww * g, ww * g * g, ww * h], axis=1) \
             .astype(dt)
-        tree = one_round(binned_d, edges_d, jnp.asarray(targets))
+        if pad:
+            targets = np.concatenate([targets, np.zeros((pad, 4), dt)])
+        targets_d = jnp.asarray(targets) if row_shard is None \
+            else jax.device_put(targets, row_shard)
+        tree = one_round(binned_d, edges_d, targets_d)
         all_trees.append(jax.tree_util.tree_map(np.asarray, tree))
         leaf = np.asarray(tree_leaf_stats(tree.value, tree.feature,
                                           tree.threshold, tree.is_leaf, Xd),
@@ -913,7 +1027,7 @@ class GBTRegressor(_GbtBase):
                       'subsampling_rate', 'features_col', 'label_col',
                       'prediction_col', 'seed')
 
-    def fit(self, frame: Frame) -> "GBTRegressionModel":
+    def fit(self, frame: Frame, mesh=None) -> "GBTRegressionModel":
         X, y, mask = self._extract(frame)
         F0, trees = _gbt_fit(
             X, y, mask.astype(np.float64), loss="squared",
@@ -921,7 +1035,7 @@ class GBTRegressor(_GbtBase):
             max_depth=self.max_depth, max_bins=self.max_bins,
             min_instances=self.min_instances_per_node,
             min_info_gain=self.min_info_gain,
-            subsample=self.subsampling_rate, seed=self.seed)
+            subsample=self.subsampling_rate, seed=self.seed, mesh=mesh)
         return GBTRegressionModel(
             trees.feature, trees.threshold, trees.is_leaf, trees.value,
             trees.gain, X.shape[1], self.max_depth, F0, self.step_size,
@@ -985,7 +1099,7 @@ class GBTClassifier(_GbtBase):
         self.probability_col = probability_col
         self.raw_prediction_col = raw_prediction_col
 
-    def fit(self, frame: Frame) -> "GBTClassificationModel":
+    def fit(self, frame: Frame, mesh=None) -> "GBTClassificationModel":
         X, y, mask = self._extract(frame)
         yv = y[mask]
         if not np.all((yv == 0) | (yv == 1)):
@@ -996,7 +1110,7 @@ class GBTClassifier(_GbtBase):
             max_depth=self.max_depth, max_bins=self.max_bins,
             min_instances=self.min_instances_per_node,
             min_info_gain=self.min_info_gain,
-            subsample=self.subsampling_rate, seed=self.seed)
+            subsample=self.subsampling_rate, seed=self.seed, mesh=mesh)
         return GBTClassificationModel(
             trees.feature, trees.threshold, trees.is_leaf, trees.value,
             trees.gain, X.shape[1], self.max_depth, F0, self.step_size,
