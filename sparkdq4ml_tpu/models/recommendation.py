@@ -38,20 +38,30 @@ from .base import Estimator, Model, persistable
 
 
 def _als_half_step(factors_other, idx_self, idx_other, ratings, n_self,
-                   rank, reg):
+                   rank, reg, w=None, psum_axis=None):
     """Solve all of one side's factors given the other side's.
 
     For every entity e on the solving side:
         (Σ_{r∈R(e)} v_r v_rᵀ + λ·n_e·I) x_e = Σ_{r∈R(e)} rating_r · v_r
     computed as two segment_sums + one batched solve.
+
+    ``w`` (nnz,) 0/1 weights let zero-padded rating slots drop out of every
+    statistic; ``psum_axis`` reduces the local segment statistics over the
+    mesh's data axis (the treeAggregate→psum contract of SURVEY.md §3.3)
+    before the replicated batched solve — Spark's ALS instead shuffles
+    factor blocks between executors per half-step.
     """
     V = factors_other[idx_other]                       # (nnz, k)
-    outer = V[:, :, None] * V[:, None, :]              # (nnz, k, k)
+    ww = jnp.ones_like(ratings) if w is None else w
+    outer = (V[:, :, None] * V[:, None, :]) * ww[:, None, None]
     A = jax.ops.segment_sum(outer, idx_self, num_segments=n_self)
-    b = jax.ops.segment_sum(V * ratings[:, None], idx_self,
+    b = jax.ops.segment_sum(V * (ratings * ww)[:, None], idx_self,
                             num_segments=n_self)
-    cnt = jax.ops.segment_sum(jnp.ones_like(ratings), idx_self,
-                              num_segments=n_self)
+    cnt = jax.ops.segment_sum(ww, idx_self, num_segments=n_self)
+    if psum_axis is not None:
+        A = jax.lax.psum(A, psum_axis)
+        b = jax.lax.psum(b, psum_axis)
+        cnt = jax.lax.psum(cnt, psum_axis)
     eye = jnp.eye(rank, dtype=V.dtype)
     # ALS-WR: λ scaled by the entity's rating count; entities with no
     # ratings get the identity system → zero factors
@@ -62,7 +72,7 @@ def _als_half_step(factors_other, idx_self, idx_other, ratings, n_self,
 
 
 def _implicit_half_step(factors_other, idx_self, idx_other, ratings,
-                        n_self, rank, reg, alpha):
+                        n_self, rank, reg, alpha, w=None, psum_axis=None):
     """HKV implicit half-step: for every entity e on the solving side
 
         (YᵀY + Σ_{r∈R(e)} (c_r − 1)·v_r v_rᵀ + λI) x_e
@@ -71,62 +81,103 @@ def _implicit_half_step(factors_other, idx_self, idx_other, ratings,
     with ``c = 1 + α|r|`` and ``p = [r > 0]``. ``YᵀY`` is one dense (k, k)
     MXU matmul shared across entities; the corrections are segment_sums
     over the observed entries only.
+
+    Under sharding, ``factors_other`` is replicated so ``YᵀY`` needs no
+    collective — only the sparse corrections psum over ``psum_axis``.
     """
     V = factors_other[idx_other]                       # (nnz, k)
     YtY = factors_other.T @ factors_other              # (k, k), shared
+    ww = jnp.ones_like(ratings) if w is None else w
     c1 = alpha * jnp.abs(ratings)                      # c − 1
     p = (ratings > 0).astype(V.dtype)
-    outer = (V[:, :, None] * V[:, None, :]) * c1[:, None, None]
+    outer = (V[:, :, None] * V[:, None, :]) * (c1 * ww)[:, None, None]
     A_extra = jax.ops.segment_sum(outer, idx_self, num_segments=n_self)
-    b = jax.ops.segment_sum(V * ((1.0 + c1) * p)[:, None], idx_self,
+    b = jax.ops.segment_sum(V * ((1.0 + c1) * p * ww)[:, None], idx_self,
                             num_segments=n_self)
-    cnt = jax.ops.segment_sum(jnp.ones_like(ratings), idx_self,
-                              num_segments=n_self)
+    cnt = jax.ops.segment_sum(ww, idx_self, num_segments=n_self)
+    if psum_axis is not None:
+        A_extra = jax.lax.psum(A_extra, psum_axis)
+        b = jax.lax.psum(b, psum_axis)
+        cnt = jax.lax.psum(cnt, psum_axis)
     eye = jnp.eye(rank, dtype=V.dtype)
     A = YtY[None, :, :] + A_extra + reg * eye
     x = jnp.linalg.solve(A, b[:, :, None])[:, :, 0]
     return jnp.where(cnt[:, None] > 0, x, 0.0)
 
 
+def _psum_mean(num, den, psum_axis):
+    if psum_axis is not None:
+        num = jax.lax.psum(num, psum_axis)
+        den = jax.lax.psum(den, psum_axis)
+    return num / jnp.maximum(den, 1.0)
+
+
 @functools.lru_cache(maxsize=None)
-def _implicit_fit_fn(rank, max_iter, reg, alpha, n_users, n_items):
-    def fit(u_idx, i_idx, ratings, U0, V0):
+def _implicit_fit_fn(rank, max_iter, reg, alpha, n_users, n_items,
+                     mesh=None):
+    def core(u_idx, i_idx, ratings, w, U0, V0, psum_axis):
         p = (ratings > 0).astype(U0.dtype)
         c = 1.0 + alpha * jnp.abs(ratings)
 
         def body(carry, _):
             U, V = carry
             U = _implicit_half_step(V, u_idx, i_idx, ratings, n_users,
-                                    rank, reg, alpha)
+                                    rank, reg, alpha, w, psum_axis)
             V = _implicit_half_step(U, i_idx, u_idx, ratings, n_items,
-                                    rank, reg, alpha)
+                                    rank, reg, alpha, w, psum_axis)
             # confidence-weighted preference loss over observed entries
             # (the unobserved-zeros term is monitoring-only, not recomputed)
             pred = jnp.sum(U[u_idx] * V[i_idx], axis=1)
-            loss = jnp.mean(c * (p - pred) ** 2)
+            loss = _psum_mean(jnp.sum(w * c * (p - pred) ** 2),
+                              jnp.sum(w), psum_axis)
             return (U, V), loss
 
         (U, V), history = jax.lax.scan(body, (U0, V0), None, length=max_iter)
         return U, V, history
 
-    return jax.jit(fit)
+    return _jit_als_fit(core, mesh)
 
 
 @functools.lru_cache(maxsize=None)
-def _als_fit_fn(rank, max_iter, reg, n_users, n_items):
-    def fit(u_idx, i_idx, ratings, U0, V0):
+def _als_fit_fn(rank, max_iter, reg, n_users, n_items, mesh=None):
+    def core(u_idx, i_idx, ratings, w, U0, V0, psum_axis):
         def body(carry, _):
             U, V = carry
-            U = _als_half_step(V, u_idx, i_idx, ratings, n_users, rank, reg)
-            V = _als_half_step(U, i_idx, u_idx, ratings, n_items, rank, reg)
+            U = _als_half_step(V, u_idx, i_idx, ratings, n_users, rank,
+                               reg, w, psum_axis)
+            V = _als_half_step(U, i_idx, u_idx, ratings, n_items, rank,
+                               reg, w, psum_axis)
             # loss (for the scan output): masked squared error
             pred = jnp.sum(U[u_idx] * V[i_idx], axis=1)
-            mse = jnp.mean((ratings - pred) ** 2)
+            mse = _psum_mean(jnp.sum(w * (ratings - pred) ** 2),
+                             jnp.sum(w), psum_axis)
             return (U, V), mse
         (U, V), history = jax.lax.scan(body, (U0, V0), None, length=max_iter)
         return U, V, history
 
-    return jax.jit(fit)
+    return _jit_als_fit(core, mesh)
+
+
+def _jit_als_fit(core, mesh):
+    """Jit ``core`` either directly or as a shard_map over the ratings
+    (nnz) axis: the factor matrices stay replicated, the per-entry
+    statistics psum over ICI — the whole alternation loop remains one
+    jitted scan with zero host round-trips, now per device."""
+    if mesh is None:
+        return jax.jit(lambda u, i, r, w, U0, V0: core(u, i, r, w, U0, V0,
+                                                       None))
+
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.mesh import DATA_AXIS
+
+    fn = jax.shard_map(
+        lambda u, i, r, w, U0, V0: core(u, i, r, w, U0, V0, DATA_AXIS),
+        mesh=mesh,
+        in_specs=(P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS),
+                  P(), P()),
+        out_specs=(P(), P(), P()))
+    return jax.jit(fn)
 
 
 @persistable
@@ -230,11 +281,13 @@ class ALS(Estimator):
 
     setSeed = set_seed
 
-    def fit(self, frame: Frame) -> "ALSModel":
+    def fit(self, frame: Frame, mesh=None) -> "ALSModel":
         dt = np.dtype(float_dtype())
         mask = np.asarray(frame.mask)
         if mask.sum() == 0:
             raise ValueError("ALS: no valid rows")
+        if mesh is not None and mesh.devices.size <= 1:
+            mesh = None
         users = np.asarray(frame._column_values(self.user_col))[mask]
         items = np.asarray(frame._column_values(self.item_col))[mask]
         ratings = np.asarray(frame._column_values(self.rating_col),
@@ -260,13 +313,36 @@ class ALS(Estimator):
         if self.implicit_prefs:
             fit_fn = _implicit_fit_fn(self.rank, self.max_iter,
                                       self.reg_param, self.alpha,
-                                      n_users, n_items)
+                                      n_users, n_items, mesh)
         else:
             fit_fn = _als_fit_fn(self.rank, self.max_iter, self.reg_param,
-                                 n_users, n_items)
-        U, V, history = jax.block_until_ready(fit_fn(
-            jnp.asarray(u_idx, jnp.int32), jnp.asarray(i_idx, jnp.int32),
-            jnp.asarray(ratings), jnp.asarray(U0), jnp.asarray(V0)))
+                                 n_users, n_items, mesh)
+
+        u_idx = np.asarray(u_idx, np.int32)
+        i_idx = np.asarray(i_idx, np.int32)
+        w = np.ones_like(ratings)
+        if mesh is None:
+            args = tuple(map(jnp.asarray, (u_idx, i_idx, ratings, w)))
+            factors = (jnp.asarray(U0), jnp.asarray(V0))
+        else:
+            # shard the ratings (nnz) axis; zero-weight pad slots never vote
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from ..parallel.mesh import DATA_AXIS
+
+            rem = (-len(ratings)) % mesh.devices.size
+            if rem:
+                z = np.zeros((rem,), dt)
+                u_idx = np.concatenate([u_idx, np.zeros((rem,), np.int32)])
+                i_idx = np.concatenate([i_idx, np.zeros((rem,), np.int32)])
+                ratings = np.concatenate([ratings, z])
+                w = np.concatenate([w, z])
+            shard = NamedSharding(mesh, P(DATA_AXIS))
+            rep = NamedSharding(mesh, P())
+            args = tuple(jax.device_put(a, shard)
+                         for a in (u_idx, i_idx, ratings, w))
+            factors = (jax.device_put(U0, rep), jax.device_put(V0, rep))
+        U, V, history = jax.block_until_ready(fit_fn(*args, *factors))
         return ALSModel(np.asarray(U), np.asarray(V), u_ids.tolist(),
                         i_ids.tolist(), self._params_dict(),
                         np.asarray(history, np.float64).tolist())

@@ -9,6 +9,8 @@ metrics from the same single pass.
 
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -16,42 +18,89 @@ import numpy as np
 from ..config import float_dtype
 
 
-@jax.jit
-def _moment_pass(X, w):
-    """One masked pass: count, per-feature sum/mean, centered second moments,
-    min/max, L1/L2 norms."""
+def _moment_stats(X, w, psum_axis=None):
+    """One masked moment pass over (a row shard of) a matrix: count,
+    per-feature sum/mean, CENTERED second moments (numerically stable — no
+    raw-moment cancellation), min/max, L1/L2 norms.
+
+    With ``psum_axis`` set (inside shard_map), the count/sum psum first so
+    every device centers on the GLOBAL mean, then the centered scatter and
+    the remaining statistics psum (pmin/pmax for extrema) — two cheap
+    collectives, same math as the single-device pass."""
     wc = w[:, None]
     n = jnp.sum(w)
-    mean = jnp.sum(X * wc, axis=0) / n
-    C = ((X - mean) * wc).T @ ((X - mean) * wc)  # centered scatter
+    s1 = jnp.sum(X * wc, axis=0)
+    if psum_axis is not None:
+        n, s1 = jax.lax.psum((n, s1), psum_axis)
+    mean = s1 / n
+    Xc = (X - mean) * wc
+    C = Xc.T @ Xc                                 # centered scatter
     big = jnp.asarray(jnp.finfo(X.dtype).max, X.dtype)
     mn = jnp.min(jnp.where(wc > 0, X, big), axis=0)
     mx = jnp.max(jnp.where(wc > 0, X, -big), axis=0)
     l1 = jnp.sum(jnp.abs(X) * wc, axis=0)
-    l2 = jnp.sqrt(jnp.sum(X * X * wc, axis=0))
+    sq = jnp.sum(X * X * wc, axis=0)
     nnz = jnp.sum((X != 0) * wc, axis=0)
-    return n, mean, C, mn, mx, l1, l2, nnz
+    if psum_axis is not None:
+        C, l1, sq, nnz = jax.lax.psum((C, l1, sq, nnz), psum_axis)
+        mn = jax.lax.pmin(mn, psum_axis)
+        mx = jax.lax.pmax(mx, psum_axis)
+    return n, mean, C, mn, mx, l1, jnp.sqrt(sq), nnz
 
 
-def _extract(frame, col):
+@jax.jit
+def _moment_pass(X, w):
+    """One masked pass: count, per-feature sum/mean, centered second moments,
+    min/max, L1/L2 norms."""
+    return _moment_stats(X, w)
+
+
+@functools.lru_cache(maxsize=None)
+def _moment_pass_fn(mesh):
+    """Mesh-sharded variant of :func:`_moment_pass` (cached per mesh)."""
+    if mesh is None:
+        return _moment_pass
+
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.mesh import DATA_AXIS
+
+    return jax.jit(jax.shard_map(
+        lambda X, w: _moment_stats(X, w, DATA_AXIS), mesh=mesh,
+        in_specs=(P(DATA_AXIS, None), P(DATA_AXIS)),
+        out_specs=P()))
+
+
+def _extract(frame, col, mesh=None):
     X = jnp.asarray(frame._column_values(col), float_dtype())
     if X.ndim == 1:
         X = X[:, None]
     w = frame.mask.astype(X.dtype)
+    if mesh is not None:
+        from ..parallel.distributed import pad_and_shard_rows
+
+        X, w = pad_and_shard_rows(mesh, np.asarray(X), np.asarray(w))
     return X, w
+
+
+def _normalize_mesh(mesh):
+    return None if mesh is None or mesh.devices.size <= 1 else mesh
 
 
 class Correlation:
     """``org.apache.spark.ml.stat.Correlation`` equivalent."""
 
     @staticmethod
-    def corr(frame, column: str = "features", method: str = "pearson"):
+    def corr(frame, column: str = "features", method: str = "pearson",
+             mesh=None):
         """(d×d) correlation matrix of a vector column as a numpy array.
 
-        ``pearson`` runs fully on device from one scatter-matrix pass;
-        ``spearman`` ranks host-side first (ranking is a data-dependent
-        permutation — not a static-shape XLA op) then reuses the same pass.
+        ``pearson`` runs fully on device from one scatter-matrix pass
+        (row-sharded + psum'd under a ``mesh``); ``spearman`` ranks
+        host-side first (ranking is a data-dependent permutation — not a
+        static-shape XLA op) then reuses the same pass.
         """
+        mesh = _normalize_mesh(mesh)
         X, w = _extract(frame, column)
         if method == "spearman":
             import scipy.stats
@@ -63,7 +112,11 @@ class Correlation:
             X = jnp.asarray(ranked, X.dtype)
         elif method != "pearson":
             raise ValueError(f"unknown correlation method {method!r}")
-        _, _, C, *_ = _moment_pass(X, w)
+        if mesh is not None:
+            from ..parallel.distributed import pad_and_shard_rows
+
+            X, w = pad_and_shard_rows(mesh, np.asarray(X), np.asarray(w))
+        _, _, C, *_ = _moment_pass_fn(mesh)(X, w)
         d = np.sqrt(np.diag(np.asarray(C)))
         denom = np.outer(d, d)
         with np.errstate(invalid="ignore", divide="ignore"):
@@ -91,9 +144,11 @@ class Summarizer:
     def metrics(cls, *names) -> "Summarizer":
         return cls(names)
 
-    def summary(self, frame, column: str = "features") -> dict:
-        X, w = _extract(frame, column)
-        n, mean, C, mn, mx, l1, l2, nnz = map(np.asarray, _moment_pass(X, w))
+    def summary(self, frame, column: str = "features", mesh=None) -> dict:
+        mesh = _normalize_mesh(mesh)
+        X, w = _extract(frame, column, mesh)
+        n, mean, C, mn, mx, l1, l2, nnz = map(np.asarray,
+                                              _moment_pass_fn(mesh)(X, w))
         var = np.diag(C) / max(float(n) - 1.0, 1.0)
         all_metrics = {
             "mean": mean, "variance": var, "std": np.sqrt(var),
@@ -103,9 +158,25 @@ class Summarizer:
         return {k: all_metrics[k] for k in self._metrics}
 
 
-def summary(frame, column: str = "features") -> dict:
+def summary(frame, column: str = "features", mesh=None) -> dict:
     """All Summarizer metrics of a vector column in one pass."""
-    return Summarizer(Summarizer.METRICS).summary(frame, column)
+    return Summarizer(Summarizer.METRICS).summary(frame, column, mesh)
+
+
+@functools.lru_cache(maxsize=None)
+def _contingency_fn(mesh):
+    """Contingency matmul ``fxᵀ @ ly``, row-sharded + psum'd under a mesh."""
+    if mesh is None:
+        return jax.jit(lambda fx, ly: fx.T @ ly)
+
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.mesh import DATA_AXIS
+
+    return jax.jit(jax.shard_map(
+        lambda fx, ly: jax.lax.psum(fx.T @ ly, DATA_AXIS), mesh=mesh,
+        in_specs=(P(DATA_AXIS, None), P(DATA_AXIS, None)),
+        out_specs=P()))
 
 
 class ChiSquareTest:
@@ -115,16 +186,18 @@ class ChiSquareTest:
     TPU-first: each feature's contingency table is ONE one-hot matmul
     (``onehot(feature)ᵀ @ onehot(label)``, MXU-shaped) over masked rows —
     no per-row host work; only the (c_f × c_l) table comes back to the host
-    for the χ² tail probability (scipy).
+    for the χ² tail probability (scipy). Under a ``mesh`` the rows shard
+    and the table psums over ICI (per-feature ``aggregateByKey`` analogue).
     """
 
     @staticmethod
     def test(frame, features_col: str = "features",
-             label_col: str = "label"):
+             label_col: str = "label", mesh=None):
         from scipy import stats as sstats
 
         from ..frame import Frame
 
+        mesh = _normalize_mesh(mesh)
         X, w = _extract(frame, features_col)
         y = jnp.asarray(frame._column_values(label_col), X.dtype)
 
@@ -141,15 +214,23 @@ class ChiSquareTest:
             raise ValueError("ChiSquareTest requires nonnegative integer "
                              "labels")
         n_label = int(yv.max()) + 1
+        if mesh is not None:
+            # masked rows already weight ly to zero; pad rows do the same
+            from ..parallel.distributed import pad_and_shard_rows
+
+            X, y, w = pad_and_shard_rows(mesh, Xh,
+                                         np.where(keep, yh, 0.0),
+                                         np.asarray(w))
         ly = jax.nn.one_hot(y.astype(jnp.int32), n_label,
                             dtype=X.dtype) * w[:, None]
 
+        contingency = _contingency_fn(mesh)
         p_values, dofs, statistics = [], [], []
         for j in range(Xh.shape[1]):
             n_feat = int(Xh[keep, j].max()) + 1
             fx = jax.nn.one_hot(X[:, j].astype(jnp.int32), n_feat,
                                 dtype=X.dtype)
-            table = np.asarray(fx.T @ ly)          # (c_f, c_l) contingency
+            table = np.asarray(contingency(fx, ly))  # (c_f, c_l)
             # drop empty rows/cols (Spark's degrees of freedom use observed
             # categories only)
             table = table[table.sum(axis=1) > 0][:, table.sum(axis=0) > 0]
