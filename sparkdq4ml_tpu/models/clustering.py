@@ -38,13 +38,8 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..config import float_dtype
 from ..frame import Frame
-from ..parallel.mesh import DATA_AXIS
+from ..parallel.mesh import DATA_AXIS, normalize_mesh
 from .base import Estimator, Model, persistable
-
-
-def _normalize_mesh(mesh):
-    """Treat a trivial (≤1-device) mesh as no mesh."""
-    return None if mesh is None or mesh.devices.size <= 1 else mesh
 
 
 def _pad_and_shard(X, w, mesh, dt):
@@ -237,7 +232,7 @@ class KMeans(Estimator):
         else:  # k-means|| / k-means++ → greedy k-means++ seeding
             centers0 = _kmeans_pp_init(X, w, self.k, rng)
 
-        mesh = _normalize_mesh(mesh)
+        mesh = normalize_mesh(mesh)
         Xd, wd = _pad_and_shard(X, w, mesh, dt)
         fit_fn = _fit_cached(mesh, self.k, self.max_iter, self.tol)
         centers, cost, iters, counts = jax.block_until_ready(
@@ -525,7 +520,7 @@ class GaussianMixture(Estimator):
                         (self.k, 1, 1))
         weights0 = np.full((self.k,), 1.0 / self.k, dt)
 
-        mesh = _normalize_mesh(mesh)
+        mesh = normalize_mesh(mesh)
         Xd, wd = _pad_and_shard(X, w, mesh, dt)
         fit_fn = _gmm_fit_cached(mesh, self.k, self.max_iter, self.tol,
                                  self.reg)
@@ -734,7 +729,7 @@ class BisectingKMeans(Estimator):
             raise ValueError(f"k={self.k} exceeds the {n_valid} valid rows")
         rng = np.random.default_rng(self.seed)
 
-        mesh = _normalize_mesh(mesh)
+        mesh = normalize_mesh(mesh)
         Xd, _ = _pad_and_shard(X, w, mesh, dt)
         if mesh is not None and Xd.shape[0] != X.shape[0]:
             # keep the host-side copies in the padded shape too, so the

@@ -282,12 +282,13 @@ class ALS(Estimator):
     setSeed = set_seed
 
     def fit(self, frame: Frame, mesh=None) -> "ALSModel":
+        from ..parallel.mesh import normalize_mesh
+
         dt = np.dtype(float_dtype())
         mask = np.asarray(frame.mask)
         if mask.sum() == 0:
             raise ValueError("ALS: no valid rows")
-        if mesh is not None and mesh.devices.size <= 1:
-            mesh = None
+        mesh = normalize_mesh(mesh)
         users = np.asarray(frame._column_values(self.user_col))[mask]
         items = np.asarray(frame._column_values(self.item_col))[mask]
         ratings = np.asarray(frame._column_values(self.rating_col),
@@ -318,29 +319,18 @@ class ALS(Estimator):
             fit_fn = _als_fit_fn(self.rank, self.max_iter, self.reg_param,
                                  n_users, n_items, mesh)
 
-        u_idx = np.asarray(u_idx, np.int32)
-        i_idx = np.asarray(i_idx, np.int32)
-        w = np.ones_like(ratings)
+        # shard the ratings (nnz) axis; zero-weight pad slots never vote
+        from ..parallel.distributed import pad_and_shard_rows
+
+        args = pad_and_shard_rows(mesh, np.asarray(u_idx, np.int32),
+                                  np.asarray(i_idx, np.int32), ratings,
+                                  np.ones_like(ratings))
         if mesh is None:
-            args = tuple(map(jnp.asarray, (u_idx, i_idx, ratings, w)))
             factors = (jnp.asarray(U0), jnp.asarray(V0))
         else:
-            # shard the ratings (nnz) axis; zero-weight pad slots never vote
-            from jax.sharding import NamedSharding, PartitionSpec as P
+            from ..parallel.mesh import replicated_sharding
 
-            from ..parallel.mesh import DATA_AXIS
-
-            rem = (-len(ratings)) % mesh.devices.size
-            if rem:
-                z = np.zeros((rem,), dt)
-                u_idx = np.concatenate([u_idx, np.zeros((rem,), np.int32)])
-                i_idx = np.concatenate([i_idx, np.zeros((rem,), np.int32)])
-                ratings = np.concatenate([ratings, z])
-                w = np.concatenate([w, z])
-            shard = NamedSharding(mesh, P(DATA_AXIS))
-            rep = NamedSharding(mesh, P())
-            args = tuple(jax.device_put(a, shard)
-                         for a in (u_idx, i_idx, ratings, w))
+            rep = replicated_sharding(mesh)
             factors = (jax.device_put(U0, rep), jax.device_put(V0, rep))
         U, V, history = jax.block_until_ready(fit_fn(*args, *factors))
         return ALSModel(np.asarray(U), np.asarray(V), u_ids.tolist(),

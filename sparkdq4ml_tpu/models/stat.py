@@ -16,6 +16,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..config import float_dtype
+from ..parallel.mesh import normalize_mesh
 
 
 def _moment_stats(X, w, psum_axis=None):
@@ -72,19 +73,20 @@ def _moment_pass_fn(mesh):
 
 
 def _extract(frame, col, mesh=None):
-    X = jnp.asarray(frame._column_values(col), float_dtype())
+    if mesh is None:
+        # stay on device — np.asarray on a device array is a device→host
+        # read (and the first such read must never happen here; see
+        # parallel/distributed.pack_design)
+        X = jnp.asarray(frame._column_values(col), float_dtype())
+        if X.ndim == 1:
+            X = X[:, None]
+        return X, frame.mask.astype(X.dtype)
+    from ..parallel.distributed import pad_and_shard_rows
+
+    X = np.asarray(frame._column_values(col), np.dtype(float_dtype()))
     if X.ndim == 1:
         X = X[:, None]
-    w = frame.mask.astype(X.dtype)
-    if mesh is not None:
-        from ..parallel.distributed import pad_and_shard_rows
-
-        X, w = pad_and_shard_rows(mesh, np.asarray(X), np.asarray(w))
-    return X, w
-
-
-def _normalize_mesh(mesh):
-    return None if mesh is None or mesh.devices.size <= 1 else mesh
+    return pad_and_shard_rows(mesh, X, np.asarray(frame.mask, X.dtype))
 
 
 class Correlation:
@@ -100,22 +102,24 @@ class Correlation:
         host-side first (ranking is a data-dependent permutation — not a
         static-shape XLA op) then reuses the same pass.
         """
-        mesh = _normalize_mesh(mesh)
-        X, w = _extract(frame, column)
+        mesh = normalize_mesh(mesh)
         if method == "spearman":
             import scipy.stats
 
-            Xn = np.asarray(X)
-            keep = np.asarray(w) > 0
-            ranked = np.zeros_like(Xn)
-            ranked[keep] = scipy.stats.rankdata(Xn[keep], axis=0)
-            X = jnp.asarray(ranked, X.dtype)
-        elif method != "pearson":
-            raise ValueError(f"unknown correlation method {method!r}")
-        if mesh is not None:
             from ..parallel.distributed import pad_and_shard_rows
 
-            X, w = pad_and_shard_rows(mesh, np.asarray(X), np.asarray(w))
+            # ranking is inherently host-side; the host read is the point
+            Xn, wn = _extract(frame, column)
+            Xn = np.asarray(Xn)
+            wn = np.asarray(wn)
+            keep = wn > 0
+            ranked = np.zeros_like(Xn)
+            ranked[keep] = scipy.stats.rankdata(Xn[keep], axis=0)
+            X, w = pad_and_shard_rows(mesh, ranked, wn)
+        elif method == "pearson":
+            X, w = _extract(frame, column, mesh)
+        else:
+            raise ValueError(f"unknown correlation method {method!r}")
         _, _, C, *_ = _moment_pass_fn(mesh)(X, w)
         d = np.sqrt(np.diag(np.asarray(C)))
         denom = np.outer(d, d)
@@ -145,7 +149,7 @@ class Summarizer:
         return cls(names)
 
     def summary(self, frame, column: str = "features", mesh=None) -> dict:
-        mesh = _normalize_mesh(mesh)
+        mesh = normalize_mesh(mesh)
         X, w = _extract(frame, column, mesh)
         n, mean, C, mn, mx, l1, l2, nnz = map(np.asarray,
                                               _moment_pass_fn(mesh)(X, w))
@@ -197,7 +201,7 @@ class ChiSquareTest:
 
         from ..frame import Frame
 
-        mesh = _normalize_mesh(mesh)
+        mesh = normalize_mesh(mesh)
         X, w = _extract(frame, features_col)
         y = jnp.asarray(frame._column_values(label_col), X.dtype)
 

@@ -56,6 +56,13 @@ def make_mesh(num_devices: Optional[int] = None,
     return Mesh(np.asarray(devices), (axis_name,))
 
 
+def normalize_mesh(mesh: Optional[Mesh]) -> Optional[Mesh]:
+    """Treat a trivial (≤1-device) mesh as no mesh — the shared guard every
+    ``fit(frame, mesh=...)`` entry point applies before building a sharded
+    program."""
+    return None if mesh is None or mesh.devices.size <= 1 else mesh
+
+
 def data_sharding(mesh: Mesh, axis_name: str = DATA_AXIS) -> NamedSharding:
     """Rows sharded over the data axis (leading-dim sharding)."""
     return NamedSharding(mesh, PartitionSpec(axis_name))
