@@ -255,21 +255,25 @@ class HashingTF(Transformer):
     setBinary = set_binary
 
     def transform(self, frame):
+        # Vectorized over the flattened corpus: md5 runs once per UNIQUE
+        # token (np.unique), bucket scatter is one np.add.at — the only
+        # Python-level loop left is per-document length collection.
         col = _token_col(frame, self.input_col)
-        M = np.zeros((len(col), self.num_features),
-                     np.dtype(float_dtype()))
-        bucket: dict = {}  # hash once per unique token, not per occurrence
-        for i, toks in enumerate(col):
-            if toks is None:
-                continue
-            for t in toks:
-                j = bucket.get(t)
-                if j is None:
-                    j = bucket[t] = _stable_hash(t, self.num_features)
-                if self.binary:
-                    M[i, j] = 1.0
-                else:
-                    M[i, j] += 1.0
+        n = len(col)
+        dt = np.dtype(float_dtype())
+        M = np.zeros((n, self.num_features), dt)
+        lens = np.fromiter((0 if t is None else len(t) for t in col),
+                           np.int64, count=n)
+        flat = [t for toks in col if toks is not None for t in toks]
+        if flat:
+            uniq, inv = np.unique(np.asarray(flat), return_inverse=True)
+            buckets = np.fromiter(
+                (_stable_hash(str(t), self.num_features) for t in uniq),
+                np.int64, count=uniq.size)
+            doc_ids = np.repeat(np.arange(n), lens)
+            np.add.at(M, (doc_ids, buckets[inv]), 1.0)
+            if self.binary:
+                M = (M > 0).astype(dt)
         return frame.with_column(self.output_col, jnp.asarray(M))
 
 
@@ -305,22 +309,33 @@ class CountVectorizer(Estimator):
     setMinDF = set_min_df
 
     def fit(self, frame) -> "CountVectorizerModel":
+        # Vectorized document-frequency: unique (doc, term) pairs via one
+        # np.unique over integer-encoded pair ids, then a bincount — no
+        # per-token Python loop.
         col = _token_col(frame, self.input_col)
         mask = np.asarray(frame.mask)
-        df: dict = {}
-        n_docs = 0
-        for toks, m in zip(col, mask):
-            if not m or toks is None:
-                continue
-            n_docs += 1
-            for t in set(toks):
-                df[t] = df.get(t, 0) + 1
+        docs = [toks for toks, m in zip(col, mask)
+                if m and toks is not None]
+        n_docs = len(docs)
+        flat = [t for toks in docs for t in toks]
+        if flat:
+            lens = np.fromiter((len(t) for t in docs), np.int64,
+                               count=n_docs)
+            uniq, inv = np.unique(np.asarray(flat), return_inverse=True)
+            doc_ids = np.repeat(np.arange(n_docs, dtype=np.int64), lens)
+            pair_ids = np.unique(doc_ids * np.int64(uniq.size) + inv)
+            df_counts = np.bincount(pair_ids % np.int64(uniq.size),
+                                    minlength=uniq.size)
+        else:
+            uniq = np.asarray([], dtype=object)
+            df_counts = np.asarray([], np.int64)
         # min_df: absolute count if >= 1, else fraction of documents
         thresh = self.min_df if self.min_df >= 1.0 \
             else self.min_df * max(n_docs, 1)
-        terms = [(t, c) for t, c in df.items() if c >= thresh]
-        terms.sort(key=lambda tc: (-tc[1], tc[0]))
-        vocab = [t for t, _ in terms[: self.vocab_size]]
+        keep = df_counts >= thresh
+        terms, cnts = uniq[keep], df_counts[keep]
+        order = np.lexsort((terms, -cnts))        # (-count, token) like MLlib
+        vocab = [str(t) for t in terms[order][: self.vocab_size]]
         return CountVectorizerModel(vocab, self.min_tf, self.binary,
                                     self.input_col, self.output_col)
 
@@ -337,29 +352,43 @@ class CountVectorizerModel(Model):
         self.binary = binary
         self.input_col = input_col
         self.output_col = output_col
-        self._index = {t: i for i, t in enumerate(self.vocabulary)}
+        self._build_index()
 
     def _post_load(self):
         self.vocabulary = list(self.vocabulary)
-        self._index = {t: i for i, t in enumerate(self.vocabulary)}
+        self._build_index()
+
+    def _build_index(self):
+        """Sorted-vocabulary lookup tables, built once per model so every
+        transform pays only the searchsorted, not an O(V log V) re-sort."""
+        vocab_arr = np.asarray(self.vocabulary)
+        self._vocab_order = np.argsort(vocab_arr)
+        self._sorted_vocab = vocab_arr[self._vocab_order]
 
     def transform(self, frame):
+        # Vectorized: one sorted-vocabulary searchsorted over the flattened
+        # corpus, one np.add.at count scatter, matrix-level min_tf/binary.
         col = _token_col(frame, self.input_col)
-        M = np.zeros((len(col), len(self.vocabulary)),
-                     np.dtype(float_dtype()))
-        for i, toks in enumerate(col):
-            if toks is None:
-                continue
-            for t in toks:
-                j = self._index.get(t)
-                if j is not None:
-                    M[i, j] += 1.0
-            if self.min_tf >= 1.0:
-                M[i][M[i] < self.min_tf] = 0.0
-            elif len(toks):
-                M[i][M[i] / len(toks) < self.min_tf] = 0.0
-            if self.binary:
-                M[i] = (M[i] > 0).astype(M.dtype)
+        n = len(col)
+        dt = np.dtype(float_dtype())
+        V = len(self.vocabulary)
+        M = np.zeros((n, V), dt)
+        lens = np.fromiter((0 if t is None else len(t) for t in col),
+                           np.int64, count=n)
+        flat = [t for toks in col if toks is not None for t in toks]
+        if flat and V:
+            doc_ids = np.repeat(np.arange(n), lens)
+            flat_arr = np.asarray(flat)
+            sv = self._sorted_vocab
+            pos = np.minimum(np.searchsorted(sv, flat_arr), V - 1)
+            hit = sv[pos] == flat_arr
+            np.add.at(M, (doc_ids[hit], self._vocab_order[pos[hit]]), 1.0)
+        if self.min_tf >= 1.0:
+            M[M < self.min_tf] = 0.0
+        else:  # fraction-of-document threshold; empty docs are all-zero
+            M[M / np.maximum(lens, 1)[:, None] < self.min_tf] = 0.0
+        if self.binary:
+            M = (M > 0).astype(dt)
         return frame.with_column(self.output_col, jnp.asarray(M))
 
 
