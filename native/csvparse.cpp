@@ -3,15 +3,22 @@
 // Role (SURVEY.md §2.2 "CSV reader"): the analogue of the Univocity parser
 // inside Spark's CSV source, for the common all-numeric feature-matrix case.
 // Parses a whole file into column-major float64 with NaN for empty fields,
-// handling bare-CR / CRLF / LF record separators in one pass, and tracks per
-// column whether every value is integral (so Python can choose int32/float).
+// handling bare-CR / CRLF / LF record separators and RFC-4180 quoting
+// (quoted fields may contain delimiters, escaped "" quotes, and embedded
+// record separators) in one pass, and tracks per column whether every value
+// is integral (so Python can choose int32/float).
 //
 // Contract (see sparkdq4ml_tpu/frame/native_csv.py):
-//   dq_parse_numeric_csv(path, delim, skip_header, &data, &ncols, &int_flags)
+//   dq_parse_numeric_csv(path, delim, quote, skip_header,
+//                        &data, &ncols, &int_flags)
 //     -> n_rows >= 0 on success; -1 if any field is non-numeric (caller
 //        falls back to the Python parser); -2 on IO error.
 //   data: column-major [ncols * n_rows] doubles, malloc'd; caller frees via
 //   dq_free. int_flags: ncols bytes, 1 = column is integral with no nulls.
+//
+// Allocation discipline: unquoted fields parse with strtod directly on the
+// (NUL-terminated) file buffer — zero per-field allocations; quoted records
+// tokenize into one REUSED record buffer with NUL-separated cleaned fields.
 //
 // Build: make -C native
 
@@ -21,23 +28,25 @@
 #include <cstdlib>
 #include <cstring>
 #include <string>
+#include <utility>
 #include <vector>
 
 namespace {
 
-// Parse one field; returns false if non-numeric. Empty -> NaN.
-bool parse_field(const char* begin, const char* end, double* out) {
+// Parse one span as a double; returns false if non-numeric. Empty -> NaN.
+// The span must sit inside a NUL-terminated buffer; strtod stops at the
+// first non-numeric char, and stop==end proves the whole span parsed.
+bool parse_span(const char* begin, const char* end, double* out) {
   while (begin < end && (*begin == ' ' || *begin == '\t')) ++begin;
   while (end > begin && (end[-1] == ' ' || end[-1] == '\t')) --end;
   if (begin == end) {
     *out = std::nan("");
     return true;
   }
-  std::string buf(begin, end);  // strtod needs NUL termination
   char* stop = nullptr;
   errno = 0;
-  double v = std::strtod(buf.c_str(), &stop);
-  if (stop != buf.c_str() + buf.size() || errno == ERANGE) return false;
+  double v = std::strtod(begin, &stop);
+  if (stop != end || errno == ERANGE) return false;
   *out = v;
   return true;
 }
@@ -46,9 +55,9 @@ bool parse_field(const char* begin, const char* end, double* out) {
 
 extern "C" {
 
-long long dq_parse_numeric_csv(const char* path, char delim, int skip_header,
-                               double** out_data, long long* out_ncols,
-                               char** out_int_flags) {
+long long dq_parse_numeric_csv(const char* path, char delim, char quote,
+                               int skip_header, double** out_data,
+                               long long* out_ncols, char** out_int_flags) {
   *out_data = nullptr;
   *out_ncols = 0;
   *out_int_flags = nullptr;
@@ -61,54 +70,130 @@ long long dq_parse_numeric_csv(const char* path, char delim, int skip_header,
   std::string text(static_cast<size_t>(size), '\0');
   size_t got = size > 0 ? std::fread(&text[0], 1, static_cast<size_t>(size), f) : 0;
   std::fclose(f);
-  text.resize(got);
+  text.resize(got);  // text.data() stays NUL-terminated (C++11 std::string)
 
   // Row-major parse into a growing buffer; transpose at the end.
   std::vector<double> values;
   size_t ncols = 0;
   long long nrows = 0;
   bool first_record = true;
+  std::string rbuf;                              // reused cleaned-record buffer
+  std::vector<std::pair<size_t, size_t>> spans;  // (begin, end) into rbuf
 
   const char* p = text.data();
   const char* const file_end = p + text.size();
   while (p < file_end) {
-    // Find the record terminator: \r\n, \r, or \n.
+    // Phase A: find the record terminator (\r\n, \r, \n) with quote state —
+    // separators inside quoted fields are content, not terminators.
+    bool rec_has_quote = false;
     const char* rec_end = p;
-    while (rec_end < file_end && *rec_end != '\r' && *rec_end != '\n') ++rec_end;
+    {
+      bool q = false;
+      while (rec_end < file_end) {
+        char ch = *rec_end;
+        if (q) {
+          if (ch == quote) {
+            if (rec_end + 1 < file_end && rec_end[1] == quote) ++rec_end;
+            else q = false;
+          }
+        } else if (ch == quote) {
+          q = true;
+          rec_has_quote = true;
+        } else if (ch == '\r' || ch == '\n') {
+          break;
+        }
+        ++rec_end;
+      }
+    }
     const char* next = rec_end;
     if (next < file_end) {
       if (*next == '\r' && next + 1 < file_end && next[1] == '\n') next += 2;
       else next += 1;
     }
-    // Skip blank records (and the header if requested).
-    const char* q = p;
-    while (q < rec_end && (*q == ' ' || *q == '\t')) ++q;
-    bool blank = (q == rec_end);
+
+    // Blank / header skipping (a quoted record is never blank).
+    bool blank = false;
+    if (!rec_has_quote) {
+      const char* q = p;
+      while (q < rec_end && (*q == ' ' || *q == '\t')) ++q;
+      blank = (q == rec_end);
+    }
     bool skip = blank || (first_record && skip_header);
     if (!blank) first_record = false;
-    if (!skip) {
-      size_t col = 0;
+    if (skip) {
+      p = next;
+      continue;
+    }
+
+    size_t col = 0;
+    auto push_value = [&](double v) -> bool {
+      if (nrows == 0) {
+        values.push_back(v);
+        ++ncols;
+      } else {
+        if (col >= ncols) return false;  // ragged wide row -> python path
+        values.push_back(v);
+      }
+      ++col;
+      return true;
+    };
+
+    if (!rec_has_quote) {
+      // Hot path: fields parse in place off the file buffer.
       const char* field = p;
       for (const char* c = p;; ++c) {
         if (c == rec_end || *c == delim) {
           double v;
-          if (!parse_field(field, c, &v)) return -1;
-          if (nrows == 0) {
-            values.push_back(v);
-            ++ncols;
-          } else {
-            if (col >= ncols) return -1;  // ragged wide row -> python path
-            values.push_back(v);
-          }
-          ++col;
+          if (!parse_span(field, c, &v)) return -1;
+          if (!push_value(v)) return -1;
           field = c + 1;
           if (c == rec_end) break;
         }
       }
-      // Ragged short row: pad with NaN (python parser does the same).
-      for (; col < ncols && nrows > 0; ++col) values.push_back(std::nan(""));
-      ++nrows;
+    } else {
+      // Quoted record: strip quotes into rbuf, fields NUL-separated so
+      // strtod can't run past a span into the next field.
+      rbuf.clear();
+      spans.clear();
+      size_t fstart = 0;
+      bool q = false;
+      for (const char* c = p;; ++c) {
+        if (c == rec_end) {
+          spans.emplace_back(fstart, rbuf.size());
+          break;
+        }
+        char ch = *c;
+        if (q) {
+          if (ch == quote) {
+            if (c + 1 < rec_end && c[1] == quote) {
+              rbuf.push_back(quote);
+              ++c;
+            } else {
+              q = false;
+            }
+          } else {
+            rbuf.push_back(ch);
+          }
+        } else if (ch == quote) {
+          q = true;
+        } else if (ch == delim) {
+          spans.emplace_back(fstart, rbuf.size());
+          rbuf.push_back('\0');
+          fstart = rbuf.size();
+        } else {
+          rbuf.push_back(ch);
+        }
+      }
+      for (const auto& s : spans) {
+        double v;
+        if (!parse_span(rbuf.data() + s.first, rbuf.data() + s.second, &v))
+          return -1;
+        if (!push_value(v)) return -1;
+      }
     }
+    // Ragged short row: pad with NaN (python parser does the same).
+    for (; col < ncols && nrows > 0; ++col) values.push_back(std::nan(""));
+    ++nrows;
     p = next;
   }
 

@@ -34,21 +34,33 @@ _FALSE = {"false", "FALSE", "False"}
 
 
 def split_records(text: str) -> list[str]:
-    r"""Split on \r\n, \r, or \n; drop blank records (Spark skips blank lines)."""
+    r"""Split on \r\n, \r, or \n; drop blank records (Spark skips blank lines).
+
+    Quote-UNaware — only safe when the text contains no quote character;
+    :func:`parse_csv_text` routes quoted input through the stateful scanner
+    so record separators inside quoted fields stay literal.
+    """
     text = text.replace("\r\n", "\n").replace("\r", "\n")
     return [line for line in text.split("\n") if line.strip() != ""]
 
 
-def split_fields(record: str, delimiter: str = ",", quote: str = '"') -> list[str]:
-    """Tokenize one record with minimal RFC-4180 quoting support."""
-    if quote not in record:
-        return record.split(delimiter)
-    fields, buf, in_q, i = [], [], False, 0
-    while i < len(record):
-        c = record[i]
+def _parse_quoted_text(text: str, delimiter: str, quote: str) -> list[list[str]]:
+    r"""Single-pass stateful tokenizer for text containing quotes: record
+    separators (\r\n, \r, \n) and delimiters inside quoted fields are
+    literal content; ``""`` inside quotes is an escaped quote (RFC 4180 —
+    the Univocity behavior behind the reference's CSV options,
+    `DataQuality4MachineLearningApp.java:53-55`)."""
+    rows: list[list[str]] = []
+    row: list[str] = []
+    buf: list[str] = []
+    quoted_field = False   # current field had quotes (never blank-skipped)
+    in_q = False
+    i, n = 0, len(text)
+    while i < n:
+        c = text[i]
         if in_q:
             if c == quote:
-                if i + 1 < len(record) and record[i + 1] == quote:
+                if i + 1 < n and text[i + 1] == quote:
                     buf.append(quote)
                     i += 1
                 else:
@@ -57,14 +69,50 @@ def split_fields(record: str, delimiter: str = ",", quote: str = '"') -> list[st
                 buf.append(c)
         elif c == quote:
             in_q = True
+            quoted_field = True
         elif c == delimiter:
-            fields.append("".join(buf))
+            row.append("".join(buf))
             buf = []
+        elif c in ("\r", "\n"):
+            if c == "\r" and i + 1 < n and text[i + 1] == "\n":
+                i += 1
+            row.append("".join(buf))
+            buf = []
+            if len(row) > 1 or row[0].strip() != "" or quoted_field:
+                rows.append(row)      # blank lines are skipped (Spark)
+            row = []
+            quoted_field = False
         else:
             buf.append(c)
         i += 1
-    fields.append("".join(buf))
-    return fields
+    if buf or row or quoted_field:   # a lone quoted "" is still a record
+        row.append("".join(buf))
+        if len(row) > 1 or row[0].strip() != "" or quoted_field:
+            rows.append(row)
+    return rows
+
+
+def parse_csv_text(text: str, delimiter: str = ",",
+                   quote: str = '"') -> list[list[str]]:
+    """Tokenize a whole CSV text into rows of fields.
+
+    Quote-free text (the reference datasets) takes the allocation-light
+    split path; any quote routes through the stateful scanner so embedded
+    record separators parse correctly.
+    """
+    if quote and quote in text:
+        return _parse_quoted_text(text, delimiter, quote)
+    return [r.split(delimiter) for r in split_records(text)]
+
+
+def split_fields(record: str, delimiter: str = ",", quote: str = '"') -> list[str]:
+    """Tokenize one record with RFC-4180 quoting — a thin wrapper over the
+    same scanner :func:`parse_csv_text` uses (one quote state machine to
+    maintain, not two)."""
+    if quote not in record:
+        return record.split(delimiter)
+    rows = _parse_quoted_text(record, delimiter, quote)
+    return rows[0] if rows else [""]
 
 
 def _try_int(s: str) -> Optional[int]:
@@ -109,28 +157,47 @@ def infer_column(values: Sequence[str]):
                       dtype=object)
 
 
+_MODES = ("PERMISSIVE", "DROPMALFORMED", "FAILFAST")
+
+
 def read_csv(path: str, header: bool = False, infer_schema: bool = True,
-             delimiter: str = ",", engine: str = "auto") -> Frame:
+             delimiter: str = ",", engine: str = "auto",
+             quote: str = '"', mode: str = "PERMISSIVE") -> Frame:
     """Load a CSV file into a Frame.
 
     ``engine``: "python" (pure host parser), "native" (C++ tokenizer), or
     "auto" (native when the shared library is built and the column set is
     numeric-friendly, else python).
+
+    ``mode`` (Spark's malformed-record policy): ``PERMISSIVE`` (default —
+    short rows null-fill, long rows truncate), ``DROPMALFORMED`` (rows with
+    the wrong field count are dropped), ``FAILFAST`` (raise on the first
+    malformed row).
     """
+    mode = mode.upper()
+    if mode not in _MODES:
+        raise ValueError(f"mode={mode!r}; expected one of {_MODES}")
     if engine in ("auto", "native"):
         from . import native_csv
 
-        frame = native_csv.try_read_csv(path, header=header,
-                                        infer_schema=infer_schema,
-                                        delimiter=delimiter,
-                                        required=(engine == "native"))
-        if frame is not None:
-            return frame
+        if mode != "PERMISSIVE":
+            # native pads short rows NaN (permissive); exact drop/failfast
+            # field-count semantics live in the python engine
+            if engine == "native":
+                raise RuntimeError("native CSV engine supports "
+                                   "mode=PERMISSIVE only")
+        else:
+            frame = native_csv.try_read_csv(path, header=header,
+                                            infer_schema=infer_schema,
+                                            delimiter=delimiter,
+                                            quote=quote,
+                                            required=(engine == "native"))
+            if frame is not None:
+                return frame
 
     with open(path, "rb") as f:
         text = f.read().decode("utf-8")
-    records = split_records(text)
-    rows = [split_fields(r, delimiter) for r in records]
+    rows = parse_csv_text(text, delimiter, quote)
     if not rows:
         return Frame({})
 
@@ -141,6 +208,14 @@ def read_csv(path: str, header: bool = False, infer_schema: bool = True,
         names = [f"_c{i}" for i in range(len(rows[0]))]
 
     ncols = len(names)
+    if mode != "PERMISSIVE":
+        bad = [r for r in rows if len(r) != ncols]
+        if bad and mode == "FAILFAST":
+            raise ValueError(
+                f"FAILFAST: malformed CSV record (expected {ncols} fields, "
+                f"got {len(bad[0])}): {bad[0]!r}")
+        if bad:  # DROPMALFORMED
+            rows = [r for r in rows if len(r) == ncols]
     cols: list[list[str]] = [[] for _ in range(ncols)]
     for r in rows:
         for i in range(ncols):
@@ -193,6 +268,8 @@ class DataFrameReader:
             infer_schema=self._bool_opt("inferschema", False),
             delimiter=self._options.get("sep", self._options.get("delimiter", ",")),
             engine=self._options.get("engine", "auto"),
+            quote=self._options.get("quote", '"'),
+            mode=self._options.get("mode", "PERMISSIVE"),
         )
 
     def csv(self, path: str, header: bool = False, inferSchema: bool = False) -> Frame:

@@ -47,6 +47,7 @@ def _load():
             lib.dq_parse_numeric_csv.argtypes = [
                 ctypes.c_char_p,                      # path
                 ctypes.c_char,                        # delimiter
+                ctypes.c_char,                        # quote
                 ctypes.c_int,                         # skip_header
                 ctypes.POINTER(ctypes.POINTER(ctypes.c_double)),  # out data
                 ctypes.POINTER(ctypes.c_longlong),    # out ncols
@@ -64,7 +65,7 @@ def available() -> bool:
 
 
 def try_read_csv(path: str, header: bool, infer_schema: bool, delimiter: str,
-                 required: bool = False):
+                 quote: str = '"', required: bool = False):
     """Native read; returns a Frame or None (fallback to python engine)."""
     lib = _load()
     if lib is None:
@@ -73,8 +74,8 @@ def try_read_csv(path: str, header: bool, infer_schema: bool, delimiter: str,
                 "native CSV engine requested but native/libdqcsv.so is not "
                 "built (run `make -C native`)")
         return None
-    if len(delimiter) != 1:
-        return None
+    if len(delimiter.encode("utf-8")) != 1 or len(quote.encode("utf-8")) != 1:
+        return None  # ctypes c_char needs exactly one BYTE → python engine
     if not infer_schema or header:
         # Native fast path only covers the inferred all-numeric, headerless
         # shape (the reference's shape); let python handle the rest.
@@ -87,7 +88,8 @@ def try_read_csv(path: str, header: bool, infer_schema: bool, delimiter: str,
     ncols = ctypes.c_longlong(0)
     intf_p = ctypes.POINTER(ctypes.c_char)()
     nrows = lib.dq_parse_numeric_csv(
-        path.encode(), delimiter.encode(), 1 if header else 0,
+        path.encode(), delimiter.encode(), quote.encode(),
+        1 if header else 0,
         ctypes.byref(data_p), ctypes.byref(ncols), ctypes.byref(intf_p))
     if nrows < 0:
         if nrows == -2:
