@@ -31,10 +31,13 @@ def start(filename: str) -> None:
     spark.udf.register("minimumPriceRule", dq.minimum_price_rule, "double")
     spark.udf.register("priceCorrelationRule", dq.price_correlation_rule, "double")
 
+    def load_phase():
+        return (spark.read.format("csv")
+                .option("inferSchema", "true").option("header", "false")
+                .load(filename))
+
     with timer.phase("load"):
-        df = (spark.read.format("csv")
-              .option("inferSchema", "true").option("header", "false")
-              .load(filename))
+        df = load_phase()
 
     df = df.with_column_renamed("_c0", "guest")
     df = df.with_column_renamed("_c1", "price")
@@ -44,30 +47,36 @@ def start(filename: str) -> None:
     df.show()
     print("----")
 
+    def dq_phase(d, show=False):
+        d = d.with_column("price_no_min",
+                          dq.call_udf("minimumPriceRule", d.col("price")))
+        if show:
+            print("----")
+            print("1st DQ rule")
+            d.print_schema()
+            d.show(50)
+            print("----")
+
+        d.create_or_replace_temp_view("price")
+        d = spark.sql("SELECT cast(guest as int) guest, price_no_min AS price "
+                      "FROM price WHERE price_no_min > 0")
+        if show:
+            print("----")
+            print("1st DQ rule - clean-up")
+            d.print_schema()
+            d.show(50)
+            print("----")
+
+        d = d.with_column("price_correct_correl",
+                          dq.call_udf("priceCorrelationRule",
+                                      d.col("price"), d.col("guest")))
+        d.create_or_replace_temp_view("price")
+        return spark.sql("SELECT guest, price_correct_correl AS price "
+                         "FROM price WHERE price_correct_correl > 0")
+
+    df_loaded = df
     with timer.phase("dq_rules"):
-        df = df.with_column("price_no_min",
-                            dq.call_udf("minimumPriceRule", df.col("price")))
-        print("----")
-        print("1st DQ rule")
-        df.print_schema()
-        df.show(50)
-        print("----")
-
-        df.create_or_replace_temp_view("price")
-        df = spark.sql("SELECT cast(guest as int) guest, price_no_min AS price "
-                       "FROM price WHERE price_no_min > 0")
-        print("----")
-        print("1st DQ rule - clean-up")
-        df.print_schema()
-        df.show(50)
-        print("----")
-
-        df = df.with_column("price_correct_correl",
-                            dq.call_udf("priceCorrelationRule",
-                                        df.col("price"), df.col("guest")))
-        df.create_or_replace_temp_view("price")
-        df = spark.sql("SELECT guest, price_correct_correl AS price "
-                       "FROM price WHERE price_correct_correl > 0")
+        df = dq_phase(df_loaded, show=True)
 
     print("----")
     print("2nd DQ rule")
@@ -87,6 +96,15 @@ def start(filename: str) -> None:
 
     with timer.phase("fit"):
         model = lr.fit(df)
+
+    # Steady-state re-runs against the XLA compile cache (the cold numbers
+    # above are compile-dominated; conflating the two misleads). "fit" here
+    # is the full API call — it materializes the model, so it INCLUDES
+    # device→host fetches; bench.py reports the device-only dispatch figure.
+    timer.steady("load", load_phase, sync=lambda f: f.mask)
+    timer.steady("dq_rules", lambda: dq_phase(df_loaded),
+                 sync=lambda f: f.mask)
+    timer.steady("fit", lambda: lr.fit(df))
 
     model.transform(df).show()
 
@@ -109,7 +127,10 @@ def start(filename: str) -> None:
     p = model.predict(features)
     print(f"Prediction for {feature} guests is {p}")
 
-    print("phase wall-clock (s):", {k: round(v, 4) for k, v in timer.report().items()})
+    pairs = timer.report_pairs()
+    print("phase wall-clock (s, cold = first run incl. XLA compile):",
+          {k: {m: (round(v, 4) if v is not None else None)
+               for m, v in p.items()} for k, p in pairs.items()})
 
 
 if __name__ == "__main__":

@@ -28,10 +28,18 @@ def block_until_ready(tree):
 
 
 class PhaseTimer:
-    """Collects named phase durations; ``report()`` returns a dict."""
+    """Collects named phase durations; ``report()`` returns a dict.
+
+    A first (cold) run through a jitted phase is dominated by XLA
+    compilation; :meth:`steady` re-runs the phase against the compile
+    cache so :meth:`report_pairs` can show (cold, steady) side by side —
+    reading the cold number as throughput would be off by orders of
+    magnitude (bench.py measures the same split).
+    """
 
     def __init__(self):
         self.phases: dict[str, float] = {}
+        self.steadies: dict[str, float] = {}
 
     @contextlib.contextmanager
     def phase(self, name: str, sync=None):
@@ -45,8 +53,41 @@ class PhaseTimer:
             self.phases[name] = self.phases.get(name, 0.0) + dt
             logger.debug("phase %-20s %8.3f ms", name, dt * 1e3)
 
+    def steady(self, name: str, fn, reps: int = 3, sync=None):
+        """Median steady-state wall-clock of ``fn()`` over ``reps`` calls
+        (run it AFTER the cold :meth:`phase` so compiles are cached);
+        returns the last result.
+
+        ``jax.block_until_ready`` only syncs jax pytrees — an opaque object
+        (a Frame, a fitted model) passes through WITHOUT waiting for its
+        pending dispatch. Pass ``sync`` to extract a device array from the
+        result (e.g. ``lambda f: f.mask``) so the timing includes the async
+        work; syncing is never a host read (bench.py's hygiene rule)."""
+        times = []
+        out = None
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            out = fn()
+            jax.block_until_ready(sync(out) if sync is not None else out)
+            times.append(time.perf_counter() - t0)
+        times.sort()
+        self.steadies[name] = times[len(times) // 2]
+        logger.debug("steady %-19s %8.3f ms", name,
+                     self.steadies[name] * 1e3)
+        return out
+
     def report(self) -> dict[str, float]:
         return dict(self.phases)
+
+    def report_pairs(self) -> dict[str, dict[str, Optional[float]]]:
+        """{phase: {"cold": s|None, "steady": s|None}} — cold includes
+        compile. Steady-only names (no matching cold phase) are reported,
+        not dropped."""
+        names = list(self.phases) + [n for n in self.steadies
+                                     if n not in self.phases]
+        return {name: {"cold": self.phases.get(name),
+                       "steady": self.steadies.get(name)}
+                for name in names}
 
 
 @contextlib.contextmanager

@@ -1,0 +1,46 @@
+"""PhaseTimer: cold/steady split (VERDICT r2 item 8) — the compile-vs-run
+observability hygiene bench.py applies, at pipeline level."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from sparkdq4ml_tpu.utils.profiling import PhaseTimer
+
+
+class TestPhaseTimer:
+    def test_cold_and_steady_pair(self):
+        t = PhaseTimer()
+        with t.phase("work"):
+            x = jnp.ones((8,)) * 2
+        out = t.steady("work", lambda: jnp.ones((8,)) * 2)
+        pairs = t.report_pairs()
+        assert pairs["work"]["cold"] is not None
+        assert pairs["work"]["steady"] is not None
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(x))
+
+    def test_sync_extractor_used(self):
+        calls = []
+
+        class Opaque:
+            arr = jnp.ones((4,))
+
+        t = PhaseTimer()
+        t.steady("op", lambda: Opaque(),
+                 sync=lambda o: calls.append(1) or o.arr, reps=2)
+        assert len(calls) == 2
+        assert "op" in t.report_pairs()       # steady-only name reported
+
+    def test_steady_only_name_not_dropped(self):
+        t = PhaseTimer()
+        with t.phase("a"):
+            pass
+        t.steady("b", lambda: jnp.zeros((2,)))
+        pairs = t.report_pairs()
+        assert pairs["a"]["steady"] is None
+        assert pairs["b"]["cold"] is None and pairs["b"]["steady"] is not None
+
+    def test_report_backwards_compatible(self):
+        t = PhaseTimer()
+        with t.phase("x"):
+            pass
+        assert isinstance(t.report()["x"], float)
