@@ -1,33 +1,44 @@
 """Benchmark harness (BASELINE.md / BASELINE.json target).
 
-Measures the LinearRegression fit wall-clock on ``dataset-full.csv`` (the
-reference's Lasso config: maxIter=40, regParam=1, elasticNetParam=1) on the
-available accelerator, against a **measured CPU baseline**: scikit-learn's
-coordinate-descent Lasso on the same standardized problem, fit in-process.
+Covers the five BASELINE.json configs plus a synthetic scale sweep:
 
-The reference publishes no numbers (SURVEY.md §6); a Spark-CPU run is not
-possible here (no JVM), so sklearn-CPU is the conservative proxy — it is a
-C-optimized solver *without* Spark's per-iteration RPC barriers, JVM boxing,
-or task-scheduling overhead, i.e. a strictly faster baseline than the Spark
-stack it stands in for. ``vs_baseline`` = baseline_seconds / tpu_seconds
-(speedup; target ≥10× per BASELINE.json).
+(a/b) LinearRegression Lasso fit on dataset-full.csv (the headline metric:
+      maxIter=40, regParam=1, elasticNetParam=1; single-chip mesh = config a,
+      the same packed psum path sharded = config b, exercised in CI and the
+      multichip dryrun),
+(c)   elastic-net general path (FISTA, regParam=0.3, elasticNetParam=0.5),
+(d)   LogisticRegression on the DQ-filtered rows (per-iteration-psum loop),
+(e)   CrossValidator grid (regParam × elasticNetParam, grid-parallel cell
+      sharding) vs sklearn GridSearchCV — run in a SUBPROCESS so its
+      internal host reads can't poison this process's dispatch mode,
+(sweep) the masked-Gramian data pass at n ∈ {1e5, 1e6, 1e7} × d ∈ {16, 128,
+      512} (HBM-bounded subset), XLA vs compiled Pallas, with on-device
+      numerics assertions — the MXU/HBM throughput story behind every fit.
 
-Also verifies the ≤1% RMSE-drift acceptance criterion before reporting.
+Baselines are **measured CPU** stand-ins (sklearn / numpy, documented per
+config): the reference publishes no numbers (SURVEY.md §6) and no JVM is
+available, so sklearn-CPU — a C-optimized solver without Spark's RPC
+barriers — is a strictly faster proxy than the Spark stack it stands in
+for. ``vs_baseline`` = baseline_seconds / device_seconds.
 
-Prints exactly ONE JSON line on stdout; diagnostics go to stderr.
+Prints exactly ONE JSON line on stdout (driver contract); the per-config
+results, sweep table, and pallas-vs-XLA table ride inside it. Per-config
+lines are echoed to stderr for human reading.
 
-Measurement hygiene: on the axon-tunneled TPU in this environment, the FIRST
-device→host data fetch (``int()``/``float()``/``np.asarray`` on a device
-array) permanently switches the process into a synchronous dispatch mode
-(~67 ms/call floor afterwards; measured — ``block_until_ready`` alone does
-not trigger it). All timing therefore happens BEFORE any host read: warm-up
-and the timing loop use only ``block_until_ready``; row counts, RMSE checks,
-and result fetches run after the loop.
+Measurement hygiene: on the axon-tunneled TPU the FIRST device→host fetch
+(``int()``/``float()``/``np.asarray`` on a device array) permanently
+switches the process into a synchronous dispatch mode (~67 ms/call floor
+afterwards; measured — ``block_until_ready`` alone does not trigger it).
+ALL timing loops therefore run before ANY host read: device results and
+on-device diff scalars are collected, and only after the last timing loop
+does the host read anything. Data for the sweep is generated ON DEVICE
+(jax.random) so multi-GB operands never cross the tunnel.
 """
 
 import json
 import os
 import statistics
+import subprocess
 import sys
 import time
 
@@ -35,19 +46,50 @@ REPO = os.path.dirname(os.path.abspath(__file__))
 sys.path.insert(0, REPO)
 
 GOLDEN_RMSE_FULL = 1.805140  # SURVEY.md §2.3, dataset-full Lasso
-REPS = 30
+# BENCH_SMOKE=1: tiny sweep + few reps, for CI validation of the harness
+# itself on CPU (real numbers come from the TPU run).
+SMOKE = os.environ.get("BENCH_SMOKE") == "1"
+REPS = 3 if SMOKE else 30
+SWEEP_REPS = 2 if SMOKE else 5
+# (rows, features) — sizes chosen to fit v5e HBM (16 GB) with headroom;
+# the 1e7×128 / 1e7×512 cells would be 5–20 GB and are deliberately absent
+# (documented cap, not silent truncation).
+SWEEP_SHAPES = [(100_000, 16), (100_000, 128)] if SMOKE else \
+    [(100_000, 16), (1_000_000, 16), (10_000_000, 16),
+     (100_000, 128), (1_000_000, 128), (1_000_000, 512)]
+CPU_SWEEP_SHAPES = {(100_000, 16), (1_000_000, 16), (100_000, 128)}
 
 
 def log(*args):
     print(*args, file=sys.stderr, flush=True)
 
 
+def make_median_time(jax):
+    """Timing loop: each rep blocks on ITS OWN ``fn()`` result — blocking on
+    a stale array measures only async dispatch enqueue (µs), not the
+    computation. Opaque (non-pytree) results pass through block_until_ready
+    untouched, which is correct for the synchronous CPU baselines."""
+    def median_time(fn, reps):
+        jax.block_until_ready(fn())   # warm: compile cached after
+        times = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            jax.block_until_ready(fn())
+            times.append(time.perf_counter() - t0)
+        return statistics.median(times)
+    return median_time
+
+
 def main():
     import jax
+    import jax.numpy as jnp
     import numpy as np
 
     import sparkdq4ml_tpu as dq
-    from sparkdq4ml_tpu.models import LinearRegression, VectorAssembler
+    from sparkdq4ml_tpu.config import config
+    from sparkdq4ml_tpu.models import VectorAssembler
+    from sparkdq4ml_tpu.models.classification import fused_logistic_fit_packed
+    from sparkdq4ml_tpu.ops import pallas_kernels
     from sparkdq4ml_tpu.parallel.distributed import (fused_linear_fit_packed,
                                                      pack_design, place_packed,
                                                      unpack_fit_result)
@@ -55,8 +97,9 @@ def main():
     path = os.path.join(REPO, "data", "dataset-full.csv")
     session = dq.TpuSession.builder().app_name("bench").master("local[*]").get_or_create()
     log(f"devices: {jax.devices()}")
+    backend = jax.default_backend()
 
-    # DQ pipeline (not benchmarked here; the fit is the BASELINE.json metric)
+    # ---- build the DQ-cleaned frame (no host reads of device arrays) ----
     dq.register_builtin_rules()
     df = (session.read.format("csv").option("inferSchema", "true")
           .option("header", "false").load(path))
@@ -73,44 +116,88 @@ def main():
     df = df.with_column("label", df.col("price"))
     df = VectorAssembler(["guest"], "features").transform(df)
 
-    import jax.numpy as jnp
-
-    # Device arrays throughout — no np.asarray before timing (host-read trap).
     X = jnp.asarray(df._column_values("features"))
     y = jnp.asarray(df._column_values("label"))
     mask = df.mask
-
-    # --- accelerator fit: ONE jitted program (packed Gramian + FISTA loop),
-    # the same fused packed path LinearRegression.fit dispatches: one input
-    # buffer, one output buffer (per-buffer dispatch cost dominates this
-    # problem size — see pack_design). NO device→host fetch may happen
-    # before/inside the loop (see module docstring); block_until_ready syncs
-    # without reading.
     mesh = None if session.mesh.devices.size <= 1 else session.mesh
-    fit_fn = fused_linear_fit_packed(mesh, "fista", 40, 1e-6, True, True)
     Zd = place_packed(pack_design(X, y, mask), mesh)
-    hyper = jnp.asarray([1.0, 1.0], Zd.dtype)
 
-    def device_fit():
-        return fit_fn(Zd, hyper)
+    # =====================================================================
+    # PHASE 1 — every device timing loop, before ANY device→host read
+    # =====================================================================
 
-    result = jax.block_until_ready(device_fit())   # compile (excluded; cached after)
-    times = []
-    for _ in range(REPS):
-        t0 = time.perf_counter()
-        result = jax.block_until_ready(device_fit())
-        times.append(time.perf_counter() - t0)
-    tpu_s = statistics.median(times)
+    median_time = make_median_time(jax)
 
-    # ---- timing done; host reads are safe from here on --------------------
+    # (a) headline: Lasso fit, one packed dispatch
+    fit_a = fused_linear_fit_packed(mesh, "fista", 40, 1e-6, True, True)
+    hyper_a = jnp.asarray([1.0, 1.0], Zd.dtype)
+    result_a = jax.block_until_ready(fit_a(Zd, hyper_a))
+    t_a = median_time(lambda: fit_a(Zd, hyper_a), REPS)
+
+    # (c) elastic-net general path (FISTA, mixed penalty, 100 iters)
+    fit_c = fused_linear_fit_packed(mesh, "fista", 100, 1e-6, True, True)
+    hyper_c = jnp.asarray([0.3, 0.5], Zd.dtype)
+    t_c = median_time(lambda: fit_c(Zd, hyper_c), REPS)
+
+    # (d) logistic on DQ rows: per-iteration psum FISTA loop
+    yb = (y > jnp.median(y)).astype(Zd.dtype)   # device-side label build
+    Zb = place_packed(pack_design(X, yb, mask), mesh)
+    fit_d = fused_logistic_fit_packed(mesh, 100, 1e-6, True, True)
+    hyper_d = jnp.asarray([0.01, 0.0], Zd.dtype)
+    t_d = median_time(lambda: fit_d(Zb, hyper_d), REPS)
+
+    # (sweep) masked-Gramian pass: XLA vs compiled Pallas, data on device
+    @jax.jit
+    def xla_gram(Z):
+        return Z.T @ Z
+
+    sweep_rows = []        # timings (host floats, no device reads)
+    pallas_diffs = []      # on-device |A_p - A_x| max scalars, read later
+    pallas_mode = "on" if backend == "tpu" else "interpret"
+    for (n, d) in SWEEP_SHAPES:
+        key = jax.random.PRNGKey(n + d)
+        Z = jax.random.normal(key, (n, d + 2), jnp.float32)
+        Z = jax.block_until_ready(Z)
+        gb = n * (d + 2) * 4 / 1e9
+
+        t_x = median_time(lambda: xla_gram(Z), SWEEP_REPS)
+
+        config.pallas = pallas_mode
+        try:
+            A_p = pallas_kernels.packed_gram_pallas(Z)
+            if backend == "tpu":
+                t_p = median_time(
+                    lambda: pallas_kernels.packed_gram_pallas(Z),
+                    SWEEP_REPS)
+            else:
+                t_p = None  # interpreter timing is meaningless
+            A_x = xla_gram(Z)
+            scale = jnp.maximum(jnp.max(jnp.abs(A_x)), 1.0)
+            pallas_diffs.append(
+                ((n, d), jnp.max(jnp.abs(A_p - A_x)) / scale))
+        finally:
+            config.pallas = "off"
+
+        sweep_rows.append({
+            "rows": n, "features": d,
+            "xla_ms": round(t_x * 1e3, 3),
+            "xla_gbps": round(gb / t_x, 1),
+            "pallas_ms": round(t_p * 1e3, 3) if t_p else None,
+            "pallas_gbps": round(gb / t_p, 1) if t_p else None,
+        })
+        del Z
+
+    # =====================================================================
+    # PHASE 2 — host reads, CPU baselines, assertions
+    # =====================================================================
     n_rows = df.count()
     log(f"DQ-clean rows: {n_rows} (expect 1024)")
-    result = unpack_fit_result(result, X.shape[1] if X.ndim > 1 else 1)
+    result = unpack_fit_result(result_a, 1)
     coef = float(result.coefficients[0])
     intercept = float(result.intercept)
-    d = df.to_pydict()
-    yv = d["label"].astype(np.float64)
-    xv = d["guest"].astype(np.float64)
+    d_host = df.to_pydict()
+    yv = d_host["label"].astype(np.float64)
+    xv = d_host["guest"].astype(np.float64)
     rmse = float(np.sqrt(np.mean((yv - (coef * xv + intercept)) ** 2)))
     drift = abs(rmse - GOLDEN_RMSE_FULL) / GOLDEN_RMSE_FULL
     log(f"fit: coef={coef:.6f} intercept={intercept:.6f} rmse={rmse:.6f} "
@@ -119,48 +206,115 @@ def main():
         log("ERROR: RMSE drift exceeds the 1% acceptance budget")
         sys.exit(1)
 
-    # --- CPU baseline: sklearn coordinate-descent Lasso on the same problem
-    Xh = np.asarray(d["guest"], np.float64).reshape(-1, 1)
-    yh = yv
-    sx, sy = Xh.std(ddof=1), yh.std(ddof=1)
+    # pallas numerics: assert before reporting any pallas number
+    for (shape, diff_dev) in pallas_diffs:
+        diff = float(diff_dev)
+        log(f"pallas-vs-xla rel diff @ {shape}: {diff:.2e}")
+        if not diff < 5e-5:
+            log(f"ERROR: pallas Gramian diverges from XLA at {shape}")
+            sys.exit(1)
+
+    # CPU baselines --------------------------------------------------------
+    # sklearn is a strictly faster Spark-CPU proxy; without it, a pure-numpy
+    # ISTA stands in for (a) and c/d report no baseline rather than dying
+    # (the driver contract — one JSON line — must survive a missing dep).
+    Xh = xv.reshape(-1, 1)
+    sx, sy = Xh.std(ddof=1), yv.std(ddof=1)
     Xs = (Xh - Xh.mean()) / sx
-    ys = (yh - yh.mean()) / sy
+    ys = (yv - yv.mean()) / sy
+    yb_h = (yv > np.median(yv)).astype(np.float64)
+
     try:
-        from sklearn.linear_model import Lasso
+        from sklearn.linear_model import (ElasticNet, Lasso,
+                                          LogisticRegression as SkLogit)
+        have_sklearn = True
+    except ImportError:
+        have_sklearn = False
 
-        def cpu_fit():
-            Lasso(alpha=1.0 / sy, max_iter=40, tol=1e-6).fit(Xs, ys)
+    if have_sklearn:
+        base_a = "sklearn Lasso(cd) maxIter=40"
+        t_a_cpu = median_time(
+            lambda: Lasso(alpha=1.0 / sy, max_iter=40, tol=1e-6).fit(Xs, ys),
+            REPS)
+        t_c_cpu = median_time(
+            lambda: ElasticNet(alpha=0.3 / sy, l1_ratio=0.5, max_iter=100,
+                               tol=1e-6).fit(Xs, ys), REPS)
+        t_d_cpu = median_time(
+            lambda: SkLogit(C=100.0, max_iter=100, tol=1e-6).fit(Xs, yb_h),
+            REPS)
+    else:
+        base_a = "numpy ISTA maxIter=40"
 
-        baseline_name = "sklearn-cpu Lasso(cd)"
-    except ImportError:  # pure-numpy ISTA fallback
-        def cpu_fit():
+        def ista():
             w = 0.0
             h = float(Xs[:, 0] @ Xs[:, 0]) / len(ys)
-            c = float(Xs[:, 0] @ ys) / len(ys)
+            c0 = float(Xs[:, 0] @ ys) / len(ys)
             lam = 1.0 / sy
             for _ in range(40):
-                g = h * w - c
+                g = h * w - c0
                 w = np.sign(w - g / h) * max(abs(w - g / h) - lam / h, 0.0)
 
-        baseline_name = "numpy ISTA"
+        t_a_cpu = median_time(ista, REPS)
+        t_c_cpu = t_d_cpu = None
 
-    cpu_fit()  # warm-up
-    cpu_times = []
-    for _ in range(REPS):
-        t0 = time.perf_counter()
-        cpu_fit()
-        cpu_times.append(time.perf_counter() - t0)
-    cpu_s = statistics.median(cpu_times)
+    # CPU gram GB/s context for the sweep's smaller cells
+    for row in sweep_rows:
+        shape = (row["rows"], row["features"])
+        if shape in CPU_SWEEP_SHAPES:
+            rng = np.random.default_rng(0)
+            Zc = rng.standard_normal((shape[0], shape[1] + 2),
+                                     dtype=np.float32)
+            t_cpu = median_time(lambda: Zc.T @ Zc, SWEEP_REPS)
+            row["cpu_gbps"] = round(
+                shape[0] * (shape[1] + 2) * 4 / 1e9 / t_cpu, 1)
 
-    speedup = cpu_s / tpu_s
-    log(f"device fit: {tpu_s*1e3:.3f} ms | baseline ({baseline_name}): "
-        f"{cpu_s*1e3:.3f} ms | speedup {speedup:.2f}x")
+    # (e) CrossValidator grid — fresh subprocess (see module docstring)
+    cv_result = None
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "bench_cv.py")],
+            capture_output=True, text=True, timeout=1200,
+            cwd=REPO)
+        if proc.returncode == 0 and proc.stdout.strip():
+            cv_result = json.loads(proc.stdout.strip().splitlines()[-1])
+        else:
+            log(f"config e (CV) failed rc={proc.returncode}: "
+                f"{proc.stderr[-500:]}")
+    except (OSError, subprocess.SubprocessError, ValueError) as e:
+        log(f"config e (CV) skipped: {e}")
+
+    # =====================================================================
+    # PHASE 3 — report
+    # =====================================================================
+    def cfg(name, t_dev, baseline_name, t_cpu):
+        return {"config": name, "device_ms": round(t_dev * 1e3, 4),
+                "baseline": baseline_name if t_cpu else "unavailable",
+                "baseline_ms": round(t_cpu * 1e3, 4) if t_cpu else None,
+                "vs_baseline": round(t_cpu / t_dev, 2) if t_cpu else None}
+
+    configs = [
+        cfg("a_linear_lasso_dataset_full", t_a, base_a, t_a_cpu),
+        cfg("c_elasticnet_fista_path", t_c,
+            "sklearn ElasticNet(cd) maxIter=100", t_c_cpu),
+        cfg("d_logistic_dq_rows", t_d,
+            "sklearn LogisticRegression(lbfgs) maxIter=100", t_d_cpu),
+    ]
+    if cv_result:
+        configs.append(cv_result)
+    for c in configs:
+        log(json.dumps(c))
+    for row in sweep_rows:
+        log(json.dumps(row))
 
     print(json.dumps({
         "metric": "linear_regression_fit_wallclock_dataset_full",
-        "value": round(tpu_s * 1e3, 4),
+        "value": round(t_a * 1e3, 4),
         "unit": "ms",
-        "vs_baseline": round(speedup, 3),
+        "vs_baseline": round(t_a_cpu / t_a, 3),
+        "configs": configs,
+        "sweep": sweep_rows,
+        "pallas_max_rel_diff": max(float(d) for _, d in pallas_diffs),
+        "backend": backend,
     }))
 
 
