@@ -880,15 +880,40 @@ class LogisticRegressionTrainingSummary(LogisticRegressionSummary):
 # NaiveBayes (MLlib org.apache.spark.ml.classification.NaiveBayes)
 # ---------------------------------------------------------------------------
 
-@jax.jit
-def _nb_sufficient_stats(X, y, w, num_classes_onehot):
+def _nb_sufficient_stats(X, y, w, num_classes: int, psum_axis=None):
     """Per-class label counts and feature sums — one masked one-hot matmul
-    (MXU), the whole NaiveBayes 'fit pass' in a single fused kernel."""
-    onehot = jax.nn.one_hot(y.astype(jnp.int32), num_classes_onehot.shape[0],
+    (MXU), the whole NaiveBayes 'fit pass' in a single fused kernel.
+    ``psum_axis`` reduces the (k,) + (k, d) statistics over the mesh's
+    data axis (the treeAggregate analogue, SURVEY.md §3.3)."""
+    onehot = jax.nn.one_hot(y.astype(jnp.int32), num_classes,
                             dtype=X.dtype) * w[:, None]    # (n, k)
     class_count = jnp.sum(onehot, axis=0)                  # (k,)
     feat_sum = onehot.T @ X                                # (k, d)
+    if psum_axis is not None:
+        class_count, feat_sum = jax.lax.psum((class_count, feat_sum),
+                                             psum_axis)
     return class_count, feat_sum
+
+
+@functools.lru_cache(maxsize=None)
+def _nb_stats_fn(mesh, num_classes: int):
+    """Jitted (and, under a mesh, shard_map'd) NaiveBayes statistics pass,
+    cached per (mesh, k)."""
+    if mesh is None:
+        # close over num_classes — jit would trace a partial-bound int
+        return jax.jit(
+            lambda X, y, w: _nb_sufficient_stats(X, y, w, num_classes))
+
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.mesh import DATA_AXIS
+
+    return jax.jit(jax.shard_map(
+        lambda X, y, w: _nb_sufficient_stats(X, y, w, num_classes,
+                                             DATA_AXIS),
+        mesh=mesh,
+        in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(DATA_AXIS)),
+        out_specs=(P(), P())))
 
 
 @persistable
@@ -951,7 +976,10 @@ class NaiveBayes(Estimator):
 
     setLabelCol = set_label_col
 
-    def fit(self, frame: Frame) -> "NaiveBayesModel":
+    def fit(self, frame: Frame, mesh=None) -> "NaiveBayesModel":
+        from ..parallel.mesh import normalize_mesh
+
+        mesh = normalize_mesh(mesh)
         dt = np.dtype(float_dtype())
         X = np.asarray(frame._column_values(self.features_col), dt)
         if X.ndim == 1:
@@ -973,11 +1001,16 @@ class NaiveBayes(Estimator):
             if not np.all((Xv == 0) | (Xv == 1)):
                 raise ValueError("bernoulli NaiveBayes requires 0/1 features")
 
-        Xd = jnp.asarray(X) if self.model_type == "multinomial" \
-            else jnp.asarray((X > 0).astype(dt))
-        w = frame.mask.astype(Xd.dtype)
-        class_count, feat_sum = _nb_sufficient_stats(
-            Xd, jnp.asarray(y), w, jnp.zeros((num_classes,)))
+        from ..parallel.distributed import pad_and_shard_rows
+
+        Xh = X if self.model_type == "multinomial" else (X > 0).astype(dt)
+        # masked slots may hold NaN features/labels (dropna/filter keep
+        # values in place); zero them — 0-weight × NaN would still poison
+        # the stats matmul (0 * NaN = NaN)
+        Xh = np.where(mask[:, None], Xh, 0.0)
+        yh = np.where(mask, y, 0.0)
+        Xd, yd, wd = pad_and_shard_rows(mesh, Xh, yh, mask.astype(dt))
+        class_count, feat_sum = _nb_stats_fn(mesh, num_classes)(Xd, yd, wd)
         class_count = np.asarray(class_count, np.float64)
         feat_sum = np.asarray(feat_sum, np.float64)
         lam = self.smoothing

@@ -78,6 +78,45 @@ def _vec_frame(n=157, d=4, seed=0):
     return f.filter(np.asarray(rng.random(n) > 0.1))
 
 
+class TestDistributedNaiveBayes:
+    @pytest.mark.parametrize("model_type", ["multinomial", "bernoulli"])
+    def test_sharded_equals_single(self, model_type):
+        from sparkdq4ml_tpu.models import NaiveBayes
+
+        rng = np.random.default_rng(11)
+        n = 173
+        if model_type == "multinomial":
+            X = rng.integers(0, 6, size=(n, 5)).astype(np.float64)
+        else:
+            X = (rng.random((n, 5)) > 0.5).astype(np.float64)
+        y = rng.integers(0, 3, size=n).astype(np.float64)
+        cols = {f"x{j}": X[:, j] for j in range(5)}
+        cols["label"] = y
+        f = VectorAssembler([f"x{j}" for j in range(5)],
+                            "features").transform(Frame(cols))
+        f = f.filter(np.asarray(rng.random(n) > 0.1))
+        nb = NaiveBayes(model_type=model_type)
+        single = nb.fit(f)
+        sharded = nb.fit(f, mesh=make_mesh(8))
+        np.testing.assert_allclose(sharded.pi, single.pi, rtol=1e-12)
+        np.testing.assert_allclose(sharded.theta, single.theta, rtol=1e-12)
+
+    def test_nan_feature_in_masked_row_ignored(self):
+        from sparkdq4ml_tpu.models import NaiveBayes
+
+        X = np.abs(np.arange(16, dtype=np.float64)).reshape(8, 2)
+        X[2, 1] = np.nan
+        cols = {"x0": X[:, 0], "x1": X[:, 1],
+                "label": np.asarray([0, 1] * 4, np.float64)}
+        f = VectorAssembler(["x0", "x1"], "features").transform(Frame(cols))
+        keep = np.ones(8, bool)
+        keep[2] = False
+        f = f.filter(keep)
+        model = NaiveBayes().fit(f)
+        assert np.all(np.isfinite(model.theta))
+        assert np.all(np.isfinite(model.pi))
+
+
 class TestDistributedStat:
     def test_correlation_sharded_equals_single(self):
         f = _vec_frame()
