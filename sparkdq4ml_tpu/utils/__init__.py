@@ -1,2 +1,3 @@
 from .logging import configure_logging
 from .profiling import PhaseTimer, block_until_ready, timed, trace
+from .recovery import FitFailure, check_finite, fit_or_resume, retry

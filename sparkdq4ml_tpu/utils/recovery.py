@@ -1,0 +1,153 @@
+"""Failure detection & recovery (SURVEY.md §5 "Failure detection / elastic
+recovery").
+
+The reference inherits Spark's recovery model — task retry, lineage
+recomputation, checkpoint dirs — but configures none of it (``local[*]``,
+no checkpoint dir, `DataQuality4MachineLearningApp.java:38-41`). The
+TPU-native equivalents of those three primitives:
+
+* **Detection** — :func:`check_finite` inspects a result pytree for
+  NaN/Inf (a diverged solver, a flaky interconnect transfer); the global
+  NaN traps in ``utils.debug`` localize the producing op when needed.
+  Device-side faults (OOM, interconnect resets, preempted tunnels)
+  surface as ``XlaRuntimeError`` and are caught by :func:`retry`.
+* **Deterministic re-execution (lineage)** — every fit in this framework
+  is a pure function of (frame, params, seed), so a failed task re-runs
+  identically; :func:`retry` is the task-retry loop
+  (``spark.task.maxFailures`` analogue).
+* **Checkpointing** — :func:`fit_or_resume` persists the fitted stage via
+  the models/base persistence layer and resumes from the artifact after a
+  driver crash/preemption instead of refitting (the checkpoint-dir
+  analogue).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Callable, Optional
+
+import jax
+import numpy as np
+
+logger = logging.getLogger("sparkdq4ml_tpu.recovery")
+
+
+class FitFailure(RuntimeError):
+    """A computation failed (non-finite result or device error) and did not
+    recover within the configured retries."""
+
+
+def check_finite(tree, _seen=None) -> bool:
+    """True when every inexact array leaf in ``tree`` is fully finite.
+
+    Works on device arrays, numpy arrays, fitted models (via their
+    ``_persist_attrs`` when declared, else their instance ``__dict__`` —
+    models with custom persistence must not silently pass), and arbitrary
+    pytrees; non-numeric leaves pass. Cycles are guarded.
+    """
+    if _seen is None:
+        _seen = set()
+    if id(tree) in _seen:
+        return True
+    _seen.add(id(tree))
+
+    attrs = getattr(tree, "_persist_attrs", None)
+    if attrs is not None:
+        return all(check_finite(getattr(tree, a, None), _seen)
+                   for a in attrs)
+    leaves = jax.tree_util.tree_leaves(tree)
+    if len(leaves) == 1 and leaves[0] is tree \
+            and not isinstance(tree, (jax.Array, np.ndarray, float,
+                                      np.floating)) \
+            and hasattr(tree, "__dict__"):
+        # tree itself is one opaque leaf (a model object): scan its public
+        # attributes directly
+        return check_finite({k: v for k, v in vars(tree).items()
+                             if not k.startswith("_")}, _seen)
+    for leaf in leaves:
+        if isinstance(leaf, (jax.Array, np.ndarray, float, np.floating)):
+            arr = np.asarray(leaf)
+            if np.issubdtype(arr.dtype, np.inexact) \
+                    and not np.all(np.isfinite(arr)):
+                return False
+        elif hasattr(leaf, "__dict__") and id(leaf) not in _seen:
+            # opaque object leaf (e.g. a model with custom save()): scan
+            # its PUBLIC instance attributes instead of passing it blindly.
+            # Private attrs are skipped — e.g. a model's _summary_source
+            # frame legitimately carries NaN in masked slots.
+            _seen.add(id(leaf))
+            public = {k: v for k, v in vars(leaf).items()
+                      if not k.startswith("_")}
+            if not check_finite(public, _seen):
+                return False
+    return True
+
+
+def retry(fn: Callable, retries: int = 3,
+          validate: Callable = check_finite,
+          on_failure: Optional[Callable] = None):
+    """Run ``fn()`` with detection + deterministic re-execution.
+
+    A device-side fault (``XlaRuntimeError``) or a result failing
+    ``validate`` triggers a re-run, up to ``retries`` attempts total;
+    ``on_failure(attempt, error_or_none)`` runs between attempts (e.g. to
+    clear caches or re-seed). Raises :class:`FitFailure` when exhausted.
+    """
+    if retries < 1:
+        raise ValueError("retries must be >= 1")
+    last_err = None
+    for attempt in range(1, retries + 1):
+        try:
+            out = fn()
+        except jax.errors.JaxRuntimeError as e:   # XlaRuntimeError subclass
+            last_err = e
+            logger.warning("attempt %d/%d failed with device error: %s",
+                           attempt, retries, e)
+        else:
+            if validate is None or validate(out):
+                return out
+            last_err = None
+            logger.warning("attempt %d/%d produced non-finite results",
+                           attempt, retries)
+        if on_failure is not None:
+            on_failure(attempt, last_err)
+    raise FitFailure(
+        f"computation failed after {retries} attempts"
+        + (f": {last_err}" if last_err is not None else " (non-finite)"))
+
+
+def fit_or_resume(estimator, frame, checkpoint_dir: str, mesh=None,
+                  retries: int = 1):
+    """Fit with a persistent checkpoint: if ``checkpoint_dir`` already holds
+    a saved stage, load and return it WITHOUT refitting (crash/preemption
+    resume); otherwise fit (with :func:`retry` semantics when
+    ``retries > 1``), save, and return the model.
+    """
+    import inspect
+    import shutil
+
+    from ..models.base import load_stage, save_stage
+
+    if os.path.exists(os.path.join(checkpoint_dir, "stage.json")) or \
+            os.path.exists(os.path.join(checkpoint_dir, "metadata.json")):
+        logger.info("resuming fitted stage from %s", checkpoint_dir)
+        return load_stage(checkpoint_dir)
+
+    takes_mesh = "mesh" in inspect.signature(estimator.fit).parameters
+
+    def do_fit():
+        if takes_mesh:
+            return estimator.fit(frame, mesh=mesh)
+        return estimator.fit(frame)
+
+    model = retry(do_fit, retries=retries)
+    # Atomic checkpoint: write to a sibling tmp dir, then one rename —
+    # a crash mid-save (the scenario this module exists for) must never
+    # leave a half-written dir that the resume branch would pick up.
+    tmp = checkpoint_dir.rstrip("/\\") + ".tmp"
+    shutil.rmtree(tmp, ignore_errors=True)
+    save_stage(model, tmp)
+    shutil.rmtree(checkpoint_dir, ignore_errors=True)
+    os.rename(tmp, checkpoint_dir)
+    return model

@@ -1,0 +1,129 @@
+"""Failure detection / recovery (SURVEY.md §5): detection via finiteness
+checks, deterministic task retry, checkpoint-resume on the persistence
+layer — the Spark task-retry / checkpoint-dir analogues."""
+
+import numpy as np
+import pytest
+
+from sparkdq4ml_tpu import Frame
+from sparkdq4ml_tpu.models import LinearRegression, VectorAssembler
+from sparkdq4ml_tpu.utils.recovery import (FitFailure, check_finite,
+                                           fit_or_resume, retry)
+
+
+def _frame(n=64, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=n)
+    cols = {"x": x, "label": 3 * x + 1 + 0.01 * rng.normal(size=n)}
+    return VectorAssembler(["x"], "features").transform(Frame(cols))
+
+
+class TestCheckFinite:
+    def test_finite_pytree(self):
+        assert check_finite({"a": np.ones(3), "b": 1.5})
+
+    def test_nan_leaf_detected(self):
+        assert not check_finite({"a": np.asarray([1.0, np.nan])})
+        assert not check_finite([np.inf])
+
+    def test_non_numeric_leaves_pass(self):
+        assert check_finite({"name": "x", "n": 3})
+
+    def test_fitted_model(self):
+        model = LinearRegression(max_iter=5).fit(_frame())
+        assert check_finite(model)
+
+    def test_diverged_model_detected(self):
+        """Models without _persist_attrs (custom save) must not pass
+        blindly: a NaN coefficient is a detected failure."""
+        from sparkdq4ml_tpu.models.regression import LinearRegressionModel
+
+        bad = LinearRegressionModel(np.asarray([np.nan]), 1.0)
+        assert not check_finite(bad)
+        good = LinearRegressionModel(np.asarray([2.0]), 1.0)
+        assert check_finite(good)
+
+    def test_private_frame_refs_ignored(self):
+        """A model's private references (e.g. the training frame, which
+        holds NaN in masked slots) must not trip detection."""
+        f = _frame()
+        model = LinearRegression(max_iter=5).fit(f)
+        model._summary_source = ({"x": np.asarray([np.nan])}, None)
+        assert check_finite(model)
+
+
+class TestRetry:
+    def test_succeeds_after_transient_failure(self):
+        calls = {"n": 0}
+
+        def flaky():
+            calls["n"] += 1
+            if calls["n"] < 3:
+                return np.asarray([np.nan])   # diverged result
+            return np.asarray([1.0])
+
+        out = retry(flaky, retries=3)
+        assert calls["n"] == 3 and np.isfinite(out).all()
+
+    def test_exhausted_raises_fit_failure(self):
+        with pytest.raises(FitFailure):
+            retry(lambda: np.asarray([np.nan]), retries=2)
+
+    def test_on_failure_hook_called(self):
+        seen = []
+        with pytest.raises(FitFailure):
+            retry(lambda: np.asarray([np.nan]), retries=2,
+                  on_failure=lambda attempt, err: seen.append(attempt))
+        assert seen == [1, 2]
+
+    def test_validate_none_returns_first(self):
+        assert retry(lambda: "anything", validate=None) == "anything"
+
+
+class TestFitOrResume:
+    def test_partial_checkpoint_refits(self, tmp_path):
+        """A half-written checkpoint (no stage.json/metadata.json marker)
+        must refit, and the atomic save replaces it."""
+        path = tmp_path / "broken"
+        path.mkdir()
+        (path / "coefficients.npy").write_bytes(b"garbage")
+        m = fit_or_resume(LinearRegression(max_iter=5), _frame(), str(path))
+        assert check_finite(m)
+        assert (path / "stage.json").exists() or \
+            (path / "metadata.json").exists()
+
+    def test_fit_then_resume_skips_refit(self, tmp_path):
+        f = _frame()
+        path = str(tmp_path / "ckpt")
+        est = LinearRegression(max_iter=10, reg_param=0.0)
+        m1 = fit_or_resume(est, f, path)
+        coef1 = float(m1.coefficients[0])
+
+        calls = {"n": 0}
+
+        class CountingEstimator(LinearRegression):
+            def fit(self, frame, mesh=None):
+                calls["n"] += 1
+                return super().fit(frame, mesh=mesh)
+
+        m2 = fit_or_resume(CountingEstimator(max_iter=10), f, path)
+        assert calls["n"] == 0                   # resumed, not refitted
+        assert float(m2.coefficients[0]) == pytest.approx(coef1)
+
+    def test_retries_through_fit(self, tmp_path):
+        f = _frame()
+        calls = {"n": 0}
+
+        class FlakyEstimator(LinearRegression):
+            def fit(self, frame, mesh=None):
+                calls["n"] += 1
+                if calls["n"] == 1:
+                    import jax
+
+                    raise jax.errors.JaxRuntimeError("simulated device loss")
+                return super().fit(frame, mesh=mesh)
+
+        m = fit_or_resume(FlakyEstimator(max_iter=5), f,
+                          str(tmp_path / "c2"), retries=3)
+        assert calls["n"] == 2
+        assert check_finite(m)
