@@ -1,6 +1,7 @@
 from .base import Estimator, Model, Pipeline, PipelineModel, Transformer
 from .classification import (BinaryLogisticRegressionSummary,
                              BinaryLogisticRegressionTrainingSummary,
+                             LinearSVC, LinearSVCModel,
                              LogisticRegression, LogisticRegressionModel,
                              LogisticRegressionSummary,
                              LogisticRegressionTrainingSummary,
@@ -14,7 +15,8 @@ from .evaluation import (BinaryClassificationEvaluator, ClusteringEvaluator,
                          Evaluator, MulticlassClassificationEvaluator,
                          RegressionEvaluator)
 from .feature import (Binarizer, Bucketizer, ChiSqSelector,
-                      ChiSqSelectorModel, Imputer, ImputerModel,
+                      ChiSqSelectorModel, DCT, ElementwiseProduct,
+                      FeatureHasher, Imputer, ImputerModel,
                       IndexToString, Interaction, MaxAbsScaler,
                       MaxAbsScalerModel, MinMaxScaler, MinMaxScalerModel,
                       Normalizer, OneHotEncoder, OneHotEncoderModel, PCA,
@@ -22,7 +24,7 @@ from .feature import (Binarizer, Bucketizer, ChiSqSelector,
                       RFormula, RFormulaModel, SQLTransformer,
                       StandardScaler, StandardScalerModel, StringIndexer,
                       StringIndexerModel, VectorAssembler, VectorIndexer,
-                      VectorIndexerModel)
+                      VectorIndexerModel, VectorSlicer)
 from .glm import (GeneralizedLinearRegression,
                   GeneralizedLinearRegressionModel, GlmTrainingSummary)
 from .linalg import Vectors
@@ -38,7 +40,8 @@ from .tree import (DecisionTreeClassificationModel, DecisionTreeClassifier,
                    RandomForestClassificationModel, RandomForestClassifier,
                    RandomForestRegressionModel, RandomForestRegressor)
 from .recommendation import ALS, ALSModel
-from .regression import (LinearRegression, LinearRegressionModel,
+from .regression import (IsotonicRegression, IsotonicRegressionModel,
+                         LinearRegression, LinearRegressionModel,
                          LinearRegressionSummary,
                          LinearRegressionTrainingSummary)
 from .tuning import (CrossValidator, CrossValidatorModel, ParamGridBuilder,

@@ -17,6 +17,7 @@ MinMaxScaler maps constant features to ``(min+max)/2``.
 
 from __future__ import annotations
 
+import functools
 from typing import Optional, Sequence
 
 import jax
@@ -1421,3 +1422,204 @@ class RFormulaModel(Model):
             out = out.with_column(self.label_col,
                                   jnp.asarray(y, float_dtype()))
         return out
+
+
+@persistable
+class ElementwiseProduct(Transformer):
+    """MLlib ``ElementwiseProduct``: Hadamard product of each row with a
+    fixed ``scaling_vec`` — one fused VPU multiply."""
+
+    _persist_attrs = ('scaling_vec', 'input_col', 'output_col')
+
+    def __init__(self, scaling_vec=None, input_col: str = "features",
+                 output_col: str = "scaled_features"):
+        self.scaling_vec = None if scaling_vec is None \
+            else np.asarray(scaling_vec, np.float64)
+        self.input_col = input_col
+        self.output_col = output_col
+
+    def set_scaling_vec(self, v):
+        self.scaling_vec = np.asarray(v, np.float64)
+        return self
+
+    def set_input_col(self, v):
+        self.input_col = v
+        return self
+
+    def set_output_col(self, v):
+        self.output_col = v
+        return self
+
+    setScalingVec = set_scaling_vec
+    setInputCol = set_input_col
+    setOutputCol = set_output_col
+
+    def transform(self, frame):
+        if self.scaling_vec is None:
+            raise ValueError("ElementwiseProduct: scaling_vec not set")
+        X = jnp.asarray(frame._column_values(self.input_col), float_dtype())
+        if X.ndim == 1:
+            X = X[:, None]
+        v = jnp.asarray(self.scaling_vec, X.dtype)
+        if v.shape[0] != X.shape[1]:
+            raise ValueError(f"scaling_vec length {v.shape[0]} != "
+                             f"vector size {X.shape[1]}")
+        return frame.with_column(self.output_col, X * v[None, :])
+
+
+@persistable
+class VectorSlicer(Transformer):
+    """MLlib ``VectorSlicer``: select a subset of vector indices — one
+    device gather."""
+
+    _persist_attrs = ('indices', 'input_col', 'output_col')
+
+    def __init__(self, indices=(), input_col: str = "features",
+                 output_col: str = "sliced_features"):
+        self.indices = [int(i) for i in indices]
+        self.input_col = input_col
+        self.output_col = output_col
+
+    def set_indices(self, v):
+        self.indices = [int(i) for i in v]
+        return self
+
+    def set_input_col(self, v):
+        self.input_col = v
+        return self
+
+    def set_output_col(self, v):
+        self.output_col = v
+        return self
+
+    setIndices = set_indices
+    setInputCol = set_input_col
+    setOutputCol = set_output_col
+
+    def transform(self, frame):
+        if not self.indices:
+            raise ValueError("VectorSlicer: indices not set")
+        X = jnp.asarray(frame._column_values(self.input_col), float_dtype())
+        if X.ndim == 1:
+            X = X[:, None]
+        d = X.shape[1]
+        if any(i < 0 or i >= d for i in self.indices):
+            raise ValueError(f"indices out of range for vector size {d}")
+        return frame.with_column(
+            self.output_col, X[:, jnp.asarray(self.indices, jnp.int32)])
+
+
+@persistable
+class DCT(Transformer):
+    """MLlib ``DCT``: orthonormal 1-D DCT-II (or its inverse, DCT-III) of
+    each row. TPU-first: the transform is ONE ``(n,d)×(d,d)`` MXU matmul
+    against a precomputed orthonormal cosine basis — the scaled output
+    matches MLlib's jTransforms ``forward(..., true)`` convention."""
+
+    _persist_attrs = ('inverse', 'input_col', 'output_col')
+
+    def __init__(self, inverse: bool = False, input_col: str = "features",
+                 output_col: str = "dct_features"):
+        self.inverse = bool(inverse)
+        self.input_col = input_col
+        self.output_col = output_col
+
+    def set_inverse(self, v):
+        self.inverse = bool(v)
+        return self
+
+    def set_input_col(self, v):
+        self.input_col = v
+        return self
+
+    def set_output_col(self, v):
+        self.output_col = v
+        return self
+
+    setInverse = set_inverse
+    setInputCol = set_input_col
+    setOutputCol = set_output_col
+
+    @staticmethod
+    @functools.lru_cache(maxsize=None)
+    def _basis(d: int, dtype_name: str):
+        """Orthonormal DCT-II matrix B (d, d): y = B @ x."""
+        k = np.arange(d)[:, None]
+        i = np.arange(d)[None, :]
+        B = np.cos(np.pi * k * (2 * i + 1) / (2 * d))
+        B *= np.sqrt(2.0 / d)
+        B[0] *= 1.0 / np.sqrt(2.0)
+        return jnp.asarray(B, dtype_name)
+
+    def transform(self, frame):
+        X = jnp.asarray(frame._column_values(self.input_col), float_dtype())
+        squeeze = X.ndim == 1
+        if squeeze:
+            X = X[:, None]
+        B = self._basis(X.shape[1], str(X.dtype))
+        out = X @ (B if self.inverse else B.T)  # inverse: Bᵀ orthonormality
+        return frame.with_column(self.output_col,
+                                 out[:, 0] if squeeze else out)
+
+
+@persistable
+class FeatureHasher(Transformer):
+    """MLlib ``FeatureHasher``: hash any mix of numeric and string columns
+    into one fixed-dimension vector. Numeric column → bucket(hash(name)),
+    value added; string column → bucket(hash(name=value)), +1. Hashing is
+    per unique (column, value) pair on host; the scatter is one
+    ``np.add.at`` (same vectorized shape as HashingTF)."""
+
+    _persist_attrs = ('num_features', 'input_cols', 'output_col')
+
+    def __init__(self, num_features: int = 1024, input_cols=(),
+                 output_col: str = "features"):
+        if num_features < 1:
+            raise ValueError("num_features must be >= 1")
+        self.num_features = int(num_features)
+        self.input_cols = list(input_cols)
+        self.output_col = output_col
+
+    def set_num_features(self, v):
+        if v < 1:
+            raise ValueError("num_features must be >= 1")
+        self.num_features = int(v)
+        return self
+
+    def set_input_cols(self, v):
+        self.input_cols = list(v)
+        return self
+
+    def set_output_col(self, v):
+        self.output_col = v
+        return self
+
+    setNumFeatures = set_num_features
+    setInputCols = set_input_cols
+    setOutputCol = set_output_col
+
+    def transform(self, frame):
+        from .text import _stable_hash
+
+        if not self.input_cols:
+            raise ValueError("FeatureHasher: input_cols not set")
+        first = frame._column_values(self.input_cols[0])
+        n = int(np.asarray(first).shape[0])
+        M = np.zeros((n, self.num_features), np.dtype(float_dtype()))
+        rows = np.arange(n)
+        for name in self.input_cols:
+            arr = frame._column_values(name)
+            if _is_string_col(arr):
+                vals = np.asarray(
+                    ["" if v is None else str(v) for v in arr])
+                uniq, inv = np.unique(vals, return_inverse=True)
+                buckets = np.fromiter(
+                    (_stable_hash(f"{name}={u}", self.num_features)
+                     for u in uniq), np.int64, count=uniq.size)
+                present = np.asarray([v is not None for v in arr])
+                np.add.at(M, (rows[present], buckets[inv][present]), 1.0)
+            else:
+                j = _stable_hash(name, self.num_features)
+                col = np.asarray(arr, np.float64)
+                M[:, j] += np.where(np.isfinite(col), col, 0.0)
+        return frame.with_column(self.output_col, jnp.asarray(M))

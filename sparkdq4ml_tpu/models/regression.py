@@ -387,3 +387,171 @@ class LinearRegressionTrainingSummary(LinearRegressionSummary):
         return self._objective_history
 
     objectiveHistory = objective_history
+
+
+# ---------------------------------------------------------------------------
+# IsotonicRegression (MLlib org.apache.spark.ml.regression.IsotonicRegression)
+# ---------------------------------------------------------------------------
+
+@persistable
+class IsotonicRegression(Estimator):
+    """MLlib ``IsotonicRegression``: weighted isotonic (or antitonic) fit of
+    label vs ONE feature, via pool-adjacent-violators.
+
+    Design: PAVA is inherently sequential pooling — a host algorithm by
+    nature (same rule as the KS test's sort, stat.py) — but it runs ONCE on
+    ≤ n aggregated points; prediction is vectorized interpolation over the
+    fitted boundaries and rides the device path through ``with_column``.
+    MLlib semantics reproduced: points with equal feature values aggregate
+    to their weighted-mean label first; prediction linearly interpolates
+    between boundaries and is constant beyond them; ``isotonic=False``
+    fits the antitonic (decreasing) function.
+    """
+
+    _persist_attrs = ("isotonic", "features_col", "label_col",
+                      "prediction_col", "weight_col", "feature_index")
+
+    def __init__(self, isotonic: bool = True, features_col: str = "features",
+                 label_col: str = "label", prediction_col: str = "prediction",
+                 weight_col: Optional[str] = None, feature_index: int = 0):
+        self.isotonic = bool(isotonic)
+        self.features_col = features_col
+        self.label_col = label_col
+        self.prediction_col = prediction_col
+        self.weight_col = weight_col
+        self.feature_index = int(feature_index)
+
+    def set_isotonic(self, v):
+        self.isotonic = bool(v)
+        return self
+
+    def set_feature_index(self, v):
+        self.feature_index = int(v)
+        return self
+
+    def set_weight_col(self, v):
+        self.weight_col = v
+        return self
+
+    def set_features_col(self, v):
+        self.features_col = v
+        return self
+
+    def set_label_col(self, v):
+        self.label_col = v
+        return self
+
+    def set_prediction_col(self, v):
+        self.prediction_col = v
+        return self
+
+    setIsotonic = set_isotonic
+    setFeatureIndex = set_feature_index
+    setWeightCol = set_weight_col
+    setFeaturesCol = set_features_col
+    setLabelCol = set_label_col
+    setPredictionCol = set_prediction_col
+
+    def fit(self, frame: Frame) -> "IsotonicRegressionModel":
+        X = np.asarray(frame._column_values(self.features_col), np.float64)
+        if X.ndim > 1:
+            X = X[:, self.feature_index]
+        y = np.asarray(frame._column_values(self.label_col), np.float64)
+        mask = np.asarray(frame.mask)
+        w = np.ones_like(y) if self.weight_col is None else \
+            np.asarray(frame._column_values(self.weight_col), np.float64)
+        x, y, w = X[mask], y[mask], w[mask]
+        if x.size == 0:
+            raise ValueError("IsotonicRegression: no valid rows")
+        if not (np.all(np.isfinite(x)) and np.all(np.isfinite(y))):
+            raise ValueError("IsotonicRegression: non-finite feature/label "
+                             "in valid rows")
+        if np.any(w < 0):
+            raise ValueError("weights must be nonnegative")
+
+        sign = 1.0 if self.isotonic else -1.0
+        order = np.argsort(x, kind="stable")
+        xs, ys, ws = x[order], sign * y[order], w[order]
+
+        # aggregate duplicate feature values: weighted mean label (MLlib)
+        uniq, start = np.unique(xs, return_index=True)
+        wsum = np.add.reduceat(ws, start)
+        ysum = np.add.reduceat(ws * ys, start)
+        keep = wsum > 0
+        bx = uniq[keep]
+        bw = wsum[keep]
+        by = ysum[keep] / bw
+
+        # pool adjacent violators (weighted), classic stack formulation
+        vals: list = []
+        wts: list = []
+        xs_lo: list = []
+        xs_hi: list = []
+        for xi, yi, wi in zip(bx, by, bw):
+            vals.append(yi)
+            wts.append(wi)
+            xs_lo.append(xi)
+            xs_hi.append(xi)
+            while len(vals) > 1 and vals[-2] > vals[-1]:
+                y2, w2 = vals.pop(), wts.pop()
+                hi2 = xs_hi.pop()          # merged pool spans (lo1, hi2)
+                xs_lo.pop()
+                y1, w1 = vals.pop(), wts.pop()
+                xs_hi.pop()
+                lo1 = xs_lo.pop()
+                vals.append((y1 * w1 + y2 * w2) / (w1 + w2))
+                wts.append(w1 + w2)
+                xs_lo.append(lo1)
+                xs_hi.append(hi2)
+
+        # MLlib keeps each pool's boundary pair (lo, hi) with the pooled
+        # value at both ends, then interpolates linearly between pools
+        boundaries: list = []
+        predictions: list = []
+        for lo, hi, v in zip(xs_lo, xs_hi, vals):
+            boundaries.append(lo)
+            predictions.append(v)
+            if hi != lo:
+                boundaries.append(hi)
+                predictions.append(v)
+        return IsotonicRegressionModel(
+            np.asarray(boundaries, np.float64),
+            sign * np.asarray(predictions, np.float64),
+            {"features_col": self.features_col,
+             "prediction_col": self.prediction_col,
+             "feature_index": self.feature_index,
+             "isotonic": self.isotonic})
+
+
+@persistable
+class IsotonicRegressionModel(Model):
+    """Fitted step/piecewise-linear function: ``boundaries`` (ascending) and
+    ``predictions``; transform is vectorized interpolation with constant
+    extrapolation (exactly ``np.interp``'s contract, which matches MLlib's
+    predictionForX)."""
+
+    _persist_attrs = ("boundaries", "predictions", "_params")
+
+    def __init__(self, boundaries, predictions, params=None):
+        self.boundaries = np.asarray(boundaries, np.float64)
+        self.predictions = np.asarray(predictions, np.float64)
+        self._params = dict(params or {})
+
+    def _p(self, k, default=None):
+        return self._params.get(k, default)
+
+    def _predict_array(self, x):
+        return np.interp(np.asarray(x, np.float64), self.boundaries,
+                         self.predictions)
+
+    def transform(self, frame: Frame) -> Frame:
+        X = np.asarray(frame._column_values(
+            self._p("features_col", "features")), np.float64)
+        if X.ndim > 1:
+            X = X[:, self._p("feature_index", 0)]
+        pred = self._predict_array(X)
+        return frame.with_column(self._p("prediction_col", "prediction"),
+                                 jnp.asarray(pred, float_dtype()))
+
+    def predict(self, feature: float) -> float:
+        return float(self._predict_array([float(feature)])[0])

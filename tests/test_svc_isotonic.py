@@ -1,0 +1,256 @@
+"""LinearSVC + IsotonicRegression + small vector transformers
+(ElementwiseProduct/VectorSlicer/DCT/FeatureHasher) — MLlib surface
+shipped by the reference's mllib dependency (pom.xml:29-32). Oracles:
+sklearn/scipy on the same data (SURVEY.md §4 pattern)."""
+
+import numpy as np
+import pytest
+
+from conftest import assert_devices
+from sparkdq4ml_tpu import Frame
+from sparkdq4ml_tpu.models import (DCT, ElementwiseProduct, FeatureHasher,
+                                   IsotonicRegression,
+                                   IsotonicRegressionModel, LinearSVC,
+                                   LinearSVCModel, VectorAssembler,
+                                   VectorSlicer)
+from sparkdq4ml_tpu.parallel.mesh import make_mesh
+
+
+def svc_frame(n=300, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, 3))
+    y = (X @ np.asarray([2.0, -1.0, 0.5]) + 0.3
+         + 0.2 * rng.normal(size=n) > 0).astype(np.float64)
+    cols = {f"x{j}": X[:, j] for j in range(3)}
+    cols["label"] = y
+    f = VectorAssembler([f"x{j}" for j in range(3)],
+                        "features").transform(Frame(cols))
+    return f, X, y
+
+
+class TestLinearSVC:
+    def test_separates_linear_data(self):
+        f, X, y = svc_frame()
+        model = LinearSVC(max_iter=200, reg_param=0.01).fit(f)
+        pred = np.asarray(model.transform(f).to_pydict()["prediction"])
+        assert np.mean(pred == y) > 0.93
+        assert model.objective_history[-1] < model.objective_history[0]
+
+    def test_sklearn_quality_parity(self):
+        pytest.importorskip("sklearn")
+        from sklearn.svm import LinearSVC as SkSVC
+
+        f, X, y = svc_frame(seed=3)
+        ours = LinearSVC(max_iter=300, reg_param=0.01).fit(f)
+        pred = np.asarray(ours.transform(f).to_pydict()["prediction"])
+        sk = SkSVC(C=100.0, max_iter=5000).fit(X, y)
+        acc_ours = np.mean(pred == y)
+        acc_sk = sk.score(X, y)
+        assert acc_ours >= acc_sk - 0.03
+
+    def test_raw_prediction_and_threshold(self):
+        f, _, _ = svc_frame()
+        model = LinearSVC(max_iter=50).fit(f)
+        d = model.transform(f).to_pydict()
+        raw = np.asarray(d["rawPrediction"])
+        assert raw.shape[1] == 2
+        np.testing.assert_allclose(raw[:, 0], -raw[:, 1], rtol=1e-6)
+        # prediction == margin > threshold
+        np.testing.assert_array_equal(
+            np.asarray(d["prediction"]), (raw[:, 1] > 0).astype(np.float64))
+
+    @pytest.mark.parametrize("labels", ["multiclass", "all_twos"])
+    def test_rejects_nonbinary(self, labels):
+        rng = np.random.default_rng(0)
+        n = 50
+        y = rng.integers(0, 3, size=n).astype(np.float64) \
+            if labels == "multiclass" else np.full(n, 2.0)
+        h = VectorAssembler(["x"], "features").transform(
+            Frame({"x": rng.normal(size=n), "label": y}))
+        with pytest.raises(ValueError, match="binary"):
+            LinearSVC().fit(h)
+
+    def test_sharded_equals_single(self):
+        assert_devices(8)
+        f, _, _ = svc_frame(seed=5)
+        kw = dict(max_iter=60, reg_param=0.1)
+        single = LinearSVC(**kw).fit(f, mesh=make_mesh(1))
+        sharded = LinearSVC(**kw).fit(f, mesh=make_mesh(8))
+        np.testing.assert_allclose(sharded.coefficients, single.coefficients,
+                                   rtol=1e-8, atol=1e-10)
+        assert sharded.intercept == pytest.approx(single.intercept,
+                                                  rel=1e-8, abs=1e-10)
+
+    def test_masked_rows_excluded(self):
+        """A fit on (clean rows + masked poisoned rows) must equal the fit
+        on the clean subset alone — masked rows may not vote."""
+        rng = np.random.default_rng(7)
+        n = 120
+        X = rng.normal(size=(n, 2))
+        y = (X[:, 0] > 0).astype(np.float64)
+        keep = np.ones(n, bool)
+        keep[::7] = False
+        Xp = X.copy()
+        Xp[~keep] *= 1e6          # poisoned features on masked rows
+        yp = y.copy()
+        yp[~keep] = 1.0 - yp[~keep]
+
+        def build(Xa, ya, mask=None):
+            f = VectorAssembler(["x0", "x1"], "features").transform(
+                Frame({"x0": Xa[:, 0], "x1": Xa[:, 1], "label": ya}))
+            return f.filter(mask) if mask is not None else f
+
+        kw = dict(max_iter=80, reg_param=0.05)
+        m_masked = LinearSVC(**kw).fit(build(Xp, yp, keep))
+        m_clean = LinearSVC(**kw).fit(build(X[keep], y[keep]))
+        np.testing.assert_allclose(m_masked.coefficients,
+                                   m_clean.coefficients,
+                                   rtol=1e-6, atol=1e-9)
+        assert m_masked.intercept == pytest.approx(m_clean.intercept,
+                                                   rel=1e-6, abs=1e-9)
+
+    def test_persistence_roundtrip(self, tmp_path):
+        from sparkdq4ml_tpu.models.base import load_stage
+
+        f, _, _ = svc_frame()
+        model = LinearSVC(max_iter=30).fit(f)
+        model.save(str(tmp_path / "svc"))
+        loaded = load_stage(str(tmp_path / "svc"))
+        assert isinstance(loaded, LinearSVCModel)
+        np.testing.assert_array_equal(loaded.coefficients,
+                                      model.coefficients)
+        assert loaded.predict([1.0, 0.0, 0.0]) == \
+            model.predict([1.0, 0.0, 0.0])
+
+
+class TestIsotonicRegression:
+    def test_matches_sklearn(self):
+        pytest.importorskip("sklearn")
+        from sklearn.isotonic import IsotonicRegression as SkIso
+
+        rng = np.random.default_rng(0)
+        x = rng.uniform(0, 10, size=200)
+        y = np.sqrt(x) + 0.3 * rng.normal(size=200)
+        f = Frame({"features": x, "label": y})
+        ours = IsotonicRegression().fit(f)
+        pred = np.asarray(ours.transform(f).to_pydict()["prediction"],
+                          np.float64)
+        sk = SkIso(out_of_bounds="clip").fit(x, y)
+        np.testing.assert_allclose(pred, sk.predict(x), rtol=1e-6,
+                                   atol=1e-8)
+
+    def test_antitonic(self):
+        rng = np.random.default_rng(1)
+        x = rng.uniform(0, 5, size=100)
+        y = -2 * x + 0.1 * rng.normal(size=100)
+        f = Frame({"features": x, "label": y})
+        m = IsotonicRegression(isotonic=False).fit(f)
+        pred = np.asarray(m.transform(f).to_pydict()["prediction"])
+        order = np.argsort(x)
+        assert np.all(np.diff(pred[order]) <= 1e-9)   # non-increasing
+
+    def test_weighted_and_duplicates(self):
+        pytest.importorskip("sklearn")
+        from sklearn.isotonic import IsotonicRegression as SkIso
+
+        x = np.asarray([1.0, 1.0, 2.0, 3.0, 3.0, 4.0])
+        y = np.asarray([2.0, 4.0, 1.0, 5.0, 7.0, 6.0])
+        w = np.asarray([1.0, 3.0, 2.0, 1.0, 1.0, 2.0])
+        f = Frame({"features": x, "label": y, "w": w})
+        m = IsotonicRegression(weight_col="w").fit(f)
+        sk = SkIso(out_of_bounds="clip").fit(x, y, sample_weight=w)
+        for q in [0.5, 1.0, 2.5, 3.0, 10.0]:
+            assert m.predict(q) == pytest.approx(float(sk.predict([q])[0]),
+                                                 rel=1e-9)
+
+    def test_constant_extrapolation(self):
+        f = Frame({"features": np.asarray([1.0, 2.0, 3.0]),
+                   "label": np.asarray([1.0, 2.0, 3.0])})
+        m = IsotonicRegression().fit(f)
+        assert m.predict(-5.0) == pytest.approx(1.0)
+        assert m.predict(99.0) == pytest.approx(3.0)
+        assert m.predict(1.5) == pytest.approx(1.5)   # linear interpolation
+
+    def test_feature_index_on_vector(self):
+        rng = np.random.default_rng(2)
+        x = rng.uniform(0, 5, size=60)
+        f = Frame({"a": rng.normal(size=60), "b": x,
+                   "label": 2 * x})
+        f = VectorAssembler(["a", "b"], "features").transform(f)
+        m = IsotonicRegression(feature_index=1).fit(f)
+        assert m.predict(2.0) == pytest.approx(4.0, rel=0.2)
+
+    def test_roundtrip(self, tmp_path):
+        from sparkdq4ml_tpu.models.base import load_stage
+
+        f = Frame({"features": np.asarray([1.0, 2.0, 3.0]),
+                   "label": np.asarray([3.0, 1.0, 5.0])})
+        m = IsotonicRegression().fit(f)
+        m.save(str(tmp_path / "iso"))
+        loaded = load_stage(str(tmp_path / "iso"))
+        assert isinstance(loaded, IsotonicRegressionModel)
+        assert loaded.predict(2.5) == m.predict(2.5)
+
+
+class TestVectorTransformers:
+    def _vec_frame(self, n=10, d=4, seed=0):
+        rng = np.random.default_rng(seed)
+        X = rng.normal(size=(n, d))
+        cols = {f"x{j}": X[:, j] for j in range(d)}
+        return (VectorAssembler([f"x{j}" for j in range(d)],
+                                "features").transform(Frame(cols)), X)
+
+    def test_elementwise_product(self):
+        f, X = self._vec_frame()
+        v = np.asarray([1.0, 0.0, -2.0, 0.5])
+        out = ElementwiseProduct(v, "features", "o").transform(f)
+        np.testing.assert_allclose(
+            np.asarray(out.to_pydict()["o"], np.float64), X * v, rtol=1e-6)
+
+    def test_vector_slicer(self):
+        f, X = self._vec_frame()
+        out = VectorSlicer([2, 0], "features", "o").transform(f)
+        np.testing.assert_allclose(
+            np.asarray(out.to_pydict()["o"], np.float64), X[:, [2, 0]],
+            rtol=1e-6)
+        with pytest.raises(ValueError, match="out of range"):
+            VectorSlicer([9], "features", "o").transform(f)
+
+    def test_dct_matches_scipy(self):
+        pytest.importorskip("scipy")
+        from scipy.fft import dct as sdct
+
+        f, X = self._vec_frame(d=8)
+        out = DCT(input_col="features", output_col="o").transform(f)
+        ref = sdct(X, type=2, norm="ortho", axis=1)
+        np.testing.assert_allclose(
+            np.asarray(out.to_pydict()["o"], np.float64), ref,
+            rtol=1e-5, atol=1e-7)
+
+    def test_dct_inverse_roundtrip(self):
+        f, X = self._vec_frame(d=8)
+        fwd = DCT(input_col="features", output_col="y").transform(f)
+        back = DCT(inverse=True, input_col="y", output_col="z").transform(fwd)
+        np.testing.assert_allclose(
+            np.asarray(back.to_pydict()["z"], np.float64), X,
+            rtol=1e-5, atol=1e-7)
+
+    def test_feature_hasher(self):
+        from sparkdq4ml_tpu.models.text import _stable_hash
+
+        cats = np.asarray(["a", "b", "a", None], object)
+        nums = np.asarray([1.5, 2.0, -1.0, 3.0])
+        f = Frame({"cat": cats, "num": nums})
+        out = FeatureHasher(num_features=16, input_cols=["cat", "num"],
+                            output_col="h").transform(f)
+        M = np.asarray(out.to_pydict()["h"], np.float64)
+        assert M.shape == (4, 16)
+        # full naive reference (collision-aware): string col adds 1 at
+        # hash(name=value), numeric col adds the value at hash(name)
+        expected = np.zeros_like(M)
+        for i, c in enumerate(cats):
+            if c is not None:
+                expected[i, _stable_hash(f"cat={c}", 16)] += 1.0
+        for i, v in enumerate(nums):
+            expected[i, _stable_hash("num", 16)] += v
+        np.testing.assert_allclose(M, expected, rtol=1e-6)
