@@ -46,3 +46,4 @@ from .regression import (IsotonicRegression, IsotonicRegressionModel,
                          LinearRegressionTrainingSummary)
 from .tuning import (CrossValidator, CrossValidatorModel, ParamGridBuilder,
                      TrainValidationSplit, TrainValidationSplitModel)
+from .word2vec import Word2Vec, Word2VecModel

@@ -1,0 +1,99 @@
+"""Word2Vec: SGNS embedding quality on a planted co-occurrence corpus,
+MLlib surface (transform = document mean vector, findSynonyms, getVectors),
+determinism by seed, sharded≡finite on the 8-device mesh, persistence."""
+
+import numpy as np
+import pytest
+
+from conftest import assert_devices
+from sparkdq4ml_tpu import Frame
+from sparkdq4ml_tpu.models import Word2Vec, Word2VecModel
+from sparkdq4ml_tpu.models.text import _obj_array
+from sparkdq4ml_tpu.parallel.mesh import make_mesh
+
+
+def planted_corpus(n_docs=400, seed=0):
+    """Two topic clusters: {cat dog pet} and {car road drive} — words
+    within a cluster co-occur, across clusters they don't."""
+    rng = np.random.default_rng(seed)
+    animals = ["cat", "dog", "pet", "fur", "paw"]
+    vehicles = ["car", "road", "drive", "wheel", "fuel"]
+    docs = []
+    for _ in range(n_docs):
+        pool = animals if rng.random() < 0.5 else vehicles
+        docs.append(list(rng.choice(pool, size=8)))
+    return Frame({"toks": _obj_array(docs)})
+
+
+def _fit(mesh=None, **kw):
+    f = planted_corpus()
+    est = Word2Vec(vector_size=16, window_size=3, min_count=1, max_iter=3,
+                   num_negatives=4, batch_size=256, seed=1,
+                   input_col="toks", output_col="vec", **kw)
+    return est.fit(f, mesh=mesh) if mesh is not None else est.fit(f), f
+
+
+class TestWord2Vec:
+    def test_clusters_separate(self):
+        model, f = _fit()
+        syn = model.find_synonyms("cat", 4).to_pydict()
+        top = set(syn["word"])
+        assert top <= {"dog", "pet", "fur", "paw"}, top
+
+    def test_transform_document_mean(self):
+        model, f = _fit()
+        out = np.asarray(model.transform(f).to_pydict()["vec"], np.float64)
+        assert out.shape == (400, 16)
+        assert np.all(np.isfinite(out))
+        # manual mean for doc 0
+        d = f.to_pydict()["toks"][0]
+        idx = {w: i for i, w in enumerate(model.vocabulary)}
+        ref = np.mean([model.vectors[idx[t]] for t in d], axis=0)
+        np.testing.assert_allclose(out[0], ref, rtol=1e-5, atol=1e-7)
+
+    def test_loss_decreases(self):
+        model, _ = _fit()
+        h = model.loss_history
+        assert len(h) > 4
+        assert np.mean(h[-3:]) < np.mean(h[:3])
+
+    def test_deterministic_by_seed(self):
+        m1, _ = _fit()
+        m2, _ = _fit()
+        np.testing.assert_array_equal(m1.vectors, m2.vectors)
+
+    def test_min_count_filters_vocab(self):
+        docs = [["a", "b"], ["a", "c"], ["a", "b"]]
+        f = Frame({"toks": _obj_array(docs)})
+        m = Word2Vec(vector_size=4, min_count=2, window_size=2, max_iter=1,
+                     input_col="toks", output_col="v", seed=0).fit(f)
+        assert set(m.vocabulary) == {"a", "b"}
+
+    def test_get_vectors_frame(self):
+        model, _ = _fit()
+        d = model.get_vectors().to_pydict()
+        assert len(d["word"]) == len(model.vocabulary)
+        assert np.asarray(d["vector"]).shape == (len(model.vocabulary), 16)
+
+    def test_unknown_synonym_query_raises(self):
+        model, _ = _fit()
+        with pytest.raises(ValueError, match="not in vocabulary"):
+            model.find_synonyms("zebra", 3)
+
+    def test_sharded_runs_and_separates(self):
+        assert_devices(8)
+        model, _ = _fit(mesh=make_mesh(8))
+        assert np.all(np.isfinite(model.vectors))
+        syn = set(model.find_synonyms("car", 4).to_pydict()["word"])
+        assert syn <= {"road", "drive", "wheel", "fuel"}, syn
+
+    def test_roundtrip(self, tmp_path):
+        from sparkdq4ml_tpu.models.base import load_stage
+
+        model, f = _fit()
+        model.save(str(tmp_path / "w2v"))
+        loaded = load_stage(str(tmp_path / "w2v"))
+        assert isinstance(loaded, Word2VecModel)
+        np.testing.assert_array_equal(loaded.vectors, model.vectors)
+        out = np.asarray(loaded.transform(f).to_pydict()["vec"])
+        assert np.all(np.isfinite(out))
