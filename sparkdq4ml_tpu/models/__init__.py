@@ -46,4 +46,7 @@ from .regression import (IsotonicRegression, IsotonicRegressionModel,
                          LinearRegressionTrainingSummary)
 from .tuning import (CrossValidator, CrossValidatorModel, ParamGridBuilder,
                      TrainValidationSplit, TrainValidationSplitModel)
+from .lsh import (BucketedRandomProjectionLSH,
+                  BucketedRandomProjectionLSHModel, MinHashLSH,
+                  MinHashLSHModel)
 from .word2vec import Word2Vec, Word2VecModel

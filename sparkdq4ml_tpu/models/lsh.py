@@ -1,0 +1,287 @@
+"""Locality-sensitive hashing (MLlib ``org.apache.spark.ml.feature``
+``BucketedRandomProjectionLSH`` / ``MinHashLSH`` — shipped by the
+reference's mllib dependency, pom.xml:29-32).
+
+TPU-first design:
+
+* **Hashing is one device op.** Random-projection hashes are a single
+  ``(n, d) × (d, L)`` MXU matmul + floor; MinHash is one masked min
+  reduction over the (n, 1, d) × (1, L, d) broadcast of precomputed
+  per-index hash values. No per-row Python.
+* **Candidate generation reuses the vectorized join planner**: bucket ids
+  are integer keys, so ``approxSimilarityJoin`` plans candidate pairs with
+  the same sort/searchsorted machinery as ``Frame.join`` (frame/frame.py
+  ``_vector_join_plan``) instead of a per-row hash probe — Spark's
+  shuffle-on-hash analogue.
+* **Exact re-ranking on device**: candidate distances are batched norms /
+  Jaccard reductions, then ``top_k``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import float_dtype
+from .base import Estimator, Model, persistable
+
+_MINHASH_PRIME = 2038074743  # MLlib's MinHashLSH prime
+
+
+class _LSHParams:
+    def set_input_col(self, v):
+        self.input_col = v
+        return self
+
+    def set_output_col(self, v):
+        self.output_col = v
+        return self
+
+    def set_num_hash_tables(self, v):
+        if v < 1:
+            raise ValueError("num_hash_tables must be >= 1")
+        self.num_hash_tables = int(v)
+        return self
+
+    def set_seed(self, v):
+        self.seed = int(v)
+        return self
+
+    setInputCol = set_input_col
+    setOutputCol = set_output_col
+    setNumHashTables = set_num_hash_tables
+    setSeed = set_seed
+
+
+def _extract_matrix(frame, col):
+    X = jnp.asarray(frame._column_values(col), float_dtype())
+    if X.ndim == 1:
+        X = X[:, None]
+    return X
+
+
+class _LSHModelBase(Model):
+    """Shared approxNearestNeighbors / approxSimilarityJoin on top of a
+    subclass-provided ``_hashes(X) -> (n, L) int`` and
+    ``_distance(A, B) -> (n,)``."""
+
+    def transform(self, frame):
+        # hash ids stay int32 — a float32 column would quantize MinHash's
+        # ~2^31-range ids (resolution 128 above 2^24)
+        X = _extract_matrix(frame, self.input_col)
+        return frame.with_column(self.output_col, self._hashes(X))
+
+    def approx_nearest_neighbors(self, frame, key, num_neighbors: int,
+                                 dist_col: str = "distCol"):
+        """Top-k rows of ``frame`` nearest to vector ``key`` among
+        candidates sharing ≥1 hash bucket (falls back to all valid rows
+        when the candidate set is smaller than k — MLlib warns instead;
+        deterministic beats partial here)."""
+        X = _extract_matrix(frame, self.input_col)
+        keyv = jnp.asarray(np.atleast_1d(np.asarray(key, np.float64)),
+                           X.dtype)
+        hx = np.asarray(self._hashes(X))                   # (n, L)
+        hk = np.asarray(self._hashes(keyv[None, :]))[0]    # (L,)
+        valid = np.asarray(frame.mask)
+        cand = ((hx == hk[None, :]).any(axis=1)) & valid
+        if cand.sum() < num_neighbors:
+            cand = valid
+        idx = np.nonzero(cand)[0]
+        d = np.asarray(self._distance(X[jnp.asarray(idx)], keyv))
+        k = min(num_neighbors, idx.size)
+        top = np.argsort(d, kind="stable")[:k]
+        keep = np.zeros(X.shape[0], bool)
+        keep[idx[top]] = True
+        out = frame.filter(np.asarray(keep))
+        dist_full = np.full(X.shape[0], np.nan)
+        dist_full[idx] = d
+        return out.with_column(dist_col,
+                               jnp.asarray(dist_full, float_dtype()))
+
+    approxNearestNeighbors = approx_nearest_neighbors
+
+    def approx_similarity_join(self, frame_a, frame_b, threshold: float,
+                               dist_col: str = "distCol"):
+        """All (a, b) pairs with distance ≤ threshold among candidates
+        sharing a hash bucket in ANY table. Candidate planning reuses the
+        vectorized numeric join plan per table; exact distances batch on
+        device. Returns a Frame with ``idA``/``idB`` (source row positions
+        among valid rows) + the distance column."""
+        from ..frame.frame import _vector_join_plan
+
+        Xa = _extract_matrix(frame_a, self.input_col)
+        Xb = _extract_matrix(frame_b, self.input_col)
+        ha = np.asarray(self._hashes(Xa), np.int64)
+        hb = np.asarray(self._hashes(Xb), np.int64)
+        ia = np.nonzero(np.asarray(frame_a.mask))[0]
+        ib = np.nonzero(np.asarray(frame_b.mask))[0]
+
+        lps, rps = [], []
+        for t in range(ha.shape[1]):
+            plan = _vector_join_plan([ha[ia, t]], [hb[ib, t]], ia, ib,
+                                     "inner")
+            if plan is not None:
+                lps.append(plan[0])
+                rps.append(plan[1])
+        lp = np.concatenate(lps) if lps else np.zeros((0,), np.int64)
+        rp = np.concatenate(rps) if rps else np.zeros((0,), np.int64)
+        if lp.size == 0:
+            from ..frame import Frame
+
+            return Frame({"idA": np.zeros((0,), np.int64),
+                          "idB": np.zeros((0,), np.int64),
+                          dist_col: np.zeros((0,), np.float64)})
+        # dedupe across tables in one vectorized pass (a Python tuple-set
+        # would be interpreter-bound exactly when buckets are skewed)
+        nb = int(rp.max()) + 1
+        uniq = np.unique(lp * np.int64(nb) + rp)
+        pa, pb = uniq // nb, uniq % nb
+        d = np.asarray(self._distance_rows(Xa[jnp.asarray(pa)],
+                                           Xb[jnp.asarray(pb)]))
+        keep = d <= threshold
+        from ..frame import Frame
+
+        return Frame({"idA": pa[keep].astype(np.int64),
+                      "idB": pb[keep].astype(np.int64),
+                      dist_col: d[keep].astype(np.float64)})
+
+    approxSimilarityJoin = approx_similarity_join
+
+
+# ---------------------------------------------------------------------------
+# BucketedRandomProjectionLSH (Euclidean)
+# ---------------------------------------------------------------------------
+
+@persistable
+class BucketedRandomProjectionLSH(Estimator, _LSHParams):
+    """Euclidean-distance LSH: ``h_l(x) = floor(x·w_l / bucketLength)`` for
+    ``num_hash_tables`` Gaussian unit directions ``w_l``."""
+
+    _persist_attrs = ('bucket_length', 'num_hash_tables', 'seed',
+                      'input_col', 'output_col')
+
+    def __init__(self, bucket_length: float = None,
+                 num_hash_tables: int = 1, seed: int = 0,
+                 input_col: str = "features", output_col: str = "hashes"):
+        if bucket_length is not None and bucket_length <= 0:
+            raise ValueError("bucket_length must be > 0")
+        self.bucket_length = bucket_length
+        self.num_hash_tables = int(num_hash_tables)
+        self.seed = int(seed)
+        self.input_col = input_col
+        self.output_col = output_col
+
+    def set_bucket_length(self, v):
+        if v <= 0:
+            raise ValueError("bucket_length must be > 0")
+        self.bucket_length = float(v)
+        return self
+
+    setBucketLength = set_bucket_length
+
+    def fit(self, frame) -> "BucketedRandomProjectionLSHModel":
+        if self.bucket_length is None:
+            raise ValueError("bucket_length must be set")
+        X = _extract_matrix(frame, self.input_col)
+        d = X.shape[1]
+        rng = np.random.default_rng(self.seed)
+        W = rng.normal(size=(d, self.num_hash_tables))
+        W /= np.linalg.norm(W, axis=0, keepdims=True)   # unit directions
+        return BucketedRandomProjectionLSHModel(
+            W.astype(np.float64), float(self.bucket_length),
+            self.input_col, self.output_col)
+
+
+@persistable
+class BucketedRandomProjectionLSHModel(_LSHModelBase):
+    _persist_attrs = ('projections', 'bucket_length', 'input_col',
+                      'output_col')
+
+    def __init__(self, projections, bucket_length, input_col="features",
+                 output_col="hashes"):
+        self.projections = np.asarray(projections)
+        self.bucket_length = float(bucket_length)
+        self.input_col = input_col
+        self.output_col = output_col
+
+    def _hashes(self, X):
+        W = jnp.asarray(self.projections, X.dtype)
+        return jnp.floor((X @ W) / self.bucket_length).astype(jnp.int32)
+
+    def _distance(self, A, key):
+        return jnp.sqrt(jnp.sum((A - key[None, :]) ** 2, axis=1))
+
+    def _distance_rows(self, A, B):
+        return jnp.sqrt(jnp.sum((A - B) ** 2, axis=1))
+
+
+# ---------------------------------------------------------------------------
+# MinHashLSH (Jaccard, binary vectors)
+# ---------------------------------------------------------------------------
+
+@persistable
+class MinHashLSH(Estimator, _LSHParams):
+    """Jaccard-distance LSH over binary vectors:
+    ``h_l(x) = min over nonzero j of ((a_l·(j+1) + b_l) mod prime)``
+    (MLlib's 1-indexed perfect-hash family)."""
+
+    _persist_attrs = ('num_hash_tables', 'seed', 'input_col', 'output_col')
+
+    def __init__(self, num_hash_tables: int = 1, seed: int = 0,
+                 input_col: str = "features", output_col: str = "hashes"):
+        self.num_hash_tables = int(num_hash_tables)
+        self.seed = int(seed)
+        self.input_col = input_col
+        self.output_col = output_col
+
+    def fit(self, frame) -> "MinHashLSHModel":
+        X = _extract_matrix(frame, self.input_col)
+        Xh = np.asarray(X)
+        valid = np.asarray(frame.mask)
+        if not np.all((Xh[valid] == 0) | (Xh[valid] == 1)):
+            raise ValueError("MinHashLSH requires binary 0/1 vectors")
+        if np.any(Xh[valid].sum(axis=1) == 0):
+            raise ValueError("MinHashLSH: every valid vector needs at "
+                             "least one nonzero entry")
+        rng = np.random.default_rng(self.seed)
+        a = rng.integers(1, _MINHASH_PRIME, size=self.num_hash_tables)
+        b = rng.integers(0, _MINHASH_PRIME, size=self.num_hash_tables)
+        return MinHashLSHModel(a.astype(np.int64), b.astype(np.int64),
+                               self.input_col, self.output_col)
+
+
+@persistable
+class MinHashLSHModel(_LSHModelBase):
+    _persist_attrs = ('coeff_a', 'coeff_b', 'input_col', 'output_col')
+
+    def __init__(self, coeff_a, coeff_b, input_col="features",
+                 output_col="hashes"):
+        self.coeff_a = np.asarray(coeff_a, np.int64)
+        self.coeff_b = np.asarray(coeff_b, np.int64)
+        self.input_col = input_col
+        self.output_col = output_col
+
+    def _hashes(self, X):
+        d = X.shape[1]
+        j = np.arange(1, d + 1, dtype=np.int64)            # 1-indexed
+        hv = (self.coeff_a[:, None] * j[None, :]
+              + self.coeff_b[:, None]) % _MINHASH_PRIME     # (L, d)
+        # int32 masked min — float32 would collapse ids above 2^24
+        hvd = jnp.asarray(hv, jnp.int32)
+        big = jnp.asarray(np.int32(_MINHASH_PRIME))
+        masked = jnp.where(X[:, None, :] > 0, hvd[None, :, :], big)
+        return jnp.min(masked, axis=2)                     # (n, L) int32
+
+    def _jaccard_dist(self, A, B):
+        inter = jnp.sum((A > 0) & (B > 0), axis=1)
+        union = jnp.sum((A > 0) | (B > 0), axis=1)
+        return 1.0 - inter / jnp.maximum(union, 1)
+
+    def _distance(self, A, key):
+        return self._jaccard_dist(A, key[None, :])
+
+    def _distance_rows(self, A, B):
+        return self._jaccard_dist(A, B)

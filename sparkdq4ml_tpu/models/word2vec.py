@@ -345,7 +345,8 @@ class Word2VecModel(Model):
     def vector_size(self):
         return int(self.vectors.shape[1])
 
-    getVectorSize = vector_size
+    def getVectorSize(self):     # PySpark surface: a METHOD, not an attr
+        return self.vector_size
 
     def get_vectors(self):
         from ..frame import Frame

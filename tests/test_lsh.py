@@ -1,0 +1,156 @@
+"""LSH family: bucketed random projection (Euclidean) and MinHash
+(Jaccard) — recall against brute-force neighbors, join correctness vs the
+exact pair set, hashing invariants, persistence."""
+
+import numpy as np
+import pytest
+
+from sparkdq4ml_tpu import Frame
+from sparkdq4ml_tpu.models import (BucketedRandomProjectionLSH,
+                                   BucketedRandomProjectionLSHModel,
+                                   MinHashLSH, MinHashLSHModel,
+                                   VectorAssembler)
+
+
+def _vec_frame(X):
+    d = X.shape[1]
+    cols = {f"x{j}": X[:, j] for j in range(d)}
+    return VectorAssembler([f"x{j}" for j in range(d)],
+                           "features").transform(Frame(cols))
+
+
+class TestBucketedRandomProjectionLSH:
+    def _data(self, n=200, d=5, seed=0):
+        rng = np.random.default_rng(seed)
+        return rng.normal(size=(n, d))
+
+    def test_transform_adds_hash_matrix(self):
+        X = self._data()
+        f = _vec_frame(X)
+        m = BucketedRandomProjectionLSH(bucket_length=1.0,
+                                        num_hash_tables=4, seed=1).fit(f)
+        H = np.asarray(m.transform(f).to_pydict()["hashes"])
+        assert H.shape == (200, 4)
+        assert np.all(H == np.floor(H))
+
+    def test_close_points_collide_more(self):
+        X = self._data()
+        X[1] = X[0] + 0.01          # near-duplicate
+        f = _vec_frame(X)
+        m = BucketedRandomProjectionLSH(bucket_length=2.0,
+                                        num_hash_tables=6, seed=2).fit(f)
+        H = np.asarray(m.transform(f).to_pydict()["hashes"])
+        near = np.mean(H[0] == H[1])
+        far = np.mean(H[0] == H[57])
+        assert near >= far
+
+    def test_nearest_neighbors_high_recall(self):
+        X = self._data(n=300)
+        f = _vec_frame(X)
+        key = X[7] + 0.001
+        m = BucketedRandomProjectionLSH(bucket_length=3.0,
+                                        num_hash_tables=8, seed=3).fit(f)
+        out = m.approx_nearest_neighbors(f, key, 5)
+        d = out.to_pydict()
+        exact = np.argsort(np.linalg.norm(X - key, axis=1))[:5]
+        got_x0 = np.asarray(d["x0"])
+        # recall vs brute force: >= 4 of top-5 found
+        found = sum(any(abs(X[i, 0] - v) < 1e-12 for v in got_x0)
+                    for i in exact)
+        assert found >= 4
+        assert np.all(np.isfinite(np.asarray(d["distCol"])))
+
+    def test_similarity_join_matches_exact(self):
+        rng = np.random.default_rng(5)
+        A = rng.normal(size=(60, 4))
+        B = np.concatenate([A[:20] + 0.001 * rng.normal(size=(20, 4)),
+                            rng.normal(size=(40, 4)) + 8.0])
+        fa, fb = _vec_frame(A), _vec_frame(B)
+        m = BucketedRandomProjectionLSH(bucket_length=2.0,
+                                        num_hash_tables=10, seed=6).fit(fa)
+        out = m.approx_similarity_join(fa, fb, threshold=0.5).to_pydict()
+        pairs = set(zip(np.asarray(out["idA"]).tolist(),
+                        np.asarray(out["idB"]).tolist()))
+        # every returned pair is truly within threshold
+        for ia, ib in pairs:
+            assert np.linalg.norm(A[ia] - B[ib]) <= 0.5
+        # the 20 planted near-duplicates are mostly recovered
+        planted = {(i, i) for i in range(20)}
+        assert len(pairs & planted) >= 17
+
+    def test_requires_bucket_length(self):
+        f = _vec_frame(self._data(20))
+        with pytest.raises(ValueError, match="bucket_length"):
+            BucketedRandomProjectionLSH().fit(f)
+
+    def test_roundtrip(self, tmp_path):
+        from sparkdq4ml_tpu.models.base import load_stage
+
+        f = _vec_frame(self._data(30))
+        m = BucketedRandomProjectionLSH(bucket_length=1.0,
+                                        num_hash_tables=3, seed=1).fit(f)
+        m.save(str(tmp_path / "lsh"))
+        loaded = load_stage(str(tmp_path / "lsh"))
+        assert isinstance(loaded, BucketedRandomProjectionLSHModel)
+        np.testing.assert_array_equal(
+            np.asarray(loaded.transform(f).to_pydict()["hashes"]),
+            np.asarray(m.transform(f).to_pydict()["hashes"]))
+
+
+class TestMinHashLSH:
+    def _binary(self, n=120, d=30, seed=0):
+        rng = np.random.default_rng(seed)
+        return (rng.random((n, d)) < 0.25).astype(np.float64)
+
+    def test_identical_sets_same_hash(self):
+        X = self._binary()
+        X[X.sum(axis=1) == 0, 0] = 1.0
+        X[1] = X[0]
+        f = _vec_frame(X)
+        m = MinHashLSH(num_hash_tables=5, seed=1).fit(f)
+        H = np.asarray(m.transform(f).to_pydict()["hashes"])
+        np.testing.assert_array_equal(H[0], H[1])
+
+    def test_rejects_nonbinary_and_empty(self):
+        f = _vec_frame(np.asarray([[0.5, 1.0], [1.0, 0.0]]))
+        with pytest.raises(ValueError, match="binary"):
+            MinHashLSH().fit(f)
+        g = _vec_frame(np.asarray([[0.0, 0.0], [1.0, 0.0]]))
+        with pytest.raises(ValueError, match="nonzero"):
+            MinHashLSH().fit(g)
+
+    def test_jaccard_neighbors(self):
+        X = self._binary(n=150)
+        X[X.sum(axis=1) == 0, 0] = 1.0
+        key = X[11].copy()
+        f = _vec_frame(X)
+        m = MinHashLSH(num_hash_tables=8, seed=2).fit(f)
+        out = m.approx_nearest_neighbors(f, key, 3).to_pydict()
+        d = np.asarray(out["distCol"])
+        assert d.min() == pytest.approx(0.0)     # the row itself
+
+    def test_similarity_join_distances_correct(self):
+        X = self._binary(n=50, seed=3)
+        X[X.sum(axis=1) == 0, 0] = 1.0
+        Y = X.copy()
+        fa, fb = _vec_frame(X), _vec_frame(Y)
+        m = MinHashLSH(num_hash_tables=6, seed=4).fit(fa)
+        out = m.approx_similarity_join(fa, fb, threshold=0.01).to_pydict()
+        ids = set(zip(np.asarray(out["idA"]).tolist(),
+                      np.asarray(out["idB"]).tolist()))
+        assert {(i, i) for i in range(50)} <= ids   # self-pairs at dist 0
+        assert np.all(np.asarray(out["distCol"]) <= 0.01 + 1e-12)
+
+    def test_roundtrip(self, tmp_path):
+        from sparkdq4ml_tpu.models.base import load_stage
+
+        X = self._binary(30)
+        X[X.sum(axis=1) == 0, 0] = 1.0
+        f = _vec_frame(X)
+        m = MinHashLSH(num_hash_tables=4, seed=5).fit(f)
+        m.save(str(tmp_path / "mh"))
+        loaded = load_stage(str(tmp_path / "mh"))
+        assert isinstance(loaded, MinHashLSHModel)
+        np.testing.assert_array_equal(
+            np.asarray(loaded.transform(f).to_pydict()["hashes"]),
+            np.asarray(m.transform(f).to_pydict()["hashes"]))
