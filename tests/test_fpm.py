@@ -1,0 +1,111 @@
+"""FPGrowth: frequent itemsets vs brute-force enumeration, association
+rule metrics by hand, transform semantics, persistence."""
+
+import itertools
+
+import numpy as np
+import pytest
+
+from sparkdq4ml_tpu import Frame
+from sparkdq4ml_tpu.models import FPGrowth, FPGrowthModel
+from sparkdq4ml_tpu.models.text import _obj_array
+
+
+def brute_force_itemsets(txns, min_count):
+    """All itemsets with count >= min_count, by exhaustive enumeration."""
+    universe = sorted({i for t in txns for i in t})
+    out = {}
+    for r in range(1, len(universe) + 1):
+        for combo in itertools.combinations(universe, r):
+            c = sum(1 for t in txns if set(combo) <= set(t))
+            if c >= min_count:
+                out[frozenset(combo)] = c
+    return out
+
+
+BASKETS = [["bread", "milk"],
+           ["bread", "diaper", "beer", "eggs"],
+           ["milk", "diaper", "beer", "cola"],
+           ["bread", "milk", "diaper", "beer"],
+           ["bread", "milk", "diaper", "cola"]]
+
+
+class TestFPGrowth:
+    def test_matches_brute_force(self):
+        f = Frame({"items": _obj_array(BASKETS)})
+        model = FPGrowth(min_support=0.4, min_confidence=0.5).fit(f)
+        got = {frozenset(s): c for s, c in model.itemsets}
+        want = brute_force_itemsets(BASKETS, min_count=2)
+        assert got == want
+
+    @pytest.mark.parametrize("support", [0.2, 0.6, 1.0])
+    def test_random_data_matches_brute_force(self, support):
+        rng = np.random.default_rng(3)
+        universe = list("abcdef")
+        txns = [list(rng.choice(universe,
+                                size=rng.integers(1, 5), replace=False))
+                for _ in range(30)]
+        f = Frame({"items": _obj_array(txns)})
+        model = FPGrowth(min_support=support).fit(f)
+        got = {frozenset(s): c for s, c in model.itemsets}
+        dedup = [tuple(dict.fromkeys(t)) for t in txns]
+        want = brute_force_itemsets(dedup,
+                                    int(np.ceil(support * len(txns))))
+        assert got == want
+
+    def test_association_rule_metrics(self):
+        f = Frame({"items": _obj_array(BASKETS)})
+        model = FPGrowth(min_support=0.4, min_confidence=0.5).fit(f)
+        d = model.association_rules.to_pydict()
+        rules = {(tuple(a), tuple(c)): (conf, lift, sup)
+                 for a, c, conf, lift, sup in zip(
+                     d["antecedent"], d["consequent"], d["confidence"],
+                     d["lift"], d["support"])}
+        # {beer} -> diaper: conf = freq(beer,diaper)/freq(beer) = 3/3
+        conf, lift, sup = rules[(("beer",), ("diaper",))]
+        assert conf == pytest.approx(1.0)
+        assert lift == pytest.approx(1.0 / (4 / 5))   # P(diaper) = 4/5
+        assert sup == pytest.approx(3 / 5)
+        # every rule clears the confidence threshold
+        assert np.all(np.asarray(d["confidence"]) >= 0.5)
+
+    def test_transform_fires_rules(self):
+        f = Frame({"items": _obj_array(BASKETS)})
+        model = FPGrowth(min_support=0.4, min_confidence=0.9).fit(f)
+        g = Frame({"items": _obj_array([["beer"], ["bread", "milk"],
+                                        None])})
+        pred = model.transform(g).to_pydict()["prediction"]
+        assert "diaper" in pred[0]          # beer -> diaper fires
+        assert "beer" not in pred[1]
+        # no row predicts an item it already has
+        for items, p in zip([["beer"], ["bread", "milk"]], pred[:2]):
+            assert not (set(items) & set(p))
+
+    def test_min_support_validation(self):
+        with pytest.raises(ValueError, match="min_support"):
+            FPGrowth(min_support=0.0)
+        with pytest.raises(ValueError, match="min_confidence"):
+            FPGrowth(min_confidence=1.5)
+
+    def test_masked_rows_excluded(self):
+        txns = BASKETS + [["poison", "bread"]] * 3
+        f = Frame({"items": _obj_array(txns)})
+        keep = np.asarray([True] * 5 + [False] * 3)
+        model = FPGrowth(min_support=0.4).fit(f.filter(keep))
+        all_items = {i for s, _ in model.itemsets for i in s}
+        assert "poison" not in all_items
+        assert model.num_transactions == 5
+
+    def test_roundtrip(self, tmp_path):
+        from sparkdq4ml_tpu.models.base import load_stage
+
+        f = Frame({"items": _obj_array(BASKETS)})
+        model = FPGrowth(min_support=0.4, min_confidence=0.5).fit(f)
+        model.save(str(tmp_path / "fp"))
+        loaded = load_stage(str(tmp_path / "fp"))
+        assert isinstance(loaded, FPGrowthModel)
+        assert {frozenset(s): c for s, c in loaded.itemsets} == \
+            {frozenset(s): c for s, c in model.itemsets}
+        d = loaded.association_rules.to_pydict()
+        assert len(d["confidence"]) == \
+            len(model.association_rules.to_pydict()["confidence"])

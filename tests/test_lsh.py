@@ -82,6 +82,26 @@ class TestBucketedRandomProjectionLSH:
         f = _vec_frame(self._data(20))
         with pytest.raises(ValueError, match="bucket_length"):
             BucketedRandomProjectionLSH().fit(f)
+        with pytest.raises(ValueError, match="num_hash_tables"):
+            BucketedRandomProjectionLSH(bucket_length=1.0,
+                                        num_hash_tables=0)
+
+    def test_join_ids_index_valid_rows(self):
+        """idA/idB are positions among VALID rows — usable directly
+        against to_pydict() output of a filtered frame."""
+        X = self._data(n=30, seed=9)
+        fa = _vec_frame(X)
+        keep = np.ones(30, bool)
+        keep[:10] = False
+        fa_f = fa.filter(keep)                 # valid rows are X[10:]
+        m = BucketedRandomProjectionLSH(bucket_length=50.0,
+                                        num_hash_tables=2, seed=1).fit(fa_f)
+        out = m.approx_similarity_join(fa_f, fa_f, threshold=1e-9)
+        d = out.to_pydict()
+        va = fa_f.to_pydict()["x0"]            # valid-row order
+        for ia_, ib_, dist in zip(d["idA"], d["idB"], d["distCol"]):
+            if dist == 0 and ia_ == ib_:
+                assert va[int(ia_)] == pytest.approx(X[10 + int(ia_), 0])
 
     def test_roundtrip(self, tmp_path):
         from sparkdq4ml_tpu.models.base import load_stage
@@ -118,6 +138,17 @@ class TestMinHashLSH:
         g = _vec_frame(np.asarray([[0.0, 0.0], [1.0, 0.0]]))
         with pytest.raises(ValueError, match="nonzero"):
             MinHashLSH().fit(g)
+
+    def test_rejects_empty_vector_at_query_time(self):
+        X = self._binary(20)
+        X[X.sum(axis=1) == 0, 0] = 1.0
+        f = _vec_frame(X)
+        m = MinHashLSH(num_hash_tables=3, seed=1).fit(f)
+        g = _vec_frame(np.zeros((2, X.shape[1])))
+        with pytest.raises(ValueError, match="nonzero"):
+            m.transform(g)
+        with pytest.raises(ValueError, match="nonzero"):
+            m.approx_nearest_neighbors(f, np.zeros(X.shape[1]), 2)
 
     def test_jaccard_neighbors(self):
         X = self._binary(n=150)
