@@ -44,6 +44,7 @@ from .regression import (IsotonicRegression, IsotonicRegressionModel,
                          LinearRegression, LinearRegressionModel,
                          LinearRegressionSummary,
                          LinearRegressionTrainingSummary)
+from .survival import AFTSurvivalRegression, AFTSurvivalRegressionModel
 from .tuning import (CrossValidator, CrossValidatorModel, ParamGridBuilder,
                      TrainValidationSplit, TrainValidationSplitModel)
 from .fpm import FPGrowth, FPGrowthModel

@@ -1,0 +1,153 @@
+"""AFTSurvivalRegression: coefficient parity vs a scipy BFGS fit of the
+identical Weibull-AFT likelihood, censoring semantics, quantile math,
+sharded≡single, persistence."""
+
+import numpy as np
+import pytest
+
+from conftest import assert_devices
+from sparkdq4ml_tpu import Frame
+from sparkdq4ml_tpu.models import (AFTSurvivalRegression,
+                                   AFTSurvivalRegressionModel,
+                                   VectorAssembler)
+from sparkdq4ml_tpu.parallel.mesh import make_mesh
+
+
+def aft_data(n=250, seed=0, censor_frac=0.3):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, 2))
+    beta = np.asarray([0.8, -0.5])
+    sigma = 0.5
+    eps = np.log(rng.exponential(size=n))          # Gumbel(min) via -log E
+    t = np.exp(1.2 + X @ beta + sigma * eps)
+    censor = (rng.random(n) > censor_frac).astype(np.float64)
+    # censored rows observe a time before the event
+    t_obs = np.where(censor == 1.0, t, t * rng.uniform(0.3, 1.0, size=n))
+    return X, t_obs, censor
+
+
+def build_frame(X, t, c):
+    cols = {"x0": X[:, 0], "x1": X[:, 1], "label": t, "censor": c}
+    return VectorAssembler(["x0", "x1"], "features").transform(Frame(cols))
+
+
+def scipy_aft(X, t, c):
+    """BFGS on the identical negative log-likelihood (the test oracle)."""
+    from scipy.optimize import minimize
+
+    n, d = X.shape
+    mu_x = X.mean(axis=0)
+    sd_x = X.std(ddof=1, axis=0)
+    Xs = (X - 0.0) / sd_x       # match the model: scale only, no centering
+    lt = np.log(t)
+
+    def nll(p):
+        beta, b0, logsig = p[:d], p[d], p[d + 1]
+        sig = np.exp(logsig)
+        eps = (lt - b0 - Xs @ beta) / sig
+        return np.sum(np.exp(eps) - c * (eps - logsig)) / n
+
+    p0 = np.zeros(d + 2)
+    p0[d] = lt.mean()
+    r = minimize(nll, p0, method="BFGS", options={"maxiter": 500})
+    return r.x[:d] / sd_x, r.x[d], float(np.exp(r.x[d + 1]))
+
+
+class TestAFT:
+    def test_matches_scipy_mle(self):
+        X, t, c = aft_data()
+        f = build_frame(X, t, c)
+        model = AFTSurvivalRegression(max_iter=800, step_size=0.05).fit(f)
+        beta_ref, b0_ref, sig_ref = scipy_aft(X, t, c)
+        np.testing.assert_allclose(model.coefficients, beta_ref,
+                                   rtol=2e-2, atol=2e-3)
+        assert model.intercept == pytest.approx(b0_ref, rel=2e-2)
+        assert model.scale == pytest.approx(sig_ref, rel=5e-2)
+
+    def test_recovers_planted_coefficients(self):
+        X, t, c = aft_data(n=800, seed=3, censor_frac=0.2)
+        f = build_frame(X, t, c)
+        model = AFTSurvivalRegression(max_iter=800, step_size=0.05).fit(f)
+        # planted betas (0.8, -0.5) — censoring biases slightly
+        assert model.coefficients[0] == pytest.approx(0.8, abs=0.15)
+        assert model.coefficients[1] == pytest.approx(-0.5, abs=0.15)
+        assert 0.3 < model.scale < 0.8
+
+    def test_censoring_changes_fit(self):
+        X, t, _ = aft_data(seed=5)
+        f_all = build_frame(X, t, np.ones_like(t))
+        f_cens = build_frame(X, t, np.zeros_like(t))
+        m1 = AFTSurvivalRegression(max_iter=200).fit(f_all)
+        m2 = AFTSurvivalRegression(max_iter=200).fit(f_cens)
+        assert not np.allclose(m1.coefficients, m2.coefficients)
+
+    def test_quantiles_and_predict(self):
+        X, t, c = aft_data()
+        f = build_frame(X, t, c)
+        est = AFTSurvivalRegression(max_iter=300,
+                                    quantile_probabilities=(0.25, 0.5, 0.75),
+                                    quantiles_col="q")
+        model = est.fit(f)
+        p = model.predict(X[0])
+        qs = model.predict_quantiles(X[0])
+        mu = np.log(p)
+        expect = p * (-np.log1p(-np.asarray([0.25, 0.5, 0.75]))) ** \
+            model.scale
+        np.testing.assert_allclose(qs, expect, rtol=1e-9)
+        assert np.all(np.diff(qs) > 0)               # quantiles ascend
+        d = model.transform(f).to_pydict()
+        assert np.asarray(d["q"]).shape == (250, 3)
+        assert np.all(np.isfinite(np.asarray(d["prediction"])))
+
+    def test_validations(self):
+        X, t, c = aft_data(n=40)
+        t[3] = -1.0
+        with pytest.raises(ValueError, match="> 0"):
+            AFTSurvivalRegression(max_iter=10).fit(build_frame(X, t, c))
+        t[3] = np.inf
+        with pytest.raises(ValueError, match="finite"):
+            AFTSurvivalRegression(max_iter=10).fit(build_frame(X, t, c))
+        t[3] = 1.0
+        c[5] = 0.5
+        with pytest.raises(ValueError, match="censor"):
+            AFTSurvivalRegression(max_iter=10).fit(build_frame(X, t, c))
+        with pytest.raises(ValueError, match="quantile"):
+            AFTSurvivalRegression(quantile_probabilities=(0.5, 1.0))
+        assert AFTSurvivalRegression().setPredictionCol(
+            "p").prediction_col == "p"
+
+    def test_sharded_equals_single(self):
+        assert_devices(8)
+        X, t, c = aft_data(n=203, seed=7)
+        f = build_frame(X, t, c)
+        kw = dict(max_iter=150, step_size=0.05)
+        single = AFTSurvivalRegression(**kw).fit(f)
+        sharded = AFTSurvivalRegression(**kw).fit(f, mesh=make_mesh(8))
+        np.testing.assert_allclose(sharded.coefficients,
+                                   single.coefficients, rtol=1e-7,
+                                   atol=1e-9)
+        assert sharded.scale == pytest.approx(single.scale, rel=1e-7)
+
+    def test_masked_rows_excluded(self):
+        X, t, c = aft_data(n=100, seed=9)
+        keep = np.ones(100, bool)
+        keep[::5] = False
+        tp = t.copy()
+        tp[~keep] = 1e9                 # poisoned survival times, masked
+        f_masked = build_frame(X, tp, c).filter(keep)
+        f_clean = build_frame(X[keep], t[keep], c[keep])
+        kw = dict(max_iter=150, step_size=0.05)
+        m1 = AFTSurvivalRegression(**kw).fit(f_masked)
+        m2 = AFTSurvivalRegression(**kw).fit(f_clean)
+        np.testing.assert_allclose(m1.coefficients, m2.coefficients,
+                                   rtol=1e-7, atol=1e-9)
+
+    def test_roundtrip(self, tmp_path):
+        from sparkdq4ml_tpu.models.base import load_stage
+
+        X, t, c = aft_data(n=60)
+        model = AFTSurvivalRegression(max_iter=100).fit(build_frame(X, t, c))
+        model.save(str(tmp_path / "aft"))
+        loaded = load_stage(str(tmp_path / "aft"))
+        assert isinstance(loaded, AFTSurvivalRegressionModel)
+        assert loaded.predict(X[0]) == pytest.approx(model.predict(X[0]))
