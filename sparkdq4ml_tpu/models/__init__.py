@@ -47,6 +47,8 @@ from .regression import (IsotonicRegression, IsotonicRegressionModel,
 from .survival import AFTSurvivalRegression, AFTSurvivalRegressionModel
 from .tuning import (CrossValidator, CrossValidatorModel, ParamGridBuilder,
                      TrainValidationSplit, TrainValidationSplitModel)
+from .fm import (FMClassificationModel, FMClassifier, FMRegressionModel,
+                 FMRegressor)
 from .fpm import FPGrowth, FPGrowthModel
 from .lsh import (BucketedRandomProjectionLSH,
                   BucketedRandomProjectionLSHModel, MinHashLSH,

@@ -251,3 +251,36 @@ def solve(A: jnp.ndarray, reg_param: float, elastic_net_param: float,
     return owlqn_solve(A, reg_param, elastic_net_param, max_iter=max_iter,
                        tol=tol, fit_intercept=fit_intercept,
                        standardization=standardization)
+
+
+def adam_scan(value_and_grad, params0, max_iter: int, lr: float,
+              grad_mask=None, b1: float = 0.9, b2: float = 0.999,
+              eps: float = 1e-8):
+    """Full-batch Adam (bias-corrected) as ONE ``lax.scan`` over a params
+    pytree — the shared optimizer of the non-Gramian fits (Weibull AFT,
+    factorization machines). ``value_and_grad(params) -> (loss, grads)``;
+    ``grad_mask`` optionally transforms the gradient pytree (e.g. zeroing
+    frozen parameter groups). Returns (params, loss_history).
+    """
+    leaves = jax.tree_util.tree_leaves(params0)
+    dt = leaves[0].dtype
+    m0 = jax.tree_util.tree_map(jnp.zeros_like, params0)
+
+    def body(state, i):
+        p, m, v = state
+        loss, g = value_and_grad(p)
+        if grad_mask is not None:
+            g = grad_mask(g)
+        m = jax.tree_util.tree_map(lambda a, b_: b1 * a + (1 - b1) * b_,
+                                   m, g)
+        v = jax.tree_util.tree_map(
+            lambda a, b_: b2 * a + (1 - b2) * b_ * b_, v, g)
+        t = i + 1
+        p = jax.tree_util.tree_map(
+            lambda p_, m_, v_: p_ - lr * (m_ / (1 - b1 ** t))
+            / (jnp.sqrt(v_ / (1 - b2 ** t)) + eps), p, m, v)
+        return (p, m, v), loss
+
+    (params, _, _), history = jax.lax.scan(
+        body, (params0, m0, m0), jnp.arange(max_iter, dtype=dt))
+    return params, history

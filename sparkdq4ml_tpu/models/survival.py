@@ -64,28 +64,14 @@ def _aft_core(X, logt, censor, mask, n, std, max_iter, lr, axis=None):
         term = jnp.where(mask, jnp.exp(eps) - dl * (eps - logsig), 0.0)
         return reduce_(jnp.sum(term)) / n
 
-    grad_fn = jax.value_and_grad(neg_ll)
+    from .solvers import adam_scan
 
     p0 = jnp.zeros((d + 2,), dt)
     # init β₀ to mean log t (the σ=1, β=0 stationary point neighborhood)
     b0_init = reduce_(jnp.sum(lt)) / n
     p0 = p0.at[d].set(b0_init)
 
-    b1, b2, eps_adam = 0.9, 0.999, 1e-8
-
-    def body(state, i):
-        p, m, v = state
-        loss, g = grad_fn(p)
-        m = b1 * m + (1 - b1) * g
-        v = b2 * v + (1 - b2) * g * g
-        mh = m / (1 - b1 ** (i + 1))
-        vh = v / (1 - b2 ** (i + 1))
-        p = p - lr * mh / (jnp.sqrt(vh) + eps_adam)
-        return (p, m, v), loss
-
-    (p, _, _), history = jax.lax.scan(
-        body, (p0, jnp.zeros_like(p0), jnp.zeros_like(p0)),
-        jnp.arange(max_iter, dtype=dt))
+    p, history = adam_scan(jax.value_and_grad(neg_ll), p0, max_iter, lr)
     beta = jnp.where(valid, p[:d] / sx, 0.0)   # unscale to raw features
     return AftFit(beta, p[d], jnp.exp(p[d + 1]), history)
 
@@ -146,6 +132,8 @@ class AFTSurvivalRegression(Estimator):
     @staticmethod
     def _check_probs(v):
         probs = tuple(float(q) for q in v)
+        if not probs:
+            raise ValueError("quantile probabilities must be non-empty")
         if any(not 0.0 < q < 1.0 for q in probs):
             raise ValueError("quantile probabilities must be in (0, 1)")
         return probs

@@ -1,0 +1,135 @@
+"""Factorization machines: planted low-rank interaction recovery,
+classification quality, sharded≡single, masked rows, persistence."""
+
+import numpy as np
+import pytest
+
+from conftest import assert_devices
+from sparkdq4ml_tpu import Frame
+from sparkdq4ml_tpu.models import (FMClassifier, FMClassificationModel,
+                                   FMRegressor, FMRegressionModel,
+                                   VectorAssembler)
+from sparkdq4ml_tpu.parallel.mesh import make_mesh
+
+
+def interaction_data(n=500, d=6, seed=0, noise=0.05):
+    """y depends on a planted pairwise interaction x0*x1 plus linears."""
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, d))
+    y = (1.0 + 0.5 * X[:, 2] + 2.0 * X[:, 0] * X[:, 1]
+         + noise * rng.normal(size=n))
+    return X, y
+
+
+def build(X, y):
+    d = X.shape[1]
+    cols = {f"x{j}": X[:, j] for j in range(d)}
+    cols["label"] = y
+    return VectorAssembler([f"x{j}" for j in range(d)],
+                           "features").transform(Frame(cols))
+
+
+def r2(y, p):
+    return 1 - np.sum((y - p) ** 2) / np.sum((y - y.mean()) ** 2)
+
+
+class TestFMRegressor:
+    def test_learns_planted_interaction(self):
+        X, y = interaction_data()
+        f = build(X, y)
+        model = FMRegressor(factor_size=4, max_iter=600, step_size=0.05,
+                            seed=1).fit(f)
+        pred = np.asarray(model.transform(f).to_pydict()["prediction"],
+                          np.float64)
+        assert r2(y, pred) > 0.95
+        # a pure linear model cannot: the interaction carries the signal
+        from sparkdq4ml_tpu.models import LinearRegression
+
+        lin = LinearRegression(max_iter=100).fit(f)
+        lin_pred = np.asarray(lin.transform(f).to_pydict()["prediction"],
+                              np.float64)
+        assert r2(y, pred) > r2(y, lin_pred) + 0.3
+
+    def test_loss_decreases(self):
+        X, y = interaction_data(seed=2)
+        model = FMRegressor(factor_size=3, max_iter=200, seed=1).fit(
+            build(X, y))
+        h = model.loss_history
+        assert h[-1] < h[0] * 0.5
+
+    def test_fit_linear_false(self):
+        X, y = interaction_data(seed=3)
+        model = FMRegressor(factor_size=3, max_iter=50, fit_linear=False,
+                            seed=1).fit(build(X, y))
+        np.testing.assert_array_equal(model.linear, 0.0)
+
+    def test_sharded_equals_single(self):
+        assert_devices(8)
+        X, y = interaction_data(n=203, seed=4)
+        f = build(X, y)
+        kw = dict(factor_size=3, max_iter=100, step_size=0.05, seed=1)
+        single = FMRegressor(**kw).fit(f, mesh=make_mesh(1))
+        sharded = FMRegressor(**kw).fit(f, mesh=make_mesh(8))
+        np.testing.assert_allclose(sharded.factors, single.factors,
+                                   rtol=1e-6, atol=1e-9)
+        np.testing.assert_allclose(sharded.linear, single.linear,
+                                   rtol=1e-6, atol=1e-9)
+
+    def test_masked_rows_excluded(self):
+        X, y = interaction_data(n=160, seed=5)
+        keep = np.ones(160, bool)
+        keep[::4] = False
+        yp = y.copy()
+        yp[~keep] = 1e6
+        kw = dict(factor_size=3, max_iter=150, seed=1)
+        m1 = FMRegressor(**kw).fit(build(X, yp).filter(keep))
+        m2 = FMRegressor(**kw).fit(build(X[keep], y[keep]))
+        np.testing.assert_allclose(m1.factors, m2.factors, rtol=1e-7,
+                                   atol=1e-10)
+
+    def test_roundtrip(self, tmp_path):
+        from sparkdq4ml_tpu.models.base import load_stage
+
+        X, y = interaction_data(n=80)
+        model = FMRegressor(factor_size=2, max_iter=50, seed=1).fit(
+            build(X, y))
+        model.save(str(tmp_path / "fm"))
+        loaded = load_stage(str(tmp_path / "fm"))
+        assert isinstance(loaded, FMRegressionModel)
+        assert loaded.predict(X[0]) == pytest.approx(model.predict(X[0]))
+
+
+class TestFMClassifier:
+    def test_xor_like_separation(self):
+        """An interaction-driven boundary a linear model cannot learn."""
+        rng = np.random.default_rng(7)
+        n = 600
+        X = rng.normal(size=(n, 2))
+        y = (X[:, 0] * X[:, 1] > 0).astype(np.float64)    # XOR quadrant
+        f = build(X, y)
+        model = FMClassifier(factor_size=4, max_iter=600, step_size=0.05,
+                             seed=1).fit(f)
+        d = model.transform(f).to_pydict()
+        acc = np.mean(np.asarray(d["prediction"]) == y)
+        assert acc > 0.9
+        prob = np.asarray(d["probability"])
+        assert prob.shape == (n, 2)
+        np.testing.assert_allclose(prob.sum(axis=1), 1.0, rtol=1e-5)
+
+    def test_rejects_nonbinary(self):
+        X, y = interaction_data(n=50)
+        with pytest.raises(ValueError, match="binary"):
+            FMClassifier(max_iter=5).fit(build(X, y))
+
+    def test_roundtrip(self, tmp_path):
+        from sparkdq4ml_tpu.models.base import load_stage
+
+        rng = np.random.default_rng(8)
+        X = rng.normal(size=(60, 2))
+        y = (X[:, 0] > 0).astype(np.float64)
+        model = FMClassifier(factor_size=2, max_iter=50, seed=1).fit(
+            build(X, y))
+        model.save(str(tmp_path / "fmc"))
+        loaded = load_stage(str(tmp_path / "fmc"))
+        assert isinstance(loaded, FMClassificationModel)
+        assert loaded.predict(X[0]) == model.predict(X[0])
