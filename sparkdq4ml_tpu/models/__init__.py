@@ -21,10 +21,13 @@ from .feature import (Binarizer, Bucketizer, ChiSqSelector,
                       MaxAbsScalerModel, MinMaxScaler, MinMaxScalerModel,
                       Normalizer, OneHotEncoder, OneHotEncoderModel, PCA,
                       PCAModel, PolynomialExpansion, QuantileDiscretizer,
-                      RFormula, RFormulaModel, SQLTransformer,
+                      RFormula, RFormulaModel, RobustScaler,
+                      RobustScalerModel, SQLTransformer,
                       StandardScaler, StandardScalerModel, StringIndexer,
                       StringIndexerModel, VectorAssembler, VectorIndexer,
-                      VectorIndexerModel, VectorSlicer)
+                      VectorIndexerModel, VectorSlicer,
+                      VarianceThresholdSelector,
+                      VarianceThresholdSelectorModel)
 from .glm import (GeneralizedLinearRegression,
                   GeneralizedLinearRegressionModel, GlmTrainingSummary)
 from .linalg import Vectors

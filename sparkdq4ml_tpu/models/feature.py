@@ -1623,3 +1623,188 @@ class FeatureHasher(Transformer):
                 col = np.asarray(arr, np.float64)
                 M[:, j] += np.where(np.isfinite(col), col, 0.0)
         return frame.with_column(self.output_col, jnp.asarray(M))
+
+
+@persistable
+class RobustScaler(Estimator):
+    """MLlib ``RobustScaler``: center by median, scale by IQR (quantile
+    range). Quantiles are a host pass over valid rows (data-dependent
+    order statistics — same boundary as QuantileDiscretizer); the
+    transform is one fused device subtract/divide."""
+
+    _persist_attrs = ('with_centering', 'with_scaling', 'lower', 'upper',
+                      'input_col', 'output_col')
+
+    def __init__(self, with_centering: bool = False,
+                 with_scaling: bool = True, lower: float = 0.25,
+                 upper: float = 0.75, input_col: str = "features",
+                 output_col: str = "scaled_features"):
+        if not 0.0 <= lower < upper <= 1.0:
+            raise ValueError("need 0 <= lower < upper <= 1")
+        self.with_centering = bool(with_centering)
+        self.with_scaling = bool(with_scaling)
+        self.lower = float(lower)
+        self.upper = float(upper)
+        self.input_col = input_col
+        self.output_col = output_col
+
+    def set_with_centering(self, v):
+        self.with_centering = bool(v)
+        return self
+
+    def set_with_scaling(self, v):
+        self.with_scaling = bool(v)
+        return self
+
+    def set_lower(self, v):
+        self.lower = float(v)
+        self._check_bounds()
+        return self
+
+    def set_upper(self, v):
+        self.upper = float(v)
+        self._check_bounds()
+        return self
+
+    def _check_bounds(self):
+        if not 0.0 <= self.lower < self.upper <= 1.0:
+            raise ValueError("need 0 <= lower < upper <= 1")
+
+    def set_input_col(self, v):
+        self.input_col = v
+        return self
+
+    def set_output_col(self, v):
+        self.output_col = v
+        return self
+
+    setWithCentering = set_with_centering
+    setWithScaling = set_with_scaling
+    setLower = set_lower
+    setUpper = set_upper
+    setInputCol = set_input_col
+    setOutputCol = set_output_col
+
+    def fit(self, frame) -> "RobustScalerModel":
+        self._check_bounds()
+        X = np.asarray(frame._column_values(self.input_col),
+                       np.dtype(float_dtype()))
+        if X.ndim == 1:
+            X = X[:, None]
+        mask = np.asarray(frame.mask)
+        if mask.sum() == 0:
+            raise ValueError("RobustScaler: no valid rows")
+        Xv = X[mask]
+        d = Xv.shape[1]
+        # NaN values are ignored in the statistics (MLlib convention); each
+        # pass is skipped entirely when its statistic is unused
+        with np.errstate(all="ignore"):
+            med = np.nanmedian(Xv, axis=0) if self.with_centering \
+                else np.zeros(d)
+            if self.with_scaling:
+                rng = (np.nanquantile(Xv, self.upper, axis=0)
+                       - np.nanquantile(Xv, self.lower, axis=0))
+                # MLlib: zero-range (constant) features map to 0.0
+                scale = np.where(np.nan_to_num(rng) > 0, 1.0 / rng, 0.0)
+            else:
+                scale = np.ones(d)
+        med = np.nan_to_num(med)     # all-NaN column: center 0, scale 0
+        return RobustScalerModel(med, scale, self.input_col,
+                                 self.output_col)
+
+
+@persistable
+class RobustScalerModel(Model):
+    """``scale`` is the multiplicative factor (0 for zero-range features —
+    the MLlib convention StandardScalerModel also follows)."""
+
+    _persist_attrs = ('median', 'scale', 'input_col', 'output_col')
+
+    def __init__(self, median, scale, input_col="features",
+                 output_col="scaled_features"):
+        self.median = np.asarray(median, np.float64)
+        self.scale = np.asarray(scale, np.float64)
+        self.input_col = input_col
+        self.output_col = output_col
+
+    def transform(self, frame):
+        X = jnp.asarray(frame._column_values(self.input_col), float_dtype())
+        squeeze = X.ndim == 1
+        if squeeze:
+            X = X[:, None]
+        out = (X - jnp.asarray(self.median, X.dtype)) \
+            * jnp.asarray(self.scale, X.dtype)
+        return frame.with_column(self.output_col,
+                                 out[:, 0] if squeeze else out)
+
+
+@persistable
+class VarianceThresholdSelector(Estimator):
+    """MLlib ``VarianceThresholdSelector``: keep features whose (sample)
+    variance exceeds ``variance_threshold`` — ONE masked moment pass on
+    device (the Summarizer statistic), selection is a gather."""
+
+    _persist_attrs = ('variance_threshold', 'features_col', 'output_col')
+
+    def __init__(self, variance_threshold: float = 0.0,
+                 features_col: str = "features",
+                 output_col: str = "selected_features"):
+        self.variance_threshold = float(variance_threshold)
+        self.features_col = features_col
+        self.output_col = output_col
+
+    def set_variance_threshold(self, v):
+        self.variance_threshold = float(v)
+        return self
+
+    def set_features_col(self, v):
+        self.features_col = v
+        return self
+
+    def set_output_col(self, v):
+        self.output_col = v
+        return self
+
+    setVarianceThreshold = set_variance_threshold
+    setFeaturesCol = set_features_col
+    setOutputCol = set_output_col
+
+    def fit(self, frame) -> "VarianceThresholdSelectorModel":
+        from .stat import _extract, _moment_pass
+
+        if not np.asarray(frame.mask).any():
+            raise ValueError("VarianceThresholdSelector: no valid rows")
+        X, w = _extract(frame, self.features_col)
+        n, _, C, *_ = _moment_pass(X, w)
+        var = np.diag(np.asarray(C)) / max(float(n) - 1.0, 1.0)
+        keep = np.nonzero(var > self.variance_threshold)[0]
+        if keep.size == 0:
+            raise ValueError("VarianceThresholdSelector: no feature "
+                             "exceeds the variance threshold")
+        return VarianceThresholdSelectorModel(
+            keep.astype(np.int64).tolist(), self.features_col,
+            self.output_col)
+
+
+@persistable
+class VarianceThresholdSelectorModel(Model):
+    _persist_attrs = ('selected_features', 'features_col', 'output_col')
+
+    def __init__(self, selected_features, features_col="features",
+                 output_col="selected_features"):
+        self.selected_features = [int(i) for i in selected_features]
+        self.features_col = features_col
+        self.output_col = output_col
+
+    def _post_load(self):
+        self.selected_features = [int(i) for i in self.selected_features]
+
+    selectedFeatures = property(lambda self: list(self.selected_features))
+
+    def transform(self, frame):
+        X = jnp.asarray(frame._column_values(self.features_col),
+                        float_dtype())
+        if X.ndim == 1:
+            X = X[:, None]
+        sel = jnp.asarray(self.selected_features, jnp.int32)
+        return frame.with_column(self.output_col, X[:, sel])

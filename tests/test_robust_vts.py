@@ -1,0 +1,127 @@
+"""RobustScaler + VarianceThresholdSelector — sklearn oracles."""
+
+import numpy as np
+import pytest
+
+from sparkdq4ml_tpu import Frame
+from sparkdq4ml_tpu.models import (RobustScaler, RobustScalerModel,
+                                   VarianceThresholdSelector,
+                                   VectorAssembler)
+
+
+def _frame(X):
+    d = X.shape[1]
+    cols = {f"x{j}": X[:, j] for j in range(d)}
+    return VectorAssembler([f"x{j}" for j in range(d)],
+                           "features").transform(Frame(cols))
+
+
+class TestRobustScaler:
+    def test_matches_sklearn(self):
+        pytest.importorskip("sklearn")
+        from sklearn.preprocessing import RobustScaler as SkRS
+
+        rng = np.random.default_rng(0)
+        X = rng.normal(size=(120, 3)) * np.asarray([1.0, 5.0, 0.1])
+        f = _frame(X)
+        ours = RobustScaler(with_centering=True).fit(f)
+        out = np.asarray(ours.transform(f).to_pydict()["scaled_features"],
+                         np.float64)
+        sk = SkRS().fit_transform(X)
+        np.testing.assert_allclose(out, sk, rtol=1e-5, atol=1e-7)
+
+    def test_no_centering_default(self):
+        rng = np.random.default_rng(1)
+        X = rng.normal(loc=100.0, size=(60, 2))
+        f = _frame(X)
+        m = RobustScaler().fit(f)          # Spark default: scale only
+        np.testing.assert_array_equal(m.median, 0.0)
+        out = np.asarray(m.transform(f).to_pydict()["scaled_features"])
+        assert np.all(np.asarray(out).mean(axis=0) > 50)  # not centered
+
+    def test_masked_rows_excluded(self):
+        X = np.concatenate([np.arange(20, dtype=np.float64)[:, None],
+                            np.arange(20, dtype=np.float64)[:, None]],
+                           axis=1)
+        Xp = X.copy()
+        Xp[10:] = 1e9
+        keep = np.arange(20) < 10
+        m1 = RobustScaler(with_centering=True).fit(_frame(Xp).filter(keep))
+        m2 = RobustScaler(with_centering=True).fit(_frame(X[:10]))
+        np.testing.assert_allclose(m1.median, m2.median)
+        np.testing.assert_allclose(m1.scale, m2.scale)
+
+    def test_constant_feature_maps_to_zero(self):
+        # MLlib convention: zero-range features → 0.0 (like StandardScaler)
+        X = np.ones((30, 2)) * 100.0
+        m = RobustScaler().fit(_frame(X))
+        out = np.asarray(m.transform(_frame(X)).to_pydict()
+                         ["scaled_features"])
+        np.testing.assert_array_equal(out, 0.0)
+
+    def test_nan_values_ignored_in_stats(self):
+        rng = np.random.default_rng(6)
+        X = rng.normal(size=(80, 2))
+        Xn = X.copy()
+        Xn[5, 0] = np.nan
+        m = RobustScaler(with_centering=True).fit(_frame(Xn))
+        ref = RobustScaler(with_centering=True).fit(
+            _frame(X[np.arange(80) != 5]))
+        # feature 0's stats ignore the NaN row; feature 1 unaffected
+        assert np.all(np.isfinite(m.median)) and np.all(
+            np.isfinite(m.scale))
+        assert m.median[1] == pytest.approx(
+            np.median(Xn[:, 1]), rel=1e-12)
+
+    def test_invalid_bounds_rejected(self):
+        with pytest.raises(ValueError, match="lower < upper"):
+            RobustScaler(lower=0.8, upper=0.2)
+        with pytest.raises(ValueError, match="lower < upper"):
+            RobustScaler().setLower(0.9)
+
+    def test_roundtrip(self, tmp_path):
+        from sparkdq4ml_tpu.models.base import load_stage
+
+        rng = np.random.default_rng(2)
+        X = rng.normal(size=(40, 2))
+        m = RobustScaler(with_centering=True).fit(_frame(X))
+        m.save(str(tmp_path / "rs"))
+        loaded = load_stage(str(tmp_path / "rs"))
+        assert isinstance(loaded, RobustScalerModel)
+        np.testing.assert_array_equal(loaded.median, m.median)
+
+
+class TestVarianceThresholdSelector:
+    def test_matches_sklearn(self):
+        pytest.importorskip("sklearn")
+        from sklearn.feature_selection import VarianceThreshold
+
+        rng = np.random.default_rng(3)
+        X = rng.normal(size=(100, 4))
+        X[:, 1] *= 0.01                        # near-constant
+        X[:, 3] = 7.0                          # constant
+        f = _frame(X)
+        m = VarianceThresholdSelector(variance_threshold=0.05).fit(f)
+        # sklearn uses population variance; ours is sample (n-1), MLlib's
+        # convention — compare selections computed consistently
+        var = X.var(axis=0, ddof=1)
+        expect = np.nonzero(var > 0.05)[0].tolist()
+        assert m.selected_features == expect
+        out = np.asarray(m.transform(f).to_pydict()["selected_features"],
+                         np.float64)
+        np.testing.assert_allclose(out, X[:, expect], rtol=1e-6)
+
+    def test_all_filtered_raises(self):
+        X = np.ones((30, 2))
+        with pytest.raises(ValueError, match="variance threshold"):
+            VarianceThresholdSelector(variance_threshold=1.0).fit(_frame(X))
+
+    def test_roundtrip(self, tmp_path):
+        from sparkdq4ml_tpu.models.base import load_stage
+
+        rng = np.random.default_rng(4)
+        X = rng.normal(size=(50, 3))
+        m = VarianceThresholdSelector().fit(_frame(X))
+        m.save(str(tmp_path / "vts"))
+        loaded = load_stage(str(tmp_path / "vts"))
+        assert loaded.selected_features == m.selected_features
