@@ -114,6 +114,72 @@ def main() -> None:
     print(f"k=3 guest clustering silhouette: {sil:.3f} "
           f"(sizes {sorted(km.summary.cluster_sizes)})")
 
+    # --- round-3 families: SVC, FM, survival, patterns, embeddings ----------
+    from sparkdq4ml_tpu import Frame
+    from sparkdq4ml_tpu.models import (AFTSurvivalRegression,
+                                       BucketedRandomProjectionLSH,
+                                       FMClassifier, FPGrowth,
+                                       IsotonicRegression, LinearSVC,
+                                       Word2Vec)
+
+    svc = LinearSVC(max_iter=100, reg_param=0.01).fit(ldf)
+    svc_out = svc.transform(ldf).to_pydict()
+    print(f"linear-SVC large-party accuracy: "
+          f"{float(np.mean(svc_out['prediction'] == svc_out['label'])):.3f}")
+
+    rng = np.random.default_rng(0)
+    Xf = rng.normal(size=(400, 2))
+    yf = (Xf[:, 0] * Xf[:, 1] > 0).astype(np.float64)   # XOR quadrants
+    fm_df = VectorAssembler(["a", "b"], "features").transform(
+        Frame({"a": Xf[:, 0], "b": Xf[:, 1], "label": yf}))
+    fm = FMClassifier(factor_size=4, max_iter=400, step_size=0.05,
+                      seed=1).fit(fm_df)
+    fm_acc = float(np.mean(np.asarray(
+        fm.transform(fm_df).to_pydict()["prediction"]) == yf))
+    print(f"factorization-machine XOR accuracy: {fm_acc:.3f} "
+          f"(a linear model gets ~0.5)")
+
+    iso = IsotonicRegression().fit(Frame({
+        "features": np.asarray(fdf.to_pydict()["guest"], np.float64),
+        "label": np.asarray(fdf.to_pydict()["price"], np.float64)}))
+    print(f"isotonic price(30 guests): {iso.predict(30.0):.2f}")
+
+    t = np.exp(1.0 + 0.3 * Xf[:, 0]
+               + 0.4 * np.log(rng.exponential(size=400)))
+    aft_df = VectorAssembler(["a"], "features").transform(Frame({
+        "a": Xf[:, 0], "label": t,
+        "censor": (rng.random(400) > 0.2).astype(np.float64)}))
+    aft = AFTSurvivalRegression(max_iter=300).fit(aft_df)
+    print(f"AFT survival: coef {float(aft.coefficients[0]):+.3f}, "
+          f"scale {aft.scale:.3f}")
+
+    baskets = Frame({"items": dq.list_column(
+        [["wine", "cheese"], ["wine", "cheese", "bread"],
+         ["beer", "chips"], ["wine", "cheese", "grapes"],
+         ["beer", "chips", "salsa"]])})
+    fp = FPGrowth(min_support=0.4, min_confidence=0.7).fit(baskets)
+    top_rule = fp.association_rules.to_pydict()
+    if len(top_rule["confidence"]):
+        print(f"FPGrowth: {len(fp.itemsets)} frequent itemsets, e.g. rule "
+              f"{top_rule['antecedent'][0]} -> {top_rule['consequent'][0]}")
+
+    docs = Frame({"toks": dq.list_column(
+        [list(rng.choice(["wine", "cheese", "grapes"], 6))
+         if rng.random() < 0.5 else
+         list(rng.choice(["beer", "chips", "salsa"], 6))
+         for _ in range(200)])})
+    w2v = Word2Vec(vector_size=8, min_count=1, max_iter=8, window_size=3,
+                   batch_size=256, seed=1, input_col="toks",
+                   output_col="vec").fit(docs)
+    syn = w2v.find_synonyms("wine", 1).to_pydict()["word"][0]
+    print(f"word2vec nearest neighbor of 'wine': {syn}")
+
+    lsh = BucketedRandomProjectionLSH(bucket_length=2.0, num_hash_tables=4,
+                                      seed=3).fit(fm_df)
+    nn = lsh.approx_nearest_neighbors(fm_df, Xf[0], 3)
+    print(f"LSH 3-NN distances: "
+          f"{np.round(np.sort(np.asarray(nn.to_pydict()['distCol'])), 3)}")
+
 
 if __name__ == "__main__":
     main()

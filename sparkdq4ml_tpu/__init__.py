@@ -4,7 +4,7 @@ layer + SQL subset + MLlib-convention estimators, distributed via
 jax.sharding meshes and XLA collectives."""
 
 from .config import config
-from .frame import Frame, read_csv
+from .frame import Frame, list_column, read_csv
 from .ops import (col, lit, call_udf, callUDF, register_udf,
                   minimum_price_rule, price_correlation_rule,
                   register_builtin_rules)

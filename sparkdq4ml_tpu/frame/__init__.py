@@ -1,2 +1,2 @@
-from .frame import Frame
+from .frame import Frame, list_column
 from .csv import DataFrameReader, read_csv

@@ -38,6 +38,18 @@ from ..ops.expressions import Col, Expr, spark_type_name
 ColumnLike = Union[Expr, jnp.ndarray, np.ndarray, Sequence]
 
 
+def list_column(items) -> np.ndarray:
+    """PUBLIC constructor for a ragged list column (token lists, item
+    baskets): a 1-D object array with one list per row. ``np.asarray``
+    would collapse equal-length lists into a 2-D array; explicit slot
+    assignment keeps the ragged shape. Use with ``Frame({...: list_column(
+    rows)})`` for any Tokenizer/Word2Vec/FPGrowth-style input."""
+    arr = np.empty(len(items), dtype=object)
+    for i, it in enumerate(items):
+        arr[i] = it
+    return arr
+
+
 def _is_string_col(arr) -> bool:
     return isinstance(arr, np.ndarray) and arr.dtype == object
 

@@ -1203,8 +1203,11 @@ class ChiSqSelector(Estimator):
                                   self.features_col, self.output_col)
 
 
-@persistable
-class ChiSqSelectorModel(Model):
+class _SelectorModelBase(Model):
+    """Shared surface of the feature selectors: a list of selected indices
+    + a gather transform (an empty selection yields an (n, 0) column, the
+    MLlib behavior)."""
+
     _persist_attrs = ('selected_features', 'features_col', 'output_col')
 
     def __init__(self, selected_features, features_col="features",
@@ -1220,8 +1223,13 @@ class ChiSqSelectorModel(Model):
                         float_dtype())
         if X.ndim == 1:
             X = X[:, None]
-        sel = jnp.asarray(self.selected_features, jnp.int32)
+        sel = jnp.asarray(np.asarray(self.selected_features, np.int32))
         return frame.with_column(self.output_col, X[:, sel])
+
+
+@persistable
+class ChiSqSelectorModel(_SelectorModelBase):
+    pass
 
 
 def _is_string_col(arr) -> bool:
@@ -1626,7 +1634,7 @@ class FeatureHasher(Transformer):
 
 
 @persistable
-class RobustScaler(Estimator):
+class RobustScaler(_ScalerBase):
     """MLlib ``RobustScaler``: center by median, scale by IQR (quantile
     range). Quantiles are a host pass over valid rows (data-dependent
     order statistics — same boundary as QuantileDiscretizer); the
@@ -1639,14 +1647,12 @@ class RobustScaler(Estimator):
                  with_scaling: bool = True, lower: float = 0.25,
                  upper: float = 0.75, input_col: str = "features",
                  output_col: str = "scaled_features"):
-        if not 0.0 <= lower < upper <= 1.0:
-            raise ValueError("need 0 <= lower < upper <= 1")
+        super().__init__(input_col, output_col)
         self.with_centering = bool(with_centering)
         self.with_scaling = bool(with_scaling)
         self.lower = float(lower)
         self.upper = float(upper)
-        self.input_col = input_col
-        self.output_col = output_col
+        self._check_bounds()
 
     def set_with_centering(self, v):
         self.with_centering = bool(v)
@@ -1670,20 +1676,10 @@ class RobustScaler(Estimator):
         if not 0.0 <= self.lower < self.upper <= 1.0:
             raise ValueError("need 0 <= lower < upper <= 1")
 
-    def set_input_col(self, v):
-        self.input_col = v
-        return self
-
-    def set_output_col(self, v):
-        self.output_col = v
-        return self
-
     setWithCentering = set_with_centering
     setWithScaling = set_with_scaling
     setLower = set_lower
     setUpper = set_upper
-    setInputCol = set_input_col
-    setOutputCol = set_output_col
 
     def fit(self, frame) -> "RobustScalerModel":
         self._check_bounds()
@@ -1778,33 +1774,15 @@ class VarianceThresholdSelector(Estimator):
         n, _, C, *_ = _moment_pass(X, w)
         var = np.diag(np.asarray(C)) / max(float(n) - 1.0, 1.0)
         keep = np.nonzero(var > self.variance_threshold)[0]
-        if keep.size == 0:
-            raise ValueError("VarianceThresholdSelector: no feature "
-                             "exceeds the variance threshold")
+        # empty selection is a valid model (MLlib; ChiSqSelector's fpr
+        # path behaves the same) — transform yields an (n, 0) column
         return VarianceThresholdSelectorModel(
             keep.astype(np.int64).tolist(), self.features_col,
             self.output_col)
 
 
 @persistable
-class VarianceThresholdSelectorModel(Model):
-    _persist_attrs = ('selected_features', 'features_col', 'output_col')
-
+class VarianceThresholdSelectorModel(_SelectorModelBase):
     def __init__(self, selected_features, features_col="features",
                  output_col="selected_features"):
-        self.selected_features = [int(i) for i in selected_features]
-        self.features_col = features_col
-        self.output_col = output_col
-
-    def _post_load(self):
-        self.selected_features = [int(i) for i in self.selected_features]
-
-    selectedFeatures = property(lambda self: list(self.selected_features))
-
-    def transform(self, frame):
-        X = jnp.asarray(frame._column_values(self.features_col),
-                        float_dtype())
-        if X.ndim == 1:
-            X = X[:, None]
-        sel = jnp.asarray(self.selected_features, jnp.int32)
-        return frame.with_column(self.output_col, X[:, sel])
+        super().__init__(selected_features, features_col, output_col)

@@ -47,14 +47,8 @@ _ENGLISH_STOP_WORDS = [
     "yourself", "yourselves"]
 
 
-def _obj_array(items) -> np.ndarray:
-    """1-D object array of token lists. np.asarray would collapse
-    equal-length lists into a 2-D array; explicit slot assignment keeps
-    one list per row."""
-    arr = np.empty(len(items), dtype=object)
-    for i, it in enumerate(items):
-        arr[i] = it
-    return arr
+from ..frame.frame import list_column as _obj_array  # public home moved;
+# the old private name stays importable for existing callers
 
 
 def _token_col(frame, name):

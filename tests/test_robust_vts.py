@@ -67,11 +67,14 @@ class TestRobustScaler:
         m = RobustScaler(with_centering=True).fit(_frame(Xn))
         ref = RobustScaler(with_centering=True).fit(
             _frame(X[np.arange(80) != 5]))
-        # feature 0's stats ignore the NaN row; feature 1 unaffected
-        assert np.all(np.isfinite(m.median)) and np.all(
-            np.isfinite(m.scale))
+        # feature 0's stats equal a fit with the NaN row dropped;
+        # feature 1 still uses all 80 rows
+        assert m.median[0] == pytest.approx(ref.median[0], rel=1e-12)
+        assert m.scale[0] == pytest.approx(ref.scale[0], rel=1e-12)
         assert m.median[1] == pytest.approx(
             np.median(Xn[:, 1]), rel=1e-12)
+        assert np.all(np.isfinite(m.median)) and np.all(
+            np.isfinite(m.scale))
 
     def test_invalid_bounds_rejected(self):
         with pytest.raises(ValueError, match="lower < upper"):
@@ -111,10 +114,14 @@ class TestVarianceThresholdSelector:
                          np.float64)
         np.testing.assert_allclose(out, X[:, expect], rtol=1e-6)
 
-    def test_all_filtered_raises(self):
+    def test_all_filtered_empty_selection(self):
+        # MLlib: an empty selection is a valid model, not an error
         X = np.ones((30, 2))
-        with pytest.raises(ValueError, match="variance threshold"):
-            VarianceThresholdSelector(variance_threshold=1.0).fit(_frame(X))
+        m = VarianceThresholdSelector(variance_threshold=1.0).fit(_frame(X))
+        assert m.selected_features == []
+        out = np.asarray(m.transform(_frame(X)).to_pydict()
+                         ["selected_features"])
+        assert out.shape == (30, 0)
 
     def test_roundtrip(self, tmp_path):
         from sparkdq4ml_tpu.models.base import load_stage
