@@ -53,6 +53,8 @@ from .tuning import (CrossValidator, CrossValidatorModel, ParamGridBuilder,
 from .fm import (FMClassificationModel, FMClassifier, FMRegressionModel,
                  FMRegressor)
 from .fpm import FPGrowth, FPGrowthModel
+from .mlp import (MultilayerPerceptronClassificationModel,
+                  MultilayerPerceptronClassifier)
 from .lsh import (BucketedRandomProjectionLSH,
                   BucketedRandomProjectionLSHModel, MinHashLSH,
                   MinHashLSHModel)

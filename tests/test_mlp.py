@@ -1,0 +1,107 @@
+"""MultilayerPerceptronClassifier: nonlinear boundary a linear model
+cannot learn, sklearn MLP quality parity, sharded≡single, validations,
+persistence."""
+
+import numpy as np
+import pytest
+
+from conftest import assert_devices
+from sparkdq4ml_tpu import Frame
+from sparkdq4ml_tpu.models import (MultilayerPerceptronClassificationModel,
+                                   MultilayerPerceptronClassifier,
+                                   VectorAssembler)
+from sparkdq4ml_tpu.parallel.mesh import make_mesh
+
+
+def xor_frame(n=400, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.uniform(-1, 1, size=(n, 2))
+    y = ((X[:, 0] > 0) ^ (X[:, 1] > 0)).astype(np.float64)
+    cols = {"a": X[:, 0], "b": X[:, 1], "label": y}
+    return (VectorAssembler(["a", "b"], "features").transform(Frame(cols)),
+            X, y)
+
+
+class TestMLP:
+    def test_learns_xor(self):
+        f, X, y = xor_frame()
+        mlp = MultilayerPerceptronClassifier(layers=[2, 8, 2],
+                                             max_iter=800, step_size=0.05,
+                                             seed=1)
+        model = mlp.fit(f)
+        d = model.transform(f).to_pydict()
+        acc = np.mean(np.asarray(d["prediction"]) == y)
+        assert acc > 0.95
+        prob = np.asarray(d["probability"])
+        np.testing.assert_allclose(prob.sum(axis=1), 1.0, rtol=1e-5)
+        assert model.loss_history[-1] < model.loss_history[0] * 0.3
+
+    def test_sklearn_quality_parity(self):
+        pytest.importorskip("sklearn")
+        from sklearn.neural_network import MLPClassifier as SkMLP
+
+        f, X, y = xor_frame(seed=3)
+        ours = MultilayerPerceptronClassifier(layers=[2, 8, 2],
+                                              max_iter=800, step_size=0.05,
+                                              seed=1).fit(f)
+        acc = np.mean(np.asarray(
+            ours.transform(f).to_pydict()["prediction"]) == y)
+        sk = SkMLP(hidden_layer_sizes=(8,), max_iter=2000,
+                   random_state=0).fit(X, y)
+        assert acc >= sk.score(X, y) - 0.05
+
+    def test_multiclass(self):
+        rng = np.random.default_rng(5)
+        n = 450
+        X = rng.normal(size=(n, 2))
+        y = (np.arctan2(X[:, 1], X[:, 0]) // (2 * np.pi / 3)
+             % 3).astype(np.float64)           # angular thirds
+        f = VectorAssembler(["a", "b"], "features").transform(
+            Frame({"a": X[:, 0], "b": X[:, 1], "label": y}))
+        model = MultilayerPerceptronClassifier(
+            layers=[2, 16, 3], max_iter=800, step_size=0.05, seed=2).fit(f)
+        acc = np.mean(np.asarray(
+            model.transform(f).to_pydict()["prediction"]) == y)
+        assert acc > 0.9
+
+    def test_layer_validations(self):
+        f, X, y = xor_frame(n=50)
+        with pytest.raises(ValueError, match="layers\\[0\\]"):
+            MultilayerPerceptronClassifier(layers=[5, 2],
+                                           max_iter=5).fit(f)
+        with pytest.raises(ValueError, match="observed classes"):
+            MultilayerPerceptronClassifier(layers=[2, 4, 1],
+                                           max_iter=5).fit(f)
+
+    def test_default_layers_logistic_like(self):
+        f, X, y = xor_frame(n=60)
+        model = MultilayerPerceptronClassifier(max_iter=20).fit(f)
+        assert model.layers == [2, 2]          # [input, classes]
+
+    def test_sharded_equals_single(self):
+        assert_devices(8)
+        f, _, _ = xor_frame(n=203, seed=7)
+        kw = dict(layers=[2, 4, 2], max_iter=120, step_size=0.05, seed=3)
+        single = MultilayerPerceptronClassifier(**kw).fit(
+            f, mesh=make_mesh(1))
+        sharded = MultilayerPerceptronClassifier(**kw).fit(
+            f, mesh=make_mesh(8))
+        for (W1, b1), (W2, b2) in zip(single.weights, sharded.weights):
+            np.testing.assert_allclose(W2, W1, rtol=1e-6, atol=1e-9)
+            np.testing.assert_allclose(b2, b1, rtol=1e-6, atol=1e-9)
+
+    def test_roundtrip(self, tmp_path):
+        from sparkdq4ml_tpu.models.base import load_stage
+
+        f, X, _ = xor_frame(n=80)
+        model = MultilayerPerceptronClassifier(layers=[2, 4, 2],
+                                               max_iter=50, seed=1).fit(f)
+        model.save(str(tmp_path / "mlp"))
+        loaded = load_stage(str(tmp_path / "mlp"))
+        assert isinstance(loaded,
+                          MultilayerPerceptronClassificationModel)
+        assert loaded.predict(X[0]) == model.predict(X[0])
+        np.testing.assert_allclose(
+            np.asarray(loaded.transform(f).to_pydict()["probability"]),
+            np.asarray(model.transform(f).to_pydict()["probability"]),
+            rtol=1e-6)
