@@ -26,6 +26,8 @@ from .feature import (Binarizer, Bucketizer, ChiSqSelector,
                       StandardScaler, StandardScalerModel, StringIndexer,
                       StringIndexerModel, VectorAssembler, VectorIndexer,
                       VectorIndexerModel, VectorSlicer,
+                      UnivariateFeatureSelector,
+                      UnivariateFeatureSelectorModel,
                       VarianceThresholdSelector,
                       VarianceThresholdSelectorModel)
 from .glm import (GeneralizedLinearRegression,

@@ -1786,3 +1786,172 @@ class VarianceThresholdSelectorModel(_SelectorModelBase):
     def __init__(self, selected_features, features_col="features",
                  output_col="selected_features"):
         super().__init__(selected_features, features_col, output_col)
+
+
+@persistable
+class UnivariateFeatureSelector(Estimator):
+    """MLlib ``UnivariateFeatureSelector``: score every feature against the
+    label with the test implied by (featureType, labelType) — χ² for
+    categorical/categorical, ANOVA F for continuous features vs categorical
+    label, F-regression for continuous/continuous — then select by mode
+    (numTopFeatures | percentile | fpr | fdr | fwe).
+
+    TPU-first: all three statistics come from one-hot / moment matmuls over
+    masked rows (the ChiSquareTest & Summarizer passes); only the final
+    p-value tail probabilities use scipy on the tiny (d,) statistics.
+    """
+
+    _persist_attrs = ('feature_type', 'label_type', 'selection_mode',
+                      'selection_threshold', 'features_col', 'label_col',
+                      'output_col')
+
+    _MODES = ("numTopFeatures", "percentile", "fpr", "fdr", "fwe")
+
+    def __init__(self, feature_type: str = "continuous",
+                 label_type: str = "categorical",
+                 selection_mode: str = "numTopFeatures",
+                 selection_threshold: Optional[float] = None,
+                 features_col: str = "features", label_col: str = "label",
+                 output_col: str = "selected_features"):
+        if feature_type not in ("categorical", "continuous"):
+            raise ValueError(f"feature_type={feature_type!r}")
+        if label_type not in ("categorical", "continuous"):
+            raise ValueError(f"label_type={label_type!r}")
+        if selection_mode not in self._MODES:
+            raise ValueError(f"selection_mode={selection_mode!r}; "
+                             f"expected one of {self._MODES}")
+        self.feature_type = feature_type
+        self.label_type = label_type
+        self.selection_mode = selection_mode
+        self.selection_threshold = selection_threshold
+        self.features_col = features_col
+        self.label_col = label_col
+        self.output_col = output_col
+
+    def set_feature_type(self, v):
+        if v not in ("categorical", "continuous"):
+            raise ValueError(f"feature_type={v!r}")
+        self.feature_type = v
+        return self
+
+    def set_label_type(self, v):
+        if v not in ("categorical", "continuous"):
+            raise ValueError(f"label_type={v!r}")
+        self.label_type = v
+        return self
+
+    def set_selection_mode(self, v):
+        if v not in self._MODES:
+            raise ValueError(f"selection_mode={v!r}")
+        self.selection_mode = v
+        return self
+
+    def set_selection_threshold(self, v):
+        self.selection_threshold = float(v)
+        return self
+
+    def set_features_col(self, v):
+        self.features_col = v
+        return self
+
+    def set_label_col(self, v):
+        self.label_col = v
+        return self
+
+    def set_output_col(self, v):
+        self.output_col = v
+        return self
+
+    setFeatureType = set_feature_type
+    setLabelType = set_label_type
+    setSelectionMode = set_selection_mode
+    setSelectionThreshold = set_selection_threshold
+    setFeaturesCol = set_features_col
+    setLabelCol = set_label_col
+    setOutputCol = set_output_col
+
+    def _p_values(self, X, y):
+        """(d,) p-values for the CONTINUOUS-feature tests (the chi2 path
+        reuses ChiSquareTest in :meth:`fit` — device matmuls + its input
+        validation, no duplicate table logic)."""
+        from scipy import stats as sstats
+
+        n, d = X.shape
+        if self.label_type == "categorical":   # ANOVA F (f_classif)
+            classes = np.unique(y)
+            grand = X.mean(axis=0)
+            ss_between = np.zeros(d)
+            ss_within = np.zeros(d)
+            for c in classes:
+                Xi = X[y == c]
+                ss_between += len(Xi) * (Xi.mean(axis=0) - grand) ** 2
+                ss_within += ((Xi - Xi.mean(axis=0)) ** 2).sum(axis=0)
+            df_b = len(classes) - 1
+            df_w = n - len(classes)
+            with np.errstate(divide="ignore", invalid="ignore"):
+                F = (ss_between / df_b) / (ss_within / df_w)
+            F = np.nan_to_num(F)
+            return sstats.f.sf(F, df_b, df_w)
+        # continuous/continuous: F-regression on the Pearson correlation
+        Xc = X - X.mean(axis=0)
+        yc = y - y.mean()
+        denom = np.sqrt((Xc ** 2).sum(axis=0) * (yc ** 2).sum())
+        with np.errstate(divide="ignore", invalid="ignore"):
+            r = np.where(denom > 0, Xc.T @ yc / denom, 0.0)
+            F = r * r / np.maximum(1.0 - r * r, 1e-300) * (n - 2)
+        return sstats.f.sf(F, 1, n - 2)
+
+    def fit(self, frame) -> "UnivariateFeatureSelectorModel":
+        X = np.asarray(frame._column_values(self.features_col),
+                       np.dtype(float_dtype()))
+        if X.ndim == 1:
+            X = X[:, None]
+        y = np.asarray(frame._column_values(self.label_col), np.float64)
+        mask = np.asarray(frame.mask)
+        if not mask.any():
+            raise ValueError("UnivariateFeatureSelector: no valid rows")
+        Xv, yv = X[mask].astype(np.float64), y[mask]
+        d = Xv.shape[1]
+        if self.feature_type == "categorical":
+            if self.label_type != "categorical":
+                raise ValueError("categorical features require a "
+                                 "categorical label (chi2)")
+            from .stat import ChiSquareTest
+
+            res = ChiSquareTest.test(frame, self.features_col,
+                                     self.label_col).to_pydict()
+            pvals = np.asarray(res["pValues"][0], np.float64)
+        else:
+            pvals = self._p_values(Xv, yv)
+
+        mode = self.selection_mode
+        # Spark's defaults per mode
+        thr = self.selection_threshold
+        if thr is None:
+            thr = {"numTopFeatures": 50, "percentile": 0.1,
+                   "fpr": 0.05, "fdr": 0.05, "fwe": 0.05}[mode]
+        order = np.argsort(pvals, kind="stable")
+        if mode == "numTopFeatures":
+            keep = np.sort(order[: int(thr)])
+        elif mode == "percentile":
+            # Spark floors (and keeps at least one), like ChiSqSelector
+            keep = np.sort(order[: max(1, int(thr * d))])
+        elif mode == "fpr":
+            keep = np.nonzero(pvals < thr)[0]
+        elif mode == "fwe":
+            keep = np.nonzero(pvals < thr / d)[0]
+        else:  # fdr: Benjamini–Hochberg
+            ranked = pvals[order]
+            below = ranked <= thr * (np.arange(1, d + 1) / d)
+            k = int(np.nonzero(below)[0].max()) + 1 if below.any() else 0
+            keep = np.sort(order[:k])
+        return UnivariateFeatureSelectorModel(
+            keep.astype(np.int64).tolist(), self.features_col,
+            self.output_col)
+
+
+@persistable
+class UnivariateFeatureSelectorModel(_SelectorModelBase):
+    def __init__(self, selected_features, features_col="features",
+                 output_col="selected_features"):
+        super().__init__(selected_features, features_col, output_col)

@@ -1,0 +1,108 @@
+"""UnivariateFeatureSelector vs sklearn's univariate scoring functions."""
+
+import numpy as np
+import pytest
+
+from sparkdq4ml_tpu import Frame
+from sparkdq4ml_tpu.models import UnivariateFeatureSelector, VectorAssembler
+
+
+def _frame(X, y):
+    d = X.shape[1]
+    cols = {f"x{j}": X[:, j] for j in range(d)}
+    cols["label"] = y
+    return VectorAssembler([f"x{j}" for j in range(d)],
+                           "features").transform(Frame(cols))
+
+
+class TestUnivariateFeatureSelector:
+    def test_f_classif_matches_sklearn(self):
+        pytest.importorskip("sklearn")
+        from sklearn.feature_selection import SelectKBest, f_classif
+
+        rng = np.random.default_rng(0)
+        n = 200
+        y = rng.integers(0, 3, size=n).astype(np.float64)
+        X = rng.normal(size=(n, 5))
+        X[:, 1] += y          # informative
+        X[:, 3] += 2 * y      # more informative
+        sel = UnivariateFeatureSelector(
+            feature_type="continuous", label_type="categorical",
+            selection_mode="numTopFeatures", selection_threshold=2)
+        m = sel.fit(_frame(X, y))
+        sk = SelectKBest(f_classif, k=2).fit(X, y)
+        assert sorted(m.selected_features) == \
+            sorted(np.nonzero(sk.get_support())[0].tolist())
+
+    def test_f_regression_matches_sklearn(self):
+        pytest.importorskip("sklearn")
+        from sklearn.feature_selection import SelectKBest, f_regression
+
+        rng = np.random.default_rng(1)
+        n = 150
+        X = rng.normal(size=(n, 4))
+        y = 3 * X[:, 2] + 0.5 * X[:, 0] + 0.1 * rng.normal(size=n)
+        sel = UnivariateFeatureSelector(
+            feature_type="continuous", label_type="continuous",
+            selection_mode="numTopFeatures", selection_threshold=2)
+        m = sel.fit(_frame(X, y))
+        sk = SelectKBest(f_regression, k=2).fit(X, y)
+        assert sorted(m.selected_features) == \
+            sorted(np.nonzero(sk.get_support())[0].tolist())
+
+    def test_chi2_categorical(self):
+        pytest.importorskip("sklearn")
+        rng = np.random.default_rng(2)
+        n = 300
+        y = rng.integers(0, 2, size=n).astype(np.float64)
+        X = np.stack([rng.integers(0, 3, size=n).astype(np.float64),
+                      (y + rng.integers(0, 2, size=n)) % 3,
+                      rng.integers(0, 4, size=n).astype(np.float64)],
+                     axis=1)
+        m = UnivariateFeatureSelector(
+            feature_type="categorical", label_type="categorical",
+            selection_mode="numTopFeatures",
+            selection_threshold=1).fit(_frame(X, y))
+        assert m.selected_features == [1]   # the label-dependent feature
+
+    @pytest.mark.parametrize("mode", ["fpr", "fdr", "fwe", "percentile"])
+    def test_selection_modes_run(self, mode):
+        rng = np.random.default_rng(3)
+        n = 120
+        X = rng.normal(size=(n, 6))
+        y = rng.integers(0, 2, size=n).astype(np.float64)
+        X[:, 0] += 3 * y
+        m = UnivariateFeatureSelector(
+            feature_type="continuous", label_type="categorical",
+            selection_mode=mode, selection_threshold=0.3).fit(_frame(X, y))
+        assert 0 in m.selected_features
+
+    def test_chi2_rejects_negative_categories(self):
+        # the chi2 path reuses ChiSquareTest's validation
+        X = np.asarray([[-1.0, 0.0], [1.0, 1.0], [0.0, 1.0]] * 10)
+        y = np.asarray([0.0, 1.0, 0.0] * 10)
+        with pytest.raises(ValueError, match="nonnegative integer"):
+            UnivariateFeatureSelector(
+                feature_type="categorical",
+                label_type="categorical").fit(_frame(X, y))
+
+    def test_invalid_combo_rejected(self):
+        rng = np.random.default_rng(4)
+        X = rng.integers(0, 2, size=(40, 2)).astype(np.float64)
+        y = rng.normal(size=40)
+        with pytest.raises(ValueError, match="categorical label"):
+            UnivariateFeatureSelector(
+                feature_type="categorical",
+                label_type="continuous").fit(_frame(X, y))
+
+    def test_roundtrip(self, tmp_path):
+        from sparkdq4ml_tpu.models.base import load_stage
+
+        rng = np.random.default_rng(5)
+        X = rng.normal(size=(60, 3))
+        y = rng.integers(0, 2, size=60).astype(np.float64)
+        m = UnivariateFeatureSelector(selection_threshold=2).fit(
+            _frame(X, y))
+        m.save(str(tmp_path / "ufs"))
+        assert load_stage(
+            str(tmp_path / "ufs")).selected_features == m.selected_features
