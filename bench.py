@@ -9,8 +9,11 @@ Covers the five BASELINE.json configs plus a synthetic scale sweep:
 (c)   elastic-net general path (FISTA, regParam=0.3, elasticNetParam=0.5),
 (d)   LogisticRegression on the DQ-filtered rows (per-iteration-psum loop),
 (e)   CrossValidator grid (regParam × elasticNetParam, grid-parallel cell
-      sharding) vs sklearn GridSearchCV — run in a SUBPROCESS so its
-      internal host reads can't poison this process's dispatch mode,
+      sharding) vs sklearn GridSearchCV(refit=True) — timed as the fused
+      device-complete CV program (fold Gramians → every cell solved →
+      winner selected → best model refit, one dispatch, no host reads;
+      the same program CrossValidator.fit runs, which then adds exactly
+      one host read to materialize the packed result),
 (sweep) the masked-Gramian data pass at n ∈ {1e5, 1e6, 1e7} × d ∈ {16, 128,
       512} (HBM-bounded subset), XLA vs compiled Pallas, with on-device
       numerics assertions — the MXU/HBM throughput story behind every fit.
@@ -38,7 +41,6 @@ does the host read anything. Data for the sweep is generated ON DEVICE
 import json
 import os
 import statistics
-import subprocess
 import sys
 import time
 
@@ -145,6 +147,20 @@ def main():
     fit_d = fused_logistic_fit_packed(mesh, 100, 1e-6, True, True)
     hyper_d = jnp.asarray([0.01, 0.0], Zd.dtype)
     t_d = median_time(lambda: fit_d(Zb, hyper_d), REPS)
+
+    # (e) CrossValidator grid: the fused device-complete CV program
+    from sparkdq4ml_tpu.models import LinearRegression
+    from sparkdq4ml_tpu.models.evaluation import RegressionEvaluator
+    from sparkdq4ml_tpu.models.tuning import (ParamGridBuilder,
+                                              cv_device_program)
+
+    grid_reg, grid_en, folds = [0.1, 0.5, 1.0], [0.0, 0.5, 1.0], 3
+    grid = (ParamGridBuilder().add_grid("reg_param", grid_reg)
+            .add_grid("elastic_net_param", grid_en).build())
+    cv_prog, cv_args, _, _ = cv_device_program(
+        df, LinearRegression(max_iter=40, tol=1e-6), grid, "rmse", folds,
+        7, mesh, RegressionEvaluator("rmse").is_larger_better())
+    t_e = median_time(lambda: cv_prog(*cv_args), REPS)
 
     # (sweep) masked-Gramian pass: XLA vs compiled Pallas, data on device
     @jax.jit
@@ -271,20 +287,20 @@ def main():
             row["cpu_gbps"] = round(
                 shape[0] * (shape[1] + 2) * 4 / 1e9 / t_cpu, 1)
 
-    # (e) CrossValidator grid — fresh subprocess (see module docstring)
-    cv_result = None
-    try:
-        proc = subprocess.run(
-            [sys.executable, os.path.join(REPO, "bench_cv.py")],
-            capture_output=True, text=True, timeout=1200,
-            cwd=REPO)
-        if proc.returncode == 0 and proc.stdout.strip():
-            cv_result = json.loads(proc.stdout.strip().splitlines()[-1])
-        else:
-            log(f"config e (CV) failed rc={proc.returncode}: "
-                f"{proc.stderr[-500:]}")
-    except (OSError, subprocess.SubprocessError, ValueError) as e:
-        log(f"config e (CV) skipped: {e}")
+    # (e) baseline: sklearn GridSearchCV, same 3x3 grid / folds / family,
+    # refit=True to match the in-program best-model refit
+    t_e_cpu = None
+    if have_sklearn:
+        from sklearn.model_selection import GridSearchCV
+
+        def cpu_grid():
+            GridSearchCV(ElasticNet(max_iter=40, tol=1e-6),
+                         {"alpha": [r / sy for r in grid_reg],
+                          "l1_ratio": grid_en},
+                         cv=folds, scoring="neg_root_mean_squared_error",
+                         n_jobs=1, refit=True).fit(Xs, ys)
+
+        t_e_cpu = median_time(cpu_grid, REPS)
 
     # =====================================================================
     # PHASE 3 — report
@@ -301,9 +317,10 @@ def main():
             "sklearn ElasticNet(cd) maxIter=100", t_c_cpu),
         cfg("d_logistic_dq_rows", t_d,
             "sklearn LogisticRegression(lbfgs) maxIter=100", t_d_cpu),
+        cfg("e_crossvalidator_grid", t_e,
+            f"sklearn GridSearchCV(ElasticNet) {len(grid)}x{folds} refit",
+            t_e_cpu),
     ]
-    if cv_result:
-        configs.append(cv_result)
     for c in configs:
         log(json.dumps(c))
     for row in sweep_rows:
