@@ -10,7 +10,7 @@ from .classification import (BinaryLogisticRegressionSummary,
 from .clustering import (BisectingKMeans, BisectingKMeansModel,
                          GaussianMixture, GaussianMixtureModel,
                          GaussianMixtureSummary, KMeans, KMeansModel,
-                         KMeansSummary)
+                         KMeansSummary, PowerIterationClustering)
 from .evaluation import (BinaryClassificationEvaluator, ClusteringEvaluator,
                          Evaluator, MulticlassClassificationEvaluator,
                          RegressionEvaluator)
@@ -25,7 +25,7 @@ from .feature import (Binarizer, Bucketizer, ChiSqSelector,
                       RobustScalerModel, SQLTransformer,
                       StandardScaler, StandardScalerModel, StringIndexer,
                       StringIndexerModel, VectorAssembler, VectorIndexer,
-                      VectorIndexerModel, VectorSlicer,
+                      VectorIndexerModel, VectorSizeHint, VectorSlicer,
                       UnivariateFeatureSelector,
                       UnivariateFeatureSelectorModel,
                       VarianceThresholdSelector,

@@ -70,6 +70,73 @@ class VectorAssembler(Transformer):
 
 
 @persistable
+class VectorSizeHint(Transformer):
+    """MLlib ``VectorSizeHint``: declare (and validate) the size of a vector
+    column so downstream stages (VectorAssembler in a streaming/persisted
+    pipeline) know their output width without seeing data.
+
+    Columnar-engine semantics: vector columns are dense ``(n, d)`` device
+    arrays, so the size is uniform and checked once against the declared
+    ``size`` — there are no per-row ragged vectors. Spark's
+    ``handle_invalid`` modes map accordingly: ``error`` raises on a
+    mismatch (including a scalar column when ``size != 1``);
+    ``skip`` drops mismatching rows — a uniform column mismatching the
+    hint means every row, so the frame comes back fully masked (empty);
+    ``optimistic`` is Spark's no-validation mode and passes through.
+    """
+
+    _persist_attrs = ('input_col', 'size', 'handle_invalid')
+
+    def __init__(self, input_col: str = None, size: int = None,
+                 handle_invalid: str = "error"):
+        if handle_invalid not in ("error", "skip", "optimistic"):
+            raise ValueError(
+                f"handle_invalid must be error/skip/optimistic, "
+                f"got {handle_invalid!r}")
+        self.input_col = input_col
+        self.size = None if size is None else int(size)
+        self.handle_invalid = handle_invalid
+
+    def set_input_col(self, v):
+        self.input_col = v
+        return self
+
+    setInputCol = set_input_col
+
+    def set_size(self, v):
+        self.size = int(v)
+        return self
+
+    setSize = set_size
+
+    def set_handle_invalid(self, v):
+        if v not in ("error", "skip", "optimistic"):
+            raise ValueError(
+                f"handle_invalid must be error/skip/optimistic, got {v!r}")
+        self.handle_invalid = v
+        return self
+
+    setHandleInvalid = set_handle_invalid
+
+    def transform(self, frame):
+        if self.input_col is None or self.size is None:
+            raise ValueError("VectorSizeHint: input_col and size must be set")
+        if self.size < 1:
+            raise ValueError(f"VectorSizeHint: invalid size {self.size}")
+        arr = frame._column_values(self.input_col)
+        width = 1 if arr.ndim == 1 else arr.shape[1]
+        if width != self.size:
+            if self.handle_invalid == "error":
+                raise ValueError(
+                    f"VectorSizeHint: column {self.input_col!r} has size "
+                    f"{width}, expected {self.size}")
+            if self.handle_invalid == "skip":
+                return frame.filter(
+                    jnp.zeros((frame.num_slots,), bool))
+        return frame
+
+
+@persistable
 class StringIndexer(Estimator):
     """MLlib ``StringIndexer``: map string categories to double indices,
     most-frequent-first (``frequencyDesc``; ties broken alphabetically, as

@@ -246,3 +246,71 @@ class TestRFormula:
         loaded = load_stage(str(tmp_path / "rfe"))
         assert loaded.formula == "y ~ a + b"
         assert loaded.features_col == "feats"
+
+
+class TestVectorSizeHint:
+    def test_matching_size_passes_through(self):
+        from sparkdq4ml_tpu.models import VectorSizeHint
+        f = Frame({"v": np.asarray([[1.0, 2.0], [3.0, 4.0]])})
+        out = VectorSizeHint(input_col="v", size=2).transform(f)
+        assert out.columns == f.columns
+        np.testing.assert_allclose(np.stack(out.to_pydict()["v"]),
+                                   [[1.0, 2.0], [3.0, 4.0]])
+
+    def test_mismatch_errors(self):
+        from sparkdq4ml_tpu.models import VectorSizeHint
+        f = Frame({"v": np.asarray([[1.0, 2.0, 3.0]])})
+        with pytest.raises(ValueError, match="size 3, expected 2"):
+            VectorSizeHint(input_col="v", size=2).transform(f)
+
+    def test_scalar_column_counts_as_size_one(self):
+        from sparkdq4ml_tpu.models import VectorSizeHint
+        f = Frame({"x": [1.0, 2.0]})
+        VectorSizeHint(input_col="x", size=1).transform(f)
+        with pytest.raises(ValueError, match="size 1, expected 4"):
+            VectorSizeHint(input_col="x", size=4).transform(f)
+
+    def test_optimistic_skips_validation(self):
+        from sparkdq4ml_tpu.models import VectorSizeHint
+        f = Frame({"v": np.asarray([[1.0, 2.0, 3.0]])})
+        out = VectorSizeHint(input_col="v", size=2,
+                             handle_invalid="optimistic").transform(f)
+        assert out.columns == f.columns
+        assert out.count() == 1
+
+    def test_skip_drops_mismatching_rows(self):
+        from sparkdq4ml_tpu.models import VectorSizeHint
+        f = Frame({"v": np.asarray([[1.0, 2.0, 3.0], [4.0, 5.0, 6.0]])})
+        out = VectorSizeHint(input_col="v", size=2,
+                             handle_invalid="skip").transform(f)
+        assert out.count() == 0          # uniform column: all rows invalid
+        ok = VectorSizeHint(input_col="v", size=3,
+                            handle_invalid="skip").transform(f)
+        assert ok.count() == 2
+
+    def test_bad_handle_invalid_rejected(self):
+        from sparkdq4ml_tpu.models import VectorSizeHint
+        with pytest.raises(ValueError, match="handle_invalid"):
+            VectorSizeHint(input_col="v", size=2, handle_invalid="bogus")
+
+    def test_unset_params_error(self):
+        from sparkdq4ml_tpu.models import VectorSizeHint
+        with pytest.raises(ValueError, match="must be set"):
+            VectorSizeHint().transform(Frame({"x": [1.0]}))
+
+    def test_in_pipeline_before_assembler(self):
+        from sparkdq4ml_tpu.models import Pipeline, VectorSizeHint
+        f = Frame({"a": [1.0, 2.0], "b": [3.0, 4.0]})
+        pipe = Pipeline(stages=[
+            VectorSizeHint(input_col="a", size=1),
+            VectorAssembler(["a", "b"], "features")])
+        out = pipe.fit(f).transform(f)
+        assert np.stack(out.to_pydict()["features"]).shape == (2, 2)
+
+    def test_persistence(self, tmp_path):
+        from sparkdq4ml_tpu.models import VectorSizeHint
+        st = VectorSizeHint(input_col="v", size=3, handle_invalid="optimistic")
+        st.save(str(tmp_path / "vsh"))
+        back = load_stage(str(tmp_path / "vsh"))
+        assert back.input_col == "v" and back.size == 3
+        assert back.handle_invalid == "optimistic"
