@@ -1,0 +1,131 @@
+"""PowerIterationClustering: behavior on planted-partition graphs, degree
+init, id mapping, mesh parity (sharded ≡ single), and persistence."""
+
+import numpy as np
+import pytest
+
+from sparkdq4ml_tpu import Frame
+from sparkdq4ml_tpu.models import PowerIterationClustering
+from sparkdq4ml_tpu.models.base import load_stage
+from sparkdq4ml_tpu.parallel.mesh import make_mesh
+
+
+def two_block_graph(n_per=8, within=1.0, across=0.01, ids=None, seed=0):
+    """Planted two-community similarity graph: dense heavy edges inside
+    each block, feeble edges across."""
+    rng = np.random.default_rng(seed)
+    n = 2 * n_per
+    ids = np.arange(n) if ids is None else np.asarray(ids)
+    src, dst, w = [], [], []
+    for i in range(n):
+        for j in range(i + 1, n):
+            same = (i < n_per) == (j < n_per)
+            base = within if same else across
+            src.append(ids[i])
+            dst.append(ids[j])
+            w.append(base * (0.8 + 0.4 * rng.random()))
+    return Frame({"src": np.asarray(src, np.int64),
+                  "dst": np.asarray(dst, np.int64),
+                  "weight": np.asarray(w)}), ids, n_per
+
+
+def partition_agreement(out, ids, n_per):
+    d = out.to_pydict()
+    by_id = dict(zip(d["id"].tolist(), d["cluster"].tolist()))
+    a = [by_id[i] for i in ids[:n_per]]
+    b = [by_id[i] for i in ids[n_per:]]
+    return len(set(a)) == 1 and len(set(b)) == 1 and set(a) != set(b)
+
+
+class TestPowerIterationClustering:
+    def test_two_blocks_recovered(self):
+        frame, ids, n_per = two_block_graph()
+        out = PowerIterationClustering(k=2, max_iter=30, seed=3) \
+            .assign_clusters(frame)
+        assert partition_agreement(out, ids, n_per)
+
+    def test_degree_init(self):
+        frame, ids, n_per = two_block_graph(seed=5)
+        out = PowerIterationClustering(k=2, max_iter=30,
+                                       init_mode="degree") \
+            .assign_clusters(frame)
+        assert partition_agreement(out, ids, n_per)
+
+    def test_arbitrary_ids_mapped_back(self):
+        raw = np.asarray([100, 7, 42, 9001, 13, 56, 8, 77,
+                          1000, 1001, 1002, 1003, 1004, 1005, 1006, 1007])
+        frame, ids, n_per = two_block_graph(ids=raw)
+        out = PowerIterationClustering(k=2, max_iter=30) \
+            .assign_clusters(frame)
+        d = out.to_pydict()
+        assert set(d["id"].tolist()) == set(raw.tolist())
+        assert partition_agreement(out, ids, n_per)
+
+    def test_mesh_matches_single(self):
+        frame, ids, n_per = two_block_graph(n_per=12, seed=1)
+        pic = PowerIterationClustering(k=2, max_iter=25, seed=2)
+        single = pic.assign_clusters(frame).to_pydict()
+        sharded = pic.assign_clusters(frame,
+                                      mesh=make_mesh(8)).to_pydict()
+        # same partition (labels may permute)
+        s = {i: c for i, c in zip(single["id"], single["cluster"])}
+        m = {i: c for i, c in zip(sharded["id"], sharded["cluster"])}
+        groups_s = {}
+        groups_m = {}
+        for i in s:
+            groups_s.setdefault(s[i], set()).add(i)
+            groups_m.setdefault(m[i], set()).add(i)
+        assert (sorted(map(sorted, groups_s.values()))
+                == sorted(map(sorted, groups_m.values())))
+
+    def test_self_loop_counts_once(self):
+        from sparkdq4ml_tpu.models.clustering import PowerIterationClustering as PIC
+        import jax.numpy as jnp
+        frame = Frame({"src": np.asarray([0, 0, 1], np.int64),
+                       "dst": np.asarray([0, 1, 2], np.int64),
+                       "weight": np.asarray([5.0, 1.0, 1.0])})
+        pic = PIC(k=2, max_iter=5)
+        # Peek at the affinity the implementation builds by re-deriving it
+        # the same way and asserting the diagonal is w, not 2w.
+        out = pic.assign_clusters(frame)
+        assert len(out.to_pydict()["id"]) == 3
+        # direct check on the construction rule
+        si = np.asarray([0]); di = np.asarray([0]); w = np.asarray([5.0])
+        W = jnp.zeros((1, 1))
+        W = W.at[si, di].add(jnp.asarray(w))
+        W = W.at[di, si].add(jnp.where(jnp.asarray(si == di), 0.0,
+                                       jnp.asarray(w)))
+        assert float(W[0, 0]) == 5.0
+
+    def test_missing_weight_defaults_to_one(self):
+        frame, ids, n_per = two_block_graph()
+        d = frame.to_pydict()
+        unweighted = Frame({"src": d["src"], "dst": d["dst"]})
+        out = PowerIterationClustering(k=2, max_iter=30) \
+            .assign_clusters(unweighted)
+        assert len(out.to_pydict()["id"]) == len(ids)
+
+    def test_validation(self):
+        with pytest.raises(ValueError, match="k must be >= 2"):
+            PowerIterationClustering(k=1)
+        with pytest.raises(ValueError, match="init_mode"):
+            PowerIterationClustering(init_mode="bogus")
+        frame = Frame({"src": np.asarray([0], np.int64),
+                       "dst": np.asarray([1], np.int64),
+                       "weight": np.asarray([-1.0])})
+        with pytest.raises(ValueError, match="nonnegative"):
+            PowerIterationClustering(k=2).assign_clusters(frame)
+        tiny = Frame({"src": np.asarray([0], np.int64),
+                      "dst": np.asarray([1], np.int64),
+                      "weight": np.asarray([1.0])})
+        with pytest.raises(ValueError, match="exceeds node count"):
+            PowerIterationClustering(k=3).assign_clusters(tiny)
+
+    def test_persistence(self, tmp_path):
+        pic = PowerIterationClustering(k=3, max_iter=7, init_mode="degree",
+                                       src_col="a", dst_col="b",
+                                       weight_col="w", seed=11)
+        pic.save(str(tmp_path / "pic"))
+        back = load_stage(str(tmp_path / "pic"))
+        assert (back.k, back.max_iter, back.init_mode) == (3, 7, "degree")
+        assert (back.src_col, back.dst_col, back.weight_col) == ("a", "b", "w")
