@@ -11,6 +11,7 @@ from .clustering import (BisectingKMeans, BisectingKMeansModel,
                          GaussianMixture, GaussianMixtureModel,
                          GaussianMixtureSummary, KMeans, KMeansModel,
                          KMeansSummary, PowerIterationClustering)
+from .lda import LDA, LDAModel
 from .evaluation import (BinaryClassificationEvaluator, ClusteringEvaluator,
                          Evaluator, MulticlassClassificationEvaluator,
                          RegressionEvaluator)
@@ -54,7 +55,7 @@ from .tuning import (CrossValidator, CrossValidatorModel, ParamGridBuilder,
                      TrainValidationSplit, TrainValidationSplitModel)
 from .fm import (FMClassificationModel, FMClassifier, FMRegressionModel,
                  FMRegressor)
-from .fpm import FPGrowth, FPGrowthModel
+from .fpm import FPGrowth, FPGrowthModel, PrefixSpan
 from .mlp import (MultilayerPerceptronClassificationModel,
                   MultilayerPerceptronClassifier)
 from .lsh import (BucketedRandomProjectionLSH,

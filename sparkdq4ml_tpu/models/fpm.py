@@ -245,3 +245,182 @@ class FPGrowthModel(Model):
 
     def _p(self, k, default=None):
         return self._params.get(k, default)
+
+
+# --- PrefixSpan ---------------------------------------------------------------
+#
+# MLlib ``PrefixSpan`` (mllib.fpm.PrefixSpan in the Spark 2.4 dependency,
+# pom.xml:29-32; the ml-level findFrequentSequentialPatterns API landed in
+# 3.0 — this class exposes that surface over the 2.4 algorithm). Sequential
+# patterns over itemset sequences are host-resident string/object data by
+# the framework's boundary rule (same as FPGrowth above); the classic
+# pseudo-projection recursion runs on the host.
+
+
+def _first_occurrence(seq, start_i, last_itemset, item, itemset_ext):
+    """Earliest projection point for extending a pattern at itemset
+    ``start_i`` (the current match position) with ``item``.
+
+    ``itemset_ext``: the item joins the pattern's last itemset, so the
+    matching itemset (searched from ``start_i`` on) must contain
+    ``last_itemset + (item,)``. Sequence extension: ``item`` opens a new
+    itemset strictly after ``start_i``. Returns (i, j) with j = offset
+    just past ``item``, or None.
+    """
+    if itemset_ext:
+        for i in range(start_i, len(seq)):
+            s = seq[i]
+            if item in s and all(x in s for x in last_itemset):
+                return i, s.index(item) + 1
+        return None
+    for i in range(start_i + 1, len(seq)):
+        s = seq[i]
+        if item in s:
+            return i, s.index(item) + 1
+    return None
+
+
+class PrefixSpan:
+    """Sequential pattern mining (PrefixSpan, Pei et al. — the algorithm
+    MLlib implements). ``find_frequent_sequential_patterns(frame)`` returns
+    a Frame with ``sequence`` (list of itemsets) and ``freq`` columns,
+    MLlib's output schema.
+
+    A sequence is a list of itemsets; itemsets are unordered (stored
+    sorted). Pattern growth uses canonical extensions — a new item either
+    starts a new itemset ("sequence extension") or joins the last itemset
+    with items greater than its current maximum ("itemset extension") —
+    with pseudo-projection (first minimal occurrence) per sequence, which
+    keeps support counting exact.
+    """
+
+    def __init__(self, min_support: float = 0.1,
+                 max_pattern_length: int = 10,
+                 max_local_proj_db_size: int = 32000000,
+                 sequence_col: str = "sequence"):
+        if not (0.0 <= min_support <= 1.0):
+            raise ValueError("min_support must be in [0, 1]")
+        if max_pattern_length < 1:
+            raise ValueError("max_pattern_length must be >= 1")
+        self.min_support = float(min_support)
+        self.max_pattern_length = int(max_pattern_length)
+        # accepted for API parity; a single host mines the whole projected
+        # DB, so the mllib local/distributed split point is meaningless here
+        self.max_local_proj_db_size = int(max_local_proj_db_size)
+        self.sequence_col = sequence_col
+
+    def set_min_support(self, v):
+        if not (0.0 <= v <= 1.0):
+            raise ValueError("min_support must be in [0, 1]")
+        self.min_support = float(v)
+        return self
+
+    setMinSupport = set_min_support
+
+    def set_max_pattern_length(self, v):
+        if v < 1:
+            raise ValueError("max_pattern_length must be >= 1")
+        self.max_pattern_length = int(v)
+        return self
+
+    setMaxPatternLength = set_max_pattern_length
+
+    def set_max_local_proj_db_size(self, v):
+        self.max_local_proj_db_size = int(v)
+        return self
+
+    setMaxLocalProjDBSize = set_max_local_proj_db_size
+
+    def set_sequence_col(self, v):
+        self.sequence_col = v
+        return self
+
+    setSequenceCol = set_sequence_col
+
+    def find_frequent_sequential_patterns(self, frame):
+        import math
+
+        raw = frame._column_values(self.sequence_col)
+        valid = np.asarray(frame.mask)
+        seqs = []
+        for s, ok in zip(raw, valid):
+            if not ok or s is None:           # masked slots never vote
+                continue
+            seqs.append(tuple(tuple(sorted(set(itemset))) for itemset in s))
+        n = len(seqs)
+        if n == 0:
+            return _ps_result([], [])
+        min_count = max(1, int(math.ceil(self.min_support * n)))
+        max_len = self.max_pattern_length
+
+        results = []
+
+        def mine(pattern, pattern_items, projections):
+            """``projections``: list of (seq_idx, i, j) — pattern's last
+            itemset matched inside itemset ``i`` ending at offset ``j``."""
+            if pattern_items >= max_len:
+                return
+            last = pattern[-1] if pattern else ()
+            last_max = last[-1] if last else None
+            # candidate support: each sequence votes once per (kind, item)
+            counts = defaultdict(int)
+            for (si, i, j) in projections:
+                seq = seqs[si]
+                seen = set()
+                if last:
+                    # itemset extensions: suffix of the matched itemset,
+                    # or any later itemset containing last ∪ {x}
+                    for x in seq[i][j:]:
+                        seen.add((True, x))
+                    for i2 in range(i + 1, len(seq)):
+                        s2 = seq[i2]
+                        if all(y in s2 for y in last):
+                            for x in s2:
+                                if x > last_max:
+                                    seen.add((True, x))
+                for i2 in range(i + 1, len(seq)):
+                    for x in seq[i2]:
+                        seen.add((False, x))
+                for c in seen:
+                    counts[c] += 1
+
+            for (is_ext, item), c in sorted(
+                    counts.items(), key=lambda kv: (kv[0][0], kv[0][1])):
+                if c < min_count:
+                    continue
+                new_pattern = (pattern[:-1] + [last + (item,)] if is_ext
+                               else pattern + [(item,)])
+                proj = []
+                for (si, i, j) in projections:
+                    seq = seqs[si]
+                    if is_ext:
+                        # at the matched itemset the pattern's last itemset
+                        # already holds; item must appear at/after offset j
+                        if item in seq[i][j:]:
+                            proj.append((si, i, seq[i].index(item) + 1))
+                            continue
+                        hit = _first_occurrence(seq, i + 1, last, item, True)
+                    else:
+                        hit = _first_occurrence(seq, i, (), item, False)
+                    if hit is not None:
+                        proj.append((si, hit[0], hit[1]))
+                results.append(([list(p) for p in new_pattern], c))
+                mine(new_pattern, pattern_items + 1, proj)
+
+        # Root projections seed at a virtual itemset −1 so the sequence-
+        # extension scans (which start at i+1) see itemset 0.
+        mine([], 0, [(si, -1, 0) for si in range(n)])
+        patterns = [r[0] for r in results]
+        freqs = [r[1] for r in results]
+        return _ps_result(patterns, freqs)
+
+    findFrequentSequentialPatterns = find_frequent_sequential_patterns
+
+
+def _ps_result(patterns, freqs):
+    from ..frame import Frame
+
+    return Frame({
+        "sequence": _obj_array(patterns),
+        "freq": np.asarray(freqs, np.int64),
+    })

@@ -1,0 +1,191 @@
+"""LDA (online VB + batch EM): topic recovery on planted-vocabulary
+corpora, transform/describeTopics/logLikelihood/logPerplexity surfaces,
+mesh parity for the EM path, and persistence."""
+
+import numpy as np
+import pytest
+
+from sparkdq4ml_tpu import Frame
+from sparkdq4ml_tpu.models import LDA, LDAModel
+from sparkdq4ml_tpu.models.base import load_stage
+from sparkdq4ml_tpu.parallel.mesh import make_mesh
+
+K, VOCAB_PER, DOCS_PER = 3, 8, 40
+VOCAB = K * VOCAB_PER
+
+
+def planted_corpus(seed=0, docs_per=DOCS_PER):
+    """Each topic owns a disjoint vocabulary block; each doc draws ~60
+    tokens from its topic's block (plus light noise)."""
+    rng = np.random.default_rng(seed)
+    rows, labels = [], []
+    for t in range(K):
+        lo = t * VOCAB_PER
+        for _ in range(docs_per):
+            cnt = np.zeros(VOCAB)
+            own = rng.integers(lo, lo + VOCAB_PER, size=60)
+            np.add.at(cnt, own, 1.0)
+            noise = rng.integers(0, VOCAB, size=4)
+            np.add.at(cnt, noise, 1.0)
+            rows.append(cnt)
+            labels.append(t)
+    order = rng.permutation(len(rows))
+    X = np.stack(rows)[order]
+    return Frame({"features": X}), np.asarray(labels)[order]
+
+
+def block_of(term):
+    return term // VOCAB_PER
+
+
+def topics_recover_blocks(model):
+    """Every fitted topic's top terms must live in one vocabulary block,
+    and the K topics must cover all K blocks."""
+    d = model.describe_topics(5).to_pydict()
+    blocks = []
+    for terms in d["termIndices"]:
+        b = {block_of(t) for t in np.asarray(terms)}
+        if len(b) != 1:
+            return False
+        blocks.append(b.pop())
+    return sorted(blocks) == list(range(K))
+
+
+class TestLDAOnline:
+    def test_topic_recovery(self):
+        frame, _ = planted_corpus()
+        model = LDA(k=K, max_iter=60, subsampling_rate=0.3, seed=5,
+                    learning_offset=16.0).fit(frame)
+        assert topics_recover_blocks(model)
+
+    def test_transform_assigns_docs(self):
+        frame, labels = planted_corpus(seed=1)
+        model = LDA(k=K, max_iter=60, subsampling_rate=0.3, seed=5,
+                    learning_offset=16.0).fit(frame)
+        out = model.transform(frame)
+        theta = np.stack(out.to_pydict()["topicDistribution"])
+        assert theta.shape == (len(labels), K)
+        np.testing.assert_allclose(theta.sum(axis=1), 1.0, atol=1e-5)
+        # docs with the same planted topic share an argmax topic
+        assign = theta.argmax(axis=1)
+        for t in range(K):
+            mode = np.bincount(assign[labels == t]).argmax()
+            agree = (assign[labels == t] == mode).mean()
+            assert agree > 0.9
+
+    def test_deterministic_by_seed(self):
+        frame, _ = planted_corpus(seed=2)
+        m1 = LDA(k=K, max_iter=10, seed=3).fit(frame)
+        m2 = LDA(k=K, max_iter=10, seed=3).fit(frame)
+        np.testing.assert_allclose(m1.topics, m2.topics)
+
+
+class TestLDAEm:
+    def test_topic_recovery(self):
+        frame, _ = planted_corpus(seed=3)
+        model = LDA(k=K, max_iter=30, optimizer="em", seed=1).fit(frame)
+        assert topics_recover_blocks(model)
+
+    def test_mesh_matches_single(self):
+        frame, _ = planted_corpus(seed=4, docs_per=16)
+        est = LDA(k=K, max_iter=15, optimizer="em", seed=2)
+        single = est.fit(frame).topics
+        sharded = est.fit(frame, mesh=make_mesh(8)).topics
+        np.testing.assert_allclose(single, sharded, rtol=1e-8, atol=1e-8)
+
+    def test_more_iterations_do_not_hurt_perplexity(self):
+        frame, _ = planted_corpus(seed=6)
+        short = LDA(k=K, max_iter=2, optimizer="em", seed=1).fit(frame)
+        long = LDA(k=K, max_iter=30, optimizer="em", seed=1).fit(frame)
+        assert long.log_perplexity(frame) <= short.log_perplexity(frame) + 1e-6
+
+
+class TestLDAModelSurface:
+    @pytest.fixture(scope="class")
+    def fitted(self):
+        frame, labels = planted_corpus(seed=7)
+        return LDA(k=K, max_iter=30, optimizer="em", seed=1).fit(frame), frame
+
+    def test_topics_matrix_shape_and_normalization(self, fitted):
+        model, _ = fitted
+        tm = model.topics_matrix()
+        assert tm.shape == (VOCAB, K)
+        np.testing.assert_allclose(tm.sum(axis=0), 1.0, atol=1e-6)
+        assert model.vocab_size == VOCAB
+        assert not model.is_distributed
+
+    def test_describe_topics_sorted_desc(self, fitted):
+        model, _ = fitted
+        d = model.describe_topics(4).to_pydict()
+        assert len(d["topic"]) == K
+        for w in d["termWeights"]:
+            w = np.asarray(w)
+            assert len(w) == 4 and np.all(np.diff(w) <= 1e-12)
+
+    def test_log_likelihood_finite_negative(self, fitted):
+        model, frame = fitted
+        ll = model.log_likelihood(frame)
+        assert np.isfinite(ll) and ll < 0
+        pp = model.log_perplexity(frame)
+        assert np.isfinite(pp) and pp > 0
+
+    def test_estimated_doc_concentration(self, fitted):
+        model, _ = fitted
+        np.testing.assert_allclose(model.estimated_doc_concentration,
+                                   np.full(K, 1.0 / K))
+
+    def test_persistence(self, fitted, tmp_path):
+        model, frame = fitted
+        model.save(str(tmp_path / "lda"))
+        back = load_stage(str(tmp_path / "lda"))
+        assert isinstance(back, LDAModel)
+        np.testing.assert_allclose(back.topics, model.topics)
+        a = np.stack(model.transform(frame).to_pydict()["topicDistribution"])
+        b = np.stack(back.transform(frame).to_pydict()["topicDistribution"])
+        np.testing.assert_allclose(a, b, atol=1e-7)
+
+
+class TestLDAValidation:
+    def test_bad_params(self):
+        with pytest.raises(ValueError, match="k must be >= 2"):
+            LDA(k=1)
+        with pytest.raises(ValueError, match="optimizer"):
+            LDA(optimizer="gibbs")
+        with pytest.raises(ValueError, match="subsampling_rate"):
+            LDA(subsampling_rate=0.0)
+        with pytest.raises(ValueError, match="not supported"):
+            LDA(optimize_doc_concentration=True)
+
+    def test_scalar_features_rejected(self):
+        with pytest.raises(ValueError, match="vector column"):
+            LDA(k=2).fit(Frame({"features": np.asarray([1.0, 2.0])}))
+
+    def test_masked_rows_carry_no_tokens(self):
+        frame, _ = planted_corpus(seed=8, docs_per=12)
+        # poison half the rows with huge junk counts, then mask them out
+        d = frame.to_pydict()
+        X = np.stack(d["features"])
+        Xbad = X.copy()
+        Xbad[::2] = 1000.0
+        f_poisoned = Frame({"features": Xbad, "flag": np.arange(len(X)) % 2})
+        f_masked = f_poisoned.filter(
+            np.asarray(f_poisoned.to_pydict()["flag"]) == 1)
+        f_clean = Frame({"features": X[1::2]})
+        m_masked = LDA(k=K, max_iter=10, optimizer="em", seed=4).fit(f_masked)
+        m_clean = LDA(k=K, max_iter=10, optimizer="em", seed=4).fit(f_clean)
+        # EM's lambda update is eta + sstats and masked rows contribute
+        # zero statistics, so the fits must agree to float precision
+        np.testing.assert_allclose(m_masked.topics, m_clean.topics,
+                                   rtol=1e-6, atol=1e-6)
+
+    def test_nan_in_masked_rows_does_not_poison(self):
+        frame, _ = planted_corpus(seed=9, docs_per=10)
+        X = np.stack(frame.to_pydict()["features"])
+        Xbad = X.copy()
+        Xbad[::2] = np.nan
+        f = Frame({"features": Xbad, "flag": np.arange(len(X)) % 2})
+        f = f.filter(np.asarray(f.to_pydict()["flag"]) == 1)
+        m = LDA(k=K, max_iter=5, optimizer="em", seed=4).fit(f)
+        assert np.all(np.isfinite(m.topics))
+        assert np.isfinite(m.log_likelihood(f))
+        assert np.isfinite(m.log_perplexity(f))

@@ -1,0 +1,182 @@
+"""PrefixSpan: the Spark programming-guide fixture, itemset extensions,
+support thresholds, pattern-length caps, and string items."""
+
+import numpy as np
+import pytest
+
+from sparkdq4ml_tpu import Frame
+from sparkdq4ml_tpu.models import PrefixSpan
+from sparkdq4ml_tpu.models.text import _obj_array
+
+
+def seq_frame(seqs):
+    return Frame({"sequence": _obj_array(seqs)})
+
+
+def mined(frame, **kw):
+    out = PrefixSpan(**kw).find_frequent_sequential_patterns(frame)
+    d = out.to_pydict()
+    return {tuple(tuple(sorted(i)) for i in s): int(f)
+            for s, f in zip(d["sequence"], d["freq"])}
+
+
+class TestSparkDocsFixture:
+    # the example from Spark's ml-frequent-pattern-mining guide
+    SEQS = [[[1, 2], [3]],
+            [[1], [3, 2], [1, 2]],
+            [[1, 2], [5]],
+            [[6]]]
+
+    def test_expected_patterns(self):
+        got = mined(seq_frame(self.SEQS), min_support=0.5,
+                    max_pattern_length=5)
+        expected = {
+            ((1,),): 3,
+            ((2,),): 3,
+            ((3,),): 2,
+            ((1,), (3,)): 2,
+            ((1, 2),): 3,
+        }
+        assert got == expected
+
+
+class TestSemantics:
+    def test_itemset_vs_sequence_extension(self):
+        # (a b) together twice vs a-then-b twice are different patterns
+        seqs = [[["a", "b"]], [["a", "b"]], [["a"], ["b"]], [["a"], ["b"]]]
+        got = mined(seq_frame(seqs), min_support=0.5)
+        assert got[(("a", "b"),)] == 2
+        assert got[(("a",), ("b",))] == 2
+        assert got[(("a",),)] == 4
+
+    def test_min_support_threshold(self):
+        seqs = [[["x"]], [["x"]], [["y"]], [["z"]]]
+        got = mined(seq_frame(seqs), min_support=0.5)
+        assert got == {(("x",),): 2}
+
+    def test_max_pattern_length_counts_items(self):
+        seqs = [[["a"], ["b"], ["c"]]] * 2
+        got1 = mined(seq_frame(seqs), min_support=1.0, max_pattern_length=1)
+        assert set(got1) == {(("a",),), (("b",),), (("c",),)}
+        got2 = mined(seq_frame(seqs), min_support=1.0, max_pattern_length=2)
+        assert (("a",), ("b",)) in got2 and (("a",), ("b",), ("c",)) not in got2
+
+    def test_repeated_item_across_itemsets(self):
+        seqs = [[["a"], ["a"]], [["a"], ["a"]]]
+        got = mined(seq_frame(seqs), min_support=1.0)
+        assert got[(("a",),)] == 2
+        assert got[(("a",), ("a",))] == 2
+
+    def test_support_counts_sequences_not_occurrences(self):
+        seqs = [[["a"], ["a"], ["a"]], [["b"]]]
+        got = mined(seq_frame(seqs), min_support=0.5)
+        assert got[(("a",),)] == 1   # one sequence, many occurrences
+
+    def test_later_itemset_supplies_itemset_extension(self):
+        # (a b) appears only in the SECOND 'a'-containing itemset; the
+        # first-occurrence projection must still find the i-extension
+        seqs = [[["a"], ["a", "b"]], [["a", "b"]]]
+        got = mined(seq_frame(seqs), min_support=1.0)
+        assert got[(("a", "b"),)] == 2
+
+    def test_duplicate_items_in_itemset_deduped(self):
+        seqs = [[["a", "a", "b"]], [["b", "a"]]]
+        got = mined(seq_frame(seqs), min_support=1.0)
+        assert got[(("a", "b"),)] == 2
+
+    def test_empty_frame(self):
+        out = PrefixSpan().find_frequent_sequential_patterns(
+            seq_frame([]))
+        assert len(out.to_pydict()["freq"]) == 0
+
+    def test_validation(self):
+        with pytest.raises(ValueError, match="min_support"):
+            PrefixSpan(min_support=1.5)
+        with pytest.raises(ValueError, match="max_pattern_length"):
+            PrefixSpan(max_pattern_length=0)
+
+    def test_camelcase_surface(self):
+        ps = (PrefixSpan().setMinSupport(0.4).setMaxPatternLength(3)
+              .setSequenceCol("s").setMaxLocalProjDBSize(1000))
+        assert ps.min_support == 0.4 and ps.max_pattern_length == 3
+        assert ps.sequence_col == "s"
+        f = Frame({"s": _obj_array([[["p"], ["q"]], [["p"], ["q"]]])})
+        d = ps.findFrequentSequentialPatterns(f).to_pydict()
+        pats = {tuple(tuple(i) for i in s) for s in d["sequence"]}
+        assert (("p",), ("q",)) in pats
+
+
+def _occurs(pattern, seq):
+    """Oracle: does ``pattern`` (list of itemsets) embed in ``seq`` with
+    strictly increasing itemset positions and subset containment?"""
+    def rec(pi, start):
+        if pi == len(pattern):
+            return True
+        need = set(pattern[pi])
+        for i in range(start, len(seq)):
+            if need <= set(seq[i]) and rec(pi + 1, i + 1):
+                return True
+        return False
+    return rec(0, 0)
+
+
+def _brute_force(seqs, min_count, max_len, alphabet):
+    """Enumerate every canonical pattern up to ``max_len`` items by DFS,
+    counting support by direct embedding checks."""
+    out = {}
+
+    def grow(pattern, n_items):
+        if n_items >= max_len:
+            return
+        cands = []
+        for a in alphabet:
+            cands.append(pattern + [(a,)])                    # s-extension
+        if pattern:
+            last = pattern[-1]
+            for a in alphabet:
+                if a > last[-1]:
+                    cands.append(pattern[:-1] + [last + (a,)])  # i-extension
+        for cand in cands:
+            c = sum(_occurs(cand, s) for s in seqs)
+            if c >= min_count:
+                key = tuple(tuple(p) for p in cand)
+                if key not in out:
+                    out[key] = c
+                    grow(cand, n_items + 1)
+
+    grow([], 0)
+    return out
+
+
+class TestBruteForceParity:
+    @pytest.mark.parametrize("seed", range(6))
+    def test_random_corpora(self, seed):
+        rng = np.random.default_rng(seed)
+        alphabet = ["a", "b", "c", "d"]
+        seqs = []
+        for _ in range(8):
+            seq = []
+            for _ in range(rng.integers(1, 5)):
+                size = rng.integers(1, 4)
+                seq.append(sorted(set(rng.choice(alphabet, size=size))))
+            seqs.append(seq)
+        min_support = float(rng.choice([0.25, 0.5]))
+        max_len = int(rng.choice([2, 3, 4]))
+        got = mined(seq_frame(seqs), min_support=min_support,
+                    max_pattern_length=max_len)
+        import math
+        want = _brute_force([[tuple(i) for i in s] for s in seqs],
+                            max(1, math.ceil(min_support * len(seqs))),
+                            max_len, alphabet)
+        assert got == want
+
+
+class TestMaskRespected:
+    def test_filtered_rows_do_not_vote(self):
+        seqs = [[["a"], ["b"]], [["a"], ["b"]], [["z"]], [["z"]]]
+        f = Frame({"sequence": _obj_array(seqs),
+                   "keep": np.asarray([1.0, 1.0, 0.0, 0.0])})
+        f = f.filter(np.asarray(f.to_pydict()["keep"]) == 1.0)
+        got = mined(f, min_support=1.0)
+        # z rows are masked out: min_support=1.0 is over the 2 kept rows
+        assert got == {(("a",),): 2, (("b",),): 2, (("a",), ("b",)): 2}
