@@ -180,6 +180,37 @@ def main() -> None:
     print(f"LSH 3-NN distances: "
           f"{np.round(np.sort(np.asarray(nn.to_pydict()['distCol'])), 3)}")
 
+    from sparkdq4ml_tpu.models import LDA, PowerIterationClustering, PrefixSpan
+
+    topics = Frame({"features": np.stack(
+        [np.bincount(rng.integers(0, 6, 40), minlength=12).astype(np.float64)
+         if rng.random() < 0.5 else
+         np.bincount(rng.integers(6, 12, 40), minlength=12).astype(np.float64)
+         for _ in range(60)])})
+    lda = LDA(k=2, max_iter=25, optimizer="em", seed=1).fit(topics)
+    tops = lda.describe_topics(3).to_pydict()["termIndices"]
+    print(f"LDA top terms per topic: {[list(map(int, t)) for t in tops]} "
+          f"(perplexity {lda.log_perplexity(topics):.2f})")
+
+    ring = Frame({
+        "src": np.asarray([0, 1, 2, 3, 4, 5, 0, 3], np.int64),
+        "dst": np.asarray([1, 2, 0, 4, 5, 3, 2, 5], np.int64),
+        "weight": np.asarray([1, 1, 1, 1, 1, 1, 1, 1], np.float64)})
+    pic = PowerIterationClustering(k=2, max_iter=20).assign_clusters(ring)
+    print(f"PIC clusters over two triangles: "
+          f"{pic.to_pydict()['cluster'].tolist()}")
+
+    visits = Frame({"sequence": dq.list_column(
+        [[["home"], ["search"], ["cart"]],
+         [["home"], ["search"], ["cart"], ["buy"]],
+         [["home"], ["cart"]],
+         [["search"], ["cart"]]])})
+    ps = PrefixSpan(min_support=0.5).find_frequent_sequential_patterns(visits)
+    d = ps.to_pydict()
+    longest = max(d["sequence"], key=lambda s: sum(len(i) for i in s))
+    print(f"PrefixSpan: {len(d['freq'])} frequent sequences, "
+          f"longest {longest}")
+
 
 if __name__ == "__main__":
     main()
