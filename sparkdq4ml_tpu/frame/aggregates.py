@@ -20,30 +20,56 @@ import numpy as np
 
 from ..ops.expressions import Expr
 
-_AGGS = ("count", "sum", "avg", "mean", "min", "max", "stddev", "variance")
+_AGGS = ("count", "sum", "avg", "mean", "min", "max", "stddev", "variance",
+         "count_distinct", "sum_distinct", "collect_list", "collect_set",
+         "first", "last", "skewness", "kurtosis",
+         "corr", "covar_samp", "covar_pop")
+# Pearson/covariance aggregates take two columns (Spark's F.corr(a, b))
+_TWO_COL = ("corr", "covar_samp", "covar_pop")
+# windowed form exists only for the running aggregates (as in Spark ≤2.x SQL)
+_WINDOWABLE = ("count", "sum", "avg", "min", "max")
 
 
 class AggExpr:
     """An aggregate over a column, e.g. ``F.avg("price")`` or SQL ``AVG(price)``."""
 
-    def __init__(self, fn: str, column: Optional[str], alias: Optional[str] = None):
+    def __init__(self, fn: str, column: Optional[str],
+                 alias: Optional[str] = None,
+                 column2: Optional[str] = None,
+                 ignore_nulls: bool = False):
         fn = fn.lower()
         if fn not in _AGGS:
             raise ValueError(f"unknown aggregate {fn!r} (supported: {_AGGS})")
         self.fn = "avg" if fn == "mean" else fn
+        if self.fn in _TWO_COL:
+            if column is None or column2 is None:
+                raise ValueError(f"{self.fn}(col1, col2) takes two columns")
+        elif column2 is not None:
+            raise ValueError(f"{self.fn}() takes one column")
         self.column = column  # None = count(*)
+        self.column2 = column2
+        self.ignore_nulls = bool(ignore_nulls)  # first/last only
         self._alias = alias
 
     def alias(self, name: str) -> "AggExpr":
-        return AggExpr(self.fn, self.column, name)
+        return AggExpr(self.fn, self.column, name, self.column2,
+                       self.ignore_nulls)
 
     @property
     def name(self) -> str:
         if self._alias:
             return self._alias
-        target = "1" if self.column is None else self.column
         if self.fn == "count" and self.column is None:
             return "count"
+        if self.fn in _TWO_COL:
+            return f"{self.fn}({self.column}, {self.column2})"
+        if self.fn in ("count_distinct", "sum_distinct"):
+            return f"{self.fn.split('_')[0]}(DISTINCT {self.column})"
+        if self.fn in ("first", "last") and self.ignore_nulls:
+            # Spark encodes the flag in the name ("first(x, true)");
+            # also keeps the two variants from colliding in one agg() call
+            return f"{self.fn}({self.column}, true)"
+        target = "1" if self.column is None else self.column
         return f"{self.fn}({target})"
 
     def __repr__(self):
@@ -51,10 +77,11 @@ class AggExpr:
 
     def over(self, spec) -> "Expr":
         """Bind as a window aggregate: ``F.sum("x").over(Window...)``.
-        stddev/variance have no windowed form here (as in Spark ≤2.x SQL)."""
+        Only the running aggregates have a windowed form here (as in
+        Spark ≤2.x SQL)."""
         from .window import window_agg
 
-        if self.fn in ("stddev", "variance"):
+        if self.fn not in _WINDOWABLE:
             raise ValueError(f"windowed {self.fn}() is not supported")
         expr = window_agg(self.fn, self.column).over(spec)
         return expr.alias(self._alias) if self._alias else expr
@@ -92,6 +119,56 @@ def variance(col: str) -> AggExpr:
     return AggExpr("variance", col)
 
 
+def count_distinct(col: str) -> AggExpr:
+    return AggExpr("count_distinct", col)
+
+
+countDistinct = count_distinct
+
+
+def sum_distinct(col: str) -> AggExpr:
+    return AggExpr("sum_distinct", col)
+
+
+sumDistinct = sum_distinct
+
+
+def collect_list(col: str) -> AggExpr:
+    return AggExpr("collect_list", col)
+
+
+def collect_set(col: str) -> AggExpr:
+    return AggExpr("collect_set", col)
+
+
+def first(col: str, ignorenulls: bool = False) -> AggExpr:
+    return AggExpr("first", col, ignore_nulls=ignorenulls)
+
+
+def last(col: str, ignorenulls: bool = False) -> AggExpr:
+    return AggExpr("last", col, ignore_nulls=ignorenulls)
+
+
+def skewness(col: str) -> AggExpr:
+    return AggExpr("skewness", col)
+
+
+def kurtosis(col: str) -> AggExpr:
+    return AggExpr("kurtosis", col)
+
+
+def corr(col1: str, col2: str) -> AggExpr:
+    return AggExpr("corr", col1, column2=col2)
+
+
+def covar_samp(col1: str, col2: str) -> AggExpr:
+    return AggExpr("covar_samp", col1, column2=col2)
+
+
+def covar_pop(col1: str, col2: str) -> AggExpr:
+    return AggExpr("covar_pop", col1, column2=col2)
+
+
 def _group_plan(key_cols: list[np.ndarray], n: int):
     """Null-safe lexicographic group discovery shared by groupBy/pivot:
     returns (order, group_starts, group_ends) over the n rows. Delegates key
@@ -124,14 +201,30 @@ def _drop_nulls(values: np.ndarray) -> np.ndarray:
     return values
 
 
-def _np_agg(fn: str, values: np.ndarray):
+def _np_agg(fn: str, values: np.ndarray, ignore_nulls: bool = False):
+    if fn in ("first", "last"):
+        # Spark's first/last default ignoreNulls=false: the raw first/last
+        # row value, null included
+        v = _drop_nulls(values) if ignore_nulls else values
+        if len(v) == 0:
+            return float("nan")
+        return v[0] if fn == "first" else v[-1]
     values = _drop_nulls(values)  # SQL semantics: aggregates skip nulls
     if fn == "count":
         return len(values)
+    if fn == "count_distinct":
+        return len(set(values.tolist()))
+    if fn == "collect_list":
+        return list(values.tolist())
+    if fn == "collect_set":
+        # first-appearance order (Spark's order is unspecified)
+        return list(dict.fromkeys(values.tolist()))
     if len(values) == 0:
         return float("nan")
     if fn == "sum":
         return values.sum()
+    if fn == "sum_distinct":
+        return np.asarray(sorted(set(values.tolist()))).sum()
     if fn == "avg":
         return float(np.mean(values))
     if fn == "min":
@@ -142,11 +235,53 @@ def _np_agg(fn: str, values: np.ndarray):
         return float(np.std(values, ddof=1)) if len(values) > 1 else float("nan")
     if fn == "variance":
         return float(np.var(values, ddof=1)) if len(values) > 1 else float("nan")
+    if fn in ("skewness", "kurtosis"):
+        # Spark: population moments; kurtosis is EXCESS kurtosis
+        v = np.asarray(values, np.float64)
+        m2 = np.mean((v - v.mean()) ** 2)
+        if m2 == 0:
+            return float("nan")
+        if fn == "skewness":
+            return float(np.mean((v - v.mean()) ** 3) / m2 ** 1.5)
+        return float(np.mean((v - v.mean()) ** 4) / m2 ** 2 - 3.0)
     raise ValueError(fn)
 
 
+def _np_agg2(fn: str, a: np.ndarray, b: np.ndarray):
+    """Two-column aggregates over pairwise non-null rows (SQL semantics)."""
+    a = np.asarray(a, np.float64)
+    b = np.asarray(b, np.float64)
+    ok = ~(np.isnan(a) | np.isnan(b))
+    a, b = a[ok], b[ok]
+    n = len(a)
+    if fn == "covar_pop":
+        return float(np.mean((a - a.mean()) * (b - b.mean()))) if n else float("nan")
+    if n < 2:
+        return float("nan")
+    if fn == "covar_samp":
+        return float(((a - a.mean()) * (b - b.mean())).sum() / (n - 1))
+    if fn == "corr":
+        sa, sb = a.std(), b.std()
+        if sa == 0 or sb == 0:
+            return float("nan")
+        return float(np.mean((a - a.mean()) * (b - b.mean())) / (sa * sb))
+    raise ValueError(fn)
+
+
+_DEVICE_AGGS = ("count", "sum", "avg", "min", "max", "stddev", "variance")
+
+
+def _one_slot_obj(value):
+    arr = np.empty(1, dtype=object)
+    arr[0] = value
+    return arr
+
+
 def global_agg(frame, aggs: list[AggExpr]):
-    """Masked device reductions over the whole frame → 1-row Frame."""
+    """Masked device reductions over the whole frame → 1-row Frame.
+    The order-/set-valued aggregates (collect_*, first/last, distinct,
+    corr family, higher moments) take the host boundary like grouped
+    aggregation — their outputs are host objects by nature."""
     from .frame import Frame
 
     mask = frame.mask
@@ -155,6 +290,24 @@ def global_agg(frame, aggs: list[AggExpr]):
     for agg in aggs:
         if agg.fn == "count" and agg.column is None:
             out[agg.name] = jnp.sum(mask, dtype=jnp.int32)[None]
+            continue
+        if agg.fn in _TWO_COL:
+            m = np.asarray(mask)
+            a = np.asarray(frame._column_values(agg.column))[m]
+            b = np.asarray(frame._column_values(agg.column2))[m]
+            out[agg.name] = np.asarray([_np_agg2(agg.fn, a, b)])
+            continue
+        if agg.fn not in _DEVICE_AGGS:
+            m = np.asarray(mask)
+            vals = np.asarray(frame._column_values(agg.column))[m]
+            res = _np_agg(agg.fn, vals, agg.ignore_nulls)
+            # list results AND non-numeric scalars (first/last of a string
+            # column) must stay object slots — np.asarray would mint a
+            # unicode array the device column layer rejects
+            host_obj = (agg.fn in ("collect_list", "collect_set")
+                        or vals.dtype == object)
+            out[agg.name] = (_one_slot_obj(res) if host_obj
+                             else np.asarray([res]))
             continue
         col = frame._column_values(agg.column)
         if isinstance(col, np.ndarray) and col.dtype == object:
@@ -242,8 +395,19 @@ class GroupedFrame:
             for a in agg_list:
                 if a.fn == "count" and a.column is None:
                     data[a.name].append(len(idx))
+                elif a.fn in _TWO_COL:
+                    data[a.name].append(_np_agg2(
+                        a.fn, np.asarray(d[a.column])[idx],
+                        np.asarray(d[a.column2])[idx]))
                 else:
-                    data[a.name].append(_np_agg(a.fn, np.asarray(d[a.column])[idx]))
+                    data[a.name].append(_np_agg(
+                        a.fn, np.asarray(d[a.column])[idx], a.ignore_nulls))
+        # list-valued aggregate columns must stay ragged object arrays
+        for a in agg_list:
+            if a.fn in ("collect_list", "collect_set"):
+                from .frame import list_column
+
+                data[a.name] = list_column(data[a.name])
         return Frame(data)
 
     def pivot(self, pivot_col: str, values=None) -> "PivotedFrame":
@@ -323,6 +487,8 @@ class PivotedFrame:
 
         agg_arrays = {a.column: np.asarray(d[a.column])
                       for a in agg_list if a.column is not None}
+        agg_arrays.update({a.column2: np.asarray(d[a.column2])
+                           for a in agg_list if a.column2 is not None})
 
         data: dict[str, list] = {k: [] for k in self._keys}
         for nm in names.values():
@@ -341,9 +507,18 @@ class PivotedFrame:
                         # no rows for this cell → null (Spark), even for
                         # COUNT over a column (Spark yields null there too)
                         data[names[(vi, ai)]].append(float("nan"))
+                    elif a.fn in _TWO_COL:
+                        data[names[(vi, ai)]].append(_np_agg2(
+                            a.fn, agg_arrays[a.column][sub],
+                            agg_arrays[a.column2][sub]))
                     else:
-                        data[names[(vi, ai)]].append(
-                            _np_agg(a.fn, agg_arrays[a.column][sub]))
+                        data[names[(vi, ai)]].append(_np_agg(
+                            a.fn, agg_arrays[a.column][sub], a.ignore_nulls))
+        from .frame import list_column
+
+        for (vi, ai), nm in names.items():
+            if agg_list[ai].fn in ("collect_list", "collect_set"):
+                data[nm] = list_column(data[nm])
         return Frame(data)
 
     def count(self):

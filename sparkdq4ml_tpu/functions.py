@@ -3,8 +3,11 @@ column constructors, UDF invocation (the reference's
 ``import static ...functions.callUDF``, `DataQuality4MachineLearningApp.java:3`),
 scalar builtins, CASE WHEN, and aggregate constructors."""
 
-from .frame.aggregates import (avg, count, max, mean, min, stddev, sum,
-                               variance)
+from .frame.aggregates import (avg, collect_list, collect_set, corr, count,
+                               count_distinct, countDistinct, covar_pop,
+                               covar_samp, first, kurtosis, last, max, mean,
+                               min, skewness, stddev, sum, sum_distinct,
+                               sumDistinct, variance)
 from .frame.window import (Window, WindowSpec, cume_dist, dense_rank, lag,
                            lead, ntile, percent_rank, rank, row_number)
 from .ops.expressions import (call_udf, callUDF, ceil, coalesce, col, concat,
@@ -17,6 +20,9 @@ from .ops.expressions import sql_round as round  # noqa: A001 - Spark name
 
 __all__ = ["col", "lit", "call_udf", "callUDF", "count", "sum", "avg",
            "mean", "min", "max", "stddev", "variance",
+           "count_distinct", "countDistinct", "sum_distinct", "sumDistinct",
+           "collect_list", "collect_set", "first", "last",
+           "skewness", "kurtosis", "corr", "covar_samp", "covar_pop",
            "abs", "sqrt", "exp", "log", "log10", "pow", "floor", "ceil",
            "round", "signum", "greatest", "least", "isnan", "isnull",
            "coalesce", "when", "fn",

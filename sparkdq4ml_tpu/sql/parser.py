@@ -69,7 +69,11 @@ _KEYWORDS = {"select", "from", "where", "as", "and", "or", "not", "cast",
 # OVER / PARTITION are contextual (recognized only after a function call /
 # inside a window spec), so columns named "over"/"partition" keep working.
 
-_AGG_FNS = {"count", "sum", "avg", "mean", "min", "max", "stddev", "variance"}
+_AGG_FNS = {"count", "sum", "avg", "mean", "min", "max", "stddev", "variance",
+            "collect_list", "collect_set", "first", "last",
+            "skewness", "kurtosis"}
+# two-column aggregates: CORR(a, b), COVAR_SAMP(a, b), COVAR_POP(a, b)
+_AGG_FNS_2 = {"corr", "covar_samp", "covar_pop"}
 _WINDOW_FNS = {"row_number", "rank", "dense_rank", "percent_rank",
                "cume_dist", "ntile", "lag", "lead"}
 
@@ -308,9 +312,11 @@ class _Parser:
 
     def parse_item(self):
         # aggregate or window fn at top level: COUNT(*), AVG(price),
-        # ROW_NUMBER() OVER (...), SUM(price) OVER (...), ...
+        # COUNT(DISTINCT guest), CORR(a, b), ROW_NUMBER() OVER (...),
+        # SUM(price) OVER (...), ...
         t = self.peek()
-        if (t.kind == "ident" and t.value.lower() in (_AGG_FNS | _WINDOW_FNS)
+        if (t.kind == "ident"
+                and t.value.lower() in (_AGG_FNS | _AGG_FNS_2 | _WINDOW_FNS)
                 and self.toks[self.i + 1].kind == "op"
                 and self.toks[self.i + 1].value == "("):
             from ..frame.aggregates import AggExpr
@@ -319,19 +325,32 @@ class _Parser:
             self.expect("op", "(")
             col = None
             args: list = []
+            distinct = False
             if not self.accept("op", ")"):
                 if self.accept("op", "*"):
                     pass
                 else:
+                    distinct = bool(self.accept("kw", "distinct"))
                     args.append(self.parse_or())
                     while self.accept("op", ","):
                         args.append(self.parse_or())
                 self.expect("op", ")")
             if len(args) == 1 and isinstance(args[0], E.Col):
                 col = args[0].name
-            if self.accept("ident", "over"):
+            if distinct:
+                if fn.lower() not in ("count", "sum") or col is None:
+                    raise ValueError(
+                        "DISTINCT is supported in COUNT(DISTINCT col) and "
+                        "SUM(DISTINCT col)")
+                expr = AggExpr(f"{fn.lower()}_distinct", col)
+            elif self.accept("ident", "over"):
                 make = self._build_window_fn(fn, col, args)
                 expr = make(self.parse_window_spec())
+            elif fn.lower() in _AGG_FNS_2:
+                if (len(args) != 2 or not all(isinstance(a, E.Col)
+                                              for a in args)):
+                    raise ValueError(f"{fn}(col1, col2) takes two columns")
+                expr = AggExpr(fn, args[0].name, column2=args[1].name)
             elif fn.lower() in _AGG_FNS:
                 _check_agg_args(fn, col, args)
                 expr = AggExpr(fn, col)
@@ -476,13 +495,19 @@ class _Parser:
                 if t.value.lower() in _AGG_FNS and self.accept("op", "*"):
                     self.expect("op", ")")
                     return E.UdfCall(t.value, [E.Lit("*")])
+                # COUNT(DISTINCT x)/SUM(DISTINCT x) inside an expression
+                # context (HAVING): encode as the _distinct aggregate name
+                fn_name = t.value
+                if (t.value.lower() in ("count", "sum")
+                        and self.accept("kw", "distinct")):
+                    fn_name = f"{t.value.lower()}_distinct"
                 args = []
                 if not self.accept("op", ")"):
                     args.append(self.parse_or())
                     while self.accept("op", ","):
                         args.append(self.parse_or())
                     self.expect("op", ")")
-                return E.UdfCall(t.value, args)
+                return E.UdfCall(fn_name, args)
             return E.Col(t.value)
         if self.accept("op", "("):
             inner = self.parse_or()
@@ -521,7 +546,16 @@ def _rewrite_having(expr, extra_aggs: list):
     column, collecting aggs that must be computed but aren't in SELECT."""
     from ..frame.aggregates import AggExpr
 
-    if isinstance(expr, E.UdfCall) and expr.udf_name.lower() in _AGG_FNS:
+    having_aggs = _AGG_FNS | _AGG_FNS_2 | {"count_distinct", "sum_distinct"}
+    if isinstance(expr, E.UdfCall) and expr.udf_name.lower() in having_aggs:
+        fn = expr.udf_name.lower()
+        if fn in _AGG_FNS_2:
+            if (len(expr.args) != 2
+                    or not all(isinstance(a, E.Col) for a in expr.args)):
+                raise ValueError(f"{fn}(col1, col2) takes two columns")
+            agg = AggExpr(fn, expr.args[0].name, column2=expr.args[1].name)
+            extra_aggs.append(agg)
+            return E.Col(agg.name)
         arg = expr.args[0] if expr.args else None
         if arg is None or (isinstance(arg, E.Lit) and arg.value == "*"):
             col = None

@@ -1,0 +1,197 @@
+"""The round-3 aggregate sweep: countDistinct/sumDistinct, collect_list/set,
+first/last, skewness/kurtosis (scipy parity), corr/covar (numpy parity) —
+global, grouped, pivoted, and through SQL."""
+
+import numpy as np
+import pytest
+import scipy.stats
+
+import sparkdq4ml_tpu as dq
+from sparkdq4ml_tpu import Frame
+from sparkdq4ml_tpu import functions as F
+
+
+@pytest.fixture
+def frame():
+    return Frame({
+        "g": ["a", "a", "a", "b", "b", "b"],
+        "x": [1.0, 2.0, 2.0, 4.0, np.nan, 6.0],
+        "y": [2.0, 4.0, 5.0, 8.0, 10.0, 11.0],
+    })
+
+
+class TestGlobal:
+    def test_count_distinct(self, frame):
+        out = frame.agg(F.count_distinct("x")).to_pydict()
+        assert out["count(DISTINCT x)"][0] == 4      # 1, 2, 4, 6 (NaN skipped)
+
+    def test_sum_distinct(self, frame):
+        out = frame.agg(F.sum_distinct("x")).to_pydict()
+        assert out["sum(DISTINCT x)"][0] == 13.0
+
+    def test_collect_list_and_set(self, frame):
+        out = frame.agg(F.collect_list("x"), F.collect_set("x")).to_pydict()
+        assert out["collect_list(x)"][0] == [1.0, 2.0, 2.0, 4.0, 6.0]
+        assert out["collect_set(x)"][0] == [1.0, 2.0, 4.0, 6.0]
+
+    def test_first_last(self, frame):
+        out = frame.agg(F.first("x"), F.last("y")).to_pydict()
+        assert out["first(x)"][0] == 1.0
+        assert out["last(y)"][0] == 11.0
+
+    def test_last_null_vs_ignorenulls(self):
+        f = Frame({"x": [1.0, 2.0, np.nan]})
+        raw = f.agg(F.last("x")).to_pydict()["last(x)"][0]
+        assert np.isnan(raw)                          # Spark default: nulls count
+        skipped = f.agg(F.last("x", ignorenulls=True)) \
+            .to_pydict()["last(x, true)"][0]
+        assert skipped == 2.0
+
+    def test_skewness_kurtosis_scipy_parity(self):
+        rng = np.random.default_rng(0)
+        v = rng.gamma(2.0, size=200)
+        f = Frame({"v": v})
+        out = f.agg(F.skewness("v"), F.kurtosis("v")).to_pydict()
+        np.testing.assert_allclose(out["skewness(v)"][0],
+                                   scipy.stats.skew(v), rtol=1e-9)
+        np.testing.assert_allclose(out["kurtosis(v)"][0],
+                                   scipy.stats.kurtosis(v), rtol=1e-9)
+
+    def test_corr_covar_numpy_parity(self, frame):
+        out = frame.agg(F.corr("x", "y"), F.covar_samp("x", "y"),
+                        F.covar_pop("x", "y")).to_pydict()
+        x = np.asarray([1.0, 2.0, 2.0, 4.0, 6.0])
+        y = np.asarray([2.0, 4.0, 5.0, 8.0, 11.0])   # NaN row dropped pairwise
+        np.testing.assert_allclose(out["corr(x, y)"][0],
+                                   np.corrcoef(x, y)[0, 1], rtol=1e-9)
+        np.testing.assert_allclose(out["covar_samp(x, y)"][0],
+                                   np.cov(x, y, ddof=1)[0, 1], rtol=1e-9)
+        np.testing.assert_allclose(out["covar_pop(x, y)"][0],
+                                   np.cov(x, y, ddof=0)[0, 1], rtol=1e-9)
+
+    def test_mask_respected(self, frame):
+        kept = frame.filter(dq.col("g") == "a")
+        out = kept.agg(F.collect_list("y"), F.count_distinct("y")).to_pydict()
+        assert out["collect_list(y)"][0] == [2.0, 4.0, 5.0]
+        assert out["count(DISTINCT y)"][0] == 3
+
+
+class TestGrouped:
+    def test_grouped_new_aggs(self, frame):
+        out = (frame.group_by("g")
+               .agg(F.collect_set("x"), F.first("y"), F.corr("x", "y"))
+               .to_pydict())
+        by = dict(zip(out["g"], range(len(out["g"]))))
+        assert out["collect_set(x)"][by["a"]] == [1.0, 2.0]
+        assert out["first(y)"][by["a"]] == 2.0
+        xb, yb = np.asarray([4.0, 6.0]), np.asarray([8.0, 11.0])
+        np.testing.assert_allclose(out["corr(x, y)"][by["b"]],
+                                   np.corrcoef(xb, yb)[0, 1])
+
+    def test_grouped_strings_collect(self):
+        f = Frame({"k": [1, 1, 2], "s": ["p", "q", "p"]})
+        out = f.group_by("k").agg(F.collect_list("s")).to_pydict()
+        by = dict(zip(out["k"], out["collect_list(s)"]))
+        assert by[1] == ["p", "q"] and by[2] == ["p"]
+
+    def test_pivot_two_col_agg(self, frame):
+        out = (frame.group_by("g").pivot("g")
+               .agg(F.covar_pop("x", "y")).to_pydict())
+        # diagonal cells hold the group's covariance, off-diagonal null
+        a_row = out["a"][list(out["g"]).index("a")]
+        xa = np.asarray([1.0, 2.0, 2.0])
+        ya = np.asarray([2.0, 4.0, 5.0])
+        np.testing.assert_allclose(a_row, np.cov(xa, ya, ddof=0)[0, 1])
+
+
+class TestSql:
+    @pytest.fixture
+    def session(self, frame):
+        s = dq.TpuSession.builder().app_name("agg-sql").get_or_create()
+        frame.create_or_replace_temp_view("t")
+        return s
+
+    def test_count_distinct_sql(self, session):
+        out = session.sql(
+            "SELECT g, COUNT(DISTINCT x) AS nx FROM t GROUP BY g").to_pydict()
+        by = dict(zip(out["g"], out["nx"]))
+        assert by["a"] == 2 and by["b"] == 2
+
+    def test_sum_distinct_sql(self, session):
+        out = session.sql("SELECT SUM(DISTINCT x) AS s FROM t").to_pydict()
+        assert out["s"][0] == 13.0
+
+    def test_corr_sql(self, session):
+        out = session.sql("SELECT CORR(x, y) AS c FROM t").to_pydict()
+        x = np.asarray([1.0, 2.0, 2.0, 4.0, 6.0])
+        y = np.asarray([2.0, 4.0, 5.0, 8.0, 11.0])
+        np.testing.assert_allclose(out["c"][0], np.corrcoef(x, y)[0, 1])
+
+    def test_collect_and_moments_sql(self, session):
+        out = session.sql(
+            "SELECT COLLECT_SET(g) AS gs, SKEWNESS(y) AS sk FROM t"
+        ).to_pydict()
+        assert out["gs"][0] == ["a", "b"]
+        yv = np.asarray([2.0, 4.0, 5.0, 8.0, 10.0, 11.0])
+        np.testing.assert_allclose(out["sk"][0], scipy.stats.skew(yv))
+
+    def test_first_last_sql(self, session):
+        out = session.sql(
+            "SELECT g, FIRST(y) AS fy, LAST(y) AS ly FROM t GROUP BY g"
+        ).to_pydict()
+        by = {g: (f_, l_) for g, f_, l_ in zip(out["g"], out["fy"], out["ly"])}
+        assert by["a"] == (2.0, 5.0) and by["b"] == (8.0, 11.0)
+
+    def test_distinct_rejected_elsewhere(self, session):
+        with pytest.raises(ValueError, match="DISTINCT"):
+            session.sql("SELECT AVG(DISTINCT x) FROM t")
+
+
+class TestValidation:
+    def test_two_col_required(self):
+        with pytest.raises(ValueError, match="two columns"):
+            F.corr("x", None)
+
+    def test_one_col_fns_reject_second(self):
+        from sparkdq4ml_tpu.frame.aggregates import AggExpr
+        with pytest.raises(ValueError, match="one column"):
+            AggExpr("avg", "x", column2="y")
+
+    def test_windowed_unsupported(self):
+        from sparkdq4ml_tpu.functions import Window
+        with pytest.raises(ValueError, match="not supported"):
+            F.collect_list("x").over(Window.partition_by("g"))
+
+    def test_string_first_last_global(self):
+        f = Frame({"s": ["p", "q", "r"]})
+        out = f.agg(F.first("s"), F.last("s")).to_pydict()
+        assert out["first(s)"][0] == "p" and out["last(s)"][0] == "r"
+
+    def test_first_variants_do_not_collide(self):
+        f = Frame({"x": [np.nan, 2.0]})
+        out = f.agg(F.first("x"), F.first("x", ignorenulls=True)).to_pydict()
+        assert np.isnan(out["first(x)"][0])
+        assert out["first(x, true)"][0] == 2.0
+
+
+class TestHaving:
+    @pytest.fixture
+    def session(self, frame):
+        s = dq.TpuSession.builder().app_name("agg-having").get_or_create()
+        frame.create_or_replace_temp_view("th")
+        return s
+
+    def test_having_corr(self, session):
+        out = session.sql(
+            "SELECT g FROM th GROUP BY g HAVING CORR(x, y) > 0.5").to_pydict()
+        assert set(out["g"]) == {"a", "b"}
+
+    def test_having_count_distinct(self, session):
+        out = session.sql(
+            "SELECT g FROM th GROUP BY g HAVING COUNT(DISTINCT x) > 1"
+        ).to_pydict()
+        assert set(out["g"]) == {"a", "b"}
+        out2 = session.sql(
+            "SELECT g FROM th GROUP BY g HAVING COUNT(DISTINCT x) > 2"
+        ).to_pydict()
+        assert len(out2["g"]) == 0
