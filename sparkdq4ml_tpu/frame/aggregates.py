@@ -224,7 +224,7 @@ def _np_agg(fn: str, values: np.ndarray, ignore_nulls: bool = False):
     if fn == "sum":
         return values.sum()
     if fn == "sum_distinct":
-        return np.asarray(sorted(set(values.tolist()))).sum()
+        return np.asarray(list(set(values.tolist()))).sum()
     if fn == "avg":
         return float(np.mean(values))
     if fn == "min":

@@ -166,6 +166,9 @@ def _as_column(values, n: Optional[int] = None):
     """Coerce raw values into a column array (device array, or host object array)."""
     if isinstance(values, np.ndarray) and values.dtype == object:
         arr = values
+    elif isinstance(values, np.ndarray) and values.dtype.kind in ("U", "S"):
+        # numpy unicode/bytes arrays are string columns: host object array
+        arr = values.astype(object)
     elif isinstance(values, (jnp.ndarray, np.ndarray)):
         arr = jnp.asarray(values)
     else:

@@ -91,6 +91,30 @@ class FrameStatFunctions:
             data[y] = np.asarray([counts[(x, y)] for x in rows], np.int64)
         return Frame(data)
 
+    def sample_by(self, col: str, fractions: dict, seed: int = 0):
+        """Stratified Bernoulli sample without replacement
+        (Spark ``stat.sampleBy``): each row whose ``col`` value appears in
+        ``fractions`` is kept with that stratum's probability; strata
+        absent from ``fractions`` sample at 0. Mask-composed — shapes stay
+        static and column arrays are shared, like ``Frame.sample``."""
+        import jax.numpy as jnp
+
+        for k, f in fractions.items():
+            if not 0.0 <= f <= 1.0:
+                raise ValueError(
+                    f"fraction for stratum {k!r} must be in [0, 1], got {f}")
+        vals = self._frame._column_values(col)
+        vals_h = (np.asarray(vals, object) if vals.dtype == object
+                  else np.asarray(vals))
+        rng = np.random.default_rng(seed)
+        u = rng.random(len(vals_h))
+        frac = np.asarray([fractions.get(v, 0.0) for v in vals_h.tolist()])
+        keep = jnp.asarray(u < frac)
+        return self._frame._with(
+            mask=jnp.logical_and(self._frame.mask, keep))
+
+    sampleBy = sample_by
+
     def freq_items(self, cols, support: float = 0.01):
         """Per-column items with frequency ≥ support (Spark ``freqItems``)."""
         from .frame import Frame

@@ -10,11 +10,16 @@ from .frame.aggregates import (avg, collect_list, collect_set, corr, count,
                                sumDistinct, variance)
 from .frame.window import (Window, WindowSpec, cume_dist, dense_rank, lag,
                            lead, ntile, percent_rank, rank, row_number)
-from .ops.expressions import (call_udf, callUDF, ceil, coalesce, col, concat,
-                              exp, floor, fn, greatest, isnan, isnull, least,
-                              length, lit, log, log10, lower, ltrim, pow,
-                              rtrim, signum, sqrt, substring, trim, upper,
-                              when)
+from .ops.expressions import (acos, asin, atan, atan2, call_udf, callUDF,
+                              cbrt, ceil, coalesce, col, concat, concat_ws,
+                              cos, cosh, degrees, exp, expm1, floor, fn,
+                              greatest, hypot, initcap, instr, isnan, isnull,
+                              least, length, lit, locate, log, log1p, log2,
+                              log10, lower, lpad, ltrim, pow, radians,
+                              regexp_extract, regexp_replace, repeat,
+                              reverse, rint, rpad, rtrim, signum, sin, sinh,
+                              split, sqrt, substring, tan, tanh, translate,
+                              trim, upper, when)
 from .ops.expressions import sql_abs as abs  # noqa: A001 - Spark name
 from .ops.expressions import sql_round as round  # noqa: A001 - Spark name
 
@@ -28,5 +33,11 @@ __all__ = ["col", "lit", "call_udf", "callUDF", "count", "sum", "avg",
            "coalesce", "when", "fn",
            "upper", "lower", "trim", "ltrim", "rtrim", "length", "concat",
            "substring",
+           "sin", "cos", "tan", "asin", "acos", "atan", "atan2",
+           "sinh", "cosh", "tanh", "degrees", "radians", "cbrt",
+           "expm1", "log1p", "log2", "hypot", "rint",
+           "concat_ws", "split", "regexp_replace", "regexp_extract",
+           "instr", "locate", "lpad", "rpad", "repeat", "reverse",
+           "initcap", "translate",
            "Window", "WindowSpec", "row_number", "rank", "dense_rank",
            "percent_rank", "cume_dist", "ntile", "lag", "lead"]

@@ -15,6 +15,7 @@ XLA fuses — the per-row boundary does not exist.
 from __future__ import annotations
 
 import functools
+import re
 from typing import Any, Callable, Sequence
 
 import jax.numpy as jnp
@@ -461,6 +462,122 @@ def _fn_substring(s, pos, length):
     return _str_map(lambda x: x[start:start + ln], s)
 
 
+def _scalar_str(v) -> str:
+    """A literal string argument (pattern/pad/separator), row-broadcast by
+    Lit.eval — take the scalar back out. A column-valued argument (more
+    than one distinct value) is rejected rather than silently collapsed
+    to row 0's value."""
+    arr = np.asarray(v, object).ravel()
+    if len(arr) > 1 and any(x != arr[0] for x in arr[1:]):
+        raise ValueError(
+            "this string-function argument must be a literal, not a "
+            "column (per-row patterns/pads are not supported)")
+    return arr[0]
+
+
+def _scalar_int(v) -> int:
+    arr = np.asarray(v).ravel()
+    if len(arr) > 1 and np.any(arr[1:] != arr[0]):
+        raise ValueError(
+            "this string-function argument must be a literal, not a "
+            "column (per-row lengths/counts are not supported)")
+    return int(arr[0])
+
+
+def _fn_concat_ws(sep, *ss):
+    s = _scalar_str(sep)
+
+    def null(x):
+        # None (string null) or NaN (this engine's numeric null)
+        return x is None or (isinstance(x, float) and x != x)
+
+    out = []
+    for row in zip(*[np.asarray(a, object) for a in ss]):
+        # Spark concat_ws SKIPS nulls instead of nulling the result
+        out.append(s.join(str(x) for x in row if not null(x)))
+    return np.asarray(out, dtype=object)
+
+
+def _fn_split(s, pattern):
+    pat = re.compile(_scalar_str(pattern))
+    return _str_map(lambda x: pat.split(x), s)
+
+
+def _fn_regexp_replace(s, pattern, replacement):
+    pat = re.compile(_scalar_str(pattern))
+    rep = _scalar_str(replacement)
+    return _str_map(lambda x: pat.sub(rep, x), s)
+
+
+def _fn_regexp_extract(s, pattern, idx):
+    pat = re.compile(_scalar_str(pattern))
+    gi = _scalar_int(idx)
+
+    def one(x):
+        m = pat.search(x)
+        return "" if m is None else (m.group(gi) or "")
+
+    return _str_map(one, s)
+
+
+def _fn_instr(s, sub):
+    needle = _scalar_str(sub)
+    arr = np.asarray(s, object)
+    return jnp.asarray(np.asarray(
+        [0 if x is None else x.find(needle) + 1 for x in arr], np.int32))
+
+
+def _fn_locate(sub, s, pos=None):
+    # Spark: locate(substr, str[, pos]) — note the flipped argument order
+    needle = _scalar_str(sub)
+    start = (_scalar_int(pos) if pos is not None else 1)
+    arr = np.asarray(s, object)
+    return jnp.asarray(np.asarray(
+        [0 if x is None else x.find(needle, max(start - 1, 0)) + 1
+         for x in arr], np.int32))
+
+
+def _fn_lpad(s, length, pad):
+    ln = _scalar_int(length)
+    p = _scalar_str(pad)
+
+    def one(x):
+        if ln <= 0:
+            return ""                         # Spark: non-positive len → ""
+        if len(x) >= ln:
+            return x[:ln]
+        fill = (p * ln)[:ln - len(x)] if p else ""
+        return fill + x
+
+    return _str_map(one, s)
+
+
+def _fn_rpad(s, length, pad):
+    ln = _scalar_int(length)
+    p = _scalar_str(pad)
+
+    def one(x):
+        if ln <= 0:
+            return ""                         # Spark: non-positive len → ""
+        if len(x) >= ln:
+            return x[:ln]
+        fill = (p * ln)[:ln - len(x)] if p else ""
+        return x + fill
+
+    return _str_map(one, s)
+
+
+def _fn_translate(s, matching, replace):
+    # first occurrence of a repeated matching char wins (Spark semantics)
+    mapping: dict = {}
+    rep = _scalar_str(replace)
+    for i, a in enumerate(_scalar_str(matching)):
+        if a not in mapping:
+            mapping[a] = rep[i] if i < len(rep) else None
+    table = str.maketrans(mapping)
+    return _str_map(lambda x: x.translate(table), s)
+
+
 _BUILTIN_FNS = {
     # numeric (device, elementwise — XLA fuses into neighbors)
     "abs": lambda v: jnp.abs(v),
@@ -483,6 +600,26 @@ _BUILTIN_FNS = {
                                           [jnp.asarray(v) for v in vs]),
     "isnan": lambda v: jnp.isnan(jnp.asarray(v, float_dtype())),
     "coalesce": _fn_coalesce,
+    "sin": lambda v: jnp.sin(jnp.asarray(v, float_dtype())),
+    "cos": lambda v: jnp.cos(jnp.asarray(v, float_dtype())),
+    "tan": lambda v: jnp.tan(jnp.asarray(v, float_dtype())),
+    "asin": lambda v: jnp.arcsin(jnp.asarray(v, float_dtype())),
+    "acos": lambda v: jnp.arccos(jnp.asarray(v, float_dtype())),
+    "atan": lambda v: jnp.arctan(jnp.asarray(v, float_dtype())),
+    "atan2": lambda a, b: jnp.arctan2(jnp.asarray(a, float_dtype()),
+                                      jnp.asarray(b, float_dtype())),
+    "sinh": lambda v: jnp.sinh(jnp.asarray(v, float_dtype())),
+    "cosh": lambda v: jnp.cosh(jnp.asarray(v, float_dtype())),
+    "tanh": lambda v: jnp.tanh(jnp.asarray(v, float_dtype())),
+    "degrees": lambda v: jnp.degrees(jnp.asarray(v, float_dtype())),
+    "radians": lambda v: jnp.radians(jnp.asarray(v, float_dtype())),
+    "cbrt": lambda v: jnp.cbrt(jnp.asarray(v, float_dtype())),
+    "expm1": lambda v: jnp.expm1(jnp.asarray(v, float_dtype())),
+    "log1p": lambda v: jnp.log1p(jnp.asarray(v, float_dtype())),
+    "log2": lambda v: jnp.log2(jnp.asarray(v, float_dtype())),
+    "hypot": lambda a, b: jnp.hypot(jnp.asarray(a, float_dtype()),
+                                    jnp.asarray(b, float_dtype())),
+    "rint": lambda v: jnp.round(jnp.asarray(v, float_dtype())),
     # string (host object arrays; TPUs do not hold strings)
     "upper": lambda s: _str_map(str.upper, s),
     "lower": lambda s: _str_map(str.lower, s),
@@ -495,6 +632,20 @@ _BUILTIN_FNS = {
     "concat": lambda *ss: _str_map(lambda *xs: "".join(str(x) for x in xs), *ss),
     "substring": _fn_substring,
     "substr": _fn_substring,
+    "concat_ws": _fn_concat_ws,
+    "split": _fn_split,
+    "regexp_replace": _fn_regexp_replace,
+    "regexp_extract": _fn_regexp_extract,
+    "instr": _fn_instr,
+    "locate": _fn_locate,
+    "lpad": _fn_lpad,
+    "rpad": _fn_rpad,
+    "repeat": lambda s, n: _str_map(
+        lambda x: x * _scalar_int(n), s),
+    "reverse": lambda s: _str_map(lambda x: x[::-1], s),
+    "initcap": lambda s: _str_map(
+        lambda x: " ".join(w.capitalize() for w in x.split(" ")), s),
+    "translate": _fn_translate,
 }
 
 
@@ -646,6 +797,66 @@ rtrim = _make_fn("rtrim")
 length = _make_fn("length")
 concat = _make_fn("concat")
 substring = _make_fn("substring")
+sin = _make_fn("sin")
+cos = _make_fn("cos")
+tan = _make_fn("tan")
+asin = _make_fn("asin")
+acos = _make_fn("acos")
+atan = _make_fn("atan")
+atan2 = _make_fn("atan2")
+sinh = _make_fn("sinh")
+cosh = _make_fn("cosh")
+tanh = _make_fn("tanh")
+degrees = _make_fn("degrees")
+radians = _make_fn("radians")
+cbrt = _make_fn("cbrt")
+expm1 = _make_fn("expm1")
+log1p = _make_fn("log1p")
+log2 = _make_fn("log2")
+hypot = _make_fn("hypot")
+rint = _make_fn("rint")
+repeat = _make_fn("repeat")
+reverse = _make_fn("reverse")
+initcap = _make_fn("initcap")
+
+
+# String functions whose pattern/pad/separator arguments are LITERALS in
+# Spark's signatures — a bare str there must not coerce to a column ref.
+def concat_ws(sep: str, *cols) -> Func:
+    return Func("concat_ws", [Lit(sep)] + [_coerce(c) for c in cols])
+
+
+def split(col_, pattern: str) -> Func:
+    return Func("split", [_coerce(col_), Lit(pattern)])
+
+
+def regexp_replace(col_, pattern: str, replacement: str) -> Func:
+    return Func("regexp_replace",
+                [_coerce(col_), Lit(pattern), Lit(replacement)])
+
+
+def regexp_extract(col_, pattern: str, idx: int) -> Func:
+    return Func("regexp_extract", [_coerce(col_), Lit(pattern), Lit(idx)])
+
+
+def instr(col_, substr: str) -> Func:
+    return Func("instr", [_coerce(col_), Lit(substr)])
+
+
+def locate(substr: str, col_, pos: int = 1) -> Func:
+    return Func("locate", [Lit(substr), _coerce(col_), Lit(pos)])
+
+
+def lpad(col_, length: int, pad: str) -> Func:
+    return Func("lpad", [_coerce(col_), Lit(length), Lit(pad)])
+
+
+def rpad(col_, length: int, pad: str) -> Func:
+    return Func("rpad", [_coerce(col_), Lit(length), Lit(pad)])
+
+
+def translate(col_, matching: str, replace: str) -> Func:
+    return Func("translate", [_coerce(col_), Lit(matching), Lit(replace)])
 
 
 def isnull(c) -> Expr:

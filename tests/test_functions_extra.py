@@ -1,0 +1,146 @@
+"""Round-3 scalar-function sweep (math + string) and stat.sampleBy, through
+the column API and SQL."""
+
+import numpy as np
+import pytest
+
+import sparkdq4ml_tpu as dq
+from sparkdq4ml_tpu import Frame
+from sparkdq4ml_tpu import functions as F
+
+
+@pytest.fixture
+def nums():
+    return Frame({"x": [0.0, 0.5, 1.0], "y": [3.0, 4.0, 0.0]})
+
+
+@pytest.fixture
+def strs():
+    return Frame({"s": ["hello world", "a,b,c", None],
+                  "t": ["x", "y", "z"]})
+
+
+def one_col(frame, expr, name="o"):
+    return list(frame.with_column(name, expr).to_pydict()[name])
+
+
+class TestMath:
+    def test_trig_numpy_parity(self, nums):
+        got = one_col(nums, F.sin(F.col("x")))
+        np.testing.assert_allclose(got, np.sin([0.0, 0.5, 1.0]), rtol=1e-6)
+        got = one_col(nums, F.atan2(F.col("y"), F.col("x")))
+        np.testing.assert_allclose(
+            got, np.arctan2([3.0, 4.0, 0.0], [0.0, 0.5, 1.0]), rtol=1e-6)
+
+    def test_hypot_log1p(self, nums):
+        got = one_col(nums, F.hypot(F.col("x"), F.col("y")))
+        np.testing.assert_allclose(got, np.hypot([0, .5, 1], [3, 4, 0]),
+                                   rtol=1e-6)
+        got = one_col(nums, F.log1p(F.col("x")))
+        np.testing.assert_allclose(got, np.log1p([0, .5, 1]), rtol=1e-6)
+
+    def test_degrees_radians_roundtrip(self, nums):
+        got = one_col(nums, F.radians(F.degrees(F.col("x"))))
+        np.testing.assert_allclose(got, [0.0, 0.5, 1.0], rtol=1e-6)
+
+    def test_sql_math(self, nums):
+        s = dq.TpuSession.builder().app_name("fx").get_or_create()
+        nums.create_or_replace_temp_view("nums")
+        out = s.sql("SELECT TANH(x) AS th FROM nums").to_pydict()
+        np.testing.assert_allclose(out["th"], np.tanh([0, .5, 1]), rtol=1e-6)
+
+
+class TestString:
+    def test_regexp_replace_extract(self, strs):
+        got = one_col(strs, F.regexp_replace(F.col("s"), r"[aeiou]", "_"))
+        assert got[0] == "h_ll_ w_rld" and got[2] is None
+        got = one_col(strs, F.regexp_extract(F.col("s"), r"(\w+) (\w+)", 2))
+        assert got[0] == "world" and got[1] == ""
+
+    def test_split(self, strs):
+        got = one_col(strs, F.split(F.col("s"), ","))
+        assert got[1] == ["a", "b", "c"] and got[2] is None
+
+    def test_concat_ws_skips_nulls(self, strs):
+        got = one_col(strs, F.concat_ws("-", F.col("s"), F.col("t")))
+        assert got[0] == "hello world-x"
+        assert got[2] == "z"                      # null s skipped, not nulled
+
+    def test_pads_and_repeat_reverse(self, strs):
+        got = one_col(strs, F.lpad(F.col("t"), 3, "0"))
+        assert got[0] == "00x"
+        got = one_col(strs, F.rpad(F.col("t"), 3, "ab"))
+        assert got[0] == "xab"
+        got = one_col(strs, F.repeat(F.col("t"), 3))
+        assert got[0] == "xxx"
+        got = one_col(strs, F.reverse(F.col("s")))
+        assert got[0] == "dlrow olleh"
+
+    def test_truncating_pad(self, strs):
+        got = one_col(strs, F.lpad(F.col("s"), 5, "*"))
+        assert got[0] == "hello"                  # Spark truncates past len
+
+    def test_instr_locate(self, strs):
+        got = one_col(strs, F.instr(F.col("s"), "world"))
+        assert got[0] == 7 and got[2] == 0        # 1-based; null → 0
+        got = one_col(strs, F.locate("l", F.col("s"), 5))
+        assert got[0] == 10                       # search starts at pos 5
+
+    def test_initcap_translate(self, strs):
+        got = one_col(strs, F.initcap(F.col("s")))
+        assert got[0] == "Hello World"
+        got = one_col(strs, F.translate(F.col("s"), "lo", "01"))
+        assert got[0] == "he001 w1r0d"
+
+    def test_sql_string_fns(self, strs):
+        s = dq.TpuSession.builder().app_name("fs").get_or_create()
+        strs.create_or_replace_temp_view("strs")
+        out = s.sql("SELECT INITCAP(t) AS i FROM strs").to_pydict()
+        assert list(out["i"]) == ["X", "Y", "Z"]
+
+
+class TestSampleBy:
+    def test_stratified_fractions(self):
+        rng = np.random.default_rng(0)
+        g = np.asarray(["a", "b"])[rng.integers(0, 2, size=4000)]
+        f = Frame({"g": g, "v": np.arange(4000, dtype=np.float64)})
+        out = f.stat.sample_by("g", {"a": 0.8, "b": 0.1}, seed=3)
+        d = out.to_pydict()
+        kept = dict(zip(*np.unique(d["g"], return_counts=True)))
+        total = dict(zip(*np.unique(g, return_counts=True)))
+        assert abs(kept["a"] / total["a"] - 0.8) < 0.05
+        assert abs(kept["b"] / total["b"] - 0.1) < 0.05
+
+    def test_absent_stratum_sampled_at_zero(self):
+        f = Frame({"g": ["a", "a", "c", "c"], "v": [1.0, 2.0, 3.0, 4.0]})
+        out = f.stat.sample_by("g", {"a": 1.0}, seed=1)
+        assert set(out.to_pydict()["g"]) == {"a"}
+
+    def test_validation(self):
+        f = Frame({"g": ["a"], "v": [1.0]})
+        with pytest.raises(ValueError, match="stratum"):
+            f.stat.sample_by("g", {"a": 1.5})
+
+    def test_numeric_strata(self):
+        f = Frame({"k": [1, 1, 2, 2], "v": [1.0, 2.0, 3.0, 4.0]})
+        out = f.stat.sampleBy("k", {1: 1.0, 2: 0.0}, seed=0)
+        assert out.to_pydict()["k"].tolist() == [1, 1]
+
+
+class TestReviewRegressions:
+    def test_column_valued_pattern_rejected(self, strs):
+        with pytest.raises(ValueError, match="must be a literal"):
+            one_col(strs, F.fn("instr", F.col("s"), F.col("t")))
+
+    def test_concat_ws_skips_nan(self):
+        f = Frame({"x": [1.0, np.nan], "t": ["a", "b"]})
+        got = one_col(f, F.concat_ws("-", F.col("x"), F.col("t")))
+        assert got[0] == "1.0-a" and got[1] == "b"
+
+    def test_pad_nonpositive_length_empty(self, strs):
+        assert one_col(strs, F.lpad(F.col("t"), -1, "*"))[0] == ""
+        assert one_col(strs, F.rpad(F.col("t"), 0, "*"))[0] == ""
+
+    def test_translate_first_mapping_wins(self, strs):
+        got = one_col(strs, F.translate(F.col("t"), "xx", "12"))
+        assert got[0] == "1"
