@@ -20,7 +20,8 @@ from .feature import (Binarizer, Bucketizer, ChiSqSelector,
                       FeatureHasher, Imputer, ImputerModel,
                       IndexToString, Interaction, MaxAbsScaler,
                       MaxAbsScalerModel, MinMaxScaler, MinMaxScalerModel,
-                      Normalizer, OneHotEncoder, OneHotEncoderModel, PCA,
+                      Normalizer, OneHotEncoder, OneHotEncoderEstimator,
+                      OneHotEncoderModel, PCA,
                       PCAModel, PolynomialExpansion, QuantileDiscretizer,
                       RFormula, RFormulaModel, RobustScaler,
                       RobustScalerModel, SQLTransformer,

@@ -279,6 +279,12 @@ class OneHotEncoder(Estimator):
                                   self.drop_last)
 
 
+# Spark 2.4 ships this estimator under the name OneHotEncoderEstimator
+# (the old OneHotEncoder transformer was deprecated); 3.0 renamed it back.
+# Both names resolve here.
+OneHotEncoderEstimator = OneHotEncoder
+
+
 @persistable
 class OneHotEncoderModel(Model):
     _persist_attrs = ('category_size', 'input_col', 'output_col', 'drop_last')
