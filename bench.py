@@ -179,6 +179,7 @@ def main():
         t_x = median_time(lambda: xla_gram(Z), SWEEP_REPS)
 
         t_p = None
+        best_block = None
         # Off-TPU the Pallas interpreter executes element-by-element — the
         # numerics cross-check at full sweep sizes would run for hours, so
         # it only runs compiled (TPU) or on the SMOKE shapes.
@@ -187,9 +188,16 @@ def main():
             try:
                 A_p = pallas_kernels.packed_gram_pallas(Z)
                 if backend == "tpu":
-                    t_p = median_time(
-                        lambda: pallas_kernels.packed_gram_pallas(Z),
-                        SWEEP_REPS)
+                    # Row-tile autotune: bigger tiles amortize grid/DMA
+                    # overhead; all candidates fit VMEM double-buffered.
+                    for blk in (512, 1024, 2048, 4096):
+                        if blk > n:
+                            continue
+                        t_b = median_time(
+                            lambda: pallas_kernels.packed_gram_pallas(
+                                Z, block_rows=blk), SWEEP_REPS)
+                        if t_p is None or t_b < t_p:
+                            t_p, best_block = t_b, blk
                 A_x = xla_gram(Z)
                 scale = jnp.maximum(jnp.max(jnp.abs(A_x)), 1.0)
                 pallas_diffs.append(
@@ -203,6 +211,7 @@ def main():
             "xla_gbps": round(gb / t_x, 1),
             "pallas_ms": round(t_p * 1e3, 3) if t_p else None,
             "pallas_gbps": round(gb / t_p, 1) if t_p else None,
+            "pallas_block": best_block,
         })
         del Z
 
