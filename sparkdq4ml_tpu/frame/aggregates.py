@@ -353,7 +353,30 @@ def global_agg(frame, aggs: list[AggExpr]):
     return Frame(out)
 
 
-class GroupedFrame:
+class _AggShortcuts:
+    """The RelationalGroupedDataset terminal shortcuts, shared by the
+    grouped, pivoted, and rollup/cube frames — each delegates to
+    ``self.agg``."""
+
+    def count(self):
+        return self.agg(AggExpr("count", None))
+
+    def sum(self, *cols: str):
+        return self.agg(*[AggExpr("sum", c) for c in cols])
+
+    def avg(self, *cols: str):
+        return self.agg(*[AggExpr("avg", c) for c in cols])
+
+    mean = avg
+
+    def min(self, *cols: str):
+        return self.agg(*[AggExpr("min", c) for c in cols])
+
+    def max(self, *cols: str):
+        return self.agg(*[AggExpr("max", c) for c in cols])
+
+
+class GroupedFrame(_AggShortcuts):
     """Result of ``Frame.group_by`` — terminal agg methods mirror Spark's
     ``RelationalGroupedDataset``."""
 
@@ -420,25 +443,9 @@ class GroupedFrame:
         self._frame._column_values(pivot_col)
         return PivotedFrame(self._frame, self._keys, pivot_col, values)
 
-    def count(self):
-        return self.agg(AggExpr("count", None))
-
-    def sum(self, *cols: str):
-        return self.agg(*[AggExpr("sum", c) for c in cols])
-
-    def avg(self, *cols: str):
-        return self.agg(*[AggExpr("avg", c) for c in cols])
-
-    mean = avg
-
-    def min(self, *cols: str):
-        return self.agg(*[AggExpr("min", c) for c in cols])
-
-    def max(self, *cols: str):
-        return self.agg(*[AggExpr("max", c) for c in cols])
 
 
-class PivotedFrame:
+class PivotedFrame(_AggShortcuts):
     """Result of ``GroupedFrame.pivot`` — terminal agg methods produce one
     output column per (pivot value × aggregate), Spark column naming:
     just the value for a single aggregate, ``value_aggname`` for several."""
@@ -521,19 +528,74 @@ class PivotedFrame:
                 data[nm] = list_column(data[nm])
         return Frame(data)
 
-    def count(self):
-        return self.agg(AggExpr("count", None))
 
-    def sum(self, *cols: str):
-        return self.agg(*[AggExpr("sum", c) for c in cols])
 
-    def avg(self, *cols: str):
-        return self.agg(*[AggExpr("avg", c) for c in cols])
+class MultiGroupedFrame(_AggShortcuts):
+    """``Frame.rollup``/``Frame.cube`` — aggregate at several grouping
+    levels and union the results, Spark's subtotal semantics: key columns
+    absent from a level come back null. Output key columns are nullable
+    and therefore host object columns (None in subtotal rows) — keeping
+    integer keys EXACT; a NaN filler would silently promote int keys to
+    the device float dtype and corrupt values past its mantissa."""
 
-    mean = avg
+    def __init__(self, frame, keys: list[str], levels: list[tuple]):
+        if not keys:
+            raise ValueError("rollup/cube require at least one key column")
+        self._frame = frame
+        self._keys = keys
+        self._levels = levels
+        for k in keys:
+            frame._column_values(k)  # validate early
 
-    def min(self, *cols: str):
-        return self.agg(*[AggExpr("min", c) for c in cols])
+    def agg(self, *aggs: Union[AggExpr, str]):
+        from .frame import Frame
 
-    def max(self, *cols: str):
-        return self.agg(*[AggExpr("max", c) for c in cols])
+        agg_list = [AggExpr(a, None) if isinstance(a, str) else a
+                    for a in aggs]
+        if not agg_list:
+            raise ValueError("agg() needs at least one aggregate")
+
+        # One pass per level; a single concatenate per column at the end.
+        key_parts: dict[str, list] = {k: [] for k in self._keys}
+        agg_parts: dict[str, list] = {a.name: [] for a in agg_list}
+        for kept in self._levels:
+            if kept:
+                out = GroupedFrame(self._frame, list(kept)).agg(*agg_list)
+            else:
+                out = global_agg(self._frame, agg_list)
+            d = out.to_pydict()
+            n = len(next(iter(d.values()))) if d else 0
+            for k in self._keys:
+                if k in d:
+                    key_parts[k].append(np.asarray(d[k], object))
+                else:
+                    filler = np.empty(n, dtype=object)  # None slots
+                    filler.fill(None)
+                    key_parts[k].append(filler)
+            for a in agg_list:
+                agg_parts[a.name].append(np.asarray(d[a.name]))
+
+        data: dict = {}
+        for k in self._keys:
+            data[k] = np.concatenate(key_parts[k])
+        for a in agg_list:
+            parts = agg_parts[a.name]
+            if any(p.dtype == object for p in parts):
+                parts = [np.asarray(p, object) for p in parts]
+            data[a.name] = np.concatenate(parts)
+        return Frame(data)
+
+
+def rollup_levels(keys: list[str]) -> list[tuple]:
+    """Prefixes, longest first, down to the grand total: Spark ROLLUP."""
+    return [tuple(keys[:i]) for i in range(len(keys), -1, -1)]
+
+
+def cube_levels(keys: list[str]) -> list[tuple]:
+    """Every key subset (kept in key order), by descending size: CUBE."""
+    import itertools as _it
+
+    out = []
+    for r in range(len(keys), -1, -1):
+        out.extend(_it.combinations(keys, r))
+    return out

@@ -735,6 +735,19 @@ class Frame:
 
     groupBy = group_by
 
+    def rollup(self, *keys: str):
+        """``rollup`` — hierarchical subtotals: every key prefix plus the
+        grand total, absent keys null (Spark ROLLUP)."""
+        from .aggregates import MultiGroupedFrame, rollup_levels
+
+        return MultiGroupedFrame(self, list(keys), rollup_levels(list(keys)))
+
+    def cube(self, *keys: str):
+        """``cube`` — subtotals for EVERY key subset (Spark CUBE)."""
+        from .aggregates import MultiGroupedFrame, cube_levels
+
+        return MultiGroupedFrame(self, list(keys), cube_levels(list(keys)))
+
     def agg(self, *aggs):
         """Global aggregates (no grouping): masked device reductions."""
         from .aggregates import AggExpr, global_agg

@@ -195,3 +195,62 @@ class TestHaving:
             "SELECT g FROM th GROUP BY g HAVING COUNT(DISTINCT x) > 2"
         ).to_pydict()
         assert len(out2["g"]) == 0
+
+
+class TestRollupCube:
+    @pytest.fixture
+    def sales(self):
+        return Frame({
+            "region": ["e", "e", "w", "w"],
+            "product": ["p1", "p2", "p1", "p2"],
+            "amount": [10.0, 20.0, 30.0, 40.0],
+        })
+
+    def test_rollup_levels(self, sales):
+        out = sales.rollup("region", "product").agg(F.sum("amount"))
+        d = out.to_pydict()
+        rows = {(r, p): v for r, p, v in
+                zip(d["region"], d["product"], d["sum(amount)"])}
+        # detail level
+        assert rows[("e", "p1")] == 10.0 and rows[("w", "p2")] == 40.0
+        # region subtotal (product null)
+        assert rows[("e", None)] == 30.0 and rows[("w", None)] == 70.0
+        # grand total (both null)
+        assert rows[(None, None)] == 100.0
+        # rollup does NOT emit product-only subtotals
+        assert (None, "p1") not in rows
+        assert len(d["region"]) == 4 + 2 + 1
+
+    def test_cube_levels(self, sales):
+        out = sales.cube("region", "product").agg(F.sum("amount"))
+        d = out.to_pydict()
+        rows = {(r, p): v for r, p, v in
+                zip(d["region"], d["product"], d["sum(amount)"])}
+        assert rows[(None, "p1")] == 40.0     # product-only subtotal
+        assert rows[(None, "p2")] == 60.0
+        assert rows[("e", None)] == 30.0
+        assert rows[(None, None)] == 100.0
+        assert len(d["region"]) == 4 + 2 + 2 + 1
+
+    def test_rollup_count_shortcut(self, sales):
+        d = sales.rollup("region").count().to_pydict()
+        rows = dict(zip(d["region"], d["count"]))
+        assert rows["e"] == 2 and rows["w"] == 2 and rows[None] == 4
+
+    def test_numeric_keys_exact_with_none_subtotals(self):
+        # key columns come back nullable (object, None in subtotal rows)
+        # so big int keys stay EXACT instead of rounding through float32
+        f = Frame({"k": [16777217, 16777217, 16777219],
+                   "v": [1.0, 2.0, 3.0]})
+        d = f.rollup("k").agg(F.sum("v")).to_pydict()
+        ks = list(d["k"])
+        assert None in ks                          # grand-total row
+        assert 16777217 in ks and 16777219 in ks   # exact past 2^24
+        total = d["sum(v)"][ks.index(None)]
+        assert total == 6.0
+
+    def test_validation(self, sales):
+        with pytest.raises(ValueError, match="at least one key"):
+            sales.rollup()
+        with pytest.raises(ValueError, match="at least one aggregate"):
+            sales.cube("region").agg()
