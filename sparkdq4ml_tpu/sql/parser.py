@@ -173,11 +173,24 @@ class _Parser:
         if self.accept("kw", "where"):
             where = self.parse_or()
         group_by = []
+        group_mode = "group"
         if self.accept("kw", "group"):
             self.expect("kw", "by")
-            group_by.append(self.expect("ident").value)
-            while self.accept("op", ","):
+            # GROUP BY ROLLUP(a, b) / CUBE(a, b) — Spark subtotal grouping
+            nxt = self.peek()
+            if (nxt.kind == "ident" and nxt.value.lower() in ("rollup", "cube")
+                    and self.toks[self.i + 1].kind == "op"
+                    and self.toks[self.i + 1].value == "("):
+                group_mode = self.next().value.lower()
+                self.expect("op", "(")
                 group_by.append(self.expect("ident").value)
+                while self.accept("op", ","):
+                    group_by.append(self.expect("ident").value)
+                self.expect("op", ")")
+            else:
+                group_by.append(self.expect("ident").value)
+                while self.accept("op", ","):
+                    group_by.append(self.expect("ident").value)
         having = None
         if self.accept("kw", "having"):
             having = self.parse_or()
@@ -190,8 +203,10 @@ class _Parser:
         limit = None
         if self.accept("kw", "limit"):
             limit = int(self.expect("number").value)
-        return Query(items, view, where, group_by, order_by, limit, joins,
-                     distinct=distinct, having=having)
+        q = Query(items, view, where, group_by, order_by, limit, joins,
+                  distinct=distinct, having=having)
+        q.group_mode = group_mode
+        return q
 
     def parse_union_query(self):
         """query (UNION [ALL] query)* — set union over identical schemas."""
@@ -533,6 +548,7 @@ class Query:
         self.distinct = distinct
         self.having = having
         self.unions = list(unions)  # [(Query, dedup: bool), ...]
+        self.group_mode = "group"   # "group" | "rollup" | "cube"
 
 
 def parse(sql: str) -> Query:
@@ -625,7 +641,12 @@ def _execute_single(q: Query, cat):
                 having = _rewrite_having(having, extra_aggs)
                 known = {a.name for a in aggs}
                 extra_aggs = [a for a in extra_aggs if a.name not in known]
-            frame = frame.group_by(*q.group_by).agg(*aggs, *extra_aggs)
+            grouped = (frame.rollup(*q.group_by)
+                       if q.group_mode == "rollup"
+                       else frame.cube(*q.group_by)
+                       if q.group_mode == "cube"
+                       else frame.group_by(*q.group_by))
+            frame = grouped.agg(*aggs, *extra_aggs)
             if having is not None:
                 frame = frame.filter(having)
             keep = [it.name for it in q.items

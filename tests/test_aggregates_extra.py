@@ -254,3 +254,20 @@ class TestRollupCube:
             sales.rollup()
         with pytest.raises(ValueError, match="at least one aggregate"):
             sales.cube("region").agg()
+
+    def test_sql_rollup_and_cube(self):
+        s = dq.TpuSession.builder().app_name("rc-sql").get_or_create()
+        Frame({"region": ["e", "e", "w", "w"],
+               "product": ["p1", "p2", "p1", "p2"],
+               "amount": [10.0, 20.0, 30.0, 40.0]}) \
+            .create_or_replace_temp_view("sales")
+        d = s.sql("SELECT region, product, SUM(amount) AS s FROM sales "
+                  "GROUP BY ROLLUP(region, product)").to_pydict()
+        rows = {(r, p): v for r, p, v in
+                zip(d["region"], d["product"], d["s"])}
+        assert rows[("e", None)] == 30.0 and rows[(None, None)] == 100.0
+        d = s.sql("SELECT region, product, SUM(amount) AS s FROM sales "
+                  "GROUP BY CUBE(region, product)").to_pydict()
+        rows = {(r, p): v for r, p, v in
+                zip(d["region"], d["product"], d["s"])}
+        assert rows[(None, "p1")] == 40.0 and len(d["s"]) == 9
