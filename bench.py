@@ -167,6 +167,14 @@ def main():
     def xla_gram(Z):
         return Z.T @ Z
 
+    # bf16-STORED variant: rows live in HBM at half the bytes and the MXU
+    # is bf16-native; accumulation stays f32 (preferred_element_type)
+    @jax.jit
+    def xla_gram_bf16(Zh):
+        return jax.lax.dot_general(
+            Zh, Zh, dimension_numbers=(((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
     sweep_rows = []        # timings (host floats, no device reads)
     pallas_diffs = []      # on-device |A_p - A_x| max scalars, read later
     pallas_mode = "on" if backend == "tpu" else "interpret"
@@ -177,6 +185,10 @@ def main():
         gb = n * (d + 2) * 4 / 1e9
 
         t_x = median_time(lambda: xla_gram(Z), SWEEP_REPS)
+
+        Zh = jax.block_until_ready(Z.astype(jnp.bfloat16))
+        t_h = median_time(lambda: xla_gram_bf16(Zh), SWEEP_REPS)
+        gb_h = n * (d + 2) * 2 / 1e9
 
         t_p = None
         best_block = None
@@ -209,11 +221,14 @@ def main():
             "rows": n, "features": d,
             "xla_ms": round(t_x * 1e3, 3),
             "xla_gbps": round(gb / t_x, 1),
+            "bf16_ms": round(t_h * 1e3, 3),
+            "bf16_gbps": round(gb_h / t_h, 1),
+            "bf16_rows_speedup": round(t_x / t_h, 2),
             "pallas_ms": round(t_p * 1e3, 3) if t_p else None,
             "pallas_gbps": round(gb / t_p, 1) if t_p else None,
             "pallas_block": best_block,
         })
-        del Z
+        del Z, Zh
 
     # =====================================================================
     # PHASE 2 — host reads, CPU baselines, assertions
