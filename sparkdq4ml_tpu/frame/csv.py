@@ -258,10 +258,16 @@ class DataFrameReader:
         return default if v is None else v.strip().lower() in ("true", "1", "yes")
 
     def load(self, path: str) -> Frame:
-        if self._format != "csv":
-            raise ValueError(f"unsupported format {self._format!r} (only csv)")
+        if self._format not in ("csv", "json"):
+            raise ValueError(
+                f"unsupported format {self._format!r} (csv or json)")
         if not os.path.exists(path):
             raise FileNotFoundError(path)
+        if self._format == "json":
+            from .jsonl import read_json
+
+            return read_json(path,
+                             multi_line=self._bool_opt("multiline", False))
         return read_csv(
             path,
             header=self._bool_opt("header", False),
@@ -274,3 +280,6 @@ class DataFrameReader:
 
     def csv(self, path: str, header: bool = False, inferSchema: bool = False) -> Frame:
         return self.option("header", header).option("inferSchema", inferSchema).load(path)
+
+    def json(self, path: str, multiLine: bool = False) -> Frame:
+        return self.format("json").option("multiLine", multiLine).load(path)

@@ -1,0 +1,120 @@
+"""JSON-lines reader/writer — Spark's default ``json`` source format (one
+object per line; ``multiLine=true`` reads a single top-level JSON array).
+
+Schema is inferred the Spark way: the column set is the union of keys over
+all records; a column whose values are all integral reads as int, any
+float promotes to double, any string/bool/nested value makes it a host
+object column; missing keys are null (NaN numeric / None object). Nested
+objects and arrays stay as host Python objects (the engine's string-side
+boundary — scalars live in HBM, structure stays on the host), where Spark
+would infer struct/array types.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+
+import numpy as np
+
+from ..config import float_dtype
+from .frame import Frame, list_column
+
+
+def _records_from_file(path: str, multi_line: bool) -> list[dict]:
+    with open(path, "r", encoding="utf-8") as f:
+        if multi_line:
+            data = json.load(f)
+            if not isinstance(data, list):
+                raise ValueError(
+                    "multiLine json must be a top-level array of objects")
+            records = data
+        else:
+            records = []
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                records.append(json.loads(line))
+    for r in records:
+        if not isinstance(r, dict):
+            raise ValueError(f"json record is not an object: {r!r}")
+    return records
+
+
+def read_json(path: str, multi_line: bool = False) -> Frame:
+    records = _records_from_file(path, multi_line)
+    names: list[str] = []
+    for r in records:
+        for k in r:
+            if k not in names:
+                names.append(k)
+
+    data = {}
+    for name in names:
+        vals = [r.get(name) for r in records]
+        kinds = set()
+        for v in vals:
+            if v is None:
+                continue
+            if isinstance(v, bool):
+                kinds.add("bool")
+            elif isinstance(v, int):
+                kinds.add("int")
+            elif isinstance(v, float):
+                kinds.add("float")
+            elif isinstance(v, str):
+                kinds.add("str")
+            else:
+                kinds.add("object")
+        if kinds <= {"int"} and all(v is not None for v in vals):
+            try:
+                data[name] = np.asarray(vals, np.int64)
+            except OverflowError:
+                # valid-JSON integers past int64: promote like a float col
+                data[name] = np.asarray([float(v) for v in vals],
+                                        np.dtype(float_dtype()))
+        elif kinds <= {"int", "float"}:
+            data[name] = np.asarray(
+                [math.nan if v is None else float(v) for v in vals],
+                np.dtype(float_dtype()))   # honor engine dtype (as CSV does)
+        elif kinds <= {"bool"} and all(v is not None for v in vals):
+            data[name] = np.asarray(vals, bool)
+        else:
+            data[name] = list_column(vals)
+    return Frame(data)
+
+
+def write_json(frame, path: str) -> None:
+    """One JSON object per line, valid rows only; NaN → null (Spark
+    writes nulls, and NaN is this engine's numeric null)."""
+    d = frame.to_pydict()
+    names = frame.columns
+    n = len(next(iter(d.values()))) if d else 0
+    parent = os.path.dirname(path)
+    if parent:
+        os.makedirs(parent, exist_ok=True)
+
+    def conv(v):
+        if v is None:
+            return None
+        if isinstance(v, (np.floating, float)):
+            # NaN/±Inf have no JSON representation → null, at EVERY depth
+            return float(v) if math.isfinite(v) else None
+        if isinstance(v, (np.bool_, bool)):
+            return bool(v)
+        if isinstance(v, (np.integer, int)):
+            return int(v)
+        if isinstance(v, np.ndarray):
+            return [conv(x) for x in v.tolist()]
+        if isinstance(v, (list, tuple)):
+            return [conv(x) for x in v]
+        if isinstance(v, dict):
+            return {k: conv(x) for k, x in v.items()}
+        return v
+
+    with open(path, "w", encoding="utf-8") as f:
+        for i in range(n):
+            f.write(json.dumps({name: conv(d[name][i]) for name in names})
+                    + "\n")

@@ -72,14 +72,23 @@ class DataFrameWriter:
         return self
 
     def save(self, path: str) -> None:
-        if self._format != "csv":
-            raise ValueError(f"unsupported format {self._format!r} (only csv)")
+        if self._format not in ("csv", "json"):
+            raise ValueError(
+                f"unsupported format {self._format!r} (csv or json)")
         if os.path.exists(path) and self._mode == "errorifexists":
             raise FileExistsError(
                 f"{path} exists (use .mode('overwrite') to replace)")
+        if self._format == "json":
+            from .jsonl import write_json
+
+            write_json(self._frame, path)
+            return
         header = self._options.get("header", "false").lower() in ("true", "1")
         delimiter = self._options.get("sep", self._options.get("delimiter", ","))
         write_csv(self._frame, path, header=header, delimiter=delimiter)
 
     def csv(self, path: str) -> None:
         self.save(path)
+
+    def json(self, path: str) -> None:
+        self.format("json").save(path)

@@ -1,0 +1,127 @@
+"""JSON-lines reader/writer: schema inference (Spark's union-of-keys,
+int→double promotion, nested values as host objects), multiLine arrays,
+round-trips, and the session.read surface."""
+
+import numpy as np
+import pytest
+
+import sparkdq4ml_tpu as dq
+from sparkdq4ml_tpu import Frame
+
+
+@pytest.fixture
+def session():
+    return dq.TpuSession.builder().app_name("json").get_or_create()
+
+
+def write(tmp_path, text, name="data.json"):
+    p = tmp_path / name
+    p.write_text(text)
+    return str(p)
+
+
+class TestReadJson:
+    def test_basic_schema_inference(self, session, tmp_path):
+        p = write(tmp_path, '{"a": 1, "b": 2.5, "s": "x"}\n'
+                            '{"a": 2, "b": 3, "s": "y"}\n')
+        df = session.read.json(p)
+        d = df.to_pydict()
+        assert d["a"].tolist() == [1, 2]
+        assert d["a"].dtype.kind == "i"            # all-int stays integral
+        np.testing.assert_allclose(d["b"], [2.5, 3.0])   # int+float → double
+        assert list(d["s"]) == ["x", "y"]
+
+    def test_missing_keys_null(self, session, tmp_path):
+        p = write(tmp_path, '{"a": 1}\n{"a": 2, "extra": "e"}\n')
+        d = session.read.json(p).to_pydict()
+        assert list(d["extra"]) == [None, "e"]
+        assert d["a"].tolist() == [1, 2]
+
+    def test_missing_int_promotes_to_double_with_nan(self, session, tmp_path):
+        p = write(tmp_path, '{"a": 1}\n{"b": 2}\n')
+        d = session.read.json(p).to_pydict()
+        assert np.isnan(d["a"][1]) and d["a"][0] == 1.0
+
+    def test_nested_values_stay_objects(self, session, tmp_path):
+        p = write(tmp_path,
+                  '{"tags": ["x", "y"], "meta": {"k": 1}}\n'
+                  '{"tags": [], "meta": {"k": 2}}\n')
+        d = session.read.json(p).to_pydict()
+        assert d["tags"][0] == ["x", "y"]
+        assert d["meta"][1] == {"k": 2}
+
+    def test_bool_column(self, session, tmp_path):
+        p = write(tmp_path, '{"f": true}\n{"f": false}\n')
+        d = session.read.json(p).to_pydict()
+        assert d["f"].tolist() == [True, False]
+
+    def test_multiline_array(self, session, tmp_path):
+        p = write(tmp_path, '[{"a": 1}, {"a": 2}]')
+        df = (session.read.format("json").option("multiLine", "true")
+              .load(p))
+        assert df.to_pydict()["a"].tolist() == [1, 2]
+
+    def test_blank_lines_skipped(self, session, tmp_path):
+        p = write(tmp_path, '{"a": 1}\n\n{"a": 2}\n\n')
+        assert session.read.json(p).count() == 2
+
+    def test_errors(self, session, tmp_path):
+        with pytest.raises(FileNotFoundError):
+            session.read.json(str(tmp_path / "missing.json"))
+        p = write(tmp_path, '[1, 2]', "arr.json")
+        with pytest.raises(ValueError, match="not an object"):
+            session.read.json(p, multiLine=True)
+        p = write(tmp_path, '{"a": 1}', "obj.json")
+        with pytest.raises(ValueError, match="top-level array"):
+            session.read.json(p, multiLine=True)
+
+
+class TestWriteJson:
+    def test_round_trip(self, session, tmp_path):
+        f = Frame({"a": [1.5, np.nan], "s": ["x", None],
+                   "i": np.asarray([7, 8], np.int64)})
+        out = str(tmp_path / "out.json")
+        f.write.json(out)
+        back = session.read.json(out)
+        d = back.to_pydict()
+        assert d["a"][0] == 1.5 and np.isnan(d["a"][1])   # NaN → null → NaN
+        assert list(d["s"]) == ["x", None]
+        assert d["i"].tolist() == [7, 8]
+
+    def test_masked_rows_not_written(self, session, tmp_path):
+        f = Frame({"a": [1.0, 2.0, 3.0]})
+        f = f.filter(dq.col("a") > 1.5)
+        out = str(tmp_path / "masked.json")
+        f.write.json(out)
+        assert session.read.json(out).count() == 2
+
+    def test_mode_guard(self, tmp_path):
+        f = Frame({"a": [1.0]})
+        out = str(tmp_path / "dup.json")
+        f.write.json(out)
+        with pytest.raises(FileExistsError):
+            f.write.json(out)
+        f.write.mode("overwrite").json(out)
+
+
+class TestReviewRegressions:
+    def test_huge_int_promotes_instead_of_crashing(self, session, tmp_path):
+        p = write(tmp_path, '{"a": 9223372036854775808}\n{"a": 1}\n')
+        d = session.read.json(p).to_pydict()
+        assert d["a"][0] == float(2**63) and d["a"][1] == 1.0
+
+    def test_nested_nan_written_as_null(self, session, tmp_path):
+        import json as _json
+        from sparkdq4ml_tpu.frame.frame import list_column
+        f = Frame({"x": list_column([[1.0, float("nan")], [2.0]])})
+        out = str(tmp_path / "nested.json")
+        f.write.json(out)
+        lines = [ln for ln in open(out).read().splitlines() if ln]
+        parsed = [_json.loads(ln) for ln in lines]   # must be strict JSON
+        assert parsed[0]["x"] == [1.0, None]
+
+    def test_float_dtype_config_honored(self, session, tmp_path):
+        from sparkdq4ml_tpu.config import float_dtype
+        p = write(tmp_path, '{"b": 2.5}\n')
+        d = session.read.json(p).to_pydict()
+        assert d["b"].dtype == np.dtype(float_dtype())
