@@ -20,6 +20,10 @@ from .ops.expressions import (acos, asin, atan, atan2, call_udf, callUDF,
                               reverse, rint, rpad, rtrim, signum, sin, sinh,
                               split, sqrt, substring, tan, tanh, translate,
                               trim, upper, when)
+from .ops.expressions import (current_date, date_add, date_format, date_sub,
+                              datediff, dayofmonth, dayofweek, dayofyear,
+                              from_unixtime, month, quarter, to_date,
+                              unix_timestamp, year)
 from .ops.expressions import sql_abs as abs  # noqa: A001 - Spark name
 from .ops.expressions import sql_round as round  # noqa: A001 - Spark name
 
@@ -39,5 +43,9 @@ __all__ = ["col", "lit", "call_udf", "callUDF", "count", "sum", "avg",
            "concat_ws", "split", "regexp_replace", "regexp_extract",
            "instr", "locate", "lpad", "rpad", "repeat", "reverse",
            "initcap", "translate",
+           "to_date", "unix_timestamp", "from_unixtime", "date_format",
+           "datediff", "date_add", "date_sub", "current_date",
+           "year", "month", "dayofmonth", "dayofweek", "dayofyear",
+           "quarter",
            "Window", "WindowSpec", "row_number", "rank", "dense_rank",
            "percent_rank", "cume_dist", "ntile", "lag", "lead"]

@@ -861,3 +861,239 @@ def translate(col_, matching: str, replace: str) -> Func:
 
 def isnull(c) -> Expr:
     return _coerce(c).is_null()
+
+
+# ---------------------------------------------------------------------------
+# Date / time functions
+#
+# TPU-native representation: a DATE is a float device column of days since
+# the Unix epoch with NaN as null — the engine's numeric-null convention,
+# so null dates are visible to isnull()/filters/aggregates (an int
+# sentinel would silently pass comparisons). Day counts are exact in
+# float32 far past any calendar. Field extraction (year/month/day...) is
+# vectorized integer math ON DEVICE (civil-from-days, Hinnant's
+# algorithm), not a host datetime loop; fields come back float with NaN
+# propagated. Parsing and formatting cross the host boundary like every
+# string op. Epoch SECONDS exceed float32's exact-integer range, so
+# unix_timestamp requires the x64 mode and yields float64.
+# ---------------------------------------------------------------------------
+
+
+def _strptime_format(java_fmt: str) -> str:
+    """Translate a Spark/Java date pattern into strptime, run by run.
+    Unsupported pattern letters raise instead of silently producing
+    all-null columns."""
+    runs = {"yyyy": "%Y", "yy": "%y", "MM": "%m", "M": "%m",
+            "dd": "%d", "d": "%d", "HH": "%H", "H": "%H",
+            "mm": "%M", "m": "%M", "ss": "%S", "s": "%S"}
+    out = []
+    i = 0
+    while i < len(java_fmt):
+        c = java_fmt[i]
+        if c.isalpha():
+            j = i
+            while j < len(java_fmt) and java_fmt[j] == c:
+                j += 1
+            run = java_fmt[i:j]
+            if run not in runs:
+                raise ValueError(
+                    f"unsupported date-format token {run!r} in "
+                    f"{java_fmt!r} (supported: {sorted(runs)})")
+            out.append(runs[run])
+            i = j
+        else:
+            out.append("%%" if c == "%" else c)
+            i += 1
+    return "".join(out)
+
+
+def _parse_dates(s, fmt: str, unit_seconds: bool):
+    """Host parse of a string column → epoch days (engine float, NaN null)
+    or epoch seconds (float64, x64 required). Unparseable / null rows →
+    NaN (Spark yields null)."""
+    import datetime as _dt
+
+    py_fmt = _strptime_format(fmt)
+    arr = np.asarray(s, object)
+    out = np.empty(len(arr), np.float64)
+    epoch = _dt.datetime(1970, 1, 1)
+    for i, x in enumerate(arr):
+        if x is None:
+            out[i] = np.nan
+            continue
+        try:
+            t = _dt.datetime.strptime(str(x).strip(), py_fmt)
+        except ValueError:
+            out[i] = np.nan
+            continue
+        delta = t - epoch
+        out[i] = delta.total_seconds() if unit_seconds else delta.days
+    if unit_seconds:
+        import jax
+
+        if not jax.config.jax_enable_x64:
+            raise ValueError(
+                "unix_timestamp requires jax_enable_x64: epoch seconds "
+                "exceed float32's exact-integer range (use to_date for "
+                "day-resolution work)")
+        return jnp.asarray(out, jnp.float64)
+    return jnp.asarray(out, float_dtype())
+
+
+def _civil_from_days(z):
+    """days-since-epoch → (year, month, day), vectorized integer device math
+    (Howard Hinnant's civil_from_days)."""
+    z = z + 719468
+    era = jnp.where(z >= 0, z, z - 146096) // 146097
+    doe = z - era * 146097                                   # [0, 146096]
+    yoe = (doe - doe // 1460 + doe // 36524 - doe // 146096) // 365
+    y = yoe + era * 400
+    doy = doe - (365 * yoe + yoe // 4 - yoe // 100)          # [0, 365]
+    mp = (5 * doy + 2) // 153                                # [0, 11]
+    d = doy - (153 * mp + 2) // 5 + 1                        # [1, 31]
+    m = jnp.where(mp < 10, mp + 3, mp - 9)                   # [1, 12]
+    return jnp.where(m <= 2, y + 1, y), m, d
+
+
+def _days_from_civil(y, m, d):
+    """(year, month, day) → days since epoch, device integer math."""
+    y = y - (m <= 2)
+    era = jnp.where(y >= 0, y, y - 399) // 400
+    yoe = y - era * 400
+    mp = jnp.where(m > 2, m - 3, m + 9)
+    doy = (153 * mp + 2) // 5 + d - 1
+    doe = yoe * 365 + yoe // 4 - yoe // 100 + doy
+    return era * 146097 + doe - 719468
+
+
+def _fn_to_date(s, fmt=None):
+    f = _scalar_str(fmt) if fmt is not None else "yyyy-MM-dd"
+    return _parse_dates(s, f, unit_seconds=False)
+
+
+def _fn_unix_timestamp(s, fmt=None):
+    f = _scalar_str(fmt) if fmt is not None else "yyyy-MM-dd HH:mm:ss"
+    return _parse_dates(s, f, unit_seconds=True)
+
+
+def _date_field(which: str):
+    def f(days):
+        days = jnp.asarray(days, float_dtype())
+        null = jnp.isnan(days)
+        z = jnp.where(null, 0, days).astype(jnp.int32)
+        y, m, d = _civil_from_days(z)
+        if which == "year":
+            v = y
+        elif which == "month":
+            v = m
+        elif which == "dayofmonth":
+            v = d
+        elif which == "quarter":
+            v = (m - 1) // 3 + 1
+        elif which == "dayofweek":
+            # Spark: 1 = Sunday ... 7 = Saturday; epoch day 0 was a Thursday
+            v = (z + 4) % 7 + 1
+        else:  # dayofyear
+            v = z - _days_from_civil(y, jnp.ones_like(y),
+                                     jnp.ones_like(y)) + 1
+        return jnp.where(null, jnp.nan, v.astype(days.dtype))
+    return f
+
+
+def _fn_datediff(end, start):
+    e = jnp.asarray(end, float_dtype())
+    s = jnp.asarray(start, float_dtype())
+    return e - s                                   # NaN propagates
+
+
+def _fn_date_add(days, n):
+    return jnp.asarray(days, float_dtype()) + _scalar_int(n)
+
+
+def _fn_date_sub(days, n):
+    return jnp.asarray(days, float_dtype()) - _scalar_int(n)
+
+
+def _fn_date_format(days, fmt):
+    import datetime as _dt
+
+    py_fmt = _strptime_format(_scalar_str(fmt))
+    arr = np.asarray(days, np.float64)
+    epoch = _dt.date(1970, 1, 1)
+    return np.asarray(
+        [None if np.isnan(v)
+         else (epoch + _dt.timedelta(days=int(v))).strftime(py_fmt)
+         for v in arr], object)
+
+
+def _fn_from_unixtime(secs, fmt=None):
+    import datetime as _dt
+
+    py_fmt = _strptime_format(
+        _scalar_str(fmt) if fmt is not None else "yyyy-MM-dd HH:mm:ss")
+    arr = np.asarray(secs, np.float64)
+    epoch = _dt.datetime(1970, 1, 1)
+    return np.asarray(
+        [None if np.isnan(v)
+         else (epoch + _dt.timedelta(seconds=int(v))).strftime(py_fmt)
+         for v in arr], object)
+
+
+_BUILTIN_FNS.update({
+    "to_date": _fn_to_date,
+    "unix_timestamp": _fn_unix_timestamp,
+    "from_unixtime": _fn_from_unixtime,
+    "date_format": _fn_date_format,
+    "datediff": _fn_datediff,
+    "date_add": _fn_date_add,
+    "date_sub": _fn_date_sub,
+    "year": _date_field("year"),
+    "month": _date_field("month"),
+    "dayofmonth": _date_field("dayofmonth"),
+    "dayofweek": _date_field("dayofweek"),
+    "dayofyear": _date_field("dayofyear"),
+    "quarter": _date_field("quarter"),
+})
+
+
+def to_date(col_, fmt: str = None) -> Func:
+    args = [_coerce(col_)] + ([Lit(fmt)] if fmt is not None else [])
+    return Func("to_date", args)
+
+
+def unix_timestamp(col_, fmt: str = None) -> Func:
+    args = [_coerce(col_)] + ([Lit(fmt)] if fmt is not None else [])
+    return Func("unix_timestamp", args)
+
+
+def from_unixtime(col_, fmt: str = None) -> Func:
+    args = [_coerce(col_)] + ([Lit(fmt)] if fmt is not None else [])
+    return Func("from_unixtime", args)
+
+
+def date_format(col_, fmt: str) -> Func:
+    return Func("date_format", [_coerce(col_), Lit(fmt)])
+
+
+def date_add(col_, n: int) -> Func:
+    return Func("date_add", [_coerce(col_), Lit(n)])
+
+
+def date_sub(col_, n: int) -> Func:
+    return Func("date_sub", [_coerce(col_), Lit(n)])
+
+
+datediff = _make_fn("datediff")
+year = _make_fn("year")
+month = _make_fn("month")
+dayofmonth = _make_fn("dayofmonth")
+dayofweek = _make_fn("dayofweek")
+dayofyear = _make_fn("dayofyear")
+quarter = _make_fn("quarter")
+
+
+def current_date() -> Expr:
+    """Today as epoch days (host clock, evaluated at call time)."""
+    import datetime as _dt
+
+    return Lit(float((_dt.date.today() - _dt.date(1970, 1, 1)).days))

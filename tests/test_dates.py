@@ -1,0 +1,120 @@
+"""Date/time functions: epoch-day device columns, on-device civil-calendar
+field extraction (cross-checked against python datetime), arithmetic,
+parsing/formatting round-trips, null handling, and SQL."""
+
+import datetime as dt
+
+import numpy as np
+import pytest
+
+import sparkdq4ml_tpu as dq
+from sparkdq4ml_tpu import Frame
+from sparkdq4ml_tpu import functions as F
+
+
+def one_col(frame, expr, name="o"):
+    return list(frame.with_column(name, expr).to_pydict()[name])
+
+
+@pytest.fixture
+def dates():
+    return Frame({"s": ["2024-02-29", "1969-07-20", "2000-12-31", None],
+                  "t": ["29/02/2024", "20/07/1969", "31/12/2000", "bogus"]})
+
+
+class TestToDate:
+    def test_default_format(self, dates):
+        got = one_col(dates, F.to_date(F.col("s")))
+        epoch = dt.date(1970, 1, 1)
+        want = [(dt.date(2024, 2, 29) - epoch).days,
+                (dt.date(1969, 7, 20) - epoch).days,
+                (dt.date(2000, 12, 31) - epoch).days]
+        assert got[:3] == want
+        assert np.isnan(got[3])                   # null → NaN (engine null)
+
+    def test_custom_format_and_unparseable(self, dates):
+        got = one_col(dates, F.to_date(F.col("t"), "dd/MM/yyyy"))
+        assert got[0] == (dt.date(2024, 2, 29) - dt.date(1970, 1, 1)).days
+        assert np.isnan(got[3])                   # bogus → null
+
+
+class TestFields:
+    @pytest.mark.parametrize("seed", range(3))
+    def test_fields_match_datetime(self, seed):
+        rng = np.random.default_rng(seed)
+        days = rng.integers(-40000, 40000, size=200)
+        f = Frame({"d": np.asarray(days, np.int32)})
+        epoch = dt.date(1970, 1, 1)
+        pydates = [epoch + dt.timedelta(days=int(v)) for v in days]
+        assert one_col(f, F.year(F.col("d"))) == [p.year for p in pydates]
+        assert one_col(f, F.month(F.col("d"))) == [p.month for p in pydates]
+        assert one_col(f, F.dayofmonth(F.col("d"))) == [p.day for p in pydates]
+        assert one_col(f, F.dayofyear(F.col("d"))) == \
+            [p.timetuple().tm_yday for p in pydates]
+        # Spark: 1=Sunday..7=Saturday; python isoweekday: 1=Mon..7=Sun
+        assert one_col(f, F.dayofweek(F.col("d"))) == \
+            [p.isoweekday() % 7 + 1 for p in pydates]
+        assert one_col(f, F.quarter(F.col("d"))) == \
+            [(p.month - 1) // 3 + 1 for p in pydates]
+
+
+class TestArithmetic:
+    def test_datediff_add_sub(self, dates):
+        f = dates.with_column("d", F.to_date(F.col("s")))
+        f = f.with_column("d2", F.date_add(F.col("d"), 10))
+        got = one_col(f, F.datediff(F.col("d2"), F.col("d")))
+        assert got[:3] == [10, 10, 10]
+        assert np.isnan(got[3])                   # null propagates as NaN
+        back = one_col(f, F.date_sub(F.col("d2"), 10))
+        assert back[:3] == one_col(f, F.col("d"))[:3]
+
+    def test_current_date_is_today(self):
+        f = Frame({"x": [0.0]})
+        got = one_col(f, F.current_date())
+        assert got[0] == (dt.date.today() - dt.date(1970, 1, 1)).days
+
+
+class TestFormatting:
+    def test_date_format_round_trip(self, dates):
+        f = dates.with_column("d", F.to_date(F.col("s")))
+        got = one_col(f, F.date_format(F.col("d"), "yyyy-MM-dd"))
+        assert got[:3] == ["2024-02-29", "1969-07-20", "2000-12-31"]
+        assert got[3] is None
+
+    def test_unix_timestamp_round_trip(self):
+        f = Frame({"ts": ["2024-06-01 12:30:45", "1970-01-01 00:00:00"]})
+        secs = one_col(f, F.unix_timestamp(F.col("ts")))
+        assert secs[1] == 0
+        assert secs[0] == int((dt.datetime(2024, 6, 1, 12, 30, 45)
+                               - dt.datetime(1970, 1, 1)).total_seconds())
+        f2 = f.with_column("u", F.unix_timestamp(F.col("ts")))
+        back = one_col(f2, F.from_unixtime(F.col("u")))
+        assert back == ["2024-06-01 12:30:45", "1970-01-01 00:00:00"]
+
+
+class TestSql:
+    def test_sql_date_chain(self):
+        s = dq.TpuSession.builder().app_name("dates").get_or_create()
+        Frame({"s": ["2023-03-15", "2023-11-02"]}) \
+            .create_or_replace_temp_view("dv")
+        out = s.sql("SELECT YEAR(TO_DATE(s)) AS y, QUARTER(TO_DATE(s)) AS q "
+                    "FROM dv").to_pydict()
+        assert out["y"].tolist() == [2023, 2023]
+        assert out["q"].tolist() == [1, 4]
+
+    def test_unsupported_format_token_raises(self):
+        f = Frame({"s": ["2020-01-01"]})
+        with pytest.raises(ValueError, match="unsupported date-format"):
+            one_col(f, F.to_date(F.col("s"), "EEE yyyy"))
+
+    def test_single_letter_tokens(self):
+        f = Frame({"s": ["3/7/2020"]})
+        got = one_col(f, F.to_date(F.col("s"), "M/d/yyyy"))
+        assert got[0] == (dt.date(2020, 3, 7) - dt.date(1970, 1, 1)).days
+
+    def test_null_dates_visible_to_filters(self):
+        f = Frame({"s": ["2020-01-05", "garbage"]})
+        f = f.with_column("y", F.year(F.to_date(F.col("s"))))
+        kept = f.filter(dq.col("y") < 2025)
+        assert kept.count() == 1                   # null row excluded
+        assert f.filter(dq.col("y").is_null()).count() == 1
