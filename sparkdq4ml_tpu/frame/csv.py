@@ -160,9 +160,61 @@ def infer_column(values: Sequence[str]):
 _MODES = ("PERMISSIVE", "DROPMALFORMED", "FAILFAST")
 
 
+def parse_ddl_schema(ddl: str) -> list:
+    """Parse a Spark DDL schema string (``"a INT, b DOUBLE, s STRING"``)
+    into [(name, type_name)]; type names are validated against the
+    engine's Spark type-name table."""
+    from ..ops.expressions import resolve_type_name
+
+    fields = []
+    for part in ddl.split(","):
+        toks = part.split()
+        if len(toks) != 2:
+            raise ValueError(
+                f"bad DDL field {part.strip()!r} (expected 'name TYPE')")
+        name, type_name = toks
+        resolve_type_name(type_name)          # raises on unknown types
+        fields.append((name, type_name.lower()))
+    return fields
+
+
+def _cast_column(values: list, type_name: str):
+    """Cast raw CSV strings to a declared Spark type; unparseable or null
+    cells become null (Spark PERMISSIVE), which for integral columns
+    promotes the column to float (the engine's nullable-numeric form)."""
+    if type_name == "string":
+        return np.asarray([v if v not in _NULL_STRINGS else None
+                           for v in values], dtype=object)
+    if type_name == "boolean":
+        out = [None if v in _NULL_STRINGS
+               else v.strip().lower() == "true" for v in values]
+        if any(v is None for v in out):
+            return np.asarray([np.nan if v is None else float(v)
+                               for v in out])
+        return np.asarray(out, bool)
+    floats = np.empty(len(values), np.float64)
+    any_null = False
+    for i, v in enumerate(values):
+        try:
+            floats[i] = float(v)
+        except (TypeError, ValueError):
+            floats[i] = np.nan
+            any_null = True
+    if type_name in ("int", "integer", "long"):
+        if not any_null and np.all(floats == np.floor(floats)):
+            dt = np.int64 if type_name == "long" else np.int32
+            return floats.astype(dt)
+        return floats          # nullable integral → float column
+    from ..config import float_dtype
+
+    return floats.astype(np.float32 if type_name == "float"
+                         else np.dtype(float_dtype()))
+
+
 def read_csv(path: str, header: bool = False, infer_schema: bool = True,
              delimiter: str = ",", engine: str = "auto",
-             quote: str = '"', mode: str = "PERMISSIVE") -> Frame:
+             quote: str = '"', mode: str = "PERMISSIVE",
+             schema=None) -> Frame:
     """Load a CSV file into a Frame.
 
     ``engine``: "python" (pure host parser), "native" (C++ tokenizer), or
@@ -173,10 +225,15 @@ def read_csv(path: str, header: bool = False, infer_schema: bool = True,
     short rows null-fill, long rows truncate), ``DROPMALFORMED`` (rows with
     the wrong field count are dropped), ``FAILFAST`` (raise on the first
     malformed row).
+
+    ``schema``: explicit [(name, type)] (from a DDL string) — skips
+    inference, names the columns, and casts each to its declared type.
     """
     mode = mode.upper()
     if mode not in _MODES:
         raise ValueError(f"mode={mode!r}; expected one of {_MODES}")
+    if schema is not None:
+        engine = "python"      # explicit-schema cast path is host-side
     if engine in ("auto", "native"):
         from . import native_csv
 
@@ -206,6 +263,12 @@ def read_csv(path: str, header: bool = False, infer_schema: bool = True,
         rows = rows[1:]
     else:
         names = [f"_c{i}" for i in range(len(rows[0]))]
+    if schema is not None:
+        if len(schema) != len(names):
+            raise ValueError(
+                f"schema has {len(schema)} fields but the file has "
+                f"{len(names)} columns")
+        names = [n for n, _ in schema]
 
     ncols = len(names)
     if mode != "PERMISSIVE":
@@ -222,6 +285,10 @@ def read_csv(path: str, header: bool = False, infer_schema: bool = True,
             cols[i].append(r[i] if i < len(r) else "")
 
     data = {}
+    if schema is not None:
+        for (name, type_name), values in zip(schema, cols):
+            data[name] = _cast_column(values, type_name)
+        return Frame(data)
     for name, values in zip(names, cols):
         if infer_schema:
             data[name] = infer_column(values)
@@ -239,6 +306,13 @@ class DataFrameReader:
         self._session = session
         self._format = "csv"
         self._options: dict[str, str] = {}
+        self._schema = None
+
+    def schema(self, ddl: str) -> "DataFrameReader":
+        """Explicit schema as a Spark DDL string (``"a INT, b DOUBLE"``) —
+        skips inference and casts columns to the declared types."""
+        self._schema = parse_ddl_schema(ddl)
+        return self
 
     def format(self, fmt: str) -> "DataFrameReader":
         self._format = fmt.lower()
@@ -276,6 +350,7 @@ class DataFrameReader:
             engine=self._options.get("engine", "auto"),
             quote=self._options.get("quote", '"'),
             mode=self._options.get("mode", "PERMISSIVE"),
+            schema=self._schema,
         )
 
     def csv(self, path: str, header: bool = False, inferSchema: bool = False) -> Frame:

@@ -34,7 +34,7 @@ from .feature import (Binarizer, Bucketizer, ChiSqSelector,
                       VarianceThresholdSelectorModel)
 from .glm import (GeneralizedLinearRegression,
                   GeneralizedLinearRegressionModel, GlmTrainingSummary)
-from .linalg import Vectors
+from .linalg import Matrices, Vectors
 from .stat import (ChiSquareTest, Correlation, KolmogorovSmirnovTest,
                    Summarizer)
 from .text import (CountVectorizer, CountVectorizerModel, HashingTF, IDF,

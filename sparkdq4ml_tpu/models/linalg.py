@@ -14,3 +14,17 @@ class Vectors:
         if len(values) == 1 and isinstance(values[0], (list, tuple, np.ndarray)):
             values = values[0]
         return np.asarray(values, dtype=np.dtype(float_dtype()))
+
+
+class Matrices:
+    """``org.apache.spark.ml.linalg.Matrices`` equivalent (dense only —
+    the engine's matrices are dense HBM arrays by design)."""
+
+    @staticmethod
+    def dense(num_rows: int, num_cols: int, values) -> np.ndarray:
+        arr = np.asarray(values, dtype=np.dtype(float_dtype()))
+        if arr.size != num_rows * num_cols:
+            raise ValueError(
+                f"{arr.size} values for a {num_rows}x{num_cols} matrix")
+        # Spark's Matrices.dense is column-major
+        return arr.reshape(num_cols, num_rows).T

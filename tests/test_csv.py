@@ -121,3 +121,51 @@ class TestReaderBuilder:
         df = read_csv(str(p), header=False, infer_schema=True)
         d = df.to_pydict()
         assert np.isnan(d["_c1"][1])
+
+
+class TestExplicitSchema:
+    def test_ddl_schema_names_and_types(self, tmp_path):
+        import sparkdq4ml_tpu as dq
+        p = tmp_path / "s.csv"
+        p.write_text("1,2.5,x,true\n2,3.5,y,false\n")
+        s = dq.TpuSession.builder().app_name("ddl").get_or_create()
+        df = (s.read.format("csv")
+              .schema("a INT, b DOUBLE, s STRING, f BOOLEAN")
+              .load(str(p)))
+        d = df.to_pydict()
+        assert d["a"].tolist() == [1, 2] and d["a"].dtype.kind == "i"
+        np.testing.assert_allclose(d["b"], [2.5, 3.5])
+        assert list(d["s"]) == ["x", "y"]
+        assert d["f"].tolist() == [True, False]
+
+    def test_unparseable_int_becomes_nullable_float(self, tmp_path):
+        import sparkdq4ml_tpu as dq
+        p = tmp_path / "n.csv"
+        p.write_text("1\nxyz\n")
+        s = dq.TpuSession.builder().app_name("ddl2").get_or_create()
+        d = s.read.format("csv").schema("a INT").load(str(p)).to_pydict()
+        assert d["a"][0] == 1.0 and np.isnan(d["a"][1])
+
+    def test_field_count_mismatch(self, tmp_path):
+        import sparkdq4ml_tpu as dq
+        p = tmp_path / "m.csv"
+        p.write_text("1,2\n")
+        s = dq.TpuSession.builder().app_name("ddl3").get_or_create()
+        with pytest.raises(ValueError, match="schema has 1 fields"):
+            s.read.format("csv").schema("a INT").load(str(p))
+
+    def test_bad_ddl(self):
+        from sparkdq4ml_tpu.frame.csv import parse_ddl_schema
+        with pytest.raises(ValueError, match="bad DDL"):
+            parse_ddl_schema("a")
+        with pytest.raises(ValueError, match="unknown SQL type"):
+            parse_ddl_schema("a BLOB")
+
+
+class TestMatrices:
+    def test_dense_column_major(self):
+        from sparkdq4ml_tpu.models import Matrices
+        m = Matrices.dense(2, 3, [1, 2, 3, 4, 5, 6])
+        np.testing.assert_allclose(m, [[1, 3, 5], [2, 4, 6]])
+        with pytest.raises(ValueError, match="values for a"):
+            Matrices.dense(2, 2, [1, 2, 3])
