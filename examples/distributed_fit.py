@@ -1,0 +1,97 @@
+"""Distributed (multi-chip) fits on a virtual mesh — the `master("local[*]")`
+analogue: run the SAME sharded `shard_map`+`psum` code paths the framework
+uses on a real TPU pod, on N fake CPU devices in one process.
+
+    python examples/distributed_fit.py          # 8 virtual devices
+
+Every fit below row-shards its data over the mesh's `data` axis and
+reduces sufficient statistics with `jax.lax.psum` over ICI — the
+`treeAggregate` replacement (SURVEY.md §3.3). The script asserts
+sharded ≡ single-device for each family.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import sparkdq4ml_tpu as dq
+from sparkdq4ml_tpu import Frame
+from sparkdq4ml_tpu import functions as F
+from sparkdq4ml_tpu.models import (KMeans, LDA, LinearRegression,
+                                   LogisticRegression, RandomForestRegressor,
+                                   VectorAssembler)
+from sparkdq4ml_tpu.parallel.mesh import make_mesh
+
+
+def main():
+    mesh = make_mesh(8)
+    print(f"mesh: {mesh.devices.size} devices over axis "
+          f"{tuple(mesh.axis_names)}")
+
+    rng = np.random.default_rng(0)
+    n, d = 4096, 4
+    X = rng.normal(size=(n, d))
+    w_true = np.asarray([3.0, -2.0, 0.5, 1.0])
+    y = X @ w_true + 0.7 + 0.05 * rng.normal(size=n)
+
+    frame = VectorAssembler([f"x{j}" for j in range(d)], "features") \
+        .transform(Frame({**{f"x{j}": X[:, j] for j in range(d)},
+                          "label": y}))
+
+    for name, est, attr in [
+        ("LinearRegression", LinearRegression(max_iter=100), "coefficients"),
+        ("LogisticRegression", LogisticRegression(max_iter=50),
+         "coefficients"),
+        ("KMeans", KMeans(k=3, seed=1), None),
+        ("RandomForestRegressor",
+         RandomForestRegressor(num_trees=5, max_depth=4, seed=2), None),
+    ]:
+        if name == "LogisticRegression":
+            fit_frame = frame.with_column(
+                "label",
+                F.when(dq.col("label") > float(np.median(y)), 1.0)
+                .otherwise(0.0))
+        else:
+            fit_frame = frame
+        single = est.fit(fit_frame)
+        sharded = est.fit(fit_frame, mesh=mesh)
+        if attr:
+            a = np.asarray(getattr(single, attr))
+            b = np.asarray(getattr(sharded, attr))
+            np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
+            print(f"{name}: sharded == single "
+                  f"(coef[0] = {float(b.ravel()[0]):+.4f})")
+        else:
+            pa = np.asarray(single.transform(fit_frame)
+                            ._column_values("prediction"))
+            pb = np.asarray(sharded.transform(fit_frame)
+                            ._column_values("prediction"))
+            # float32 run: psum ordering perturbs split stats in the last
+            # ulp, so compare numerically, not bit-for-bit
+            np.testing.assert_allclose(pa, pb, rtol=5e-3, atol=5e-3)
+            print(f"{name}: sharded == single (predictions agree)")
+
+    docs = Frame({"features": rng.poisson(
+        1.0, size=(512, 24)).astype(np.float64)})
+    lda = LDA(k=3, max_iter=10, optimizer="em", seed=1)
+    # float32 here (production default; tests assert 1e-8 in f64) — psum
+    # reduction order differs from the single-device sum
+    np.testing.assert_allclose(lda.fit(docs).topics,
+                               lda.fit(docs, mesh=mesh).topics,
+                               rtol=5e-4, atol=5e-4)
+    print("LDA (variational EM): sharded == single")
+
+    print("all sharded fits match their single-device fits")
+
+
+if __name__ == "__main__":
+    main()
