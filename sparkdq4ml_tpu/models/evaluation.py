@@ -142,12 +142,17 @@ class BinaryClassificationEvaluator(Evaluator):
 
 
 class MulticlassClassificationEvaluator(Evaluator):
-    """Metrics: accuracy (default), f1 (binary-weighted)."""
+    """MLlib metrics: ``f1`` (the Spark default), ``accuracy``,
+    ``weightedPrecision``, ``weightedRecall`` — per-class one-vs-rest
+    scores weighted by true-class frequency."""
 
-    def __init__(self, metric_name: str = "accuracy", label_col: str = "label",
+    _METRICS = ("f1", "accuracy", "weightedPrecision", "weightedRecall")
+
+    def __init__(self, metric_name: str = "f1", label_col: str = "label",
                  prediction_col: str = "prediction"):
-        if metric_name not in ("accuracy", "f1"):
-            raise ValueError(f"unknown metric {metric_name!r}")
+        if metric_name not in self._METRICS:
+            raise ValueError(f"unknown metric {metric_name!r} "
+                             f"(supported: {self._METRICS})")
         self.metric_name = metric_name
         self.label_col = label_col
         self.prediction_col = prediction_col
@@ -159,16 +164,22 @@ class MulticlassClassificationEvaluator(Evaluator):
         if self.metric_name == "accuracy":
             return float(np.mean(y == p))
         classes = np.unique(y)
-        f1s, weights = [], []
+        scores, weights = [], []
         for c in classes:
             tp = float(((p == c) & (y == c)).sum())
             fp = float(((p == c) & (y != c)).sum())
             fn = float(((p != c) & (y == c)).sum())
             prec = tp / max(tp + fp, 1.0)
             rec = tp / max(tp + fn, 1.0)
-            f1s.append(0.0 if prec + rec == 0 else 2 * prec * rec / (prec + rec))
+            if self.metric_name == "weightedPrecision":
+                scores.append(prec)
+            elif self.metric_name == "weightedRecall":
+                scores.append(rec)
+            else:
+                scores.append(0.0 if prec + rec == 0
+                              else 2 * prec * rec / (prec + rec))
             weights.append((y == c).mean())
-        return float(np.average(f1s, weights=weights))
+        return float(np.average(scores, weights=weights))
 
 
 class ClusteringEvaluator(Evaluator):

@@ -47,9 +47,29 @@ class TestEvaluators:
         f2 = Frame({"label": [1.0, 0.0], "rawPrediction": [0.2, 0.8]})
         assert BinaryClassificationEvaluator().evaluate(f2) == pytest.approx(0.0)
 
-    def test_multiclass_accuracy(self):
+    def test_multiclass_default_f1(self):
         f = Frame({"label": [1.0, 0.0, 1.0], "prediction": [1.0, 0.0, 0.0]})
+        # Spark default metric is weighted f1 (= 2/3 here; accuracy too)
         assert MulticlassClassificationEvaluator().evaluate(f) == pytest.approx(2 / 3)
+        assert MulticlassClassificationEvaluator("accuracy").evaluate(f) \
+            == pytest.approx(2 / 3)
+
+    def test_multiclass_sklearn_parity(self):
+        import numpy as np
+        from sklearn.metrics import (f1_score, precision_score, recall_score)
+        rng = np.random.default_rng(0)
+        y = rng.integers(0, 3, 60).astype(float)
+        p = np.where(rng.random(60) < 0.7, y,
+                     rng.integers(0, 3, 60)).astype(float)
+        f = Frame({"label": y, "prediction": p})
+        assert MulticlassClassificationEvaluator("f1").evaluate(f) \
+            == pytest.approx(f1_score(y, p, average="weighted"))
+        assert MulticlassClassificationEvaluator("weightedPrecision") \
+            .evaluate(f) == pytest.approx(
+                precision_score(y, p, average="weighted", zero_division=0))
+        assert MulticlassClassificationEvaluator("weightedRecall") \
+            .evaluate(f) == pytest.approx(
+                recall_score(y, p, average="weighted", zero_division=0))
 
     def test_unknown_metric_rejected(self):
         with pytest.raises(ValueError):
