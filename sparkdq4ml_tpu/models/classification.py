@@ -870,6 +870,61 @@ class BinaryLogisticRegressionSummary:
         fpr, tpr = roc_points(self._label, self._prob)
         return Frame({"FPR": fpr, "TPR": tpr})
 
+    def _threshold_stats(self):
+        """Cumulative (tp, fp) at each distinct probability threshold,
+        descending — the shared sweep behind the by-threshold curves."""
+        order = np.argsort(-self._prob, kind="stable")
+        prob = self._prob[order]
+        pos = (self._label[order] == 1.0).astype(np.float64)
+        tp = np.cumsum(pos)
+        fp = np.cumsum(1.0 - pos)
+        # keep the LAST index of each distinct threshold (all rows with
+        # score >= t are predicted positive at threshold t)
+        last = np.r_[prob[1:] != prob[:-1], True]
+        return prob[last], tp[last], fp[last]
+
+    @property
+    def pr(self) -> Frame:
+        """(recall, precision) curve, MLlib's ``summary.pr()``."""
+        thr, tp, fp = self._threshold_stats()
+        npos = max(float((self._label == 1.0).sum()), 1.0)
+        precision = tp / np.maximum(tp + fp, 1.0)
+        recall = tp / npos
+        return Frame({"recall": np.r_[0.0, recall],
+                      "precision": np.r_[1.0, precision]})
+
+    def _by_threshold(self, metric: str) -> Frame:
+        thr, tp, fp = self._threshold_stats()
+        npos = max(float((self._label == 1.0).sum()), 1.0)
+        precision = tp / np.maximum(tp + fp, 1.0)
+        recall = tp / npos
+        if metric == "precision":
+            vals = precision
+        elif metric == "recall":
+            vals = recall
+        else:
+            denom = np.maximum(precision + recall, 1e-30)
+            vals = 2.0 * precision * recall / denom
+        return Frame({"threshold": thr, metric: vals})
+
+    @property
+    def precision_by_threshold(self) -> Frame:
+        return self._by_threshold("precision")
+
+    precisionByThreshold = precision_by_threshold
+
+    @property
+    def recall_by_threshold(self) -> Frame:
+        return self._by_threshold("recall")
+
+    recallByThreshold = recall_by_threshold
+
+    @property
+    def f_measure_by_threshold(self) -> Frame:
+        return self._by_threshold("F-Measure")
+
+    fMeasureByThreshold = f_measure_by_threshold
+
 
 class BinaryLogisticRegressionTrainingSummary(BinaryLogisticRegressionSummary):
     def __init__(self, model, frame, result: LogisticFitResult):

@@ -316,3 +316,48 @@ class TestMultinomial:
         s = m.evaluate(f)
         assert 0.0 <= s.accuracy <= 1.0
         assert s.labels.tolist() == [0.0, 1.0, 2.0]
+
+
+class TestThresholdCurves:
+    @pytest.fixture(scope="class")
+    def summary(self):
+        rng = np.random.default_rng(0)
+        n = 120
+        x = rng.normal(size=n)
+        y = (x + 0.5 * rng.normal(size=n) > 0).astype(np.float64)
+        f = VectorAssembler(["x"], "features").transform(
+            Frame({"x": x, "label": y}))
+        return LogisticRegression(max_iter=100).fit(f).summary
+
+    def test_pr_curve_matches_sklearn(self, summary):
+        from sklearn.metrics import precision_recall_curve
+        d = summary.pr.to_pydict()
+        prec_sk, rec_sk, _ = precision_recall_curve(
+            summary._label, summary._prob)
+        ours = set(zip(np.round(d["recall"], 9),
+                       np.round(d["precision"], 9)))
+        # sklearn's curve points (reversed order) must all appear in ours
+        missing = [(r, p) for p, r in zip(np.round(prec_sk, 9),
+                                          np.round(rec_sk, 9))
+                   if (r, p) not in ours and r > 0]
+        assert not missing
+
+    def test_by_threshold_frames(self, summary):
+        p = summary.precision_by_threshold.to_pydict()
+        r = summary.recall_by_threshold.to_pydict()
+        fm = summary.f_measure_by_threshold.to_pydict()
+        assert list(p.keys()) == ["threshold", "precision"]
+        assert list(r.keys()) == ["threshold", "recall"]
+        assert list(fm.keys()) == ["threshold", "F-Measure"]
+        # recall is monotone nondecreasing as the threshold drops
+        assert np.all(np.diff(r["recall"]) >= -1e-12)
+        assert r["recall"][-1] == pytest.approx(1.0)
+        # f = harmonic mean of the other two, pointwise
+        f_chk = (2 * np.asarray(p["precision"]) * np.asarray(r["recall"])
+                 / np.maximum(np.asarray(p["precision"])
+                              + np.asarray(r["recall"]), 1e-30))
+        np.testing.assert_allclose(fm["F-Measure"], f_chk, rtol=1e-9)
+
+    def test_camelcase_surface(self, summary):
+        assert summary.precisionByThreshold.count() == \
+            summary.recallByThreshold.count()
