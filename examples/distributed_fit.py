@@ -13,7 +13,10 @@ sharded ≡ single-device for each family.
 import os
 import sys
 
-os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
 
 import jax
 
@@ -75,10 +78,18 @@ def main():
                             ._column_values("prediction"))
             pb = np.asarray(sharded.transform(fit_frame)
                             ._column_values("prediction"))
-            # float32 run: psum ordering perturbs split stats in the last
-            # ulp, so compare numerically, not bit-for-bit
-            np.testing.assert_allclose(pa, pb, rtol=5e-3, atol=5e-3)
-            print(f"{name}: sharded == single (predictions agree)")
+            if name == "KMeans":
+                # integer cluster ids: demand near-total agreement (a
+                # borderline point may flip under f32 psum ordering)
+                agree = float(np.mean(pa == pb))
+                assert agree > 0.99, f"{name} agreement {agree:.3f}"
+                print(f"{name}: sharded == single "
+                      f"({agree:.1%} of assignments)")
+            else:
+                # continuous leaf means: f32 psum ordering perturbs split
+                # stats in the last ulp — compare numerically
+                np.testing.assert_allclose(pa, pb, rtol=5e-3, atol=5e-3)
+                print(f"{name}: sharded == single (predictions agree)")
 
     docs = Frame({"features": rng.poisson(
         1.0, size=(512, 24)).astype(np.float64)})

@@ -870,34 +870,19 @@ class BinaryLogisticRegressionSummary:
         fpr, tpr = roc_points(self._label, self._prob)
         return Frame({"FPR": fpr, "TPR": tpr})
 
-    def _threshold_stats(self):
-        """Cumulative (tp, fp) at each distinct probability threshold,
-        descending — the shared sweep behind the by-threshold curves."""
-        order = np.argsort(-self._prob, kind="stable")
-        prob = self._prob[order]
-        pos = (self._label[order] == 1.0).astype(np.float64)
-        tp = np.cumsum(pos)
-        fp = np.cumsum(1.0 - pos)
-        # keep the LAST index of each distinct threshold (all rows with
-        # score >= t are predicted positive at threshold t)
-        last = np.r_[prob[1:] != prob[:-1], True]
-        return prob[last], tp[last], fp[last]
-
     @property
     def pr(self) -> Frame:
         """(recall, precision) curve, MLlib's ``summary.pr()``."""
-        thr, tp, fp = self._threshold_stats()
-        npos = max(float((self._label == 1.0).sum()), 1.0)
-        precision = tp / np.maximum(tp + fp, 1.0)
-        recall = tp / npos
+        from .evaluation import pr_points
+
+        _, precision, recall = pr_points(self._label, self._prob)
         return Frame({"recall": np.r_[0.0, recall],
                       "precision": np.r_[1.0, precision]})
 
     def _by_threshold(self, metric: str) -> Frame:
-        thr, tp, fp = self._threshold_stats()
-        npos = max(float((self._label == 1.0).sum()), 1.0)
-        precision = tp / np.maximum(tp + fp, 1.0)
-        recall = tp / npos
+        from .evaluation import pr_points
+
+        thr, precision, recall = pr_points(self._label, self._prob)
         if metric == "precision":
             vals = precision
         elif metric == "recall":

@@ -8,21 +8,34 @@ import numpy as np
 from ..frame.frame import Frame
 
 
+def threshold_sweep(labels: np.ndarray, scores: np.ndarray):
+    """Cumulative (thresholds desc, tp, fp) at each DISTINCT score —
+    the single O(n log n) sweep behind every ROC/PR curve and
+    by-threshold metric (at threshold t, every row scoring ≥ t is
+    predicted positive, so the last index of each tied run counts)."""
+    order = np.argsort(-scores, kind="mergesort")
+    y = (labels[order] == 1.0).astype(np.float64)
+    s = scores[order]
+    tp = np.cumsum(y)
+    fp = np.cumsum(1.0 - y)
+    boundary = np.r_[s[1:] != s[:-1], True]
+    return s[boundary], tp[boundary], fp[boundary]
+
+
+def pr_points(labels: np.ndarray, scores: np.ndarray):
+    """(thresholds desc, precision, recall) at each distinct score."""
+    thr, tp, fp = threshold_sweep(labels, scores)
+    npos = max(float((labels == 1.0).sum()), 1.0)
+    precision = tp / np.maximum(tp + fp, 1.0)
+    recall = tp / npos
+    return thr, precision, recall
+
+
 def roc_points(labels: np.ndarray, scores: np.ndarray):
     """(FPR, TPR) arrays over descending score thresholds, O(n log n).
 
-    Shared by the evaluators and the classifier summaries — one cumsum over
-    the label vector sorted by score, keeping only threshold boundaries.
-    """
-    order = np.argsort(-scores, kind="mergesort")
-    y = labels[order]
-    s = scores[order]
-    tps = np.cumsum(y)
-    fps = np.cumsum(1.0 - y)
-    # keep the last index of each tied score run
-    boundary = np.r_[s[1:] != s[:-1], True]
-    tps = tps[boundary]
-    fps = fps[boundary]
+    Shared by the evaluators and the classifier summaries."""
+    _, tps, fps = threshold_sweep(labels, scores)
     npos = max(tps[-1], 1.0) if len(tps) else 1.0
     nneg = max(fps[-1], 1.0) if len(fps) else 1.0
     tpr = np.r_[0.0, tps / npos]
@@ -43,18 +56,10 @@ def area_under_roc(labels: np.ndarray, scores: np.ndarray) -> float:
 def area_under_pr(labels: np.ndarray, scores: np.ndarray) -> float:
     """Precision-recall AUC over threshold boundaries, O(n log n)."""
     pos = labels == 1.0
-    npos = pos.sum()
-    if npos == 0 or (~pos).sum() == 0:
+    if pos.sum() == 0 or (~pos).sum() == 0:
         return float("nan")
-    order = np.argsort(-scores, kind="mergesort")
-    y = labels[order]
-    s = scores[order]
-    tps = np.cumsum(y)
-    preds = np.arange(1, len(y) + 1)
-    boundary = np.r_[s[1:] != s[:-1], True]
-    precision = np.r_[1.0, (tps / preds)[boundary]]
-    recall = np.r_[0.0, (tps / npos)[boundary]]
-    return float(np.trapezoid(precision, recall))
+    _, precision, recall = pr_points(labels, scores)
+    return float(np.trapezoid(np.r_[1.0, precision], np.r_[0.0, recall]))
 
 
 class Evaluator:
