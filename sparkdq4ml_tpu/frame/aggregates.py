@@ -126,6 +126,19 @@ def count_distinct(col: str) -> AggExpr:
 countDistinct = count_distinct
 
 
+def approx_count_distinct(col: str, rsd: float = 0.05) -> AggExpr:
+    """Spark's HLL sketch bounds executor memory; this engine's groups are
+    host-resident so the EXACT count is cheaper than a sketch — ``rsd``
+    is accepted for API compatibility and the answer has zero error."""
+    if not 0.0 < rsd < 1.0:
+        raise ValueError(f"rsd must be in (0, 1), got {rsd}")
+    return AggExpr("count_distinct", col,
+                   alias=f"approx_count_distinct({col})")
+
+
+approxCountDistinct = approx_count_distinct
+
+
 def sum_distinct(col: str) -> AggExpr:
     return AggExpr("sum_distinct", col)
 

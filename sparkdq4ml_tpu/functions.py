@@ -3,7 +3,8 @@ column constructors, UDF invocation (the reference's
 ``import static ...functions.callUDF``, `DataQuality4MachineLearningApp.java:3`),
 scalar builtins, CASE WHEN, and aggregate constructors."""
 
-from .frame.aggregates import (avg, collect_list, collect_set, corr, count,
+from .frame.aggregates import (approx_count_distinct,
+                               approxCountDistinct, avg, collect_list, collect_set, corr, count,
                                count_distinct, countDistinct, covar_pop,
                                covar_samp, first, kurtosis, last, max, mean,
                                min, skewness, stddev, sum, sum_distinct,
@@ -29,7 +30,8 @@ from .ops.expressions import sql_round as round  # noqa: A001 - Spark name
 
 __all__ = ["col", "lit", "call_udf", "callUDF", "count", "sum", "avg",
            "mean", "min", "max", "stddev", "variance",
-           "count_distinct", "countDistinct", "sum_distinct", "sumDistinct",
+           "count_distinct", "countDistinct", "approx_count_distinct",
+           "approxCountDistinct", "sum_distinct", "sumDistinct",
            "collect_list", "collect_set", "first", "last",
            "skewness", "kurtosis", "corr", "covar_samp", "covar_pop",
            "abs", "sqrt", "exp", "log", "log10", "pow", "floor", "ceil",

@@ -271,3 +271,13 @@ class TestRollupCube:
         rows = {(r, p): v for r, p, v in
                 zip(d["region"], d["product"], d["s"])}
         assert rows[(None, "p1")] == 40.0 and len(d["s"]) == 9
+
+
+class TestApproxCountDistinct:
+    def test_exact_answer(self, frame):
+        out = frame.agg(F.approx_count_distinct("x")).to_pydict()
+        assert out["approx_count_distinct(x)"][0] == 4
+
+    def test_rsd_validated(self):
+        with pytest.raises(ValueError, match="rsd"):
+            F.approx_count_distinct("x", rsd=1.5)
