@@ -42,13 +42,14 @@ class LinearRegression(Estimator):
     _persist_attrs = ("max_iter", "reg_param", "elastic_net_param", "tol",
                       "fit_intercept", "standardization", "solver",
                       "features_col", "label_col", "prediction_col",
-                      "aggregation_depth")
+                      "weight_col", "aggregation_depth")
 
     def __init__(self, max_iter: int = 100, reg_param: float = 0.0,
                  elastic_net_param: float = 0.0, tol: float = 1e-6,
                  fit_intercept: bool = True, standardization: bool = True,
                  solver: str = "auto", features_col: str = "features",
                  label_col: str = "label", prediction_col: str = "prediction",
+                 weight_col: Optional[str] = None,
                  aggregation_depth: int = 2):
         self.max_iter = max_iter
         self.reg_param = reg_param
@@ -60,6 +61,7 @@ class LinearRegression(Estimator):
         self.features_col = features_col
         self.label_col = label_col
         self.prediction_col = prediction_col
+        self.weight_col = weight_col
         # treeAggregate tree depth in MLlib; meaningless under psum (the ICI
         # all-reduce is already log-depth in hardware). Accepted for API parity.
         self.aggregation_depth = aggregation_depth
@@ -95,6 +97,9 @@ class LinearRegression(Estimator):
     def set_prediction_col(self, v: str):
         self.prediction_col = v; return self
 
+    def set_weight_col(self, v):
+        self.weight_col = v; return self
+
     def set_aggregation_depth(self, v: int):
         self.aggregation_depth = int(v); return self
 
@@ -108,6 +113,7 @@ class LinearRegression(Estimator):
     setFeaturesCol = set_features_col
     setLabelCol = set_label_col
     setPredictionCol = set_prediction_col
+    setWeightCol = set_weight_col
     setAggregationDepth = set_aggregation_depth
 
     def get_max_iter(self): return self.max_iter
@@ -130,7 +136,8 @@ class LinearRegression(Estimator):
         return {k: getattr(self, k) for k in (
             "max_iter", "reg_param", "elastic_net_param", "tol",
             "fit_intercept", "standardization", "solver", "features_col",
-            "label_col", "prediction_col", "aggregation_depth")}
+            "label_col", "prediction_col", "weight_col",
+            "aggregation_depth")}
 
     # -- fit ----------------------------------------------------------------
     def fit(self, frame: Frame, mesh=None) -> "LinearRegressionModel":
@@ -149,6 +156,18 @@ class LinearRegression(Estimator):
                                             unpack_fit_result)
 
         X, y, mask = _extract_xy(frame, self.features_col, self.label_col)
+        if self.weight_col is not None:
+            # Instance weights (MLlib weightCol): scaling packed rows by
+            # sqrt(w) makes the Gramian ZᵀZ = Σ w·zzᵀ — every moment the
+            # solver unpacks (n = Σw, weighted mean/std, Gram, correlation)
+            # becomes its weighted form, so an integer weight k is EXACTLY
+            # a row repeated k times (the regression test for this path).
+            # Summary metrics remain unweighted row statistics.
+            w = frame._column_values(self.weight_col)
+            if bool(np.any(np.asarray(w) < 0)):
+                raise ValueError("weights must be nonnegative")
+            mask = mask.astype(float_dtype()) * jnp.sqrt(
+                jnp.asarray(w, float_dtype()))
         solver_name = resolve_solver(self.solver, self.reg_param,
                                      self.elastic_net_param)
         if mesh is not None and mesh.devices.size <= 1:
@@ -451,6 +470,7 @@ class IsotonicRegression(Estimator):
     setFeaturesCol = set_features_col
     setLabelCol = set_label_col
     setPredictionCol = set_prediction_col
+    setWeightCol = set_weight_col
 
     def fit(self, frame: Frame) -> "IsotonicRegressionModel":
         X = np.asarray(frame._column_values(self.features_col), np.float64)

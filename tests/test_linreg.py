@@ -3,8 +3,10 @@
 import numpy as np
 import pytest
 
+from sparkdq4ml_tpu import Frame
 from conftest import dataset_path, prepare_features, run_dq_pipeline
 from sparkdq4ml_tpu.models import (LinearRegression, LinearRegressionModel,
+                                   VectorAssembler,
                                    Vectors)
 
 # SURVEY.md §2.3: Lasso under the app's config (maxIter=40, regParam=1,
@@ -241,3 +243,63 @@ class TestModelApi:
         assert (lr.max_iter, lr.reg_param, lr.elastic_net_param, lr.tol,
                 lr.fit_intercept, lr.standardization, lr.solver) == (
             100, 0.0, 0.0, 1e-6, True, True, "auto")
+
+
+class TestWeightCol:
+    """weightCol: an integer weight k must behave EXACTLY like the row
+    repeated k times — for every solver and penalty."""
+
+    @pytest.fixture(scope="class")
+    def weighted_and_repeated(self):
+        rng = np.random.default_rng(3)
+        n, d = 40, 3
+        X = rng.normal(size=(n, d))
+        y = X @ np.asarray([2.0, -1.0, 0.5]) + 1.0 + 0.1 * rng.normal(size=n)
+        w = rng.integers(1, 4, size=n).astype(np.float64)
+        cols = {f"x{j}": X[:, j] for j in range(d)}
+        fw = VectorAssembler([f"x{j}" for j in range(d)], "features") \
+            .transform(Frame({**cols, "label": y, "w": w}))
+        idx = np.repeat(np.arange(n), w.astype(int))
+        fr = VectorAssembler([f"x{j}" for j in range(d)], "features") \
+            .transform(Frame({**{f"x{j}": X[idx, j] for j in range(d)},
+                              "label": y[idx]}))
+        return fw, fr
+
+    @pytest.mark.parametrize("params", [
+        dict(),                                              # OLS (normal)
+        dict(reg_param=0.3, elastic_net_param=1.0),          # Lasso (FISTA)
+        dict(reg_param=0.5, elastic_net_param=0.4),          # elastic net
+        dict(reg_param=0.2, elastic_net_param=0.0),          # ridge
+    ])
+    def test_weight_equals_repetition(self, weighted_and_repeated, params):
+        fw, fr = weighted_and_repeated
+        mw = LinearRegression(max_iter=400, weight_col="w", **params).fit(fw)
+        mr = LinearRegression(max_iter=400, **params).fit(fr)
+        np.testing.assert_allclose(mw.coefficients, mr.coefficients,
+                                   rtol=1e-5, atol=1e-7)
+        np.testing.assert_allclose(mw.intercept, mr.intercept,
+                                   rtol=1e-5, atol=1e-7)
+
+    def test_weighted_ols_matches_sklearn(self, weighted_and_repeated):
+        from sklearn.linear_model import LinearRegression as SkLR
+        fw, _ = weighted_and_repeated
+        d = fw.to_pydict()
+        X = np.stack(d["features"])
+        m = LinearRegression(weight_col="w").fit(fw)
+        sk = SkLR().fit(X, d["label"], sample_weight=d["w"])
+        np.testing.assert_allclose(m.coefficients, sk.coef_, rtol=1e-6)
+        np.testing.assert_allclose(m.intercept, sk.intercept_, rtol=1e-6)
+
+    def test_negative_weights_rejected(self):
+        f = VectorAssembler(["x"], "features").transform(
+            Frame({"x": np.asarray([1.0, 2.0]),
+                   "label": np.asarray([1.0, 2.0]),
+                   "w": np.asarray([1.0, -1.0])}))
+        with pytest.raises(ValueError, match="nonnegative"):
+            LinearRegression(weight_col="w").fit(f)
+
+    def test_persistence_round_trip(self, tmp_path):
+        est = LinearRegression(weight_col="w", reg_param=0.1)
+        est.save(str(tmp_path / "wlr"))
+        from sparkdq4ml_tpu.models.base import load_stage
+        assert load_stage(str(tmp_path / "wlr")).weight_col == "w"
