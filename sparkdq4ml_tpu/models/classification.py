@@ -76,11 +76,15 @@ def _sharded_feature_stats(X, mask):
 
 
 def _logistic_core(X, y, mask, reg_param, alpha, n, std,
-                   max_iter, tol, fit_intercept, standardization, axis=None):
+                   max_iter, tol, fit_intercept, standardization, axis=None,
+                   weights=None):
     """FISTA on mean log-loss over (possibly sharded) rows.
 
     When ``axis`` is set (inside shard_map), every per-row reduction is
     followed by a psum over that axis; n/std are passed in already global.
+    ``weights``: optional per-row instance weights (MLlib weightCol); the
+    default is the 0/1 mask. Margins always use the BOOLEAN mask — weights
+    enter linearly through the per-row loss/gradient terms and ``n``.
     """
     dt = X.dtype
     d = X.shape[1]
@@ -89,6 +93,7 @@ def _logistic_core(X, y, mask, reg_param, alpha, n, std,
     Xs = (X / sx) * mask.astype(dt)[:, None]   # standardized, masked rows
     yv = y.astype(dt) * mask.astype(dt)
     wm = mask.astype(dt)
+    wv = wm if weights is None else weights.astype(dt)
 
     # penalty on raw coefficients when standardization=False: u1=1/sigma for
     # L1, u2=1/sigma^2 for L2 (see solvers._penalty_weights)
@@ -99,8 +104,8 @@ def _logistic_core(X, y, mask, reg_param, alpha, n, std,
     def reduce_(v):
         return jax.lax.psum(v, axis) if axis is not None else v
 
-    # Lipschitz bound: λmax(XᵀX/n)/4 ≤ ‖Xs‖_F²/(4n)
-    sq = reduce_(jnp.sum(Xs * Xs))
+    # Lipschitz bound: λmax(XᵀWX/n)/4 ≤ ‖√w·Xs‖_F²/(4n)
+    sq = reduce_(jnp.sum(wv[:, None] * Xs * Xs))
     L = sq / (4.0 * n) + jnp.max(lam2, initial=0.0) + jnp.asarray(1e-12, dt)
     step = 1.0 / L
 
@@ -109,9 +114,9 @@ def _logistic_core(X, y, mask, reg_param, alpha, n, std,
         margin = Xs @ w + b * wm
         # stable log(1+exp(-z)) with z = (2y-1)*margin
         z = (2.0 * yv - wm) * margin
-        ll = jnp.where(mask, jnp.logaddexp(0.0, -z), 0.0)
+        ll = wv * jnp.logaddexp(0.0, -z)   # wv=0 zeroes masked rows
         p = jax.nn.sigmoid(margin)
-        resid = (p - yv) * wm
+        resid = (p - yv) * wv
         g_w = Xs.T @ resid
         g_b = jnp.sum(resid)
         packed = jnp.concatenate([g_w, jnp.array([g_b, jnp.sum(ll)])])
@@ -171,7 +176,8 @@ class SoftmaxFitResult(NamedTuple):
 
 
 def _softmax_core(X, y, mask, reg_param, alpha, n, std, num_classes,
-                  max_iter, tol, fit_intercept, standardization, axis=None):
+                  max_iter, tol, fit_intercept, standardization, axis=None,
+                  weights=None):
     """FISTA on the mean softmax cross-entropy over (possibly sharded) rows.
 
     MLlib ``family="multinomial"`` conventions: features scaled by sample
@@ -188,6 +194,7 @@ def _softmax_core(X, y, mask, reg_param, alpha, n, std, num_classes,
     sx = jnp.where(valid, std, 1.0)
     Xs = (X / sx) * mask.astype(dt)[:, None]   # standardized, masked rows
     wm = mask.astype(dt)
+    wv = wm if weights is None else weights.astype(dt)
     Y1 = jax.nn.one_hot(y.astype(jnp.int32), K, dtype=dt) * wm[:, None]
 
     u1 = jnp.ones((d,), dt) if standardization \
@@ -200,7 +207,7 @@ def _softmax_core(X, y, mask, reg_param, alpha, n, std, num_classes,
 
     # Softmax Hessian w.r.t. margins is diag(p) − ppᵀ ⪯ ½·I, so
     # L ≤ ½‖Xs‖_F²/n (vs ¼ for the binary sigmoid).
-    sq = reduce_(jnp.sum(Xs * Xs))
+    sq = reduce_(jnp.sum(wv[:, None] * Xs * Xs))
     L = 0.5 * sq / n + jnp.max(lam2, initial=0.0) + jnp.asarray(1e-12, dt)
     step = 1.0 / L
 
@@ -211,9 +218,9 @@ def _softmax_core(X, y, mask, reg_param, alpha, n, std, num_classes,
         b = wb[m:]
         margin = Xs @ W.T + b[None, :] * wm[:, None]        # (n, K)
         lse = jax.nn.logsumexp(margin, axis=1)
-        ll = jnp.where(mask, lse - jnp.sum(margin * Y1, axis=1), 0.0)
+        ll = wv * jnp.where(mask, lse - jnp.sum(margin * Y1, axis=1), 0.0)
         p = jax.nn.softmax(margin, axis=1)
-        resid = (p - Y1) * wm[:, None]                      # (n, K)
+        resid = (p - Y1) * wv[:, None]                      # (n, K)
         g_W = resid.T @ Xs                                  # (K, d)
         g_b = jnp.sum(resid, axis=0)                        # (K,)
         packed = jnp.concatenate([g_W.ravel(), g_b, jnp.sum(ll)[None]])
@@ -281,6 +288,17 @@ def _unpack_z(Z):
     return X, y, mask
 
 
+
+def _unpack_zw(Z):
+    """Split the weighted packed design ``Z = [X·m, y·m, w·m]``
+    (pack_design_weighted layout): the last column carries the REAL
+    instance weights (zero on masked rows), so the boolean mask is
+    ``w > 0`` and the weights ride the same single buffer."""
+    d = Z.shape[1] - 2
+    w = Z[:, d + 1]
+    return Z[:, :d], Z[:, d], w > 0, w
+
+
 def _pack_logistic_result(r: "LogisticFitResult"):
     """One output buffer: [coef(d) | intercept | iters | converged | history]
     (same layout as the linear path; decode with
@@ -294,27 +312,38 @@ def _pack_logistic_result(r: "LogisticFitResult"):
 
 @functools.lru_cache(maxsize=None)
 def fused_logistic_fit_packed(mesh: Optional[Mesh], max_iter: int, tol: float,
-                              fit_intercept: bool, standardization: bool):
+                              fit_intercept: bool, standardization: bool,
+                              weighted: bool = False):
     """One jitted program: stats pass + FISTA scan (+ per-iteration psum when
     sharded). Mirrors the linear path's ``fused_linear_fit_packed``,
     including its single-input/single-output dispatch discipline:
     ``fit(Z, hyper) -> flat`` with ``Z = pack_design(X, y, mask)`` and
-    ``hyper = [regParam, elasticNetParam]``."""
+    ``hyper = [regParam, elasticNetParam]``. With ``weighted=True`` the
+    input is ``pack_design_weighted(X, y, mask, w)`` — the last column
+    carries real instance weights (MLlib weightCol), and n/std/loss/grad
+    are their weighted forms."""
+
+    def split(Z):
+        if weighted:
+            return _unpack_zw(Z)
+        X, y, mask = _unpack_z(Z)
+        return X, y, mask, None
 
     if mesh is None or mesh.devices.size <= 1:
         def fit(Z, hyper):
-            X, y, mask = _unpack_z(Z)
-            n, std = _feature_stats(X, y, mask)
+            X, y, mask, w = split(Z)
+            n, std = _feature_stats(X, y, mask if w is None else w)
             return _pack_logistic_result(_logistic_core(
                 X, y, mask, hyper[0], hyper[1], n, std, max_iter,
-                tol, fit_intercept, standardization))
+                tol, fit_intercept, standardization, weights=w))
     else:
         def local(Z, hyper):
-            X, y, mask = _unpack_z(Z)
-            n, std = _sharded_feature_stats(X, mask)
+            X, y, mask, w = split(Z)
+            n, std = _sharded_feature_stats(X, mask if w is None else w)
             return _pack_logistic_result(_logistic_core(
                 X, y, mask, hyper[0], hyper[1], n, std, max_iter,
-                tol, fit_intercept, standardization, axis=DATA_AXIS))
+                tol, fit_intercept, standardization, axis=DATA_AXIS,
+                weights=w))
 
         fit = jax.shard_map(
             local, mesh=mesh,
@@ -468,25 +497,33 @@ def unpack_softmax_result(flat, num_classes: int, d: int):
 @functools.lru_cache(maxsize=None)
 def fused_softmax_fit_packed(mesh: Optional[Mesh], num_classes: int,
                              max_iter: int, tol: float,
-                             fit_intercept: bool, standardization: bool):
+                             fit_intercept: bool, standardization: bool,
+                             weighted: bool = False):
     """Multinomial analogue of ``fused_logistic_fit_packed`` — same
-    single-input/single-output dispatch discipline and per-iteration psum."""
+    single-input/single-output dispatch discipline and per-iteration psum
+    (and the same ``weighted`` contract)."""
+
+    def split(Z):
+        if weighted:
+            return _unpack_zw(Z)
+        X, y, mask = _unpack_z(Z)
+        return X, y, mask, None
 
     if mesh is None or mesh.devices.size <= 1:
         def fit(Z, hyper):
-            X, y, mask = _unpack_z(Z)
-            n, std = _feature_stats(X, y, mask)
+            X, y, mask, w = split(Z)
+            n, std = _feature_stats(X, y, mask if w is None else w)
             return _pack_softmax_result(_softmax_core(
                 X, y, mask, hyper[0], hyper[1], n, std, num_classes,
-                max_iter, tol, fit_intercept, standardization))
+                max_iter, tol, fit_intercept, standardization, weights=w))
     else:
         def local(Z, hyper):
-            X, y, mask = _unpack_z(Z)
-            n, std = _sharded_feature_stats(X, mask)
+            X, y, mask, w = split(Z)
+            n, std = _sharded_feature_stats(X, mask if w is None else w)
             return _pack_softmax_result(_softmax_core(
                 X, y, mask, hyper[0], hyper[1], n, std, num_classes,
                 max_iter, tol, fit_intercept, standardization,
-                axis=DATA_AXIS))
+                axis=DATA_AXIS, weights=w))
 
         fit = jax.shard_map(
             local, mesh=mesh,
@@ -505,7 +542,7 @@ class LogisticRegression(Estimator):
     _persist_attrs = ("max_iter", "reg_param", "elastic_net_param", "tol",
                       "fit_intercept", "standardization", "threshold",
                       "family", "features_col", "label_col", "prediction_col",
-                      "probability_col", "raw_prediction_col")
+                      "probability_col", "raw_prediction_col", "weight_col")
 
     def __init__(self, max_iter: int = 100, reg_param: float = 0.0,
                  elastic_net_param: float = 0.0, tol: float = 1e-6,
@@ -514,7 +551,8 @@ class LogisticRegression(Estimator):
                  features_col: str = "features", label_col: str = "label",
                  prediction_col: str = "prediction",
                  probability_col: str = "probability",
-                 raw_prediction_col: str = "rawPrediction"):
+                 raw_prediction_col: str = "rawPrediction",
+                 weight_col: Optional[str] = None):
         if family not in ("auto", "binomial", "multinomial"):
             raise ValueError(f"unknown family {family!r}")
         self.max_iter = max_iter
@@ -530,6 +568,7 @@ class LogisticRegression(Estimator):
         self.prediction_col = prediction_col
         self.probability_col = probability_col
         self.raw_prediction_col = raw_prediction_col
+        self.weight_col = weight_col
 
     # fluent setters (snake + camel)
     def set_max_iter(self, v): self.max_iter = int(v); return self
@@ -541,6 +580,7 @@ class LogisticRegression(Estimator):
     def set_threshold(self, v): self.threshold = float(v); return self
     def set_features_col(self, v): self.features_col = v; return self
     def set_label_col(self, v): self.label_col = v; return self
+    def set_weight_col(self, v): self.weight_col = v; return self
 
     def set_family(self, v):
         if v not in ("auto", "binomial", "multinomial"):
@@ -559,6 +599,7 @@ class LogisticRegression(Estimator):
     setThreshold = set_threshold
     setFeaturesCol = set_features_col
     setLabelCol = set_label_col
+    setWeightCol = set_weight_col
 
     def get_reg_param(self): return self.reg_param
     def get_tol(self): return self.tol
@@ -573,7 +614,7 @@ class LogisticRegression(Estimator):
             "max_iter", "reg_param", "elastic_net_param", "tol",
             "fit_intercept", "standardization", "threshold", "family",
             "features_col", "label_col", "prediction_col", "probability_col",
-            "raw_prediction_col")}
+            "raw_prediction_col", "weight_col")}
 
     def fit(self, frame: Frame, mesh=None) -> "LogisticRegressionModel":
         if mesh is None:
@@ -600,10 +641,22 @@ class LogisticRegression(Estimator):
                 f"{num_classes} classes; use family='multinomial'")
 
         from ..config import float_dtype
-        from ..parallel.distributed import (pack_design, place_packed,
-                                            unpack_fit_result)
+        from ..parallel.distributed import (pack_design,
+                                            pack_design_weighted,
+                                            place_packed, unpack_fit_result)
 
-        Zd = place_packed(pack_design(X, y, mask), mesh)
+        weighted = self.weight_col is not None
+        if weighted:
+            # masked rows' weight values never participate (see the
+            # LinearRegression weightCol note): validate valid rows only,
+            # zero the rest so a NaN payload cannot poison the packing
+            w = frame._column_values(self.weight_col)
+            if bool(np.any(np.asarray(w)[np.asarray(mask)] < 0)):
+                raise ValueError("weights must be nonnegative")
+            w = jnp.where(mask, jnp.asarray(w, float_dtype()), 0.0)
+            Zd = place_packed(pack_design_weighted(X, y, mask, w), mesh)
+        else:
+            Zd = place_packed(pack_design(X, y, mask), mesh)
         hyper = jnp.asarray([self.reg_param, self.elastic_net_param],
                             float_dtype())
 
@@ -611,7 +664,8 @@ class LogisticRegression(Estimator):
             K = max(num_classes, 2)
             fit_fn = fused_softmax_fit_packed(mesh, K, self.max_iter,
                                               self.tol, self.fit_intercept,
-                                              self.standardization)
+                                              self.standardization,
+                                              weighted=weighted)
             result = unpack_softmax_result(fit_fn(Zd, hyper), K, X.shape[1])
             W = np.asarray(result.coefficient_matrix, np.float64)
             b = np.asarray(result.intercept_vector, np.float64)
@@ -634,7 +688,8 @@ class LogisticRegression(Estimator):
 
         fit_fn = fused_logistic_fit_packed(mesh, self.max_iter, self.tol,
                                            self.fit_intercept,
-                                           self.standardization)
+                                           self.standardization,
+                                           weighted=weighted)
         result = LogisticFitResult(
             *unpack_fit_result(fit_fn(Zd, hyper), X.shape[1]))
         model = LogisticRegressionModel(

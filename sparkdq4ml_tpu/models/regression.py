@@ -163,11 +163,17 @@ class LinearRegression(Estimator):
             # becomes its weighted form, so an integer weight k is EXACTLY
             # a row repeated k times (the regression test for this path).
             # Summary metrics remain unweighted row statistics.
+            # Masked rows' weight VALUES never participate: validation
+            # only inspects valid rows, and sqrt() sees 0 there (a NaN/
+            # negative payload in a filtered slot must not poison Z).
+            # Validating costs one host read — a weighted-fit-only price.
             w = frame._column_values(self.weight_col)
-            if bool(np.any(np.asarray(w) < 0)):
+            w_host = np.asarray(w)
+            if bool(np.any(w_host[np.asarray(mask)] < 0)):
                 raise ValueError("weights must be nonnegative")
+            mask_b = mask
             mask = mask.astype(float_dtype()) * jnp.sqrt(
-                jnp.asarray(w, float_dtype()))
+                jnp.where(mask_b, jnp.asarray(w, float_dtype()), 0.0))
         solver_name = resolve_solver(self.solver, self.reg_param,
                                      self.elastic_net_param)
         if mesh is not None and mesh.devices.size <= 1:
@@ -470,7 +476,6 @@ class IsotonicRegression(Estimator):
     setFeaturesCol = set_features_col
     setLabelCol = set_label_col
     setPredictionCol = set_prediction_col
-    setWeightCol = set_weight_col
 
     def fit(self, frame: Frame) -> "IsotonicRegressionModel":
         X = np.asarray(frame._column_values(self.features_col), np.float64)

@@ -111,6 +111,24 @@ def pack_design(X, y, mask) -> np.ndarray:
     return Z * w[:, None]
 
 
+
+def pack_design_weighted(X, y, mask, w):
+    """Packed design for WEIGHTED fits: ``Z = [X·m, y·m, w·m]`` — the mask
+    zeroes invalid rows (boolean, exactly like :func:`pack_design`) while
+    the last column carries the real instance weights, so one buffer still
+    ships everything the weighted logistic/softmax cores consume
+    (``classification._unpack_zw``)."""
+    xp = jnp if any(isinstance(a, jax.Array) for a in (X, y, mask, w)) else np
+    X = xp.asarray(X)
+    if X.ndim == 1:
+        X = X[:, None]
+    y = xp.asarray(y, X.dtype)
+    m = xp.asarray(mask, X.dtype)
+    wv = xp.asarray(w, X.dtype)
+    Z = xp.concatenate([X, y[:, None], wv[:, None]], axis=1)
+    return Z * m[:, None]
+
+
 def place_packed(Z, mesh: Optional[Mesh]):
     """Pad packed rows to the shard count and device_put row-sharded.
     Zero padding rows are mask=0 rows by construction (see pack_design)."""

@@ -303,3 +303,14 @@ class TestWeightCol:
         est.save(str(tmp_path / "wlr"))
         from sparkdq4ml_tpu.models.base import load_stage
         assert load_stage(str(tmp_path / "wlr")).weight_col == "w"
+
+    def test_masked_row_weights_never_participate(self):
+        import sparkdq4ml_tpu as dq
+        f = VectorAssembler(["x"], "features").transform(
+            Frame({"x": np.asarray([1.0, 2.0, 3.0, 4.0]),
+                   "label": np.asarray([2.0, 4.0, 6.0, 8.0]),
+                   "w": np.asarray([1.0, 2.0, np.nan, -5.0])}))
+        f = f.filter(dq.col("x") < 2.5)       # masks the NaN/negative rows
+        m = LinearRegression(weight_col="w").fit(f)
+        assert np.all(np.isfinite(m.coefficients))
+        assert np.isfinite(m.intercept)

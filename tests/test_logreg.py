@@ -361,3 +361,95 @@ class TestThresholdCurves:
     def test_camelcase_surface(self, summary):
         assert summary.precisionByThreshold.count() == \
             summary.recallByThreshold.count()
+
+
+class TestWeightCol:
+    """weightCol: integer weight k must equal the row repeated k times
+    (the weighted mean-loss objective makes this exact), binary and
+    multinomial."""
+
+    @pytest.fixture(scope="class")
+    def data(self):
+        rng = np.random.default_rng(7)
+        n, d = 60, 3
+        X = rng.normal(size=(n, d))
+        logits = X @ np.asarray([1.5, -2.0, 0.7]) + 0.3
+        yb = (logits + rng.logistic(size=n) > 0).astype(np.float64)
+        ym = rng.integers(0, 3, size=n).astype(np.float64)
+        w = rng.integers(1, 4, size=n).astype(np.float64)
+        return X, yb, ym, w
+
+    def _frames(self, X, y, w):
+        n, d = X.shape
+        cols = {f"x{j}": X[:, j] for j in range(d)}
+        fw = VectorAssembler([f"x{j}" for j in range(d)], "features") \
+            .transform(Frame({**cols, "label": y, "w": w}))
+        idx = np.repeat(np.arange(n), w.astype(int))
+        fr = VectorAssembler([f"x{j}" for j in range(d)], "features") \
+            .transform(Frame({**{f"x{j}": X[idx, j] for j in range(d)},
+                              "label": y[idx]}))
+        return fw, fr
+
+    @pytest.mark.parametrize("params", [
+        dict(max_iter=300),
+        dict(max_iter=300, reg_param=0.05, elastic_net_param=1.0),
+        dict(max_iter=300, reg_param=0.1, elastic_net_param=0.3),
+    ])
+    def test_binary_weight_equals_repetition(self, data, params):
+        X, yb, _, w = data
+        fw, fr = self._frames(X, yb, w)
+        mw = LogisticRegression(weight_col="w", **params).fit(fw)
+        mr = LogisticRegression(**params).fit(fr)
+        np.testing.assert_allclose(mw.coefficients, mr.coefficients,
+                                   rtol=1e-4, atol=1e-6)
+        np.testing.assert_allclose(mw.intercept, mr.intercept,
+                                   rtol=1e-4, atol=1e-6)
+
+    def test_multinomial_weight_equals_repetition(self, data):
+        X, _, ym, w = data
+        fw, fr = self._frames(X, ym, w)
+        mw = LogisticRegression(weight_col="w", family="multinomial",
+                                max_iter=300).fit(fw)
+        mr = LogisticRegression(family="multinomial", max_iter=300).fit(fr)
+        np.testing.assert_allclose(mw.coefficient_matrix,
+                                   mr.coefficient_matrix,
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_sklearn_sample_weight_parity(self, data):
+        from sklearn.linear_model import LogisticRegression as SkLogit
+        X, yb, _, w = data
+        fw, _ = self._frames(X, yb, w)
+        m = LogisticRegression(max_iter=500, tol=1e-10,
+                               weight_col="w").fit(fw)
+        sk = SkLogit(C=1e8, max_iter=2000, tol=1e-10).fit(
+            X, yb, sample_weight=w)
+        np.testing.assert_allclose(m.coefficients, sk.coef_.ravel(),
+                                   rtol=2e-3, atol=2e-4)
+
+    def test_negative_weights_rejected(self, data):
+        X, yb, _, w = data
+        cols = {f"x{j}": X[:, j] for j in range(X.shape[1])}
+        fw = VectorAssembler(list(cols), "features").transform(
+            Frame({**cols, "label": yb, "w": -w}))
+        with pytest.raises(ValueError, match="nonnegative"):
+            LogisticRegression(weight_col="w").fit(fw)
+
+    def test_sharded_weighted_matches_single(self, data):
+        from sparkdq4ml_tpu.parallel.mesh import make_mesh
+        X, yb, _, w = data
+        fw, _ = self._frames(X, yb, w)
+        est = LogisticRegression(weight_col="w", max_iter=200)
+        a = est.fit(fw)
+        b = est.fit(fw, mesh=make_mesh(8))
+        np.testing.assert_allclose(a.coefficients, b.coefficients,
+                                   rtol=1e-8, atol=1e-10)
+
+    def test_masked_row_weights_never_participate(self):
+        import sparkdq4ml_tpu as dq
+        f = VectorAssembler(["x"], "features").transform(
+            Frame({"x": np.asarray([-2.0, -1.0, 1.0, 2.0, 9.0]),
+                   "label": np.asarray([0.0, 0.0, 1.0, 1.0, 1.0]),
+                   "w": np.asarray([1.0, 2.0, 1.0, 2.0, np.nan])}))
+        f = f.filter(dq.col("x") < 5.0)       # masks the NaN-weight row
+        m = LogisticRegression(weight_col="w", max_iter=50).fit(f)
+        assert np.all(np.isfinite(m.coefficients))
