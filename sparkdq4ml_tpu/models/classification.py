@@ -1306,13 +1306,14 @@ class NaiveBayes(Estimator):
 
     _persist_attrs = ('smoothing', 'model_type', 'features_col', 'label_col',
                       'prediction_col', 'probability_col',
-                      'raw_prediction_col')
+                      'raw_prediction_col', 'weight_col')
 
     def __init__(self, smoothing: float = 1.0, model_type: str = "multinomial",
                  features_col: str = "features", label_col: str = "label",
                  prediction_col: str = "prediction",
                  probability_col: str = "probability",
-                 raw_prediction_col: str = "rawPrediction"):
+                 raw_prediction_col: str = "rawPrediction",
+                 weight_col: Optional[str] = None):
         if model_type not in ("multinomial", "bernoulli"):
             raise ValueError(f"model_type={model_type!r}")
         if smoothing < 0:
@@ -1324,6 +1325,7 @@ class NaiveBayes(Estimator):
         self.prediction_col = prediction_col
         self.probability_col = probability_col
         self.raw_prediction_col = raw_prediction_col
+        self.weight_col = weight_col
 
     def set_smoothing(self, v):
         if v < 0:
@@ -1352,6 +1354,12 @@ class NaiveBayes(Estimator):
         return self
 
     setLabelCol = set_label_col
+
+    def set_weight_col(self, v):
+        self.weight_col = v
+        return self
+
+    setWeightCol = set_weight_col
 
     def fit(self, frame: Frame, mesh=None) -> "NaiveBayesModel":
         from ..parallel.mesh import normalize_mesh
@@ -1386,7 +1394,16 @@ class NaiveBayes(Estimator):
         # the stats matmul (0 * NaN = NaN)
         Xh = np.where(mask[:, None], Xh, 0.0)
         yh = np.where(mask, y, 0.0)
-        Xd, yd, wd = pad_and_shard_rows(mesh, Xh, yh, mask.astype(dt))
+        row_w = mask.astype(dt)
+        if self.weight_col is not None:
+            # instance weights (MLlib weightCol): the per-class sufficient
+            # statistics are one weighted one-hot matmul, so weights slot
+            # straight into the row-weight vector; masked slots stay 0
+            w = np.asarray(frame._column_values(self.weight_col), dt)
+            if np.any(w[mask] < 0):
+                raise ValueError("weights must be nonnegative")
+            row_w = np.where(mask, w, 0.0).astype(dt)
+        Xd, yd, wd = pad_and_shard_rows(mesh, Xh, yh, row_w)
         class_count, feat_sum = _nb_stats_fn(mesh, num_classes)(Xd, yd, wd)
         class_count = np.asarray(class_count, np.float64)
         feat_sum = np.asarray(feat_sum, np.float64)
@@ -1408,7 +1425,8 @@ class NaiveBayes(Estimator):
     def _params_dict(self):
         return {k: getattr(self, k) for k in (
             "smoothing", "model_type", "features_col", "label_col",
-            "prediction_col", "probability_col", "raw_prediction_col")}
+            "prediction_col", "probability_col", "raw_prediction_col",
+            "weight_col")}
 
 
 @persistable

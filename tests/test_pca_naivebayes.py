@@ -177,3 +177,40 @@ class TestNaiveBayes:
         loaded = load_stage(str(tmp_path / "nb"))
         assert isinstance(loaded, NaiveBayesModel)
         assert loaded.predict(X[0]) == model.predict(X[0])
+
+
+class TestNaiveBayesWeightCol:
+    def test_weight_equals_repetition(self):
+        from sparkdq4ml_tpu.models import NaiveBayes
+        rng = np.random.default_rng(4)
+        n, d = 50, 6
+        X = rng.poisson(2.0, size=(n, d)).astype(np.float64)
+        y = rng.integers(0, 3, size=n).astype(np.float64)
+        w = rng.integers(1, 4, size=n).astype(np.float64)
+        fw = Frame({"features": X, "label": y, "w": w})
+        idx = np.repeat(np.arange(n), w.astype(int))
+        fr = Frame({"features": X[idx], "label": y[idx]})
+        mw = NaiveBayes(weight_col="w").fit(fw)
+        mr = NaiveBayes().fit(fr)
+        np.testing.assert_allclose(mw.pi, mr.pi, rtol=1e-10)
+        np.testing.assert_allclose(mw.theta, mr.theta, rtol=1e-10)
+
+    def test_sklearn_sample_weight_parity(self):
+        from sklearn.naive_bayes import MultinomialNB
+        from sparkdq4ml_tpu.models import NaiveBayes
+        rng = np.random.default_rng(5)
+        X = rng.poisson(2.0, size=(40, 5)).astype(np.float64)
+        y = rng.integers(0, 2, size=40).astype(np.float64)
+        w = rng.uniform(0.5, 3.0, size=40)
+        m = NaiveBayes(smoothing=1.0, weight_col="w").fit(
+            Frame({"features": X, "label": y, "w": w}))
+        sk = MultinomialNB(alpha=1.0).fit(X, y, sample_weight=w)
+        np.testing.assert_allclose(m.theta, sk.feature_log_prob_, rtol=1e-8)
+
+    def test_negative_rejected(self):
+        from sparkdq4ml_tpu.models import NaiveBayes
+        f = Frame({"features": np.asarray([[1.0], [2.0]]),
+                   "label": np.asarray([0.0, 1.0]),
+                   "w": np.asarray([1.0, -1.0])})
+        with pytest.raises(ValueError, match="nonnegative"):
+            NaiveBayes(weight_col="w").fit(f)
