@@ -904,7 +904,8 @@ def _gbt_leaf_fn(max_depth):
 
 
 def _gbt_fit(X, y, w, *, loss, max_iter, step, max_depth, max_bins,
-             min_instances, min_info_gain, subsample, seed, mesh=None):
+             min_instances, min_info_gain, subsample, seed, mesh=None,
+             valid_w=None, validation_tol=0.01):
     """Returns (F0, stacked TreeArrays). Stats rows per tree:
     [w, w·g, w·g², w·h] — variance-of-gradient splits (Friedman), Newton
     leaf values Σg/Σh. For squared loss h ≡ 1 so the leaf is the residual
@@ -912,7 +913,13 @@ def _gbt_fit(X, y, w, *, loss, max_iter, step, max_depth, max_bins,
 
     Under a ``mesh`` each boosting round's tree builds row-sharded
     (psum'd level histograms); the replicated tree then scores the full
-    rows for the next round's gradients."""
+    rows for the next round's gradients.
+
+    ``valid_w``: optional held-out row weights (MLlib
+    ``validationIndicatorCol``). After each round the validation loss is
+    evaluated on those rows; boosting stops once the relative improvement
+    over the best loss so far drops below ``validation_tol``, and the
+    returned ensemble is truncated at the best round."""
     dt = np.dtype(float_dtype())
     edges, binned = bin_features(X, w > 0, max_bins)
     rng = np.random.default_rng(seed)
@@ -950,9 +957,18 @@ def _gbt_fit(X, y, w, *, loss, max_iter, step, max_depth, max_bins,
                                    min_info_gain, mesh)
     tree_leaf_stats = _gbt_leaf_fn(max_depth)
 
+    def _val_loss(F_now):
+        vs = max(valid_w.sum(), 1e-12)
+        if loss == "squared":
+            return float(np.sum(valid_w * (y - F_now) ** 2) / vs)
+        z = np.where(y > 0.5, F_now, -F_now)
+        return float(np.sum(valid_w * np.logaddexp(0.0, -z)) / vs)
+
     Xd = jnp.asarray(X, dt)
     F = np.full((n,), F0, np.float64)
     all_trees = []
+    best_loss = _val_loss(F) if valid_w is not None else None
+    best_k = 0
     for _ in range(max_iter):
         if loss == "squared":
             g = y - F
@@ -975,6 +991,17 @@ def _gbt_fit(X, y, w, *, loss, max_iter, step, max_depth, max_bins,
                                           tree.threshold, tree.is_leaf, Xd),
                           np.float64)
         F = F + step * leaf
+        if valid_w is not None:
+            cur = _val_loss(F)
+            if cur < best_loss - validation_tol * max(abs(best_loss), 1e-12):
+                best_loss = cur
+                best_k = len(all_trees)
+            elif len(all_trees) - best_k >= 1:
+                break            # no meaningful improvement: stop boosting
+    if valid_w is not None:
+        # truncate at the best round; keep at least one tree (an ensemble
+        # of zero trees has no stacked arrays and MLlib keeps one too)
+        all_trees = all_trees[:max(best_k, 1)]
     stacked = TreeArrays(*[np.stack([getattr(t, f) for t in all_trees])
                            for f in TreeArrays._fields])
     return F0, stacked
@@ -986,7 +1013,8 @@ class _GbtBase(Estimator, _TreeParams):
                  min_instances_per_node: int = 1, min_info_gain: float = 0.0,
                  subsampling_rate: float = 1.0,
                  features_col: str = "features", label_col: str = "label",
-                 prediction_col: str = "prediction", seed: int = 0):
+                 prediction_col: str = "prediction", seed: int = 0,
+                 validation_indicator_col=None, validation_tol: float = 0.01):
         self.max_iter = int(max_iter)
         self.step_size = float(step_size)
         self.max_depth = int(max_depth)
@@ -998,12 +1026,36 @@ class _GbtBase(Estimator, _TreeParams):
         self.label_col = label_col
         self.prediction_col = prediction_col
         self.seed = int(seed)
+        self.validation_indicator_col = validation_indicator_col
+        self.validation_tol = float(validation_tol)
+
+    def _split_weights(self, frame, mask):
+        """(training weights, validation weights or None) from the
+        validationIndicatorCol, mask-aware."""
+        w = mask.astype(np.float64)
+        if self.validation_indicator_col is None:
+            return w, None
+        v = np.asarray(
+            frame._column_values(self.validation_indicator_col)) > 0
+        return w * (~v), w * v
 
     def set_max_iter(self, v):
         self.max_iter = int(v)
         return self
 
     setMaxIter = set_max_iter
+
+    def set_validation_indicator_col(self, v):
+        self.validation_indicator_col = v
+        return self
+
+    setValidationIndicatorCol = set_validation_indicator_col
+
+    def set_validation_tol(self, v):
+        self.validation_tol = float(v)
+        return self
+
+    setValidationTol = set_validation_tol
 
     def set_step_size(self, v):
         self.step_size = float(v)
@@ -1025,17 +1077,20 @@ class GBTRegressor(_GbtBase):
     _persist_attrs = ('max_iter', 'step_size', 'max_depth', 'max_bins',
                       'min_instances_per_node', 'min_info_gain',
                       'subsampling_rate', 'features_col', 'label_col',
-                      'prediction_col', 'seed')
+                      'prediction_col', 'seed',
+                      'validation_indicator_col', 'validation_tol')
 
     def fit(self, frame: Frame, mesh=None) -> "GBTRegressionModel":
         X, y, mask = self._extract(frame)
+        w_train, w_val = self._split_weights(frame, mask)
         F0, trees = _gbt_fit(
-            X, y, mask.astype(np.float64), loss="squared",
+            X, y, w_train, loss="squared",
             max_iter=self.max_iter, step=self.step_size,
             max_depth=self.max_depth, max_bins=self.max_bins,
             min_instances=self.min_instances_per_node,
             min_info_gain=self.min_info_gain,
-            subsample=self.subsampling_rate, seed=self.seed, mesh=mesh)
+            subsample=self.subsampling_rate, seed=self.seed, mesh=mesh,
+            valid_w=w_val, validation_tol=self.validation_tol)
         return GBTRegressionModel(
             trees.feature, trees.threshold, trees.is_leaf, trees.value,
             trees.gain, X.shape[1], self.max_depth, F0, self.step_size,
@@ -1104,13 +1159,15 @@ class GBTClassifier(_GbtBase):
         yv = y[mask]
         if not np.all((yv == 0) | (yv == 1)):
             raise ValueError("GBTClassifier requires binary 0/1 labels")
+        w_train, w_val = self._split_weights(frame, mask)
         F0, trees = _gbt_fit(
-            X, y, mask.astype(np.float64), loss="logistic",
+            X, y, w_train, loss="logistic",
             max_iter=self.max_iter, step=self.step_size,
             max_depth=self.max_depth, max_bins=self.max_bins,
             min_instances=self.min_instances_per_node,
             min_info_gain=self.min_info_gain,
-            subsample=self.subsampling_rate, seed=self.seed, mesh=mesh)
+            subsample=self.subsampling_rate, seed=self.seed, mesh=mesh,
+            valid_w=w_val, validation_tol=self.validation_tol)
         return GBTClassificationModel(
             trees.feature, trees.threshold, trees.is_leaf, trees.value,
             trees.gain, X.shape[1], self.max_depth, F0, self.step_size,
