@@ -82,29 +82,13 @@ def make_median_time(jax):
     return median_time
 
 
-def _probe_backend(timeout_s: int = 150) -> bool:
-    """True when the default JAX backend initializes in a fresh subprocess.
-
-    A tunneled-TPU pool can wedge (device claim blocks forever inside
-    PJRT init — observed when a prior client dies mid-claim). The driver
-    contract is ONE JSON line; hanging forever breaks it, so probe in a
-    throwaway process and fall back to CPU with an honest ``backend``
-    field if the accelerator never comes up."""
-    import subprocess
-
-    try:
-        proc = subprocess.run(
-            [sys.executable, "-c", "import jax; jax.devices()"],
-            capture_output=True, timeout=timeout_s)
-        return proc.returncode == 0
-    except subprocess.TimeoutExpired:
-        return False
-    except OSError:
-        return False
-
-
 def main():
-    if os.environ.get("BENCH_SKIP_PROBE") != "1" and not _probe_backend():
+    # The driver contract is ONE JSON line; a wedged tunnel must yield an
+    # honest backend=cpu result, not an infinite hang (shared probe helper).
+    from sparkdq4ml_tpu.utils.debug import backend_initializes
+
+    if (os.environ.get("BENCH_SKIP_PROBE") != "1"
+            and not backend_initializes()):
         log("accelerator backend failed to initialize (wedged tunnel?); "
             "falling back to CPU — results will carry backend=cpu")
         os.environ["JAX_PLATFORMS"] = "cpu"

@@ -539,6 +539,8 @@ class LogisticRegression(Estimator):
     regularization (MLlib ``family`` semantics: auto / binomial /
     multinomial)."""
 
+    weight_col = None    # back-compat default for pre-weightCol saves
+
     _persist_attrs = ("max_iter", "reg_param", "elastic_net_param", "tol",
                       "fit_intercept", "standardization", "threshold",
                       "family", "features_col", "label_col", "prediction_col",
@@ -651,7 +653,8 @@ class LogisticRegression(Estimator):
             # LinearRegression weightCol note): validate valid rows only,
             # zero the rest so a NaN payload cannot poison the packing
             w = frame._column_values(self.weight_col)
-            if bool(np.any(np.asarray(w)[np.asarray(mask)] < 0)):
+            # NaN fails >= too (silent NaN poisoning must raise instead)
+            if not bool(np.all(np.asarray(w)[np.asarray(mask)] >= 0)):
                 raise ValueError("weights must be nonnegative")
             w = jnp.where(mask, jnp.asarray(w, float_dtype()), 0.0)
             Zd = place_packed(pack_design_weighted(X, y, mask, w), mesh)
@@ -1304,6 +1307,8 @@ class NaiveBayes(Estimator):
     sufficient statistics (no per-row loop), and prediction is
     ``pi + X @ thetaᵀ`` — a single MXU matmul batched over rows."""
 
+    weight_col = None    # back-compat default for pre-weightCol saves
+
     _persist_attrs = ('smoothing', 'model_type', 'features_col', 'label_col',
                       'prediction_col', 'probability_col',
                       'raw_prediction_col', 'weight_col')
@@ -1400,7 +1405,7 @@ class NaiveBayes(Estimator):
             # statistics are one weighted one-hot matmul, so weights slot
             # straight into the row-weight vector; masked slots stay 0
             w = np.asarray(frame._column_values(self.weight_col), dt)
-            if np.any(w[mask] < 0):
+            if not np.all(w[mask] >= 0):   # NaN fails >= too
                 raise ValueError("weights must be nonnegative")
             row_w = np.where(mask, w, 0.0).astype(dt)
         Xd, yd, wd = pad_and_shard_rows(mesh, Xh, yh, row_w)

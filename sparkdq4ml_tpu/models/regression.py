@@ -39,6 +39,10 @@ def _extract_xy(frame: Frame, features_col: str, label_col: str):
 class LinearRegression(Estimator):
     """Elastic-net linear regression, MLlib numeric convention."""
 
+    # class-level default: estimators persisted before this param existed
+    # load via setattr (base.load_stage) and must still resolve it
+    weight_col = None
+
     _persist_attrs = ("max_iter", "reg_param", "elastic_net_param", "tol",
                       "fit_intercept", "standardization", "solver",
                       "features_col", "label_col", "prediction_col",
@@ -169,7 +173,9 @@ class LinearRegression(Estimator):
             # Validating costs one host read — a weighted-fit-only price.
             w = frame._column_values(self.weight_col)
             w_host = np.asarray(w)
-            if bool(np.any(w_host[np.asarray(mask)] < 0)):
+            # NaN fails >= too: a NaN weight on a valid row must raise,
+            # not silently poison the Gramian
+            if not bool(np.all(w_host[np.asarray(mask)] >= 0)):
                 raise ValueError("weights must be nonnegative")
             mask_b = mask
             mask = mask.astype(float_dtype()) * jnp.sqrt(

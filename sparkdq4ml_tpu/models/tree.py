@@ -996,7 +996,7 @@ def _gbt_fit(X, y, w, *, loss, max_iter, step, max_depth, max_bins,
             if cur < best_loss - validation_tol * max(abs(best_loss), 1e-12):
                 best_loss = cur
                 best_k = len(all_trees)
-            elif len(all_trees) - best_k >= 1:
+            else:
                 break            # no meaningful improvement: stop boosting
     if valid_w is not None:
         # truncate at the best round; keep at least one tree (an ensemble
@@ -1008,6 +1008,10 @@ def _gbt_fit(X, y, w, *, loss, max_iter, step, max_depth, max_bins,
 
 
 class _GbtBase(Estimator, _TreeParams):
+    # back-compat defaults for pre-validationIndicatorCol saves
+    validation_indicator_col = None
+    validation_tol = 0.01
+
     def __init__(self, max_iter: int = 20, step_size: float = 0.1,
                  max_depth: int = 5, max_bins: int = 32,
                  min_instances_per_node: int = 1, min_info_gain: float = 0.0,

@@ -36,3 +36,23 @@ class nan_checks:
     def __exit__(self, *exc):
         jax.config.update("jax_debug_nans", self._saved)
         return False
+
+
+def backend_initializes(timeout_s: int = 150) -> bool:
+    """True when the default JAX backend comes up in a THROWAWAY process.
+
+    A tunneled-TPU pool can wedge (device claim blocks forever inside PJRT
+    init — observed when a prior client dies mid-claim); probing in a
+    subprocess lets callers fall back to CPU instead of hanging. Shared by
+    ``bench.py`` and ``__graft_entry__.dryrun_multichip``.
+    """
+    import subprocess
+    import sys
+
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            capture_output=True, timeout=timeout_s)
+        return proc.returncode == 0
+    except (subprocess.TimeoutExpired, OSError):
+        return False
