@@ -148,23 +148,47 @@ class Summarizer:
     def metrics(cls, *names) -> "Summarizer":
         return cls(names)
 
-    def summary(self, frame, column: str = "features", mesh=None) -> dict:
+    def summary(self, frame, column: str = "features", mesh=None,
+                weight_col: str = None) -> dict:
+        """One-pass metrics; ``weight_col`` (MLlib's optional weight
+        argument) weights mean/variance/norms/numNonZeros, while ``count``
+        stays the number of weight-positive rows and min/max ignore
+        weights — MLlib's MultivariateOnlineSummarizer semantics
+        (zero-weight rows are skipped entirely)."""
         mesh = normalize_mesh(mesh)
         X, w = _extract(frame, column, mesh)
+        count = None
+        if weight_col is not None:
+            uw = np.asarray(frame._column_values(weight_col), np.float64)
+            valid = np.asarray(frame.mask)
+            if not np.all(uw[valid] >= 0):     # NaN fails >= too
+                raise ValueError("weights must be nonnegative")
+            uw = np.where(valid, uw, 0.0)
+            count = int((uw > 0).sum())
+            w = jnp.asarray(uw, X.dtype)
+            if mesh is not None:
+                # re-shard the replaced weights like _extract did
+                from ..parallel.distributed import pad_and_shard_rows
+
+                X_np = np.asarray(X)
+                X, w = pad_and_shard_rows(mesh, X_np[:len(uw)], uw)[0:2]
         n, mean, C, mn, mx, l1, l2, nnz = map(np.asarray,
                                               _moment_pass_fn(mesh)(X, w))
         var = np.diag(C) / max(float(n) - 1.0, 1.0)
         all_metrics = {
             "mean": mean, "variance": var, "std": np.sqrt(var),
-            "count": int(n), "numNonZeros": nnz, "min": mn, "max": mx,
+            "count": int(n) if count is None else count,
+            "numNonZeros": nnz, "min": mn, "max": mx,
             "normL1": l1, "normL2": l2,
         }
         return {k: all_metrics[k] for k in self._metrics}
 
 
-def summary(frame, column: str = "features", mesh=None) -> dict:
+def summary(frame, column: str = "features", mesh=None,
+            weight_col: str = None) -> dict:
     """All Summarizer metrics of a vector column in one pass."""
-    return Summarizer(Summarizer.METRICS).summary(frame, column, mesh)
+    return Summarizer(Summarizer.METRICS).summary(frame, column, mesh,
+                                                  weight_col)
 
 
 @functools.lru_cache(maxsize=None)

@@ -218,3 +218,45 @@ class TestKolmogorovSmirnovTest:
         out = KolmogorovSmirnovTest.test(f, "x").to_pydict()
         ref = sstats.kstest(x[keep], "norm", mode="asymp")
         assert out["statistic"][0] == pytest.approx(ref.statistic, rel=1e-9)
+
+
+class TestSummarizerWeightCol:
+    def test_weighted_matches_repetition(self):
+        from sparkdq4ml_tpu.models.stat import Summarizer
+        rng = np.random.default_rng(2)
+        n, d = 30, 4
+        X = rng.normal(size=(n, d))
+        w = rng.integers(1, 4, size=n).astype(np.float64)
+        fw = Frame({"features": X, "w": w})
+        idx = np.repeat(np.arange(n), w.astype(int))
+        fr = Frame({"features": X[idx]})
+        s = Summarizer(Summarizer.METRICS)
+        a = s.summary(fw, weight_col="w")
+        b = s.summary(fr)
+        np.testing.assert_allclose(a["mean"], b["mean"], rtol=1e-9)
+        np.testing.assert_allclose(a["variance"], b["variance"], rtol=1e-9)
+        np.testing.assert_allclose(a["normL1"], b["normL1"], rtol=1e-9)
+        np.testing.assert_allclose(a["normL2"], b["normL2"], rtol=1e-9)
+        np.testing.assert_allclose(a["min"], b["min"])
+        np.testing.assert_allclose(a["max"], b["max"])
+        assert a["count"] == n            # weight-positive ROWS, unweighted
+
+    def test_weighted_mesh_matches_single(self):
+        from sparkdq4ml_tpu.models.stat import Summarizer
+        from sparkdq4ml_tpu.parallel.mesh import make_mesh
+        rng = np.random.default_rng(3)
+        X = rng.normal(size=(25, 3))
+        w = rng.uniform(0.5, 2.0, size=25)
+        f = Frame({"features": X, "w": w})
+        s = Summarizer(Summarizer.METRICS)
+        a = s.summary(f, weight_col="w")
+        b = s.summary(f, mesh=make_mesh(8), weight_col="w")
+        for k in ("mean", "variance", "normL1", "normL2", "min", "max"):
+            np.testing.assert_allclose(a[k], b[k], rtol=1e-8)
+
+    def test_negative_weight_rejected(self):
+        from sparkdq4ml_tpu.models.stat import Summarizer
+        f = Frame({"features": np.asarray([[1.0], [2.0]]),
+                   "w": np.asarray([1.0, -2.0])})
+        with pytest.raises(ValueError, match="nonnegative"):
+            Summarizer(("mean",)).summary(f, weight_col="w")
