@@ -34,7 +34,10 @@ def _moment_stats(X, w, psum_axis=None):
     if psum_axis is not None:
         n, s1 = jax.lax.psum((n, s1), psum_axis)
     mean = s1 / n
-    Xc = (X - mean) * wc
+    # √w scaling ⇒ C = Σ w·(x−μ)(x−μ)ᵀ — weighted ONCE. (X−μ)·w would
+    # square the weight inside the Gram product: identical for 0/1 masks
+    # but ~w× off for real weights (the r3 weighted-variance bug).
+    Xc = (X - mean) * jnp.sqrt(wc)
     C = Xc.T @ Xc                                 # centered scatter
     big = jnp.asarray(jnp.finfo(X.dtype).max, X.dtype)
     mn = jnp.min(jnp.where(wc > 0, X, big), axis=0)

@@ -37,6 +37,7 @@ class TpuSession:
         self.app_name = app_name
         self.master = master
         self.conf: dict[str, str] = dict(conf or {})
+        self._ensure_backend()
         self._init_distributed()
         n = parse_master(master)
         self.mesh = make_mesh(n)
@@ -47,6 +48,58 @@ class TpuSession:
         self._init_compilation_cache()
         logger.debug("session %r: %d device(s), platform=%s", app_name,
                      self.num_devices, jax.devices()[0].platform)
+
+    def _is_multihost(self) -> bool:
+        """Single predicate for "this session bootstraps a multi-host
+        runtime" — shared by the probe skip and ``_init_distributed`` so
+        the two can never disagree (a rank that probe-falls-back to CPU
+        while its peers claim accelerators would desync the mesh)."""
+        return (self.master or "").strip().lower() in ("pod", "pod[*]") or \
+            bool(self.conf.get("spark.distributed.coordinator"))
+
+    def _ensure_backend(self) -> None:
+        """Session init must come up even when the device tunnel is wedged
+        (`DataQuality4MachineLearningApp.java:38-41` always succeeds): probe
+        the backend in a subprocess and pin this process to CPU on failure
+        instead of hanging forever in PJRT init. Opt out (e.g. multi-host
+        pods, where every process MUST claim its accelerator) with
+        ``.config("spark.backend.probe", "off")``; tune the probe window
+        with ``.config("spark.backend.probeTimeout", seconds)``."""
+        if str(self.conf.get("spark.backend.probe", "on")).lower() in (
+                "off", "false", "0"):
+            return
+        if self._is_multihost():
+            return  # multi-host bootstrap: CPU fallback would desync ranks
+        from .utils import debug as _debug
+
+        timeout = float(self.conf.get("spark.backend.probeTimeout", 150))
+        if (self.master or "").strip().lower().startswith("tpu"):
+            # The user explicitly demanded the accelerator — a silent CPU
+            # fallback would betray that. Probe FRESH (a stale cached
+            # healthy verdict would walk straight into the hang; a stale
+            # cached 'cpu' would wrongly refuse a recovered TPU) and
+            # WITHOUT the pin-to-CPU latch so a later retry in this
+            # process can still succeed. The platform distinguishes
+            # "wedged" from "no TPU on this machine".
+            plat = _debug.probe_backend_platform(timeout)
+            if plat is None:
+                raise RuntimeError(
+                    f"master={self.master!r} requested the TPU backend but "
+                    f"it did not initialize within {timeout:.0f} s (wedged "
+                    "device tunnel?); retry later, or use "
+                    "master='local[*]' to accept a CPU fallback")
+            if plat in ("cpu", "gpu", "cuda", "rocm"):
+                # Known non-TPU platforms fail with the real cause; unknown
+                # names pass — tunneled TPU plugins report under their own
+                # platform name (e.g. "axon"), and refusing those would
+                # break exactly the hardware this path is for.
+                raise RuntimeError(
+                    f"master={self.master!r} requested the TPU backend but "
+                    f"the default backend here is {plat!r}; "
+                    "use master='local[*]' to run on the local backend")
+            return
+        _debug.ensure_backend(timeout)
+        # on fallback, ensure_backend already warned
 
     def _init_distributed(self) -> None:
         """Multi-host runtime init — the cluster-master analogue of Spark's
@@ -64,10 +117,9 @@ class TpuSession:
 
         Idempotent: a no-op when the distributed client already exists.
         """
-        coord = self.conf.get("spark.distributed.coordinator")
-        is_pod = (self.master or "").strip().lower() in ("pod", "pod[*]")
-        if not (is_pod or coord):
+        if not self._is_multihost():
             return
+        coord = self.conf.get("spark.distributed.coordinator")
         try:
             from jax._src import distributed as _dist
 
