@@ -8,15 +8,24 @@ Covers the five BASELINE.json configs plus a synthetic scale sweep:
       multichip dryrun),
 (c)   elastic-net general path (FISTA, regParam=0.3, elasticNetParam=0.5),
 (d)   LogisticRegression on the DQ-filtered rows (per-iteration-psum loop),
+      plus a 1e6×16 scale variant (d_scale) where barrier elimination —
+      not solver iteration counts — dominates,
 (e)   CrossValidator grid (regParam × elasticNetParam, grid-parallel cell
       sharding) vs sklearn GridSearchCV(refit=True) — timed as the fused
       device-complete CV program (fold Gramians → every cell solved →
       winner selected → best model refit, one dispatch, no host reads;
       the same program CrossValidator.fit runs, which then adds exactly
       one host read to materialize the packed result),
+(dq)  the DQ phase itself (`App.java:52-95`): CSV parse throughput
+      (native C++ tokenizer vs pure-Python) on a ~1e6-row synthetic file,
+      and the fused rules+filter pass (XLA, on device) vs vectorized numpy,
 (sweep) the masked-Gramian data pass at n ∈ {1e5, 1e6, 1e7} × d ∈ {16, 128,
       512} (HBM-bounded subset), XLA vs compiled Pallas, with on-device
       numerics assertions — the MXU/HBM throughput story behind every fit.
+      On TPU each cell also reports its roofline fractions: ``hbm_frac``
+      (achieved GB/s ÷ chip HBM peak) and ``mfu`` (achieved FLOP/s ÷ chip
+      bf16 matmul peak; f32 cells use the same denominator, so their mfu
+      is a conservative lower bound).
 
 Baselines are **measured CPU** stand-ins (sklearn / numpy, documented per
 config): the reference publishes no numbers (SURVEY.md §6) and no JVM is
@@ -61,6 +70,25 @@ SWEEP_SHAPES = [(100_000, 16), (100_000, 128)] if SMOKE else \
      (100_000, 128), (1_000_000, 128), (1_000_000, 512)]
 CPU_SWEEP_SHAPES = {(100_000, 16), (1_000_000, 16), (100_000, 128)}
 
+# Public per-chip peaks (vendor spec sheets), keyed by device_kind prefix:
+# (HBM GB/s, bf16 dense matmul TFLOP/s). Drives the hbm_frac / mfu roofline
+# fractions; unknown kinds (incl. "cpu") report no fractions.
+ROOFLINE = {
+    "TPU v4": (1228.0, 275.0),
+    "TPU v5 lite": (819.0, 197.0),    # v5e
+    "TPU v5e": (819.0, 197.0),
+    "TPU v5p": (2765.0, 459.0),
+    "TPU v6 lite": (1640.0, 918.0),   # v6e / Trillium
+    "TPU v6e": (1640.0, 918.0),
+}
+
+
+def roofline_for(device_kind: str):
+    for prefix, peaks in ROOFLINE.items():
+        if device_kind.startswith(prefix):
+            return peaks
+    return None
+
 
 def log(*args):
     print(*args, file=sys.stderr, flush=True)
@@ -84,12 +112,22 @@ def make_median_time(jax):
 
 def main():
     # The driver contract is ONE JSON line; a wedged tunnel must yield an
-    # honest backend=cpu result, not an infinite hang (shared probe helper).
-    from sparkdq4ml_tpu.utils.debug import backend_initializes
+    # honest backend=cpu result, not an infinite hang. A TRANSIENT wedge
+    # must not concede the whole capture either (it did in round 3): probe
+    # in a bounded retry loop — up to BENCH_PROBE_DEADLINE seconds
+    # (default 20 min), one probe per ~60 s — before accepting CPU.
+    from sparkdq4ml_tpu.utils.debug import backend_initializes_retry
 
+    try:
+        deadline = float(os.environ.get("BENCH_PROBE_DEADLINE", "1200"))
+    except ValueError:
+        log("BENCH_PROBE_DEADLINE is not a number; using 1200 s")
+        deadline = 1200.0
     if (os.environ.get("BENCH_SKIP_PROBE") != "1"
-            and not backend_initializes()):
-        log("accelerator backend failed to initialize (wedged tunnel?); "
+            and not backend_initializes_retry(deadline_s=deadline,
+                                              interval_s=60.0, log=log)):
+        log("accelerator backend failed to initialize for "
+            f"{deadline:.0f} s (wedged tunnel?); "
             "falling back to CPU — results will carry backend=cpu")
         os.environ["JAX_PLATFORMS"] = "cpu"
         import jax
@@ -113,6 +151,9 @@ def main():
     session = dq.TpuSession.builder().app_name("bench").master("local[*]").get_or_create()
     log(f"devices: {jax.devices()}")
     backend = jax.default_backend()
+    device_kind = jax.devices()[0].device_kind
+    roof = roofline_for(device_kind)
+    is_tpu = backend == "tpu" or device_kind.lower().startswith("tpu")
 
     # ---- build the DQ-cleaned frame (no host reads of device arrays) ----
     dq.register_builtin_rules()
@@ -159,7 +200,35 @@ def main():
     Zb = place_packed(pack_design(X, yb, mask), mesh)
     fit_d = fused_logistic_fit_packed(mesh, 100, 1e-6, True, True)
     hyper_d = jnp.asarray([0.01, 0.0], Zd.dtype)
+    result_d = jax.block_until_ready(fit_d(Zb, hyper_d))  # iters read later
     t_d = median_time(lambda: fit_d(Zb, hyper_d), REPS)
+
+    # (d_scale) logistic at 1e6×16: the regime config (d) cannot show on
+    # 1024 rows — here the fused on-device loop (zero host barriers, MXU
+    # matmuls) is measured against sklearn lbfgs on the same shape.
+    n_ds, d_ds = (100_000, 16) if SMOKE else (1_000_000, 16)
+    Xds = jax.random.normal(jax.random.PRNGKey(7), (n_ds, d_ds), jnp.float32)
+    w_true = jax.random.normal(jax.random.PRNGKey(8), (d_ds,), jnp.float32)
+    noise = 0.5 * jax.random.normal(jax.random.PRNGKey(9), (n_ds,),
+                                    jnp.float32)
+    yds = (Xds @ w_true + noise > 0).astype(jnp.float32)
+    Zds = jax.block_until_ready(place_packed(
+        pack_design(Xds, yds, jnp.ones((n_ds,), jnp.float32)), mesh))
+    del Xds, yds, noise
+    fit_ds = fused_logistic_fit_packed(mesh, 100, 1e-6, True, True)
+    t_ds = median_time(lambda: fit_ds(Zds, hyper_d), max(3, REPS // 6))
+
+    # (dq) the fused rules+filter pass — the reference's UDF hot loop
+    # (`App.java:68-95`) as ONE elementwise device pass
+    from sparkdq4ml_tpu.ops.rules import dq_rules_fused
+
+    n_dq = 100_000 if SMOKE else 1_000_000
+    price_dq = jax.random.uniform(jax.random.PRNGKey(3), (n_dq,),
+                                  jnp.float32, 1.0, 120.0)
+    guest_dq = jax.random.randint(jax.random.PRNGKey(4), (n_dq,),
+                                  1, 40).astype(jnp.float32)
+    fused_rules_fn = jax.jit(dq_rules_fused)
+    t_rules = median_time(lambda: fused_rules_fn(price_dq, guest_dq), REPS)
 
     # (e) CrossValidator grid: the fused device-complete CV program
     from sparkdq4ml_tpu.models import LinearRegression
@@ -190,7 +259,7 @@ def main():
 
     sweep_rows = []        # timings (host floats, no device reads)
     pallas_diffs = []      # on-device |A_p - A_x| max scalars, read later
-    pallas_mode = "on" if backend == "tpu" else "interpret"
+    pallas_mode = "on" if is_tpu else "interpret"
     for (n, d) in SWEEP_SHAPES:
         key = jax.random.PRNGKey(n + d)
         Z = jax.random.normal(key, (n, d + 2), jnp.float32)
@@ -208,11 +277,11 @@ def main():
         # Off-TPU the Pallas interpreter executes element-by-element — the
         # numerics cross-check at full sweep sizes would run for hours, so
         # it only runs compiled (TPU) or on the SMOKE shapes.
-        if backend == "tpu" or SMOKE:
+        if is_tpu or SMOKE:
             config.pallas = pallas_mode
             try:
                 A_p = pallas_kernels.packed_gram_pallas(Z)
-                if backend == "tpu":
+                if is_tpu:
                     # Row-tile autotune: bigger tiles amortize grid/DMA
                     # overhead; all candidates fit VMEM double-buffered.
                     for blk in (512, 1024, 2048, 4096):
@@ -287,6 +356,8 @@ def main():
     except ImportError:
         have_sklearn = False
 
+    sk_iters_d = None
+    t_ds_cpu = None
     if have_sklearn:
         base_a = "sklearn Lasso(cd) maxIter=40"
         t_a_cpu = median_time(
@@ -298,6 +369,20 @@ def main():
         t_d_cpu = median_time(
             lambda: SkLogit(C=100.0, max_iter=100, tol=1e-6).fit(Xs, yb_h),
             REPS)
+        sk_iters_d = int(np.ravel(SkLogit(C=100.0, max_iter=100, tol=1e-6)
+                                  .fit(Xs, yb_h).n_iter_)[0])
+
+        # d_scale baseline: same shape/regime, independent draw (the
+        # comparison is solver-vs-solver on the task family, not bitwise)
+        rng_ds = np.random.default_rng(11)
+        Xh_ds = rng_ds.standard_normal((n_ds, d_ds)).astype(np.float64)
+        wh = rng_ds.standard_normal(d_ds)
+        yh_ds = (Xh_ds @ wh + 0.5 * rng_ds.standard_normal(n_ds) > 0
+                 ).astype(np.float64)
+        t_ds_cpu = median_time(
+            lambda: SkLogit(C=100.0, max_iter=100, tol=1e-6)
+            .fit(Xh_ds, yh_ds), 3)
+        del Xh_ds
     else:
         base_a = "numpy ISTA maxIter=40"
 
@@ -324,6 +409,64 @@ def main():
             row["cpu_gbps"] = round(
                 shape[0] * (shape[1] + 2) * 4 / 1e9 / t_cpu, 1)
 
+    # (dq) numpy baseline for the fused rules pass — the vectorized-host
+    # equivalent of the reference's per-row UDF chain
+    rng_dq = np.random.default_rng(12)
+    ph = rng_dq.uniform(1.0, 120.0, n_dq).astype(np.float32)
+    gh = rng_dq.integers(1, 40, n_dq).astype(np.float32)
+
+    def np_rules():
+        pnm = np.where(ph < 20, -1.0, ph)
+        pcc = np.where((gh < 14) & (ph > 90), -1.0, ph)
+        return pnm, pcc, (pnm > 0) & (pcc > 0)
+
+    t_rules_cpu = median_time(np_rules, REPS)
+    # bytes touched: 2 f32 inputs read + 2 f32 outputs + 1 bool written
+    rules_bytes = n_dq * (4 * 4 + 1)
+
+    # (dq) CSV parse throughput: native C++ tokenizer vs pure-Python vs
+    # pandas on a synthetic (guest,price) file at DQ-bench scale
+    import tempfile
+
+    n_csv = 100_000 if SMOKE else 1_000_000
+    # unique per run: a fixed name would let concurrent benches race on
+    # write/parse/remove
+    csv_fd, csv_path = tempfile.mkstemp(prefix=f"dq_bench_{n_csv}_",
+                                        suffix=".csv")
+    rng_csv = np.random.default_rng(13)
+    guests_csv = rng_csv.integers(1, 40, n_csv)
+    prices_csv = np.round(rng_csv.uniform(1.0, 120.0, n_csv), 2)
+    with os.fdopen(csv_fd, "w") as f:
+        f.write("\n".join(f"{g},{p}" for g, p in
+                          zip(guests_csv, prices_csv)))
+        f.write("\n")
+    csv_bytes = os.path.getsize(csv_path)
+
+    from sparkdq4ml_tpu.frame import native_csv
+    from sparkdq4ml_tpu.frame.csv import read_csv
+
+    t_parse_native = None
+    if native_csv.available():
+        t_parse_native = median_time(
+            lambda: read_csv(csv_path, engine="native"), 3)
+    # the pure-python engine is O(seconds) at 1e6 rows, and a host parser
+    # has no compile cache to warm: ONE direct timed run, no warmup rep
+    t0 = time.perf_counter()
+    read_csv(csv_path, engine="python")
+    t_parse_py = time.perf_counter() - t0
+    t_parse_pandas = None
+    try:
+        import pandas as pd
+
+        t_parse_pandas = median_time(
+            lambda: pd.read_csv(csv_path, header=None), 3)
+    except ImportError:
+        pass
+    try:
+        os.remove(csv_path)   # ~15 MB of /tmp litter otherwise
+    except OSError:
+        pass
+
     # (e) baseline: sklearn GridSearchCV, same 3x3 grid / folds / family,
     # refit=True to match the in-program best-model refit
     t_e_cpu = None
@@ -342,22 +485,79 @@ def main():
     # =====================================================================
     # PHASE 3 — report
     # =====================================================================
-    def cfg(name, t_dev, baseline_name, t_cpu):
-        return {"config": name, "device_ms": round(t_dev * 1e3, 4),
-                "baseline": baseline_name if t_cpu else "unavailable",
-                "baseline_ms": round(t_cpu * 1e3, 4) if t_cpu else None,
-                "vs_baseline": round(t_cpu / t_dev, 2) if t_cpu else None}
+    def cfg(name, t_dev, baseline_name, t_cpu, **extra):
+        out = {"config": name, "device_ms": round(t_dev * 1e3, 4),
+               "baseline": baseline_name if t_cpu else "unavailable",
+               "baseline_ms": round(t_cpu * 1e3, 4) if t_cpu else None,
+               "vs_baseline": round(t_cpu / t_dev, 2) if t_cpu else None}
+        out.update(extra)
+        return out
+
+    # Config (d) has never cleared 10× on 1024 rows and the reason is
+    # structural, not a bug: report it instead of hiding it.
+    iters_d = int(unpack_fit_result(np.asarray(result_d), 1).iterations)
+    sk_clause = (f"vs sklearn lbfgs converging in {sk_iters_d} iterations"
+                 if sk_iters_d is not None else
+                 "(no sklearn baseline available)")
+    analysis_d = (
+        f"device runs {iters_d} FISTA iterations inside one fused dispatch "
+        f"{sk_clause} on 1024 rows; at this size wall-clock is bounded by "
+        f"solver iteration count times dispatch floor, not FLOPs — see "
+        f"d_scale_logistic for the regime where the fused loop wins")
 
     configs = [
         cfg("a_linear_lasso_dataset_full", t_a, base_a, t_a_cpu),
         cfg("c_elasticnet_fista_path", t_c,
             "sklearn ElasticNet(cd) maxIter=100", t_c_cpu),
         cfg("d_logistic_dq_rows", t_d,
-            "sklearn LogisticRegression(lbfgs) maxIter=100", t_d_cpu),
+            "sklearn LogisticRegression(lbfgs) maxIter=100", t_d_cpu,
+            analysis=analysis_d),
+        cfg(f"d_scale_logistic_{n_ds}x{d_ds}", t_ds,
+            f"sklearn LogisticRegression(lbfgs) {n_ds}x{d_ds}", t_ds_cpu),
         cfg("e_crossvalidator_grid", t_e,
             f"sklearn GridSearchCV(ElasticNet) {len(grid)}x{folds} refit",
             t_e_cpu),
+        cfg(f"dq_rules_fused_{n_dq}", t_rules,
+            f"numpy vectorized rules {n_dq}", t_rules_cpu,
+            device_gbps=round(rules_bytes / t_rules / 1e9, 2),
+            baseline_gbps=round(rules_bytes / t_rules_cpu / 1e9, 2)),
     ]
+    parse_cfg = {
+        "config": f"dq_parse_csv_{n_csv}",
+        "file_mb": round(csv_bytes / 1e6, 1),
+        "native_ms": round(t_parse_native * 1e3, 1) if t_parse_native
+        else None,
+        "native_gbps": round(csv_bytes / t_parse_native / 1e9, 3)
+        if t_parse_native else None,
+        "python_ms": round(t_parse_py * 1e3, 1),
+        "python_gbps": round(csv_bytes / t_parse_py / 1e9, 3),
+        "pandas_ms": round(t_parse_pandas * 1e3, 1) if t_parse_pandas
+        else None,
+        "pandas_gbps": round(csv_bytes / t_parse_pandas / 1e9, 3)
+        if t_parse_pandas else None,
+        "native_vs_python": round(t_parse_py / t_parse_native, 2)
+        if t_parse_native else None,
+    }
+    configs.append(parse_cfg)
+
+    # Roofline fractions (TPU only): achieved ÷ chip peak per sweep cell.
+    # mfu uses the bf16 matmul peak as denominator for the f32 cells too,
+    # making their mfu a conservative lower bound (stated in the README).
+    if roof is not None:
+        hbm_peak, tflops_peak = roof
+        for row in sweep_rows:
+            n_r, d_r = row["rows"], row["features"]
+            flops = 2.0 * n_r * (d_r + 2) ** 2
+            row["hbm_frac"] = round(row["xla_gbps"] / hbm_peak, 4)
+            row["mfu"] = round(
+                flops / (row["xla_ms"] / 1e3) / (tflops_peak * 1e12), 4)
+            row["bf16_hbm_frac"] = round(row["bf16_gbps"] / hbm_peak, 4)
+            row["bf16_mfu"] = round(
+                flops / (row["bf16_ms"] / 1e3) / (tflops_peak * 1e12), 4)
+            if row.get("pallas_gbps"):
+                row["pallas_hbm_frac"] = round(
+                    row["pallas_gbps"] / hbm_peak, 4)
+
     for c in configs:
         log(json.dumps(c))
     for row in sweep_rows:
@@ -373,6 +573,9 @@ def main():
         "pallas_max_rel_diff": max((float(d) for _, d in pallas_diffs),
                                    default=None),
         "backend": backend,
+        "device_kind": device_kind,
+        "roofline": {"hbm_gbps": roof[0], "bf16_tflops": roof[1]}
+        if roof else None,
     }))
 
 

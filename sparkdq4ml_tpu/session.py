@@ -75,12 +75,29 @@ class TpuSession:
         timeout = float(self.conf.get("spark.backend.probeTimeout", 150))
         if (self.master or "").strip().lower().startswith("tpu"):
             # The user explicitly demanded the accelerator — a silent CPU
-            # fallback would betray that. Probe FRESH (a stale cached
-            # healthy verdict would walk straight into the hang; a stale
-            # cached 'cpu' would wrongly refuse a recovered TPU) and
-            # WITHOUT the pin-to-CPU latch so a later retry in this
-            # process can still succeed. The platform distinguishes
-            # "wedged" from "no TPU on this machine".
+            # fallback would betray that. First: if THIS process is
+            # already on CPU (an earlier wedged-tunnel fallback pinned it,
+            # or a CPU backend initialized first), no probe can help —
+            # backends are per-process; fail with the real cause instead
+            # of the downstream device-count error.
+            if _debug.process_on_cpu():
+                if _debug.fell_back_to_cpu():
+                    raise RuntimeError(
+                        f"master={self.master!r} requested the TPU backend "
+                        "but this process already fell back to CPU after a "
+                        "wedged-tunnel probe; start a fresh process to "
+                        "claim the TPU")
+                raise RuntimeError(
+                    f"master={self.master!r} requested the TPU backend but "
+                    "the CPU backend initialized first in this process "
+                    "(backends are per-process); if this machine has a "
+                    "TPU, create the session before other jax use or "
+                    "start a fresh process")
+            # Probe FRESH (a stale cached healthy verdict would walk
+            # straight into the hang; a stale cached 'cpu' would wrongly
+            # refuse a recovered TPU) and WITHOUT the pin-to-CPU latch so
+            # a later retry in this process can still succeed. The
+            # platform distinguishes "wedged" from "no TPU here".
             plat = _debug.probe_backend_platform(timeout)
             if plat is None:
                 raise RuntimeError(

@@ -59,7 +59,13 @@ def probe_backend_platform(timeout_s: float = 150):
         if proc.returncode != 0:
             return None
         lines = proc.stdout.strip().splitlines()
-        return lines[-1] if lines else None
+        plat = lines[-1] if lines else None
+        if plat:
+            # every fresh success feeds the cross-process cache, so e.g.
+            # bench's retry probe spares the TpuSession right after it
+            # from paying a duplicate cold-import subprocess
+            _store_probe_platform(plat)
+        return plat
     except (subprocess.TimeoutExpired, OSError):
         return None
 
@@ -111,6 +117,21 @@ def fell_back_to_cpu() -> bool:
     """True when :func:`ensure_backend` pinned CPU because the default
     backend was wedged (as opposed to CPU being forced or already live)."""
     return _FELL_BACK
+
+
+def process_on_cpu() -> bool:
+    """True when THIS process is already committed to the CPU backend —
+    an earlier wedge fallback pinned it, or a CPU backend initialized
+    first. Backends are per-process: once true, no accelerator probe can
+    help this process; only a fresh one can claim the device."""
+    if _FELL_BACK:
+        return True
+    try:
+        from jax._src import xla_bridge
+
+        return bool(xla_bridge._backends) and jax.default_backend() == "cpu"
+    except Exception:
+        return False
 
 
 def ensure_backend(timeout_s: float = 150) -> str:
@@ -179,9 +200,7 @@ def probe_platform_cached(timeout_s: float = 150):
     """
     plat = _cached_probe_platform()
     if plat is None:
-        plat = probe_backend_platform(timeout_s)
-        if plat is not None:
-            _store_probe_platform(plat)
+        plat = probe_backend_platform(timeout_s)  # stores on success
     return plat
 
 

@@ -150,8 +150,13 @@ class TestProbeCache:
         monkeypatch.setattr(dbg, "_probe_cache_path", lambda: path)
         monkeypatch.setenv("SPARKDQ4ML_PROBE_CACHE_TTL", "600")
         probes = []
-        monkeypatch.setattr(dbg, "probe_backend_platform",
-                            lambda t=150: probes.append(1) or "tpu")
+
+        def fake_probe(t=150):   # the real probe stores on success
+            probes.append(1)
+            dbg._store_probe_platform("tpu")
+            return "tpu"
+
+        monkeypatch.setattr(dbg, "probe_backend_platform", fake_probe)
         assert dbg.probe_platform_cached(1) == "tpu"
         assert dbg.probe_platform_cached(1) == "tpu"
         assert len(probes) == 1           # second call served from cache
@@ -166,6 +171,7 @@ class TestSessionProbeConfig:
         import sparkdq4ml_tpu.session as sess_mod
         import sparkdq4ml_tpu.utils.debug as dbg
 
+        monkeypatch.setattr(dbg, "process_on_cpu", lambda: False)
         monkeypatch.setattr(dbg, "probe_backend_platform", lambda t: None)
         with pytest.raises(RuntimeError, match="did not initialize"):
             sess_mod.TpuSession(app_name="t", master="tpu[8]")
@@ -174,8 +180,30 @@ class TestSessionProbeConfig:
         import sparkdq4ml_tpu.session as sess_mod
         import sparkdq4ml_tpu.utils.debug as dbg
 
+        monkeypatch.setattr(dbg, "process_on_cpu", lambda: False)
         monkeypatch.setattr(dbg, "probe_backend_platform", lambda t: "cpu")
         with pytest.raises(RuntimeError, match="default backend here"):
+            sess_mod.TpuSession(app_name="t", master="tpu[8]")
+
+    def test_explicit_tpu_master_raises_when_process_on_cpu(self,
+                                                           monkeypatch):
+        # Backends are per-process: once this process fell back (or came
+        # up CPU-first), a healthy probe subprocess must NOT let init
+        # proceed into the confusing device-count error.
+        import sparkdq4ml_tpu.session as sess_mod
+        import sparkdq4ml_tpu.utils.debug as dbg
+
+        monkeypatch.setattr(dbg, "process_on_cpu", lambda: True)
+
+        def boom(t):
+            raise AssertionError("probe must not run: process already CPU")
+
+        monkeypatch.setattr(dbg, "probe_backend_platform", boom)
+        with pytest.raises(RuntimeError, match="initialized first"):
+            sess_mod.TpuSession(app_name="t", master="tpu[8]")
+        # after a wedge fallback, the remediation changes accordingly
+        monkeypatch.setattr(dbg, "fell_back_to_cpu", lambda: True)
+        with pytest.raises(RuntimeError, match="fell back to CPU"):
             sess_mod.TpuSession(app_name="t", master="tpu[8]")
 
     def test_explicit_tpu_master_ignores_stale_cache(self, monkeypatch,
@@ -189,6 +217,7 @@ class TestSessionProbeConfig:
         monkeypatch.setattr(dbg, "_probe_cache_path", lambda: path)
         monkeypatch.setenv("SPARKDQ4ML_PROBE_CACHE_TTL", "600")
         dbg._store_probe_platform("tpu")            # stale healthy verdict
+        monkeypatch.setattr(dbg, "process_on_cpu", lambda: False)
         monkeypatch.setattr(dbg, "probe_backend_platform", lambda t: None)
         with pytest.raises(RuntimeError, match="did not initialize"):
             sess_mod.TpuSession(app_name="t", master="tpu[8]")
