@@ -5,37 +5,77 @@
 // Parses a whole file into column-major float64 with NaN for empty fields,
 // handling bare-CR / CRLF / LF record separators and RFC-4180 quoting
 // (quoted fields may contain delimiters, escaped "" quotes, and embedded
-// record separators) in one pass, and tracks per column whether every value
-// is integral (so Python can choose int32/float).
+// record separators), and tracks per column whether every value is integral
+// (so Python can choose int32/float).
+//
+// Throughput design (the reference's DQ phase is half IO, `App.java:52-95`):
+//   * number parsing uses the Clinger fast path — mantissa accumulated in a
+//     uint64 and scaled by an exact power of ten, correctly rounded whenever
+//     the field has <= 15 significant digits and |10^e| <= 1e22 (virtually
+//     every real-world numeric CSV field); anything else (hex, inf/nan,
+//     long mantissas, huge exponents) falls back to strtod, so results are
+//     bit-identical to the previous strtod-only implementation;
+//   * when the file contains NO quote character (one memchr pass proves it),
+//     record boundaries are independent, so the buffer is split at record
+//     separators into one chunk per hardware thread and parsed in parallel
+//     (DQCSV_THREADS caps it; the quoted general case keeps the serial
+//     state machine).
 //
 // Contract (see sparkdq4ml_tpu/frame/native_csv.py):
 //   dq_parse_numeric_csv(path, delim, quote, skip_header,
 //                        &data, &ncols, &int_flags)
-//     -> n_rows >= 0 on success; -1 if any field is non-numeric (caller
-//        falls back to the Python parser); -2 on IO error.
+//     -> n_rows >= 0 on success; -1 if any field is non-numeric or a row is
+//        wider than the first (caller falls back to the Python parser);
+//        -2 on IO error.
 //   data: column-major [ncols * n_rows] doubles, malloc'd; caller frees via
 //   dq_free. int_flags: ncols bytes, 1 = column is integral with no nulls.
-//
-// Allocation discipline: unquoted fields parse with strtod directly on the
-// (NUL-terminated) file buffer — zero per-field allocations; quoted records
-// tokenize into one REUSED record buffer with NUL-separated cleaned fields.
 //
 // Build: make -C native
 
 #include <cerrno>
 #include <cmath>
+#include <cstdint>
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
 #include <string>
+#include <thread>
 #include <utility>
 #include <vector>
 
 namespace {
 
+// 10^k is exactly representable in double for k <= 22.
+const double kPow10[23] = {1e0,  1e1,  1e2,  1e3,  1e4,  1e5,  1e6,  1e7,
+                           1e8,  1e9,  1e10, 1e11, 1e12, 1e13, 1e14, 1e15,
+                           1e16, 1e17, 1e18, 1e19, 1e20, 1e21, 1e22};
+
+// strtod on an explicit span (copied out so strtod cannot run past the
+// span, and so this stays thread-safe without touching the shared buffer).
+bool strtod_span(const char* begin, const char* end, double* out) {
+  char small[64];
+  std::string big;
+  const size_t len = static_cast<size_t>(end - begin);
+  const char* buf;
+  if (len < sizeof(small)) {
+    std::memcpy(small, begin, len);
+    small[len] = '\0';
+    buf = small;
+  } else {
+    big.assign(begin, end);
+    buf = big.c_str();
+  }
+  char* stop = nullptr;
+  errno = 0;
+  double v = std::strtod(buf, &stop);
+  if (stop != buf + len || errno == ERANGE) return false;
+  *out = v;
+  return true;
+}
+
 // Parse one span as a double; returns false if non-numeric. Empty -> NaN.
-// The span must sit inside a NUL-terminated buffer; strtod stops at the
-// first non-numeric char, and stop==end proves the whole span parsed.
+// Fast path: Clinger — exact for <= 15 significant digits and |e| <= 22;
+// everything else defers to strtod (bit-identical results either way).
 bool parse_span(const char* begin, const char* end, double* out) {
   while (begin < end && (*begin == ' ' || *begin == '\t')) ++begin;
   while (end > begin && (end[-1] == ' ' || end[-1] == '\t')) --end;
@@ -43,12 +83,126 @@ bool parse_span(const char* begin, const char* end, double* out) {
     *out = std::nan("");
     return true;
   }
-  char* stop = nullptr;
-  errno = 0;
-  double v = std::strtod(begin, &stop);
-  if (stop != end || errno == ERANGE) return false;
-  *out = v;
-  return true;
+  const char* c = begin;
+  bool neg = false;
+  if (*c == '+' || *c == '-') {
+    neg = (*c == '-');
+    ++c;
+  }
+  std::uint64_t mant = 0;
+  int digits = 0;  // digits folded into mant (incl. leading zeros: safe)
+  int frac = 0;
+  bool any = false;
+  for (; c < end && *c >= '0' && *c <= '9'; ++c) {
+    any = true;
+    if (digits >= 19) return strtod_span(begin, end, out);
+    mant = mant * 10 + static_cast<std::uint64_t>(*c - '0');
+    ++digits;
+  }
+  if (c < end && *c == '.') {
+    ++c;
+    for (; c < end && *c >= '0' && *c <= '9'; ++c) {
+      any = true;
+      if (digits >= 19) return strtod_span(begin, end, out);
+      mant = mant * 10 + static_cast<std::uint64_t>(*c - '0');
+      ++digits;
+      ++frac;
+    }
+  }
+  if (!any) return strtod_span(begin, end, out);  // inf/nan/hex/junk
+  int exp10 = 0;
+  bool eneg = false;
+  if (c < end && (*c == 'e' || *c == 'E')) {
+    ++c;
+    if (c < end && (*c == '+' || *c == '-')) {
+      eneg = (*c == '-');
+      ++c;
+    }
+    if (c == end) return false;  // "1e" is not a number (strtod agrees)
+    for (; c < end && *c >= '0' && *c <= '9'; ++c) {
+      exp10 = exp10 * 10 + (*c - '0');
+      if (exp10 > 9999) return strtod_span(begin, end, out);
+    }
+  }
+  if (c != end) return strtod_span(begin, end, out);  // trailing junk
+  const int e = (eneg ? -exp10 : exp10) - frac;
+  if (digits <= 15 && e >= -22 && e <= 22) {
+    double v = static_cast<double>(mant);
+    v = (e >= 0) ? v * kPow10[e] : v / kPow10[-e];
+    *out = neg ? -v : v;
+    return true;
+  }
+  return strtod_span(begin, end, out);
+}
+
+// Advance past one record separator (\r\n, \r, \n).
+inline const char* skip_sep(const char* p, const char* end) {
+  if (p < end) {
+    if (*p == '\r' && p + 1 < end && p[1] == '\n') return p + 2;
+    return p + 1;
+  }
+  return p;
+}
+
+struct ChunkResult {
+  std::vector<double> vals;  // row-major, rows * ncols
+  long long rows = 0;
+  bool err = false;
+};
+
+// Parse an unquoted byte range whose ncols is already known. Short rows
+// NaN-pad; wide rows or non-numeric fields set err (python fallback).
+void parse_chunk(const char* p, const char* chunk_end, char delim,
+                 size_t ncols, ChunkResult* out) {
+  std::vector<double>& values = out->vals;
+  // modest estimate (~8 bytes/field typical); geometric growth covers the
+  // rest — a worst-case reserve would commit ~4x the file size in address
+  // space and can bad_alloc under cgroup/ulimit caps
+  values.reserve(static_cast<size_t>((chunk_end - p) / 8) + ncols);
+  while (p < chunk_end) {
+    const char* rec_end = p;
+    while (rec_end < chunk_end && *rec_end != '\r' && *rec_end != '\n')
+      ++rec_end;
+    const char* next = skip_sep(rec_end, chunk_end);
+    const char* q = p;
+    while (q < rec_end && (*q == ' ' || *q == '\t')) ++q;
+    if (q == rec_end) {  // blank record
+      p = next;
+      continue;
+    }
+    size_t col = 0;
+    const char* field = p;
+    for (const char* c = p;; ++c) {
+      if (c == rec_end || *c == delim) {
+        double v;
+        if (col >= ncols || !parse_span(field, c, &v)) {
+          out->err = true;
+          return;
+        }
+        values.push_back(v);
+        ++col;
+        field = c + 1;
+        if (c == rec_end) break;
+      }
+    }
+    for (; col < ncols; ++col) values.push_back(std::nan(""));
+    ++out->rows;
+    p = next;
+  }
+}
+
+int thread_budget(size_t bytes) {
+  const char* env = std::getenv("DQCSV_THREADS");
+  long cap = 0;
+  if (env != nullptr) cap = std::strtol(env, nullptr, 10);
+  unsigned hw = std::thread::hardware_concurrency();
+  long t = cap > 0 ? cap : (hw > 0 ? static_cast<long>(hw) : 1);
+  if (t > 16) t = 16;
+  // below ~4 MB thread spawn + merge overhead beats the parse itself
+  if (bytes < (1u << 22)) t = 1;
+  long by_size = static_cast<long>(bytes / (1u << 20)) + 1;  // >=1MB/thread
+  if (t > by_size) t = by_size;
+  return static_cast<int>(t < 1 ? 1 : t);
 }
 
 }  // namespace
@@ -68,159 +222,270 @@ long long dq_parse_numeric_csv(const char* path, char delim, char quote,
   long size = std::ftell(f);
   std::fseek(f, 0, SEEK_SET);
   std::string text(static_cast<size_t>(size), '\0');
-  size_t got = size > 0 ? std::fread(&text[0], 1, static_cast<size_t>(size), f) : 0;
+  size_t got =
+      size > 0 ? std::fread(&text[0], 1, static_cast<size_t>(size), f) : 0;
   std::fclose(f);
-  text.resize(got);  // text.data() stays NUL-terminated (C++11 std::string)
+  text.resize(got);
 
-  // Row-major parse into a growing buffer; transpose at the end.
-  std::vector<double> values;
+  const char* const file_begin = text.data();
+  const char* const file_end = file_begin + text.size();
+  const bool has_quote =
+      std::memchr(file_begin, quote, text.size()) != nullptr;
+
+  // ---- parse into row-major `values` (+ per-chunk pieces when parallel) --
+  std::vector<double> values;  // serial path / parallel prologue
   size_t ncols = 0;
   long long nrows = 0;
-  bool first_record = true;
-  std::string rbuf;                              // reused cleaned-record buffer
-  std::vector<std::pair<size_t, size_t>> spans;  // (begin, end) into rbuf
+  std::vector<ChunkResult> chunks;
+  int nthreads = 1;  // also governs the transpose stage below
 
-  const char* p = text.data();
-  const char* const file_end = p + text.size();
-  while (p < file_end) {
-    // Phase A: find the record terminator (\r\n, \r, \n) with quote state —
-    // separators inside quoted fields are content, not terminators.
-    bool rec_has_quote = false;
-    const char* rec_end = p;
-    {
-      bool q = false;
-      while (rec_end < file_end) {
-        char ch = *rec_end;
-        if (q) {
-          if (ch == quote) {
-            if (rec_end + 1 < file_end && rec_end[1] == quote) ++rec_end;
-            else q = false;
-          }
-        } else if (ch == quote) {
-          q = true;
-          rec_has_quote = true;
-        } else if (ch == '\r' || ch == '\n') {
-          break;
-        }
+  if (!has_quote) {
+    // Quote-free: record separators are unambiguous, so the tail of the
+    // buffer parallelizes by chunks aligned to record boundaries.
+    // Prologue (serial): optional header skip + the first data record,
+    // which fixes ncols for every chunk.
+    const char* p = file_begin;
+    bool skipped_header = (skip_header == 0);
+    while (p < file_end && nrows == 0) {
+      const char* rec_end = p;
+      while (rec_end < file_end && *rec_end != '\r' && *rec_end != '\n')
         ++rec_end;
-      }
-    }
-    const char* next = rec_end;
-    if (next < file_end) {
-      if (*next == '\r' && next + 1 < file_end && next[1] == '\n') next += 2;
-      else next += 1;
-    }
-
-    // Blank / header skipping (a quoted record is never blank).
-    bool blank = false;
-    if (!rec_has_quote) {
+      const char* next = skip_sep(rec_end, file_end);
       const char* q = p;
       while (q < rec_end && (*q == ' ' || *q == '\t')) ++q;
-      blank = (q == rec_end);
-    }
-    bool skip = blank || (first_record && skip_header);
-    if (!blank) first_record = false;
-    if (skip) {
-      p = next;
-      continue;
-    }
-
-    size_t col = 0;
-    auto push_value = [&](double v) -> bool {
-      if (nrows == 0) {
-        values.push_back(v);
-        ++ncols;
-      } else {
-        if (col >= ncols) return false;  // ragged wide row -> python path
-        values.push_back(v);
+      if (q == rec_end) {  // blank
+        p = next;
+        continue;
       }
-      ++col;
-      return true;
-    };
-
-    if (!rec_has_quote) {
-      // Hot path: fields parse in place off the file buffer.
+      if (!skipped_header) {
+        skipped_header = true;
+        p = next;
+        continue;
+      }
       const char* field = p;
       for (const char* c = p;; ++c) {
         if (c == rec_end || *c == delim) {
           double v;
           if (!parse_span(field, c, &v)) return -1;
-          if (!push_value(v)) return -1;
+          values.push_back(v);
+          ++ncols;
           field = c + 1;
           if (c == rec_end) break;
         }
       }
-    } else {
-      // Quoted record: strip quotes into rbuf, fields NUL-separated so
-      // strtod can't run past a span into the next field.
-      rbuf.clear();
-      spans.clear();
-      size_t fstart = 0;
-      bool q = false;
-      for (const char* c = p;; ++c) {
-        if (c == rec_end) {
-          spans.emplace_back(fstart, rbuf.size());
-          break;
-        }
-        char ch = *c;
-        if (q) {
-          if (ch == quote) {
-            if (c + 1 < rec_end && c[1] == quote) {
-              rbuf.push_back(quote);
-              ++c;
-            } else {
-              q = false;
+      nrows = 1;
+      p = next;
+    }
+    if (nrows == 0 || ncols == 0) {
+      *out_ncols = 0;
+      return 0;
+    }
+    nthreads = thread_budget(static_cast<size_t>(file_end - p));
+    std::vector<const char*> bounds;  // nthreads+1 chunk edges
+    bounds.push_back(p);
+    const size_t tail = static_cast<size_t>(file_end - p);
+    for (int t = 1; t < nthreads; ++t) {
+      const char* b = p + tail * static_cast<size_t>(t) /
+                              static_cast<size_t>(nthreads);
+      if (b < bounds.back()) b = bounds.back();
+      while (b < file_end && *b != '\r' && *b != '\n') ++b;
+      b = skip_sep(b, file_end);
+      bounds.push_back(b);
+    }
+    bounds.push_back(file_end);
+    chunks.resize(bounds.size() - 1);
+    std::vector<std::thread> workers;
+    for (size_t t = 0; t + 1 < bounds.size(); ++t) {
+      workers.emplace_back(parse_chunk, bounds[t], bounds[t + 1], delim,
+                           ncols, &chunks[t]);
+    }
+    for (auto& w : workers) w.join();
+    for (const auto& c : chunks) {
+      if (c.err) return -1;
+      nrows += c.rows;
+    }
+  } else {
+    // Quoted general case: one serial pass with full quote state (the
+    // original algorithm, unchanged semantics).
+    bool first_record = true;
+    std::string rbuf;
+    std::vector<std::pair<size_t, size_t>> spans;
+    const char* p = file_begin;
+    while (p < file_end) {
+      bool rec_has_quote = false;
+      const char* rec_end = p;
+      {
+        bool q = false;
+        while (rec_end < file_end) {
+          char ch = *rec_end;
+          if (q) {
+            if (ch == quote) {
+              if (rec_end + 1 < file_end && rec_end[1] == quote)
+                ++rec_end;
+              else
+                q = false;
             }
+          } else if (ch == quote) {
+            q = true;
+            rec_has_quote = true;
+          } else if (ch == '\r' || ch == '\n') {
+            break;
+          }
+          ++rec_end;
+        }
+      }
+      const char* next = skip_sep(rec_end, file_end);
+
+      bool blank = false;
+      if (!rec_has_quote) {
+        const char* q = p;
+        while (q < rec_end && (*q == ' ' || *q == '\t')) ++q;
+        blank = (q == rec_end);
+      }
+      bool skip = blank || (first_record && skip_header);
+      if (!blank) first_record = false;
+      if (skip) {
+        p = next;
+        continue;
+      }
+
+      size_t col = 0;
+      auto push_value = [&](double v) -> bool {
+        if (nrows == 0) {
+          values.push_back(v);
+          ++ncols;
+        } else {
+          if (col >= ncols) return false;  // ragged wide row -> python
+          values.push_back(v);
+        }
+        ++col;
+        return true;
+      };
+
+      if (!rec_has_quote) {
+        const char* field = p;
+        for (const char* c = p;; ++c) {
+          if (c == rec_end || *c == delim) {
+            double v;
+            if (!parse_span(field, c, &v)) return -1;
+            if (!push_value(v)) return -1;
+            field = c + 1;
+            if (c == rec_end) break;
+          }
+        }
+      } else {
+        rbuf.clear();
+        spans.clear();
+        size_t fstart = 0;
+        bool q = false;
+        for (const char* c = p;; ++c) {
+          if (c == rec_end) {
+            spans.emplace_back(fstart, rbuf.size());
+            break;
+          }
+          char ch = *c;
+          if (q) {
+            if (ch == quote) {
+              if (c + 1 < rec_end && c[1] == quote) {
+                rbuf.push_back(quote);
+                ++c;
+              } else {
+                q = false;
+              }
+            } else {
+              rbuf.push_back(ch);
+            }
+          } else if (ch == quote) {
+            q = true;
+          } else if (ch == delim) {
+            // spans are parsed via copied-out buffers (strtod_span), so
+            // fields can sit back-to-back — no separator byte needed
+            spans.emplace_back(fstart, rbuf.size());
+            fstart = rbuf.size();
           } else {
             rbuf.push_back(ch);
           }
-        } else if (ch == quote) {
-          q = true;
-        } else if (ch == delim) {
-          spans.emplace_back(fstart, rbuf.size());
-          rbuf.push_back('\0');
-          fstart = rbuf.size();
-        } else {
-          rbuf.push_back(ch);
+        }
+        for (const auto& s : spans) {
+          double v;
+          if (!parse_span(rbuf.data() + s.first, rbuf.data() + s.second,
+                          &v))
+            return -1;
+          if (!push_value(v)) return -1;
         }
       }
-      for (const auto& s : spans) {
-        double v;
-        if (!parse_span(rbuf.data() + s.first, rbuf.data() + s.second, &v))
-          return -1;
-        if (!push_value(v)) return -1;
-      }
+      for (; col < ncols && nrows > 0; ++col)
+        values.push_back(std::nan(""));
+      ++nrows;
+      p = next;
     }
-    // Ragged short row: pad with NaN (python parser does the same).
-    for (; col < ncols && nrows > 0; ++col) values.push_back(std::nan(""));
-    ++nrows;
-    p = next;
+    if (nrows == 0 || ncols == 0) {
+      *out_ncols = 0;
+      return 0;
+    }
   }
 
-  if (nrows == 0 || ncols == 0) {
-    *out_ncols = 0;
-    return 0;
-  }
-
-  double* data = static_cast<double*>(std::malloc(sizeof(double) * ncols * nrows));
+  // ---- transpose row-major pieces into column-major + int flags ---------
+  double* data =
+      static_cast<double*>(std::malloc(sizeof(double) * ncols * nrows));
   char* int_flags = static_cast<char*>(std::malloc(ncols));
   if (data == nullptr || int_flags == nullptr) {
     std::free(data);
     std::free(int_flags);
     return -2;
   }
-  for (size_t j = 0; j < ncols; ++j) {
-    bool integral = true;
-    for (long long i = 0; i < nrows; ++i) {
-      double v = values[static_cast<size_t>(i) * ncols + j];
-      data[j * nrows + i] = v;  // column-major
-      if (std::isnan(v) || v != std::floor(v) ||
-          v < -2147483648.0 || v > 2147483647.0) {
-        integral = false;
+  std::memset(int_flags, 1, ncols);
+
+  // Each piece owns a disjoint row range -> transpose pieces in parallel,
+  // each with private integral flags, AND-combined after the join.
+  struct Piece {
+    const double* vals;
+    long long rows;
+    long long row0;
+  };
+  std::vector<Piece> pieces;
+  long long off = 0;
+  if (!values.empty()) {
+    const long long r = static_cast<long long>(values.size() / ncols);
+    pieces.push_back({values.data(), r, 0});
+    off = r;
+  }
+  for (const auto& c : chunks) {
+    if (c.rows > 0) {
+      pieces.push_back({c.vals.data(), c.rows, off});
+      off += c.rows;
+    }
+  }
+  std::vector<std::vector<char>> flags(pieces.size(),
+                                       std::vector<char>(ncols, 1));
+  auto transpose_piece = [&](size_t pi) {
+    const Piece& pc = pieces[pi];
+    std::vector<char>& fl = flags[pi];
+    for (long long i = 0; i < pc.rows; ++i) {
+      const double* row = pc.vals + static_cast<size_t>(i) * ncols;
+      for (size_t j = 0; j < ncols; ++j) {
+        const double v = row[j];
+        data[j * static_cast<size_t>(nrows) +
+             static_cast<size_t>(pc.row0 + i)] = v;
+        if (std::isnan(v) || v != std::floor(v) || v < -2147483648.0 ||
+            v > 2147483647.0) {
+          fl[j] = 0;
+        }
       }
     }
-    int_flags[j] = integral ? 1 : 0;
+  };
+  if (pieces.size() > 1 && nthreads > 1) {
+    std::vector<std::thread> workers;
+    for (size_t pi = 0; pi < pieces.size(); ++pi)
+      workers.emplace_back(transpose_piece, pi);
+    for (auto& w : workers) w.join();
+  } else {
+    for (size_t pi = 0; pi < pieces.size(); ++pi) transpose_piece(pi);
   }
+  for (size_t pi = 0; pi < pieces.size(); ++pi)
+    for (size_t j = 0; j < ncols; ++j)
+      if (!flags[pi][j]) int_flags[j] = 0;
+
   *out_data = data;
   *out_ncols = static_cast<long long>(ncols);
   *out_int_flags = int_flags;

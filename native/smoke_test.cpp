@@ -5,7 +5,8 @@
 #include <cstdlib>
 
 extern "C" {
-long long dq_parse_numeric_csv(const char*, char, int, double**, long long*, char**);
+long long dq_parse_numeric_csv(const char*, char, char, int, double**,
+                               long long*, char**);
 void dq_free(void*);
 }
 
@@ -17,7 +18,8 @@ int main(int argc, char** argv) {
   double* data = nullptr;
   long long ncols = 0;
   char* flags = nullptr;
-  long long nrows = dq_parse_numeric_csv(argv[1], ',', 0, &data, &ncols, &flags);
+  long long nrows =
+      dq_parse_numeric_csv(argv[1], ',', '"', 0, &data, &ncols, &flags);
   if (nrows < 0) {
     std::fprintf(stderr, "parse failed: %lld\n", nrows);
     return 1;

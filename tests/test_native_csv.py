@@ -62,6 +62,42 @@ class TestNativeParity:
         df = read_csv(str(p), engine="native")
         assert dict(df.dtypes())["_c0"] == "string"
 
+    def test_float_parse_bit_identical_fuzz(self, tmp_path):
+        """The Clinger fast path must be BIT-identical to Python's
+        correctly-rounded float() — across magnitudes, precisions, exponent
+        forms, and the fallback cases (>15 digits, huge exponents)."""
+        rng = np.random.default_rng(99)
+        vals = np.concatenate([
+            rng.uniform(-1e3, 1e3, 200),
+            rng.uniform(-1, 1, 200) * 10.0 ** rng.integers(-30, 30, 200),
+            np.asarray([0.0, -0.0, 1e-308, 1e308, 123456789012345678.0,
+                        0.1, 2.5, 1e22, 1e23, 1e-22, 1e-23]),
+        ])
+        # repr() gives shortest round-trip strings; also exercise fixed
+        # long-mantissa renderings (forces the strtod fallback)
+        lines = [repr(float(v)) for v in vals]
+        lines += [f"{v:.20f}" for v in vals[:50]]
+        path = tmp_path / "fuzz.csv"
+        path.write_text("\n".join(lines) + "\n")
+        nat = read_csv(str(path), engine="native")
+        py = read_csv(str(path), engine="python")
+        a = np.asarray(nat.to_pydict()["_c0"], np.float64)
+        b = np.asarray(py.to_pydict()["_c0"], np.float64)
+        assert a.shape == b.shape == (len(lines),)
+        # bit-identical, not just close
+        np.testing.assert_array_equal(a.view(np.int64), b.view(np.int64))
+
+    def test_exponent_and_sign_forms(self, tmp_path):
+        path = tmp_path / "forms.csv"
+        path.write_text("1e3,+2.5,-0.125,3E-2\n"
+                        "0001.5000,.5,5.,1e+0\n")
+        nat = read_csv(str(path), engine="native")
+        py = read_csv(str(path), engine="python")
+        for col in py.columns:
+            np.testing.assert_array_equal(
+                np.asarray(nat.to_pydict()[col], np.float64),
+                np.asarray(py.to_pydict()[col], np.float64))
+
     def test_missing_file(self):
         with pytest.raises(FileNotFoundError):
             read_csv("/nonexistent-file.csv", engine="native")
