@@ -193,10 +193,14 @@ void parse_chunk(const char* p, const char* chunk_end, char delim,
 
 int thread_budget(size_t bytes) {
   const char* env = std::getenv("DQCSV_THREADS");
-  long cap = 0;
-  if (env != nullptr) cap = std::strtol(env, nullptr, 10);
+  if (env != nullptr) {
+    // An explicit count is honored verbatim (capped at 16) even on tiny
+    // files — this is how the test suite reaches the parallel path.
+    long cap = std::strtol(env, nullptr, 10);
+    if (cap >= 1) return static_cast<int>(cap > 16 ? 16 : cap);
+  }
   unsigned hw = std::thread::hardware_concurrency();
-  long t = cap > 0 ? cap : (hw > 0 ? static_cast<long>(hw) : 1);
+  long t = hw > 0 ? static_cast<long>(hw) : 1;
   if (t > 16) t = 16;
   // below ~4 MB thread spawn + merge overhead beats the parse itself
   if (bytes < (1u << 22)) t = 1;

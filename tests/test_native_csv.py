@@ -98,6 +98,47 @@ class TestNativeParity:
                 np.asarray(nat.to_pydict()[col], np.float64),
                 np.asarray(py.to_pydict()[col], np.float64))
 
+    def test_parallel_chunk_path_matches_serial(self, tmp_path,
+                                                monkeypatch):
+        """DQCSV_THREADS forces the multi-chunk parse + parallel transpose
+        even on a small file — chunk alignment, row0 offsets, short-row
+        NaN padding, blank lines, and int flags must all match serial."""
+        rng = np.random.default_rng(17)
+        lines = []
+        for i in range(997):   # odd count so chunks split unevenly
+            if i % 101 == 0:
+                lines.append("")                       # blank record
+            if i % 97 == 0:
+                lines.append(f"{i}")                   # short row -> NaN pad
+            else:
+                lines.append(f"{i},{rng.uniform(-5, 5):.6f},{i * 2}")
+        path = tmp_path / "par.csv"
+        path.write_text("\r\n".join(lines) + "\r\n")   # CRLF separators
+        monkeypatch.delenv("DQCSV_THREADS", raising=False)
+        serial = read_csv(str(path), engine="native")
+        monkeypatch.setenv("DQCSV_THREADS", "5")
+        par = read_csv(str(path), engine="native")
+        assert par.count() == serial.count()
+        assert par.columns == serial.columns
+        assert dict(par.dtypes()) == dict(serial.dtypes())
+        for col in serial.columns:
+            np.testing.assert_array_equal(
+                np.asarray(par.to_pydict()[col], np.float64),
+                np.asarray(serial.to_pydict()[col], np.float64))
+
+    def test_parallel_wide_row_rejected_in_any_chunk(self, tmp_path,
+                                                     monkeypatch):
+        lines = [f"{i},{i}" for i in range(300)]
+        lines[250] = "1,2,3"                           # wide row, late chunk
+        path = tmp_path / "wide.csv"
+        path.write_text("\n".join(lines) + "\n")
+        monkeypatch.setenv("DQCSV_THREADS", "4")
+        # wide rows are a python-engine case: the native parser must
+        # signal fallback (None), not mis-parse, from a worker chunk too
+        assert native_csv.try_read_csv(str(path), header=False,
+                                       infer_schema=True,
+                                       delimiter=",") is None
+
     def test_missing_file(self):
         with pytest.raises(FileNotFoundError):
             read_csv("/nonexistent-file.csv", engine="native")
