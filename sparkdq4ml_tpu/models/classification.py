@@ -212,26 +212,25 @@ def _logistic_newton_core(X, y, mask, reg_param, alpha, n, std,
     m = d + 1
 
     def stats(wb):
-        """loss, gradient, Hessian at wb — one fused (psum'd) pass."""
+        """Gradient + Hessian at wb — one fused (psum'd) pass. (The loss
+        is NOT computed here: the driver reads objectives only through
+        ``batched_objective``, so packing a loss scalar would be dead
+        O(n) work the psum forbids XLA from eliminating.)"""
         margin = Za @ wb
-        z = (2.0 * yv - wm) * margin
-        ll = wv * jnp.logaddexp(0.0, -z)
         p = jax.nn.sigmoid(margin)
         resid = (p - yv) * wv
         g = Za.T @ resid                                   # (m,)
         s = wv * p * (1.0 - p)
         H = (Za * s[:, None]).T @ Za                       # (m, m)
-        packed = reduce_(jnp.concatenate(
-            [H.ravel(), g, jnp.sum(ll)[None]]))
+        packed = reduce_(jnp.concatenate([H.ravel(), g]))
         H = packed[:m * m].reshape(m, m) / n
-        g = packed[m * m:m * m + m] / n
-        loss = packed[-1] / n
+        g = packed[m * m:] / n
         g = g + lam2_full * wb
         H = H + jnp.diag(lam2_full)
         g = jnp.where(valid_full, g, 0.0)
         H = jnp.where(valid_full[:, None] & valid_full[None, :], H,
                       jnp.eye(m, dtype=dt))
-        return loss, g, H
+        return g, H
 
     def batched_objective(C):
         """Objectives of a (4, m) candidate stack in one fused pass."""
@@ -241,18 +240,38 @@ def _logistic_newton_core(X, y, mask, reg_param, alpha, n, std,
         ll = reduce_(ll) / n
         return ll + 0.5 * jnp.sum(lam2_full[None, :] * C * C, axis=1)
 
-    wb0 = jnp.zeros((m,), dt)
+    wb, ok, iters, history = _newton_drive(stats, batched_objective, m,
+                                           valid_full, dt, max_iter, tol)
+    coef = jnp.where(valid, wb[:d] / sx, 0.0)
+    intercept = wb[d]
+    return LogisticFitResult(coef, intercept, iters, history, ok)
+
+
+def _newton_drive(stats, batched_objective, M, valid_full, dt,
+                  max_iter, tol):
+    """Shared damped-Newton driver (binary + softmax cores): jittered
+    Hessian solve, batched {1, ½, ¼, ⅛}·δ line search, convergence latch,
+    and objective-history bookkeeping — in ONE place so the two solvers'
+    convergence behavior stays identical by construction.
+
+    ``stats(wb) -> (g, H)`` must be the regularized gradient/Hessian pass;
+    ``batched_objective(C)`` the objectives of a (c, M) candidate stack.
+
+    while_loop, not scan: each Newton iteration is HEAVY (Gramian Hessian
+    + solve + batched line search), so converged fits must stop computing
+    — a scan with a done-latch would burn the full max_iter budget of
+    Hessians to freeze the result. History is written into a preallocated
+    buffer; the unfilled tail is pinned to the final objective after the
+    loop (same decode contract as FISTA's scan).
+
+    Returns ``(wb, converged, iterations, history)`` with ``history`` of
+    length ``max_iter + 1`` (entry 0 = objective at zero).
+    """
+    wb0 = jnp.zeros((M,), dt)
     # matvec-width pass only — stats(wb0) would psum a full discarded
     # Hessian just to read this scalar
     obj0 = batched_objective(wb0[None, :])[0]
     steps = jnp.asarray([1.0, 0.5, 0.25, 0.125], dt)
-
-    # while_loop, not scan: each Newton iteration is HEAVY (Gramian
-    # Hessian + solve + batched line search), so converged fits must stop
-    # computing — a scan with a done-latch would burn the full max_iter
-    # budget of Hessians to freeze the result. History is written into a
-    # preallocated buffer; the unfilled tail is pinned to the final
-    # objective after the loop (same decode contract as FISTA's scan).
     hist0 = jnp.full((max_iter + 1,), obj0, dt)
 
     def cond(state):
@@ -261,12 +280,12 @@ def _logistic_newton_core(X, y, mask, reg_param, alpha, n, std,
 
     def body(state):
         wb, _, _, iters, last_obj, hist = state
-        _, g, H = stats(wb)
+        g, H = stats(wb)
         # scaled jitter keeps the solve finite when H is near-singular
         jitter = jnp.asarray(1e-9, dt) * (1.0 + jnp.max(jnp.abs(jnp.diag(H))))
-        delta = jnp.linalg.solve(H + jitter * jnp.eye(m, dtype=dt), g)
+        delta = jnp.linalg.solve(H + jitter * jnp.eye(M, dtype=dt), g)
         delta = jnp.where(valid_full, delta, 0.0)
-        C = wb[None, :] - steps[:, None] * delta[None, :]  # (4, m)
+        C = wb[None, :] - steps[:, None] * delta[None, :]  # (4, M)
         objs = batched_objective(C)
         objs = jnp.where(jnp.isfinite(objs), objs, jnp.inf)
         improving = objs < last_obj
@@ -292,10 +311,8 @@ def _logistic_newton_core(X, y, mask, reg_param, alpha, n, std,
     init = (wb0, jnp.asarray(False), jnp.asarray(False),
             jnp.asarray(0, jnp.int32), obj0, hist0)
     wb, _, ok, iters, last_obj, hist = jax.lax.while_loop(cond, body, init)
-    coef = jnp.where(valid, wb[:d] / sx, 0.0)
-    intercept = wb[d]
     history = jnp.where(jnp.arange(max_iter + 1) <= iters, hist, last_obj)
-    return LogisticFitResult(coef, intercept, iters, history, ok)
+    return wb, ok, iters, history
 
 
 class SoftmaxFitResult(NamedTuple):
@@ -403,6 +420,95 @@ def _softmax_core(X, y, mask, reg_param, alpha, n, std, num_classes,
     b = wb[m:]
     history = jnp.concatenate([obj0[None], history])
     return SoftmaxFitResult(W, b, iters, history, done)
+
+
+def _softmax_newton_core(X, y, mask, reg_param, alpha, n, std, num_classes,
+                         max_iter, tol, fit_intercept, standardization,
+                         axis=None, weights=None):
+    """Damped Newton (IRLS) on mean softmax cross-entropy — the L1-free
+    multinomial fast path (see ``_logistic_newton_core`` for the design;
+    this is its K-class generalization).
+
+    The softmax Hessian couples classes: block (k,l) is
+    ``Σ_n s_nkl · za_n za_nᵀ`` with ``s_nkl = w_n (p_nk δ_kl − p_nk p_nl)``
+    — built in ONE einsum over the batch (MXU-shaped contraction), psum'd
+    once per iteration together with the gradient. The full
+    ``(K(d+1))²`` system solves on device; the router caps ``K(d+1)`` so
+    the solve stays trivial next to the data pass. For unpenalized fits
+    the shift degeneracy (softmax invariance) makes H singular along the
+    all-classes-shift direction — the scaled jitter handles it, and the
+    caller's identifiability pivot (MLlib centering) fixes the gauge.
+    """
+    del alpha  # L1-free by construction (router guarantees it)
+    dt = X.dtype
+    d = X.shape[1]
+    K = num_classes
+    valid = std > 0
+    sx = jnp.where(valid, std, 1.0)
+    wm = mask.astype(dt)
+    Xs = (X / sx) * wm[:, None]
+    wv = wm if weights is None else weights.astype(dt)
+    Y1 = jax.nn.one_hot(y.astype(jnp.int32), K, dtype=dt) * wm[:, None]
+    Za = jnp.concatenate([Xs, wm[:, None]], axis=1)      # (n, d+1)
+
+    u1 = jnp.ones((d,), dt) if standardization \
+        else jnp.where(valid, 1.0 / sx, 0.0)
+    lam2 = reg_param * (u1 if standardization else u1 * u1)    # (d,)
+    # wb layout: (K, d+1) ravelled — [W | b] per class row
+    lam2_row = jnp.concatenate([lam2, jnp.zeros((1,), dt)])    # (d+1,)
+    lam2_full = jnp.tile(lam2_row, K)
+    valid_row = jnp.concatenate([valid,
+                                 jnp.full((1,), bool(fit_intercept))])
+    valid_full = jnp.tile(valid_row, K)
+    M = K * (d + 1)
+
+    def reduce_(v):
+        return jax.lax.psum(v, axis) if axis is not None else v
+
+    def margins_of(Wb):
+        """(n, K) margins for a (K, d+1) coefficient block."""
+        return Za @ Wb.T
+
+    def stats(wb):
+        """Gradient + block Hessian at wb — one fused (psum'd) pass (the
+        loss lives only in ``batched_objective``; see the binary core)."""
+        Wb = wb.reshape(K, d + 1)
+        margin = margins_of(Wb)
+        p = jax.nn.softmax(margin, axis=1)
+        resid = (p - Y1) * wv[:, None]                     # (n, K)
+        g = (resid.T @ Za).ravel()                         # (K(d+1),)
+        # block Hessian: S_nkl = wv_n (p_nk δ_kl − p_nk p_nl)
+        S = wv[:, None, None] * (
+            jnp.einsum("nk,kl->nkl", p, jnp.eye(K, dtype=dt))
+            - p[:, :, None] * p[:, None, :])               # (n, K, K)
+        H = jnp.einsum("nkl,ni,nj->kilj", S, Za, Za).reshape(M, M)
+        packed = reduce_(jnp.concatenate([H.ravel(), g]))
+        H = packed[:M * M].reshape(M, M) / n
+        g = packed[M * M:] / n
+        g = g + lam2_full * wb
+        H = H + jnp.diag(lam2_full)
+        g = jnp.where(valid_full, g, 0.0)
+        H = jnp.where(valid_full[:, None] & valid_full[None, :], H,
+                      jnp.eye(M, dtype=dt))
+        return g, H
+
+    def batched_objective(C):
+        """(c,) objectives of a (c, M) candidate stack in one fused pass."""
+        Wc = C.reshape(-1, K, d + 1)
+        margins = jnp.einsum("nj,ckj->nck", Za, Wc)        # (n, c, K)
+        lse = jax.nn.logsumexp(margins, axis=2)            # (n, c)
+        fitted = jnp.einsum("nck,nk->nc", margins, Y1)
+        ll = jnp.sum(wv[:, None] * jnp.where(mask[:, None],
+                                             lse - fitted, 0.0), axis=0)
+        ll = reduce_(ll) / n
+        return ll + 0.5 * jnp.sum(lam2_full[None, :] * C * C, axis=1)
+
+    wb, ok, iters, history = _newton_drive(stats, batched_objective, M,
+                                           valid_full, dt, max_iter, tol)
+    Wb = wb.reshape(K, d + 1)
+    W = jnp.where(valid[None, :], Wb[:, :d] / sx[None, :], 0.0)
+    b = Wb[:, d]
+    return SoftmaxFitResult(W, b, iters, history, ok)
 
 
 def _unpack_z(Z):
@@ -636,10 +742,14 @@ def unpack_softmax_result(flat, num_classes: int, d: int):
 def fused_softmax_fit_packed(mesh: Optional[Mesh], num_classes: int,
                              max_iter: int, tol: float,
                              fit_intercept: bool, standardization: bool,
-                             weighted: bool = False):
+                             weighted: bool = False,
+                             solver: str = "fista"):
     """Multinomial analogue of ``fused_logistic_fit_packed`` — same
     single-input/single-output dispatch discipline and per-iteration psum
-    (and the same ``weighted`` contract)."""
+    (and the same ``weighted`` / ``solver`` contracts; "newton" is the
+    L1-free block-Hessian IRLS, see ``_softmax_newton_core``)."""
+    core = {"fista": _softmax_core,
+            "newton": _softmax_newton_core}[solver]
 
     def split(Z):
         if weighted:
@@ -651,14 +761,14 @@ def fused_softmax_fit_packed(mesh: Optional[Mesh], num_classes: int,
         def fit(Z, hyper):
             X, y, mask, w = split(Z)
             n, std = _feature_stats(X, y, mask if w is None else w)
-            return _pack_softmax_result(_softmax_core(
+            return _pack_softmax_result(core(
                 X, y, mask, hyper[0], hyper[1], n, std, num_classes,
                 max_iter, tol, fit_intercept, standardization, weights=w))
     else:
         def local(Z, hyper):
             X, y, mask, w = split(Z)
             n, std = _sharded_feature_stats(X, mask if w is None else w)
-            return _pack_softmax_result(_softmax_core(
+            return _pack_softmax_result(core(
                 X, y, mask, hyper[0], hyper[1], n, std, num_classes,
                 max_iter, tol, fit_intercept, standardization,
                 axis=DATA_AXIS, weights=w))
@@ -803,10 +913,19 @@ class LogisticRegression(Estimator):
 
         if family == "multinomial":
             K = max(num_classes, 2)
+            # Same routing as the binary path: L1-free penalties take the
+            # block-Hessian Newton solver; the K(d+1) cap keeps the
+            # on-device solve trivial next to the per-iteration data pass.
+            l1_free = (self.elastic_net_param == 0.0
+                       or self.reg_param == 0.0)
+            sm_solver = "newton" if (l1_free
+                                     and K * (X.shape[1] + 1) <= 256) \
+                else "fista"
             fit_fn = fused_softmax_fit_packed(mesh, K, self.max_iter,
                                               self.tol, self.fit_intercept,
                                               self.standardization,
-                                              weighted=weighted)
+                                              weighted=weighted,
+                                              solver=sm_solver)
             result = unpack_softmax_result(fit_fn(Zd, hyper), K, X.shape[1])
             W = np.asarray(result.coefficient_matrix, np.float64)
             b = np.asarray(result.intercept_vector, np.float64)
