@@ -6,7 +6,7 @@ estimator surface its LinearRegression exercises at
 
 TPU-first fit path: unlike the linear case (one Gramian suffices —
 solvers.py), logistic loss needs per-iteration data passes. The whole FISTA
-loop therefore runs inside ONE jitted ``lax.scan`` over the row-sharded data:
+loop therefore runs inside ONE jitted ``lax.while_loop`` over the row-sharded data:
 each iteration computes the local masked gradient and reduces the ``(d+2)``
 gradient/loss vector with a single ``psum`` over the mesh — this is the true
 per-iteration ``treeAggregate`` analogue (SURVEY.md §3.3), with the
@@ -133,37 +133,15 @@ def _logistic_core(X, y, mask, reg_param, alpha, n, std,
         w = wb[:d]
         return loss + jnp.sum(lam1 * jnp.abs(w)) + 0.5 * jnp.sum(lam2 * w * w)
 
-    wb0 = jnp.zeros((d + 1,), dt)
-    loss0, _ = loss_grad(wb0)
-    obj0 = objective(wb0, loss0)
-
-    def body(state, _):
-        wb, wb_prev, t, done, iters, last_obj = state
-        tn = 0.5 * (1.0 + jnp.sqrt(1.0 + 4.0 * t * t))
-        v = wb + ((t - 1.0) / tn) * (wb - wb_prev)
-        loss_v, grad = loss_grad(v)
-        cand = v - step * grad
+    def prox(cand):
         w_new = jnp.where(valid, _soft(cand[:d], step * lam1), 0.0)
         b_new = jnp.where(fit_intercept, cand[d], 0.0)
-        wb_new = jnp.concatenate([w_new, b_new[None]])
-        loss_new, _ = loss_grad(wb_new)
-        obj = objective(wb_new, loss_new)
-        rel = jnp.abs(obj - last_obj) / jnp.maximum(jnp.abs(last_obj), 1e-12)
-        now_done = jnp.logical_or(done, rel < tol)
-        wb_out = jnp.where(done, wb, wb_new)
-        wb_prev_out = jnp.where(done, wb_prev, wb)
-        t_out = jnp.where(done, t, tn)
-        obj_out = jnp.where(done, last_obj, obj)
-        iters_out = iters + jnp.where(done, 0, 1).astype(jnp.int32)
-        return (wb_out, wb_prev_out, t_out, now_done, iters_out, obj_out), obj_out
+        return jnp.concatenate([w_new, b_new[None]])
 
-    init = (wb0, wb0, jnp.asarray(1.0, dt), jnp.asarray(False),
-            jnp.asarray(0, jnp.int32), obj0)
-    (wb, _, _, done, iters, _), history = jax.lax.scan(body, init, None,
-                                                       length=max_iter)
+    wb, done, iters, history = _fista_drive(loss_grad, objective, prox,
+                                            step, d + 1, dt, max_iter, tol)
     coef = jnp.where(valid, wb[:d] / sx, 0.0)   # unscale to raw features
     intercept = wb[d]
-    history = jnp.concatenate([obj0[None], history])
     return LogisticFitResult(coef, intercept, iters, history, done)
 
 
@@ -247,6 +225,54 @@ def _logistic_newton_core(X, y, mask, reg_param, alpha, n, std,
     return LogisticFitResult(coef, intercept, iters, history, ok)
 
 
+def _fista_drive(loss_grad, objective, prox, step, M, dt, max_iter, tol):
+    """Shared Nesterov/FISTA driver (binary + softmax + SVC cores):
+    momentum extrapolation, gradient-prox step, convergence latch, and
+    objective-history bookkeeping in ONE place.
+
+    ``loss_grad(wb) -> (loss, grad)`` is the (psum'd) smooth pass;
+    ``objective(wb, loss)`` adds the nonsmooth/ridge terms;
+    ``prox(cand) -> wb`` applies the proximal map + validity masking.
+
+    while_loop, not scan: each iteration is two O(n·d) data passes, so a
+    fit that converges at iteration k must stop paying for the remaining
+    ``max_iter − k`` passes (a scan with a done-latch keeps computing
+    them just to freeze the carry). History tail is pinned to the final
+    objective after the loop — same decode contract as before.
+
+    Returns ``(wb, converged, iterations, history)`` with ``history`` of
+    length ``max_iter + 1`` (entry 0 = objective at zero).
+    """
+    wb0 = jnp.zeros((M,), dt)
+    loss0, _ = loss_grad(wb0)
+    obj0 = objective(wb0, loss0)
+    hist0 = jnp.full((max_iter + 1,), obj0, dt)
+
+    def cond(state):
+        _, _, _, done, iters, _, _ = state
+        return jnp.logical_and(iters < max_iter, ~done)
+
+    def body(state):
+        wb, wb_prev, t, _, iters, last_obj, hist = state
+        tn = 0.5 * (1.0 + jnp.sqrt(1.0 + 4.0 * t * t))
+        v = wb + ((t - 1.0) / tn) * (wb - wb_prev)
+        _, grad = loss_grad(v)
+        wb_new = prox(v - step * grad)
+        loss_new, _ = loss_grad(wb_new)
+        obj = objective(wb_new, loss_new)
+        rel = jnp.abs(obj - last_obj) / jnp.maximum(jnp.abs(last_obj), 1e-12)
+        done = rel < tol
+        hist = hist.at[iters + 1].set(obj)
+        return (wb_new, wb, tn, done, iters + 1, obj, hist)
+
+    init = (wb0, wb0, jnp.asarray(1.0, dt), jnp.asarray(False),
+            jnp.asarray(0, jnp.int32), obj0, hist0)
+    wb, _, _, done, iters, last_obj, hist = jax.lax.while_loop(
+        cond, body, init)
+    history = jnp.where(jnp.arange(max_iter + 1) <= iters, hist, last_obj)
+    return wb, done, iters, history
+
+
 def _newton_drive(stats, batched_objective, M, valid_full, dt,
                   max_iter, tol):
     """Shared damped-Newton driver (binary + softmax cores): jittered
@@ -281,8 +307,12 @@ def _newton_drive(stats, batched_objective, M, valid_full, dt,
     def body(state):
         wb, _, _, iters, last_obj, hist = state
         g, H = stats(wb)
-        # scaled jitter keeps the solve finite when H is near-singular
-        jitter = jnp.asarray(1e-9, dt) * (1.0 + jnp.max(jnp.abs(jnp.diag(H))))
+        # Scaled jitter keeps the solve usable when H is near-singular
+        # (e.g. the unpenalized-softmax shift degeneracy). Scale by the
+        # dtype's eps: an absolute 1e-9 is BELOW half-ulp of a float32
+        # diagonal (~1e-8 at O(1) entries) and would be bit-for-bit inert.
+        jitter = 100.0 * jnp.asarray(jnp.finfo(dt).eps, dt) * \
+            (1.0 + jnp.max(jnp.abs(jnp.diag(H))))
         delta = jnp.linalg.solve(H + jitter * jnp.eye(M, dtype=dt), g)
         delta = jnp.where(valid_full, delta, 0.0)
         C = wb[None, :] - steps[:, None] * delta[None, :]  # (4, M)
@@ -331,9 +361,10 @@ def _softmax_core(X, y, mask, reg_param, alpha, n, std, num_classes,
     MLlib ``family="multinomial"`` conventions: features scaled by sample
     std without centering; the (K, d) coefficient matrix penalized
     elementwise with the same elastic-net weights as the binary path; the
-    K intercepts unpenalized. The whole loop is one ``lax.scan`` with a
-    single fused ``(K·d + K + 1)`` psum per iteration when sharded — the
-    per-iteration ``treeAggregate`` analogue, exactly like the binary path.
+    K intercepts unpenalized. The whole loop is one ``lax.while_loop``
+    (shared ``_fista_drive``) with a single fused ``(K·d + K + 1)`` psum
+    per iteration when sharded — the per-iteration ``treeAggregate``
+    analogue, exactly like the binary path.
     """
     dt = X.dtype
     d = X.shape[1]
@@ -385,40 +416,17 @@ def _softmax_core(X, y, mask, reg_param, alpha, n, std, num_classes,
         return (loss + jnp.sum(lam1[None, :] * jnp.abs(W))
                 + 0.5 * jnp.sum(lam2[None, :] * W * W))
 
-    wb0 = jnp.zeros((m + K,), dt)
-    loss0, _ = loss_grad(wb0)
-    obj0 = objective(wb0, loss0)
-
     lam1_full = jnp.concatenate([jnp.tile(lam1, K), jnp.zeros((K,), dt)])
     valid_full = jnp.concatenate([jnp.tile(valid, K),
                                   jnp.full((K,), fit_intercept)])
 
-    def body(state, _):
-        wb, wb_prev, t, done, iters, last_obj = state
-        tn = 0.5 * (1.0 + jnp.sqrt(1.0 + 4.0 * t * t))
-        v = wb + ((t - 1.0) / tn) * (wb - wb_prev)
-        _, grad = loss_grad(v)
-        cand = v - step * grad
-        wb_new = jnp.where(valid_full, _soft(cand, step * lam1_full), 0.0)
-        loss_new, _ = loss_grad(wb_new)
-        obj = objective(wb_new, loss_new)
-        rel = jnp.abs(obj - last_obj) / jnp.maximum(jnp.abs(last_obj), 1e-12)
-        now_done = jnp.logical_or(done, rel < tol)
-        wb_out = jnp.where(done, wb, wb_new)
-        wb_prev_out = jnp.where(done, wb_prev, wb)
-        t_out = jnp.where(done, t, tn)
-        obj_out = jnp.where(done, last_obj, obj)
-        iters_out = iters + jnp.where(done, 0, 1).astype(jnp.int32)
-        return (wb_out, wb_prev_out, t_out, now_done, iters_out,
-                obj_out), obj_out
+    def prox(cand):
+        return jnp.where(valid_full, _soft(cand, step * lam1_full), 0.0)
 
-    init = (wb0, wb0, jnp.asarray(1.0, dt), jnp.asarray(False),
-            jnp.asarray(0, jnp.int32), obj0)
-    (wb, _, _, done, iters, _), history = jax.lax.scan(body, init, None,
-                                                       length=max_iter)
+    wb, done, iters, history = _fista_drive(loss_grad, objective, prox,
+                                            step, m + K, dt, max_iter, tol)
     W = jnp.where(valid[None, :], wb[:m].reshape(K, d) / sx[None, :], 0.0)
     b = wb[m:]
-    history = jnp.concatenate([obj0[None], history])
     return SoftmaxFitResult(W, b, iters, history, done)
 
 
@@ -604,7 +612,7 @@ def _svc_core(X, y, mask, reg_param, n, std, max_iter, tol,
 
     MLlib minimizes the (subdifferentiable) hinge with OWLQN; the squared
     hinge is its smooth relative (sklearn's ``LinearSVC`` default), which
-    maps onto the same zero-host-sync Nesterov ``lax.scan`` as the
+    maps onto the same zero-host-sync Nesterov ``lax.while_loop`` as the
     logistic path — one fused (d+2) psum per iteration when sharded.
     Decision boundaries agree with the hinge solution to test tolerance
     (asserted vs sklearn); conventions (std scaling without centering,
@@ -652,37 +660,14 @@ def _svc_core(X, y, mask, reg_param, n, std, max_iter, tol,
         w = wb[:d]
         return loss + 0.5 * jnp.sum(lam2 * w * w)
 
-    wb0 = jnp.zeros((d + 1,), dt)
-    loss0, _ = loss_grad(wb0)
-    obj0 = objective(wb0, loss0)
-
-    def body(state, _):
-        wb, wb_prev, t, done, iters, last_obj = state
-        tn = 0.5 * (1.0 + jnp.sqrt(1.0 + 4.0 * t * t))
-        v = wb + ((t - 1.0) / tn) * (wb - wb_prev)
-        _, grad = loss_grad(v)
-        cand = v - step * grad
-        wb_new = jnp.concatenate(
+    def prox(cand):
+        return jnp.concatenate(
             [jnp.where(valid, cand[:d], 0.0),
              jnp.where(fit_intercept, cand[d], 0.0)[None]])
-        loss_new, _ = loss_grad(wb_new)
-        obj = objective(wb_new, loss_new)
-        rel = jnp.abs(obj - last_obj) / jnp.maximum(jnp.abs(last_obj), 1e-12)
-        now_done = jnp.logical_or(done, rel < tol)
-        wb_out = jnp.where(done, wb, wb_new)
-        wb_prev_out = jnp.where(done, wb_prev, wb)
-        t_out = jnp.where(done, t, tn)
-        obj_out = jnp.where(done, last_obj, obj)
-        iters_out = iters + jnp.where(done, 0, 1).astype(jnp.int32)
-        return (wb_out, wb_prev_out, t_out, now_done, iters_out,
-                obj_out), obj_out
 
-    init = (wb0, wb0, jnp.asarray(1.0, dt), jnp.asarray(False),
-            jnp.asarray(0, jnp.int32), obj0)
-    (wb, _, _, done, iters, _), history = jax.lax.scan(body, init, None,
-                                                       length=max_iter)
+    wb, done, iters, history = _fista_drive(loss_grad, objective, prox,
+                                            step, d + 1, dt, max_iter, tol)
     coef = jnp.where(valid, wb[:d] / sx, 0.0)
-    history = jnp.concatenate([obj0[None], history])
     return LogisticFitResult(coef, wb[d], iters, history, done)
 
 
