@@ -11,6 +11,7 @@ session is discovering devices and building a ``jax.sharding.Mesh``.
 from __future__ import annotations
 
 import logging
+import os
 from typing import Optional
 
 import jax
@@ -25,6 +26,44 @@ from .sql.parser import execute as _sql_execute
 logger = logging.getLogger("sparkdq4ml_tpu.session")
 
 _ACTIVE: Optional["TpuSession"] = None
+
+
+def host_cache_tag() -> str:
+    """Short fingerprint of this host's CPU feature set, used to key the
+    persistent XLA cache dir (x86 exposes a ``flags`` line in
+    /proc/cpuinfo, ARM a ``Features`` line; fall back to the processor
+    string where neither exists)."""
+    import hashlib
+    import platform
+
+    try:
+        with open("/proc/cpuinfo") as f:
+            feat = next((ln for ln in f
+                         if ln.startswith(("flags", "Features"))), "")
+    except OSError:
+        feat = platform.processor()
+    return hashlib.sha1(
+        (platform.machine() + feat).encode()).hexdigest()[:8]
+
+
+def _prune_stale_cache_dirs(base: str, keep: str,
+                            max_age_days: float = 30.0) -> None:
+    """Best-effort cleanup of orphaned host-tag cache dirs (a kernel or VM
+    migration that changes one cpuinfo flag re-keys the dir; the old ones
+    would otherwise accumulate forever). Only dirs matching our own
+    ``xla*`` naming under ``base`` are touched, and only when untouched
+    for ``max_age_days``."""
+    import glob
+    import shutil
+    import time
+
+    cutoff = time.time() - max_age_days * 86400.0
+    try:
+        for p in glob.glob(os.path.join(base, "xla*")):
+            if p != keep and os.path.isdir(p) and os.path.getmtime(p) < cutoff:
+                shutil.rmtree(p, ignore_errors=True)
+    except Exception:
+        pass
 
 
 class TpuSession:
@@ -181,15 +220,45 @@ class TpuSession:
             except Exception as e:
                 logger.debug("compilation cache opt-out: %s", e)
             return
-        default_dir = os.path.join(
-            os.path.expanduser("~"), ".cache", "sparkdq4ml_tpu", "xla")
+        # Key the default dir by a host fingerprint: XLA:CPU caches AOT
+        # results with the COMPILE machine's feature set, and loading them
+        # on a different host spams feature-mismatch warnings (and risks
+        # SIGILL). A per-host dir keeps entries where they are valid.
+        # SPARKDQ4ML_CACHE_DIR overrides (the test suite uses it so test
+        # kernels never land in the production cache).
+        base = os.path.join(os.path.expanduser("~"), ".cache",
+                            "sparkdq4ml_tpu")
+        env_dir = os.environ.get("SPARKDQ4ML_CACHE_DIR")
+        default_dir = env_dir or os.path.join(
+            base, f"xla-{host_cache_tag()}")
         cache_dir = self.conf.get("spark.compilation.cacheDir", default_dir)
+        if cache_dir == default_dir and not env_dir:
+            _prune_stale_cache_dirs(base, keep=default_dir)
         try:
             os.makedirs(cache_dir, exist_ok=True)
             jax.config.update("jax_compilation_cache_dir", cache_dir)
-            # Cache every compile (the default only caches "long" ones).
-            jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
-            jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+            aggressive = (jax.default_backend() != "cpu"
+                          or os.environ.get("SPARKDQ4ML_CACHE_EVERYTHING")
+                          == "1")
+            if aggressive:
+                # Accelerator compiles ride a tunnel and cost 20-40 s:
+                # cache every compile (the default only caches "long"
+                # ones). The env override exists for the test suite, whose
+                # thousands of tiny repeated CPU compiles are exactly the
+                # case worth caching (stderr noise is captured there).
+                jax.config.update(
+                    "jax_persistent_cache_min_compile_time_secs", 0.0)
+                jax.config.update(
+                    "jax_persistent_cache_min_entry_size_bytes", -1)
+            else:
+                # Stock thresholds on CPU: compiles are fast, and
+                # persisting every tiny kernel floods XLA's AOT reload
+                # with spurious feature-mismatch warnings; only long
+                # compiles persist.
+                jax.config.update(
+                    "jax_persistent_cache_min_compile_time_secs", 1.0)
+                jax.config.update(
+                    "jax_persistent_cache_min_entry_size_bytes", 0)
             # jax latches "is the cache enabled?" process-globally at the
             # first compile; a compile before this session was built would
             # have pinned it to off. Reset the latch so our dir takes effect.

@@ -23,6 +23,29 @@ import jax
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
 
+# Sessions created in tests configure the persistent compilation cache; on
+# CPU they default to "long compiles only", but the suite's thousands of
+# tiny repeated compiles are exactly the case worth caching across runs.
+# The dedicated host-keyed tests dir keeps test kernels out of the
+# production cache (and out of foreign hosts' caches in shared ~/.cache).
+os.environ.setdefault("SPARKDQ4ML_CACHE_EVERYTHING", "1")
+
+from sparkdq4ml_tpu.session import host_cache_tag  # noqa: E402
+
+_cache_dir = os.environ.get("SPARKDQ4ML_CACHE_DIR") or os.path.join(
+    os.path.expanduser("~"), ".cache", "sparkdq4ml_tpu",
+    f"xla-tests-{host_cache_tag()}")
+os.environ.setdefault("SPARKDQ4ML_CACHE_DIR", _cache_dir)
+# Pre-wire for compiles that happen BEFORE any test creates a TpuSession
+# (most model tests never do).
+try:
+    os.makedirs(_cache_dir, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", _cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+except Exception:
+    pass
+
 import jax.numpy as jnp
 import pytest
 

@@ -25,16 +25,36 @@ def _restore_jax_cache_config():
     cc.reset_cache()
 
 
-def test_cache_dir_created_and_configured(tmp_path):
+def test_cache_dir_created_and_configured(tmp_path, monkeypatch):
+    # conftest sets SPARKDQ4ML_CACHE_EVERYTHING for suite speed; this test
+    # verifies the production CPU policy, so drop it.
+    monkeypatch.delenv("SPARKDQ4ML_CACHE_EVERYTHING", raising=False)
     cache = os.path.join(str(tmp_path), "xla-cache")
     s = (TpuSession.builder().app_name("t")
          .config("spark.compilation.cacheDir", cache).get_or_create())
     try:
         assert os.path.isdir(cache)
         assert jax.config.jax_compilation_cache_dir == cache
-        # A fresh compile lands an entry on disk.
+        # On CPU the session keeps the stock "long compiles only"
+        # thresholds (persisting every tiny kernel floods AOT reload
+        # warnings); pin the threshold to 0 here to verify the DIR wiring
+        # with a fast compile.
+        assert jax.config.jax_persistent_cache_min_compile_time_secs == 1.0
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
         jax.jit(lambda x: x * 3.0 + 1.0)(np.arange(8.0)).block_until_ready()
         assert len(os.listdir(cache)) >= 1
+    finally:
+        s.stop()
+
+
+def test_cache_everything_env_forces_aggressive(tmp_path, monkeypatch):
+    monkeypatch.setenv("SPARKDQ4ML_CACHE_EVERYTHING", "1")
+    cache = os.path.join(str(tmp_path), "xla-agg")
+    s = (TpuSession.builder().app_name("t")
+         .config("spark.compilation.cacheDir", cache).get_or_create())
+    try:
+        assert jax.config.jax_persistent_cache_min_compile_time_secs == 0.0
     finally:
         s.stop()
 
