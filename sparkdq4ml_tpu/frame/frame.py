@@ -808,8 +808,48 @@ class Frame:
                 out.append(r)
         return Frame.from_rows(out, self.columns)
 
-    def drop_duplicates(self) -> "Frame":
-        return self.distinct()
+    def drop_duplicates(self, subset=None) -> "Frame":
+        """Spark ``dropDuplicates``: with ``subset``, keep the FIRST valid
+        row per distinct key combination (all columns retained); without,
+        identical to :meth:`distinct`."""
+        if subset is None:
+            return self.distinct()
+        if isinstance(subset, str):
+            subset = [subset]
+        for c in subset:
+            if c not in self.columns:
+                raise ValueError(f"dropDuplicates column {c!r} not found")
+        idx = np.nonzero(self._host_mask())[0]
+        seen = set()
+        keep = []
+        keycols = [np.asarray(self._column_values(c)) for c in subset]
+
+        def cell_key(cell):
+            a = np.asarray(cell)
+            if a.ndim:
+                return tuple(a.ravel().tolist())
+            x = a.item() if hasattr(a, "item") else cell
+            # NaN = SQL NULL throughout this engine: null keys form ONE
+            # group (NaN != NaN would keep every null-key duplicate)
+            if isinstance(x, float) and x != x:
+                return None
+            return x
+
+        for pos in idx:
+            key = tuple(cell_key(k[pos]) for k in keycols)
+            if key not in seen:
+                seen.add(key)
+                keep.append(pos)
+        keep_idx = np.asarray(keep, np.int64)
+        data = {}
+        for name in self.columns:
+            arr = self._data[name]
+            if _is_string_col(arr):
+                data[name] = np.asarray(arr, dtype=object)[keep_idx]
+            else:
+                data[name] = jnp.take(jnp.asarray(arr),
+                                      jnp.asarray(keep_idx), axis=0)
+        return Frame(data)
 
     dropDuplicates = drop_duplicates
 
@@ -898,6 +938,19 @@ class Frame:
             missing = idx < 0
             safe = np.where(missing, 0, idx)
             out = {}
+            if frame.num_slots == 0 and len(idx):
+                # gathering from an EMPTY side (e.g. left join against an
+                # empty right frame): every idx is -1; jnp.take from a
+                # zero-length axis raises, so synthesize the null columns
+                for name in frame.columns:
+                    arr = frame._data[name]
+                    if _is_string_col(arr):
+                        out[name] = np.full(len(idx), None, dtype=object)
+                    else:
+                        a = jnp.asarray(arr)
+                        out[name] = jnp.full((len(idx),) + a.shape[1:],
+                                             jnp.nan, float_dtype())
+                return out
             for name in frame.columns:
                 arr = frame._data[name]
                 if _is_string_col(arr):

@@ -35,25 +35,65 @@ _OFFSET_FNS = ("lag", "lead")
 _AGG_FNS = ("count", "sum", "avg", "mean", "min", "max")
 
 
+# Spark's frame-boundary sentinels (pyspark.sql.Window uses extreme ints)
+_UNBOUNDED = (1 << 62)
+
+
 class WindowSpec:
-    """Immutable partition/order specification."""
+    """Immutable partition/order/frame specification."""
 
     def __init__(self, partition_cols: Sequence[str] = (),
-                 order_cols: Sequence[tuple[str, bool]] = ()):
+                 order_cols: Sequence[tuple[str, bool]] = (),
+                 frame: tuple = None):
         self.partition_cols = tuple(partition_cols)
         self.order_cols = tuple(order_cols)
+        self.frame = frame            # None | ("rows"|"range", start, end)
 
     def partition_by(self, *cols: str) -> "WindowSpec":
         return WindowSpec(self.partition_cols + tuple(_colname(c) for c in cols),
-                          self.order_cols)
+                          self.order_cols, self.frame)
 
     partitionBy = partition_by
 
     def order_by(self, *cols) -> "WindowSpec":
         return WindowSpec(self.partition_cols,
-                          self.order_cols + tuple(_order_item(c) for c in cols))
+                          self.order_cols + tuple(_order_item(c) for c in cols),
+                          self.frame)
 
     orderBy = order_by
+
+    def rows_between(self, start: int, end: int) -> "WindowSpec":
+        """ROWS frame: physical row offsets relative to the current row
+        (``Window.unboundedPreceding`` / ``currentRow`` /
+        ``unboundedFollowing`` sentinels, or plain ints — Spark API)."""
+        start, end = int(start), int(end)
+        if start > end:
+            raise ValueError(f"frame start {start} > end {end}")
+        return WindowSpec(self.partition_cols, self.order_cols,
+                          ("rows", start, end))
+
+    rowsBetween = rows_between
+
+    def range_between(self, start: int, end: int) -> "WindowSpec":
+        """RANGE frame. Supported bounds: the unbounded/current-row
+        sentinel combinations (value offsets would need per-row order-key
+        arithmetic — not implemented; Spark's common uses are the
+        sentinel forms)."""
+        start, end = int(start), int(end)
+        if start > end:
+            raise ValueError(f"frame start {start} > end {end}")
+        for v in (start, end):
+            if v not in (-_UNBOUNDED, 0, _UNBOUNDED) and abs(v) >= _UNBOUNDED:
+                raise ValueError("bad frame bound")
+        if start not in (-_UNBOUNDED, 0) or end not in (0, _UNBOUNDED):
+            if not (start == -_UNBOUNDED and end == _UNBOUNDED):
+                raise NotImplementedError(
+                    "range_between supports only unboundedPreceding/"
+                    "currentRow/unboundedFollowing bounds")
+        return WindowSpec(self.partition_cols, self.order_cols,
+                          ("range", start, end))
+
+    rangeBetween = range_between
 
     def describe(self) -> str:
         parts = []
@@ -62,6 +102,18 @@ class WindowSpec:
         if self.order_cols:
             parts.append("ORDER BY " + ", ".join(
                 f"{c}{'' if asc else ' DESC'}" for c, asc in self.order_cols))
+        if self.frame is not None:
+            kind, s, e = self.frame
+
+            def b(v):
+                if v <= -_UNBOUNDED:
+                    return "UNBOUNDED PRECEDING"
+                if v >= _UNBOUNDED:
+                    return "UNBOUNDED FOLLOWING"
+                if v == 0:
+                    return "CURRENT ROW"
+                return f"{-v} PRECEDING" if v < 0 else f"{v} FOLLOWING"
+            parts.append(f"{kind.upper()} BETWEEN {b(s)} AND {b(e)}")
         return " ".join(parts)
 
     def __repr__(self):
@@ -129,6 +181,10 @@ def _order_item(c) -> tuple[str, bool]:
 
 class Window:
     """Entry points, Spark-style: ``Window.partitionBy("k").orderBy("v")``."""
+
+    unboundedPreceding = unbounded_preceding = -_UNBOUNDED
+    unboundedFollowing = unbounded_following = _UNBOUNDED
+    currentRow = current_row = 0
 
     @staticmethod
     def partition_by(*cols: str) -> WindowSpec:
@@ -342,10 +398,25 @@ class WindowExpr(Expr):
                     v = v.astype(np.float64)
                     null = np.isnan(v)
             ordered = bool(self.spec.order_cols)
+            frame_spec = self.spec.frame
+            if frame_spec is not None and not ordered:
+                kind_, fs_, fe_ = frame_spec
+                # Spark: ROWS frames always need ordering; RANGE frames
+                # need it whenever a CURRENT ROW bound makes the frame
+                # row-dependent (unbounded-both is the only orderless form)
+                if kind_ == "rows" or not (fs_ <= -_UNBOUNDED
+                                           and fe_ >= _UNBOUNDED):
+                    raise ValueError(f"a {kind_.upper()} frame requires an "
+                                     "ORDER BY in its window")
             out = np.empty(nv, np.float64)
             for s, e in zip(starts, ends):
                 seg = np.where(null[s:e], 0.0, v[s:e])
                 cnt = (~null[s:e]).astype(np.float64)
+                if frame_spec is not None:
+                    out[s:e] = _framed_agg(agg, frame_spec, seg, cnt,
+                                           v[s:e], null[s:e],
+                                           peer, s, e)
+                    continue
                 if not ordered:          # whole-partition aggregate
                     out[s:e] = _segment_agg(agg, seg, cnt, v[s:e], null[s:e])
                     continue
@@ -374,6 +445,84 @@ class WindowExpr(Expr):
             return out.astype(fdt), np.nan, False
 
         raise ValueError(f"unknown window function {fn!r}")
+
+
+def _framed_agg(agg, frame_spec, seg, cnt, raw, null, peer, s, e):
+    """Aggregate over an explicit ROWS/RANGE frame for one partition
+    (host-side, vectorized): per sorted row r, the inclusive window
+    [r+start, r+end] clipped to the partition (ROWS), or the sentinel
+    RANGE forms resolved through peer groups. Spark semantics for empty /
+    all-null windows: count = 0, sum/avg/min/max = null."""
+    kind, fs, fe = frame_spec
+    n = len(seg)
+    if n == 0:
+        return np.empty(0, np.float64)
+    r = np.arange(n)
+
+    if kind == "range":
+        # peer-group resolved bounds: CURRENT ROW includes all peers
+        upto = _peer_upto(peer, s, e)              # rows ≤ last peer
+        pk = peer[s:e].copy()
+        pk[0] = True                 # n > 0: the n == 0 case returned above
+        peer_start = np.maximum.accumulate(np.where(pk, r, 0))
+        lo = np.zeros(n, np.int64) if fs <= -_UNBOUNDED else peer_start
+        hi = np.full(n, n - 1, np.int64) if fe >= _UNBOUNDED else upto - 1
+    else:                                          # rows
+        lo = np.zeros(n, np.int64) if fs <= -_UNBOUNDED else \
+            np.clip(r + fs, 0, n)                  # n ⇒ empty below
+        hi = np.full(n, n - 1, np.int64) if fe >= _UNBOUNDED else \
+            np.clip(r + fe, -1, n - 1)             # −1 ⇒ empty below
+
+    empty = lo > hi
+    lo_c = np.clip(lo, 0, n - 1)
+    hi_c = np.clip(hi, 0, n - 1)
+    S = np.concatenate([[0.0], np.cumsum(seg)])
+    C = np.concatenate([[0.0], np.cumsum(cnt)])
+    wcnt = np.where(empty, 0.0, C[hi_c + 1] - C[lo_c])
+    if agg == "count":
+        return wcnt
+    wsum = np.where(empty, 0.0, S[hi_c + 1] - S[lo_c])
+    if agg == "sum":
+        return np.where(wcnt > 0, wsum, np.nan)
+    if agg == "avg":
+        return np.where(wcnt > 0, wsum / np.maximum(wcnt, 1.0), np.nan)
+
+    # min / max with nulls neutralized
+    neutral = np.inf if agg == "min" else -np.inf
+    acc = np.where(null, neutral, raw.astype(np.float64))
+    reduce_ = np.minimum if agg == "min" else np.maximum
+    if np.all(lo_c == 0):                  # frame starts at partition top
+        val = reduce_.accumulate(acc)[hi_c]
+    elif np.all(hi_c == n - 1):            # frame runs to partition end
+        val = reduce_.accumulate(acc[::-1])[::-1][lo_c]
+    else:
+        val = _window_reduce(reduce_, acc, lo_c, hi_c, neutral)
+    return np.where(wcnt > 0, val, np.nan)
+
+
+def _window_reduce(reduce_, acc, lo, hi, neutral):
+    """Per-row reduce of acc[lo[r]..hi[r]] for bounded fixed-span windows
+    (lo/hi come from a common offset pair, so hi−lo is constant except at
+    the clipped partition edges — pad with the neutral and slide)."""
+    n = len(acc)
+    w = int(np.max(hi - lo)) + 1 if n else 1
+    w = max(w, 1)
+    padded = np.concatenate([np.full(w - 1, neutral), acc,
+                             np.full(w - 1, neutral)])
+    sw = np.lib.stride_tricks.sliding_window_view(padded, w)
+    # window covering [lo, hi] of width hi-lo+1 ≤ w sits at padded index
+    # hi + (w-1) - (w-1) = ... anchor on hi: take the window ENDING at hi
+    # (padded end index hi + w - 1), then mask off entries before lo via
+    # the left neutral padding — entries [hi-w+1, hi]; those below lo are
+    # within the neutral pad only when lo == hi-w+1, which holds except at
+    # clipped edges where extra (smaller) entries are real rows BELOW lo.
+    vals = sw[hi]  # window [hi-w+1, hi] in padded coords
+    # rows below lo inside the span must be neutralized
+    offs = np.arange(w)
+    starts = hi - w + 1
+    mask_bad = (starts[:, None] + offs[None, :]) < lo[:, None]
+    vals = np.where(mask_bad, neutral, vals)
+    return reduce_.reduce(vals, axis=1)
 
 
 def _segment_agg(agg, seg, cnt, raw, null):

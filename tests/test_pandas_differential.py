@@ -1,0 +1,274 @@
+"""Pandas-differential battery: seeded random frames through the Frame
+engine's relational core — group_by aggregates, joins with duplicate keys,
+multi-key sorts, distinct/dropna/fillna, pivot, windowed ranking — checked
+against pandas as an INDEPENDENT oracle (nothing in this repo shares code
+with it), restricted to the semantic intersection where Spark and pandas
+agree by design (e.g. NaN-free value columns for sum/min/max, no null join
+keys — the divergent cases have their own dedicated Spark-semantics tests).
+"""
+
+import numpy as np
+import pytest
+
+pd = pytest.importorskip("pandas")
+
+from sparkdq4ml_tpu import Frame
+from sparkdq4ml_tpu import functions as F
+
+
+def _frames(seed, n=200, nkeys=7):
+    rng = np.random.default_rng(seed)
+    data = {
+        "k": rng.integers(0, nkeys, n).astype(np.int64),
+        "k2": rng.integers(0, 3, n).astype(np.int64),
+        "v": np.round(rng.normal(10.0, 5.0, n), 3),
+        "w": np.round(rng.uniform(-1.0, 1.0, n), 3),
+    }
+    return Frame(dict(data)), pd.DataFrame(data)
+
+
+def _sorted_rows(d):
+    """Row multiset of a to_pydict()/DataFrame dict, order-insensitive."""
+    cols = sorted(d.keys())
+    rows = list(zip(*[np.asarray(d[c]).tolist() for c in cols]))
+    return sorted(map(repr, rows)), cols
+
+
+def assert_same_rows(frame, pdf):
+    got = {k: np.asarray(v) for k, v in frame.to_pydict().items()}
+    want = {c: pdf[c].to_numpy() for c in pdf.columns}
+    assert sorted(got.keys()) == sorted(want.keys()), (
+        sorted(got.keys()), sorted(want.keys()))
+    grows, cols = _sorted_rows(got)
+    wrows, _ = _sorted_rows(want)
+    assert len(grows) == len(wrows), (len(grows), len(wrows))
+    for a, b in zip(grows, wrows):
+        assert a == b, (a, b)
+
+
+class TestGroupByAggs:
+    @pytest.mark.parametrize("seed", [0, 1, 2])
+    def test_single_key_sum_mean_min_max_count(self, seed):
+        f, pdf = _frames(seed)
+        out = f.group_by("k").agg(F.sum("v").alias("s"),
+                                  F.mean("v").alias("m"),
+                                  F.min("v").alias("lo"),
+                                  F.max("v").alias("hi"),
+                                  F.count("v").alias("c"))
+        ref = (pdf.groupby("k", as_index=False)
+               .agg(s=("v", "sum"), m=("v", "mean"), lo=("v", "min"),
+                    hi=("v", "max"), c=("v", "count")))
+        g = out.sort("k").to_pydict()
+        r = ref.sort_values("k")
+        np.testing.assert_array_equal(np.asarray(g["k"]), r["k"].to_numpy())
+        np.testing.assert_allclose(np.asarray(g["s"]), r["s"], rtol=1e-9)
+        np.testing.assert_allclose(np.asarray(g["m"]), r["m"], rtol=1e-9)
+        np.testing.assert_allclose(np.asarray(g["lo"]), r["lo"])
+        np.testing.assert_allclose(np.asarray(g["hi"]), r["hi"])
+        np.testing.assert_array_equal(np.asarray(g["c"]), r["c"].to_numpy())
+
+    @pytest.mark.parametrize("seed", [3, 4])
+    def test_two_key_grouping(self, seed):
+        f, pdf = _frames(seed)
+        out = f.group_by("k", "k2").agg(F.sum("v").alias("s"),
+                                        F.count("v").alias("c"))
+        ref = (pdf.groupby(["k", "k2"], as_index=False)
+               .agg(s=("v", "sum"), c=("v", "count")))
+        g = out.sort("k", "k2").to_pydict()
+        r = ref.sort_values(["k", "k2"])
+        np.testing.assert_array_equal(np.asarray(g["k"]), r["k"].to_numpy())
+        np.testing.assert_array_equal(np.asarray(g["k2"]), r["k2"].to_numpy())
+        np.testing.assert_allclose(np.asarray(g["s"]), r["s"], rtol=1e-9)
+        np.testing.assert_array_equal(np.asarray(g["c"]), r["c"].to_numpy())
+
+    def test_grouping_after_filter_mask(self):
+        # masked rows must not contribute to any group statistic
+        f, pdf = _frames(11)
+        f2 = f.filter(F.col("w") > 0.0)
+        pdf2 = pdf[pdf["w"] > 0.0]
+        out = f2.group_by("k").agg(F.sum("v").alias("s"),
+                                   F.count("v").alias("c"))
+        ref = (pdf2.groupby("k", as_index=False)
+               .agg(s=("v", "sum"), c=("v", "count")))
+        g = out.sort("k").to_pydict()
+        r = ref.sort_values("k")
+        np.testing.assert_array_equal(np.asarray(g["k"]), r["k"].to_numpy())
+        np.testing.assert_allclose(np.asarray(g["s"]), r["s"], rtol=1e-9)
+        np.testing.assert_array_equal(np.asarray(g["c"]), r["c"].to_numpy())
+
+
+class TestJoins:
+    def _pair(self, seed, nl=60, nr=50, nkeys=9):
+        rng = np.random.default_rng(seed)
+        left = {"k": rng.integers(0, nkeys, nl).astype(np.int64),
+                "a": np.round(rng.normal(size=nl), 3)}
+        right = {"k": rng.integers(0, nkeys, nr).astype(np.int64),
+                 "b": np.round(rng.normal(size=nr), 3)}
+        return (Frame(dict(left)), Frame(dict(right)),
+                pd.DataFrame(left), pd.DataFrame(right))
+
+    @pytest.mark.parametrize("how", ["inner", "left", "right", "outer"])
+    @pytest.mark.parametrize("seed", [5, 6])
+    def test_join_duplicate_keys(self, how, seed):
+        fl, fr, pl, pr = self._pair(seed)
+        out = fl.join(fr, on="k", how=how)
+        ref = pl.merge(pr, on="k", how="outer" if how == "outer" else how)
+        assert_same_rows(out, ref)
+
+    @pytest.mark.parametrize("seed", [7])
+    def test_left_semi_anti(self, seed):
+        fl, fr, pl, pr = self._pair(seed)
+        semi = fl.join(fr, on="k", how="left_semi")
+        anti = fl.join(fr, on="k", how="left_anti")
+        in_right = pl["k"].isin(set(pr["k"]))
+        assert_same_rows(semi, pl[in_right])
+        assert_same_rows(anti, pl[~in_right])
+
+    def test_join_empty_right(self):
+        fl, _, pl, _ = self._pair(8)
+        fr = Frame({"k": np.asarray([], np.int64),
+                    "b": np.asarray([], np.float64)})
+        assert fl.join(fr, on="k", how="inner").count() == 0
+        left = fl.join(fr, on="k", how="left")
+        assert left.count() == pl.shape[0]
+        assert np.all(np.isnan(np.asarray(left.to_pydict()["b"],
+                                          np.float64)))
+
+
+class TestSortDistinctNa:
+    @pytest.mark.parametrize("seed", [9, 10])
+    def test_multi_key_sort(self, seed):
+        f, pdf = _frames(seed)
+        out = f.sort("k", "v", ascending=[True, False]).to_pydict()
+        ref = pdf.sort_values(["k", "v"], ascending=[True, False])
+        np.testing.assert_array_equal(np.asarray(out["k"]),
+                                      ref["k"].to_numpy())
+        np.testing.assert_allclose(np.asarray(out["v"]), ref["v"])
+
+    def test_distinct(self):
+        rng = np.random.default_rng(12)
+        data = {"a": rng.integers(0, 4, 100).astype(np.int64),
+                "b": rng.integers(0, 3, 100).astype(np.int64)}
+        f = Frame(dict(data))
+        pdf = pd.DataFrame(data)
+        assert_same_rows(f.distinct(), pdf.drop_duplicates())
+
+    def test_drop_duplicates_subset(self):
+        rng = np.random.default_rng(13)
+        data = {"a": rng.integers(0, 4, 60).astype(np.int64),
+                "b": np.arange(60, dtype=np.float64)}
+        f = Frame(dict(data))
+        pdf = pd.DataFrame(data)
+        ours = f.drop_duplicates(["a"])
+        # Spark keeps the FIRST row per key (ours documented likewise)
+        ref = pdf.drop_duplicates(subset=["a"], keep="first")
+        assert_same_rows(ours, ref)
+
+    def test_dropna_fillna(self):
+        rng = np.random.default_rng(14)
+        v = rng.normal(size=80)
+        v[rng.integers(0, 80, 15)] = np.nan
+        w = rng.normal(size=80)
+        w[rng.integers(0, 80, 10)] = np.nan
+        data = {"v": v, "w": w}
+        f = Frame(dict(data))
+        pdf = pd.DataFrame(data)
+        assert f.dropna().count() == pdf.dropna().shape[0]
+        assert f.dropna(subset=["v"]).count() == \
+            pdf.dropna(subset=["v"]).shape[0]
+        filled = np.asarray(f.fillna(0.0).to_pydict()["v"])
+        np.testing.assert_allclose(filled, pdf["v"].fillna(0.0).to_numpy())
+
+
+class TestPivot:
+    def test_pivot_sum_matches_pivot_table(self):
+        rng = np.random.default_rng(15)
+        data = {"k": rng.integers(0, 5, 120).astype(np.int64),
+                "c": rng.integers(0, 3, 120).astype(np.int64),
+                "v": np.round(rng.normal(size=120), 3)}
+        f = Frame(dict(data))
+        pdf = pd.DataFrame(data)
+        out = f.group_by("k").pivot("c").agg(F.sum("v")).sort("k")
+        ref = pd.pivot_table(pdf, index="k", columns="c", values="v",
+                             aggfunc="sum").sort_index()
+        g = out.to_pydict()
+        np.testing.assert_array_equal(np.asarray(g["k"]),
+                                      ref.index.to_numpy())
+        for c in ref.columns:
+            col = next(name for name in g
+                       if name != "k" and str(c) in str(name))
+            ours = np.asarray(g[col], np.float64)
+            want = ref[c].to_numpy()
+            both = ~(np.isnan(ours) | np.isnan(want))
+            np.testing.assert_allclose(ours[both], want[both], rtol=1e-9)
+            np.testing.assert_array_equal(np.isnan(ours), np.isnan(want))
+
+
+class TestWindowDifferential:
+    def test_row_number_and_rank_vs_pandas(self):
+        rng = np.random.default_rng(16)
+        data = {"g": rng.integers(0, 6, 150).astype(np.int64),
+                "v": np.round(rng.normal(size=150), 3)}
+        f = Frame(dict(data))
+        pdf = pd.DataFrame(data)
+        w = F.Window.partitionBy("g").orderBy("v")
+        out = (f.withColumn("rn", F.row_number().over(w))
+                .withColumn("rk", F.rank().over(w)))
+        g = out.to_pydict()
+        ref_rn = pdf.groupby("g")["v"].rank(method="first").astype(int)
+        ref_rk = pdf.groupby("g")["v"].rank(method="min").astype(int)
+        # row_number breaks ties arbitrarily: compare the SET of numbers
+        # per (group, value) block; rank is deterministic.
+        np.testing.assert_array_equal(np.asarray(g["rk"], np.int64),
+                                      ref_rk.to_numpy())
+        df_ours = pd.DataFrame({"g": g["g"], "v": g["v"], "rn": g["rn"]})
+        for (grp, val), blk in df_ours.groupby(["g", "v"]):
+            ref_blk = ref_rn[(pdf["g"] == grp) & (pdf["v"] == val)]
+            assert sorted(blk["rn"]) == sorted(ref_blk.tolist())
+
+    def test_running_sum_vs_pandas(self):
+        rng = np.random.default_rng(17)
+        data = {"g": rng.integers(0, 4, 100).astype(np.int64),
+                "t": rng.permutation(100).astype(np.int64),
+                "v": np.round(rng.normal(size=100), 3)}
+        f = Frame(dict(data))
+        pdf = pd.DataFrame(data)
+        w = (F.Window.partitionBy("g").orderBy("t")
+             .rowsBetween(F.Window.unboundedPreceding, F.Window.currentRow))
+        out = f.withColumn("rs", F.sum("v").over(w)).to_pydict()
+        ref = (pdf.sort_values("t").groupby("g")["v"].cumsum())
+        ours = pd.Series(np.asarray(out["rs"]),
+                         index=pd.Index(np.asarray(out["t"])))
+        want = pd.Series(ref.to_numpy(),
+                         index=pd.Index(pdf.sort_values("t")["t"].to_numpy()))
+        np.testing.assert_allclose(ours.sort_index().to_numpy(),
+                                   want.sort_index().to_numpy(), rtol=1e-9)
+
+
+class TestNullKeyDedup:
+    def test_nan_keys_form_one_group(self):
+        f = Frame({"k": np.asarray([np.nan, np.nan, 1.0, 1.0, 2.0]),
+                   "v": np.arange(5.0)})
+        out = f.drop_duplicates(["k"])
+        assert out.count() == 3          # {null, 1.0, 2.0}
+        kept = np.asarray(out.to_pydict()["v"])
+        assert set(kept.tolist()) == {0.0, 2.0, 4.0}   # first of each
+
+
+class TestRangeFrameRequiresOrder:
+    def test_current_row_range_without_order_raises(self):
+        f = Frame({"g": np.asarray(["a", "a"], dtype=object),
+                   "v": np.asarray([1.0, 2.0])})
+        w = (F.Window.partitionBy("g")
+             .rangeBetween(F.Window.currentRow, F.Window.currentRow))
+        with pytest.raises(ValueError, match="ORDER BY"):
+            f.withColumn("s", F.sum("v").over(w)).to_pydict()
+
+    def test_unbounded_both_range_without_order_ok(self):
+        f = Frame({"g": np.asarray(["a", "a"], dtype=object),
+                   "v": np.asarray([1.0, 2.0])})
+        w = (F.Window.partitionBy("g")
+             .rangeBetween(F.Window.unboundedPreceding,
+                           F.Window.unboundedFollowing))
+        out = f.withColumn("s", F.sum("v").over(w)).to_pydict()
+        assert list(out["s"]) == [3.0, 3.0]

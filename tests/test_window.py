@@ -269,3 +269,94 @@ class TestSqlOver:
         out = session.sql("SELECT partition, over FROM weird "
                           "WHERE partition > 1")
         assert out.to_pydict()["over"].tolist() == [4.0]
+
+
+class TestExplicitFrames:
+    """rowsBetween / rangeBetween (Spark Window frame API)."""
+
+    def _frame(self):
+        return Frame({
+            "g": np.asarray(["a"] * 5 + ["b"] * 3, dtype=object),
+            "t": np.asarray([1, 2, 3, 4, 5, 1, 2, 3], np.int64),
+            "v": np.asarray([1.0, 2.0, 3.0, 4.0, 5.0, 10.0, 20.0, 30.0]),
+        })
+
+    def test_moving_average_rows(self):
+        # 3-row centered moving average, clipped at partition edges
+        f = self._frame()
+        w = (F.Window.partitionBy("g").orderBy("t").rowsBetween(-1, 1))
+        out = f.withColumn("ma", F.mean("v").over(w)).to_pydict()
+        got = dict(zip(zip(out["g"], out["t"]), out["ma"]))
+        assert got[("a", 1)] == pytest.approx((1 + 2) / 2)
+        assert got[("a", 3)] == pytest.approx((2 + 3 + 4) / 3)
+        assert got[("a", 5)] == pytest.approx((4 + 5) / 2)
+        assert got[("b", 2)] == pytest.approx((10 + 20 + 30) / 3)
+
+    def test_rows_unbounded_preceding_running_sum_excludes_peers(self):
+        # ROWS (not RANGE): peers do NOT ride along
+        f = Frame({"g": np.asarray(["a"] * 3, dtype=object),
+                   "t": np.asarray([1, 1, 2], np.int64),
+                   "v": np.asarray([1.0, 10.0, 100.0])})
+        w = (F.Window.partitionBy("g").orderBy("t")
+             .rowsBetween(F.Window.unboundedPreceding, F.Window.currentRow))
+        out = f.withColumn("rs", F.sum("v").over(w)).to_pydict()
+        # the two t=1 peers get DIFFERENT running sums under ROWS
+        sums = sorted(out["rs"][:2])
+        assert sums[1] - sums[0] in (1.0, 10.0)
+        assert out["rs"][2] == pytest.approx(111.0)
+
+    def test_range_current_to_unbounded_following(self):
+        f = self._frame()
+        w = (F.Window.partitionBy("g").orderBy("t")
+             .rangeBetween(F.Window.currentRow,
+                           F.Window.unboundedFollowing))
+        out = f.withColumn("s", F.sum("v").over(w)).to_pydict()
+        got = dict(zip(zip(out["g"], out["t"]), out["s"]))
+        assert got[("a", 1)] == pytest.approx(15.0)
+        assert got[("a", 4)] == pytest.approx(9.0)
+        assert got[("b", 3)] == pytest.approx(30.0)
+
+    def test_bounded_following_only_window_can_be_empty(self):
+        f = self._frame()
+        w = (F.Window.partitionBy("g").orderBy("t").rowsBetween(1, 2))
+        out = f.withColumn("s", F.sum("v").over(w)) \
+               .withColumn("c", F.count("v").over(w)).to_pydict()
+        got = dict(zip(zip(out["g"], out["t"]),
+                       zip(out["s"], out["c"])))
+        assert got[("a", 1)][0] == pytest.approx(2 + 3)
+        assert got[("a", 4)][0] == pytest.approx(5.0)
+        s5, c5 = got[("a", 5)]
+        assert np.isnan(s5) and c5 == 0          # empty frame: sum null
+        assert got[("b", 2)][0] == pytest.approx(30.0)
+
+    def test_min_max_bounded_frame(self):
+        f = self._frame()
+        w = (F.Window.partitionBy("g").orderBy("t").rowsBetween(-1, 1))
+        out = f.withColumn("lo", F.min("v").over(w)) \
+               .withColumn("hi", F.max("v").over(w)).to_pydict()
+        got = dict(zip(zip(out["g"], out["t"]),
+                       zip(out["lo"], out["hi"])))
+        assert got[("a", 3)] == (2.0, 4.0)
+        assert got[("a", 1)] == (1.0, 2.0)
+        assert got[("b", 3)] == (20.0, 30.0)
+
+    def test_rows_frame_requires_order(self):
+        f = self._frame()
+        w = F.Window.partitionBy("g").rowsBetween(-1, 1)
+        with pytest.raises(ValueError, match="ORDER BY"):
+            f.withColumn("x", F.sum("v").over(w)).to_pydict()
+
+    def test_invalid_frame_rejected(self):
+        with pytest.raises(ValueError, match="start"):
+            F.Window.partitionBy("g").orderBy("t").rowsBetween(2, 1)
+        with pytest.raises(NotImplementedError):
+            F.Window.partitionBy("g").orderBy("t").rangeBetween(-5, 5)
+
+    def test_ranking_ignores_frame(self):
+        # SQL: ranking functions are frame-insensitive
+        f = self._frame()
+        w0 = F.Window.partitionBy("g").orderBy("t")
+        w1 = w0.rowsBetween(-1, 1)
+        a = f.withColumn("r", F.row_number().over(w0)).to_pydict()["r"]
+        b = f.withColumn("r", F.row_number().over(w1)).to_pydict()["r"]
+        assert list(a) == list(b)
