@@ -454,6 +454,31 @@ def _fn_round(v, digits=None):
                      jnp.ceil(scaled - 0.5)) / scale
 
 
+def _fn_length(s):
+    """Spark ``length``: null → null. Results are int32; a column
+    containing nulls promotes to float with NaN (the engine's numeric-null
+    convention — same promotion as ``lag`` on ints). Numeric columns cast
+    to their string rendering first, like Spark."""
+    if _is_object(s):
+        lens = [None if x is None else len(str(x)) for x in s]
+    else:
+        a = np.asarray(s)
+        if np.issubdtype(a.dtype, np.floating):
+            # str(numpy scalar) keeps the dtype's short repr; float(x)
+            # would upcast f32→f64 and render the rounding error
+            # ('0.10000000149011612' instead of '0.1')
+            lens = [None if np.isnan(x) else len(str(x)) for x in a]
+        elif np.issubdtype(a.dtype, np.bool_):
+            lens = [len(str(bool(x))) for x in a]
+        else:
+            lens = [len(str(int(x))) for x in a]
+    if any(v is None for v in lens):
+        return jnp.asarray(np.asarray(
+            [np.nan if v is None else float(v) for v in lens], np.float64),
+            float_dtype())
+    return jnp.asarray(np.asarray(lens, np.int32))
+
+
 def _fn_substring(s, pos, length):
     # Spark substring is 1-based; pos 0 behaves like 1.
     p = int(np.asarray(pos)[0])
@@ -626,9 +651,7 @@ _BUILTIN_FNS = {
     "trim": lambda s: _str_map(str.strip, s),
     "ltrim": lambda s: _str_map(str.lstrip, s),
     "rtrim": lambda s: _str_map(str.rstrip, s),
-    "length": lambda s: jnp.asarray(
-        np.asarray([-1 if x is None else len(x) for x in np.asarray(s, object)],
-                   np.int32) if _is_object(np.asarray(s, object)) else s),
+    "length": _fn_length,
     "concat": lambda *ss: _str_map(lambda *xs: "".join(str(x) for x in xs), *ss),
     "substring": _fn_substring,
     "substr": _fn_substring,
@@ -978,7 +1001,7 @@ def _fn_unix_timestamp(s, fmt=None):
 
 def _date_field(which: str):
     def f(days):
-        days = jnp.asarray(days, float_dtype())
+        days = _days_of(days)
         null = jnp.isnan(days)
         z = jnp.where(null, 0, days).astype(jnp.int32)
         y, m, d = _civil_from_days(z)
@@ -1000,25 +1023,39 @@ def _date_field(which: str):
     return f
 
 
+def _days_of(v):
+    """Epoch-day view of a date operand with Spark's implicit cast: string
+    (object) columns parse their DATE PREFIX as ``yyyy-MM-dd`` — Spark's
+    cast accepts timestamp-shaped strings ('2026-01-01 10:00:00',
+    ISO 'T' form) by reading the date part — unparseable/null → NaN;
+    numeric columns are epoch days already (``to_date`` output)."""
+    if _is_object(v):
+        prefix = np.asarray(
+            [None if x is None
+             else str(x).strip().split()[0].split("T")[0] if str(x).strip()
+             else None
+             for x in v], object)
+        return _parse_dates(prefix, "yyyy-MM-dd", unit_seconds=False)
+    return jnp.asarray(v, float_dtype())
+
+
 def _fn_datediff(end, start):
-    e = jnp.asarray(end, float_dtype())
-    s = jnp.asarray(start, float_dtype())
-    return e - s                                   # NaN propagates
+    return _days_of(end) - _days_of(start)         # NaN propagates
 
 
 def _fn_date_add(days, n):
-    return jnp.asarray(days, float_dtype()) + _scalar_int(n)
+    return _days_of(days) + _scalar_int(n)
 
 
 def _fn_date_sub(days, n):
-    return jnp.asarray(days, float_dtype()) - _scalar_int(n)
+    return _days_of(days) - _scalar_int(n)
 
 
 def _fn_date_format(days, fmt):
     import datetime as _dt
 
     py_fmt = _strptime_format(_scalar_str(fmt))
-    arr = np.asarray(days, np.float64)
+    arr = np.asarray(_days_of(days), np.float64)
     epoch = _dt.date(1970, 1, 1)
     return np.asarray(
         [None if np.isnan(v)

@@ -118,3 +118,51 @@ class TestSql:
         kept = f.filter(dq.col("y") < 2025)
         assert kept.count() == 1                   # null row excluded
         assert f.filter(dq.col("y").is_null()).count() == 1
+
+
+class TestImplicitStringDateCast:
+    """Spark implicitly casts yyyy-MM-dd strings to dates in date
+    functions; the engine's date fns must accept string columns directly
+    (not only to_date output)."""
+
+    def _frame(self):
+        return Frame({"d": np.asarray(
+            ["2026-01-31", "2026-02-28", None, "2025-12-01"], dtype=object)})
+
+    def test_year_month_on_string_column(self):
+        f = self._frame()
+        o = (f.with_column("y", F.year(F.col("d")))
+              .with_column("m", F.month(F.col("d")))).to_pydict()
+        assert list(o["y"])[:2] == [2026.0, 2026.0]
+        assert np.isnan(o["y"][2])
+        assert list(o["m"])[:2] == [1.0, 2.0]
+
+    def test_date_add_datediff_on_string_column(self):
+        f = self._frame()
+        o = (f.with_column("a", F.date_add(F.col("d"), 31))
+              .with_column("dd", F.datediff(F.col("d"), F.col("d")))
+              ).to_pydict()
+        # 2026-01-31 + 31 days = 2026-03-03 = epoch day 20515
+        assert o["a"][0] == 20515.0
+        assert np.isnan(o["a"][2])
+        assert o["dd"][0] == 0.0
+
+    def test_date_format_on_string_column(self):
+        f = self._frame()
+        o = f.with_column("s", F.date_format(F.col("d"), "dd/MM/yyyy"))
+        got = o.to_pydict()["s"]
+        assert list(got) == ["31/01/2026", "28/02/2026", None, "01/12/2025"]
+
+    def test_unparseable_string_yields_null(self):
+        f = Frame({"d": np.asarray(["not-a-date", "2026-01-01"],
+                                   dtype=object)})
+        o = f.with_column("y", F.year(F.col("d"))).to_pydict()
+        assert np.isnan(o["y"][0]) and o["y"][1] == 2026.0
+
+    def test_timestamp_shaped_strings_cast_by_date_prefix(self):
+        f = Frame({"d": np.asarray(
+            ["2026-01-01 10:00:00", "2026-02-03T04:05:06", "  ", None],
+            dtype=object)})
+        o = f.with_column("y", F.year(F.col("d"))).to_pydict()
+        assert o["y"][0] == 2026.0 and o["y"][1] == 2026.0
+        assert np.isnan(o["y"][2]) and np.isnan(o["y"][3])

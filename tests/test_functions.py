@@ -124,3 +124,31 @@ class TestSqlSpellings:
     def test_sql_unknown_function_raises(self, session):
         with pytest.raises(KeyError, match="not registered"):
             session.sql("SELECT frobnicate(x) AS y FROM t").to_pydict()
+
+
+class TestLengthNullSemantics:
+    def test_length_null_is_null_not_sentinel(self):
+        from sparkdq4ml_tpu import Frame
+        f = Frame({"s": np.asarray(["ab", None, "xyz"], dtype=object)})
+        o = np.asarray(f.with_column("l", F.length(F.col("s")))
+                        .to_pydict()["l"], np.float64)
+        assert o[0] == 2.0 and o[2] == 3.0
+        assert np.isnan(o[1])                      # Spark: length(null)=null
+
+    def test_length_all_present_stays_int(self):
+        from sparkdq4ml_tpu import Frame
+        f = Frame({"s": np.asarray(["ab", "xyz"], dtype=object)})
+        o = f.with_column("l", F.length(F.col("s"))).to_pydict()["l"]
+        assert np.asarray(o).dtype == np.int32
+
+    def test_length_numeric_casts_to_string(self):
+        from sparkdq4ml_tpu import Frame
+        f = Frame({"n": np.asarray([1, 22, 333], np.int64)})
+        o = f.with_column("l", F.length(F.col("n"))).to_pydict()["l"]
+        assert list(np.asarray(o)) == [1, 2, 3]
+
+    def test_length_float32_uses_short_repr(self):
+        from sparkdq4ml_tpu import Frame
+        f = Frame({"x": np.asarray([0.1, 2.5], np.float32)})
+        o = f.with_column("l", F.length(F.col("x"))).to_pydict()["l"]
+        assert list(np.asarray(o)) == [3, 3]      # '0.1', '2.5'
