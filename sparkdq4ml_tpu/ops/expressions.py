@@ -472,11 +472,7 @@ def _fn_length(s):
             lens = [len(str(bool(x))) for x in a]
         else:
             lens = [len(str(int(x))) for x in a]
-    if any(v is None for v in lens):
-        return jnp.asarray(np.asarray(
-            [np.nan if v is None else float(v) for v in lens], np.float64),
-            float_dtype())
-    return jnp.asarray(np.asarray(lens, np.int32))
+    return _int_or_null(lens)
 
 
 def _fn_substring(s, pos, length):
@@ -545,11 +541,21 @@ def _fn_regexp_extract(s, pattern, idx):
     return _str_map(one, s)
 
 
+def _int_or_null(vals):
+    """int32 column, NaN-promoting to float when nulls are present (the
+    engine's numeric-null convention; Spark: null in → null out)."""
+    if any(v is None for v in vals):
+        return jnp.asarray(np.asarray(
+            [np.nan if v is None else float(v) for v in vals], np.float64),
+            float_dtype())
+    return jnp.asarray(np.asarray(vals, np.int32))
+
+
 def _fn_instr(s, sub):
     needle = _scalar_str(sub)
     arr = np.asarray(s, object)
-    return jnp.asarray(np.asarray(
-        [0 if x is None else x.find(needle) + 1 for x in arr], np.int32))
+    return _int_or_null(
+        [None if x is None else x.find(needle) + 1 for x in arr])
 
 
 def _fn_locate(sub, s, pos=None):
@@ -557,9 +563,9 @@ def _fn_locate(sub, s, pos=None):
     needle = _scalar_str(sub)
     start = (_scalar_int(pos) if pos is not None else 1)
     arr = np.asarray(s, object)
-    return jnp.asarray(np.asarray(
-        [0 if x is None else x.find(needle, max(start - 1, 0)) + 1
-         for x in arr], np.int32))
+    return _int_or_null(
+        [None if x is None else x.find(needle, max(start - 1, 0)) + 1
+         for x in arr])
 
 
 def _fn_lpad(s, length, pad):
@@ -1023,19 +1029,51 @@ def _date_field(which: str):
     return f
 
 
+def _parse_datetime_cell(x):
+    """Spark's implicit string→timestamp cast for one cell: full
+    timestamps ('yyyy-MM-dd HH:mm:ss', ISO 'T'), dates, and the partial
+    forms 'yyyy-MM' / 'yyyy' (missing fields default to 01 / midnight).
+    Returns a datetime or None."""
+    import datetime as _dt
+
+    if x is None:
+        return None
+    s = str(x).strip()
+    if not s:
+        return None
+    for fmt in ("%Y-%m-%d %H:%M:%S", "%Y-%m-%dT%H:%M:%S",
+                "%Y-%m-%d %H:%M", "%Y-%m-%dT%H:%M",
+                "%Y-%m-%d", "%Y-%m", "%Y"):
+        try:
+            return _dt.datetime.strptime(s, fmt)
+        except ValueError:
+            continue
+    # timestamp with fractional seconds: drop the fraction
+    head = s.split(".")[0]
+    if head != s:
+        for fmt in ("%Y-%m-%d %H:%M:%S", "%Y-%m-%dT%H:%M:%S"):
+            try:
+                return _dt.datetime.strptime(head, fmt)
+            except ValueError:
+                continue
+    return None
+
+
 def _days_of(v):
     """Epoch-day view of a date operand with Spark's implicit cast: string
-    (object) columns parse their DATE PREFIX as ``yyyy-MM-dd`` — Spark's
-    cast accepts timestamp-shaped strings ('2026-01-01 10:00:00',
-    ISO 'T' form) by reading the date part — unparseable/null → NaN;
-    numeric columns are epoch days already (``to_date`` output)."""
+    (object) columns accept full dates, timestamp-shaped strings (the
+    time part is dropped for day math), and partial 'yyyy[-MM]' forms —
+    unparseable/null → NaN; numeric columns are epoch days already
+    (``to_date`` output)."""
     if _is_object(v):
-        prefix = np.asarray(
-            [None if x is None
-             else str(x).strip().split()[0].split("T")[0] if str(x).strip()
-             else None
-             for x in v], object)
-        return _parse_dates(prefix, "yyyy-MM-dd", unit_seconds=False)
+        import datetime as _dt
+
+        epoch = _dt.date(1970, 1, 1)
+        out = np.empty(len(v), np.float64)
+        for i, x in enumerate(v):
+            t = _parse_datetime_cell(x)
+            out[i] = np.nan if t is None else (t.date() - epoch).days
+        return jnp.asarray(out, float_dtype())
     return jnp.asarray(v, float_dtype())
 
 
@@ -1055,7 +1093,13 @@ def _fn_date_format(days, fmt):
     import datetime as _dt
 
     py_fmt = _strptime_format(_scalar_str(fmt))
-    arr = np.asarray(_days_of(days), np.float64)
+    if _is_object(days):
+        # string input: Spark casts to TIMESTAMP, so time-of-day survives
+        # into HH/mm/ss format tokens
+        return np.asarray(
+            [None if (t := _parse_datetime_cell(x)) is None
+             else t.strftime(py_fmt) for x in days], object)
+    arr = np.asarray(days, np.float64)
     epoch = _dt.date(1970, 1, 1)
     return np.asarray(
         [None if np.isnan(v)

@@ -166,3 +166,19 @@ class TestImplicitStringDateCast:
         o = f.with_column("y", F.year(F.col("d"))).to_pydict()
         assert o["y"][0] == 2026.0 and o["y"][1] == 2026.0
         assert np.isnan(o["y"][2]) and np.isnan(o["y"][3])
+
+    def test_partial_dates_cast_like_spark(self):
+        f = Frame({"d": np.asarray(["2026", "2026-07", "2026-07-15"],
+                                   dtype=object)})
+        o = (f.with_column("y", F.year(F.col("d")))
+              .with_column("m", F.month(F.col("d")))).to_pydict()
+        assert list(o["y"]) == [2026.0, 2026.0, 2026.0]
+        assert list(o["m"]) == [1.0, 7.0, 7.0]      # missing fields -> 01
+
+    def test_date_format_preserves_time_of_day_for_strings(self):
+        f = Frame({"d": np.asarray(["2026-01-01 10:30:45", "2026-01-02"],
+                                   dtype=object)})
+        o = f.with_column("s", F.date_format(F.col("d"),
+                                             "yyyy-MM-dd HH:mm:ss"))
+        got = list(o.to_pydict()["s"])
+        assert got == ["2026-01-01 10:30:45", "2026-01-02 00:00:00"]
