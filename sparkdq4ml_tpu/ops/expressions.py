@@ -269,12 +269,77 @@ class Cast(Expr):
         self.child = child
         self.type_name = type_name
 
+    _BOOL_TRUE = frozenset(("true", "t", "yes", "y", "1"))
+    _BOOL_FALSE = frozenset(("false", "f", "no", "n", "0"))
+
     def eval(self, frame):
         v = self.child.eval(frame)
         dt = resolve_type_name(self.type_name)
         if isinstance(dt, np.dtype) and dt == object:
-            return np.asarray([str(x) for x in np.asarray(v)], dtype=object)
+            # to string: null stays null (numeric NaN is this engine's
+            # null, so it maps to None too, not the text 'nan')
+            a = v if _is_object(v) else np.asarray(v)
+            return np.asarray(
+                [None if x is None
+                 or (isinstance(x, (float, np.floating)) and np.isnan(x))
+                 else str(x) for x in a], dtype=object)
+        if _is_object(v):
+            return self._cast_strings(v, dt)
         return jnp.asarray(v).astype(dt)
+
+    def _cast_strings(self, v, dt):
+        """Spark string→numeric/boolean cast: trim, parse; unparseable /
+        null → null (NaN-float representation when nulls force it).
+        Booleans accept the word literals; integer targets parse integral
+        strings EXACTLY (no 2^53 float corruption) and truncate decimal
+        forms toward zero; underscores and non-finite values are rejected
+        for integer targets the way Spark rejects them."""
+        if np.dtype(dt) == np.bool_:
+            vals = []
+            for x in v:
+                if x is None:
+                    vals.append(None)
+                    continue
+                s = str(x).strip().lower()
+                vals.append(True if s in self._BOOL_TRUE else
+                            False if s in self._BOOL_FALSE else None)
+            if any(b is None for b in vals):
+                return jnp.asarray(np.asarray(
+                    [np.nan if b is None else float(b) for b in vals],
+                    np.float64), float_dtype())
+            return jnp.asarray(np.asarray(vals, np.bool_))
+
+        int_target = np.issubdtype(np.dtype(dt), np.integer)
+        parsed = np.empty(len(v), np.float64)
+        exact = np.zeros(len(v), np.int64)
+        all_exact_int = True
+        for i, x in enumerate(v):
+            if x is None:
+                parsed[i] = np.nan
+                all_exact_int = False
+                continue
+            s = str(x).strip()
+            if "_" in s:                  # Python literal syntax, not SQL
+                parsed[i] = np.nan
+                all_exact_int = False
+                continue
+            try:
+                exact[i] = int(s)         # exact (beyond 2^53) integral
+                parsed[i] = float(exact[i])
+                continue
+            except (ValueError, OverflowError):
+                all_exact_int = False
+            try:
+                parsed[i] = float(s)
+            except ValueError:
+                parsed[i] = np.nan
+        if int_target:
+            if all_exact_int:
+                return jnp.asarray(exact.astype(dt))
+            finite = np.isfinite(parsed)
+            whole = np.where(finite, np.trunc(parsed), np.nan)
+            return jnp.asarray(whole, float_dtype())
+        return jnp.asarray(parsed, dt)
 
     @property
     def name(self) -> str:
@@ -1029,34 +1094,36 @@ def _date_field(which: str):
     return f
 
 
-def _parse_datetime_cell(x):
-    """Spark's implicit string→timestamp cast for one cell: full
-    timestamps ('yyyy-MM-dd HH:mm:ss', ISO 'T'), dates, and the partial
-    forms 'yyyy-MM' / 'yyyy' (missing fields default to 01 / midnight).
-    Returns a datetime or None."""
-    import datetime as _dt
+_DATETIME_RE = None
 
+
+def _parse_datetime_cell(x):
+    """Spark's lenient implicit string→timestamp cast for one cell:
+    ``yyyy[-M[-d]][ T hh:mm[:ss[.fff]]][anything]`` — partial dates
+    default missing fields to 01/midnight, and trailing content
+    (timezone suffixes, junk after a complete prefix) is ignored like
+    Spark's ``stringToDate``/``stringToTimestamp``. Returns a datetime
+    or None."""
+    import datetime as _dt
+    import re
+
+    global _DATETIME_RE
+    if _DATETIME_RE is None:
+        _DATETIME_RE = re.compile(
+            r"^(\d{4})(?:-(\d{1,2})(?:-(\d{1,2})"
+            r"(?:[ T](\d{1,2}):(\d{2})(?::(\d{2})(?:\.\d+)?)?)?)?)?")
     if x is None:
         return None
     s = str(x).strip()
-    if not s:
+    m = _DATETIME_RE.match(s)
+    if not m:
         return None
-    for fmt in ("%Y-%m-%d %H:%M:%S", "%Y-%m-%dT%H:%M:%S",
-                "%Y-%m-%d %H:%M", "%Y-%m-%dT%H:%M",
-                "%Y-%m-%d", "%Y-%m", "%Y"):
-        try:
-            return _dt.datetime.strptime(s, fmt)
-        except ValueError:
-            continue
-    # timestamp with fractional seconds: drop the fraction
-    head = s.split(".")[0]
-    if head != s:
-        for fmt in ("%Y-%m-%d %H:%M:%S", "%Y-%m-%dT%H:%M:%S"):
-            try:
-                return _dt.datetime.strptime(head, fmt)
-            except ValueError:
-                continue
-    return None
+    y, mo, d, hh, mi, ss = m.groups()
+    try:
+        return _dt.datetime(int(y), int(mo or 1), int(d or 1),
+                            int(hh or 0), int(mi or 0), int(ss or 0))
+    except ValueError:          # e.g. month 13 / day 32
+        return None
 
 
 def _days_of(v):

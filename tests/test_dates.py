@@ -182,3 +182,11 @@ class TestImplicitStringDateCast:
                                              "yyyy-MM-dd HH:mm:ss"))
         got = list(o.to_pydict()["s"])
         assert got == ["2026-01-01 10:30:45", "2026-01-02 00:00:00"]
+
+    def test_timezone_and_trailing_content_ignored(self):
+        f = Frame({"d": np.asarray(
+            ["2026-01-01 10:00:00+09:00", "2026-01-01 10:00:00 UTC",
+             "2026-03-05 trailing junk", "2026-13-01"], dtype=object)})
+        o = f.with_column("y", F.year(F.col("d"))).to_pydict()
+        assert list(o["y"])[:3] == [2026.0, 2026.0, 2026.0]
+        assert np.isnan(o["y"][3])           # month 13 -> null, not wrap

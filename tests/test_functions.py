@@ -152,3 +152,68 @@ class TestLengthNullSemantics:
         f = Frame({"x": np.asarray([0.1, 2.5], np.float32)})
         o = f.with_column("l", F.length(F.col("x"))).to_pydict()["l"]
         assert list(np.asarray(o)) == [3, 3]      # '0.1', '2.5'
+
+
+class TestStringNumericCast:
+    """Spark CAST(string AS numeric): trim, parse, unparseable/null -> null,
+    int targets truncate toward zero via double."""
+
+    def test_cast_string_to_int_and_double(self):
+        from sparkdq4ml_tpu import Frame
+        f = Frame({"s": np.asarray(["12", "12.7", "x", None, " 3 ", "-2.9"],
+                                   dtype=object)})
+        o = (f.with_column("i", F.col("s").cast("int"))
+              .with_column("d", F.col("s").cast("double"))).to_pydict()
+        i = np.asarray(o["i"], np.float64)
+        d = np.asarray(o["d"], np.float64)
+        np.testing.assert_array_equal(i[[0, 1, 4, 5]], [12., 12., 3., -2.])
+        assert np.isnan(i[2]) and np.isnan(i[3])
+        np.testing.assert_allclose(d[[0, 1, 4, 5]], [12., 12.7, 3., -2.9],
+                                   rtol=1e-6)
+        assert np.isnan(d[2]) and np.isnan(d[3])
+
+    def test_cast_clean_int_strings_stay_int(self):
+        from sparkdq4ml_tpu import Frame
+        f = Frame({"s": np.asarray(["1", "2", "3"], dtype=object)})
+        o = f.with_column("i", F.col("s").cast("int")).to_pydict()["i"]
+        assert np.issubdtype(np.asarray(o).dtype, np.integer)
+
+    def test_sql_cast_string_column(self, session):
+        import sparkdq4ml_tpu as dq
+        from sparkdq4ml_tpu import Frame
+        f = Frame({"s": np.asarray(["10", "oops", "30"], dtype=object)})
+        f.create_or_replace_temp_view("t_cast")
+        out = session.sql("SELECT cast(s as double) v FROM t_cast")
+        v = np.asarray(out.to_pydict()["v"], np.float64)
+        assert v[0] == 10.0 and v[2] == 30.0 and np.isnan(v[1])
+
+    def test_cast_string_to_boolean_word_literals(self):
+        from sparkdq4ml_tpu import Frame
+        f = Frame({"s": np.asarray(["true", "FALSE", "yes", "0", "maybe",
+                                    None], dtype=object)})
+        o = np.asarray(f.with_column("b", F.col("s").cast("boolean"))
+                        .to_pydict()["b"], np.float64)
+        np.testing.assert_array_equal(o[:4], [1.0, 0.0, 1.0, 0.0])
+        assert np.isnan(o[4]) and np.isnan(o[5])
+
+    def test_cast_long_exact_beyond_2_53(self):
+        from sparkdq4ml_tpu import Frame
+        f = Frame({"s": np.asarray(["9007199254740993"], dtype=object)})
+        o = f.with_column("v", F.col("s").cast("long")).to_pydict()["v"]
+        assert int(np.asarray(o)[0]) == 9007199254740993
+
+    def test_cast_rejects_python_only_forms(self):
+        from sparkdq4ml_tpu import Frame
+        f = Frame({"s": np.asarray(["1_000", "inf", "5"], dtype=object)})
+        o = np.asarray(f.with_column("v", F.col("s").cast("int"))
+                        .to_pydict()["v"], np.float64)
+        assert np.isnan(o[0]) and np.isnan(o[1]) and o[2] == 5.0
+
+    def test_cast_to_string_null_stays_null(self):
+        from sparkdq4ml_tpu import Frame
+        f = Frame({"s": np.asarray(["a", None], dtype=object),
+                   "x": np.asarray([1.5, np.nan])})
+        o = (f.with_column("cs", F.col("s").cast("string"))
+              .with_column("cx", F.col("x").cast("string"))).to_pydict()
+        assert list(o["cs"]) == ["a", None]
+        assert o["cx"][0] == "1.5" and o["cx"][1] is None
