@@ -22,7 +22,10 @@ Grammar (recursive descent):
                   JOIN ident (ON ident '=' ident | USING '(' ident,* ')')
     select_list:= '*' | item (',' item)*
     item       := expr [OVER window] [[AS] ident]
-    window     := '(' [PARTITION BY ident,*] [ORDER BY ident [ASC|DESC],*] ')'
+    window     := '(' [PARTITION BY ident,*] [ORDER BY ident [ASC|DESC],*]
+                      [(ROWS|RANGE) BETWEEN bound AND bound] ')'
+    bound      := UNBOUNDED (PRECEDING|FOLLOWING) | CURRENT ROW
+                  | int (PRECEDING|FOLLOWING)
                   -- after a ranking fn (ROW_NUMBER/RANK/DENSE_RANK/
                   -- PERCENT_RANK/CUME_DIST/NTILE/LAG/LEAD) or an aggregate;
                   -- default frame RANGE UNBOUNDED PRECEDING..CURRENT ROW
@@ -275,9 +278,11 @@ class _Parser:
         return self.parse_item()
 
     def parse_window_spec(self):
-        """``( [PARTITION BY ident,*] [ORDER BY item,*] )`` after OVER.
-        The default frame (RANGE UNBOUNDED PRECEDING..CURRENT ROW) applies;
-        explicit ROWS/RANGE clauses are not in the grammar."""
+        """``( [PARTITION BY ident,*] [ORDER BY item,*]
+        [ROWS|RANGE BETWEEN bound AND bound] )`` after OVER, with
+        ``bound := UNBOUNDED PRECEDING|FOLLOWING | CURRENT ROW |
+        <n> PRECEDING|FOLLOWING`` — the same frames as the fluent
+        ``rowsBetween``/``rangeBetween`` API."""
         from ..frame.window import WindowSpec
 
         self.expect("op", "(")
@@ -292,8 +297,42 @@ class _Parser:
             order.append(self.parse_order_item())
             while self.accept("op", ","):
                 order.append(self.parse_order_item())
+        spec = WindowSpec(partition, order)
+        kind = None
+        if self.accept("ident", "rows"):
+            kind = "rows"
+        elif self.accept("ident", "range"):
+            kind = "range"
+        if kind is not None:
+            self.expect("kw", "between")
+            lo = self._parse_frame_bound()
+            self.expect("kw", "and")
+            hi = self._parse_frame_bound()
+            spec = (spec.rows_between(lo, hi) if kind == "rows"
+                    else spec.range_between(lo, hi))
         self.expect("op", ")")
-        return WindowSpec(partition, order)
+        return spec
+
+    def _parse_frame_bound(self) -> int:
+        from ..frame.window import Window
+
+        if self.accept("ident", "unbounded"):
+            if self.accept("ident", "preceding"):
+                return Window.unbounded_preceding
+            self.expect("ident", "following")
+            return Window.unbounded_following
+        if self.accept("ident", "current"):
+            self.expect("ident", "row")
+            return 0
+        n = self.expect("number").value
+        if float(n) != int(float(n)):
+            raise ValueError(f"SQL parse error: frame bound must be an "
+                             f"integer, got {n!r}")
+        off = int(float(n))
+        if self.accept("ident", "preceding"):
+            return -off
+        self.expect("ident", "following")
+        return off
 
     def _build_window_fn(self, fn: str, col, args: list):
         """Bind a parsed ``fn(args...)`` to a WindowFunction (pre-OVER)."""

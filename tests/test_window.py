@@ -360,3 +360,57 @@ class TestExplicitFrames:
         a = f.withColumn("r", F.row_number().over(w0)).to_pydict()["r"]
         b = f.withColumn("r", F.row_number().over(w1)).to_pydict()["r"]
         assert list(a) == list(b)
+
+
+class TestSqlFrames:
+    """ROWS/RANGE BETWEEN in the SQL OVER clause."""
+
+    def _cat(self):
+        from sparkdq4ml_tpu.sql.catalog import Catalog
+        cat = Catalog()
+        f = Frame({"g": np.asarray(["a", "a", "a", "b", "b"], dtype=object),
+                   "t": np.asarray([1, 2, 3, 1, 2], np.int64),
+                   "v": np.asarray([1.0, 2.0, 3.0, 10.0, 20.0])})
+        cat.register("t1", f)
+        return cat
+
+    def test_rows_between_preceding_current(self):
+        from sparkdq4ml_tpu.sql.parser import execute
+        out = execute(
+            "SELECT g, t, SUM(v) OVER (PARTITION BY g ORDER BY t "
+            "ROWS BETWEEN 1 PRECEDING AND CURRENT ROW) AS rs FROM t1",
+            self._cat())
+        assert list(np.asarray(out.to_pydict()["rs"], np.float64)) == \
+            [1.0, 3.0, 5.0, 10.0, 30.0]
+
+    def test_range_unbounded_both(self):
+        from sparkdq4ml_tpu.sql.parser import execute
+        out = execute(
+            "SELECT g, AVG(v) OVER (PARTITION BY g RANGE BETWEEN "
+            "UNBOUNDED PRECEDING AND UNBOUNDED FOLLOWING) AS m FROM t1",
+            self._cat())
+        assert list(np.asarray(out.to_pydict()["m"], np.float64)) == \
+            [2.0, 2.0, 2.0, 15.0, 15.0]
+
+    def test_rows_following_window(self):
+        from sparkdq4ml_tpu.sql.parser import execute
+        out = execute(
+            "SELECT g, SUM(v) OVER (PARTITION BY g ORDER BY t "
+            "ROWS BETWEEN CURRENT ROW AND 1 FOLLOWING) AS s FROM t1",
+            self._cat())
+        assert list(np.asarray(out.to_pydict()["s"], np.float64)) == \
+            [3.0, 5.0, 3.0, 30.0, 20.0]
+
+    def test_bad_frame_syntax_raises(self):
+        from sparkdq4ml_tpu.sql.parser import execute
+        with pytest.raises(ValueError):
+            execute("SELECT SUM(v) OVER (PARTITION BY g ORDER BY t "
+                    "ROWS BETWEEN garbage AND CURRENT ROW) AS s FROM t1",
+                    self._cat())
+
+    def test_non_integer_bound_rejected(self):
+        from sparkdq4ml_tpu.sql.parser import execute
+        with pytest.raises(ValueError, match="integer"):
+            execute("SELECT SUM(v) OVER (PARTITION BY g ORDER BY t "
+                    "ROWS BETWEEN 1.7 PRECEDING AND CURRENT ROW) AS s "
+                    "FROM t1", self._cat())
