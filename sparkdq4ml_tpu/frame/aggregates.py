@@ -13,6 +13,7 @@ the honest design; the device path is reserved for the numeric hot loops.
 
 from __future__ import annotations
 
+import builtins
 from typing import Optional, Union
 
 import jax.numpy as jnp
@@ -21,6 +22,7 @@ import numpy as np
 from ..ops.expressions import Expr
 
 _AGGS = ("count", "sum", "avg", "mean", "min", "max", "stddev", "variance",
+         "stddev_pop", "var_pop", "median", "mode", "percentile_approx",
          "count_distinct", "sum_distinct", "collect_list", "collect_set",
          "first", "last", "skewness", "kurtosis",
          "corr", "covar_samp", "covar_pop")
@@ -36,7 +38,8 @@ class AggExpr:
     def __init__(self, fn: str, column: Optional[str],
                  alias: Optional[str] = None,
                  column2: Optional[str] = None,
-                 ignore_nulls: bool = False):
+                 ignore_nulls: bool = False,
+                 param=None):
         fn = fn.lower()
         if fn not in _AGGS:
             raise ValueError(f"unknown aggregate {fn!r} (supported: {_AGGS})")
@@ -49,11 +52,12 @@ class AggExpr:
         self.column = column  # None = count(*)
         self.column2 = column2
         self.ignore_nulls = bool(ignore_nulls)  # first/last only
+        self.param = param                       # percentile_approx only
         self._alias = alias
 
     def alias(self, name: str) -> "AggExpr":
         return AggExpr(self.fn, self.column, name, self.column2,
-                       self.ignore_nulls)
+                       self.ignore_nulls, self.param)
 
     @property
     def name(self) -> str:
@@ -69,6 +73,8 @@ class AggExpr:
             # Spark encodes the flag in the name ("first(x, true)");
             # also keeps the two variants from colliding in one agg() call
             return f"{self.fn}({self.column}, true)"
+        if self.fn == "percentile_approx":
+            return f"percentile_approx({self.column}, {self.param})"
         target = "1" if self.column is None else self.column
         return f"{self.fn}({target})"
 
@@ -117,6 +123,33 @@ def stddev(col: str) -> AggExpr:
 
 def variance(col: str) -> AggExpr:
     return AggExpr("variance", col)
+
+
+def stddev_pop(col: str) -> AggExpr:
+    return AggExpr("stddev_pop", col)
+
+
+def var_pop(col: str) -> AggExpr:
+    return AggExpr("var_pop", col)
+
+
+def median(col: str) -> AggExpr:
+    return AggExpr("median", col)
+
+
+def mode(col: str) -> AggExpr:
+    return AggExpr("mode", col)
+
+
+def percentile_approx(col: str, percentage: float,
+                      accuracy: int = 10000) -> AggExpr:
+    """Spark's approximate percentile; this engine computes the EXACT
+    nearest-rank order statistic (groups are host-resident, the sort is
+    cheaper than a sketch), so ``accuracy`` is accepted for API
+    compatibility and the answer has zero error."""
+    if not 0.0 <= float(percentage) <= 1.0:
+        raise ValueError(f"percentage must be in [0, 1], got {percentage}")
+    return AggExpr("percentile_approx", col, param=float(percentage))
 
 
 def count_distinct(col: str) -> AggExpr:
@@ -214,7 +247,8 @@ def _drop_nulls(values: np.ndarray) -> np.ndarray:
     return values
 
 
-def _np_agg(fn: str, values: np.ndarray, ignore_nulls: bool = False):
+def _np_agg(fn: str, values: np.ndarray, ignore_nulls: bool = False,
+            param=None):
     if fn in ("first", "last"):
         # Spark's first/last default ignoreNulls=false: the raw first/last
         # row value, null included
@@ -248,6 +282,26 @@ def _np_agg(fn: str, values: np.ndarray, ignore_nulls: bool = False):
         return float(np.std(values, ddof=1)) if len(values) > 1 else float("nan")
     if fn == "variance":
         return float(np.var(values, ddof=1)) if len(values) > 1 else float("nan")
+    if fn == "stddev_pop":
+        return float(np.std(values, ddof=0))
+    if fn == "var_pop":
+        return float(np.var(values, ddof=0))
+    if fn == "median":
+        return float(np.median(np.asarray(values, np.float64)))
+    if fn == "mode":
+        # most frequent value; ties break to the smallest (deterministic —
+        # Spark leaves tie order unspecified)
+        uniq, cnt = np.unique(np.asarray(values), return_counts=True)
+        return uniq[np.lexsort((uniq, -cnt))[0]]
+    if fn == "percentile_approx":
+        # exact nearest-rank order statistic: the smallest value whose
+        # cumulative rank >= ceil(p*n) (Spark's convention — e.g.
+        # p=0.5 over [1, 5] is 1, not 5). Spark's sketch bounds memory;
+        # the exact sort here is cheaper and has zero error.
+        v = np.sort(np.asarray(values, np.float64))
+        p = float(param if param is not None else 0.5)
+        idx = builtins.max(int(np.ceil(p * len(v))) - 1, 0)
+        return float(v[builtins.min(idx, len(v) - 1)])
     if fn in ("skewness", "kurtosis"):
         # Spark: population moments; kurtosis is EXCESS kurtosis
         v = np.asarray(values, np.float64)
@@ -313,7 +367,7 @@ def global_agg(frame, aggs: list[AggExpr]):
         if agg.fn not in _DEVICE_AGGS:
             m = np.asarray(mask)
             vals = np.asarray(frame._column_values(agg.column))[m]
-            res = _np_agg(agg.fn, vals, agg.ignore_nulls)
+            res = _np_agg(agg.fn, vals, agg.ignore_nulls, agg.param)
             # list results AND non-numeric scalars (first/last of a string
             # column) must stay object slots — np.asarray would mint a
             # unicode array the device column layer rejects
@@ -437,7 +491,8 @@ class GroupedFrame(_AggShortcuts):
                         np.asarray(d[a.column2])[idx]))
                 else:
                     data[a.name].append(_np_agg(
-                        a.fn, np.asarray(d[a.column])[idx], a.ignore_nulls))
+                        a.fn, np.asarray(d[a.column])[idx], a.ignore_nulls,
+                        a.param))
         # list-valued aggregate columns must stay ragged object arrays
         for a in agg_list:
             if a.fn in ("collect_list", "collect_set"):
@@ -533,7 +588,8 @@ class PivotedFrame(_AggShortcuts):
                             agg_arrays[a.column2][sub]))
                     else:
                         data[names[(vi, ai)]].append(_np_agg(
-                            a.fn, agg_arrays[a.column][sub], a.ignore_nulls))
+                            a.fn, agg_arrays[a.column][sub], a.ignore_nulls,
+                            a.param))
         from .frame import list_column
 
         for (vi, ai), nm in names.items():

@@ -7,20 +7,22 @@ from .frame.aggregates import (approx_count_distinct,
                                approxCountDistinct, avg, collect_list, collect_set, corr, count,
                                count_distinct, countDistinct, covar_pop,
                                covar_samp, first, kurtosis, last, max, mean,
-                               min, skewness, stddev, sum, sum_distinct,
-                               sumDistinct, variance)
+                               median, min, mode, percentile_approx,
+                               skewness, stddev, stddev_pop, sum,
+                               sum_distinct, sumDistinct, var_pop, variance)
 from .frame.window import (Window, WindowSpec, cume_dist, dense_rank, lag,
                            lead, ntile, percent_rank, rank, row_number)
-from .ops.expressions import (acos, asin, atan, atan2, call_udf, callUDF,
-                              cbrt, ceil, coalesce, col, concat, concat_ws,
-                              cos, cosh, degrees, exp, expm1, floor, fn,
-                              greatest, hypot, initcap, instr, isnan, isnull,
-                              least, length, lit, locate, log, log1p, log2,
-                              log10, lower, lpad, ltrim, pow, radians,
-                              regexp_extract, regexp_replace, repeat,
-                              reverse, rint, rpad, rtrim, signum, sin, sinh,
-                              split, sqrt, substring, tan, tanh, translate,
-                              trim, upper, when)
+from .ops.expressions import (acos, asin, atan, atan2, base64, call_udf,
+                              callUDF, cbrt, ceil, coalesce, col, concat,
+                              concat_ws, cos, cosh, degrees, exp, expm1,
+                              floor, fn, greatest, hypot, initcap, instr,
+                              isnan, isnull, least, length, lit, locate,
+                              log, log1p, log2, log10, lower, lpad, ltrim,
+                              md5, nvl, pow, radians, regexp_extract,
+                              regexp_replace, repeat, reverse, rint, rpad,
+                              rtrim, sha1, sha2, signum, sin, sinh, split,
+                              sqrt, substring, tan, tanh, translate, trim,
+                              unbase64, upper, when)
 from .ops.expressions import (current_date, date_add, date_format, date_sub,
                               datediff, dayofmonth, dayofweek, dayofyear,
                               from_unixtime, month, quarter, to_date,
@@ -36,7 +38,7 @@ __all__ = ["col", "lit", "call_udf", "callUDF", "count", "sum", "avg",
            "skewness", "kurtosis", "corr", "covar_samp", "covar_pop",
            "abs", "sqrt", "exp", "log", "log10", "pow", "floor", "ceil",
            "round", "signum", "greatest", "least", "isnan", "isnull",
-           "coalesce", "when", "fn",
+           "coalesce", "nvl", "when", "fn", "md5", "sha1", "sha2", "base64", "unbase64", "median", "mode", "percentile_approx", "stddev_pop", "var_pop",
            "upper", "lower", "trim", "ltrim", "rtrim", "length", "concat",
            "substring",
            "sin", "cos", "tan", "asin", "acos", "atan", "atan2",

@@ -14,7 +14,9 @@ XLA fuses — the per-row boundary does not exist.
 
 from __future__ import annotations
 
+import base64 as _b64
 import functools
+import hashlib
 import re
 from typing import Any, Callable, Sequence
 
@@ -540,6 +542,20 @@ def _fn_length(s):
     return _int_or_null(lens)
 
 
+def _fn_sha2(s, n):
+    """Spark ``sha2(col, bitLength)``: bitLength in {0, 224, 256, 384,
+    512} (0 means 256); anything else yields null per row (Spark's
+    behavior), validated ONCE — not a per-row hashlib error."""
+    bits = _scalar_int(n)
+    if bits == 0:
+        bits = 256
+    if bits not in (224, 256, 384, 512):
+        a = np.asarray(s, object)
+        return np.full(len(a), None, dtype=object)
+    algo = f"sha{bits}"
+    return _str_map(lambda x: hashlib.new(algo, x.encode()).hexdigest(), s)
+
+
 def _fn_substring(s, pos, length):
     # Spark substring is 1-based; pos 0 behaves like 1.
     p = int(np.asarray(pos)[0])
@@ -724,6 +740,17 @@ _BUILTIN_FNS = {
     "rtrim": lambda s: _str_map(str.rstrip, s),
     "length": _fn_length,
     "concat": lambda *ss: _str_map(lambda *xs: "".join(str(x) for x in xs), *ss),
+    "md5": lambda s: _str_map(
+        lambda x: hashlib.md5(x.encode()).hexdigest(), s),
+    "sha1": lambda s: _str_map(
+        lambda x: hashlib.sha1(x.encode()).hexdigest(), s),
+    "sha2": _fn_sha2,
+    "base64": lambda s: _str_map(
+        lambda x: _b64.b64encode(x.encode()).decode(), s),
+    # Spark's unbase64 yields BINARY; string cells here hold the bytes as
+    # latin-1 (lossless byte-per-char), so non-UTF8 payloads can't crash
+    "unbase64": lambda s: _str_map(
+        lambda x: _b64.b64decode(x.encode()).decode("latin-1"), s),
     "substring": _fn_substring,
     "substr": _fn_substring,
     "concat_ws": _fn_concat_ws,
@@ -883,6 +910,12 @@ greatest = _make_fn("greatest")
 least = _make_fn("least")
 isnan = _make_fn("isnan")
 coalesce = _make_fn("coalesce")
+nvl = _make_fn("coalesce")          # Spark: nvl(a, b) == coalesce(a, b)
+md5 = _make_fn("md5")
+sha1 = _make_fn("sha1")
+sha2 = _make_fn("sha2")
+base64 = _make_fn("base64")
+unbase64 = _make_fn("unbase64")
 upper = _make_fn("upper")
 lower = _make_fn("lower")
 trim = _make_fn("trim")

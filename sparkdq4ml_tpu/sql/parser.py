@@ -73,8 +73,11 @@ _KEYWORDS = {"select", "from", "where", "as", "and", "or", "not", "cast",
 # inside a window spec), so columns named "over"/"partition" keep working.
 
 _AGG_FNS = {"count", "sum", "avg", "mean", "min", "max", "stddev", "variance",
+            "stddev_pop", "var_pop", "median", "mode",
             "collect_list", "collect_set", "first", "last",
             "skewness", "kurtosis"}
+# percentile_approx(col, p[, accuracy]) takes a literal percentage
+_AGG_FNS_PCT = {"percentile_approx", "approx_percentile"}
 # two-column aggregates: CORR(a, b), COVAR_SAMP(a, b), COVAR_POP(a, b)
 _AGG_FNS_2 = {"corr", "covar_samp", "covar_pop"}
 _WINDOW_FNS = {"row_number", "rank", "dense_rank", "percent_rank",
@@ -348,6 +351,10 @@ class _Parser:
             if len(args) != 1 or not isinstance(args[0], E.Lit):
                 raise ValueError("ntile(n) requires an integer literal")
             return W.ntile(int(args[0].value)).over
+        if fl in _AGG_FNS_PCT:
+            raise ValueError(
+                f"windowed {fl}() is not supported (Spark <=2.x SQL "
+                "windows the running aggregates only)")
         if fl in ("lag", "lead"):
             if not args or not isinstance(args[0], E.Col):
                 raise ValueError(f"{fl}(col[, offset[, default]]) requires a "
@@ -370,7 +377,8 @@ class _Parser:
         # SUM(price) OVER (...), ...
         t = self.peek()
         if (t.kind == "ident"
-                and t.value.lower() in (_AGG_FNS | _AGG_FNS_2 | _WINDOW_FNS)
+                and t.value.lower() in (_AGG_FNS | _AGG_FNS_2
+                                        | _AGG_FNS_PCT | _WINDOW_FNS)
                 and self.toks[self.i + 1].kind == "op"
                 and self.toks[self.i + 1].value == "("):
             from ..frame.aggregates import AggExpr
@@ -408,6 +416,15 @@ class _Parser:
             elif fn.lower() in _AGG_FNS:
                 _check_agg_args(fn, col, args)
                 expr = AggExpr(fn, col)
+            elif fn.lower() in _AGG_FNS_PCT:
+                if (len(args) not in (2, 3) or not isinstance(args[0], E.Col)
+                        or not isinstance(args[1], E.Lit)):
+                    raise ValueError(
+                        f"{fn}(col, percentage[, accuracy]) requires a "
+                        "column and a literal percentage")
+                from ..frame.aggregates import percentile_approx as _pa
+
+                expr = _pa(args[0].name, float(args[1].value))
             else:
                 raise ValueError(f"window function {fn}() requires an "
                                  "OVER clause")

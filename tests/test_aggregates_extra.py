@@ -281,3 +281,94 @@ class TestApproxCountDistinct:
     def test_rsd_validated(self):
         with pytest.raises(ValueError, match="rsd"):
             F.approx_count_distinct("x", rsd=1.5)
+
+
+class TestRound4Aggregates:
+    """stddev_pop/var_pop/median/mode/percentile_approx (fluent + SQL)."""
+
+    def _frame(self):
+        return Frame({"k": np.asarray([0, 0, 1, 1, 1], np.int64),
+                      "v": np.asarray([1.0, 5.0, 2.0, 2.0, 8.0])})
+
+    def test_population_moments(self):
+        out = (self._frame().group_by("k")
+               .agg(F.stddev_pop("v").alias("sp"),
+                    F.var_pop("v").alias("vp")).sort("k").to_pydict())
+        np.testing.assert_allclose(out["sp"], [2.0, np.sqrt(8.0)], rtol=1e-6)
+        np.testing.assert_allclose(out["vp"], [4.0, 8.0], rtol=1e-6)
+
+    def test_median_mode_percentile(self):
+        out = (self._frame().group_by("k")
+               .agg(F.median("v").alias("m"), F.mode("v").alias("mo"),
+                    F.percentile_approx("v", 0.5).alias("p50"))
+               .sort("k").to_pydict())
+        np.testing.assert_allclose(out["m"], [3.0, 2.0])
+        np.testing.assert_allclose(out["mo"], [1.0, 2.0])  # tie -> smallest
+        # Spark's rank convention: smallest value with cumulative rank
+        # >= ceil(p*n) — p50 of [1, 5] is 1, not 5
+        np.testing.assert_allclose(out["p50"], [1.0, 2.0])
+
+    def test_global_agg_forms(self):
+        f = self._frame()
+        out = f.agg(F.median("v").alias("m"),
+                    F.percentile_approx("v", 0.9).alias("p")).to_pydict()
+        assert out["m"][0] == 2.0
+        assert out["p"][0] == 8.0
+
+    def test_sql_forms(self, session):
+        f = self._frame()
+        f.create_or_replace_temp_view("t_r4agg")
+        out = session.sql(
+            "SELECT k, MEDIAN(v) AS m, MODE(v) AS mo, STDDEV_POP(v) AS sp, "
+            "PERCENTILE_APPROX(v, 0.9) AS p FROM t_r4agg GROUP BY k")
+        d = out.sort("k").to_pydict()
+        np.testing.assert_allclose(d["m"], [3.0, 2.0])
+        np.testing.assert_allclose(d["p"], [5.0, 8.0])
+
+    def test_percentile_validation(self):
+        with pytest.raises(ValueError, match="percentage"):
+            F.percentile_approx("v", 1.5)
+
+
+class TestHashAndEncodingFns:
+    def test_md5_sha_base64(self):
+        import base64 as b64
+        import hashlib
+        f = Frame({"s": np.asarray(["abc", None], dtype=object)})
+        o = (f.with_column("m", F.md5(F.col("s")))
+              .with_column("h1", F.sha1(F.col("s")))
+              .with_column("b", F.base64(F.col("s")))
+              .with_column("u", F.unbase64(F.base64(F.col("s"))))).to_pydict()
+        assert o["m"][0] == hashlib.md5(b"abc").hexdigest()
+        assert o["h1"][0] == hashlib.sha1(b"abc").hexdigest()
+        assert o["b"][0] == b64.b64encode(b"abc").decode()
+        assert o["u"][0] == "abc"
+        assert o["m"][1] is None and o["b"][1] is None   # null propagates
+
+    def test_nvl_is_coalesce(self):
+        f = Frame({"x": np.asarray([np.nan, 2.0])})
+        o = f.with_column("n", F.nvl(F.col("x"), F.lit(9.0))).to_pydict()
+        np.testing.assert_allclose(np.asarray(o["n"]), [9.0, 2.0])
+
+    def test_percentile_rank_boundary_matches_spark(self):
+        f = Frame({"v": np.asarray([1.0, 5.0])})
+        out = f.agg(F.percentile_approx("v", 0.5).alias("p")).to_pydict()
+        assert out["p"][0] == 1.0        # ceil(0.5*2)=1 -> first element
+
+    def test_sha2_invalid_bits_yields_null(self):
+        f = Frame({"s": np.asarray(["abc"], dtype=object)})
+        o = f.with_column("h", F.sha2(F.col("s"), 128)).to_pydict()
+        assert o["h"][0] is None          # Spark: invalid bitLength -> null
+
+    def test_unbase64_binary_payload_survives(self):
+        f = Frame({"s": np.asarray(["/w=="], dtype=object)})  # byte 0xFF
+        o = f.with_column("u", F.unbase64(F.col("s"))).to_pydict()
+        assert o["u"][0] == "\xff"        # latin-1 byte-per-char, no crash
+
+    def test_windowed_percentile_clear_error(self, session):
+        f = Frame({"k": np.asarray([0, 1], np.int64),
+                   "v": np.asarray([1.0, 2.0])})
+        f.create_or_replace_temp_view("t_wp")
+        with pytest.raises(ValueError, match="windowed percentile_approx"):
+            session.sql("SELECT PERCENTILE_APPROX(v, 0.5) OVER "
+                        "(PARTITION BY k) AS p FROM t_wp")

@@ -42,7 +42,7 @@ class TestGlobalAgg:
         from sparkdq4ml_tpu.frame.aggregates import AggExpr
 
         with pytest.raises(ValueError):
-            AggExpr("median", "p")
+            AggExpr("zorblify", "p")
 
 
 class TestGroupBy:
