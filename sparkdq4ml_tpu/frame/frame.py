@@ -314,9 +314,18 @@ class Frame:
         return self._with(data=data)
 
     def filter(self, condition: Union[Expr, jnp.ndarray]) -> "Frame":
-        """AND a predicate into the validity mask (static shapes preserved)."""
+        """AND a predicate into the validity mask (static shapes preserved).
+
+        SQL three-valued logic: a NULL predicate (NaN in this engine's
+        float encoding — e.g. ``array_contains`` over a null cell) drops
+        the row, exactly like Spark's WHERE. A bare ``NaN.astype(bool)``
+        would be True and silently keep null rows."""
         cond = condition.eval(self) if isinstance(condition, Expr) else jnp.asarray(condition)
-        return self._with(mask=jnp.logical_and(self._mask, cond.astype(jnp.bool_)))
+        if jnp.issubdtype(cond.dtype, jnp.floating):
+            keep = jnp.logical_and(~jnp.isnan(cond), cond != 0)
+        else:
+            keep = cond.astype(jnp.bool_)
+        return self._with(mask=jnp.logical_and(self._mask, keep))
 
     where = filter
 

@@ -12,7 +12,8 @@ from .frame.aggregates import (approx_count_distinct,
                                sum_distinct, sumDistinct, var_pop, variance)
 from .frame.window import (Window, WindowSpec, cume_dist, dense_rank, lag,
                            lead, ntile, percent_rank, rank, row_number)
-from .ops.expressions import (acos, asin, atan, atan2, base64, call_udf,
+from .ops.expressions import (acos, array_contains, asin, atan, atan2,
+                              base64, call_udf, element_at, size,
                               callUDF, cbrt, ceil, coalesce, col, concat,
                               concat_ws, cos, cosh, degrees, exp, expm1,
                               floor, fn, greatest, hypot, initcap, instr,
@@ -38,7 +39,7 @@ __all__ = ["col", "lit", "call_udf", "callUDF", "count", "sum", "avg",
            "skewness", "kurtosis", "corr", "covar_samp", "covar_pop",
            "abs", "sqrt", "exp", "log", "log10", "pow", "floor", "ceil",
            "round", "signum", "greatest", "least", "isnan", "isnull",
-           "coalesce", "nvl", "when", "fn", "md5", "sha1", "sha2", "base64", "unbase64", "median", "mode", "percentile_approx", "stddev_pop", "var_pop",
+           "coalesce", "nvl", "when", "fn", "md5", "sha1", "sha2", "base64", "unbase64", "median", "mode", "percentile_approx", "stddev_pop", "var_pop", "array_contains", "element_at", "size",
            "upper", "lower", "trim", "ltrim", "rtrim", "length", "concat",
            "substring",
            "sin", "cos", "tan", "asin", "acos", "atan", "atan2",

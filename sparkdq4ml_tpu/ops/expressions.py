@@ -564,26 +564,26 @@ def _fn_substring(s, pos, length):
     return _str_map(lambda x: x[start:start + ln], s)
 
 
-def _scalar_str(v) -> str:
-    """A literal string argument (pattern/pad/separator), row-broadcast by
-    Lit.eval — take the scalar back out. A column-valued argument (more
-    than one distinct value) is rejected rather than silently collapsed
-    to row 0's value."""
+def _scalar_value(v):
+    """A literal argument of any type, row-broadcast by Lit.eval — take
+    the scalar back out. A column-valued argument (more than one distinct
+    value) is rejected rather than silently collapsed to row 0's value.
+    Single base for :func:`_scalar_str` / :func:`_scalar_int`."""
     arr = np.asarray(v, object).ravel()
     if len(arr) > 1 and any(x != arr[0] for x in arr[1:]):
         raise ValueError(
-            "this string-function argument must be a literal, not a "
-            "column (per-row patterns/pads are not supported)")
-    return arr[0]
+            "this function argument must be a literal, not a column "
+            "(per-row values are not supported)")
+    x = arr[0]
+    return x.item() if hasattr(x, "item") else x
+
+
+def _scalar_str(v) -> str:
+    return _scalar_value(v)
 
 
 def _scalar_int(v) -> int:
-    arr = np.asarray(v).ravel()
-    if len(arr) > 1 and np.any(arr[1:] != arr[0]):
-        raise ValueError(
-            "this string-function argument must be a literal, not a "
-            "column (per-row lengths/counts are not supported)")
-    return int(arr[0])
+    return int(_scalar_value(v))
 
 
 def _fn_concat_ws(sep, *ss):
@@ -603,6 +603,63 @@ def _fn_concat_ws(sep, *ss):
 def _fn_split(s, pattern):
     pat = re.compile(_scalar_str(pattern))
     return _str_map(lambda x: pat.split(x), s)
+
+
+def _require_array_cells(arr, fn_name):
+    """Spark's analyzer rejects array functions on non-array input; the
+    equivalent here is a host check on the first non-null cell (a plain
+    string column would otherwise give plausible character-level
+    results)."""
+    a = np.asarray(arr, object)
+    for cell in a:
+        if cell is None:
+            continue
+        if not isinstance(cell, (list, tuple, np.ndarray)):
+            raise ValueError(
+                f"{fn_name}() expects an array column (e.g. split() or "
+                f"collect_list() output), got a {type(cell).__name__} cell")
+        break
+    return a
+
+
+def _fn_array_contains(arr, value):
+    """Spark ``array_contains(col, value)``: null cell → null; the value
+    is a literal scalar. List cells come from ``split``/``collect_list``."""
+    v = _scalar_value(value)
+    out = []
+    for cell in _require_array_cells(arr, "array_contains"):
+        out.append(None if cell is None else bool(v in cell))
+    if any(x is None for x in out):
+        return jnp.asarray(np.asarray(
+            [np.nan if x is None else float(x) for x in out], np.float64),
+            float_dtype())
+    return jnp.asarray(np.asarray(out, np.bool_))
+
+
+def _fn_element_at(arr, index):
+    """Spark ``element_at(col, i)``: 1-based, negative counts from the
+    end, out-of-bounds / null cell → null."""
+    i = _scalar_int(index)
+    if i == 0:
+        raise ValueError("element_at index is 1-based; 0 is invalid")
+    out = []
+    for cell in _require_array_cells(arr, "element_at"):
+        if cell is None:
+            out.append(None)
+            continue
+        pos = i - 1 if i > 0 else len(cell) + i
+        out.append(cell[pos] if 0 <= pos < len(cell) else None)
+    return np.asarray(out, object)
+
+
+def _fn_array_size(arr):
+    """Spark ``size(col)``: length of a list cell; null → -1. This is
+    Spark 2.4's sizeOfNull=true default — the parity target here is the
+    reference's pinned Spark 2.4.4 (`pom.xml:14`); Spark 3 flipped the
+    default to null."""
+    return jnp.asarray(np.asarray(
+        [-1 if cell is None else len(cell)
+         for cell in _require_array_cells(arr, "size")], np.int32))
 
 
 def _fn_regexp_replace(s, pattern, replacement):
@@ -755,6 +812,9 @@ _BUILTIN_FNS = {
     "substr": _fn_substring,
     "concat_ws": _fn_concat_ws,
     "split": _fn_split,
+    "array_contains": _fn_array_contains,
+    "element_at": _fn_element_at,
+    "size": _fn_array_size,
     "regexp_replace": _fn_regexp_replace,
     "regexp_extract": _fn_regexp_extract,
     "instr": _fn_instr,
@@ -915,6 +975,20 @@ md5 = _make_fn("md5")
 sha1 = _make_fn("sha1")
 sha2 = _make_fn("sha2")
 base64 = _make_fn("base64")
+def array_contains(col_, value) -> Func:
+    """PySpark shape: the value is a plain literal (or a Lit), never a
+    column reference."""
+    return Func("array_contains",
+                [_coerce(col_), value if isinstance(value, Expr)
+                 else Lit(value)])
+
+
+def element_at(col_, index: int) -> Func:
+    return Func("element_at", [_coerce(col_), Lit(int(index))])
+
+
+def size(col_) -> Func:
+    return Func("size", [_coerce(col_)])
 unbase64 = _make_fn("unbase64")
 upper = _make_fn("upper")
 lower = _make_fn("lower")

@@ -145,3 +145,57 @@ class TestReviewRegressions:
     def test_translate_first_mapping_wins(self, strs):
         got = one_col(strs, F.translate(F.col("t"), "xx", "12"))
         assert got[0] == "1"
+
+
+class TestArrayFunctions:
+    """array_contains / element_at / size over list cells (split output)."""
+
+    def _frame(self):
+        from sparkdq4ml_tpu import Frame
+        return Frame({"s": np.asarray(["a,b,c", "x", None], dtype=object)})
+
+    def test_array_contains(self):
+        f = self._frame().with_column("arr", F.split(F.col("s"), ","))
+        o = np.asarray(f.with_column("h", F.array_contains(F.col("arr"),
+                                                           F.lit("b")))
+                        .to_pydict()["h"], np.float64)
+        assert o[0] == 1.0 and o[1] == 0.0
+        assert np.isnan(o[2])                  # null cell -> null
+
+    def test_element_at_one_based_and_negative(self):
+        f = self._frame().with_column("arr", F.split(F.col("s"), ","))
+        o = (f.with_column("e2", F.element_at(F.col("arr"), 2))
+              .with_column("last", F.element_at(F.col("arr"), -1))
+              .with_column("oob", F.element_at(F.col("arr"), 9))).to_pydict()
+        assert list(o["e2"]) == ["b", None, None]
+        assert list(o["last"]) == ["c", "x", None]
+        assert list(o["oob"]) == [None, None, None]
+
+    def test_element_at_zero_rejected(self):
+        f = self._frame().with_column("arr", F.split(F.col("s"), ","))
+        with pytest.raises(ValueError, match="1-based"):
+            f.with_column("z", F.element_at(F.col("arr"), 0)).to_pydict()
+
+    def test_size_with_legacy_null(self):
+        f = self._frame().with_column("arr", F.split(F.col("s"), ","))
+        o = f.with_column("n", F.size(F.col("arr"))).to_pydict()["n"]
+        assert list(np.asarray(o)) == [3, 1, -1]   # Spark 2.4 sizeOfNull
+
+    def test_null_predicate_drops_row_in_filter(self):
+        # SQL three-valued logic: WHERE over a null predicate excludes
+        # the row (a bare NaN->bool cast would keep it)
+        f = self._frame().with_column("arr", F.split(F.col("s"), ","))
+        kept = f.filter(F.array_contains(F.col("arr"), F.lit("b")))
+        assert kept.count() == 1
+        assert list(kept.to_pydict()["s"]) == ["a,b,c"]
+
+    def test_bare_string_value_is_literal(self):
+        f = self._frame().with_column("arr", F.split(F.col("s"), ","))
+        o = np.asarray(f.with_column("h", F.array_contains(F.col("arr"), "b"))
+                        .to_pydict()["h"], np.float64)
+        assert o[0] == 1.0 and o[1] == 0.0
+
+    def test_non_array_column_rejected(self):
+        f = self._frame()
+        with pytest.raises(ValueError, match="array column"):
+            f.with_column("n", F.size(F.col("s"))).to_pydict()
