@@ -394,6 +394,76 @@ class LinearRegressionSummary:
 
     degreesOfFreedom = degrees_of_freedom
 
+    # -- inference statistics (MLlib: solver="normal" surface) -------------
+    def _inference(self):
+        """(std_errors, t_values, p_values), intercept LAST (MLlib's
+        layout). Classical OLS covariance ``σ̂²(XᵀX)⁻¹`` — exact only for
+        unpenalized, unweighted TRAINING fits, so anything else raises
+        like MLlib's UnsupportedOperationException (evaluate() summaries
+        have no valid Wald statistics; weighted fits should use the GLM
+        gaussian path, which computes the weighted versions properly)."""
+        cached = getattr(self, "_inference_cache", None)
+        if cached is not None:
+            return cached
+        params = self._model._params or {}
+        if float(params.get("reg_param", 0.0)) > 0.0:
+            raise ValueError(
+                "standard errors / t-values / p-values are available only "
+                "for unpenalized fits (MLlib: solver='normal' without "
+                "regularization); this model has regParam > 0")
+        if params.get("weight_col") is not None:
+            raise ValueError(
+                "standard errors for weighted fits are not computed here; "
+                "use GeneralizedLinearRegression(family='gaussian', "
+                "weight_col=...) whose summary implements the weighted "
+                "Wald statistics")
+        if not isinstance(self, LinearRegressionTrainingSummary):
+            raise ValueError(
+                "inference statistics exist only on the TRAINING summary "
+                "(MLlib: evaluate() summaries throw); held-out residuals "
+                "do not form Wald statistics for the training estimate")
+        from scipy import stats as _sstats
+
+        Xd, _, mask = _extract_xy(self._frame, self._model.features_col,
+                                  self._model.label_col)
+        X = np.asarray(Xd, np.float64)[np.asarray(mask)]
+        fit_intercept = bool(params.get("fit_intercept", True))
+        A = np.concatenate([X, np.ones((len(X), 1))], axis=1) \
+            if fit_intercept else X
+        dof = self.degrees_of_freedom
+        if dof <= 0:
+            raise ValueError("non-positive degrees of freedom")
+        resid = self._label - self._pred
+        sigma2 = float(resid @ resid) / dof
+        cov = sigma2 * np.linalg.pinv(A.T @ A)
+        se = np.sqrt(np.diag(cov))
+        coef = np.asarray(self._model.coefficients, np.float64)
+        beta = np.concatenate([coef, [self._model.intercept]]) \
+            if fit_intercept else coef
+        with np.errstate(divide="ignore", invalid="ignore"):
+            t = beta / se
+        p = 2.0 * _sstats.t.sf(np.abs(t), dof)
+        self._inference_cache = (se, t, p)
+        return self._inference_cache
+
+    @property
+    def coefficient_standard_errors(self) -> np.ndarray:
+        return self._inference()[0]
+
+    coefficientStandardErrors = coefficient_standard_errors
+
+    @property
+    def t_values(self) -> np.ndarray:
+        return self._inference()[1]
+
+    tValues = t_values
+
+    @property
+    def p_values(self) -> np.ndarray:
+        return self._inference()[2]
+
+    pValues = p_values
+
 
 class LinearRegressionTrainingSummary(LinearRegressionSummary):
     """Training summary: evaluation metrics + solver trajectory

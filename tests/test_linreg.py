@@ -314,3 +314,78 @@ class TestWeightCol:
         m = LinearRegression(weight_col="w").fit(f)
         assert np.all(np.isfinite(m.coefficients))
         assert np.isfinite(m.intercept)
+
+
+class TestInferenceStatistics:
+    """coefficientStandardErrors / tValues / pValues (MLlib's
+    solver='normal' surface), intercept LAST."""
+
+    def _fit(self, reg=0.0):
+        rng = np.random.default_rng(0)
+        n, d = 80, 3
+        X = rng.normal(size=(n, d))
+        y = X @ [1.5, -2.0, 0.5] + 0.3 * rng.normal(size=n) + 2.0
+        f = VectorAssembler([f"x{j}" for j in range(d)], "features").transform(
+            Frame({**{f"x{j}": X[:, j] for j in range(d)}, "label": y}))
+        return LinearRegression(reg_param=reg, max_iter=200).fit(f), X, y
+
+    def test_matches_glm_gaussian_oracle(self):
+        from sparkdq4ml_tpu.models import GeneralizedLinearRegression
+        m, X, y = self._fit()
+        s = m.summary
+        f = VectorAssembler([f"x{j}" for j in range(X.shape[1])],
+                            "features").transform(
+            Frame({**{f"x{j}": X[:, j] for j in range(X.shape[1])},
+                   "label": y}))
+        gs = GeneralizedLinearRegression(family="gaussian",
+                                         link="identity",
+                                         max_iter=50).fit(f).summary
+        np.testing.assert_allclose(s.coefficient_standard_errors,
+                                   np.asarray(gs.coefficient_standard_errors),
+                                   rtol=1e-5)
+        np.testing.assert_allclose(s.t_values, np.asarray(gs.t_values),
+                                   rtol=1e-4)
+        np.testing.assert_allclose(s.p_values, np.asarray(gs.p_values),
+                                   atol=1e-10)
+
+    def test_closed_form(self):
+        from scipy import stats as sstats
+        m, X, y = self._fit()
+        s = m.summary
+        A = np.concatenate([X, np.ones((len(X), 1))], axis=1)
+        beta = np.linalg.lstsq(A, y, rcond=None)[0]
+        resid = y - A @ beta
+        dof = len(y) - A.shape[1]
+        cov = (resid @ resid / dof) * np.linalg.inv(A.T @ A)
+        np.testing.assert_allclose(s.coefficient_standard_errors,
+                                   np.sqrt(np.diag(cov)), rtol=1e-4)
+        t = np.concatenate([m.coefficients, [m.intercept]]) / \
+            np.sqrt(np.diag(cov))
+        np.testing.assert_allclose(s.t_values, t, rtol=1e-3)
+        np.testing.assert_allclose(
+            s.p_values, 2 * sstats.t.sf(np.abs(t), dof), atol=1e-9)
+
+    def test_penalized_fit_raises(self):
+        m, _, _ = self._fit(reg=0.5)
+        with pytest.raises(ValueError, match="unpenalized"):
+            m.summary.coefficient_standard_errors
+
+    def test_weighted_fit_raises(self):
+        rng = np.random.default_rng(1)
+        f = VectorAssembler(["x"], "features").transform(
+            Frame({"x": rng.normal(size=30),
+                   "label": rng.normal(size=30),
+                   "w": rng.uniform(1, 2, 30)}))
+        m = LinearRegression(weight_col="w", max_iter=50).fit(f)
+        with pytest.raises(ValueError, match="weighted"):
+            m.summary.p_values
+
+    def test_evaluate_summary_raises(self):
+        m, X, y = self._fit()
+        f2 = VectorAssembler([f"x{j}" for j in range(X.shape[1])],
+                             "features").transform(
+            Frame({**{f"x{j}": X[:, j] for j in range(X.shape[1])},
+                   "label": y}))
+        ev = m.evaluate(f2)
+        with pytest.raises(ValueError, match="TRAINING"):
+            ev.t_values
