@@ -257,12 +257,20 @@ class OneHotEncoder(Estimator):
     device comparison against an iota — one fused op, no host loop.
     """
 
-    _persist_attrs = ('input_col', 'output_col', 'drop_last')
+    _persist_attrs = ('input_col', 'output_col', 'drop_last',
+                      'input_cols', 'output_cols')
+    input_cols = None     # back-compat default for pre-plural saves
+    output_cols = None
 
     def __init__(self, input_col: str = None, output_col: str = None,
-                 drop_last: bool = True):
+                 drop_last: bool = True, input_cols=None, output_cols=None):
+        if input_col is not None and input_cols is not None:
+            raise ValueError("set input_col OR input_cols, not both")
         self.input_col = input_col
         self.output_col = output_col
+        self.input_cols = list(input_cols) if input_cols is not None else None
+        self.output_cols = (list(output_cols) if output_cols is not None
+                            else None)
         self.drop_last = drop_last
 
     def set_drop_last(self, v: bool):
@@ -271,11 +279,34 @@ class OneHotEncoder(Estimator):
 
     setDropLast = set_drop_last
 
+    def _col_pairs(self):
+        """Normalized [(in, out)] across the single- and plural-column
+        forms (Spark 2.4's OneHotEncoderEstimator / 3.x OneHotEncoder
+        take inputCols/outputCols lists)."""
+        if self.input_cols is not None:
+            if not self.input_cols:
+                raise ValueError("input_cols must not be empty")
+            outs = self.output_cols
+            if outs is None or len(outs) != len(self.input_cols):
+                raise ValueError("output_cols must match input_cols")
+            return list(zip(self.input_cols, outs))
+        if self.input_col is None:
+            raise ValueError("OneHotEncoder needs input_col or input_cols")
+        return [(self.input_col, self.output_col)]
+
     def fit(self, frame) -> "OneHotEncoderModel":
-        idx = frame._column_values(self.input_col)
         w = frame.mask
-        size = int(np.asarray(jnp.max(jnp.where(w, jnp.asarray(idx), -1)))) + 1
-        return OneHotEncoderModel(size, self.input_col, self.output_col,
+        sizes = []
+        for cin, _ in self._col_pairs():
+            idx = frame._column_values(cin)
+            sizes.append(int(np.asarray(
+                jnp.max(jnp.where(w, jnp.asarray(idx), -1)))) + 1)
+        if self.input_cols is not None:
+            return OneHotEncoderModel(sizes[0], None, None, self.drop_last,
+                                      category_sizes=sizes,
+                                      input_cols=self.input_cols,
+                                      output_cols=self.output_cols)
+        return OneHotEncoderModel(sizes[0], self.input_col, self.output_col,
                                   self.drop_last)
 
 
@@ -287,21 +318,58 @@ OneHotEncoderEstimator = OneHotEncoder
 
 @persistable
 class OneHotEncoderModel(Model):
-    _persist_attrs = ('category_size', 'input_col', 'output_col', 'drop_last')
-    def __init__(self, category_size, input_col, output_col, drop_last=True):
+    _persist_attrs = ('category_size', 'input_col', 'output_col',
+                      'drop_last', 'category_sizes', 'input_cols',
+                      'output_cols')
+    category_sizes = None  # back-compat defaults for pre-plural saves
+    input_cols = None
+    output_cols = None
+
+    def __init__(self, category_size, input_col, output_col, drop_last=True,
+                 category_sizes=None, input_cols=None, output_cols=None):
         self.category_size = int(category_size)
         self.input_col = input_col
         self.output_col = output_col
         self.drop_last = drop_last
+        self.category_sizes = (list(map(int, category_sizes))
+                               if category_sizes is not None else None)
+        self.input_cols = list(input_cols) if input_cols is not None else None
+        self.output_cols = (list(output_cols) if output_cols is not None
+                            else None)
+        if self.input_cols is not None:
+            # re-establish the estimator's invariant on the persisted
+            # Model too (zip would silently truncate otherwise)
+            if (self.output_cols is None or self.category_sizes is None
+                    or len(self.output_cols) != len(self.input_cols)
+                    or len(self.category_sizes) != len(self.input_cols)):
+                raise ValueError(
+                    "input_cols / output_cols / category_sizes lengths "
+                    "must match")
 
-    categorySizes = property(lambda self: [self.category_size])
+    @property
+    def categorySizes(self):
+        if self.category_sizes is not None:
+            return list(self.category_sizes)
+        return [self.category_size]
+
+    def _triples(self):
+        if self.input_cols is not None:
+            return list(zip(self.input_cols, self.output_cols,
+                            self.category_sizes))
+        return [(self.input_col, self.output_col, self.category_size)]
 
     def transform(self, frame):
-        idx = jnp.asarray(frame._column_values(self.input_col), int_dtype())
-        width = self.category_size - (1 if self.drop_last else 0)
-        eye = jnp.arange(width, dtype=int_dtype())
-        onehot = (idx[:, None] == eye[None, :]).astype(float_dtype())
-        return frame.with_column(self.output_col, onehot)
+        out = frame
+        for cin, cout, size in self._triples():
+            # read indices from the ORIGINAL frame: an earlier output
+            # name colliding with a later input name must not feed a
+            # one-hot matrix back in as indices
+            idx = jnp.asarray(frame._column_values(cin), int_dtype())
+            width = size - (1 if self.drop_last else 0)
+            eye = jnp.arange(width, dtype=int_dtype())
+            onehot = (idx[:, None] == eye[None, :]).astype(float_dtype())
+            out = out.with_column(cout, onehot)
+        return out
 
 
 @persistable

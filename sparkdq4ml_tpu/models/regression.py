@@ -433,6 +433,13 @@ class LinearRegressionSummary:
         dof = self.degrees_of_freedom
         if dof <= 0:
             raise ValueError("non-positive degrees of freedom")
+        if np.linalg.matrix_rank(A) < A.shape[1]:
+            # MLlib's normal solver fails on singular normal equations; a
+            # pinv here would return finite-but-meaningless errors for an
+            # unidentifiable (collinear) design
+            raise ValueError(
+                "design matrix is rank-deficient (collinear features); "
+                "standard errors are not identifiable")
         resid = self._label - self._pred
         sigma2 = float(resid @ resid) / dof
         cov = sigma2 * np.linalg.pinv(A.T @ A)

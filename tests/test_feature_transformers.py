@@ -314,3 +314,77 @@ class TestVectorSizeHint:
         back = load_stage(str(tmp_path / "vsh"))
         assert back.input_col == "v" and back.size == 3
         assert back.handle_invalid == "optimistic"
+
+
+class TestOneHotEncoderPlural:
+    """inputCols/outputCols form (Spark 2.4 OneHotEncoderEstimator /
+    3.x OneHotEncoder)."""
+
+    def test_multi_column_encode(self):
+        from sparkdq4ml_tpu.models import OneHotEncoder
+        f = Frame({"a": np.asarray([0.0, 1.0, 2.0]),
+                   "b": np.asarray([1.0, 0.0, 1.0])})
+        m = OneHotEncoder(input_cols=["a", "b"],
+                          output_cols=["av", "bv"]).fit(f)
+        assert m.categorySizes == [3, 2]
+        out = m.transform(f).to_pydict()
+        av = np.asarray(out["av"])
+        bv = np.asarray(out["bv"])
+        assert av.shape == (3, 2)           # dropLast: 3 cats -> width 2
+        np.testing.assert_array_equal(av[0], [1.0, 0.0])
+        np.testing.assert_array_equal(av[2], [0.0, 0.0])  # last cat -> zeros
+        assert bv.shape == (3, 1)
+        # dropLast keeps the category-0 indicator column only
+        np.testing.assert_array_equal(bv[:, 0], [0.0, 1.0, 0.0])
+
+    def test_save_load_plural(self, tmp_path):
+        from sparkdq4ml_tpu.models import OneHotEncoder, OneHotEncoderModel
+        f = Frame({"a": np.asarray([0.0, 1.0]), "b": np.asarray([0.0, 1.0])})
+        m = OneHotEncoder(input_cols=["a", "b"], output_cols=["av", "bv"],
+                          drop_last=False).fit(f)
+        m.save(str(tmp_path / "ohe"))
+        loaded = OneHotEncoderModel.load(str(tmp_path / "ohe"))
+        out = loaded.transform(f).to_pydict()
+        assert np.asarray(out["av"]).shape == (2, 2)
+
+    def test_both_forms_rejected(self):
+        from sparkdq4ml_tpu.models import OneHotEncoder
+        with pytest.raises(ValueError, match="not both"):
+            OneHotEncoder(input_col="a", input_cols=["a"])
+
+    def test_mismatched_outputs_rejected(self):
+        from sparkdq4ml_tpu.models import OneHotEncoder
+        f = Frame({"a": np.asarray([0.0])})
+        with pytest.raises(ValueError, match="match"):
+            OneHotEncoder(input_cols=["a"], output_cols=[]).fit(f)
+
+    def test_single_col_back_compat(self):
+        from sparkdq4ml_tpu.models import OneHotEncoder
+        f = Frame({"k": np.asarray([0.0, 1.0, 2.0, 1.0])})
+        m = OneHotEncoder(input_col="k", output_col="kv").fit(f)
+        out = np.asarray(m.transform(f).to_pydict()["kv"])
+        assert out.shape == (4, 2)
+        np.testing.assert_array_equal(out[1], [0.0, 1.0])
+
+    def test_output_name_colliding_with_later_input(self):
+        from sparkdq4ml_tpu.models import OneHotEncoder
+        f = Frame({"a": np.asarray([0.0, 1.0, 2.0]),
+                   "b": np.asarray([1.0, 0.0, 1.0])})
+        m = OneHotEncoder(input_cols=["a", "b"],
+                          output_cols=["b", "c"]).fit(f)
+        out = m.transform(f).to_pydict()
+        # column 'c' must encode the ORIGINAL b, not a's one-hot output
+        np.testing.assert_array_equal(np.asarray(out["c"])[:, 0],
+                                      [0.0, 1.0, 0.0])
+
+    def test_empty_plural_rejected(self):
+        from sparkdq4ml_tpu.models import OneHotEncoder
+        f = Frame({"a": np.asarray([0.0])})
+        with pytest.raises(ValueError, match="empty"):
+            OneHotEncoder(input_cols=[], output_cols=[]).fit(f)
+
+    def test_model_invariant_enforced(self):
+        from sparkdq4ml_tpu.models import OneHotEncoderModel
+        with pytest.raises(ValueError, match="lengths"):
+            OneHotEncoderModel(3, None, None, category_sizes=[3, 2],
+                               input_cols=["a", "b"], output_cols=["av"])

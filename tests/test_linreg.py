@@ -389,3 +389,12 @@ class TestInferenceStatistics:
         ev = m.evaluate(f2)
         with pytest.raises(ValueError, match="TRAINING"):
             ev.t_values
+
+    def test_collinear_design_raises(self):
+        rng = np.random.default_rng(2)
+        x = rng.normal(size=40)
+        f = VectorAssembler(["x0", "x1"], "features").transform(
+            Frame({"x0": x, "x1": x, "label": 2 * x + 1}))
+        m = LinearRegression(reg_param=0.0, max_iter=100).fit(f)
+        with pytest.raises(ValueError, match="rank-deficient"):
+            m.summary.p_values
