@@ -1043,17 +1043,28 @@ class Frame:
         return self._with(data=data)
 
     def describe(self, *cols: str) -> "Frame":
-        """Spark's ``describe``: count/mean/stddev/min/max summary rows for
-        numeric columns (all numeric columns when none named)."""
+        """Spark's ``describe``: count/mean/stddev/min/max summary rows.
+        String columns describe like Spark's — non-null count and
+        lexicographic min/max, with null mean/stddev cells."""
         from .aggregates import AggExpr, global_agg
 
         if not cols:
             cols = tuple(name for name, arr in self._data.items()
-                         if not _is_string_col(arr) and arr.ndim == 1)
+                         if arr.ndim == 1)
         stats = ["count", "mean", "stddev", "min", "max"]
         fns = [{"mean": "avg"}.get(s, s) for s in stats]
         data: dict[str, object] = {"summary": np.asarray(stats, dtype=object)}
+        m = self._host_mask()
         for c in cols:
+            arr = self._data[c]
+            if _is_string_col(arr):
+                vals = [x for x in np.asarray(arr, object)[m]
+                        if x is not None]
+                data[c] = np.asarray(
+                    [str(len(vals)), None, None,
+                     (min(vals) if vals else None),
+                     (max(vals) if vals else None)], dtype=object)
+                continue
             aggs = [AggExpr(fn, c).alias(fn) for fn in fns]
             row = global_agg(self, aggs).to_pydict()  # one sync per column
             data[c] = np.asarray([str(row[fn][0]) for fn in fns], dtype=object)

@@ -296,11 +296,12 @@ class OneHotEncoder(Estimator):
 
     def fit(self, frame) -> "OneHotEncoderModel":
         w = frame.mask
-        sizes = []
-        for cin, _ in self._col_pairs():
-            idx = frame._column_values(cin)
-            sizes.append(int(np.asarray(
-                jnp.max(jnp.where(w, jnp.asarray(idx), -1)))) + 1)
+        # stack the per-column maxes and cross device->host ONCE (a sync
+        # per column would scale fit latency with the column count)
+        maxes = jnp.stack([
+            jnp.max(jnp.where(w, jnp.asarray(frame._column_values(cin)), -1))
+            for cin, _ in self._col_pairs()])
+        sizes = (np.asarray(maxes).astype(np.int64) + 1).tolist()
         if self.input_cols is not None:
             return OneHotEncoderModel(sizes[0], None, None, self.drop_last,
                                       category_sizes=sizes,
@@ -336,15 +337,23 @@ class OneHotEncoderModel(Model):
         self.input_cols = list(input_cols) if input_cols is not None else None
         self.output_cols = (list(output_cols) if output_cols is not None
                             else None)
+        self._check_plural_invariant()
+
+    def _check_plural_invariant(self):
+        """zip in _triples would silently truncate on mismatched lists."""
         if self.input_cols is not None:
-            # re-establish the estimator's invariant on the persisted
-            # Model too (zip would silently truncate otherwise)
             if (self.output_cols is None or self.category_sizes is None
                     or len(self.output_cols) != len(self.input_cols)
                     or len(self.category_sizes) != len(self.input_cols)):
                 raise ValueError(
                     "input_cols / output_cols / category_sizes lengths "
                     "must match")
+
+    def _post_load(self):
+        # load_stage constructs via __new__ + setattr, bypassing __init__:
+        # re-establish the invariant for saved (possibly hand-edited or
+        # truncated) stage files too
+        self._check_plural_invariant()
 
     @property
     def categorySizes(self):

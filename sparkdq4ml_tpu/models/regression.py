@@ -433,7 +433,8 @@ class LinearRegressionSummary:
         dof = self.degrees_of_freedom
         if dof <= 0:
             raise ValueError("non-positive degrees of freedom")
-        if np.linalg.matrix_rank(A) < A.shape[1]:
+        G = A.T @ A                  # p×p Gram: rank check + inverse share it
+        if np.linalg.matrix_rank(G) < A.shape[1]:
             # MLlib's normal solver fails on singular normal equations; a
             # pinv here would return finite-but-meaningless errors for an
             # unidentifiable (collinear) design
@@ -442,7 +443,7 @@ class LinearRegressionSummary:
                 "standard errors are not identifiable")
         resid = self._label - self._pred
         sigma2 = float(resid @ resid) / dof
-        cov = sigma2 * np.linalg.pinv(A.T @ A)
+        cov = sigma2 * np.linalg.pinv(G)
         se = np.sqrt(np.diag(cov))
         coef = np.asarray(self._model.coefficients, np.float64)
         beta = np.concatenate([coef, [self._model.intercept]]) \

@@ -388,3 +388,28 @@ class TestOneHotEncoderPlural:
         with pytest.raises(ValueError, match="lengths"):
             OneHotEncoderModel(3, None, None, category_sizes=[3, 2],
                                input_cols=["a", "b"], output_cols=["av"])
+
+    def test_corrupted_save_rejected_on_load(self, tmp_path):
+        import json, os
+        from sparkdq4ml_tpu.models import OneHotEncoder, OneHotEncoderModel
+        f = Frame({"a": np.asarray([0.0, 1.0]), "b": np.asarray([0.0, 1.0])})
+        m = OneHotEncoder(input_cols=["a", "b"],
+                          output_cols=["av", "bv"]).fit(f)
+        path = str(tmp_path / "ohe")
+        m.save(path)
+        meta_path = os.path.join(path, "stage.json")
+        if not os.path.exists(meta_path):
+            meta_path = next(os.path.join(path, p) for p in os.listdir(path)
+                             if p.endswith(".json"))
+        meta = json.load(open(meta_path))
+        # truncate output_cols wherever the attrs landed in the payload
+        def truncate(d):
+            for k, v in list(d.items()):
+                if k == "output_cols" and isinstance(v, list):
+                    d[k] = v[:1]
+                elif isinstance(d[k], dict):
+                    truncate(d[k])
+        truncate(meta)
+        json.dump(meta, open(meta_path, "w"))
+        with pytest.raises(ValueError, match="lengths"):
+            OneHotEncoderModel.load(path)

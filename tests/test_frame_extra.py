@@ -138,3 +138,20 @@ class TestMisc:
         f = Frame({"v": [1.0, 2.0, 3.0]})
         d = f.summary("min", "90%").to_pydict()
         assert d["summary"].tolist() == ["min", "90%"]
+
+
+class TestDescribeStrings:
+    def test_string_columns_described_like_spark(self):
+        f = Frame({"s": np.asarray(["b", "a", None], dtype=object),
+                   "x": np.asarray([1.0, 2.0, 3.0])})
+        d = f.describe().to_pydict()
+        assert "s" in d and "x" in d
+        s = list(d["s"])
+        assert s[0] == "2"                       # non-null count
+        assert s[1] is None and s[2] is None     # mean/stddev null
+        assert s[3] == "a" and s[4] == "b"       # lexicographic min/max
+
+    def test_named_string_column(self):
+        f = Frame({"s": np.asarray(["x"], dtype=object)})
+        d = f.describe("s").to_pydict()
+        assert list(d["s"])[0] == "1"
