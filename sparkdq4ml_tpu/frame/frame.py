@@ -299,6 +299,17 @@ class Frame:
     withColumnRenamed = with_column_renamed
 
     def select(self, *exprs: Union[str, Expr]) -> "Frame":
+        from ..ops.expressions import Alias, Explode
+
+        # Spark allows ONE generator (explode) per select: resolve the
+        # scalar columns first, then expand rows at the host boundary.
+        # Only a bare Explode or an Alias over one counts — any other
+        # wrapper (Cast(Explode), arithmetic) falls through to eval(),
+        # whose generator error explains the restriction.
+        gens = [e for e in exprs if isinstance(e, Explode)
+                or (isinstance(e, Alias) and isinstance(e.child, Explode))]
+        if len(gens) > 1:
+            raise ValueError("only one explode() per select (Spark rule)")
         data: dict[str, object] = {}
         for e in exprs:
             if isinstance(e, str):
@@ -306,8 +317,86 @@ class Frame:
                     data.update(self._data)
                     continue
                 e = Col(e)
+            # identity, not `in`: Expr.__eq__ builds a BinOp (truthy), so
+            # membership tests over Expr lists must never use ==
+            if any(e is g for g in gens):
+                continue
             data[e.name] = e.eval(self)
-        return self._with(data=data)
+        if not gens:
+            return self._with(data=data)
+        g = gens[0]
+        inner = g if isinstance(g, Explode) else g.child
+        src_vals = inner.source_values(self)
+        # a temp slot keeps an explicitly-selected source column (or one
+        # pulled in via '*') in the output, like Spark
+        tmp = "__explode_source__"
+        while tmp in data:
+            tmp += "_"
+        return self._with(data={**data, tmp: src_vals}).explode(tmp, g.name)
+
+    def explode(self, column: str, output_col: str = None,
+                keep_nulls: bool = False) -> "Frame":
+        """Spark's ``explode``: one output row per element of a list cell.
+
+        Row multiplication is inherently dynamic-shaped, so this is a host
+        boundary like join/groupBy (the "gather at the boundary" rule):
+        lengths gather once, scalar columns ``np.repeat``, and the result
+        is a compact new Frame. Null/empty cells drop their row (Spark's
+        ``explode``); ``keep_nulls=True`` gives ``explode_outer`` (one
+        null-element row instead)."""
+        arr = self._data.get(column)
+        if arr is None:
+            raise ValueError(f"no column {column!r}")
+        if not _is_string_col(arr):
+            raise ValueError("explode() expects an array column (e.g. "
+                             "split() or collect_list() output)")
+        from ..ops.expressions import _require_array_cells
+
+        _require_array_cells(arr, "explode")  # a str cell would silently
+        # produce zero rows otherwise (plain string columns are object
+        # arrays too)
+        out_name = output_col or column
+        idx = np.nonzero(self._host_mask())[0]
+        cells = np.asarray(arr, object)[idx]
+        lens = np.asarray([
+            (len(c) if isinstance(c, (list, tuple, np.ndarray)) else 0)
+            if c is not None else 0 for c in cells], np.int64)
+        if keep_nulls:
+            rep = np.maximum(lens, 1)
+        else:
+            rep = lens
+        src = np.repeat(idx, rep)
+        values = []
+        for c, ln in zip(cells, lens):
+            if ln:
+                values.extend(list(c))
+            elif keep_nulls:
+                values.append(None)
+        data: dict[str, object] = {}
+        for name, col_arr in self._data.items():
+            if name == column:
+                continue
+            if _is_string_col(col_arr):
+                data[name] = np.asarray(col_arr, object)[src]
+            else:
+                data[name] = jnp.take(jnp.asarray(col_arr),
+                                      jnp.asarray(src), axis=0) \
+                    if len(src) else jnp.asarray(col_arr)[:0]
+        # element dtype from the NON-NULL values: numeric lists land on
+        # device; strings (or an all-null result, which must not flip a
+        # string column to float NaN) stay host
+        non_null = [v for v in values if v is not None]
+        if non_null and all(isinstance(v, (int, float, np.floating,
+                                           np.integer)) for v in non_null):
+            data[out_name] = jnp.asarray(np.asarray(
+                [np.nan if v is None else float(v) for v in values],
+                np.float64), float_dtype())
+        else:
+            out = np.empty(len(values), object)
+            for i, v in enumerate(values):
+                out[i] = v
+            data[out_name] = out
+        return Frame(data)
 
     def drop(self, *names: str) -> "Frame":
         data = {k: v for k, v in self._data.items() if k not in names}

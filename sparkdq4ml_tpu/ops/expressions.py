@@ -662,6 +662,40 @@ def _fn_array_size(arr):
          for cell in _require_array_cells(arr, "size")], np.int32))
 
 
+class Explode(Expr):
+    """Marker expression for ``F.explode(col_or_expr)`` — a GENERATOR,
+    not a scalar column: it multiplies rows, so only ``Frame.select``
+    (one per select, Spark's rule) and ``Frame.explode`` understand it;
+    evaluating it like a column raises. ``source`` is a column name or
+    any array-valued expression (``explode(split(...))``)."""
+
+    def __init__(self, source):
+        self.source = source            # str | Expr
+
+    def eval(self, frame):
+        raise ValueError(
+            "explode() is a generator — use it inside select() (one per "
+            "select) or call Frame.explode(column) directly")
+
+    def source_values(self, frame):
+        """The array column being exploded, resolved against ``frame``."""
+        if isinstance(self.source, str):
+            return frame._column_values(self.source)  # friendly KeyError
+        return self.source.eval(frame)
+
+    @property
+    def name(self) -> str:
+        return "col"                    # Spark's default generator name
+
+    def __str__(self):
+        src = self.source if isinstance(self.source, str) else str(self.source)
+        return f"explode({src})"
+
+
+def explode(col_) -> Explode:
+    return Explode(col_ if isinstance(col_, str) else col_)
+
+
 def _fn_regexp_replace(s, pattern, replacement):
     pat = re.compile(_scalar_str(pattern))
     rep = _scalar_str(replacement)

@@ -199,3 +199,90 @@ class TestArrayFunctions:
         f = self._frame()
         with pytest.raises(ValueError, match="array column"):
             f.with_column("n", F.size(F.col("s"))).to_pydict()
+
+
+class TestExplode:
+    def _frame(self):
+        from sparkdq4ml_tpu import Frame
+        f = Frame({"id": np.asarray([1.0, 2.0, 3.0]),
+                   "s": np.asarray(["a,b", "c", None], dtype=object)})
+        return f.with_column("arr", F.split(F.col("s"), ","))
+
+    def test_method_form(self):
+        out = self._frame().explode("arr", "x").to_pydict()
+        assert list(out["x"]) == ["a", "b", "c"]
+        np.testing.assert_allclose(np.asarray(out["id"]), [1.0, 1.0, 2.0])
+
+    def test_null_and_empty_rows_dropped(self):
+        from sparkdq4ml_tpu import Frame, functions as F2
+        f = Frame({"s": np.asarray([None, ""], dtype=object)}) \
+            .with_column("arr", F2.split(F2.col("s"), ","))
+        # null cell drops; "" splits to [""] (one empty-string element)
+        out = f.explode("arr", "x")
+        assert list(out.to_pydict()["x"]) == [""]
+
+    def test_explode_outer(self):
+        out = self._frame().explode("arr", "x", keep_nulls=True).to_pydict()
+        assert len(out["x"]) == 4
+        assert out["x"][3] is None
+
+    def test_select_generator_form(self):
+        out = self._frame().select(
+            "id", F.explode(F.col("arr")).alias("x")).to_pydict()
+        assert list(out["x"]) == ["a", "b", "c"]
+        np.testing.assert_allclose(np.asarray(out["id"]), [1.0, 1.0, 2.0])
+
+    def test_default_generator_name_is_col(self):
+        out = self._frame().select("id", F.explode(F.col("arr")))
+        assert out.columns == ["id", "col"]
+
+    def test_two_generators_rejected(self):
+        f = self._frame()
+        with pytest.raises(ValueError, match="one explode"):
+            f.select(F.explode(F.col("arr")), F.explode(F.col("arr")))
+
+    def test_eval_outside_select_raises(self):
+        f = self._frame()
+        with pytest.raises(ValueError, match="generator"):
+            f.with_column("x", F.explode(F.col("arr")))
+
+    def test_numeric_elements_land_on_device(self):
+        from sparkdq4ml_tpu.frame.frame import Frame, list_column
+        f = Frame({"arr": list_column([[1.0, 2.0], [3.0]])})
+        out = f.explode("arr").to_pydict()
+        np.testing.assert_allclose(np.asarray(out["arr"]), [1.0, 2.0, 3.0])
+
+    def test_masked_rows_never_explode(self):
+        import sparkdq4ml_tpu as dq
+        f = self._frame().filter(dq.col("id") < 2.0)
+        out = f.explode("arr", "x").to_pydict()
+        assert list(out["x"]) == ["a", "b"]
+
+    def test_source_column_kept_when_selected(self):
+        out = self._frame().select(
+            "arr", F.explode(F.col("arr")).alias("x")).to_pydict()
+        assert "arr" in out and "x" in out
+        assert out["arr"][0] == ["a", "b"]        # repeated source cell
+
+    def test_explode_of_expression(self):
+        from sparkdq4ml_tpu import Frame
+        f = Frame({"s": np.asarray(["a,b", "c"], dtype=object)})
+        out = f.select(F.explode(F.split(F.col("s"), ",")).alias("x"))
+        assert list(out.to_pydict()["x"]) == ["a", "b", "c"]
+
+    def test_cast_of_explode_gives_generator_error(self):
+        f = self._frame()
+        with pytest.raises(ValueError, match="generator"):
+            f.select("id", F.explode(F.col("arr")).cast("int"))
+
+    def test_plain_string_column_rejected(self):
+        f = self._frame()
+        with pytest.raises(ValueError, match="array column"):
+            f.explode("s")
+
+    def test_all_null_outer_stays_object(self):
+        from sparkdq4ml_tpu import Frame, functions as F2
+        f = Frame({"s": np.asarray([None], dtype=object)}) \
+            .with_column("arr", F2.split(F2.col("s"), ","))
+        out = f.explode("arr", "x", keep_nulls=True).to_pydict()
+        assert out["x"][0] is None                # None, not float NaN
