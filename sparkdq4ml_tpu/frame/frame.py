@@ -332,10 +332,13 @@ class Frame:
         tmp = "__explode_source__"
         while tmp in data:
             tmp += "_"
-        return self._with(data={**data, tmp: src_vals}).explode(tmp, g.name)
+        return self._with(data={**data, tmp: src_vals}).explode(
+            tmp, g.name, keep_nulls=inner.outer,
+            position_col="pos" if inner.with_position else None)
 
     def explode(self, column: str, output_col: str = None,
-                keep_nulls: bool = False) -> "Frame":
+                keep_nulls: bool = False,
+                position_col: str = None) -> "Frame":
         """Spark's ``explode``: one output row per element of a list cell.
 
         Row multiplication is inherently dynamic-shaped, so this is a host
@@ -367,11 +370,14 @@ class Frame:
             rep = lens
         src = np.repeat(idx, rep)
         values = []
+        positions = []
         for c, ln in zip(cells, lens):
             if ln:
                 values.extend(list(c))
+                positions.extend(range(ln))
             elif keep_nulls:
                 values.append(None)
+                positions.append(None)     # posexplode_outer: null pos
         data: dict[str, object] = {}
         for name, col_arr in self._data.items():
             if name == column:
@@ -396,6 +402,25 @@ class Frame:
             for i, v in enumerate(values):
                 out[i] = v
             data[out_name] = out
+        if position_col is not None:
+            if position_col in data:
+                raise ValueError(
+                    f"position column {position_col!r} collides with an "
+                    "existing output column")
+            if any(p is None for p in positions):
+                pos_arr = jnp.asarray(np.asarray(
+                    [np.nan if p is None else float(p) for p in positions],
+                    np.float64), float_dtype())
+            else:
+                pos_arr = jnp.asarray(np.asarray(positions, np.int32))
+            # Spark's posexplode order is (pos, col): rebuild with the
+            # position column right before the value column
+            ordered: dict[str, object] = {}
+            for k, v in data.items():
+                if k == out_name:
+                    ordered[position_col] = pos_arr
+                ordered[k] = v
+            data = ordered
         return Frame(data)
 
     def drop(self, *names: str) -> "Frame":

@@ -19,7 +19,8 @@ from .ops.expressions import (acos, array_contains, asin, atan, atan2,
                               floor, fn, greatest, hypot, initcap, instr,
                               isnan, isnull, least, length, lit, locate,
                               log, log1p, log2, log10, lower, lpad, ltrim,
-                              explode, md5, nvl, pow, radians,
+                              explode, explode_outer, posexplode,
+                              md5, nvl, pow, radians,
                               regexp_extract,
                               regexp_replace, repeat, reverse, rint, rpad,
                               rtrim, sha1, sha2, signum, sin, sinh, split,
@@ -40,7 +41,7 @@ __all__ = ["col", "lit", "call_udf", "callUDF", "count", "sum", "avg",
            "skewness", "kurtosis", "corr", "covar_samp", "covar_pop",
            "abs", "sqrt", "exp", "log", "log10", "pow", "floor", "ceil",
            "round", "signum", "greatest", "least", "isnan", "isnull",
-           "coalesce", "nvl", "when", "fn", "md5", "sha1", "sha2", "base64", "unbase64", "median", "mode", "percentile_approx", "stddev_pop", "var_pop", "array_contains", "element_at", "size", "explode",
+           "coalesce", "nvl", "when", "fn", "md5", "sha1", "sha2", "base64", "unbase64", "median", "mode", "percentile_approx", "stddev_pop", "var_pop", "array_contains", "element_at", "size", "explode", "explode_outer", "posexplode",
            "upper", "lower", "trim", "ltrim", "rtrim", "length", "concat",
            "substring",
            "sin", "cos", "tan", "asin", "acos", "atan", "atan2",

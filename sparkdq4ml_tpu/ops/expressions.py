@@ -669,8 +669,11 @@ class Explode(Expr):
     evaluating it like a column raises. ``source`` is a column name or
     any array-valued expression (``explode(split(...))``)."""
 
-    def __init__(self, source):
+    def __init__(self, source, outer: bool = False,
+                 with_position: bool = False):
         self.source = source            # str | Expr
+        self.outer = outer              # explode_outer: keep null rows
+        self.with_position = with_position  # posexplode: (pos, col)
 
     def eval(self, frame):
         raise ValueError(
@@ -689,11 +692,24 @@ class Explode(Expr):
 
     def __str__(self):
         src = self.source if isinstance(self.source, str) else str(self.source)
-        return f"explode({src})"
+        fn = "posexplode" if self.with_position else             ("explode_outer" if self.outer else "explode")
+        return f"{fn}({src})"
 
 
 def explode(col_) -> Explode:
     return Explode(col_ if isinstance(col_, str) else col_)
+
+
+def explode_outer(col_) -> Explode:
+    """Like ``explode`` but null/empty cells yield one null-element row."""
+    return Explode(col_ if isinstance(col_, str) else col_, outer=True)
+
+
+def posexplode(col_) -> Explode:
+    """``explode`` plus a 0-based element position column ``pos``
+    (Spark's default (pos, col) naming)."""
+    return Explode(col_ if isinstance(col_, str) else col_,
+                   with_position=True)
 
 
 def _fn_regexp_replace(s, pattern, replacement):

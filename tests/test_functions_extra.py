@@ -286,3 +286,33 @@ class TestExplode:
             .with_column("arr", F2.split(F2.col("s"), ","))
         out = f.explode("arr", "x", keep_nulls=True).to_pydict()
         assert out["x"][0] is None                # None, not float NaN
+
+    def test_explode_outer_select_form(self):
+        out = self._frame().select(
+            "id", F.explode_outer(F.col("arr")).alias("x")).to_pydict()
+        assert len(out["x"]) == 4
+        assert out["x"][3] is None
+        assert np.asarray(out["id"])[3] == 3.0
+
+    def test_posexplode(self):
+        out = self._frame().select(
+            "id", F.posexplode(F.col("arr"))).to_pydict()
+        assert list(np.asarray(out["pos"])) == [0, 1, 0]
+        assert list(out["col"]) == ["a", "b", "c"]
+
+    def test_posexplode_alias_names_value_column(self):
+        out = self._frame().select(
+            F.posexplode(F.col("arr")).alias("v")).to_pydict()
+        assert "pos" in out and "v" in out
+
+    def test_posexplode_spark_column_order(self):
+        out = self._frame().select("id", F.posexplode(F.col("arr")))
+        assert out.columns == ["id", "pos", "col"]   # Spark's (pos, col)
+
+    def test_position_name_collision_raises(self):
+        from sparkdq4ml_tpu import Frame, functions as F2
+        f = Frame({"pos": np.asarray([1.0, 2.0]),
+                   "s": np.asarray(["a,b", "c"], dtype=object)})
+        fa = f.with_column("arr", F2.split(F2.col("s"), ","))
+        with pytest.raises(ValueError, match="collides"):
+            fa.select("pos", F2.posexplode(F2.col("arr")))
