@@ -89,6 +89,95 @@ def test_cache_reconfigured_on_get_or_create(tmp_path):
         s.stop()
 
 
+class TestCacheHostKey:
+    """Load-side AOT-mismatch guard (VERDICT r4 item 4): entries written
+    by another host/jaxlib must be invalidated before XLA reloads them."""
+
+    def test_poisoned_entries_invalidated(self, tmp_path):
+        import json
+
+        cache = tmp_path / "xla-poisoned"
+        cache.mkdir()
+        (cache / "host_key.json").write_text(json.dumps({"tag": "deadbeef"}))
+        (cache / "jit_foreign-entry").write_bytes(b"\x00AOT-from-elsewhere")
+        s = (TpuSession.builder().app_name("t")
+             .config("spark.compilation.cacheDir", str(cache))
+             .get_or_create())
+        try:
+            from sparkdq4ml_tpu.session import host_cache_tag
+
+            assert not (cache / "jit_foreign-entry").exists()
+            assert (json.loads((cache / "host_key.json").read_text())["tag"]
+                    == host_cache_tag())
+            assert jax.config.jax_compilation_cache_dir == str(cache)
+        finally:
+            s.stop()
+
+    def test_unstamped_nonempty_dir_invalidated(self, tmp_path):
+        # No provenance stamp + existing entries = exactly the round-4
+        # error-spam scenario (a dir inherited from an older build).
+        cache = tmp_path / "xla-legacy"
+        cache.mkdir()
+        (cache / "jit_old-entry").write_bytes(b"\x00old")
+        s = (TpuSession.builder().app_name("t")
+             .config("spark.compilation.cacheDir", str(cache))
+             .get_or_create())
+        try:
+            assert not (cache / "jit_old-entry").exists()
+            assert (cache / "host_key.json").exists()
+        finally:
+            s.stop()
+
+    def test_non_cache_files_never_deleted(self, tmp_path):
+        # Provenance hygiene must not become data loss: a user can point
+        # cacheDir at a directory holding OTHER files; only names that
+        # look like XLA cache entries (jit_*/pjit_*/*-cache) may go.
+        import json
+
+        cache = tmp_path / "xla-shared"
+        cache.mkdir()
+        (cache / "host_key.json").write_text(json.dumps({"tag": "deadbeef"}))
+        (cache / "jit_foreign-entry").write_bytes(b"\x00foreign")
+        (cache / "notes.txt").write_text("user data, not a cache entry")
+        (cache / "results.json").write_text("{}")
+        s = (TpuSession.builder().app_name("t")
+             .config("spark.compilation.cacheDir", str(cache))
+             .get_or_create())
+        try:
+            assert not (cache / "jit_foreign-entry").exists()
+            assert (cache / "notes.txt").exists()
+            assert (cache / "results.json").exists()
+        finally:
+            s.stop()
+
+    def test_matching_stamp_preserves_entries(self, tmp_path):
+        import json
+
+        from sparkdq4ml_tpu.session import host_cache_tag
+
+        cache = tmp_path / "xla-ours"
+        cache.mkdir()
+        (cache / "host_key.json").write_text(
+            json.dumps({"tag": host_cache_tag()}))
+        (cache / "jit_our-entry").write_bytes(b"\x00ours")
+        s = (TpuSession.builder().app_name("t")
+             .config("spark.compilation.cacheDir", str(cache))
+             .get_or_create())
+        try:
+            assert (cache / "jit_our-entry").exists()
+        finally:
+            s.stop()
+
+    def test_tag_includes_jaxlib_version(self, monkeypatch):
+        import jaxlib
+
+        from sparkdq4ml_tpu.session import host_cache_tag
+
+        before = host_cache_tag()
+        monkeypatch.setattr(jaxlib, "__version__", "0.0.0-other")
+        assert host_cache_tag() != before
+
+
 class TestDistributedInit:
     """Multi-host bootstrap wiring (session._init_distributed). The real
     jax.distributed.initialize needs a pod; assert the dispatch logic."""
