@@ -43,7 +43,99 @@
 #include <utility>
 #include <vector>
 
+#if defined(__unix__) || defined(__APPLE__)
+#define DQCSV_HAVE_MMAP 1
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+#endif
+
 namespace {
+
+// File buffer: mmap when possible (zero-copy — the old fread-into-
+// std::string path cost a full zero-init memset PLUS a copy of the whole
+// file before the first byte was parsed), falling back to malloc+fread.
+//
+// Caveat a snapshot copy doesn't have: if another process TRUNCATES the
+// file mid-parse, touching a page past the new EOF raises SIGBUS (fatal
+// to the embedding interpreter, not a Python exception). Readers that
+// must survive concurrent rewrites can set DQCSV_NO_MMAP=1 to force the
+// fread snapshot path.
+struct FileBuf {
+  const char* data = nullptr;
+  size_t size = 0;
+  void* map = nullptr;
+  size_t map_len = 0;
+  char* heap = nullptr;
+  bool ok = false;
+
+  ~FileBuf() {
+#ifdef DQCSV_HAVE_MMAP
+    if (map != nullptr) munmap(map, map_len);
+#endif
+    std::free(heap);
+  }
+};
+
+void load_file(const char* path, FileBuf* out) {
+#ifdef DQCSV_HAVE_MMAP
+  const char* no_mmap = std::getenv("DQCSV_NO_MMAP");
+  if (no_mmap != nullptr && no_mmap[0] != '\0' && no_mmap[0] != '0') {
+    goto fread_path;
+  }
+  {
+  int fd = ::open(path, O_RDONLY);
+  if (fd >= 0) {
+    struct stat st;
+    if (::fstat(fd, &st) == 0 && S_ISREG(st.st_mode)) {
+      const size_t size = static_cast<size_t>(st.st_size);
+      if (size == 0) {
+        ::close(fd);
+        out->ok = true;
+        return;
+      }
+      void* m = ::mmap(nullptr, size, PROT_READ, MAP_PRIVATE, fd, 0);
+      if (m != MAP_FAILED) {
+#ifdef MADV_SEQUENTIAL
+        ::madvise(m, size, MADV_SEQUENTIAL);
+#endif
+        ::close(fd);
+        out->map = m;
+        out->map_len = size;
+        out->data = static_cast<const char*>(m);
+        out->size = size;
+        out->ok = true;
+        return;
+      }
+    }
+    ::close(fd);
+  }
+  }
+fread_path:
+#endif
+  std::FILE* f = std::fopen(path, "rb");
+  if (f == nullptr) return;
+  std::fseek(f, 0, SEEK_END);
+  long size = std::ftell(f);
+  std::fseek(f, 0, SEEK_SET);
+  if (size < 0) {
+    std::fclose(f);
+    return;
+  }
+  char* buf = static_cast<char*>(std::malloc(size > 0 ? size : 1));
+  if (buf == nullptr) {
+    std::fclose(f);
+    return;
+  }
+  size_t got =
+      size > 0 ? std::fread(buf, 1, static_cast<size_t>(size), f) : 0;
+  std::fclose(f);
+  out->heap = buf;
+  out->data = buf;
+  out->size = got;
+  out->ok = true;
+}
 
 // 10^k is exactly representable in double for k <= 22.
 const double kPow10[23] = {1e0,  1e1,  1e2,  1e3,  1e4,  1e5,  1e6,  1e7,
@@ -144,6 +236,179 @@ inline const char* skip_sep(const char* p, const char* end) {
   return p;
 }
 
+// SWAR zero-byte mask, EXACT per byte (no cross-byte borrows): bit 7 of
+// each byte of the result is set iff that byte of x is zero. The usual
+// (x-0x01..) & ~x & 0x80.. trick is only exact for *first-match* use;
+// this variant — (~((x&0x7f..)+0x7f..) & ~x) & 0x80.. — never carries
+// between bytes ((b&0x7f)+0x7f <= 0xfe), so popcounting it is also
+// correct, which the record counter below relies on. Portable uint64
+// loads, no SSE requirement, ~1 byte/cycle.
+inline std::uint64_t swar_zero_mask(std::uint64_t x) {
+  const std::uint64_t low7 = 0x7f7f7f7f7f7f7f7fULL;
+  const std::uint64_t high = 0x8080808080808080ULL;
+  return ~((x & low7) + low7) & ~x & high;
+}
+
+// Integral-int32 test without libm: at the baseline x86-64 target
+// std::floor compiles to a CALL into libm (no SSE4.1 roundsd), which at
+// one call per field dominated the whole parse. cvttsd2si+cvtsi2sd is
+// base SSE2. NaN and out-of-range fail the first comparison (NaN
+// compares false), so the cast below never sees them.
+inline bool non_integral_int32(double v) {
+  if (!(v >= -2147483648.0 && v <= 2147483647.0)) return true;
+  return v != static_cast<double>(static_cast<long long>(v));
+}
+
+inline const char* scan_structural(const char* p, const char* end,
+                                   char delim) {
+  const std::uint64_t ones = 0x0101010101010101ULL;
+  const std::uint64_t dpat = ones * static_cast<unsigned char>(delim);
+  const std::uint64_t rpat = ones * static_cast<std::uint64_t>('\r');
+  const std::uint64_t npat = ones * static_cast<std::uint64_t>('\n');
+  while (p + 8 <= end) {
+    std::uint64_t w;
+    std::memcpy(&w, p, 8);
+    const std::uint64_t m = swar_zero_mask(w ^ dpat) |
+                            swar_zero_mask(w ^ rpat) |
+                            swar_zero_mask(w ^ npat);
+    if (m != 0) return p + (__builtin_ctzll(m) >> 3);
+    p += 8;
+  }
+  while (p < end && *p != delim && *p != '\r' && *p != '\n') ++p;
+  return p;
+}
+
+// Word-batched field parse: ONE 8-byte load yields the field boundary
+// (structural SWAR mask), the dot position, the digit-validity check,
+// and the numeric value (Lemire 8-digit SWAR conversion) — ~25
+// branch-light ops/field vs the generic byte loop's 3 branches/byte,
+// which is what per-field costs look like when fields average ~4 bytes.
+// Covers unsigned fields of <= 7 digit/dot bytes terminated inside the
+// word — the overwhelming shape of numeric CSVs. Returns 1 = value,
+// 2 = empty field, -1 = not covered (sign, >=8 bytes, exponent, junk,
+// near buffer end) -> caller's generic loop decides.
+inline int parse_field_word(const char* p, const char* end, char delim,
+                            double* out, const char** stop) {
+  if (p + 8 > end) return -1;
+  const std::uint64_t ones = 0x0101010101010101ULL;
+  std::uint64_t w;
+  std::memcpy(&w, p, 8);
+  const std::uint64_t sm =
+      swar_zero_mask(w ^ (ones * static_cast<unsigned char>(delim))) |
+      swar_zero_mask(w ^ (ones * static_cast<std::uint64_t>('\r'))) |
+      swar_zero_mask(w ^ (ones * static_cast<std::uint64_t>('\n')));
+  if (sm == 0) return -1;  // field continues past the word
+  const int len = __builtin_ctzll(sm) >> 3;  // < 8
+  if (len == 0) {
+    *out = std::nan("");
+    *stop = p;
+    return 2;
+  }
+  const std::uint64_t fmask = (1ULL << (8 * len)) - 1;
+  const std::uint64_t dm =
+      swar_zero_mask(w ^ (ones * static_cast<std::uint64_t>('.'))) & fmask;
+  std::uint64_t dg;  // ascii digits, string order (first char at LSB)
+  int ndig, frac;
+  if (dm == 0) {
+    dg = w & fmask;
+    ndig = len;
+    frac = 0;
+  } else if ((dm & (dm - 1)) == 0) {  // exactly one dot
+    const int k = __builtin_ctzll(dm) >> 3;
+    const std::uint64_t lowm = (1ULL << (8 * k)) - 1;
+    dg = (w & lowm) | ((w >> 8) & ~lowm & (fmask >> 8));
+    ndig = len - 1;
+    frac = len - 1 - k;
+  } else {
+    return -1;  // two dots: junk (strtod would reject mid-field)
+  }
+  if (ndig == 0) return -1;  // lone "." (or dot-only field): junk
+  const std::uint64_t dmask = (1ULL << (8 * ndig)) - 1;
+  const std::uint64_t x = (dg ^ (ones * 0x30)) & dmask;
+  if ((((x + ones * 0x06) | x) & (ones * 0xf0) & dmask) != 0)
+    return -1;  // non-digit byte (sign, blank, 'e', junk) -> generic
+  // Left-align into "00000ddd" MSB-first decimal order and convert
+  // (Lemire, "quickly parsing eight digits"): exact for <= 7 digits.
+  const std::uint64_t wd = x << (8 * (8 - ndig));
+  const std::uint64_t b10 =
+      ((wd * (1 + (10ULL << 8))) >> 8) & 0x00FF00FF00FF00FFULL;
+  const std::uint64_t s100 =
+      ((b10 * (1 + (100ULL << 16))) >> 16) & 0x0000FFFF0000FFFFULL;
+  const std::uint64_t val =
+      (s100 * (1 + (10000ULL << 32))) >> 32;  // <= 9999999: exact double
+  double v = static_cast<double>(static_cast<std::uint32_t>(val));
+  if (frac != 0) v /= kPow10[frac];  // exact 10^frac: correctly rounded
+  *out = v;
+  *stop = p + len;
+  return 1;
+}
+
+// Fused single-pass field parse (the single-core throughput fix: the old
+// loop touched every byte twice — once scanning for the record end, once
+// re-scanning for delimiters — and then parse_span touched the digits a
+// third time). Tries the word-batched path first, then parses digits
+// INLINE while advancing, stopping at the first structural byte.
+// Returns 0 = non-numeric (python fallback), 1 = value in *out,
+// 2 = all-blank field (*out = NaN). *stop is the structural byte
+// (delim / '\r' / '\n' / end) terminating the field. Anything unusual
+// (exponent, >15 digits, inf/nan, junk) defers to scan_structural +
+// parse_span — bit-identical to the slow path.
+inline int parse_field_inline(const char* p0, const char* end, char delim,
+                              double* out, const char** stop) {
+  const int rw = parse_field_word(p0, end, delim, out, stop);
+  if (rw >= 0) return rw;
+  const char* p = p0;
+  while (p < end && (*p == ' ' || *p == '\t')) ++p;
+  const char* begin = p;
+  bool neg = false;
+  if (p < end && (*p == '+' || *p == '-')) {
+    neg = (*p == '-');
+    ++p;
+  }
+  std::uint64_t mant = 0;
+  int digits = 0;
+  int frac = 0;
+  bool dot = false;
+  for (; p < end; ++p) {
+    const unsigned d =
+        static_cast<unsigned>(static_cast<unsigned char>(*p)) - '0';
+    if (d <= 9) {
+      if (digits >= 15) goto slow;  // long mantissa: exactness not proven
+      mant = mant * 10 + d;
+      ++digits;
+      if (dot) ++frac;
+    } else if (*p == '.' && !dot) {
+      dot = true;
+    } else {
+      break;
+    }
+  }
+  {
+    const char* t = p;
+    while (t < end && (*t == ' ' || *t == '\t')) ++t;
+    if (t == end || *t == delim || *t == '\r' || *t == '\n') {
+      if (digits == 0) {
+        if (p != begin) goto slow;  // lone sign / dot: junk
+        *out = std::nan("");        // empty / all-blank field
+        *stop = t;
+        return 2;
+      }
+      double v = static_cast<double>(mant);
+      if (frac != 0) v /= kPow10[frac];  // frac <= digits <= 15 <= 22
+      *out = neg ? -v : v;
+      *stop = t;
+      return 1;
+    }
+  }
+slow:
+  (void)begin;
+  {
+    const char* s = scan_structural(p, end, delim);
+    *stop = s;
+    return parse_span(p0, s, out) ? 1 : 0;
+  }
+}
+
 struct ChunkResult {
   std::vector<double> vals;  // row-major, rows * ncols
   long long rows = 0;
@@ -152,6 +417,8 @@ struct ChunkResult {
 
 // Parse an unquoted byte range whose ncols is already known. Short rows
 // NaN-pad; wide rows or non-numeric fields set err (python fallback).
+// One fused pass: every byte is visited once (parse_field_inline), vs
+// the previous record-scan + field-scan + parse_span triple touch.
 void parse_chunk(const char* p, const char* chunk_end, char delim,
                  size_t ncols, ChunkResult* out) {
   std::vector<double>& values = out->vals;
@@ -159,36 +426,131 @@ void parse_chunk(const char* p, const char* chunk_end, char delim,
   // rest — a worst-case reserve would commit ~4x the file size in address
   // space and can bad_alloc under cgroup/ulimit caps
   values.reserve(static_cast<size_t>((chunk_end - p) / 8) + ncols);
+  size_t col = 0;
   while (p < chunk_end) {
-    const char* rec_end = p;
-    while (rec_end < chunk_end && *rec_end != '\r' && *rec_end != '\n')
-      ++rec_end;
-    const char* next = skip_sep(rec_end, chunk_end);
-    const char* q = p;
-    while (q < rec_end && (*q == ' ' || *q == '\t')) ++q;
-    if (q == rec_end) {  // blank record
-      p = next;
+    double v;
+    const char* stop;
+    const int r = parse_field_inline(p, chunk_end, delim, &v, &stop);
+    if (r == 0) {
+      out->err = true;
+      return;
+    }
+    if (stop < chunk_end && *stop == delim) {  // field, more to come
+      if (col >= ncols) {  // ragged wide row -> python fallback
+        out->err = true;
+        return;
+      }
+      values.push_back(v);
+      ++col;
+      p = stop + 1;
+    } else {  // record end ('\r' / '\n' / buffer end)
+      if (col == 0 && r == 2) {  // blank record: skip, no NaN row
+        p = skip_sep(stop, chunk_end);
+        continue;
+      }
+      if (col >= ncols) {
+        out->err = true;
+        return;
+      }
+      values.push_back(v);
+      ++col;
+      for (; col < ncols; ++col) values.push_back(std::nan(""));
+      ++out->rows;
+      col = 0;
+      p = skip_sep(stop, chunk_end);
+    }
+  }
+}
+
+// Upper bound on the number of records in [p, end): separators counted
+// as count('\n') + count('\r') - count("\r\n"), plus a trailing
+// unterminated record. Blank lines make this an OVERcount — the direct
+// path compacts afterwards. One SWAR pass with popcounts (a memchr-per-
+// line loop costs ~8 ns/line in call overhead at ~9-byte records — it
+// was 18% of the whole parse).
+size_t count_records_upper(const char* p, const char* end) {
+  if (p >= end) return 0;
+  const std::uint64_t ones = 0x0101010101010101ULL;
+  const std::uint64_t npat = ones * static_cast<std::uint64_t>('\n');
+  const std::uint64_t rpat = ones * static_cast<std::uint64_t>('\r');
+  size_t nl = 0, cr = 0, crlf = 0;
+  bool prev_cr = false;
+  while (p + 8 <= end) {
+    std::uint64_t w;
+    std::memcpy(&w, p, 8);
+    const std::uint64_t nm = swar_zero_mask(w ^ npat);
+    const std::uint64_t rm = swar_zero_mask(w ^ rpat);
+    nl += static_cast<size_t>(__builtin_popcountll(nm));
+    cr += static_cast<size_t>(__builtin_popcountll(rm));
+    // '\r' at byte i pairs with '\n' at byte i+1; little-endian puts
+    // byte i at bits [8i, 8i+8), so shift the CR mask up one byte.
+    crlf += static_cast<size_t>(__builtin_popcountll((rm << 8) & nm));
+    if (prev_cr && (nm & 0x80u)) ++crlf;  // pair across the word edge
+    prev_cr = (rm >> 56) != 0;
+    p += 8;
+  }
+  for (; p < end; ++p) {
+    const char c = *p;
+    if (c == '\n') {
+      ++nl;
+      if (prev_cr) ++crlf;
+    } else if (c == '\r') {
+      ++cr;
+    }
+    prev_cr = (c == '\r');
+  }
+  size_t n = nl + cr - crlf;
+  const char last = end[-1];
+  if (last != '\n' && last != '\r') ++n;  // unterminated final record
+  return n;
+}
+
+// Single-thread unquoted fast path: parse [p, chunk_end) STRAIGHT into
+// the column-major output (rows starting at row0, capacity cap_rows) —
+// no row-major staging vector, no transpose pass, and integral flags
+// tracked inline instead of a floor() sweep afterwards. This halves the
+// memory traffic of the old staged pipeline; on a one-core host (where
+// the parallel chunk path cannot engage) it is the difference between
+// ~0.2 and ~0.5 GB/s. Returns rows written, or -1 on non-numeric /
+// ragged input (python fallback).
+long long parse_direct(const char* p, const char* chunk_end, char delim,
+                       size_t ncols, double* data, long long cap_rows,
+                       long long row0, char* int_flags) {
+  // Per-column write cursors: one pointer increment per field instead of
+  // a col*cap_rows+row multiply; flags short-circuit so a column that
+  // already proved non-integral costs one predictable branch per field.
+  std::vector<double*> cur(ncols);
+  for (size_t j = 0; j < ncols; ++j)
+    cur[j] = data + j * static_cast<size_t>(cap_rows) + row0;
+  long long rows = 0;
+  size_t col = 0;
+  while (p < chunk_end) {
+    double v;
+    const char* stop;
+    const int r = parse_field_inline(p, chunk_end, delim, &v, &stop);
+    if (r == 0) return -1;
+    const bool at_delim = stop < chunk_end && *stop == delim;
+    if (col == 0 && !at_delim && r == 2) {  // blank record: skip
+      p = skip_sep(stop, chunk_end);
       continue;
     }
-    size_t col = 0;
-    const char* field = p;
-    for (const char* c = p;; ++c) {
-      if (c == rec_end || *c == delim) {
-        double v;
-        if (col >= ncols || !parse_span(field, c, &v)) {
-          out->err = true;
-          return;
-        }
-        values.push_back(v);
-        ++col;
-        field = c + 1;
-        if (c == rec_end) break;
+    if (col >= ncols || row0 + rows >= cap_rows) return -1;  // ragged wide
+    *cur[col]++ = v;
+    if (int_flags[col] != 0 && non_integral_int32(v)) int_flags[col] = 0;
+    ++col;
+    if (at_delim) {
+      p = stop + 1;
+    } else {
+      for (; col < ncols; ++col) {  // NaN-pad short rows
+        *cur[col]++ = std::nan("");
+        int_flags[col] = 0;
       }
+      ++rows;
+      col = 0;
+      p = skip_sep(stop, chunk_end);
     }
-    for (; col < ncols; ++col) values.push_back(std::nan(""));
-    ++out->rows;
-    p = next;
   }
+  return rows;
 }
 
 int thread_budget(size_t bytes) {
@@ -220,21 +582,14 @@ long long dq_parse_numeric_csv(const char* path, char delim, char quote,
   *out_ncols = 0;
   *out_int_flags = nullptr;
 
-  std::FILE* f = std::fopen(path, "rb");
-  if (f == nullptr) return -2;
-  std::fseek(f, 0, SEEK_END);
-  long size = std::ftell(f);
-  std::fseek(f, 0, SEEK_SET);
-  std::string text(static_cast<size_t>(size), '\0');
-  size_t got =
-      size > 0 ? std::fread(&text[0], 1, static_cast<size_t>(size), f) : 0;
-  std::fclose(f);
-  text.resize(got);
+  FileBuf fb;
+  load_file(path, &fb);
+  if (!fb.ok) return -2;
 
-  const char* const file_begin = text.data();
-  const char* const file_end = file_begin + text.size();
+  const char* const file_begin = fb.data;
+  const char* const file_end = file_begin + fb.size;
   const bool has_quote =
-      std::memchr(file_begin, quote, text.size()) != nullptr;
+      fb.size > 0 && std::memchr(file_begin, quote, fb.size) != nullptr;
 
   // ---- parse into row-major `values` (+ per-chunk pieces when parallel) --
   std::vector<double> values;  // serial path / parallel prologue
@@ -285,6 +640,46 @@ long long dq_parse_numeric_csv(const char* path, char delim, char quote,
       return 0;
     }
     nthreads = thread_budget(static_cast<size_t>(file_end - p));
+    if (nthreads == 1) {
+      // Single-thread: skip the row-major staging + transpose entirely
+      // and write column-major directly (see parse_direct). Capacity =
+      // separator count (blank lines overcount; compacted below).
+      const long long cap =
+          1 + static_cast<long long>(count_records_upper(p, file_end));
+      double* data = static_cast<double*>(
+          std::malloc(sizeof(double) * ncols * static_cast<size_t>(cap)));
+      char* int_flags = static_cast<char*>(std::malloc(ncols));
+      if (data == nullptr || int_flags == nullptr) {
+        std::free(data);
+        std::free(int_flags);
+        return -2;
+      }
+      std::memset(int_flags, 1, ncols);
+      for (size_t j = 0; j < ncols; ++j) {  // prologue's first record
+        const double v = values[j];
+        data[j * static_cast<size_t>(cap)] = v;
+        if (non_integral_int32(v)) int_flags[j] = 0;
+      }
+      const long long more =
+          parse_direct(p, file_end, delim, ncols, data, cap, 1, int_flags);
+      if (more < 0) {
+        std::free(data);
+        std::free(int_flags);
+        return -1;
+      }
+      const long long total = 1 + more;
+      if (total < cap) {  // blank lines overcounted: compact the strides
+        for (size_t j = 1; j < ncols; ++j) {
+          std::memmove(data + j * static_cast<size_t>(total),
+                       data + j * static_cast<size_t>(cap),
+                       sizeof(double) * static_cast<size_t>(total));
+        }
+      }
+      *out_data = data;
+      *out_ncols = static_cast<long long>(ncols);
+      *out_int_flags = int_flags;
+      return total;
+    }
     std::vector<const char*> bounds;  // nthreads+1 chunk edges
     bounds.push_back(p);
     const size_t tail = static_cast<size_t>(file_end - p);
@@ -471,10 +866,7 @@ long long dq_parse_numeric_csv(const char* path, char delim, char quote,
         const double v = row[j];
         data[j * static_cast<size_t>(nrows) +
              static_cast<size_t>(pc.row0 + i)] = v;
-        if (std::isnan(v) || v != std::floor(v) || v < -2147483648.0 ||
-            v > 2147483647.0) {
-          fl[j] = 0;
-        }
+        if (fl[j] != 0 && non_integral_int32(v)) fl[j] = 0;
       }
     }
   };

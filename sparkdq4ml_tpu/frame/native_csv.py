@@ -95,25 +95,28 @@ def try_read_csv(path: str, header: bool, infer_schema: bool, delimiter: str,
         if nrows == -2:
             raise FileNotFoundError(path)
         return None  # non-numeric content → python engine
+    data = {}
     try:
         nc = ncols.value
         if nc == 0 or nrows == 0:
             from .frame import Frame
             return Frame({})
-        flat = np.ctypeslib.as_array(data_p, shape=(nc * nrows,)).copy()
+        # No intermediate .copy(): astype below always copies out of the
+        # C buffer (dtype conversion or copy=True default), so an extra
+        # staging copy would just add a full-matrix memory pass.
+        flat = np.ctypeslib.as_array(data_p, shape=(nc * nrows,))
         cols = flat.reshape(nc, nrows)  # column-major from C
         int_flags = bytes(ctypes.cast(intf_p, ctypes.POINTER(ctypes.c_char * nc)).contents)
+        for j in range(nc):
+            col = cols[j]
+            if int_flags[j]:
+                data[f"_c{j}"] = col.astype(np.dtype(int_dtype()))
+            else:
+                data[f"_c{j}"] = col.astype(np.dtype(float_dtype()))
     finally:
         lib.dq_free(data_p)
         lib.dq_free(intf_p)
 
     from .frame import Frame
 
-    data = {}
-    for j in range(nc):
-        col = cols[j]
-        if int_flags[j]:
-            data[f"_c{j}"] = col.astype(np.dtype(int_dtype()))
-        else:
-            data[f"_c{j}"] = col.astype(np.dtype(float_dtype()))
     return Frame(data)
