@@ -51,6 +51,10 @@
 #include <unistd.h>
 #endif
 
+#ifdef __AVX2__
+#include <immintrin.h>
+#endif
+
 namespace {
 
 // File buffer: mmap when possible (zero-copy — the old fread-into-
@@ -278,15 +282,65 @@ inline const char* scan_structural(const char* p, const char* end,
   return p;
 }
 
+// Shared word-conversion core: given the 8-byte load `w` and the field
+// length (1..7), split on the optional dot, validate every byte is a
+// digit, and convert (Lemire, "quickly parsing eight digits" — exact for
+// <= 7 digits; the final /10^frac is an exact power: correctly rounded).
+// Returns 3 = integral-by-construction (bare digits, <= 9999999 — an
+// int32 for free), 1 = value with a fraction, 0 = not covered (sign,
+// exponent, junk, two dots) -> caller's generic path. ONE definition so
+// the serial bitmap walk and the parallel chunk path can never diverge
+// bit-wise.
+inline int convert_digits_word(std::uint64_t w, int len, double* out) {
+  const std::uint64_t ones = 0x0101010101010101ULL;
+  const std::uint64_t fmask = (1ULL << (8 * len)) - 1;
+  const std::uint64_t dm =
+      swar_zero_mask(w ^ (ones * static_cast<std::uint64_t>('.'))) & fmask;
+  std::uint64_t dg;  // ascii digits, string order (first char at LSB)
+  int ndig, frac;
+  if (dm == 0) {
+    dg = w & fmask;
+    ndig = len;
+    frac = 0;
+  } else if ((dm & (dm - 1)) == 0) {  // exactly one dot
+    const int k = __builtin_ctzll(dm) >> 3;
+    const std::uint64_t lowm = (1ULL << (8 * k)) - 1;
+    dg = (w & lowm) | ((w >> 8) & ~lowm & (fmask >> 8));
+    ndig = len - 1;
+    frac = len - 1 - k;
+  } else {
+    return 0;  // two dots: junk (strtod would reject mid-field)
+  }
+  if (ndig == 0) return 0;  // lone "." (or dot-only field): junk
+  const std::uint64_t dmask = (1ULL << (8 * ndig)) - 1;
+  const std::uint64_t x = (dg ^ (ones * 0x30)) & dmask;
+  if ((((x + ones * 0x06) | x) & (ones * 0xf0) & dmask) != 0)
+    return 0;  // non-digit byte (sign, blank, 'e', junk) -> generic
+  // Left-align into "00000ddd" MSB-first decimal order and convert.
+  const std::uint64_t wd = x << (8 * (8 - ndig));
+  const std::uint64_t b10 =
+      ((wd * (1 + (10ULL << 8))) >> 8) & 0x00FF00FF00FF00FFULL;
+  const std::uint64_t s100 =
+      ((b10 * (1 + (100ULL << 16))) >> 16) & 0x0000FFFF0000FFFFULL;
+  const std::uint64_t val =
+      (s100 * (1 + (10000ULL << 32))) >> 32;  // <= 9999999: exact double
+  double v = static_cast<double>(static_cast<std::uint32_t>(val));
+  if (frac != 0) {
+    *out = v / kPow10[frac];
+    return 1;
+  }
+  *out = v;
+  return 3;
+}
+
 // Word-batched field parse: ONE 8-byte load yields the field boundary
-// (structural SWAR mask), the dot position, the digit-validity check,
-// and the numeric value (Lemire 8-digit SWAR conversion) — ~25
-// branch-light ops/field vs the generic byte loop's 3 branches/byte,
-// which is what per-field costs look like when fields average ~4 bytes.
-// Covers unsigned fields of <= 7 digit/dot bytes terminated inside the
-// word — the overwhelming shape of numeric CSVs. Returns 1 = value,
-// 2 = empty field, -1 = not covered (sign, >=8 bytes, exponent, junk,
-// near buffer end) -> caller's generic loop decides.
+// (structural SWAR mask) plus everything convert_digits_word derives
+// from it — ~25 branch-light ops/field vs the generic byte loop's 3
+// branches/byte, which is what per-field costs look like when fields
+// average ~4 bytes. Covers unsigned fields of <= 7 digit/dot bytes
+// terminated inside the word — the overwhelming shape of numeric CSVs.
+// Returns 1 = value, 2 = empty field, -1 = not covered -> caller's
+// generic loop decides.
 inline int parse_field_word(const char* p, const char* end, char delim,
                             double* out, const char** stop) {
   if (p + 8 > end) return -1;
@@ -304,41 +358,8 @@ inline int parse_field_word(const char* p, const char* end, char delim,
     *stop = p;
     return 2;
   }
-  const std::uint64_t fmask = (1ULL << (8 * len)) - 1;
-  const std::uint64_t dm =
-      swar_zero_mask(w ^ (ones * static_cast<std::uint64_t>('.'))) & fmask;
-  std::uint64_t dg;  // ascii digits, string order (first char at LSB)
-  int ndig, frac;
-  if (dm == 0) {
-    dg = w & fmask;
-    ndig = len;
-    frac = 0;
-  } else if ((dm & (dm - 1)) == 0) {  // exactly one dot
-    const int k = __builtin_ctzll(dm) >> 3;
-    const std::uint64_t lowm = (1ULL << (8 * k)) - 1;
-    dg = (w & lowm) | ((w >> 8) & ~lowm & (fmask >> 8));
-    ndig = len - 1;
-    frac = len - 1 - k;
-  } else {
-    return -1;  // two dots: junk (strtod would reject mid-field)
-  }
-  if (ndig == 0) return -1;  // lone "." (or dot-only field): junk
-  const std::uint64_t dmask = (1ULL << (8 * ndig)) - 1;
-  const std::uint64_t x = (dg ^ (ones * 0x30)) & dmask;
-  if ((((x + ones * 0x06) | x) & (ones * 0xf0) & dmask) != 0)
-    return -1;  // non-digit byte (sign, blank, 'e', junk) -> generic
-  // Left-align into "00000ddd" MSB-first decimal order and convert
-  // (Lemire, "quickly parsing eight digits"): exact for <= 7 digits.
-  const std::uint64_t wd = x << (8 * (8 - ndig));
-  const std::uint64_t b10 =
-      ((wd * (1 + (10ULL << 8))) >> 8) & 0x00FF00FF00FF00FFULL;
-  const std::uint64_t s100 =
-      ((b10 * (1 + (100ULL << 16))) >> 16) & 0x0000FFFF0000FFFFULL;
-  const std::uint64_t val =
-      (s100 * (1 + (10000ULL << 32))) >> 32;  // <= 9999999: exact double
-  double v = static_cast<double>(static_cast<std::uint32_t>(val));
-  if (frac != 0) v /= kPow10[frac];  // exact 10^frac: correctly rounded
-  *out = v;
+  const int r = convert_digits_word(w, len, out);
+  if (r == 0) return -1;
   *stop = p + len;
   return 1;
 }
@@ -460,95 +481,263 @@ void parse_chunk(const char* p, const char* chunk_end, char delim,
       p = skip_sep(stop, chunk_end);
     }
   }
+  if (col > 0) {
+    // Trailing delimiter at EOF ("...3," with no newline): the implicit
+    // final field is empty — emit it (NaN) and close the record instead
+    // of silently dropping the half-written row (python-engine parity).
+    if (col >= ncols) {
+      out->err = true;
+      return;
+    }
+    values.push_back(std::nan(""));
+    ++col;
+    for (; col < ncols; ++col) values.push_back(std::nan(""));
+    ++out->rows;
+  }
 }
 
-// Upper bound on the number of records in [p, end): separators counted
-// as count('\n') + count('\r') - count("\r\n"), plus a trailing
-// unterminated record. Blank lines make this an OVERcount — the direct
-// path compacts afterwards. One SWAR pass with popcounts (a memchr-per-
-// line loop costs ~8 ns/line in call overhead at ~9-byte records — it
-// was 18% of the whole parse).
-size_t count_records_upper(const char* p, const char* end) {
-  if (p >= end) return 0;
-  const std::uint64_t ones = 0x0101010101010101ULL;
-  const std::uint64_t npat = ones * static_cast<std::uint64_t>('\n');
-  const std::uint64_t rpat = ones * static_cast<std::uint64_t>('\r');
+// Length-known word conversion for the bitmap walk: the boundary is
+// already fixed by the structural bitmap, so this is one 8-byte load
+// handed to the shared convert_digits_word core. len must be 1..7 with
+// 8 readable bytes at p; return codes are the core's (3/1/0).
+inline int convert_field_word(const char* p, int len, double* out) {
+  std::uint64_t w;
+  std::memcpy(&w, p, 8);
+  return convert_digits_word(w, len, out);
+}
+
+// Structural bitmap for [p, p+n): bit i of bits[i/64] set iff byte i is
+// delim / '\r' / '\n'. Also returns the record-separator upper bound
+// (count('\n') + count('\r') - count("\r\n") + trailing unterminated) so
+// the capacity pass and the classify pass are ONE sweep. AVX2 when the
+// build target has it (-march=native probe in the Makefile): two 32-byte
+// compares per 64-byte group, ~24 GB/s — the byte-at-a-time record scan
+// this replaces was 10%+ of the whole parse. Portable SWAR fallback.
+size_t build_structural_bitmap(const char* p, size_t n, char delim,
+                               std::uint64_t* bits, bool* has_cr) {
   size_t nl = 0, cr = 0, crlf = 0;
   bool prev_cr = false;
-  while (p + 8 <= end) {
-    std::uint64_t w;
-    std::memcpy(&w, p, 8);
-    const std::uint64_t nm = swar_zero_mask(w ^ npat);
-    const std::uint64_t rm = swar_zero_mask(w ^ rpat);
+  size_t i = 0;
+#ifdef __AVX2__
+  const __m256i vd = _mm256_set1_epi8(delim);
+  const __m256i vr = _mm256_set1_epi8('\r');
+  const __m256i vn = _mm256_set1_epi8('\n');
+  for (; i + 64 <= n; i += 64) {
+    const __m256i a =
+        _mm256_loadu_si256(reinterpret_cast<const __m256i*>(p + i));
+    const __m256i b =
+        _mm256_loadu_si256(reinterpret_cast<const __m256i*>(p + i + 32));
+    const std::uint64_t ra =
+        static_cast<std::uint32_t>(_mm256_movemask_epi8(
+            _mm256_cmpeq_epi8(a, vr)));
+    const std::uint64_t rb =
+        static_cast<std::uint32_t>(_mm256_movemask_epi8(
+            _mm256_cmpeq_epi8(b, vr)));
+    const std::uint64_t na =
+        static_cast<std::uint32_t>(_mm256_movemask_epi8(
+            _mm256_cmpeq_epi8(a, vn)));
+    const std::uint64_t nb =
+        static_cast<std::uint32_t>(_mm256_movemask_epi8(
+            _mm256_cmpeq_epi8(b, vn)));
+    const std::uint64_t da =
+        static_cast<std::uint32_t>(_mm256_movemask_epi8(
+            _mm256_cmpeq_epi8(a, vd)));
+    const std::uint64_t db =
+        static_cast<std::uint32_t>(_mm256_movemask_epi8(
+            _mm256_cmpeq_epi8(b, vd)));
+    const std::uint64_t rm = ra | (rb << 32);
+    const std::uint64_t nm = na | (nb << 32);
+    bits[i / 64] = rm | nm | da | (db << 32);
     nl += static_cast<size_t>(__builtin_popcountll(nm));
     cr += static_cast<size_t>(__builtin_popcountll(rm));
-    // '\r' at byte i pairs with '\n' at byte i+1; little-endian puts
-    // byte i at bits [8i, 8i+8), so shift the CR mask up one byte.
-    crlf += static_cast<size_t>(__builtin_popcountll((rm << 8) & nm));
-    if (prev_cr && (nm & 0x80u)) ++crlf;  // pair across the word edge
-    prev_cr = (rm >> 56) != 0;
-    p += 8;
+    crlf += static_cast<size_t>(__builtin_popcountll((rm << 1) & nm));
+    if (prev_cr && (nm & 1u)) ++crlf;
+    prev_cr = (rm >> 63) != 0;
   }
-  for (; p < end; ++p) {
-    const char c = *p;
-    if (c == '\n') {
-      ++nl;
-      if (prev_cr) ++crlf;
-    } else if (c == '\r') {
-      ++cr;
+#else
+  const std::uint64_t ones = 0x0101010101010101ULL;
+  const std::uint64_t dpat = ones * static_cast<unsigned char>(delim);
+  const std::uint64_t rpat = ones * static_cast<std::uint64_t>('\r');
+  const std::uint64_t npat = ones * static_cast<std::uint64_t>('\n');
+  for (; i + 64 <= n; i += 64) {
+    std::uint64_t m = 0;
+    for (size_t j = 0; j < 64; j += 8) {
+      std::uint64_t w;
+      std::memcpy(&w, p + i + j, 8);
+      const std::uint64_t rm8 = swar_zero_mask(w ^ rpat);
+      const std::uint64_t nm8 = swar_zero_mask(w ^ npat);
+      const std::uint64_t dm8 = swar_zero_mask(w ^ dpat);
+      nl += static_cast<size_t>(__builtin_popcountll(nm8));
+      cr += static_cast<size_t>(__builtin_popcountll(rm8));
+      crlf += static_cast<size_t>(__builtin_popcountll((rm8 << 8) & nm8));
+      if (prev_cr && (nm8 & 0x80u)) ++crlf;
+      prev_cr = (rm8 >> 56) != 0;
+      // compress bit-7-of-each-byte down to 8 adjacent bits
+      m |= ((((rm8 | nm8 | dm8) >> 7) * 0x0102040810204081ULL) >> 56) << j;
     }
-    prev_cr = (c == '\r');
+    bits[i / 64] = m;
   }
-  size_t n = nl + cr - crlf;
-  const char last = end[-1];
-  if (last != '\n' && last != '\r') ++n;  // unterminated final record
-  return n;
+#endif
+  for (; i < n; i += 64) {  // scalar tail (< 64 bytes, plus non-AVX rest)
+    std::uint64_t m = 0;
+    const size_t lim = (n - i < 64) ? n - i : 64;
+    for (size_t j = 0; j < lim; ++j) {
+      const char c = p[i + j];
+      if (c == '\n') {
+        ++nl;
+        if (prev_cr) ++crlf;
+        m |= 1ULL << j;
+      } else if (c == '\r') {
+        ++cr;
+        m |= 1ULL << j;
+      } else if (c == delim) {
+        m |= 1ULL << j;
+      }
+      prev_cr = (c == '\r');
+    }
+    bits[i / 64] = m;
+  }
+  size_t recs = nl + cr - crlf;
+  if (n > 0) {
+    const char last = p[n - 1];
+    if (last != '\n' && last != '\r') ++recs;  // unterminated final record
+  }
+  *has_cr = (cr != 0);  // lets the walk drop its CRLF checks entirely
+  return recs;
 }
 
-// Single-thread unquoted fast path: parse [p, chunk_end) STRAIGHT into
-// the column-major output (rows starting at row0, capacity cap_rows) —
-// no row-major staging vector, no transpose pass, and integral flags
-// tracked inline instead of a floor() sweep afterwards. This halves the
-// memory traffic of the old staged pipeline; on a one-core host (where
-// the parallel chunk path cannot engage) it is the difference between
-// ~0.2 and ~0.5 GB/s. Returns rows written, or -1 on non-numeric /
-// ragged input (python fallback).
-long long parse_direct(const char* p, const char* chunk_end, char delim,
-                       size_t ncols, double* data, long long cap_rows,
-                       long long row0, char* int_flags) {
-  // Per-column write cursors: one pointer increment per field instead of
-  // a col*cap_rows+row multiply; flags short-circuit so a column that
-  // already proved non-integral costs one predictable branch per field.
+// Single-thread unquoted fast path, bitmap-driven: phase A above already
+// classified every structural byte, so this walk takes field ADDRESSES
+// from the bitmap instead of deriving each from the previous field's
+// parsed length — the loop-carried dependency becomes ctz over a mask
+// word, and the ~20-cycle per-field convert chains (Lemire SWAR digits,
+// the exact divide by 10^frac) are independent work the OoO core
+// overlaps 2-3x. A field the word-convert rejects (sign, exponent, >= 8
+// bytes, junk) goes through parse_span on its exact [prev, pos) span —
+// bit-identical to the generic path. Integral tracking is free for the
+// common shape: a word-parsed field with frac == 0 is 1-7 bare digits,
+// which IS an integral int32 by construction, so only frac > 0 and
+// generic-path values pay the cvttsd2si check. kHasCR comes from phase A
+// (cr count == 0, i.e. the usual LF-only file, drops the per-field CRLF
+// pair check from the walk entirely). Returns rows written, or -1 on
+// non-numeric / ragged input (python fallback).
+template <bool kHasCR>
+long long parse_direct_bitmap(const char* base, const char* chunk_end,
+                              char delim, size_t ncols, double* data,
+                              long long cap_rows, long long row0,
+                              char* int_flags, const std::uint64_t* bits,
+                              size_t bit0) {
+  const size_t n = static_cast<size_t>(chunk_end - base);
   std::vector<double*> cur(ncols);
   for (size_t j = 0; j < ncols; ++j)
     cur[j] = data + j * static_cast<size_t>(cap_rows) + row0;
   long long rows = 0;
   size_t col = 0;
-  while (p < chunk_end) {
-    double v;
-    const char* stop;
-    const int r = parse_field_inline(p, chunk_end, delim, &v, &stop);
-    if (r == 0) return -1;
-    const bool at_delim = stop < chunk_end && *stop == delim;
-    if (col == 0 && !at_delim && r == 2) {  // blank record: skip
-      p = skip_sep(stop, chunk_end);
-      continue;
+  size_t prev = bit0;  // current field start (absolute byte offset)
+  const size_t nwords = (n + 63) / 64;
+  for (size_t k = bit0 / 64; k < nwords; ++k) {
+    std::uint64_t word = bits[k];
+    if (k == bit0 / 64 && (bit0 % 64) != 0)
+      word &= ~((1ULL << (bit0 % 64)) - 1);  // ignore prologue's bytes
+    while (word != 0) {
+      const size_t pos =
+          k * 64 + static_cast<size_t>(__builtin_ctzll(word));
+      word &= word - 1;
+      const char c = base[pos];
+      if (kHasCR && c == '\n' && pos == prev && pos > bit0 &&
+          base[pos - 1] == '\r') {
+        prev = pos + 1;  // second half of a CRLF pair
+        continue;
+      }
+      const size_t len = pos - prev;
+      double v;
+      int r;  // 3 = integral value, 1 = value, 2 = blank field
+      if (len >= 1 && len <= 7 && prev + 8 <= n) {  // word readable
+        r = convert_field_word(base + prev, static_cast<int>(len), &v);
+      } else {
+        r = 0;
+      }
+      if (r == 0) {  // empty, long, signed, exponent, junk -> exact span
+        const char* fb = base + prev;
+        const char* fe = base + pos;
+        const char* q = fb;
+        while (q < fe && (*q == ' ' || *q == '\t')) ++q;
+        if (q == fe) {
+          v = std::nan("");
+          r = 2;
+        } else if (parse_span(fb, fe, &v)) {
+          r = 1;
+        } else {
+          return -1;  // non-numeric -> python fallback
+        }
+      }
+      const bool at_delim = (c == delim);
+      if (col == 0 && !at_delim && r == 2) {  // blank record: skip
+        prev = pos + 1;
+        continue;
+      }
+      if (col >= ncols || row0 + rows >= cap_rows) return -1;
+      *cur[col]++ = v;
+      if (r != 3 && int_flags[col] != 0 && non_integral_int32(v))
+        int_flags[col] = 0;  // r==3: integral by construction, no check
+      ++col;
+      if (at_delim) {
+        prev = pos + 1;
+      } else {
+        for (; col < ncols; ++col) {  // NaN-pad short rows
+          *cur[col]++ = std::nan("");
+          int_flags[col] = 0;
+        }
+        ++rows;
+        col = 0;
+        prev = pos + 1;
+      }
     }
-    if (col >= ncols || row0 + rows >= cap_rows) return -1;  // ragged wide
-    *cur[col]++ = v;
-    if (int_flags[col] != 0 && non_integral_int32(v)) int_flags[col] = 0;
-    ++col;
-    if (at_delim) {
-      p = stop + 1;
-    } else {
-      for (; col < ncols; ++col) {  // NaN-pad short rows
+  }
+  if (prev < n) {  // unterminated final record: one trailing field
+    double v;
+    int r = 0;
+    const size_t len = n - prev;
+    if (len >= 1 && len <= 7 && prev + 8 <= n)
+      r = convert_field_word(base + prev, static_cast<int>(len), &v);
+    if (r == 0) {
+      const char* fb = base + prev;
+      const char* q = fb;
+      while (q < chunk_end && (*q == ' ' || *q == '\t')) ++q;
+      if (q == chunk_end) {
+        v = std::nan("");
+        r = 2;
+      } else if (parse_span(fb, chunk_end, &v)) {
+        r = 1;
+      } else {
+        return -1;
+      }
+    }
+    if (!(col == 0 && r == 2)) {
+      if (col >= ncols || row0 + rows >= cap_rows) return -1;
+      *cur[col]++ = v;
+      if (r != 3 && int_flags[col] != 0 && non_integral_int32(v))
+        int_flags[col] = 0;
+      ++col;
+      for (; col < ncols; ++col) {
         *cur[col]++ = std::nan("");
         int_flags[col] = 0;
       }
       ++rows;
-      col = 0;
-      p = skip_sep(stop, chunk_end);
     }
+  } else if (col > 0) {
+    // Trailing delimiter at EOF ("...3," with no newline): the implicit
+    // final field is empty — emit it (NaN) and close the record instead
+    // of silently dropping the half-written row (python-engine parity).
+    if (col >= ncols || row0 + rows >= cap_rows) return -1;
+    *cur[col]++ = std::nan("");
+    int_flags[col] = 0;
+    ++col;
+    for (; col < ncols; ++col) {
+      *cur[col]++ = std::nan("");
+      int_flags[col] = 0;
+    }
+    ++rows;
   }
   return rows;
 }
@@ -642,10 +831,15 @@ long long dq_parse_numeric_csv(const char* path, char delim, char quote,
     nthreads = thread_budget(static_cast<size_t>(file_end - p));
     if (nthreads == 1) {
       // Single-thread: skip the row-major staging + transpose entirely
-      // and write column-major directly (see parse_direct). Capacity =
-      // separator count (blank lines overcount; compacted below).
-      const long long cap =
-          1 + static_cast<long long>(count_records_upper(p, file_end));
+      // and write column-major directly (see parse_direct_bitmap).
+      // ONE classify sweep yields both the capacity (separator count;
+      // blank lines overcount and are compacted below) and the
+      // structural bitmap the walk consumes.
+      const size_t tail_n = static_cast<size_t>(file_end - p);
+      std::vector<std::uint64_t> bits((tail_n + 63) / 64);
+      bool has_cr = false;
+      const long long cap = 1 + static_cast<long long>(
+          build_structural_bitmap(p, tail_n, delim, bits.data(), &has_cr));
       double* data = static_cast<double*>(
           std::malloc(sizeof(double) * ncols * static_cast<size_t>(cap)));
       char* int_flags = static_cast<char*>(std::malloc(ncols));
@@ -661,7 +855,12 @@ long long dq_parse_numeric_csv(const char* path, char delim, char quote,
         if (non_integral_int32(v)) int_flags[j] = 0;
       }
       const long long more =
-          parse_direct(p, file_end, delim, ncols, data, cap, 1, int_flags);
+          has_cr ? parse_direct_bitmap<true>(p, file_end, delim, ncols,
+                                             data, cap, 1, int_flags,
+                                             bits.data(), 0)
+                 : parse_direct_bitmap<false>(p, file_end, delim, ncols,
+                                              data, cap, 1, int_flags,
+                                              bits.data(), 0);
       if (more < 0) {
         std::free(data);
         std::free(int_flags);

@@ -129,6 +129,15 @@ def _try_float(s: str) -> Optional[float]:
         return None
 
 
+def _is_null_field(v: str) -> bool:
+    """Whitespace-only (incl. empty) fields are nulls for numeric/boolean
+    typing — Spark's univocity parser trims unquoted fields by default
+    (ignoreLeading/TrailingWhiteSpace), so "  " reads as empty -> null.
+    The native tokenizer (parse_span) agrees. String columns keep the
+    narrower exact-"" rule so "  " survives as a value there."""
+    return v in _NULL_STRINGS or not v.strip()
+
+
 def infer_column(values: Sequence[str]):
     """Infer one column's type and parse it.
 
@@ -136,7 +145,7 @@ def infer_column(values: Sequence[str]):
     Spark CSV inferrer's ladder. Returns a numpy array (object dtype for
     strings).
     """
-    non_null = [v for v in values if v not in _NULL_STRINGS]
+    non_null = [v for v in values if not _is_null_field(v)]
     has_null = len(non_null) != len(values)
 
     if non_null and all(_try_int(v) is not None for v in non_null):
@@ -146,10 +155,10 @@ def infer_column(values: Sequence[str]):
             dt = np.dtype(int_dtype()) if -(2**31) <= lo and hi < 2**31 else np.int64
             return np.asarray([int(v) for v in values], dtype=dt)
         # int column with nulls promotes to double + NaN
-        return np.asarray([float(v) if v not in _NULL_STRINGS else np.nan
+        return np.asarray([np.nan if _is_null_field(v) else float(v)
                            for v in values], dtype=np.dtype(float_dtype()))
     if non_null and all(_try_float(v) is not None for v in non_null):
-        return np.asarray([float(v) if v not in _NULL_STRINGS else np.nan
+        return np.asarray([np.nan if _is_null_field(v) else float(v)
                            for v in values], dtype=np.dtype(float_dtype()))
     if non_null and all(v in _TRUE or v in _FALSE for v in non_null) and not has_null:
         return np.asarray([v in _TRUE for v in values], dtype=np.bool_)
@@ -186,7 +195,7 @@ def _cast_column(values: list, type_name: str):
         return np.asarray([v if v not in _NULL_STRINGS else None
                            for v in values], dtype=object)
     if type_name == "boolean":
-        out = [None if v in _NULL_STRINGS
+        out = [None if _is_null_field(v)
                else v.strip().lower() == "true" for v in values]
         if any(v is None for v in out):
             return np.asarray([np.nan if v is None else float(v)

@@ -143,6 +143,61 @@ class TestNativeParity:
         with pytest.raises(FileNotFoundError):
             read_csv("/nonexistent-file.csv", engine="native")
 
+    def test_trailing_delimiter_final_record_kept(self, tmp_path,
+                                                  monkeypatch):
+        # "...3," with no final newline: the implicit last field is empty,
+        # and the half-written record must NOT be silently dropped —
+        # python-engine parity (a plausible truncated-mid-write input).
+        p = tmp_path / "t.csv"
+        p.write_bytes(b"1,2\n3,")
+        py = read_csv(str(p), engine="python")
+        monkeypatch.delenv("DQCSV_THREADS", raising=False)
+        nat = read_csv(str(p), engine="native")
+        monkeypatch.setenv("DQCSV_THREADS", "3")
+        par = read_csv(str(p), engine="native")
+        assert nat.count() == par.count() == py.count() == 2
+        for fr in (nat, par):
+            d = fr.to_pydict()
+            assert float(d["_c0"][1]) == 3.0
+            assert np.isnan(float(d["_c1"][1]))
+
+    @pytest.mark.parametrize("sep,trailing", [("\n", True), ("\n", False),
+                                              ("\r\n", True), ("\r", True)])
+    def test_bitmap_walk_messy_grid_fuzz(self, tmp_path, sep, trailing,
+                                         monkeypatch):
+        """Randomized messy-but-numeric grid through the bitmap walk
+        (single-thread fast path): blank records, empty / whitespace-only
+        fields, short rows, signs, exponents, >7-digit mantissas — across
+        LF / CRLF / bare-CR separators with and without a final newline.
+        Serial native must match the parallel-chunk engine cell for cell
+        (both ultimately defined by parse_span semantics)."""
+        rng = np.random.default_rng(23)
+        cells = ["7", "4.25", "-3.5", "+0.125", "1e3", "2.5E-2", " 8 ",
+                 "", "  ", "123456789.25", "98765432", ".5", "5.", "0"]
+        lines = []
+        for i in range(503):
+            if i % 83 == 0:
+                lines.append("")                          # blank record
+            if i % 71 == 0:
+                lines.append(str(rng.integers(0, 99)))    # short row
+            else:
+                lines.append(",".join(
+                    cells[rng.integers(0, len(cells))] for _ in range(3)))
+        text = sep.join(lines) + (sep if trailing else "")
+        path = tmp_path / "messy.csv"
+        path.write_bytes(text.encode())
+        monkeypatch.delenv("DQCSV_THREADS", raising=False)
+        serial = read_csv(str(path), engine="native")
+        monkeypatch.setenv("DQCSV_THREADS", "4")
+        par = read_csv(str(path), engine="native")
+        py = read_csv(str(path), engine="python")
+        assert serial.count() == par.count() == py.count()
+        assert dict(serial.dtypes()) == dict(par.dtypes())
+        for col in serial.columns:
+            a = np.asarray(serial.to_pydict()[col], np.float64)
+            b = np.asarray(par.to_pydict()[col], np.float64)
+            np.testing.assert_array_equal(a.view(np.int64), b.view(np.int64))
+
 
 def test_engine_native_unavailable_raises(monkeypatch):
     monkeypatch.setattr(native_csv, "_LIB", None)
