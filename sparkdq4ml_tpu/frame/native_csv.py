@@ -2,10 +2,11 @@
 
 Role: the data-loader fast path — the analogue of the Univocity parser inside
 Spark's CSV source (SURVEY.md §2.2 "CSV reader"). The native tokenizer handles
-the common all-numeric case (which is what feature matrices are); anything
-else returns ``None`` here and the pure-Python reader takes over, so the
-framework works identically whether or not the shared library is built
-(``make -C native``).
+the common all-numeric case (which is what feature matrices are), with or
+without a header record (names are read host-side, the body is skipped
+C-side); anything else returns ``None`` here and the pure-Python reader takes
+over, so the framework works identically whether or not the shared library is
+built (``make -C native``).
 
 The C side parses the file into column-major float64 with NaN for empty
 fields, handling bare-CR/CRLF/LF records; Python decides integer-vs-double per
@@ -76,13 +77,22 @@ def try_read_csv(path: str, header: bool, infer_schema: bool, delimiter: str,
         return None
     if len(delimiter.encode("utf-8")) != 1 or len(quote.encode("utf-8")) != 1:
         return None  # ctypes c_char needs exactly one BYTE → python engine
-    if not infer_schema or header:
-        # Native fast path only covers the inferred all-numeric, headerless
-        # shape (the reference's shape); let python handle the rest.
+    if not infer_schema:
+        # Native fast path only covers the inferred all-numeric shape (the
+        # reference's shape); explicit schemas stay on the python engine.
         if required:
             raise RuntimeError("native CSV engine only supports "
-                               "header=False, infer_schema=True")
+                               "infer_schema=True")
         return None
+    names = None
+    if header:
+        # Column names come from the header record host-side; the C
+        # tokenizer skips that record (skip_header) and parses the numeric
+        # body. Anything irregular — unreadable text, a header wider or
+        # narrower than the data — falls back to the python engine.
+        names = _read_header_names(path, delimiter, quote)
+        if names is None:
+            return None
 
     data_p = ctypes.POINTER(ctypes.c_double)()
     ncols = ctypes.c_longlong(0)
@@ -98,7 +108,11 @@ def try_read_csv(path: str, header: bool, infer_schema: bool, delimiter: str,
     data = {}
     try:
         nc = ncols.value
+        if names is not None and len(names) != nc:
+            return None  # ragged header vs body → python semantics
         if nc == 0 or nrows == 0:
+            if names:
+                return None  # header-only file: python's typing is exact
             from .frame import Frame
             return Frame({})
         # No intermediate .copy(): astype below always copies out of the
@@ -109,10 +123,11 @@ def try_read_csv(path: str, header: bool, infer_schema: bool, delimiter: str,
         int_flags = bytes(ctypes.cast(intf_p, ctypes.POINTER(ctypes.c_char * nc)).contents)
         for j in range(nc):
             col = cols[j]
+            name = names[j] if names is not None else f"_c{j}"
             if int_flags[j]:
-                data[f"_c{j}"] = col.astype(np.dtype(int_dtype()))
+                data[name] = col.astype(np.dtype(int_dtype()))
             else:
-                data[f"_c{j}"] = col.astype(np.dtype(float_dtype()))
+                data[name] = col.astype(np.dtype(float_dtype()))
     finally:
         lib.dq_free(data_p)
         lib.dq_free(intf_p)
@@ -120,3 +135,84 @@ def try_read_csv(path: str, header: bool, infer_schema: bool, delimiter: str,
     from .frame import Frame
 
     return Frame(data)
+
+
+def _read_header_names(path: str, delimiter: str, quote: str):
+    """First non-blank record's fields, via the same record/field scanner
+    the python engine uses (one quoting state machine to maintain) — or
+    None when the header can't be confidently read, sending the read to
+    the python engine. Fail-closed cases:
+
+    - undecodable bytes, or no complete first record inside the probe
+      window (an unquoted record terminator proves completeness even when
+      the file is larger than the probe);
+    - the python engine and the C prologue would pick DIFFERENT header
+      records: python's blank-record skip is ``str.strip()`` (any unicode
+      whitespace), the C side's is space/tab only, so a ``\\x0b``-only
+      first line would make C skip the REAL header as its header record
+      and parse it as data — a silent extra row. Detected by replicating
+      the C pick host-side and comparing.
+    """
+    try:
+        with open(path, "rb") as f:
+            chunk = f.read(1 << 16)
+            more = f.read(1) != b""
+    except OSError:
+        return None
+    try:
+        text = chunk.decode("utf-8")
+    except UnicodeDecodeError:
+        return None
+    if more and not _has_unquoted_record_end(text, quote):
+        return None  # first record may be truncated by the probe: punt
+    from .csv import parse_csv_text, split_fields
+
+    rows = parse_csv_text(text, delimiter, quote)
+    if not rows:
+        return None
+    # The record the C prologue will treat as the header: first record
+    # (plain \r\n|\r|\n split, no quote awareness — the C side's skip
+    # happens in the same byte-level terms) whose content is not
+    # space/tab-only. If its fields differ from python's first record,
+    # the engines would disagree on where data starts: fall back.
+    c_first = None
+    for rec in _plain_records(text):
+        if rec.strip(" \t") != "":
+            c_first = rec
+            break
+    if c_first is None or split_fields(c_first, delimiter, quote) != rows[0]:
+        return None
+    return list(rows[0])
+
+
+def _plain_records(text: str):
+    """Byte-level record split (\\r\\n, \\r, \\n), quote-unaware — the C
+    prologue's view of the file."""
+    rec = []
+    i = 0
+    n = len(text)
+    while i < n:
+        ch = text[i]
+        if ch == "\n" or ch == "\r":
+            yield "".join(rec)
+            rec = []
+            if ch == "\r" and i + 1 < n and text[i + 1] == "\n":
+                i += 1
+        else:
+            rec.append(ch)
+        i += 1
+    if rec:
+        yield "".join(rec)
+
+
+def _has_unquoted_record_end(text: str, quote: str) -> bool:
+    """True when an unquoted record terminator exists in ``text`` — proof
+    the first record is complete inside the probe window even for quoted
+    files (RFC-4180: terminators inside quotes don't end a record)."""
+    in_quotes = False
+    for ch in text:
+        if ch == quote:
+            in_quotes = not in_quotes
+        elif (ch == "\n" or ch == "\r") and not in_quotes:
+            return True
+    return False
