@@ -34,6 +34,7 @@ import numpy as np
 
 from ..config import config, float_dtype, int_dtype
 from ..ops.expressions import Col, Expr, spark_type_name
+from ..utils.debug import ensure_backend
 
 ColumnLike = Union[Expr, jnp.ndarray, np.ndarray, Sequence]
 
@@ -195,6 +196,13 @@ class Frame:
     """Immutable columnar frame with a validity mask (see module docstring)."""
 
     def __init__(self, columns: Mapping[str, ColumnLike], mask=None):
+        # Library-boundary liveness: a Frame built WITHOUT a TpuSession is
+        # the first jnp touch in direct-library use, and on a wedged
+        # tunneled-TPU box an unguarded first touch hangs PJRT init
+        # forever. ensure_backend probes + bounds that first init exactly
+        # like session start does, and is a single cached global read on
+        # every call after the first (and when a backend is already up).
+        ensure_backend()
         self._data: dict[str, object] = {}
         n = None
         for name, values in columns.items():

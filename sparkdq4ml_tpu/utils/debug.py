@@ -115,6 +115,13 @@ def backend_initializes_retry(probe_timeout_s: int = 150,
 _ENSURED_PLATFORM: str = ""
 _FELL_BACK: bool = False
 
+# Single-flight latch for ensure_backend's slow path: reachable from any
+# user thread via Frame.__init__, and concurrent first-touches must not
+# race the probe + watchdog (see ensure_backend).
+import threading as _threading
+
+_ENSURE_LOCK = _threading.Lock()
+
 # Set in the environment of a process that the init watchdog re-exec'd
 # pinned to CPU after the REAL backend init wedged (see
 # ``bounded_backend_init``); lets the fresh process know it is a fallback.
@@ -144,7 +151,33 @@ def _banner(msg: str) -> None:
         pass
 
 
-def bounded_backend_init(timeout_s: float = 150) -> None:
+def _probe_timeout() -> float:
+    """``SPARKDQ4ML_PROBE_TIMEOUT`` (seconds), default 150 — the env
+    default for callers without a session config (the ``Frame`` boundary
+    guard, the driver entry)."""
+    import os
+
+    try:
+        return float(os.environ.get("SPARKDQ4ML_PROBE_TIMEOUT", "150"))
+    except ValueError:
+        return 150.0
+
+
+def _probe_disabled() -> bool:
+    """``SPARKDQ4ML_BACKEND_PROBE=off|0|false`` disables the subprocess
+    probe + bounded init entirely — the env-level twin of the session's
+    ``spark.backend.probe=off``. Required on multi-host pod ranks that
+    build Frames BEFORE their session: a transient probe failure on one
+    rank would pin it to CPU while its peers claim accelerators,
+    desyncing the mesh (the session's multihost path skips the probe for
+    the same reason)."""
+    import os
+
+    return os.environ.get("SPARKDQ4ML_BACKEND_PROBE", "").lower() in (
+        "off", "0", "false")
+
+
+def bounded_backend_init(timeout_s: "Optional[float]" = None) -> None:
     """First REAL backend touch in THIS process, bounded by a watchdog.
 
     A healthy probe subprocess does NOT guarantee this process's PJRT init
@@ -162,13 +195,17 @@ def bounded_backend_init(timeout_s: float = 150) -> None:
 
     This is the reference's session-liveness contract — init always
     succeeds (`DataQuality4MachineLearningApp.java:38-41`) — extended to
-    'or degrades to CPU in bounded time'.
+    'or degrades to CPU in bounded time'. ``timeout_s`` defaults to
+    ``SPARKDQ4ML_PROBE_TIMEOUT`` (else 150 s), like ``ensure_backend``.
     """
     import os
     import sys
     import threading
 
     import jax as _jax
+
+    if timeout_s is None:
+        timeout_s = _probe_timeout()
 
     if os.environ.get("SPARKDQ4ML_INIT_WATCHDOG", "1") in ("0", "false",
                                                            "off"):
@@ -239,17 +276,21 @@ def process_on_cpu() -> bool:
         return False
 
 
-def ensure_backend(timeout_s: float = 150) -> str:
+def ensure_backend(timeout_s: "Optional[float]" = None) -> str:
     """Make THIS process safe to initialize a JAX backend, probing first.
 
     Entry-point guard (VERDICT r3 item 3): ``jax.devices()`` on a wedged
     tunneled-TPU pool blocks forever inside PJRT init, which made every
-    user-facing entry point (``TpuSession``, the examples) hang. This
-    probes the default backend in a throwaway subprocess and, when the
-    probe fails, pins this process to CPU *before* any backend init —
-    the session then comes up degraded instead of never
-    (the reference's session init always succeeds,
-    ``DataQuality4MachineLearningApp.java:38-41``).
+    user-facing entry point (``TpuSession``, the examples, and bare
+    ``Frame`` construction in direct-library use) hang. This probes the
+    default backend in a throwaway subprocess and, when the probe fails,
+    pins this process to CPU *before* any backend init — the session
+    then comes up degraded instead of never (the reference's session
+    init always succeeds, ``DataQuality4MachineLearningApp.java:38-41``).
+
+    ``timeout_s`` defaults to ``SPARKDQ4ML_PROBE_TIMEOUT`` (else 150 s) —
+    callers without a session config (the ``Frame`` boundary guard) get
+    an env-tunable bound.
 
     Returns the platform string this process will use (``"cpu"`` after a
     fallback, ``"default"`` when the stock backend is healthy). No-ops —
@@ -257,10 +298,32 @@ def ensure_backend(timeout_s: float = 150) -> str:
     when a backend is already live in-process, or on a repeat call.
     """
     global _ENSURED_PLATFORM, _FELL_BACK
+
+    if _ENSURED_PLATFORM:
+        return _ENSURED_PLATFORM  # hot path: Frame.__init__ calls this
+    # Slow path is single-flight: Frame.__init__ makes this reachable
+    # from arbitrary user threads, and two concurrent first-Frames must
+    # not each pay a probe subprocess — worse, the loser's init watchdog
+    # would count down while jax's internal backend-init lock is held by
+    # the winner's (healthy) init, expiring into a spurious CPU re-exec.
+    with _ENSURE_LOCK:
+        if _ENSURED_PLATFORM:
+            return _ENSURED_PLATFORM
+        return _ensure_backend_locked(timeout_s)
+
+
+def _ensure_backend_locked(timeout_s: "Optional[float]") -> str:
+    global _ENSURED_PLATFORM, _FELL_BACK
     import logging
     import os
 
-    if _ENSURED_PLATFORM:
+    if timeout_s is None:
+        timeout_s = _probe_timeout()
+    if _probe_disabled():
+        # Env-level probe opt-out (multi-host pod ranks, users who accept
+        # the raw init): behave like the unguarded library — trust the
+        # default backend init unconditionally.
+        _ENSURED_PLATFORM = "default"
         return _ENSURED_PLATFORM
     if os.environ.get(_REEXEC_MARKER) == "1":
         # We ARE the init-watchdog's fallback process. Pin CPU in the

@@ -134,6 +134,28 @@ print("WEDGE_M_OK", MARK, jax.default_backend(), dbg.fell_back_to_cpu())
 """
 
 
+_LIBRARY_BOUNDARY_SIM = """
+import os, sys, time
+sys.path.insert(0, {repo!r})
+import sparkdq4ml_tpu.utils.debug as dbg
+if os.environ.get("JAX_PLATFORMS", "") != "cpu":
+    dbg.probe_backend_platform = lambda *a, **k: "tpu"
+    import jax
+    jax.devices = lambda *a, **k: time.sleep(3600)
+# Direct library use: NO TpuSession — a bare Frame is the first jnp
+# touch, and must carry the same probe + bounded-init guard.
+import numpy as np
+from sparkdq4ml_tpu import Frame
+from sparkdq4ml_tpu.models import LinearRegression, VectorAssembler
+f = Frame({{"x": np.arange(12.0), "label": 2.0 * np.arange(12.0) + 3.0}})
+f = VectorAssembler(input_cols=["x"], output_col="features").transform(f)
+m = LinearRegression(max_iter=30).fit(f)
+import jax
+assert abs(m.predict([5.0]) - 13.0) < 0.5
+print("LIB_BOUNDARY_WEDGE_OK", jax.default_backend(), dbg.fell_back_to_cpu())
+"""
+
+
 _FORCED_ACCEL_SIM = """
 import os, sys
 sys.path.insert(0, {repo!r})
@@ -219,6 +241,27 @@ class TestBoundedRealInit:
             '    dbg.probe_backend_platform = _no_probe',
             seed_cache=True)
 
+    def test_direct_library_use_without_session_is_wedge_proof(
+            self, tmp_path):
+        # The round-4 contract covered TpuSession and the examples; a
+        # user driving the LIBRARY directly (bare Frame + fit, no
+        # session) must get the same bounded liveness — Frame.__init__
+        # carries the ensure_backend guard.
+        script = tmp_path / "lib_sim.py"
+        script.write_text(_LIBRARY_BOUNDARY_SIM.format(repo=REPO))
+        env = {k: v for k, v in os.environ.items()
+               if k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
+        env["TMPDIR"] = str(tmp_path)
+        env["SPARKDQ4ML_PROBE_CACHE_TTL"] = "0"
+        env["SPARKDQ4ML_PROBE_TIMEOUT"] = "3"
+        proc = subprocess.run(
+            [sys.executable, str(script)], capture_output=True, text=True,
+            timeout=240, cwd=REPO, env=env)
+        assert proc.returncode == 0, (proc.stdout[-2000:],
+                                      proc.stderr[-2000:])
+        assert "LIB_BOUNDARY_WEDGE_OK cpu True" in proc.stdout
+        assert "re-executing pinned to" in proc.stderr
+
     def test_python_dash_m_reexec_preserves_package_context(self, tmp_path):
         # The watchdog re-exec must preserve the REAL command line
         # (sys.orig_argv): under `python -m pkg`, sys.argv[0] is the
@@ -242,6 +285,54 @@ class TestBoundedRealInit:
                                       proc.stderr[-2000:])
         assert "WEDGE_M_OK helper-ok cpu True" in proc.stdout
         assert "re-executing pinned to" in proc.stderr
+
+    def test_probe_env_optout(self, monkeypatch):
+        # SPARKDQ4ML_BACKEND_PROBE=off: the env-level twin of the
+        # session's spark.backend.probe=off — multi-host pod ranks that
+        # build Frames before their session must be able to skip the
+        # probe entirely (a one-rank CPU pin would desync the mesh).
+        import sparkdq4ml_tpu.utils.debug as dbg
+
+        monkeypatch.setattr(dbg, "_ENSURED_PLATFORM", "")
+        monkeypatch.setenv("SPARKDQ4ML_BACKEND_PROBE", "off")
+
+        def boom(*a, **k):
+            raise AssertionError("probe must not run when opted out")
+
+        monkeypatch.setattr(dbg, "probe_backend_platform", boom)
+        assert dbg.ensure_backend(1) == "default"
+
+    def test_ensure_backend_single_flight_across_threads(self, monkeypatch):
+        # Frame.__init__ makes ensure_backend reachable from arbitrary
+        # user threads; concurrent first-touches must collapse to ONE
+        # slow-path run (the loser would otherwise burn a duplicate probe
+        # subprocess, and its watchdog could expire behind jax's init
+        # lock into a spurious CPU re-exec).
+        import threading
+        import time as _time
+
+        import sparkdq4ml_tpu.utils.debug as dbg
+
+        monkeypatch.setattr(dbg, "_ENSURED_PLATFORM", "")
+        calls = []
+
+        def slow_locked(timeout_s):
+            calls.append(1)
+            _time.sleep(0.2)
+            dbg._ENSURED_PLATFORM = "cpu"
+            return "cpu"
+
+        monkeypatch.setattr(dbg, "_ensure_backend_locked", slow_locked)
+        results = []
+        threads = [threading.Thread(
+            target=lambda: results.append(dbg.ensure_backend(1)))
+            for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert len(calls) == 1          # single-flight
+        assert results == ["cpu"] * 4   # every thread sees the verdict
 
     def test_watchdog_disabled_env(self, monkeypatch):
         import sparkdq4ml_tpu.utils.debug as dbg
