@@ -195,6 +195,8 @@ def _as_column(values, n: Optional[int] = None):
 class Frame:
     """Immutable columnar frame with a validity mask (see module docstring)."""
 
+    _alias: Optional[str] = None  # set by .alias(); not inherited by _with
+
     def __init__(self, columns: Mapping[str, ColumnLike], mask=None):
         # Library-boundary liveness: a Frame built WITHOUT a TpuSession is
         # the first jnp touch in direct-library use, and on a wedged
@@ -309,6 +311,14 @@ class Frame:
     def select(self, *exprs: Union[str, Expr]) -> "Frame":
         from ..ops.expressions import Alias, Explode
 
+        # flatten list/tuple items so `select(df.colRegex("`x.*`"))` works
+        flat = []
+        for e in exprs:
+            if isinstance(e, (list, tuple)):
+                flat.extend(e)
+            else:
+                flat.append(e)
+        exprs = tuple(flat)
         # Spark allows ONE generator (explode) per select: resolve the
         # scalar columns first, then expand rows at the host boundary.
         # Only a bare Explode or an Alias over one counts — any other
@@ -469,6 +479,8 @@ class Frame:
         f._mask = jnp.concatenate([self._mask, other._mask])
         return f
 
+    unionAll = union  # Spark 2.x alias (deprecated there, kept for parity)
+
     def union_by_name(self, other: "Frame",
                       allow_missing_columns: bool = False) -> "Frame":
         """``unionByName`` — union resolving columns by name, not position.
@@ -518,6 +530,47 @@ class Frame:
         rows = self.collect()
         return [(tuple(norm(x) for x in r), r) for r in rows]
 
+    def select_expr(self, *exprs: str) -> "Frame":
+        """Spark's ``selectExpr``: SQL expression strings evaluated over
+        this frame (same grammar as ``session.sql``'s select list — CAST,
+        arithmetic, functions, aliases, ``*``), via a scratch catalog so
+        no temp view leaks."""
+        from ..sql.catalog import Catalog
+        from ..sql.parser import execute
+
+        cat = Catalog()
+        cat.register("__this__", self)
+        return execute(
+            f"SELECT {', '.join(exprs)} FROM __this__", catalog=cat)
+
+    selectExpr = select_expr
+
+    def col_regex(self, pattern: str) -> list:
+        """Spark's ``colRegex``: column expressions whose names match the
+        (Java-style, backtick-quoted allowed) regex — pass the result
+        straight to ``select`` (it flattens lists)."""
+        import re as _re
+
+        pat = pattern.strip()
+        if pat.startswith("`") and pat.endswith("`"):
+            pat = pat[1:-1]
+        rx = _re.compile(pat)
+        return [Col(c) for c in self.columns if rx.fullmatch(c)]
+
+    colRegex = col_regex
+
+    @property
+    def schema(self) -> list[tuple[str, str]]:
+        """``[(name, spark_type_name)]`` — the engine's schema form (the
+        ``StructType`` analogue; same pairs as ``dtypes()``)."""
+        return self.dtypes()
+
+    @property
+    def na(self) -> "_NAFunctions":
+        """``df.na`` accessor (Spark ``DataFrameNaFunctions``):
+        ``na.fill`` / ``na.drop`` / ``na.replace``."""
+        return _NAFunctions(self)
+
     def intersect(self, other: "Frame") -> "Frame":
         """Distinct rows present in both frames (SQL INTERSECT, null-safe)."""
         if self.columns != other.columns:
@@ -547,6 +600,24 @@ class Frame:
         return Frame.from_rows(rows, self.columns)
 
     exceptAll = except_all
+
+    def intersect_all(self, other: "Frame") -> "Frame":
+        """Rows present in both frames, preserving duplicate counts
+        (SQL INTERSECT ALL — each row appears min(count_self, count_other)
+        times, null-safe like ``intersect``)."""
+        if self.columns != other.columns:
+            raise ValueError("intersectAll requires identical column lists")
+        from collections import Counter
+
+        budget = Counter(k for k, _ in other._keyed_rows())
+        rows = []
+        for key, row in self._keyed_rows():
+            if budget[key] > 0:
+                budget[key] -= 1
+                rows.append(row)
+        return Frame.from_rows(rows, self.columns)
+
+    intersectAll = intersect_all
 
     def subtract(self, other: "Frame") -> "Frame":
         """Distinct rows of self not in other (SQL EXCEPT [DISTINCT])."""
@@ -727,6 +798,38 @@ class Frame:
     def unpersist(self, blocking: bool = False) -> "Frame":
         return self
 
+    def repartition(self, num_partitions: int, *cols) -> "Frame":
+        """No-op for API parity: a device-mesh engine has no partition
+        count — distribution happens at fit time via ``mesh=`` sharding,
+        not by reshaping the frame."""
+        return self
+
+    def coalesce(self, num_partitions: int) -> "Frame":
+        return self
+
+    def hint(self, name: str, *parameters) -> "Frame":
+        """No-op for API parity (broadcast/shuffle hints steer Spark's
+        planner; XLA owns that choice here)."""
+        return self
+
+    def checkpoint(self, eager: bool = True) -> "Frame":
+        """No-op for API parity: the frame IS materialized (eager engine);
+        there is no lineage to truncate."""
+        return self
+
+    localCheckpoint = checkpoint
+    local_checkpoint = checkpoint
+
+    def alias(self, name: str) -> "Frame":
+        """Record a frame alias (Spark ``alias``). Join disambiguation by
+        alias-qualified columns is not supported — rename columns instead
+        (``with_column_renamed``). Like Spark's (a plan-node property),
+        the alias applies to THIS frame object; derived frames don't
+        inherit it."""
+        out = self._with()
+        out._alias = name
+        return out
+
     def explain(self, extended: bool = False) -> None:
         """Describe the physical representation (the eager-engine analogue
         of Spark's plan dump): columns, dtypes, placement, mask stats."""
@@ -796,6 +899,73 @@ class Frame:
 
     def first(self):
         return self.head(1)
+
+    def tail(self, n: int) -> list[tuple]:
+        """Last ``n`` valid rows (Spark ``tail``)."""
+        rows = self.collect()
+        return rows[-n:] if n > 0 else []
+
+    def to_pandas(self):
+        """Materialize as a pandas DataFrame (Spark ``toPandas``): string
+        columns stay object dtype, numeric columns keep the engine's
+        device dtypes, and vector columns (2D, e.g. an assembled
+        ``features``) become per-row arrays in an object column — the
+        shape Spark's toPandas gives vector UDTs."""
+        import pandas as pd
+
+        d = self.to_pydict()
+        out = {}
+        for k, v in d.items():
+            arr = np.asarray(v) if not _is_string_col(v) else v
+            if getattr(arr, "ndim", 1) > 1:
+                col = np.empty(len(arr), dtype=object)
+                for i in range(len(arr)):
+                    col[i] = np.asarray(arr[i])
+                arr = col
+            out[k] = arr
+        return pd.DataFrame(out, columns=self.columns)
+
+    toPandas = to_pandas
+
+    def to_json(self) -> list[str]:
+        """One JSON object string per valid row (Spark ``toJSON``; a list,
+        not an RDD — this engine has no lazy distributed collection).
+        NaN/None become JSON null; numpy scalars coerce to Python."""
+        import json
+        import math
+
+        def _coerce(v):
+            if v is None:
+                return None
+            if isinstance(v, (np.floating, float)):
+                f = float(v)
+                return None if math.isnan(f) else f
+            if isinstance(v, (np.integer, int)):
+                return int(v)
+            if isinstance(v, (np.bool_, bool)):
+                return bool(v)
+            if isinstance(v, np.ndarray):
+                return [_coerce(x) for x in v.tolist()]
+            return v
+
+        cols = self.columns
+        return [json.dumps({c: _coerce(v) for c, v in zip(cols, row)})
+                for row in self.collect()]
+
+    toJSON = to_json
+
+    def foreach(self, f) -> None:
+        """Apply ``f`` to every valid row host-side (Spark ``foreach`` —
+        eager here, no executors)."""
+        for row in self.collect():
+            f(row)
+
+    def foreach_partition(self, f) -> None:
+        """Apply ``f`` to an iterator over all valid rows (Spark
+        ``foreachPartition``; this engine is one partition)."""
+        f(iter(self.collect()))
+
+    foreachPartition = foreach_partition
 
     # -- display -----------------------------------------------------------
     def _format_cell(self, v, truncate: int) -> str:
@@ -921,6 +1091,9 @@ class Frame:
                       for name, vals in d.items()})
 
     orderBy = sort
+    # one partition: sorting "within partitions" IS a total sort here
+    sortWithinPartitions = sort
+    sort_within_partitions = sort
     order_by = sort
 
     def distinct(self) -> "Frame":
@@ -1131,25 +1304,49 @@ class Frame:
 
     crossJoin = cross_join
 
-    def dropna(self, subset=None) -> "Frame":
-        """Mask out rows with NaN (float) / None (string) in any [subset]
-        column — stays static-shaped like ``filter``."""
+    def dropna(self, how="any", thresh=None, subset=None) -> "Frame":
+        """Mask out null rows (Spark ``dropna`` / ``na.drop`` signature:
+        ``how`` "any"|"all", ``thresh`` = minimum non-null count which
+        overrides ``how``, ``subset`` = columns considered). NaN (float) /
+        None (string) count as null; stays static-shaped like ``filter``.
+        A list first argument is accepted as a legacy positional
+        ``subset``."""
+        if isinstance(how, (list, tuple)):
+            subset, how = list(how), "any"
+        if how not in ("any", "all"):
+            raise ValueError(f"how={how!r}; expected 'any' or 'all'")
         cols = subset if subset is not None else self.columns
-        keep = jnp.ones((self._n,), jnp.bool_)
+        nonnull = jnp.zeros((self._n,), jnp.int32)
         for name in cols:
             arr = self._column_values(name)
             if _is_string_col(arr):
-                keep = jnp.logical_and(
-                    keep, jnp.asarray([x is not None for x in arr]))
+                ok = jnp.asarray([x is not None for x in arr])
             elif np.issubdtype(np.dtype(arr.dtype), np.floating):
                 flat_nan = jnp.isnan(arr)
                 if flat_nan.ndim > 1:
                     flat_nan = flat_nan.any(axis=tuple(range(1, flat_nan.ndim)))
-                keep = jnp.logical_and(keep, jnp.logical_not(flat_nan))
+                ok = jnp.logical_not(flat_nan)
+            else:
+                ok = jnp.ones((self._n,), jnp.bool_)  # ints have no null
+            nonnull = nonnull + ok.astype(jnp.int32)
+        if thresh is not None:
+            keep = nonnull >= int(thresh)
+        elif how == "all":
+            keep = nonnull > 0
+        else:
+            keep = nonnull == len(cols)
         return self._with(mask=jnp.logical_and(self._mask, keep))
 
     def fillna(self, value, subset=None) -> "Frame":
-        """Replace NaN/None with ``value`` in [subset] columns."""
+        """Replace NaN/None with ``value`` in [subset] columns. A dict
+        ``value`` maps column -> fill value per column (Spark's common
+        ``na.fill({'col': 0.0})`` form; ``subset`` is ignored then, like
+        Spark)."""
+        if isinstance(value, dict):
+            out = self
+            for name, v in value.items():
+                out = out.fillna(v, subset=[name])
+            return out
         cols = subset if subset is not None else self.columns
         data = dict(self._data)
         for name in cols:
@@ -1230,3 +1427,21 @@ class Frame:
         default_catalog().register(name, self)
 
     createOrReplaceTempView = create_or_replace_temp_view
+
+
+class _NAFunctions:
+    """``df.na`` accessor (Spark ``DataFrameNaFunctions``) — thin verbs
+    over the frame's own null handling: ``fill`` -> ``fillna``,
+    ``drop`` -> ``dropna``, ``replace`` -> ``replace``."""
+
+    def __init__(self, frame: "Frame"):
+        self._frame = frame
+
+    def fill(self, value, subset=None) -> "Frame":
+        return self._frame.fillna(value, subset=subset)
+
+    def drop(self, how="any", thresh=None, subset=None) -> "Frame":
+        return self._frame.dropna(how=how, thresh=thresh, subset=subset)
+
+    def replace(self, to_replace, value=None, subset=None) -> "Frame":
+        return self._frame.replace(to_replace, value=value, subset=subset)

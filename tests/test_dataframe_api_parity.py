@@ -1,0 +1,172 @@
+"""Spark DataFrame API-parity batch: selectExpr, na accessor, toPandas,
+tail/toJSON, colRegex + select flattening, intersectAll, unionAll,
+foreach/foreachPartition, schema property, and the eager-engine no-op
+shims (repartition/coalesce/hint/checkpoint/alias)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from sparkdq4ml_tpu import Frame
+
+
+@pytest.fixture
+def f():
+    return Frame({"x": np.arange(5.0),
+                  "y": 2.0 * np.arange(5.0),
+                  "label": [1.0, 2.0, np.nan, 4.0, 5.0]})
+
+
+class TestSelectExpr:
+    def test_expressions_and_aliases(self, f):
+        g = f.select_expr("x", "CAST(y AS INT) AS yi", "x + y AS s")
+        assert g.columns == ["x", "yi", "s"]
+        assert dict(g.dtypes())["yi"] in ("int", "integer")
+        rows = g.collect()
+        assert rows[2][2] == pytest.approx(6.0)
+
+    def test_star(self, f):
+        assert f.select_expr("*").columns == f.columns
+
+    def test_no_temp_view_leak(self, f):
+        from sparkdq4ml_tpu.sql.catalog import default_catalog
+
+        f.select_expr("x")
+        with pytest.raises(KeyError):
+            default_catalog().lookup("__this__")
+
+    def test_functions(self, f):
+        g = f.select_expr("abs(x - 3) AS d")
+        assert [r[0] for r in g.collect()] == [3, 2, 1, 0, 1]
+
+
+class TestNAAccessor:
+    def test_fill_drop_replace(self, f):
+        assert f.na.drop().count() == 4
+        filled = f.na.fill(0.0)
+        assert filled.collect()[2][2] == 0.0
+        rep = f.na.replace(1.0, 9.0, subset=["label"])
+        assert rep.collect()[0][2] == 9.0
+
+    def test_matches_direct_methods(self, f):
+        assert f.na.drop().collect() == f.dropna().collect()
+        assert f.na.fill(7.0).collect() == f.fillna(7.0).collect()
+
+    def test_drop_how_and_thresh(self):
+        g = Frame({"a": [1.0, np.nan, np.nan],
+                   "b": [1.0, 2.0, np.nan]})
+        assert g.na.drop("any").count() == 1
+        assert g.na.drop("all").count() == 2      # only the all-null row
+        assert g.na.drop(thresh=1).count() == 2   # >= 1 non-null
+        assert g.na.drop(thresh=2).count() == 1
+        with pytest.raises(ValueError):
+            g.na.drop("most")
+
+    def test_dropna_legacy_positional_subset(self):
+        g = Frame({"a": [1.0, np.nan], "b": [np.nan, 2.0]})
+        assert g.dropna(["a"]).count() == 1       # list = subset (legacy)
+
+    def test_fill_dict_per_column(self, f):
+        g = Frame({"a": [np.nan, 1.0], "b": [np.nan, 2.0]})
+        filled = g.na.fill({"a": 0.0, "b": 9.0})
+        assert filled.collect()[0] == (0.0, 9.0)
+        # subset untouched columns stay NaN
+        half = g.na.fill({"a": 0.0})
+        assert np.isnan(half.collect()[0][1])
+
+
+class TestActions:
+    def test_tail(self, f):
+        assert f.tail(2) == f.collect()[-2:]
+        assert f.tail(0) == []
+        assert len(f.tail(99)) == 5
+
+    def test_to_pandas(self, f):
+        pd_df = f.to_pandas()
+        assert list(pd_df.columns) == f.columns
+        assert pd_df.shape == (5, 3)
+        assert np.isnan(pd_df["label"][2])
+
+    def test_to_pandas_vector_column(self, f):
+        # assembled features are 2D device columns; toPandas must give
+        # per-row arrays in an object column, not crash
+        from sparkdq4ml_tpu.models import VectorAssembler
+
+        g = VectorAssembler(input_cols=["x", "y"],
+                            output_col="features").transform(f)
+        pd_df = g.to_pandas()
+        assert pd_df.shape[0] == 5
+        np.testing.assert_allclose(np.asarray(pd_df["features"][1]),
+                                   [1.0, 2.0])
+
+    def test_alias_default_is_none(self, f):
+        from sparkdq4ml_tpu.ops.expressions import Col
+
+        assert f._alias is None
+        assert f.alias("t").filter(Col("x") > 1)._alias is None  # not inherited
+
+    def test_to_json_nan_is_null(self, f):
+        objs = [json.loads(s) for s in f.to_json()]
+        assert len(objs) == 5
+        assert objs[2]["label"] is None
+        assert objs[0] == {"x": 0.0, "y": 0.0, "label": 1.0}
+
+    def test_foreach_and_partition(self, f):
+        seen = []
+        f.foreach(lambda r: seen.append(r[0]))
+        assert len(seen) == 5
+        counts = []
+        f.foreach_partition(lambda it: counts.append(sum(1 for _ in it)))
+        assert counts == [5]
+
+
+class TestColRegex:
+    def test_matches_and_select_flattening(self, f):
+        cols = f.col_regex("`[xy]`")
+        assert [c.name for c in cols] == ["x", "y"]
+        assert f.select(f.col_regex("`.*`")).columns == f.columns
+        assert f.select(cols).columns == ["x", "y"]
+
+    def test_fullmatch_not_search(self, f):
+        # Spark's colRegex is a full match: 'x' must not match 'label'
+        assert [c.name for c in f.col_regex("`a`")] == []
+
+
+class TestSetOps:
+    def test_intersect_all_preserves_duplicates(self):
+        a = Frame({"v": [1.0, 1.0, 2.0, 3.0]})
+        b = Frame({"v": [1.0, 2.0, 2.0]})
+        got = sorted(r[0] for r in a.intersect_all(b).collect())
+        assert got == [1.0, 2.0]  # min counts: 1×1, 1×2, 0×3
+
+    def test_intersect_all_requires_same_columns(self):
+        with pytest.raises(ValueError):
+            Frame({"a": [1.0]}).intersect_all(Frame({"b": [1.0]}))
+
+    def test_union_all_alias(self, f):
+        assert f.unionAll(f).count() == 10
+
+
+class TestShims:
+    def test_noop_shims_return_frame(self, f):
+        assert f.repartition(8) is f
+        assert f.coalesce(1) is f
+        assert f.hint("broadcast") is f
+        assert f.checkpoint() is f
+        assert f.local_checkpoint() is f
+
+    def test_sort_within_partitions_is_total_sort(self, f):
+        a = f.na.fill(-1.0)
+        assert (a.sortWithinPartitions("x", ascending=False).collect()
+                == a.sort("x", ascending=False).collect())
+
+    def test_alias_carries_name(self, f):
+        g = f.alias("t")
+        assert g._alias == "t"
+        assert g.na.fill(-1.0).collect() == f.na.fill(-1.0).collect()
+
+    def test_schema_property(self, f):
+        assert f.schema == f.dtypes()
+        assert f.schema[0][0] == "x"
+        assert f.schema[0][1] in ("float", "double")
