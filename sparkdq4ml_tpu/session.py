@@ -373,6 +373,8 @@ class TpuSession:
     def active(cls) -> Optional["TpuSession"]:
         return _ACTIVE
 
+    getActiveSession = active  # Spark 3.x name
+
     # -- surface ------------------------------------------------------------
     @property
     def devices(self):
@@ -399,6 +401,41 @@ class TpuSession:
         return Frame.from_rows(data, names)
 
     createDataFrame = create_data_frame
+
+    def range(self, start: int, end: Optional[int] = None, step: int = 1,
+              num_partitions: Optional[int] = None) -> "Frame":
+        """Spark ``spark.range``: a Frame with one integer ``id`` column.
+        ``range(n)`` counts 0..n-1; ``range(start, end, step)`` like
+        Python's. ``num_partitions`` is accepted and ignored (this engine
+        shards at fit time, like the ``repartition`` no-op shim). ids are
+        int64 under ``jax_enable_x64``; without it the device dtype is
+        int32, so out-of-int32 bounds raise instead of silently
+        wrapping."""
+        import numpy as np
+
+        from .frame.frame import Frame
+
+        if step == 0:
+            raise ValueError("range step must not be zero")
+        if end is None:
+            start, end = 0, start
+        ids = np.arange(start, end, step, dtype=np.int64)
+        import jax as _jax
+
+        if not _jax.config.jax_enable_x64 and ids.size > 0:
+            lo, hi = int(ids.min()), int(ids.max())
+            if lo < -(2 ** 31) or hi >= 2 ** 31:
+                raise ValueError(
+                    f"range ids [{lo}, {hi}] exceed int32 and x64 is "
+                    "disabled; enable jax_enable_x64 for 64-bit ids")
+        return Frame({"id": ids})
+
+    @property
+    def version(self) -> str:
+        """Engine version string (Spark ``spark.version`` analogue)."""
+        from . import __version__
+
+        return __version__
 
     def stop(self) -> None:
         global _ACTIVE

@@ -18,11 +18,23 @@ class Catalog:
             raise KeyError(f"temp view {name!r} not found "
                            f"(views: {sorted(self._views)})") from None
 
+    def table_exists(self, name: str) -> bool:
+        return name.lower() in self._views
+
+    tableExists = table_exists
+
     def drop(self, name: str) -> bool:
         return self._views.pop(name.lower(), None) is not None
 
+    dropTempView = drop  # Spark catalog name
+    drop_temp_view = drop
+
     def list_views(self):
         return sorted(self._views)
+
+    # Spark catalog names for the same listing
+    list_tables = list_views
+    listTables = list_views
 
     def clear(self) -> None:
         self._views.clear()

@@ -148,6 +148,41 @@ class TestSetOps:
         assert f.unionAll(f).count() == 10
 
 
+class TestSessionSurface:
+    def test_range(self):
+        from sparkdq4ml_tpu import TpuSession
+
+        s = (TpuSession.builder().app_name("t").master("local[*]")
+             .get_or_create())
+        try:
+            assert [r[0] for r in s.range(4).collect()] == [0, 1, 2, 3]
+            assert [r[0] for r in s.range(2, 8, 2).collect()] == [2, 4, 6]
+            assert s.range(3).columns == ["id"]
+            assert s.range(0, 10, 1, 4).count() == 10  # numPartitions ignored
+            with pytest.raises(ValueError, match="step"):
+                s.range(0, 10, 0)
+            # x64 is on in tests: big ids survive end-to-end
+            assert s.range(2 ** 40, 2 ** 40 + 2).collect()[1][0] == 2 ** 40 + 1
+            assert s.version == __import__("sparkdq4ml_tpu").__version__
+            assert TpuSession.getActiveSession() is s
+        finally:
+            s.stop()
+
+    def test_catalog_surface(self, f):
+        from sparkdq4ml_tpu import TpuSession
+
+        s = (TpuSession.builder().app_name("t").master("local[*]")
+             .get_or_create())
+        try:
+            f.create_or_replace_temp_view("tt")
+            assert s.catalog.tableExists("tt")
+            assert "tt" in s.catalog.listTables()
+            assert s.catalog.dropTempView("tt")
+            assert not s.catalog.table_exists("tt")
+        finally:
+            s.stop()
+
+
 class TestShims:
     def test_noop_shims_return_frame(self, f):
         assert f.repartition(8) is f
