@@ -423,7 +423,8 @@ class TpuSession:
         import jax as _jax
 
         if not _jax.config.jax_enable_x64 and ids.size > 0:
-            lo, hi = int(ids.min()), int(ids.max())
+            # arange is monotone: the extremes are its endpoints (O(1))
+            lo, hi = sorted((int(ids[0]), int(ids[-1])))
             if lo < -(2 ** 31) or hi >= 2 ** 31:
                 raise ValueError(
                     f"range ids [{lo}, {hi}] exceed int32 and x64 is "

@@ -3,6 +3,15 @@
 
 from __future__ import annotations
 
+from typing import NamedTuple
+
+
+class Table(NamedTuple):
+    """Spark ``catalog.listTables()`` row shape (temp views only here)."""
+
+    name: str
+    isTemporary: bool = True
+
 
 class Catalog:
     def __init__(self):
@@ -32,9 +41,14 @@ class Catalog:
     def list_views(self):
         return sorted(self._views)
 
-    # Spark catalog names for the same listing
-    list_tables = list_views
-    listTables = list_views
+    def list_tables(self) -> list["Table"]:
+        """Spark's ``catalog.listTables()`` shape: objects with ``.name``
+        (and ``.isTemporary``, always True — this catalog holds only temp
+        views), so the ported idiom ``[t.name for t in listTables()]``
+        works. ``list_views`` keeps the plain-string form."""
+        return [Table(name=n, isTemporary=True) for n in sorted(self._views)]
+
+    listTables = list_tables
 
     def clear(self) -> None:
         self._views.clear()

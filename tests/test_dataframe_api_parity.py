@@ -176,7 +176,11 @@ class TestSessionSurface:
         try:
             f.create_or_replace_temp_view("tt")
             assert s.catalog.tableExists("tt")
-            assert "tt" in s.catalog.listTables()
+            # Spark shape: objects with .name / .isTemporary
+            names = [t.name for t in s.catalog.listTables()]
+            assert "tt" in names
+            assert all(t.isTemporary for t in s.catalog.listTables())
+            assert "tt" in s.catalog.list_views()  # plain-string form
             assert s.catalog.dropTempView("tt")
             assert not s.catalog.table_exists("tt")
         finally:

@@ -1,0 +1,25 @@
+"""Example scripts run end-to-end (subprocess, CPU-pinned, short probe):
+each example asserts its own results internally, so rc==0 + the final OK
+banner is a real integration check, not a smoke-only pass."""
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(script: str, timeout: int = 240):
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    env["JAX_PLATFORMS"] = "cpu"
+    env["SPARKDQ4ML_PROBE_TIMEOUT"] = "3"
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, "examples", script)],
+        capture_output=True, text=True, timeout=timeout, cwd=REPO, env=env)
+
+
+def test_sql_tour_end_to_end():
+    proc = _run("sql_tour.py")
+    assert proc.returncode == 0, (proc.stdout[-2000:], proc.stderr[-1500:])
+    assert "sql_tour OK" in proc.stdout
+    assert "fluent dense_rank == SQL OVER dense_rank" in proc.stdout
