@@ -1057,16 +1057,36 @@ class Frame:
                     for a in aggs]
         return global_agg(self, agg_list)
 
-    def sort(self, *cols: str, ascending=True) -> "Frame":
+    def sort(self, *cols, ascending=True) -> "Frame":
         """``orderBy`` — reorders valid rows (host argsort at the boundary),
-        dropping masked slots (the result is compact)."""
+        dropping masked slots (the result is compact). Columns may be
+        names, ``Col``s, or ``col.asc()``/``col.desc()`` sort markers
+        (a marker's direction overrides ``ascending`` for that column)."""
+        from ..ops.expressions import SortOrder
+
         if not cols:
             raise ValueError("sort requires at least one column")
-        d = self.to_pydict()
         asc = ([ascending] * len(cols) if isinstance(ascending, bool)
                else list(ascending))
         if len(asc) != len(cols):
             raise ValueError("ascending list must match columns")
+        resolved = []
+        for i, c in enumerate(cols):
+            if isinstance(c, SortOrder):
+                name = c.name
+                asc[i] = c.ascending
+            elif isinstance(c, str):
+                name = c
+            else:
+                name = c.name  # Col / aliased expr
+            if name not in self.columns:
+                raise ValueError(
+                    f"sort key {name!r} is not a column of this frame "
+                    "(sorting by a computed expression is not supported — "
+                    "add it with with_column first)")
+            resolved.append(name)
+        cols = resolved
+        d = self.to_pydict()
         keys = []
         for c, a in zip(reversed(cols), reversed(asc)):
             k = np.asarray(d[c])

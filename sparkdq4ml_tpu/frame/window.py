@@ -173,7 +173,13 @@ def _colname(c) -> str:
 
 
 def _order_item(c) -> tuple[str, bool]:
-    """Accept "name", ("name", ascending), or a Col."""
+    """Accept "name", ("name", ascending), a Col, or a
+    ``col.asc()``/``col.desc()`` SortOrder marker (the Spark idiom
+    ``Window.orderBy(col("x").desc())``)."""
+    from ..ops.expressions import SortOrder
+
+    if isinstance(c, SortOrder):
+        return (_colname(c.child), c.ascending)
     if isinstance(c, tuple) and len(c) == 2:
         return (_colname(c[0]), bool(c[1]))
     return (_colname(c), True)

@@ -112,6 +112,52 @@ class Expr:
     def is_not_null(self) -> "Expr":
         return UnaryOp("isnotnull", self)
 
+    # Spark Column camelCase names
+    isNull = is_null
+    isNotNull = is_not_null
+
+    def ilike(self, pattern: str) -> "Expr":
+        """Case-insensitive LIKE (Spark ``ilike``): lower both sides."""
+        return StringMatch("like", fn("lower", self), pattern.lower())
+
+    def eq_null_safe(self, other) -> "Expr":
+        """Null-safe equality (Spark ``eqNullSafe`` / SQL ``<=>``): true
+        when both sides are null, false when exactly one is — composed
+        from == and is_null, so NaN-null float columns and None-null
+        string columns both follow Spark's truth table."""
+        other = other if isinstance(other, Expr) else Lit(other)
+        return (self == other) | (self.is_null() & other.is_null())
+
+    eqNullSafe = eq_null_safe
+
+    def substr(self, startPos, length) -> "Expr":
+        """Spark ``col.substr(pos, len)`` (1-based) — the method form of
+        ``substring``. pos/len may be ints or Columns (Spark's
+        ``substr(Column, Column)`` overload); a null pos/len yields
+        null."""
+        p = startPos if isinstance(startPos, Expr) else Lit(startPos)
+        ln = length if isinstance(length, Expr) else Lit(length)
+        return fn("substring", self, p, ln)
+
+    def get_item(self, key: int) -> "Expr":
+        """Spark ``getItem``: 0-based array element; negative or
+        out-of-range ordinals yield null (GetArrayItem semantics —
+        ``element_at`` is the 1-based SQL form where negatives count from
+        the end)."""
+        return fn("get_item", self, Lit(int(key)))
+
+    getItem = get_item
+
+    def asc(self) -> "SortOrder":
+        """Ascending sort marker for ``sort``/``orderBy``/window specs.
+        Null placement follows the engine's column kind: string None
+        sorts first; float NaN-nulls sort last (numpy ordering)."""
+        return SortOrder(self, True)
+
+    def desc(self) -> "SortOrder":
+        """Descending sort marker (see ``asc`` for null placement)."""
+        return SortOrder(self, False)
+
     # -- operators --------------------------------------------------------
     def _bin(self, op, other, reverse=False):
         other = other if isinstance(other, Expr) else Lit(other)
@@ -139,6 +185,19 @@ class Expr:
     def __invert__(self):  return UnaryOp("!", self)
 
     __hash__ = object.__hash__  # __eq__ is overloaded; keep Exprs hashable
+
+
+class SortOrder:
+    """Sort-direction marker from ``col.asc()`` / ``col.desc()`` —
+    consumed by ``Frame.sort``; not an evaluable expression."""
+
+    def __init__(self, child: "Expr", ascending: bool):
+        self.child = child
+        self.ascending = ascending
+
+    @property
+    def name(self) -> str:
+        return self.child.name
 
 
 class Col(Expr):
@@ -557,11 +616,27 @@ def _fn_sha2(s, n):
 
 
 def _fn_substring(s, pos, length):
-    # Spark substring is 1-based; pos 0 behaves like 1.
-    p = int(np.asarray(pos)[0])
-    ln = int(np.asarray(length)[0])
-    start = max(p - 1, 0)
-    return _str_map(lambda x: x[start:start + ln], s)
+    # Spark substring is 1-based; pos 0 behaves like 1. pos/length may be
+    # scalar literals (broadcast columns) or per-row columns (Spark's
+    # substr(Column, Column) overload); a null pos/length yields null.
+    pa = np.asarray(pos).ravel()
+    la = np.asarray(length).ravel()
+
+    def _at(a, i):
+        v = a[i] if a.size > 1 else a[0]
+        if isinstance(v, (float, np.floating)) and np.isnan(v):
+            return None
+        return int(v)
+
+    out = []
+    for i, x in enumerate(s):
+        p, ln = _at(pa, i), _at(la, i)
+        if x is None or p is None or ln is None:
+            out.append(None)
+            continue
+        start = max(p - 1, 0)
+        out.append(x[start:start + ln])
+    return np.asarray(out, object)
 
 
 def _scalar_value(v):
@@ -649,6 +724,20 @@ def _fn_element_at(arr, index):
             continue
         pos = i - 1 if i > 0 else len(cell) + i
         out.append(cell[pos] if 0 <= pos < len(cell) else None)
+    return np.asarray(out, object)
+
+
+def _fn_get_item(arr, index):
+    """Spark ``getItem``: 0-based ordinal; negative or out-of-range (or a
+    null cell) → null — Spark's GetArrayItem truth table, unlike
+    ``element_at`` where negatives count from the end."""
+    i = _scalar_int(index)
+    out = []
+    for cell in _require_array_cells(arr, "getItem"):
+        if cell is None or i < 0 or i >= len(cell):
+            out.append(None)
+        else:
+            out.append(cell[i])
     return np.asarray(out, object)
 
 
@@ -864,6 +953,7 @@ _BUILTIN_FNS = {
     "split": _fn_split,
     "array_contains": _fn_array_contains,
     "element_at": _fn_element_at,
+    "get_item": _fn_get_item,
     "size": _fn_array_size,
     "regexp_replace": _fn_regexp_replace,
     "regexp_extract": _fn_regexp_extract,

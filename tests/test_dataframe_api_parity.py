@@ -148,6 +148,98 @@ class TestSetOps:
         assert f.unionAll(f).count() == 10
 
 
+class TestColumnMethods:
+    """Spark Column-method batch: asc/desc sort markers, isNull camel
+    names, eqNullSafe, substr, getItem, ilike."""
+
+    @pytest.fixture
+    def g(self):
+        return Frame({"x": [3.0, 1.0, 2.0],
+                      "s": ["b", None, "a"]})
+
+    def test_asc_desc_markers(self, g):
+        from sparkdq4ml_tpu.ops.expressions import Col
+
+        assert [r[0] for r in g.sort(Col("x").desc()).collect()] == [3, 2, 1]
+        assert [r[0] for r in g.sort(Col("x").asc()).collect()] == [1, 2, 3]
+        # marker direction overrides the ascending kwarg for that column
+        assert [r[0] for r in
+                g.sort(Col("x").desc(), ascending=True).collect()] == [3, 2, 1]
+
+    def test_is_null_camel_names(self, g):
+        from sparkdq4ml_tpu.ops.expressions import Col
+
+        assert g.filter(Col("s").isNull()).count() == 1
+        assert g.filter(Col("s").isNotNull()).count() == 2
+
+    def test_eq_null_safe(self):
+        from sparkdq4ml_tpu.ops.expressions import Col
+
+        h = Frame({"a": [1.0, np.nan, 2.0], "b": [1.0, np.nan, 9.0]})
+        # Spark <=>: true==true, null<=>null true, 2<=>9 false
+        assert h.filter(Col("a").eqNullSafe(Col("b"))).count() == 2
+        s = Frame({"s": ["x", None]})
+        assert s.filter(Col("s").eqNullSafe(None)).count() == 1
+
+    def test_substr_and_get_item(self, g):
+        from sparkdq4ml_tpu import functions as F
+        from sparkdq4ml_tpu.ops.expressions import Col
+
+        out = g.select(Col("s").substr(1, 1).alias("c")).to_pydict()["c"]
+        assert list(out) == ["b", None, "a"]
+        arr = Frame({"t": ["p,q", "r,s"]}).select(
+            F.split(F.col("t"), ",").alias("arr"))
+        second = arr.select(Col("arr").getItem(1).alias("v"))
+        assert list(second.to_pydict()["v"]) == ["q", "s"]
+
+    def test_ilike(self):
+        from sparkdq4ml_tpu.ops.expressions import Col
+
+        t = Frame({"t": ["Hello", "world", "HELP"]})
+        assert t.filter(Col("t").ilike("h%")).count() == 2
+        assert t.filter(Col("t").like("h%")).count() == 0  # case-sensitive
+
+    def test_get_item_negative_and_oob_are_null(self):
+        from sparkdq4ml_tpu import functions as F
+        from sparkdq4ml_tpu.ops.expressions import Col
+
+        arr = Frame({"t": ["p,q", "r,s"]}).select(
+            F.split(F.col("t"), ",").alias("arr"))
+        # Spark GetArrayItem: negative / out-of-range ordinal -> null
+        for k in (-1, -2, 5):
+            vals = arr.select(Col("arr").getItem(k).alias("v")
+                              ).to_pydict()["v"]
+            assert list(vals) == [None, None]
+
+    def test_substr_column_overload(self):
+        from sparkdq4ml_tpu import functions as F
+        from sparkdq4ml_tpu.ops.expressions import Col
+
+        t = Frame({"s": ["hello", "world"], "n": [2, 3]})
+        out = t.select(Col("s").substr(1, Col("n")).alias("p")
+                       ).to_pydict()["p"]
+        assert list(out) == ["he", "wor"]
+
+    def test_window_orderby_accepts_desc_marker(self):
+        from sparkdq4ml_tpu import functions as F
+        from sparkdq4ml_tpu.frame.window import Window
+        from sparkdq4ml_tpu.ops.expressions import Col
+
+        t = Frame({"k": [1.0, 1.0, 1.0], "v": [10.0, 30.0, 20.0]})
+        w = Window.partitionBy("k").orderBy(Col("v").desc())
+        out = t.with_column("rn", F.row_number().over(w))
+        got = {float(v): int(r) for v, r in
+               zip(out.to_pydict()["v"], out.to_pydict()["rn"])}
+        assert got == {30.0: 1, 20.0: 2, 10.0: 3}
+
+    def test_sort_computed_expression_raises_clearly(self):
+        from sparkdq4ml_tpu.ops.expressions import Col
+
+        t = Frame({"x": [1.0, 2.0]})
+        with pytest.raises(ValueError, match="with_column first"):
+            t.sort(Col("x") + 1)
+
+
 class TestSessionSurface:
     def test_range(self):
         from sparkdq4ml_tpu import TpuSession
