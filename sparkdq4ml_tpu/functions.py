@@ -26,6 +26,11 @@ from .ops.expressions import (acos, array_contains, asin, atan, atan2,
                               rtrim, sha1, sha2, signum, sin, sinh, split,
                               sqrt, substring, tan, tanh, translate, trim,
                               unbase64, upper, when)
+from .ops.expressions import (array, array_distinct, array_join, expr,
+                              flatten, format_number, format_string,
+                              levenshtein, monotonically_increasing_id,
+                              nanvl, rand, randn, slice, sort_array,
+                              spark_partition_id)
 from .ops.expressions import (current_date, date_add, date_format, date_sub,
                               datediff, dayofmonth, dayofweek, dayofyear,
                               from_unixtime, month, quarter, to_date,
@@ -55,4 +60,14 @@ __all__ = ["col", "lit", "call_udf", "callUDF", "count", "sum", "avg",
            "year", "month", "dayofmonth", "dayofweek", "dayofyear",
            "quarter",
            "Window", "WindowSpec", "row_number", "rank", "dense_rank",
-           "percent_rank", "cume_dist", "ntile", "lag", "lead"]
+           "percent_rank", "cume_dist", "ntile", "lag", "lead",
+           "array", "sort_array", "array_distinct", "array_join", "slice",
+           "flatten", "nanvl", "format_number", "format_string",
+           "levenshtein", "rand", "randn", "monotonically_increasing_id",
+           "spark_partition_id", "expr", "broadcast"]
+
+
+def broadcast(df):
+    """Spark ``broadcast(df)`` join hint: a no-op here — XLA owns the
+    execution strategy (see ``Frame.hint``)."""
+    return df

@@ -727,6 +727,177 @@ def _fn_element_at(arr, index):
     return np.asarray(out, object)
 
 
+def _fn_array(*cols):
+    """``array(c1, c2, …)``: one array cell per row from scalar columns.
+    Nulls become None inside the cell — including float NaN-nulls, so
+    array_join/array_distinct/sort_array see them as nulls, not
+    values."""
+    if not cols:
+        raise ValueError("array() needs at least one column")
+    host = [np.asarray(c, object) if _is_object(np.asarray(c))
+            else np.asarray(c) for c in cols]
+    n = len(host[0])
+    out = np.empty(n, object)
+    for i in range(n):
+        out[i] = np.asarray(
+            [None if _cell_is_null(h[i]) else h[i] for h in host], object)
+    return out
+
+
+def _fn_sort_array(arr, asc):
+    """``sort_array``: nulls first ascending / last descending (Spark)."""
+    up = bool(np.asarray(asc).ravel()[0])
+    out = []
+    for cell in _require_array_cells(arr, "sort_array"):
+        if cell is None:
+            out.append(None)
+            continue
+        vals = [v for v in cell if v is not None]
+        nulls = [None] * (len(cell) - len(vals))
+        vals.sort(reverse=not up)
+        out.append(np.asarray(nulls + vals if up else vals + nulls, object))
+    return np.asarray(out, object)
+
+
+def _fn_array_distinct(arr):
+    out = []
+    for cell in _require_array_cells(arr, "array_distinct"):
+        if cell is None:
+            out.append(None)
+            continue
+        seen, vals = set(), []
+        for v in cell:
+            k = ("\0null",) if v is None else v
+            if k not in seen:
+                seen.add(k)
+                vals.append(v)
+        out.append(np.asarray(vals, object))
+    return np.asarray(out, object)
+
+
+def _fn_array_join(arr, delim, *null_replacement):
+    """``array_join(col, delim[, nullReplacement])``: nulls are dropped
+    unless a replacement is given (Spark)."""
+    d = str(np.asarray(delim).ravel()[0])
+    rep = (str(np.asarray(null_replacement[0]).ravel()[0])
+           if null_replacement else None)
+    out = []
+    for cell in _require_array_cells(arr, "array_join"):
+        if cell is None:
+            out.append(None)
+            continue
+        parts = [(rep if v is None else str(v)) for v in cell
+                 if v is not None or rep is not None]
+        out.append(d.join(parts))
+    return np.asarray(out, object)
+
+
+def _fn_slice(arr, start, length):
+    """``slice(col, start, length)``: 1-based; negative start counts from
+    the end; start 0 errors (Spark)."""
+    s = _scalar_int(start)
+    ln = _scalar_int(length)
+    if s == 0:
+        raise ValueError("slice start index is 1-based; 0 is invalid")
+    if ln < 0:
+        raise ValueError("slice length must be >= 0")
+    out = []
+    for cell in _require_array_cells(arr, "slice"):
+        if cell is None:
+            out.append(None)
+            continue
+        pos = s - 1 if s > 0 else len(cell) + s
+        if pos < 0:
+            out.append(np.asarray([], object))
+        else:
+            out.append(np.asarray(list(cell[pos:pos + ln]), object))
+    return np.asarray(out, object)
+
+
+def _fn_flatten(arr):
+    """``flatten``: one level of nesting removed; a null inner array
+    nulls the whole result cell (Spark). Requires array<array> input —
+    a flat array column (whose inner cells are scalars/strings) is
+    rejected like Spark's analyzer would, instead of silently exploding
+    strings into characters."""
+    out = []
+    for cell in _require_array_cells(arr, "flatten"):
+        if cell is None:
+            out.append(None)
+            continue
+        vals: list = []
+        for inner in cell:
+            if inner is None:
+                vals = None
+                break
+            if not isinstance(inner, (list, tuple, np.ndarray)):
+                raise ValueError(
+                    "flatten() expects an array-of-arrays column; inner "
+                    f"cells here are {type(inner).__name__}")
+            vals.extend(inner)
+        out.append(None if vals is None else np.asarray(vals, object))
+    return np.asarray(out, object)
+
+
+def _fn_nanvl(a, b):
+    """``nanvl(a, b)``: b where a is NaN (numeric columns; XLA fuses)."""
+    a = jnp.asarray(a)
+    return jnp.where(jnp.isnan(a), jnp.asarray(b, a.dtype), a)
+
+
+def _fn_format_number(x, d):
+    nd = _scalar_int(d)
+    if nd < 0:
+        raise ValueError("format_number decimal places must be >= 0")
+    vals = np.asarray(x, np.float64)
+    return np.asarray([None if np.isnan(v) else format(v, f",.{nd}f")
+                       for v in vals], object)
+
+
+def _fn_format_string(fmt, *cols):
+    """printf formatting; a null argument in a row nulls that row's
+    result (the engine's general null-propagation rule — Java's
+    String.format would render %s nulls as 'null' but throw on %d)."""
+    fa = np.asarray(fmt, object).ravel()  # Lit: frame-length column
+    f = fa[0] if fa.size else ""
+    host = [np.asarray(c, object) for c in cols]
+    out = []
+    for i in range(len(fa)):
+        args = tuple(h[i] for h in host)
+        if any(_cell_is_null(v) for v in args):
+            out.append(None)
+            continue
+        out.append(f % args)
+    return np.asarray(out, object)
+
+
+def _cell_is_null(v) -> bool:
+    return v is None or (isinstance(v, (float, np.floating)) and np.isnan(v))
+
+
+def _fn_levenshtein(l, r):  # noqa: E741 - Spark's own argument names
+    def dist(a, b):
+        if a is None or b is None:
+            return None
+        if len(a) < len(b):
+            a, b = b, a
+        prev = list(range(len(b) + 1))
+        for i, ca in enumerate(a, 1):
+            cur = [i]
+            for j, cb in enumerate(b, 1):
+                cur.append(min(prev[j] + 1, cur[-1] + 1,
+                               prev[j - 1] + (ca != cb)))
+            prev = cur
+        return prev[-1]
+
+    la = np.asarray(l, object)
+    ra = np.asarray(r, object)
+    out = [dist(a, b) for a, b in zip(la, ra)]
+    if any(v is None for v in out):
+        return np.asarray(out, object)
+    return np.asarray(out, np.int32)
+
+
 def _fn_get_item(arr, index):
     """Spark ``getItem``: 0-based ordinal; negative or out-of-range (or a
     null cell) → null — Spark's GetArrayItem truth table, unlike
@@ -954,6 +1125,16 @@ _BUILTIN_FNS = {
     "array_contains": _fn_array_contains,
     "element_at": _fn_element_at,
     "get_item": _fn_get_item,
+    "array": _fn_array,
+    "sort_array": _fn_sort_array,
+    "array_distinct": _fn_array_distinct,
+    "array_join": _fn_array_join,
+    "slice": _fn_slice,
+    "flatten": _fn_flatten,
+    "nanvl": _fn_nanvl,
+    "format_number": _fn_format_number,
+    "format_string": _fn_format_string,
+    "levenshtein": _fn_levenshtein,
     "size": _fn_array_size,
     "regexp_replace": _fn_regexp_replace,
     "regexp_extract": _fn_regexp_extract,
@@ -1138,6 +1319,119 @@ rtrim = _make_fn("rtrim")
 length = _make_fn("length")
 concat = _make_fn("concat")
 substring = _make_fn("substring")
+array = _make_fn("array")
+array_distinct = _make_fn("array_distinct")
+flatten = _make_fn("flatten")
+nanvl = _make_fn("nanvl")
+format_number = _make_fn("format_number")
+levenshtein = _make_fn("levenshtein")
+
+
+def format_string(fmt: str, *cols) -> "Func":
+    """``format_string('%s: %d', c1, c2)`` — printf formatting; the
+    format is a literal, not a column name (``fn`` would coerce a bare
+    string to a Col)."""
+    return fn("format_string", Lit(fmt), *cols)
+
+
+def sort_array(col_, asc: bool = True) -> "Func":
+    """``sort_array(col[, asc])``: nulls first ascending / last
+    descending (Spark)."""
+    return fn("sort_array", col_, Lit(bool(asc)))
+
+
+def array_join(col_, delimiter: str, null_replacement=None) -> "Func":
+    """``array_join(col, delim[, nullReplacement])``: nulls dropped
+    unless a replacement is given (Spark)."""
+    if null_replacement is None:
+        return fn("array_join", col_, Lit(delimiter))
+    return fn("array_join", col_, Lit(delimiter), Lit(null_replacement))
+
+
+def slice(col_, start: int, length: int) -> "Func":  # noqa: A001 - Spark name
+    """``slice(col, start, length)``: 1-based, negative start counts from
+    the end (Spark)."""
+    return fn("slice", col_, Lit(int(start)), Lit(int(length)))
+
+
+class RowFunc(Expr):
+    """Frame-length generator column (``rand``/``randn``/row ids): knows
+    nothing about other columns, only how many row slots the frame has.
+    Seeded generators are deterministic per expression instance, like
+    Spark's ``rand(seed)`` per plan node."""
+
+    _KINDS = ("rand", "randn", "id", "partition_id")
+
+    def __init__(self, kind: str, seed=None):
+        if kind not in self._KINDS:
+            raise ValueError(f"unknown row generator {kind!r}")
+        self.kind = kind
+        self.seed = seed
+
+    def eval(self, frame):
+        n = frame.num_slots
+        if self.kind == "id":
+            return jnp.arange(n, dtype=int_dtype())
+        if self.kind == "partition_id":
+            # one logical partition: the id is 0 everywhere (the same
+            # no-op stance as repartition/coalesce)
+            return jnp.zeros((n,), dtype=int_dtype())
+        rng = np.random.default_rng(self.seed)
+        host = (rng.uniform(size=n) if self.kind == "rand"
+                else rng.standard_normal(size=n))
+        return jnp.asarray(host.astype(np.dtype(float_dtype())))
+
+    @property
+    def name(self) -> str:
+        if self.kind == "id":
+            return "monotonically_increasing_id()"
+        if self.kind == "partition_id":
+            return "spark_partition_id()"
+        seed = "" if self.seed is None else str(self.seed)
+        return f"{self.kind}({seed})"
+
+    def __str__(self):
+        return self.name
+
+
+def rand(seed=None) -> RowFunc:
+    """Uniform [0, 1) column (Spark ``rand``); deterministic per seed."""
+    return RowFunc("rand", seed)
+
+
+def randn(seed=None) -> RowFunc:
+    """Standard-normal column (Spark ``randn``)."""
+    return RowFunc("randn", seed)
+
+
+def monotonically_increasing_id() -> RowFunc:
+    """Row ids 0..n-1 (Spark's are only partition-monotone; one logical
+    partition here makes them consecutive)."""
+    return RowFunc("id")
+
+
+def spark_partition_id() -> RowFunc:
+    """Always 0 — one logical partition (see repartition's no-op note)."""
+    return RowFunc("partition_id")
+
+
+def expr(sql_text: str) -> Expr:
+    """Spark ``F.expr``: one SQL expression (the same grammar as
+    ``selectExpr`` items — CAST, arithmetic, functions, AS alias).
+    Aggregates/window items are not scalar expressions; use
+    ``selectExpr``/``session.sql`` for those."""
+    from ..sql.parser import _Parser, tokenize
+
+    p = _Parser(tokenize(sql_text))
+    item = p.parse_select_item()
+    p.expect("eof")  # trailing tokens = a typo, not a second expression
+    if not isinstance(item, Expr):
+        raise ValueError(
+            f"expr({sql_text!r}) is not a scalar expression; use "
+            "selectExpr()/session.sql() for aggregates and window items")
+    return item
+
+
 sin = _make_fn("sin")
 cos = _make_fn("cos")
 tan = _make_fn("tan")

@@ -1,0 +1,156 @@
+"""Second functions batch: array construction + array ops (sort_array,
+array_distinct, array_join, slice, flatten), nanvl, generators
+(rand/randn/monotonically_increasing_id/spark_partition_id), expr(),
+format_number/format_string, levenshtein, broadcast no-op."""
+
+import numpy as np
+import pytest
+
+from sparkdq4ml_tpu import Frame
+from sparkdq4ml_tpu import functions as F
+
+
+@pytest.fixture
+def f():
+    return Frame({"a": [1.0, 4.0, np.nan],
+                  "b": [9.0, 2.0, 7.0],
+                  "s": ["x", None, "z"]})
+
+
+def _arr_frame(*cells):
+    return Frame({"t": [",".join(c) for c in cells]}).select(
+        F.split(F.col("t"), ",").alias("arr"))
+
+
+class TestArrayOps:
+    def test_array_builds_per_row_cells(self, f):
+        out = f.select(F.array("a", "b").alias("ab")).to_pydict()["ab"]
+        np.testing.assert_allclose(np.asarray(out[0], np.float64), [1, 9])
+        np.testing.assert_allclose(np.asarray(out[1], np.float64), [4, 2])
+
+    def test_sort_array_directions(self):
+        t = _arr_frame(["b", "a", "c"])
+        asc = t.select(F.sort_array("arr").alias("s")).to_pydict()["s"][0]
+        assert list(asc) == ["a", "b", "c"]
+        desc = t.select(F.sort_array("arr", False).alias("s")
+                        ).to_pydict()["s"][0]
+        assert list(desc) == ["c", "b", "a"]
+
+    def test_array_distinct_preserves_first_occurrence_order(self):
+        t = _arr_frame(["b", "a", "b", "c", "a"])
+        d = t.select(F.array_distinct("arr").alias("d")).to_pydict()["d"][0]
+        assert list(d) == ["b", "a", "c"]
+
+    def test_array_join_and_null_replacement(self):
+        t = _arr_frame(["p", "q"])
+        j = t.select(F.array_join("arr", "-").alias("j")).to_pydict()["j"]
+        assert list(j) == ["p-q"]
+        # nulls dropped without replacement, kept with one (Spark)
+        withnull = Frame({"x": [1.0]}).select(
+            F.array(F.col("x"), F.lit(None)).alias("arr"))
+        drop = withnull.select(F.array_join("arr", ",").alias("j")
+                               ).to_pydict()["j"][0]
+        rep = withnull.select(F.array_join("arr", ",", "NA").alias("j")
+                              ).to_pydict()["j"][0]
+        assert drop == "1.0"
+        assert rep == "1.0,NA"
+
+    def test_slice_semantics(self):
+        t = _arr_frame(list("abcde"))
+        sl = t.select(F.slice("arr", 2, 2).alias("s")).to_pydict()["s"][0]
+        assert list(sl) == ["b", "c"]
+        neg = t.select(F.slice("arr", -2, 2).alias("s")).to_pydict()["s"][0]
+        assert list(neg) == ["d", "e"]
+        with pytest.raises(ValueError, match="1-based"):
+            t.select(F.slice("arr", 0, 1)).collect()
+
+    def test_flatten(self):
+        inner = _arr_frame(["a", "b"]).select(
+            F.array(F.col("arr"), F.col("arr")).alias("nested"))
+        flat = inner.select(F.flatten("nested").alias("f")).to_pydict()["f"][0]
+        assert list(flat) == ["a", "b", "a", "b"]
+
+    def test_flatten_rejects_flat_arrays(self):
+        t = _arr_frame(["ab", "cd"])
+        with pytest.raises(ValueError, match="array-of-arrays"):
+            t.select(F.flatten("arr")).collect()
+
+    def test_array_nan_null_becomes_none(self):
+        g = Frame({"x": [np.nan, 1.0], "y": [2.0, 3.0]})
+        cells = g.select(F.array("x", "y").alias("a")).to_pydict()["a"]
+        assert cells[0][0] is None     # NaN-null -> None in the cell
+        j = g.select(F.array_join(F.array("x", "y"), ",").alias("j")
+                     ).to_pydict()["j"]
+        assert j[0] == "2.0"           # null dropped, not 'nan'
+
+
+class TestScalars:
+    def test_nanvl(self, f):
+        out = f.select(F.nanvl(F.col("a"), F.col("b")).alias("n")
+                       ).to_pydict()["n"]
+        np.testing.assert_allclose(np.asarray(out, np.float64), [1, 4, 7])
+
+    def test_format_number(self):
+        t = Frame({"x": [1234.5, np.nan]})
+        out = t.select(F.format_number(F.col("x"), 1).alias("f")
+                       ).to_pydict()["f"]
+        assert list(out) == ["1,234.5", None]
+
+    def test_format_string(self, f):
+        out = f.select(F.format_string("%s!", F.col("s")).alias("t")
+                       ).to_pydict()["t"]
+        # null arg -> null result (engine null propagation)
+        assert list(out) == ["x!", None, "z!"]
+
+    def test_format_string_no_columns_is_frame_length(self, f):
+        out = f.select(F.format_string("hi").alias("t")).to_pydict()["t"]
+        assert list(out) == ["hi", "hi", "hi"]
+
+    def test_format_string_null_numeric_arg_propagates(self, f):
+        out = f.select(F.format_string("%.0f", F.col("a")).alias("t")
+                       ).to_pydict()["t"]
+        assert list(out) == ["1", "4", None]  # NaN-null -> null, no crash
+
+    def test_levenshtein(self):
+        t = Frame({"l": ["kitten", "abc", None],
+                   "r": ["sitting", "abc", "x"]})
+        out = t.select(F.levenshtein(F.col("l"), F.col("r")).alias("d")
+                       ).to_pydict()["d"]
+        assert list(out) == [3, 0, None]
+
+
+class TestGenerators:
+    def test_rand_deterministic_per_seed(self, f):
+        r1 = list(f.select(F.rand(7).alias("r")).to_pydict()["r"])
+        r2 = list(f.select(F.rand(7).alias("r")).to_pydict()["r"])
+        assert r1 == r2
+        assert all(0.0 <= float(v) < 1.0 for v in r1)
+        r3 = list(f.select(F.rand(8).alias("r")).to_pydict()["r"])
+        assert r1 != r3
+
+    def test_randn_shape_and_ids(self, f):
+        n = f.select(F.randn(3).alias("n")).to_pydict()["n"]
+        assert len(n) == 3
+        ids = f.select(F.monotonically_increasing_id().alias("i")
+                       ).to_pydict()["i"]
+        assert list(map(int, ids)) == [0, 1, 2]
+        pid = f.select(F.spark_partition_id().alias("p")).to_pydict()["p"]
+        assert list(map(int, pid)) == [0, 0, 0]
+
+
+class TestExprAndBroadcast:
+    def test_expr_scalar(self, f):
+        out = f.select(F.expr("a + b AS s2"))
+        assert out.columns == ["s2"]
+        assert float(out.to_pydict()["s2"][0]) == 10.0
+
+    def test_expr_rejects_aggregates(self):
+        with pytest.raises(ValueError, match="selectExpr"):
+            F.expr("count(*)")
+
+    def test_expr_rejects_trailing_tokens(self):
+        with pytest.raises(ValueError):
+            F.expr("a + 1, b + 2")   # two items = typo, not a list
+
+    def test_broadcast_noop(self, f):
+        assert F.broadcast(f) is f
