@@ -31,6 +31,10 @@ from .ops.expressions import (array, array_distinct, array_join, expr,
                               levenshtein, monotonically_increasing_id,
                               nanvl, rand, randn, slice, sort_array,
                               spark_partition_id)
+from .ops.expressions import (array_except, array_intersect, array_max,
+                              array_min, array_position, array_remove,
+                              array_repeat, array_union, arrays_overlap,
+                              arrays_zip, sequence, shuffle)
 from .ops.expressions import (current_date, date_add, date_format, date_sub,
                               datediff, dayofmonth, dayofweek, dayofyear,
                               from_unixtime, month, quarter, to_date,
@@ -64,7 +68,11 @@ __all__ = ["col", "lit", "call_udf", "callUDF", "count", "sum", "avg",
            "array", "sort_array", "array_distinct", "array_join", "slice",
            "flatten", "nanvl", "format_number", "format_string",
            "levenshtein", "rand", "randn", "monotonically_increasing_id",
-           "spark_partition_id", "expr", "broadcast"]
+           "spark_partition_id", "expr", "broadcast",
+           "array_position", "array_remove", "array_union",
+           "array_intersect", "array_except", "arrays_overlap",
+           "array_min", "array_max", "array_repeat", "sequence",
+           "arrays_zip", "shuffle"]
 
 
 def broadcast(df):

@@ -15,6 +15,7 @@ XLA fuses — the per-row boundary does not exist.
 from __future__ import annotations
 
 import base64 as _b64
+import builtins
 import functools
 import hashlib
 import re
@@ -734,8 +735,7 @@ def _fn_array(*cols):
     values."""
     if not cols:
         raise ValueError("array() needs at least one column")
-    host = [np.asarray(c, object) if _is_object(np.asarray(c))
-            else np.asarray(c) for c in cols]
+    host = [np.asarray(c) for c in cols]  # one device→host fetch per column
     n = len(host[0])
     out = np.empty(n, object)
     for i in range(n):
@@ -744,9 +744,10 @@ def _fn_array(*cols):
     return out
 
 
-def _fn_sort_array(arr, asc):
-    """``sort_array``: nulls first ascending / last descending (Spark)."""
-    up = bool(np.asarray(asc).ravel()[0])
+def _fn_sort_array(arr, *asc):
+    """``sort_array``: nulls first ascending / last descending (Spark);
+    SQL's second argument is optional, defaulting to ascending."""
+    up = bool(np.asarray(asc[0]).ravel()[0]) if asc else True
     out = []
     for cell in _require_array_cells(arr, "sort_array"):
         if cell is None:
@@ -767,7 +768,7 @@ def _fn_array_distinct(arr):
             continue
         seen, vals = set(), []
         for v in cell:
-            k = ("\0null",) if v is None else v
+            k = _elem_key(v)
             if k not in seen:
                 seen.add(k)
                 vals.append(v)
@@ -920,6 +921,220 @@ def _fn_array_size(arr):
     return jnp.asarray(np.asarray(
         [-1 if cell is None else len(cell)
          for cell in _require_array_cells(arr, "size")], np.int32))
+
+
+def _elem_key(v):
+    """Hashable identity for array-set operations: Spark's set functions
+    (union/intersect/except/distinct) treat null as equal to null."""
+    if v is None:
+        return ("\0null",)
+    if isinstance(v, (float, np.floating)) and np.isnan(v):
+        return ("\0nan",)
+    return v
+
+
+def _fn_array_position(arr, value):
+    """Spark ``array_position(col, value)``: 1-based index of the FIRST
+    element equal to the literal; 0 when absent; null cell → null. Null
+    elements never match (Spark's null-safe scan skips them)."""
+    v = _scalar_value(value)
+    out = []
+    for cell in _require_array_cells(arr, "array_position"):
+        if cell is None or v is None:
+            out.append(None)
+            continue
+        pos = 0
+        for i, x in enumerate(cell):
+            if x is not None and x == v:
+                pos = i + 1
+                break
+        out.append(pos)
+    if any(x is None for x in out):
+        return np.asarray(out, object)
+    return jnp.asarray(np.asarray(out, np.int64))
+
+
+def _fn_array_remove(arr, element):
+    """Spark ``array_remove(col, element)``: drop ALL elements equal to
+    the literal; null elements are kept (they compare null, not equal);
+    null cell or null element → null."""
+    v = _scalar_value(element)
+    out = []
+    for cell in _require_array_cells(arr, "array_remove"):
+        if cell is None or v is None:
+            out.append(None)
+        else:
+            out.append(np.asarray(
+                [x for x in cell if x is None or x != v], object))
+    return np.asarray(out, object)
+
+
+def _array_set_op(name, candidates, keep):
+    """Shared scan for the three array-set functions: one dedup pass over
+    ``candidates(la, lb)`` keeping elements whose key passes
+    ``keep(key, right_keyset)``; null ≡ null; either cell null → null."""
+
+    def f(a, b):
+        ca = _require_array_cells(a, name)
+        cb = _require_array_cells(b, name)
+        out = []
+        for la, lb in zip(ca, cb):
+            if la is None or lb is None:
+                out.append(None)
+                continue
+            right = {_elem_key(x) for x in lb}
+            seen, vals = set(), []
+            for x in candidates(la, lb):
+                k = _elem_key(x)
+                if k not in seen and keep(k, right):
+                    seen.add(k)
+                    vals.append(x)
+            out.append(np.asarray(vals, object))
+        return np.asarray(out, object)
+
+    return f
+
+
+# Spark ``array_union``: a's first occurrences in order, then b's unseen
+# ones. ``array_intersect``/``array_except``: deduplicated elements of a
+# (in a's order) present/absent in b.
+_fn_array_union = _array_set_op(
+    "array_union", lambda la, lb: list(la) + list(lb), lambda k, r: True)
+_fn_array_intersect = _array_set_op(
+    "array_intersect", lambda la, lb: la, lambda k, r: k in r)
+_fn_array_except = _array_set_op(
+    "array_except", lambda la, lb: la, lambda k, r: k not in r)
+
+
+def _fn_arrays_overlap(a, b):
+    """Spark ``arrays_overlap``: true on a shared non-null element; if
+    none and both sides are non-empty but either holds a null, the
+    answer is unknowable → null; otherwise false. Null cell → null."""
+    ca = _require_array_cells(a, "arrays_overlap")
+    cb = _require_array_cells(b, "arrays_overlap")
+    out = []
+    for la, lb in zip(ca, cb):
+        if la is None or lb is None:
+            out.append(None)
+            continue
+        sa = {_elem_key(x) for x in la if x is not None}
+        has_null = any(x is None for x in la) or any(x is None for x in lb)
+        if any(x is not None and _elem_key(x) in sa for x in lb):
+            out.append(True)
+        elif len(la) and len(lb) and has_null:
+            out.append(None)
+        else:
+            out.append(False)
+    if any(x is None for x in out):
+        return jnp.asarray(np.asarray(
+            [np.nan if x is None else float(x) for x in out], np.float64),
+            float_dtype())
+    return jnp.asarray(np.asarray(out, np.bool_))
+
+
+def _array_extreme(which):
+    """``array_min`` / ``array_max``: null elements skipped; empty or
+    all-null or null cell → null (Spark)."""
+    pick = min if which == "min" else max
+
+    def f(arr):
+        out = []
+        for cell in _require_array_cells(arr, f"array_{which}"):
+            vals = (None if cell is None
+                    else [x for x in cell if x is not None])
+            out.append(pick(vals) if vals else None)
+        if all(isinstance(x, str) for x in out if x is not None):
+            return np.asarray(out, object)
+        return jnp.asarray(np.asarray(
+            [np.nan if x is None else float(x) for x in out], np.float64),
+            float_dtype())
+
+    return f
+
+
+def _fn_array_repeat(elem, count):
+    """Spark ``array_repeat(col, count)``: one array cell per row holding
+    the row's (scalar) value ``count`` times; negative count → empty."""
+    n = builtins.max(0, _scalar_int(count))
+    host = np.asarray(elem, object) if _is_object(np.asarray(elem)) \
+        else np.asarray(elem)
+    out = np.empty(len(host), object)
+    for i, x in enumerate(host):
+        v = None if _cell_is_null(x) else x
+        out[i] = np.asarray([v] * n, object)
+    return out
+
+
+def _fn_sequence(start, stop, *step):
+    """Spark ``sequence(start, stop[, step])``: inclusive integer range
+    per row; the default step is ±1 toward stop; a step of 0 or one
+    pointing away from stop errors like Spark's runtime check."""
+    sa = np.asarray(start, np.float64)
+    so = np.asarray(stop, np.float64)
+    st = np.asarray(step[0], np.float64) if step else None
+    out = np.empty(len(sa), object)
+    for i in range(len(sa)):
+        if np.isnan(sa[i]) or np.isnan(so[i]) or \
+                (st is not None and np.isnan(st[i])):
+            out[i] = None
+            continue
+        lo, hi = int(sa[i]), int(so[i])
+        s = int(st[i]) if st is not None else (1 if hi >= lo else -1)
+        if s == 0 or (hi > lo and s < 0) or (hi < lo and s > 0):
+            raise ValueError(
+                f"sequence boundaries: {lo} to {hi} by {s} — the step "
+                "must move toward stop (Spark's requirement)")
+        out[i] = np.asarray(list(range(lo, hi + (1 if s > 0 else -1), s)),
+                            object)
+    return out
+
+
+def _fn_arrays_zip(*arrs):
+    """Spark ``arrays_zip``: element-wise tuples, padded with null to the
+    longest input. Spark's cells are structs; struct columns do not
+    exist in this engine, so each zipped element is a fixed-width list —
+    positional access (`getItem`) behaves identically."""
+    cells = [_require_array_cells(a, "arrays_zip") for a in arrs]
+    out = []
+    for row in zip(*cells):
+        if any(c is None for c in row):
+            out.append(None)
+            continue
+        width = builtins.max((len(c) for c in row), default=0)
+        out.append(np.asarray(
+            [np.asarray([c[j] if j < len(c) else None for c in row], object)
+             for j in range(width)], object))
+    return np.asarray(out, object)
+
+
+def _fn_shuffle(arr, *seed):
+    """Spark ``shuffle(col)``: random permutation per cell. Spark's is
+    nondeterministic per query; here a seed of −1 (or SQL's one-argument
+    form) means "draw one from the OS" and any other value makes the
+    column reproducible (the same extension ``rand(seed)`` exposes)."""
+    s = _scalar_int(seed[0]) if seed else -1
+    rng = np.random.default_rng(None if s == -1 else s)
+    out = []
+    for cell in _require_array_cells(arr, "shuffle"):
+        if cell is None:
+            out.append(None)
+        else:
+            out.append(np.asarray(
+                [cell[j] for j in rng.permutation(len(cell))], object))
+    return np.asarray(out, object)
+
+
+def _fn_reverse(v):
+    """Spark ``reverse``: strings reverse characterwise, arrays
+    elementwise — dispatched on the first non-null cell like the other
+    array/string dual functions."""
+    a = np.asarray(v, object)
+    first = next((c for c in a if c is not None), None)
+    if isinstance(first, (list, tuple, np.ndarray)):
+        return np.asarray(
+            [None if c is None else np.asarray(list(c)[::-1], object)
+             for c in a], object)
+    return _str_map(lambda x: x[::-1], v)
 
 
 class Explode(Expr):
@@ -1144,7 +1359,19 @@ _BUILTIN_FNS = {
     "rpad": _fn_rpad,
     "repeat": lambda s, n: _str_map(
         lambda x: x * _scalar_int(n), s),
-    "reverse": lambda s: _str_map(lambda x: x[::-1], s),
+    "reverse": _fn_reverse,
+    "array_position": _fn_array_position,
+    "array_remove": _fn_array_remove,
+    "array_union": _fn_array_union,
+    "array_intersect": _fn_array_intersect,
+    "array_except": _fn_array_except,
+    "arrays_overlap": _fn_arrays_overlap,
+    "array_min": _array_extreme("min"),
+    "array_max": _array_extreme("max"),
+    "array_repeat": _fn_array_repeat,
+    "sequence": _fn_sequence,
+    "arrays_zip": _fn_arrays_zip,
+    "shuffle": _fn_shuffle,
     "initcap": lambda s: _str_map(
         lambda x: " ".join(w.capitalize() for w in x.split(" ")), s),
     "translate": _fn_translate,
@@ -1352,6 +1579,49 @@ def slice(col_, start: int, length: int) -> "Func":  # noqa: A001 - Spark name
     """``slice(col, start, length)``: 1-based, negative start counts from
     the end (Spark)."""
     return fn("slice", col_, Lit(int(start)), Lit(int(length)))
+
+
+def array_position(col_, value) -> Func:
+    """``array_position(col, value)`` — 1-based first match, 0 if absent."""
+    return Func("array_position",
+                [_coerce(col_), value if isinstance(value, Expr)
+                 else Lit(value)])
+
+
+def array_remove(col_, element) -> Func:
+    """``array_remove(col, element)`` — drop every equal element."""
+    return Func("array_remove",
+                [_coerce(col_), element if isinstance(element, Expr)
+                 else Lit(element)])
+
+
+array_union = _make_fn("array_union")
+array_intersect = _make_fn("array_intersect")
+array_except = _make_fn("array_except")
+arrays_overlap = _make_fn("arrays_overlap")
+array_min = _make_fn("array_min")
+array_max = _make_fn("array_max")
+arrays_zip = _make_fn("arrays_zip")
+
+
+def array_repeat(col_, count: int) -> Func:
+    """``array_repeat(col, count)`` — the count is a literal."""
+    return Func("array_repeat", [_coerce(col_), Lit(int(count))])
+
+
+def sequence(start, stop, step=None) -> Func:
+    """``sequence(start, stop[, step])`` — inclusive range per row."""
+    args = [_coerce(start), _coerce(stop)]
+    if step is not None:
+        args.append(_coerce(step))
+    return Func("sequence", args)
+
+
+def shuffle(col_, seed: int = None) -> Func:
+    """``shuffle(col)`` — random per-cell permutation; the optional seed
+    is an extension (Spark's is always nondeterministic)."""
+    return Func("shuffle",
+                [_coerce(col_), Lit(-1 if seed is None else int(seed))])
 
 
 class RowFunc(Expr):

@@ -1,0 +1,194 @@
+"""Third functions batch: the Spark 2.4 array-set family
+(array_position/remove/union/intersect/except, arrays_overlap,
+array_min/max, array_repeat, sequence, arrays_zip, shuffle) and the
+array form of reverse. Semantics targets are Spark 2.4's documented
+truth tables (the reference pins spark 2.4.4, `pom.xml:14`)."""
+
+import numpy as np
+import pytest
+
+from sparkdq4ml_tpu import Frame
+from sparkdq4ml_tpu import functions as F
+
+
+def _arr_frame(*cells):
+    return Frame({"t": [",".join(c) for c in cells]}).select(
+        F.split(F.col("t"), ",").alias("arr"))
+
+
+def _two_arrays(a_cells, b_cells):
+    n = len(a_cells)
+    f = Frame({"a": [",".join(c) for c in a_cells],
+               "b": [",".join(c) for c in b_cells],
+               "i": list(range(n))})
+    return f.select(F.split(F.col("a"), ",").alias("x"),
+                    F.split(F.col("b"), ",").alias("y"))
+
+
+class TestArrayPosition:
+    def test_first_match_one_based(self):
+        t = _arr_frame(["b", "a", "b"], ["z", "q"])
+        out = t.select(F.array_position("arr", "b").alias("p")
+                       ).to_pydict()["p"]
+        assert list(out) == [1, 0]
+
+    def test_null_cell_is_null(self):
+        f = Frame({"s": ["a,b", None]}).select(
+            F.split(F.col("s"), ",").alias("arr"))
+        out = f.select(F.array_position("arr", "a").alias("p")
+                       ).to_pydict()["p"]
+        assert out[0] == 1 and out[1] is None
+
+    def test_sql_path(self, session):
+        t = _arr_frame(["x", "y"])
+        t.create_or_replace_temp_view("tp")
+        out = session.sql("SELECT array_position(arr, 'y') AS p FROM tp"
+                          ).to_pydict()["p"]
+        assert list(out) == [2]
+
+
+class TestArrayRemove:
+    def test_removes_all_matches_keeps_nulls(self):
+        withnull = Frame({"x": [1.0]}).select(
+            F.array(F.lit(3.0), F.col("x"), F.lit(None),
+                    F.lit(3.0)).alias("arr"))
+        out = withnull.select(F.array_remove("arr", 3.0).alias("r")
+                              ).to_pydict()["r"][0]
+        assert list(out) == [1.0, None]
+
+
+class TestSetOps:
+    def test_union_dedups_in_order(self):
+        t = _two_arrays([["b", "a", "b"]], [["c", "a", "d"]])
+        out = t.select(F.array_union("x", "y").alias("u")
+                       ).to_pydict()["u"][0]
+        assert list(out) == ["b", "a", "c", "d"]
+
+    def test_intersect_keeps_left_order(self):
+        t = _two_arrays([["d", "a", "c", "a"]], [["a", "c", "z"]])
+        out = t.select(F.array_intersect("x", "y").alias("i")
+                       ).to_pydict()["i"][0]
+        assert list(out) == ["a", "c"]
+
+    def test_except_dedups(self):
+        t = _two_arrays([["b", "a", "b", "c"]], [["c", "z"]])
+        out = t.select(F.array_except("x", "y").alias("e")
+                       ).to_pydict()["e"][0]
+        assert list(out) == ["b", "a"]
+
+    def test_null_equals_null_in_set_ops(self):
+        # null ≡ null for the set functions (Spark)
+        f = Frame({"x": [1.0]})
+        a = F.array(F.lit(1.0), F.lit(None))
+        b = F.array(F.lit(None), F.lit(2.0))
+        out = f.select(F.array_intersect(a, b).alias("i")).to_pydict()["i"][0]
+        assert list(out) == [None]
+
+
+class TestArraysOverlap:
+    def test_truth_table(self):
+        f = Frame({"x": [1.0]})
+        common = f.select(F.arrays_overlap(
+            F.array(F.lit(1.0), F.lit(2.0)),
+            F.array(F.lit(2.0), F.lit(9.0))).alias("o")).to_pydict()["o"][0]
+        assert common is True or common == 1.0
+        disjoint = f.select(F.arrays_overlap(
+            F.array(F.lit(1.0)), F.array(F.lit(9.0))).alias("o")
+            ).to_pydict()["o"][0]
+        assert disjoint is False or disjoint == 0.0
+        # no common element but a null present → unknown (null)
+        unknown = f.select(F.arrays_overlap(
+            F.array(F.lit(1.0), F.lit(None)), F.array(F.lit(9.0))).alias("o")
+            ).to_pydict()["o"][0]
+        assert unknown is None or np.isnan(unknown)  # NaN is this engine's numeric null
+
+
+class TestMinMax:
+    def test_numeric_skips_nulls(self):
+        f = Frame({"x": [5.0]})
+        arr = F.array(F.lit(3.0), F.lit(None), F.col("x"))
+        lo = f.select(F.array_min(arr).alias("m")).to_pydict()["m"][0]
+        hi = f.select(F.array_max(arr).alias("m")).to_pydict()["m"][0]
+        assert lo == 3.0 and hi == 5.0
+
+    def test_string_arrays(self):
+        t = _arr_frame(["pear", "apple", "zed"])
+        lo = t.select(F.array_min("arr").alias("m")).to_pydict()["m"][0]
+        hi = t.select(F.array_max("arr").alias("m")).to_pydict()["m"][0]
+        assert lo == "apple" and hi == "zed"
+
+    def test_empty_is_null(self):
+        f = Frame({"s": ["a,b"]}).select(
+            F.split(F.col("s"), ",").alias("arr"))
+        out = f.select(F.array_min(F.array_except("arr", "arr")).alias("m")
+                       ).to_pydict()["m"][0]
+        assert out is None
+
+
+class TestRepeatSequenceZip:
+    def test_array_repeat(self):
+        f = Frame({"x": [7.0, np.nan]})
+        out = f.select(F.array_repeat("x", 3).alias("r")).to_pydict()["r"]
+        assert list(out[0]) == [7.0, 7.0, 7.0]
+        assert list(out[1]) == [None, None, None]
+        empty = f.select(F.array_repeat("x", -1).alias("r")
+                         ).to_pydict()["r"][0]
+        assert list(empty) == []
+
+    def test_sequence_default_step_both_directions(self):
+        f = Frame({"lo": [1.0, 5.0], "hi": [4.0, 2.0]})
+        out = f.select(F.sequence("lo", "hi").alias("s")).to_pydict()["s"]
+        assert list(out[0]) == [1, 2, 3, 4]
+        assert list(out[1]) == [5, 4, 3, 2]
+
+    def test_sequence_explicit_step_and_error(self):
+        f = Frame({"lo": [0.0], "hi": [6.0]})
+        out = f.select(F.sequence("lo", "hi", F.lit(2.0)).alias("s")
+                       ).to_pydict()["s"][0]
+        assert list(out) == [0, 2, 4, 6]
+        with pytest.raises(ValueError, match="step"):
+            f.select(F.sequence("hi", "lo", F.lit(1.0)).alias("s")).collect()
+
+    def test_arrays_zip_pads_to_longest(self):
+        t = _two_arrays([["a", "b", "c"]], [["1", "2"]])
+        out = t.select(F.arrays_zip("x", "y").alias("z")).to_pydict()["z"][0]
+        assert [list(p) for p in out] == [["a", "1"], ["b", "2"],
+                                          ["c", None]]
+
+
+class TestShuffleReverse:
+    def test_shuffle_seeded_is_permutation(self):
+        t = _arr_frame(list("abcdef"))
+        out = t.select(F.shuffle("arr", seed=7).alias("s")).to_pydict()["s"]
+        assert sorted(out[0]) == list("abcdef")
+        again = t.select(F.shuffle("arr", seed=7).alias("s")
+                         ).to_pydict()["s"]
+        assert list(out[0]) == list(again[0])
+
+    def test_reverse_arrays_and_strings(self):
+        t = _arr_frame(["a", "b", "c"])
+        out = t.select(F.reverse("arr").alias("r")).to_pydict()["r"][0]
+        assert list(out) == ["c", "b", "a"]
+        s = Frame({"s": ["abc", None]}).select(
+            F.reverse("s").alias("r")).to_pydict()["r"]
+        assert list(s) == ["cba", None]
+
+
+class TestSqlSurface:
+    def test_set_ops_from_sql(self, session):
+        t = _two_arrays([["b", "a"]], [["a", "z"]])
+        t.create_or_replace_temp_view("tz")
+        u = session.sql("SELECT array_union(x, y) AS u FROM tz"
+                        ).to_pydict()["u"][0]
+        assert list(u) == ["b", "a", "z"]
+
+    def test_sql_one_argument_forms(self, session):
+        # Spark SQL's sort_array(arr) / shuffle(arr) take one argument
+        t = _arr_frame(["c", "a", "b"])
+        t.create_or_replace_temp_view("t1")
+        s = session.sql("SELECT sort_array(arr) AS s FROM t1"
+                        ).to_pydict()["s"][0]
+        assert list(s) == ["a", "b", "c"]
+        sh = session.sql("SELECT shuffle(arr) AS s FROM t1"
+                         ).to_pydict()["s"][0]
+        assert sorted(sh) == ["a", "b", "c"]
