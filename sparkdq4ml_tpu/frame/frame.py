@@ -309,7 +309,7 @@ class Frame:
     withColumnRenamed = with_column_renamed
 
     def select(self, *exprs: Union[str, Expr]) -> "Frame":
-        from ..ops.expressions import Alias, Explode
+        from ..ops.expressions import Alias, Explode, JsonTuple
 
         # flatten list/tuple items so `select(df.colRegex("`x.*`"))` works
         flat = []
@@ -338,6 +338,11 @@ class Frame:
             # identity, not `in`: Expr.__eq__ builds a BinOp (truthy), so
             # membership tests over Expr lists must never use ==
             if any(e is g for g in gens):
+                continue
+            if isinstance(e, JsonTuple):
+                # multi-column generator: no row multiplication, so it
+                # expands inline (c0…cN) unlike the explode family
+                data.update(e.columns(self))
                 continue
             data[e.name] = e.eval(self)
         if not gens:

@@ -39,8 +39,20 @@ from .ops.expressions import (current_date, date_add, date_format, date_sub,
                               datediff, dayofmonth, dayofweek, dayofyear,
                               from_unixtime, month, quarter, to_date,
                               unix_timestamp, year)
+from .ops.expressions import (add_months, current_timestamp, date_trunc,
+                              hour, last_day, minute, months_between,
+                              next_day, second, to_timestamp, trunc,
+                              weekofyear)
 from .ops.expressions import sql_abs as abs  # noqa: A001 - Spark name
 from .ops.expressions import sql_round as round  # noqa: A001 - Spark name
+from .ops.expressions import (Lambda, aggregate, exists, filter,  # noqa: A004
+                              transform)
+from .ops.expressions import (ascii, bin, bit_length, bitwiseNOT, bround,
+                              conv, crc32, decode, encode, factorial,
+                              get_json_object, hash, hex, ifnull,
+                              json_tuple, nullif, nvl2, octet_length,
+                              shiftleft, shiftright, shiftrightunsigned,
+                              soundex, substring_index, unhex, xxhash64)
 
 __all__ = ["col", "lit", "call_udf", "callUDF", "count", "sum", "avg",
            "mean", "min", "max", "stddev", "variance",
@@ -72,7 +84,17 @@ __all__ = ["col", "lit", "call_udf", "callUDF", "count", "sum", "avg",
            "array_position", "array_remove", "array_union",
            "array_intersect", "array_except", "arrays_overlap",
            "array_min", "array_max", "array_repeat", "sequence",
-           "arrays_zip", "shuffle"]
+           "arrays_zip", "shuffle",
+           "hour", "minute", "second", "weekofyear", "last_day",
+           "add_months", "months_between", "next_day", "trunc",
+           "date_trunc", "to_timestamp", "current_timestamp",
+           "bround", "factorial", "hex", "unhex", "bin", "conv",
+           "ascii", "crc32", "hash", "xxhash64", "shiftleft",
+           "shiftright", "shiftrightunsigned", "bitwiseNOT", "nullif",
+           "nvl2", "ifnull", "substring_index", "soundex", "encode",
+           "decode", "bit_length", "octet_length", "get_json_object",
+           "json_tuple",
+           "transform", "filter", "exists", "aggregate", "Lambda"]
 
 
 def broadcast(df):

@@ -1937,12 +1937,24 @@ def _parse_datetime_cell(x):
         return None
 
 
+# Numeric date/time values carry no type tag in this engine (dates are
+# epoch DAYS — to_date's output; timestamps epoch SECONDS —
+# to_timestamp/unix_timestamp's output), so mixed compositions like
+# hour(to_timestamp(s)) disambiguate by magnitude: |v| ≥ 1e8 is seconds
+# (1e8 s = 1973-03-03; 1e8 days is year 275760, far past Spark's own
+# 9999-12-31 ceiling). The one ambiguous window — timestamps inside
+# 1966-10-31..1973-03-03 — would need day-resolution fallbacks; Spark's
+# typed DATE/TIMESTAMP split has no such window, which is the cost of a
+# float-only column model and is documented here deliberately.
+_SECONDS_CUTOFF = 1e8
+
+
 def _days_of(v):
     """Epoch-day view of a date operand with Spark's implicit cast: string
     (object) columns accept full dates, timestamp-shaped strings (the
     time part is dropped for day math), and partial 'yyyy[-MM]' forms —
-    unparseable/null → NaN; numeric columns are epoch days already
-    (``to_date`` output)."""
+    unparseable/null → NaN; numeric columns are epoch days (``to_date``)
+    or epoch seconds (``to_timestamp``), split at ``_SECONDS_CUTOFF``."""
     if _is_object(v):
         import datetime as _dt
 
@@ -1952,7 +1964,9 @@ def _days_of(v):
             t = _parse_datetime_cell(x)
             out[i] = np.nan if t is None else (t.date() - epoch).days
         return jnp.asarray(out, float_dtype())
-    return jnp.asarray(v, float_dtype())
+    arr = jnp.asarray(v, float_dtype())
+    return jnp.where(jnp.abs(arr) >= _SECONDS_CUTOFF,
+                     jnp.floor(arr / 86400.0), arr)
 
 
 def _fn_datediff(end, start):
@@ -2056,3 +2070,1231 @@ def current_date() -> Expr:
     import datetime as _dt
 
     return Lit(float((_dt.date.today() - _dt.date(1970, 1, 1)).days))
+
+
+# -- timestamp-resolution family ------------------------------------------
+# Date values are epoch DAYS (to_date's output); timestamps are epoch
+# SECONDS and require jax_enable_x64 (seconds exceed float32's exact
+# range — the same contract unix_timestamp enforces). A numeric input to
+# the time-of-day extractors is epoch days, i.e. midnight, so
+# hour/minute/second are 0 — exactly Spark's hour(CAST(x AS DATE)).
+
+
+def _time_field(which: str):
+    def f(v):
+        if _is_object(v):
+            sel = {"hour": lambda t: t.hour, "minute": lambda t: t.minute,
+                   "second": lambda t: t.second}[which]
+            out = [None if (t := _parse_datetime_cell(x)) is None else sel(t)
+                   for x in np.asarray(v, object)]
+            return jnp.asarray(np.asarray(
+                [np.nan if x is None else float(x) for x in out], np.float64),
+                float_dtype())
+        # numeric: epoch seconds carry time-of-day; epoch days (below the
+        # magnitude cutoff) are midnight ⇒ 0, Spark's hour(CAST AS DATE)
+        host = np.asarray(v, np.float64)
+        if np.any(np.abs(host[~np.isnan(host)]) >= _SECONDS_CUTOFF):
+            # time-of-day of an epoch-second value needs sub-second
+            # precision the f32 column cannot carry — same contract as
+            # to_timestamp/unix_timestamp, raised instead of silently
+            # returning minutes/seconds that are off by the f32 quantum
+            _require_x64(f"{which}() on epoch-second (timestamp) values")
+        arr = jnp.asarray(v, jnp.float64)
+        sod = jnp.where(jnp.abs(arr) >= _SECONDS_CUTOFF,
+                        jnp.mod(arr, 86400.0), 0.0)
+        val = {"hour": sod // 3600.0,
+               "minute": jnp.mod(sod, 3600.0) // 60.0,
+               "second": jnp.mod(sod, 60.0) // 1.0}[which]
+        return jnp.where(jnp.isnan(arr), jnp.nan,
+                         val).astype(float_dtype())
+    return f
+
+
+def _fn_weekofyear(v):
+    """ISO-8601 week number (Spark's WEEKOFYEAR). Host calendar math —
+    the ISO rule (week containing the year's first Thursday) is not
+    worth a branchless device expression for frame-sized date columns."""
+    import datetime as _dt
+
+    days = np.asarray(_days_of(v), np.float64)
+    epoch = _dt.date(1970, 1, 1)
+    out = [np.nan if np.isnan(d)
+           else float((epoch + _dt.timedelta(days=int(d))).isocalendar()[1])
+           for d in days]
+    return jnp.asarray(np.asarray(out, np.float64), float_dtype())
+
+
+def _fn_last_day(v):
+    """``last_day(date)``: last day of the date's month, device civil
+    math — the 1st of the next month minus one day."""
+    days = _days_of(v)
+    null = jnp.isnan(days)
+    z = jnp.where(null, 0, days).astype(jnp.int32)
+    y, m, _ = _civil_from_days(z)
+    ny = jnp.where(m == 12, y + 1, y)
+    nm = jnp.where(m == 12, 1, m + 1)
+    out = _days_from_civil(ny, nm, jnp.ones_like(ny)) - 1
+    return jnp.where(null, jnp.nan, out.astype(days.dtype))
+
+
+def _days_in_month(y, m):
+    ny = jnp.where(m == 12, y + 1, y)
+    nm = jnp.where(m == 12, 1, m + 1)
+    one = jnp.ones_like(y)
+    return (_days_from_civil(ny, nm, one) - _days_from_civil(y, m, one))
+
+
+def _fn_add_months(v, n):
+    """``add_months(date, n)``: calendar month shift with Spark's
+    day-of-month clamp (Jan 31 + 1 month = Feb 28/29)."""
+    k = _scalar_int(n)
+    days = _days_of(v)
+    null = jnp.isnan(days)
+    z = jnp.where(null, 0, days).astype(jnp.int32)
+    y, m, d = _civil_from_days(z)
+    total = y * 12 + (m - 1) + k
+    ny = total // 12
+    nm = total % 12 + 1
+    nd = jnp.minimum(d, _days_in_month(ny, nm))
+    out = _days_from_civil(ny, nm, nd)
+    return jnp.where(null, jnp.nan, out.astype(days.dtype))
+
+
+def _fn_months_between(end, start, *round_off):
+    """Spark ``months_between``: whole calendar months when both dates
+    fall on the same day-of-month or both on month-ends; otherwise the
+    fractional remainder uses Spark's fixed /31 divisor. Day resolution
+    (this engine's date values carry no time-of-day); roundOff (default
+    true) rounds to 8 places like Spark."""
+    ro = bool(_scalar_value(round_off[0])) if round_off else True
+    d1 = _days_of(end)
+    d2 = _days_of(start)
+    null = jnp.isnan(d1) | jnp.isnan(d2)
+    z1 = jnp.where(null, 0, d1).astype(jnp.int32)
+    z2 = jnp.where(null, 0, d2).astype(jnp.int32)
+    y1, m1, dd1 = _civil_from_days(z1)
+    y2, m2, dd2 = _civil_from_days(z2)
+    months = ((y1 - y2) * 12 + (m1 - m2)).astype(jnp.float64)
+    both_last = (dd1 == _days_in_month(y1, m1)) & \
+                (dd2 == _days_in_month(y2, m2))
+    whole = (dd1 == dd2) | both_last
+    frac = (dd1 - dd2).astype(jnp.float64) / 31.0
+    out = jnp.where(whole, months, months + frac)
+    if ro:
+        out = jnp.round(out * 1e8) / 1e8
+    return jnp.where(null, jnp.nan, out.astype(float_dtype()))
+
+
+_DOW_NAMES = {"su": 1, "sun": 1, "sunday": 1, "mo": 2, "mon": 2,
+              "monday": 2, "tu": 3, "tue": 3, "tuesday": 3, "we": 4,
+              "wed": 4, "wednesday": 4, "th": 5, "thu": 5, "thursday": 5,
+              "fr": 6, "fri": 6, "friday": 6, "sa": 7, "sat": 7,
+              "saturday": 7}
+
+
+def _fn_next_day(v, day_name):
+    """``next_day(date, 'Mon')``: the first named weekday STRICTLY after
+    the date; an unrecognized name yields null (Spark 2.4's behavior,
+    not an error)."""
+    name = str(_scalar_value(day_name) or "").strip().lower()
+    target = _DOW_NAMES.get(name)
+    days = _days_of(v)
+    null = jnp.isnan(days)
+    if target is None:
+        return jnp.full_like(days, jnp.nan)
+    z = jnp.where(null, 0, days).astype(jnp.int32)
+    dow = (z + 4) % 7 + 1              # 1 = Sunday (epoch day 0: Thursday)
+    delta = (target - dow) % 7
+    delta = jnp.where(delta == 0, 7, delta)
+    return jnp.where(null, jnp.nan, (z + delta).astype(days.dtype))
+
+
+def _fn_trunc(v, fmt):
+    """``trunc(date, fmt)``: year/month truncation to epoch days; an
+    unsupported format yields null (Spark)."""
+    f = str(_scalar_str(fmt)).lower()
+    days = _days_of(v)
+    null = jnp.isnan(days)
+    z = jnp.where(null, 0, days).astype(jnp.int32)
+    y, m, _ = _civil_from_days(z)
+    one = jnp.ones_like(y)
+    if f in ("year", "yyyy", "yy"):
+        out = _days_from_civil(y, one, one)
+    elif f in ("month", "mon", "mm"):
+        out = _days_from_civil(y, m, one)
+    else:
+        return jnp.full_like(days, jnp.nan)
+    return jnp.where(null, jnp.nan, out.astype(days.dtype))
+
+
+def _require_x64(what: str):
+    import jax
+
+    if not jax.config.jax_enable_x64:
+        raise ValueError(
+            f"{what} requires jax_enable_x64: epoch seconds exceed "
+            "float32's exact-integer range (use to_date/trunc for "
+            "day-resolution work)")
+
+
+def _seconds_of(v):
+    """Epoch-seconds view: strings via the lenient timestamp cast;
+    numeric epoch seconds pass through, epoch days (below the magnitude
+    cutoff) are midnight of that day."""
+    if _is_object(v):
+        import datetime as _dt
+
+        out = np.empty(len(v), np.float64)
+        epoch = _dt.datetime(1970, 1, 1)
+        for i, x in enumerate(np.asarray(v, object)):
+            t = _parse_datetime_cell(x)
+            out[i] = np.nan if t is None else (t - epoch).total_seconds()
+        return out
+    arr = np.asarray(v, np.float64)
+    return np.where(np.abs(arr) >= _SECONDS_CUTOFF, arr, arr * 86400.0)
+
+
+def _fn_to_timestamp(s, *fmt):
+    """``to_timestamp(col[, fmt])`` → epoch seconds (float64, x64
+    required). Without a format the lenient cast accepts partial
+    dates/timestamps like Spark; with one, strict strptime like
+    unix_timestamp."""
+    _require_x64("to_timestamp")
+    if fmt:
+        return _parse_dates(s, _scalar_str(fmt[0]), unit_seconds=True)
+    return jnp.asarray(_seconds_of(s), jnp.float64)
+
+
+def _fn_date_trunc(fmt, v):
+    """``date_trunc(fmt, col)`` → truncated epoch seconds (x64). Spark's
+    argument order (format first) — the reverse of ``trunc``."""
+    _require_x64("date_trunc")
+    f = str(_scalar_str(fmt)).lower()
+    secs = jnp.asarray(_seconds_of(v), jnp.float64)
+    null = jnp.isnan(secs)
+    if f in ("second", "minute", "hour", "day", "week"):
+        width = {"second": 1.0, "minute": 60.0, "hour": 3600.0,
+                 "day": 86400.0, "week": 7 * 86400.0}[f]
+        # epoch day 0 is a Thursday; ISO weeks start Monday (epoch day 4)
+        shift = 4 * 86400.0 if f == "week" else 0.0
+        out = jnp.floor((secs - shift) / width) * width + shift
+    elif f in ("year", "yyyy", "yy", "month", "mon", "mm", "quarter"):
+        z = jnp.where(null, 0, jnp.floor(secs / 86400.0)).astype(jnp.int32)
+        y, m, _ = _civil_from_days(z)
+        one = jnp.ones_like(y)
+        tm = one if f in ("year", "yyyy", "yy") else (
+            ((m - 1) // 3) * 3 + 1 if f == "quarter" else m)
+        out = _days_from_civil(y, tm, one).astype(jnp.float64) * 86400.0
+    else:
+        return jnp.full_like(secs, jnp.nan)
+    return jnp.where(null, jnp.nan, out)
+
+
+_BUILTIN_FNS.update({
+    "hour": _time_field("hour"),
+    "minute": _time_field("minute"),
+    "second": _time_field("second"),
+    "weekofyear": _fn_weekofyear,
+    "last_day": _fn_last_day,
+    "add_months": _fn_add_months,
+    "months_between": _fn_months_between,
+    "next_day": _fn_next_day,
+    "trunc": _fn_trunc,
+    "to_timestamp": _fn_to_timestamp,
+    "date_trunc": _fn_date_trunc,
+})
+
+
+hour = _make_fn("hour")
+minute = _make_fn("minute")
+second = _make_fn("second")
+weekofyear = _make_fn("weekofyear")
+last_day = _make_fn("last_day")
+
+
+def add_months(col_, n: int) -> Func:
+    return Func("add_months", [_coerce(col_), Lit(int(n))])
+
+
+def months_between(end, start, roundOff: bool = True) -> Func:  # noqa: N803
+    return Func("months_between",
+                [_coerce(end), _coerce(start), Lit(bool(roundOff))])
+
+
+def next_day(col_, day_of_week: str) -> Func:
+    return Func("next_day", [_coerce(col_), Lit(str(day_of_week))])
+
+
+def trunc(col_, fmt: str) -> Func:
+    return Func("trunc", [_coerce(col_), Lit(str(fmt))])
+
+
+def date_trunc(fmt: str, col_) -> Func:
+    return Func("date_trunc", [Lit(str(fmt)), _coerce(col_)])
+
+
+def to_timestamp(col_, fmt: str = None) -> Func:
+    args = [_coerce(col_)] + ([Lit(fmt)] if fmt is not None else [])
+    return Func("to_timestamp", args)
+
+
+def current_timestamp() -> Expr:
+    """Now as epoch seconds (host clock, evaluated at call time). Exact
+    under jax_enable_x64; under float32 the value quantizes to ~±64 s —
+    use x64 for timestamp work (the same caveat as unix_timestamp)."""
+    import time as _time
+
+    return Lit(float(int(_time.time())))
+
+
+# -- math / bitwise batch --------------------------------------------------
+
+
+def _fn_bround(v, *digits):
+    """Spark ``bround``: HALF_EVEN (banker's) rounding — jnp.round's
+    native mode, unlike ``round``'s HALF_UP."""
+    d = _scalar_int(digits[0]) if digits else 0
+    v = jnp.asarray(v, float_dtype())
+    scale = 10.0 ** d
+    return jnp.round(v * scale) / scale
+
+
+def _exact_int64_col(vals):
+    """Column of 64-bit ints (Nones allowed). With x64 off, jnp would
+    silently wrap these to int32 (the conftest turns x64 on, so the wrap
+    would only bite library users) — exact host objects instead."""
+    import jax
+
+    if any(x is None for x in vals):
+        return np.asarray(vals, object)
+    if jax.config.jax_enable_x64:
+        return jnp.asarray(np.asarray(vals, np.int64))
+    return np.asarray(vals, object)
+
+
+def _fn_factorial(v):
+    """Spark ``factorial``: defined on 0..20 (long range), anything else
+    → null. Host exact integers — 20! exceeds float64's exact range, so
+    device float math would corrupt the top values."""
+    import math
+
+    arr = np.asarray(v, np.float64)
+    out = [None if (np.isnan(x) or x < 0 or x > 20 or x != int(x))
+           else math.factorial(int(x)) for x in arr]
+    return _exact_int64_col(out)
+
+
+def _int64_of(v):
+    """Two's-complement int64 view of a numeric column (bit ops / radix
+    formatting); NaN rows tracked separately by the caller."""
+    arr = np.asarray(v, np.float64)
+    mask = np.isnan(arr)
+    return np.where(mask, 0, arr).astype(np.int64), mask
+
+
+def _fn_hex(v):
+    """Spark ``hex``: numbers → uppercase hex of the two's-complement
+    long; strings → hex of the UTF-8 bytes."""
+    a = np.asarray(v, object) if _is_object(v) else None
+    if a is not None:
+        return _str_map(lambda x: x.encode().hex().upper(), v)
+    z, mask = _int64_of(v)
+    return np.asarray(
+        [None if m else format(int(x) & _MASK64, "X")
+         for x, m in zip(z, mask)], object)
+
+
+def _fn_unhex(s):
+    """Spark ``unhex``: hex string → BINARY; bytes surface as latin-1
+    text (the ``unbase64`` convention); malformed input → null."""
+    def u(x):
+        try:
+            return bytes.fromhex(x).decode("latin-1")
+        except ValueError:
+            return None
+    return _str_map(u, s)
+
+
+def _fn_bin(v):
+    """Spark ``bin``: binary text of the two's-complement long
+    (Java ``Long.toBinaryString``)."""
+    z, mask = _int64_of(v)
+    return np.asarray(
+        [None if m else format(int(x) & _MASK64, "b")
+         for x, m in zip(z, mask)], object)
+
+
+def _fn_conv(s, from_base, to_base):
+    """Spark ``conv(num, fromBase, toBase)``: radix conversion over
+    string digits, uppercase output, malformed input → null. A negative
+    toBase renders signed output; otherwise the value is treated as an
+    unsigned 64-bit quantity (Spark/Hive semantics)."""
+    fb = _scalar_int(from_base)
+    tb = _scalar_int(to_base)
+    digits = "0123456789ABCDEFGHIJKLMNOPQRSTUVWXYZ"
+    if not (2 <= fb <= 36 and 2 <= builtins.abs(tb) <= 36):
+        return np.asarray([None] * len(np.asarray(s, object)), object)
+
+    def one(x):
+        t = str(x).strip().upper()
+        neg = t.startswith("-")
+        if neg:
+            t = t[1:]
+        try:
+            val = int(t, fb) if t else None
+        except ValueError:
+            # Hive keeps the longest valid prefix
+            for j in range(len(t), 0, -1):
+                try:
+                    val = int(t[:j], fb)
+                    break
+                except ValueError:
+                    continue
+            else:
+                val = None
+        if val is None:
+            return None
+        if neg:
+            val = -val
+        if tb > 0:
+            val &= 0xFFFFFFFFFFFFFFFF          # unsigned 64-bit view
+            base, sign = tb, ""
+        else:
+            if val < -(1 << 63) or val >= (1 << 63):
+                val &= 0xFFFFFFFFFFFFFFFF
+                val -= (1 << 64) if val >= (1 << 63) else 0
+            base, sign = -tb, ("-" if val < 0 else "")
+            val = builtins.abs(val)
+        if val == 0:
+            return "0"
+        out = []
+        while val:
+            val, r = divmod(val, base)
+            out.append(digits[r])
+        return sign + "".join(reversed(out))
+
+    return _str_map(one, s)
+
+
+def _nullable_int32_col(vals):
+    """Column of small ints with Nones: object array when any null,
+    else a device int32 column (the 32-bit sibling of _exact_int64_col)."""
+    if any(x is None for x in vals):
+        return np.asarray(vals, object)
+    return jnp.asarray(np.asarray(vals, np.int32))
+
+
+def _fn_ascii(s):
+    """Spark ``ascii``: code point of the first character; '' → 0."""
+    return _nullable_int32_col(
+        [None if x is None else (ord(str(x)[0]) if str(x) else 0)
+         for x in np.asarray(s, object)])
+
+
+def _fn_crc32(s):
+    import zlib
+
+    out = [None if x is None else zlib.crc32(str(x).encode())
+           for x in np.asarray(s, object)]
+    return _exact_int64_col(out)  # crc32 > 2^31 must not wrap int32
+
+
+def _shift_fn(which: str):
+    """shiftleft / shiftright (arithmetic) / shiftrightunsigned (logical)
+    over the int32 view (Spark's int overloads; its long overloads need
+    explicit casts there too)."""
+
+    def f(v, n):
+        k = _scalar_int(n) % 32
+        arr = np.asarray(v, np.float64)
+        mask = np.isnan(arr)
+        z = np.where(mask, 0, arr).astype(np.int32)
+        if which == "left":
+            r = np.left_shift(z, k)
+        elif which == "right":
+            r = np.right_shift(z, k)
+        else:
+            r = np.right_shift(z.view(np.uint32), k).view(np.int32)
+        out = r.astype(np.float64)
+        return jnp.asarray(np.where(mask, np.nan, out), float_dtype()) \
+            if mask.any() else jnp.asarray(r)
+
+    return f
+
+
+def _fn_bitwise_not(v):
+    arr = np.asarray(v, np.float64)
+    mask = np.isnan(arr)
+    r = ~np.where(mask, 0, arr).astype(np.int32)
+    if mask.any():
+        return jnp.asarray(np.where(mask, np.nan, r.astype(np.float64)),
+                           float_dtype())
+    return jnp.asarray(r)
+
+
+def _fn_nullif(a, b):
+    """SQL ``nullif(a, b)``: null where equal, else a."""
+    if _is_object(a) or _is_object(b):
+        va = np.asarray(a, object)
+        vb = np.asarray(b, object)
+        return np.asarray(
+            [None if (x is not None and y is not None and x == y) else x
+             for x, y in zip(va, vb)], object)
+    va = jnp.asarray(a, float_dtype())
+    vb = jnp.asarray(b, float_dtype())
+    return jnp.where(va == vb, jnp.nan, va)
+
+
+def _fn_nvl2(a, b, c):
+    """Spark ``nvl2(a, b, c)``: b where a is not null, else c."""
+    nulls = _null_mask(a)
+    if _is_object(b) or _is_object(c):
+        vb = np.asarray(b, object)
+        vc = np.asarray(c, object)
+        m = np.asarray(nulls)
+        return np.asarray([y if keep else x
+                           for x, y, keep in zip(vc, vb, ~m)], object)
+    return jnp.where(nulls, jnp.asarray(c, float_dtype()),
+                     jnp.asarray(b, float_dtype()))
+
+
+def _fn_substring_index(s, delim, count):
+    """Spark ``substring_index(str, delim, count)``: everything before
+    the count-th delimiter (from the left for positive counts, from the
+    right for negative); count 0 → ''."""
+    d = _scalar_str(delim)
+    k = _scalar_int(count)
+
+    def one(x):
+        if k == 0 or not d:
+            return ""
+        parts = x.split(d)
+        if k > 0:
+            return d.join(parts[:k])
+        return d.join(parts[builtins.max(len(parts) + k, 0):])
+
+    return _str_map(one, s)
+
+
+_SOUNDEX_CODES = {**{c: "1" for c in "BFPV"}, **{c: "2" for c in "CGJKQSXZ"},
+                  **{c: "3" for c in "DT"}, "L": "4",
+                  **{c: "5" for c in "MN"}, "R": "6"}
+
+
+def _fn_soundex(s):
+    """American Soundex (Spark/Hive variant): 4 chars, H/W transparent
+    between same-coded consonants, non-alpha input passed through."""
+    def one(x):
+        if not x or not x[0].isalpha():
+            return x
+        u = x.upper()
+        code = [u[0]]
+        prev = _SOUNDEX_CODES.get(u[0], "")
+        for ch in u[1:]:
+            c = _SOUNDEX_CODES.get(ch)
+            if c is None:
+                # vowels reset the run; H/W do not
+                if ch not in "HW":
+                    prev = ""
+                continue
+            if c != prev:
+                code.append(c)
+                if len(code) == 4:
+                    break
+            prev = c
+        return "".join(code).ljust(4, "0")
+
+    return _str_map(one, s)
+
+
+def _fn_encode(s, charset):
+    cs = _scalar_str(charset)
+    return _str_map(lambda x: x.encode(cs).decode("latin-1"), s)
+
+
+def _fn_decode(s, charset):
+    cs = _scalar_str(charset)
+    return _str_map(lambda x: x.encode("latin-1").decode(cs), s)
+
+
+def _fn_octet_length(s):
+    return _nullable_int32_col(
+        [None if x is None else len(str(x).encode())
+         for x in np.asarray(s, object)])
+
+
+def _fn_bit_length(s):
+    return _nullable_int32_col(
+        [None if x is None else len(str(x).encode()) * 8
+         for x in np.asarray(s, object)])
+
+
+# -- Spark hash functions --------------------------------------------------
+# Spark's Murmur3_x86_32 (seed 42) and XxHash64 (seed 42), bit-exact to
+# the JVM implementations for the types this engine holds: numeric
+# columns hash as DOUBLE (doubleToLongBits → hashLong), strings as their
+# UTF-8 bytes. Null children are skipped (the running hash passes
+# through), like Spark's HashExpression.
+
+_M3_C1 = 0xCC9E2D51
+_M3_C2 = 0x1B873593
+_MASK32 = 0xFFFFFFFF
+_MASK64 = 0xFFFFFFFFFFFFFFFF
+
+
+def _rotl32(x, r):
+    return ((x << r) | (x >> (32 - r))) & _MASK32
+
+
+def _m3_mix_k1(k1):
+    k1 = (k1 * _M3_C1) & _MASK32
+    k1 = _rotl32(k1, 15)
+    return (k1 * _M3_C2) & _MASK32
+
+
+def _m3_mix_h1(h1, k1):
+    h1 ^= k1
+    h1 = _rotl32(h1, 13)
+    return (h1 * 5 + 0xE6546B64) & _MASK32
+
+
+def _m3_fmix(h1, length):
+    h1 ^= length
+    h1 ^= h1 >> 16
+    h1 = (h1 * 0x85EBCA6B) & _MASK32
+    h1 ^= h1 >> 13
+    h1 = (h1 * 0xC2B2AE35) & _MASK32
+    return h1 ^ (h1 >> 16)
+
+
+def _m3_hash_long(value, seed):
+    low = value & _MASK32
+    high = (value >> 32) & _MASK32
+    h1 = _m3_mix_h1(seed, _m3_mix_k1(low))
+    h1 = _m3_mix_h1(h1, _m3_mix_k1(high))
+    return _m3_fmix(h1, 8)
+
+
+def _m3_hash_bytes(data: bytes, seed: int) -> int:
+    """Spark's hashUnsafeBytes: 4-byte little-endian blocks, then each
+    remaining byte runs a FULL mix round on its SIGNED value — not the
+    standard murmur3 tail, so only aligned inputs match public vectors."""
+    h1 = seed
+    n_aligned = len(data) - len(data) % 4
+    for i in range(0, n_aligned, 4):
+        block = int.from_bytes(data[i:i + 4], "little")
+        h1 = _m3_mix_h1(h1, _m3_mix_k1(block))
+    for i in range(n_aligned, len(data)):
+        b = data[i]
+        signed = b - 256 if b >= 128 else b
+        h1 = _m3_mix_h1(h1, _m3_mix_k1(signed & _MASK32))
+    return _m3_fmix(h1, len(data))
+
+
+_XX_P1 = 0x9E3779B185EBCA87
+_XX_P2 = 0xC2B2AE3D27D4EB4F
+_XX_P3 = 0x165667B19E3779F9
+_XX_P4 = 0x85EBCA77C2B2AE63
+_XX_P5 = 0x27D4EB2F165667C5
+
+
+def _rotl64(x, r):
+    return ((x << r) | (x >> (64 - r))) & _MASK64
+
+
+def _xx_fmix(h):
+    h ^= h >> 33
+    h = (h * _XX_P2) & _MASK64
+    h ^= h >> 29
+    h = (h * _XX_P3) & _MASK64
+    return h ^ (h >> 32)
+
+
+def _xx_round(acc, inp):
+    acc = (acc + inp * _XX_P2) & _MASK64
+    return (_rotl64(acc, 31) * _XX_P1) & _MASK64
+
+
+def _xx_hash_long(value, seed):
+    h = (seed + _XX_P5 + 8) & _MASK64
+    h ^= _xx_round(0, value & _MASK64)
+    h = (_rotl64(h, 27) * _XX_P1 + _XX_P4) & _MASK64
+    return _xx_fmix(h)
+
+
+def _xx_hash_bytes(data: bytes, seed: int) -> int:
+    n = len(data)
+    if n >= 32:
+        v1 = (seed + _XX_P1 + _XX_P2) & _MASK64
+        v2 = (seed + _XX_P2) & _MASK64
+        v3 = seed
+        v4 = (seed - _XX_P1) & _MASK64
+        i = 0
+        while i <= n - 32:
+            v1 = _xx_round(v1, int.from_bytes(data[i:i + 8], "little"))
+            v2 = _xx_round(v2, int.from_bytes(data[i + 8:i + 16], "little"))
+            v3 = _xx_round(v3, int.from_bytes(data[i + 16:i + 24], "little"))
+            v4 = _xx_round(v4, int.from_bytes(data[i + 24:i + 32], "little"))
+            i += 32
+        h = (_rotl64(v1, 1) + _rotl64(v2, 7) + _rotl64(v3, 12)
+             + _rotl64(v4, 18)) & _MASK64
+        for v in (v1, v2, v3, v4):
+            h = ((h ^ _xx_round(0, v)) * _XX_P1 + _XX_P4) & _MASK64
+    else:
+        h = (seed + _XX_P5) & _MASK64
+        i = 0
+    h = (h + n) & _MASK64
+    while i <= n - 8:
+        h ^= _xx_round(0, int.from_bytes(data[i:i + 8], "little"))
+        h = (_rotl64(h, 27) * _XX_P1 + _XX_P4) & _MASK64
+        i += 8
+    if i <= n - 4:
+        h ^= (int.from_bytes(data[i:i + 4], "little") * _XX_P1) & _MASK64
+        h = (_rotl64(h, 23) * _XX_P2 + _XX_P3) & _MASK64
+        i += 4
+    while i < n:
+        h ^= (data[i] * _XX_P5) & _MASK64
+        h = (_rotl64(h, 11) * _XX_P1) & _MASK64
+        i += 1
+    return _xx_fmix(h)
+
+
+def _spark_hash(cols, seed, hash_long, hash_bytes, signed_bits):
+    """The HashExpression fold: the running hash seeds each child's hash;
+    null children pass through."""
+    import struct
+
+    host = [np.asarray(c, object) if _is_object(c) else np.asarray(c)
+            for c in cols]
+    n = len(host[0]) if host else 0
+    out = []
+    for i in range(n):
+        h = seed
+        for col_vals in host:
+            x = col_vals[i]
+            if x is None or (isinstance(x, (float, np.floating))
+                             and np.isnan(x)):
+                continue
+            if isinstance(x, str):
+                h = hash_bytes(x.encode(), h)
+            else:
+                bits = struct.unpack("<q", struct.pack("<d", float(x)))[0]
+                h = hash_long(bits, h)
+        # two's-complement back to signed
+        if h >= (1 << (signed_bits - 1)):
+            h -= (1 << signed_bits)
+        out.append(h)
+    if signed_bits == 32:
+        return jnp.asarray(np.asarray(out, np.int32))
+    return _exact_int64_col(out)  # 64-bit hashes must not wrap under x64-off
+
+
+def _fn_hash(*cols):
+    return _spark_hash(cols, 42, _m3_hash_long, _m3_hash_bytes, 32)
+
+
+def _fn_xxhash64(*cols):
+    return _spark_hash(cols, 42, _xx_hash_long, _xx_hash_bytes, 64)
+
+
+# -- JSON ------------------------------------------------------------------
+
+
+_JSON_SEG_RE = None
+
+
+def _json_traverse(doc, path: str):
+    """Walk ``$.key[idx].key…``; returns a sentinel-wrapped value, or None
+    for missing values AND malformed paths — every character of the path
+    must belong to a valid segment (Spark yields null on bad paths, so a
+    skipped-garbage walk like finditer would invent answers)."""
+    import re as _re
+
+    global _JSON_SEG_RE
+    if _JSON_SEG_RE is None:
+        _JSON_SEG_RE = _re.compile(
+            r"\.([A-Za-z_][A-Za-z0-9_]*)|\[(\d+)\]")
+    if not path.startswith("$"):
+        return None
+    cur = doc
+    pos = 1
+    while pos < len(path):
+        m = _JSON_SEG_RE.match(path, pos)
+        if m is None:
+            return None                      # malformed residue
+        pos = m.end()
+        key, idx = m.group(1), m.group(2)
+        if key is not None:
+            if not isinstance(cur, dict) or key not in cur:
+                return None
+            cur = cur[key]
+        else:
+            j = int(idx)
+            if not isinstance(cur, list) or j >= len(cur):
+                return None
+            cur = cur[j]
+    return (cur,)
+
+
+def _json_render(v):
+    """Spark's get_json_object rendering: strings bare, scalars via
+    their JSON lexeme, containers as compact JSON text."""
+    import json as _json
+
+    if v is None:
+        return None
+    if isinstance(v, str):
+        return v
+    if v is True or v is False:
+        return "true" if v else "false"
+    if isinstance(v, (dict, list)):
+        return _json.dumps(v, separators=(",", ":"))
+    return repr(v) if not isinstance(v, float) else _json.dumps(v)
+
+
+def _fn_get_json_object(s, path):
+    import json as _json
+
+    p = _scalar_str(path)
+
+    def one(x):
+        try:
+            doc = _json.loads(x)
+        except (ValueError, TypeError):
+            return None
+        hit = _json_traverse(doc, p)
+        return None if hit is None else _json_render(hit[0])
+
+    return _str_map(one, s)
+
+
+_BUILTIN_FNS.update({
+    "bround": _fn_bround,
+    "factorial": _fn_factorial,
+    "hex": _fn_hex,
+    "unhex": _fn_unhex,
+    "bin": _fn_bin,
+    "conv": _fn_conv,
+    "ascii": _fn_ascii,
+    "crc32": _fn_crc32,
+    "shiftleft": _shift_fn("left"),
+    "shiftright": _shift_fn("right"),
+    "shiftrightunsigned": _shift_fn("unsigned"),
+    "bitwise_not": _fn_bitwise_not,
+    "nullif": _fn_nullif,
+    "nvl2": _fn_nvl2,
+    "ifnull": _fn_coalesce,
+    "substring_index": _fn_substring_index,
+    "soundex": _fn_soundex,
+    "encode": _fn_encode,
+    "decode": _fn_decode,
+    "bit_length": _fn_bit_length,
+    "octet_length": _fn_octet_length,
+    "hash": _fn_hash,
+    "xxhash64": _fn_xxhash64,
+    "get_json_object": _fn_get_json_object,
+})
+
+
+def bround(col_, scale: int = 0) -> Func:
+    return Func("bround", [_coerce(col_), Lit(int(scale))])
+
+
+factorial = _make_fn("factorial")
+hex = _make_fn("hex")  # noqa: A001 - Spark name
+unhex = _make_fn("unhex")
+bin = _make_fn("bin")  # noqa: A001 - Spark name
+ascii = _make_fn("ascii")  # noqa: A001 - Spark name
+crc32 = _make_fn("crc32")
+soundex = _make_fn("soundex")
+bit_length = _make_fn("bit_length")
+octet_length = _make_fn("octet_length")
+hash = _make_fn("hash")  # noqa: A001 - Spark name
+xxhash64 = _make_fn("xxhash64")
+nullif = _make_fn("nullif")
+nvl2 = _make_fn("nvl2")
+ifnull = _make_fn("ifnull")
+
+
+def conv(col_, from_base: int, to_base: int) -> Func:
+    return Func("conv", [_coerce(col_), Lit(int(from_base)),
+                         Lit(int(to_base))])
+
+
+def shiftleft(col_, n: int) -> Func:
+    return Func("shiftleft", [_coerce(col_), Lit(int(n))])
+
+
+def shiftright(col_, n: int) -> Func:
+    return Func("shiftright", [_coerce(col_), Lit(int(n))])
+
+
+def shiftrightunsigned(col_, n: int) -> Func:
+    return Func("shiftrightunsigned", [_coerce(col_), Lit(int(n))])
+
+
+def bitwiseNOT(col_) -> Func:  # noqa: N802 - Spark name
+    return Func("bitwise_not", [_coerce(col_)])
+
+
+def substring_index(col_, delim: str, count: int) -> Func:
+    return Func("substring_index",
+                [_coerce(col_), Lit(str(delim)), Lit(int(count))])
+
+
+def encode(col_, charset: str) -> Func:
+    return Func("encode", [_coerce(col_), Lit(str(charset))])
+
+
+def decode(col_, charset: str) -> Func:
+    return Func("decode", [_coerce(col_), Lit(str(charset))])
+
+
+def get_json_object(col_, path: str) -> Func:
+    return Func("get_json_object", [_coerce(col_), Lit(str(path))])
+
+
+class JsonTuple(Expr):
+    """``json_tuple(col, 'f1', 'f2', …)`` — a multi-COLUMN generator
+    (Spark's only non-row-multiplying generator): one output column per
+    requested top-level field, default names c0…cN. ``Frame.select``
+    expands it; evaluating it as a scalar column raises, like Explode."""
+
+    def __init__(self, source, fields):
+        self.source = _coerce(source)
+        self.fields = [str(f) for f in fields]
+        if not self.fields:
+            raise ValueError("json_tuple needs at least one field name")
+
+    def eval(self, frame):
+        raise ValueError(
+            "json_tuple() is a generator producing multiple columns — "
+            "use it as a top-level select item")
+
+    def columns(self, frame):
+        """→ [(name, object-array), …] for Frame.select."""
+        import json as _json
+
+        src = np.asarray(self.source.eval(frame), object)
+        cols = {f: np.empty(len(src), object) for f in self.fields}
+        for i, x in enumerate(src):
+            try:
+                doc = _json.loads(x) if x is not None else None
+            except (ValueError, TypeError):
+                doc = None
+            for f in self.fields:
+                v = None
+                if isinstance(doc, dict) and f in doc:
+                    v = _json_render(doc[f])
+                cols[f][i] = v
+        return [(f"c{j}", cols[f]) for j, f in enumerate(self.fields)]
+
+
+def json_tuple(col_, *fields) -> JsonTuple:
+    return JsonTuple(col_, fields)
+
+
+# -- higher-order array functions (Spark 2.4's lambda family) --------------
+#
+# transform/filter/exists evaluate the lambda body ONCE, vectorized, over
+# a scope frame holding every element of every cell flattened into one
+# column (outer columns repeat per element, so `x -> x + other_col`
+# works); results regroup by cell length. aggregate folds over element
+# POSITIONS — one vectorized body eval per position j updating the rows
+# whose cells reach j — so the eval count is max_len, not total
+# elements. Array cells are host objects, so this is host orchestration
+# around device-capable body evals, the same split as the rest of the
+# array family.
+
+
+class Lambda:
+    """``x -> body`` / ``(acc, x) -> body``: parameter names plus a body
+    Expr in which the parameters appear as Col references (the scope
+    frame binds them, shadowing outer columns like Spark)."""
+
+    def __init__(self, params, body: Expr):
+        self.params = [str(p) for p in params]
+        self.body = body
+
+
+_LAM_COUNTER = [0]
+
+
+def _fresh_lambda(fn, n_params):
+    """PySpark-3-style fluent lambda: the Python callable receives Col
+    expressions for freshly named parameters and returns the body."""
+    names = []
+    for _ in range(n_params):
+        names.append(f"_lam_x{_LAM_COUNTER[0]}")
+        _LAM_COUNTER[0] += 1
+    body = fn(*[Col(n) for n in names])
+    if not isinstance(body, Expr):
+        body = Lit(body)
+    return Lambda(names, body)
+
+
+def _host_col(vals):
+    return np.asarray(vals, object) if _is_object(vals) else np.asarray(vals)
+
+
+def _column_from_elems(elems):
+    """Element list (Nones allowed) → engine column: strings stay host
+    objects, everything else becomes a NaN-null float column."""
+    if any(isinstance(v, str) for v in elems):
+        return np.asarray(elems, object)
+    return jnp.asarray(np.asarray(
+        [np.nan if v is None or (isinstance(v, (float, np.floating))
+                                 and np.isnan(v)) else float(v)
+         for v in elems], np.float64), float_dtype())
+
+
+def _referenced_cols(e, out: set):
+    """Col names reachable from an Expr tree — generic attribute walk, so
+    new Expr kinds are covered without registration. Used to repeat only
+    the outer columns a lambda body actually touches."""
+    if isinstance(e, Col):
+        out.add(e.name)
+        return
+    if not isinstance(e, Expr):
+        return
+    for v in vars(e).values():
+        if isinstance(v, Expr):
+            _referenced_cols(v, out)
+        elif isinstance(v, (list, tuple)):
+            for x in v:
+                if isinstance(x, (list, tuple)):
+                    for y in x:
+                        _referenced_cols(y, out)
+                else:
+                    _referenced_cols(x, out)
+
+
+_NULL_ABSORBERS = {"isnull", "isnan", "coalesce", "ifnull", "nvl", "nvl2",
+                   "nullif"}
+
+
+def _null_defined_on(body: Expr, param: str) -> bool:
+    """True iff the body's value on a null ``param`` is itself non-null —
+    conservatively: every reference to the param is wrapped in a
+    null-absorbing function. A bare comparison like ``x > 4`` is
+    null-propagating, so exists() must report unknown for null elements;
+    ``NOT isnull(x)`` is defined (false) on null, so computed values are
+    the truth."""
+    def ok(e) -> bool:
+        if isinstance(e, Col):
+            return e.name != param
+        if isinstance(e, Func) and e.fn_name in _NULL_ABSORBERS:
+            return True
+        if isinstance(e, UnaryOp) and e.op in ("isnull", "isnotnull"):
+            return True
+        if isinstance(e, UdfCall) and e.udf_name.lower() in _NULL_ABSORBERS:
+            return True
+        if not isinstance(e, Expr):
+            return True
+        for v in vars(e).values():
+            kids = v if isinstance(v, (list, tuple)) else [v]
+            for k in kids:
+                inner = k if isinstance(k, (list, tuple)) else [k]
+                for x in inner:
+                    if isinstance(x, Expr) and not ok(x):
+                        return False
+        return True
+
+    return ok(body)
+
+
+def _scope_frame(parent, lens, bindings, needed=None):
+    """Per-element scope: outer columns repeated by cell length, lambda
+    params appended last so they shadow same-named outer columns.
+    ``needed`` limits the repeat to the columns the body references
+    (repeating a wide frame per element for an ``x -> x + 1`` lambda
+    would multiply host copies by the column count for nothing)."""
+    from ..frame.frame import Frame
+
+    reps = np.asarray(lens, np.int64)
+    data = {}
+    for name, vals in parent._data.items():
+        if needed is not None and name not in needed:
+            continue
+        data[name] = np.repeat(_host_col(vals), reps, axis=0)
+    data.update(bindings)
+    return Frame(data)
+
+
+def _row_frame(parent, bindings, needed=None):
+    """Per-row scope (aggregate): outer columns as-is, params appended.
+    ``needed`` matters doubly here — this frame is rebuilt once per
+    element position."""
+    from ..frame.frame import Frame
+
+    data = {name: _host_col(vals) for name, vals in parent._data.items()
+            if needed is None or name in needed}
+    data.update(bindings)
+    return Frame(data)
+
+
+def _elem_of(out_host, k):
+    v = out_host[k]
+    if v is None or (isinstance(v, (float, np.floating)) and np.isnan(v)):
+        return None
+    return v
+
+
+class HigherOrder(Expr):
+    """transform / filter (element predicate) / exists / aggregate."""
+
+    _KINDS = ("transform", "filter", "exists", "aggregate")
+
+    def __init__(self, kind, source, lam: Lambda, init: Expr = None,
+                 finish: Lambda = None):
+        if kind not in self._KINDS:
+            raise ValueError(f"unknown higher-order function {kind!r}")
+        want = 2 if kind == "aggregate" else 1
+        if len(lam.params) != want:
+            raise ValueError(
+                f"{kind}() lambda takes {want} parameter(s), "
+                f"got {len(lam.params)}")
+        self.kind = kind
+        self.source = _coerce(source)
+        self.lam = lam
+        self.init = init
+        self.finish = finish
+
+    def eval(self, frame):
+        cells = _require_array_cells(
+            np.asarray(self.source.eval(frame), object), self.kind)
+        if self.kind == "aggregate":
+            return self._eval_aggregate(frame, cells)
+        lens = [0 if c is None else len(c) for c in cells]
+        flat = [e for c in cells if c is not None for e in c]
+        bindings = {self.lam.params[0]: _column_from_elems(flat)}
+        needed: set = set()
+        _referenced_cols(self.lam.body, needed)
+        try:
+            out = self.lam.body.eval(
+                _scope_frame(frame, lens, bindings, needed=needed))
+        except KeyError:
+            # an Expr kind the attribute walk missed referenced a column
+            # indirectly — fall back to the full (correct, wider) scope
+            out = self.lam.body.eval(_scope_frame(frame, lens, bindings))
+        # exists needs to know whether the predicate is DEFINED on null
+        # (isnull-style bodies return a real boolean for a null element;
+        # comparisons return null, which NaN math renders as False — an
+        # evaluation probe cannot tell the two Falses apart, so the check
+        # is structural: every reference to the param must sit under a
+        # null-absorbing function).
+        null_defined = (self.kind == "exists"
+                        and _null_defined_on(self.lam.body,
+                                             self.lam.params[0]))
+        out_host = _host_col(out)
+        results = []
+        k = 0
+        for c, ln in zip(cells, lens):
+            if c is None:
+                results.append(None)
+                continue
+            start, k = k, k + ln
+            seg = range(start, start + ln)
+            if self.kind == "transform":
+                results.append(np.asarray(
+                    [_elem_of(out_host, j) for j in seg], object))
+            elif self.kind == "filter":
+                results.append(np.asarray(
+                    [c[j - start] for j in seg
+                     if (v := _elem_of(out_host, j)) is not None and bool(v)],
+                    object))
+            else:  # exists — three-valued like SQL ANY
+                vals = [_elem_of(out_host, j) for j in seg]
+                # a null INPUT element makes the predicate unknown —
+                # unless the null-probe above showed the body is defined
+                # on null (isnull-style), in which case the computed
+                # values are the truth
+                null_in = (not null_defined
+                           and any(_cell_is_null(x) for x in c))
+                if any(v is not None and bool(v) for v in vals):
+                    results.append(True)
+                elif null_in or any(v is None for v in vals):
+                    results.append(None)
+                else:
+                    results.append(False)
+        if self.kind == "exists":
+            if any(r is None for r in results):
+                return jnp.asarray(np.asarray(
+                    [np.nan if r is None else float(r) for r in results],
+                    np.float64), float_dtype())
+            return jnp.asarray(np.asarray(results, np.bool_))
+        return np.asarray(results, object)
+
+    def _eval_aggregate(self, frame, cells):
+        acc_name, x_name = self.lam.params
+        acc = _host_col(self.init.eval(frame) if self.init is not None
+                        else Lit(0.0).eval(frame))
+        max_len = builtins.max((0 if c is None else len(c) for c in cells),
+                               default=0)
+        needed: set = set()
+        _referenced_cols(self.lam.body, needed)
+        if self.finish is not None:
+            _referenced_cols(self.finish.body, needed)
+        needed |= {acc_name, x_name}
+        for j in range(max_len):
+            xj = [None if c is None or j >= len(c) else c[j] for c in cells]
+            bindings = {acc_name: acc, x_name: _column_from_elems(xj)}
+            try:
+                env = _row_frame(frame, bindings, needed=needed)
+                new_acc = _host_col(self.lam.body.eval(env))
+            except KeyError:   # attribute walk missed a reference
+                needed = None
+                env = _row_frame(frame, bindings)
+                new_acc = _host_col(self.lam.body.eval(env))
+            active = np.asarray(
+                [c is not None and j < len(c) for c in cells])
+            if _is_object(acc) or _is_object(new_acc):
+                acc = np.asarray(
+                    [n if a else o
+                     for o, n, a in zip(acc, new_acc, active)], object)
+            else:
+                acc = np.where(active, new_acc, acc)
+        if self.finish is not None:
+            env = _row_frame(frame, {self.finish.params[0]: acc})
+            acc = _host_col(self.finish.body.eval(env))
+        # null cells → null result
+        null_rows = np.asarray([c is None for c in cells])
+        if _is_object(acc):
+            return np.asarray([None if nr else v
+                               for v, nr in zip(acc, null_rows)], object)
+        out = np.asarray(acc, np.float64)
+        return jnp.asarray(np.where(null_rows, np.nan, out), float_dtype())
+
+
+def transform(col_, f) -> HigherOrder:
+    """``transform(col, x -> …)`` — per-element map. ``f`` is a Python
+    callable over a Col (PySpark-3 shape) or a prebuilt Lambda."""
+    lam = f if isinstance(f, Lambda) else _fresh_lambda(f, 1)
+    return HigherOrder("transform", col_, lam)
+
+
+def filter(col_, f) -> HigherOrder:  # noqa: A001 - Spark name
+    """``filter(col, x -> predicate)`` — keep matching elements; a null
+    predicate drops the element (SQL semantics)."""
+    lam = f if isinstance(f, Lambda) else _fresh_lambda(f, 1)
+    return HigherOrder("filter", col_, lam)
+
+
+def exists(col_, f) -> HigherOrder:
+    """``exists(col, x -> predicate)`` — three-valued ANY over the
+    elements."""
+    lam = f if isinstance(f, Lambda) else _fresh_lambda(f, 1)
+    return HigherOrder("exists", col_, lam)
+
+
+def aggregate(col_, initial_value, merge, finish=None) -> HigherOrder:
+    """``aggregate(col, init, (acc, x) -> …[, acc -> …])`` — sequential
+    fold per cell, vectorized across rows by element position."""
+    lam = merge if isinstance(merge, Lambda) else _fresh_lambda(merge, 2)
+    fin = None
+    if finish is not None:
+        fin = finish if isinstance(finish, Lambda) \
+            else _fresh_lambda(finish, 1)
+    init = initial_value if isinstance(initial_value, Expr) \
+        else Lit(initial_value)
+    return HigherOrder("aggregate", col_, lam, init=init, finish=fin)

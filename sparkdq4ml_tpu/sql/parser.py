@@ -60,7 +60,7 @@ _TOKEN_RE = re.compile(
     r"(?P<number>\d+\.\d*(?:[eE][+-]?\d+)?|\.\d+(?:[eE][+-]?\d+)?|\d+(?:[eE][+-]?\d+)?)"
     r"|(?P<string>'(?:[^']|'')*')"
     r"|(?P<ident>[A-Za-z_][A-Za-z_0-9]*)"
-    r"|(?P<op><=|>=|<>|!=|==|=|<|>|\+|-|\*|/|\(|\)|,)"
+    r"|(?P<op>->|<=|>=|<>|!=|==|=|<|>|\+|-|\*|/|\(|\)|,)"
     r")")
 
 _KEYWORDS = {"select", "from", "where", "as", "and", "or", "not", "cast",
@@ -167,14 +167,17 @@ class _Parser:
         self.expect("kw", "select")
         distinct = bool(self.accept("kw", "distinct"))
         items = self.parse_select_list()
-        self.expect("kw", "from")
-        view = self.expect("ident").value
+        # Spark allows FROM-less SELECT (``SELECT 1``, ``SELECT
+        # current_date()``): the projection runs over OneRowRelation.
+        view = None
         joins = []
-        while True:
-            join = self.parse_join()
-            if join is None:
-                break
-            joins.append(join)
+        if self.accept("kw", "from"):
+            view = self.expect("ident").value
+            while True:
+                join = self.parse_join()
+                if join is None:
+                    break
+                joins.append(join)
         where = None
         if self.accept("kw", "where"):
             where = self.parse_or()
@@ -572,6 +575,9 @@ class _Parser:
                 if (t.value.lower() in ("count", "sum")
                         and self.accept("kw", "distinct")):
                     fn_name = f"{t.value.lower()}_distinct"
+                if fn_name.lower() in ("transform", "filter", "exists",
+                                       "aggregate"):
+                    return self.parse_higher_order(fn_name.lower())
                 args = []
                 if not self.accept("op", ")"):
                     args.append(self.parse_or())
@@ -585,6 +591,38 @@ class _Parser:
             self.expect("op", ")")
             return inner
         raise ValueError(f"SQL parse error at {t.value!r}")
+
+    def parse_lambda(self):
+        """``x -> expr`` / ``(acc, x) -> expr`` — Spark 2.4's SQL lambda.
+        Parameters surface as Col refs in the body; the higher-order
+        evaluator's scope frame binds them (shadowing outer columns)."""
+        params = []
+        if self.accept("op", "("):
+            params.append(self.expect("ident").value)
+            while self.accept("op", ","):
+                params.append(self.expect("ident").value)
+            self.expect("op", ")")
+        else:
+            params.append(self.expect("ident").value)
+        self.expect("op", "->")
+        return E.Lambda(params, self.parse_or())
+
+    def parse_higher_order(self, fn: str):
+        """transform/filter/exists (col, lambda); aggregate
+        (col, init, merge[, finish]) — '(' already consumed."""
+        source = self.parse_or()
+        self.expect("op", ",")
+        if fn == "aggregate":
+            init = self.parse_or()
+            self.expect("op", ",")
+            merge = self.parse_lambda()
+            finish = self.parse_lambda() if self.accept("op", ",") else None
+            self.expect("op", ")")
+            return E.HigherOrder("aggregate", source, merge, init=init,
+                                 finish=finish)
+        lam = self.parse_lambda()
+        self.expect("op", ")")
+        return E.HigherOrder(fn, source, lam)
 
 
 class Query:
@@ -669,7 +707,13 @@ def _execute_single(q: Query, cat):
     """Run one SELECT (no union handling) and return a Frame."""
     from ..frame.aggregates import AggExpr
 
-    frame = cat.lookup(q.view)
+    if q.view is None:
+        # OneRowRelation: a single anonymous row for literal projections
+        from ..frame.frame import Frame
+
+        frame = Frame({"__one_row__": [0.0]}).drop("__one_row__")
+    else:
+        frame = cat.lookup(q.view)
     for view, how, keys in q.joins:
         frame = frame.join(cat.lookup(view), on=keys or None, how=how)
     if q.where is not None:

@@ -1,0 +1,167 @@
+"""Timestamp-resolution date family: hour/minute/second, weekofyear,
+last_day, add_months, months_between, next_day, trunc, date_trunc,
+to_timestamp, current_timestamp, and FROM-less SELECT (OneRowRelation).
+Oracles are Python's datetime/calendar — independent of the device civil
+math under test — plus Spark's documented truth tables."""
+
+import calendar
+import datetime as dt
+
+import numpy as np
+import pytest
+
+from sparkdq4ml_tpu import Frame
+from sparkdq4ml_tpu import functions as F
+
+EPOCH = dt.date(1970, 1, 1)
+
+
+def _days(*isodates):
+    return [float((dt.date.fromisoformat(s) - EPOCH).days) for s in isodates]
+
+
+def _one(frame, expr, name="v"):
+    return frame.select(expr.alias(name)).to_pydict()[name]
+
+
+class TestTimeFields:
+    def test_string_timestamps(self):
+        f = Frame({"t": ["2023-03-05 14:07:09", "2023-03-05", None]})
+        assert _one(f, F.hour("t")) [0] == 14
+        assert _one(f, F.minute("t"))[0] == 7
+        assert _one(f, F.second("t"))[0] == 9
+        # date-only string: midnight (Spark's cast)
+        assert _one(f, F.hour("t"))[1] == 0
+        assert np.isnan(_one(f, F.hour("t"))[2])
+
+    def test_numeric_dates_are_midnight(self):
+        f = Frame({"d": _days("2023-03-05")})
+        assert _one(f, F.hour("d"))[0] == 0
+        assert _one(f, F.second("d"))[0] == 0
+
+
+class TestCalendarFns:
+    def test_weekofyear_iso(self):
+        # 2021-01-01 is ISO week 53 of 2020; 2021-01-04 is week 1
+        f = Frame({"d": _days("2021-01-01", "2021-01-04", "2023-07-14")})
+        out = _one(f, F.weekofyear("d"))
+        assert list(out) == [53, 1, 28]
+
+    def test_last_day_incl_leap(self):
+        f = Frame({"d": _days("2024-02-10", "2023-02-10", "2023-12-31")})
+        out = _one(f, F.last_day("d"))
+        expect = _days("2024-02-29", "2023-02-28", "2023-12-31")
+        assert list(out) == expect
+
+    def test_add_months_clamps(self):
+        f = Frame({"d": _days("2023-01-31", "2023-11-15")})
+        out = _one(f, F.add_months("d", 1))
+        expect = _days("2023-02-28", "2023-12-15")
+        assert list(out) == expect
+        back = _one(f, F.add_months("d", -13))
+        expect_back = _days("2021-12-31", "2022-10-15")
+        assert list(back) == expect_back
+
+    def test_months_between_whole_and_fraction(self):
+        f = Frame({"e": _days("2023-03-15", "2023-03-31", "2023-03-20"),
+                   "s": _days("2023-01-15", "2023-02-28", "2023-01-10")})
+        out = _one(f, F.months_between("e", "s"))
+        # same day-of-month → 2.0; both month-ends → 1.0;
+        # otherwise months + (20-10)/31
+        np.testing.assert_allclose(
+            out, [2.0, 1.0, 2.0 + 10.0 / 31.0], rtol=1e-7)
+
+    def test_next_day(self):
+        # 2023-07-14 is a Friday
+        f = Frame({"d": _days("2023-07-14")})
+        assert _one(f, F.next_day("d", "Mon"))[0] == _days("2023-07-17")[0]
+        # strictly after: next Friday is +7
+        assert _one(f, F.next_day("d", "friday"))[0] == _days("2023-07-21")[0]
+        assert np.isnan(_one(f, F.next_day("d", "noday"))[0])
+
+    def test_trunc(self):
+        f = Frame({"d": _days("2023-07-14")})
+        assert _one(f, F.trunc("d", "year"))[0] == _days("2023-01-01")[0]
+        assert _one(f, F.trunc("d", "MM"))[0] == _days("2023-07-01")[0]
+        assert np.isnan(_one(f, F.trunc("d", "week"))[0])
+
+
+class TestTimestamps:
+    def test_to_timestamp_lenient_and_formatted(self):
+        f = Frame({"t": ["2023-03-05 01:02:03", "junk"]})
+        out = _one(f, F.to_timestamp("t"))
+        expect = (dt.datetime(2023, 3, 5, 1, 2, 3)
+                  - dt.datetime(1970, 1, 1)).total_seconds()
+        assert out[0] == expect and np.isnan(out[1])
+        g = Frame({"t": ["05/03/2023"]})
+        got = _one(g, F.to_timestamp("t", "dd/MM/yyyy"))[0]
+        assert got == (dt.datetime(2023, 3, 5)
+                       - dt.datetime(1970, 1, 1)).total_seconds()
+
+    def test_date_trunc_units(self):
+        base = dt.datetime(2023, 7, 14, 14, 37, 45)
+        secs = (base - dt.datetime(1970, 1, 1)).total_seconds()
+        f = Frame({"t": [base.strftime("%Y-%m-%d %H:%M:%S")]})
+
+        def check(unit, expect_dt):
+            got = _one(f, F.date_trunc(unit, F.col("t")))[0]
+            assert got == (expect_dt
+                           - dt.datetime(1970, 1, 1)).total_seconds(), unit
+
+        check("hour", base.replace(minute=0, second=0))
+        check("day", base.replace(hour=0, minute=0, second=0))
+        check("month", dt.datetime(2023, 7, 1))
+        check("quarter", dt.datetime(2023, 7, 1))
+        check("year", dt.datetime(2023, 1, 1))
+        # 2023-07-14 is Friday; ISO week starts Monday 2023-07-10
+        check("week", dt.datetime(2023, 7, 10))
+        assert np.isnan(_one(f, F.date_trunc("era", F.col("t")))[0])
+        assert secs == secs  # silence lint: base sanity
+
+    def test_current_timestamp_close_to_now(self):
+        f = Frame({"x": [0.0]})
+        got = _one(f, F.current_timestamp())[0]
+        import time
+
+        assert abs(got - time.time()) < 120
+
+
+class TestSqlSurface:
+    def test_fromless_select(self, session):
+        out = session.sql("SELECT 1 AS one").to_pydict()["one"]
+        assert list(out) == [1]
+
+    def test_fromless_select_fn(self, session):
+        out = session.sql("SELECT upper('ab') AS u").to_pydict()["u"]
+        assert list(out) == ["AB"]
+
+    def test_date_fns_from_sql(self, session):
+        Frame({"d": _days("2023-01-31")}).create_or_replace_temp_view("dv")
+        out = session.sql("SELECT add_months(d, 1) AS m, "
+                          "last_day(d) AS l FROM dv").to_pydict()
+        assert out["m"][0] == _days("2023-02-28")[0]
+        assert out["l"][0] == _days("2023-01-31")[0]
+
+
+class TestPythonOracleSweep:
+    """Device civil math vs Python datetime over a broad random sweep."""
+
+    def test_add_months_last_day_random(self):
+        rng = np.random.default_rng(0)
+        dates = [dt.date(1970, 1, 1) + dt.timedelta(days=int(x))
+                 for x in rng.integers(-20000, 40000, size=200)]
+        shifts = rng.integers(-30, 30, size=200)
+        f = Frame({"d": [float((d - EPOCH).days) for d in dates]})
+        for k in (int(shifts[0]), 7, -11):
+            got = _one(f, F.add_months("d", k))
+            for d, g in zip(dates, got):
+                total = d.year * 12 + (d.month - 1) + k
+                y, m = divmod(total, 12)
+                m += 1
+                day = min(d.day, calendar.monthrange(y, m)[1])
+                assert g == float((dt.date(y, m, day) - EPOCH).days)
+        lg = _one(f, F.last_day("d"))
+        for d, g in zip(dates, lg):
+            ld = dt.date(d.year, d.month,
+                         calendar.monthrange(d.year, d.month)[1])
+            assert g == float((ld - EPOCH).days)

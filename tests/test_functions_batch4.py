@@ -1,0 +1,241 @@
+"""Fourth functions batch: math/bitwise (bround, factorial, hex/unhex,
+bin, conv, shifts, bitwiseNOT), Spark hash functions (murmur3 `hash`,
+`xxhash64` — validated against published smhasher vectors on the aligned
+path plus the long≡8-LE-bytes identity both JVM implementations satisfy),
+null combinators (nullif/nvl2/ifnull), string extras (substring_index,
+soundex, ascii, encode/decode, bit/octet_length), and JSON
+(get_json_object, json_tuple)."""
+
+import numpy as np
+import pytest
+
+from sparkdq4ml_tpu import Frame
+from sparkdq4ml_tpu import functions as F
+from sparkdq4ml_tpu.ops import expressions as E
+
+
+def _one(frame, expr, name="v"):
+    return frame.select(expr.alias(name)).to_pydict()[name]
+
+
+class TestMathBitwise:
+    def test_bround_half_even_vs_round_half_up(self):
+        f = Frame({"x": [0.5, 1.5, 2.5, -0.5]})
+        br = _one(f, F.bround("x"))
+        assert list(br) == [0.0, 2.0, 2.0, -0.0]
+        hu = _one(f, F.round("x"))
+        assert list(hu) == [1.0, 2.0, 3.0, -1.0]
+
+    def test_bround_scale(self):
+        # 2.125 and 0.375 are exact in binary: *100 → x.5 exactly,
+        # half-even picks the even neighbor (212, 38)
+        f = Frame({"x": [2.125, 0.375]})
+        out = _one(f, F.bround("x", 2))
+        np.testing.assert_allclose(out, [2.12, 0.38], atol=1e-9)
+
+    def test_factorial_exact_top_of_range(self):
+        f = Frame({"n": [0.0, 5.0, 20.0]})
+        out = _one(f, F.factorial("n"))
+        assert list(out) == [1, 120, 2432902008176640000]
+
+    def test_factorial_out_of_range_null(self):
+        f = Frame({"n": [21.0, -1.0, 3.0]})
+        out = _one(f, F.factorial("n"))
+        assert out[0] is None and out[1] is None and out[2] == 6
+
+    def test_hex_unhex(self):
+        f = Frame({"n": [255.0, 17.0], "s": ["ABC", "xy"]})
+        assert list(_one(f, F.hex("n"))) == ["FF", "11"]
+        assert list(_one(f, F.hex("s"))) == ["414243", "7879"]
+        g = Frame({"h": ["414243", "zz"]})
+        out = _one(g, F.unhex("h"))
+        assert out[0] == "ABC" and out[1] is None
+
+    def test_hex_negative_twos_complement(self):
+        f = Frame({"n": [-1.0]})
+        assert _one(f, F.hex("n"))[0] == "F" * 16
+
+    def test_bin(self):
+        f = Frame({"n": [10.0, 0.0, -1.0]})
+        out = _one(f, F.bin("n"))
+        assert out[0] == "1010" and out[1] == "0" and out[2] == "1" * 64
+
+    def test_conv(self):
+        f = Frame({"s": ["100", "1F", "bad"]})
+        assert _one(f, F.conv("s", 2, 10))[0] == "4"
+        assert _one(f, F.conv("s", 16, 10))[1] == "31"
+        # Hive longest-valid-prefix: 'bad' in base 10 has no valid prefix
+        g = Frame({"s": ["12x9"]})
+        assert _one(g, F.conv("s", 10, 16))[0] == "C"
+
+    def test_conv_negative_to_base_is_signed(self):
+        f = Frame({"s": ["-16"]})
+        assert _one(f, F.conv("s", 10, -16))[0] == "-10"
+        # unsigned view for positive toBase
+        assert _one(f, F.conv("s", 10, 16))[0] == "F" * 15 + "0"
+
+    def test_shifts(self):
+        f = Frame({"n": [8.0, -8.0]})
+        assert list(_one(f, F.shiftleft("n", 2))) == [32, -32]
+        assert list(_one(f, F.shiftright("n", 2))) == [2, -2]
+        out = _one(f, F.shiftrightunsigned("n", 2))
+        assert out[0] == 2 and out[1] == (2**32 - 8) >> 2
+
+    def test_bitwise_not(self):
+        f = Frame({"n": [0.0, 5.0]})
+        assert list(_one(f, F.bitwiseNOT("n"))) == [-1, -6]
+
+
+class TestHashVectors:
+    """Aligned-path murmur3 vectors are standard smhasher values (Spark's
+    tail handling only diverges on non-4-multiple lengths)."""
+
+    def test_murmur3_published_vectors(self):
+        assert E._m3_hash_bytes(b"", 0) == 0
+        assert E._m3_hash_bytes(b"", 1) == 0x514E28B7
+        assert E._m3_hash_bytes(b"\x00\x00\x00\x00", 0) == 0x2362F9DE
+
+    def test_xxh64_published_vector(self):
+        assert E._xx_hash_bytes(b"", 0) == 0xEF46DB3751D8E999
+
+    def test_long_equals_8_le_bytes_identity(self):
+        # both JVM implementations satisfy hashLong(v) == hashBytes(LE8(v))
+        rng = np.random.default_rng(1)
+        for v in [int(x) for x in rng.integers(-2**62, 2**62, size=24)]:
+            b = (v & 0xFFFFFFFFFFFFFFFF).to_bytes(8, "little")
+            assert E._m3_hash_long(v, 42) == E._m3_hash_bytes(b, 42)
+            assert E._xx_hash_long(v, 42) == E._xx_hash_bytes(b, 42)
+
+    def test_xxh64_long_input_exercises_stripes(self):
+        data = bytes(range(100))
+        h1 = E._xx_hash_bytes(data, 42)
+        h2 = E._xx_hash_bytes(data, 42)
+        h3 = E._xx_hash_bytes(data[:-1] + b"\xff", 42)
+        assert h1 == h2 != h3
+
+
+class TestHashColumns:
+    def test_all_null_row_is_seed(self):
+        f = Frame({"s": [None, "x"]})
+        out = _one(f, F.hash("s"))
+        assert out[0] == 42
+
+    def test_multi_column_fold_order_matters(self):
+        f = Frame({"a": ["x"], "b": ["y"]})
+        ab = _one(f, F.hash("a", "b"))[0]
+        ba = _one(f, F.hash("b", "a"))[0]
+        assert ab != ba
+
+    def test_xxhash64_signed_64bit_output(self):
+        f = Frame({"s": ["anything", "else"]})
+        out = _one(f, F.xxhash64("s"))
+        for v in out:
+            assert -(2**63) <= int(v) < 2**63
+
+    def test_numeric_hash_is_double_hash(self):
+        import struct
+
+        f = Frame({"n": [3.5]})
+        got = _one(f, F.hash("n"))[0]
+        bits = struct.unpack("<q", struct.pack("<d", 3.5))[0]
+        expect = E._m3_hash_long(bits, 42)
+        if expect >= 2**31:
+            expect -= 2**32
+        assert got == expect
+
+
+class TestNullCombinators:
+    def test_nullif(self):
+        f = Frame({"a": [1.0, 2.0], "b": [1.0, 9.0]})
+        out = _one(f, F.nullif("a", "b"))
+        assert np.isnan(out[0]) and out[1] == 2.0
+
+    def test_nullif_strings(self):
+        f = Frame({"a": ["x", "y"], "b": ["x", "z"]})
+        out = _one(f, F.nullif("a", "b"))
+        assert out[0] is None and out[1] == "y"
+
+    def test_nvl2(self):
+        f = Frame({"a": [1.0, np.nan], "b": [10.0, 10.0],
+                   "c": [20.0, 20.0]})
+        out = _one(f, F.nvl2("a", "b", "c"))
+        assert list(out) == [10.0, 20.0]
+
+    def test_ifnull_is_coalesce(self):
+        f = Frame({"a": [np.nan, 5.0], "b": [7.0, 7.0]})
+        out = _one(f, F.ifnull("a", "b"))
+        assert list(out) == [7.0, 5.0]
+
+
+class TestStringExtras:
+    def test_substring_index(self):
+        f = Frame({"s": ["www.apache.org"]})
+        assert _one(f, F.substring_index("s", ".", 2))[0] == "www.apache"
+        assert _one(f, F.substring_index("s", ".", -2))[0] == "apache.org"
+        assert _one(f, F.substring_index("s", ".", 0))[0] == ""
+
+    def test_soundex_classics(self):
+        f = Frame({"s": ["Robert", "Rupert", "Ashcraft", "Tymczak",
+                         "Pfister", "Honeyman"]})
+        out = _one(f, F.soundex("s"))
+        assert list(out) == ["R163", "R163", "A261", "T522", "P236",
+                             "H555"]
+
+    def test_ascii(self):
+        f = Frame({"s": ["Apache", "", "z"]})
+        out = _one(f, F.ascii("s"))
+        assert list(out) == [65, 0, 122]
+
+    def test_crc32_matches_zlib(self):
+        import zlib
+
+        f = Frame({"s": ["ABC"]})
+        assert _one(f, F.crc32("s"))[0] == zlib.crc32(b"ABC")
+
+    def test_encode_decode_roundtrip(self):
+        f = Frame({"s": ["héllo"]})
+        enc = f.select(F.encode("s", "utf-8").alias("e"))
+        back = enc.select(F.decode("e", "utf-8").alias("d"))
+        assert back.to_pydict()["d"][0] == "héllo"
+
+    def test_bit_octet_length(self):
+        f = Frame({"s": ["abc", "é"]})
+        assert list(_one(f, F.octet_length("s"))) == [3, 2]
+        assert list(_one(f, F.bit_length("s"))) == [24, 16]
+
+
+class TestJson:
+    def test_get_json_object_paths(self):
+        doc = '{"a": {"b": [10, {"c": "deep"}]}, "s": "str", "n": 2.5}'
+        f = Frame({"j": [doc, "not json"]})
+        assert _one(f, F.get_json_object("j", "$.s"))[0] == "str"
+        assert _one(f, F.get_json_object("j", "$.a.b[0]"))[0] == "10"
+        assert _one(f, F.get_json_object("j", "$.a.b[1].c"))[0] == "deep"
+        # containers render as compact JSON text
+        assert _one(f, F.get_json_object("j", "$.a.b"))[0] == \
+            '[10,{"c":"deep"}]'
+        assert _one(f, F.get_json_object("j", "$.missing"))[0] is None
+        assert _one(f, F.get_json_object("j", "$.s"))[1] is None
+
+    def test_json_tuple_expands_columns(self):
+        f = Frame({"j": ['{"a": "1", "b": "x"}', '{"a": "9"}']})
+        out = f.select(F.json_tuple("j", "a", "b")).to_pydict()
+        assert list(out["c0"]) == ["1", "9"]
+        assert out["c1"][0] == "x" and out["c1"][1] is None
+
+    def test_json_tuple_as_scalar_raises(self):
+        f = Frame({"j": ['{"a":1}']})
+        with pytest.raises(ValueError, match="generator"):
+            f.with_column("t", F.json_tuple("j", "a")).collect()
+
+
+class TestSqlSurface:
+    def test_new_fns_from_sql(self, session):
+        Frame({"n": [10.0], "s": ["www.a.b"]}
+              ).create_or_replace_temp_view("b4")
+        out = session.sql(
+            "SELECT bin(n) AS b, substring_index(s, '.', 1) AS h, "
+            "nullif(n, 10) AS z FROM b4").to_pydict()
+        assert out["b"][0] == "1010"
+        assert out["h"][0] == "www"
+        assert np.isnan(out["z"][0])

@@ -1,0 +1,203 @@
+"""Spark 2.4 higher-order array functions: transform / filter / exists /
+aggregate with Python lambdas (PySpark-3 fluent shape) and SQL ``x ->``
+lambda syntax, including outer-column capture, null propagation, and the
+review-driven regressions (timestamp-aware extractors, exact int64
+results, strict JSON paths)."""
+
+import datetime as dt
+
+import numpy as np
+import pytest
+
+from sparkdq4ml_tpu import Frame
+from sparkdq4ml_tpu import functions as F
+
+
+def _arr(*cells):
+    return Frame({"t": [",".join(c) for c in cells]}).select(
+        F.split(F.col("t"), ",").alias("arr"))
+
+
+def _num_arr_frame():
+    return Frame({"x": [10.0, 100.0]}).select(
+        F.array(F.lit(1.0), F.lit(2.0), F.lit(3.0)).alias("a"),
+        F.col("x"))
+
+
+class TestTransform:
+    def test_elementwise_map(self):
+        f = _num_arr_frame()
+        out = f.select(F.transform("a", lambda e: e * 2).alias("t")
+                       ).to_pydict()["t"]
+        assert [float(v) for v in out[0]] == [2.0, 4.0, 6.0]
+
+    def test_outer_column_capture(self):
+        f = _num_arr_frame()
+        out = f.select(F.transform("a", lambda e: e * F.col("x")).alias("t")
+                       ).to_pydict()["t"]
+        assert [float(v) for v in out[0]] == [10.0, 20.0, 30.0]
+        assert [float(v) for v in out[1]] == [100.0, 200.0, 300.0]
+
+    def test_string_lambda_body(self):
+        t = _arr(["ab", "cd"])
+        out = t.select(F.transform("arr", lambda s: F.upper(s)).alias("t")
+                       ).to_pydict()["t"][0]
+        assert list(out) == ["AB", "CD"]
+
+    def test_null_cell_propagates(self):
+        f = Frame({"s": ["a,b", None]}).select(
+            F.split(F.col("s"), ",").alias("arr"))
+        out = f.select(F.transform("arr", lambda s: F.upper(s)).alias("t")
+                       ).to_pydict()["t"]
+        assert out[1] is None
+
+
+class TestFilterExists:
+    def test_filter_keeps_matches(self):
+        f = _num_arr_frame()
+        out = f.select(F.filter("a", lambda e: e > 1.5).alias("t")
+                       ).to_pydict()["t"][0]
+        assert [float(v) for v in out] == [2.0, 3.0]
+
+    def test_filter_null_predicate_drops(self):
+        f = Frame({"x": [1.0]}).select(
+            F.array(F.lit(1.0), F.lit(None), F.lit(3.0)).alias("a"))
+        out = f.select(F.filter("a", lambda e: e > 0).alias("t")
+                       ).to_pydict()["t"][0]
+        assert [float(v) for v in out] == [1.0, 3.0]
+
+    def test_exists_null_defined_predicate_is_false_not_null(self):
+        # IS NOT NULL is defined on null elements: exists must answer
+        # false, not unknown (review regression)
+        f = Frame({"x": [1.0]})
+        arr = F.array(F.lit(None), F.lit(None))
+        out = f.select(F.exists(arr, lambda e: ~F.isnull(e)).alias("t")
+                       ).to_pydict()["t"][0]
+        assert bool(out) is False and not (isinstance(out, float)
+                                           and np.isnan(out))
+        yes = f.select(F.exists(arr, lambda e: F.isnull(e)).alias("t")
+                       ).to_pydict()["t"][0]
+        assert bool(yes) is True
+
+    def test_exists_three_valued(self):
+        f = Frame({"x": [1.0]})
+        yes = f.select(F.exists(F.array(F.lit(1.0), F.lit(5.0)),
+                                lambda e: e > 4).alias("t")
+                       ).to_pydict()["t"][0]
+        assert bool(yes) is True
+        no = f.select(F.exists(F.array(F.lit(1.0)), lambda e: e > 4
+                               ).alias("t")).to_pydict()["t"][0]
+        assert bool(no) is False
+        unk = f.select(F.exists(F.array(F.lit(1.0), F.lit(None)),
+                                lambda e: e > 4).alias("t")
+                       ).to_pydict()["t"][0]
+        assert unk is None or np.isnan(unk)
+
+
+class TestAggregate:
+    def test_sum_fold(self):
+        f = _num_arr_frame()
+        out = f.select(F.aggregate("a", F.lit(0.0),
+                                   lambda acc, e: acc + e).alias("t")
+                       ).to_pydict()["t"]
+        assert list(out) == [6.0, 6.0]
+
+    def test_finish_lambda(self):
+        f = _num_arr_frame()
+        out = f.select(F.aggregate("a", F.lit(0.0), lambda acc, e: acc + e,
+                                   lambda acc: acc * 10).alias("t")
+                       ).to_pydict()["t"][0]
+        assert out == 60.0
+
+    def test_init_expr_and_outer_column(self):
+        f = _num_arr_frame()
+        out = f.select(F.aggregate("a", F.col("x"),
+                                   lambda acc, e: acc + e).alias("t")
+                       ).to_pydict()["t"]
+        assert list(out) == [16.0, 106.0]
+
+    def test_ragged_lengths(self):
+        f = Frame({"s": ["1,2,3,4", "5"]}).select(
+            F.split(F.col("s"), ",").alias("arr"))
+        out = f.select(F.aggregate(
+            "arr", F.lit(0.0),
+            lambda acc, e: acc + e.cast("double")).alias("t")
+            ).to_pydict()["t"]
+        assert list(out) == [10.0, 5.0]
+
+    def test_null_cell_is_null(self):
+        f = Frame({"s": ["1,2", None]}).select(
+            F.split(F.col("s"), ",").alias("arr"))
+        out = f.select(F.aggregate(
+            "arr", F.lit(0.0),
+            lambda acc, e: acc + e.cast("double")).alias("t")
+            ).to_pydict()["t"]
+        assert np.isnan(out[1])
+
+
+class TestSqlLambdas:
+    def test_transform_sql(self, session):
+        _arr(["a", "b"]).create_or_replace_temp_view("hof1")
+        out = session.sql("SELECT transform(arr, x -> upper(x)) AS t "
+                          "FROM hof1").to_pydict()["t"][0]
+        assert list(out) == ["A", "B"]
+
+    def test_filter_exists_sql(self, session):
+        _arr(["a", "b", "c"]).create_or_replace_temp_view("hof2")
+        out = session.sql("SELECT filter(arr, x -> x <> 'b') AS t "
+                          "FROM hof2").to_pydict()["t"][0]
+        assert list(out) == ["a", "c"]
+        ex = session.sql("SELECT exists(arr, x -> x = 'c') AS t FROM hof2"
+                         ).to_pydict()["t"][0]
+        assert bool(ex) is True
+
+    def test_aggregate_sql_two_param(self, session):
+        Frame({"s": ["1,2,3"]}).select(
+            F.split(F.col("s"), ",").alias("arr")
+        ).create_or_replace_temp_view("hof3")
+        out = session.sql(
+            "SELECT aggregate(arr, 0, (acc, x) -> acc + cast(x as int)) "
+            "AS t FROM hof3").to_pydict()["t"][0]
+        assert out == 6.0
+
+    def test_lambda_param_shadows_outer_column(self, session):
+        # a column literally named `x` must be shadowed by the lambda param
+        Frame({"s": ["7,8"], "x": [100.0]}).select(
+            F.split(F.col("s"), ",").alias("arr"), F.col("x")
+        ).create_or_replace_temp_view("hof4")
+        out = session.sql(
+            "SELECT transform(arr, x -> cast(x as int) + 1) AS t FROM hof4"
+            ).to_pydict()["t"][0]
+        assert [float(v) for v in out] == [8.0, 9.0]
+
+
+class TestReviewRegressions:
+    def test_hour_of_to_timestamp_composition(self):
+        f = Frame({"s": ["2020-03-15 12:34:56"]})
+        ts = f.select(F.to_timestamp("s").alias("t"))
+        assert ts.select(F.hour("t").alias("h")).to_pydict()["h"][0] == 12
+        assert ts.select(F.minute("t").alias("m")).to_pydict()["m"][0] == 34
+        assert ts.select(F.second("t").alias("s2")).to_pydict()["s2"][0] == 56
+
+    def test_date_trunc_of_to_timestamp(self):
+        f = Frame({"s": ["2020-03-15 12:34:56"]})
+        ts = f.select(F.to_timestamp("s").alias("t"))
+        got = ts.select(F.date_trunc("hour", F.col("t")).alias("x")
+                        ).to_pydict()["x"][0]
+        expect = (dt.datetime(2020, 3, 15, 12)
+                  - dt.datetime(1970, 1, 1)).total_seconds()
+        assert got == expect
+
+    def test_datediff_accepts_timestamp_values(self):
+        f = Frame({"s": ["2020-03-15 12:00:00"], "d": ["2020-03-10"]})
+        ts = f.select(F.to_timestamp("s").alias("t"),
+                      F.to_date("d").alias("d"))
+        got = ts.select(F.datediff(F.col("t"), F.col("d")).alias("n")
+                        ).to_pydict()["n"][0]
+        assert got == 5.0
+
+    def test_malformed_json_paths_are_null(self):
+        g = Frame({"j": ['{"a":{"b":5}}']})
+        for bad in ("$x!!.a.b", "$.a[zz].b", "a.b", "$.a..b"):
+            assert g.select(F.get_json_object("j", bad).alias("v")
+                            ).to_pydict()["v"][0] is None, bad
