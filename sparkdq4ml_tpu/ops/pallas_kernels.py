@@ -44,6 +44,12 @@ from .rules import (BAD_ROW_SENTINEL, CORRELATION_MAX_GUESTS,
 # 512 rows × up-to-128 padded lanes ≈ 256 KB/input block in VMEM — far under
 # the ~16 MB budget, large enough to keep the MXU busy.
 BLOCK_ROWS = 512
+# Lane-tile width for the Gramian OUTPUT: Mosaic's scoped-VMEM scratch for
+# the accumulator scales with the output block (measured ~16× its padded
+# bytes on v5e — a full (514, 514) f32 block wants 21 MB against the 16 MB
+# stack limit). Tiling the output columns keeps the scratch bounded for any
+# d; at d+2 ≤ 128 the grid degenerates to the untiled layout.
+BLOCK_COLS = 128
 # Row tiles for the elementwise DQ kernel: (DQ_BLOCK_ROWS, 128) f32 blocks,
 # 5 buffers live (2 in + 3 out) ≈ 1.3 MB of VMEM.
 DQ_BLOCK_ROWS = 512
@@ -95,19 +101,21 @@ def dispatch_to_pallas(*operands) -> bool:
 # Masked augmented Gramian
 # ---------------------------------------------------------------------------
 
-def _gram_kernel(z_ref, w_ref, out_ref):
-    """One row tile: out += (Z·w)ᵀZ — mask-multiply fused into the MXU pass."""
-    i = pl.program_id(0)
+def _gram_kernel(zl_ref, zr_ref, w_ref, out_ref):
+    """One (col-tile, row-tile) step: out[:, j] += (Z·w)ᵀ Z[:, j] — the
+    mask-multiply fused into the MXU pass. Row tiles are the INNER grid
+    axis, so each output column block accumulates to completion before
+    the next is touched."""
+    i = pl.program_id(1)
 
     @pl.when(i == 0)
     def _():
         out_ref[:] = jnp.zeros_like(out_ref)
 
-    z = z_ref[:]
-    zw = z * w_ref[:]  # broadcast (TILE, 1) mask over lanes
-    # Contract the row (sublane) dimension: (TILE, D)ᵀ(TILE, D) → (D, D).
+    zw = zl_ref[:] * w_ref[:]  # broadcast (TILE, 1) mask over lanes
+    # Contract the row (sublane) dimension: (TILE, D)ᵀ(TILE, Dt) → (D, Dt).
     out_ref[:] += jax.lax.dot_general(
-        zw, z,
+        zw, zr_ref[:],
         dimension_numbers=(((0,), (0,)), ((), ())),
         preferred_element_type=out_ref.dtype,
     )
@@ -116,19 +124,22 @@ def _gram_kernel(z_ref, w_ref, out_ref):
 @functools.partial(jax.jit, static_argnames=("block_rows", "interpret"))
 def _masked_gram_call(Z, w, block_rows: int, interpret: bool):
     n, D = Z.shape
-    grid = (pl.cdiv(n, block_rows),)
+    bc = min(BLOCK_COLS, D)
+    grid = (pl.cdiv(D, bc), pl.cdiv(n, block_rows))  # (cols OUTER, rows inner)
     return pl.pallas_call(
         _gram_kernel,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((block_rows, D), lambda i: (i, 0)),
-            pl.BlockSpec((block_rows, 1), lambda i: (i, 0)),
+            pl.BlockSpec((block_rows, D), lambda j, i: (i, 0)),
+            pl.BlockSpec((block_rows, bc), lambda j, i: (i, j)),
+            pl.BlockSpec((block_rows, 1), lambda j, i: (i, 0)),
         ],
-        # Single output block revisited by every grid step (accumulator).
-        out_specs=pl.BlockSpec((D, D), lambda i: (0, 0)),
+        # One output column block per outer step, revisited by every row
+        # tile (accumulator); VMEM scratch scales with (D, bc), not (D, D).
+        out_specs=pl.BlockSpec((D, bc), lambda j, i: (0, j)),
         out_shape=jax.ShapeDtypeStruct((D, D), Z.dtype),
         interpret=interpret,
-    )(Z, w)
+    )(Z, Z, w)
 
 
 def masked_gram_pallas(X: jnp.ndarray, y: jnp.ndarray, mask: jnp.ndarray,
@@ -157,17 +168,17 @@ def masked_gram_pallas(X: jnp.ndarray, y: jnp.ndarray, mask: jnp.ndarray,
     return _masked_gram_call(Z, w[:, None], block, _interpret())
 
 
-def _packed_gram_kernel(z_ref, out_ref):
-    """One row tile of the pre-masked design: out += ZᵀZ."""
-    i = pl.program_id(0)
+def _packed_gram_kernel(zl_ref, zr_ref, out_ref):
+    """One (col-tile, row-tile) step of the pre-masked design:
+    out[:, j] += Zᵀ Z[:, j]."""
+    i = pl.program_id(1)
 
     @pl.when(i == 0)
     def _():
         out_ref[:] = jnp.zeros_like(out_ref)
 
-    z = z_ref[:]
     out_ref[:] += jax.lax.dot_general(
-        z, z,
+        zl_ref[:], zr_ref[:],
         dimension_numbers=(((0,), (0,)), ((), ())),
         preferred_element_type=out_ref.dtype,
     )
@@ -176,14 +187,19 @@ def _packed_gram_kernel(z_ref, out_ref):
 @functools.partial(jax.jit, static_argnames=("block_rows", "interpret"))
 def _packed_gram_call(Z, block_rows: int, interpret: bool):
     n, D = Z.shape
+    bc = min(BLOCK_COLS, D)
+    grid = (pl.cdiv(D, bc), pl.cdiv(n, block_rows))
     return pl.pallas_call(
         _packed_gram_kernel,
-        grid=(pl.cdiv(n, block_rows),),
-        in_specs=[pl.BlockSpec((block_rows, D), lambda i: (i, 0))],
-        out_specs=pl.BlockSpec((D, D), lambda i: (0, 0)),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((block_rows, D), lambda j, i: (i, 0)),
+            pl.BlockSpec((block_rows, bc), lambda j, i: (i, j)),
+        ],
+        out_specs=pl.BlockSpec((D, bc), lambda j, i: (0, j)),
         out_shape=jax.ShapeDtypeStruct((D, D), Z.dtype),
         interpret=interpret,
-    )(Z)
+    )(Z, Z)
 
 
 def packed_gram_pallas(Z: jnp.ndarray,
