@@ -299,6 +299,14 @@ class TpuSession:
         cache_dir = self.conf.get("spark.compilation.cacheDir", default_dir)
         if cache_dir == default_dir and not env_dir:
             _prune_stale_cache_dirs(base, keep=default_dir)
+        # Per-BACKEND subdir: under a tunneled accelerator the plugin's
+        # server compiles the session's CPU-side AOT executables with the
+        # SERVER machine's feature set (+amx…, +prefer-no-scatter) and the
+        # client stores them locally — same host, same jaxlib, same tag,
+        # still poisonous to a later pure-CPU session (observed live in r5
+        # the moment the tunnel came healthy). Splitting by backend keeps
+        # the two writer populations apart without invalidation thrash.
+        cache_dir = os.path.join(cache_dir, jax.default_backend())
         try:
             os.makedirs(cache_dir, exist_ok=True)
             _validate_cache_dir(cache_dir, host_cache_tag())

@@ -40,7 +40,11 @@ os.environ.setdefault("SPARKDQ4ML_CACHE_DIR", _cache_dir)
 # (most model tests never do).
 try:
     os.makedirs(_cache_dir, exist_ok=True)
-    jax.config.update("jax_compilation_cache_dir", _cache_dir)
+    # per-backend subdir, mirroring TpuSession._init_compilation_cache:
+    # tunnel-healthy subprocess tests reach the real accelerator, whose
+    # server-compiled CPU AOT entries must not mix with local-CPU ones
+    jax.config.update("jax_compilation_cache_dir",
+                      os.path.join(_cache_dir, "cpu"))
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
 except Exception:

@@ -32,9 +32,10 @@ def test_cache_dir_created_and_configured(tmp_path, monkeypatch):
     cache = os.path.join(str(tmp_path), "xla-cache")
     s = (TpuSession.builder().app_name("t")
          .config("spark.compilation.cacheDir", cache).get_or_create())
+    backend_dir = os.path.join(cache, jax.default_backend())
     try:
-        assert os.path.isdir(cache)
-        assert jax.config.jax_compilation_cache_dir == cache
+        assert os.path.isdir(backend_dir)
+        assert jax.config.jax_compilation_cache_dir == backend_dir
         # On CPU the session keeps the stock "long compiles only"
         # thresholds (persisting every tiny kernel floods AOT reload
         # warnings); pin the threshold to 0 here to verify the DIR wiring
@@ -43,7 +44,7 @@ def test_cache_dir_created_and_configured(tmp_path, monkeypatch):
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
         jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
         jax.jit(lambda x: x * 3.0 + 1.0)(np.arange(8.0)).block_until_ready()
-        assert len(os.listdir(cache)) >= 1
+        assert len(os.listdir(backend_dir)) >= 1
     finally:
         s.stop()
 
@@ -78,13 +79,14 @@ def test_cache_reconfigured_on_get_or_create(tmp_path):
     second = os.path.join(str(tmp_path), "b")
     s = (TpuSession.builder().app_name("t")
          .config("spark.compilation.cacheDir", first).get_or_create())
+    be = jax.default_backend()
     try:
-        assert jax.config.jax_compilation_cache_dir == first
+        assert jax.config.jax_compilation_cache_dir == os.path.join(first, be)
         s2 = (TpuSession.builder()
               .config("spark.compilation.cacheDir", second).get_or_create())
         assert s2 is s
-        assert jax.config.jax_compilation_cache_dir == second
-        assert os.path.isdir(second)
+        assert jax.config.jax_compilation_cache_dir == os.path.join(second, be)
+        assert os.path.isdir(os.path.join(second, be))
     finally:
         s.stop()
 
@@ -96,12 +98,12 @@ class TestCacheHostKey:
     def test_poisoned_entries_invalidated(self, tmp_path):
         import json
 
-        cache = tmp_path / "xla-poisoned"
-        cache.mkdir()
+        cache = tmp_path / "xla-poisoned" / jax.default_backend()
+        cache.mkdir(parents=True)
         (cache / "host_key.json").write_text(json.dumps({"tag": "deadbeef"}))
         (cache / "jit_foreign-entry").write_bytes(b"\x00AOT-from-elsewhere")
         s = (TpuSession.builder().app_name("t")
-             .config("spark.compilation.cacheDir", str(cache))
+             .config("spark.compilation.cacheDir", str(cache.parent))
              .get_or_create())
         try:
             from sparkdq4ml_tpu.session import host_cache_tag
@@ -116,11 +118,11 @@ class TestCacheHostKey:
     def test_unstamped_nonempty_dir_invalidated(self, tmp_path):
         # No provenance stamp + existing entries = exactly the round-4
         # error-spam scenario (a dir inherited from an older build).
-        cache = tmp_path / "xla-legacy"
-        cache.mkdir()
+        cache = tmp_path / "xla-legacy" / jax.default_backend()
+        cache.mkdir(parents=True)
         (cache / "jit_old-entry").write_bytes(b"\x00old")
         s = (TpuSession.builder().app_name("t")
-             .config("spark.compilation.cacheDir", str(cache))
+             .config("spark.compilation.cacheDir", str(cache.parent))
              .get_or_create())
         try:
             assert not (cache / "jit_old-entry").exists()
@@ -134,14 +136,14 @@ class TestCacheHostKey:
         # look like XLA cache entries (jit_*/pjit_*/*-cache) may go.
         import json
 
-        cache = tmp_path / "xla-shared"
-        cache.mkdir()
+        cache = tmp_path / "xla-shared" / jax.default_backend()
+        cache.mkdir(parents=True)
         (cache / "host_key.json").write_text(json.dumps({"tag": "deadbeef"}))
         (cache / "jit_foreign-entry").write_bytes(b"\x00foreign")
         (cache / "notes.txt").write_text("user data, not a cache entry")
         (cache / "results.json").write_text("{}")
         s = (TpuSession.builder().app_name("t")
-             .config("spark.compilation.cacheDir", str(cache))
+             .config("spark.compilation.cacheDir", str(cache.parent))
              .get_or_create())
         try:
             assert not (cache / "jit_foreign-entry").exists()
@@ -155,13 +157,13 @@ class TestCacheHostKey:
 
         from sparkdq4ml_tpu.session import host_cache_tag
 
-        cache = tmp_path / "xla-ours"
-        cache.mkdir()
+        cache = tmp_path / "xla-ours" / jax.default_backend()
+        cache.mkdir(parents=True)
         (cache / "host_key.json").write_text(
             json.dumps({"tag": host_cache_tag()}))
         (cache / "jit_our-entry").write_bytes(b"\x00ours")
         s = (TpuSession.builder().app_name("t")
-             .config("spark.compilation.cacheDir", str(cache))
+             .config("spark.compilation.cacheDir", str(cache.parent))
              .get_or_create())
         try:
             assert (cache / "jit_our-entry").exists()
