@@ -280,7 +280,12 @@ def main():
     # (default 20 min), one probe per ~60 s — before accepting CPU.
     from sparkdq4ml_tpu.utils.debug import backend_initializes_retry
 
-    _acquire_bench_lock(float(os.environ.get("BENCH_LOCK_WAIT", "1200")))
+    try:
+        lock_wait = float(os.environ.get("BENCH_LOCK_WAIT", "1200"))
+    except ValueError:
+        log("BENCH_LOCK_WAIT is not a number; using 1200 s")
+        lock_wait = 1200.0
+    _acquire_bench_lock(lock_wait)
 
     try:
         deadline = float(os.environ.get("BENCH_PROBE_DEADLINE", "1200"))

@@ -14,12 +14,14 @@ row interpreter.
 
 Grammar (recursive descent):
 
-    query      := select (UNION [ALL] select)*
-    select     := SELECT [DISTINCT] select_list FROM ident join*
+    query      := [WITH ident AS '(' set ')' (',' ident AS '(' set ')')*] set
+    set        := select (UNION [ALL] select)*
+    select     := SELECT [DISTINCT] select_list FROM relation join*
                   [WHERE or_expr] [GROUP BY ...] [HAVING or_expr]
                   [ORDER BY ...] [LIMIT n]
+    relation   := ident | '(' set ')' [AS] [ident]      -- derived table
     join       := [INNER|LEFT [OUTER]|RIGHT [OUTER]|FULL [OUTER]|CROSS]
-                  JOIN ident (ON ident '=' ident | USING '(' ident,* ')')
+                  JOIN relation (ON ident '=' ident | USING '(' ident,* ')')
     select_list:= '*' | item (',' item)*
     item       := expr [OVER window] [[AS] ident]
     window     := '(' [PARTITION BY ident,*] [ORDER BY ident [ASC|DESC],*]
@@ -34,9 +36,10 @@ Grammar (recursive descent):
     not_expr   := NOT not_expr | cmp
     cmp        := add ((= | == | != | <> | < | <= | > | >=) add)?
                 | add IS [NOT] NULL
-                | add [NOT] IN '(' or_expr,* ')'
+                | add [NOT] IN '(' (or_expr,* | set) ')'
                 | add [NOT] BETWEEN add AND add
                 | add [NOT] LIKE 'pattern'
+                | EXISTS '(' set ')'          -- uncorrelated subqueries
     add        := mul (('+'|'-') mul)*
     mul        := unary (('*'|'/') unary)*
     unary      := '-' unary | atom
@@ -45,6 +48,8 @@ Grammar (recursive descent):
                 | CASE (WHEN or_expr THEN or_expr)+ [ELSE or_expr] END
                 | ident '(' [expr (',' expr)*] ')'     -- UDF or builtin fn
                 | ident | '(' or_expr ')'
+                | '(' set ')'                 -- scalar subquery (1 col,
+                                              -- <=1 row; null when empty)
 """
 
 from __future__ import annotations
@@ -163,6 +168,22 @@ class _Parser:
         return t
 
     # -- query -------------------------------------------------------------
+    def parse_relation(self):
+        """A FROM/JOIN source: a view name, or a parenthesized derived
+        table ``(SELECT ...) [AS] alias`` (alias optional, Spark 3+)."""
+        if (self.peek().kind == "op" and self.peek().value == "("
+                and self.toks[self.i + 1].kind == "kw"
+                and self.toks[self.i + 1].value.lower() == "select"):
+            self.next()
+            sub = self.parse_set_expr()
+            self.expect("op", ")")
+            self.accept("kw", "as")
+            alias = None
+            if self.peek().kind == "ident":
+                alias = self.next().value
+            return DerivedTable(sub, alias)
+        return self.expect("ident").value
+
     def parse_query(self):
         self.expect("kw", "select")
         distinct = bool(self.accept("kw", "distinct"))
@@ -172,7 +193,7 @@ class _Parser:
         view = None
         joins = []
         if self.accept("kw", "from"):
-            view = self.expect("ident").value
+            view = self.parse_relation()
             while True:
                 join = self.parse_join()
                 if join is None:
@@ -217,12 +238,33 @@ class _Parser:
         q.group_mode = group_mode
         return q
 
-    def parse_union_query(self):
-        """query (UNION [ALL] query)* — set union over identical schemas."""
+    def parse_set_expr(self):
+        """query (UNION [ALL] query)* — set union over identical schemas.
+        No EOF expectation, so it also parses parenthesized subqueries."""
         q = self.parse_query()
         while self.accept("kw", "union"):
             dedup = not self.accept("kw", "all")
             q.unions.append((self.parse_query(), dedup))
+        return q
+
+    def parse_union_query(self):
+        """Top-level statement: ``[WITH name AS (query), ...] set_expr``.
+        WITH is contextual (like OVER/PARTITION) so columns named "with"
+        keep working: it is only recognized as the first token."""
+        ctes = []
+        if (self.peek().kind == "ident"
+                and self.peek().value.lower() == "with"):
+            self.next()
+            while True:
+                name = self.expect("ident").value
+                self.expect("kw", "as")
+                self.expect("op", "(")
+                ctes.append((name, self.parse_set_expr()))
+                self.expect("op", ")")
+                if not self.accept("op", ","):
+                    break
+        q = self.parse_set_expr()
+        q.ctes = ctes
         self.expect("eof")
         return q
 
@@ -241,7 +283,7 @@ class _Parser:
             how = "inner"
         else:
             self.expect("kw", "join")
-        view = self.expect("ident").value
+        view = self.parse_relation()
         keys: list[str] = []
         if how != "cross":
             if self.accept("kw", "using"):
@@ -486,6 +528,11 @@ class _Parser:
             negated = True
         if self.accept("kw", "in"):
             self.expect("op", "(")
+            if (self.peek().kind == "kw"
+                    and self.peek().value.lower() == "select"):
+                sub = self.parse_set_expr()
+                self.expect("op", ")")
+                return SubqueryIn(left, sub, negated)
             values = [self.parse_or()]
             while self.accept("op", ","):
                 values.append(self.parse_or())
@@ -575,6 +622,13 @@ class _Parser:
                 if (t.value.lower() in ("count", "sum")
                         and self.accept("kw", "distinct")):
                     fn_name = f"{t.value.lower()}_distinct"
+                # EXISTS (SELECT ...) — the predicate form; EXISTS(arr,
+                # x -> ...) remains the higher-order array function.
+                if (fn_name.lower() == "exists" and self.peek().kind == "kw"
+                        and self.peek().value.lower() == "select"):
+                    sub = self.parse_set_expr()
+                    self.expect("op", ")")
+                    return SubqueryExists(sub)
                 if fn_name.lower() in ("transform", "filter", "exists",
                                        "aggregate"):
                     return self.parse_higher_order(fn_name.lower())
@@ -587,6 +641,11 @@ class _Parser:
                 return E.UdfCall(fn_name, args)
             return E.Col(t.value)
         if self.accept("op", "("):
+            if (self.peek().kind == "kw"
+                    and self.peek().value.lower() == "select"):
+                sub = self.parse_set_expr()
+                self.expect("op", ")")
+                return ScalarSubquery(sub)
             inner = self.parse_or()
             self.expect("op", ")")
             return inner
@@ -625,9 +684,68 @@ class _Parser:
         return E.HigherOrder(fn, source, lam)
 
 
+class DerivedTable:
+    """A parenthesized subquery in relation position: ``FROM (SELECT
+    ...) [AS] alias`` — executed into a Frame at lookup time."""
+
+    __slots__ = ("query", "alias")
+
+    def __init__(self, query, alias=None):
+        self.query = query
+        self.alias = alias
+
+
+class _AliasableSubquery(E.Expr):
+    """Subquery placeholders are Expr subclasses so every grammar position
+    a column can take — ``(SELECT ...) IS NULL``, ``BETWEEN``, ``LIKE``,
+    ``AS name`` — composes; the resolution walk replaces them with
+    literals before any eval. eval() itself is unreachable after
+    resolution and raises a clear error if a placeholder escapes."""
+
+    __slots__ = ()
+
+    def eval(self, frame):
+        raise ValueError(
+            "subqueries are only supported inside session.sql() — this "
+            "expression still holds an unresolved subquery placeholder")
+
+
+class ScalarSubquery(_AliasableSubquery):
+    """``(SELECT agg FROM ...)`` in expression position. Uncorrelated
+    only; resolved to a literal (its single value, null when empty)
+    before the enclosing query runs."""
+
+    __slots__ = ("query",)
+
+    def __init__(self, query):
+        self.query = query
+
+
+class SubqueryIn(_AliasableSubquery):
+    """``expr [NOT] IN (SELECT col FROM ...)`` — resolved to an InList
+    over the subquery's materialized (uncorrelated) value set."""
+
+    __slots__ = ("child", "query", "negated")
+
+    def __init__(self, child, query, negated=False):
+        self.child = child
+        self.query = query
+        self.negated = negated
+
+
+class SubqueryExists(_AliasableSubquery):
+    """``EXISTS (SELECT ...)`` — uncorrelated; resolved to a boolean
+    literal (row count > 0)."""
+
+    __slots__ = ("query",)
+
+    def __init__(self, query):
+        self.query = query
+
+
 class Query:
     """Parsed query: select items, view, joins, where, group/having/order/
-    limit, distinct flag, and trailing UNION branches."""
+    limit, distinct flag, trailing UNION branches, and WITH CTEs."""
 
     def __init__(self, items, view, where, group_by=(), order_by=(),
                  limit=None, joins=(), distinct=False, having=None,
@@ -643,6 +761,7 @@ class Query:
         self.having = having
         self.unions = list(unions)  # [(Query, dedup: bool), ...]
         self.group_mode = "group"   # "group" | "rollup" | "cube"
+        self.ctes = []              # [(name, Query), ...]
 
 
 def parse(sql: str) -> Query:
@@ -689,18 +808,105 @@ def _rewrite_having(expr, extra_aggs: list):
     return expr
 
 
-def execute(sql: str, catalog=None):
-    """Run a query (including trailing UNION branches) against the catalog."""
-    from .catalog import default_catalog
+class _OverlayCatalog:
+    """CTE scope: WITH-bound names shadow the base catalog for the
+    duration of one statement, without mutating it."""
 
-    cat = catalog if catalog is not None else default_catalog()
-    q = parse(sql)
+    def __init__(self, base):
+        self._base = base
+        self._views: dict[str, object] = {}
+
+    def register(self, name: str, frame) -> None:
+        self._views[name.lower()] = frame
+
+    def lookup(self, name: str):
+        try:
+            return self._views[name.lower()]
+        except KeyError:
+            return self._base.lookup(name)
+
+
+def _pyval(v):
+    """numpy scalar → python scalar (Lit dispatches on python types)."""
+    return v.item() if hasattr(v, "item") else v
+
+
+def _resolve_subqueries(expr, cat):
+    """Replace uncorrelated subquery placeholders with literal values by
+    executing them against the catalog, rebuilding the expression tree."""
+    if isinstance(expr, ScalarSubquery):
+        frame = _execute_set(expr.query, cat)
+        cols = frame.columns
+        if len(cols) != 1:
+            raise ValueError("scalar subquery must return exactly one "
+                             f"column, got {len(cols)}: {cols}")
+        values = [_pyval(v) for v in frame.to_pydict()[cols[0]]]
+        if len(values) > 1:
+            raise ValueError("scalar subquery returned more than one row")
+        return E.Lit(values[0] if values else math.nan)
+    if isinstance(expr, SubqueryIn):
+        frame = _execute_set(expr.query, cat)
+        cols = frame.columns
+        if len(cols) != 1:
+            raise ValueError("IN (subquery) must select exactly one "
+                             f"column, got {len(cols)}: {cols}")
+        values = frame.to_pydict()[cols[0]]
+        return E.InList(_resolve_subqueries(expr.child, cat),
+                        [E.Lit(_pyval(v)) for v in values], expr.negated)
+    if isinstance(expr, SubqueryExists):
+        return E.Lit(_execute_set(expr.query, cat).count() > 0)
+    if isinstance(expr, E.BinOp):
+        return E.BinOp(expr.op, _resolve_subqueries(expr.left, cat),
+                       _resolve_subqueries(expr.right, cat))
+    if isinstance(expr, E.UnaryOp):
+        return E.UnaryOp(expr.op, _resolve_subqueries(expr.child, cat))
+    if isinstance(expr, E.InList):
+        return E.InList(_resolve_subqueries(expr.child, cat),
+                        [_resolve_subqueries(v, cat) for v in expr.values],
+                        expr.negated)
+    if isinstance(expr, E.UdfCall):
+        return E.UdfCall(expr.udf_name,
+                         [_resolve_subqueries(a, cat) for a in expr.args],
+                         registry=expr._registry)
+    if isinstance(expr, E.Cast):
+        return E.Cast(_resolve_subqueries(expr.child, cat), expr.type_name)
+    if isinstance(expr, E.StringMatch):
+        return E.StringMatch(expr.kind,
+                             _resolve_subqueries(expr.child, cat),
+                             expr.pattern, negated=expr.negated)
+    if isinstance(expr, E.CaseWhen):
+        return E.CaseWhen(
+            [(_resolve_subqueries(c, cat), _resolve_subqueries(v, cat))
+             for c, v in expr.branches],
+            None if expr.otherwise_expr is None
+            else _resolve_subqueries(expr.otherwise_expr, cat))
+    if isinstance(expr, E.Alias):
+        return E.Alias(_resolve_subqueries(expr.child, cat), expr._name)
+    return expr
+
+
+def _execute_set(q: Query, cat):
+    """Run one set expression (a SELECT plus trailing UNION branches)."""
     frame = _execute_single(q, cat)
     for sub, dedup in q.unions:
         frame = frame.union(_execute_single(sub, cat))
         if dedup:
             frame = frame.distinct()
     return frame
+
+
+def execute(sql: str, catalog=None):
+    """Run a statement (WITH CTEs + query + UNIONs) against the catalog."""
+    from .catalog import default_catalog
+
+    cat = catalog if catalog is not None else default_catalog()
+    q = parse(sql)
+    if q.ctes:
+        cat = _OverlayCatalog(cat)
+        for name, sub in q.ctes:
+            # Later CTEs may reference earlier ones (executed in order).
+            cat.register(name, _execute_set(sub, cat))
+    return _execute_set(q, cat)
 
 
 def _execute_single(q: Query, cat):
@@ -712,10 +918,22 @@ def _execute_single(q: Query, cat):
         from ..frame.frame import Frame
 
         frame = Frame({"__one_row__": [0.0]}).drop("__one_row__")
+    elif isinstance(q.view, DerivedTable):
+        frame = _execute_set(q.view.query, cat)
     else:
         frame = cat.lookup(q.view)
     for view, how, keys in q.joins:
-        frame = frame.join(cat.lookup(view), on=keys or None, how=how)
+        right = (_execute_set(view.query, cat)
+                 if isinstance(view, DerivedTable) else cat.lookup(view))
+        frame = frame.join(right, on=keys or None, how=how)
+    # Uncorrelated subqueries (scalar / IN / EXISTS) resolve to literals
+    # against the same catalog before the enclosing query evaluates.
+    if q.where is not None:
+        q.where = _resolve_subqueries(q.where, cat)
+    if q.having is not None:
+        q.having = _resolve_subqueries(q.having, cat)
+    q.items = [it if isinstance(it, (str, AggExpr))
+               else _resolve_subqueries(it, cat) for it in q.items]
     if q.where is not None:
         frame = frame.filter(q.where)
 

@@ -1,0 +1,193 @@
+"""CTEs, derived tables, and uncorrelated subqueries.
+
+The reference app's SQL surface is two flat SELECTs
+(`DataQuality4MachineLearningApp.java:77-78,89-90`); WITH / derived
+tables / IN-EXISTS-scalar subqueries are the grammar closure a Spark
+user expects from the same engine. All subqueries here are uncorrelated
+(resolved against the catalog before the outer query evaluates — one
+extra fused XLA program per subquery, zero per-row interpretation).
+"""
+
+import numpy as np
+import pytest
+
+import sparkdq4ml_tpu as dq
+from sparkdq4ml_tpu import Frame
+
+
+@pytest.fixture
+def views(session):
+    t = Frame({"guest": [2.0, 10.0, 14.0, 20.0],
+               "price": [30.0, 95.0, 120.0, 200.0]})
+    t.create_or_replace_temp_view("t")
+    g = Frame({"guest": [10.0, 20.0], "tag": [1.0, 2.0]})
+    g.create_or_replace_temp_view("g")
+    return t, g
+
+
+class TestCte:
+    def test_single_cte(self, session, views):
+        out = session.sql("WITH big AS (SELECT guest, price FROM t "
+                          "WHERE price > 90) SELECT count(*) AS n FROM big")
+        assert out.to_pydict()["n"][0] == 3
+
+    def test_chained_ctes_reference_earlier(self, session, views):
+        out = session.sql(
+            "WITH a AS (SELECT guest FROM t WHERE price > 90), "
+            "b AS (SELECT guest FROM a WHERE guest > 12) "
+            "SELECT count(*) AS n FROM b")
+        assert out.to_pydict()["n"][0] == 2
+
+    def test_cte_shadows_catalog_view(self, session, views):
+        # A CTE named like an existing view wins inside the statement...
+        out = session.sql("WITH t AS (SELECT guest FROM g) "
+                          "SELECT count(*) AS n FROM t")
+        assert out.to_pydict()["n"][0] == 2
+        # ...and the catalog view is untouched afterwards.
+        assert session.sql("SELECT count(*) AS n FROM t").to_pydict()["n"][0] == 4
+
+    def test_cte_with_union_inside(self, session, views):
+        out = session.sql(
+            "WITH u AS (SELECT guest FROM t UNION ALL SELECT guest FROM g) "
+            "SELECT count(*) AS n FROM u")
+        assert out.to_pydict()["n"][0] == 6
+
+    def test_column_named_with_still_works(self, session):
+        # WITH is contextual: only the first token starts a CTE clause.
+        f = Frame({"with": [1.0, 2.0]})
+        f.create_or_replace_temp_view("w")
+        # Quoting isn't supported, but selecting the column is fine.
+        assert session.sql("SELECT count(*) AS n FROM w").to_pydict()["n"][0] == 2
+
+
+class TestDerivedTables:
+    def test_from_subquery(self, session, views):
+        out = session.sql("SELECT avg(price) AS ap FROM "
+                          "(SELECT price FROM t WHERE guest > 5) sub")
+        assert out.to_pydict()["ap"][0] == pytest.approx(138.3333, rel=1e-4)
+
+    def test_alias_optional(self, session, views):
+        out = session.sql("SELECT count(*) AS n FROM "
+                          "(SELECT guest FROM t WHERE price > 90)")
+        assert out.to_pydict()["n"][0] == 3
+
+    def test_join_derived_table(self, session, views):
+        out = session.sql("SELECT price, tag FROM t JOIN "
+                          "(SELECT guest, tag FROM g) x USING (guest)")
+        d = out.to_pydict()
+        assert sorted(d["price"].tolist()) == [95.0, 200.0]
+        assert sorted(d["tag"].tolist()) == [1.0, 2.0]
+
+    def test_union_inside_derived(self, session, views):
+        out = session.sql("SELECT count(*) AS n FROM "
+                          "(SELECT guest FROM t UNION ALL SELECT guest FROM g) u")
+        assert out.to_pydict()["n"][0] == 6
+
+    def test_nested_derived(self, session, views):
+        out = session.sql(
+            "SELECT count(*) AS n FROM (SELECT guest FROM "
+            "(SELECT guest, price FROM t WHERE price > 90) i "
+            "WHERE guest > 12) o")
+        assert out.to_pydict()["n"][0] == 2
+
+
+class TestScalarSubquery:
+    def test_where_above_average(self, session, views):
+        out = session.sql(
+            "SELECT guest FROM t WHERE price > (SELECT avg(price) FROM t)")
+        assert out.to_pydict()["guest"].tolist() == [14.0, 20.0]
+
+    def test_select_list(self, session, views):
+        out = session.sql(
+            "SELECT guest, (SELECT max(price) FROM t) AS mp FROM t LIMIT 2")
+        assert out.to_pydict()["mp"].tolist() == [200.0, 200.0]
+
+    def test_empty_result_is_null(self, session, views):
+        # Spark: scalar subquery over zero rows yields NULL; NULL
+        # comparisons are never true.
+        out = session.sql("SELECT guest FROM t WHERE price > "
+                          "(SELECT avg(price) FROM t WHERE guest > 100)")
+        assert out.count() == 0
+
+    def test_multi_row_is_error(self, session, views):
+        with pytest.raises(ValueError, match="more than one row"):
+            session.sql("SELECT guest FROM t WHERE price > "
+                        "(SELECT price FROM t)")
+
+    def test_multi_column_is_error(self, session, views):
+        with pytest.raises(ValueError, match="exactly one column"):
+            session.sql("SELECT guest FROM t WHERE price > "
+                        "(SELECT guest, price FROM t)")
+
+    def test_subquery_in_predicate_positions(self, session, views):
+        # Placeholders are Expr subclasses: IS NULL / BETWEEN compose.
+        assert session.sql("SELECT guest FROM t WHERE "
+                           "(SELECT max(price) FROM t) IS NOT NULL").count() == 4
+        assert session.sql("SELECT guest FROM t WHERE "
+                           "(SELECT max(price) FROM t) "
+                           "BETWEEN 150 AND 250").count() == 4
+        assert session.sql("SELECT guest FROM t WHERE "
+                           "(SELECT max(price) FROM t) "
+                           "BETWEEN 0 AND 100").count() == 0
+
+    def test_unresolved_placeholder_eval_is_clear_error(self, session, views):
+        from sparkdq4ml_tpu.sql.parser import ScalarSubquery, parse
+        t, _ = views
+        ph = ScalarSubquery(parse("SELECT max(price) FROM t"))
+        with pytest.raises(ValueError, match="unresolved subquery"):
+            t.filter(ph)
+
+
+class TestInSubquery:
+    def test_in(self, session, views):
+        out = session.sql(
+            "SELECT price FROM t WHERE guest IN (SELECT guest FROM g)")
+        assert out.to_pydict()["price"].tolist() == [95.0, 200.0]
+
+    def test_not_in(self, session, views):
+        out = session.sql(
+            "SELECT price FROM t WHERE guest NOT IN (SELECT guest FROM g)")
+        assert out.to_pydict()["price"].tolist() == [30.0, 120.0]
+
+    def test_in_literal_list_still_works(self, session, views):
+        out = session.sql("SELECT price FROM t WHERE guest IN (2, 14)")
+        assert out.to_pydict()["price"].tolist() == [30.0, 120.0]
+
+    def test_one_column_enforced(self, session, views):
+        with pytest.raises(ValueError, match="exactly one"):
+            session.sql("SELECT price FROM t WHERE guest IN "
+                        "(SELECT guest, tag FROM g)")
+
+    def test_matches_fluent_isin(self, session, views):
+        t, g = views
+        sql = session.sql(
+            "SELECT price FROM t WHERE guest IN (SELECT guest FROM g)")
+        vals = [float(v) for v in g.to_pydict()["guest"]]
+        fluent = t.filter(t["guest"].isin(vals)).select("price")
+        np.testing.assert_allclose(sql.to_pydict()["price"],
+                                   fluent.to_pydict()["price"])
+
+
+class TestExists:
+    def test_exists_true(self, session, views):
+        out = session.sql("SELECT count(*) AS n FROM t WHERE "
+                          "EXISTS (SELECT guest FROM g WHERE guest > 15)")
+        assert out.to_pydict()["n"][0] == 4
+
+    def test_exists_false(self, session, views):
+        out = session.sql("SELECT count(*) AS n FROM t WHERE "
+                          "EXISTS (SELECT guest FROM g WHERE guest > 100)")
+        assert out.to_pydict()["n"][0] == 0
+
+    def test_not_exists(self, session, views):
+        out = session.sql("SELECT count(*) AS n FROM t WHERE NOT "
+                          "EXISTS (SELECT guest FROM g WHERE guest > 100)")
+        assert out.to_pydict()["n"][0] == 4
+
+    def test_higher_order_exists_unaffected(self, session):
+        # EXISTS(arr, x -> ...) remains the array function.
+        f = Frame({"xs": [[1.0, 5.0], [2.0, 3.0]]})
+        f.create_or_replace_temp_view("hx")
+        out = session.sql(
+            "SELECT exists(xs, x -> x > 4) AS e FROM hx")
+        assert [bool(v) for v in out.to_pydict()["e"]] == [True, False]
