@@ -20,8 +20,9 @@ Grammar (recursive descent):
                   [WHERE or_expr] [GROUP BY ...] [HAVING or_expr]
                   [ORDER BY ...] [LIMIT n]
     relation   := ident | '(' set ')' [AS] [ident]      -- derived table
-    join       := [INNER|LEFT [OUTER]|RIGHT [OUTER]|FULL [OUTER]|CROSS]
-                  JOIN relation (ON ident '=' ident | USING '(' ident,* ')')
+    join       := [INNER|LEFT [OUTER|SEMI|ANTI]|RIGHT [OUTER]|FULL [OUTER]
+                  |CROSS] JOIN relation
+                  (ON ident '=' ident | USING '(' ident,* ')')
     select_list:= '*' | item (',' item)*
     item       := expr [OVER window] [[AS] ident]
     window     := '(' [PARTITION BY ident,*] [ORDER BY ident [ASC|DESC],*]
@@ -275,6 +276,13 @@ class _Parser:
         for kw in ("inner", "left", "right", "full", "cross"):
             if self.accept("kw", kw):
                 how = {"full": "outer"}.get(kw, kw)
+                if kw == "left":
+                    # LEFT SEMI / LEFT ANTI (contextual idents, so columns
+                    # named "semi"/"anti" keep working elsewhere)
+                    if self.accept("ident", "semi"):
+                        how = "left_semi"
+                    elif self.accept("ident", "anti"):
+                        how = "left_anti"
                 self.accept("kw", "outer")
                 break
         if how is None:

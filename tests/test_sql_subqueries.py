@@ -168,6 +168,32 @@ class TestInSubquery:
                                    fluent.to_pydict()["price"])
 
 
+class TestSemiAntiJoin:
+    """LEFT SEMI / LEFT ANTI — the join forms Spark rewrites correlated
+    EXISTS / NOT EXISTS into; here they are first-class SQL."""
+
+    def test_left_semi(self, session, views):
+        out = session.sql("SELECT price FROM t LEFT SEMI JOIN g USING (guest)")
+        assert sorted(out.to_pydict()["price"].tolist()) == [95.0, 200.0]
+
+    def test_left_anti(self, session, views):
+        out = session.sql("SELECT price FROM t LEFT ANTI JOIN g USING (guest)")
+        assert sorted(out.to_pydict()["price"].tolist()) == [30.0, 120.0]
+
+    def test_semi_matches_in_subquery(self, session, views):
+        semi = session.sql(
+            "SELECT price FROM t LEFT SEMI JOIN g USING (guest)")
+        inq = session.sql(
+            "SELECT price FROM t WHERE guest IN (SELECT guest FROM g)")
+        assert sorted(semi.to_pydict()["price"].tolist()) == \
+            sorted(inq.to_pydict()["price"].tolist())
+
+    def test_semi_join_derived_table(self, session, views):
+        out = session.sql("SELECT price FROM t LEFT SEMI JOIN "
+                          "(SELECT guest FROM g WHERE tag > 1) x USING (guest)")
+        assert out.to_pydict()["price"].tolist() == [200.0]
+
+
 class TestExists:
     def test_exists_true(self, session, views):
         out = session.sql("SELECT count(*) AS n FROM t WHERE "
