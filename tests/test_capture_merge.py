@@ -1,0 +1,131 @@
+"""merge_best: the capture daemon's per-measurement min-estimator.
+
+Contention on the shared 1-core host is strictly additive on every
+measured time (observed live: the same sweep captured 0.0247 ms idle vs
+0.3782 ms while pytest ran; sklearn baselines inflated ~2x when a test
+run overlapped the daemon's bench), so min over runs per measurement is
+the honest point estimate — the cross-run analogue of min-over-reps
+inside one run.
+"""
+
+import importlib.util
+import os
+
+import pytest
+
+_SPEC = importlib.util.spec_from_file_location(
+    "tpu_capture_daemon",
+    os.path.join(os.path.dirname(__file__), os.pardir, "scripts",
+                 "tpu_capture_daemon.py"))
+daemon = importlib.util.module_from_spec(_SPEC)
+_SPEC.loader.exec_module(daemon)
+
+
+def _capture(device_ms, baseline_ms, xla_ms=1.0, pallas_ms=None,
+             backend="tpu", **cfg_extra):
+    return {
+        "metric": "m", "value": device_ms, "unit": "ms",
+        "vs_baseline": round(baseline_ms / device_ms, 2),
+        "backend": backend,
+        "pallas_max_rel_diff": 1e-6,
+        "configs": [
+            {"config": "a_linear", "device_ms": device_ms,
+             "baseline_ms": baseline_ms,
+             "vs_baseline": round(baseline_ms / device_ms, 2), **cfg_extra},
+            {"config": "dq_parse_csv_1000000", "native_ms": 80.0,
+             "python_ms": 3000.0, "native_gbps": 0.1,
+             "native_vs_python": 37.5},
+        ],
+        "sweep": [
+            {"rows": 100, "features": 16, "xla_ms": xla_ms,
+             "xla_gbps": 100.0 / xla_ms, "mfu": 0.1,
+             "bf16_ms": None, "pallas_ms": pallas_ms,
+             "pallas_gbps": None if pallas_ms is None else 50.0,
+             **({"pallas_error": "HTTP 500"} if pallas_ms is None else {})},
+        ],
+    }
+
+
+class TestMergeBest:
+    def test_first_run_passthrough(self):
+        m = daemon.merge_best(_capture(1.0, 10.0), None)
+        assert m["runs_merged"] == 1
+        assert m["value"] == 1.0
+
+    def test_min_each_side_independently(self):
+        # Run 1: clean baseline, slow device. Run 2: fast device,
+        # contended (inflated) baseline. The merge takes the best of
+        # each and recomputes the ratio.
+        r1 = _capture(2.0, 10.0)
+        r2 = _capture(1.0, 25.0)
+        m = daemon.merge_best(r2, daemon.merge_best(r1, None))
+        a = m["configs"][0]
+        assert a["device_ms"] == 1.0
+        assert a["baseline_ms"] == 10.0
+        assert a["vs_baseline"] == 10.0
+        assert m["value"] == 1.0
+        assert m["vs_baseline"] == 10.0
+        assert m["runs_merged"] == 2
+        assert "estimator_note" in m
+
+    def test_inverse_fields_rescale(self):
+        r1 = _capture(1.0, 10.0, xla_ms=2.0)   # xla_gbps 50, mfu 0.1
+        r2 = _capture(1.0, 10.0, xla_ms=1.0)   # xla_gbps 100
+        m = daemon.merge_best(r1, daemon.merge_best(r2, None))
+        cell = m["sweep"][0]
+        assert cell["xla_ms"] == 1.0
+        assert cell["xla_gbps"] == pytest.approx(100.0)
+
+    def test_pallas_error_cleared_by_successful_run(self):
+        failed = _capture(1.0, 10.0, pallas_ms=None)
+        ok = _capture(1.0, 10.0, pallas_ms=3.0)
+        m = daemon.merge_best(failed, daemon.merge_best(ok, None))
+        cell = m["sweep"][0]
+        assert cell["pallas_ms"] == 3.0
+        assert "pallas_error" not in cell
+
+    def test_rel_diff_stays_conservative_max(self):
+        r1 = _capture(1.0, 10.0)
+        r1["pallas_max_rel_diff"] = 5e-5
+        r2 = _capture(1.0, 10.0)
+        m = daemon.merge_best(r2, daemon.merge_best(r1, None))
+        assert m["pallas_max_rel_diff"] == 5e-5
+
+    def test_backend_mismatch_resets(self):
+        cpu = _capture(0.5, 10.0, backend="cpu")
+        tpu = _capture(1.0, 10.0)
+        m = daemon.merge_best(tpu, daemon.merge_best(cpu, None))
+        assert m["runs_merged"] == 1
+        assert m["value"] == 1.0
+
+    def test_csv_row_mins(self):
+        r1 = _capture(1.0, 10.0)
+        r1["configs"][1]["native_ms"] = 60.0
+        r2 = _capture(1.0, 10.0)
+        m = daemon.merge_best(r2, daemon.merge_best(r1, None))
+        csv = m["configs"][1]
+        assert csv["native_ms"] == 60.0
+        assert csv["native_vs_python"] == 50.0
+
+
+class TestPruneQuality:
+    def test_quality_ranks_by_device_time(self, tmp_path):
+        import json
+        good = tmp_path / "BENCH_TPU_1.json"
+        good.write_text(json.dumps(_capture(0.5, 10.0)))
+        bad = tmp_path / "BENCH_TPU_2.json"
+        bad.write_text(json.dumps(_capture(1.0, 100.0)))
+        assert daemon._capture_quality(str(good)) > \
+            daemon._capture_quality(str(bad))
+
+    def test_cpu_and_garbage_rank_lowest(self, tmp_path):
+        import json
+        cpu = tmp_path / "a.json"
+        cpu.write_text(json.dumps(_capture(0.1, 10.0, backend="cpu")))
+        garbage = tmp_path / "b.json"
+        garbage.write_text("[1, 2")
+        tpu = tmp_path / "c.json"
+        tpu.write_text(json.dumps(_capture(5.0, 10.0)))
+        assert daemon._capture_quality(str(tpu)) > \
+            daemon._capture_quality(str(cpu))
+        assert daemon._capture_quality(str(garbage)) == float("-inf")
