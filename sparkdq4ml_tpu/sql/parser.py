@@ -228,9 +228,9 @@ class _Parser:
         order_by = []
         if self.accept("kw", "order"):
             self.expect("kw", "by")
-            order_by.append(self.parse_order_item())
+            order_by.append(self.parse_sort_item())
             while self.accept("op", ","):
-                order_by.append(self.parse_order_item())
+                order_by.append(self.parse_sort_item())
         limit = None
         if self.accept("kw", "limit"):
             limit = int(self.expect("number").value)
@@ -313,6 +313,8 @@ class _Parser:
         return (view, how, keys)
 
     def parse_order_item(self):
+        """Window-spec ORDER BY: plain column names only (a window's sort
+        key is a physical column of the partition)."""
         name = self.expect("ident").value
         ascending = True
         if self.accept("kw", "desc"):
@@ -320,6 +322,23 @@ class _Parser:
         else:
             self.accept("kw", "asc")
         return (name, ascending)
+
+    def parse_sort_item(self):
+        """Query-level ORDER BY key: a column name, a 1-based select-item
+        position (``ORDER BY 2``), or any expression — including
+        aggregates (``ORDER BY count(*) DESC``), resolved at execute."""
+        expr = self.parse_or()
+        ascending = True
+        if self.accept("kw", "desc"):
+            ascending = False
+        else:
+            self.accept("kw", "asc")
+        if isinstance(expr, E.Col):
+            return (expr.name, ascending)
+        if (isinstance(expr, E.Lit) and isinstance(expr.value, int)
+                and not isinstance(expr.value, bool)):
+            return (expr.value, ascending)
+        return (expr, ascending)
 
     def parse_select_list(self):
         items = [self.parse_select_item()]
@@ -917,6 +936,42 @@ def execute(sql: str, catalog=None):
     return _execute_set(q, cat)
 
 
+def _referenced_cols(expr, out: set) -> None:
+    """Collect every column name an expression tree references."""
+    if isinstance(expr, E.Col):
+        out.add(expr.name)
+    for attr in ("left", "right", "child", "otherwise_expr"):
+        v = getattr(expr, attr, None)
+        if v is not None:
+            _referenced_cols(v, out)
+    for v in getattr(expr, "args", None) or ():
+        _referenced_cols(v, out)
+    for v in getattr(expr, "values", None) or ():
+        _referenced_cols(v, out)
+    for c, v in getattr(expr, "branches", None) or ():
+        _referenced_cols(c, out)
+        _referenced_cols(v, out)
+
+
+def _sort_with_exprs(frame, order_by, extra_drops=()):
+    """Sort by a mix of column names and expressions: expression keys
+    materialize as temp columns (one fused device pass each), sort, then
+    drop the temps plus any caller-supplied post-sort columns."""
+    cols, asc, temps = [], [], []
+    for i, (key, a) in enumerate(order_by):
+        if isinstance(key, str):
+            cols.append(key)
+        else:
+            tmp = f"__ord_{i}"
+            frame = frame.with_column(tmp, key)
+            temps.append(tmp)
+            cols.append(tmp)
+        asc.append(a)
+    frame = frame.sort(*cols, ascending=asc)
+    drops = temps + [c for c in extra_drops if c in frame.columns]
+    return frame.drop(*drops) if drops else frame
+
+
 def _execute_single(q: Query, cat):
     """Run one SELECT (no union handling) and return a Frame."""
     from ..frame.aggregates import AggExpr
@@ -945,6 +1000,22 @@ def _execute_single(q: Query, cat):
     if q.where is not None:
         frame = frame.filter(q.where)
 
+    # ORDER BY <position>: 1-based index into the select list (Spark/ANSI)
+    if any(isinstance(k, int) for k, _ in q.order_by):
+        resolved = []
+        for key, asc in q.order_by:
+            if isinstance(key, int):
+                if not 1 <= key <= len(q.items):
+                    raise ValueError(f"ORDER BY position {key} is not in "
+                                     f"the select list (1..{len(q.items)})")
+                item = q.items[key - 1]
+                if isinstance(item, str):
+                    raise ValueError(
+                        "ORDER BY position cannot reference *")
+                key = item.name
+            resolved.append((key, asc))
+        q.order_by = resolved
+
     aggs = [it for it in q.items if isinstance(it, AggExpr)]
     having = q.having
     if having is not None and not q.group_by:
@@ -965,8 +1036,23 @@ def _execute_single(q: Query, cat):
             extra_aggs: list = []
             if having is not None:
                 having = _rewrite_having(having, extra_aggs)
-                known = {a.name for a in aggs}
-                extra_aggs = [a for a in extra_aggs if a.name not in known]
+            # ORDER BY over aggregates (``ORDER BY count(*) DESC``):
+            # rewrite agg calls into references to aggregated output
+            # columns, computing any that aren't already in SELECT and
+            # dropping them again after the final sort.
+            order_by = []
+            for key, asc in q.order_by:
+                if not isinstance(key, str):
+                    key = _rewrite_having(key, extra_aggs)
+                    if isinstance(key, E.Col):
+                        key = key.name
+                order_by.append((key, asc))
+            q.order_by = order_by
+            known = {a.name for a in aggs}
+            seen: set = set()
+            extra_aggs = [a for a in extra_aggs
+                          if a.name not in known and a.name not in seen
+                          and not seen.add(a.name)]
             grouped = (frame.rollup(*q.group_by)
                        if q.group_mode == "rollup"
                        else frame.cube(*q.group_by)
@@ -977,7 +1063,18 @@ def _execute_single(q: Query, cat):
                 frame = frame.filter(having)
             keep = [it.name for it in q.items
                     if isinstance(it, (E.Col, AggExpr))]
-            frame = frame.select(*keep)
+            # Columns the final sort still needs (extra aggs referenced
+            # by ORDER BY) survive the projection and drop after sorting.
+            order_needs: set = set()
+            for key, _ in q.order_by:
+                if isinstance(key, str):
+                    order_needs.add(key)
+                else:
+                    _referenced_cols(key, order_needs)
+            drop_after = [c for c in order_needs
+                          if c in frame.columns and c not in keep]
+            frame = frame.select(*keep, *drop_after)
+            q.drop_after_sort = drop_after
         else:
             if non_aggs:
                 raise ValueError("plain columns in an aggregate query "
@@ -1004,6 +1101,17 @@ def _execute_single(q: Query, cat):
             # SQL sorts before projecting, so ORDER BY may reference columns
             # the SELECT drops — sort first when the source has them all
             # (otherwise fall through: the key must be a SELECT alias).
+            # Expression keys materialize as temp columns on the source
+            # frame here (they reference source columns); the projection
+            # below drops the temps for free.
+            keys = []
+            for i, (key, asc) in enumerate(q.order_by):
+                if not isinstance(key, str):
+                    tmp = f"__ord_{i}"
+                    frame = frame.with_column(tmp, key)
+                    key = tmp
+                keys.append((key, asc))
+            q.order_by = keys
             if all(c in frame.columns for c, _ in q.order_by):
                 frame = frame.sort(*[c for c, _ in q.order_by],
                                    ascending=[a for _, a in q.order_by])
@@ -1017,9 +1125,10 @@ def _execute_single(q: Query, cat):
         # first occurrence, so any pre-projection sort order is preserved).
         frame = frame.distinct()
     if q.order_by:
-        cols = [c for c, _ in q.order_by]
-        asc = [a for _, a in q.order_by]
-        frame = frame.sort(*cols, ascending=asc)
+        frame = _sort_with_exprs(frame, q.order_by,
+                                 getattr(q, "drop_after_sort", ()))
+    elif getattr(q, "drop_after_sort", ()):
+        frame = frame.drop(*q.drop_after_sort)
     if q.limit is not None:
         frame = frame.limit(q.limit)
     return frame

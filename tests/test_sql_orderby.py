@@ -1,0 +1,89 @@
+"""Query-level ORDER BY: names, positions, expressions, aggregates.
+
+Spark/ANSI forms beyond the bare column-name key: ``ORDER BY 2`` (select
+position), ``ORDER BY p*-1`` (expression over source columns, projected
+or not), ``ORDER BY count(*) DESC`` (aggregate rewritten to the
+aggregated output column and dropped after the sort). Expression keys
+materialize as one fused device pass each (temp column → sort → drop).
+"""
+
+import pytest
+
+from sparkdq4ml_tpu import Frame
+
+
+@pytest.fixture
+def view(session):
+    f = Frame({"g": [3.0, 1.0, 2.0, 1.0], "p": [10.0, 40.0, 20.0, 5.0]})
+    f.create_or_replace_temp_view("ob")
+    return f
+
+
+class TestOrderByForms:
+    def test_position(self, session, view):
+        out = session.sql("SELECT g, p FROM ob ORDER BY 2")
+        assert out.to_pydict()["p"].tolist() == [5.0, 10.0, 20.0, 40.0]
+
+    def test_position_desc_multi(self, session, view):
+        out = session.sql("SELECT g, p FROM ob ORDER BY 1 DESC, 2 ASC")
+        d = out.to_pydict()
+        assert d["g"].tolist() == [3.0, 2.0, 1.0, 1.0]
+        assert d["p"].tolist() == [10.0, 20.0, 5.0, 40.0]
+
+    def test_position_out_of_range(self, session, view):
+        with pytest.raises(ValueError, match="position 3"):
+            session.sql("SELECT g, p FROM ob ORDER BY 3")
+
+    def test_position_cannot_reference_star(self, session, view):
+        with pytest.raises(ValueError, match="reference"):
+            session.sql("SELECT * FROM ob ORDER BY 1")
+
+    def test_expression_key(self, session, view):
+        out = session.sql("SELECT g, p FROM ob ORDER BY p * -1")
+        assert out.to_pydict()["p"].tolist() == [40.0, 20.0, 10.0, 5.0]
+
+    def test_expression_over_unselected_column(self, session, view):
+        # SQL sorts before projecting: p+g is legal even when only g
+        # survives the SELECT.
+        out = session.sql("SELECT g FROM ob ORDER BY p + g DESC")
+        assert out.to_pydict()["g"].tolist() == [1.0, 2.0, 3.0, 1.0]
+        assert out.columns == ["g"]
+
+    def test_expression_with_star(self, session, view):
+        out = session.sql("SELECT * FROM ob ORDER BY p - g")
+        assert out.to_pydict()["p"].tolist() == [5.0, 10.0, 20.0, 40.0]
+        assert out.columns == ["g", "p"]  # temp sort column dropped
+
+    def test_alias_key_still_works(self, session, view):
+        out = session.sql("SELECT p * 2 AS dp FROM ob ORDER BY dp")
+        assert out.to_pydict()["dp"].tolist() == [10.0, 20.0, 40.0, 80.0]
+
+
+class TestOrderByAggregates:
+    def test_count_star_desc(self, session, view):
+        out = session.sql(
+            "SELECT g FROM ob GROUP BY g ORDER BY count(*) DESC")
+        assert out.to_pydict()["g"].tolist() == [1.0, 2.0, 3.0]
+        assert out.columns == ["g"]  # the helper count column is dropped
+
+    def test_agg_not_in_select(self, session, view):
+        out = session.sql("SELECT g, count(*) AS n FROM ob "
+                          "GROUP BY g ORDER BY sum(p) DESC")
+        d = out.to_pydict()
+        assert d["g"].tolist() == [1.0, 2.0, 3.0]   # sums 45, 20, 10
+        assert d["n"].tolist() == [2, 1, 1]
+        assert out.columns == ["g", "n"]
+
+    def test_agg_expression(self, session, view):
+        out = session.sql("SELECT g FROM ob GROUP BY g "
+                          "ORDER BY max(p) - min(p) DESC")
+        assert out.to_pydict()["g"].tolist() == [1.0, 2.0, 3.0]
+
+    def test_agg_in_select_reused(self, session, view):
+        # count(*) appears in SELECT; ORDER BY reuses that column rather
+        # than computing a duplicate aggregate.
+        out = session.sql("SELECT g, count(*) AS n FROM ob "
+                          "GROUP BY g ORDER BY count(*) DESC, g ASC")
+        d = out.to_pydict()
+        assert d["n"].tolist() == [2, 1, 1]
+        assert d["g"].tolist() == [1.0, 2.0, 3.0]
