@@ -17,8 +17,10 @@ Grammar (recursive descent):
     query      := [WITH ident AS '(' set ')' (',' ident AS '(' set ')')*] set
     set        := select (UNION [ALL] select)*
     select     := SELECT [DISTINCT] select_list FROM relation join*
-                  [WHERE or_expr] [GROUP BY ...] [HAVING or_expr]
-                  [ORDER BY ...] [LIMIT n]
+                  [WHERE or_expr]
+                  [GROUP BY (expr|position),* | ROLLUP/CUBE '(' ident,* ')']
+                  [HAVING or_expr]
+                  [ORDER BY (expr|position) [ASC|DESC],*] [LIMIT n]
     relation   := ident | '(' set ')' [AS] [ident]      -- derived table
     join       := [INNER|LEFT [OUTER|SEMI|ANTI]|RIGHT [OUTER]|FULL [OUTER]
                   |CROSS] JOIN relation
@@ -219,9 +221,9 @@ class _Parser:
                     group_by.append(self.expect("ident").value)
                 self.expect("op", ")")
             else:
-                group_by.append(self.expect("ident").value)
+                group_by.append(self.parse_group_item())
                 while self.accept("op", ","):
-                    group_by.append(self.expect("ident").value)
+                    group_by.append(self.parse_group_item())
         having = None
         if self.accept("kw", "having"):
             having = self.parse_or()
@@ -322,6 +324,18 @@ class _Parser:
         else:
             self.accept("kw", "asc")
         return (name, ascending)
+
+    def parse_group_item(self):
+        """GROUP BY key: a column name, a 1-based select-item position
+        (``GROUP BY 1``), or any expression (``GROUP BY cast(p as int)``);
+        non-name keys resolve at execute. ROLLUP/CUBE keep plain names."""
+        expr = self.parse_or()
+        if isinstance(expr, E.Col):
+            return expr.name
+        if (isinstance(expr, E.Lit) and isinstance(expr.value, int)
+                and not isinstance(expr.value, bool)):
+            return expr.value
+        return expr
 
     def parse_sort_item(self):
         """Query-level ORDER BY key: a column name, a 1-based select-item
@@ -1015,6 +1029,53 @@ def _execute_single(q: Query, cat):
                 key = item.name
             resolved.append((key, asc))
         q.order_by = resolved
+
+    # GROUP BY <position> / <expression>: positions resolve against the
+    # select list; expression keys materialize as device columns before
+    # grouping — under the select item's name when the same expression
+    # appears there (``SELECT cast(p as int) pi ... GROUP BY cast(p as
+    # int)`` groups as ``pi``), else under a temp name the projection
+    # drops. Matched select items become plain Col refs so they are not
+    # re-evaluated against the aggregated frame.
+    if q.group_by and any(not isinstance(k, str) for k in q.group_by):
+        keys = []
+        for j, key in enumerate(q.group_by):
+            if isinstance(key, str):
+                keys.append(key)
+                continue
+            if isinstance(key, int):
+                if not 1 <= key <= len(q.items):
+                    raise ValueError(f"GROUP BY position {key} is not in "
+                                     f"the select list (1..{len(q.items)})")
+                item = q.items[key - 1]
+                if isinstance(item, str):
+                    raise ValueError("GROUP BY position cannot reference *")
+                if isinstance(item, AggExpr):
+                    raise ValueError(
+                        "GROUP BY position cannot reference an aggregate")
+                if isinstance(item, E.Col):
+                    keys.append(item.name)
+                    continue
+                name = item.name
+                frame = frame.with_column(name, item)
+                q.items[key - 1] = E.Col(name)
+                keys.append(name)
+                continue
+            matched = next(
+                (idx for idx, it in enumerate(q.items)
+                 if not isinstance(it, (str, AggExpr))
+                 and (str(it) == str(key)
+                      or (isinstance(it, E.Alias)
+                          and str(it.child) == str(key)))), None)
+            if matched is not None:
+                name = q.items[matched].name
+                frame = frame.with_column(name, q.items[matched])
+                q.items[matched] = E.Col(name)
+            else:
+                name = f"__grp_{j}"
+                frame = frame.with_column(name, key)
+            keys.append(name)
+        q.group_by = keys
 
     aggs = [it for it in q.items if isinstance(it, AggExpr)]
     having = q.having

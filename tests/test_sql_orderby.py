@@ -79,6 +79,37 @@ class TestOrderByAggregates:
                           "ORDER BY max(p) - min(p) DESC")
         assert out.to_pydict()["g"].tolist() == [1.0, 2.0, 3.0]
 
+    def test_group_by_position(self, session, view):
+        out = session.sql("SELECT cast(g as int) gi, count(*) AS n "
+                          "FROM ob GROUP BY 1")
+        d = out.to_pydict()
+        assert d["gi"].tolist() == [1, 2, 3]
+        assert d["n"].tolist() == [2, 1, 1]
+
+    def test_group_by_expression(self, session, view):
+        out = session.sql("SELECT cast(g as int) gi, count(*) AS n "
+                          "FROM ob GROUP BY cast(g as int)")
+        assert out.to_pydict()["n"].tolist() == [2, 1, 1]
+
+    def test_group_by_expression_not_selected(self, session, view):
+        out = session.sql("SELECT count(*) AS n FROM ob "
+                          "GROUP BY cast(g as int)")
+        assert out.to_pydict()["n"].tolist() == [2, 1, 1]
+        assert out.columns == ["n"]  # temp group column dropped
+
+    def test_group_by_position_rejects_star_and_agg(self, session, view):
+        with pytest.raises(ValueError, match="aggregate"):
+            session.sql("SELECT g, count(*) AS n FROM ob GROUP BY 2")
+        with pytest.raises(ValueError, match="position 5"):
+            session.sql("SELECT g FROM ob GROUP BY 5")
+
+    def test_group_by_expr_with_order_by(self, session, view):
+        out = session.sql("SELECT cast(g as int) gi, sum(p) AS sp "
+                          "FROM ob GROUP BY 1 ORDER BY sp DESC")
+        d = out.to_pydict()
+        assert d["gi"].tolist() == [1, 2, 3]
+        assert d["sp"].tolist() == [45.0, 20.0, 10.0]
+
     def test_agg_in_select_reused(self, session, view):
         # count(*) appears in SELECT; ORDER BY reuses that column rather
         # than computing a duplicate aggregate.
