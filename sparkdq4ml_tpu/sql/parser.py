@@ -936,11 +936,45 @@ def _execute_set(q: Query, cat):
     return frame
 
 
+_DDL_RE = re.compile(
+    r"^\s*create\s+(?:or\s+replace\s+)?(?:temp(?:orary)?\s+)?view\s+"
+    r"([A-Za-z_][A-Za-z_0-9]*)\s+as\s+(.*)$",
+    re.IGNORECASE | re.DOTALL)
+_DROP_RE = re.compile(
+    r"^\s*drop\s+(?:temp(?:orary)?\s+)?view\s+(if\s+exists\s+)?"
+    r"([A-Za-z_][A-Za-z_0-9]*)\s*$", re.IGNORECASE)
+
+
 def execute(sql: str, catalog=None):
-    """Run a statement (WITH CTEs + query + UNIONs) against the catalog."""
+    """Run a statement (WITH CTEs + query + UNIONs) against the catalog.
+
+    Besides queries, two DDL forms Spark users reach for from
+    ``session.sql``: ``CREATE [OR REPLACE] [TEMP] VIEW name AS query``
+    (materializes the query and registers it — all views here are temp
+    views over device-resident Frames) and ``DROP [TEMP] VIEW
+    [IF EXISTS] name``. Both return an empty no-column Frame like
+    Spark's DDL commands.
+    """
     from .catalog import default_catalog
 
     cat = catalog if catalog is not None else default_catalog()
+    m = _DDL_RE.match(sql)
+    if m:
+        name, body = m.group(1), m.group(2)
+        frame = execute(body, cat)
+        cat.register(name, frame)
+        from ..frame.frame import Frame
+
+        return Frame({"__one_row__": [0.0]}).drop("__one_row__").limit(0)
+    m = _DROP_RE.match(sql)
+    if m:
+        if_exists, name = bool(m.group(1)), m.group(2)
+        existed = cat.drop(name)
+        if not existed and not if_exists:
+            raise KeyError(f"temp view {name!r} not found")
+        from ..frame.frame import Frame
+
+        return Frame({"__one_row__": [0.0]}).drop("__one_row__").limit(0)
     q = parse(sql)
     if q.ctes:
         cat = _OverlayCatalog(cat)

@@ -168,6 +168,36 @@ class TestInSubquery:
                                    fluent.to_pydict()["price"])
 
 
+class TestViewDdl:
+    """CREATE [OR REPLACE] TEMP VIEW ... AS / DROP VIEW [IF EXISTS]."""
+
+    def test_create_and_query(self, session, views):
+        r = session.sql("CREATE OR REPLACE TEMP VIEW big AS "
+                        "SELECT guest FROM t WHERE price > 90")
+        assert r.count() == 0 and r.columns == []   # Spark DDL shape
+        assert session.sql("SELECT count(*) AS n FROM big") \
+            .to_pydict()["n"][0] == 3
+        session.catalog.drop("big")
+
+    def test_create_with_cte_body(self, session, views):
+        session.sql("CREATE TEMP VIEW v2 AS WITH a AS "
+                    "(SELECT guest FROM t WHERE price > 90) "
+                    "SELECT guest FROM a WHERE guest > 12")
+        assert session.sql("SELECT count(*) AS n FROM v2") \
+            .to_pydict()["n"][0] == 2
+        session.catalog.drop("v2")
+
+    def test_drop_view(self, session, views):
+        session.sql("CREATE TEMP VIEW dv AS SELECT guest FROM t")
+        session.sql("DROP VIEW dv")
+        assert not session.catalog.table_exists("dv")
+
+    def test_drop_missing(self, session, views):
+        session.sql("DROP VIEW IF EXISTS nope")   # silent
+        with pytest.raises(KeyError):
+            session.sql("DROP VIEW nope")
+
+
 class TestSemiAntiJoin:
     """LEFT SEMI / LEFT ANTI — the join forms Spark rewrites correlated
     EXISTS / NOT EXISTS into; here they are first-class SQL."""
