@@ -83,10 +83,16 @@ class AggExpr:
 
     def over(self, spec) -> "Expr":
         """Bind as a window aggregate: ``F.sum("x").over(Window...)``.
-        Only the running aggregates have a windowed form here (as in
-        Spark ≤2.x SQL)."""
+        Running aggregates plus ``first``/``last`` (→ the
+        first_value/last_value window forms) have windowed shapes."""
         from .window import window_agg
 
+        if self.fn in ("first", "last"):
+            if self.ignore_nulls:
+                raise ValueError(f"windowed {self.fn}() does not support "
+                                 "ignoreNulls")
+            expr = window_agg(f"{self.fn}_value", self.column).over(spec)
+            return expr.alias(self._alias) if self._alias else expr
         if self.fn not in _WINDOWABLE:
             raise ValueError(f"windowed {self.fn}() is not supported")
         expr = window_agg(self.fn, self.column).over(spec)

@@ -33,6 +33,8 @@ _RANKING_FNS = ("row_number", "rank", "dense_rank", "percent_rank",
                 "cume_dist", "ntile")
 _OFFSET_FNS = ("lag", "lead")
 _AGG_FNS = ("count", "sum", "avg", "mean", "min", "max")
+# value-picking fns: the value at the frame's first/last/n-th row
+_VALUE_FNS = ("first_value", "last_value", "nth_value")
 
 
 # Spark's frame-boundary sentinels (pyspark.sql.Window uses extreme ints)
@@ -385,6 +387,61 @@ class WindowExpr(Expr):
                 out[s:e] = shifted
             return out, (None if is_string else np.nan), is_string
 
+        if fn in _VALUE_FNS:
+            v = host(func.column)[order]
+            is_string = v.dtype == object
+            ordered = bool(self.spec.order_cols)
+            frame_spec = self.spec.frame
+            if frame_spec is not None and not ordered:
+                kind_, fs_, fe_ = frame_spec
+                if kind_ == "rows" or not (fs_ <= -_UNBOUNDED
+                                           and fe_ >= _UNBOUNDED):
+                    raise ValueError(f"a {kind_.upper()} frame requires an "
+                                     "ORDER BY in its window")
+            if is_string:
+                out = np.full(nv, None, dtype=object)
+            else:
+                v = v.astype(np.float64)
+                out = np.full(nv, np.nan, np.float64)
+            for s, e in zip(starts, ends):
+                n = e - s
+                if n == 0:
+                    continue
+                if frame_spec is not None:
+                    lo, hi, empty = _frame_bounds(frame_spec, peer, s, e, n)
+                elif ordered:
+                    # default frame: RANGE UNBOUNDED PRECEDING..CURRENT ROW
+                    # (incl. peers) — last_value famously tracks the
+                    # current peer group, not the partition end
+                    upto = _peer_upto(peer, s, e)
+                    lo = np.zeros(n, np.int64)
+                    hi = upto - 1
+                    empty = lo > hi
+                else:                    # whole partition
+                    lo = np.zeros(n, np.int64)
+                    hi = np.full(n, n - 1, np.int64)
+                    empty = lo > hi
+                if fn == "first_value":
+                    pick = lo
+                elif fn == "last_value":
+                    pick = hi
+                else:
+                    k = int(func.n)
+                    if k < 1:
+                        raise ValueError(
+                            "nth_value requires a positive offset")
+                    pick = lo + k - 1
+                    empty = empty | (pick > hi)
+                seg = v[s:e]
+                vals = seg[np.clip(pick, 0, n - 1)]
+                if is_string:
+                    res = np.array(vals, dtype=object)
+                    res[empty] = None
+                else:
+                    res = np.where(empty, np.nan, vals)
+                out[s:e] = res
+            return out, (None if is_string else np.nan), is_string
+
         if fn in _AGG_FNS:
             agg = {"mean": "avg"}.get(fn, fn)
             counting_all = agg == "count" and func.column is None
@@ -453,23 +510,17 @@ class WindowExpr(Expr):
         raise ValueError(f"unknown window function {fn!r}")
 
 
-def _framed_agg(agg, frame_spec, seg, cnt, raw, null, peer, s, e):
-    """Aggregate over an explicit ROWS/RANGE frame for one partition
-    (host-side, vectorized): per sorted row r, the inclusive window
-    [r+start, r+end] clipped to the partition (ROWS), or the sentinel
-    RANGE forms resolved through peer groups. Spark semantics for empty /
-    all-null windows: count = 0, sum/avg/min/max = null."""
+def _frame_bounds(frame_spec, peer, s, e, n):
+    """Per-row inclusive frame bounds for one partition (sorted domain):
+    returns ``(lo, hi, empty)``. ROWS offsets clip to the partition;
+    RANGE bounds resolve through peer groups (CURRENT ROW includes all
+    peers, Spark semantics)."""
     kind, fs, fe = frame_spec
-    n = len(seg)
-    if n == 0:
-        return np.empty(0, np.float64)
     r = np.arange(n)
-
     if kind == "range":
-        # peer-group resolved bounds: CURRENT ROW includes all peers
         upto = _peer_upto(peer, s, e)              # rows ≤ last peer
         pk = peer[s:e].copy()
-        pk[0] = True                 # n > 0: the n == 0 case returned above
+        pk[0] = True                               # callers ensure n > 0
         peer_start = np.maximum.accumulate(np.where(pk, r, 0))
         lo = np.zeros(n, np.int64) if fs <= -_UNBOUNDED else peer_start
         hi = np.full(n, n - 1, np.int64) if fe >= _UNBOUNDED else upto - 1
@@ -478,8 +529,19 @@ def _framed_agg(agg, frame_spec, seg, cnt, raw, null, peer, s, e):
             np.clip(r + fs, 0, n)                  # n ⇒ empty below
         hi = np.full(n, n - 1, np.int64) if fe >= _UNBOUNDED else \
             np.clip(r + fe, -1, n - 1)             # −1 ⇒ empty below
+    return lo, hi, lo > hi
 
-    empty = lo > hi
+
+def _framed_agg(agg, frame_spec, seg, cnt, raw, null, peer, s, e):
+    """Aggregate over an explicit ROWS/RANGE frame for one partition
+    (host-side, vectorized): per sorted row r, the inclusive window
+    [r+start, r+end] clipped to the partition (ROWS), or the sentinel
+    RANGE forms resolved through peer groups. Spark semantics for empty /
+    all-null windows: count = 0, sum/avg/min/max = null."""
+    n = len(seg)
+    if n == 0:
+        return np.empty(0, np.float64)
+    lo, hi, empty = _frame_bounds(frame_spec, peer, s, e, n)
     lo_c = np.clip(lo, 0, n - 1)
     hi_c = np.clip(hi, 0, n - 1)
     S = np.concatenate([[0.0], np.cumsum(seg)])
@@ -589,6 +651,26 @@ def lead(col: Union[str, Col], offset: int = 1, default=None) -> WindowFunction:
     """Value of ``col`` ``offset`` rows after the current row."""
     return WindowFunction("lead", column=_colname(col), offset=offset,
                           default=default)
+
+
+def first_value(col: Union[str, Col]) -> WindowFunction:
+    """Value at the frame's first row (default frame: the partition
+    start). Spark's ``first(col).over(w)`` maps here."""
+    return WindowFunction("first_value", column=_colname(col))
+
+
+def last_value(col: Union[str, Col]) -> WindowFunction:
+    """Value at the frame's last row. Under the default frame (RANGE
+    UNBOUNDED PRECEDING..CURRENT ROW) this tracks the current peer
+    group — Spark's famously surprising semantics — not the partition
+    end; add ROWS/RANGE ... UNBOUNDED FOLLOWING for that."""
+    return WindowFunction("last_value", column=_colname(col))
+
+
+def nth_value(col: Union[str, Col], n: int) -> WindowFunction:
+    """Value at the frame's n-th row (1-based); null when the frame has
+    fewer than ``n`` rows."""
+    return WindowFunction("nth_value", column=_colname(col), n=n)
 
 
 def window_agg(fn: str, column: Optional[str]) -> WindowFunction:

@@ -10,8 +10,9 @@ from .frame.aggregates import (approx_count_distinct,
                                median, min, mode, percentile_approx,
                                skewness, stddev, stddev_pop, sum,
                                sum_distinct, sumDistinct, var_pop, variance)
-from .frame.window import (Window, WindowSpec, cume_dist, dense_rank, lag,
-                           lead, ntile, percent_rank, rank, row_number)
+from .frame.window import (Window, WindowSpec, cume_dist, dense_rank,
+                           first_value, lag, last_value, lead, nth_value,
+                           ntile, percent_rank, rank, row_number)
 from .ops.expressions import (acos, array_contains, asin, atan, atan2,
                               base64, call_udf, element_at, size,
                               callUDF, cbrt, ceil, coalesce, col, concat,

@@ -89,7 +89,8 @@ _AGG_FNS_PCT = {"percentile_approx", "approx_percentile"}
 # two-column aggregates: CORR(a, b), COVAR_SAMP(a, b), COVAR_POP(a, b)
 _AGG_FNS_2 = {"corr", "covar_samp", "covar_pop"}
 _WINDOW_FNS = {"row_number", "rank", "dense_rank", "percent_rank",
-               "cume_dist", "ntile", "lag", "lead"}
+               "cume_dist", "ntile", "lag", "lead",
+               "first_value", "last_value", "nth_value"}
 
 
 def _lit_value(expr, what: str):
@@ -441,6 +442,16 @@ class _Parser:
             raise ValueError(
                 f"windowed {fl}() is not supported (Spark <=2.x SQL "
                 "windows the running aggregates only)")
+        if fl in ("first_value", "last_value"):
+            if len(args) != 1 or not isinstance(args[0], E.Col):
+                raise ValueError(f"{fl}(col) requires a column argument")
+            return getattr(W, fl)(args[0].name).over
+        if fl == "nth_value":
+            if (len(args) != 2 or not isinstance(args[0], E.Col)):
+                raise ValueError("nth_value(col, n) requires a column and "
+                                 "an integer literal")
+            return W.nth_value(args[0].name,
+                               int(_lit_value(args[1], "nth_value n"))).over
         if fl in ("lag", "lead"):
             if not args or not isinstance(args[0], E.Col):
                 raise ValueError(f"{fl}(col[, offset[, default]]) requires a "

@@ -27,6 +27,72 @@ def _by_name(frame, value_col):
     return {n: v for n, v in zip(d["name"], d[value_col])}
 
 
+class TestValueFunctions:
+    """first_value/last_value/nth_value — frame-positional value picks."""
+
+    def test_first_value_partition_start(self, sales):
+        w = F.Window.partitionBy("dept").orderBy("amount")
+        got = _by_name(sales.withColumn("fv", F.first_value("amount").over(w)),
+                       "fv")
+        assert got["u"] == got["z"] == 10.0
+        assert got["x"] == got["y"] == 5.0
+
+    def test_last_value_default_frame_tracks_peers(self, sales):
+        # Spark's famous default-frame semantics: the frame ends at the
+        # current row's LAST PEER, so ties (30, 30) see each other.
+        w = F.Window.partitionBy("dept").orderBy("amount")
+        got = _by_name(sales.withColumn("lv", F.last_value("amount").over(w)),
+                       "lv")
+        assert got["u"] == 10.0
+        assert got["v"] == got["w"] == 30.0   # peer group of the tie
+        assert got["z"] == 50.0
+
+    def test_last_value_unbounded_frame(self, sales):
+        w = (F.Window.partitionBy("dept").orderBy("amount")
+             .rowsBetween(F.Window.unboundedPreceding,
+                          F.Window.unboundedFollowing))
+        got = _by_name(sales.withColumn("lv", F.last_value("amount").over(w)),
+                       "lv")
+        assert got["u"] == got["z"] == 50.0
+        assert got["x"] == got["y"] == 7.0
+
+    def test_nth_value_null_before_n_rows(self, sales):
+        w = F.Window.partitionBy("dept").orderBy("amount")
+        got = _by_name(sales.withColumn("nv",
+                                        F.nth_value("amount", 2).over(w)),
+                       "nv")
+        assert np.isnan(got["u"])              # frame has 1 row
+        assert got["z"] == 30.0
+        assert np.isnan(got["x"]) and got["y"] == 7.0
+
+    def test_first_agg_maps_to_first_value(self, sales):
+        w = F.Window.partitionBy("dept").orderBy("amount")
+        got = _by_name(sales.withColumn("fv", F.first("amount").over(w)),
+                       "fv")
+        assert got["u"] == got["z"] == 10.0
+
+    def test_sql_forms(self, session, sales):
+        sales.create_or_replace_temp_view("sales_vw")
+        out = session.sql(
+            "SELECT name, first_value(amount) OVER "
+            "(PARTITION BY dept ORDER BY amount) AS fv, "
+            "nth_value(amount, 2) OVER "
+            "(PARTITION BY dept ORDER BY amount "
+            "ROWS BETWEEN 1 PRECEDING AND 1 FOLLOWING) AS nv "
+            "FROM sales_vw")
+        got_fv = _by_name(out, "fv")
+        assert got_fv["u"] == 10.0 and got_fv["x"] == 5.0
+
+    def test_string_column_values(self, session):
+        f = Frame({"k": [1.0, 1.0, 2.0],
+                   "s": np.asarray(["b", "a", "c"], dtype=object),
+                   "v": [2.0, 1.0, 3.0]})
+        w = F.Window.partitionBy("k").orderBy("v")
+        out = f.withColumn("fv", F.first_value("s").over(w)).to_pydict()
+        by_v = dict(zip(out["v"].tolist(), out["fv"]))
+        assert by_v[1.0] == "a" and by_v[2.0] == "a" and by_v[3.0] == "c"
+
+
 class TestRanking:
     def test_row_number(self, sales):
         w = F.Window.partitionBy("dept").orderBy("amount")
