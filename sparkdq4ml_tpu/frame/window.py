@@ -392,12 +392,9 @@ class WindowExpr(Expr):
             is_string = v.dtype == object
             ordered = bool(self.spec.order_cols)
             frame_spec = self.spec.frame
-            if frame_spec is not None and not ordered:
-                kind_, fs_, fe_ = frame_spec
-                if kind_ == "rows" or not (fs_ <= -_UNBOUNDED
-                                           and fe_ >= _UNBOUNDED):
-                    raise ValueError(f"a {kind_.upper()} frame requires an "
-                                     "ORDER BY in its window")
+            _require_order_for_frame(frame_spec, ordered)
+            if fn == "nth_value" and int(func.n) < 1:
+                raise ValueError("nth_value requires a positive offset")
             if is_string:
                 out = np.full(nv, None, dtype=object)
             else:
@@ -426,11 +423,7 @@ class WindowExpr(Expr):
                 elif fn == "last_value":
                     pick = hi
                 else:
-                    k = int(func.n)
-                    if k < 1:
-                        raise ValueError(
-                            "nth_value requires a positive offset")
-                    pick = lo + k - 1
+                    pick = lo + int(func.n) - 1
                     empty = empty | (pick > hi)
                 seg = v[s:e]
                 vals = seg[np.clip(pick, 0, n - 1)]
@@ -440,7 +433,9 @@ class WindowExpr(Expr):
                 else:
                     res = np.where(empty, np.nan, vals)
                 out[s:e] = res
-            return out, (None if is_string else np.nan), is_string
+            if is_string:
+                return out, None, True
+            return out.astype(fdt), np.nan, False
 
         if fn in _AGG_FNS:
             agg = {"mean": "avg"}.get(fn, fn)
@@ -462,15 +457,7 @@ class WindowExpr(Expr):
                     null = np.isnan(v)
             ordered = bool(self.spec.order_cols)
             frame_spec = self.spec.frame
-            if frame_spec is not None and not ordered:
-                kind_, fs_, fe_ = frame_spec
-                # Spark: ROWS frames always need ordering; RANGE frames
-                # need it whenever a CURRENT ROW bound makes the frame
-                # row-dependent (unbounded-both is the only orderless form)
-                if kind_ == "rows" or not (fs_ <= -_UNBOUNDED
-                                           and fe_ >= _UNBOUNDED):
-                    raise ValueError(f"a {kind_.upper()} frame requires an "
-                                     "ORDER BY in its window")
+            _require_order_for_frame(frame_spec, ordered)
             out = np.empty(nv, np.float64)
             for s, e in zip(starts, ends):
                 seg = np.where(null[s:e], 0.0, v[s:e])
@@ -508,6 +495,18 @@ class WindowExpr(Expr):
             return out.astype(fdt), np.nan, False
 
         raise ValueError(f"unknown window function {fn!r}")
+
+
+def _require_order_for_frame(frame_spec, ordered: bool) -> None:
+    """Spark: ROWS frames always need ordering; RANGE frames need it
+    whenever a CURRENT ROW bound makes the frame row-dependent
+    (unbounded-both is the only orderless form)."""
+    if frame_spec is not None and not ordered:
+        kind_, fs_, fe_ = frame_spec
+        if kind_ == "rows" or not (fs_ <= -_UNBOUNDED
+                                   and fe_ >= _UNBOUNDED):
+            raise ValueError(f"a {kind_.upper()} frame requires an "
+                             "ORDER BY in its window")
 
 
 def _frame_bounds(frame_spec, peer, s, e, n):
