@@ -99,6 +99,57 @@ def main() -> None:
     assert exploded.count() == 2 * pair.count()
     print("explode: 3 rows x split-array(2) ->", exploded.count(), "rows")
 
+    # -- CTEs + uncorrelated subqueries -----------------------------------
+    premium = spark.sql(
+        "WITH stats AS (SELECT avg(price) AS ap FROM clean) "
+        "SELECT guest, price FROM clean "
+        "WHERE price > (SELECT ap FROM stats) ORDER BY price DESC LIMIT 5")
+    mean_price = float(np.mean(clean.to_pydict()["price"]))
+    assert all(float(p) > mean_price
+               for p in premium.to_pydict()["price"])
+    print("CTE + scalar subquery: top-5 above-average prices:",
+          [round(float(p), 1) for p in premium.to_pydict()["price"]])
+
+    # LEFT SEMI agrees with IN (subquery) — the rewrite Spark itself does
+    semi = spark.sql("SELECT price FROM clean LEFT SEMI JOIN busy "
+                     "USING (guest)")
+    in_sub = spark.sql("SELECT price FROM clean "
+                       "WHERE guest IN (SELECT guest FROM busy)")
+    assert semi.count() == in_sub.count()
+    print(f"semi-join == IN(subquery): {semi.count()} rows both ways")
+
+    # -- derived table + ORDER BY aggregate -------------------------------
+    spread = spark.sql(
+        "SELECT guest, max(price) - min(price) AS spread "
+        "FROM (SELECT guest, price FROM clean WHERE guest > 1) g "
+        "GROUP BY guest ORDER BY max(price) - min(price) DESC LIMIT 3")
+    s_vals = [float(v) for v in spread.to_pydict()["spread"]]
+    assert s_vals == sorted(s_vals, reverse=True)
+    print("derived table + ORDER BY agg: top spreads:", s_vals)
+
+    # -- window value functions -------------------------------------------
+    fv = spark.sql(
+        "SELECT guest, price, first_value(price) OVER "
+        "(PARTITION BY guest ORDER BY price) AS cheapest FROM clean")
+    d = fv.to_pydict()
+    by_guest: dict = {}
+    for g, p in zip(d["guest"].tolist(), d["price"].tolist()):
+        by_guest[g] = min(by_guest.get(g, p), p)
+    assert all(float(c) == by_guest[g]
+               for g, c in zip(d["guest"].tolist(), d["cheapest"].tolist()))
+    print("first_value OVER: per-guest cheapest verified on",
+          len(by_guest), "guests")
+
+    # -- SQL DDL ----------------------------------------------------------
+    spark.sql("CREATE OR REPLACE TEMP VIEW premium AS "
+              "SELECT guest, price FROM clean WHERE price > 90")
+    assert spark.catalog.table_exists("premium")
+    n_premium = spark.sql("SELECT count(*) AS n FROM premium") \
+        .to_pydict()["n"][0]
+    spark.sql("DROP VIEW premium")
+    assert not spark.catalog.table_exists("premium")
+    print(f"DDL: CREATE TEMP VIEW ({n_premium} rows) + DROP round-trip")
+
     spark.stop()
     print("sql_tour OK")
 

@@ -111,6 +111,47 @@ def _check_agg_args(fn: str, col, args) -> None:
         raise ValueError(f"{fn} argument must be * or a column name")
 
 
+class _AggRef(E.Expr):
+    """A parsed aggregate appearing inside select-list arithmetic
+    (``SELECT max(p) - min(p)``): carries the AggExpr; rewritten to a
+    Col over the aggregated output before any eval."""
+
+    def __init__(self, agg):
+        self.agg = agg
+
+    @property
+    def name(self) -> str:
+        return self.agg.name
+
+    def __str__(self):
+        return self.agg.name
+
+    def eval(self, frame):
+        raise ValueError(
+            "aggregate expressions are only valid in a SQL select list — "
+            "this tree still holds an unresolved aggregate reference")
+
+
+class PostAggItem:
+    """A select item that is an expression OVER aggregate results
+    (``max(p) - min(p) AS spread``): ``expr`` references the aggregated
+    output columns of ``aggs``, and is computed on the aggregated frame."""
+
+    __slots__ = ("expr", "aggs", "_name")
+
+    def __init__(self, expr, aggs, name=None):
+        self.expr = expr
+        self.aggs = list(aggs)
+        self._name = name
+
+    @property
+    def name(self) -> str:
+        return self._name if self._name is not None else str(self.expr)
+
+    def alias(self, name: str) -> "PostAggItem":
+        return PostAggItem(self.expr, self.aggs, name)
+
+
 class _Token:
     __slots__ = ("kind", "value")
 
@@ -525,19 +566,35 @@ class _Parser:
             else:
                 raise ValueError(f"window function {fn}() requires an "
                                  "OVER clause")
-            if self.accept("kw", "as"):
-                return expr.alias(self.expect("ident").value)
-            alias = self.accept("ident")
-            if alias is not None:
-                return expr.alias(alias.value)
-            return expr
-        expr = self.parse_or()
+            from ..frame.aggregates import AggExpr as _AggE
+
+            # Aggregate arithmetic in the select list (``SELECT max(p) -
+            # min(p) AS spread``): continue precedence climbing with the
+            # parsed aggregate as the left operand, then detect below.
+            if (isinstance(expr, _AggE)
+                    and self.peek().kind == "op"
+                    and self.peek().value in ("+", "-", "*", "/")):
+                expr = self.parse_add(_AggRef(expr))
+            else:
+                if self.accept("kw", "as"):
+                    return expr.alias(self.expect("ident").value)
+                alias = self.accept("ident")
+                if alias is not None:
+                    return expr.alias(alias.value)
+                return expr
+        else:
+            expr = self.parse_or()
+        # Post-aggregate detection: an expression whose tree contains
+        # aggregate calls projects over the aggregated frame.
+        collected: list = []
+        rewritten = _rewrite_having(expr, collected)
+        item = PostAggItem(rewritten, collected) if collected else expr
         if self.accept("kw", "as"):
-            return expr.alias(self.expect("ident").value)
+            return item.alias(self.expect("ident").value)
         alias = self.accept("ident")
         if alias is not None:  # bare alias: `cast(guest as int) guest`
-            return expr.alias(alias.value)
-        return expr
+            return item.alias(alias.value)
+        return item
 
     # -- expressions (precedence climbing) ----------------------------------
     def parse_or(self):
@@ -601,8 +658,8 @@ class _Parser:
             return E.StringMatch("like", left, pat, negated=negated)
         return left
 
-    def parse_add(self):
-        left = self.parse_mul()
+    def parse_add(self, left=None):
+        left = self.parse_mul(left)
         while True:
             if self.accept("op", "+"):
                 left = E.BinOp("+", left, self.parse_mul())
@@ -611,8 +668,8 @@ class _Parser:
             else:
                 return left
 
-    def parse_mul(self):
-        left = self.parse_unary()
+    def parse_mul(self, left=None):
+        left = self.parse_unary() if left is None else left
         while True:
             if self.accept("op", "*"):
                 left = E.BinOp("*", left, self.parse_unary())
@@ -828,7 +885,12 @@ def _rewrite_having(expr, extra_aggs: list):
     from ..frame.aggregates import AggExpr
 
     having_aggs = _AGG_FNS | _AGG_FNS_2 | {"count_distinct", "sum_distinct"}
-    if isinstance(expr, E.UdfCall) and expr.udf_name.lower() in having_aggs:
+    if isinstance(expr, _AggRef):
+        extra_aggs.append(expr.agg)
+        return E.Col(expr.agg.name)
+    if (isinstance(expr, E.UdfCall) and expr.udf_name.lower() in having_aggs
+            and (len(expr.args) <= 1
+                 or expr.udf_name.lower() in _AGG_FNS_2)):
         fn = expr.udf_name.lower()
         if fn in _AGG_FNS_2:
             if (len(expr.args) != 2
@@ -857,6 +919,19 @@ def _rewrite_having(expr, extra_aggs: list):
         return E.InList(_rewrite_having(expr.child, extra_aggs),
                         [_rewrite_having(v, extra_aggs) for v in expr.values],
                         expr.negated)
+    if isinstance(expr, E.UdfCall):     # non-aggregate call: recurse args
+        return E.UdfCall(expr.udf_name,
+                         [_rewrite_having(a, extra_aggs) for a in expr.args],
+                         registry=expr._registry)
+    if isinstance(expr, E.Cast):
+        return E.Cast(_rewrite_having(expr.child, extra_aggs),
+                      expr.type_name)
+    if isinstance(expr, E.CaseWhen):
+        return E.CaseWhen(
+            [(_rewrite_having(c, extra_aggs), _rewrite_having(v, extra_aggs))
+             for c, v in expr.branches],
+            None if expr.otherwise_expr is None
+            else _rewrite_having(expr.otherwise_expr, extra_aggs))
     return expr
 
 
@@ -934,6 +1009,9 @@ def _resolve_subqueries(expr, cat):
             else _resolve_subqueries(expr.otherwise_expr, cat))
     if isinstance(expr, E.Alias):
         return E.Alias(_resolve_subqueries(expr.child, cat), expr._name)
+    if isinstance(expr, PostAggItem):
+        return PostAggItem(_resolve_subqueries(expr.expr, cat),
+                           expr.aggs, expr._name)
     return expr
 
 
@@ -1123,16 +1201,26 @@ def _execute_single(q: Query, cat):
         q.group_by = keys
 
     aggs = [it for it in q.items if isinstance(it, AggExpr)]
+    post_items = [it for it in q.items if isinstance(it, PostAggItem)]
+    # Component aggregates a post-agg expression needs, minus those the
+    # select list already computes (dedup by output-column name).
+    known_names = {a.name for a in aggs}
+    component_aggs = []
+    for it in post_items:
+        for a in it.aggs:
+            if a.name not in known_names:
+                known_names.add(a.name)
+                component_aggs.append(a)
     having = q.having
     if having is not None and not q.group_by:
         raise ValueError("HAVING requires GROUP BY")
-    if aggs or q.group_by:
+    if aggs or post_items or q.group_by:
         if any(isinstance(it, str) and it == "*" for it in q.items):
             raise ValueError(
                 "SELECT * cannot be combined with aggregates/GROUP BY; "
                 "list the grouped columns explicitly")
         non_aggs = [it for it in q.items
-                    if not isinstance(it, (AggExpr, str))]
+                    if not isinstance(it, (AggExpr, PostAggItem, str))]
         for it in non_aggs:
             if not isinstance(it, E.Col) or (q.group_by
                                              and it.name not in q.group_by):
@@ -1154,7 +1242,8 @@ def _execute_single(q: Query, cat):
                         key = key.name
                 order_by.append((key, asc))
             q.order_by = order_by
-            known = {a.name for a in aggs}
+            known = {a.name for a in aggs} \
+                | {a.name for a in component_aggs}
             seen: set = set()
             extra_aggs = [a for a in extra_aggs
                           if a.name not in known and a.name not in seen
@@ -1164,11 +1253,13 @@ def _execute_single(q: Query, cat):
                        else frame.cube(*q.group_by)
                        if q.group_mode == "cube"
                        else frame.group_by(*q.group_by))
-            frame = grouped.agg(*aggs, *extra_aggs)
+            frame = grouped.agg(*aggs, *component_aggs, *extra_aggs)
             if having is not None:
                 frame = frame.filter(having)
+            for it in post_items:
+                frame = frame.with_column(it.name, it.expr)
             keep = [it.name for it in q.items
-                    if isinstance(it, (E.Col, AggExpr))]
+                    if isinstance(it, (E.Col, AggExpr, PostAggItem))]
             # Columns the final sort still needs (extra aggs referenced
             # by ORDER BY) survive the projection and drop after sorting.
             order_needs: set = set()
@@ -1185,7 +1276,11 @@ def _execute_single(q: Query, cat):
             if non_aggs:
                 raise ValueError("plain columns in an aggregate query "
                                  "require GROUP BY")
-            frame = frame.agg(*aggs)
+            frame = frame.agg(*aggs, *component_aggs)
+            if post_items:
+                for it in post_items:
+                    frame = frame.with_column(it.name, it.expr)
+                frame = frame.select(*[it.name for it in q.items])
     else:
         # NB: Expr overloads ==, so compare with identity-safe checks, never
         # `items == ["*"]` (a single-Expr list would compare truthy).

@@ -59,6 +59,46 @@ class TestOrderByForms:
         assert out.to_pydict()["dp"].tolist() == [10.0, 20.0, 40.0, 80.0]
 
 
+class TestPostAggregateSelect:
+    """Arithmetic over aggregates in the select list — computed on the
+    aggregated frame from component aggregates (deduped by name)."""
+
+    def test_grouped_spread(self, session, view):
+        out = session.sql("SELECT g, max(p) - min(p) AS spread "
+                          "FROM ob GROUP BY g")
+        d = out.to_pydict()
+        assert dict(zip(d["g"].tolist(), d["spread"].tolist())) == \
+            {1.0: 35.0, 2.0: 0.0, 3.0: 0.0}
+
+    def test_global_aggregate_expression(self, session, view):
+        out = session.sql("SELECT max(p) - min(p) AS spread FROM ob")
+        assert out.to_pydict()["spread"].tolist() == [35.0]
+
+    def test_component_reuse_with_bare_agg(self, session, view):
+        # sum(p)/count(*) shares nothing with avg(p) but both compute
+        out = session.sql("SELECT sum(p) / count(*) AS m, avg(p) AS a "
+                          "FROM ob")
+        d = out.to_pydict()
+        assert d["m"][0] == pytest.approx(d["a"][0])
+
+    def test_scalar_on_left(self, session, view):
+        out = session.sql("SELECT 100 * count(*) AS c FROM ob")
+        assert out.to_pydict()["c"].tolist() == [400]
+
+    def test_nested_in_scalar_fn(self, session, view):
+        out = session.sql("SELECT abs(min(p) - 15) AS a FROM ob")
+        assert out.to_pydict()["a"].tolist() == [10.0]
+
+    def test_order_and_having_interplay(self, session, view):
+        out = session.sql("SELECT g, max(p) - min(p) AS spread FROM ob "
+                          "GROUP BY g HAVING count(*) > 1 "
+                          "ORDER BY spread DESC")
+        d = out.to_pydict()
+        assert d["g"].tolist() == [1.0]
+        assert d["spread"].tolist() == [35.0]
+        assert out.columns == ["g", "spread"]   # components dropped
+
+
 class TestOrderByAggregates:
     def test_count_star_desc(self, session, view):
         out = session.sql(
