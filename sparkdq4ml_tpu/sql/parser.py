@@ -1212,8 +1212,12 @@ def _execute_single(q: Query, cat):
                 known_names.add(a.name)
                 component_aggs.append(a)
     having = q.having
-    if having is not None and not q.group_by:
-        raise ValueError("HAVING requires GROUP BY")
+    if (having is not None and not q.group_by
+            and not (aggs or post_items)):
+        # Spark allows HAVING without GROUP BY only over an aggregate
+        # projection (it filters the single global-aggregate row).
+        raise ValueError("HAVING requires GROUP BY or an aggregate "
+                         "select list")
     if aggs or post_items or q.group_by:
         if any(isinstance(it, str) and it == "*" for it in q.items):
             raise ValueError(
@@ -1276,8 +1280,20 @@ def _execute_single(q: Query, cat):
             if non_aggs:
                 raise ValueError("plain columns in an aggregate query "
                                  "require GROUP BY")
-            frame = frame.agg(*aggs, *component_aggs)
-            if post_items:
+            # Global aggregate: HAVING filters the single result row
+            # (Spark's groupless HAVING), using component aggregates
+            # that are computed then dropped by the final projection.
+            having_extras: list = []
+            if having is not None:
+                having = _rewrite_having(having, having_extras)
+                names = {a.name for a in aggs} \
+                    | {a.name for a in component_aggs}
+                having_extras = [a for a in having_extras
+                                 if a.name not in names]
+            frame = frame.agg(*aggs, *component_aggs, *having_extras)
+            if having is not None:
+                frame = frame.filter(having)
+            if post_items or having_extras or component_aggs:
                 for it in post_items:
                     frame = frame.with_column(it.name, it.expr)
                 frame = frame.select(*[it.name for it in q.items])

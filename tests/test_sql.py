@@ -186,9 +186,14 @@ class TestDistinctHavingUnion:
                       "HAVING COUNT(*) >= 2", session.catalog)
         assert sorted(r[0] for r in out.collect()) == ["a", "b"]
 
-    def test_having_without_group_by_rejected(self, session, sales):
-        with pytest.raises(ValueError, match="HAVING requires GROUP BY"):
-            execute("SELECT SUM(amt) FROM sales HAVING SUM(amt) > 0",
+    def test_having_without_group_by(self, session, sales):
+        # Spark: groupless HAVING filters the global-aggregate row; it is
+        # rejected only when the select list has no aggregate at all.
+        out = execute("SELECT SUM(amt) FROM sales HAVING SUM(amt) > 0",
+                      session.catalog)
+        assert out.count() == 1
+        with pytest.raises(ValueError, match="HAVING requires"):
+            execute("SELECT dept FROM sales HAVING SUM(amt) > 0",
                     session.catalog)
 
     def test_union_all(self, session, sales):

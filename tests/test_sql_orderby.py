@@ -89,6 +89,19 @@ class TestPostAggregateSelect:
         out = session.sql("SELECT abs(min(p) - 15) AS a FROM ob")
         assert out.to_pydict()["a"].tolist() == [10.0]
 
+    def test_groupless_having(self, session, view):
+        # Spark: HAVING without GROUP BY filters the global-agg row.
+        assert session.sql("SELECT count(*) AS n FROM ob "
+                           "HAVING count(*) > 2").to_pydict()["n"] \
+            .tolist() == [4]
+        assert session.sql("SELECT count(*) AS n FROM ob "
+                           "HAVING count(*) > 9").count() == 0
+        out = session.sql("SELECT avg(p) AS a FROM ob HAVING max(p) > 30")
+        assert out.columns == ["a"]          # having's max(p) dropped
+        assert out.count() == 1
+        with pytest.raises(ValueError, match="HAVING requires"):
+            session.sql("SELECT g FROM ob HAVING count(*) > 1")
+
     def test_order_and_having_interplay(self, session, view):
         out = session.sql("SELECT g, max(p) - min(p) AS spread FROM ob "
                           "GROUP BY g HAVING count(*) > 1 "
