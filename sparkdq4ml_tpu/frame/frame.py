@@ -1065,8 +1065,11 @@ class Frame:
     def sort(self, *cols, ascending=True) -> "Frame":
         """``orderBy`` — reorders valid rows (host argsort at the boundary),
         dropping masked slots (the result is compact). Columns may be
-        names, ``Col``s, or ``col.asc()``/``col.desc()`` sort markers
-        (a marker's direction overrides ``ascending`` for that column)."""
+        names, ``Col``s, or ``col.asc()``/``col.desc()`` (+
+        ``*_nulls_first/last``) sort markers — a marker's direction and
+        null placement override ``ascending`` for that column. Default
+        null placement is Spark's: nulls first ascending, last
+        descending (NaN is the numeric null)."""
         from ..ops.expressions import SortOrder
 
         if not cols:
@@ -1075,11 +1078,13 @@ class Frame:
                else list(ascending))
         if len(asc) != len(cols):
             raise ValueError("ascending list must match columns")
+        nulls_first: list = [None] * len(cols)
         resolved = []
         for i, c in enumerate(cols):
             if isinstance(c, SortOrder):
                 name = c.name
                 asc[i] = c.ascending
+                nulls_first[i] = c.nulls_first
             elif isinstance(c, str):
                 name = c
             else:
@@ -1093,23 +1098,30 @@ class Frame:
         cols = resolved
         d = self.to_pydict()
         keys = []
-        for c, a in zip(reversed(cols), reversed(asc)):
+        for c, a, nf in zip(reversed(cols), reversed(asc),
+                            reversed(nulls_first)):
+            if nf is None:
+                nf = a                 # Spark default: asc→first, desc→last
             k = np.asarray(d[c])
             if k.dtype == object:
                 if not a:
                     raise ValueError("descending sort on string columns is "
                                      "not supported")
-                # nulls first (Spark's NULLS FIRST for ascending order):
-                # secondary key = value with None mapped to "", primary
-                # (appended later = higher priority) = null flag
                 null_flag = np.asarray([x is None for x in k], bool)
                 keys.append(np.asarray([x if x is not None else "" for x in k],
                                        dtype=object))
-                keys.append(~null_flag)
-                continue
-            if not a:
-                k = -k
-            keys.append(k)
+            else:
+                null_flag = np.isnan(k) if np.issubdtype(
+                    k.dtype, np.floating) else np.zeros(len(k), bool)
+                v = -k if not a else k
+                # NaN would float to the end inside lexsort regardless of
+                # the flag key; neutralize it so the flag alone decides
+                keys.append(np.where(null_flag, 0.0, v)
+                            if null_flag.any() else v)
+            # appended last = higher lexsort priority: the null flag
+            # partitions each key before its values order within
+            # (False sorts first, so nulls-first wants nulls=False)
+            keys.append(~null_flag if nf else null_flag)
         order = np.lexsort(keys)
         return Frame({name: (vals[order] if vals.dtype == object
                              else np.asarray(vals)[order])

@@ -151,13 +151,25 @@ class Expr:
 
     def asc(self) -> "SortOrder":
         """Ascending sort marker for ``sort``/``orderBy``/window specs.
-        Null placement follows the engine's column kind: string None
-        sorts first; float NaN-nulls sort last (numpy ordering)."""
+        Default null placement is Spark's: nulls first ascending, nulls
+        last descending (the _nulls_first/_nulls_last variants pin it)."""
         return SortOrder(self, True)
 
     def desc(self) -> "SortOrder":
         """Descending sort marker (see ``asc`` for null placement)."""
         return SortOrder(self, False)
+
+    def asc_nulls_first(self) -> "SortOrder":
+        return SortOrder(self, True, nulls_first=True)
+
+    def asc_nulls_last(self) -> "SortOrder":
+        return SortOrder(self, True, nulls_first=False)
+
+    def desc_nulls_first(self) -> "SortOrder":
+        return SortOrder(self, False, nulls_first=True)
+
+    def desc_nulls_last(self) -> "SortOrder":
+        return SortOrder(self, False, nulls_first=False)
 
     # -- operators --------------------------------------------------------
     def _bin(self, op, other, reverse=False):
@@ -189,12 +201,15 @@ class Expr:
 
 
 class SortOrder:
-    """Sort-direction marker from ``col.asc()`` / ``col.desc()`` —
-    consumed by ``Frame.sort``; not an evaluable expression."""
+    """Sort-direction marker from ``col.asc()`` / ``col.desc()`` (and the
+    ``*_nulls_first/last`` variants) — consumed by ``Frame.sort``; not an
+    evaluable expression. ``nulls_first=None`` means the Spark default
+    for the direction: first when ascending, last when descending."""
 
-    def __init__(self, child: "Expr", ascending: bool):
+    def __init__(self, child: "Expr", ascending: bool, nulls_first=None):
         self.child = child
         self.ascending = ascending
+        self.nulls_first = nulls_first
 
     @property
     def name(self) -> str:

@@ -382,18 +382,33 @@ class _Parser:
     def parse_sort_item(self):
         """Query-level ORDER BY key: a column name, a 1-based select-item
         position (``ORDER BY 2``), or any expression — including
-        aggregates (``ORDER BY count(*) DESC``), resolved at execute."""
+        aggregates (``ORDER BY count(*) DESC``), resolved at execute.
+        ``NULLS FIRST|LAST`` (contextual idents) pins null placement;
+        the default is Spark's asc→first / desc→last."""
         expr = self.parse_or()
         ascending = True
         if self.accept("kw", "desc"):
             ascending = False
         else:
             self.accept("kw", "asc")
-        if isinstance(expr, E.Col):
-            return (expr.name, ascending)
+        nulls_first = None
+        if self.accept("ident", "nulls"):
+            if self.accept("ident", "first"):
+                nulls_first = True
+            elif self.accept("ident", "last"):
+                nulls_first = False
+            else:
+                raise ValueError("expected FIRST or LAST after NULLS")
         if (isinstance(expr, E.Lit) and isinstance(expr.value, int)
                 and not isinstance(expr.value, bool)):
+            if nulls_first is not None:
+                raise ValueError("NULLS FIRST/LAST with a positional "
+                                 "ORDER BY key is not supported")
             return (expr.value, ascending)
+        if nulls_first is not None:
+            return (E.SortOrder(expr, ascending, nulls_first), ascending)
+        if isinstance(expr, E.Col):
+            return (expr.name, ascending)
         return (expr, ascending)
 
     def parse_select_list(self):
@@ -1091,12 +1106,21 @@ def _referenced_cols(expr, out: set) -> None:
 
 
 def _sort_with_exprs(frame, order_by, extra_drops=()):
-    """Sort by a mix of column names and expressions: expression keys
-    materialize as temp columns (one fused device pass each), sort, then
-    drop the temps plus any caller-supplied post-sort columns."""
+    """Sort by a mix of column names, SortOrder markers (direction +
+    NULLS FIRST/LAST), and expressions: expression keys materialize as
+    temp columns (one fused device pass each), sort, then drop the temps
+    plus any caller-supplied post-sort columns."""
     cols, asc, temps = [], [], []
     for i, (key, a) in enumerate(order_by):
         if isinstance(key, str):
+            cols.append(key)
+        elif isinstance(key, E.SortOrder):
+            if not isinstance(key.child, E.Col):
+                tmp = f"__ord_{i}"
+                frame = frame.with_column(tmp, key.child)
+                temps.append(tmp)
+                key = E.SortOrder(E.Col(tmp), key.ascending,
+                                  key.nulls_first)
             cols.append(key)
         else:
             tmp = f"__ord_{i}"
@@ -1240,7 +1264,10 @@ def _execute_single(q: Query, cat):
             # dropping them again after the final sort.
             order_by = []
             for key, asc in q.order_by:
-                if not isinstance(key, str):
+                if isinstance(key, E.SortOrder):
+                    key = E.SortOrder(_rewrite_having(key.child, extra_aggs),
+                                      key.ascending, key.nulls_first)
+                elif not isinstance(key, str):
                     key = _rewrite_having(key, extra_aggs)
                     if isinstance(key, E.Col):
                         key = key.name
@@ -1323,13 +1350,20 @@ def _execute_single(q: Query, cat):
             # below drops the temps for free.
             keys = []
             for i, (key, asc) in enumerate(q.order_by):
-                if not isinstance(key, str):
+                if isinstance(key, E.SortOrder):
+                    if not isinstance(key.child, E.Col):
+                        tmp = f"__ord_{i}"
+                        frame = frame.with_column(tmp, key.child)
+                        key = E.SortOrder(E.Col(tmp), key.ascending,
+                                          key.nulls_first)
+                elif not isinstance(key, str):
                     tmp = f"__ord_{i}"
                     frame = frame.with_column(tmp, key)
                     key = tmp
                 keys.append((key, asc))
             q.order_by = keys
-            if all(c in frame.columns for c, _ in q.order_by):
+            if all((c if isinstance(c, str) else c.name) in frame.columns
+                   for c, _ in q.order_by):
                 frame = frame.sort(*[c for c, _ in q.order_by],
                                    ascending=[a for _, a in q.order_by])
                 q = Query(q.items, q.view, None, [], [], q.limit,

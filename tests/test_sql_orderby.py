@@ -59,6 +59,55 @@ class TestOrderByForms:
         assert out.to_pydict()["dp"].tolist() == [10.0, 20.0, 40.0, 80.0]
 
 
+class TestNullOrdering:
+    """NULLS FIRST/LAST + Spark's defaults (asc→first, desc→last)."""
+
+    @pytest.fixture
+    def nulled(self, session):
+        f = Frame({"x": [3.0, float("nan"), 1.0, float("nan")],
+                   "y": [1.0, 2.0, 3.0, 4.0]})
+        f.create_or_replace_temp_view("nl")
+        return f
+
+    def _xs(self, out):
+        return [None if v != v else v for v in out.to_pydict()["x"].tolist()]
+
+    def test_defaults(self, session, nulled):
+        assert self._xs(session.sql("SELECT x FROM nl ORDER BY x")) == \
+            [None, None, 1.0, 3.0]
+        assert self._xs(session.sql("SELECT x FROM nl ORDER BY x DESC")) == \
+            [3.0, 1.0, None, None]
+
+    def test_explicit_placement(self, session, nulled):
+        assert self._xs(session.sql(
+            "SELECT x FROM nl ORDER BY x NULLS LAST")) == \
+            [1.0, 3.0, None, None]
+        assert self._xs(session.sql(
+            "SELECT x FROM nl ORDER BY x DESC NULLS FIRST")) == \
+            [None, None, 3.0, 1.0]
+
+    def test_expression_key_with_nulls(self, session, nulled):
+        assert self._xs(session.sql(
+            "SELECT x FROM nl ORDER BY x * 2 NULLS LAST")) == \
+            [1.0, 3.0, None, None]
+
+    def test_fluent_markers(self, session, nulled):
+        f = nulled
+        assert self._xs(f.sort(f["x"].asc_nulls_last()).select("x")) == \
+            [1.0, 3.0, None, None]
+        assert self._xs(f.sort(f["x"].desc_nulls_first()).select("x")) == \
+            [None, None, 3.0, 1.0]
+
+    def test_secondary_key_within_nulls(self, session, nulled):
+        out = session.sql("SELECT x, y FROM nl ORDER BY x NULLS LAST, "
+                          "y DESC")
+        assert out.to_pydict()["y"].tolist() == [3.0, 1.0, 4.0, 2.0]
+
+    def test_positional_with_nulls_rejected(self, session, nulled):
+        with pytest.raises(ValueError, match="positional"):
+            session.sql("SELECT x FROM nl ORDER BY 1 NULLS LAST")
+
+
 class TestPostAggregateSelect:
     """Arithmetic over aggregates in the select list — computed on the
     aggregated frame from component aggregates (deduped by name)."""
