@@ -470,6 +470,12 @@ class Frame:
         keep = jnp.cumsum(self._mask.astype(jnp.int32)) <= n
         return self._with(mask=jnp.logical_and(self._mask, keep))
 
+    def offset(self, n: int) -> "Frame":
+        """Skip the first ``n`` valid rows (SQL OFFSET; Spark 3.4's
+        ``df.offset``) — a mask update like ``limit``, no data movement."""
+        keep = jnp.cumsum(self._mask.astype(jnp.int32)) > n
+        return self._with(mask=jnp.logical_and(self._mask, keep))
+
     def union(self, other: "Frame") -> "Frame":
         if self.columns != other.columns:
             raise ValueError("union requires identical column lists")

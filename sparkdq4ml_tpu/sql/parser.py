@@ -15,13 +15,14 @@ row interpreter.
 Grammar (recursive descent):
 
     query      := [WITH ident AS '(' set ')' (',' ident AS '(' set ')')*] set
-    set        := select (UNION [ALL] select)*
+    set        := select ((UNION [ALL] | INTERSECT | EXCEPT) select)*
     select     := SELECT [DISTINCT] select_list FROM relation join*
                   [WHERE or_expr]
                   [GROUP BY (expr|position),* | ROLLUP/CUBE '(' ident,* ')']
                   [HAVING or_expr]
                   [ORDER BY (expr|position) [ASC|DESC],*] [LIMIT n]
-    relation   := ident | '(' set ')' [AS] [ident]      -- derived table
+    relation   := ident [[AS] ident] | '(' set ')' [AS] [ident]
+                  -- derived table; aliases scope qualified refs a.col
     join       := [INNER|LEFT [OUTER|SEMI|ANTI]|RIGHT [OUTER]|FULL [OUTER]
                   |CROSS] JOIN relation
                   (ON ident '=' ident | USING '(' ident,* ')')
@@ -68,7 +69,7 @@ _TOKEN_RE = re.compile(
     r"(?P<number>\d+\.\d*(?:[eE][+-]?\d+)?|\.\d+(?:[eE][+-]?\d+)?|\d+(?:[eE][+-]?\d+)?)"
     r"|(?P<string>'(?:[^']|'')*')"
     r"|(?P<ident>[A-Za-z_][A-Za-z_0-9]*)"
-    r"|(?P<op>->|<=|>=|<>|!=|==|=|<|>|\+|-|\*|/|\(|\)|,)"
+    r"|(?P<op>->|<=|>=|<>|!=|==|=|<|>|\+|-|\*|/|\(|\)|,|\.)"
     r")")
 
 _KEYWORDS = {"select", "from", "where", "as", "and", "or", "not", "cast",
@@ -214,8 +215,9 @@ class _Parser:
 
     # -- query -------------------------------------------------------------
     def parse_relation(self):
-        """A FROM/JOIN source: a view name, or a parenthesized derived
-        table ``(SELECT ...) [AS] alias`` (alias optional, Spark 3+)."""
+        """A FROM/JOIN source: a view name (with optional ``[AS] alias``),
+        or a parenthesized derived table ``(SELECT ...) [AS] alias``.
+        Returns ``(source, alias)`` where source is a name or Query."""
         if (self.peek().kind == "op" and self.peek().value == "("
                 and self.toks[self.i + 1].kind == "kw"
                 and self.toks[self.i + 1].value.lower() == "select"):
@@ -226,8 +228,22 @@ class _Parser:
             alias = None
             if self.peek().kind == "ident":
                 alias = self.next().value
-            return DerivedTable(sub, alias)
-        return self.expect("ident").value
+            return DerivedTable(sub, alias), alias
+        view = self.expect("ident").value
+        alias = None
+        if self.accept("kw", "as"):
+            alias = self.expect("ident").value
+        elif self.peek().kind == "ident" and not self._ident_starts_clause():
+            alias = self.next().value
+        return view, alias
+
+    def _ident_starts_clause(self) -> bool:
+        """Contextual idents that begin a clause rather than alias a
+        relation (ON/USING/keywords are kw-kind already; these are the
+        ident-kind clause openers, so relations cannot be aliased to
+        these names without AS)."""
+        return self.peek().value.lower() in ("semi", "anti", "intersect",
+                                             "except", "offset")
 
     def parse_query(self):
         self.expect("kw", "select")
@@ -236,9 +252,10 @@ class _Parser:
         # Spark allows FROM-less SELECT (``SELECT 1``, ``SELECT
         # current_date()``): the projection runs over OneRowRelation.
         view = None
+        view_alias = None
         joins = []
         if self.accept("kw", "from"):
-            view = self.parse_relation()
+            view, view_alias = self.parse_relation()
             while True:
                 join = self.parse_join()
                 if join is None:
@@ -276,21 +293,36 @@ class _Parser:
             while self.accept("op", ","):
                 order_by.append(self.parse_sort_item())
         limit = None
+        offset = 0
         if self.accept("kw", "limit"):
             limit = int(self.expect("number").value)
+        if self.accept("ident", "offset"):     # LIMIT n OFFSET m / OFFSET m
+            offset = int(self.expect("number").value)
         q = Query(items, view, where, group_by, order_by, limit, joins,
                   distinct=distinct, having=having)
         q.group_mode = group_mode
+        q.view_alias = view_alias
+        q.offset = offset
         return q
 
     def parse_set_expr(self):
-        """query (UNION [ALL] query)* — set union over identical schemas.
-        No EOF expectation, so it also parses parenthesized subqueries."""
+        """query ((UNION [ALL] | INTERSECT | EXCEPT) query)* — set
+        operators over identical schemas, left-associative (standard
+        SQL's higher INTERSECT precedence is not modeled; parenthesize
+        to force grouping). No EOF expectation, so it also parses
+        parenthesized subqueries."""
         q = self.parse_query()
-        while self.accept("kw", "union"):
-            dedup = not self.accept("kw", "all")
-            q.unions.append((self.parse_query(), dedup))
-        return q
+        while True:
+            if self.accept("kw", "union"):
+                dedup = not self.accept("kw", "all")
+                q.unions.append(("union_all" if not dedup else "union",
+                                 self.parse_query()))
+            elif (self.peek().kind == "ident"
+                  and self.peek().value.lower() in ("intersect", "except")):
+                op = self.next().value.lower()
+                q.unions.append((op, self.parse_query()))
+            else:
+                return q
 
     def parse_union_query(self):
         """Top-level statement: ``[WITH name AS (query), ...] set_expr``.
@@ -335,7 +367,7 @@ class _Parser:
             how = "inner"
         else:
             self.expect("kw", "join")
-        view = self.parse_relation()
+        view, alias = self.parse_relation()
         keys: list[str] = []
         if how != "cross":
             if self.accept("kw", "using"):
@@ -346,15 +378,25 @@ class _Parser:
                 self.expect("op", ")")
             else:
                 self.expect("kw", "on")
-                a = self.expect("ident").value
+                a = self._parse_maybe_dotted()
                 self.expect("op", "=")
-                b = self.expect("ident").value
-                if a != b:
+                b = self._parse_maybe_dotted()
+                # qualified ON (``ON t.k = g.k``) reduces to the shared
+                # base column — the engine's joins are USING-shaped
+                a_col = a.rpartition(".")[2]
+                b_col = b.rpartition(".")[2]
+                if a_col != b_col:
                     raise ValueError(
                         f"JOIN ON supports equi-join on a shared column name; "
                         f"got {a!r} = {b!r} (use USING or rename first)")
-                keys.append(a)
-        return (view, how, keys)
+                keys.append(a_col)
+        return (view, how, keys, alias)
+
+    def _parse_maybe_dotted(self) -> str:
+        name = self.expect("ident").value
+        while self.accept("op", "."):
+            name += "." + self.expect("ident").value
+        return name
 
     def parse_order_item(self):
         """Window-spec ORDER BY: plain column names only (a window's sort
@@ -763,7 +805,14 @@ class _Parser:
                         args.append(self.parse_or())
                     self.expect("op", ")")
                 return E.UdfCall(fn_name, args)
-            return E.Col(t.value)
+            # qualified column ref: alias.col (resolved at execute
+            # against the relation scope; a literal dotted column name
+            # on the frame wins first)
+            name = t.value
+            while (self.peek().kind == "op" and self.peek().value == "."):
+                self.next()
+                name += "." + self.expect("ident").value
+            return E.Col(name)
         if self.accept("op", "("):
             if (self.peek().kind == "kw"
                     and self.peek().value.lower() == "select"):
@@ -883,9 +932,12 @@ class Query:
         self.joins = list(joins)
         self.distinct = distinct
         self.having = having
-        self.unions = list(unions)  # [(Query, dedup: bool), ...]
+        self.unions = list(unions)  # [(op, Query)] op ∈ union[_all]/
+        #                             intersect/except, left-assoc
         self.group_mode = "group"   # "group" | "rollup" | "cube"
         self.ctes = []              # [(name, Query), ...]
+        self.view_alias = None      # FROM-relation alias (qualified refs)
+        self.offset = 0             # rows skipped before LIMIT applies
 
 
 def parse(sql: str) -> Query:
@@ -1031,12 +1083,19 @@ def _resolve_subqueries(expr, cat):
 
 
 def _execute_set(q: Query, cat):
-    """Run one set expression (a SELECT plus trailing UNION branches)."""
+    """Run one set expression: a SELECT plus trailing UNION [ALL] /
+    INTERSECT / EXCEPT branches (left-associative)."""
     frame = _execute_single(q, cat)
-    for sub, dedup in q.unions:
-        frame = frame.union(_execute_single(sub, cat))
-        if dedup:
-            frame = frame.distinct()
+    for op, sub in q.unions:
+        rhs = _execute_single(sub, cat)
+        if op == "union_all":
+            frame = frame.union(rhs)
+        elif op == "union":
+            frame = frame.union(rhs).distinct()
+        elif op == "intersect":
+            frame = frame.intersect(rhs)
+        else:                              # except
+            frame = frame.subtract(rhs)
     return frame
 
 
@@ -1088,6 +1147,93 @@ def execute(sql: str, catalog=None):
     return _execute_set(q, cat)
 
 
+def _map_cols(expr, fn):
+    """Rebuild an expression tree with ``fn`` applied to every Col leaf
+    (the shared walk under qualified-ref resolution and agg renaming)."""
+    if isinstance(expr, E.Col):
+        new = fn(expr.name)
+        return expr if new == expr.name else E.Col(new)
+    if isinstance(expr, E.SortOrder):
+        return E.SortOrder(_map_cols(expr.child, fn), expr.ascending,
+                           expr.nulls_first)
+    if isinstance(expr, E.BinOp):
+        return E.BinOp(expr.op, _map_cols(expr.left, fn),
+                       _map_cols(expr.right, fn))
+    if isinstance(expr, E.UnaryOp):
+        return E.UnaryOp(expr.op, _map_cols(expr.child, fn))
+    if isinstance(expr, E.InList):
+        return E.InList(_map_cols(expr.child, fn),
+                        [_map_cols(v, fn) for v in expr.values],
+                        expr.negated)
+    if isinstance(expr, E.UdfCall):
+        return E.UdfCall(expr.udf_name,
+                         [_map_cols(a, fn) for a in expr.args],
+                         registry=expr._registry)
+    if isinstance(expr, E.Cast):
+        return E.Cast(_map_cols(expr.child, fn), expr.type_name)
+    if isinstance(expr, E.StringMatch):
+        return E.StringMatch(expr.kind, _map_cols(expr.child, fn),
+                             expr.pattern, negated=expr.negated)
+    if isinstance(expr, E.CaseWhen):
+        return E.CaseWhen(
+            [(_map_cols(c, fn), _map_cols(v, fn))
+             for c, v in expr.branches],
+            None if expr.otherwise_expr is None
+            else _map_cols(expr.otherwise_expr, fn))
+    if isinstance(expr, E.Alias):
+        return E.Alias(_map_cols(expr.child, fn), expr._name)
+    return expr
+
+
+def _resolve_name(name: str, scope: dict, columns) -> str:
+    """Resolve a possibly-qualified name against the relation scope.
+    A literal column of that (dotted) name wins first — frames may carry
+    dotted names from CSV headers; Spark needs backticks there, here the
+    literal match is the tiebreak. Names with parens are aggregate-output
+    references, never qualified refs."""
+    if "." not in name or "(" in name or name in columns:
+        return name
+    alias, _, col = name.partition(".")
+    m = scope.get(alias.lower())
+    if m is None:
+        raise ValueError(
+            f"unknown relation alias {alias!r} in {name!r} "
+            f"(aliases in scope: {sorted(scope)})")
+    if col not in m:
+        raise ValueError(f"column {col!r} not found in relation "
+                         f"{alias!r} (has: {sorted(m)})")
+    return m[col]
+
+
+def _resolve_qualified(expr, scope: dict, columns):
+    """Rewrite qualified Col refs (``t.price``) to flat output columns;
+    inside post-aggregate items, also re-point references at the
+    aggregates' renamed output columns (``max(t.p)`` → ``max(p)``)."""
+    if not scope:
+        return expr
+    if isinstance(expr, PostAggItem):
+        renames = {}
+        aggs = []
+        for a in expr.aggs:
+            old = a.name
+            # mutating the parse-fresh AggExpr is safe: every Query
+            # object executes exactly once
+            if getattr(a, "column", None) is not None:
+                a.column = _resolve_name(a.column, scope, columns)
+            if getattr(a, "column2", None) is not None:
+                a.column2 = _resolve_name(a.column2, scope, columns)
+            if a.name != old:
+                renames[old] = a.name
+            aggs.append(a)
+        inner = expr.expr
+        if renames:
+            inner = _map_cols(inner, lambda n: renames.get(n, n))
+        inner = _map_cols(inner,
+                          lambda n: _resolve_name(n, scope, columns))
+        return PostAggItem(inner, aggs, expr._name)
+    return _map_cols(expr, lambda n: _resolve_name(n, scope, columns))
+
+
 def _referenced_cols(expr, out: set) -> None:
     """Collect every column name an expression tree references."""
     if isinstance(expr, E.Col):
@@ -1137,6 +1283,7 @@ def _execute_single(q: Query, cat):
     """Run one SELECT (no union handling) and return a Frame."""
     from ..frame.aggregates import AggExpr
 
+    scope: dict = {}       # relation alias → {source col: output col}
     if q.view is None:
         # OneRowRelation: a single anonymous row for literal projections
         from ..frame.frame import Frame
@@ -1144,12 +1291,58 @@ def _execute_single(q: Query, cat):
         frame = Frame({"__one_row__": [0.0]}).drop("__one_row__")
     elif isinstance(q.view, DerivedTable):
         frame = _execute_set(q.view.query, cat)
+        if q.view.alias:
+            scope[q.view.alias.lower()] = {c: c for c in frame.columns}
     else:
         frame = cat.lookup(q.view)
-    for view, how, keys in q.joins:
+        # the alias replaces the name when given (Spark scoping)
+        scope[(q.view_alias or q.view).lower()] = \
+            {c: c for c in frame.columns}
+    for view, how, keys, jalias in q.joins:
         right = (_execute_set(view.query, cat)
                  if isinstance(view, DerivedTable) else cat.lookup(view))
+        rcols = list(right.columns)
+        pre = set(frame.columns)
         frame = frame.join(right, on=keys or None, how=how)
+        name = jalias or (view if isinstance(view, str) else None)
+        if name:
+            post = set(frame.columns)
+            if how in ("left_semi", "left_anti"):
+                # semi/anti output carries left columns only; the right
+                # side is addressable just through the join keys
+                mapping = {k: k for k in keys}
+            else:
+                mapping = {c: (f"{c}_right" if c not in keys and c in pre
+                               and f"{c}_right" in post else c)
+                           for c in rcols}
+            scope[name.lower()] = mapping
+    # Qualified refs (``t.price``) resolve to flat output columns now
+    # that the join scope is known.
+    if scope:
+        cols_now = frame.columns
+        if q.where is not None:
+            q.where = _resolve_qualified(q.where, scope, cols_now)
+        if q.having is not None:
+            q.having = _resolve_qualified(q.having, scope, cols_now)
+        items = []
+        for it in q.items:
+            if isinstance(it, AggExpr):
+                if getattr(it, "column", None) is not None:
+                    it.column = _resolve_name(it.column, scope, cols_now)
+                if getattr(it, "column2", None) is not None:
+                    it.column2 = _resolve_name(it.column2, scope, cols_now)
+                items.append(it)
+            elif isinstance(it, str):
+                items.append(it)
+            else:
+                items.append(_resolve_qualified(it, scope, cols_now))
+        q.items = items
+        q.group_by = [_resolve_name(k, scope, cols_now)
+                      if isinstance(k, str) else k for k in q.group_by]
+        q.order_by = [(_resolve_name(k, scope, cols_now)
+                       if isinstance(k, str)
+                       else _resolve_qualified(k, scope, cols_now), a)
+                      for k, a in q.order_by]
     # Uncorrelated subqueries (scalar / IN / EXISTS) resolve to literals
     # against the same catalog before the enclosing query evaluates.
     if q.where is not None:
@@ -1337,8 +1530,10 @@ def _execute_single(q: Query, cat):
                     expanded.extend(E.Col(c) for c in frame.columns)
                 else:
                     expanded.append(it)
-            q = Query(expanded, q.view, None, [], q.order_by, q.limit,
-                      distinct=q.distinct)
+            q2 = Query(expanded, q.view, None, [], q.order_by, q.limit,
+                       distinct=q.distinct)
+            q2.offset = q.offset
+            q = q2
         star = (len(q.items) == 1 and isinstance(q.items[0], str)
                 and q.items[0] == "*")
         if q.order_by and not star:
@@ -1366,8 +1561,10 @@ def _execute_single(q: Query, cat):
                    for c, _ in q.order_by):
                 frame = frame.sort(*[c for c, _ in q.order_by],
                                    ascending=[a for _, a in q.order_by])
-                q = Query(q.items, q.view, None, [], [], q.limit,
-                          distinct=q.distinct)
+                q2 = Query(q.items, q.view, None, [], [], q.limit,
+                           distinct=q.distinct)
+                q2.offset = q.offset
+                q = q2
         if not star:
             frame = frame.select(*q.items)
 
@@ -1380,6 +1577,8 @@ def _execute_single(q: Query, cat):
                                  getattr(q, "drop_after_sort", ()))
     elif getattr(q, "drop_after_sort", ()):
         frame = frame.drop(*q.drop_after_sort)
+    if q.offset:
+        frame = frame.offset(q.offset)
     if q.limit is not None:
         frame = frame.limit(q.limit)
     return frame

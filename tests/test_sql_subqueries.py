@@ -168,6 +168,52 @@ class TestInSubquery:
                                    fluent.to_pydict()["price"])
 
 
+class TestSetOpsAndOffset:
+    """INTERSECT / EXCEPT set operators and LIMIT ... OFFSET."""
+
+    @pytest.fixture
+    def ab(self, session):
+        Frame({"x": [1.0, 2.0, 3.0, 2.0]}).create_or_replace_temp_view("sa")
+        Frame({"x": [2.0, 3.0, 5.0]}).create_or_replace_temp_view("sb")
+
+    def test_intersect(self, session, ab):
+        out = session.sql("SELECT x FROM sa INTERSECT SELECT x FROM sb")
+        assert sorted(out.to_pydict()["x"].tolist()) == [2.0, 3.0]
+
+    def test_except(self, session, ab):
+        out = session.sql("SELECT x FROM sa EXCEPT SELECT x FROM sb")
+        assert out.to_pydict()["x"].tolist() == [1.0]
+
+    def test_left_assoc_chain(self, session, ab):
+        out = session.sql("SELECT x FROM sa UNION ALL SELECT x FROM sb "
+                          "EXCEPT SELECT x FROM sb")
+        assert out.to_pydict()["x"].tolist() == [1.0]
+
+    def test_limit_offset(self, session, ab):
+        out = session.sql("SELECT x FROM sa ORDER BY x LIMIT 2 OFFSET 1")
+        assert out.to_pydict()["x"].tolist() == [2.0, 2.0]
+
+    def test_offset_alone(self, session, ab):
+        out = session.sql("SELECT x FROM sa ORDER BY x OFFSET 2")
+        assert out.to_pydict()["x"].tolist() == [2.0, 3.0]
+
+    def test_offset_with_star(self, session, ab):
+        out = session.sql("SELECT * FROM sa ORDER BY x LIMIT 1 OFFSET 3")
+        assert out.to_pydict()["x"].tolist() == [3.0]
+
+    def test_fluent_offset(self, session):
+        assert Frame({"x": [1.0, 2.0, 3.0]}).offset(1) \
+            .to_pydict()["x"].tolist() == [2.0, 3.0]
+
+    def test_intersect_matches_fluent(self, session, ab):
+        sql = session.sql("SELECT x FROM sa INTERSECT SELECT x FROM sb")
+        a = Frame({"x": [1.0, 2.0, 3.0, 2.0]})
+        b = Frame({"x": [2.0, 3.0, 5.0]})
+        fluent = a.intersect(b)
+        assert sorted(sql.to_pydict()["x"].tolist()) == \
+            sorted(fluent.to_pydict()["x"].tolist())
+
+
 class TestViewDdl:
     """CREATE [OR REPLACE] TEMP VIEW ... AS / DROP VIEW [IF EXISTS]."""
 
