@@ -226,7 +226,8 @@ class _Parser:
             self.expect("op", ")")
             self.accept("kw", "as")
             alias = None
-            if self.peek().kind == "ident":
+            if (self.peek().kind == "ident"
+                    and not self._ident_starts_clause()):
                 alias = self.next().value
             return DerivedTable(sub, alias), alias
         view = self.expect("ident").value
@@ -1182,7 +1183,27 @@ def _map_cols(expr, fn):
             else _map_cols(expr.otherwise_expr, fn))
     if isinstance(expr, E.Alias):
         return E.Alias(_map_cols(expr.child, fn), expr._name)
+    if isinstance(expr, SubqueryIn):
+        # only the OUTER-scope side is mapped; the subquery resolves in
+        # its own scope when it executes
+        return SubqueryIn(_map_cols(expr.child, fn), expr.query,
+                          expr.negated)
+    if isinstance(expr, E.HigherOrder):
+        # lambda params shadow columns inside the body, so the body's
+        # Col refs are left alone; only the source array is mapped
+        return E.HigherOrder(expr.kind, _map_cols(expr.source, fn),
+                             expr.lam, init=expr.init, finish=expr.finish)
     return expr
+
+
+def _resolve_agg_cols(agg, scope: dict, columns):
+    """Resolve dotted column names inside an AggExpr (mutating the
+    parse-fresh object is safe: every Query executes exactly once)."""
+    if getattr(agg, "column", None) is not None:
+        agg.column = _resolve_name(agg.column, scope, columns)
+    if getattr(agg, "column2", None) is not None:
+        agg.column2 = _resolve_name(agg.column2, scope, columns)
+    return agg
 
 
 def _resolve_name(name: str, scope: dict, columns) -> str:
@@ -1216,12 +1237,7 @@ def _resolve_qualified(expr, scope: dict, columns):
         aggs = []
         for a in expr.aggs:
             old = a.name
-            # mutating the parse-fresh AggExpr is safe: every Query
-            # object executes exactly once
-            if getattr(a, "column", None) is not None:
-                a.column = _resolve_name(a.column, scope, columns)
-            if getattr(a, "column2", None) is not None:
-                a.column2 = _resolve_name(a.column2, scope, columns)
+            a = _resolve_agg_cols(a, scope, columns)
             if a.name != old:
                 renames[old] = a.name
             aggs.append(a)
@@ -1324,19 +1340,11 @@ def _execute_single(q: Query, cat):
             q.where = _resolve_qualified(q.where, scope, cols_now)
         if q.having is not None:
             q.having = _resolve_qualified(q.having, scope, cols_now)
-        items = []
-        for it in q.items:
-            if isinstance(it, AggExpr):
-                if getattr(it, "column", None) is not None:
-                    it.column = _resolve_name(it.column, scope, cols_now)
-                if getattr(it, "column2", None) is not None:
-                    it.column2 = _resolve_name(it.column2, scope, cols_now)
-                items.append(it)
-            elif isinstance(it, str):
-                items.append(it)
-            else:
-                items.append(_resolve_qualified(it, scope, cols_now))
-        q.items = items
+        q.items = [_resolve_agg_cols(it, scope, cols_now)
+                   if isinstance(it, AggExpr)
+                   else it if isinstance(it, str)
+                   else _resolve_qualified(it, scope, cols_now)
+                   for it in q.items]
         q.group_by = [_resolve_name(k, scope, cols_now)
                       if isinstance(k, str) else k for k in q.group_by]
         q.order_by = [(_resolve_name(k, scope, cols_now)

@@ -87,6 +87,19 @@ class TestQualifiedRefs:
         out = session.sql("SELECT a.b FROM dotted WHERE a.b > 1")
         assert out.to_pydict()["a.b"].tolist() == [2.0]
 
+    def test_qualified_inside_in_subquery(self, session, views):
+        out = session.sql("SELECT t.price FROM t WHERE t.guest IN "
+                          "(SELECT guest FROM g)")
+        assert out.to_pydict()["price"].tolist() == [95.0, 120.0]
+
+    def test_unaliased_derived_before_setop_and_offset(self, session, views):
+        # INTERSECT/OFFSET after an unaliased derived table must start
+        # the clause, not become the table's alias.
+        assert session.sql("SELECT price FROM (SELECT price FROM t) "
+                           "INTERSECT SELECT price FROM t").count() == 3
+        assert session.sql("SELECT price FROM (SELECT price FROM t) "
+                           "OFFSET 2").count() == 1
+
     def test_derived_table_alias(self, session, views):
         out = session.sql("SELECT s.price FROM "
                           "(SELECT guest, price FROM t) s "
