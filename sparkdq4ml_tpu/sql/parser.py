@@ -20,7 +20,9 @@ Grammar (recursive descent):
                   [WHERE or_expr]
                   [GROUP BY (expr|position),* | ROLLUP/CUBE '(' ident,* ')']
                   [HAVING or_expr]
-                  [ORDER BY (expr|position) [ASC|DESC],*] [LIMIT n]
+                  [ORDER BY (expr|position) [ASC|DESC]
+                   [NULLS FIRST|LAST],*]
+                  [LIMIT n] [OFFSET m]
     relation   := ident [[AS] ident] | '(' set ')' [AS] [ident]
                   -- derived table; aliases scope qualified refs a.col
     join       := [INNER|LEFT [OUTER|SEMI|ANTI]|RIGHT [OUTER]|FULL [OUTER]

@@ -117,3 +117,92 @@ class TestDifferential:
         # tie-break on b may differ between engines; compare the a column
         np.testing.assert_allclose(got.to_pydict()["a"],
                                    want.to_pydict()["a"], rtol=1e-9)
+
+
+class TestNewGrammarDifferential:
+    """Round-5 grammar forms vs hand-built fluent equivalents."""
+
+    @pytest.mark.parametrize("seed", range(4))
+    def test_in_subquery_agrees_with_isin(self, session, seed):
+        rng = np.random.default_rng(200 + seed)
+        frame = random_frame(rng)
+        frame.create_or_replace_temp_view("fz")
+        picks = Frame({"k": rng.integers(0, 4, 5).astype(np.int64)})
+        picks.create_or_replace_temp_view("picks")
+        got = session.sql(
+            "SELECT a FROM fz WHERE k IN (SELECT k FROM picks)")
+        vals = [int(v) for v in picks.to_pydict()["k"]]
+        want = frame.filter(dq.col("k").isin(vals)).select("a")
+        frames_equal(got, want)
+
+    @pytest.mark.parametrize("seed", range(4))
+    def test_scalar_subquery_agrees_with_literal(self, session, seed):
+        rng = np.random.default_rng(300 + seed)
+        frame = random_frame(rng)
+        frame.create_or_replace_temp_view("fz")
+        got = session.sql(
+            "SELECT a FROM fz WHERE a > (SELECT AVG(a) FROM fz)")
+        mean = float(np.mean(frame.to_pydict()["a"]))
+        want = frame.filter(dq.col("a") > mean).select("a")
+        frames_equal(got, want)
+
+    @pytest.mark.parametrize("seed", range(3))
+    def test_cte_agrees_with_inline(self, session, seed):
+        rng = np.random.default_rng(400 + seed)
+        frame = random_frame(rng)
+        frame.create_or_replace_temp_view("fz")
+        got = session.sql(
+            "WITH pos AS (SELECT a, b, k FROM fz WHERE b > 0) "
+            "SELECT k, COUNT(*) AS c FROM pos GROUP BY k ORDER BY k")
+        want_inline = session.sql(
+            "SELECT k, COUNT(*) AS c FROM fz WHERE b > 0 "
+            "GROUP BY k ORDER BY k")
+        frames_equal(got, want_inline)
+
+    @pytest.mark.parametrize("seed", range(3))
+    def test_set_ops_agree_with_fluent(self, session, seed):
+        rng = np.random.default_rng(500 + seed)
+        fa = Frame({"k": rng.integers(0, 6, 20).astype(np.int64)})
+        fb = Frame({"k": rng.integers(0, 6, 20).astype(np.int64)})
+        fa.create_or_replace_temp_view("da")
+        fb.create_or_replace_temp_view("db")
+        got_i = session.sql("SELECT k FROM da INTERSECT SELECT k FROM db")
+        want_i = fa.intersect(fb)
+        assert sorted(got_i.to_pydict()["k"].tolist()) == \
+            sorted(want_i.to_pydict()["k"].tolist())
+        got_e = session.sql("SELECT k FROM da EXCEPT SELECT k FROM db")
+        want_e = fa.subtract(fb)
+        assert sorted(got_e.to_pydict()["k"].tolist()) == \
+            sorted(want_e.to_pydict()["k"].tolist())
+
+    @pytest.mark.parametrize("seed", range(3))
+    def test_offset_agrees_with_fluent(self, session, seed):
+        rng = np.random.default_rng(600 + seed)
+        frame = random_frame(rng)
+        frame.create_or_replace_temp_view("fz")
+        m = int(rng.integers(1, 10))
+        got = session.sql(f"SELECT a FROM fz ORDER BY a OFFSET {m}")
+        want = frame.sort("a").offset(m).select("a")
+        frames_equal(got, want)
+
+    @pytest.mark.parametrize("seed", range(3))
+    def test_qualified_refs_agree_with_plain(self, session, seed):
+        rng = np.random.default_rng(700 + seed)
+        frame = random_frame(rng)
+        frame.create_or_replace_temp_view("fz")
+        got = session.sql("SELECT fz.a, fz.b FROM fz WHERE fz.k = 1")
+        want = session.sql("SELECT a, b FROM fz WHERE k = 1")
+        frames_equal(got, want)
+
+    @pytest.mark.parametrize("seed", range(3))
+    def test_post_agg_agrees_with_fluent(self, session, seed):
+        rng = np.random.default_rng(800 + seed)
+        frame = random_frame(rng)
+        frame.create_or_replace_temp_view("fz")
+        got = session.sql("SELECT k, MAX(a) - MIN(a) AS spread FROM fz "
+                          "GROUP BY k ORDER BY k")
+        agg = (frame.group_by("k")
+               .agg(F.max("a").alias("mx"), F.min("a").alias("mn")))
+        want = (agg.with_column("spread", dq.col("mx") - dq.col("mn"))
+                .select(dq.col("k"), dq.col("spread")).sort("k"))
+        frames_equal(got, want)
