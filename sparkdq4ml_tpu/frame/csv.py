@@ -341,11 +341,16 @@ class DataFrameReader:
         return default if v is None else v.strip().lower() in ("true", "1", "yes")
 
     def load(self, path: str) -> Frame:
-        if self._format not in ("csv", "json"):
+        if self._format not in ("csv", "json", "parquet"):
             raise ValueError(
-                f"unsupported format {self._format!r} (csv or json)")
+                f"unsupported format {self._format!r} (csv, json, "
+                "or parquet)")
         if not os.path.exists(path):
             raise FileNotFoundError(path)
+        if self._format == "parquet":
+            from .parquet import read_parquet
+
+            return read_parquet(path)
         if self._format == "json":
             from .jsonl import read_json
 
@@ -367,3 +372,6 @@ class DataFrameReader:
 
     def json(self, path: str, multiLine: bool = False) -> Frame:
         return self.format("json").option("multiLine", multiLine).load(path)
+
+    def parquet(self, path: str) -> Frame:
+        return self.format("parquet").load(path)

@@ -72,12 +72,20 @@ class DataFrameWriter:
         return self
 
     def save(self, path: str) -> None:
-        if self._format not in ("csv", "json"):
+        if self._format not in ("csv", "json", "parquet"):
             raise ValueError(
-                f"unsupported format {self._format!r} (csv or json)")
+                f"unsupported format {self._format!r} (csv, json, "
+                "or parquet)")
         if os.path.exists(path) and self._mode == "errorifexists":
             raise FileExistsError(
                 f"{path} exists (use .mode('overwrite') to replace)")
+        if self._format == "parquet":
+            from .parquet import write_parquet
+
+            write_parquet(
+                self._frame, path,
+                compression=self._options.get("compression", "snappy"))
+            return
         if self._format == "json":
             from .jsonl import write_json
 
@@ -92,3 +100,6 @@ class DataFrameWriter:
 
     def json(self, path: str) -> None:
         self.format("json").save(path)
+
+    def parquet(self, path: str) -> None:
+        self.format("parquet").save(path)
