@@ -308,6 +308,59 @@ class Frame:
 
     withColumnRenamed = with_column_renamed
 
+    def with_columns_renamed(self, mapping: Mapping[str, str]) -> "Frame":
+        """Spark 3.4's ``withColumnsRenamed`` — batch rename; absent keys
+        are no-ops (same semantics as the single-column form)."""
+        data = {mapping.get(k, k): v for k, v in self._data.items()}
+        return self._with(data=data)
+
+    withColumnsRenamed = with_columns_renamed
+
+    def transform(self, func, *args, **kwargs) -> "Frame":
+        """Spark's ``df.transform(fn)`` — chainable function application:
+        ``df.transform(clean).transform(label)`` reads pipeline-style."""
+        out = func(self, *args, **kwargs)
+        if not isinstance(out, Frame):
+            raise TypeError("transform function must return a Frame, got "
+                            f"{type(out).__name__}")
+        return out
+
+    def unpivot(self, ids, values=None, variable_column_name: str = "variable",
+                value_column_name: str = "value") -> "Frame":
+        """Spark 3.4's ``unpivot``/``melt``: wide → long. ``ids`` stay as
+        identifier columns; each of ``values`` (default: every non-id
+        numeric column) contributes one output row per input row, tagged
+        with its column name. Row-major like Spark: input row 0's value
+        columns first, then row 1's. Host-side reshape at the boundary —
+        the long result lands as fresh device columns."""
+        ids = [ids] if isinstance(ids, str) else list(ids)
+        if values is None:
+            values = [c for c in self.columns if c not in ids]
+        values = [values] if isinstance(values, str) else list(values)
+        if not values:
+            raise ValueError("unpivot requires at least one value column")
+        for c in ids + values:
+            if c not in self.columns:
+                raise ValueError(f"unpivot column {c!r} is not a column")
+        d = self.to_pydict()
+        n = len(next(iter(d.values()))) if d else 0
+        k = len(values)
+        data: dict = {}
+        for c in ids:
+            col = np.asarray(d[c])
+            data[c] = (np.repeat(col, k) if col.dtype != object
+                       else np.asarray([x for x in col for _ in range(k)],
+                                       dtype=object))
+        data[variable_column_name] = np.asarray(values * n, dtype=object) \
+            if n else np.asarray([], dtype=object)
+        vals = np.column_stack(
+            [np.asarray(d[c], np.float64) for c in values]) \
+            if n else np.zeros((0, k))
+        data[value_column_name] = vals.ravel()
+        return Frame(data)
+
+    melt = unpivot
+
     def select(self, *exprs: Union[str, Expr]) -> "Frame":
         from ..ops.expressions import Alias, Explode, JsonTuple
 

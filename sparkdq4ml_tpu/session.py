@@ -401,6 +401,10 @@ class TpuSession:
         (`DataQuality4MachineLearningApp.java:77,89`)."""
         return _sql_execute(query, self.catalog)
 
+    def table(self, name: str):
+        """Spark's ``spark.table(name)`` — the registered temp view."""
+        return self.catalog.lookup(name)
+
     def create_data_frame(self, data, names=None):
         from .frame.frame import Frame
 

@@ -301,3 +301,57 @@ class TestShims:
         assert f.schema == f.dtypes()
         assert f.schema[0][0] == "x"
         assert f.schema[0][1] in ("float", "double")
+
+
+class TestReshapeAndTransform:
+    """Spark 3.4 batch: unpivot/melt, withColumnsRenamed, df.transform,
+    spark.table."""
+
+    def test_unpivot_row_major(self, f):
+        out = Frame({"id": [1.0, 2.0], "a": [10.0, 20.0],
+                     "b": [0.5, 0.7]}).unpivot("id")
+        d = out.to_pydict()
+        assert d["id"].tolist() == [1.0, 1.0, 2.0, 2.0]
+        assert list(d["variable"]) == ["a", "b", "a", "b"]
+        assert d["value"].tolist() == [10.0, 0.5, 20.0, 0.7]
+
+    def test_melt_alias_with_names(self):
+        out = Frame({"id": [1.0], "a": [3.0], "b": [4.0]}).melt(
+            "id", ["b"], "var", "val")
+        d = out.to_pydict()
+        assert list(d["var"]) == ["b"]
+        assert d["val"].tolist() == [4.0]
+
+    def test_unpivot_string_id(self):
+        out = Frame({"k": np.asarray(["u", "v"], dtype=object),
+                     "a": [1.0, 2.0], "b": [3.0, 4.0]}).unpivot("k")
+        assert list(out.to_pydict()["k"]) == ["u", "u", "v", "v"]
+
+    def test_unpivot_bad_column(self, f):
+        with pytest.raises(ValueError, match="not a column"):
+            f.unpivot("nope")
+
+    def test_with_columns_renamed(self, f):
+        out = f.with_columns_renamed({"x": "ex", "missing": "m"})
+        assert out.columns == ["ex", "y", "label"]
+        assert out.withColumnsRenamed({"y": "why"}).columns == \
+            ["ex", "why", "label"]
+
+    def test_transform_chain(self, f):
+        def double_y(df):
+            return df.with_column("y", df["y"] * 2)
+
+        def keep_big(df, thresh):
+            return df.filter(df["y"] > thresh)
+
+        out = f.transform(double_y).transform(keep_big, 8.0)
+        assert out.to_pydict()["y"].tolist() == [12.0, 16.0]
+
+    def test_transform_must_return_frame(self, f):
+        with pytest.raises(TypeError, match="must return a Frame"):
+            f.transform(lambda df: 42)
+
+    def test_session_table(self, session, f):
+        f.create_or_replace_temp_view("tbl_api")
+        assert session.table("tbl_api").count() == 5
+        session.catalog.drop("tbl_api")
