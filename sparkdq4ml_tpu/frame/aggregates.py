@@ -461,6 +461,54 @@ class GroupedFrame(_AggShortcuts):
         for k in keys:
             frame._column_values(k)  # validate early
 
+    def apply_in_pandas(self, func, schema):
+        """Spark 3's ``groupBy(...).applyInPandas(fn, schema)``: the
+        grouped-map UDF. Each group materializes as a pandas DataFrame on
+        the host, ``func`` maps it to a new DataFrame, and the pieces
+        concatenate into one Frame cast to the DDL ``schema``. This is
+        the escape hatch for per-group logic the fused aggregate path
+        cannot express — it pays the host boundary once per group, so
+        keep it off hot paths (the vectorized agg() stays the fast lane).
+        """
+        import pandas as pd
+
+        from .csv import parse_ddl_schema
+        from .frame import Frame
+
+        fields = parse_ddl_schema(schema) if isinstance(schema, str) \
+            else list(schema)
+        pdf = self._frame.to_pandas()
+        if len(pdf) == 0:
+            groups = []
+        else:
+            groups = [g.reset_index(drop=True)
+                      for _, g in pdf.groupby(self._keys, sort=True,
+                                              dropna=False)]
+        outs = []
+        for g in groups:
+            out = func(g)
+            if not isinstance(out, pd.DataFrame):
+                raise TypeError("applyInPandas function must return a "
+                                f"pandas DataFrame, got {type(out).__name__}")
+            outs.append(out)
+        names = [n for n, _ in fields]
+        if outs:
+            cat = pd.concat(outs, ignore_index=True)
+            missing = [n for n in names if n not in cat.columns]
+            if missing:
+                raise ValueError(f"applyInPandas output is missing schema "
+                                 f"columns {missing}")
+            data = {n: cat[n].to_numpy() for n in names}
+        else:
+            data = {n: np.asarray([], np.float64) for n in names}
+        frame = Frame(data)
+        for name, tname in fields:
+            frame = frame.with_column(
+                name, frame.col(name).cast(tname))
+        return frame
+
+    applyInPandas = apply_in_pandas
+
     def agg(self, *aggs: Union[AggExpr, str]):
         from .frame import Frame
 
