@@ -1558,6 +1558,19 @@ class Frame:
 
     createOrReplaceTempView = create_or_replace_temp_view
 
+    def create_temp_view(self, name: str) -> None:
+        """``createTempView`` — like the or-replace form but raises if
+        the name is taken (Spark's TempTableAlreadyExistsException)."""
+        from ..sql.catalog import default_catalog
+
+        cat = default_catalog()
+        if cat.table_exists(name):
+            raise ValueError(f"temp view {name!r} already exists "
+                             "(use createOrReplaceTempView)")
+        cat.register(name, self)
+
+    createTempView = create_temp_view
+
 
 class _NAFunctions:
     """``df.na`` accessor (Spark ``DataFrameNaFunctions``) — thin verbs

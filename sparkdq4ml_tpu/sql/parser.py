@@ -1028,11 +1028,28 @@ def _pyval(v):
     return v.item() if hasattr(v, "item") else v
 
 
+def _execute_subquery(q: Query, cat):
+    """Run a subquery, converting an outer-alias reference into the
+    clear diagnosis: correlation is not supported — Spark itself
+    rewrites correlated EXISTS/IN into semi/anti joins, and those are
+    first-class here."""
+    try:
+        return _execute_set(q, cat)
+    except ValueError as e:
+        if "unknown relation alias" in str(e):
+            raise ValueError(
+                "correlated subqueries are not supported (the subquery "
+                f"references an outer relation: {e}); rewrite as a join "
+                "— LEFT SEMI for EXISTS/IN, LEFT ANTI for NOT "
+                "EXISTS/NOT IN") from e
+        raise
+
+
 def _resolve_subqueries(expr, cat):
     """Replace uncorrelated subquery placeholders with literal values by
     executing them against the catalog, rebuilding the expression tree."""
     if isinstance(expr, ScalarSubquery):
-        frame = _execute_set(expr.query, cat)
+        frame = _execute_subquery(expr.query, cat)
         cols = frame.columns
         if len(cols) != 1:
             raise ValueError("scalar subquery must return exactly one "
@@ -1042,7 +1059,7 @@ def _resolve_subqueries(expr, cat):
             raise ValueError("scalar subquery returned more than one row")
         return E.Lit(values[0] if values else math.nan)
     if isinstance(expr, SubqueryIn):
-        frame = _execute_set(expr.query, cat)
+        frame = _execute_subquery(expr.query, cat)
         cols = frame.columns
         if len(cols) != 1:
             raise ValueError("IN (subquery) must select exactly one "
@@ -1051,7 +1068,7 @@ def _resolve_subqueries(expr, cat):
         return E.InList(_resolve_subqueries(expr.child, cat),
                         [E.Lit(_pyval(v)) for v in values], expr.negated)
     if isinstance(expr, SubqueryExists):
-        return E.Lit(_execute_set(expr.query, cat).count() > 0)
+        return E.Lit(_execute_subquery(expr.query, cat).count() > 0)
     if isinstance(expr, E.BinOp):
         return E.BinOp(expr.op, _resolve_subqueries(expr.left, cat),
                        _resolve_subqueries(expr.right, cat))

@@ -168,6 +168,25 @@ class TestInSubquery:
                                    fluent.to_pydict()["price"])
 
 
+class TestCorrelationDiagnosis:
+    def test_correlated_exists_gets_clear_error(self, session, views):
+        # Spark rewrites correlated EXISTS into semi joins; here the
+        # rewrite is the user's (semi/anti joins are first-class) and the
+        # error says exactly that.
+        with pytest.raises(ValueError, match="LEFT SEMI"):
+            session.sql("SELECT guest FROM t WHERE EXISTS "
+                        "(SELECT 1 FROM g WHERE t.guest = g.guest)")
+
+    def test_create_temp_view_raises_on_duplicate(self, session, views):
+        t, _ = views
+        t.create_temp_view("ctv_once")
+        try:
+            with pytest.raises(ValueError, match="already exists"):
+                t.create_temp_view("ctv_once")
+        finally:
+            session.catalog.drop("ctv_once")
+
+
 class TestSetOpsAndOffset:
     """INTERSECT / EXCEPT set operators and LIMIT ... OFFSET."""
 
