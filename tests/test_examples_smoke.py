@@ -23,3 +23,11 @@ def test_sql_tour_end_to_end():
     assert proc.returncode == 0, (proc.stdout[-2000:], proc.stderr[-1500:])
     assert "sql_tour OK" in proc.stdout
     assert "fluent dense_rank == SQL OVER dense_rank" in proc.stdout
+
+
+def test_io_tour_end_to_end():
+    proc = _run("io_tour.py")
+    assert proc.returncode == 0, (proc.stdout[-2000:], proc.stderr[-1500:])
+    assert "io_tour OK" in proc.stdout
+    assert "parquet: round-trip 1040 rows" in proc.stdout
+    assert "applyInPandas: 1040 rows demeaned" in proc.stdout
