@@ -7,6 +7,7 @@ Run: python examples/io_tour.py
 """
 
 import os
+import shutil
 import sys
 import tempfile
 
@@ -93,6 +94,7 @@ def main() -> None:
     spark.catalog.drop("inv")
     print("spark.table: view round-trip OK")
 
+    shutil.rmtree(tmp, ignore_errors=True)
     spark.stop()
     print("io_tour OK")
 

@@ -4,6 +4,7 @@ banner is a real integration check, not a smoke-only pass."""
 
 import os
 import subprocess
+import pytest
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -26,6 +27,8 @@ def test_sql_tour_end_to_end():
 
 
 def test_io_tour_end_to_end():
+    pytest.importorskip("pandas")
+    pytest.importorskip("pyarrow")
     proc = _run("io_tour.py")
     assert proc.returncode == 0, (proc.stdout[-2000:], proc.stderr[-1500:])
     assert "io_tour OK" in proc.stdout
