@@ -1028,6 +1028,158 @@ def _pyval(v):
     return v.item() if hasattr(v, "item") else v
 
 
+def _conjuncts(e) -> list:
+    """Flatten an AND tree into its conjuncts."""
+    if isinstance(e, E.BinOp) and e.op == "&":
+        return _conjuncts(e.left) + _conjuncts(e.right)
+    return [e]
+
+
+def _conjoin(parts):
+    out = None
+    for p in parts:
+        out = p if out is None else E.BinOp("&", out, p)
+    return out
+
+
+def _relation_aliases(q: Query) -> set:
+    """The relation aliases a query's own FROM/JOIN clause binds."""
+    names = set()
+    if isinstance(q.view, str):
+        names.add((q.view_alias or q.view).lower())
+    elif isinstance(q.view, DerivedTable) and q.view.alias:
+        names.add(q.view.alias.lower())
+    for view, _how, _keys, jalias in q.joins:
+        nm = jalias or (view if isinstance(view, str) else None)
+        if nm:
+            names.add(nm.lower())
+    return names
+
+
+def _outer_refs(expr, outer_scope: dict, inner_aliases: set) -> set:
+    """Qualified names in ``expr`` whose alias binds in the OUTER scope
+    but not in the subquery's own relations — the correlation points."""
+    cols: set = set()
+    _referenced_cols(expr, cols)
+    out = set()
+    for name in cols:
+        if "." not in name or "(" in name:
+            continue
+        alias = name.partition(".")[0].lower()
+        if alias in outer_scope and alias not in inner_aliases:
+            out.add(name)
+    return out
+
+
+def _decorrelate_one(sub: Query, extra_outer_cols, outer_scope, cat):
+    """Rewrite one correlated predicate subquery into a semi-join input.
+
+    Returns ``(right_frame, keys)`` where ``right_frame``'s columns are
+    named after the OUTER flat columns and ``keys`` joins it left-semi
+    (EXISTS/IN) or left-anti (negations) — Spark's own decorrelation.
+    ``extra_outer_cols`` carries the IN form's outer expression paired
+    with the subquery's select item. Only conjunctive equi-correlation
+    is supported; anything else raises the unsupported-correlation error.
+    """
+    inner_aliases = _relation_aliases(sub)
+
+    def unsupported(why):
+        return ValueError(
+            f"unsupported correlated subquery ({why}); only conjunctive "
+            "equality correlation decorrelates (the Spark semi/anti-join "
+            "rewrite) — rewrite the query as an explicit JOIN")
+
+    if sub.unions or sub.group_by or sub.having or sub.limit is not None \
+            or getattr(sub, "offset", 0) or sub.ctes:
+        raise unsupported("the subquery uses set ops, grouping, or limits")
+    eq_pairs = []      # (outer flat col, inner expr)
+    rest = []
+    for c in _conjuncts(sub.where) if sub.where is not None else []:
+        refs = _outer_refs(c, outer_scope, inner_aliases)
+        if not refs:
+            rest.append(c)
+            continue
+        if (isinstance(c, E.BinOp) and c.op == "=="
+                and isinstance(c.left, E.Col) and isinstance(c.right, E.Col)):
+            l_out = c.left.name in refs
+            r_out = c.right.name in refs
+            if l_out != r_out:
+                outer_name = c.left.name if l_out else c.right.name
+                inner_col = c.right if l_out else c.left
+                eq_pairs.append((
+                    _resolve_name(outer_name, outer_scope, ()), inner_col))
+                continue
+        raise unsupported(f"non-equi correlated predicate {c}")
+    for outer_expr, item in extra_outer_cols:
+        if not isinstance(outer_expr, E.Col):
+            raise unsupported("the IN operand must be a plain column")
+        eq_pairs.append((outer_expr.name, item))
+    if not eq_pairs:
+        raise unsupported("no equality correlation found")
+
+    def _inner_key(ie):
+        # normalized inner-column identity: strip the subquery's own
+        # relation qualifier so ``g.guest`` and ``guest`` compare equal
+        if isinstance(ie, E.Col):
+            alias, _, col = ie.name.partition(".")
+            return col if alias.lower() in inner_aliases else ie.name
+        return str(ie)
+
+    deduped: dict = {}
+    for o, ie in eq_pairs:
+        k = _inner_key(ie)
+        if o in deduped and deduped[o][1] != k:
+            raise unsupported("two different correlation keys target one "
+                              "outer column")
+        deduped.setdefault(o, (ie, k))
+    eq_pairs = [(o, ie) for o, (ie, _) in deduped.items()]
+    names = [o for o, _ in eq_pairs]
+    inner = Query([E.Alias(ie if isinstance(ie, E.Expr) else E.Col(ie), o)
+                   for o, ie in eq_pairs],
+                  sub.view, _conjoin(rest), joins=sub.joins, distinct=True)
+    inner.view_alias = sub.view_alias
+    return _execute_set(inner, cat), names
+
+
+def _decorrelate_where(where, scope: dict, cat):
+    """Split WHERE into plain conjuncts and correlated predicate
+    subqueries; the latter become (right_frame, keys, how) semi/anti
+    joins. Uncorrelated subqueries stay put (literal resolution handles
+    them, preserving their null semantics)."""
+    keep = []
+    joins = []
+    for c in _conjuncts(where):
+        neg = False
+        target = c
+        if (isinstance(c, E.UnaryOp) and c.op == "!"
+                and isinstance(c.child, (SubqueryExists, SubqueryIn))):
+            neg, target = True, c.child
+        if isinstance(target, SubqueryExists):
+            sub, extra = target.query, []
+        elif isinstance(target, SubqueryIn):
+            from ..frame.aggregates import AggExpr
+
+            sub = target.query
+            neg = neg != target.negated
+            if len(sub.items) != 1 or isinstance(sub.items[0],
+                                                 (str, AggExpr)):
+                keep.append(c)
+                continue
+            extra = [(target.child, sub.items[0])]
+        else:
+            keep.append(c)
+            continue
+        inner_aliases = _relation_aliases(sub)
+        correlated = bool(_outer_refs(sub.where, scope, inner_aliases)
+                          if sub.where is not None else False)
+        if not correlated:
+            keep.append(c)          # uncorrelated: existing literal path
+            continue
+        right, keys = _decorrelate_one(sub, extra, scope, cat)
+        joins.append((right, keys, "left_anti" if neg else "left_semi"))
+    return _conjoin(keep), joins
+
+
 def _execute_subquery(q: Query, cat):
     """Run a subquery, converting an outer-alias reference into the
     clear diagnosis: correlation is not supported — Spark itself
@@ -1370,6 +1522,14 @@ def _execute_single(q: Query, cat):
                        if isinstance(k, str)
                        else _resolve_qualified(k, scope, cols_now), a)
                       for k, a in q.order_by]
+    # Correlated EXISTS/IN predicates decorrelate into semi/anti joins
+    # (the rewrite Spark itself performs). NOT IN keeps join-key null
+    # semantics here (a null key never matches), not SQL's three-valued
+    # NOT IN — the uncorrelated literal path below retains the latter.
+    if q.where is not None and scope:
+        q.where, corr_joins = _decorrelate_where(q.where, scope, cat)
+        for right, keys, how in corr_joins:
+            frame = frame.join(right, on=keys, how=how)
     # Uncorrelated subqueries (scalar / IN / EXISTS) resolve to literals
     # against the same catalog before the enclosing query evaluates.
     if q.where is not None:

@@ -168,14 +168,63 @@ class TestInSubquery:
                                    fluent.to_pydict()["price"])
 
 
-class TestCorrelationDiagnosis:
-    def test_correlated_exists_gets_clear_error(self, session, views):
-        # Spark rewrites correlated EXISTS into semi joins; here the
-        # rewrite is the user's (semi/anti joins are first-class) and the
-        # error says exactly that.
-        with pytest.raises(ValueError, match="LEFT SEMI"):
+class TestCorrelatedSubqueries:
+    """Equi-correlated EXISTS/IN decorrelate into semi/anti joins — the
+    rewrite Spark itself performs. Non-equi correlation raises with the
+    rewrite named."""
+
+    def test_correlated_exists(self, session, views):
+        out = session.sql("SELECT price FROM t WHERE EXISTS "
+                          "(SELECT 1 FROM g WHERE g.guest = t.guest)")
+        assert sorted(out.to_pydict()["price"].tolist()) == [95.0, 200.0]
+
+    def test_correlated_not_exists(self, session, views):
+        out = session.sql("SELECT price FROM t WHERE NOT EXISTS "
+                          "(SELECT 1 FROM g WHERE g.guest = t.guest)")
+        assert sorted(out.to_pydict()["price"].tolist()) == [30.0, 120.0]
+
+    def test_correlated_exists_with_inner_filter(self, session, views):
+        out = session.sql("SELECT price FROM t WHERE EXISTS "
+                          "(SELECT 1 FROM g WHERE g.guest = t.guest "
+                          "AND g.tag > 1)")
+        assert out.to_pydict()["price"].tolist() == [200.0]
+
+    def test_correlated_exists_composes_with_outer_predicates(
+            self, session, views):
+        out = session.sql("SELECT price FROM t WHERE EXISTS "
+                          "(SELECT 1 FROM g WHERE g.guest = t.guest) "
+                          "AND price < 100")
+        assert out.to_pydict()["price"].tolist() == [95.0]
+
+    def test_correlated_in(self, session, views):
+        out = session.sql("SELECT price FROM t WHERE guest IN "
+                          "(SELECT guest FROM g WHERE g.guest = t.guest "
+                          "AND tag > 1)")
+        assert out.to_pydict()["price"].tolist() == [200.0]
+
+    def test_correlated_not_in(self, session, views):
+        out = session.sql("SELECT price FROM t WHERE guest NOT IN "
+                          "(SELECT guest FROM g WHERE g.guest = t.guest)")
+        assert sorted(out.to_pydict()["price"].tolist()) == [30.0, 120.0]
+
+    def test_agrees_with_explicit_semi_join(self, session, views):
+        corr = session.sql("SELECT price FROM t WHERE EXISTS "
+                           "(SELECT 1 FROM g WHERE g.guest = t.guest)")
+        semi = session.sql(
+            "SELECT price FROM t LEFT SEMI JOIN g USING (guest)")
+        assert sorted(corr.to_pydict()["price"].tolist()) == \
+            sorted(semi.to_pydict()["price"].tolist())
+
+    def test_non_equi_correlation_gets_clear_error(self, session, views):
+        with pytest.raises(ValueError, match="non-equi"):
             session.sql("SELECT guest FROM t WHERE EXISTS "
-                        "(SELECT 1 FROM g WHERE t.guest = g.guest)")
+                        "(SELECT 1 FROM g WHERE g.tag > t.guest)")
+
+    def test_correlated_grouped_subquery_unsupported(self, session, views):
+        with pytest.raises(ValueError, match="set ops, grouping"):
+            session.sql("SELECT guest FROM t WHERE EXISTS "
+                        "(SELECT count(*) FROM g WHERE g.guest = t.guest "
+                        "GROUP BY tag)")
 
     def test_create_temp_view_raises_on_duplicate(self, session, views):
         t, _ = views
