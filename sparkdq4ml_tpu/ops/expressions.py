@@ -2899,6 +2899,7 @@ _BUILTIN_FNS.update({
     "nullif": _fn_nullif,
     "nvl2": _fn_nvl2,
     "ifnull": _fn_coalesce,
+    "nvl": _fn_coalesce,
     "substring_index": _fn_substring_index,
     "soundex": _fn_soundex,
     "encode": _fn_encode,

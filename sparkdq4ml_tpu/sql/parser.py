@@ -768,9 +768,17 @@ class _Parser:
             self.expect("op", ")")
             return E.Cast(inner, tname)
         if self.accept("kw", "case"):
+            # simple form: CASE operand WHEN v THEN r ... — each WHEN
+            # value compares against the operand by equality
+            operand = None
+            if not (self.peek().kind == "kw"
+                    and self.peek().value.lower() == "when"):
+                operand = self.parse_or()
             branches = []
             while self.accept("kw", "when"):
                 cond = self.parse_or()
+                if operand is not None:
+                    cond = E.BinOp("==", operand, cond)
                 self.expect("kw", "then")
                 branches.append((cond, self.parse_or()))
             if not branches:

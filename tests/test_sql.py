@@ -216,3 +216,19 @@ class TestDistinctHavingUnion:
         out = execute("SELECT s FROM nulls WHERE s NOT IN ('a')",
                       session.catalog)
         assert [r[0] for r in out.collect()] == ["c"]  # None row drops
+
+
+class TestSimpleCaseAndNvl:
+    def test_simple_case_form(self, session, view):
+        out = session.sql("SELECT CASE guest WHEN 1 THEN 10 WHEN 2 THEN 20 "
+                          "ELSE 99 END AS c FROM price")
+        assert out.to_pydict()["c"].tolist() == [10, 20, 99]
+
+    def test_searched_case_still_works(self, session, view):
+        out = session.sql("SELECT CASE WHEN guest > 2 THEN 1 ELSE 0 END AS c "
+                          "FROM price")
+        assert out.to_pydict()["c"].tolist() == [0, 0, 1]
+
+    def test_nvl_alias(self, session, view):
+        out = session.sql("SELECT nvl(nullif(guest, 2), -1) AS c FROM price")
+        assert out.to_pydict()["c"].tolist() == [1.0, -1.0, 3.0]
