@@ -46,6 +46,7 @@ def main() -> None:
     df = session.sql("SELECT guest, price_correct_correl AS price "
                      "FROM price WHERE price_correct_correl > 0")
     df = df.with_column("label", df.col("price"))
+    assert df.count() == 1024          # golden DQ count (SURVEY §2.3)
     print(f"DQ-clean rows: {df.count()}")
 
     # --- train/test split + Pipeline fit -----------------------------------
@@ -57,6 +58,7 @@ def main() -> None:
     model = pipe.fit(train)
     rmse = RegressionEvaluator(metric_name="rmse").evaluate(
         model.transform(test))
+    assert rmse < 4.0                  # ~1.77 measured; wide margin
     print(f"held-out RMSE (train {train.count()} / test {test.count()}): "
           f"{rmse:.4f}")
 
@@ -67,6 +69,7 @@ def main() -> None:
         restored = PipelineModel.load(ckpt)
         r2 = RegressionEvaluator(metric_name="r2").evaluate(
             restored.transform(test))
+        assert r2 > 0.99               # persistence must not drift
         print(f"restored model r2 on test: {r2:.4f}")
 
     # --- cross-validated grid over (regParam x elasticNetParam) ------------
@@ -78,12 +81,14 @@ def main() -> None:
                         RegressionEvaluator(metric_name="rmse"), num_folds=3)
     cv_model = cv.fit(fdf)
     best = cv_model.best_index
+    assert cv_model.avg_metrics[best] < 3.0
     print(f"CV best params: {grid[best]}  avg RMSE {cv_model.avg_metrics[best]:.4f}")
 
     # --- logistic classifier: is this a "large party" booking? -------------
     ldf = fdf.with_column("label", (fdf.col("guest") > 25).cast("double"))
     lmodel = LogisticRegression(max_iter=50, reg_param=0.01).fit(ldf)
     auc = BinaryClassificationEvaluator().evaluate(lmodel.transform(ldf))
+    assert auc > 0.99                  # separable threshold labels
     print(f"large-party classifier AUC: {auc:.4f} "
           f"(iterations: {lmodel.summary.total_iterations})")
 
@@ -101,16 +106,19 @@ def main() -> None:
     gbt = GBTRegressor(max_iter=20, max_depth=3, step_size=0.2).fit(fdf)
     gbt_rmse = RegressionEvaluator(metric_name="rmse").evaluate(
         gbt.transform(fdf))
+    assert gbt_rmse < 4.0
     print(f"GBT price fit RMSE: {gbt_rmse:.4f}")
 
     rf = RandomForestClassifier(num_trees=10, max_depth=4).fit(ldf)
     rf_out = rf.transform(ldf).to_pydict()
     rf_acc = float(np.mean(rf_out["prediction"] == rf_out["label"]))
+    assert rf_acc > 0.95
     print(f"random-forest large-party accuracy: {rf_acc:.3f}")
 
     km = KMeans(k=3, seed=7, features_col="features").fit(fdf)
     sil = ClusteringEvaluator(features_col="features").evaluate(
         km.transform(fdf))
+    assert sil > 0.5
     print(f"k=3 guest clustering silhouette: {sil:.3f} "
           f"(sizes {sorted(km.summary.cluster_sizes)})")
 
