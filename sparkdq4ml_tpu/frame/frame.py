@@ -1228,15 +1228,11 @@ class Frame:
 
     def distinct(self) -> "Frame":
         """Unique valid rows (host boundary; result compact, order of first
-        occurrence)."""
-        rows = self.collect()
+        occurrence). Null-safe like Spark: null rows equal each other, so
+        duplicates with NaN/None cells collapse too."""
         seen = set()
         out = []
-        for r in rows:
-            key = tuple(
-                tuple(x.tolist()) if isinstance(x, np.ndarray)  # vector cell
-                else (x.item() if hasattr(x, "item") else x)
-                for x in r)
+        for key, r in self._keyed_rows():
             if key not in seen:
                 seen.add(key)
                 out.append(r)

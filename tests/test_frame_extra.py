@@ -155,3 +155,28 @@ class TestDescribeStrings:
         f = Frame({"s": np.asarray(["x"], dtype=object)})
         d = f.describe("s").to_pydict()
         assert list(d["s"])[0] == "1"
+
+
+class TestDistinctNullSafety:
+    def test_distinct_collapses_nan_rows(self):
+        import math
+
+        from sparkdq4ml_tpu import Frame
+        f = Frame({"k": [math.nan, math.nan, 1.0]})
+        assert f.distinct().count() == 2   # Spark: null rows equal
+
+    def test_distinct_collapses_none_strings(self):
+        import numpy as np
+
+        from sparkdq4ml_tpu import Frame
+        f = Frame({"s": np.asarray([None, None, "a"], object)})
+        assert f.distinct().count() == 2
+
+    def test_sql_distinct_null_safe(self, session):
+        import math
+
+        from sparkdq4ml_tpu import Frame
+        Frame({"k": [math.nan, math.nan, 2.0]}) \
+            .create_or_replace_temp_view("dn")
+        assert session.sql("SELECT DISTINCT k FROM dn").count() == 2
+        session.catalog.drop("dn")
