@@ -405,18 +405,23 @@ def global_agg(frame, aggs: list[AggExpr]):
         wf = wf * jnp.logical_not(null).astype(vf.dtype)
         nv = jnp.sum(wf)
         vf = jnp.where(null, 0.0, vf)
+        nan = jnp.asarray(jnp.nan, vf.dtype)
+        empty = float(nv) == 0.0      # eager: SQL NULL results over
+        #                               zero non-null rows (Spark)
         if agg.fn == "count":
             out[agg.name] = jnp.sum(valid, dtype=jnp.int32)[None]
         elif agg.fn == "sum":
-            out[agg.name] = jnp.sum(vf * wf)[None]
+            out[agg.name] = (nan if empty else jnp.sum(vf * wf))[None]
         elif agg.fn == "avg":
             out[agg.name] = (jnp.sum(vf * wf) / nv)[None]
         elif agg.fn == "min":
             big = jnp.asarray(jnp.inf, vf.dtype)
-            out[agg.name] = jnp.min(jnp.where(valid, vf, big))[None].astype(v.dtype)
+            out[agg.name] = (nan if empty else jnp.min(
+                jnp.where(valid, vf, big)).astype(v.dtype))[None]
         elif agg.fn == "max":
             small = jnp.asarray(-jnp.inf, vf.dtype)
-            out[agg.name] = jnp.max(jnp.where(valid, vf, small))[None].astype(v.dtype)
+            out[agg.name] = (nan if empty else jnp.max(
+                jnp.where(valid, vf, small)).astype(v.dtype))[None]
         else:  # stddev / variance: sample (n-1); NaN when n < 2 (Spark)
             mu = jnp.sum(vf * wf) / nv
             ss = jnp.sum(wf * (vf - mu) ** 2)

@@ -565,10 +565,15 @@ def _null_mask(v):
 
 
 def _str_map(fn, *arrays):
-    """Apply a per-row Python fn over host string columns (None-safe)."""
+    """Apply a per-row Python fn over host string columns (null-safe:
+    None AND float NaN — a NULL literal reaches here as NaN — yield
+    NULL instead of feeding a float into a str method)."""
+    def null(x):
+        return x is None or (isinstance(x, float) and x != x)
+
     out = []
     for row in zip(*[np.asarray(a, object) for a in arrays]):
-        out.append(None if any(x is None for x in row) else fn(*row))
+        out.append(None if any(null(x) for x in row) else fn(*row))
     return np.asarray(out, dtype=object)
 
 
@@ -675,6 +680,19 @@ def _scalar_str(v) -> str:
 
 def _scalar_int(v) -> int:
     return int(_scalar_value(v))
+
+
+def _fn_concat(*ss):
+    """Spark concat: NULL if ANY argument is null (None or float NaN —
+    the engine's numeric null stringifies as 'nan' otherwise)."""
+    def null(x):
+        return x is None or (isinstance(x, float) and x != x)
+
+    out = []
+    for row in zip(*[np.asarray(a, object) for a in ss]):
+        out.append(None if any(null(x) for x in row)
+                   else "".join(str(x) for x in row))
+    return np.asarray(out, dtype=object)
 
 
 def _fn_concat_ws(sep, *ss):
@@ -1303,9 +1321,11 @@ _BUILTIN_FNS = {
     "round": _fn_round,
     "sign": lambda v: jnp.sign(jnp.asarray(v, float_dtype())),
     "signum": lambda v: jnp.sign(jnp.asarray(v, float_dtype())),
-    "greatest": lambda *vs: functools.reduce(jnp.maximum,
+    # fmax/fmin skip NaN (Spark: greatest/least ignore nulls, NULL only
+    # when every operand is null)
+    "greatest": lambda *vs: functools.reduce(jnp.fmax,
                                              [jnp.asarray(v) for v in vs]),
-    "least": lambda *vs: functools.reduce(jnp.minimum,
+    "least": lambda *vs: functools.reduce(jnp.fmin,
                                           [jnp.asarray(v) for v in vs]),
     "isnan": lambda v: jnp.isnan(jnp.asarray(v, float_dtype())),
     "coalesce": _fn_coalesce,
@@ -1336,7 +1356,7 @@ _BUILTIN_FNS = {
     "ltrim": lambda s: _str_map(str.lstrip, s),
     "rtrim": lambda s: _str_map(str.rstrip, s),
     "length": _fn_length,
-    "concat": lambda *ss: _str_map(lambda *xs: "".join(str(x) for x in xs), *ss),
+    "concat": lambda *ss: _fn_concat(*ss),
     "md5": lambda s: _str_map(
         lambda x: hashlib.md5(x.encode()).hexdigest(), s),
     "sha1": lambda s: _str_map(

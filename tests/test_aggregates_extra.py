@@ -372,3 +372,29 @@ class TestHashAndEncodingFns:
         with pytest.raises(ValueError, match="windowed percentile_approx"):
             session.sql("SELECT PERCENTILE_APPROX(v, 0.5) OVER "
                         "(PARTITION BY k) AS p FROM t_wp")
+
+
+class TestEmptyAggregateNulls:
+    """Spark: SUM/MIN/MAX over zero non-null rows are NULL (never ±inf
+    or 0); COUNT is 0. Caught by a semantics probe against ±inf leaks."""
+
+    def test_global_aggs_over_empty_frame(self):
+        import numpy as np
+
+        from sparkdq4ml_tpu import Frame, functions as F
+        from sparkdq4ml_tpu.ops.expressions import col
+
+        empty = Frame({"v": [1.0, 2.0]}).filter(col("v") > 99)
+        d = empty.agg(F.min("v").alias("mn"), F.max("v").alias("mx"),
+                      F.sum("v").alias("s"), F.count("v").alias("n")) \
+            .to_pydict()
+        assert np.isnan(d["mn"][0]) and np.isnan(d["mx"][0])
+        assert np.isnan(d["s"][0])
+        assert d["n"][0] == 0
+
+    def test_concat_null_propagates(self, session):
+        out = session.sql("SELECT concat('a', NULL) AS c, "
+                          "concat('a', 'b') AS ok")
+        d = out.to_pydict()
+        assert list(d["c"]) == [None]
+        assert list(d["ok"]) == ["ab"]

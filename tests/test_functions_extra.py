@@ -316,3 +316,25 @@ class TestExplode:
         fa = f.with_column("arr", F2.split(F2.col("s"), ","))
         with pytest.raises(ValueError, match="collides"):
             fa.select("pos", F2.posexplode(F2.col("arr")))
+
+
+class TestNullSemanticsProbes:
+    """Spark null-handling parity found by probing: greatest/least skip
+    nulls (NULL only when all operands are null); string fns over a NULL
+    (float-NaN) input yield NULL instead of crashing."""
+
+    def test_greatest_least_skip_nulls(self, session):
+        d = session.sql("SELECT greatest(1, NULL, 3) AS g, "
+                        "least(5, NULL, 3) AS l, "
+                        "greatest(NULL, NULL) AS an").to_pydict()
+        assert d["g"].tolist() == [3.0]
+        assert d["l"].tolist() == [3.0]
+        import numpy as np
+        assert np.isnan(d["an"][0])
+
+    def test_string_fn_over_null_literal(self, session):
+        d = session.sql("SELECT upper(NULL) AS u, lower(NULL) AS lo, "
+                        "trim(NULL) AS t").to_pydict()
+        assert list(d["u"]) == [None]
+        assert list(d["lo"]) == [None]
+        assert list(d["t"]) == [None]
