@@ -476,7 +476,10 @@ class WindowExpr(Expr):
                 if agg == "count":
                     out[s:e] = cc[upto - 1]
                 elif agg == "sum":
-                    out[s:e] = cs[upto - 1]
+                    # zero non-null rows in the frame so far → NULL, not
+                    # 0 (Spark; caught by the pandas differential sweep)
+                    out[s:e] = np.where(cc[upto - 1] > 0, cs[upto - 1],
+                                        np.nan)
                 elif agg == "avg":
                     c = cc[upto - 1]
                     out[s:e] = np.where(c > 0, cs[upto - 1] / np.maximum(c, 1),

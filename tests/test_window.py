@@ -480,3 +480,17 @@ class TestSqlFrames:
             execute("SELECT SUM(v) OVER (PARTITION BY g ORDER BY t "
                     "ROWS BETWEEN 1.7 PRECEDING AND CURRENT ROW) AS s "
                     "FROM t1", self._cat())
+
+
+class TestRunningSumNullPrefix:
+    def test_all_null_prefix_is_null_not_zero(self):
+        # Spark: SUM OVER an ordered frame with zero non-null rows so far
+        # is NULL; found by the pandas differential sweep.
+        import math
+        f = Frame({"k": [1.0, 1.0, 1.0], "o": [1.0, 2.0, 3.0],
+                   "v": [math.nan, 2.0, 3.0]})
+        w = F.Window.partitionBy("k").orderBy("o")
+        rs = f.withColumn("rs", F.sum("v").over(w)).sort("o") \
+            .to_pydict()["rs"]
+        assert math.isnan(rs[0])
+        assert rs[1] == 2.0 and rs[2] == 5.0
