@@ -184,6 +184,8 @@ class Expr:
     def __rmul__(self, o): return self._bin("*", o, True)
     def __truediv__(self, o):  return self._bin("/", o)
     def __rtruediv__(self, o): return self._bin("/", o, True)
+    def __mod__(self, o):      return self._bin("%", o)
+    def __rmod__(self, o):     return self._bin("%", o, True)
     def __neg__(self):     return UnaryOp("-", self)
     def __lt__(self, o):   return self._bin("<", o)
     def __le__(self, o):   return self._bin("<=", o)
@@ -265,11 +267,22 @@ class Alias(Expr):
         return f"{self.child} AS {self._name}"
 
 
+def _sql_divide(a, b):
+    """Spark's non-ANSI division: x / 0 is NULL (incl. 0 / 0)."""
+    return jnp.where(b == 0, jnp.nan, jnp.divide(a, b))
+
+
+def _sql_mod(a, b):
+    """Spark's % / mod(): sign follows the dividend; x % 0 is NULL."""
+    return jnp.where(b == 0, jnp.nan, jnp.fmod(a, b))
+
+
 _BIN_FNS = {
     "+": jnp.add,
     "-": jnp.subtract,
     "*": jnp.multiply,
-    "/": jnp.divide,
+    "/": _sql_divide,
+    "%": _sql_mod,
     "<": jnp.less,
     "<=": jnp.less_equal,
     ">": jnp.greater,
@@ -304,8 +317,9 @@ class BinOp(Expr):
             return np_fns[self.op](np.asarray(a, object), np.asarray(b, object)
                                    ).astype(bool)
         a, b = _promote(a, b)
-        if self.op == "/":
-            # Spark's / always yields double
+        if self.op in ("/", "%"):
+            # Spark's / always yields double; % needs float for the
+            # NULL-on-zero-divisor result
             a = jnp.asarray(a, float_dtype())
             b = jnp.asarray(b, float_dtype())
         return _BIN_FNS[self.op](a, b)
@@ -1346,6 +1360,13 @@ _BUILTIN_FNS = {
     "expm1": lambda v: jnp.expm1(jnp.asarray(v, float_dtype())),
     "log1p": lambda v: jnp.log1p(jnp.asarray(v, float_dtype())),
     "log2": lambda v: jnp.log2(jnp.asarray(v, float_dtype())),
+    "mod": lambda a, b: _sql_mod(jnp.asarray(a, float_dtype()),
+                                 jnp.asarray(b, float_dtype())),
+    # positive modulus (Spark pmod): result sign follows the DIVISOR
+    "pmod": lambda a, b: jnp.where(
+        jnp.asarray(b, float_dtype()) == 0, jnp.nan,
+        jnp.mod(jnp.asarray(a, float_dtype()),
+                jnp.asarray(b, float_dtype()))),
     "hypot": lambda a, b: jnp.hypot(jnp.asarray(a, float_dtype()),
                                     jnp.asarray(b, float_dtype())),
     "rint": lambda v: jnp.round(jnp.asarray(v, float_dtype())),
@@ -1392,6 +1413,16 @@ _BUILTIN_FNS = {
     "locate": _fn_locate,
     "lpad": _fn_lpad,
     "rpad": _fn_rpad,
+    # left/right are SQL keywords (join types); the parser special-cases
+    # the call forms LEFT(s, n) / RIGHT(s, n) into these
+    "left": lambda s, n: _str_map(
+        lambda x: x[:_scalar_int(n)] if _scalar_int(n) > 0 else "", s),
+    "right": lambda s, n: _str_map(
+        lambda x: x[-_scalar_int(n):] if _scalar_int(n) > 0 else "", s),
+    "overlay": lambda s, r, pos, ln=None: _str_map(
+        lambda x, y: x[:_scalar_int(pos) - 1] + y
+        + x[_scalar_int(pos) - 1
+            + (_scalar_int(ln) if ln is not None else len(y)):], s, r),
     "repeat": lambda s, n: _str_map(
         lambda x: x * _scalar_int(n), s),
     "reverse": _fn_reverse,

@@ -71,7 +71,7 @@ _TOKEN_RE = re.compile(
     r"(?P<number>\d+\.\d*(?:[eE][+-]?\d+)?|\.\d+(?:[eE][+-]?\d+)?|\d+(?:[eE][+-]?\d+)?)"
     r"|(?P<string>'(?:[^']|'')*')"
     r"|(?P<ident>[A-Za-z_][A-Za-z_0-9]*)"
-    r"|(?P<op>->|<=|>=|<>|!=|==|=|<|>|\+|-|\*|/|\(|\)|,|\.)"
+    r"|(?P<op>->|<=|>=|<>|!=|==|=|<|>|\+|-|\*|/|%|\(|\)|,|\.)"
     r")")
 
 _KEYWORDS = {"select", "from", "where", "as", "and", "or", "not", "cast",
@@ -735,6 +735,8 @@ class _Parser:
                 left = E.BinOp("*", left, self.parse_unary())
             elif self.accept("op", "/"):
                 left = E.BinOp("/", left, self.parse_unary())
+            elif self.accept("op", "%"):
+                left = E.BinOp("%", left, self.parse_unary())
             else:
                 return left
 
@@ -786,6 +788,18 @@ class _Parser:
             otherwise = self.parse_or() if self.accept("kw", "else") else None
             self.expect("kw", "end")
             return E.CaseWhen(branches, otherwise)
+        # LEFT(s, n) / RIGHT(s, n): the string functions named by join
+        # keywords — recognized only in call position
+        if (t.kind == "kw" and t.value.lower() in ("left", "right")
+                and self.toks[self.i + 1].kind == "op"
+                and self.toks[self.i + 1].value == "("):
+            self.next()
+            self.expect("op", "(")
+            args = [self.parse_or()]
+            while self.accept("op", ","):
+                args.append(self.parse_or())
+            self.expect("op", ")")
+            return E.UdfCall(t.value.lower(), args)
         if t.kind == "ident":
             self.next()
             if self.accept("op", "("):

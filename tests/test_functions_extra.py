@@ -338,3 +338,52 @@ class TestNullSemanticsProbes:
         assert list(d["u"]) == [None]
         assert list(d["lo"]) == [None]
         assert list(d["t"]) == [None]
+
+
+class TestDivisionModSemantics:
+    """Spark non-ANSI arithmetic: x/0 and x%0 are NULL; % sign follows
+    the dividend, pmod's the divisor."""
+
+    def test_divide_by_zero_is_null(self, session):
+        import numpy as np
+        d = session.sql("SELECT 1 / 0 AS a, 0.0 / 0 AS b, 10 / 4 AS c") \
+            .to_pydict()
+        assert np.isnan(d["a"][0]) and np.isnan(d["b"][0])
+        assert d["c"][0] == 2.5
+
+    def test_mod_family(self, session):
+        import numpy as np
+        d = session.sql("SELECT 7 % 3 AS a, mod(0-7, 3) AS m, "
+                        "pmod(0-7, 3) AS p, 5 % 0 AS z").to_pydict()
+        assert d["a"][0] == 1.0
+        assert d["m"][0] == -1.0     # dividend sign (Java/Spark %)
+        assert d["p"][0] == 2.0      # positive modulus
+        assert np.isnan(d["z"][0])
+
+    def test_fluent_mod_operator(self):
+        from sparkdq4ml_tpu import Frame
+        f = Frame({"x": [7.0, -7.0]})
+        assert f.with_column("m", f["x"] % 3).to_pydict()["m"].tolist() \
+            == [1.0, -1.0]
+
+
+class TestKeywordNamedStringFns:
+    def test_left_right_call_forms(self, session):
+        d = session.sql("SELECT left('hello', 2) AS l, "
+                        "right('hello', 2) AS r").to_pydict()
+        assert list(d["l"]) == ["he"] and list(d["r"]) == ["lo"]
+
+    def test_overlay(self, session):
+        d = session.sql("SELECT overlay('hello', 'XX', 2) AS a, "
+                        "overlay('hello', 'XX', 2, 3) AS b").to_pydict()
+        assert list(d["a"]) == ["hXXlo"]
+        assert list(d["b"]) == ["hXXo"]
+
+    def test_left_join_grammar_unaffected(self, session):
+        from sparkdq4ml_tpu import Frame
+        Frame({"k": [1.0], "x": [2.0]}).create_or_replace_temp_view("lj_a")
+        Frame({"k": [1.0], "y": [3.0]}).create_or_replace_temp_view("lj_b")
+        out = session.sql("SELECT x, y FROM lj_a LEFT JOIN lj_b USING (k)")
+        assert out.count() == 1
+        session.catalog.drop("lj_a")
+        session.catalog.drop("lj_b")
