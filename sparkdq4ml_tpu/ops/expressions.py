@@ -552,6 +552,8 @@ class UdfCall(Expr):
             # `abs(x)`, `upper(s)` etc. resolve without UDF registration
             # (Spark's FunctionRegistry builtins behave the same way).
             key = self.udf_name.lower()
+            if key in _ROW_FNS:     # frame-aware: need the row count
+                return _ROW_FNS[key](frame, self.args)
             if key in _BUILTIN_FNS:
                 return Func(key, self.args).eval(frame)
             raise
@@ -1317,6 +1319,72 @@ def _fn_translate(s, matching, replace):
             mapping[a] = rep[i] if i < len(rep) else None
     table = str.maketrans(mapping)
     return _str_map(lambda x: x.translate(table), s)
+
+
+# Frame-aware nullary/row functions: they need the row count (or the
+# evaluated argument's dtype), so they bypass the value-only builtin
+# table and receive (frame, arg_exprs) from UdfCall.eval.
+def _row_mono_id(frame, args):
+    if args:
+        raise ValueError("monotonically_increasing_id() takes no arguments")
+    return jnp.arange(frame.num_slots, dtype=jnp.int32)
+
+
+def _row_uuid(frame, args):
+    if args:
+        raise ValueError("uuid() takes no arguments")
+    import uuid as _uuid
+
+    return np.asarray([str(_uuid.uuid4()) for _ in range(frame.num_slots)],
+                      dtype=object)
+
+
+def _row_rand(kind):
+    def f(frame, args):
+        import secrets
+
+        import jax as _jax
+
+        seed = (int(_lit_arg(args[0], f"{kind} seed")) if args
+                else secrets.randbits(31))
+        key = _jax.random.PRNGKey(seed)
+        shape = (frame.num_slots,)
+        if kind == "rand":
+            return _jax.random.uniform(key, shape, float_dtype())
+        return _jax.random.normal(key, shape, float_dtype())
+    return f
+
+
+def _lit_arg(expr, what):
+    if isinstance(expr, Lit):
+        return expr.value
+    if isinstance(expr, UnaryOp) and expr.op == "-" \
+            and isinstance(expr.child, Lit):
+        return -expr.child.value
+    raise ValueError(f"{what} must be a literal")
+
+
+def _row_typeof(frame, args):
+    if len(args) != 1:
+        raise ValueError("typeof(expr) takes one argument")
+    v = args[0].eval(frame)
+    if _is_object(v):
+        name = "string"
+    else:
+        dt = jnp.asarray(v).dtype
+        name = ("boolean" if dt == jnp.bool_
+                else "int" if jnp.issubdtype(dt, jnp.integer)
+                else "double")
+    return np.asarray([name] * frame.num_slots, dtype=object)
+
+
+_ROW_FNS = {
+    "monotonically_increasing_id": _row_mono_id,
+    "uuid": _row_uuid,
+    "rand": _row_rand("rand"),
+    "randn": _row_rand("randn"),
+    "typeof": _row_typeof,
+}
 
 
 _BUILTIN_FNS = {

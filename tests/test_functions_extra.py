@@ -387,3 +387,38 @@ class TestKeywordNamedStringFns:
         assert out.count() == 1
         session.catalog.drop("lj_a")
         session.catalog.drop("lj_b")
+
+
+class TestRowFunctions:
+    """Frame-aware nullary fns: mono id, rand/randn, uuid, typeof."""
+
+    def test_monotonically_increasing_id(self, session):
+        from sparkdq4ml_tpu import Frame, functions as F
+        f = Frame({"x": [5.0, 6.0, 7.0]})
+        ids = f.with_column("id", F.monotonically_increasing_id()) \
+            .to_pydict()["id"].tolist()
+        assert ids == [0, 1, 2]
+
+    def test_rand_deterministic_with_seed(self, session):
+        from sparkdq4ml_tpu import Frame
+        Frame({"x": [1.0, 2.0]}).create_or_replace_temp_view("rf")
+        a = session.sql("SELECT rand(7) AS r FROM rf").to_pydict()["r"]
+        b = session.sql("SELECT rand(7) AS r FROM rf").to_pydict()["r"]
+        assert (a == b).all()
+        assert ((a >= 0) & (a < 1)).all()
+        session.catalog.drop("rf")
+
+    def test_uuid_unique_per_row(self, session):
+        from sparkdq4ml_tpu import Frame
+        Frame({"x": [1.0, 2.0, 3.0]}).create_or_replace_temp_view("uf")
+        u = session.sql("SELECT uuid() AS u FROM uf").to_pydict()["u"]
+        assert len(set(u)) == 3 and all(len(x) == 36 for x in u)
+        session.catalog.drop("uf")
+
+    def test_typeof(self, session):
+        from sparkdq4ml_tpu import Frame
+        Frame({"x": [1.0]}).create_or_replace_temp_view("tf")
+        d = session.sql("SELECT typeof(x) AS a, typeof('s') AS b FROM tf") \
+            .to_pydict()
+        assert list(d["a"]) == ["double"] and list(d["b"]) == ["string"]
+        session.catalog.drop("tf")
