@@ -103,25 +103,3 @@ def broadcast(df):
     execution strategy (see ``Frame.hint``)."""
     return df
 
-
-def monotonically_increasing_id():
-    """Spark's monotonically_increasing_id: the row index here (single
-    partition — ids are globally sequential, satisfying Spark's
-    monotone-and-unique contract)."""
-    from .ops.expressions import UdfCall
-
-    return UdfCall("monotonically_increasing_id", [])
-
-
-def rand(seed=None):
-    """Uniform [0, 1) per row; deterministic for a given seed."""
-    from .ops.expressions import Lit, UdfCall
-
-    return UdfCall("rand", [] if seed is None else [Lit(int(seed))])
-
-
-def randn(seed=None):
-    """Standard normal per row; deterministic for a given seed."""
-    from .ops.expressions import Lit, UdfCall
-
-    return UdfCall("randn", [] if seed is None else [Lit(int(seed))])

@@ -1321,13 +1321,26 @@ def _fn_translate(s, matching, replace):
     return _str_map(lambda x: x.translate(table), s)
 
 
-# Frame-aware nullary/row functions: they need the row count (or the
-# evaluated argument's dtype), so they bypass the value-only builtin
+# Frame-aware nullary/row functions reached by NAME from SQL (the fluent
+# constructors build RowFunc nodes directly): they need the row count or
+# the evaluated argument's dtype, so they bypass the value-only builtin
 # table and receive (frame, arg_exprs) from UdfCall.eval.
-def _row_mono_id(frame, args):
-    if args:
-        raise ValueError("monotonically_increasing_id() takes no arguments")
-    return jnp.arange(frame.num_slots, dtype=jnp.int32)
+def _lit_arg(expr, what):
+    if isinstance(expr, Lit):
+        return expr.value
+    if isinstance(expr, UnaryOp) and expr.op == "-" \
+            and isinstance(expr.child, Lit):
+        return -expr.child.value
+    raise ValueError(f"{what} must be a literal")
+
+
+def _row_generator(kind, takes_seed=False):
+    def f(frame, args):
+        if not takes_seed and args:
+            raise ValueError(f"{kind}() takes no arguments")
+        seed = int(_lit_arg(args[0], "seed")) if args else None
+        return RowFunc(kind, seed).eval(frame)
+    return f
 
 
 def _row_uuid(frame, args):
@@ -1337,31 +1350,6 @@ def _row_uuid(frame, args):
 
     return np.asarray([str(_uuid.uuid4()) for _ in range(frame.num_slots)],
                       dtype=object)
-
-
-def _row_rand(kind):
-    def f(frame, args):
-        import secrets
-
-        import jax as _jax
-
-        seed = (int(_lit_arg(args[0], f"{kind} seed")) if args
-                else secrets.randbits(31))
-        key = _jax.random.PRNGKey(seed)
-        shape = (frame.num_slots,)
-        if kind == "rand":
-            return _jax.random.uniform(key, shape, float_dtype())
-        return _jax.random.normal(key, shape, float_dtype())
-    return f
-
-
-def _lit_arg(expr, what):
-    if isinstance(expr, Lit):
-        return expr.value
-    if isinstance(expr, UnaryOp) and expr.op == "-" \
-            and isinstance(expr.child, Lit):
-        return -expr.child.value
-    raise ValueError(f"{what} must be a literal")
 
 
 def _row_typeof(frame, args):
@@ -1379,10 +1367,11 @@ def _row_typeof(frame, args):
 
 
 _ROW_FNS = {
-    "monotonically_increasing_id": _row_mono_id,
+    "monotonically_increasing_id": _row_generator("id"),
+    "spark_partition_id": _row_generator("partition_id"),
+    "rand": _row_generator("rand", takes_seed=True),
+    "randn": _row_generator("randn", takes_seed=True),
     "uuid": _row_uuid,
-    "rand": _row_rand("rand"),
-    "randn": _row_rand("randn"),
     "typeof": _row_typeof,
 }
 
