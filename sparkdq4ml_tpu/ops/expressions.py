@@ -1334,11 +1334,18 @@ def _lit_arg(expr, what):
     raise ValueError(f"{what} must be a literal")
 
 
-def _row_generator(kind, takes_seed=False):
+def _row_generator(sql_name, kind, takes_seed=False):
     def f(frame, args):
         if not takes_seed and args:
-            raise ValueError(f"{kind}() takes no arguments")
-        seed = int(_lit_arg(args[0], "seed")) if args else None
+            raise ValueError(f"{sql_name}() takes no arguments")
+        if args and len(args) > 1:
+            raise ValueError(f"{sql_name}([seed]) takes at most one "
+                             "argument")
+        seed = int(_lit_arg(args[0], f"{sql_name} seed")) if args else None
+        if seed is not None and seed < 0:
+            # numpy's default_rng rejects negatives; fold like Spark's
+            # hash-seeded generators rather than erroring
+            seed &= 0x7FFFFFFF
         return RowFunc(kind, seed).eval(frame)
     return f
 
@@ -1367,10 +1374,12 @@ def _row_typeof(frame, args):
 
 
 _ROW_FNS = {
-    "monotonically_increasing_id": _row_generator("id"),
-    "spark_partition_id": _row_generator("partition_id"),
-    "rand": _row_generator("rand", takes_seed=True),
-    "randn": _row_generator("randn", takes_seed=True),
+    "monotonically_increasing_id":
+        _row_generator("monotonically_increasing_id", "id"),
+    "spark_partition_id": _row_generator("spark_partition_id",
+                                         "partition_id"),
+    "rand": _row_generator("rand", "rand", takes_seed=True),
+    "randn": _row_generator("randn", "randn", takes_seed=True),
     "uuid": _row_uuid,
     "typeof": _row_typeof,
 }
@@ -1769,7 +1778,11 @@ class RowFunc(Expr):
             # one logical partition: the id is 0 everywhere (the same
             # no-op stance as repartition/coalesce)
             return jnp.zeros((n,), dtype=int_dtype())
-        rng = np.random.default_rng(self.seed)
+        seed = self.seed
+        if seed is not None and int(seed) < 0:
+            # numpy's default_rng rejects negatives; fold deterministically
+            seed = int(seed) & 0x7FFFFFFFFFFFFFFF
+        rng = np.random.default_rng(seed)
         host = (rng.uniform(size=n) if self.kind == "rand"
                 else rng.standard_normal(size=n))
         return jnp.asarray(host.astype(np.dtype(float_dtype())))
