@@ -1342,10 +1342,8 @@ def _row_generator(sql_name, kind, takes_seed=False):
             raise ValueError(f"{sql_name}([seed]) takes at most one "
                              "argument")
         seed = int(_lit_arg(args[0], f"{sql_name} seed")) if args else None
-        if seed is not None and seed < 0:
-            # numpy's default_rng rejects negatives; fold like Spark's
-            # hash-seeded generators rather than erroring
-            seed &= 0x7FFFFFFF
+        # RowFunc.eval folds negative seeds, so SQL and fluent paths
+        # produce identical streams for the same seed
         return RowFunc(kind, seed).eval(frame)
     return f
 
