@@ -77,7 +77,7 @@ class RegressionEvaluator(Evaluator):
 
     def __init__(self, metric_name: str = "rmse", label_col: str = "label",
                  prediction_col: str = "prediction"):
-        if metric_name not in ("rmse", "mse", "mae", "r2"):
+        if metric_name not in ("rmse", "mse", "mae", "r2", "var"):
             raise ValueError(f"unknown metric {metric_name!r}")
         self.metric_name = metric_name
         self.label_col = label_col
@@ -90,7 +90,7 @@ class RegressionEvaluator(Evaluator):
     setMetricName = set_metric_name
 
     def is_larger_better(self) -> bool:
-        return self.metric_name == "r2"
+        return self.metric_name in ("r2", "var")
 
     isLargerBetter = is_larger_better
 
@@ -107,6 +107,8 @@ class RegressionEvaluator(Evaluator):
             return float(np.mean((y - p) ** 2))
         if self.metric_name == "mae":
             return float(np.mean(np.abs(y - p)))
+        if self.metric_name == "var":   # explained variance (MLlib)
+            return float(np.var(y) - np.var(y - p))
         ss_res = float(np.sum((y - p) ** 2))
         ss_tot = float(np.sum((y - y.mean()) ** 2))
         return float("nan") if ss_tot == 0 else 1.0 - ss_res / ss_tot
@@ -151,7 +153,8 @@ class MulticlassClassificationEvaluator(Evaluator):
     ``weightedPrecision``, ``weightedRecall`` — per-class one-vs-rest
     scores weighted by true-class frequency."""
 
-    _METRICS = ("f1", "accuracy", "weightedPrecision", "weightedRecall")
+    _METRICS = ("f1", "accuracy", "weightedPrecision", "weightedRecall",
+                "hammingLoss")
 
     def __init__(self, metric_name: str = "f1", label_col: str = "label",
                  prediction_col: str = "prediction"):
@@ -162,12 +165,19 @@ class MulticlassClassificationEvaluator(Evaluator):
         self.label_col = label_col
         self.prediction_col = prediction_col
 
+    def is_larger_better(self) -> bool:
+        return self.metric_name != "hammingLoss"
+
+    isLargerBetter = is_larger_better
+
     def evaluate(self, frame: Frame) -> float:
         d = frame.to_pydict()
         y = d[self.label_col].astype(np.float64)
         p = d[self.prediction_col].astype(np.float64)
         if self.metric_name == "accuracy":
             return float(np.mean(y == p))
+        if self.metric_name == "hammingLoss":
+            return float(np.mean(y != p))
         classes = np.unique(y)
         scores, weights = [], []
         for c in classes:

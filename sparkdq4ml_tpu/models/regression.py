@@ -46,7 +46,11 @@ class LinearRegression(Estimator):
     _persist_attrs = ("max_iter", "reg_param", "elastic_net_param", "tol",
                       "fit_intercept", "standardization", "solver",
                       "features_col", "label_col", "prediction_col",
-                      "weight_col", "aggregation_depth")
+                      "weight_col", "aggregation_depth", "loss", "epsilon")
+
+    # class-level defaults: stages persisted before these params existed
+    loss = "squaredError"
+    epsilon = 1.35
 
     def __init__(self, max_iter: int = 100, reg_param: float = 0.0,
                  elastic_net_param: float = 0.0, tol: float = 1e-6,
@@ -54,7 +58,8 @@ class LinearRegression(Estimator):
                  solver: str = "auto", features_col: str = "features",
                  label_col: str = "label", prediction_col: str = "prediction",
                  weight_col: Optional[str] = None,
-                 aggregation_depth: int = 2):
+                 aggregation_depth: int = 2, loss: str = "squaredError",
+                 epsilon: float = 1.35):
         self.max_iter = max_iter
         self.reg_param = reg_param
         self.elastic_net_param = elastic_net_param
@@ -69,6 +74,11 @@ class LinearRegression(Estimator):
         # treeAggregate tree depth in MLlib; meaningless under psum (the ICI
         # all-reduce is already log-depth in hardware). Accepted for API parity.
         self.aggregation_depth = aggregation_depth
+        if loss not in ("squaredError", "huber"):
+            raise ValueError(f"unknown loss {loss!r} "
+                             "(squaredError or huber)")
+        self.loss = loss
+        self.epsilon = float(epsilon)
 
     # -- MLlib-style fluent setters/getters --------------------------------
     def set_max_iter(self, v: int):
@@ -180,6 +190,8 @@ class LinearRegression(Estimator):
             mask_b = mask
             mask = mask.astype(float_dtype()) * jnp.sqrt(
                 jnp.where(mask_b, jnp.asarray(w, float_dtype()), 0.0))
+        if self.loss == "huber":
+            return self._fit_huber(frame, X, y, mask)
         solver_name = resolve_solver(self.solver, self.reg_param,
                                      self.elastic_net_param)
         if mesh is not None and mesh.devices.size <= 1:
@@ -201,6 +213,38 @@ class LinearRegression(Estimator):
         model._summary_source = (frame, result)
         return model
 
+
+    def _fit_huber(self, frame, X, y, mask) -> "LinearRegressionModel":
+        """MLlib ``loss="huber"``: robust fit of Huber's concomitant-scale
+        objective (see ``solvers.huber_fit``). L1 is unsupported exactly
+        as in MLlib; the scale estimate surfaces as ``model.scale``.
+        The robust loss has no Gramian sufficient statistic, so this
+        path revisits rows per iteration inside one jitted while_loop
+        (a mesh would psum the per-iteration gradient; the single-program
+        form covers the reference's row counts with headroom)."""
+        from .solvers import FitResult, huber_fit
+
+        if self.elastic_net_param not in (0, 0.0):
+            raise ValueError("huber loss supports only L2 regularization "
+                             "(elasticNetParam must be 0), as in MLlib")
+        b_, c_, sigma, iters, obj = huber_fit(
+            X, y, mask, epsilon=self.epsilon, reg_param=self.reg_param,
+            fit_intercept=self.fit_intercept, max_iter=max(self.max_iter, 200),
+            tol=self.tol)
+        model = LinearRegressionModel(
+            coefficients=np.asarray(b_), intercept=float(c_),
+            params=self._params_dict())
+        model.scale = float(sigma)
+        import jax.numpy as jnp
+
+        fd = jnp.asarray(X).dtype
+        result = FitResult(
+            coefficients=jnp.asarray(b_), intercept=jnp.asarray(c_, fd),
+            iterations=jnp.asarray(int(iters), jnp.int32),
+            objective_history=jnp.asarray([float(obj)], fd),
+            converged=jnp.asarray(True))
+        model._summary_source = (frame, result)
+        return model
 
     def fit_from_gram(self, A, frame: Frame) -> "LinearRegressionModel":
         """Fit from a precomputed augmented Gramian — zero data passes.

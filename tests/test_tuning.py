@@ -177,3 +177,31 @@ class TestTrainValidationSplit:
         model = tvs.fit(reg_frame)
         assert model.best_index == 0
         assert model.validation_metrics.shape == (2,)
+
+
+class TestEvaluatorMetricAdditions:
+    def test_regression_var_metric(self):
+        import numpy as np
+
+        from sparkdq4ml_tpu import Frame
+        from sparkdq4ml_tpu.models.evaluation import RegressionEvaluator
+        rng = np.random.default_rng(0)
+        y = rng.normal(0, 2, 50)
+        p = y + rng.normal(0, 0.5, 50)
+        ev = RegressionEvaluator(metric_name="var")
+        got = ev.evaluate(Frame({"label": y, "prediction": p}))
+        assert got == pytest.approx(float(np.var(y) - np.var(y - p)),
+                                    rel=1e-5)
+        assert ev.is_larger_better()
+
+    def test_multiclass_hamming_loss(self):
+        import numpy as np
+
+        from sparkdq4ml_tpu import Frame
+        from sparkdq4ml_tpu.models.evaluation import \
+            MulticlassClassificationEvaluator
+        f = Frame({"label": [0.0, 1.0, 2.0, 1.0],
+                   "prediction": [0.0, 2.0, 2.0, 1.0]})
+        ev = MulticlassClassificationEvaluator(metric_name="hammingLoss")
+        assert ev.evaluate(f) == pytest.approx(0.25)
+        assert not ev.is_larger_better()
