@@ -107,8 +107,10 @@ class RegressionEvaluator(Evaluator):
             return float(np.mean((y - p) ** 2))
         if self.metric_name == "mae":
             return float(np.mean(np.abs(y - p)))
-        if self.metric_name == "var":   # explained variance (MLlib)
-            return float(np.var(y) - np.var(y - p))
+        if self.metric_name == "var":
+            # Spark RegressionMetrics.explainedVariance:
+            # mean((p_i - mean(y))^2)
+            return float(np.mean((p - y.mean()) ** 2))
         ss_res = float(np.sum((y - p) ** 2))
         ss_tot = float(np.sum((y - y.mean()) ** 2))
         return float("nan") if ss_tot == 0 else 1.0 - ss_res / ss_tot

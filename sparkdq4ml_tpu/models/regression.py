@@ -151,7 +151,7 @@ class LinearRegression(Estimator):
             "max_iter", "reg_param", "elastic_net_param", "tol",
             "fit_intercept", "standardization", "solver", "features_col",
             "label_col", "prediction_col", "weight_col",
-            "aggregation_depth")}
+            "aggregation_depth", "loss", "epsilon")}
 
     # -- fit ----------------------------------------------------------------
     def fit(self, frame: Frame, mesh=None) -> "LinearRegressionModel":
@@ -222,27 +222,24 @@ class LinearRegression(Estimator):
         path revisits rows per iteration inside one jitted while_loop
         (a mesh would psum the per-iteration gradient; the single-program
         form covers the reference's row counts with headroom)."""
-        from .solvers import FitResult, huber_fit
+        from .solvers import huber_fit
 
         if self.elastic_net_param not in (0, 0.0):
             raise ValueError("huber loss supports only L2 regularization "
                              "(elasticNetParam must be 0), as in MLlib")
         b_, c_, sigma, iters, obj = huber_fit(
             X, y, mask, epsilon=self.epsilon, reg_param=self.reg_param,
-            fit_intercept=self.fit_intercept, max_iter=max(self.max_iter, 200),
-            tol=self.tol)
+            fit_intercept=self.fit_intercept, max_iter=self.max_iter,
+            tol=self.tol, standardization=self.standardization)
         model = LinearRegressionModel(
             coefficients=np.asarray(b_), intercept=float(c_),
-            params=self._params_dict())
-        model.scale = float(sigma)
-        import jax.numpy as jnp
-
+            params=self._params_dict(), scale=float(sigma))
         fd = jnp.asarray(X).dtype
         result = FitResult(
             coefficients=jnp.asarray(b_), intercept=jnp.asarray(c_, fd),
             iterations=jnp.asarray(int(iters), jnp.int32),
             objective_history=jnp.asarray([float(obj)], fd),
-            converged=jnp.asarray(True))
+            converged=jnp.asarray(int(iters) < self.max_iter))
         model._summary_source = (frame, result)
         return model
 
@@ -268,9 +265,11 @@ class LinearRegression(Estimator):
 @persistable
 class LinearRegressionModel(Model):
     def __init__(self, coefficients: np.ndarray, intercept: float,
-                 params: Optional[dict] = None):
+                 params: Optional[dict] = None, scale: float = 1.0):
         self.coefficients = np.asarray(coefficients)
         self.intercept = float(intercept)
+        # MLlib: 1.0 for squared-error fits; the fitted sigma for huber
+        self.scale = float(scale)
         self._params = dict(params or {})
         self._training_summary: Optional[LinearRegressionTrainingSummary] = None
         self._summary_source = None  # (frame, FitResult) until first access
@@ -345,6 +344,7 @@ class LinearRegressionModel(Model):
         write_json(os.path.join(path, "metadata.json"), {
             "class": "LinearRegressionModel",
             "intercept": self.intercept,
+            "scale": self.scale,
             "params": self._params,
         })
         np.save(os.path.join(path, "coefficients.npy"), self.coefficients)
@@ -355,7 +355,8 @@ class LinearRegressionModel(Model):
         if meta.get("class") != "LinearRegressionModel":
             raise ValueError(f"not a LinearRegressionModel checkpoint: {path}")
         coef = np.load(os.path.join(path, "coefficients.npy"))
-        return cls(coef, meta["intercept"], meta.get("params"))
+        return cls(coef, meta["intercept"], meta.get("params"),
+                   scale=meta.get("scale", 1.0))
 
     # Pipeline-persistence hooks (base.save_stage/load_stage dispatch here).
     def _save_to_dir(self, path: str) -> None:

@@ -288,7 +288,7 @@ def adam_scan(value_and_grad, params0, max_iter: int, lr: float,
 
 def huber_fit(X, y, mask, epsilon: float = 1.35, reg_param: float = 0.0,
               fit_intercept: bool = True, max_iter: int = 500,
-              tol: float = 1e-8):
+              tol: float = 1e-8, standardization: bool = True):
     """MLlib's ``loss="huber"`` robust regression: joint minimization of
     Huber's concomitant-scale objective (Owen 2007 — the same objective
     sklearn's HuberRegressor and Spark's HuberAggregator use)
@@ -308,12 +308,21 @@ def huber_fit(X, y, mask, epsilon: float = 1.35, reg_param: float = 0.0,
     fdt = jnp.asarray(X).dtype
     X = jnp.asarray(X)
     y = jnp.asarray(y, fdt)
-    m = jnp.asarray(mask, fdt)
+    # callers pass the Gramian-convention mask (bool, or sqrt(w) when a
+    # weightCol is set); the robust objective weights rows LINEARLY, so
+    # square it — a no-op for booleans, exactly w for weighted fits
+    m = jnp.square(jnp.asarray(mask, fdt))
     n = jnp.maximum(jnp.sum(m), 1.0)
     d = X.shape[1]
 
-    # OLS warm start via the existing Gramian machinery
-    A = augmented_gram(X, y, m)
+    # OLS warm start via the existing Gramian machinery (which expects
+    # the sqrt-convention mask, i.e. the caller's original)
+    A = augmented_gram(X, y, jnp.asarray(mask, fdt))
+    moments = unpack_moments(A, fit_intercept)
+    # MLlib penalizes the STANDARDIZED coefficients when
+    # standardization=True: beta_std_j = beta_j * std_j
+    pen_scale = (jnp.asarray(moments.std_x, fdt) if standardization
+                 else jnp.ones((d,), fdt))
     ols = normal_solve(A, 0.0, 0.0, fit_intercept=fit_intercept)
     b0 = jnp.asarray(ols.coefficients, fdt)
     c0 = jnp.asarray(ols.intercept, fdt)
@@ -331,8 +340,10 @@ def huber_fit(X, y, mask, epsilon: float = 1.35, reg_param: float = 0.0,
         # fitted scale_ cross-checks directly
         h = jnp.where(jnp.abs(r) <= eps, r * r,
                       2.0 * eps * jnp.abs(r) - eps * eps)
+        # MLlib cost: (1/n) sum(loss) + regParam * 0.5 ||b_std||^2 —
+        # scaled through by n so the loss term stays a plain sum
         return (jnp.sum(m * (sigma + h * sigma))
-                + reg_param * n * jnp.sum(b * b))
+                + reg_param * n * 0.5 * jnp.sum((b * pen_scale) ** 2))
 
     grad = jax.grad(objective)
 

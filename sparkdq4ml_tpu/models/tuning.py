@@ -385,6 +385,8 @@ class CrossValidator(Estimator):
     def _use_fast_path(self) -> bool:
         if not isinstance(self.estimator, LinearRegression):
             return False
+        if getattr(self.estimator, "loss", "squaredError") != "squaredError":
+            return False  # huber has no Gramian statistic: generic path
         if getattr(self.estimator, "weight_col", None):
             return False  # weighted fits take the generic fit-per-cell path
         if self.collect_sub_models:

@@ -468,3 +468,48 @@ class TestHuberLoss:
         from sparkdq4ml_tpu.models import LinearRegressionModel
         back = LinearRegressionModel.load(p)
         np.testing.assert_allclose(back.coefficients, m.coefficients)
+
+    def test_weighted_huber_matches_row_repetition(self):
+        # integer weight k == row repeated k times (the engine-wide
+        # weightCol invariant, now honored on the robust path too)
+        rng = np.random.default_rng(3)
+        X = rng.normal(0, 1, (60, 2))
+        y = X @ np.array([2.0, -1.0]) + rng.normal(0, 0.3, 60)
+        w = rng.integers(1, 4, 60).astype(np.float64)
+        cols = {"x0": X[:, 0], "x1": X[:, 1], "label": y, "w": w}
+        f = VectorAssembler(["x0", "x1"], "features").transform(Frame(cols))
+        mw = LinearRegression(loss="huber", weight_col="w",
+                              max_iter=1500, tol=1e-12).fit(f)
+        Xr = np.repeat(X, w.astype(int), axis=0)
+        yr = np.repeat(y, w.astype(int))
+        fr = VectorAssembler(["x0", "x1"], "features").transform(
+            Frame({"x0": Xr[:, 0], "x1": Xr[:, 1], "label": yr}))
+        mr = LinearRegression(loss="huber", max_iter=1500,
+                              tol=1e-12).fit(fr)
+        np.testing.assert_allclose(np.asarray(mw.coefficients),
+                                   np.asarray(mr.coefficients), atol=2e-2)
+
+    def test_scale_persists(self, tmp_path):
+        _, _, _, f = self._make(100, 2, 0.0)
+        m = LinearRegression(loss="huber", max_iter=500).fit(f)
+        p = str(tmp_path / "hub2")
+        m.save(p)
+        from sparkdq4ml_tpu.models import LinearRegressionModel
+        back = LinearRegressionModel.load(p)
+        assert back.scale == pytest.approx(m.scale)
+        assert back._params.get("loss") == "huber"
+
+    def test_cv_generic_path_keeps_huber(self):
+        # the Gramian fast path must NOT silently refit huber as OLS
+        from sparkdq4ml_tpu.models.tuning import CrossValidator, \
+            ParamGridBuilder
+        from sparkdq4ml_tpu.models.evaluation import RegressionEvaluator
+        _, _, _, f = self._make(200, 2, 0.1, seed=2)
+        grid = ParamGridBuilder().add_grid("reg_param", [0.0, 0.01]).build()
+        cv = CrossValidator(
+            LinearRegression(loss="huber", max_iter=300), grid,
+            RegressionEvaluator("rmse"), num_folds=2)
+        assert not cv._use_fast_path()
+        best = cv.fit(f).best_model
+        assert best._params.get("loss") == "huber"
+        assert best.scale != 1.0          # a real huber fit ran

@@ -190,7 +190,8 @@ class TestEvaluatorMetricAdditions:
         p = y + rng.normal(0, 0.5, 50)
         ev = RegressionEvaluator(metric_name="var")
         got = ev.evaluate(Frame({"label": y, "prediction": p}))
-        assert got == pytest.approx(float(np.var(y) - np.var(y - p)),
+        # Spark RegressionMetrics.explainedVariance = mean((p - mean(y))^2)
+        assert got == pytest.approx(float(np.mean((p - y.mean()) ** 2)),
                                     rel=1e-5)
         assert ev.is_larger_better()
 
