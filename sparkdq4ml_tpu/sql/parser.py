@@ -71,7 +71,7 @@ _TOKEN_RE = re.compile(
     r"(?P<number>\d+\.\d*(?:[eE][+-]?\d+)?|\.\d+(?:[eE][+-]?\d+)?|\d+(?:[eE][+-]?\d+)?)"
     r"|(?P<string>'(?:[^']|'')*')"
     r"|(?P<ident>[A-Za-z_][A-Za-z_0-9]*)"
-    r"|(?P<op>->|<=|>=|<>|!=|==|=|<|>|\+|-|\*|/|%|\(|\)|,|\.)"
+    r"|(?P<op>->|\|\||<=|>=|<>|!=|==|=|<|>|\+|-|\*|/|%|\(|\)|,|\.)"
     r")")
 
 _KEYWORDS = {"select", "from", "where", "as", "and", "or", "not", "cast",
@@ -725,6 +725,9 @@ class _Parser:
                 left = E.BinOp("+", left, self.parse_mul())
             elif self.accept("op", "-"):
                 left = E.BinOp("-", left, self.parse_mul())
+            elif self.accept("op", "||"):
+                # SQL || = concat (Spark: strings; null-propagating)
+                left = E.UdfCall("concat", [left, self.parse_mul()])
             else:
                 return left
 
@@ -769,6 +772,20 @@ class _Parser:
             tname = self.expect("ident").value
             self.expect("op", ")")
             return E.Cast(inner, tname)
+        if (t.kind == "ident" and t.value.lower() == "extract"
+                and self.toks[self.i + 1].kind == "op"
+                and self.toks[self.i + 1].value == "("):
+            # extract(FIELD FROM expr) — sugar over the field functions
+            self.next()
+            self.expect("op", "(")
+            field = self.expect("ident").value.lower()
+            aliases = {"day": "dayofmonth", "dow": "dayofweek",
+                       "doy": "dayofyear", "week": "weekofyear"}
+            field = aliases.get(field, field)
+            self.expect("kw", "from")
+            inner = self.parse_or()
+            self.expect("op", ")")
+            return E.UdfCall(field, [inner])
         if self.accept("kw", "case"):
             # simple form: CASE operand WHEN v THEN r ... — each WHEN
             # value compares against the operand by equality
@@ -813,6 +830,15 @@ class _Parser:
                 if (t.value.lower() in ("count", "sum")
                         and self.accept("kw", "distinct")):
                     fn_name = f"{t.value.lower()}_distinct"
+                # if(cond, a, b) — Spark's CASE sugar
+                if fn_name.lower() == "if":
+                    cond = self.parse_or()
+                    self.expect("op", ",")
+                    then = self.parse_or()
+                    self.expect("op", ",")
+                    other = self.parse_or()
+                    self.expect("op", ")")
+                    return E.CaseWhen([(cond, then)], other)
                 # EXISTS (SELECT ...) — the predicate form; EXISTS(arr,
                 # x -> ...) remains the higher-order array function.
                 if (fn_name.lower() == "exists" and self.peek().kind == "kw"

@@ -232,3 +232,23 @@ class TestSimpleCaseAndNvl:
     def test_nvl_alias(self, session, view):
         out = session.sql("SELECT nvl(nullif(guest, 2), -1) AS c FROM price")
         assert out.to_pydict()["c"].tolist() == [1.0, -1.0, 3.0]
+
+
+class TestSqlSugar:
+    def test_concat_pipes(self, session, view):
+        d = session.sql("SELECT 'a' || 'b' || 'c' AS c, "
+                        "'x' || NULL AS n").to_pydict()
+        assert list(d["c"]) == ["abc"]
+        assert list(d["n"]) == [None]     # null-propagating like concat
+
+    def test_if_function(self, session, view):
+        out = session.sql("SELECT if(guest > 2, 'big', 'small') AS c "
+                          "FROM price")
+        assert list(out.to_pydict()["c"]) == ["small", "small", "big"]
+
+    def test_extract(self, session):
+        d = session.sql("SELECT extract(year FROM to_date('2026-07-31')) "
+                        "AS y, extract(month FROM to_date('2026-07-31')) "
+                        "AS m, extract(day FROM to_date('2026-07-31')) "
+                        "AS d").to_pydict()
+        assert (d["y"][0], d["m"][0], d["d"][0]) == (2026.0, 7.0, 31.0)
