@@ -252,3 +252,26 @@ class TestSqlSugar:
                         "AS m, extract(day FROM to_date('2026-07-31')) "
                         "AS d").to_pydict()
         assert (d["y"][0], d["m"][0], d["d"][0]) == (2026.0, 7.0, 31.0)
+
+
+class TestParserRobustness:
+    def test_random_token_soup_raises_cleanly(self):
+        # the parser's error contract: ValueError/KeyError with a
+        # message, never an AttributeError/IndexError crash
+        import numpy as np
+
+        from sparkdq4ml_tpu.sql.parser import parse
+        toks = ["SELECT", "FROM", "WHERE", "GROUP", "BY", "ORDER",
+                "HAVING", "a", "b", "v", "(", ")", ",", "+", "-", "*",
+                "/", "%", "||", "1", "2.5", "'s'", "AND", "OR", "NOT",
+                "IN", "BETWEEN", "LIKE", "AS", "JOIN", "ON", "USING",
+                "UNION", "ALL", "CASE", "WHEN", "THEN", "ELSE", "END",
+                "CAST", "INT", "NULL", "DISTINCT", "LIMIT", "OFFSET",
+                "count", "sum", ".", "=", ">", "<", "max"]
+        rng = np.random.default_rng(42)
+        for _ in range(500):
+            q = " ".join(rng.choice(toks, rng.integers(1, 15)))
+            try:
+                parse(q)
+            except (ValueError, KeyError):
+                pass
