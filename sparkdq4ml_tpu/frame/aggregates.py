@@ -32,6 +32,15 @@ _TWO_COL = ("corr", "covar_samp", "covar_pop")
 _WINDOWABLE = ("count", "sum", "avg", "min", "max")
 
 
+def _dict_aggs(d: dict) -> list:
+    """PySpark's dict form: ``agg({'col': 'fn'})`` → AggExpr list with
+    Spark's generated ``fn(col)`` output names ('*' allowed for count)."""
+    out = []
+    for col, fn in d.items():
+        out.append(AggExpr(fn, None if col == "*" else col))
+    return out
+
+
 class AggExpr:
     """An aggregate over a column, e.g. ``F.avg("price")`` or SQL ``AVG(price)``."""
 
@@ -517,6 +526,8 @@ class GroupedFrame(_AggShortcuts):
     def agg(self, *aggs: Union[AggExpr, str]):
         from .frame import Frame
 
+        if len(aggs) == 1 and isinstance(aggs[0], dict):
+            aggs = tuple(_dict_aggs(aggs[0]))
         agg_list = []
         for a in aggs:
             if isinstance(a, str):

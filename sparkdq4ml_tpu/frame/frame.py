@@ -1148,9 +1148,13 @@ class Frame:
         return MultiGroupedFrame(self, list(keys), cube_levels(list(keys)))
 
     def agg(self, *aggs):
-        """Global aggregates (no grouping): masked device reductions."""
-        from .aggregates import AggExpr, global_agg
+        """Global aggregates (no grouping): masked device reductions.
+        Accepts AggExprs, bare fn names, or PySpark's dict form
+        (``agg({'v': 'avg'})``)."""
+        from .aggregates import AggExpr, _dict_aggs, global_agg
 
+        if len(aggs) == 1 and isinstance(aggs[0], dict):
+            aggs = tuple(_dict_aggs(aggs[0]))
         agg_list = [a if isinstance(a, AggExpr) else AggExpr(a, None)
                     for a in aggs]
         return global_agg(self, agg_list)

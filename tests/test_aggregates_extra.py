@@ -398,3 +398,21 @@ class TestEmptyAggregateNulls:
         d = out.to_pydict()
         assert list(d["c"]) == [None]
         assert list(d["ok"]) == ["ab"]
+
+
+class TestDictAggForm:
+    def test_grouped_dict(self):
+        from sparkdq4ml_tpu import Frame
+        f = Frame({"k": [1.0, 1.0, 2.0], "v": [3.0, 5.0, 7.0],
+                   "w": [1.0, 2.0, 3.0]})
+        out = f.group_by("k").agg({"v": "max", "w": "sum"})
+        d = out.to_pydict()
+        assert d["max(v)"].tolist() == [5.0, 7.0]
+        assert d["sum(w)"].tolist() == [3.0, 3.0]
+
+    def test_global_dict_and_star(self):
+        from sparkdq4ml_tpu import Frame
+        f = Frame({"k": [1.0, 1.0], "v": [4.0, 6.0]})
+        assert f.agg({"v": "avg"}).to_pydict()["avg(v)"].tolist() == [5.0]
+        assert f.group_by("k").agg({"*": "count"}) \
+            .to_pydict()["count"].tolist() == [2]
