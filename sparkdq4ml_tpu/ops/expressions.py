@@ -79,6 +79,8 @@ class Expr:
     def cast(self, type_name: str) -> "Cast":
         return Cast(self, type_name)
 
+    astype = cast   # PySpark alias
+
     def isin(self, *values) -> "Expr":
         """Membership test — ``col.isin(1, 2, 3)`` / SQL ``IN (…)``."""
         if len(values) == 1 and isinstance(values[0], (list, tuple, set)):
