@@ -25,9 +25,9 @@ _AGGS = ("count", "sum", "avg", "mean", "min", "max", "stddev", "variance",
          "stddev_pop", "var_pop", "median", "mode", "percentile_approx",
          "count_distinct", "sum_distinct", "collect_list", "collect_set",
          "first", "last", "skewness", "kurtosis",
-         "corr", "covar_samp", "covar_pop")
-# Pearson/covariance aggregates take two columns (Spark's F.corr(a, b))
-_TWO_COL = ("corr", "covar_samp", "covar_pop")
+         "corr", "covar_samp", "covar_pop", "max_by", "min_by")
+# two-column aggregates (Spark's F.corr(a, b), max_by(x, ord))
+_TWO_COL = ("corr", "covar_samp", "covar_pop", "max_by", "min_by")
 # windowed form exists only for the running aggregates (as in Spark ≤2.x SQL)
 _WINDOWABLE = ("count", "sum", "avg", "min", "max")
 
@@ -108,36 +108,93 @@ class AggExpr:
         return expr.alias(self._alias) if self._alias else expr
 
 
-# functions-module-style constructors (org.apache.spark.sql.functions)
+class AggOfExpr(AggExpr):
+    """An aggregate over an EXPRESSION (``sum(price * qty)``): the
+    expression materializes as a temp device column just before
+    aggregation (one fused pass), then aggregates like any column.
+    Constructed by the SQL parser and the fluent constructors when given
+    an Expr instead of a name."""
+
+    def __init__(self, fn: str, expr, alias: Optional[str] = None):
+        fn = fn.lower()
+        fn = "avg" if fn == "mean" else fn
+        if fn not in _AGGS or fn in _TWO_COL:
+            raise ValueError(
+                f"aggregate {fn!r} does not take an expression argument")
+        self.fn = fn
+        self.expr = expr
+        self.column = None
+        self.column2 = None
+        self.ignore_nulls = False
+        self.param = None
+        self._alias = alias
+
+    def alias(self, name: str) -> "AggOfExpr":
+        return AggOfExpr(self.fn, self.expr, name)
+
+    @property
+    def name(self) -> str:
+        return self._alias if self._alias else f"{self.fn}({self.expr})"
+
+
+def materialize_agg_exprs(frame, aggs):
+    """Expression-argument aggregates → temp columns + plain AggExprs.
+    Returns (frame, rewritten aggs); shared by every aggregation entry
+    (global, grouped, pivoted, rollup/cube)."""
+    out = []
+    for i, a in enumerate(aggs):
+        if isinstance(a, AggOfExpr):
+            tmp = f"__aggarg_{i}"
+            frame = frame.with_column(tmp, a.expr)
+            out.append(AggExpr(a.fn, tmp, alias=a.name))
+        else:
+            out.append(a)
+    return frame, out
+
+
+# functions-module-style constructors (org.apache.spark.sql.functions).
+# Each accepts a column NAME or (like PySpark) a column EXPRESSION —
+# F.sum(col("p") * 2) — which routes through AggOfExpr materialization.
+def _agg_or_expr(fn: str, col):
+    if isinstance(col, Expr):
+        from ..ops.expressions import Col
+        if isinstance(col, Col):
+            return AggExpr(fn, col.name)
+        return AggOfExpr(fn, col)
+    return AggExpr(fn, col)
+
+
 def count(col: Optional[str] = None) -> AggExpr:
+    if isinstance(col, Expr):
+        return _agg_or_expr("count", col)
     return AggExpr("count", None if col in (None, "*") else col)
 
 
-def sum(col: str) -> AggExpr:       # noqa: A001 - mirrors Spark's name
-    return AggExpr("sum", col)
+def sum(col) -> AggExpr:       # noqa: A001 - mirrors Spark's name
+    return _agg_or_expr("sum", col)
 
 
-def avg(col: str) -> AggExpr:
-    return AggExpr("avg", col)
+def avg(col) -> AggExpr:
+    return _agg_or_expr("avg", col)
 
 
 mean = avg
 
 
-def min(col: str) -> AggExpr:       # noqa: A001
-    return AggExpr("min", col)
+def min(col) -> AggExpr:       # noqa: A001
+    return _agg_or_expr("min", col)
 
 
-def max(col: str) -> AggExpr:       # noqa: A001
-    return AggExpr("max", col)
+def max(col) -> AggExpr:       # noqa: A001
+    return _agg_or_expr("max", col)
 
 
-def stddev(col: str) -> AggExpr:
-    return AggExpr("stddev", col)
+def stddev(col) -> AggExpr:
+    return _agg_or_expr("stddev", col)
 
 
-def variance(col: str) -> AggExpr:
-    return AggExpr("variance", col)
+def variance(col) -> AggExpr:
+    return _agg_or_expr("variance", col)
 
 
 def stddev_pop(col: str) -> AggExpr:
@@ -336,6 +393,13 @@ def _np_agg2(fn: str, a: np.ndarray, b: np.ndarray):
     ok = ~(np.isnan(a) | np.isnan(b))
     a, b = a[ok], b[ok]
     n = len(a)
+    if fn in ("max_by", "min_by"):
+        # value of a at the extreme of b (Spark max_by/min_by); NULL
+        # when no pairwise non-null row exists
+        if n == 0:
+            return float("nan")
+        idx = int(np.argmax(b)) if fn == "max_by" else int(np.argmin(b))
+        return float(a[idx])
     if fn == "covar_pop":
         return float(np.mean((a - a.mean()) * (b - b.mean()))) if n else float("nan")
     if n < 2:
@@ -535,8 +599,9 @@ class GroupedFrame(_AggShortcuts):
             agg_list.append(a)
         if not agg_list:
             raise ValueError("agg() needs at least one aggregate")
+        frame_src, agg_list = materialize_agg_exprs(self._frame, agg_list)
 
-        d = self._frame.to_pydict()  # host boundary: one gather
+        d = frame_src.to_pydict()  # host boundary: one gather
         key_cols = [np.asarray(d[k]) for k in self._keys]
         order, group_starts, group_ends = _group_plan(
             key_cols, len(key_cols[0]) if key_cols else 0)
@@ -601,8 +666,9 @@ class PivotedFrame(_AggShortcuts):
                     for a in aggs]
         if not agg_list:
             raise ValueError("agg() needs at least one aggregate")
+        frame_src, agg_list = materialize_agg_exprs(self._frame, agg_list)
 
-        d = self._frame.to_pydict()  # host boundary: one gather
+        d = frame_src.to_pydict()  # host boundary: one gather
         pcol = np.asarray(d[self._pivot_col])
         if self._values is None:
             uniq = [x for x in set(pcol.tolist()) if x is not None]
@@ -694,14 +760,15 @@ class MultiGroupedFrame(_AggShortcuts):
         if not agg_list:
             raise ValueError("agg() needs at least one aggregate")
 
+        frame_src, agg_list = materialize_agg_exprs(self._frame, agg_list)
         # One pass per level; a single concatenate per column at the end.
         key_parts: dict[str, list] = {k: [] for k in self._keys}
         agg_parts: dict[str, list] = {a.name: [] for a in agg_list}
         for kept in self._levels:
             if kept:
-                out = GroupedFrame(self._frame, list(kept)).agg(*agg_list)
+                out = GroupedFrame(frame_src, list(kept)).agg(*agg_list)
             else:
-                out = global_agg(self._frame, agg_list)
+                out = global_agg(frame_src, agg_list)
             d = out.to_pydict()
             n = len(next(iter(d.values()))) if d else 0
             for k in self._keys:

@@ -1151,13 +1151,15 @@ class Frame:
         """Global aggregates (no grouping): masked device reductions.
         Accepts AggExprs, bare fn names, or PySpark's dict form
         (``agg({'v': 'avg'})``)."""
-        from .aggregates import AggExpr, _dict_aggs, global_agg
+        from .aggregates import (AggExpr, _dict_aggs, global_agg,
+                                 materialize_agg_exprs)
 
         if len(aggs) == 1 and isinstance(aggs[0], dict):
             aggs = tuple(_dict_aggs(aggs[0]))
         agg_list = [a if isinstance(a, AggExpr) else AggExpr(a, None)
                     for a in aggs]
-        return global_agg(self, agg_list)
+        frame, agg_list = materialize_agg_exprs(self, agg_list)
+        return global_agg(frame, agg_list)
 
     def sort(self, *cols, ascending=True) -> "Frame":
         """``orderBy`` — reorders valid rows (host argsort at the boundary),

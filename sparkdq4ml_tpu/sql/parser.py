@@ -90,7 +90,9 @@ _AGG_FNS = {"count", "sum", "avg", "mean", "min", "max", "stddev", "variance",
 # percentile_approx(col, p[, accuracy]) takes a literal percentage
 _AGG_FNS_PCT = {"percentile_approx", "approx_percentile"}
 # two-column aggregates: CORR(a, b), COVAR_SAMP(a, b), COVAR_POP(a, b)
-_AGG_FNS_2 = {"corr", "covar_samp", "covar_pop"}
+_AGG_FNS_2 = {"corr", "covar_samp", "covar_pop", "max_by", "min_by"}
+# boolean/conditional aggregates desugared into agg + post-agg forms
+_BOOL_AGGS = {"count_if", "any", "some", "every", "bool_or", "bool_and"}
 _WINDOW_FNS = {"row_number", "rank", "dense_rank", "percent_rank",
                "cume_dist", "ntile", "lag", "lead",
                "first_value", "last_value", "nth_value"}
@@ -576,10 +578,12 @@ class _Parser:
         t = self.peek()
         if (t.kind == "ident"
                 and t.value.lower() in (_AGG_FNS | _AGG_FNS_2
-                                        | _AGG_FNS_PCT | _WINDOW_FNS)
+                                        | _AGG_FNS_PCT | _WINDOW_FNS
+                                        | _BOOL_AGGS
+                                        | {"approx_count_distinct"})
                 and self.toks[self.i + 1].kind == "op"
                 and self.toks[self.i + 1].value == "("):
-            from ..frame.aggregates import AggExpr
+            from ..frame.aggregates import AggExpr, AggOfExpr
 
             fn = self.next().value
             self.expect("op", "(")
@@ -611,9 +615,34 @@ class _Parser:
                                               for a in args)):
                     raise ValueError(f"{fn}(col1, col2) takes two columns")
                 expr = AggExpr(fn, args[0].name, column2=args[1].name)
+            elif fn.lower() == "approx_count_distinct":
+                if col is None:
+                    raise ValueError(
+                        "approx_count_distinct(col) takes a column")
+                expr = AggExpr("count_distinct", col,
+                               alias=f"approx_count_distinct({col})")
+            elif fn.lower() in _BOOL_AGGS:
+                if len(args) != 1:
+                    raise ValueError(f"{fn}(predicate) takes one argument")
+                pred = args[0]
+                flag = E.CaseWhen([(pred, E.Lit(1))], E.Lit(0))
+                low = fn.lower()
+                if low == "count_if":
+                    expr = _AggRef(AggOfExpr(
+                        "sum", flag, alias=f"count_if({pred})"))
+                else:
+                    # any/some/bool_or ≡ max(flag) > 0;
+                    # every/bool_and ≡ min(flag) > 0
+                    red = "max" if low in ("any", "some", "bool_or")                         else "min"
+                    expr = E.BinOp(">", _AggRef(AggOfExpr(red, flag)),
+                                   E.Lit(0))
             elif fn.lower() in _AGG_FNS:
-                _check_agg_args(fn, col, args)
-                expr = AggExpr(fn, col)
+                if col is None and len(args) == 1                         and isinstance(args[0], E.Expr):
+                    # aggregate over an expression: sum(price * qty)
+                    expr = AggOfExpr(fn, args[0])
+                else:
+                    _check_agg_args(fn, col, args)
+                    expr = AggExpr(fn, col)
             elif fn.lower() in _AGG_FNS_PCT:
                 if (len(args) not in (2, 3) or not isinstance(args[0], E.Col)
                         or not isinstance(args[1], E.Lit)):
@@ -635,13 +664,18 @@ class _Parser:
                     and self.peek().kind == "op"
                     and self.peek().value in ("+", "-", "*", "/")):
                 expr = self.parse_add(_AggRef(expr))
-            else:
+            elif isinstance(expr, _AggE) or not isinstance(expr, E.Expr):
+                # plain aggregate / percentile item — no detection needed
                 if self.accept("kw", "as"):
                     return expr.alias(self.expect("ident").value)
                 alias = self.accept("ident")
                 if alias is not None:
                     return expr.alias(alias.value)
                 return expr
+            elif (self.peek().kind == "op"
+                  and self.peek().value in ("+", "-", "*", "/")):
+                # desugared bool-agg forms compose arithmetically too
+                expr = self.parse_add(expr)
         else:
             expr = self.parse_or()
         # Post-aggregate detection: an expression whose tree contains

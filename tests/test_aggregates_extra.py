@@ -416,3 +416,58 @@ class TestDictAggForm:
         assert f.agg({"v": "avg"}).to_pydict()["avg(v)"].tolist() == [5.0]
         assert f.group_by("k").agg({"*": "count"}) \
             .to_pydict()["count"].tolist() == [2]
+
+
+class TestExpressionAggregates:
+    """Aggregates over expressions (sum(p * q)) + the bool/conditional
+    family, desugared via AggOfExpr materialization."""
+
+    @pytest.fixture
+    def view(self, session):
+        from sparkdq4ml_tpu import Frame
+        Frame({"k": [1.0, 1.0, 2.0], "p": [2.0, 3.0, 10.0],
+               "q": [1.0, 2.0, 3.0]}).create_or_replace_temp_view("ea")
+        yield
+        session.catalog.drop("ea")
+
+    def test_sum_of_expression(self, session, view):
+        assert session.sql("SELECT sum(p * q) AS s FROM ea") \
+            .to_pydict()["s"].tolist() == [38.0]
+
+    def test_grouped_avg_of_expression(self, session, view):
+        d = session.sql("SELECT k, avg(p + q) AS a FROM ea GROUP BY k "
+                        "ORDER BY k").to_pydict()
+        assert d["a"].tolist() == [4.0, 13.0]
+
+    def test_count_if(self, session, view):
+        assert session.sql("SELECT count_if(p > 2) AS c FROM ea") \
+            .to_pydict()["c"].tolist() == [2]
+
+    def test_bool_aggregates(self, session, view):
+        d = session.sql("SELECT any(p > 5) AS a, every(p > 1) AS e, "
+                        "bool_or(p > 99) AS o, bool_and(p > 1) AS b "
+                        "FROM ea").to_pydict()
+        assert [bool(d[c][0]) for c in ("a", "e", "o", "b")] == \
+            [True, True, False, True]
+
+    def test_max_by_min_by(self, session, view):
+        d = session.sql("SELECT max_by(k, p) AS m, min_by(k, p) AS n "
+                        "FROM ea").to_pydict()
+        assert (d["m"][0], d["n"][0]) == (2.0, 1.0)
+
+    def test_approx_count_distinct_sql(self, session, view):
+        assert session.sql("SELECT approx_count_distinct(k) AS c FROM ea") \
+            .to_pydict()["c"].tolist() == [2]
+
+    def test_fluent_expression_agg(self):
+        import sparkdq4ml_tpu as dq
+        from sparkdq4ml_tpu import Frame, functions as F
+        f = Frame({"p": [3.0, 4.0]})
+        assert f.agg(F.sum(dq.col("p") * 2).alias("s")) \
+            .to_pydict()["s"].tolist() == [14.0]
+
+    def test_plain_and_windowed_paths_unchanged(self, session, view):
+        assert session.sql("SELECT sum(p) AS s FROM ea") \
+            .to_pydict()["s"].tolist() == [15.0]
+        assert session.sql("SELECT sum(p) OVER (PARTITION BY k) AS w "
+                           "FROM ea").count() == 3
