@@ -136,6 +136,11 @@ class AggOfExpr(AggExpr):
     def name(self) -> str:
         return self._alias if self._alias else f"{self.fn}({self.expr})"
 
+    def over(self, spec):
+        raise ValueError(
+            "windowed aggregates over expressions are not supported — "
+            "materialize the expression with withColumn first")
+
 
 def materialize_agg_exprs(frame, aggs):
     """Expression-argument aggregates → temp columns + plain AggExprs.
@@ -388,18 +393,29 @@ def _np_agg(fn: str, values: np.ndarray, ignore_nulls: bool = False,
 
 def _np_agg2(fn: str, a: np.ndarray, b: np.ndarray):
     """Two-column aggregates over pairwise non-null rows (SQL semantics)."""
+    if fn in ("max_by", "min_by"):
+        # value of a at the extreme of b (Spark max_by/min_by): rows with
+        # a null ORDERING are ignored; the value may be any type (string
+        # max_by is the idiomatic use) and passes through unconverted
+        a = np.asarray(a)
+        bb = np.asarray(b, np.float64)
+        ok = ~np.isnan(bb)
+        if a.dtype == object:
+            ok &= np.asarray([x is not None for x in a])
+        else:
+            ok &= ~np.isnan(np.asarray(a, np.float64))
+        if not ok.any():
+            return None if a.dtype == object else float("nan")
+        sel = np.flatnonzero(ok)
+        pick = sel[int(np.argmax(bb[sel])) if fn == "max_by"
+                   else int(np.argmin(bb[sel]))]
+        v = a[pick]
+        return v if a.dtype == object else float(v)
     a = np.asarray(a, np.float64)
     b = np.asarray(b, np.float64)
     ok = ~(np.isnan(a) | np.isnan(b))
     a, b = a[ok], b[ok]
     n = len(a)
-    if fn in ("max_by", "min_by"):
-        # value of a at the extreme of b (Spark max_by/min_by); NULL
-        # when no pairwise non-null row exists
-        if n == 0:
-            return float("nan")
-        idx = int(np.argmax(b)) if fn == "max_by" else int(np.argmin(b))
-        return float(a[idx])
     if fn == "covar_pop":
         return float(np.mean((a - a.mean()) * (b - b.mean()))) if n else float("nan")
     if n < 2:

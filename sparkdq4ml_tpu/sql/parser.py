@@ -616,11 +616,15 @@ class _Parser:
                     raise ValueError(f"{fn}(col1, col2) takes two columns")
                 expr = AggExpr(fn, args[0].name, column2=args[1].name)
             elif fn.lower() == "approx_count_distinct":
-                if col is None:
+                if not args or not isinstance(args[0], E.Col):
                     raise ValueError(
-                        "approx_count_distinct(col) takes a column")
-                expr = AggExpr("count_distinct", col,
-                               alias=f"approx_count_distinct({col})")
+                        "approx_count_distinct(col[, rsd]) takes a column")
+                from ..frame.aggregates import \
+                    approx_count_distinct as _acd
+
+                rsd = (float(_lit_value(args[1], "rsd"))
+                       if len(args) > 1 else 0.05)
+                expr = _acd(args[0].name, rsd)
             elif fn.lower() in _BOOL_AGGS:
                 if len(args) != 1:
                     raise ValueError(f"{fn}(predicate) takes one argument")
@@ -1040,6 +1044,22 @@ def _rewrite_having(expr, extra_aggs: list):
     if isinstance(expr, _AggRef):
         extra_aggs.append(expr.agg)
         return E.Col(expr.agg.name)
+    if (isinstance(expr, E.UdfCall)
+            and expr.udf_name.lower() in _BOOL_AGGS
+            and len(expr.args) == 1):
+        from ..frame.aggregates import AggOfExpr
+
+        low = expr.udf_name.lower()
+        flag = E.CaseWhen([(expr.args[0], E.Lit(1))], E.Lit(0))
+        if low == "count_if":
+            agg = AggOfExpr("sum", flag,
+                            alias=f"count_if({expr.args[0]})")
+            extra_aggs.append(agg)
+            return E.Col(agg.name)
+        red = ("max" if low in ("any", "some", "bool_or") else "min")
+        agg = AggOfExpr(red, flag)
+        extra_aggs.append(agg)
+        return E.BinOp(">", E.Col(agg.name), E.Lit(0))
     if (isinstance(expr, E.UdfCall) and expr.udf_name.lower() in having_aggs
             and (len(expr.args) <= 1
                  or expr.udf_name.lower() in _AGG_FNS_2)):
@@ -1057,8 +1077,11 @@ def _rewrite_having(expr, extra_aggs: list):
         elif isinstance(arg, E.Col):
             col = arg.name
         else:
-            raise ValueError(
-                f"HAVING aggregate over an expression is not supported: {expr}")
+            from ..frame.aggregates import AggOfExpr
+
+            agg = AggOfExpr(expr.udf_name, arg)
+            extra_aggs.append(agg)
+            return E.Col(agg.name)
         agg = AggExpr(expr.udf_name, col)
         extra_aggs.append(agg)
         return E.Col(agg.name)

@@ -471,3 +471,37 @@ class TestExpressionAggregates:
             .to_pydict()["s"].tolist() == [15.0]
         assert session.sql("SELECT sum(p) OVER (PARTITION BY k) AS w "
                            "FROM ea").count() == 3
+
+    def test_max_by_string_values(self, session, view):
+        import numpy as np
+
+        from sparkdq4ml_tpu import Frame
+        Frame({"p": [2.0, 9.0], "name": np.asarray(["a", "b"], object)}) \
+            .create_or_replace_temp_view("mbs")
+        assert session.sql("SELECT max_by(name, p) AS m, "
+                           "min_by(name, p) AS n FROM mbs") \
+            .to_pydict()["m"][0] == "b"
+        session.catalog.drop("mbs")
+
+    def test_bool_aggs_in_having_order_and_arithmetic(self, session, view):
+        assert session.sql("SELECT k FROM ea GROUP BY k "
+                           "HAVING count_if(p > 2) > 0") \
+            .to_pydict()["k"].tolist() == [1.0, 2.0]
+        assert session.sql("SELECT 1 + count_if(p > 2) AS c FROM ea") \
+            .to_pydict()["c"].tolist() == [3]
+        assert session.sql("SELECT k FROM ea GROUP BY k "
+                           "ORDER BY count_if(p > 5) DESC") \
+            .to_pydict()["k"].tolist() == [2.0, 1.0]
+
+    def test_expression_agg_in_having(self, session, view):
+        assert session.sql("SELECT k FROM ea GROUP BY k "
+                           "HAVING sum(p * 2) > 10") \
+            .to_pydict()["k"].tolist() == [2.0]
+
+    def test_acd_rsd_arg_and_windowed_expr_rejected(self, session, view):
+        assert session.sql("SELECT approx_count_distinct(k, 0.05) AS c "
+                           "FROM ea").to_pydict()["c"].tolist() == [2]
+        import sparkdq4ml_tpu as dq
+        from sparkdq4ml_tpu import functions as F
+        with pytest.raises(ValueError, match="windowed"):
+            F.sum(dq.col("p") * 2).over(F.Window.partitionBy("k"))
