@@ -893,6 +893,17 @@ class _Parser:
                     while self.accept("op", ","):
                         args.append(self.parse_or())
                     self.expect("op", ")")
+                # fn(...) OVER (...) in EXPRESSION position (e.g.
+                # ``price - first_value(price) OVER (...)``): a window
+                # expr is a regular column Expr, so it composes
+                if (self.peek().kind == "ident"
+                        and self.peek().value.lower() == "over"
+                        and fn_name.lower() in (_WINDOW_FNS | _AGG_FNS)):
+                    self.next()
+                    col = (args[0].name if len(args) == 1
+                           and isinstance(args[0], E.Col) else None)
+                    make = self._build_window_fn(fn_name, col, args)
+                    return make(self.parse_window_spec())
                 return E.UdfCall(fn_name, args)
             # qualified column ref: alias.col (resolved at execute
             # against the relation scope; a literal dotted column name

@@ -494,3 +494,28 @@ class TestRunningSumNullPrefix:
             .to_pydict()["rs"]
         assert math.isnan(rs[0])
         assert rs[1] == 2.0 and rs[2] == 5.0
+
+
+class TestWindowInExpressionPosition:
+    def test_share_of_total(self, session):
+        f = Frame({"k": [1.0, 1.0], "v": [3.0, 5.0]})
+        f.create_or_replace_temp_view("wexp")
+        out = session.sql("SELECT v / sum(v) OVER (PARTITION BY k) "
+                          "AS share FROM wexp")
+        assert out.to_pydict()["share"].tolist() == [0.375, 0.625]
+        session.catalog.drop("wexp")
+
+    def test_difference_from_first(self, session):
+        f = Frame({"g": [2.0, 10.0], "p": [30.0, 95.0]})
+        f.create_or_replace_temp_view("wexp2")
+        out = session.sql("SELECT p - first_value(p) OVER (ORDER BY g) "
+                          "AS uplift FROM wexp2")
+        assert out.to_pydict()["uplift"].tolist() == [0.0, 65.0]
+        session.catalog.drop("wexp2")
+
+    def test_sql_transformer_uses_full_grammar(self, session):
+        from sparkdq4ml_tpu.models import SQLTransformer
+        f = Frame({"g": [2.0, 10.0, 14.0], "p": [30.0, 95.0, 120.0]})
+        t = SQLTransformer(statement="SELECT g, p FROM __THIS__ WHERE "
+                           "p > (SELECT avg(p) FROM __THIS__)")
+        assert t.transform(f).to_pydict()["p"].tolist() == [95.0, 120.0]
