@@ -394,16 +394,15 @@ def _np_agg(fn: str, values: np.ndarray, ignore_nulls: bool = False,
 def _np_agg2(fn: str, a: np.ndarray, b: np.ndarray):
     """Two-column aggregates over pairwise non-null rows (SQL semantics)."""
     if fn in ("max_by", "min_by"):
-        # value of a at the extreme of b (Spark max_by/min_by): rows with
-        # a null ORDERING are ignored; the value may be any type (string
-        # max_by is the idiomatic use) and passes through unconverted
+        # value of a at the extreme of b (Spark max_by/min_by): only rows
+        # with a null ORDERING are ignored — the selected VALUE returns
+        # as-is, NULL included (Spark returns NULL when the row at the
+        # extreme ordering has a null value; ADVICE.md #3). The value may
+        # be any type (string max_by is the idiomatic use) and passes
+        # through unconverted.
         a = np.asarray(a)
         bb = np.asarray(b, np.float64)
         ok = ~np.isnan(bb)
-        if a.dtype == object:
-            ok &= np.asarray([x is not None for x in a])
-        else:
-            ok &= ~np.isnan(np.asarray(a, np.float64))
         if not ok.any():
             return None if a.dtype == object else float("nan")
         sel = np.flatnonzero(ok)
@@ -449,6 +448,9 @@ def global_agg(frame, aggs: list[AggExpr]):
     mask = frame.mask
     w = mask.astype(jnp.float32)
     out = {}
+    # (name, nonnull_count, value, null_result) for the aggregates whose
+    # empty-input NULL decision is deferred to ONE host sync after the loop
+    deferred: list = []
     for agg in aggs:
         if agg.fn == "count" and agg.column is None:
             out[agg.name] = jnp.sum(mask, dtype=jnp.int32)[None]
@@ -495,28 +497,42 @@ def global_agg(frame, aggs: list[AggExpr]):
         nv = jnp.sum(wf)
         vf = jnp.where(null, 0.0, vf)
         nan = jnp.asarray(jnp.nan, vf.dtype)
-        empty = float(nv) == 0.0      # eager: SQL NULL results over
-        #                               zero non-null rows (Spark)
+        # SQL NULL results over zero non-null rows (Spark): keyed on the
+        # non-null ROW COUNT, not the weight sum (a zero weight sum over
+        # non-null rows must yield 0.0 from sum(), ADVICE.md #5), and the
+        # decision is deferred — one host sync after the loop instead of
+        # an eager float() per aggregate.
+        cnt = jnp.sum(valid, dtype=jnp.int32)
         if agg.fn == "count":
-            out[agg.name] = jnp.sum(valid, dtype=jnp.int32)[None]
-        elif agg.fn == "sum":
-            out[agg.name] = (nan if empty else jnp.sum(vf * wf))[None]
+            out[agg.name] = cnt[None]
         elif agg.fn == "avg":
             out[agg.name] = (jnp.sum(vf * wf) / nv)[None]
+        elif agg.fn == "sum":
+            out[agg.name] = None  # placeholder keeps the column order
+            deferred.append((agg.name, cnt, jnp.sum(vf * wf)[None],
+                             nan[None]))
         elif agg.fn == "min":
             big = jnp.asarray(jnp.inf, vf.dtype)
-            out[agg.name] = (nan if empty else jnp.min(
-                jnp.where(valid, vf, big)).astype(v.dtype))[None]
+            out[agg.name] = None
+            deferred.append((agg.name, cnt, jnp.min(
+                jnp.where(valid, vf, big)).astype(v.dtype)[None],
+                nan[None]))
         elif agg.fn == "max":
             small = jnp.asarray(-jnp.inf, vf.dtype)
-            out[agg.name] = (nan if empty else jnp.max(
-                jnp.where(valid, vf, small)).astype(v.dtype))[None]
+            out[agg.name] = None
+            deferred.append((agg.name, cnt, jnp.max(
+                jnp.where(valid, vf, small)).astype(v.dtype)[None],
+                nan[None]))
         else:  # stddev / variance: sample (n-1); NaN when n < 2 (Spark)
             mu = jnp.sum(vf * wf) / nv
             ss = jnp.sum(wf * (vf - mu) ** 2)
             var = jnp.where(nv > 1.0, ss / jnp.maximum(nv - 1.0, 1.0),
                             jnp.asarray(jnp.nan, vf.dtype))
             out[agg.name] = (var if agg.fn == "variance" else jnp.sqrt(var))[None]
+    if deferred:
+        counts = np.asarray(jnp.stack([c for _, c, _, _ in deferred]))
+        for (name, _, val, nanv), c in zip(deferred, counts):
+            out[name] = val if int(c) > 0 else nanv
     return Frame(out)
 
 

@@ -310,8 +310,27 @@ class Frame:
 
     def with_columns_renamed(self, mapping: Mapping[str, str]) -> "Frame":
         """Spark 3.4's ``withColumnsRenamed`` — batch rename; absent keys
-        are no-ops (same semantics as the single-column form)."""
-        data = {mapping.get(k, k): v for k, v in self._data.items()}
+        are no-ops (same semantics as the single-column form).
+
+        A rename target that collides with a surviving column raises:
+        Spark would produce duplicate column names, which this engine's
+        dict-backed frame cannot represent — silently keeping one of the
+        two (the old behavior) lost data with no error (ADVICE.md #4).
+        Swaps (``{'a': 'b', 'b': 'a'}``) remain legal: the collision test
+        only counts columns that keep their name."""
+        renamed_away = {k for k, new in mapping.items()
+                        if k in self._data and new != k}
+        data: dict = {}
+        for k, v in self._data.items():
+            nk = mapping.get(k, k)
+            if nk in data or (nk != k and nk in self._data
+                              and nk not in renamed_away):
+                raise ValueError(
+                    f"withColumnsRenamed: rename target {nk!r} collides "
+                    "with an existing column; the engine cannot hold "
+                    "duplicate column names (rename or drop the other "
+                    f"{nk!r} first)")
+            data[nk] = v
         return self._with(data=data)
 
     withColumnsRenamed = with_columns_renamed

@@ -36,7 +36,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from ..config import float_dtype
 from ..frame.frame import Frame
-from ..parallel.mesh import DATA_AXIS
+from ..parallel.mesh import DATA_AXIS, shard_map
 from .base import Estimator, Model, persistable, read_json, write_json
 from .regression import _extract_xy
 from .solvers import _soft
@@ -597,7 +597,7 @@ def fused_logistic_fit_packed(mesh: Optional[Mesh], max_iter: int, tol: float,
                 tol, fit_intercept, standardization, axis=DATA_AXIS,
                 weights=w))
 
-        fit = jax.shard_map(
+        fit = shard_map(
             local, mesh=mesh,
             in_specs=(P(DATA_AXIS), P()),
             out_specs=P())
@@ -694,7 +694,7 @@ def fused_svc_fit_packed(mesh: Optional[Mesh], max_iter: int, tol: float,
                 X, y, mask, hyper[0], n, std, max_iter, tol,
                 fit_intercept, standardization, axis=DATA_AXIS))
 
-        fit = jax.shard_map(
+        fit = shard_map(
             local, mesh=mesh,
             in_specs=(P(DATA_AXIS), P()),
             out_specs=P())
@@ -758,7 +758,7 @@ def fused_softmax_fit_packed(mesh: Optional[Mesh], num_classes: int,
                 max_iter, tol, fit_intercept, standardization,
                 axis=DATA_AXIS, weights=w))
 
-        fit = jax.shard_map(
+        fit = shard_map(
             local, mesh=mesh,
             in_specs=(P(DATA_AXIS), P()),
             out_specs=P())
@@ -1537,9 +1537,9 @@ def _nb_stats_fn(mesh, num_classes: int):
 
     from jax.sharding import PartitionSpec as P
 
-    from ..parallel.mesh import DATA_AXIS
+    from ..parallel.mesh import DATA_AXIS, shard_map
 
-    return jax.jit(jax.shard_map(
+    return jax.jit(shard_map(
         lambda X, y, w: _nb_sufficient_stats(X, y, w, num_classes,
                                              DATA_AXIS),
         mesh=mesh,

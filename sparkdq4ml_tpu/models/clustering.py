@@ -38,7 +38,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..config import float_dtype
 from ..frame import Frame
-from ..parallel.mesh import DATA_AXIS, normalize_mesh
+from ..parallel.mesh import DATA_AXIS, normalize_mesh, shard_map
 from .base import Estimator, Model, persistable
 
 
@@ -82,7 +82,7 @@ def _make_fit(mesh, k, max_iter, tol):
             return (jax.lax.psum(s, DATA_AXIS), jax.lax.psum(c, DATA_AXIS),
                     jax.lax.psum(cost, DATA_AXIS))
 
-        stats = jax.shard_map(
+        stats = shard_map(
             local, mesh=mesh,
             in_specs=(P(DATA_AXIS), P(DATA_AXIS), P()),
             out_specs=(P(), P(), P()))
@@ -393,7 +393,7 @@ def _make_gmm_fit(mesh, k, max_iter, tol, reg):
             return (jax.lax.psum(Nk, DATA_AXIS), jax.lax.psum(Sk, DATA_AXIS),
                     jax.lax.psum(Ck, DATA_AXIS), jax.lax.psum(ll, DATA_AXIS))
 
-        stats = jax.shard_map(
+        stats = shard_map(
             local, mesh=mesh,
             in_specs=(P(DATA_AXIS), P(DATA_AXIS), P(), P(), P()),
             out_specs=(P(), P(), P(), P()))
@@ -1057,7 +1057,7 @@ class PowerIterationClustering(Estimator):
 
             @jax.jit
             @functools.partial(
-                jax.shard_map, mesh=mesh,
+                shard_map, mesh=mesh,
                 in_specs=(P(DATA_AXIS), P(), P(DATA_AXIS)), out_specs=P(),
                 check_vma=False)
             def power(Ws, v, inv_deg_s):

@@ -49,10 +49,15 @@ def _fm_core(X, y, mask, n, *, factor_size, loss, reg_param, max_iter, lr,
     Xm = X * wm[:, None]
     ym = y * wm
 
-    def reduce_(v):
-        return jax.lax.psum(v, axis) if axis is not None else v
+    # shard count: replicated objective terms are pre-divided by it so the
+    # psum in psum_value_and_grad restores them exactly once
+    nshards = (jax.lax.psum(jnp.asarray(1.0, dt), axis)
+               if axis is not None else jnp.asarray(1.0, dt))
 
     def objective(params):
+        # LOCAL share of the loss: psum_value_and_grad sums value+grad
+        # over the mesh (grad through a psum is unreliable on legacy
+        # shard_map; see solvers.psum_value_and_grad)
         b, w, V = params
         pred = fm_forward(Xm, b, w, V)
         if loss == "squared":
@@ -60,12 +65,12 @@ def _fm_core(X, y, mask, n, *, factor_size, loss, reg_param, max_iter, lr,
         else:   # logistic: labels 0/1, stable softplus form
             z = (2.0 * ym - wm) * pred
             per_row = jnp.logaddexp(0.0, -z)
-        data_loss = reduce_(jnp.sum(jnp.where(mask, per_row, 0.0))) / n
+        data_loss = jnp.sum(jnp.where(mask, per_row, 0.0)) / n
         # L2 on every parameter group (MLlib's regParam)
         return data_loss + reg_param * (
-            jnp.sum(w * w) + jnp.sum(V * V) + b * b)
+            jnp.sum(w * w) + jnp.sum(V * V) + b * b) / nshards
 
-    from .solvers import adam_scan
+    from .solvers import adam_scan, psum_value_and_grad
 
     key = jax.random.PRNGKey(seed)
     V0 = init_std * jax.random.normal(key, (d, factor_size), dt)
@@ -78,8 +83,9 @@ def _fm_core(X, y, mask, n, *, factor_size, loss, reg_param, max_iter, lr,
             g = (g[0], jnp.zeros_like(g[1]), g[2])
         return g
 
-    (b, w, V), history = adam_scan(jax.value_and_grad(objective), params0,
-                                   max_iter, lr, grad_mask=grad_mask)
+    (b, w, V), history = adam_scan(psum_value_and_grad(objective, axis),
+                                   params0, max_iter, lr,
+                                   grad_mask=grad_mask)
     return FmFit(b, w, V, history)
 
 
@@ -102,9 +108,9 @@ def _fm_fit_fn(mesh, factor_size, loss, reg_param, max_iter, lr, init_std,
 
     from jax.sharding import PartitionSpec as P
 
-    from ..parallel.mesh import DATA_AXIS
+    from ..parallel.mesh import DATA_AXIS, shard_map
 
-    return jax.jit(jax.shard_map(
+    return jax.jit(shard_map(
         lambda X, y, m: run(X, y, m, DATA_AXIS), mesh=mesh,
         in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(DATA_AXIS)),
         out_specs=P()))

@@ -29,7 +29,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..config import float_dtype
 from ..frame import Frame
-from ..parallel.mesh import DATA_AXIS
+from ..parallel.mesh import DATA_AXIS, shard_map
 from .base import Estimator, Model, persistable
 
 _FAMILY_LINKS = {
@@ -210,7 +210,7 @@ def _build_fit(mesh, family: str, link: str, max_iter: int, tol: float,
             return (jax.lax.psum(a, DATA_AXIS), jax.lax.psum(b, DATA_AXIS),
                     jax.lax.psum(dev, DATA_AXIS))
 
-        stats = jax.shard_map(
+        stats = shard_map(
             sharded_stats, mesh=mesh,
             in_specs=(P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS),
                       P(DATA_AXIS), P()),

@@ -53,7 +53,7 @@ from jax.sharding import PartitionSpec as P
 
 from ..config import float_dtype
 from ..frame import Frame
-from ..parallel.mesh import DATA_AXIS, normalize_mesh
+from ..parallel.mesh import DATA_AXIS, normalize_mesh, shard_map
 from .base import Estimator, Model, persistable
 
 _EPS = 1e-30
@@ -107,7 +107,7 @@ def _online_fit_fn(mesh, n_total: int, batch: int, k: int, vocab: int,
             s = _e_step(c_shard, beta_rep, alpha, inner_iter)[1]
             return jax.lax.psum(s, DATA_AXIS)
 
-        return jax.shard_map(
+        return shard_map(
             local, mesh=mesh, in_specs=(P(DATA_AXIS), P()), out_specs=P(),
             check_vma=False)(cnts_b, expElogbeta)
 

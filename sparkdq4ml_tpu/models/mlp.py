@@ -48,17 +48,17 @@ def _mlp_fit_fn(mesh, layers: tuple, max_iter: int, lr: float, seed: int):
         Y1 = jax.nn.one_hot(y.astype(jnp.int32), num_classes,
                             dtype=dt) * wm[:, None]
 
-        def reduce_(v):
-            return jax.lax.psum(v, axis) if axis is not None else v
-
         def objective(params):
             # invalid rows arrive zeroed (host-side) and pads are zero by
-            # construction — no per-iteration re-masking needed
+            # construction — no per-iteration re-masking needed. LOCAL
+            # share only: psum_value_and_grad sums value+grad over the
+            # mesh (grad *through* a psum is unreliable on legacy
+            # shard_map; see solvers.psum_value_and_grad).
             logits = _mlp_forward(params, X)
             lse = jax.nn.logsumexp(logits, axis=1)
             ll = jnp.where(mask,
                            lse - jnp.sum(logits * Y1, axis=1), 0.0)
-            return reduce_(jnp.sum(ll)) / n
+            return jnp.sum(ll) / n
 
         key = jax.random.PRNGKey(seed)
         params0 = []
@@ -71,9 +71,9 @@ def _mlp_fit_fn(mesh, layers: tuple, max_iter: int, lr: float, seed: int):
                                    -limit, limit)
             params0.append((W, jnp.zeros((fan_out,), dt)))
 
-        from .solvers import adam_scan
+        from .solvers import adam_scan, psum_value_and_grad
 
-        params, history = adam_scan(jax.value_and_grad(objective),
+        params, history = adam_scan(psum_value_and_grad(objective, axis),
                                     tuple(params0), max_iter, lr)
         return tuple(params), history
 
@@ -82,9 +82,9 @@ def _mlp_fit_fn(mesh, layers: tuple, max_iter: int, lr: float, seed: int):
 
     from jax.sharding import PartitionSpec as P
 
-    from ..parallel.mesh import DATA_AXIS
+    from ..parallel.mesh import DATA_AXIS, shard_map
 
-    return jax.jit(jax.shard_map(
+    return jax.jit(shard_map(
         lambda X, y, m: core(X, y, m, DATA_AXIS), mesh=mesh,
         in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(DATA_AXIS)),
         out_specs=P()))

@@ -169,9 +169,9 @@ def _jit_als_fit(core, mesh):
 
     from jax.sharding import PartitionSpec as P
 
-    from ..parallel.mesh import DATA_AXIS
+    from ..parallel.mesh import DATA_AXIS, shard_map
 
-    fn = jax.shard_map(
+    fn = shard_map(
         lambda u, i, r, w, U0, V0: core(u, i, r, w, U0, V0, DATA_AXIS),
         mesh=mesh,
         in_specs=(P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS),

@@ -196,13 +196,45 @@ class LinearRegression(Estimator):
                                      self.elastic_net_param)
         if mesh is not None and mesh.devices.size <= 1:
             mesh = None  # unify the single-device cache key
-        fit_fn = fused_linear_fit_packed(mesh, solver_name, self.max_iter,
-                                         self.tol, self.fit_intercept,
-                                         self.standardization)
-        Zd = place_packed(pack_design(X, y, mask), mesh)
+        from ..utils import faults as _faults
+        from ..utils import recovery as _recovery
+        from .solvers import downgrade_solver
+
+        Z = pack_design(X, y, mask)
         hyper = jnp.asarray([self.reg_param, self.elastic_net_param],
                             float_dtype())
-        result = unpack_fit_result(fit_fn(Zd, hyper), X.shape[1])
+        d = X.shape[1]
+
+        def make_call(m, sname):
+            # Everything stays inside the closure: fallback rungs must
+            # cost nothing (no trace, no placement) unless they run.
+            def call():
+                _faults.inject("fit_packed")
+                fit_fn = fused_linear_fit_packed(
+                    m, sname, self.max_iter, self.tol, self.fit_intercept,
+                    self.standardization)
+                Zd = place_packed(Z, m)
+                return _faults.corrupt(
+                    "solver", unpack_fit_result(fit_fn(Zd, hyper), d))
+            return call
+
+        # Fallback ladder: sharded fit → single-device fit → closed-form
+        # solver (when the penalty permits). Identical statistics on every
+        # rung; only throughput/solver trajectory degrade. Rungs after the
+        # first run only when the one before exhausted its retry policy.
+        fallbacks = []
+        if mesh is not None:
+            fallbacks.append(("single_device", make_call(None, solver_name)))
+        downgraded = downgrade_solver(solver_name, self.reg_param,
+                                      self.elastic_net_param)
+        if downgraded is not None:
+            fallbacks.append((f"solver_{downgraded}",
+                              make_call(None, downgraded)))
+        result = _recovery.resilient_call(
+            make_call(mesh, solver_name), site="fit_packed",
+            policy=_recovery.active_policy("fit_packed"),
+            validate=_recovery.result_validator(),
+            fallbacks=fallbacks, breaker=_recovery.DEVICE_BREAKER)
         model = LinearRegressionModel(
             coefficients=np.asarray(result.coefficients),
             intercept=float(result.intercept),

@@ -137,11 +137,13 @@ def _soft(x, t):
 
 
 @functools.partial(jax.jit, static_argnames=("max_iter", "fit_intercept",
-                                             "standardization"))
+                                             "standardization",
+                                             "record_history"))
 def fista_solve(A: jnp.ndarray, reg_param, elastic_net_param,
                 max_iter: int = 100, tol: float = 1e-6,
                 fit_intercept: bool = True,
-                standardization: bool = True) -> FitResult:
+                standardization: bool = True,
+                record_history: bool = True) -> FitResult:
     """Accelerated proximal gradient (FISTA) on the standardized objective.
 
     Reaches the same optimum as MLlib's OWLQN on the convex elastic net
@@ -149,6 +151,17 @@ def fista_solve(A: jnp.ndarray, reg_param, elastic_net_param,
     loop is one ``lax.scan`` with static shapes. ``objective_history[0]`` is
     the loss at w=0 (≈0.5), matching MLlib's convention of recording the
     initial objective.
+
+    ``record_history=False`` drops the per-iteration objective trace
+    (the returned history holds only the initial objective) — callers
+    that solve many throwaway cells (the fused CV grid) skip the wasted
+    stacking. The trace itself is accumulated in the scan CARRY with an
+    explicit int32 ``dynamic_update_index_in_dim`` rather than as a
+    stacked scan output: the stacking machinery's update indices come
+    out mixed s64/s32 under x64, which the jax-0.4.x SPMD partitioner
+    rejects whenever the solve lands inside a sharded program (the fused
+    CV refit). Identical trace, partitioner-safe on every jax this
+    framework supports.
     """
     m = unpack_moments(A, fit_intercept=fit_intercept)
     dt = A.dtype
@@ -164,9 +177,10 @@ def fista_solve(A: jnp.ndarray, reg_param, elastic_net_param,
 
     w0 = jnp.zeros((d,), dt)
     obj0 = _objective(w0, m, lam1, lam2)
+    hist0 = jnp.zeros((max_iter if record_history else 0,), dt)
 
-    def body(state, _):
-        w, w_prev, t, done, iters, last_obj = state
+    def body(state, i):
+        w, w_prev, t, done, iters, last_obj, hist = state
         tn = 0.5 * (1.0 + jnp.sqrt(1.0 + 4.0 * t * t))
         v = w + ((t - 1.0) / tn) * (w - w_prev)
         grad = m.G @ v - m.b + lam2 * v
@@ -181,16 +195,22 @@ def fista_solve(A: jnp.ndarray, reg_param, elastic_net_param,
         t_out = jnp.where(done, t, tn)
         obj_out = jnp.where(done, last_obj, obj)
         iters_out = iters + jnp.where(done, 0, 1).astype(jnp.int32)
-        return (w_out, w_prev_out, t_out, now_done, iters_out, obj_out), obj_out
+        if record_history:
+            hist = jax.lax.dynamic_update_index_in_dim(hist, obj_out, i, 0)
+        return (w_out, w_prev_out, t_out, now_done, iters_out, obj_out,
+                hist), None
 
-    init = (w0, w0, jnp.asarray(1.0, dt), jnp.asarray(False), jnp.asarray(0, jnp.int32), obj0)
-    (w, _, _, done, iters, _), history = jax.lax.scan(body, init, None, length=max_iter)
+    init = (w0, w0, jnp.asarray(1.0, dt), jnp.asarray(False),
+            jnp.asarray(0, jnp.int32), obj0, hist0)
+    (w, _, _, done, iters, _, hist), _ = jax.lax.scan(
+        body, init, jnp.arange(max_iter, dtype=jnp.int32))
 
     sx = jnp.where(m.valid, m.std_x, 1.0)
     sy = jnp.where(m.std_y > 0, m.std_y, 1.0)
     coef = jnp.where(m.valid, w * sy / sx, 0.0)
     intercept = (m.mean_y - jnp.dot(coef, m.mean_x)) if fit_intercept else jnp.asarray(0.0, dt)
-    history = jnp.concatenate([obj0[None], history])
+    history = (jnp.concatenate([obj0[None], hist]) if record_history
+               else obj0[None])
     return FitResult(coef, intercept, iters, history, done)
 
 
@@ -233,24 +253,79 @@ def resolve_solver(solver: str, reg_param: float, elastic_net_param: float) -> s
     raise ValueError(f"unknown solver {solver!r}")
 
 
+def downgrade_solver(solver_name: str, reg_param: float,
+                     elastic_net_param: float) -> Optional[str]:
+    """The resilience ladder's solver downgrade (``utils.recovery``):
+    an iterative solver (``owlqn``/``fista``) that keeps failing degrades
+    to the closed-form ``normal`` path — but only when no L1 term is
+    active (normal equations cannot express the L1 penalty, exactly
+    MLlib's restriction). Returns ``None`` when no downgrade exists."""
+    has_l1 = (reg_param > 0.0) and (elastic_net_param > 0.0)
+    if solver_name in ("owlqn", "fista") and not has_l1:
+        return "normal"
+    return None
+
+
 def solve(A: jnp.ndarray, reg_param: float, elastic_net_param: float,
           max_iter: int, tol: float, fit_intercept: bool, standardization: bool,
           solver: str = "auto") -> FitResult:
-    """Solver dispatch on a precomputed Gramian (see :func:`resolve_solver`)."""
+    """Solver dispatch on a precomputed Gramian (see :func:`resolve_solver`).
+
+    Host-level dispatch boundary, so it carries the ``solver`` fault-site
+    hooks (``utils.faults``): a scheduled device error raises here before
+    the jitted solve, and a scheduled NaN poisons the returned statistics
+    — both exercised by the resilience suite. No-ops without a plan.
+    """
+    from ..utils import faults as _faults
+
+    _faults.inject("solver")
     name = resolve_solver(solver, reg_param, elastic_net_param)
     if name == "normal":
-        return normal_solve(A, reg_param, elastic_net_param,
-                            fit_intercept=fit_intercept,
-                            standardization=standardization)
-    if name == "fista":
-        return fista_solve(A, reg_param, elastic_net_param, max_iter=max_iter,
-                           tol=tol, fit_intercept=fit_intercept,
-                           standardization=standardization)
-    from .owlqn import owlqn_solve
+        result = normal_solve(A, reg_param, elastic_net_param,
+                              fit_intercept=fit_intercept,
+                              standardization=standardization)
+    elif name == "fista":
+        result = fista_solve(A, reg_param, elastic_net_param,
+                             max_iter=max_iter, tol=tol,
+                             fit_intercept=fit_intercept,
+                             standardization=standardization)
+    else:
+        from .owlqn import owlqn_solve
 
-    return owlqn_solve(A, reg_param, elastic_net_param, max_iter=max_iter,
-                       tol=tol, fit_intercept=fit_intercept,
-                       standardization=standardization)
+        result = owlqn_solve(A, reg_param, elastic_net_param,
+                             max_iter=max_iter, tol=tol,
+                             fit_intercept=fit_intercept,
+                             standardization=standardization)
+    return _faults.corrupt("solver", result)
+
+
+def psum_value_and_grad(local_objective, axis):
+    """``value_and_grad`` for a data-parallel objective inside shard_map:
+    differentiate the LOCAL objective, then explicitly ``psum`` both the
+    value and every gradient leaf.
+
+    Mathematically identical to ``value_and_grad(psum(local))`` — grad is
+    linear — but robust across shard_map implementations: differentiating
+    *through* a psum relies on replication tracking that the legacy
+    ``check_rep`` machinery gets silently wrong when the check is off
+    (which it must be: the old checker cannot traverse the while/scan
+    loops every solver here uses; see ``parallel.mesh.shard_map``). Any
+    replicated term in the local objective (regularizers on replicated
+    params) must be pre-divided by the shard count so the psum restores
+    it exactly once.
+
+    ``axis=None`` returns plain ``jax.value_and_grad`` — the single-device
+    path pays nothing.
+    """
+    vg = jax.value_and_grad(local_objective)
+    if axis is None:
+        return vg
+
+    def vg_psum(params):
+        v, g = vg(params)
+        return (jax.lax.psum(v, axis),
+                jax.tree_util.tree_map(lambda t: jax.lax.psum(t, axis), g))
+    return vg_psum
 
 
 def adam_scan(value_and_grad, params0, max_iter: int, lr: float,

@@ -67,9 +67,9 @@ def _moment_pass_fn(mesh):
 
     from jax.sharding import PartitionSpec as P
 
-    from ..parallel.mesh import DATA_AXIS
+    from ..parallel.mesh import DATA_AXIS, shard_map
 
-    return jax.jit(jax.shard_map(
+    return jax.jit(shard_map(
         lambda X, w: _moment_stats(X, w, DATA_AXIS), mesh=mesh,
         in_specs=(P(DATA_AXIS, None), P(DATA_AXIS)),
         out_specs=P()))
@@ -202,9 +202,9 @@ def _contingency_fn(mesh):
 
     from jax.sharding import PartitionSpec as P
 
-    from ..parallel.mesh import DATA_AXIS
+    from ..parallel.mesh import DATA_AXIS, shard_map
 
-    return jax.jit(jax.shard_map(
+    return jax.jit(shard_map(
         lambda fx, ly: jax.lax.psum(fx.T @ ly, DATA_AXIS), mesh=mesh,
         in_specs=(P(DATA_AXIS, None), P(DATA_AXIS, None)),
         out_specs=P()))

@@ -57,21 +57,25 @@ def _aft_core(X, logt, censor, mask, n, std, max_iter, lr, axis=None):
         return jax.lax.psum(v, axis) if axis is not None else v
 
     def neg_ll(params):
+        # LOCAL share of the likelihood: psum_value_and_grad reduces
+        # value+grad over the mesh (grad through a psum is unreliable on
+        # legacy shard_map; see solvers.psum_value_and_grad)
         beta, b0, logsig = params[:d], params[d], params[d + 1]
         sig = jnp.exp(logsig)
         eps = (lt - b0 * wm - Xs @ beta) / sig
         # masked rows: wm=0 ⇒ eps=0 ⇒ e^0=1 would leak — gate every term
         term = jnp.where(mask, jnp.exp(eps) - dl * (eps - logsig), 0.0)
-        return reduce_(jnp.sum(term)) / n
+        return jnp.sum(term) / n
 
-    from .solvers import adam_scan
+    from .solvers import adam_scan, psum_value_and_grad
 
     p0 = jnp.zeros((d + 2,), dt)
     # init β₀ to mean log t (the σ=1, β=0 stationary point neighborhood)
     b0_init = reduce_(jnp.sum(lt)) / n
     p0 = p0.at[d].set(b0_init)
 
-    p, history = adam_scan(jax.value_and_grad(neg_ll), p0, max_iter, lr)
+    p, history = adam_scan(psum_value_and_grad(neg_ll, axis), p0,
+                           max_iter, lr)
     beta = jnp.where(valid, p[:d] / sx, 0.0)   # unscale to raw features
     return AftFit(beta, p[d], jnp.exp(p[d + 1]), history)
 
@@ -91,9 +95,9 @@ def _aft_fit_fn(mesh, max_iter: int, lr: float):
 
     from jax.sharding import PartitionSpec as P
 
-    from ..parallel.mesh import DATA_AXIS
+    from ..parallel.mesh import DATA_AXIS, shard_map
 
-    return jax.jit(jax.shard_map(
+    return jax.jit(shard_map(
         lambda X, lt, c, m: stats_and_fit(X, lt, c, m, DATA_AXIS),
         mesh=mesh,
         in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(DATA_AXIS),

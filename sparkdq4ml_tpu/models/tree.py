@@ -423,7 +423,7 @@ def _fit_forest(binned, edges, y, w, *, n_trees, max_depth, max_bins,
 
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    from ..parallel.mesh import DATA_AXIS
+    from ..parallel.mesh import DATA_AXIS, shard_map
 
     nsh = mesh.devices.size
     rem = (-n) % nsh
@@ -463,7 +463,7 @@ def _forest_builder(max_depth, max_bins, impurity, min_instances,
 
     from jax.sharding import PartitionSpec as P
 
-    from ..parallel.mesh import DATA_AXIS
+    from ..parallel.mesh import DATA_AXIS, shard_map
 
     if with_masks:
         def local(b, e, t, fm):
@@ -471,7 +471,7 @@ def _forest_builder(max_depth, max_bins, impurity, min_instances,
                 lambda tt, ff: one_tree(b, e, tt, ff, DATA_AXIS),
                 in_axes=(0, 0))(t, fm)
 
-        fn = jax.shard_map(
+        fn = shard_map(
             local, mesh=mesh,
             in_specs=(P(DATA_AXIS, None), P(), P(None, DATA_AXIS, None),
                       P()),
@@ -481,7 +481,7 @@ def _forest_builder(max_depth, max_bins, impurity, min_instances,
             return jax.vmap(
                 lambda tt: one_tree(b, e, tt, None, DATA_AXIS))(t)
 
-        fn = jax.shard_map(
+        fn = shard_map(
             local, mesh=mesh,
             in_specs=(P(DATA_AXIS, None), P(), P(None, DATA_AXIS, None)),
             out_specs=P())
@@ -882,9 +882,9 @@ def _gbt_round_builder(max_depth, max_bins, min_instances, min_info_gain,
 
     from jax.sharding import PartitionSpec as P
 
-    from ..parallel.mesh import DATA_AXIS
+    from ..parallel.mesh import DATA_AXIS, shard_map
 
-    fn = jax.shard_map(
+    fn = shard_map(
         lambda b, e, t: one_round(b, e, t, DATA_AXIS), mesh=mesh,
         in_specs=(P(DATA_AXIS, None), P(), P(DATA_AXIS, None)),
         out_specs=P())
@@ -935,7 +935,7 @@ def _gbt_fit(X, y, w, *, loss, max_iter, step, max_depth, max_bins,
     else:
         from jax.sharding import NamedSharding, PartitionSpec as P
 
-        from ..parallel.mesh import DATA_AXIS
+        from ..parallel.mesh import DATA_AXIS, shard_map
 
         pad = (-n) % mesh.devices.size
         if pad:

@@ -216,25 +216,29 @@ def _cv_program_fn(mesh, num_folds: int, n_params: int, n_features: int,
         return jax.vmap(one)(jnp.arange(k))
 
     def cell(A_tr, A_te, reg, alpha):
+        # record_history=False: the trace is unused here, and its scan
+        # stacking is the op the 0.4.x partitioner miscompiles inside a
+        # sharded cell (see fista_solve)
         r = fista_solve(A_tr, reg, alpha, max_iter=max_iter, tol=tol,
                         fit_intercept=fit_intercept,
-                        standardization=standardization)
+                        standardization=standardization,
+                        record_history=False)
         return _holdout_metric_from_gram(A_te, r.coefficients, r.intercept,
                                          metric)
 
     if use_mesh:
         from jax.sharding import PartitionSpec as P
 
-        from ..parallel.mesh import DATA_AXIS
+        from ..parallel.mesh import DATA_AXIS, shard_map
 
-        grams_fn = jax.shard_map(
+        grams_fn = shard_map(
             lambda Zs, fs: jax.lax.psum(fold_grams(Zs, fs), DATA_AXIS),
             mesh=mesh, in_specs=(P(DATA_AXIS), P(DATA_AXIS)), out_specs=P())
         # check_vma off: the FISTA scan's replicated init carry (w=0) meets
         # a device-varying Gramian inside the manual region, which the
         # varying-manual-axes checker rejects even though the computation is
         # per-device-pure (no collectives inside the scan).
-        cells_fn = jax.shard_map(
+        cells_fn = shard_map(
             jax.vmap(cell), mesh=mesh,
             in_specs=(P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS)),
             out_specs=P(DATA_AXIS), check_vma=False)

@@ -149,10 +149,10 @@ def _sgns_fit_fn(vocab_size: int, dim: int, batch: int, steps: int,
 
     from jax.sharding import PartitionSpec as P
 
-    from ..parallel.mesh import DATA_AXIS
+    from ..parallel.mesh import DATA_AXIS, shard_map
 
     # minibatches shard on the batch (pair) axis; embeddings replicate
-    return jax.jit(jax.shard_map(
+    return jax.jit(shard_map(
         lambda c, o, cdf, key, U0, V0: core(c, o, cdf, key, U0, V0,
                                             DATA_AXIS),
         mesh=mesh,
@@ -302,7 +302,7 @@ class Word2Vec(Estimator):
         if mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
 
-            from ..parallel.mesh import DATA_AXIS
+            from ..parallel.mesh import DATA_AXIS, shard_map
 
             shard = NamedSharding(mesh, P(None, DATA_AXIS))
             rep = NamedSharding(mesh, P())

@@ -18,6 +18,7 @@ import base64 as _b64
 import builtins
 import functools
 import hashlib
+import math
 import re
 from typing import Any, Callable, Sequence
 
@@ -448,6 +449,12 @@ class InList(Expr):
     Numeric columns fold to an OR-reduction of equalities on device; string
     columns test with host numpy. Null rows (None / NaN) are never members
     (SQL three-valued logic collapses to False in a WHERE mask).
+
+    A NULL *in the value set* follows SQL three-valued logic too (Spark
+    parity): ``x NOT IN (…, NULL)`` can never be TRUE (``x <> NULL`` is
+    unknown), so NOT IN filters every row; plain ``IN`` drops the NULL
+    from the list — a match still passes, a non-match becomes unknown and
+    filters, which the boolean mask already expresses as False.
     """
 
     def __init__(self, child: Expr, values: Sequence[Expr],
@@ -456,9 +463,22 @@ class InList(Expr):
         self.values = list(values)
         self.negated = negated
 
+    @staticmethod
+    def _is_null_lit(x) -> bool:
+        return isinstance(x, Lit) and (
+            x.value is None or (isinstance(x.value, float)
+                                and math.isnan(x.value)))
+
     def eval(self, frame):
+        values = self.values
+        if any(self._is_null_lit(x) for x in values):
+            if self.negated:
+                return jnp.zeros((frame.num_slots,), jnp.bool_)
+            values = [x for x in values if not self._is_null_lit(x)]
+            if not values:      # IN (NULL): unknown for every row
+                return jnp.zeros((frame.num_slots,), jnp.bool_)
         v = self.child.eval(frame)
-        vals = [x.eval(frame) for x in self.values]
+        vals = [x.eval(frame) for x in values]
         if _is_object(v) or any(_is_object(x) for x in vals):
             va = np.asarray(v, object)
             hit = np.zeros(va.shape[0], bool)

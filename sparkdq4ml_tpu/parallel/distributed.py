@@ -17,6 +17,7 @@ construction — the same mechanism that makes DQ filtering static-shaped
 from __future__ import annotations
 
 import functools
+import logging
 from typing import Optional
 
 import jax
@@ -25,7 +26,9 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..models.solvers import augmented_gram
-from .mesh import DATA_AXIS
+from .mesh import DATA_AXIS, shard_map
+
+logger = logging.getLogger("sparkdq4ml_tpu.distributed")
 
 
 def pad_rows(X: np.ndarray, y: np.ndarray, mask: np.ndarray, multiple: int):
@@ -52,7 +55,7 @@ def _gram_sharded_fn(mesh: Mesh):
     def local(X, y, mask):
         return jax.lax.psum(augmented_gram(X, y, mask), DATA_AXIS)
 
-    sharded = jax.shard_map(
+    sharded = shard_map(
         local, mesh=mesh,
         in_specs=(P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS)),
         out_specs=P())
@@ -174,7 +177,7 @@ def fused_linear_fit_packed(mesh: Optional[Mesh], solver: str, max_iter: int,
     if mesh is None or mesh.devices.size <= 1:
         gram = local_gram
     else:
-        gram = jax.shard_map(
+        gram = shard_map(
             lambda Zs: jax.lax.psum(local_gram(Zs), DATA_AXIS),
             mesh=mesh, in_specs=(P(DATA_AXIS),), out_specs=P())
 
@@ -235,23 +238,66 @@ def place_sharded(X, y, mask, mesh: Optional[Mesh]):
             jax.device_put(mh, shard))
 
 
+def _gram_single_cpu(Xh, yh, mh):
+    """Single-device Gramian pinned to the host CPU backend — the last
+    rung of the sharded-Gramian fallback ladder: when the mesh path is
+    failing (lost device, wedged tunnel), the statistics still compute,
+    just slower. Falls back to the default device when this process has
+    no CPU backend (should not happen; jax always registers one)."""
+    try:
+        cpu = jax.devices("cpu")[0]
+    except RuntimeError:
+        return _gram_single(jnp.asarray(Xh), jnp.asarray(yh),
+                            jnp.asarray(mh, jnp.bool_))
+    with jax.default_device(cpu):
+        return _gram_single(jax.device_put(Xh, cpu), jax.device_put(yh, cpu),
+                            jax.device_put(np.asarray(mh, bool), cpu))
+
+
 def compute_gram(X, y, mask, mesh: Optional[Mesh] = None):
     """Augmented Gramian ``A``, sharded over ``mesh`` when it has >1 device.
 
     Accepts host or device arrays; on the sharded path, inputs are placed with
     a row-sharded ``NamedSharding`` so each device holds only its shard (HBM
     never sees the replicated matrix).
+
+    The sharded path runs under the resilience policy
+    (``utils.recovery.resilient_call``): a device error — real
+    ``XlaRuntimeError`` or one injected at the ``gram_sharded`` fault
+    site — retries with backoff, trips the ``gram_sharded`` circuit
+    breaker, and ultimately falls back to the single-device CPU Gramian
+    with a logged warning instead of aborting the fit. Identical
+    statistics either way (the psum and the single matmul compute the
+    same ``A``); only throughput degrades.
     """
     if mesh is None or mesh.devices.size <= 1:
         return _gram_single(jnp.asarray(X), jnp.asarray(y),
                             jnp.asarray(mask, jnp.bool_))
+    from ..utils import faults as _faults
+    from ..utils import recovery as _recovery
+
     nshards = mesh.devices.size
     Xh = np.asarray(X)
     yh = np.asarray(y)
     mh = np.asarray(mask, bool)
-    Xh, yh, mh = pad_rows(Xh, yh, mh, nshards)
+    Xp, yp, mp = pad_rows(Xh, yh, mh, nshards)
     shard = NamedSharding(mesh, P(DATA_AXIS))
-    Xd = jax.device_put(Xh, shard)
-    yd = jax.device_put(yh, shard)
-    md = jax.device_put(mh, shard)
-    return _gram_sharded_fn(mesh)(Xd, yd, md)
+
+    def sharded():
+        _faults.inject("gram_sharded")
+        Xd = jax.device_put(Xp, shard)
+        yd = jax.device_put(yp, shard)
+        md = jax.device_put(mp, shard)
+        return _gram_sharded_fn(mesh)(Xd, yd, md)
+
+    def single_cpu():
+        logger.warning(
+            "sharded Gramian failed on %d devices; falling back to the "
+            "single-device CPU path", nshards)
+        return _gram_single_cpu(Xh, yh, mh)
+
+    return _recovery.resilient_call(
+        sharded, site="gram_sharded",
+        policy=_recovery.active_policy("gram_sharded"),
+        fallbacks=[("single_cpu", single_cpu)],
+        breaker=_recovery.DEVICE_BREAKER)

@@ -136,10 +136,17 @@ class TpuSession:
         self.app_name = app_name
         self.master = master
         self.conf: dict[str, str] = dict(conf or {})
+        self._init_faults()
         self._ensure_backend()
         self._init_distributed()
         n = parse_master(master)
         self.mesh = make_mesh(n)
+        # Chaos hook: a scheduled ``mesh:device_drop`` spec shrinks the
+        # session mesh — the lost-worker scenario, exercised end-to-end by
+        # the resilience suite. No-op without an active fault plan.
+        from .utils import faults as _faults
+
+        self.mesh = _faults.degrade_mesh("mesh", self.mesh)
         self.catalog: Catalog = default_catalog()
         self.udf: UDFRegistry = default_registry()
         if register_rules:
@@ -147,6 +154,33 @@ class TpuSession:
         self._init_compilation_cache()
         logger.debug("session %r: %d device(s), platform=%s", app_name,
                      self.num_devices, jax.devices()[0].platform)
+
+    def _init_faults(self) -> None:
+        """Install the fault-injection plan (``utils.faults``) from session
+        conf or environment — chaos-in-production is opt-in and explicit:
+
+            .config("spark.faults", "gram_sharded:device_error:1")
+            .config("spark.faults.seed", 7)
+
+        or ``SPARKDQ4ML_FAULTS`` in the environment. The recovery policy
+        the injected failures exercise is likewise conf-driven
+        (``spark.recovery.maxAttempts``, ``.backoffBase``, ``.backoffMax``,
+        ``.backoffFactor``, ``.jitter``, ``.attemptDeadline``,
+        ``.totalDeadline``, ``.validate`` — see
+        ``utils.recovery.RetryPolicy.from_conf``). With neither conf key
+        nor env var set this is a no-op and leaves any programmatically
+        installed plan alone."""
+        from .utils import faults as _faults
+
+        seed = int(self.conf.get("spark.faults.seed", 0))
+        spec = self.conf.get("spark.faults")
+        if spec:
+            # remembered so stop() can uninstall: chaos configured on one
+            # session must never leak into the next one
+            self._fault_plan = _faults.install_plan(
+                _faults.parse_plan(spec, seed=seed))
+        elif os.environ.get(_faults.ENV_VAR):
+            self._fault_plan = _faults.install_from_env(seed=seed)
 
     def _is_multihost(self) -> bool:
         """Single predicate for "this session bootstraps a multi-host
@@ -369,6 +403,8 @@ class TpuSession:
                 _ACTIVE.conf.update(self._conf)  # Spark getOrCreate semantics
                 if any(k.startswith("spark.compilation.") for k in self._conf):
                     _ACTIVE._init_compilation_cache()
+                if any(k.startswith("spark.faults") for k in self._conf):
+                    _ACTIVE._init_faults()   # late chaos conf still installs
             return _ACTIVE
 
         getOrCreate = get_or_create
@@ -444,6 +480,17 @@ class TpuSession:
         return Frame({"id": ids})
 
     @property
+    def recovery_log(self):
+        """The process-global structured recovery-event log (retries,
+        backoffs, fallbacks, circuit-breaker trips, preemption resumes)
+        — ``utils.recovery.RECOVERY_LOG``. Empty on a clean run; the
+        observable side of the resilience layer (README § "Failure model
+        & fault injection")."""
+        from .utils.recovery import RECOVERY_LOG
+
+        return RECOVERY_LOG
+
+    @property
     def version(self) -> str:
         """Engine version string (Spark ``spark.version`` analogue)."""
         from . import __version__
@@ -455,3 +502,13 @@ class TpuSession:
         if _ACTIVE is self:
             _ACTIVE = None
         self.catalog.clear()
+        # Uninstall the fault plan THIS session installed (conf/env):
+        # chaos is session-scoped opt-in; a later chaos-free session (or
+        # plain library use) must not keep injecting this one's faults.
+        plan = getattr(self, "_fault_plan", None)
+        if plan is not None:
+            from .utils import faults as _faults
+
+            if _faults.active() is plan:
+                _faults.clear()
+            self._fault_plan = None

@@ -1639,9 +1639,13 @@ def _execute_single(q: Query, cat):
                        else _resolve_qualified(k, scope, cols_now), a)
                       for k, a in q.order_by]
     # Correlated EXISTS/IN predicates decorrelate into semi/anti joins
-    # (the rewrite Spark itself performs). NOT IN keeps join-key null
-    # semantics here (a null key never matches), not SQL's three-valued
-    # NOT IN — the uncorrelated literal path below retains the latter.
+    # (the rewrite Spark itself performs). CORRELATED NOT IN keeps the
+    # anti-join's null semantics (a null key never matches, so its row
+    # survives), not SQL's three-valued NOT IN. The UNCORRELATED path
+    # below implements the full three-valued rule: subquery/literal value
+    # sets materialize into an InList, whose eval makes NOT IN filter
+    # every row when the set contains a NULL/NaN and drops the NULL for
+    # plain IN (ops/expressions.InList).
     if q.where is not None and scope:
         q.where, corr_joins = _decorrelate_where(q.where, scope, cat)
         for right, keys, how in corr_joins:
@@ -1842,7 +1846,7 @@ def _execute_single(q: Query, cat):
         if q.order_by and not star:
             # SQL sorts before projecting, so ORDER BY may reference columns
             # the SELECT drops — sort first when the source has them all
-            # (otherwise fall through: the key must be a SELECT alias).
+            # (otherwise fall through: some key must be a SELECT alias).
             # Expression keys materialize as temp columns on the source
             # frame here (they reference source columns); the projection
             # below drops the temps for free.
@@ -1869,7 +1873,33 @@ def _execute_single(q: Query, cat):
                 q2.offset = q.offset
                 q = q2
         if not star:
-            frame = frame.select(*q.items)
+            keep_for_sort: list = []
+            if q.order_by:
+                # Post-projection sort (a key is a SELECT alias): any
+                # other key column the projection would drop — the
+                # __ord_N temps materialized above, or a plain source
+                # column — must survive the projection and be dropped
+                # after _sort_with_exprs (same drop_after_sort protocol
+                # as the aggregate path; ADVICE.md #1).
+                produced = {it.name for it in q.items
+                            if not isinstance(it, str)}
+                needed: set = set()
+                for key, _ in q.order_by:
+                    if isinstance(key, str):
+                        needed.add(key)
+                    else:
+                        _referenced_cols(key, needed)
+                keep_for_sort = [c for c in frame.columns
+                                 if c in needed and c not in produced]
+                if keep_for_sort and q.distinct:
+                    raise ValueError(
+                        "SELECT DISTINCT: ORDER BY keys must appear in "
+                        "the select list (sorting by "
+                        f"{sorted(needed - produced)} would change the "
+                        "distinct rows)")
+            frame = frame.select(*q.items, *keep_for_sort)
+            if keep_for_sort:
+                q.drop_after_sort = keep_for_sort
 
     if q.distinct:
         # SELECT DISTINCT dedups the projected rows (mask-based: keeps the

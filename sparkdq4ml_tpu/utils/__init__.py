@@ -1,3 +1,7 @@
-from .logging import configure_logging
-from .profiling import PhaseTimer, block_until_ready, timed, trace
-from .recovery import FitFailure, check_finite, fit_or_resume, retry
+from . import faults
+from .logging import configure_logging, format_kv
+from .profiling import PhaseTimer, block_until_ready, counters, timed, trace
+from .recovery import (RECOVERY_LOG, CircuitBreaker, CircuitOpenError,
+                       DeadlineExceeded, FitFailure, RecoveryEvent,
+                       RecoveryLog, RetryPolicy, check_finite, fit_or_resume,
+                       recovery_events, resilient_call, retry)

@@ -1,0 +1,359 @@
+"""Deterministic fault injection — the chaos layer of the resilience stack.
+
+The reference app inherits Spark's failure machinery (task retry, lineage
+recomputation, checkpointing) but none of it is *testable* there: you
+cannot ask `local[*]` to lose an executor on the third task. Here failures
+are first-class: a :class:`FaultPlan` schedules failures at named **sites**
+in the execution path, keyed by a per-site attempt counter and a seed, so
+every injected failure is reproducible run-to-run — the property the
+``tests/test_faults.py`` suite is built on.
+
+Failure classes (``kind``):
+
+* ``device_error`` — raises :class:`InjectedDeviceError`, a
+  ``jax.errors.JaxRuntimeError`` subclass, i.e. exactly the exception type
+  a real XLA device fault (OOM, interconnect reset, preempted tunnel)
+  surfaces as. The production catch paths cannot tell the difference,
+  which is the point.
+* ``nan`` — poisons one leaf of a result pytree with NaN (a diverged
+  solver / flaky transfer), at a seeded element position.
+* ``preempt`` — raises :class:`Preemption` (NOT a device error): the
+  mid-fit preemption that ``recovery.fit_or_resume`` turns into a
+  checkpoint-resume instead of a crash.
+* ``device_drop`` — shrinks a mesh by ``n`` devices (default 1), the
+  lost-worker scenario; ``parallel.mesh.normalize_mesh`` semantics apply
+  to whatever survives.
+
+Sites instrumented in production code: ``gram_sharded``
+(``parallel.distributed.compute_gram``'s sharded path), ``fit_packed``
+(the packed linear-fit dispatch in ``models.regression``), ``solver``
+(``models.solvers.solve`` and the packed fit's result pytree), ``fit``
+(``recovery.fit_or_resume``'s fit call), and ``mesh`` (session mesh
+construction). Injection happens at host-level dispatch boundaries only —
+never inside a traced/jitted function, where a Python-level raise would
+fire at trace time, not run time.
+
+Activation: programmatic (:func:`install_plan`, or the
+:func:`inject_faults` context manager tests use) or env-driven — set
+``SPARKDQ4ML_FAULTS`` (or session conf ``spark.faults``) to a
+semicolon-separated spec list, e.g.::
+
+    SPARKDQ4ML_FAULTS="gram_sharded:device_error:1,2;solver:nan:1"
+    SPARKDQ4ML_FAULTS="fit:preempt:p=0.25:seed=7;mesh:device_drop:n=2"
+
+Spec grammar: ``site:kind[:a1,a2,...][:p=prob][:n=count][:seed=s]`` —
+an explicit 1-based attempt list fires deterministically on those
+attempts; ``p=`` fires as a seeded Bernoulli draw per attempt (still
+reproducible: the draw is a pure function of (seed, site, attempt));
+with neither, the fault fires on attempt 1 only.
+
+When no plan is installed every hook is a no-op behind one ``is None``
+check — the chaos layer costs nothing in production.
+
+See README.md "Failure model & fault injection" for the recovery side:
+retry policy knobs, circuit breaker, and the fallback ladder.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import zlib
+from dataclasses import dataclass, field
+from typing import List, Optional, Sequence
+
+logger = logging.getLogger("sparkdq4ml_tpu.faults")
+
+ENV_VAR = "SPARKDQ4ML_FAULTS"
+
+KINDS = ("device_error", "nan", "preempt", "device_drop")
+
+
+def _jax_runtime_error_base():
+    import jax
+
+    return jax.errors.JaxRuntimeError
+
+
+class Preemption(RuntimeError):
+    """Simulated mid-fit preemption (maintenance event / spot reclaim).
+
+    Deliberately NOT a ``JaxRuntimeError``: retry loops must not swallow
+    it as a transient device fault — ``recovery.fit_or_resume`` owns it
+    (checkpoint what is done, resume from the artifact)."""
+
+
+# The injected device error must be catchable exactly where real XLA
+# faults are caught; subclassing at import time would force a jax import
+# here, so the class is built lazily on first use.
+_INJECTED_DEVICE_ERROR = None
+
+
+def injected_device_error_class():
+    global _INJECTED_DEVICE_ERROR
+    if _INJECTED_DEVICE_ERROR is None:
+        class InjectedDeviceError(_jax_runtime_error_base()):
+            """Simulated ``XlaRuntimeError`` (device OOM / interconnect
+            reset / preempted tunnel) raised by the fault plan."""
+
+        _INJECTED_DEVICE_ERROR = InjectedDeviceError
+    return _INJECTED_DEVICE_ERROR
+
+
+@dataclass
+class FaultSpec:
+    """One scheduled failure: ``kind`` at ``site``, firing on the listed
+    1-based attempts, or per-attempt with probability ``p`` (seeded)."""
+
+    site: str
+    kind: str
+    attempts: Optional[frozenset] = None   # None + p=None → {1}
+    p: Optional[float] = None
+    n: int = 1                             # device_drop count / nan leaves
+    seed: Optional[int] = None             # overrides the plan seed
+
+    def __post_init__(self):
+        if self.kind not in KINDS:
+            raise ValueError(f"unknown fault kind {self.kind!r} "
+                             f"(supported: {KINDS})")
+        if self.attempts is None and self.p is None:
+            self.attempts = frozenset({1})
+
+    def fires(self, attempt: int, plan_seed: int) -> bool:
+        if self.attempts is not None:
+            return attempt in self.attempts
+        # seeded Bernoulli: pure function of (seed, site, attempt) — no
+        # global RNG state, so concurrent sites never perturb each other
+        return _det_uniform(self._seed(plan_seed), self.site,
+                            attempt) < float(self.p)
+
+    def _seed(self, plan_seed: int) -> int:
+        return plan_seed if self.seed is None else self.seed
+
+
+def _det_uniform(seed: int, site: str, attempt: int) -> float:
+    """Deterministic uniform in [0, 1): crc32-keyed — ``hash(str)`` is
+    salted per process and would break run-to-run reproducibility."""
+    key = zlib.crc32(f"{seed}:{site}:{attempt}".encode()) & 0xFFFFFFFF
+    return key / 2.0 ** 32
+
+
+def parse_spec(text: str) -> FaultSpec:
+    parts = [p.strip() for p in text.strip().split(":") if p.strip()]
+    if len(parts) < 2:
+        raise ValueError(
+            f"fault spec {text!r} must be site:kind[:attempts][:p=..]"
+            "[:n=..][:seed=..]")
+    site, kind = parts[0], parts[1].lower()
+    attempts, p, n, seed = None, None, 1, None
+    for part in parts[2:]:
+        if part.startswith("p="):
+            p = float(part[2:])
+        elif part.startswith("n="):
+            n = int(part[2:])
+        elif part.startswith("seed="):
+            seed = int(part[5:])
+        else:
+            attempts = frozenset(int(a) for a in part.split(",") if a)
+    return FaultSpec(site, kind, attempts, p, n, seed)
+
+
+def parse_plan(text: str, seed: int = 0) -> "FaultPlan":
+    """Parse a plan string: specs separated by ``;`` (or newlines —
+    commas stay free for attempt lists inside a spec)."""
+    sep = ";" if ";" in text else "\n"
+    specs = [parse_spec(s) for s in text.split(sep) if s.strip()]
+    return FaultPlan(specs, seed=seed)
+
+
+@dataclass
+class FaultPlan:
+    """Active failure schedule + per-(site, class) attempt counters + fire
+    log. Attempt counters are keyed by failure *class* (``raise`` for
+    device_error/preempt, ``nan``, ``drop``) so that co-located hooks —
+    an ``inject`` and a ``corrupt`` guarding the same dispatch — never
+    double-count one logical attempt."""
+
+    specs: List[FaultSpec]
+    seed: int = 0
+    _counts: dict = field(default_factory=dict)
+    _fired: list = field(default_factory=list)
+    _lock: threading.Lock = field(default_factory=threading.Lock)
+
+    def _has(self, site: str, kinds: Sequence[str]) -> bool:
+        return any(s.site == site and s.kind in kinds for s in self.specs)
+
+    def _tick(self, site: str, cls: str) -> int:
+        key = f"{site}#{cls}"
+        with self._lock:
+            self._counts[key] = self._counts.get(key, 0) + 1
+            return self._counts[key]
+
+    def _due(self, site: str, attempt: int, kinds: Sequence[str]):
+        for spec in self.specs:
+            if spec.site == site and spec.kind in kinds \
+                    and spec.fires(attempt, self.seed):
+                return spec
+        return None
+
+    def _record(self, spec: FaultSpec, attempt: int):
+        with self._lock:
+            self._fired.append((spec.site, spec.kind, attempt))
+        logger.warning("fault injected: site=%s kind=%s attempt=%d",
+                       spec.site, spec.kind, attempt)
+
+    # -- introspection (test assertions) -----------------------------------
+    @property
+    def fired(self) -> list:
+        with self._lock:
+            return list(self._fired)
+
+    def attempts_at(self, site: str, cls: str = "raise") -> int:
+        with self._lock:
+            return self._counts.get(f"{site}#{cls}", 0)
+
+
+# -- active-plan management (module global; None == chaos off) --------------
+_PLAN: Optional[FaultPlan] = None
+_ENV_CHECKED = False
+
+
+def install_plan(plan: Optional[FaultPlan]) -> Optional[FaultPlan]:
+    global _PLAN, _ENV_CHECKED
+    _PLAN = plan
+    _ENV_CHECKED = True  # an explicit install wins over the env
+    return plan
+
+
+def install_from_env(env: Optional[str] = None,
+                     seed: int = 0) -> Optional[FaultPlan]:
+    """(Re-)read the env spec; installs None when unset."""
+    text = os.environ.get(ENV_VAR) if env is None else env
+    return install_plan(parse_plan(text, seed=seed) if text else None)
+
+
+def clear() -> None:
+    install_plan(None)
+
+
+def active() -> Optional[FaultPlan]:
+    """The active plan — lazily picks up ``SPARKDQ4ML_FAULTS`` once so
+    env-driven chaos works without a session."""
+    global _ENV_CHECKED
+    if not _ENV_CHECKED:
+        _ENV_CHECKED = True
+        if os.environ.get(ENV_VAR):
+            install_from_env()
+            _ENV_CHECKED = True
+    return _PLAN
+
+
+class inject_faults:
+    """Context manager installing a plan for a scope (tests)::
+
+        with inject_faults("gram_sharded:device_error:1", seed=42):
+            model = lr.fit(frame)
+    """
+
+    def __init__(self, *specs, seed: int = 0):
+        parsed = []
+        for s in specs:
+            parsed.append(s if isinstance(s, FaultSpec) else parse_spec(s))
+        self.plan = FaultPlan(parsed, seed=seed)
+        self._prev = None
+
+    def __enter__(self) -> FaultPlan:
+        self._prev = _PLAN
+        install_plan(self.plan)
+        return self.plan
+
+    def __exit__(self, *exc):
+        install_plan(self._prev)
+        return False
+
+
+# -- site hooks (the production instrumentation points) ---------------------
+def inject(site: str) -> None:
+    """Raise the scheduled failure for ``site``, if any. The per-site
+    attempt counter ticks on every call that has a matching raise-class
+    spec, so a retry loop naturally walks past an attempt-1-only fault on
+    its second try."""
+    plan = active()
+    if plan is None or not plan._has(site, ("device_error", "preempt")):
+        return
+    attempt = plan._tick(site, "raise")
+    spec = plan._due(site, attempt, ("device_error", "preempt"))
+    if spec is None:
+        return
+    plan._record(spec, attempt)
+    if spec.kind == "preempt":
+        raise Preemption(
+            f"injected preemption at {site!r} (attempt {attempt})")
+    raise injected_device_error_class()(
+        f"injected device error at {site!r} (attempt {attempt})")
+
+
+def corrupt(site: str, tree):
+    """Poison one float leaf element of ``tree`` with NaN when a ``nan``
+    fault is due at ``site`` (seeded element choice); otherwise return
+    ``tree`` unchanged."""
+    plan = active()
+    if plan is None or not plan._has(site, ("nan",)):
+        return tree
+    attempt = plan._tick(site, "nan")
+    spec = plan._due(site, attempt, ("nan",))
+    if spec is None:
+        return tree
+    plan._record(spec, attempt)
+    return _poison(tree, spec._seed(plan.seed), site, attempt)
+
+
+def _poison(tree, seed: int, site: str, attempt: int):
+    """NaN one element of one inexact array leaf, chosen deterministically."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    targets = [i for i, leaf in enumerate(leaves)
+               if hasattr(leaf, "dtype") and hasattr(leaf, "size")
+               and np.issubdtype(np.asarray(leaf).dtype, np.inexact)
+               and np.asarray(leaf).size > 0]
+    if not targets:
+        return tree
+    u = _det_uniform(seed, site + "#leaf", attempt)
+    li = targets[int(u * len(targets)) % len(targets)]
+    leaf = leaves[li]
+    size = int(np.asarray(leaf).size)
+    ei = int(_det_uniform(seed, site + "#elem", attempt) * size) % size
+    if isinstance(leaf, jax.Array):
+        flat = jnp.ravel(leaf).at[ei].set(jnp.nan).reshape(leaf.shape)
+    else:
+        flat = np.array(leaf, copy=True)
+        flat.reshape(-1)[ei] = np.nan
+    leaves[li] = flat
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+def degrade_mesh(site: str, mesh):
+    """Drop ``n`` devices from ``mesh`` when a ``device_drop`` fault is due
+    at ``site`` — the lost-worker scenario. Never drops below 1 device."""
+    plan = active()
+    if plan is None or mesh is None \
+            or not plan._has(site, ("device_drop",)):
+        return mesh
+    attempt = plan._tick(site, "drop")
+    spec = plan._due(site, attempt, ("device_drop",))
+    if spec is None:
+        return mesh
+    plan._record(spec, attempt)
+    devices = list(mesh.devices.flat)
+    keep = max(1, len(devices) - spec.n)
+    if keep == len(devices):
+        return mesh
+    from ..parallel.mesh import make_mesh
+
+    logger.warning("fault plan dropped %d device(s): mesh %d -> %d",
+                   len(devices) - keep, len(devices), keep)
+    return make_mesh(devices=devices[:keep])

@@ -26,3 +26,20 @@ def configure_logging(framework_level: int = logging.DEBUG,
     logging.getLogger("sparkdq4ml_tpu").setLevel(framework_level)
     for noisy in ("jax", "jax._src", "absl"):
         logging.getLogger(noisy).setLevel(logging.WARNING)
+
+
+def format_kv(**fields) -> str:
+    """Structured ``key=value`` event line (logfmt convention) — the
+    single render used for recovery-telemetry events
+    (``utils.recovery.RecoveryEvent``), so log scrapers see one stable
+    shape. Empty/zero-ish values are elided; values with spaces are
+    quoted."""
+    parts = []
+    for k, v in fields.items():
+        if v is None or v == "" or v == 0 or v == 0.0:
+            continue
+        s = str(v)
+        if " " in s or "=" in s:
+            s = '"' + s.replace('"', r'\"') + '"'
+        parts.append(f"{k}={s}")
+    return " ".join(parts)

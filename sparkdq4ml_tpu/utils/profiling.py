@@ -8,13 +8,18 @@ The reference exposes nothing beyond post-hoc ``objectiveHistory`` prints
 * :func:`trace` — context manager around ``jax.profiler`` emitting an XLA
   trace viewable in TensorBoard/Perfetto, for the fit hot loop,
 * :func:`block_until_ready` — honest timing helper (JAX dispatch is async;
-  timings without a sync measure nothing).
+  timings without a sync measure nothing),
+* :data:`counters` — process-global named counters; the recovery layer
+  (``utils.recovery.RECOVERY_LOG``) mirrors every retry/fallback/breaker
+  event here as ``recovery.<action>``, so resilience activity shows up in
+  the same place as performance telemetry.
 """
 
 from __future__ import annotations
 
 import contextlib
 import logging
+import threading
 import time
 from typing import Optional
 
@@ -25,6 +30,45 @@ logger = logging.getLogger("sparkdq4ml_tpu.profiling")
 
 def block_until_ready(tree):
     return jax.block_until_ready(tree)
+
+
+class Counters:
+    """Thread-safe named monotonic counters (Spark-metrics analogue).
+
+    The recovery subsystem increments ``recovery.retry``,
+    ``recovery.fallback``, ``recovery.circuit_open``, … per structured
+    event; anything else in the framework is free to add its own names.
+    ``snapshot()`` returns a plain dict for reports/assertions."""
+
+    def __init__(self):
+        self._counts: dict[str, int] = {}
+        self._lock = threading.Lock()
+
+    def increment(self, name: str, by: int = 1) -> int:
+        with self._lock:
+            self._counts[name] = self._counts.get(name, 0) + by
+            return self._counts[name]
+
+    def get(self, name: str) -> int:
+        with self._lock:
+            return self._counts.get(name, 0)
+
+    def snapshot(self, prefix: str = "") -> dict:
+        with self._lock:
+            return {k: v for k, v in self._counts.items()
+                    if k.startswith(prefix)}
+
+    def clear(self, prefix: str = "") -> None:
+        with self._lock:
+            if not prefix:
+                self._counts.clear()
+            else:
+                for k in [k for k in self._counts if k.startswith(prefix)]:
+                    del self._counts[k]
+
+
+#: Process-global counter registry (see :class:`Counters`).
+counters = Counters()
 
 
 class PhaseTimer:

@@ -505,3 +505,66 @@ class TestExpressionAggregates:
         from sparkdq4ml_tpu import functions as F
         with pytest.raises(ValueError, match="windowed"):
             F.sum(dq.col("p") * 2).over(F.Window.partitionBy("k"))
+
+
+class TestMaxByNullHandling:
+    """Spark parity (ADVICE.md #3): max_by/min_by ignore only rows whose
+    ORDERING value is null; the selected VALUE returns as-is — NULL
+    included."""
+
+    def test_null_value_at_extreme_is_returned(self, session):
+        Frame({"x": np.asarray([None, "a"], object), "y": [10.0, 1.0]}) \
+            .create_or_replace_temp_view("mbn")
+        d = session.sql("SELECT max_by(x, y) AS m, min_by(x, y) AS n "
+                        "FROM mbn").to_pydict()
+        assert d["m"][0] is None          # value at y=10 is NULL → NULL
+        assert d["n"][0] == "a"
+        session.catalog.drop("mbn")
+
+    def test_numeric_null_value_returned_as_nan(self, session):
+        Frame({"x": [np.nan, 5.0], "y": [10.0, 1.0]}) \
+            .create_or_replace_temp_view("mbn2")
+        d = session.sql("SELECT max_by(x, y) AS m FROM mbn2").to_pydict()
+        assert np.isnan(d["m"][0])
+        session.catalog.drop("mbn2")
+
+    def test_null_ordering_rows_still_ignored(self, session):
+        Frame({"x": [7.0, 5.0], "y": [np.nan, 1.0]}) \
+            .create_or_replace_temp_view("mbn3")
+        d = session.sql("SELECT max_by(x, y) AS m FROM mbn3").to_pydict()
+        assert d["m"][0] == 5.0           # y=NaN row never wins
+        session.catalog.drop("mbn3")
+
+
+class TestGlobalAggEmptyKeying:
+    """ADVICE.md #5: the empty-input NULL decision keys on the count of
+    non-null rows (one deferred host sync for the whole agg call), not on
+    the weight sum."""
+
+    def test_sum_min_max_null_over_all_null_column(self):
+        f = Frame({"x": [np.nan, np.nan]})
+        d = f.agg(F.sum("x"), F.min("x"), F.max("x")).to_pydict()
+        assert np.isnan(d["sum(x)"][0])
+        assert np.isnan(d["min(x)"][0])
+        assert np.isnan(d["max(x)"][0])
+
+    def test_sum_min_max_over_masked_out_frame(self):
+        f = Frame({"x": [1.0, 2.0]}).filter(dq.col("x") > 99)
+        d = f.agg(F.sum("x"), F.min("x"), F.count("x")).to_pydict()
+        assert np.isnan(d["sum(x)"][0])
+        assert np.isnan(d["min(x)"][0])
+        assert d["count(x)"][0] == 0
+
+    def test_values_and_order_preserved(self):
+        f = Frame({"x": [1.0, np.nan, 3.0], "y": [2.0, 4.0, 6.0]})
+        out = f.agg(F.max("x"), F.sum("y"), F.min("x"), F.count("x"))
+        assert out.columns == ["max(x)", "sum(y)", "min(x)", "count(x)"]
+        d = out.to_pydict()
+        assert d["max(x)"][0] == 3.0
+        assert d["sum(y)"][0] == 12.0
+        assert d["min(x)"][0] == 1.0
+        assert d["count(x)"][0] == 2
+
+    def test_zero_sum_over_valid_rows_is_zero_not_null(self):
+        f = Frame({"x": [1.5, -1.5, 0.0]})
+        assert f.agg(F.sum("x")).to_pydict()["sum(x)"][0] == 0.0

@@ -337,6 +337,21 @@ class TestReshapeAndTransform:
         assert out.withColumnsRenamed({"y": "why"}).columns == \
             ["ex", "why", "label"]
 
+    def test_with_columns_renamed_collision_raises(self, f):
+        # renaming x onto the untouched y would silently drop a column
+        # (the engine cannot hold duplicate names) — raise instead
+        with pytest.raises(ValueError, match="collides"):
+            f.with_columns_renamed({"x": "y"})
+        with pytest.raises(ValueError, match="collides"):
+            f.with_columns_renamed({"x": "t", "y": "t"})
+
+    def test_with_columns_renamed_swap_allowed(self, f):
+        out = f.with_columns_renamed({"x": "y", "y": "x"})
+        assert out.columns == ["y", "x", "label"]
+        d, orig = out.to_pydict(), f.to_pydict()
+        assert d["y"].tolist() == orig["x"].tolist()
+        assert d["x"].tolist() == orig["y"].tolist()
+
     def test_transform_chain(self, f):
         def double_y(df):
             return df.with_column("y", df["y"] * 2)

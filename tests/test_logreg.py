@@ -37,9 +37,14 @@ class TestSklearnParity:
         lam = 0.05
         model = LogisticRegression(reg_param=lam, elastic_net_param=1.0,
                                    max_iter=3000, tol=1e-13).fit(f)
-        # sklearn: min (1/C)·(‖w‖₁) + Σ logloss on pre-standardized features
+        # sklearn: min (1/C)·(‖w‖₁) + Σ logloss on pre-standardized
+        # features. penalty="elasticnet" is required for l1_ratio to
+        # apply at all — without it modern sklearn warns and silently
+        # fits L2, turning this into a parity test against the wrong
+        # objective.
         sx = X.std(axis=0, ddof=1)
-        ref = sk.LogisticRegression(C=1.0 / (len(y) * lam), l1_ratio=1.0,
+        ref = sk.LogisticRegression(C=1.0 / (len(y) * lam),
+                                    penalty="elasticnet", l1_ratio=1.0,
                                     solver="saga", tol=1e-12,
                                     max_iter=50000)
         ref.fit(X / sx, y)

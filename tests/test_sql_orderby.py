@@ -220,3 +220,49 @@ class TestOrderByAggregates:
         d = out.to_pydict()
         assert d["n"].tolist() == [2, 1, 1]
         assert d["g"].tolist() == [1.0, 2.0, 3.0]
+
+
+class TestOrderByAliasWithExpressionKeys:
+    """Regression (ADVICE.md #1): mixing a SELECT alias with an expression
+    or dropped-column key forces the post-projection sort, which used to
+    drop the materialized __ord_N temps (and any dropped source column the
+    sort still needed) in the projection and crash. The temps now survive
+    the projection and drop after the sort."""
+
+    @pytest.fixture
+    def two_col(self, session):
+        f = Frame({"a": [3.0, 1.0, 2.0, 4.0], "b": [0.0, 1.0, 0.0, 1.0]})
+        f.create_or_replace_temp_view("oax")
+        return f
+
+    def test_alias_plus_expression_key(self, session, two_col):
+        out = session.sql("SELECT a + b AS x FROM oax ORDER BY x, a % 2")
+        assert out.columns == ["x"]
+        assert out.to_pydict()["x"].tolist() == [2.0, 2.0, 3.0, 5.0]
+
+    def test_alias_plus_expression_key_breaks_ties(self, session, two_col):
+        # a%2 orders the x-ties: a=2 (even, 0) before a=1 (odd, 1)
+        out = session.sql(
+            "SELECT a + b AS x, a FROM oax ORDER BY x, a % 2")
+        d = out.to_pydict()
+        assert d["x"].tolist() == [2.0, 2.0, 3.0, 5.0]
+        assert d["a"].tolist() == [2.0, 1.0, 3.0, 4.0]
+        assert out.columns == ["x", "a"]   # no __ord leak
+
+    def test_alias_nulls_last_plus_dropped_column(self, session):
+        import numpy as np
+
+        Frame({"a": [np.nan, 2.0, 1.0, 2.0],
+               "b": [9.0, 4.0, 7.0, 3.0]}) \
+            .create_or_replace_temp_view("oan")
+        out = session.sql(
+            "SELECT a AS x FROM oan ORDER BY x NULLS LAST, b")
+        vals = out.to_pydict()["x"]
+        assert vals[:3].tolist() == [1.0, 2.0, 2.0]
+        assert np.isnan(vals[3])
+        assert out.columns == ["x"]        # b kept for the sort, then dropped
+        session.catalog.drop("oan")
+
+    def test_distinct_with_hidden_key_raises_clearly(self, session, two_col):
+        with pytest.raises(ValueError, match="DISTINCT"):
+            session.sql("SELECT DISTINCT a AS x FROM oax ORDER BY x, b")

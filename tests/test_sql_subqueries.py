@@ -361,3 +361,48 @@ class TestExists:
         out = session.sql(
             "SELECT exists(xs, x -> x > 4) AS e FROM hx")
         assert [bool(v) for v in out.to_pydict()["e"]] == [True, False]
+
+
+class TestNotInNullSemantics:
+    """SQL three-valued logic (ADVICE.md #2): a NULL in the IN/NOT IN
+    value set — literal or materialized from an uncorrelated subquery —
+    makes NOT IN unable to return TRUE (``x <> NULL`` is unknown), so it
+    filters every row; plain IN drops the NULL from the list (matches
+    still pass, non-matches become unknown and filter)."""
+
+    @pytest.fixture
+    def null_views(self, session):
+        t = Frame({"k": [1.0, 2.0, 3.0]})
+        t.create_or_replace_temp_view("tvl_t")
+        s = Frame({"v": [2.0, np.nan]})
+        s.create_or_replace_temp_view("tvl_s")
+        yield t, s
+        session.catalog.drop("tvl_t")
+        session.catalog.drop("tvl_s")
+
+    def test_not_in_subquery_with_null_filters_all(self, session, null_views):
+        out = session.sql(
+            "SELECT k FROM tvl_t WHERE k NOT IN (SELECT v FROM tvl_s)")
+        assert out.count() == 0          # Spark: zero rows, not [1, 3]
+
+    def test_in_subquery_with_null_keeps_matches(self, session, null_views):
+        out = session.sql(
+            "SELECT k FROM tvl_t WHERE k IN (SELECT v FROM tvl_s)")
+        assert out.to_pydict()["k"].tolist() == [2.0]
+
+    def test_not_in_literal_list_with_null(self, session, null_views):
+        out = session.sql("SELECT k FROM tvl_t WHERE k NOT IN (2, NULL)")
+        assert out.count() == 0
+
+    def test_in_literal_list_with_null(self, session, null_views):
+        out = session.sql("SELECT k FROM tvl_t WHERE k IN (2, NULL)")
+        assert out.to_pydict()["k"].tolist() == [2.0]
+
+    def test_not_in_without_null_unchanged(self, session, null_views):
+        out = session.sql("SELECT k FROM tvl_t WHERE k NOT IN (2)")
+        assert out.to_pydict()["k"].tolist() == [1.0, 3.0]
+
+    def test_fluent_isin_matches(self, session, null_views):
+        t, _ = null_views
+        assert t.filter(t["k"].isin([2.0, float("nan")])) \
+            .to_pydict()["k"].tolist() == [2.0]
