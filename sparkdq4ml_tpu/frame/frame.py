@@ -35,6 +35,7 @@ import numpy as np
 from ..config import config, float_dtype, int_dtype
 from ..ops.expressions import Col, Expr, spark_type_name
 from ..utils.debug import ensure_backend
+from ..utils.observability import op_span
 
 ColumnLike = Union[Expr, jnp.ndarray, np.ndarray, Sequence]
 
@@ -291,6 +292,11 @@ class Frame:
         return _as_column(expr_or_values, self._n)
 
     # -- transformations (each returns a new Frame) ------------------------
+    # Observability: the op_span decorator is a no-op (one flag read) until
+    # spark.observability.enabled turns the tracer on; then each decorated
+    # op records a span with rows in/out (static shapes — never a device
+    # read, so the "no host syncs" hygiene of the fused paths holds).
+    @op_span("frame.with_column")
     def with_column(self, name: str, values: ColumnLike) -> "Frame":
         """``withColumn`` — add or replace a column from an expression/array."""
         data = dict(self._data)
@@ -380,6 +386,7 @@ class Frame:
 
     melt = unpivot
 
+    @op_span("frame.select")
     def select(self, *exprs: Union[str, Expr]) -> "Frame":
         from ..ops.expressions import Alias, Explode, JsonTuple
 
@@ -431,6 +438,7 @@ class Frame:
             tmp, g.name, keep_nulls=inner.outer,
             position_col="pos" if inner.with_position else None)
 
+    @op_span("frame.explode")
     def explode(self, column: str, output_col: str = None,
                 keep_nulls: bool = False,
                 position_col: str = None) -> "Frame":
@@ -522,6 +530,7 @@ class Frame:
         data = {k: v for k, v in self._data.items() if k not in names}
         return self._with(data=data)
 
+    @op_span("frame.filter")
     def filter(self, condition: Union[Expr, jnp.ndarray]) -> "Frame":
         """AND a predicate into the validity mask (static shapes preserved).
 
@@ -548,6 +557,7 @@ class Frame:
         keep = jnp.cumsum(self._mask.astype(jnp.int32)) > n
         return self._with(mask=jnp.logical_and(self._mask, keep))
 
+    @op_span("frame.union")
     def union(self, other: "Frame") -> "Frame":
         if self.columns != other.columns:
             raise ValueError("union requires identical column lists")
@@ -945,6 +955,7 @@ class Frame:
     def _host_mask(self) -> np.ndarray:
         return np.asarray(self._mask)
 
+    @op_span("frame.to_pydict", cat="action")
     def to_pydict(self, limit: Optional[int] = None) -> dict[str, np.ndarray]:
         """Materialize valid rows on host (the gather happens here, once, at
         the host boundary — never inside the compute path).
@@ -1166,6 +1177,7 @@ class Frame:
 
         return MultiGroupedFrame(self, list(keys), cube_levels(list(keys)))
 
+    @op_span("frame.agg")
     def agg(self, *aggs):
         """Global aggregates (no grouping): masked device reductions.
         Accepts AggExprs, bare fn names, or PySpark's dict form
@@ -1180,6 +1192,7 @@ class Frame:
         frame, agg_list = materialize_agg_exprs(self, agg_list)
         return global_agg(frame, agg_list)
 
+    @op_span("frame.sort")
     def sort(self, *cols, ascending=True) -> "Frame":
         """``orderBy`` — reorders valid rows (host argsort at the boundary),
         dropping masked slots (the result is compact). Columns may be
@@ -1251,6 +1264,7 @@ class Frame:
     sort_within_partitions = sort
     order_by = sort
 
+    @op_span("frame.distinct")
     def distinct(self) -> "Frame":
         """Unique valid rows (host boundary; result compact, order of first
         occurrence). Null-safe like Spark: null rows equal each other, so
@@ -1263,6 +1277,7 @@ class Frame:
                 out.append(r)
         return Frame.from_rows(out, self.columns)
 
+    @op_span("frame.drop_duplicates")
     def drop_duplicates(self, subset=None) -> "Frame":
         """Spark ``dropDuplicates``: with ``subset``, keep the FIRST valid
         row per distinct key combination (all columns retained); without,
@@ -1308,6 +1323,7 @@ class Frame:
 
     dropDuplicates = drop_duplicates
 
+    @op_span("frame.join")
     def join(self, other: "Frame", on, how: str = "inner") -> "Frame":
         """Relational join on key column(s) present in both frames.
 

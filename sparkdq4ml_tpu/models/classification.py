@@ -906,12 +906,30 @@ class LogisticRegression(Estimator):
             sm_solver = "newton" if (l1_free
                                      and K * (X.shape[1] + 1) <= 256) \
                 else "fista"
-            fit_fn = fused_softmax_fit_packed(mesh, K, self.max_iter,
-                                              self.tol, self.fit_intercept,
-                                              self.standardization,
-                                              weighted=weighted,
-                                              solver=sm_solver)
-            result = unpack_softmax_result(fit_fn(Zd, hyper), K, X.shape[1])
+            from ..utils import observability as _obs
+            from ..utils.profiling import counters as _counters
+
+            with _obs.fit_span("fit.logistic_regression",
+                               fused_softmax_fit_packed,
+                               family="multinomial", classes=K,
+                               rows=int(X.shape[0]),
+                               features=int(X.shape[1]),
+                               solver=sm_solver, max_iter=self.max_iter,
+                               shards=(mesh.devices.size if mesh is not None
+                                       else 1)) as s:
+                fit_fn = fused_softmax_fit_packed(mesh, K, self.max_iter,
+                                                  self.tol,
+                                                  self.fit_intercept,
+                                                  self.standardization,
+                                                  weighted=weighted,
+                                                  solver=sm_solver)
+                result = unpack_softmax_result(fit_fn(Zd, hyper), K,
+                                               X.shape[1])
+                _counters.increment("solver.fits")
+                _counters.increment("solver.iterations",
+                                    int(result.iterations))
+                s.set(iterations=int(result.iterations),
+                      converged=bool(result.converged))
             W = np.asarray(result.coefficient_matrix, np.float64)
             b = np.asarray(result.intercept_vector, np.float64)
             # Identifiability pivot (MLlib convention): the softmax loss is
@@ -939,13 +957,27 @@ class LogisticRegression(Estimator):
         # per-iteration (d+1)^2 Hessian psum + host-free solve stays cheap.
         l1_free = (self.elastic_net_param == 0.0 or self.reg_param == 0.0)
         solver = "newton" if (l1_free and X.shape[1] <= 256) else "fista"
-        fit_fn = fused_logistic_fit_packed(mesh, self.max_iter, self.tol,
-                                           self.fit_intercept,
-                                           self.standardization,
-                                           weighted=weighted,
-                                           solver=solver)
-        result = LogisticFitResult(
-            *unpack_fit_result(fit_fn(Zd, hyper), X.shape[1]))
+        from ..utils import observability as _obs
+        from ..utils.profiling import counters as _counters
+
+        with _obs.fit_span("fit.logistic_regression",
+                           fused_logistic_fit_packed,
+                           family="binomial", classes=num_classes,
+                           rows=int(X.shape[0]), features=int(X.shape[1]),
+                           solver=solver, max_iter=self.max_iter,
+                           shards=(mesh.devices.size if mesh is not None
+                                   else 1)) as s:
+            fit_fn = fused_logistic_fit_packed(mesh, self.max_iter, self.tol,
+                                               self.fit_intercept,
+                                               self.standardization,
+                                               weighted=weighted,
+                                               solver=solver)
+            result = LogisticFitResult(
+                *unpack_fit_result(fit_fn(Zd, hyper), X.shape[1]))
+            _counters.increment("solver.fits")
+            _counters.increment("solver.iterations", int(result.iterations))
+            s.set(iterations=int(result.iterations),
+                  converged=bool(result.converged))
         model = LogisticRegressionModel(
             coefficients=np.asarray(result.coefficients),
             intercept=float(result.intercept),

@@ -197,7 +197,9 @@ class LinearRegression(Estimator):
         if mesh is not None and mesh.devices.size <= 1:
             mesh = None  # unify the single-device cache key
         from ..utils import faults as _faults
+        from ..utils import observability as _obs
         from ..utils import recovery as _recovery
+        from ..utils.profiling import counters
         from .solvers import downgrade_solver
 
         Z = pack_design(X, y, mask)
@@ -230,11 +232,31 @@ class LinearRegression(Estimator):
         if downgraded is not None:
             fallbacks.append((f"solver_{downgraded}",
                               make_call(None, downgraded)))
-        result = _recovery.resilient_call(
-            make_call(mesh, solver_name), site="fit_packed",
-            policy=_recovery.active_policy("fit_packed"),
-            validate=_recovery.result_validator(),
-            fallbacks=fallbacks, breaker=_recovery.DEVICE_BREAKER)
+        # Observability: the fit span records the cold-compile vs steady
+        # split (trace-cache probe on the lru-cached jit factory), the
+        # solver trajectory (iterations/objective — read from the packed
+        # result, which unpack_fit_result already materialized on host, so
+        # no added sync), and any retry/fallback the resilience layer took.
+        with _obs.fit_span("fit.linear_regression", fused_linear_fit_packed,
+                           rows=int(X.shape[0]), features=d,
+                           solver=solver_name,
+                           shards=(mesh.devices.size if mesh is not None
+                                   else 1),
+                           max_iter=self.max_iter) as s:
+            with _obs.span("fit.solve", cat="solver", solver=solver_name):
+                result = _recovery.resilient_call(
+                    make_call(mesh, solver_name), site="fit_packed",
+                    policy=_recovery.active_policy("fit_packed"),
+                    validate=_recovery.result_validator(),
+                    fallbacks=fallbacks, breaker=_recovery.DEVICE_BREAKER)
+            iters = int(result.iterations)
+            counters.increment("solver.fits")
+            counters.increment("solver.iterations", iters)
+            if s is not _obs._NOOP:
+                hist = np.asarray(result.objective_history, np.float64)
+                s.set(iterations=iters, converged=bool(result.converged),
+                      objective_final=float(
+                          hist[min(iters, hist.shape[0] - 1)]))
         model = LinearRegressionModel(
             coefficients=np.asarray(result.coefficients),
             intercept=float(result.intercept),

@@ -277,25 +277,30 @@ def solve(A: jnp.ndarray, reg_param: float, elastic_net_param: float,
     — both exercised by the resilience suite. No-ops without a plan.
     """
     from ..utils import faults as _faults
+    from ..utils import observability as _obs
+    from ..utils.profiling import counters
 
     _faults.inject("solver")
     name = resolve_solver(solver, reg_param, elastic_net_param)
-    if name == "normal":
-        result = normal_solve(A, reg_param, elastic_net_param,
-                              fit_intercept=fit_intercept,
-                              standardization=standardization)
-    elif name == "fista":
-        result = fista_solve(A, reg_param, elastic_net_param,
-                             max_iter=max_iter, tol=tol,
-                             fit_intercept=fit_intercept,
-                             standardization=standardization)
-    else:
-        from .owlqn import owlqn_solve
+    counters.increment(f"solver.{name}_calls")
+    with _obs.span("solver.solve", cat="solver", solver=name,
+                   features=int(A.shape[0]) - 2, max_iter=max_iter):
+        if name == "normal":
+            result = normal_solve(A, reg_param, elastic_net_param,
+                                  fit_intercept=fit_intercept,
+                                  standardization=standardization)
+        elif name == "fista":
+            result = fista_solve(A, reg_param, elastic_net_param,
+                                 max_iter=max_iter, tol=tol,
+                                 fit_intercept=fit_intercept,
+                                 standardization=standardization)
+        else:
+            from .owlqn import owlqn_solve
 
-        result = owlqn_solve(A, reg_param, elastic_net_param,
-                             max_iter=max_iter, tol=tol,
-                             fit_intercept=fit_intercept,
-                             standardization=standardization)
+            result = owlqn_solve(A, reg_param, elastic_net_param,
+                                 max_iter=max_iter, tol=tol,
+                                 fit_intercept=fit_intercept,
+                                 standardization=standardization)
     return _faults.corrupt("solver", result)
 
 

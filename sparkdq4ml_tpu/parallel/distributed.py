@@ -274,7 +274,9 @@ def compute_gram(X, y, mask, mesh: Optional[Mesh] = None):
         return _gram_single(jnp.asarray(X), jnp.asarray(y),
                             jnp.asarray(mask, jnp.bool_))
     from ..utils import faults as _faults
+    from ..utils import observability as _obs
     from ..utils import recovery as _recovery
+    from ..utils.profiling import counters
 
     nshards = mesh.devices.size
     Xh = np.asarray(X)
@@ -285,10 +287,22 @@ def compute_gram(X, y, mask, mesh: Optional[Mesh] = None):
 
     def sharded():
         _faults.inject("gram_sharded")
-        Xd = jax.device_put(Xp, shard)
-        yd = jax.device_put(yp, shard)
-        md = jax.device_put(mp, shard)
-        return _gram_sharded_fn(mesh)(Xd, yd, md)
+        counters.increment("parallel.psum_dispatches")
+        # Per-shard Gramian timing: with tracing ON the span blocks on the
+        # result so the duration covers the actual collective, not just
+        # the async enqueue — an enabled-mode-only sync, per the
+        # observability cost contract (disabled mode adds no host work).
+        with _obs.span("parallel.gram_shard", cat="parallel",
+                       shards=nshards, rows=int(Xp.shape[0]),
+                       rows_per_shard=int(Xp.shape[0]) // nshards,
+                       device=mesh.devices.flat[0].platform) as s:
+            Xd = jax.device_put(Xp, shard)
+            yd = jax.device_put(yp, shard)
+            md = jax.device_put(mp, shard)
+            A = _gram_sharded_fn(mesh)(Xd, yd, md)
+            if s is not _obs._NOOP:
+                jax.block_until_ready(A)
+            return A
 
     def single_cpu():
         logger.warning(
@@ -296,8 +310,13 @@ def compute_gram(X, y, mask, mesh: Optional[Mesh] = None):
             "single-device CPU path", nshards)
         return _gram_single_cpu(Xh, yh, mh)
 
-    return _recovery.resilient_call(
-        sharded, site="gram_sharded",
-        policy=_recovery.active_policy("gram_sharded"),
-        fallbacks=[("single_cpu", single_cpu)],
-        breaker=_recovery.DEVICE_BREAKER)
+    mark = _obs.recovery_mark()
+    with _obs.span("parallel.gram", cat="parallel", shards=nshards,
+                   rows=int(Xh.shape[0]), features=int(Xh.shape[1])) as s:
+        A = _recovery.resilient_call(
+            sharded, site="gram_sharded",
+            policy=_recovery.active_policy("gram_sharded"),
+            fallbacks=[("single_cpu", single_cpu)],
+            breaker=_recovery.DEVICE_BREAKER)
+        _obs.annotate_recovery(s, mark)
+        return A

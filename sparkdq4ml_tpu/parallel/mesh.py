@@ -44,6 +44,12 @@ def shard_map(f, mesh, in_specs, out_specs, check_vma: bool = True):
     check is a static lint, not a semantics change; the modern
     ``check_vma`` checker (which does infer through loops) still honors
     the caller's flag."""
+    from ..utils.profiling import counters
+
+    # One sharded-program BUILD (trace-time, not per-dispatch): the
+    # collective-shape signal the observability layer surfaces as
+    # ``parallel.shard_map_builds``.
+    counters.increment("parallel.shard_map_builds")
     if hasattr(jax, "shard_map"):
         try:
             return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
@@ -84,6 +90,9 @@ def make_mesh(num_devices: Optional[int] = None,
             raise ValueError(
                 f"requested {num_devices} devices, only {len(devices)} present")
         devices = devices[:num_devices]
+    from ..utils.observability import METRICS
+
+    METRICS.set_gauge("mesh.devices", len(devices))
     return Mesh(np.asarray(devices), (axis_name,))
 
 

@@ -152,8 +152,81 @@ class TpuSession:
         if register_rules:
             register_builtin_rules(self.udf)
         self._init_compilation_cache()
+        self._init_observability()
         logger.debug("session %r: %d device(s), platform=%s", app_name,
                      self.num_devices, jax.devices()[0].platform)
+
+    def _init_observability(self) -> None:
+        """Install the tracing/metrics subsystem (``utils.observability``)
+        from session conf or environment — off by default (the hot fused
+        paths keep their zero-host-sync contract):
+
+            .config("spark.observability.enabled", "true")
+            .config("spark.observability.maxSpans", 50000)
+            .config("spark.observability.logSpans", "true")   # logfmt lines
+
+        or ``SPARKDQ4ML_OBS=1`` in the environment. When enabled, a root
+        ``session`` span is opened (ended by ``stop()``); everything the
+        session touches — SQL queries, frame ops, fits, solver blocks,
+        sharded Gramians — nests under it. Read back via
+        :meth:`metrics`, :meth:`trace_report`, :meth:`dump_trace`."""
+        from .utils import observability as _obs
+
+        conf_val = str(self.conf.get("spark.observability.enabled",
+                                     "")).lower()
+        # same truthiness vocabulary as the conf key — "SPARKDQ4ML_OBS=off"
+        # must not ENABLE tracing
+        env_on = os.environ.get(_obs.ENV_VAR, "").strip().lower() not in (
+            "", "0", "false", "off", "no")
+        if conf_val in ("true", "on", "1") or (conf_val == "" and env_on):
+            _obs.enable(
+                max_spans=int(self.conf.get("spark.observability.maxSpans",
+                                            10_000)),
+                log_spans=str(self.conf.get("spark.observability.logSpans",
+                                            "")).lower() in ("true", "on",
+                                                             "1"))
+            self._obs_enabled_here = True
+            if getattr(self, "_session_span", None) is None:
+                self._session_span = _obs.TRACER.begin(
+                    "session", cat="session", app=self.app_name,
+                    devices=self.num_devices,
+                    platform=jax.devices()[0].platform)
+        elif conf_val in ("false", "off", "0"):
+            # explicit opt-out wins over a programmatic/env enable — the
+            # same session-scoped-override rule as spark.compilation.cache
+            _obs.disable()
+
+    # -- observability surface ---------------------------------------------
+    def metrics(self) -> dict:
+        """One merged metrics snapshot: every monotonic counter (solver
+        fits/iterations, jit trace hits/misses, ``recovery.*`` from the
+        resilience layer, collective dispatch counts), every gauge
+        (``mesh.devices``), and every latency histogram
+        (``span_ms.<category>``) — flat by name."""
+        from .utils import observability as _obs
+
+        return _obs.metrics_snapshot()
+
+    def metrics_text(self) -> str:
+        """Prometheus text-format rendering of :meth:`metrics` (counters,
+        gauges, and cumulative-bucket histograms), scrape-ready."""
+        from .utils import observability as _obs
+
+        return _obs.prometheus_text()
+
+    def trace_report(self) -> str:
+        """Human-readable span tree of everything traced so far (empty
+        string when observability was never enabled)."""
+        from .utils import observability as _obs
+
+        return _obs.trace_report()
+
+    def dump_trace(self, path: str) -> str:
+        """Write the Chrome trace-event JSON (Perfetto /
+        ``chrome://tracing`` loadable) to ``path``; returns the path."""
+        from .utils import observability as _obs
+
+        return _obs.dump_chrome_trace(path)
 
     def _init_faults(self) -> None:
         """Install the fault-injection plan (``utils.faults``) from session
@@ -405,6 +478,9 @@ class TpuSession:
                     _ACTIVE._init_compilation_cache()
                 if any(k.startswith("spark.faults") for k in self._conf):
                     _ACTIVE._init_faults()   # late chaos conf still installs
+                if any(k.startswith("spark.observability.")
+                       for k in self._conf):
+                    _ACTIVE._init_observability()
             return _ACTIVE
 
         getOrCreate = get_or_create
@@ -502,6 +578,21 @@ class TpuSession:
         if _ACTIVE is self:
             _ACTIVE = None
         self.catalog.clear()
+        # Close the root session span and stop recording if THIS session
+        # turned tracing on (same session-scoped rule as the fault plan).
+        # Already-recorded spans stay exportable: dump_trace/trace_report
+        # after stop() still work (post-mortem analysis is the point).
+        span = getattr(self, "_session_span", None)
+        if span is not None:
+            from .utils import observability as _obs
+
+            _obs.TRACER.end(span)
+            self._session_span = None
+        if getattr(self, "_obs_enabled_here", False):
+            from .utils import observability as _obs
+
+            _obs.disable()
+            self._obs_enabled_here = False
         # Uninstall the fault plan THIS session installed (conf/env):
         # chaos is session-scoped opt-in; a later chaos-free session (or
         # plain library use) must not keep injecting this one's faults.

@@ -65,6 +65,7 @@ import re
 from typing import Optional
 
 from ..ops import expressions as E
+from ..utils import observability as _obs
 
 _TOKEN_RE = re.compile(
     r"\s*(?:"
@@ -1396,6 +1397,41 @@ _DROP_RE = re.compile(
     r"([A-Za-z_][A-Za-z_0-9]*)\s*$", re.IGNORECASE)
 
 
+def plan_summary(q: Query) -> str:
+    """``explain()``-style one-line plan for a parsed query — the operator
+    chain root-first (the shape Spark's ``explain`` prints), attached to
+    every ``sql.query`` span so traces show WHAT a query did, not just its
+    text."""
+    parts: list[str] = []
+    if q.limit is not None:
+        parts.append(f"Limit[{q.limit}]")
+    if q.offset:
+        parts.append(f"Offset[{q.offset}]")
+    if q.order_by:
+        parts.append(f"Sort[{len(q.order_by)}]")
+    if q.distinct:
+        parts.append("Distinct")
+    if q.having is not None:
+        parts.append("Having")
+    if q.group_by:
+        mode = q.group_mode if q.group_mode != "group" else "groupBy"
+        parts.append(f"Aggregate[{mode}:{len(q.group_by)}]")
+    parts.append(f"Project[{len(q.items)}]")
+    if q.where is not None:
+        parts.append("Filter")
+    for j in q.joins:
+        how = j[1] if len(j) > 1 and isinstance(j[1], str) else "inner"
+        parts.append(f"Join[{how}]")
+    src = q.view if isinstance(q.view, str) else "(subquery)"
+    parts.append(f"Scan[{src}]")
+    s = " <- ".join(parts)
+    if q.unions:
+        s += f" (+{len(q.unions)} set-op)"
+    if q.ctes:
+        s = f"With[{len(q.ctes)}] " + s
+    return s
+
+
 def execute(sql: str, catalog=None):
     """Run a statement (WITH CTEs + query + UNIONs) against the catalog.
 
@@ -1405,13 +1441,30 @@ def execute(sql: str, catalog=None):
     views over device-resident Frames) and ``DROP [TEMP] VIEW
     [IF EXISTS] name``. Both return an empty no-column Frame like
     Spark's DDL commands.
+
+    When observability is enabled, each statement runs inside an
+    ``sql.query`` span carrying the query text, the plan summary
+    (:func:`plan_summary`), and the output row count.
     """
+    if not _obs.TRACER.enabled:
+        return _execute_statement(sql, catalog)
+    with _obs.TRACER.span("sql.query", cat="sql",
+                          query=" ".join(sql.split())[:300]) as s:
+        out = _execute_statement(sql, catalog)
+        n = getattr(out, "_n", None)
+        if n is not None:
+            s.set(rows_out=n)
+        return out
+
+
+def _execute_statement(sql: str, catalog=None):
     from .catalog import default_catalog
 
     cat = catalog if catalog is not None else default_catalog()
     m = _DDL_RE.match(sql)
     if m:
         name, body = m.group(1), m.group(2)
+        _obs.current_span().set(plan=f"CreateView[{name}]")
         frame = execute(body, cat)
         cat.register(name, frame)
         from ..frame.frame import Frame
@@ -1420,6 +1473,7 @@ def execute(sql: str, catalog=None):
     m = _DROP_RE.match(sql)
     if m:
         if_exists, name = bool(m.group(1)), m.group(2)
+        _obs.current_span().set(plan=f"DropView[{name}]")
         existed = cat.drop(name)
         if not existed and not if_exists:
             raise KeyError(f"temp view {name!r} not found")
@@ -1427,6 +1481,7 @@ def execute(sql: str, catalog=None):
 
         return Frame({"__one_row__": [0.0]}).drop("__one_row__").limit(0)
     q = parse(sql)
+    _obs.current_span().set(plan=plan_summary(q))
     if q.ctes:
         cat = _OverlayCatalog(cat)
         for name, sub in q.ctes:

@@ -1,5 +1,6 @@
-from . import faults
+from . import faults, observability
 from .logging import configure_logging, format_kv
+from .observability import METRICS, TRACER, metrics_snapshot, prometheus_text
 from .profiling import PhaseTimer, block_until_ready, counters, timed, trace
 from .recovery import (RECOVERY_LOG, CircuitBreaker, CircuitOpenError,
                        DeadlineExceeded, FitFailure, RecoveryEvent,

@@ -17,26 +17,48 @@ _DATEFMT = "%Y-%m-%d %H:%M:%S"
 
 def configure_logging(framework_level: int = logging.DEBUG,
                       root_level: int = logging.INFO,
-                      stream=None) -> None:
+                      stream=None, force: bool = False) -> None:
+    """Install the framework's log4j-style tiering.
+
+    ``force=False`` (default) APPENDS our handler when the root logger
+    already has handlers — replacing them would clobber pytest's caplog
+    and any host application's logging setup (a library must not own the
+    root). ``force=True`` restores the old destructive behavior: all root
+    handlers are replaced, for standalone scripts that want exactly one
+    console handler."""
     handler = logging.StreamHandler(stream or sys.stderr)
     handler.setFormatter(logging.Formatter(_FORMAT, _DATEFMT))
-    root = logging.getLogger()
-    root.handlers = [handler]
+    handler._sparkdq4ml = True       # idempotency tag (see below)
+    root = logging.getLogger()       # logger-ns: ok (configures the root)
+    if force or not root.handlers:
+        root.handlers = [handler]
+    else:
+        # Replace only OUR previously installed handler(s) — repeated
+        # configure_logging() calls must not stack duplicates — and leave
+        # foreign handlers (pytest's caplog, the host app's) untouched.
+        root.handlers = [h for h in root.handlers
+                         if not getattr(h, "_sparkdq4ml", False)]
+        root.addHandler(handler)
     root.setLevel(root_level)
     logging.getLogger("sparkdq4ml_tpu").setLevel(framework_level)
     for noisy in ("jax", "jax._src", "absl"):
-        logging.getLogger(noisy).setLevel(logging.WARNING)
+        logging.getLogger(noisy).setLevel(logging.WARNING)  # logger-ns: ok
 
 
 def format_kv(**fields) -> str:
     """Structured ``key=value`` event line (logfmt convention) — the
     single render used for recovery-telemetry events
-    (``utils.recovery.RecoveryEvent``), so log scrapers see one stable
-    shape. Empty/zero-ish values are elided; values with spaces are
+    (``utils.recovery.RecoveryEvent``) and span lines
+    (``utils.observability``), so log scrapers see one stable shape.
+
+    Only ``None`` and the empty string are elided: ``retries=0`` and
+    ``duration_ms=0.0`` are MEANINGFUL measurements (a clean run, an
+    instant op) and dropping them would give scrapers an unstable schema
+    — the old zero-ish elision did exactly that. Values with spaces are
     quoted."""
     parts = []
     for k, v in fields.items():
-        if v is None or v == "" or v == 0 or v == 0.0:
+        if v is None or (isinstance(v, str) and v == ""):
             continue
         s = str(v)
         if " " in s or "=" in s:

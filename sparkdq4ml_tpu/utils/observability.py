@@ -1,0 +1,646 @@
+"""Structured tracing + metrics — the observability subsystem.
+
+The reference's only observability is stdout banners and a post-hoc
+``objectiveHistory`` print (SURVEY.md §5); this module is the production
+replacement: a span-based tracer with hierarchical, contextvar-propagated
+spans (session → sql query → frame op → fit → solver iteration block) and a
+metrics registry that extends :data:`utils.profiling.counters` (monotonic
+counters) with gauges and fixed-bucket latency histograms.
+
+Exporters (all host-side, on demand — never on the hot path):
+
+* :func:`chrome_trace` / :func:`dump_chrome_trace` — Chrome trace-event JSON
+  loadable in Perfetto / ``chrome://tracing``,
+* logfmt event lines through :func:`utils.logging.format_kv` (one DEBUG line
+  per finished span when ``log_spans`` is on),
+* :func:`prometheus_text` — a Prometheus text-format snapshot of every
+  counter, gauge, and histogram in one scrape,
+* :func:`trace_report` — a human-readable span tree.
+
+Cost contract: **disabled mode is a near-zero no-op** — every instrumented
+site guards on one ``TRACER.enabled`` flag read and allocates nothing (the
+shared :data:`_NOOP` context manager is returned, no Span object exists),
+so the fused device paths keep their "no host reads" hygiene. Enabling
+observability MAY add host syncs (honest span timing blocks on the traced
+dispatch where noted); that is the explicit price of turning it on.
+
+Wired through the framework:
+
+* ``frame/frame.py`` — op spans (:func:`op_span` decorator; rows in/out),
+* ``sql/parser.py`` — per-query span with the query text and an
+  ``explain()``-style plan summary,
+* ``models/solvers.py`` / ``regression.py`` / ``classification.py`` — fit
+  spans with cold-compile vs steady split (jit trace-cache hit/miss),
+  iteration counts, final objective, retry/fallback annotations pulled from
+  ``utils.recovery.RECOVERY_LOG``,
+* ``parallel/distributed.py`` / ``mesh.py`` — per-shard Gramian timing,
+  collective/shard_map build counters, mesh-size gauge,
+* ``session.py`` — ``spark.observability.*`` conf + ``SPARKDQ4ML_OBS`` env
+  gating, ``session.metrics()`` / ``trace_report()`` / ``dump_trace(path)``.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import functools
+import json
+import logging
+import os
+import threading
+import time
+from typing import Callable, Optional
+
+from . import profiling
+from .logging import format_kv
+
+logger = logging.getLogger("sparkdq4ml_tpu.observability")
+
+ENV_VAR = "SPARKDQ4ML_OBS"
+
+# ---------------------------------------------------------------------------
+# Metrics: gauges + fixed-bucket histograms (counters live in
+# utils.profiling.counters so the recovery mirror keeps one home)
+# ---------------------------------------------------------------------------
+
+#: Default latency buckets (milliseconds) — fixed at creation so scrapes see
+#: a stable schema; spans record their duration into ``span_ms.<category>``.
+DEFAULT_BUCKETS_MS = (0.1, 0.5, 1.0, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0,
+                      500.0, 1000.0, 2500.0, 5000.0, 10000.0)
+
+
+class Histogram:
+    """Fixed-bucket histogram (Prometheus convention: cumulative bucket
+    counts keyed by upper bound ``le``, plus ``sum`` and ``count``).
+    Thread-safe; buckets are fixed at construction."""
+
+    def __init__(self, name: str, buckets=DEFAULT_BUCKETS_MS):
+        self.name = name
+        self.buckets = tuple(sorted(float(b) for b in buckets))
+        self._counts = [0] * (len(self.buckets) + 1)  # last = +Inf overflow
+        self._sum = 0.0
+        self._count = 0
+        self._lock = threading.Lock()
+
+    def observe(self, value: float) -> None:
+        v = float(value)
+        i = 0
+        for i, b in enumerate(self.buckets):  # ≤ ~14 buckets: linear is fine
+            if v <= b:
+                break
+        else:
+            i = len(self.buckets)
+        with self._lock:
+            self._counts[i] += 1
+            self._sum += v
+            self._count += 1
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            counts = list(self._counts)
+            total, s = self._count, self._sum
+        cumulative, acc = {}, 0
+        for b, c in zip(self.buckets, counts):
+            acc += c
+            cumulative[b] = acc
+        cumulative[float("inf")] = total
+        return {"buckets": cumulative, "sum": s, "count": total}
+
+
+class MetricsRegistry:
+    """Gauges + histograms, by name. Counters intentionally stay in
+    :data:`utils.profiling.counters` (one monotonic registry, one recovery
+    mirror); :func:`metrics_snapshot` merges all three views."""
+
+    def __init__(self):
+        self._gauges: dict[str, float] = {}
+        self._histograms: dict[str, Histogram] = {}
+        self._lock = threading.Lock()
+
+    def set_gauge(self, name: str, value: float) -> None:
+        with self._lock:
+            self._gauges[name] = float(value)
+
+    def get_gauge(self, name: str, default: float = 0.0) -> float:
+        with self._lock:
+            return self._gauges.get(name, default)
+
+    def histogram(self, name: str, buckets=None) -> Histogram:
+        with self._lock:
+            h = self._histograms.get(name)
+            if h is None:
+                h = Histogram(name, buckets or DEFAULT_BUCKETS_MS)
+                self._histograms[name] = h
+            return h
+
+    def observe(self, name: str, value: float, buckets=None) -> None:
+        self.histogram(name, buckets).observe(value)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            gauges = dict(self._gauges)
+            hists = dict(self._histograms)
+        out: dict = dict(gauges)
+        for name, h in hists.items():
+            out[name] = h.snapshot()
+        return out
+
+    def clear(self) -> None:
+        with self._lock:
+            self._gauges.clear()
+            self._histograms.clear()
+
+
+#: Process-global metrics registry (gauges + histograms).
+METRICS = MetricsRegistry()
+
+
+def metrics_snapshot() -> dict:
+    """One merged registry view: every monotonic counter (including the
+    ``recovery.*`` mirror from PR 1), every gauge, and every histogram
+    summary, flat by name."""
+    out: dict = dict(profiling.counters.snapshot())
+    out.update(METRICS.snapshot())
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Tracer: hierarchical spans, contextvar-propagated
+# ---------------------------------------------------------------------------
+
+
+class _NoopSpan:
+    """Shared disabled-mode stand-in: reentrant, stateless, allocation-free.
+    Every method is a no-op returning self so instrumented sites never
+    branch on the enabled flag twice."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def set(self, **attrs):
+        return self
+
+
+_NOOP = _NoopSpan()
+
+_CURRENT: contextvars.ContextVar[Optional["Span"]] = contextvars.ContextVar(
+    "sparkdq4ml_obs_current_span", default=None)
+
+
+class Span:
+    """One traced operation. Use as a context manager (normal case) or via
+    ``Tracer.begin``/``Tracer.end`` for long-lived spans (the session root).
+    ``set(**attrs)`` attaches structured attributes at any point."""
+
+    __slots__ = ("name", "cat", "attrs", "sid", "parent_id", "tid",
+                 "ts_us", "dur_us", "_t0", "_token", "_tracer")
+
+    def __init__(self, tracer: "Tracer", name: str, cat: str, attrs: dict):
+        self._tracer = tracer
+        self.name = name
+        self.cat = cat
+        self.attrs = attrs
+        self.sid = tracer._next_id()
+        parent = _CURRENT.get()
+        if parent is None:
+            # Ambient fallback: a long-lived root opened with ``begin``
+            # (the session span) parents spans whose context lost the
+            # link — worker threads (fresh contexts) and callers whose
+            # enclosing ``with span`` exited after ``begin`` ran inside
+            # it (the contextvar reset would otherwise orphan everything
+            # that follows). Lock-free read: end()/clear() may empty the
+            # list between the check and the index, so tolerate that
+            # instead of crashing the instrumented user operation.
+            try:
+                parent = tracer._ambient[-1]
+            except IndexError:
+                parent = None
+        self.parent_id = parent.sid if parent is not None else None
+        self.tid = threading.get_ident()
+        self.ts_us = 0
+        self.dur_us: Optional[int] = None
+        self._t0 = 0.0
+        self._token: Optional[contextvars.Token] = None
+
+    def set(self, **attrs) -> "Span":
+        # Copy-on-write, never in-place: exporters snapshot ``self.attrs``
+        # by reference from other threads (open spans export live), and a
+        # concurrent in-place mutation would raise "dictionary changed
+        # size during iteration" mid-scrape. A reference swap is atomic.
+        self.attrs = {**self.attrs, **attrs}
+        return self
+
+    def __enter__(self) -> "Span":
+        self._token = _CURRENT.set(self)
+        self.ts_us = self._tracer._now_us()
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, et, ev, tb) -> bool:
+        self.dur_us = int((time.perf_counter() - self._t0) * 1e6)
+        if et is not None:
+            self.attrs = {**self.attrs, "error": et.__name__}
+        if self._token is not None:
+            try:
+                _CURRENT.reset(self._token)
+            except ValueError:   # crossed contexts (begin/end style misuse)
+                _CURRENT.set(None)
+            self._token = None
+        self._tracer._finish(self)
+        return False
+
+
+class Tracer:
+    """Span recorder. ``enabled`` is THE hot-path gate: every instrumented
+    site reads it once and returns :data:`_NOOP` when off. Finished spans
+    land in a bounded buffer (oldest dropped) and their durations feed the
+    ``span_ms.<category>`` histograms."""
+
+    def __init__(self, max_spans: int = 10_000):
+        self.enabled = False
+        self.log_spans = False
+        self.max_spans = max_spans
+        self._spans: list[Span] = []
+        self._open: dict[int, Span] = {}
+        self._ambient: list[Span] = []   # begun roots (see Span.__init__)
+        self._lock = threading.Lock()
+        self._id = 0
+        self._epoch_s = time.time()
+        self._pc0 = time.perf_counter()
+
+    # -- internals --------------------------------------------------------
+    def _next_id(self) -> int:
+        with self._lock:
+            self._id += 1
+            return self._id
+
+    def _now_us(self) -> int:
+        return int((self._epoch_s
+                    + (time.perf_counter() - self._pc0)) * 1e6)
+
+    def _finish(self, s: Span) -> None:
+        with self._lock:
+            self._open.pop(s.sid, None)
+            self._spans.append(s)
+            if len(self._spans) > self.max_spans:
+                del self._spans[: len(self._spans) - self.max_spans]
+        METRICS.observe(f"span_ms.{s.cat or 'other'}",
+                        (s.dur_us or 0) / 1e3)
+        if self.log_spans:
+            logger.debug(
+                "span %s",
+                format_kv(name=s.name, cat=s.cat,
+                          dur_ms=round((s.dur_us or 0) / 1e3, 3),
+                          span_id=s.sid, parent_id=s.parent_id, **s.attrs))
+
+    # -- recording --------------------------------------------------------
+    def span(self, name: str, cat: str = "", **attrs):
+        """Context manager for one traced operation. Returns the shared
+        no-op when disabled — one flag check, zero allocation."""
+        if not self.enabled:
+            return _NOOP
+        return Span(self, name, cat, attrs)
+
+    def begin(self, name: str, cat: str = "", **attrs):
+        """Open a long-lived span (e.g. the session root) that outlives the
+        calling frame. Pair with :meth:`end`. Child spans nest under it via
+        the context AND the ambient-root fallback (so spans from worker
+        threads or sibling contexts still parent correctly)."""
+        if not self.enabled:
+            return _NOOP
+        s = Span(self, name, cat, attrs)
+        s.ts_us = self._now_us()
+        s._t0 = time.perf_counter()
+        _CURRENT.set(s)
+        with self._lock:
+            self._open[s.sid] = s
+            self._ambient.append(s)
+        return s
+
+    def end(self, s) -> None:
+        if s is None or s is _NOOP:
+            return
+        s.dur_us = int((time.perf_counter() - s._t0) * 1e6)
+        if _CURRENT.get() is s:
+            _CURRENT.set(None)
+        with self._lock:
+            if s in self._ambient:
+                self._ambient.remove(s)
+        self._finish(s)
+
+    # -- views ------------------------------------------------------------
+    def spans(self) -> list:
+        """Finished + still-open spans (open ones report duration so far)."""
+        with self._lock:
+            done = list(self._spans)
+            open_ = list(self._open.values())
+        return done + open_
+
+    def clear(self) -> None:
+        with self._lock:
+            self._spans.clear()
+            self._open.clear()
+            self._ambient.clear()
+
+
+#: Process-global tracer. Disabled by default; ``session`` conf/env turn it
+#: on (or call :func:`enable` directly).
+TRACER = Tracer()
+
+
+def enabled() -> bool:
+    return TRACER.enabled
+
+
+def enable(max_spans: int = 10_000, log_spans: bool = False) -> None:
+    """Turn recording on (idempotent). Previously recorded spans are kept;
+    call ``TRACER.clear()`` / ``reset()`` for a fresh buffer."""
+    TRACER.max_spans = int(max_spans)
+    TRACER.log_spans = bool(log_spans)
+    TRACER.enabled = True
+    _install_jax_compile_listener()
+
+
+def disable() -> None:
+    """Stop recording. Already-recorded spans stay exportable."""
+    TRACER.enabled = False
+
+
+def reset() -> None:
+    """Clear spans, gauges, and histograms (counters have their own
+    ``profiling.counters.clear``)."""
+    TRACER.clear()
+    METRICS.clear()
+
+
+def span(name: str, cat: str = "", **attrs):
+    """Module-level convenience: ``with observability.span("x"): ...``."""
+    if not TRACER.enabled:
+        return _NOOP
+    return TRACER.span(name, cat, **attrs)
+
+
+def current_span():
+    """The innermost active span in this context (the :data:`_NOOP`
+    singleton when disabled or outside any span) — instrumented sites use
+    it to attach attributes computed mid-operation without re-plumbing the
+    span object."""
+    if not TRACER.enabled:
+        return _NOOP
+    s = _CURRENT.get()
+    return s if s is not None else _NOOP
+
+
+def op_span(name: str, cat: str = "frame"):
+    """Decorator for frame-op style methods: when tracing is enabled, wrap
+    the call in a span carrying rows in/out (``num_slots`` — static shape
+    info, never a device read). Disabled cost: one attribute read and a
+    branch."""
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(self, *args, **kwargs):
+            t = TRACER
+            if not t.enabled:
+                return fn(self, *args, **kwargs)
+            with Span(t, name, cat, {"rows_in": getattr(self, "_n", None)}) \
+                    as s:
+                out = fn(self, *args, **kwargs)
+                n = getattr(out, "_n", None)
+                if n is not None:
+                    s.set(rows_out=n)
+                return out
+        return wrapper
+    return deco
+
+
+# ---------------------------------------------------------------------------
+# Recovery + compile-cache annotations
+# ---------------------------------------------------------------------------
+
+
+def recovery_mark() -> int:
+    """Cursor into the structured recovery log; pair with
+    :func:`recovery_delta` to annotate a span with the retries/fallbacks
+    that happened inside it."""
+    from .recovery import RECOVERY_LOG
+
+    return len(RECOVERY_LOG)
+
+
+def recovery_delta(mark: int) -> dict:
+    """``{action: count}`` of recovery events recorded since ``mark``
+    (empty on a clean run). The log is bounded, so a mark taken more than
+    ``maxlen`` events ago degrades to counting the whole window."""
+    from .recovery import RECOVERY_LOG
+
+    events = RECOVERY_LOG.events()
+    out: dict[str, int] = {}
+    for e in events[max(0, min(mark, len(events))):]:
+        out[e.action] = out.get(e.action, 0) + 1
+    return out
+
+
+def annotate_recovery(s, mark: int) -> None:
+    """Attach ``recovery_<action>=count`` attributes for events since
+    ``mark`` (no-op when nothing happened or the span is the no-op)."""
+    if s is _NOOP:
+        return
+    delta = recovery_delta(mark)
+    if delta:
+        s.set(**{f"recovery_{k}": v for k, v in delta.items()})
+
+
+def jit_cache_probe(cached_factory) -> Callable[[], str]:
+    """Cold-compile vs steady detection for an ``lru_cache``-ed jit-factory
+    (``fused_linear_fit_packed`` et al.): snapshot ``cache_info()`` now,
+    and the returned thunk reports ``"miss"`` (a new trace+compile was
+    built since) or ``"hit"`` (served from cache). Also mirrors into the
+    ``jit.trace_miss`` / ``jit.trace_hit`` counters."""
+    try:
+        before = cached_factory.cache_info().misses
+    except AttributeError:        # not an lru_cache — report unknown
+        return lambda: "unknown"
+
+    def verdict() -> str:
+        try:
+            missed = cached_factory.cache_info().misses > before
+        except AttributeError:
+            return "unknown"
+        profiling.counters.increment(
+            "jit.trace_miss" if missed else "jit.trace_hit")
+        return "miss" if missed else "hit"
+    return verdict
+
+
+@contextlib.contextmanager
+def fit_span(name: str, jit_factory, **attrs):
+    """The shared fit-instrumentation shape (LinearRegression /
+    LogisticRegression both families): one span carrying the fit attrs,
+    the cold-compile vs steady verdict from :func:`jit_cache_probe` on the
+    lru-cached jit factory, and recovery retry/fallback annotations for
+    anything the resilience layer did inside. Yields the span (the no-op
+    when disabled) — the caller sets result attrs (iterations, converged)
+    on it. The enabled flag is read ONCE here, so a concurrent enable
+    mid-fit cannot desync the probe from the span."""
+    t = TRACER
+    if not t.enabled:
+        yield _NOOP
+        return
+    verdict = jit_cache_probe(jit_factory)
+    mark = recovery_mark()
+    with t.span(name, cat="fit", **attrs) as s:
+        yield s
+        s.set(compile=verdict())
+        annotate_recovery(s, mark)
+
+
+_jax_listener_installed = False
+
+
+def _install_jax_compile_listener() -> None:
+    """Best-effort backend compile counter: subscribe to jax's monitoring
+    events and mirror compilation-related ones into the counter registry
+    (``jit.backend.<event>``). Private-API dependent, so any failure just
+    means the deterministic lru-level ``jit.trace_*`` counters are the
+    only compile signal."""
+    global _jax_listener_installed
+    if _jax_listener_installed:
+        return
+    try:
+        from jax._src import monitoring as _mon
+
+        def _on_event(event, *a, **kw):
+            if "compil" in event:
+                tail = event.strip("/").replace("/", "_")
+                profiling.counters.increment(f"jit.backend.{tail}")
+
+        _mon.register_event_listener(_on_event)
+        _jax_listener_installed = True
+    except Exception:  # pragma: no cover - depends on jax internals
+        _jax_listener_installed = True  # don't retry every enable()
+
+
+# ---------------------------------------------------------------------------
+# Exporters
+# ---------------------------------------------------------------------------
+
+
+def _tid_map(spans) -> dict:
+    """Stable small integer per OS thread id (chrome tids read better)."""
+    out: dict[int, int] = {}
+    for s in spans:
+        if s.tid not in out:
+            out[s.tid] = len(out)
+    return out
+
+
+def chrome_trace() -> dict:
+    """Chrome trace-event JSON object (``{"traceEvents": [...]}``) —
+    complete ("X") events with microsecond timestamps; span/parent ids ride
+    in ``args`` so tooling can rebuild the tree exactly. Open spans export
+    with their duration so far and ``"open": true``."""
+    tracer = TRACER
+    spans = tracer.spans()
+    tids = _tid_map(spans)
+    pid = os.getpid()
+    events = []
+    for s in spans:
+        open_ = s.dur_us is None
+        dur = (tracer._now_us() - s.ts_us) if open_ else s.dur_us
+        args = {k: v for k, v in s.attrs.items()}
+        args["span_id"] = s.sid
+        if s.parent_id is not None:
+            args["parent_id"] = s.parent_id
+        if open_:
+            args["open"] = True
+        events.append({
+            "ph": "X", "name": s.name, "cat": s.cat or "other",
+            "ts": s.ts_us, "dur": max(int(dur), 1),
+            "pid": pid, "tid": tids[s.tid], "args": args,
+        })
+    return {"traceEvents": events,
+            "displayTimeUnit": "ms",
+            "otherData": {"framework": "sparkdq4ml_tpu"}}
+
+
+def dump_chrome_trace(path: str) -> str:
+    """Write :func:`chrome_trace` to ``path`` (atomic rename); returns the
+    path. Open in Perfetto / ``chrome://tracing``."""
+    doc = chrome_trace()
+    tmp = f"{path}.{os.getpid()}.tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f)
+    os.replace(tmp, path)
+    return path
+
+
+def trace_report() -> str:
+    """Human-readable span tree (indentation = parentage), oldest first."""
+    spans = sorted(TRACER.spans(), key=lambda s: (s.ts_us, s.sid))
+    children: dict[Optional[int], list] = {}
+    for s in spans:
+        children.setdefault(s.parent_id, []).append(s)
+    by_id = {s.sid: s for s in spans}
+    lines: list[str] = []
+
+    def emit(s, depth):
+        dur = ("open" if s.dur_us is None
+               else f"{s.dur_us / 1e3:.3f} ms")
+        attrs = format_kv(**s.attrs)
+        lines.append("  " * depth + f"{s.name} [{s.cat or 'other'}] {dur}"
+                     + (f"  {attrs}" if attrs else ""))
+        for c in children.get(s.sid, []):
+            emit(c, depth + 1)
+
+    # roots: no parent, or parent already evicted from the bounded buffer
+    for s in spans:
+        if s.parent_id is None or s.parent_id not in by_id:
+            emit(s, 0)
+    return "\n".join(lines)
+
+
+def _prom_name(name: str) -> str:
+    out = []
+    for ch in name:
+        out.append(ch if (ch.isalnum() or ch in "_:") else "_")
+    s = "".join(out)
+    if s and s[0].isdigit():
+        s = "_" + s
+    return "sparkdq4ml_" + s
+
+
+def _prom_num(v: float) -> str:
+    if v == float("inf"):
+        return "+Inf"
+    f = float(v)
+    return str(int(f)) if f == int(f) else repr(f)
+
+
+def prometheus_text() -> str:
+    """Prometheus text-format snapshot: every counter (including
+    ``recovery.*``), every gauge, and every histogram (cumulative
+    ``_bucket{le=...}`` series + ``_sum``/``_count``), one scrape."""
+    lines: list[str] = []
+    for name, v in sorted(profiling.counters.snapshot().items()):
+        pn = _prom_name(name)
+        lines.append(f"# TYPE {pn} counter")
+        lines.append(f"{pn} {_prom_num(v)}")
+    snap = METRICS.snapshot()
+    for name in sorted(snap):
+        v = snap[name]
+        pn = _prom_name(name)
+        if isinstance(v, dict):      # histogram summary
+            lines.append(f"# TYPE {pn} histogram")
+            for le, c in v["buckets"].items():
+                lines.append(f'{pn}_bucket{{le="{_prom_num(le)}"}} {c}')
+            lines.append(f"{pn}_sum {_prom_num(v['sum'])}")
+            lines.append(f"{pn}_count {v['count']}")
+        else:
+            lines.append(f"# TYPE {pn} gauge")
+            lines.append(f"{pn} {_prom_num(v)}")
+    return "\n".join(lines) + "\n"

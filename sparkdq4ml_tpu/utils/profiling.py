@@ -145,7 +145,19 @@ def trace(log_dir: Optional[str] = None):
 
 
 @contextlib.contextmanager
-def timed(label: str = "block"):
+def timed(label: str = "block", sync=None):
+    """Log the wall-clock of a block.
+
+    JAX dispatch is ASYNC: without ``sync`` this measures only enqueue
+    time — pending device work is excluded, and a fused fit can "take"
+    microseconds. Pass ``sync`` (a device array / pytree, same contract
+    as ``PhaseTimer.phase``) to ``block_until_ready`` it before the clock
+    stops, making the timing honest; syncing is a device wait, never a
+    host read. A zero-arg callable ``sync`` is invoked at exit and its
+    result blocked on — use that when the array only exists after the
+    block runs (``timed("fit", sync=lambda: out["coef"])``)."""
     t0 = time.perf_counter()
     yield
+    if sync is not None:
+        jax.block_until_ready(sync() if callable(sync) else sync)
     logger.info("%s took %.3f ms", label, (time.perf_counter() - t0) * 1e3)
