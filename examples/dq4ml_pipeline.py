@@ -132,6 +132,12 @@ def start(filename: str) -> None:
           {k: {m: (round(v, 4) if v is not None else None)
                for m, v in p.items()} for k, p in pairs.items()})
 
+    # Pipeline-compiler telemetry (README § "Pipeline compiler & jit
+    # cache"): steady-state reruns should show `compile` frozen while
+    # `flush`/`hit` climb — cache reuse across the repeated DQ queries.
+    from sparkdq4ml_tpu.utils.profiling import counters
+    print("pipeline counters:", counters.snapshot("pipeline"))
+
 
 if __name__ == "__main__":
     configure_logging()

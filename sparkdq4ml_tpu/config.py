@@ -23,6 +23,22 @@ class _Config:
     default_int_dtype: jnp.dtype = jnp.int32
     # Rows shown by Frame.show() when no argument is given (Spark default: 20).
     default_show_rows: int = 20
+    # Fused expression-pipeline compiler (ops/compiler.py): consecutive
+    # compilable Frame.with_column/filter ops coalesce into ONE jitted XLA
+    # program per structural plan key (spark.pipeline.enabled conf; False
+    # restores the exact per-op eager path).
+    pipeline: bool = True
+    # Row-slot bucket floor for the pipeline's shape-bucketed padding
+    # (rows pad up to the next power of two, never below this).
+    pipeline_min_bucket: int = 8
+    # Above this row count programs compile at EXACT length instead of a
+    # padded bucket: the per-flush pad + unpad copies are O(n) and at
+    # this scale cost more than an occasional retrace, while below it
+    # bucketing lets frames of different lengths (e.g. two CSV loads)
+    # share one compiled program.
+    pipeline_exact_threshold: int = 1 << 17
+    # Bounded LRU size of the plan-keyed jit cache.
+    pipeline_cache_size: int = 256
     # Pallas fast-path selection for the hot ops (ops/pallas_kernels.py):
     # the single-device Gramian in solvers.augmented_gram and the fused DQ
     # chain entry point ops/rules.py:dq_rules_fused. "off" = plain XLA

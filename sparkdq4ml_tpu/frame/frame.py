@@ -27,8 +27,11 @@ label-column copy (`:101`).
 from __future__ import annotations
 
 import io
+import logging
+import threading
 from typing import Iterable, Mapping, Optional, Sequence, Union
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -36,6 +39,15 @@ from ..config import config, float_dtype, int_dtype
 from ..ops.expressions import Col, Expr, spark_type_name
 from ..utils.debug import ensure_backend
 from ..utils.observability import op_span
+from ..utils.profiling import counters
+
+logger = logging.getLogger("sparkdq4ml_tpu.frame")
+
+# Serializes pipeline flushes: frames were thread-safe-immutable before
+# the lazy layer, and must stay observably so. Inside the lock, stores
+# publish BEFORE _pending clears, so the unlocked fast-path check in the
+# _data/_mask getters can never see "no pending" with stale stores.
+_FLUSH_LOCK = threading.RLock()
 
 ColumnLike = Union[Expr, jnp.ndarray, np.ndarray, Sequence]
 
@@ -194,9 +206,45 @@ def _as_column(values, n: Optional[int] = None):
 
 
 class Frame:
-    """Immutable columnar frame with a validity mask (see module docstring)."""
+    """Immutable columnar frame with a validity mask (see module docstring).
+
+    Pipeline compiler (``ops/compiler.py``): consecutive *compilable*
+    ``with_column``/``with_columns``/``filter`` calls do not dispatch one
+    XLA computation each — they accumulate as pending steps
+    (``_pending``) and materialize as ONE jitted program at the first
+    read of ``_data``/``_mask`` (any action, aggregation, sort, join,
+    fit, or host boundary). ``select`` fuses its own projection
+    expressions into the same program. Externally frames stay immutable
+    and eager-equivalent: the flush is a cache fill, semantics are
+    bit-identical, and ``config.pipeline = False``
+    (``spark.pipeline.enabled``) restores the exact per-op eager path.
+    """
 
     _alias: Optional[str] = None  # set by .alias(); not inherited by _with
+    _pending: tuple = ()          # deferred pipeline steps (see _defer)
+
+    # _data/_mask are flush-on-read properties so EVERY consumer — frame
+    # methods, aggregates, models, tests poking internals — sees the
+    # materialized state without knowing the pipeline layer exists.
+    @property
+    def _data(self) -> dict:
+        if self._pending:
+            self._flush()
+        return self._data_store
+
+    @_data.setter
+    def _data(self, value: dict) -> None:
+        self._data_store = value
+
+    @property
+    def _mask(self):
+        if self._pending:
+            self._flush()
+        return self._mask_store
+
+    @_mask.setter
+    def _mask(self, value) -> None:
+        self._mask_store = value
 
     def __init__(self, columns: Mapping[str, ColumnLike], mask=None):
         # Library-boundary liveness: a Frame built WITHOUT a TpuSession is
@@ -234,10 +282,106 @@ class Frame:
         f._n = self._n
         return f
 
+    # -- pipeline compiler plumbing (ops/compiler.py) ----------------------
+    def _defer(self, step) -> "Frame":
+        """New frame sharing this one's base columns/mask with ``step``
+        appended to the pending pipeline. Flush never mutates a shared
+        store in place, so sharing is safe; compilable steps are pure, so
+        sibling frames replaying a shared prefix stay correct."""
+        f = Frame.__new__(Frame)
+        with _FLUSH_LOCK:
+            # consistent (stores, pending) snapshot: racing a concurrent
+            # flush of this frame unlocked could pair the POST-flush
+            # stores with the PRE-flush step list — the child would then
+            # double-apply every step
+            f._data_store = self._data_store
+            f._mask_store = self._mask_store
+            f._pending = self._pending + (step,)
+        f._n = self._n
+        return f
+
+    def _pending_names(self) -> list[str]:
+        names: list[str] = []
+        for s in self._pending:
+            if s[0] == "with_column":
+                names.append(s[1])
+            elif s[0] == "with_columns":
+                names.extend(n for n, _ in s[1])
+        return names
+
+    def _pipe_schema(self):
+        # lazy: only columns the checked expression references get a
+        # dtype probe — deferral stays O(expr), not O(frame width)
+        from ..ops.compiler import LazySchema
+
+        return LazySchema(self._data_store, self._pending_names())
+
+    def _can_defer(self, *exprs) -> bool:
+        if not config.pipeline or self._n == 0:
+            return False
+        from ..ops.compiler import is_compilable
+
+        schema = self._pipe_schema()
+        return all(isinstance(e, Expr) and is_compilable(e, schema)
+                   for e in exprs)
+
+    def _flush(self) -> None:
+        """Materialize the pending pipeline steps as one compiled program
+        (or, on any compiler failure, by eager per-op replay — the
+        optimization layer must never change results).
+
+        ``_pending`` is cleared only AFTER a successful materialization:
+        if even the eager replay raises (a genuinely bad expression), the
+        exception propagates with the steps intact, so every subsequent
+        read raises the same error instead of silently serving the
+        pre-op frame state. Flushes serialize on ``_FLUSH_LOCK`` and
+        publish the new stores BEFORE clearing ``_pending`` — a reader
+        racing the unlocked getter fast-path either re-enters here (and
+        finds nothing left to do) or sees the fully flushed state; never
+        stale stores, never a double-applied step."""
+        from ..ops.compiler import PipelineError, run_pipeline
+
+        with _FLUSH_LOCK:
+            steps = self._pending
+            if not steps:
+                return
+            try:
+                new_data, new_mask, _ = run_pipeline(
+                    self._data_store, self._mask_store, self._n, steps)
+            except PipelineError as e:
+                logger.debug("pipeline flush fell back to eager replay: %s",
+                             e)
+                new_data, new_mask = self._eager_replay(steps)
+            self._data_store = new_data
+            self._mask_store = new_mask
+            self._pending = ()
+
+    def _eager_replay(self, steps):
+        """Apply pipeline steps through the eager code paths (fallback)."""
+        f = self._with(data=self._data_store, mask=self._mask_store)
+        for s in steps:
+            if s[0] == "with_column":
+                f = f._with_column_eager(s[1], s[2])
+            elif s[0] == "with_columns":
+                f = f._with_columns_eager(dict(s[1]))
+            else:
+                f = f._filter_eager(s[1])
+        return f._data_store, f._mask_store
+
     # -- basic introspection ----------------------------------------------
     @property
     def columns(self) -> list[str]:
-        return list(self._data)
+        if not self._pending:
+            return list(self._data_store)
+        # pending with_column targets are columns too — WITHOUT forcing a
+        # flush (column-name introspection is not a materialization point)
+        out = list(self._data_store)
+        seen = set(out)
+        for n in self._pending_names():
+            if n not in seen:
+                seen.add(n)
+                out.append(n)
+        return out
 
     @property
     def num_slots(self) -> int:
@@ -280,7 +424,11 @@ class Frame:
                 f"no column {name!r}; columns: {self.columns}") from None
 
     def col(self, name: str) -> Col:
-        self._column_values(name)  # raise early on unknown names, like Spark's analyzer
+        # raise early on unknown names, like Spark's analyzer — a name
+        # check, not a value read, so a pending pipeline stays pending
+        if name not in self.columns:
+            raise KeyError(
+                f"no column {name!r}; columns: {self.columns}")
         return Col(name)
 
     def __getitem__(self, name: str) -> Col:
@@ -298,7 +446,16 @@ class Frame:
     # read, so the "no host syncs" hygiene of the fused paths holds).
     @op_span("frame.with_column")
     def with_column(self, name: str, values: ColumnLike) -> "Frame":
-        """``withColumn`` — add or replace a column from an expression/array."""
+        """``withColumn`` — add or replace a column from an expression/array.
+
+        A compilable expression defers into the fused pipeline (one XLA
+        program per chain at the next materialization point) instead of
+        dispatching its own computation; see the class docstring."""
+        if isinstance(values, Expr) and self._can_defer(values):
+            return self._defer(("with_column", name, values))
+        return self._with_column_eager(name, values)
+
+    def _with_column_eager(self, name: str, values: ColumnLike) -> "Frame":
         data = dict(self._data)
         data[name] = self._eval(values)
         return self._with(data=data)
@@ -407,6 +564,10 @@ class Frame:
                 or (isinstance(e, Alias) and isinstance(e.child, Explode))]
         if len(gens) > 1:
             raise ValueError("only one explode() per select (Spark rule)")
+        # Fused select+filter: compilable projection expressions evaluate
+        # inside ONE compiled program together with any pending
+        # with_column/filter steps (the SQL SELECT-list + WHERE hot path).
+        pre = self._precompute_select(exprs, gens)
         data: dict[str, object] = {}
         for e in exprs:
             if isinstance(e, str):
@@ -423,6 +584,9 @@ class Frame:
                 # expands inline (c0…cN) unlike the explode family
                 data.update(e.columns(self))
                 continue
+            if id(e) in pre:
+                data[e.name] = pre[id(e)]
+                continue
             data[e.name] = e.eval(self)
         if not gens:
             return self._with(data=data)
@@ -437,6 +601,46 @@ class Frame:
         return self._with(data={**data, tmp: src_vals}).explode(
             tmp, g.name, keep_nulls=inner.outer,
             position_col="pos" if inner.with_position else None)
+
+    def _precompute_select(self, exprs, gens) -> dict:
+        """Evaluate compilable select expressions (plus any pending
+        pipeline steps) in one compiled program; returns ``{id(expr):
+        array}`` for the loop in :meth:`select` to consume. Empty dict ⇒
+        nothing fused (caller falls through to per-expression eval, which
+        flushes pending steps on first `_data` read)."""
+        if not config.pipeline or self._n == 0:
+            return {}
+        from ..ops.compiler import (PipelineError, is_compilable,
+                                    run_pipeline)
+
+        from ..ops.expressions import JsonTuple
+
+        schema = self._pipe_schema()
+        cand = [e for e in exprs
+                if isinstance(e, Expr) and not isinstance(e, JsonTuple)
+                and not any(e is g for g in gens)
+                and not isinstance(e, Col)          # plain refs are free
+                and is_compilable(e, schema)]
+        # Fusing pays when a pending chain flushes anyway or when >= 2
+        # expressions share one program; a lone expression on a clean
+        # frame costs the same either way — keep it eager.
+        if not cand or (not self._pending and len(cand) < 2):
+            return {}
+        extra = [(f"__sel_{i}", e) for i, e in enumerate(cand)]
+        with _FLUSH_LOCK:
+            steps = self._pending
+            try:
+                new_data, new_mask, extras = run_pipeline(
+                    self._data_store, self._mask_store, self._n, steps,
+                    extra)
+            except PipelineError as e:
+                logger.debug("fused select fell back to eager: %s", e)
+                return {}
+            # stores BEFORE pending — same publish ordering as _flush
+            self._data_store = new_data
+            self._mask_store = new_mask
+            self._pending = ()
+        return {id(e): extras[f"__sel_{i}"] for i, e in enumerate(cand)}
 
     @op_span("frame.explode")
     def explode(self, column: str, output_col: str = None,
@@ -481,6 +685,7 @@ class Frame:
             elif keep_nulls:
                 values.append(None)
                 positions.append(None)     # posexplode_outer: null pos
+        src_dev = jnp.asarray(src) if len(src) else None  # ONE transfer
         data: dict[str, object] = {}
         for name, col_arr in self._data.items():
             if name == column:
@@ -489,7 +694,7 @@ class Frame:
                 data[name] = np.asarray(col_arr, object)[src]
             else:
                 data[name] = jnp.take(jnp.asarray(col_arr),
-                                      jnp.asarray(src), axis=0) \
+                                      src_dev, axis=0) \
                     if len(src) else jnp.asarray(col_arr)[:0]
         # element dtype from the NON-NULL values: numeric lists land on
         # device; strings (or an all-null result, which must not flip a
@@ -537,12 +742,20 @@ class Frame:
         SQL three-valued logic: a NULL predicate (NaN in this engine's
         float encoding — e.g. ``array_contains`` over a null cell) drops
         the row, exactly like Spark's WHERE. A bare ``NaN.astype(bool)``
-        would be True and silently keep null rows."""
+        would be True and silently keep null rows.
+
+        A compilable predicate defers into the fused pipeline — the mask
+        AND lands inside the same compiled program as the column
+        expressions it rides with."""
+        if isinstance(condition, Expr) and self._can_defer(condition):
+            return self._defer(("filter", condition))
+        return self._filter_eager(condition)
+
+    def _filter_eager(self, condition: Union[Expr, jnp.ndarray]) -> "Frame":
+        from ..ops.expressions import predicate_keep_mask
+
         cond = condition.eval(self) if isinstance(condition, Expr) else jnp.asarray(condition)
-        if jnp.issubdtype(cond.dtype, jnp.floating):
-            keep = jnp.logical_and(~jnp.isnan(cond), cond != 0)
-        else:
-            keep = cond.astype(jnp.bool_)
+        keep = predicate_keep_mask(cond)
         return self._with(mask=jnp.logical_and(self._mask, keep))
 
     where = filter
@@ -756,7 +969,8 @@ class Frame:
                            if isinstance(k, (int, float))
                            and not isinstance(k, bool)}
                 if num_map:
-                    col = jnp.asarray(arr)
+                    src = jnp.asarray(arr)  # converted ONCE; matches test
+                    col = src               # against the original values
                     # replacing with None (null) or a float widens ints
                     if any(v is None or isinstance(v, float)
                            for v in num_map.values()) \
@@ -765,7 +979,7 @@ class Frame:
                     for old, new in num_map.items():
                         if new is None:
                             new = float("nan")
-                        col = jnp.where(jnp.asarray(arr) == old,
+                        col = jnp.where(src == old,
                                         jnp.asarray(new, col.dtype), col)
                     data[name] = col
         return self._with(data=data)
@@ -774,7 +988,17 @@ class Frame:
         """``withColumns`` — add/replace several columns at once. Every
         expression resolves against the *input* frame (Spark semantics), so
         a map that replaces a column and references it elsewhere sees the
-        original values."""
+        original values.
+
+        When every expression is compilable the whole batch defers as ONE
+        pipeline step — N expressions in one compiled program."""
+        items = tuple(cols_map.items())
+        if items and self._can_defer(*[v for _, v in items]):
+            return self._defer(("with_columns", items))
+        return self._with_columns_eager(cols_map)
+
+    def _with_columns_eager(self, cols_map: Mapping[str, ColumnLike]) \
+            -> "Frame":
         evaluated = {name: self._eval(values)
                      for name, values in cols_map.items()}
         data = dict(self._data)
@@ -881,9 +1105,18 @@ class Frame:
 
     randomSplit = random_split
 
+    @op_span("frame.cache")
     def cache(self) -> "Frame":
-        """No-op for API parity: columns are already materialized device
-        arrays (this engine is eager; there is no lazy plan to pin)."""
+        """Materialize and pin: flush any pending fused pipeline, then
+        ``block_until_ready`` every device column and the validity mask.
+        JAX dispatch is async — without the block, timing code around
+        ``cache()`` would measure enqueue, not compute; this makes
+        ``cache()`` the honest timing boundary bench.py treats it as
+        (Spark parity: after ``cache().count()`` the data IS resident)."""
+        arrs = [jnp.asarray(arr) for arr in self._data.values()
+                if not _is_string_col(arr)]
+        jax.block_until_ready(arrs + [self._mask])
+        counters.increment("frame.cache")
         return self
 
     persist = cache
@@ -953,6 +1186,7 @@ class Frame:
         return self.count() == 0
 
     def _host_mask(self) -> np.ndarray:
+        counters.increment("frame.host_sync")
         return np.asarray(self._mask)
 
     @op_span("frame.to_pydict", cat="action")
@@ -960,23 +1194,43 @@ class Frame:
         """Materialize valid rows on host (the gather happens here, once, at
         the host boundary — never inside the compute path).
 
+        All device→host transfers batch into ONE ``jax.device_get`` of
+        the column dict (mask included when no ``limit`` trims it first)
+        instead of one sync per column; each batch counts as a
+        ``frame.host_sync`` in ``profiling.counters``.
+
         ``limit`` gathers only the first N valid rows — ``take``/``show``
         use it so peeking at a large device-resident frame does not transfer
         the whole dataset.
         """
-        m = self._host_mask()
         if limit is not None:
+            # the limit cut needs the mask on host BEFORE slicing columns:
+            # one tiny mask sync, then one batched sync of the prefixes
+            m = self._host_mask()
             keep = np.cumsum(m) <= limit
             m = m & keep
             upto = int(np.argmax(~keep)) if not keep.all() else len(m)
             m = m[:upto]
+            device = {name: jnp.asarray(arr)[: len(m)]
+                      for name, arr in self._data.items()
+                      if not _is_string_col(arr)}
+            pulled = jax.device_get(device) if device else {}
+            if device:
+                counters.increment("frame.host_sync")
+        else:
+            mask_key = "__mask__"
+            while mask_key in self._data:       # paranoid name collision
+                mask_key += "_"
+            device = {name: arr for name, arr in self._data.items()
+                      if not _is_string_col(arr)}
+            device[mask_key] = self._mask
+            pulled = jax.device_get(device)     # ONE batched transfer
+            counters.increment("frame.host_sync")
+            m = np.asarray(pulled.pop(mask_key), bool)
         out = {}
         for name, arr in self._data.items():
-            if _is_string_col(arr):
-                host = arr[: len(m)]
-            else:
-                host = np.asarray(arr[: len(m)])
-            out[name] = host[m]
+            host = pulled[name] if name in pulled else arr[: len(m)]
+            out[name] = np.asarray(host)[m]
         return out
 
     def collect(self, limit: Optional[int] = None) -> list[tuple]:
@@ -1311,14 +1565,14 @@ class Frame:
                 seen.add(key)
                 keep.append(pos)
         keep_idx = np.asarray(keep, np.int64)
-        data = {}
+        keep_dev = jnp.asarray(keep_idx)  # one host→device transfer, not
+        data = {}                         # one per gathered column
         for name in self.columns:
             arr = self._data[name]
             if _is_string_col(arr):
                 data[name] = np.asarray(arr, dtype=object)[keep_idx]
             else:
-                data[name] = jnp.take(jnp.asarray(arr),
-                                      jnp.asarray(keep_idx), axis=0)
+                data[name] = jnp.take(jnp.asarray(arr), keep_dev, axis=0)
         return Frame(data)
 
     dropDuplicates = drop_duplicates
@@ -1408,6 +1662,9 @@ class Frame:
             """Materialize frame columns at idx; idx == -1 ⇒ null fill."""
             missing = idx < 0
             safe = np.where(missing, 0, idx)
+            safe_dev = jnp.asarray(safe)       # ONE host→device transfer
+            miss_dev = (jnp.asarray(missing)   # shared across all columns
+                        if fill_missing and missing.any() else None)
             out = {}
             if frame.num_slots == 0 and len(idx):
                 # gathering from an EMPTY side (e.g. left join against an
@@ -1431,14 +1688,14 @@ class Frame:
                         col[missing] = None
                     out[name] = col
                 else:
-                    col = jnp.take(jnp.asarray(arr), jnp.asarray(safe), axis=0)
-                    if fill_missing and missing.any():
+                    col = jnp.take(jnp.asarray(arr), safe_dev, axis=0)
+                    if miss_dev is not None:
                         if not np.issubdtype(np.dtype(col.dtype), np.floating):
                             col = col.astype(float_dtype())
                         nan = jnp.asarray(np.nan, col.dtype)
-                        m = jnp.asarray(missing)
-                        col = jnp.where(m[(...,) + (None,) * (col.ndim - 1)],
-                                        nan, col)
+                        col = jnp.where(
+                            miss_dev[(...,) + (None,) * (col.ndim - 1)],
+                            nan, col)
                     out[name] = col
             return out
 

@@ -1,0 +1,740 @@
+"""Fused expression-pipeline compiler: plan-keyed jit cache + bucketed padding.
+
+The frame engine is eager by design (frame.py docstring: Spark's lazy DAG is
+deliberately not replicated) — but in eager JAX every ``with_column`` /
+``filter`` node dispatches as its *own* XLA computation, and the fusion the
+design banks on only happens **inside** ``jax.jit``. BENCH_r05 showed the op
+sweep pinned at interpreter-dispatch cost, not FLOPs. This module is the
+missing compilation layer: chains of compilable frame ops coalesce (see
+``Frame._defer``) and materialize as ONE jitted XLA program per *plan shape*.
+
+Three pieces, mirroring the hierarchy lesson of Snap ML (PAPERS.md — keep the
+hot loop in one compiled unit) and the graph-level-optimization approach of
+"Memory Safe Computations with XLA Compiler" (PAPERS.md):
+
+* **Structural plan key** — an ``Expr`` tree linearizes to a string of op
+  kinds, referenced-column dtypes, and vector widths. Python literals in
+  comparison/arithmetic positions are *hoisted out of the key* and passed as
+  runtime scalar arguments, so ``price < 3`` and ``price < 4`` share one
+  compiled program (``_lower`` rewrites the hoisted ``Lit`` into an
+  :class:`_ArgLit` that broadcasts the runtime scalar at trace time).
+
+* **Plan-keyed jit cache** — one ``jax.jit`` callable per plan key (bounded
+  LRU). The program computes every pending column expression and the
+  filter-mask AND in a single XLA computation, with buffer donation on the
+  (padded) mask and on padded inputs of replaced columns.
+
+* **Shape-bucketed row padding** — inputs pad up to the next power-of-two
+  bucket with a ``False`` mask tail, so two CSV loads of different lengths
+  hit the same compiled program instead of retracing; outputs slice back to
+  the true row count.
+
+Observability: ``pipeline.flush`` / ``pipeline.compile`` / ``pipeline.hit``
+/ ``pipeline.fallback`` counters in :data:`utils.profiling.counters`, and a
+``frame.pipeline.flush`` span (steps, bucket, rows, cache verdict) when
+tracing is on. Disable the whole layer with
+``.config("spark.pipeline.enabled", "false")`` (→ ``config.pipeline``),
+which restores the exact per-op eager path.
+
+Semantics are bit-identical to eager evaluation: the compiled program runs
+the *same* ``Expr.eval`` methods (against a :class:`_TraceFrame` shim whose
+columns are tracers), so every null rule, dtype promotion, and division
+corner is the one the eager path implements. Anything outside the compilable
+subset (strings, UDFs, row generators, array cells) never defers.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+import threading
+import warnings
+from collections import OrderedDict
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import config, float_dtype, int_dtype
+from ..utils import observability as _obs
+from ..utils.profiling import counters
+from . import expressions as E
+
+__all__ = [
+    "bucket_size", "is_compilable", "run_pipeline", "clear_cache",
+    "cache_len", "PipelineError",
+]
+
+
+class PipelineError(RuntimeError):
+    """Internal compile/run failure — callers fall back to eager replay."""
+
+
+# ---------------------------------------------------------------------------
+# Shape buckets
+# ---------------------------------------------------------------------------
+
+def bucket_size(n: int) -> int:
+    """Row-slot bucket for ``n`` rows: the next power of two, floored at
+    ``config.pipeline_min_bucket``. Two frames whose lengths land in the
+    same bucket execute the same compiled program (the padded tail rides
+    a ``False`` validity mask, so no masked reduction ever sees it).
+
+    Above ``config.pipeline_exact_threshold`` the bucket IS ``n``: the
+    pad-in + slice-out copies are O(n) per flush and at that scale cost
+    more than the occasional retrace they avoid, while the small-frame
+    regime (repeated queries over varying batch sizes) keeps full
+    cross-length sharing."""
+    lo = max(int(config.pipeline_min_bucket), 1)
+    if n <= lo:
+        return lo
+    if n > int(config.pipeline_exact_threshold):
+        return n
+    return 1 << (n - 1).bit_length()
+
+
+# ---------------------------------------------------------------------------
+# Compilability — the subset of Expr that traces under jit
+# ---------------------------------------------------------------------------
+
+# Pure-jnp builtin scalar functions (device columns in, device column out).
+# Everything else in _BUILTIN_FNS is host-side (strings/arrays) or needs a
+# host-extracted literal in a non-trailing position.
+_NUMERIC_FUNCS = frozenset({
+    "abs", "sqrt", "exp", "log", "log10", "pow", "power", "floor", "ceil",
+    "sign", "signum", "greatest", "least", "isnan", "coalesce", "sin",
+    "cos", "tan", "asin", "acos", "atan", "atan2", "sinh", "cosh", "tanh",
+    "degrees", "radians", "cbrt", "expm1", "log1p", "log2", "mod", "pmod",
+    "hypot", "rint", "nanvl",
+})
+# round(col, d) is deliberately NOT compilable: its ``/ 10**d`` uses a
+# compile-time-constant divisor, which XLA strength-reduces to a
+# reciprocal multiply under jit — a 1-ULP divergence from the eager op.
+# (Hoisted BinOp literals dodge this: a runtime-scalar divisor is not
+# strength-reduced.) Bit-identical semantics outrank fusing one op.
+_LIT_TAIL_FUNCS: frozenset = frozenset()
+
+# (min, max) argument counts; None = unbounded. Wrong-arity calls must
+# NOT defer — the eager path raises the TypeError at the call site, and
+# deferring would postpone (or, pre-fix, swallow) that error.
+_FUNC_ARITY = {
+    "pow": (2, 2), "power": (2, 2), "atan2": (2, 2), "hypot": (2, 2),
+    "mod": (2, 2), "pmod": (2, 2), "nanvl": (2, 2),
+    "greatest": (1, None), "least": (1, None), "coalesce": (1, None),
+    "round": (1, 2),
+}
+
+
+def _arity_ok(fn_name: str, n_args: int) -> bool:
+    lo, hi = _FUNC_ARITY.get(fn_name, (1, 1))
+    return n_args >= lo and (hi is None or n_args <= hi)
+
+
+def _lit_compilable(v) -> bool:
+    """Mirrors ``Lit.eval``'s type dispatch EXACTLY: only Python
+    bool/int/float take the device path there (np.float64 passes as a
+    float subclass; np.int64/np.bool_ do NOT subclass int/bool and fall
+    to the host object-array branch, so they must not defer — and their
+    repr could collide with the Python literal's plan key)."""
+    return isinstance(v, (bool, int, float))
+
+
+def _col_spec(arr) -> str:
+    """Plan-key spec of a referenced base column: dtype + vector width
+    (``f64``, ``f32x4``, …). Host object columns report ``h`` and are
+    rejected by :func:`is_compilable`."""
+    if isinstance(arr, np.ndarray) and arr.dtype == object:
+        return "h"
+    a = jnp.asarray(arr)
+    w = f"x{a.shape[1]}" if a.ndim == 2 else ""
+    return f"{np.dtype(a.dtype).str}{w}"
+
+
+def schema_of(data: dict, pending_names: Sequence[str] = ()) -> dict:
+    """name → key spec for the compilability walk: base device columns map
+    to their dtype spec, host columns to ``h``, and columns produced by
+    earlier pending steps to ``p`` (their dtype is determined by plan
+    structure, so the spec carries no dtype)."""
+    spec = {name: _col_spec(arr) for name, arr in data.items()}
+    for name in pending_names:
+        spec[name] = "p"
+    return spec
+
+
+class LazySchema:
+    """``get``-only schema that resolves column specs ON DEMAND — the
+    per-op ``_can_defer`` check runs once per deferred call, and eagerly
+    spec-ing every stored column made deferral O(frame width) per op on
+    wide frames; an expression only needs the handful of columns it
+    references. Not used by :func:`_linearize` (which copies and mutates
+    a real dict)."""
+
+    def __init__(self, data: dict, pending_names: Sequence[str]):
+        self._data = data
+        self._pending = frozenset(pending_names)
+        self._cache: dict = {}
+
+    def get(self, name, default=None):
+        if name in self._pending:
+            return "p"
+        try:
+            return self._cache[name]
+        except KeyError:
+            pass
+        arr = self._data.get(name)
+        if arr is None:
+            return default
+        spec = self._cache[name] = _col_spec(arr)
+        return spec
+
+
+def _dtype_tag() -> str:
+    """Engine dtype fingerprint prefixed to every plan key: expression
+    eval bakes ``float_dtype()``/``int_dtype()`` into the program (e.g.
+    ``/`` casts to the configured float), so a config flip (tests switch
+    float32 ↔ float64) must miss the cache, not serve stale dtypes."""
+    return f"{np.dtype(float_dtype()).str}/{np.dtype(int_dtype()).str}"
+
+
+def is_compilable(expr, schema: dict) -> bool:
+    """True when ``expr`` evaluates entirely on device under jit: numeric
+    column refs, numeric literals, arithmetic/comparison/boolean ops,
+    numeric casts, CASE WHEN, IN over literal values, and the pure-jnp
+    builtin functions. Strings, UDFs, row generators, subquery markers,
+    and array-cell functions are not (they stay on the eager path)."""
+    if isinstance(expr, E.Col):
+        s = schema.get(expr.name)
+        return s is not None and s != "h"
+    if isinstance(expr, E.Lit):
+        return _lit_compilable(expr.value)
+    if isinstance(expr, E.Alias):
+        return is_compilable(expr.child, schema)
+    if isinstance(expr, E.BinOp):
+        return (is_compilable(expr.left, schema)
+                and is_compilable(expr.right, schema))
+    if isinstance(expr, E.UnaryOp):
+        return expr.op in ("-", "!", "isnull", "isnotnull") \
+            and is_compilable(expr.child, schema)
+    if isinstance(expr, E.Cast):
+        try:
+            dt = E.resolve_type_name(expr.type_name)
+        except ValueError:
+            return False
+        if isinstance(dt, np.dtype) and dt == object:
+            return False            # → string: host path
+        return is_compilable(expr.child, schema)
+    if isinstance(expr, E.InList):
+        return (is_compilable(expr.child, schema)
+                and all(isinstance(v, E.Lit)
+                        and (_lit_compilable(v.value)
+                             or E.InList._is_null_lit(v))
+                        for v in expr.values))
+    if isinstance(expr, E.CaseWhen):
+        return (all(is_compilable(c, schema) and is_compilable(v, schema)
+                    for c, v in expr.branches)
+                and (expr.otherwise_expr is None
+                     or is_compilable(expr.otherwise_expr, schema)))
+    if isinstance(expr, E.Func):
+        if not _arity_ok(expr.fn_name, len(expr.args)):
+            return False
+        if expr.fn_name in _LIT_TAIL_FUNCS:
+            return (is_compilable(expr.args[0], schema)
+                    and all(isinstance(a, E.Lit)
+                            and _lit_compilable(a.value)
+                            for a in expr.args[1:]))
+        if expr.fn_name in _NUMERIC_FUNCS:
+            return all(is_compilable(a, schema) for a in expr.args)
+        return False
+    return False
+
+
+# ---------------------------------------------------------------------------
+# Plan lowering: key string + literal hoisting (one traversal, lockstep)
+# ---------------------------------------------------------------------------
+
+class _ArgLit(E.Expr):
+    """A hoisted literal: broadcasts the ``i``-th runtime scalar argument
+    at its original ``Lit`` dtype. Exists only inside cached rewritten
+    plans — never escapes the compiler."""
+
+    def __init__(self, index: int, kind: str):
+        self.index = index
+        self.kind = kind            # "b" | "i" | "f"
+
+    def eval(self, frame):
+        val = _RUNTIME_LITS.lits[self.index]
+        dt = (jnp.bool_ if self.kind == "b"
+              else int_dtype() if self.kind == "i" else float_dtype())
+        return jnp.full((frame.num_slots,), val, dt)
+
+    def __str__(self):
+        return f"?lit{self.index}"
+
+
+class _HostConstLit(E.Expr):
+    """A literal evaluated as a HOST numpy array: the lit-tail arguments
+    of :data:`_LIT_TAIL_FUNCS` (e.g. ``round``'s digit count) are
+    host-extracted inside the builtin (``int(np.asarray(d)[0])``), and
+    under jit even a constant ``jnp.full`` is staged into a tracer that
+    ``np.asarray`` rejects. Exists only inside rewritten plans."""
+
+    def __init__(self, value):
+        self.value = value
+
+    def eval(self, frame):
+        return np.full((frame.num_slots,), self.value)
+
+    def __str__(self):
+        return repr(self.value)
+
+
+class _Lits(threading.local):
+    lits: tuple = ()                # per-thread default (trace-time only)
+
+
+_RUNTIME_LITS = _Lits()
+
+
+def _lit_kind(v) -> str:
+    if isinstance(v, (bool, np.bool_)):
+        return "b"
+    if isinstance(v, (int, np.integer)):
+        return "i"
+    return "f"
+
+
+def _hoistable_lit(expr) -> Optional[E.Lit]:
+    """The ``price < LITERAL`` case: a numeric (non-bool, non-NaN-sentinel)
+    Lit in a BinOp/UnaryOp('-') operand position hoists to a runtime
+    scalar. Bools and NaN stay in the key: NaN drives *static* null-rule
+    branches elsewhere (InList), and bools are two values — hoisting buys
+    nothing and loses constant-folding."""
+    if isinstance(expr, E.Lit) and isinstance(expr.value, (int, float)) \
+            and not isinstance(expr.value, bool) \
+            and not (isinstance(expr.value, float)
+                     and math.isnan(expr.value)):
+        return expr
+    return None
+
+
+def _lower(expr, schema: dict, lits: list):
+    """One traversal returning ``(key_fragment, rewritten_expr)``.
+
+    ``lits`` collects the hoisted ``Lit`` nodes in traversal order; the
+    rewritten tree holds matching :class:`_ArgLit` placeholders at the
+    same positions. Key equality ⇒ identical traversal ⇒ later frames
+    extract their literal values in exactly the cached program's order.
+    """
+    if isinstance(expr, E.Col):
+        return f"C({expr.name!r}:{schema.get(expr.name)})", expr
+    if isinstance(expr, E.Lit):
+        return f"V({expr.value!r})", expr
+    if isinstance(expr, E.Alias):
+        k, ch = _lower(expr.child, schema, lits)
+        return k, (expr if ch is expr.child else E.Alias(ch, expr._name))
+    if isinstance(expr, E.BinOp):
+
+        def operand(side):
+            h = _hoistable_lit(side)
+            if h is not None:
+                idx = len(lits)
+                lits.append(h)
+                kind = _lit_kind(h.value)
+                return f"L{kind}", _ArgLit(idx, kind)
+            return _lower(side, schema, lits)
+
+        lk, le = operand(expr.left)
+        rk, re = operand(expr.right)
+        return (f"B({expr.op},{lk},{rk})",
+                expr if le is expr.left and re is expr.right
+                else E.BinOp(expr.op, le, re))
+    if isinstance(expr, E.UnaryOp):
+        h = _hoistable_lit(expr.child) if expr.op == "-" else None
+        if h is not None:
+            idx = len(lits)
+            lits.append(h)
+            kind = _lit_kind(h.value)
+            return (f"U(-,L{kind})",
+                    E.UnaryOp("-", _ArgLit(idx, kind)))
+        k, ch = _lower(expr.child, schema, lits)
+        return (f"U({expr.op},{k})",
+                expr if ch is expr.child else E.UnaryOp(expr.op, ch))
+    if isinstance(expr, E.Cast):
+        k, ch = _lower(expr.child, schema, lits)
+        return (f"T({expr.type_name.lower()},{k})",
+                expr if ch is expr.child else E.Cast(ch, expr.type_name))
+    if isinstance(expr, E.InList):
+        k, ch = _lower(expr.child, schema, lits)
+        vals = ",".join("NULL" if E.InList._is_null_lit(v)
+                        else repr(v.value) for v in expr.values)
+        return (f"I({int(expr.negated)},{k},[{vals}])",
+                expr if ch is expr.child
+                else E.InList(ch, expr.values, expr.negated))
+    if isinstance(expr, E.CaseWhen):
+        parts = []
+        branches = []
+        changed = False
+        for c, v in expr.branches:
+            ck, ce = _lower(c, schema, lits)
+            vk, ve = _lower(v, schema, lits)
+            parts.append(f"{ck}:{vk}")
+            changed = changed or ce is not c or ve is not v
+            branches.append((ce, ve))
+        if expr.otherwise_expr is not None:
+            ok, oe = _lower(expr.otherwise_expr, schema, lits)
+            changed = changed or oe is not expr.otherwise_expr
+        else:
+            ok, oe = "_", None
+        return (f"W([{';'.join(parts)}],{ok})",
+                expr if not changed else E.CaseWhen(branches, oe))
+    if isinstance(expr, E.Func):
+        lit_tail = expr.fn_name in _LIT_TAIL_FUNCS
+        parts = []
+        args = []
+        changed = False
+        for i, a in enumerate(expr.args):
+            if lit_tail and i > 0:
+                # host-extracted literal args (is_compilable guarantees
+                # Lits here): evaluate as host numpy, bake into the key
+                parts.append(f"V({a.value!r})")
+                args.append(_HostConstLit(a.value))
+                changed = True
+                continue
+            # numeric-builtin literal args hoist like BinOp operands:
+            # pow(x, 2)/pow(x, 3) share one program, AND the exponent
+            # stays a runtime scalar so XLA cannot strength-reduce
+            # constant forms (pow(x, 2) → x*x) into 1-ULP divergence
+            # from the eager op.
+            h = _hoistable_lit(a)
+            if h is not None:
+                idx = len(lits)
+                lits.append(h)
+                kind = _lit_kind(h.value)
+                parts.append(f"L{kind}")
+                args.append(_ArgLit(idx, kind))
+                changed = True
+                continue
+            ak, ae = _lower(a, schema, lits)
+            parts.append(ak)
+            changed = changed or ae is not a
+            args.append(ae)
+        return (f"F({expr.fn_name},{','.join(parts)})",
+                expr if not changed else E.Func(expr.fn_name, args))
+    raise PipelineError(f"non-compilable node reached _lower: {expr!r}")
+
+
+def _referenced_base_cols(expr, schema: dict, out: list) -> None:
+    """Column names an expression reads from the frame's STORED columns
+    (names the step-evolved ``schema`` does not map to ``p``), in
+    first-seen order — the compiled program's array inputs. A name read
+    before a later step replaces it resolves to base here because the
+    caller marks outputs ``p`` only after lowering the step that
+    produces them."""
+    if isinstance(expr, E.Col):
+        if schema.get(expr.name) not in (None, "p") and expr.name not in out:
+            out.append(expr.name)
+        return
+    for attr in ("left", "right", "child", "otherwise_expr"):
+        v = getattr(expr, attr, None)
+        if isinstance(v, E.Expr):
+            _referenced_base_cols(v, schema, out)
+    for v in getattr(expr, "args", None) or ():
+        _referenced_base_cols(v, schema, out)
+    for v in getattr(expr, "values", None) or ():
+        _referenced_base_cols(v, schema, out)
+    for c, v in getattr(expr, "branches", None) or ():
+        _referenced_base_cols(c, schema, out)
+        _referenced_base_cols(v, schema, out)
+
+
+# ---------------------------------------------------------------------------
+# The compiled program
+# ---------------------------------------------------------------------------
+
+class _TraceFrame:
+    """Frame shim the compiled program evaluates expressions against: its
+    columns are jit tracers and ``num_slots`` is the (static) bucket
+    size, so ``Expr.eval`` runs unmodified — same nulls, same dtype
+    promotion, same division corners as the eager path."""
+
+    def __init__(self, env: dict, n: int):
+        self._env = env
+        self._n = n
+
+    @property
+    def num_slots(self) -> int:
+        return self._n
+
+    def _column_values(self, name: str):
+        try:
+            return self._env[name]
+        except KeyError:
+            raise KeyError(f"pipeline program has no column {name!r}; "
+                           f"inputs: {sorted(self._env)}") from None
+
+
+class _SchemaOverlay:
+    """Mutable step-output overlay over a base schema (dict or
+    :class:`LazySchema`) — _linearize marks produced columns ``p``
+    without copying or eagerly materializing the base."""
+
+    def __init__(self, base):
+        self._base = base
+        self._over: dict = {}
+
+    def get(self, name, default=None):
+        if name in self._over:
+            return self._over[name]
+        return self._base.get(name, default)
+
+    def __setitem__(self, name, spec) -> None:
+        self._over[name] = spec
+
+
+def _linearize(steps, extra, base_schema):
+    """THE single plan walk — used by both the cache probe and plan
+    construction, so the key, the hoisted-literal order, and the
+    rewritten trees can never drift apart (a divergence would make every
+    lookup miss, or worse, bind literal values to the wrong _ArgLit
+    slots). ``base_schema`` holds only the frame's stored columns; it
+    evolves step-by-step (each step's outputs become ``p`` for LATER
+    steps) so a step that reads a column *before* a later step replaces
+    it keys on — and receives — the BASE column as a program input.
+
+    Returns ``(key, lit_nodes, lowered_steps, lowered_extra, refs)``.
+    """
+    lits: list = []
+    key_parts: list = []
+    lowered_steps: list = []
+    lowered_extra: list = []
+    refs: list = []
+    schema = _SchemaOverlay(base_schema)
+    for step in steps:
+        if step[0] == "with_column":
+            k, ex = _lower(step[2], schema, lits)
+            _referenced_base_cols(step[2], schema, refs)
+            key_parts.append(f"W({step[1]!r})={k}")
+            lowered_steps.append(("with_column", step[1], ex))
+            schema[step[1]] = "p"
+        elif step[0] == "with_columns":
+            pairs = []
+            ks = []
+            for name, sub in step[1]:
+                k, ex = _lower(sub, schema, lits)
+                _referenced_base_cols(sub, schema, refs)
+                ks.append(f"{name!r}={k}")
+                pairs.append((name, ex))
+            key_parts.append(f"WS({';'.join(ks)})")
+            lowered_steps.append(("with_columns", tuple(pairs)))
+            for name, _ in step[1]:
+                schema[name] = "p"
+        elif step[0] == "filter":
+            k, ex = _lower(step[1], schema, lits)
+            _referenced_base_cols(step[1], schema, refs)
+            key_parts.append(f"F:{k}")
+            lowered_steps.append(("filter", ex))
+        else:
+            raise PipelineError(f"unknown pipeline step {step[0]!r}")
+    for name, sub in extra:
+        k, ex = _lower(sub, schema, lits)
+        _referenced_base_cols(sub, schema, refs)
+        key_parts.append(f"O({name!r})={k}")
+        lowered_extra.append((name, ex))
+    key = _dtype_tag() + "|" + "|".join(key_parts)
+    return key, lits, lowered_steps, lowered_extra, refs
+
+
+class _Plan:
+    """One cache entry: the jitted program plus its calling convention
+    (see :func:`_linearize` for the key/lowering walk)."""
+
+    def __init__(self, steps, extra, base_schema):
+        key, lits, lowered_steps, lowered_extra, refs = _linearize(
+            steps, extra, base_schema)
+        replaced = {s[1] for s in steps if s[0] == "with_column"}
+        for s in steps:
+            if s[0] == "with_columns":
+                replaced |= {name for name, _ in s[1]}
+        # donate the padded inputs of columns the program both reads and
+        # replaces (their old buffers die at flush); everything else rides
+        # the kept dict and may alias the frame's own buffers.
+        self.donated = tuple(r for r in refs if r in replaced)
+        self.kept = tuple(r for r in refs if r not in replaced)
+        self.extra_names = tuple(name for name, _ in lowered_extra)
+        self.key = key
+        self.n_lits = len(lits)
+
+        donated_names = self.donated
+        extra_pairs = tuple(lowered_extra)
+        step_tuple = tuple(lowered_steps)
+
+        def program(kept, donated, mask, lit_args):
+            # Body runs at trace time only → this counts XLA compiles.
+            counters.increment("pipeline.compile")
+            _RUNTIME_LITS.lits = lit_args
+            try:
+                env = dict(kept)
+                env.update(zip(donated_names, donated))
+                fr = _TraceFrame(env, mask.shape[0])
+                new_mask = mask
+                changed = {}
+                for st in step_tuple:
+                    if st[0] == "with_column":
+                        v = st[2].eval(fr)
+                        env[st[1]] = v
+                        changed[st[1]] = v
+                    elif st[0] == "with_columns":
+                        # Spark withColumns: every expression resolves
+                        # against the *pre-step* frame state.
+                        vals = {name: ex.eval(fr) for name, ex in st[1]}
+                        env.update(vals)
+                        changed.update(vals)
+                    else:
+                        # SQL three-valued logic — the SAME helper the
+                        # eager Frame._filter_eager path calls
+                        keep = E.predicate_keep_mask(st[1].eval(fr))
+                        new_mask = jnp.logical_and(new_mask, keep)
+                extras = {name: ex.eval(fr) for name, ex in extra_pairs}
+                return changed, new_mask, extras
+            finally:
+                _RUNTIME_LITS.lits = ()
+
+        # Buffer donation (replaced columns + mask) only pays on
+        # accelerators, where the donated HBM buffer is reused for the
+        # output; on XLA:CPU (unified memory) aliasing buys nothing and
+        # measurably slows the call (~25% on the 20-op bench chain), so
+        # the CPU path keeps the plain signature.
+        if jax.default_backend() == "cpu":
+            self.fn = jax.jit(program)
+        else:
+            self.fn = jax.jit(program, donate_argnums=(1, 2))
+        self.donates = jax.default_backend() != "cpu"
+
+
+_CACHE: "OrderedDict[str, _Plan]" = OrderedDict()
+_CACHE_LOCK = threading.Lock()
+
+
+def clear_cache() -> None:
+    """Drop every compiled plan (tests; conf flips)."""
+    with _CACHE_LOCK:
+        _CACHE.clear()
+
+
+def cache_len() -> int:
+    with _CACHE_LOCK:
+        return len(_CACHE)
+
+
+def _lookup_plan(steps, extra, base_schema):
+    # Probe via the SAME _linearize walk that builds plans: key equality
+    # guarantees the probe's lit order matches the cached program's
+    # _ArgLit slots (the lowered trees are discarded on a hit).
+    key, lits, _steps, _extra, _refs = _linearize(steps, extra, base_schema)
+    lit_values = tuple(
+        v.value.item() if hasattr(v.value, "item") else v.value
+        for v in lits)
+    with _CACHE_LOCK:
+        plan = _CACHE.get(key)
+        if plan is not None:
+            _CACHE.move_to_end(key)
+            return plan, lit_values
+    plan = _Plan(steps, extra, base_schema)
+    with _CACHE_LOCK:
+        _CACHE[plan.key] = plan
+        while len(_CACHE) > int(config.pipeline_cache_size):
+            _CACHE.popitem(last=False)
+            counters.increment("pipeline.evict")
+    return plan, lit_values
+
+
+# ---------------------------------------------------------------------------
+# Padding + execution
+# ---------------------------------------------------------------------------
+
+def _pad(arr, b: int, fresh: bool):
+    """Pad a device column to ``b`` row slots (zero tail). ``fresh``
+    forces a copy even when no padding is needed — required for buffers
+    the compiled call donates (the frame may share the original)."""
+    a = jnp.asarray(arr)
+    n = a.shape[0]
+    if n == b:
+        return jnp.copy(a) if fresh else a
+    fill = jnp.zeros((b - n,) + a.shape[1:], a.dtype)
+    return jnp.concatenate([a, fill], axis=0)
+
+
+@functools.partial(jax.jit, static_argnums=1)
+def _unpad_tree(tree, n: int):
+    """Slice every padded output back to ``n`` rows in ONE dispatch —
+    un-jitted per-array ``a[:n]`` slices cost a dispatch each (~1 ms × 11
+    outputs on the 20-op bench chain, dominating the flush). A trivial
+    memcpy program; its per-(shapes, n) retrace is not a pipeline
+    compile."""
+    return jax.tree_util.tree_map(lambda a: a[:n], tree)
+
+
+def run_pipeline(data: dict, mask, n: int, steps, extra=()):
+    """Execute pending ``steps`` (+ ``extra`` projection expressions) over
+    the base column dict as one compiled program.
+
+    Returns ``(new_data, new_mask, extras)`` where ``new_data`` is a fresh
+    column dict (replaced columns in place, new columns appended),
+    ``new_mask`` the post-filter validity mask, and ``extras`` maps the
+    requested projection names to their arrays — everything sliced back
+    to ``n`` rows. Raises :class:`PipelineError` on any internal failure;
+    callers must fall back to the eager path (never lose correctness to
+    an optimization layer).
+    """
+    counters.increment("pipeline.flush")
+    # BASE schema only (lazy: only referenced columns get dtype probes) —
+    # _lookup_plan/_Plan evolve it step-by-step so a column read before a
+    # later step replaces it stays a base input.
+    schema = LazySchema(data, ())
+    try:
+        b = bucket_size(n)
+        plan, lit_values = _lookup_plan(steps, tuple(extra), schema)
+        before = counters.get("pipeline.compile")
+        kept = {name: _pad(data[name], b, fresh=False)
+                for name in plan.kept}
+        # freshness only matters for buffers the call donates (the frame
+        # may share the originals); _pad's zero fill is False for bool,
+        # so the padded mask tail is invalid by construction
+        donated = tuple(_pad(data[name], b, fresh=plan.donates)
+                        for name in plan.donated)
+        mask_in = _pad(jnp.asarray(mask, jnp.bool_), b, fresh=plan.donates)
+        with warnings.catch_warnings():
+            # donation of a replaced column whose output dtype differs
+            # (int column replaced by a float expression) is unusable —
+            # harmless, and the warning would spam every compile
+            warnings.filterwarnings(
+                "ignore", message=".*[Dd]onated.*", category=UserWarning)
+            span_cm = (_obs.TRACER.span(
+                "frame.pipeline.flush", cat="frame", steps=len(steps),
+                outputs=len(extra), rows=n, bucket=b)
+                if _obs.TRACER.enabled else None)
+            if span_cm is None:
+                changed, new_mask, extras = plan.fn(
+                    kept, donated, mask_in, lit_values)
+                compiled = counters.get("pipeline.compile") > before
+            else:
+                with span_cm as sp:
+                    changed, new_mask, extras = plan.fn(
+                        kept, donated, mask_in, lit_values)
+                    compiled = counters.get("pipeline.compile") > before
+                    sp.set(cache="compile" if compiled else "hit")
+        if not compiled:
+            counters.increment("pipeline.hit")
+        if b != n:
+            changed, new_mask, extras = _unpad_tree(
+                (changed, new_mask, extras), n)
+        new_data = dict(data)
+        new_data.update(changed)
+        return new_data, new_mask, extras
+    except PipelineError:
+        counters.increment("pipeline.fallback")
+        raise
+    except Exception as e:          # any jax/trace surprise → eager replay
+        counters.increment("pipeline.fallback")
+        raise PipelineError(str(e)) from e

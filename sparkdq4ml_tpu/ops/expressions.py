@@ -270,6 +270,19 @@ class Alias(Expr):
         return f"{self.child} AS {self._name}"
 
 
+def predicate_keep_mask(cond):
+    """SQL WHERE truthiness of a predicate column: a NULL predicate (NaN
+    in this engine's float encoding) drops the row — three-valued logic,
+    where a bare ``NaN.astype(bool)`` would be True — and nonzero
+    numerics are true. THE single definition shared by
+    ``Frame._filter_eager`` and the pipeline compiler's fused filter, so
+    the eager and compiled paths cannot diverge on null rows."""
+    cond = jnp.asarray(cond)
+    if jnp.issubdtype(cond.dtype, jnp.floating):
+        return jnp.logical_and(jnp.logical_not(jnp.isnan(cond)), cond != 0)
+    return cond.astype(jnp.bool_)
+
+
 def _sql_divide(a, b):
     """Spark's non-ANSI division: x / 0 is NULL (incl. 0 / 0)."""
     return jnp.where(b == 0, jnp.nan, jnp.divide(a, b))

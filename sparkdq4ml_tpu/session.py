@@ -153,8 +153,47 @@ class TpuSession:
             register_builtin_rules(self.udf)
         self._init_compilation_cache()
         self._init_observability()
+        self._init_pipeline()
         logger.debug("session %r: %d device(s), platform=%s", app_name,
                      self.num_devices, jax.devices()[0].platform)
+
+    def _init_pipeline(self) -> None:
+        """Configure the fused expression-pipeline compiler
+        (``ops/compiler.py``) from session conf — ON by default:
+
+            .config("spark.pipeline.enabled", "false")   # exact eager path
+            .config("spark.pipeline.minBucket", 8)       # padding floor
+            .config("spark.pipeline.cacheSize", 256)     # plan-key LRU
+
+        Flipping ``enabled`` also clears the plan-keyed jit cache so a
+        disable→enable cycle never serves plans compiled under different
+        bucket settings. Settings this session changes are remembered
+        and restored by :meth:`stop` — pipeline conf is session-scoped
+        like the fault plan, never a process-wide leak."""
+        from .config import config as _cfg
+        from .ops import compiler as _compiler
+
+        saved = getattr(self, "_pipeline_saved", None) or {}
+
+        def _set(attr, value):
+            saved.setdefault(attr, getattr(_cfg, attr))
+            setattr(_cfg, attr, value)
+
+        val = str(self.conf.get("spark.pipeline.enabled", "")).lower()
+        if val in ("false", "off", "0"):
+            _set("pipeline", False)
+            _compiler.clear_cache()
+        elif val in ("true", "on", "1"):
+            _set("pipeline", True)
+        if "spark.pipeline.minBucket" in self.conf:
+            _set("pipeline_min_bucket",
+                 int(self.conf["spark.pipeline.minBucket"]))
+            _compiler.clear_cache()
+        if "spark.pipeline.cacheSize" in self.conf:
+            _set("pipeline_cache_size",
+                 int(self.conf["spark.pipeline.cacheSize"]))
+        if saved:
+            self._pipeline_saved = saved
 
     def _init_observability(self) -> None:
         """Install the tracing/metrics subsystem (``utils.observability``)
@@ -481,6 +520,8 @@ class TpuSession:
                 if any(k.startswith("spark.observability.")
                        for k in self._conf):
                     _ACTIVE._init_observability()
+                if any(k.startswith("spark.pipeline.") for k in self._conf):
+                    _ACTIVE._init_pipeline()
             return _ACTIVE
 
         getOrCreate = get_or_create
@@ -593,6 +634,18 @@ class TpuSession:
 
             _obs.disable()
             self._obs_enabled_here = False
+        # Restore pipeline-compiler settings THIS session changed (same
+        # session-scoped rule as the fault plan): a session that disabled
+        # the pipeline must not leave the process on the eager path.
+        saved = getattr(self, "_pipeline_saved", None)
+        if saved:
+            from .config import config as _cfg
+            from .ops import compiler as _compiler
+
+            for attr, value in saved.items():
+                setattr(_cfg, attr, value)
+            self._pipeline_saved = None
+            _compiler.clear_cache()
         # Uninstall the fault plan THIS session installed (conf/env):
         # chaos is session-scoped opt-in; a later chaos-free session (or
         # plain library use) must not keep injecting this one's faults.

@@ -1388,6 +1388,18 @@ def _execute_set(q: Query, cat):
     return frame
 
 
+class _AnyColSchema(dict):
+    """Optimistic column schema for plan_summary's structural fused-stage
+    check: every column resolves as a device column of unknown dtype
+    (``p``), so the check keys on expression FORM only."""
+
+    def get(self, key, default=None):  # noqa: ARG002 - dict signature
+        return "p"
+
+
+_OPTIMISTIC_SCHEMA = _AnyColSchema()
+
+
 _DDL_RE = re.compile(
     r"^\s*create\s+(?:or\s+replace\s+)?(?:temp(?:orary)?\s+)?view\s+"
     r"([A-Za-z_][A-Za-z_0-9]*)\s+as\s+(.*)$",
@@ -1401,7 +1413,21 @@ def plan_summary(q: Query) -> str:
     """``explain()``-style one-line plan for a parsed query — the operator
     chain root-first (the shape Spark's ``explain`` prints), attached to
     every ``sql.query`` span so traces show WHAT a query did, not just its
-    text."""
+    text.
+
+    When the pipeline compiler is on (``spark.pipeline.enabled``, the
+    default) and the WHERE predicate plus every projection expression is
+    *structurally* compilable, the Project+Filter pair of a
+    non-aggregating query prints as ``FusedStage(Project[n] <- Filter)``
+    — one compiled XLA program. Structural means column dtypes are
+    assumed numeric (the plan is summarized before execution binds the
+    frame): a string-COLUMN reference still executes eagerly, but
+    string/UDF/subquery expression forms are detected and keep the
+    unfused ``Project <- Filter`` rendering."""
+    from ..config import config as _cfg
+    from ..frame.aggregates import AggExpr
+    from ..ops.compiler import is_compilable
+
     parts: list[str] = []
     if q.limit is not None:
         parts.append(f"Limit[{q.limit}]")
@@ -1416,9 +1442,20 @@ def plan_summary(q: Query) -> str:
     if q.group_by:
         mode = q.group_mode if q.group_mode != "group" else "groupBy"
         parts.append(f"Aggregate[{mode}:{len(q.group_by)}]")
-    parts.append(f"Project[{len(q.items)}]")
-    if q.where is not None:
-        parts.append("Filter")
+    aggregating = bool(q.group_by) or any(
+        isinstance(it, (AggExpr, PostAggItem)) for it in q.items)
+    fusable = (_cfg.pipeline and q.where is not None and not aggregating
+               and is_compilable(q.where, _OPTIMISTIC_SCHEMA)
+               and all(isinstance(it, str)
+                       or is_compilable(it, _OPTIMISTIC_SCHEMA)
+                       or isinstance(it, E.Col)
+                       for it in q.items))
+    if fusable:
+        parts.append(f"FusedStage(Project[{len(q.items)}] <- Filter)")
+    else:
+        parts.append(f"Project[{len(q.items)}]")
+        if q.where is not None:
+            parts.append("Filter")
     for j in q.joins:
         how = j[1] if len(j) > 1 and isinstance(j[1], str) else "inner"
         parts.append(f"Join[{how}]")
@@ -1481,7 +1518,10 @@ def _execute_statement(sql: str, catalog=None):
 
         return Frame({"__one_row__": [0.0]}).drop("__one_row__").limit(0)
     q = parse(sql)
-    _obs.current_span().set(plan=plan_summary(q))
+    if _obs.TRACER.enabled:
+        # plan_summary walks the WHERE/projection trees — skip the build
+        # entirely when the span is a no-op (the SQL hot path)
+        _obs.current_span().set(plan=plan_summary(q))
     if q.ctes:
         cat = _OverlayCatalog(cat)
         for name, sub in q.ctes:

@@ -497,8 +497,11 @@ class TestSqlSpans:
         assert len(sql_spans) == 1
         s = sql_spans[0]
         assert "SELECT a FROM t" in s.attrs["query"]
-        assert s.attrs["plan"] == ("Limit[5] <- Sort[1] <- Project[1] "
-                                   "<- Filter <- Scan[t]")
+        # Project+Filter print as one FusedStage when the pipeline
+        # compiler is on (the default) — the stage boundary marker
+        assert s.attrs["plan"] == (
+            "Limit[5] <- Sort[1] <- FusedStage(Project[1] <- Filter) "
+            "<- Scan[t]")
         assert s.attrs["rows_out"] == out.num_slots
         # frame ops executed by the query nest under it
         frame_children = [c for c in obs.TRACER.spans()
