@@ -300,6 +300,109 @@ def bench_frame_pipeline(median_time, n_rows: int):
     }
 
 
+def bench_grouped_ops(median_time):
+    """(grouped_ops) Device-resident grouped execution (ops/segments.py)
+    vs the legacy host numpy path: groupBy().agg() across a rows × groups
+    grid, plus sort and distinct — the ISSUE-4 acceptance surface. The
+    device path is ONE jitted sort + segment-reduce program whose only
+    host sync is the group count; the host path loops Python over groups.
+    Compile counters prove the plan-keyed cache replays warm
+    (compiles_steady=0 across repeated queries)."""
+    import jax
+    import numpy as np
+
+    from sparkdq4ml_tpu.config import config
+    from sparkdq4ml_tpu.frame import aggregates as A
+    from sparkdq4ml_tpu.frame.frame import Frame
+    from sparkdq4ml_tpu.ops import segments
+    from sparkdq4ml_tpu.utils.profiling import counters
+
+    if SMOKE:
+        rows_sweep, groups_sweep = [100_000], [8, 1024]
+    else:
+        rows_sweep = [100_000, 1_000_000, 10_000_000]
+        groups_sweep = [8, 1024, 100_000]
+    # grouped ops run 10-10000x longer per call than the sub-ms fit
+    # configs, so the global REPS=30 would push this section past the
+    # bench lock window: 3 device reps / 1 host rep give a stable median
+    # (the host path is a Python loop over groups; one rep keeps the
+    # 1e7x100k cell from dominating wall-clock), and the sort/distinct
+    # sweeps stop at 1e6 rows (logged, not silently dropped) — the 1e7
+    # distinct host walk alone is ~a minute per rep.
+    dev_reps = REPS if SMOKE else 3
+    host_reps = REPS if SMOKE else 1
+    out = []
+    prev = config.grouped_exec
+    for n_rows in rows_sweep:
+        for n_groups in groups_sweep:
+            if n_groups * 4 > n_rows:
+                continue
+            rng = np.random.default_rng(42)
+            frame = Frame({
+                "k": rng.integers(0, n_groups, n_rows).astype(np.float64),
+                "v": rng.normal(size=n_rows),
+            }).cache()
+            aggs = [A.count(), A.sum("v"), A.avg("v"), A.min("v"),
+                    A.max("v")]
+            # honest GB/s denominators: agg and sort stream both float64
+            # columns (k + v = 16 B/row); distinct runs on select("k")
+            # and touches only the 8-byte key column
+            op_bytes = {"agg": n_rows * 16, "sort": n_rows * 16,
+                        "distinct": n_rows * 8}
+
+            def run_agg():
+                res = frame.group_by("k").agg(*aggs)
+                jax.block_until_ready(
+                    [c for c in res._data.values()
+                     if getattr(c, "dtype", None) != object])
+
+            def run_sort():
+                res = frame.sort("v")
+                jax.block_until_ready(list(res._data.values()))
+
+            def run_distinct():
+                res = frame.select("k").distinct()
+                jax.block_until_ready(list(res._data.values()))
+
+            ops = [("agg", run_agg)]
+            if n_rows <= 1_000_000:
+                ops += [("sort", run_sort), ("distinct", run_distinct)]
+            elif n_groups == groups_sweep[0]:
+                log(json.dumps({"config": "grouped_ops", "rows": n_rows,
+                                "note": "sort/distinct capped at 1e6 rows"
+                                        " (host walk ~minutes beyond)"}))
+            row = {"config": "grouped_ops", "rows": n_rows,
+                   "groups": n_groups}
+            try:
+                config.grouped_exec = True
+                segments.clear_cache()
+                counters.clear("grouped")
+                for name, fn in ops:
+                    before = counters.get("grouped.compile")
+                    fn()                         # cold: trace + compile
+                    cold = counters.get("grouped.compile") - before
+                    t_dev = median_time(fn, dev_reps)
+                    steady = counters.get("grouped.compile") - before - cold
+                    config.grouped_exec = False
+                    try:
+                        fn()                     # warm host-path caches
+                        t_host = median_time(fn, host_reps)
+                    finally:
+                        config.grouped_exec = True
+                    row[f"{name}_device_ms"] = round(t_dev * 1e3, 3)
+                    row[f"{name}_host_ms"] = round(t_host * 1e3, 3)
+                    row[f"{name}_speedup"] = round(t_host / t_dev, 2)
+                    row[f"{name}_device_gbps"] = round(
+                        op_bytes[name] / t_dev / 1e9, 3)
+                    row[f"{name}_compiles_cold"] = cold
+                    row[f"{name}_compiles_steady"] = steady
+            finally:
+                config.grouped_exec = prev
+            out.append(row)
+            log(json.dumps(row))
+    return out
+
+
 def _acquire_bench_lock(wait_s: float = 1200.0):
     """Serialize bench runs across processes via an exclusive flock.
 
@@ -789,6 +892,10 @@ def main():
     n_fp = 100_000 if SMOKE else 1_000_000
     frame_pipeline = bench_frame_pipeline(median_time, n_fp)
 
+    # (grouped_ops) device-resident groupBy/sort/distinct vs the host
+    # numpy path (ops/segments.py) across a rows × groups grid
+    grouped_ops = bench_grouped_ops(median_time)
+
     # (e) baseline: sklearn GridSearchCV, same 3x3 grid / folds / family,
     # refit=True to match the in-program best-model refit
     t_e_cpu = None
@@ -971,6 +1078,7 @@ def main():
         "vs_baseline": round(t_a_cpu / t_a, 3) if t_a else None,
         "configs": configs,
         "frame_pipeline": frame_pipeline,
+        "grouped_ops": grouped_ops,
         "sweep": sweep_rows,
         "pallas_max_rel_diff": max((float(d) for _, d in pallas_diffs),
                                    default=None),

@@ -39,6 +39,11 @@ class _Config:
     pipeline_exact_threshold: int = 1 << 17
     # Bounded LRU size of the plan-keyed jit cache.
     pipeline_cache_size: int = 256
+    # Device-resident grouped execution (ops/segments.py): numeric
+    # groupBy/sort/distinct lower to one jitted program (device sort +
+    # segment reductions) instead of the host numpy boundary
+    # (spark.groupedExec.enabled conf; False restores the legacy path).
+    grouped_exec: bool = True
     # Pallas fast-path selection for the hot ops (ops/pallas_kernels.py):
     # the single-device Gramian in solvers.augmented_gram and the fused DQ
     # chain entry point ops/rules.py:dq_rules_fused. "off" = plain XLA

@@ -1,14 +1,18 @@
-"""Aggregations: global (device, mask-weighted) and grouped (host boundary).
+"""Aggregations: global (device, mask-weighted) and grouped (device-first).
 
 Design note: global aggregates (``df.agg``, ``describe``) are masked device
 reductions — one fused kernel per call, honoring the validity mask exactly
-like the fit statistics. Grouped aggregation keys are data-dependent
-(dynamic shapes), which XLA cannot compile statically; group discovery
-therefore happens at the host boundary (numpy) and per-group reductions use
-vectorized numpy — the same "gather at the boundary, never in the compute
-path" rule as ``Frame.to_pydict``. For this framework's workload scale
-(SURVEY.md §0: the engine's rows are catering records, not tokens) this is
-the honest design; the device path is reserved for the numeric hot loops.
+like the fit statistics. Grouped aggregation over NUMERIC keys and the
+compilable aggregate family lowers to ONE jitted device program
+(``ops/segments.py``: on-device lexicographic key sort + segment-boundary
+discovery + ``segment_*`` reductions) whose only host sync is the final
+group count. Everything outside that surface — string keys, host-object
+aggregates (``collect_list``, ``percentile_approx``, the two-column
+family), grouped-map UDFs — takes the original host boundary: group
+discovery with numpy lexsort and vectorized per-group numpy reductions,
+the same "gather at the boundary, never in the compute path" rule as
+``Frame.to_pydict``. ``spark.groupedExec.enabled=false`` restores the
+host path for everything (bit-identical results either way).
 """
 
 from __future__ import annotations
@@ -632,6 +636,20 @@ class GroupedFrame(_AggShortcuts):
         if not agg_list:
             raise ValueError("agg() needs at least one aggregate")
         frame_src, agg_list = materialize_agg_exprs(self._frame, agg_list)
+
+        # Device-resident path first (ops/segments.py): one jitted
+        # segment-reduce program, one host sync (the group count). Any
+        # ineligible plan (string keys, host-object aggs) or internal
+        # failure falls back to the host path below via the shared
+        # try_device protocol — the optimization layer must never change
+        # results.
+        from ..ops import segments
+
+        out = segments.try_device(
+            "grouped_agg",
+            lambda: segments.grouped_agg(frame_src, self._keys, agg_list))
+        if out is not None:
+            return out
 
         d = frame_src.to_pydict()  # host boundary: one gather
         key_cols = [np.asarray(d[k]) for k in self._keys]

@@ -68,6 +68,46 @@ def _is_string_col(arr) -> bool:
     return isinstance(arr, np.ndarray) and arr.dtype == object
 
 
+def lexsort_keys(arrays, ascending, nulls_first):
+    """THE lexsort component construction for row ordering — shared by the
+    host ``Frame.sort`` path and the grouped engine's CPU sort plan
+    (``ops/segments._host_sort_plan``), so null placement and direction
+    semantics cannot drift between them.
+
+    ``arrays`` are per-key numpy arrays (original key order); returns the
+    ``np.lexsort`` key list. Per key, appended last = higher priority:
+    the null flag partitions each key before its values order within
+    (False sorts first, so nulls-first wants nulls=False). Default null
+    placement (``nulls_first[i] is None``) is Spark's: first ascending,
+    last descending. NaN is the numeric null; None the string null;
+    descending string keys are not supported (raises)."""
+    keys = []
+    for k, a, nf in zip(reversed(arrays), reversed(ascending),
+                        reversed(nulls_first)):
+        if nf is None:
+            nf = a                 # Spark default: asc→first, desc→last
+        k = np.asarray(k)
+        if k.dtype == object:
+            if not a:
+                raise ValueError("descending sort on string columns is "
+                                 "not supported")
+            null_flag = np.asarray([x is None for x in k], bool)
+            keys.append(np.asarray([x if x is not None else "" for x in k],
+                                   dtype=object))
+        else:
+            if k.dtype == np.bool_:
+                k = k.astype(np.int8)   # numpy forbids unary minus on bool
+            null_flag = np.isnan(k) if np.issubdtype(
+                k.dtype, np.floating) else np.zeros(len(k), bool)
+            v = -k if not a else k
+            # NaN would float to the end inside lexsort regardless of
+            # the flag key; neutralize it so the flag alone decides
+            keys.append(np.where(null_flag, 0.0, v)
+                        if null_flag.any() else v)
+        keys.append(~null_flag if nf else null_flag)
+    return keys
+
+
 def _vector_join_plan(lcols, rcols, li, ri, how):
     """Vectorized hash-join *plan* for all-numeric keys — (lpairs, rpairs)
     row-index arrays, or None when ineligible (non-finite float keys, or
@@ -1481,33 +1521,20 @@ class Frame:
                     "add it with with_column first)")
             resolved.append(name)
         cols = resolved
+        # Device path (ops/segments.py): numeric sort keys compute the
+        # permutation on device (jax.lax.sort) and gather payload with
+        # jnp.take — one host sync (the valid-row count) instead of the
+        # full round trip. String keys / failures take the host lexsort.
+        from ..ops import segments
+
+        out = segments.try_device(
+            "sort", lambda: segments.device_sort(self, cols, asc,
+                                                 nulls_first))
+        if out is not None:
+            return out
         d = self.to_pydict()
-        keys = []
-        for c, a, nf in zip(reversed(cols), reversed(asc),
-                            reversed(nulls_first)):
-            if nf is None:
-                nf = a                 # Spark default: asc→first, desc→last
-            k = np.asarray(d[c])
-            if k.dtype == object:
-                if not a:
-                    raise ValueError("descending sort on string columns is "
-                                     "not supported")
-                null_flag = np.asarray([x is None for x in k], bool)
-                keys.append(np.asarray([x if x is not None else "" for x in k],
-                                       dtype=object))
-            else:
-                null_flag = np.isnan(k) if np.issubdtype(
-                    k.dtype, np.floating) else np.zeros(len(k), bool)
-                v = -k if not a else k
-                # NaN would float to the end inside lexsort regardless of
-                # the flag key; neutralize it so the flag alone decides
-                keys.append(np.where(null_flag, 0.0, v)
-                            if null_flag.any() else v)
-            # appended last = higher lexsort priority: the null flag
-            # partitions each key before its values order within
-            # (False sorts first, so nulls-first wants nulls=False)
-            keys.append(~null_flag if nf else null_flag)
-        order = np.lexsort(keys)
+        order = np.lexsort(lexsort_keys([d[c] for c in cols], asc,
+                                        nulls_first))
         return Frame({name: (vals[order] if vals.dtype == object
                              else np.asarray(vals)[order])
                       for name, vals in d.items()})
@@ -1520,9 +1547,17 @@ class Frame:
 
     @op_span("frame.distinct")
     def distinct(self) -> "Frame":
-        """Unique valid rows (host boundary; result compact, order of first
-        occurrence). Null-safe like Spark: null rows equal each other, so
-        duplicates with NaN/None cells collapse too."""
+        """Unique valid rows (result compact, order of first occurrence).
+        Null-safe like Spark: null rows equal each other, so duplicates
+        with NaN/None cells collapse too. All-numeric frames dedup on
+        device (ops/segments.py: one sort + boundary program, one host
+        sync); any string column falls back to the host row walk."""
+        from ..ops import segments
+
+        out = segments.try_device(
+            "distinct", lambda: segments.device_unique(self, self.columns))
+        if out is not None:
+            return out
         seen = set()
         out = []
         for key, r in self._keyed_rows():
@@ -1543,10 +1578,26 @@ class Frame:
         for c in subset:
             if c not in self.columns:
                 raise ValueError(f"dropDuplicates column {c!r} not found")
+        # Numeric 1-D subset keys dedup on device (same kernel as
+        # distinct); vector-cell keys stay host-side — the host path
+        # treats NaN components of a vector cell as distinct (NaN != NaN
+        # inside the tuple key) while scalar NaN keys fold, and the
+        # device kernel implements only the scalar fold.
+        if all(getattr(self._data.get(c), "ndim", 1) == 1
+               for c in subset):
+            from ..ops import segments
+
+            out = segments.try_device(
+                "drop_duplicates",
+                lambda: segments.device_unique(self, subset))
+            if out is not None:
+                return out
         idx = np.nonzero(self._host_mask())[0]
         seen = set()
         keep = []
         keycols = [np.asarray(self._column_values(c)) for c in subset]
+        if any(not _is_string_col(self._data[c]) for c in subset):
+            counters.increment("frame.host_sync")  # device key-column pull
 
         def cell_key(cell):
             a = np.asarray(cell)
@@ -1615,9 +1666,26 @@ class Frame:
         if how == "cross":
             lpairs = np.repeat(li, len(ri))
             rpairs = np.tile(ri, len(li))
+        elif ri.size == 0:
+            # Empty group table (right side has zero valid rows): the
+            # plan is fully determined without building one — inner /
+            # right / semi match nothing, left / outer / anti keep every
+            # left row (null-filled right columns via the -1 sentinel).
+            # Guarding here keeps the searchsorted clamp in
+            # _vector_join_plan (gvals.size - 1) unreachable at size 0.
+            if how in ("inner", "right", "left_semi"):
+                lpairs = np.empty(0, np.int64)
+                rpairs = np.empty(0, np.int64)
+            else:                       # left / outer / left_anti
+                lpairs = li.astype(np.int64)
+                rpairs = np.full(li.size, -1, np.int64)
         else:
             # key columns materialize ONCE; the vector plan and the dict
-            # fallback share them (a plan bail-out must not re-read)
+            # fallback share them (a plan bail-out must not re-read).
+            # Each side's device-key pull counts as one host sync batch.
+            for fr in (self, other):
+                if any(not _is_string_col(fr._data[k]) for k in keys):
+                    counters.increment("frame.host_sync")
             lraw = [np.asarray(self._column_values(k))[li] for k in keys]
             rraw = [np.asarray(other._column_values(k))[ri] for k in keys]
             plan = None

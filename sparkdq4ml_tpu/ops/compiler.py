@@ -62,8 +62,8 @@ from ..utils.profiling import counters
 from . import expressions as E
 
 __all__ = [
-    "bucket_size", "is_compilable", "run_pipeline", "clear_cache",
-    "cache_len", "PipelineError",
+    "bucket_size", "pad_rows", "dtype_tag", "is_compilable",
+    "run_pipeline", "clear_cache", "cache_len", "PipelineError",
 ]
 
 
@@ -193,8 +193,17 @@ def _dtype_tag() -> str:
     """Engine dtype fingerprint prefixed to every plan key: expression
     eval bakes ``float_dtype()``/``int_dtype()`` into the program (e.g.
     ``/`` casts to the configured float), so a config flip (tests switch
-    float32 ↔ float64) must miss the cache, not serve stale dtypes."""
+    float32 ↔ float64) must miss the cache, not serve stale dtypes.
+
+    Shared plan-key infrastructure: ``ops/segments.py`` (the grouped
+    execution engine) prefixes its grouped/sort/unique plan keys with the
+    same tag, and reuses :func:`bucket_size`/:func:`pad_rows` so both
+    caches share one bucketing discipline."""
     return f"{np.dtype(float_dtype()).str}/{np.dtype(int_dtype()).str}"
+
+
+# public aliases for the cross-module plan-cache contract (segments.py)
+dtype_tag = _dtype_tag
 
 
 def is_compilable(expr, schema: dict) -> bool:
@@ -656,13 +665,18 @@ def _lookup_plan(steps, extra, base_schema):
 def _pad(arr, b: int, fresh: bool):
     """Pad a device column to ``b`` row slots (zero tail). ``fresh``
     forces a copy even when no padding is needed — required for buffers
-    the compiled call donates (the frame may share the original)."""
+    the compiled call donates (the frame may share the original).
+    Public as :data:`pad_rows` — the grouped engine (``ops/segments.py``)
+    pads its key/value/mask inputs with the same helper."""
     a = jnp.asarray(arr)
     n = a.shape[0]
     if n == b:
         return jnp.copy(a) if fresh else a
     fill = jnp.zeros((b - n,) + a.shape[1:], a.dtype)
     return jnp.concatenate([a, fill], axis=0)
+
+
+pad_rows = _pad
 
 
 @functools.partial(jax.jit, static_argnums=1)

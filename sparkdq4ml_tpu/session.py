@@ -192,6 +192,17 @@ class TpuSession:
         if "spark.pipeline.cacheSize" in self.conf:
             _set("pipeline_cache_size",
                  int(self.conf["spark.pipeline.cacheSize"]))
+        # Device-resident grouped execution (ops/segments.py) rides the
+        # same session-scoped save/restore:
+        #     .config("spark.groupedExec.enabled", "false")  # host groupBy
+        gval = str(self.conf.get("spark.groupedExec.enabled", "")).lower()
+        if gval in ("false", "off", "0"):
+            from .ops import segments as _segments
+
+            _set("grouped_exec", False)
+            _segments.clear_cache()
+        elif gval in ("true", "on", "1"):
+            _set("grouped_exec", True)
         if saved:
             self._pipeline_saved = saved
 
@@ -646,6 +657,9 @@ class TpuSession:
                 setattr(_cfg, attr, value)
             self._pipeline_saved = None
             _compiler.clear_cache()
+            from .ops import segments as _segments
+
+            _segments.clear_cache()
         # Uninstall the fault plan THIS session installed (conf/env):
         # chaos is session-scoped opt-in; a later chaos-free session (or
         # plain library use) must not keep injecting this one's faults.

@@ -1400,6 +1400,27 @@ class _AnyColSchema(dict):
 _OPTIMISTIC_SCHEMA = _AnyColSchema()
 
 
+def _segment_lowerable_aggs(items) -> bool:
+    """Structural check for the ``SegmentedAggregate`` plan marker: every
+    aggregate in the select list (including the components of post-agg
+    expressions) passes the executor's OWN eligibility predicate
+    (``segments.agg_lowerable`` — one definition, marker and executor in
+    lockstep) — same optimistic-dtype convention as the FusedStage
+    check."""
+    from ..frame.aggregates import AggExpr
+    from ..ops.segments import agg_lowerable
+
+    found = False
+    for it in items:
+        aggs = (it.aggs if isinstance(it, PostAggItem)
+                else [it] if isinstance(it, AggExpr) else [])
+        for a in aggs:
+            found = True
+            if not agg_lowerable(a):
+                return False
+    return found
+
+
 _DDL_RE = re.compile(
     r"^\s*create\s+(?:or\s+replace\s+)?(?:temp(?:orary)?\s+)?view\s+"
     r"([A-Za-z_][A-Za-z_0-9]*)\s+as\s+(.*)$",
@@ -1423,7 +1444,16 @@ def plan_summary(q: Query) -> str:
     assumed numeric (the plan is summarized before execution binds the
     frame): a string-COLUMN reference still executes eagerly, but
     string/UDF/subquery expression forms are detected and keep the
-    unfused ``Project <- Filter`` rendering."""
+    unfused ``Project <- Filter`` rendering.
+
+    Grouped execution markers follow the same structural rule: with
+    ``spark.groupedExec.enabled`` (the default), ``ORDER BY`` prints as
+    ``DeviceSort[n]`` (one on-device ``lax.sort`` program) and a plain
+    ``GROUP BY`` whose aggregates are all segment-lowerable prints as
+    ``SegmentedAggregate[groupBy:n]`` (one sort + segment-reduce
+    program, see ``ops/segments.py``); a string key discovered at
+    execution time silently takes the host fallback, exactly like a
+    string column under ``FusedStage``."""
     from ..config import config as _cfg
     from ..frame.aggregates import AggExpr
     from ..ops.compiler import is_compilable
@@ -1434,14 +1464,19 @@ def plan_summary(q: Query) -> str:
     if q.offset:
         parts.append(f"Offset[{q.offset}]")
     if q.order_by:
-        parts.append(f"Sort[{len(q.order_by)}]")
+        parts.append(f"DeviceSort[{len(q.order_by)}]"
+                     if _cfg.grouped_exec else f"Sort[{len(q.order_by)}]")
     if q.distinct:
         parts.append("Distinct")
     if q.having is not None:
         parts.append("Having")
     if q.group_by:
         mode = q.group_mode if q.group_mode != "group" else "groupBy"
-        parts.append(f"Aggregate[{mode}:{len(q.group_by)}]")
+        segmented = (_cfg.grouped_exec and q.group_mode == "group"
+                     and _segment_lowerable_aggs(q.items))
+        parts.append(
+            f"SegmentedAggregate[{mode}:{len(q.group_by)}]" if segmented
+            else f"Aggregate[{mode}:{len(q.group_by)}]")
     aggregating = bool(q.group_by) or any(
         isinstance(it, (AggExpr, PostAggItem)) for it in q.items)
     fusable = (_cfg.pipeline and q.where is not None and not aggregating

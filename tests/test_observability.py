@@ -498,9 +498,10 @@ class TestSqlSpans:
         s = sql_spans[0]
         assert "SELECT a FROM t" in s.attrs["query"]
         # Project+Filter print as one FusedStage when the pipeline
-        # compiler is on (the default) — the stage boundary marker
+        # compiler is on (the default) — the stage boundary marker; the
+        # ORDER BY prints as DeviceSort under grouped execution (PR 4)
         assert s.attrs["plan"] == (
-            "Limit[5] <- Sort[1] <- FusedStage(Project[1] <- Filter) "
+            "Limit[5] <- DeviceSort[1] <- FusedStage(Project[1] <- Filter) "
             "<- Scan[t]")
         assert s.attrs["rows_out"] == out.num_slots
         # frame ops executed by the query nest under it
