@@ -1,0 +1,209 @@
+#!/usr/bin/env python3
+"""Bench-regression gate: compare the two newest ``BENCH_r*.json`` runs.
+
+CI/tooling guard for the ROADMAP's "fast as the hardware allows" north
+star: every perf PR must be able to PROVE it didn't regress the previous
+round. The newest bench snapshot is compared to the one before it and the
+script exits 1 when any SHARED metric regressed by more than the
+threshold (default 15%).
+
+Metric direction is inferred from the key name — the bench JSON's own
+vocabulary:
+
+* lower-is-better:  ``*_ms``, ``*_s``, ``*_secs``, ``*_seconds``,
+  ``*time*``
+* higher-is-better: ``*gbps``, ``*gb_s``, ``vs_baseline``, ``*speedup``,
+  ``*throughput*``, ``*rows_per*``
+
+Anything else (row counts, iteration counts, file sizes) is not a
+performance metric and is ignored. Only metrics present in BOTH runs
+compare — a new bench section cannot fail the gate, a removed one cannot
+hide a regression in what remains.
+
+Snapshot formats accepted per file, in order of preference:
+
+1. the bench document itself (``{"configs": [...], "sweep": [...]}``),
+2. a capture wrapper with a ``parsed`` field holding that document,
+3. a capture wrapper whose ``tail`` string contains the document (the
+   driver truncates; unparseable tails make the file unusable).
+
+A run that cannot produce metrics is reported and skipped (exit 0 with a
+warning): the gate must not fail CI because a capture was truncated.
+
+Usage::
+
+    python scripts/check_bench_regress.py [--dir REPO] [--threshold 0.15]
+    python scripts/check_bench_regress.py --old OLD.json --new NEW.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+_LOWER_RE = re.compile(r"(_ms$|_s$|_secs$|_seconds$|time)")
+_HIGHER_RE = re.compile(
+    r"(gbps|gb_s|vs_baseline|speedup|throughput|rows_per)")
+
+
+def metric_direction(key: str):
+    """``"lower"`` / ``"higher"`` / None (not a perf metric). The leaf
+    key decides — path components only qualify WHICH metric it is."""
+    leaf = key.rsplit("/", 1)[-1].lower()
+    if _HIGHER_RE.search(leaf):
+        return "higher"
+    if _LOWER_RE.search(leaf):
+        return "lower"
+    return None
+
+
+def _list_key(item: dict) -> str:
+    """Stable identity for a list element: benches key their rows by
+    ``config`` name or by the (rows, features) sweep point."""
+    if isinstance(item, dict):
+        if "config" in item:
+            return str(item["config"])
+        if "rows" in item:
+            return f"r{item.get('rows')}x{item.get('features', '')}"
+        if "metric" in item:
+            return str(item["metric"])
+    return ""
+
+
+def flatten_metrics(doc, prefix: str = "") -> dict:
+    """``{path: float}`` over every numeric leaf whose name reads as a
+    perf metric (see :func:`metric_direction`)."""
+    out: dict[str, float] = {}
+    if isinstance(doc, dict):
+        for k, v in doc.items():
+            out.update(flatten_metrics(v, f"{prefix}/{k}" if prefix else k))
+    elif isinstance(doc, list):
+        for i, item in enumerate(doc):
+            key = _list_key(item) or str(i)
+            out.update(flatten_metrics(item, f"{prefix}/{key}"))
+    elif isinstance(doc, (int, float)) and not isinstance(doc, bool):
+        if metric_direction(prefix) is not None:
+            out[prefix] = float(doc)
+    return out
+
+
+def load_bench_doc(path: str):
+    """Extract the bench document from a snapshot file (see module
+    docstring); None when nothing parseable is found."""
+    try:
+        with open(path) as f:
+            raw = json.load(f)
+    except (OSError, ValueError) as e:
+        print(f"WARN: {path}: unreadable ({e})")
+        return None
+    if not isinstance(raw, dict):
+        return None
+    if any(k in raw for k in ("configs", "sweep", "frame_pipeline",
+                              "grouped_ops")):
+        return raw
+    if isinstance(raw.get("parsed"), dict):
+        return raw["parsed"]
+    tail = raw.get("tail")
+    if isinstance(tail, str):
+        # the capture tail usually truncates the FRONT of the dump; try
+        # the whole string, then the largest {...} suffix-balanced block
+        for cand in (tail, tail[tail.find("{"):]):
+            try:
+                doc = json.loads(cand)
+                if isinstance(doc, dict):
+                    return doc
+            except ValueError:
+                continue
+    return None
+
+
+def find_latest_pair(bench_dir: str):
+    """The two newest ``BENCH_r<NN>.json`` by round number, or None."""
+    rounds = []
+    for p in glob.glob(os.path.join(bench_dir, "BENCH_r*.json")):
+        m = re.search(r"BENCH_r(\d+)\.json$", p)
+        if m:
+            rounds.append((int(m.group(1)), p))
+    rounds.sort()
+    if len(rounds) < 2:
+        return None
+    return rounds[-2][1], rounds[-1][1]
+
+
+def compare(old_metrics: dict, new_metrics: dict,
+            threshold: float) -> list[dict]:
+    """Regressions among shared metrics: change worse than ``threshold``
+    (relative) against the metric's direction."""
+    out = []
+    for key in sorted(set(old_metrics) & set(new_metrics)):
+        old, new = old_metrics[key], new_metrics[key]
+        if old <= 0 or new < 0:        # degenerate/zero baselines: skip
+            continue
+        direction = metric_direction(key)
+        rel = (new - old) / old
+        regressed = (rel > threshold if direction == "lower"
+                     else rel < -threshold)
+        if regressed:
+            out.append({"metric": key, "old": old, "new": new,
+                        "change": rel, "direction": direction})
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--dir", default=os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))),
+        help="directory holding BENCH_r*.json (default: repo root)")
+    ap.add_argument("--old", help="explicit older snapshot")
+    ap.add_argument("--new", help="explicit newer snapshot")
+    ap.add_argument("--threshold", type=float, default=0.15,
+                    help="relative regression tolerance (default 0.15)")
+    args = ap.parse_args(argv)
+
+    if bool(args.old) != bool(args.new):
+        ap.error("--old and --new must be given together")
+    if args.old:
+        old_path, new_path = args.old, args.new
+    else:
+        pair = find_latest_pair(args.dir)
+        if pair is None:
+            print("SKIP: fewer than two BENCH_r*.json snapshots")
+            return 0
+        old_path, new_path = pair
+
+    old_doc = load_bench_doc(old_path)
+    new_doc = load_bench_doc(new_path)
+    if old_doc is None or new_doc is None:
+        which = old_path if old_doc is None else new_path
+        print(f"SKIP: no parseable bench document in {which}")
+        return 0
+    old_metrics = flatten_metrics(old_doc)
+    new_metrics = flatten_metrics(new_doc)
+    shared = set(old_metrics) & set(new_metrics)
+    if not shared:
+        print("SKIP: no shared perf metrics between "
+              f"{old_path} and {new_path}")
+        return 0
+
+    regressions = compare(old_metrics, new_metrics, args.threshold)
+    print(f"compared {len(shared)} shared metrics: "
+          f"{os.path.basename(old_path)} -> {os.path.basename(new_path)} "
+          f"(threshold {args.threshold:.0%})")
+    if not regressions:
+        print("PASS: no regression beyond threshold")
+        return 0
+    for r in regressions:
+        arrow = "slower" if r["direction"] == "lower" else "lower"
+        print(f"FAIL: {r['metric']}: {r['old']:g} -> {r['new']:g} "
+              f"({r['change']:+.1%}, {arrow} is worse)")
+    print(f"{len(regressions)} metric(s) regressed > "
+          f"{args.threshold:.0%}")
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -44,6 +44,14 @@ class _Config:
     # segment reductions) instead of the host numpy boundary
     # (spark.groupedExec.enabled conf; False restores the legacy path).
     grouped_exec: bool = True
+    # EXPLAIN ANALYZE (sql/parser.py): sample device memory at span
+    # boundaries during the analyzed query (spark.explain.memory conf) —
+    # a live-array census per span; off leaves peak_mem unattributed.
+    explain_memory: bool = True
+    # Append the jit-cache introspection section (one line per compiled
+    # program the query touched) to EXPLAIN ANALYZE output
+    # (spark.explain.caches conf).
+    explain_caches: bool = True
     # Pallas fast-path selection for the hot ops (ops/pallas_kernels.py):
     # the single-device Gramian in solvers.augmented_gram and the fused DQ
     # chain entry point ops/rules.py:dq_rules_fused. "off" = plain XLA

@@ -1196,9 +1196,47 @@ class Frame:
         out._alias = name
         return out
 
-    def explain(self, extended: bool = False) -> None:
+    def explain(self, extended: bool = False, analyze: bool = False) -> None:
         """Describe the physical representation (the eager-engine analogue
-        of Spark's plan dump): columns, dtypes, placement, mask stats."""
+        of Spark's plan dump): columns, dtypes, placement, mask stats.
+
+        ``analyze=True`` additionally EXECUTES the frame's pending fused
+        pipeline under a per-query stats collector and appends the
+        measured flush profile — one line per recorded span (wall ms,
+        rows, compile-vs-cache-hit verdict, host syncs, peak device
+        bytes) plus the query-level counter deltas. An already-
+        materialized frame reports an empty analyze section (nothing left
+        to execute) — the informative call site is right after building a
+        lazy op chain."""
+        print(self.explain_string(extended=extended, analyze=analyze))
+
+    def explain_string(self, extended: bool = False,
+                       analyze: bool = False) -> str:
+        """The text :meth:`explain` prints (testable surface)."""
+        analyzed: list[str] = []
+        if analyze:
+            # run BEFORE the physical description below reads _data/_mask
+            # (its count() would silently flush the pending steps outside
+            # the measurement window)
+            from ..config import config as _config
+            from ..utils import observability as _obs
+            from ..utils.logging import format_kv
+
+            with _obs.query_stats(
+                    sample_memory=_config.explain_memory) as qs:
+                jax.block_until_ready(self._mask)   # flush + honest wait
+            analyzed.append("== Analyzed ==")
+            for s in qs.spans:
+                attrs = {k: v for k, v in s.attrs.items() if v is not None}
+                kv = format_kv(dur_ms=round((s.dur_us or 0) / 1e3, 3),
+                               **attrs)
+                analyzed.append(f"  {s.name}" + (f"  {kv}" if kv else ""))
+            delta = qs.counter_delta()
+            if delta:
+                analyzed.append("  counters: " + format_kv(**delta))
+            if not qs.spans:
+                analyzed.append("  (nothing pending — frame already "
+                                "materialized)")
         n_valid = self.count()
         lines = ["== Physical Frame =="]
         lines.append(f"row slots: {self.num_slots} (valid: {n_valid}, "
@@ -1215,7 +1253,7 @@ class Frame:
             lines.append(f"devices: {sorted(devs) or ['host']}")
             lines.append("execution: eager columnar; filters are validity-"
                          "mask AND; XLA fuses expression chains under jit")
-        print("\n".join(lines))
+        return "\n".join(lines + analyzed)
 
     # -- actions -----------------------------------------------------------
     def count(self) -> int:

@@ -46,16 +46,22 @@ class FrameStatFunctions:
 
     def corr(self, col1: str, col2: str, method: str = "pearson") -> float:
         """Pearson (or Spearman rank) correlation of two numeric columns."""
+        from ..utils.profiling import counters
+
         a, b, w = self._pair(col1, col2)
         if method == "spearman":
             a, b = _rank(a, w), _rank(b, w)
         elif method != "pearson":
             raise ValueError(f"unknown correlation method {method!r}")
+        counters.increment("frame.host_sync")  # device scalar → float
         return float(_corr_cov(a, b, w)[0])
 
     def cov(self, col1: str, col2: str) -> float:
         """Sample covariance (n−1 denominator, like Spark)."""
+        from ..utils.profiling import counters
+
         a, b, w = self._pair(col1, col2)
+        counters.increment("frame.host_sync")  # device scalar → float
         return float(_corr_cov(a, b, w)[1])
 
     def approx_quantile(self, col: str, probabilities, relative_error=0.0):
@@ -63,7 +69,10 @@ class FrameStatFunctions:
         to bound executor memory; here an exact device sort is both cheaper
         and exact at any size XLA can sort, so ``relative_error`` is
         accepted for API compatibility and ignored."""
+        from ..utils.profiling import counters
+
         a = jnp.asarray(self._frame._column_values(col), float_dtype())
+        counters.increment("frame.host_sync")  # mask + column pull, one batch
         keep = np.asarray(self._frame.mask)
         vals = np.sort(np.asarray(a)[keep])
         if len(vals) == 0:
@@ -104,6 +113,10 @@ class FrameStatFunctions:
                 raise ValueError(
                     f"fraction for stratum {k!r} must be in [0, 1], got {f}")
         vals = self._frame._column_values(col)
+        if vals.dtype != object:
+            from ..utils.profiling import counters
+
+            counters.increment("frame.host_sync")  # device stratum pull
         vals_h = (np.asarray(vals, object) if vals.dtype == object
                   else np.asarray(vals))
         rng = np.random.default_rng(seed)

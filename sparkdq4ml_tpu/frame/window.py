@@ -250,7 +250,14 @@ class WindowExpr(Expr):
 
     # -- evaluation --------------------------------------------------------
     def eval(self, frame):
+        from ..utils.profiling import counters
+
         func, spec = self.func, self.spec
+        # The window plan is host-side by design (module docstring): the
+        # mask + every referenced device column pull to host here. ONE
+        # counted sync per window evaluation — the same batch convention
+        # as the join key-pull — so host-boundary audits see it.
+        counters.increment("frame.host_sync")
         m = np.asarray(frame.mask)
         idx = np.flatnonzero(m)                      # valid slots only
         nv = len(idx)

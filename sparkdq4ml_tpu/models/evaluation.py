@@ -8,11 +8,30 @@ import numpy as np
 from ..frame.frame import Frame
 
 
+def _host_pair(labels, scores):
+    """Device inputs pull to host in ONE batched, COUNTED transfer
+    (``frame.host_sync``); numpy inputs pass through free. The curve
+    helpers are public library surface — a caller handing them device
+    arrays used to trigger an implicit, uncounted device→host transfer
+    per numpy op, invisible to the sync audits the fused paths pin."""
+    if not isinstance(labels, np.ndarray) or not isinstance(scores,
+                                                            np.ndarray):
+        import jax
+
+        from ..utils.profiling import counters
+
+        if any(hasattr(x, "devices") for x in (labels, scores)):
+            counters.increment("frame.host_sync")
+        labels, scores = jax.device_get((labels, scores))
+    return np.asarray(labels), np.asarray(scores)
+
+
 def threshold_sweep(labels: np.ndarray, scores: np.ndarray):
     """Cumulative (thresholds desc, tp, fp) at each DISTINCT score —
     the single O(n log n) sweep behind every ROC/PR curve and
     by-threshold metric (at threshold t, every row scoring ≥ t is
     predicted positive, so the last index of each tied run counts)."""
+    labels, scores = _host_pair(labels, scores)
     order = np.argsort(-scores, kind="mergesort")
     y = (labels[order] == 1.0).astype(np.float64)
     s = scores[order]
@@ -24,6 +43,7 @@ def threshold_sweep(labels: np.ndarray, scores: np.ndarray):
 
 def pr_points(labels: np.ndarray, scores: np.ndarray):
     """(thresholds desc, precision, recall) at each distinct score."""
+    labels, scores = _host_pair(labels, scores)
     thr, tp, fp = threshold_sweep(labels, scores)
     npos = max(float((labels == 1.0).sum()), 1.0)
     precision = tp / np.maximum(tp + fp, 1.0)
@@ -46,6 +66,7 @@ def roc_points(labels: np.ndarray, scores: np.ndarray):
 def area_under_roc(labels: np.ndarray, scores: np.ndarray) -> float:
     """Exact AUC (rank statistic with tie handling) via the trapezoid over
     the ROC boundary points — O(n log n)."""
+    labels, scores = _host_pair(labels, scores)
     pos = labels == 1.0
     if pos.sum() == 0 or (~pos).sum() == 0:
         return float("nan")
@@ -55,6 +76,7 @@ def area_under_roc(labels: np.ndarray, scores: np.ndarray) -> float:
 
 def area_under_pr(labels: np.ndarray, scores: np.ndarray) -> float:
     """Precision-recall AUC over threshold boundaries, O(n log n)."""
+    labels, scores = _host_pair(labels, scores)
     pos = labels == 1.0
     if pos.sum() == 0 or (~pos).sum() == 0:
         return float("nan")

@@ -253,10 +253,17 @@ class LinearRegression(Estimator):
             counters.increment("solver.fits")
             counters.increment("solver.iterations", iters)
             if s is not _obs._NOOP:
+                from ..utils import meminfo as _meminfo
+
                 hist = np.asarray(result.objective_history, np.float64)
+                # input_bytes: static-shape estimate of the packed design
+                # the fit dispatched (the fit-node device-memory figure
+                # EXPLAIN/memory_report cross-reference) — metadata only,
+                # never a device read.
                 s.set(iterations=iters, converged=bool(result.converged),
                       objective_final=float(
-                          hist[min(iters, hist.shape[0] - 1)]))
+                          hist[min(iters, hist.shape[0] - 1)]),
+                      input_bytes=_meminfo.estimated_bytes(Z))
         model = LinearRegressionModel(
             coefficients=np.asarray(result.coefficients),
             intercept=float(result.intercept),

@@ -304,6 +304,44 @@ def solve(A: jnp.ndarray, reg_param: float, elastic_net_param: float,
     return _faults.corrupt("solver", result)
 
 
+def _jit_entry_size(fn) -> Optional[int]:
+    """Compiled-program count of a ``jax.jit`` entry point (private-ish
+    ``_cache_size`` API — None when unavailable, never an error)."""
+    try:
+        return int(fn._cache_size())
+    except Exception:
+        return None
+
+
+def solver_cache_stats() -> dict:
+    """Registry callback (observability.CACHES): compiled-program counts
+    of the solver jit entry points plus the per-solver call counters —
+    ``session.cache_report()['solver']``."""
+    from ..utils.profiling import counters
+
+    stats: dict = {
+        "kind": "jax.jit entry points (sufficient-statistics solvers)",
+        "programs": {"fista_solve": _jit_entry_size(fista_solve),
+                     "normal_solve": _jit_entry_size(normal_solve)},
+    }
+    calls = {name: counters.get(f"solver.{name}_calls")
+             for name in ("fista", "normal", "owlqn")}
+    stats["calls"] = {k: v for k, v in calls.items() if v}
+    stats["fits"] = counters.get("solver.fits")
+    stats["trace_hits"] = counters.get("jit.trace_hit")
+    stats["trace_misses"] = counters.get("jit.trace_miss")
+    return stats
+
+
+def _register_cache_stats() -> None:
+    from ..utils import observability as _obs
+
+    _obs.CACHES.register("solver", solver_cache_stats)
+
+
+_register_cache_stats()
+
+
 def psum_value_and_grad(local_objective, axis):
     """``value_and_grad`` for a data-parallel objective inside shard_map:
     differentiate the LOCAL objective, then explicitly ``psum`` both the

@@ -573,6 +573,11 @@ class _Plan:
         self.extra_names = tuple(name for name, _ in lowered_extra)
         self.key = key
         self.n_lits = len(lits)
+        # Introspection (observability.CACHES / EXPLAIN ANALYZE): per-plan
+        # replay count and bucket histogram, updated under _CACHE_LOCK.
+        self.hits = 0
+        self.compiles = 0
+        self.buckets: dict[int, int] = {}
 
         donated_names = self.donated
         extra_pairs = tuple(lowered_extra)
@@ -740,6 +745,12 @@ def run_pipeline(data: dict, mask, n: int, steps, extra=()):
                     sp.set(cache="compile" if compiled else "hit")
         if not compiled:
             counters.increment("pipeline.hit")
+        with _CACHE_LOCK:     # per-entry stats for cache_report()
+            if compiled:
+                plan.compiles += 1
+            else:
+                plan.hits += 1
+            plan.buckets[b] = plan.buckets.get(b, 0) + 1
         if b != n:
             changed, new_mask, extras = _unpad_tree(
                 (changed, new_mask, extras), n)
@@ -752,3 +763,31 @@ def run_pipeline(data: dict, mask, n: int, steps, extra=()):
     except Exception as e:          # any jax/trace surprise → eager replay
         counters.increment("pipeline.fallback")
         raise PipelineError(str(e)) from e
+
+
+# ---------------------------------------------------------------------------
+# Cache introspection (observability.CACHES — see session.cache_report())
+# ---------------------------------------------------------------------------
+
+def cache_stats() -> dict:
+    """Registry callback: size/capacity, hit/miss/eviction counters, and
+    one entry per cached program (plan-key prefix, replay count, bucket
+    histogram) — the per-program lines EXPLAIN ANALYZE prints."""
+    with _CACHE_LOCK:
+        entries = [{"key": p.key[:160], "hits": p.hits,
+                    "compiles": p.compiles, "buckets": dict(p.buckets),
+                    "runtime_literals": p.n_lits}
+                   for p in _CACHE.values()]
+    return {
+        "kind": "plan-keyed jit cache (fused expression pipeline)",
+        "size": len(entries),
+        "capacity": int(config.pipeline_cache_size),
+        "hits": counters.get("pipeline.hit"),
+        "misses": counters.get("pipeline.compile"),
+        "evictions": counters.get("pipeline.evict"),
+        "fallbacks": counters.get("pipeline.fallback"),
+        "entries": entries,
+    }
+
+
+_obs.CACHES.register("pipeline", cache_stats)

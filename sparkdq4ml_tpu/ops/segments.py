@@ -152,6 +152,9 @@ _DENSE_MAX = 1 << 17
 # ---------------------------------------------------------------------------
 
 _CACHE: "OrderedDict[str, object]" = OrderedDict()
+#: Per-plan replay stats, keyed like _CACHE (observability.CACHES /
+#: EXPLAIN ANALYZE per-program lines); mutated under _CACHE_LOCK only.
+_PLAN_STATS: dict[str, dict] = {}
 _CACHE_LOCK = threading.Lock()
 # Serializes device-path executions (plan fetch → program call → counter
 # attribution) across threads; see try_device. RLock: a thunk may itself
@@ -163,6 +166,7 @@ def clear_cache() -> None:
     """Drop every compiled grouped/sort/unique plan (tests; conf flips)."""
     with _CACHE_LOCK:
         _CACHE.clear()
+        _PLAN_STATS.clear()
 
 
 def cache_len() -> int:
@@ -175,14 +179,41 @@ def _cached_plan(key: str, build):
         fn = _CACHE.get(key)
         if fn is not None:
             _CACHE.move_to_end(key)
+            _PLAN_STATS.setdefault(key, {"hits": 0, "builds": 0})[
+                "hits"] += 1
             return fn
     fn = jax.jit(build())
     with _CACHE_LOCK:
         _CACHE[key] = fn
+        _PLAN_STATS.setdefault(key, {"hits": 0, "builds": 0})["builds"] += 1
         while len(_CACHE) > int(config.pipeline_cache_size):
-            _CACHE.popitem(last=False)
+            evicted, _ = _CACHE.popitem(last=False)
+            _PLAN_STATS.pop(evicted, None)
             counters.increment("grouped.evict")
     return fn
+
+
+def cache_stats() -> dict:
+    """Registry callback (observability.CACHES): size/capacity, the
+    grouped.* counters, and one entry per cached program."""
+    with _CACHE_LOCK:
+        entries = [{"key": k[:160], **dict(v)}
+                   for k, v in _PLAN_STATS.items()]
+        size = len(_CACHE)
+    return {
+        "kind": "plan-keyed jit cache (segment-reduction grouped exec)",
+        "size": size,
+        "capacity": int(config.pipeline_cache_size),
+        "hits": counters.get("grouped.hit"),
+        "misses": counters.get("grouped.compile"),
+        "evictions": counters.get("grouped.evict"),
+        "fallbacks": counters.get("grouped.fallback"),
+        "dense_misses": counters.get("grouped.dense_miss"),
+        "entries": entries,
+    }
+
+
+_obs.CACHES.register("grouped", cache_stats)
 
 
 # ---------------------------------------------------------------------------

@@ -193,6 +193,33 @@ def fused_linear_fit_packed(mesh: Optional[Mesh], solver: str, max_iter: int,
     return jax.jit(fit)
 
 
+def fit_factory_cache_stats() -> dict:
+    """Registry callback (observability.CACHES): lru_cache introspection
+    of the packed/sharded jit factories — the fit-path entries of
+    ``session.cache_report()``. ``hits`` are factory replays (no new
+    trace+compile); ``misses`` are cold builds."""
+    out: dict = {"kind": "lru_cache jit factories (fused linear fit)"}
+    for name, factory in (("fused_linear_fit_packed",
+                           fused_linear_fit_packed),
+                          ("gram_sharded", _gram_sharded_fn)):
+        try:
+            info = factory.cache_info()
+            out[name] = {"size": info.currsize, "hits": info.hits,
+                         "misses": info.misses}
+        except Exception as e:
+            out[name] = {"error": str(e)}
+    return out
+
+
+def _register_cache_stats() -> None:
+    from ..utils import observability as _obs
+
+    _obs.CACHES.register("fit.factories", fit_factory_cache_stats)
+
+
+_register_cache_stats()
+
+
 def unpack_fit_result(flat, d: int):
     """Decode the packed fit output (host side) into a ``FitResult``."""
     from ..models.solvers import FitResult

@@ -203,6 +203,17 @@ class TpuSession:
             _segments.clear_cache()
         elif gval in ("true", "on", "1"):
             _set("grouped_exec", True)
+        # EXPLAIN ANALYZE knobs (sql/parser.py) ride the same
+        # session-scoped save/restore:
+        #     .config("spark.explain.memory", "false")  # no mem sampling
+        #     .config("spark.explain.caches", "false")  # no cache section
+        for conf_key, attr in (("spark.explain.memory", "explain_memory"),
+                               ("spark.explain.caches", "explain_caches")):
+            v = str(self.conf.get(conf_key, "")).lower()
+            if v in ("false", "off", "0"):
+                _set(attr, False)
+            elif v in ("true", "on", "1"):
+                _set(attr, True)
         if saved:
             self._pipeline_saved = saved
 
@@ -277,6 +288,24 @@ class TpuSession:
         from .utils import observability as _obs
 
         return _obs.dump_chrome_trace(path)
+
+    def memory_report(self, top: int = 5) -> dict:
+        """Device-memory accounting snapshot (``utils.meminfo``): live/
+        peak bytes, live-array census by dtype, the ``top`` largest
+        buffers, and per-device allocator stats where the backend exposes
+        them. Host-side metadata only — never a device sync."""
+        from .utils import meminfo as _meminfo
+
+        return _meminfo.memory_report(top=top)
+
+    def cache_report(self) -> dict:
+        """Unified jit-cache introspection (``observability.CACHES``):
+        per-cache size/hits/misses/evictions and per-entry detail for the
+        pipeline compiler, the grouped-execution engine, the solver jit
+        entry points, and the packed-fit factories."""
+        from .utils import observability as _obs
+
+        return _obs.cache_report()
 
     def _init_faults(self) -> None:
         """Install the fault-injection plan (``utils.faults``) from session
@@ -531,7 +560,9 @@ class TpuSession:
                 if any(k.startswith("spark.observability.")
                        for k in self._conf):
                     _ACTIVE._init_observability()
-                if any(k.startswith("spark.pipeline.") for k in self._conf):
+                if any(k.startswith(("spark.pipeline.", "spark.groupedExec",
+                                     "spark.explain."))
+                       for k in self._conf):
                     _ACTIVE._init_pipeline()
             return _ACTIVE
 

@@ -1454,54 +1454,439 @@ def plan_summary(q: Query) -> str:
     program, see ``ops/segments.py``); a string key discovered at
     execution time silently takes the host fallback, exactly like a
     string column under ``FusedStage``."""
-    from ..config import config as _cfg
-    from ..frame.aggregates import AggExpr
-    from ..ops.compiler import is_compilable
-
-    parts: list[str] = []
-    if q.limit is not None:
-        parts.append(f"Limit[{q.limit}]")
-    if q.offset:
-        parts.append(f"Offset[{q.offset}]")
-    if q.order_by:
-        parts.append(f"DeviceSort[{len(q.order_by)}]"
-                     if _cfg.grouped_exec else f"Sort[{len(q.order_by)}]")
-    if q.distinct:
-        parts.append("Distinct")
-    if q.having is not None:
-        parts.append("Having")
-    if q.group_by:
-        mode = q.group_mode if q.group_mode != "group" else "groupBy"
-        segmented = (_cfg.grouped_exec and q.group_mode == "group"
-                     and _segment_lowerable_aggs(q.items))
-        parts.append(
-            f"SegmentedAggregate[{mode}:{len(q.group_by)}]" if segmented
-            else f"Aggregate[{mode}:{len(q.group_by)}]")
-    aggregating = bool(q.group_by) or any(
-        isinstance(it, (AggExpr, PostAggItem)) for it in q.items)
-    fusable = (_cfg.pipeline and q.where is not None and not aggregating
-               and is_compilable(q.where, _OPTIMISTIC_SCHEMA)
-               and all(isinstance(it, str)
-                       or is_compilable(it, _OPTIMISTIC_SCHEMA)
-                       or isinstance(it, E.Col)
-                       for it in q.items))
-    if fusable:
-        parts.append(f"FusedStage(Project[{len(q.items)}] <- Filter)")
-    else:
-        parts.append(f"Project[{len(q.items)}]")
-        if q.where is not None:
-            parts.append("Filter")
-    for j in q.joins:
-        how = j[1] if len(j) > 1 and isinstance(j[1], str) else "inner"
-        parts.append(f"Join[{how}]")
-    src = q.view if isinstance(q.view, str) else "(subquery)"
-    parts.append(f"Scan[{src}]")
-    s = " <- ".join(parts)
+    chain = plan_tree(q).main_chain()
+    s = " <- ".join(n.label for n in chain)
     if q.unions:
         s += f" (+{len(q.unions)} set-op)"
     if q.ctes:
         s = f"With[{len(q.ctes)}] " + s
     return s
+
+
+def _structurally_fusable(q: Query) -> bool:
+    """The FusedStage predicate — one definition for the plan-summary
+    marker, the plan tree, and EXPLAIN (the pipeline compiler re-checks
+    against real dtypes at flush time; see :func:`plan_summary`)."""
+    from ..config import config as _cfg
+    from ..frame.aggregates import AggExpr
+    from ..ops.compiler import is_compilable
+
+    aggregating = bool(q.group_by) or any(
+        isinstance(it, (AggExpr, PostAggItem)) for it in q.items)
+    return (_cfg.pipeline and q.where is not None and not aggregating
+            and is_compilable(q.where, _OPTIMISTIC_SCHEMA)
+            and all(isinstance(it, str)
+                    or is_compilable(it, _OPTIMISTIC_SCHEMA)
+                    or isinstance(it, E.Col)
+                    for it in q.items))
+
+
+def _structurally_segmented(q: Query) -> bool:
+    from ..config import config as _cfg
+
+    return (_cfg.grouped_exec and q.group_mode == "group"
+            and _segment_lowerable_aggs(q.items))
+
+
+class PlanNode:
+    """One operator of the structural query plan — the per-operator node
+    tree ``plan_summary``'s flat chain is derived from, and the carrier
+    of EXPLAIN ANALYZE's measured stats (``stats`` stays empty on the
+    un-analyzed path). ``children[0]`` is the operator's input; a Join's
+    ``children[1]`` is the probe-side Scan."""
+
+    __slots__ = ("op", "detail", "children", "stats")
+
+    def __init__(self, op: str, detail: str = "", children=()):
+        self.op = op
+        self.detail = detail
+        self.children = list(children)
+        self.stats: dict = {}
+
+    @property
+    def label(self) -> str:
+        return f"{self.op}{self.detail}"
+
+    def walk(self):
+        """Preorder traversal over every node."""
+        yield self
+        for c in self.children:
+            yield from c.walk()
+
+    def execution_order(self):
+        """Nodes in the order the engine RUNS them (inputs before
+        consumers) — the order their spans arrive in, which is what FIFO
+        span attribution must follow (a root-first walk would hand the
+        WHERE filter's span to the Having node). Postorder — which is
+        already execution order for chains, Join probe sides, and SetOps
+        union branches — except ``With``, whose CTEs (children[1:]) run
+        BEFORE the main query (children[0])."""
+        if self.op == "With":
+            for c in self.children[1:]:
+                yield from c.execution_order()
+            if self.children:
+                yield from self.children[0].execution_order()
+            yield self
+            return
+        for c in self.children:
+            yield from c.execution_order()
+        yield self
+
+    def main_chain(self) -> list:
+        """Root-first operator chain down ``children[0]``, ending at the
+        Scan — exactly the shape :func:`plan_summary` prints. (A Scan
+        may carry a derived-table subquery plan as its child; the chain
+        does not descend into it.)"""
+        out, node = [], self
+        while node is not None:
+            out.append(node)
+            node = (node.children[0]
+                    if node.children and node.op != "Scan" else None)
+        return out
+
+    def render(self, analyze: bool = False) -> str:
+        """Indented operator tree; with ``analyze`` each node's measured
+        stats print as a logfmt suffix."""
+        from ..utils.logging import format_kv
+
+        lines: list[str] = []
+
+        def emit(node, depth):
+            pad = "" if depth == 0 else "   " * (depth - 1) + "+- "
+            line = pad + node.label
+            if analyze and node.stats:
+                # unknowns render as "-" so every node shows the full
+                # stat schema (format_kv would elide None)
+                stats = {k: ("-" if node.stats[k] is None
+                             else node.stats[k]) for k in node.stats}
+                kv = format_kv(**stats)
+                if kv:
+                    line += f"  ({kv})"
+            lines.append(line)
+            for c in node.children:
+                emit(c, depth + 1)
+
+        emit(self, 0)
+        return "\n".join(lines)
+
+
+def plan_tree(q: Query) -> PlanNode:
+    """Build the per-operator plan-node tree for a parsed query (the
+    structural plan: built before execution binds the frame, so markers
+    follow the same optimistic-dtype convention as ``plan_summary``)."""
+    def scan_node(view):
+        """Scan leaf; a derived table carries its subquery's plan as a
+        child (outside the main chain) so EXPLAIN shows it and span
+        attribution consumes the subquery's spans at the right point
+        instead of handing them to outer same-named operators."""
+        if isinstance(view, DerivedTable):
+            return PlanNode("Scan", "[(subquery)]",
+                            [plan_tree(view.query)])
+        if isinstance(view, str):
+            return PlanNode("Scan", f"[{view}]")
+        return PlanNode("Scan", "[(subquery)]")  # OneRowRelation et al.
+
+    node = scan_node(q.view)
+    for view, how, _keys, _alias in reversed(q.joins):
+        how = how if isinstance(how, str) else "inner"
+        node = PlanNode("Join", f"[{how}]", [node, scan_node(view)])
+    if _structurally_fusable(q):
+        node = PlanNode("FusedStage",
+                        f"(Project[{len(q.items)}] <- Filter)", [node])
+    else:
+        if q.where is not None:
+            node = PlanNode("Filter", "", [node])
+        node = PlanNode("Project", f"[{len(q.items)}]", [node])
+    if q.group_by:
+        mode = q.group_mode if q.group_mode != "group" else "groupBy"
+        op = ("SegmentedAggregate" if _structurally_segmented(q)
+              else "Aggregate")
+        node = PlanNode(op, f"[{mode}:{len(q.group_by)}]", [node])
+    if q.having is not None:
+        node = PlanNode("Having", "", [node])
+    if q.distinct:
+        node = PlanNode("Distinct", "", [node])
+    if q.order_by:
+        from ..config import config as _cfg
+
+        node = PlanNode("DeviceSort" if _cfg.grouped_exec else "Sort",
+                        f"[{len(q.order_by)}]", [node])
+    if q.offset:
+        node = PlanNode("Offset", f"[{q.offset}]", [node])
+    if q.limit is not None:
+        node = PlanNode("Limit", f"[{q.limit}]", [node])
+    return node
+
+
+_EXPLAIN_RE = re.compile(r"^\s*explain(\s+analyze)?\b(.*)$",
+                         re.IGNORECASE | re.DOTALL)
+
+#: Plan-node op → the span names that measure it, most specific first.
+#: ``frame.grouped.flush:<op>`` keys the grouped-engine flush spans by
+#: their ``op`` attribute. Spans are consumed FIFO, so a query with two
+#: joins attributes the first ``frame.join`` span to the first Join node.
+_NODE_SPAN_CANDIDATES = {
+    "FusedStage": ("frame.pipeline.flush", "frame.filter", "frame.select"),
+    "Filter": ("frame.filter",),
+    "Project": ("frame.select",),
+    "Aggregate": ("frame.agg",),
+    "SegmentedAggregate": ("frame.grouped.flush:group_by", "frame.agg"),
+    "Having": ("frame.filter",),
+    "Sort": ("frame.sort",),
+    "DeviceSort": ("frame.sort", "frame.grouped.flush:sort"),
+    "Distinct": ("frame.distinct", "frame.drop_duplicates",
+                 "frame.grouped.flush:distinct"),
+    "Join": ("frame.join",),
+}
+
+#: Nodes whose program (if any) is the pipeline compiler's — a deferred
+#: filter/projection flushes OUTSIDE its own op span (at the next
+#: materialization point), so the verdict may ride an unconsumed
+#: ``frame.pipeline.flush`` span at query level. The predicate keys on
+#: the flush span's shape: ``steps`` are with_column/filter steps (the
+#: Filter node's program), ``outputs`` are fused select projections (the
+#: Project node's program); FusedStage owns both.
+_PIPELINE_NODE_PRED = {
+    "FusedStage": lambda a: True,
+    "Filter": lambda a: a.get("steps", 0) > 0,
+    "Project": lambda a: a.get("outputs", 0) > 0,
+}
+
+#: The acceptance contract: EVERY operator node carries these keys after
+#: an ANALYZE pass (measured where a span matched, defaults otherwise).
+_ANALYZE_DEFAULTS = (("rows_in", None), ("rows_out", None),
+                     ("wall_ms", 0.0), ("compile", "none"),
+                     ("host_syncs", 0), ("peak_mem", None))
+
+
+def _annotate_plan(tree: PlanNode, qs) -> None:
+    """Attribute one query's collected spans to plan-tree operators.
+
+    ``qs`` is an ``observability.QueryStatsCollector`` whose window was
+    exactly this query's execution. Attribution is name-based and FIFO
+    (frame ops execute in plan order within one query); the compile-vs-
+    cache-hit verdict comes from the operator's own flush span or the
+    flush span nested directly under it. After the walk every node holds
+    the full stat schema (:data:`_ANALYZE_DEFAULTS`)."""
+    by_name: dict[str, list] = {}
+    children_of: dict = {}
+    for s in qs.spans:
+        by_name.setdefault(s.name, []).append(s)
+        children_of.setdefault(s.parent_id, []).append(s)
+        if s.name == "frame.grouped.flush":
+            by_name.setdefault(
+                f"frame.grouped.flush:{s.attrs.get('op')}", []).append(s)
+
+    def pop(name, pred=None):
+        lst = by_name.get(name)
+        for s in list(lst or ()):
+            if pred is not None and not pred(s.attrs):
+                continue
+            for other in by_name.values():   # one span feeds ONE node
+                if s in other:
+                    other.remove(s)
+            return s
+        return None
+
+    peak_attr = max((s.attrs.get("peak_mem", 0) for s in qs.spans),
+                    default=0) or None
+    # EXECUTION order, not render order: spans arrive input-side-first,
+    # and FIFO queues must be consumed the same way (a root-first walk
+    # would hand the WHERE filter's span to the Having node).
+    for node in tree.execution_order():
+        primary = None
+        for name in _NODE_SPAN_CANDIDATES.get(node.op, ()):
+            primary = pop(name)
+            if primary is not None:
+                break
+        stats = node.stats
+        if primary is not None:
+            a = primary.attrs
+            if "rows_in" in a:
+                stats["rows_in"] = a.get("rows_in")
+                stats["rows_out"] = a.get("rows_out")
+            else:                 # a flush span: rows/groups vocabulary
+                stats["rows_in"] = a.get("rows")
+                stats["rows_out"] = a.get("groups", a.get("rows"))
+            stats["wall_ms"] = round((primary.dur_us or 0) / 1e3, 3)
+            stats["host_syncs"] = a.get("host_syncs", 0)
+            if a.get("peak_mem") is not None:
+                stats["peak_mem"] = a["peak_mem"]
+            if a.get("lowering"):
+                stats["lowering"] = a["lowering"]
+            verdict = a.get("cache")
+            if verdict is None:
+                # the flush program ran nested under this op's span
+                # (grouped sort/distinct on accelerators)
+                for c in children_of.get(primary.sid, ()):
+                    if c.name in ("frame.pipeline.flush",
+                                  "frame.grouped.flush") \
+                            and c.attrs.get("cache"):
+                        verdict = c.attrs["cache"]
+                        break
+            pred = _PIPELINE_NODE_PRED.get(node.op)
+            if verdict is None and pred is not None:
+                # deferred pipeline steps flush at the next
+                # materialization point, outside the op's own span
+                flush = pop("frame.pipeline.flush", pred)
+                if flush is not None:
+                    verdict = flush.attrs.get("cache")
+                    stats["flush_ms"] = round((flush.dur_us or 0) / 1e3, 3)
+            if verdict is not None:
+                stats["compile"] = verdict
+            for k, v in a.items():
+                if k.startswith("recovery_"):
+                    stats[k] = v
+        for key, default in _ANALYZE_DEFAULTS:
+            stats.setdefault(key, default)
+        if stats["peak_mem"] is None:
+            stats["peak_mem"] = peak_attr
+    # Row counts flow along edges: an operator with no span of its own
+    # (Scan, Limit, Offset) inherits its input's output count and its
+    # consumer's input count — static shape info, never a device read.
+    chain = tree.main_chain()
+    for parent, child in zip(chain, chain[1:]):
+        if child.stats.get("rows_out") is None \
+                and parent.stats.get("rows_in") is not None:
+            child.stats["rows_out"] = parent.stats["rows_in"]
+        if parent.stats.get("rows_in") is None \
+                and child.stats.get("rows_out") is not None:
+            parent.stats["rows_in"] = child.stats["rows_out"]
+
+
+def _parse_explain_tree(body: str):
+    """Parse an EXPLAIN'd statement into ``(plan_tree, kind, payload)``:
+    ``("query", Query)`` for a SELECT statement, ``("create"|"drop",
+    body)`` for the DDL forms (their child tree is the materializing
+    query's plan)."""
+    m = _DDL_RE.match(body)
+    if m:
+        name, inner = m.group(1), m.group(2)
+        sub = _EXPLAIN_RE.match(inner)
+        if sub:       # EXPLAIN CREATE VIEW v AS EXPLAIN ... is nonsense
+            raise ValueError("nested EXPLAIN is not supported")
+        tree = PlanNode("CreateView", f"[{name}]",
+                        [plan_tree(parse(inner))])
+        return tree, "create", body
+    m = _DROP_RE.match(body)
+    if m:
+        return PlanNode("DropView", f"[{m.group(2)}]"), "drop", body
+    q = parse(body)
+    tree = plan_tree(q)
+    if q.ctes:
+        # children[0] = main query; children[1:] = the CTE bodies in
+        # registration order (execution_order runs them first)
+        tree = PlanNode("With", f"[{len(q.ctes)}]",
+                        [tree] + [plan_tree(sub) for _name, sub in q.ctes])
+    if q.unions:
+        tree = PlanNode("SetOps", f"[+{len(q.unions)}]",
+                        [tree] + [plan_tree(sub) for _op, sub in q.unions])
+    return tree, "query", q
+
+
+def _cache_lines(before: dict, after: dict) -> list[str]:
+    """One line per cache (and per cached program) the query touched —
+    the diff of two ``observability.cache_report()`` snapshots."""
+    lines: list[str] = []
+    for name, post in sorted(after.items()):
+        pre = before.get(name, {})
+        if not isinstance(post, dict) or not isinstance(pre, dict):
+            continue
+        deltas = {}
+        for k in ("hits", "misses", "evictions", "fallbacks",
+                  "dense_misses"):
+            d = (post.get(k) or 0) - (pre.get(k) or 0)
+            if d:
+                deltas[k] = d
+        if not deltas and post.get("entries") == pre.get("entries"):
+            continue
+        summary = " ".join(f"{k}+{v}" for k, v in deltas.items())
+        lines.append(f"{name}: size={post.get('size', '?')}"
+                     + (f" {summary}" if summary else ""))
+        pre_entries = {e.get("key"): e for e in pre.get("entries") or ()}
+        for e in post.get("entries") or ():
+            p = pre_entries.get(e.get("key"), {})
+            touched = any((e.get(k) or 0) > (p.get(k) or 0)
+                          for k in ("hits", "compiles", "builds"))
+            if not touched:
+                continue
+            detail = {k: v for k, v in e.items() if k != "key"}
+            from ..utils.logging import format_kv
+
+            lines.append(f"  program {format_kv(**detail)} key="
+                         f"{e.get('key', '')!r}")
+    return lines
+
+
+def _execute_explain(body: str, cat, analyze: bool):
+    """Run an ``EXPLAIN [ANALYZE]`` statement. EXPLAIN renders the
+    structural plan tree WITHOUT executing (zero compiles, zero device
+    work — pure parsing); EXPLAIN ANALYZE executes the statement under a
+    per-query stats collector (``observability.query_stats``) and
+    annotates every operator with measured rows, wall ms, compile/hit
+    verdicts, host syncs, recovery events, and peak device bytes, plus a
+    cache section (one line per compiled program touched). Returns a
+    one-row Frame with the plan text in a ``plan`` column (the Spark
+    ``EXPLAIN`` result shape)."""
+    from ..config import config as _cfg
+    from ..frame.frame import Frame
+
+    tree, kind, payload = _parse_explain_tree(body)
+    _obs.current_span().set(
+        plan=("ExplainAnalyze" if analyze else "Explain"))
+    if not analyze:
+        text = "== Physical Plan ==\n" + tree.render()
+        return Frame({"plan": [text]})
+
+    import time as _time
+
+    import jax as _jax
+
+    caches_before = _obs.cache_report() if _cfg.explain_caches else {}
+    with _obs.query_stats(sample_memory=_cfg.explain_memory) as qs:
+        t0 = _time.perf_counter()
+        if kind == "query":
+            out = _run_parsed(payload, cat)
+        else:
+            out = _execute_statement(payload, cat)
+        # honest wall-clock: flush any pending fused pipeline and wait
+        # for the async dispatches the query enqueued
+        _jax.block_until_ready(out._mask)
+        wall_ms = (_time.perf_counter() - t0) * 1e3
+    _annotate_plan(tree, qs)
+    top = tree.main_chain()[0]
+    if top.stats.get("rows_out") is None:
+        top.stats["rows_out"] = out.num_slots
+    delta = qs.counter_delta()
+    lines = ["== Analyzed Plan ==", tree.render(analyze=True),
+             "== Query Stats =="]
+    from ..utils.logging import format_kv
+
+    totals = {
+        "wall_ms": round(wall_ms, 3),
+        "rows_out": out.num_slots,
+        "host_syncs": delta.get("frame.host_sync", 0),
+        "compiles": (delta.get("pipeline.compile", 0)
+                     + delta.get("grouped.compile", 0)),
+        "cache_hits": (delta.get("pipeline.hit", 0)
+                       + delta.get("grouped.hit", 0)),
+        "fallbacks": (delta.get("pipeline.fallback", 0)
+                      + delta.get("grouped.fallback", 0)),
+        "recovery_events": sum(v for k, v in delta.items()
+                               if k.startswith("recovery.")),
+    }
+    if _cfg.explain_memory:
+        from ..utils import meminfo as _meminfo
+
+        totals["live_bytes"] = _meminfo.sample()
+        totals["peak_bytes"] = _meminfo.peak_bytes()
+    lines.append(format_kv(**totals))
+    if _cfg.explain_caches:
+        cl = _cache_lines(caches_before, _obs.cache_report())
+        if cl:
+            lines.append("== Caches ==")
+            lines.extend(cl)
+    return Frame({"plan": ["\n".join(lines)]})
 
 
 def execute(sql: str, catalog=None):
@@ -1529,10 +1914,23 @@ def execute(sql: str, catalog=None):
         return out
 
 
+def _run_parsed(q: Query, cat):
+    """Execute an already-parsed query: CTE overlay + set expression."""
+    if q.ctes:
+        cat = _OverlayCatalog(cat)
+        for name, sub in q.ctes:
+            # Later CTEs may reference earlier ones (executed in order).
+            cat.register(name, _execute_set(sub, cat))
+    return _execute_set(q, cat)
+
+
 def _execute_statement(sql: str, catalog=None):
     from .catalog import default_catalog
 
     cat = catalog if catalog is not None else default_catalog()
+    m = _EXPLAIN_RE.match(sql)
+    if m and m.group(2).strip():
+        return _execute_explain(m.group(2), cat, analyze=bool(m.group(1)))
     m = _DDL_RE.match(sql)
     if m:
         name, body = m.group(1), m.group(2)
@@ -1557,12 +1955,7 @@ def _execute_statement(sql: str, catalog=None):
         # plan_summary walks the WHERE/projection trees — skip the build
         # entirely when the span is a no-op (the SQL hot path)
         _obs.current_span().set(plan=plan_summary(q))
-    if q.ctes:
-        cat = _OverlayCatalog(cat)
-        for name, sub in q.ctes:
-            # Later CTEs may reference earlier ones (executed in order).
-            cat.register(name, _execute_set(sub, cat))
-    return _execute_set(q, cat)
+    return _run_parsed(q, cat)
 
 
 def _map_cols(expr, fn):

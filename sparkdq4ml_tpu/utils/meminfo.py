@@ -1,0 +1,219 @@
+"""Device-memory accounting — live/peak buffer bytes at the XLA boundary.
+
+"Memory Safe Computations with XLA Compiler" (PAPERS.md, arxiv 2206.14148)
+makes the case this module answers: without buffer-level accounting at the
+XLA boundary, "why did this query OOM / stall" is guesswork. Two sources,
+merged best-effort:
+
+* **Allocator statistics** — ``device.memory_stats()`` where the backend
+  exposes them (TPU/GPU PJRT allocators report ``bytes_in_use`` /
+  ``peak_bytes_in_use``). These are the ground truth for HBM pressure,
+  including buffers XLA holds that no Python array references.
+* **Live-array census** — ``jax.live_arrays()``: every jax Array the
+  process still references, summed by static ``nbytes``. Portable to every
+  backend (XLA:CPU reports no allocator stats) and attributable (per-dtype
+  breakdown, largest buffers), at the cost of missing allocator-internal
+  slack. Never a device sync: shapes/dtypes are host-side metadata.
+
+Sampling feeds the observability registry (``mem.live_bytes`` /
+``mem.peak_bytes`` gauges) and — when ``TRACER.mem_sample`` is on (EXPLAIN
+ANALYZE turns it on for the duration of one query; ``spark.explain.memory``
+gates it) — every finished span gets a ``peak_mem`` attribute: the max of
+the live-bytes census at span entry and exit, improved to the allocator's
+``peak_bytes_in_use`` delta where available.
+
+Cost contract: nothing here runs on the default path. ``sample()`` walks
+the live-array registry (O(#arrays), host-only) and is called only from
+explicitly-enabled sampling sites or user-facing reports.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+import jax
+
+#: Process-lifetime peak of the live-bytes census (monotone; reset_peak()).
+_PEAK_LOCK = threading.Lock()
+_PEAK_BYTES = 0
+
+
+def _array_nbytes(a) -> int:
+    """Static size of one jax Array — shape/dtype metadata, never a device
+    read. Sharded arrays report the addressable footprint (nbytes covers
+    the logical array; per-shard accounting would need addressable_shards,
+    which this census deliberately avoids touching — shard iteration can
+    materialize lazy views on some backends)."""
+    try:
+        return int(a.nbytes)
+    except Exception:
+        try:
+            import numpy as np
+
+            return int(np.prod(a.shape)) * np.dtype(a.dtype).itemsize
+        except Exception:
+            return 0
+
+
+def live_bytes() -> int:
+    """Total bytes of every live jax Array (host-side census, no sync)."""
+    try:
+        return sum(_array_nbytes(a) for a in jax.live_arrays())
+    except Exception:
+        return 0
+
+
+def live_array_count() -> int:
+    try:
+        return len(jax.live_arrays())
+    except Exception:
+        return 0
+
+
+def estimated_bytes(tree) -> int:
+    """Static-shape byte estimate of a pytree (the portable fallback the
+    fit/flush sites use to pre-size a dispatch): sum of
+    ``prod(shape) * itemsize`` over array-like leaves. Never a device
+    read — works on tracers, jax Arrays, and numpy alike."""
+    import numpy as np
+
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        shape = getattr(leaf, "shape", None)
+        dtype = getattr(leaf, "dtype", None)
+        if shape is None or dtype is None:
+            continue
+        try:
+            total += int(np.prod(shape)) * np.dtype(dtype).itemsize
+        except Exception:
+            continue
+    return total
+
+
+def device_stats() -> list[dict]:
+    """Per-device allocator statistics where the backend exposes them
+    (``[]`` on XLA:CPU). Keys mirror PJRT: ``bytes_in_use``,
+    ``peak_bytes_in_use``, ``bytes_limit`` when present."""
+    out = []
+    try:
+        for d in jax.devices():
+            try:
+                stats = d.memory_stats()
+            except Exception:
+                stats = None
+            if not stats:
+                continue
+            entry = {"device": str(d), "platform": d.platform}
+            for k in ("bytes_in_use", "peak_bytes_in_use", "bytes_limit",
+                      "largest_alloc_size", "num_allocs"):
+                if k in stats:
+                    entry[k] = int(stats[k])
+            out.append(entry)
+    except Exception:
+        pass
+    return out
+
+
+def peak_bytes() -> int:
+    """Process-lifetime peak of the live-bytes census (improved by the
+    allocator peak where available)."""
+    with _PEAK_LOCK:
+        peak = _PEAK_BYTES
+    alloc_peak = sum(s.get("peak_bytes_in_use", 0) for s in device_stats())
+    return max(peak, alloc_peak)
+
+
+def reset_peak() -> None:
+    global _PEAK_BYTES
+    with _PEAK_LOCK:
+        _PEAK_BYTES = 0
+
+
+def sample(update_gauges: bool = True) -> int:
+    """One accounting sample: the live-bytes census, folded into the peak
+    tracker and (by default) the ``mem.live_bytes`` / ``mem.peak_bytes``
+    gauges. Returns the live-bytes figure."""
+    global _PEAK_BYTES
+    b = live_bytes()
+    with _PEAK_LOCK:
+        if b > _PEAK_BYTES:
+            _PEAK_BYTES = b
+        peak = _PEAK_BYTES
+    if update_gauges:
+        from . import observability as _obs
+
+        _obs.METRICS.set_gauge("mem.live_bytes", b)
+        _obs.METRICS.set_gauge("mem.peak_bytes", peak)
+    return b
+
+
+def memory_report(top: int = 5) -> dict:
+    """One merged accounting view (``session.memory_report()``):
+
+    * ``live_bytes`` / ``peak_bytes`` / ``live_arrays`` — the census,
+    * ``by_dtype`` — live bytes per dtype string, descending,
+    * ``largest`` — the ``top`` biggest live buffers (shape, dtype, bytes),
+    * ``devices`` — allocator stats where the backend exposes them,
+    * ``backend`` — the default backend name.
+    """
+    buffers = []
+    try:
+        arrays = jax.live_arrays()
+    except Exception:
+        arrays = []
+    by_dtype: dict[str, int] = {}
+    total = 0
+    for a in arrays:
+        nb = _array_nbytes(a)
+        total += nb
+        dt = str(getattr(a, "dtype", "?"))
+        by_dtype[dt] = by_dtype.get(dt, 0) + nb
+        buffers.append((nb, tuple(getattr(a, "shape", ())), dt))
+    buffers.sort(key=lambda t: t[0], reverse=True)
+    global _PEAK_BYTES
+    with _PEAK_LOCK:
+        if total > _PEAK_BYTES:
+            _PEAK_BYTES = total
+        peak = _PEAK_BYTES
+    stats = device_stats()
+    alloc_peak = sum(s.get("peak_bytes_in_use", 0) for s in stats)
+    return {
+        "backend": jax.default_backend(),
+        "live_bytes": total,
+        "peak_bytes": max(peak, alloc_peak),
+        "live_arrays": len(arrays),
+        "by_dtype": dict(sorted(by_dtype.items(), key=lambda kv: -kv[1])),
+        "largest": [{"bytes": nb, "shape": list(shape), "dtype": dt}
+                    for nb, shape, dt in buffers[:max(int(top), 0)]],
+        "devices": stats,
+    }
+
+
+class SpanSampler:
+    """Entry/exit sampling pair for one span (created only when
+    ``TRACER.mem_sample`` is on): ``peak_mem`` is the max of the census at
+    the two boundaries, plus the allocator peak delta where stats exist."""
+
+    __slots__ = ("entry_bytes", "entry_alloc_peak")
+
+    def __init__(self):
+        self.entry_bytes = sample(update_gauges=False)
+        self.entry_alloc_peak = sum(
+            s.get("peak_bytes_in_use", 0) for s in device_stats())
+
+    def finish(self) -> dict:
+        exit_bytes = sample()
+        peak = max(self.entry_bytes, exit_bytes)
+        alloc_peak = sum(
+            s.get("peak_bytes_in_use", 0) for s in device_stats())
+        if alloc_peak > self.entry_alloc_peak:
+            peak = max(peak, alloc_peak)
+        return {"peak_mem": peak, "mem_live_bytes": exit_bytes}
+
+
+def span_sampler() -> Optional[SpanSampler]:
+    try:
+        return SpanSampler()
+    except Exception:
+        return None

@@ -195,10 +195,16 @@ _CURRENT: contextvars.ContextVar[Optional["Span"]] = contextvars.ContextVar(
 class Span:
     """One traced operation. Use as a context manager (normal case) or via
     ``Tracer.begin``/``Tracer.end`` for long-lived spans (the session root).
-    ``set(**attrs)`` attaches structured attributes at any point."""
+    ``set(**attrs)`` attaches structured attributes at any point.
 
-    __slots__ = ("name", "cat", "attrs", "sid", "parent_id", "tid",
-                 "ts_us", "dur_us", "_t0", "_token", "_tracer")
+    ``trace_id`` is the span id of the trace's ROOT span (a root's
+    trace_id is its own sid) — emitted by BOTH exporters (logfmt lines and
+    Chrome-trace ``args``), so a logfmt line can be cross-referenced into
+    the Perfetto view of the same run."""
+
+    __slots__ = ("name", "cat", "attrs", "sid", "parent_id", "trace_id",
+                 "tid", "ts_us", "dur_us", "_t0", "_token", "_tracer",
+                 "_mem")
 
     def __init__(self, tracer: "Tracer", name: str, cat: str, attrs: dict):
         self._tracer = tracer
@@ -221,11 +227,13 @@ class Span:
             except IndexError:
                 parent = None
         self.parent_id = parent.sid if parent is not None else None
+        self.trace_id = parent.trace_id if parent is not None else self.sid
         self.tid = threading.get_ident()
         self.ts_us = 0
         self.dur_us: Optional[int] = None
         self._t0 = 0.0
         self._token: Optional[contextvars.Token] = None
+        self._mem = None              # meminfo.SpanSampler when sampling
 
     def set(self, **attrs) -> "Span":
         # Copy-on-write, never in-place: exporters snapshot ``self.attrs``
@@ -237,12 +245,19 @@ class Span:
 
     def __enter__(self) -> "Span":
         self._token = _CURRENT.set(self)
+        if self._tracer.mem_sample:
+            from . import meminfo
+
+            self._mem = meminfo.span_sampler()
         self.ts_us = self._tracer._now_us()
         self._t0 = time.perf_counter()
         return self
 
     def __exit__(self, et, ev, tb) -> bool:
         self.dur_us = int((time.perf_counter() - self._t0) * 1e6)
+        if self._mem is not None:
+            self.attrs = {**self.attrs, **self._mem.finish()}
+            self._mem = None
         if et is not None:
             self.attrs = {**self.attrs, "error": et.__name__}
         if self._token is not None:
@@ -264,10 +279,13 @@ class Tracer:
     def __init__(self, max_spans: int = 10_000):
         self.enabled = False
         self.log_spans = False
+        self.mem_sample = False       # per-span device-memory sampling
         self.max_spans = max_spans
+        self.dropped = 0              # spans evicted by the bounded buffer
         self._spans: list[Span] = []
         self._open: dict[int, Span] = {}
         self._ambient: list[Span] = []   # begun roots (see Span.__init__)
+        self._sinks: list = []        # per-query collectors (query_stats)
         self._lock = threading.Lock()
         self._id = 0
         self._epoch_s = time.time()
@@ -287,8 +305,21 @@ class Tracer:
         with self._lock:
             self._open.pop(s.sid, None)
             self._spans.append(s)
-            if len(self._spans) > self.max_spans:
-                del self._spans[: len(self._spans) - self.max_spans]
+            excess = len(self._spans) - self.max_spans
+            if excess > 0:
+                # The bounded buffer wrapping used to be SILENT — a trace
+                # that looks complete but starts mid-query. Count it so
+                # trace_report()/chrome_trace() can say what's missing.
+                del self._spans[:excess]
+                self.dropped += excess
+            sinks = list(self._sinks)
+        if excess > 0:
+            profiling.counters.increment("trace.dropped_spans", excess)
+        for sink in sinks:
+            try:
+                sink(s)
+            except Exception:   # a broken collector must not break the op
+                logger.debug("span sink failed", exc_info=True)
         METRICS.observe(f"span_ms.{s.cat or 'other'}",
                         (s.dur_us or 0) / 1e3)
         if self.log_spans:
@@ -296,7 +327,8 @@ class Tracer:
                 "span %s",
                 format_kv(name=s.name, cat=s.cat,
                           dur_ms=round((s.dur_us or 0) / 1e3, 3),
-                          span_id=s.sid, parent_id=s.parent_id, **s.attrs))
+                          trace_id=s.trace_id, span_id=s.sid,
+                          parent_id=s.parent_id, **s.attrs))
 
     # -- recording --------------------------------------------------------
     def span(self, name: str, cat: str = "", **attrs):
@@ -326,6 +358,9 @@ class Tracer:
         if s is None or s is _NOOP:
             return
         s.dur_us = int((time.perf_counter() - s._t0) * 1e6)
+        if s._mem is not None:
+            s.attrs = {**s.attrs, **s._mem.finish()}
+            s._mem = None
         if _CURRENT.get() is s:
             _CURRENT.set(None)
         with self._lock:
@@ -346,6 +381,7 @@ class Tracer:
             self._spans.clear()
             self._open.clear()
             self._ambient.clear()
+            self.dropped = 0
 
 
 #: Process-global tracer. Disabled by default; ``session`` conf/env turn it
@@ -372,10 +408,13 @@ def disable() -> None:
 
 
 def reset() -> None:
-    """Clear spans, gauges, and histograms (counters have their own
-    ``profiling.counters.clear``)."""
+    """Clear spans, gauges, histograms, and the device-memory peak tracker
+    (counters have their own ``profiling.counters.clear``)."""
     TRACER.clear()
     METRICS.clear()
+    from . import meminfo
+
+    meminfo.reset_peak()
 
 
 def span(name: str, cat: str = "", **attrs):
@@ -396,23 +435,44 @@ def current_span():
     return s if s is not None else _NOOP
 
 
+def current_ids() -> tuple:
+    """``(trace_id, span_id)`` of the innermost active span — ``(None,
+    None)`` when tracing is off or no span is open. Recovery events attach
+    these so a retry/fallback line in the structured log can be cross-
+    referenced into the logfmt span stream and the Perfetto view."""
+    if not TRACER.enabled:
+        return (None, None)
+    s = _CURRENT.get()
+    if s is None:
+        try:
+            s = TRACER._ambient[-1]
+        except IndexError:
+            return (None, None)
+    return (s.trace_id, s.sid)
+
+
 def op_span(name: str, cat: str = "frame"):
     """Decorator for frame-op style methods: when tracing is enabled, wrap
     the call in a span carrying rows in/out (``num_slots`` — static shape
-    info, never a device read). Disabled cost: one attribute read and a
-    branch."""
+    info, never a device read) and the number of ``frame.host_sync``
+    events the op (and anything nested under it) performed — the per-
+    operator sync attribution EXPLAIN ANALYZE reads. Disabled cost: one
+    attribute read and a branch."""
     def deco(fn):
         @functools.wraps(fn)
         def wrapper(self, *args, **kwargs):
             t = TRACER
             if not t.enabled:
                 return fn(self, *args, **kwargs)
+            sync0 = profiling.counters.get("frame.host_sync")
             with Span(t, name, cat, {"rows_in": getattr(self, "_n", None)}) \
                     as s:
                 out = fn(self, *args, **kwargs)
                 n = getattr(out, "_n", None)
                 if n is not None:
                     s.set(rows_out=n)
+                s.set(host_syncs=profiling.counters.get("frame.host_sync")
+                      - sync0)
                 return out
         return wrapper
     return deco
@@ -526,6 +586,157 @@ def _install_jax_compile_listener() -> None:
 
 
 # ---------------------------------------------------------------------------
+# Per-query stats collection (EXPLAIN ANALYZE)
+# ---------------------------------------------------------------------------
+
+
+class QueryStatsCollector:
+    """Scopes the span and counter streams to ONE query so EXPLAIN ANALYZE
+    can attribute them to plan operators: every span finished while the
+    collector is installed lands in ``spans`` (in completion order), and
+    ``counter_delta()`` reports how every monotonic counter moved.
+
+    Scoped to the INSTALLING thread: a query executes synchronously on
+    one thread, and filtering by thread id keeps two concurrent EXPLAIN
+    ANALYZE queries (cross-thread frame sharing is supported engine-wide)
+    from polluting each other's span streams. Spans an op hands to a
+    worker thread would be excluded — no instrumented path does that
+    today. Counter deltas remain process-global (counters carry no
+    thread identity); concurrent queries share those."""
+
+    def __init__(self):
+        self.spans: list = []
+        self._tid = threading.get_ident()
+        self._counters0 = profiling.counters.snapshot()
+
+    def _on_span(self, s) -> None:
+        if s.tid == self._tid:
+            self.spans.append(s)
+
+    def counter_delta(self) -> dict:
+        """``{name: increment}`` for every counter that moved since the
+        collector was installed (recovery/fallback/compile/host-sync
+        activity of exactly this query)."""
+        now = profiling.counters.snapshot()
+        out = {}
+        for k, v in now.items():
+            d = v - self._counters0.get(k, 0)
+            if d:
+                out[k] = d
+        return out
+
+    def spans_named(self, *names) -> list:
+        return [s for s in self.spans if s.name in names]
+
+
+# query_stats nesting/concurrency state: the enabled/mem_sample restore
+# is REFCOUNTED (the outermost/first collector snapshots, the last one
+# out restores) so a collector exiting on one thread cannot disable
+# tracing while another thread's EXPLAIN ANALYZE is mid-flight.
+_QS_LOCK = threading.Lock()
+_QS_ACTIVE = 0
+_QS_WAS_ENABLED = False
+_QS_WAS_MEM = False
+
+
+@contextlib.contextmanager
+def query_stats(sample_memory: bool = True):
+    """Install a :class:`QueryStatsCollector` for the duration of one
+    query (the EXPLAIN ANALYZE execution window). Activates tracing for
+    the window if it is off — per-query activation is the contract that
+    keeps the DEFAULT path a no-op — and restores the previous state
+    when the LAST active collector exits (refcounted: safe under
+    concurrent queries from multiple threads; each collector sees only
+    its own thread's spans). ``sample_memory`` additionally turns on
+    per-span device-memory sampling (``peak_mem`` attrs; see
+    ``utils.meminfo``)."""
+    global _QS_ACTIVE, _QS_WAS_ENABLED, _QS_WAS_MEM
+    t = TRACER
+    with _QS_LOCK:
+        if _QS_ACTIVE == 0:
+            _QS_WAS_ENABLED = t.enabled
+            _QS_WAS_MEM = t.mem_sample
+        _QS_ACTIVE += 1
+        if not t.enabled:
+            enable(max_spans=t.max_spans, log_spans=t.log_spans)
+        if sample_memory:
+            t.mem_sample = True
+    qs = QueryStatsCollector()
+    with t._lock:
+        t._sinks.append(qs._on_span)
+    try:
+        yield qs
+    finally:
+        with t._lock:
+            try:
+                t._sinks.remove(qs._on_span)
+            except ValueError:
+                pass
+        with _QS_LOCK:
+            _QS_ACTIVE -= 1
+            if _QS_ACTIVE == 0:
+                t.mem_sample = _QS_WAS_MEM
+                t.enabled = _QS_WAS_ENABLED
+
+
+# ---------------------------------------------------------------------------
+# Unified jit-cache introspection
+# ---------------------------------------------------------------------------
+
+
+class CacheRegistry:
+    """One registry every compiled-program cache reports into: the
+    pipeline compiler (``ops/compiler.py``), the grouped-execution engine
+    (``ops/segments.py``), the solver jit entry points
+    (``models/solvers.py``), and the packed-fit lru factory
+    (``parallel/distributed.py``) each register a zero-arg stats callable
+    under a stable name. ``report()`` (surfaced as
+    ``session.cache_report()``) returns the merged view; EXPLAIN ANALYZE
+    diffs two reports to print one line per cached program the query
+    touched."""
+
+    def __init__(self):
+        self._providers: dict[str, Callable[[], dict]] = {}
+        self._lock = threading.Lock()
+
+    def register(self, name: str, stats_fn: Callable[[], dict]) -> None:
+        """Idempotent: re-registration under the same name replaces (a
+        module reload must not accumulate stale providers)."""
+        with self._lock:
+            self._providers[name] = stats_fn
+
+    def unregister(self, name: str) -> None:
+        with self._lock:
+            self._providers.pop(name, None)
+
+    def names(self) -> list[str]:
+        with self._lock:
+            return sorted(self._providers)
+
+    def report(self) -> dict:
+        with self._lock:
+            items = list(self._providers.items())
+        out: dict = {}
+        for name, fn in sorted(items):
+            try:
+                out[name] = fn()
+            except Exception as e:   # introspection must never take
+                out[name] = {"error": str(e)}  # a query down
+        return out
+
+
+#: Process-global cache registry (see :class:`CacheRegistry`).
+CACHES = CacheRegistry()
+
+
+def cache_report() -> dict:
+    """Merged per-cache introspection: size/capacity, hits/misses/
+    evictions, and per-entry detail (plan-key prefix, hit count, bucket
+    histogram) where the producer tracks it."""
+    return CACHES.report()
+
+
+# ---------------------------------------------------------------------------
 # Exporters
 # ---------------------------------------------------------------------------
 
@@ -553,6 +764,7 @@ def chrome_trace() -> dict:
         open_ = s.dur_us is None
         dur = (tracer._now_us() - s.ts_us) if open_ else s.dur_us
         args = {k: v for k, v in s.attrs.items()}
+        args["trace_id"] = s.trace_id
         args["span_id"] = s.sid
         if s.parent_id is not None:
             args["parent_id"] = s.parent_id
@@ -565,7 +777,8 @@ def chrome_trace() -> dict:
         })
     return {"traceEvents": events,
             "displayTimeUnit": "ms",
-            "otherData": {"framework": "sparkdq4ml_tpu"}}
+            "otherData": {"framework": "sparkdq4ml_tpu",
+                          "dropped_spans": tracer.dropped}}
 
 
 def dump_chrome_trace(path: str) -> str:
@@ -601,6 +814,9 @@ def trace_report() -> str:
     for s in spans:
         if s.parent_id is None or s.parent_id not in by_id:
             emit(s, 0)
+    if TRACER.dropped:
+        lines.append(f"dropped={TRACER.dropped} spans (bounded buffer "
+                     "wrapped; raise spark.observability.maxSpans)")
     return "\n".join(lines)
 
 
@@ -621,19 +837,51 @@ def _prom_num(v: float) -> str:
     return str(int(f)) if f == int(f) else repr(f)
 
 
+#: ``# HELP`` text per metric-name prefix (first match wins); the fallback
+#: names the original dotted metric so a scrape reader can map the
+#: sanitized Prometheus name back to the in-process counter.
+_HELP_PREFIXES = (
+    ("recovery.", "resilience-layer event count (utils.recovery)"),
+    ("pipeline.", "fused expression-pipeline compiler (ops/compiler.py)"),
+    ("grouped.", "device-resident grouped execution (ops/segments.py)"),
+    ("jit.", "XLA trace/compile cache activity"),
+    ("solver.", "linear-solver dispatch (models/solvers.py)"),
+    ("frame.", "frame-engine op/boundary activity"),
+    ("parallel.", "mesh collective dispatch (parallel/)"),
+    ("mesh.", "device-mesh state"),
+    ("mem.", "device-memory accounting (utils.meminfo)"),
+    ("trace.", "span tracer internals"),
+    ("span_ms.", "span wall-clock latency histogram, milliseconds"),
+    ("sql.", "SQL layer activity"),
+)
+
+
+def _prom_help(name: str) -> str:
+    for prefix, text in _HELP_PREFIXES:
+        if name.startswith(prefix):
+            return f"{name} - {text}"
+    return f"{name} - sparkdq4ml_tpu metric"
+
+
 def prometheus_text() -> str:
     """Prometheus text-format snapshot: every counter (including
     ``recovery.*``), every gauge, and every histogram (cumulative
-    ``_bucket{le=...}`` series + ``_sum``/``_count``), one scrape."""
+    ``_bucket{le=...}`` series + ``_sum``/``_count``), one scrape. Each
+    series carries ``# HELP`` (mapping the sanitized name back to the
+    dotted in-process name) and ``# TYPE`` headers; metric names sanitize
+    through :func:`_prom_name` (dots and any other illegal characters
+    become underscores, leading digits are prefixed)."""
     lines: list[str] = []
     for name, v in sorted(profiling.counters.snapshot().items()):
         pn = _prom_name(name)
+        lines.append(f"# HELP {pn} {_prom_help(name)}")
         lines.append(f"# TYPE {pn} counter")
         lines.append(f"{pn} {_prom_num(v)}")
     snap = METRICS.snapshot()
     for name in sorted(snap):
         v = snap[name]
         pn = _prom_name(name)
+        lines.append(f"# HELP {pn} {_prom_help(name)}")
         if isinstance(v, dict):      # histogram summary
             lines.append(f"# TYPE {pn} histogram")
             for le, c in v["buckets"].items():

@@ -89,6 +89,11 @@ class RecoveryEvent:
     backoff_s: float = 0.0
     detail: str = ""
     time_s: float = 0.0  # wall-clock timestamp (time.time)
+    # Active-span correlation (None when tracing was off): the logfmt span
+    # stream and the Chrome/Perfetto trace emit the same ids, so a retry
+    # line here pins to the exact span it happened inside.
+    trace_id: Optional[int] = None
+    span_id: Optional[int] = None
 
     def as_kv(self) -> str:
         from .logging import format_kv
@@ -96,7 +101,8 @@ class RecoveryEvent:
         return format_kv(
             site=self.site, action=self.action, attempt=self.attempt,
             rung=self.rung, cause=self.cause,
-            backoff_s=round(self.backoff_s, 4), detail=self.detail)
+            backoff_s=round(self.backoff_s, 4), detail=self.detail,
+            trace_id=self.trace_id, span_id=self.span_id)
 
 
 class RecoveryLog:
@@ -110,6 +116,10 @@ class RecoveryLog:
         self._lock = threading.Lock()
 
     def record(self, site: str, action: str, **kw) -> RecoveryEvent:
+        if "trace_id" not in kw:
+            from . import observability as _obs
+
+            kw["trace_id"], kw["span_id"] = _obs.current_ids()
         ev = RecoveryEvent(site=site, action=action, time_s=time.time(), **kw)
         with self._lock:
             self._events.append(ev)

@@ -405,8 +405,10 @@ def _parse_prometheus(text: str) -> dict:
         r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{[^}]*\})?\s+(\S+)$")
     for line in text.strip().splitlines():
         if line.startswith("#"):
-            assert re.match(r"^# TYPE [a-zA-Z_:][a-zA-Z0-9_:]* "
-                            r"(counter|gauge|histogram)$", line), line
+            assert re.match(r"^# (TYPE [a-zA-Z_:][a-zA-Z0-9_:]* "
+                            r"(counter|gauge|histogram)"
+                            r"|HELP [a-zA-Z_:][a-zA-Z0-9_:]* \S.*)$",
+                            line), line
             continue
         m = line_re.match(line)
         assert m, f"malformed Prometheus line: {line!r}"
