@@ -19,6 +19,10 @@ Covers the five BASELINE.json configs plus a synthetic scale sweep:
 (dq)  the DQ phase itself (`App.java:52-95`): CSV parse throughput
       (native C++ tokenizer vs pure-Python) on a ~1e6-row synthetic file,
       and the fused rules+filter pass (XLA, on device) vs vectorized numpy,
+(serving) closed-loop multi-tenant serving (serve/): 32 concurrent
+      clients driving the headline DQ+Lasso query through the QueryServer,
+      sustained QPS + p50/p99 latency, shared plan/jit cache on vs off,
+      cross-tenant program-reuse pin, golden numbers asserted per query,
 (sweep) the masked-Gramian data pass at n ∈ {1e5, 1e6, 1e7} × d ∈ {16, 128,
       512} (HBM-bounded subset), XLA vs compiled Pallas, with on-device
       numerics assertions — the MXU/HBM throughput story behind every fit.
@@ -401,6 +405,139 @@ def bench_grouped_ops(median_time):
             out.append(row)
             log(json.dumps(row))
     return out
+
+
+def bench_serving(session, data_path: str):
+    """(serving) Closed-loop multi-tenant serving bench — the ISSUE-6
+    acceptance metric. N concurrent clients (one logical tenant each)
+    drive the headline DQ+Lasso query through the QueryServer in a
+    closed loop (submit → wait → submit), giving sustained QPS and
+    p50/p99 end-to-end latency, with the shared plan/jit cache ON vs
+    OFF (per-tenant cache namespaces — what serving would cost if every
+    tenant compiled its own plans). ``cross_tenant_new_compiles`` pins
+    the reuse claim: with sharing on, the SECOND tenant's first query
+    replays the first tenant's compiled programs with zero new pipeline/
+    grouped compiles (cache_report diff). Every served query must return
+    the golden numbers (count=24, RMSE 2.8099 ± 1%) or the bench exits
+    1 — concurrency must never change results."""
+    import threading
+
+    import sparkdq4ml_tpu as dq
+    from sparkdq4ml_tpu.models import LinearRegression, VectorAssembler
+    from sparkdq4ml_tpu.ops import compiler, segments
+    from sparkdq4ml_tpu.serve import QueryServer, TenantQuota
+
+    clients = 8 if SMOKE else 32
+    per_client = 2 if SMOKE else 6
+    workers = 8
+    golden_rmse = 2.809940          # SURVEY.md §2.3, dataset-abstract
+
+    def job(ctx):
+        df = (ctx.read.format("csv").option("inferSchema", "true")
+              .option("header", "false").load(data_path))
+        df = df.with_column_renamed("_c0", "guest") \
+               .with_column_renamed("_c1", "price")
+        df = df.with_column("price_no_min",
+                            dq.call_udf("minimumPriceRule", dq.col("price")))
+        ctx.register_view("price", df)
+        df = ctx.sql("SELECT cast(guest as int) guest, price_no_min AS "
+                     "price FROM price WHERE price_no_min > 0")
+        df = df.with_column(
+            "price_correct_correl",
+            dq.call_udf("priceCorrelationRule", dq.col("price"),
+                        dq.col("guest")))
+        ctx.register_view("price", df)
+        df = ctx.sql("SELECT guest, price_correct_correl AS price "
+                     "FROM price WHERE price_correct_correl > 0")
+        df = df.with_column("label", df.col("price"))
+        df = VectorAssembler(["guest"], "features").transform(df)
+        model = LinearRegression(max_iter=40, reg_param=1.0,
+                                 elastic_net_param=1.0).fit(df)
+        return {"count": df.count(),
+                "rmse": float(model.summary.root_mean_squared_error)}
+
+    def plan_compiles(report):
+        # pipeline + grouped "misses" ARE the plan-compile counters; the
+        # solver/fit factories are tenant-independent in both modes and
+        # deliberately excluded from the reuse pin
+        return sum(int(report.get(k, {}).get("misses", 0))
+                   for k in ("pipeline", "grouped"))
+
+    def run_arm(shared: bool):
+        compiler.clear_cache()
+        segments.clear_cache()
+        server = QueryServer(
+            session, workers=workers, max_queue=4 * clients,
+            default_quota=TenantQuota(max_in_flight=2,
+                                      max_queued=per_client + 2),
+            shared_plan_cache=shared).start()
+        # Cold warm-up on tenant-00, then the cross-tenant pin: does
+        # tenant-01's FIRST query need any new compiled plan?
+        r0 = server.submit(job, tenant="tenant-00").result()
+        rep0 = plan_compiles(server.cache_report())
+        r1 = server.submit(job, tenant="tenant-01").result()
+        cross_new = plan_compiles(server.cache_report()) - rep0
+
+        results: list = []
+        res_lock = threading.Lock()
+
+        def client(i: int):
+            tenant = f"tenant-{i:02d}"
+            out = [server.submit(job, tenant=tenant).result()
+                   for _ in range(per_client)]
+            with res_lock:
+                results.extend(out)
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(clients)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        server.stop()
+        ok = [r for r in results if r.ok]
+        golden_ok = all(
+            r.ok                           # short-circuits: a failed
+            and r.value["count"] == 24     # warm-up has value=None
+            and abs(r.value["rmse"] - golden_rmse) / golden_rmse < 0.01
+            for r in ok + [r0, r1])
+        lats = sorted(r.e2e_ms for r in ok)
+
+        def pct(p):
+            return (round(lats[min(len(lats) - 1,
+                                   int(p * (len(lats) - 1)))], 2)
+                    if lats else None)
+
+        return {
+            "queries": len(results), "completed": len(ok),
+            "qps": round(len(ok) / wall, 2), "wall_s": round(wall, 3),
+            "p50_ms": pct(0.50), "p99_ms": pct(0.99),
+            "cross_tenant_new_compiles": cross_new,
+            "golden_ok": bool(golden_ok and r0.ok and r1.ok
+                              and len(ok) == len(results)),
+        }
+
+    shared = run_arm(True)
+    isolated = run_arm(False)
+    # drop the tenant-namespaced plans the isolated arm salted in
+    compiler.clear_cache()
+    segments.clear_cache()
+    if not (shared["golden_ok"] and isolated["golden_ok"]):
+        log("ERROR: serving bench: a served query missed the golden "
+            "numbers (count 24 / RMSE 2.8099) or failed outright")
+        sys.exit(1)
+    row = {
+        "config": "serving", "clients": clients,
+        "queries_per_client": per_client, "workers": workers,
+        "shared_cache": shared, "isolated_cache": isolated,
+        "shared_vs_isolated_qps": round(
+            shared["qps"] / isolated["qps"], 2)
+        if isolated["qps"] else None,
+    }
+    log(json.dumps(row))
+    return row
 
 
 def _acquire_bench_lock(wait_s: float = 1200.0):
@@ -896,6 +1033,12 @@ def main():
     # numpy path (ops/segments.py) across a rows × groups grid
     grouped_ops = bench_grouped_ops(median_time)
 
+    # (serving) closed-loop multi-tenant QPS/p99 on the headline DQ+Lasso
+    # query (serve/), shared plan cache on vs off, golden-pinned
+    serving = bench_serving(session,
+                            os.path.join(REPO, "data",
+                                         "dataset-abstract.csv"))
+
     # (e) baseline: sklearn GridSearchCV, same 3x3 grid / folds / family,
     # refit=True to match the in-program best-model refit
     t_e_cpu = None
@@ -1079,6 +1222,7 @@ def main():
         "configs": configs,
         "frame_pipeline": frame_pipeline,
         "grouped_ops": grouped_ops,
+        "serving": serving,
         "sweep": sweep_rows,
         "pallas_max_rel_diff": max((float(d) for _, d in pallas_diffs),
                                    default=None),

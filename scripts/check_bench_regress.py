@@ -13,7 +13,8 @@ vocabulary:
 * lower-is-better:  ``*_ms``, ``*_s``, ``*_secs``, ``*_seconds``,
   ``*time*``
 * higher-is-better: ``*gbps``, ``*gb_s``, ``vs_baseline``, ``*speedup``,
-  ``*throughput*``, ``*rows_per*``
+  ``*throughput*``, ``*rows_per*``, ``qps`` / ``*_qps`` (the serving
+  bench's sustained-throughput metric)
 
 Anything else (row counts, iteration counts, file sizes) is not a
 performance metric and is ignored. Only metrics present in BOTH runs
@@ -47,7 +48,7 @@ import sys
 
 _LOWER_RE = re.compile(r"(_ms$|_s$|_secs$|_seconds$|time)")
 _HIGHER_RE = re.compile(
-    r"(gbps|gb_s|vs_baseline|speedup|throughput|rows_per)")
+    r"(gbps|gb_s|vs_baseline|speedup|throughput|rows_per|^qps$|_qps$)")
 
 
 def metric_direction(key: str):
@@ -103,7 +104,7 @@ def load_bench_doc(path: str):
     if not isinstance(raw, dict):
         return None
     if any(k in raw for k in ("configs", "sweep", "frame_pipeline",
-                              "grouped_ops")):
+                              "grouped_ops", "serving")):
         return raw
     if isinstance(raw.get("parsed"), dict):
         return raw["parsed"]

@@ -10,4 +10,15 @@ from .ops import (col, lit, call_udf, callUDF, register_udf,
                   register_builtin_rules)
 from .session import TpuSession
 
+
+def __getattr__(name):
+    # Lazy serving-layer exports: importing the package must not pull in
+    # the server machinery (pay-for-use contract; README § "Serving").
+    if name in ("QueryServer", "TenantQuota", "QueryResult"):
+        from . import serve as _serve
+
+        return getattr(_serve, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
 __version__ = "0.1.0"

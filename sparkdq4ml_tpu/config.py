@@ -12,6 +12,12 @@ import dataclasses
 
 import jax.numpy as jnp
 
+#: Conf boolean spellings — THE shared vocabulary for every conf parser
+#: (session ``spark.*`` keys, ``spark.serve.*`` keys, env gates). One
+#: tuple each, so a new spelling cannot silently diverge between parsers.
+CONF_FALSE = ("false", "off", "0", "no")
+CONF_TRUE = ("true", "on", "1", "yes")
+
 
 @dataclasses.dataclass
 class _Config:
@@ -52,6 +58,11 @@ class _Config:
     # program the query touched) to EXPLAIN ANALYZE output
     # (spark.explain.caches conf).
     explain_caches: bool = True
+    # Query-serving layer (serve/): gates session.serve(). False
+    # (spark.serve.enabled=false) makes session.serve() refuse to start a
+    # server; the layer is otherwise pay-for-use — a process that never
+    # starts a QueryServer runs zero serve code (no threads, no metrics).
+    serve_enabled: bool = True
     # Pallas fast-path selection for the hot ops (ops/pallas_kernels.py):
     # the single-device Gramian in solvers.augmented_gram and the fused DQ
     # chain entry point ops/rules.py:dq_rules_fused. "off" = plain XLA

@@ -36,7 +36,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from ..config import float_dtype
 from ..frame.frame import Frame
-from ..parallel.mesh import DATA_AXIS, shard_map
+from ..parallel.mesh import DATA_AXIS, serialize_collectives, shard_map
 from .base import Estimator, Model, persistable, read_json, write_json
 from .regression import _extract_xy
 from .solvers import _soft
@@ -602,7 +602,7 @@ def fused_logistic_fit_packed(mesh: Optional[Mesh], max_iter: int, tol: float,
             in_specs=(P(DATA_AXIS), P()),
             out_specs=P())
 
-    return jax.jit(fit)
+    return serialize_collectives(jax.jit(fit), mesh)
 
 
 def _svc_core(X, y, mask, reg_param, n, std, max_iter, tol,
@@ -699,7 +699,7 @@ def fused_svc_fit_packed(mesh: Optional[Mesh], max_iter: int, tol: float,
             in_specs=(P(DATA_AXIS), P()),
             out_specs=P())
 
-    return jax.jit(fit)
+    return serialize_collectives(jax.jit(fit), mesh)
 
 
 def _pack_softmax_result(r: "SoftmaxFitResult"):
@@ -763,7 +763,7 @@ def fused_softmax_fit_packed(mesh: Optional[Mesh], num_classes: int,
             in_specs=(P(DATA_AXIS), P()),
             out_specs=P())
 
-    return jax.jit(fit)
+    return serialize_collectives(jax.jit(fit), mesh)
 
 
 @persistable
@@ -1571,12 +1571,12 @@ def _nb_stats_fn(mesh, num_classes: int):
 
     from ..parallel.mesh import DATA_AXIS, shard_map
 
-    return jax.jit(shard_map(
+    return serialize_collectives(jax.jit(shard_map(
         lambda X, y, w: _nb_sufficient_stats(X, y, w, num_classes,
                                              DATA_AXIS),
         mesh=mesh,
         in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(DATA_AXIS)),
-        out_specs=(P(), P())))
+        out_specs=(P(), P()))), mesh)
 
 
 @persistable

@@ -38,7 +38,8 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..config import float_dtype
 from ..frame import Frame
-from ..parallel.mesh import DATA_AXIS, normalize_mesh, shard_map
+from ..parallel.mesh import (DATA_AXIS, normalize_mesh,
+                             serialize_collectives, shard_map)
 from .base import Estimator, Model, persistable
 
 
@@ -108,7 +109,7 @@ def _make_fit(mesh, k, max_iter, tol):
         _, counts, cost = stats(X, w, centers)
         return centers, cost, iters, counts
 
-    return jax.jit(fit)
+    return serialize_collectives(jax.jit(fit), mesh)
 
 
 @functools.lru_cache(maxsize=None)
@@ -426,7 +427,7 @@ def _make_gmm_fit(mesh, k, max_iter, tol, reg):
             cond, body, init)
         return weights, means, covs, ll, iters
 
-    return jax.jit(fit)
+    return serialize_collectives(jax.jit(fit), mesh)
 
 
 @functools.lru_cache(maxsize=None)

@@ -25,6 +25,7 @@ import numpy as np
 from ..config import float_dtype
 from ..frame.frame import Frame
 from .base import Estimator, Model, persistable
+from ..parallel.mesh import serialize_collectives
 
 
 class FmFit(NamedTuple):
@@ -110,10 +111,10 @@ def _fm_fit_fn(mesh, factor_size, loss, reg_param, max_iter, lr, init_std,
 
     from ..parallel.mesh import DATA_AXIS, shard_map
 
-    return jax.jit(shard_map(
+    return serialize_collectives(jax.jit(shard_map(
         lambda X, y, m: run(X, y, m, DATA_AXIS), mesh=mesh,
         in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(DATA_AXIS)),
-        out_specs=P()))
+        out_specs=P())), mesh)
 
 
 class _FMBase(Estimator):

@@ -29,7 +29,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..config import float_dtype
 from ..frame import Frame
-from ..parallel.mesh import DATA_AXIS, shard_map
+from ..parallel.mesh import DATA_AXIS, serialize_collectives, shard_map
 from .base import Estimator, Model, persistable
 
 _FAMILY_LINKS = {
@@ -244,7 +244,7 @@ def _build_fit(mesh, family: str, link: str, max_iter: int, tol: float,
         xtwx, _, dev = stats(X1, y, w, off, beta)
         return GlmFit(beta, iters, delta <= tol, dev, xtwx)
 
-    return jax.jit(fit)
+    return serialize_collectives(jax.jit(fit), mesh)
 
 
 @functools.lru_cache(maxsize=None)

@@ -53,7 +53,8 @@ from jax.sharding import PartitionSpec as P
 
 from ..config import float_dtype
 from ..frame import Frame
-from ..parallel.mesh import DATA_AXIS, normalize_mesh, shard_map
+from ..parallel.mesh import (DATA_AXIS, normalize_mesh,
+                             serialize_collectives, shard_map)
 from .base import Estimator, Model, persistable
 
 _EPS = 1e-30
@@ -138,7 +139,7 @@ def _online_fit_fn(mesh, n_total: int, batch: int, k: int, vocab: int,
                                    jnp.arange(max_iter, dtype=dt))
         return lam
 
-    return jax.jit(fit)
+    return serialize_collectives(jax.jit(fit), mesh)
 
 
 @functools.lru_cache(maxsize=None)

@@ -24,6 +24,7 @@ import numpy as np
 from ..config import float_dtype
 from ..frame.frame import Frame
 from .base import Estimator, Model, persistable
+from ..parallel.mesh import serialize_collectives
 
 
 def _mlp_forward(params, X):
@@ -84,10 +85,10 @@ def _mlp_fit_fn(mesh, layers: tuple, max_iter: int, lr: float, seed: int):
 
     from ..parallel.mesh import DATA_AXIS, shard_map
 
-    return jax.jit(shard_map(
+    return serialize_collectives(jax.jit(shard_map(
         lambda X, y, m: core(X, y, m, DATA_AXIS), mesh=mesh,
         in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(DATA_AXIS)),
-        out_specs=P()))
+        out_specs=P())), mesh)
 
 
 @persistable

@@ -35,6 +35,7 @@ import numpy as np
 from ..config import float_dtype
 from ..frame import Frame
 from .base import Estimator, Model, persistable
+from ..parallel.mesh import serialize_collectives
 
 
 def _als_half_step(factors_other, idx_self, idx_other, ratings, n_self,
@@ -177,7 +178,7 @@ def _jit_als_fit(core, mesh):
         in_specs=(P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS),
                   P(), P()),
         out_specs=(P(), P(), P()))
-    return jax.jit(fn)
+    return serialize_collectives(jax.jit(fn), mesh)
 
 
 @persistable

@@ -16,7 +16,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..config import float_dtype
-from ..parallel.mesh import normalize_mesh
+from ..parallel.mesh import normalize_mesh, serialize_collectives
 
 
 def _moment_stats(X, w, psum_axis=None):
@@ -69,10 +69,10 @@ def _moment_pass_fn(mesh):
 
     from ..parallel.mesh import DATA_AXIS, shard_map
 
-    return jax.jit(shard_map(
+    return serialize_collectives(jax.jit(shard_map(
         lambda X, w: _moment_stats(X, w, DATA_AXIS), mesh=mesh,
         in_specs=(P(DATA_AXIS, None), P(DATA_AXIS)),
-        out_specs=P()))
+        out_specs=P())), mesh)
 
 
 def _extract(frame, col, mesh=None):
@@ -204,10 +204,10 @@ def _contingency_fn(mesh):
 
     from ..parallel.mesh import DATA_AXIS, shard_map
 
-    return jax.jit(shard_map(
+    return serialize_collectives(jax.jit(shard_map(
         lambda fx, ly: jax.lax.psum(fx.T @ ly, DATA_AXIS), mesh=mesh,
         in_specs=(P(DATA_AXIS, None), P(DATA_AXIS, None)),
-        out_specs=P()))
+        out_specs=P())), mesh)
 
 
 class ChiSquareTest:

@@ -26,6 +26,7 @@ import numpy as np
 from ..config import float_dtype
 from ..frame.frame import Frame
 from .base import Estimator, Model, persistable
+from ..parallel.mesh import serialize_collectives
 
 
 class AftFit(NamedTuple):
@@ -97,12 +98,12 @@ def _aft_fit_fn(mesh, max_iter: int, lr: float):
 
     from ..parallel.mesh import DATA_AXIS, shard_map
 
-    return jax.jit(shard_map(
+    return serialize_collectives(jax.jit(shard_map(
         lambda X, lt, c, m: stats_and_fit(X, lt, c, m, DATA_AXIS),
         mesh=mesh,
         in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(DATA_AXIS),
                   P(DATA_AXIS)),
-        out_specs=P()))
+        out_specs=P())), mesh)
 
 
 @persistable

@@ -37,6 +37,7 @@ import numpy as np
 from ..config import float_dtype
 from ..frame import Frame
 from .base import Estimator, Model, persistable
+from ..parallel.mesh import serialize_collectives
 
 _NEG = -1e30
 
@@ -485,7 +486,7 @@ def _forest_builder(max_depth, max_bins, impurity, min_instances,
             local, mesh=mesh,
             in_specs=(P(DATA_AXIS, None), P(), P(None, DATA_AXIS, None)),
             out_specs=P())
-    return jax.jit(fn)
+    return serialize_collectives(jax.jit(fn), mesh)
 
 
 class _TreeModelBase(Model):
@@ -888,7 +889,7 @@ def _gbt_round_builder(max_depth, max_bins, min_instances, min_info_gain,
         lambda b, e, t: one_round(b, e, t, DATA_AXIS), mesh=mesh,
         in_specs=(P(DATA_AXIS, None), P(), P(DATA_AXIS, None)),
         out_specs=P())
-    return jax.jit(fn)
+    return serialize_collectives(jax.jit(fn), mesh)
 
 
 @functools.lru_cache(maxsize=None)

@@ -37,6 +37,7 @@ from .base import Estimator, Model
 from .evaluation import Evaluator, RegressionEvaluator
 from .regression import LinearRegression, _extract_xy
 from .solvers import fista_solve, resolve_solver
+from ..parallel.mesh import serialize_collectives
 
 
 def _snake(name: str) -> str:
@@ -283,7 +284,7 @@ def _cv_program_fn(mesh, num_folds: int, n_params: int, n_features: int,
                       r.objective_history.astype(dt)]
         return jnp.concatenate(parts)
 
-    return jax.jit(program)
+    return serialize_collectives(jax.jit(program), mesh)
 
 
 def cv_device_program(frame: Frame, estimator: LinearRegression,

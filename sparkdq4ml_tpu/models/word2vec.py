@@ -36,6 +36,7 @@ import numpy as np
 from ..config import float_dtype
 from .base import Estimator, Model, persistable
 from .text import _obj_array, _token_col
+from ..parallel.mesh import serialize_collectives
 
 
 def _build_vocab(col, mask, min_count: int, max_vocab: int):
@@ -152,13 +153,13 @@ def _sgns_fit_fn(vocab_size: int, dim: int, batch: int, steps: int,
     from ..parallel.mesh import DATA_AXIS, shard_map
 
     # minibatches shard on the batch (pair) axis; embeddings replicate
-    return jax.jit(shard_map(
+    return serialize_collectives(jax.jit(shard_map(
         lambda c, o, cdf, key, U0, V0: core(c, o, cdf, key, U0, V0,
                                             DATA_AXIS),
         mesh=mesh,
         in_specs=(P(None, DATA_AXIS), P(None, DATA_AXIS), P(), P(), P(),
                   P()),
-        out_specs=(P(), P(), P())))
+        out_specs=(P(), P(), P()))), mesh)
 
 
 @persistable

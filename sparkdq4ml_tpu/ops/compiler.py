@@ -45,6 +45,8 @@ subset (strings, UDFs, row generators, array cells) never defers.
 
 from __future__ import annotations
 
+import contextlib
+import contextvars
 import functools
 import math
 import threading
@@ -64,6 +66,7 @@ from . import expressions as E
 __all__ = [
     "bucket_size", "pad_rows", "dtype_tag", "is_compilable",
     "run_pipeline", "clear_cache", "cache_len", "PipelineError",
+    "plan_namespace", "plan_namespace_tag",
 ]
 
 
@@ -578,6 +581,13 @@ class _Plan:
         self.hits = 0
         self.compiles = 0
         self.buckets: dict[int, int] = {}
+        # Per-plan trace count: the compile-vs-hit verdict in run_pipeline
+        # compares THIS plan's count across the call, not the global
+        # pipeline.compile counter — a concurrent worker tracing a
+        # different plan (the normal state of the serving thread-pool)
+        # must not turn another plan's replay into a phantom "compile".
+        self.traces = 0
+        self._trace_lock = threading.Lock()
 
         donated_names = self.donated
         extra_pairs = tuple(lowered_extra)
@@ -586,6 +596,8 @@ class _Plan:
         def program(kept, donated, mask, lit_args):
             # Body runs at trace time only → this counts XLA compiles.
             counters.increment("pipeline.compile")
+            with self._trace_lock:
+                self.traces += 1
             _RUNTIME_LITS.lits = lit_args
             try:
                 env = dict(kept)
@@ -629,6 +641,41 @@ class _Plan:
 _CACHE: "OrderedDict[str, _Plan]" = OrderedDict()
 _CACHE_LOCK = threading.Lock()
 
+# ---------------------------------------------------------------------------
+# Cache namespaces (the serving layer's shared-plan-cache switch)
+# ---------------------------------------------------------------------------
+
+#: Plan-key namespace for the current execution context. Empty (the
+#: default) means every caller shares one process-wide plan cache — the
+#: structural keys make cross-tenant reuse safe by construction, so this
+#: is the production configuration. The serving layer
+#: (``serve/server.py``) sets a per-tenant namespace only when its
+#: shared-plan-cache mode is OFF, which partitions the cache by tenant —
+#: the control arm of the serving bench's shared-on vs shared-off
+#: comparison. A contextvar, not a global: each worker thread/context
+#: scopes its own queries without affecting concurrent ones.
+_PLAN_NS: contextvars.ContextVar[str] = contextvars.ContextVar(
+    "sparkdq4ml_plan_namespace", default="")
+
+
+def plan_namespace_tag() -> str:
+    """Key prefix for the active cache namespace (empty in shared mode).
+    Prepended to pipeline plan keys here and to grouped-execution plan
+    keys in ``ops/segments.py`` — both engines partition together."""
+    ns = _PLAN_NS.get()
+    return f"ns:{ns!r}|" if ns else ""
+
+
+@contextlib.contextmanager
+def plan_namespace(ns: str):
+    """Scope plan-cache keys to namespace ``ns`` for the duration of the
+    block (thread/context-local). ``ns=""`` is the shared namespace."""
+    token = _PLAN_NS.set(str(ns))
+    try:
+        yield
+    finally:
+        _PLAN_NS.reset(token)
+
 
 def clear_cache() -> None:
     """Drop every compiled plan (tests; conf flips)."""
@@ -646,6 +693,7 @@ def _lookup_plan(steps, extra, base_schema):
     # guarantees the probe's lit order matches the cached program's
     # _ArgLit slots (the lowered trees are discarded on a hit).
     key, lits, _steps, _extra, _refs = _linearize(steps, extra, base_schema)
+    key = plan_namespace_tag() + key
     lit_values = tuple(
         v.value.item() if hasattr(v.value, "item") else v.value
         for v in lits)
@@ -655,8 +703,19 @@ def _lookup_plan(steps, extra, base_schema):
             _CACHE.move_to_end(key)
             return plan, lit_values
     plan = _Plan(steps, extra, base_schema)
+    plan.key = key                 # namespace rides the cached identity
     with _CACHE_LOCK:
-        _CACHE[plan.key] = plan
+        # Insert-if-absent: two threads can race past the probe and both
+        # build this plan. Keeping the FIRST inserted object (instead of
+        # overwriting) means every later hit/compile stat lands on the
+        # one entry cache_report() sees — an overwrite would strand the
+        # winner's stats on an evicted object (lost updates under the
+        # 16-thread hammer test).
+        existing = _CACHE.get(key)
+        if existing is not None:
+            _CACHE.move_to_end(key)
+            return existing, lit_values
+        _CACHE[key] = plan
         while len(_CACHE) > int(config.pipeline_cache_size):
             _CACHE.popitem(last=False)
             counters.increment("pipeline.evict")
@@ -714,7 +773,7 @@ def run_pipeline(data: dict, mask, n: int, steps, extra=()):
     try:
         b = bucket_size(n)
         plan, lit_values = _lookup_plan(steps, tuple(extra), schema)
-        before = counters.get("pipeline.compile")
+        before = plan.traces
         kept = {name: _pad(data[name], b, fresh=False)
                 for name in plan.kept}
         # freshness only matters for buffers the call donates (the frame
@@ -736,12 +795,12 @@ def run_pipeline(data: dict, mask, n: int, steps, extra=()):
             if span_cm is None:
                 changed, new_mask, extras = plan.fn(
                     kept, donated, mask_in, lit_values)
-                compiled = counters.get("pipeline.compile") > before
+                compiled = plan.traces > before
             else:
                 with span_cm as sp:
                     changed, new_mask, extras = plan.fn(
                         kept, donated, mask_in, lit_values)
-                    compiled = counters.get("pipeline.compile") > before
+                    compiled = plan.traces > before
                     sp.set(cache="compile" if compiled else "hit")
         if not compiled:
             counters.increment("pipeline.hit")

@@ -85,7 +85,7 @@ from jax import lax
 from ..config import config, float_dtype, int_dtype
 from ..utils import observability as _obs
 from ..utils.profiling import counters
-from .compiler import bucket_size, dtype_tag, pad_rows
+from .compiler import bucket_size, dtype_tag, pad_rows, plan_namespace_tag
 
 logger = logging.getLogger("sparkdq4ml_tpu.ops.segments")
 
@@ -175,6 +175,10 @@ def cache_len() -> int:
 
 
 def _cached_plan(key: str, build):
+    # Namespace prefix (ops/compiler.plan_namespace): empty in the shared
+    # process-wide mode; the serving layer's isolated-cache mode salts it
+    # per tenant so both plan-cache engines partition together.
+    key = plan_namespace_tag() + key
     with _CACHE_LOCK:
         fn = _CACHE.get(key)
         if fn is not None:
@@ -184,6 +188,14 @@ def _cached_plan(key: str, build):
             return fn
     fn = jax.jit(build())
     with _CACHE_LOCK:
+        # Insert-if-absent (same rule as the pipeline cache): a build race
+        # keeps the first inserted program so replay stats stay coherent.
+        existing = _CACHE.get(key)
+        if existing is not None:
+            _CACHE.move_to_end(key)
+            _PLAN_STATS.setdefault(key, {"hits": 0, "builds": 0})[
+                "hits"] += 1
+            return existing
         _CACHE[key] = fn
         _PLAN_STATS.setdefault(key, {"hits": 0, "builds": 0})["builds"] += 1
         while len(_CACHE) > int(config.pipeline_cache_size):

@@ -26,7 +26,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..models.solvers import augmented_gram
-from .mesh import DATA_AXIS, shard_map
+from .mesh import DATA_AXIS, serialize_collectives, shard_map
 
 logger = logging.getLogger("sparkdq4ml_tpu.distributed")
 
@@ -59,7 +59,7 @@ def _gram_sharded_fn(mesh: Mesh):
         local, mesh=mesh,
         in_specs=(P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS)),
         out_specs=P())
-    return jax.jit(sharded)
+    return serialize_collectives(jax.jit(sharded), mesh)
 
 
 def _resolve_solve_A(solver: str, max_iter: int, tol: float,
@@ -190,7 +190,12 @@ def fused_linear_fit_packed(mesh: Optional[Mesh], solver: str, max_iter: int,
         return jnp.concatenate(
             [r.coefficients, scalars, r.objective_history.astype(dt)])
 
-    return jax.jit(fit)
+    # Multi-device programs serialize dispatch-to-completion on the
+    # process-wide collective guard (mesh.serialize_collectives): two
+    # overlapping psum executions interleave their participant threads on
+    # XLA:CPU and deadlock — the exact workload a concurrent QueryServer
+    # produces. Identity wrapper (zero cost) off-mesh.
+    return serialize_collectives(jax.jit(fit), mesh)
 
 
 def fit_factory_cache_stats() -> dict:

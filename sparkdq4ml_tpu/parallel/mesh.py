@@ -16,7 +16,10 @@ changes (that is the point of SPMD).
 
 from __future__ import annotations
 
+import contextlib
+import functools
 import re
+import threading
 from typing import Optional, Sequence
 
 import jax
@@ -24,6 +27,61 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 DATA_AXIS = "data"
+
+# ---------------------------------------------------------------------------
+# Collective-dispatch serialization
+# ---------------------------------------------------------------------------
+
+#: Process-wide guard for executing multi-device collective programs.
+#: XLA:CPU's intra-process collectives rendezvous participant threads per
+#: (device set, op); when two executions of psum-bearing programs overlap
+#: — exactly what a concurrent serving workload produces — the
+#: participant threads of the two runs interleave and BOTH rendezvous
+#: wait forever (observed live under 32 concurrent packed Lasso fits:
+#: "This thread has been waiting for 5000ms ... waiting for all
+#: participants"). Serializing dispatch-to-completion of multi-device
+#: programs is the correctness fix; single-device programs (the common
+#: serving hot path) never take the lock. RLock: a guarded program may be
+#: invoked from inside another guarded region on the same thread (e.g. a
+#: fallback rung re-dispatching).
+_COLLECTIVE_LOCK = threading.RLock()
+
+
+def _multi_device(mesh) -> bool:
+    return mesh is not None and getattr(mesh, "devices", None) is not None \
+        and mesh.devices.size > 1
+
+
+@contextlib.contextmanager
+def collective_guard(mesh=None):
+    """Hold the process-wide collective lock while a multi-device program
+    runs (no-op for ``None``/single-device meshes). Callers must keep the
+    device work INSIDE the guard — jax dispatch is async, so block on the
+    result before leaving the block (``serialize_collectives`` does both
+    for jitted callables)."""
+    if not _multi_device(mesh):
+        yield
+        return
+    with _COLLECTIVE_LOCK:
+        yield
+
+
+def serialize_collectives(fn, mesh):
+    """Wrap a jitted multi-device program so every call holds the
+    collective lock for dispatch AND completion (``block_until_ready``
+    inside the lock — releasing with the collective still in flight
+    would re-create the interleave). Identity when the mesh is ``None``
+    or single-device, so the wrapper costs nothing on the common path;
+    under ``jax.jit`` tracing the block is a no-op on tracers and the
+    lock is only held for the trace."""
+    if not _multi_device(mesh):
+        return fn
+
+    @functools.wraps(fn)
+    def locked(*args, **kwargs):
+        with _COLLECTIVE_LOCK:
+            return jax.block_until_ready(fn(*args, **kwargs))
+    return locked
 
 
 def shard_map(f, mesh, in_specs, out_specs, check_vma: bool = True):

@@ -1,0 +1,19 @@
+"""Concurrent query-serving layer: multi-tenant sessions over one engine,
+a shared plan/jit cache, admission control, and SLO observability.
+
+Entry points: :class:`QueryServer` (or ``session.serve()``),
+:class:`TenantQuota`, and the structured :class:`QueryResult`. See
+``serve/server.py`` for the architecture and README § "Serving".
+"""
+
+from .admission import AdmissionController, Rejection, TenantQuota
+from .server import (MAX_TENANT_SERIES, QueryDeadlineExceeded,
+                     QueryExecutionError, QueryFuture, QueryRefused,
+                     QueryResult, QueryServer, ServeError, TenantContext)
+
+__all__ = [
+    "AdmissionController", "Rejection", "TenantQuota",
+    "QueryServer", "QueryFuture", "QueryResult", "TenantContext",
+    "ServeError", "QueryRefused", "QueryDeadlineExceeded",
+    "QueryExecutionError", "MAX_TENANT_SERIES",
+]

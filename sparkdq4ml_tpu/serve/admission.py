@@ -1,0 +1,138 @@
+"""Admission control for the query-serving layer.
+
+Every decision about whether a submitted query RUNS is made here, before
+any engine work happens — the Snap ML lesson (PAPERS.md, arxiv
+1803.06333) that a hierarchical execution framework needs its resource
+policy at the front door, and the "Memory Safe Computations with XLA"
+lesson (arxiv 2206.14148) that device-memory bounds belong in the plan
+admission decision, not in an OOM backtrace.
+
+Four gates, applied in order (first refusal wins):
+
+1. **Overload shedding** — a per-tenant :class:`~sparkdq4ml_tpu.utils.
+   recovery.CircuitBreaker` (the PR-1 machinery): a tenant whose queries
+   keep failing or blowing deadlines trips its breaker and new
+   submissions are *shed* instantly (status ``"shed"``) until the
+   cooldown admits a half-open trial. A misbehaving tenant cannot occupy
+   queue slots the healthy tenants need.
+2. **Global queue bound** — total queued jobs across tenants is capped
+   (``max_queue``); beyond it submissions are rejected with
+   ``"queue_full"`` instead of growing an unbounded backlog.
+3. **Per-tenant queue quota** — each tenant may hold at most
+   ``quota.max_queued`` waiting jobs (``"tenant_queue_full"``); one
+   chatty tenant cannot monopolize the global queue.
+4. **Memory gate** — a job that declares an estimated device footprint
+   (``est_bytes``) is checked against ``memory_limit_bytes`` on top of
+   the live-array census (:func:`utils.meminfo.would_fit`); an
+   over-budget job is rejected with ``"memory"`` *before* it can OOM the
+   device mid-flight. Advisory (the census is a lower bound on allocator
+   pressure), and only applied when both the limit and the estimate are
+   known — a job with no estimate is admitted.
+
+Per-tenant **in-flight** quotas (``quota.max_in_flight``) are enforced by
+the server's scheduler, not here: an admitted job waits in its tenant's
+queue until the tenant has a free execution slot.
+
+Every refusal is a structured :class:`Rejection` (status + machine-
+readable reason + human detail) and lands in the ``serve.reject.*`` /
+``serve.shed`` counters — refusals are observable, never silent.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+from ..utils import meminfo
+from ..utils.profiling import counters
+from ..utils.recovery import CircuitBreaker
+
+
+@dataclasses.dataclass(frozen=True)
+class TenantQuota:
+    """Per-tenant resource limits. ``max_in_flight`` bounds concurrent
+    executions (scheduler-enforced); ``max_queued`` bounds the waiting
+    backlog (admission-enforced)."""
+
+    max_in_flight: int = 4
+    max_queued: int = 16
+
+    def __post_init__(self):
+        if self.max_in_flight < 1:
+            raise ValueError("max_in_flight must be >= 1")
+        if self.max_queued < 0:
+            raise ValueError("max_queued must be >= 0")
+
+
+@dataclasses.dataclass(frozen=True)
+class Rejection:
+    """One structured admission refusal."""
+
+    status: str          # "rejected" | "shed"
+    reason: str          # queue_full | tenant_queue_full | memory |
+    #                      breaker_open | shutdown
+    detail: str = ""
+
+
+class AdmissionController:
+    """The four-gate admission policy (module docstring). Stateless apart
+    from the breaker it is handed; the server calls :meth:`admit` under
+    its scheduler lock so the queue-depth figures it sees are exact."""
+
+    def __init__(self, max_queue: int = 64,
+                 memory_limit_bytes: Optional[int] = None,
+                 breaker: Optional[CircuitBreaker] = None):
+        if max_queue < 1:
+            raise ValueError("max_queue must be >= 1")
+        self.max_queue = int(max_queue)
+        self.memory_limit_bytes = (None if memory_limit_bytes is None
+                                   else int(memory_limit_bytes))
+        self.breaker = breaker
+
+    @staticmethod
+    def breaker_key(tenant: str) -> str:
+        return f"serve/{tenant}"
+
+    def admit(self, tenant: str, quota: TenantQuota, queued_total: int,
+              tenant_queued: int,
+              est_bytes: Optional[int] = None,
+              live_bytes: Optional[int] = None) -> Optional[Rejection]:
+        """None = admitted; otherwise the structured refusal. Counters:
+        ``serve.shed``, ``serve.reject`` plus ``serve.reject.<reason>``.
+        ``live_bytes`` lets the caller take the live-array census BEFORE
+        its scheduler lock (the census walks every live jax array — an
+        O(arrays) scan the server must not hold its condition lock
+        through); the gate is advisory, so a slightly stale figure is
+        fine. ``None`` = census taken here."""
+        if self.breaker is not None and not self.breaker.allow(
+                self.breaker_key(tenant)):
+            counters.increment("serve.shed")
+            return Rejection(
+                "shed", "breaker_open",
+                f"tenant {tenant!r} circuit breaker is open "
+                "(recent failures/deadline overruns); retry after cooldown")
+        if queued_total >= self.max_queue:
+            return self._reject(
+                "queue_full",
+                f"server queue is full ({queued_total}/{self.max_queue})")
+        if tenant_queued >= quota.max_queued:
+            return self._reject(
+                "tenant_queue_full",
+                f"tenant {tenant!r} queue is full "
+                f"({tenant_queued}/{quota.max_queued})")
+        if (self.memory_limit_bytes is not None and est_bytes is not None
+                and est_bytes > 0):
+            fits, live = meminfo.would_fit(
+                est_bytes, self.memory_limit_bytes, live=live_bytes)
+            if not fits:
+                return self._reject(
+                    "memory",
+                    f"estimated {int(est_bytes)} B + live {live} B exceeds "
+                    f"the {self.memory_limit_bytes} B device-memory limit")
+        return None
+
+    @staticmethod
+    def _reject(reason: str, detail: str) -> Rejection:
+        counters.increment("serve.reject")
+        counters.increment(f"serve.reject.{reason}")
+        return Rejection("rejected", reason, detail)

@@ -6,12 +6,37 @@ Covers the session surface the reference exercises
 temp views, and — the TPU-native part — the device mesh that replaces Spark's
 executor pool (SURVEY.md §3.1). There is no session daemon: "starting" a
 session is discovering devices and building a ``jax.sharding.Mesh``.
+
+Threading model (session vs server)
+-----------------------------------
+
+* The **session is a process singleton** (Spark ``getOrCreate``
+  semantics). ``builder().get_or_create()`` is thread-safe — a
+  double-checked lock (:data:`_ACTIVE_LOCK`) guarantees racing threads
+  get ONE session object, never two half-initialized ones.
+* **Frames and queries are safe to share across threads**: frame flushes
+  serialize on the pipeline flush lock, the plan/jit caches and metric
+  registries are lock-protected, and grouped execution serializes its
+  device path. Concurrent ``session.sql`` calls against the SAME catalog
+  are safe for reads; concurrent DDL (``CREATE VIEW``) on one catalog
+  last-writer-wins like Spark temp views.
+* **Multi-tenant concurrency belongs to the serving layer**:
+  :meth:`TpuSession.serve` returns the process :class:`~sparkdq4ml_tpu.
+  serve.QueryServer`, which gives each tenant its own temp-view catalog,
+  admission control, and SLO metrics over the shared engine. Prefer it
+  over hand-rolled threads when callers are independent workloads.
+* **Conf mutation is session-scoped and lock-protected**: the
+  ``_init_pipeline`` save/restore of process config
+  (:data:`_CONF_LOCK`) cannot interleave with a concurrent ``stop()``
+  restoring it. ``stop()`` drains the serving layer FIRST, so in-flight
+  served queries never observe a half-restored config.
 """
 
 from __future__ import annotations
 
 import logging
 import os
+import threading
 from typing import Optional
 
 import jax
@@ -26,6 +51,19 @@ from .sql.parser import execute as _sql_execute
 logger = logging.getLogger("sparkdq4ml_tpu.session")
 
 _ACTIVE: Optional["TpuSession"] = None
+#: Guards the active-session singleton (builder/get_or_create/stop): the
+#: double-checked lock behind Spark's one-session-per-process contract.
+_ACTIVE_LOCK = threading.Lock()
+#: Guards the session-scoped config save/restore (_init_pipeline/stop):
+#: a builder re-init on one thread and a stop() on another must not
+#: interleave their read-modify-write of the process config.
+_CONF_LOCK = threading.Lock()
+
+#: Conf boolean spellings (session-scoped keys) — the shared vocabulary
+#: from config.py, so spark.serve.enabled=no and the serve layer's own
+#: parser can never disagree.
+from .config import CONF_FALSE as _CONF_FALSE  # noqa: E402
+from .config import CONF_TRUE as _CONF_TRUE  # noqa: E402
 
 
 def host_cache_tag() -> str:
@@ -173,49 +211,53 @@ class TpuSession:
         from .config import config as _cfg
         from .ops import compiler as _compiler
 
-        saved = getattr(self, "_pipeline_saved", None) or {}
+        with _CONF_LOCK:
+            saved = getattr(self, "_pipeline_saved", None) or {}
 
-        def _set(attr, value):
-            saved.setdefault(attr, getattr(_cfg, attr))
-            setattr(_cfg, attr, value)
+            def _set(attr, value):
+                saved.setdefault(attr, getattr(_cfg, attr))
+                setattr(_cfg, attr, value)
 
-        val = str(self.conf.get("spark.pipeline.enabled", "")).lower()
-        if val in ("false", "off", "0"):
-            _set("pipeline", False)
-            _compiler.clear_cache()
-        elif val in ("true", "on", "1"):
-            _set("pipeline", True)
-        if "spark.pipeline.minBucket" in self.conf:
-            _set("pipeline_min_bucket",
-                 int(self.conf["spark.pipeline.minBucket"]))
-            _compiler.clear_cache()
-        if "spark.pipeline.cacheSize" in self.conf:
-            _set("pipeline_cache_size",
-                 int(self.conf["spark.pipeline.cacheSize"]))
-        # Device-resident grouped execution (ops/segments.py) rides the
-        # same session-scoped save/restore:
-        #     .config("spark.groupedExec.enabled", "false")  # host groupBy
-        gval = str(self.conf.get("spark.groupedExec.enabled", "")).lower()
-        if gval in ("false", "off", "0"):
-            from .ops import segments as _segments
+            val = str(self.conf.get("spark.pipeline.enabled", "")).lower()
+            if val in _CONF_FALSE:
+                _set("pipeline", False)
+                _compiler.clear_cache()
+            elif val in _CONF_TRUE:
+                _set("pipeline", True)
+            if "spark.pipeline.minBucket" in self.conf:
+                _set("pipeline_min_bucket",
+                     int(self.conf["spark.pipeline.minBucket"]))
+                _compiler.clear_cache()
+            if "spark.pipeline.cacheSize" in self.conf:
+                _set("pipeline_cache_size",
+                     int(self.conf["spark.pipeline.cacheSize"]))
+            # Device-resident grouped execution (ops/segments.py) rides the
+            # same session-scoped save/restore:
+            #     .config("spark.groupedExec.enabled", "false") # host groupBy
+            gval = str(self.conf.get("spark.groupedExec.enabled", "")).lower()
+            if gval in _CONF_FALSE:
+                from .ops import segments as _segments
 
-            _set("grouped_exec", False)
-            _segments.clear_cache()
-        elif gval in ("true", "on", "1"):
-            _set("grouped_exec", True)
-        # EXPLAIN ANALYZE knobs (sql/parser.py) ride the same
-        # session-scoped save/restore:
-        #     .config("spark.explain.memory", "false")  # no mem sampling
-        #     .config("spark.explain.caches", "false")  # no cache section
-        for conf_key, attr in (("spark.explain.memory", "explain_memory"),
-                               ("spark.explain.caches", "explain_caches")):
-            v = str(self.conf.get(conf_key, "")).lower()
-            if v in ("false", "off", "0"):
-                _set(attr, False)
-            elif v in ("true", "on", "1"):
-                _set(attr, True)
-        if saved:
-            self._pipeline_saved = saved
+                _set("grouped_exec", False)
+                _segments.clear_cache()
+            elif gval in _CONF_TRUE:
+                _set("grouped_exec", True)
+            # EXPLAIN ANALYZE knobs (sql/parser.py) and the serving-layer
+            # gate (serve/) ride the same session-scoped save/restore:
+            #     .config("spark.explain.memory", "false")  # no mem sampling
+            #     .config("spark.explain.caches", "false")  # no cache section
+            #     .config("spark.serve.enabled", "false")   # serve() refuses
+            for conf_key, attr in (
+                    ("spark.explain.memory", "explain_memory"),
+                    ("spark.explain.caches", "explain_caches"),
+                    ("spark.serve.enabled", "serve_enabled")):
+                v = str(self.conf.get(conf_key, "")).lower()
+                if v in _CONF_FALSE:
+                    _set(attr, False)
+                elif v in _CONF_TRUE:
+                    _set(attr, True)
+            if saved:
+                self._pipeline_saved = saved
 
     def _init_observability(self) -> None:
         """Install the tracing/metrics subsystem (``utils.observability``)
@@ -548,10 +590,16 @@ class TpuSession:
             return self
 
         def get_or_create(self) -> "TpuSession":
+            # Thread-safe singleton (double-checked): concurrent callers —
+            # e.g. serving-layer clients racing at process start — get ONE
+            # fully-constructed session; the conf-update path is likewise
+            # serialized so two builders cannot interleave re-inits.
             global _ACTIVE
-            if _ACTIVE is None:
-                _ACTIVE = TpuSession(self._app_name, self._master, self._conf)
-            else:
+            with _ACTIVE_LOCK:
+                if _ACTIVE is None:
+                    _ACTIVE = TpuSession(self._app_name, self._master,
+                                         self._conf)
+                    return _ACTIVE
                 _ACTIVE.conf.update(self._conf)  # Spark getOrCreate semantics
                 if any(k.startswith("spark.compilation.") for k in self._conf):
                     _ACTIVE._init_compilation_cache()
@@ -561,10 +609,10 @@ class TpuSession:
                        for k in self._conf):
                     _ACTIVE._init_observability()
                 if any(k.startswith(("spark.pipeline.", "spark.groupedExec",
-                                     "spark.explain."))
+                                     "spark.explain.", "spark.serve."))
                        for k in self._conf):
                     _ACTIVE._init_pipeline()
-            return _ACTIVE
+                return _ACTIVE
 
         getOrCreate = get_or_create
 
@@ -595,6 +643,32 @@ class TpuSession:
         """Run the SQL subset against this session's temp views
         (`DataQuality4MachineLearningApp.java:77,89`)."""
         return _sql_execute(query, self.catalog)
+
+    def serve(self, **overrides):
+        """The session's :class:`~sparkdq4ml_tpu.serve.QueryServer` —
+        started on first call from ``spark.serve.*`` conf keys (workers,
+        maxQueue, maxInFlight, maxQueuedPerTenant, memoryLimitBytes,
+        defaultDeadline, sharedPlanCache, breakerThreshold,
+        breakerCooldown), keyword ``overrides`` winning. Subsequent
+        calls return the same running server; :meth:`stop` drains and
+        stops it. ``spark.serve.enabled=false`` makes this raise — the
+        serving layer is otherwise pay-for-use (no server, no threads,
+        no metrics). See README § "Serving"."""
+        from .config import config as _cfg
+
+        with _ACTIVE_LOCK:
+            server = getattr(self, "_server", None)
+            if server is not None and server.running:
+                return server
+            if not _cfg.serve_enabled:
+                raise RuntimeError(
+                    "query serving is disabled "
+                    "(spark.serve.enabled=false on this session)")
+            from .serve import QueryServer
+
+            self._server = QueryServer.from_conf(self, self.conf,
+                                                 **overrides).start()
+            return self._server
 
     def table(self, name: str):
         """Spark's ``spark.table(name)`` — the registered temp view."""
@@ -658,8 +732,23 @@ class TpuSession:
 
     def stop(self) -> None:
         global _ACTIVE
-        if _ACTIVE is self:
-            _ACTIVE = None
+        # The server handle is swapped out under the SAME lock serve()
+        # creates it under — a serve() racing this stop() either lands
+        # before (its server is the one drained below) or after (it
+        # starts a fresh server on a stopped-but-usable session object);
+        # it can never start one that stop() silently ignores.
+        with _ACTIVE_LOCK:
+            if _ACTIVE is self:
+                _ACTIVE = None
+            server = getattr(self, "_server", None)
+            self._server = None
+        # Drain the serving layer FIRST (outside the lock — draining can
+        # take a while): in-flight served queries finish against the
+        # session's still-installed config; only then is the
+        # session-scoped conf restored below (the stop-vs-query race the
+        # threading-model doc pins down).
+        if server is not None:
+            server.stop(drain=True)
         self.catalog.clear()
         # Close the root session span and stop recording if THIS session
         # turned tracing on (same session-scoped rule as the fault plan).
@@ -679,18 +768,21 @@ class TpuSession:
         # Restore pipeline-compiler settings THIS session changed (same
         # session-scoped rule as the fault plan): a session that disabled
         # the pipeline must not leave the process on the eager path.
-        saved = getattr(self, "_pipeline_saved", None)
-        if saved:
-            from .config import config as _cfg
-            from .ops import compiler as _compiler
+        # Under _CONF_LOCK so a concurrent builder re-init cannot
+        # interleave with (and then clobber) this restore.
+        with _CONF_LOCK:
+            saved = getattr(self, "_pipeline_saved", None)
+            if saved:
+                from .config import config as _cfg
+                from .ops import compiler as _compiler
 
-            for attr, value in saved.items():
-                setattr(_cfg, attr, value)
-            self._pipeline_saved = None
-            _compiler.clear_cache()
-            from .ops import segments as _segments
+                for attr, value in saved.items():
+                    setattr(_cfg, attr, value)
+                self._pipeline_saved = None
+                _compiler.clear_cache()
+                from .ops import segments as _segments
 
-            _segments.clear_cache()
+                _segments.clear_cache()
         # Uninstall the fault plan THIS session installed (conf/env):
         # chaos is session-scoped opt-in; a later chaos-free session (or
         # plain library use) must not keep injecting this one's faults.

@@ -91,6 +91,29 @@ def estimated_bytes(tree) -> int:
     return total
 
 
+def headroom(limit_bytes: int) -> int:
+    """Bytes remaining under ``limit_bytes`` given the live-array census
+    (0 when already over). Host-side metadata only — never a sync."""
+    return max(0, int(limit_bytes) - live_bytes())
+
+
+def would_fit(est_bytes: int, limit_bytes: int,
+              live: Optional[int] = None) -> tuple[bool, int]:
+    """Admission-gate predicate (the serving layer's memory gate): would a
+    job estimated at ``est_bytes`` device bytes fit under ``limit_bytes``
+    on top of what is live right now? Returns ``(fits, live_bytes_now)``
+    so the caller can put the observed figure in its structured
+    rejection. ``live`` lets a caller reuse a census it already took
+    (e.g. before acquiring a scheduler lock); ``None`` = census here.
+    The census is a lower bound on true allocator pressure (allocator
+    slack is invisible on backends without memory_stats), so the gate is
+    advisory, not a hard reservation — documented in README § Serving."""
+    if live is None:
+        live = live_bytes()
+    live = int(live)
+    return (live + max(int(est_bytes), 0) <= int(limit_bytes), live)
+
+
 def device_stats() -> list[dict]:
     """Per-device allocator statistics where the backend exposes them
     (``[]`` on XLA:CPU). Keys mirror PJRT: ``bytes_in_use``,

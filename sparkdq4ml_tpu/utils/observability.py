@@ -841,6 +841,8 @@ def _prom_num(v: float) -> str:
 #: names the original dotted metric so a scrape reader can map the
 #: sanitized Prometheus name back to the in-process counter.
 _HELP_PREFIXES = (
+    ("serve.", "query-serving layer: admission, queueing, per-tenant SLO "
+     "(serve/)"),
     ("recovery.", "resilience-layer event count (utils.recovery)"),
     ("pipeline.", "fused expression-pipeline compiler (ops/compiler.py)"),
     ("grouped.", "device-resident grouped execution (ops/segments.py)"),

@@ -309,6 +309,22 @@ class CircuitBreaker:
             else:
                 self._state.pop(key, None)
 
+    def snapshot(self) -> dict:
+        """Per-key breaker state for observability surfaces (the serving
+        layer's ``QueryServer.stats()``): consecutive failure count and
+        whether the key is currently refusing calls (``open`` goes False
+        again once the cooldown admits a half-open trial)."""
+        with self._lock:
+            now = self._clock()
+            return {
+                key: {
+                    "consecutive_failures": fails,
+                    "open": (opened is not None
+                             and now - opened < self.cooldown),
+                }
+                for key, (fails, opened) in self._state.items()
+            }
+
 
 #: Process-global breaker guarding device execution paths (sharded Gramian,
 #: packed fit). Keys are site names; tests reset it via ``reset()``.
