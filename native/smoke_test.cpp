@@ -1,37 +1,158 @@
-// Minimal C++-side smoke test for the native CSV tokenizer: parses the file
-// given on argv[1] and prints shape + first values. Exercised by `make test`;
-// the authoritative behavior tests live in tests/test_native_csv.py.
+// C++-side smoke test for the native CSV tokenizer: parses the file given
+// on argv[1] through every entry point the Python layer uses and checks
+// they agree bit-wise:
+//
+//   * v1 one-shot (dq_parse_numeric_csv — the legacy ABI),
+//   * v2 one-shot at the scalar tier and at the best tier the CPU offers
+//     (runtime dispatch: requesting avx512 on a lesser CPU must clamp
+//     cleanly, never SIGILL),
+//   * the streaming API (dq_stream_*) at a small chunk size, stitched
+//     host-side and compared to the one-shot result.
+//
+// Exercised by `make test` and scripts/check_native_build.py; the
+// authoritative behavior tests live in tests/test_native_csv.py and
+// tests/test_ingest.py.
 #include <cstdio>
 #include <cstdlib>
+#include <cstring>
+#include <vector>
 
 extern "C" {
 long long dq_parse_numeric_csv(const char*, char, char, int, double**,
                                long long*, char**);
+long long dq_parse_numeric_csv_v2(const char*, char, char, int, int, int,
+                                  double**, long long*, char**);
+int dq_effective_simd(int);
+void* dq_stream_open(const char*, char, char, int, long long, int, int);
+long long dq_stream_ncols(void*);
+int dq_stream_simd(void*);
+long long dq_stream_next(void*, double**);
+void dq_stream_int_flags(void*, char*);
+void dq_stream_close(void*);
 void dq_free(void*);
 }
+
+namespace {
+
+struct Parsed {
+  std::vector<double> data;  // column-major
+  std::vector<char> flags;
+  long long rows = -1;
+  long long cols = 0;
+};
+
+bool oneshot(const char* path, int simd, int threads, bool v1, Parsed* out) {
+  double* data = nullptr;
+  long long ncols = 0;
+  char* flags = nullptr;
+  const long long rows =
+      v1 ? dq_parse_numeric_csv(path, ',', '"', 0, &data, &ncols, &flags)
+         : dq_parse_numeric_csv_v2(path, ',', '"', 0, simd, threads, &data,
+                                   &ncols, &flags);
+  if (rows < 0) {
+    std::fprintf(stderr, "parse failed (%s simd=%d): %lld\n",
+                 v1 ? "v1" : "v2", simd, rows);
+    return false;
+  }
+  out->rows = rows;
+  out->cols = ncols;
+  out->data.assign(data, data + ncols * rows);
+  out->flags.assign(flags, flags + ncols);
+  dq_free(data);
+  dq_free(flags);
+  return true;
+}
+
+// memcmp, not ==: NaN-padded nulls must match bit-wise too.
+bool same(const Parsed& a, const Parsed& b, const char* what) {
+  if (a.rows != b.rows || a.cols != b.cols ||
+      std::memcmp(a.flags.data(), b.flags.data(),
+                  static_cast<size_t>(a.cols)) != 0 ||
+      std::memcmp(a.data.data(), b.data.data(),
+                  a.data.size() * sizeof(double)) != 0) {
+    std::fprintf(stderr, "MISMATCH: %s (rows %lld vs %lld)\n", what, a.rows,
+                 b.rows);
+    return false;
+  }
+  return true;
+}
+
+bool stream_all(const char* path, int simd, long long chunk_bytes,
+                Parsed* out) {
+  void* h = dq_stream_open(path, ',', '"', 0, chunk_bytes, 0, simd);
+  if (h == nullptr) {
+    std::fprintf(stderr, "stream open failed\n");
+    return false;
+  }
+  const long long ncols = dq_stream_ncols(h);
+  if (ncols <= 0) {
+    dq_stream_close(h);
+    std::fprintf(stderr, "stream ncols=%lld\n", ncols);
+    return false;
+  }
+  std::vector<std::vector<double>> cols(static_cast<size_t>(ncols));
+  long long total = 0;
+  for (;;) {
+    double* data = nullptr;
+    const long long rows = dq_stream_next(h, &data);
+    if (rows < 0) {
+      dq_stream_close(h);
+      std::fprintf(stderr, "stream next=%lld\n", rows);
+      return false;
+    }
+    if (rows == 0) break;
+    for (long long j = 0; j < ncols; ++j)
+      cols[static_cast<size_t>(j)].insert(
+          cols[static_cast<size_t>(j)].end(), data + j * rows,
+          data + (j + 1) * rows);
+    dq_free(data);
+    total += rows;
+  }
+  out->rows = total;
+  out->cols = ncols;
+  out->data.clear();
+  for (const auto& c : cols)
+    out->data.insert(out->data.end(), c.begin(), c.end());
+  out->flags.assign(static_cast<size_t>(ncols), 0);
+  dq_stream_int_flags(h, out->flags.data());
+  dq_stream_close(h);
+  return true;
+}
+
+}  // namespace
 
 int main(int argc, char** argv) {
   if (argc < 2) {
     std::fprintf(stderr, "usage: %s file.csv\n", argv[0]);
     return 2;
   }
-  double* data = nullptr;
-  long long ncols = 0;
-  char* flags = nullptr;
-  long long nrows =
-      dq_parse_numeric_csv(argv[1], ',', '"', 0, &data, &ncols, &flags);
-  if (nrows < 0) {
-    std::fprintf(stderr, "parse failed: %lld\n", nrows);
-    return 1;
-  }
-  std::printf("rows=%lld cols=%lld first=[", nrows, ncols);
-  for (long long j = 0; j < ncols; ++j)
-    std::printf("%s%g", j ? "," : "", data[j * nrows]);
+  const char* path = argv[1];
+  // auto honors DQCSV_SIMD; an explicit tier request ignores env and
+  // clamps to the CPU ceiling — the proof it clamped (vs SIGILLed) is the
+  // simd=2 parse below running and matching scalar bit-wise.
+  const int best = dq_effective_simd(-1);
+  const int clamp512 = dq_effective_simd(2);
+  std::printf("simd: auto=%d requested-avx512=%d\n", best, clamp512);
+
+  Parsed v1, scalar, simd, threaded, streamed;
+  if (!oneshot(path, 0, 0, /*v1=*/true, &v1)) return 1;
+  if (!oneshot(path, 0, 1, /*v1=*/false, &scalar)) return 1;
+  if (!oneshot(path, 2, 1, /*v1=*/false, &simd)) return 1;  // clamped tier
+  if (!oneshot(path, 2, 4, /*v1=*/false, &threaded)) return 1;
+  if (!stream_all(path, 2, /*chunk_bytes=*/4096, &streamed)) return 1;
+
+  if (!same(scalar, simd, "scalar vs simd")) return 1;
+  if (!same(scalar, threaded, "scalar vs simd+threads")) return 1;
+  if (!same(scalar, streamed, "one-shot vs streamed")) return 1;
+  // v1 runs whatever DQCSV_SIMD/auto picks — still bit-identical
+  if (!same(scalar, v1, "v2 scalar vs v1")) return 1;
+
+  std::printf("rows=%lld cols=%lld first=[", scalar.rows, scalar.cols);
+  for (long long j = 0; j < scalar.cols; ++j)
+    std::printf("%s%g", j ? "," : "", scalar.data[j * scalar.rows]);
   std::printf("] int_flags=[");
-  for (long long j = 0; j < ncols; ++j)
-    std::printf("%s%d", j ? "," : "", flags[j]);
-  std::printf("]\n");
-  dq_free(data);
-  dq_free(flags);
+  for (long long j = 0; j < scalar.cols; ++j)
+    std::printf("%s%d", j ? "," : "", scalar.flags[j]);
+  std::printf("]\nsmoke OK: scalar == simd == simd+threads == streamed\n");
   return 0;
 }

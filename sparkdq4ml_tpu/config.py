@@ -63,6 +63,29 @@ class _Config:
     # server; the layer is otherwise pay-for-use — a process that never
     # starts a QueryServer runs zero serve code (no threads, no metrics).
     serve_enabled: bool = True
+    # Streaming CSV ingest (frame/native_csv.py): files larger than one
+    # chunk parse through the native dq_stream API in bounded chunks cut
+    # on structural record boundaries, with a prefetch thread overlapping
+    # parse of chunk N+1 with host->device transfer of chunk N
+    # (spark.ingest.streaming conf; False restores the exact legacy
+    # one-shot native path).
+    ingest_streaming: bool = True
+    # Parse threads per chunk: 0 = auto (DQCSV_THREADS env, then a
+    # size-based heuristic in the native layer), else an explicit cap
+    # (spark.ingest.threads).
+    ingest_threads: int = 0
+    # Chunk size in bytes for the streaming parse — the static per-chunk
+    # memory bound; also the streaming threshold: smaller files take one
+    # one-shot native call (spark.ingest.chunkBytes).
+    ingest_chunk_bytes: int = 8 << 20
+    # Bounded prefetch queue depth: how many parsed-but-untransferred
+    # chunks the producer thread may run ahead (spark.ingest.prefetch).
+    ingest_prefetch: int = 2
+    # SIMD tier for the native parse: "auto" (runtime CPU-feature
+    # dispatch, overridable by DQCSV_SIMD env), "off" (scalar),
+    # "avx2", "avx512" — explicit tiers clamp to what the CPU supports
+    # (spark.ingest.simd).
+    ingest_simd: str = "auto"
     # Pallas fast-path selection for the hot ops (ops/pallas_kernels.py):
     # the single-device Gramian in solvers.augmented_gram and the fused DQ
     # chain entry point ops/rules.py:dq_rules_fused. "off" = plain XLA

@@ -260,6 +260,14 @@ def read_csv(path: str, header: bool = False, infer_schema: bool = True,
                                             required=(engine == "native"))
             if frame is not None:
                 return frame
+            if native_csv.available():
+                # native was eligible and declined (non-numeric content,
+                # ragged header, multibyte delimiter...): the ingest
+                # telemetry counts the demotion so a fleet-wide scrape can
+                # see what share of reads misses the fast path
+                from ..utils.profiling import counters
+
+                counters.increment("ingest.python_fallback")
 
     with open(path, "rb") as f:
         text = f.read().decode("utf-8")

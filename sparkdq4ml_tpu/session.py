@@ -250,12 +250,31 @@ class TpuSession:
             for conf_key, attr in (
                     ("spark.explain.memory", "explain_memory"),
                     ("spark.explain.caches", "explain_caches"),
-                    ("spark.serve.enabled", "serve_enabled")):
+                    ("spark.serve.enabled", "serve_enabled"),
+                    ("spark.ingest.streaming", "ingest_streaming")):
                 v = str(self.conf.get(conf_key, "")).lower()
                 if v in _CONF_FALSE:
                     _set(attr, False)
                 elif v in _CONF_TRUE:
                     _set(attr, True)
+            # Streaming-ingest tuning (frame/native_csv.py), session-scoped
+            # like everything above:
+            #     .config("spark.ingest.streaming", "false") # legacy one-shot
+            #     .config("spark.ingest.threads", 4)         # parse threads
+            #     .config("spark.ingest.chunkBytes", 1 << 20) # chunk bound
+            #     .config("spark.ingest.prefetch", 2)        # queue depth
+            #     .config("spark.ingest.simd", "off")        # scalar tier
+            if "spark.ingest.threads" in self.conf:
+                _set("ingest_threads", int(self.conf["spark.ingest.threads"]))
+            if "spark.ingest.chunkBytes" in self.conf:
+                _set("ingest_chunk_bytes",
+                     int(self.conf["spark.ingest.chunkBytes"]))
+            if "spark.ingest.prefetch" in self.conf:
+                _set("ingest_prefetch",
+                     int(self.conf["spark.ingest.prefetch"]))
+            if "spark.ingest.simd" in self.conf:
+                _set("ingest_simd",
+                     str(self.conf["spark.ingest.simd"]).lower())
             if saved:
                 self._pipeline_saved = saved
 
@@ -609,7 +628,8 @@ class TpuSession:
                        for k in self._conf):
                     _ACTIVE._init_observability()
                 if any(k.startswith(("spark.pipeline.", "spark.groupedExec",
-                                     "spark.explain.", "spark.serve."))
+                                     "spark.explain.", "spark.serve.",
+                                     "spark.ingest."))
                        for k in self._conf):
                     _ACTIVE._init_pipeline()
                 return _ACTIVE
