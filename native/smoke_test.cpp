@@ -78,8 +78,8 @@ bool same(const Parsed& a, const Parsed& b, const char* what) {
 }
 
 bool stream_all(const char* path, int simd, long long chunk_bytes,
-                Parsed* out) {
-  void* h = dq_stream_open(path, ',', '"', 0, chunk_bytes, 0, simd);
+                Parsed* out, int threads = 0) {
+  void* h = dq_stream_open(path, ',', '"', 0, chunk_bytes, threads, simd);
   if (h == nullptr) {
     std::fprintf(stderr, "stream open failed\n");
     return false;
@@ -146,6 +146,30 @@ int main(int argc, char** argv) {
   if (!same(scalar, streamed, "one-shot vs streamed")) return 1;
   // v1 runs whatever DQCSV_SIMD/auto picks — still bit-identical
   if (!same(scalar, v1, "v2 scalar vs v1")) return 1;
+
+  // `smoke file.csv grid`: the threaded stream parity grid — every
+  // {chunk size} x {explicit thread count} combination of the dq_stream
+  // chunk-parallel path must match the scalar one-shot bit-wise. This is
+  // the surface the TSan build arm of scripts/check_native_build.py
+  // races: chunk cutting, per-piece parse threads, cross-chunk integral
+  // backfill, all under a real thread schedule.
+  if (argc > 2 && std::strcmp(argv[2], "grid") == 0) {
+    const long long chunks[] = {1 << 14, 1 << 20};
+    const int threadings[] = {1, 2, 4};
+    for (long long cb : chunks) {
+      for (int th : threadings) {
+        Parsed g;
+        char what[64];
+        std::snprintf(what, sizeof what, "stream grid chunk=%lld threads=%d",
+                      cb, th);
+        if (!stream_all(path, 2, cb, &g, th)) return 1;
+        if (!same(scalar, g, what)) return 1;
+      }
+    }
+    std::printf("stream grid OK: %zu chunk sizes x %zu thread counts\n",
+                sizeof(chunks) / sizeof(chunks[0]),
+                sizeof(threadings) / sizeof(threadings[0]));
+  }
 
   std::printf("rows=%lld cols=%lld first=[", scalar.rows, scalar.cols);
   for (long long j = 0; j < scalar.cols; ++j)

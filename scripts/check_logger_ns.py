@@ -2,102 +2,38 @@
 """Lint: every ``logging.getLogger(...)`` in ``sparkdq4ml_tpu/`` must live in
 the ``sparkdq4ml_tpu.`` namespace.
 
-Why: ``utils.logging.configure_logging`` tiers log levels by namespace
-(framework at DEBUG, root at INFO, jax at WARNING) — a logger created
-outside ``sparkdq4ml_tpu.*`` silently escapes that tiering and the
-observability story ("one namespace to scrape") breaks one module at a
-time. Allowed spellings:
+Since ISSUE 8 this is a thin CLI over the dqlint framework's
+``logger-ns`` rule (``sparkdq4ml_tpu/analysis/rules/logger_ns.py``) —
+one rule implementation, two entry points (this legacy script and the
+unified ``scripts/check_static.py`` gate). Semantics are unchanged:
 
-* a string literal starting with ``"sparkdq4ml_tpu"``,
-* ``__name__`` (modules inside the package resolve to the namespace),
-* any call on a line carrying a ``# logger-ns: ok`` pragma (reserved for
-  the configurator itself, which legitimately touches the root logger and
-  third-party namespaces).
+* allowed spellings: a literal starting with ``"sparkdq4ml_tpu"``,
+  ``__name__``, or a call carrying ``# logger-ns: ok``;
+* ``from logging import getLogger`` is flagged outright;
+* AST-based, so line-wrapped calls are caught and comments/docstrings
+  never false-positive.
 
-``from logging import getLogger`` is flagged outright: a bare-name alias
-would hide later calls from this check.
-
-AST-based (not regex over lines), so line-wrapped calls are caught and
-text inside comments/docstrings is never a false positive. Exit status 0
-when clean; 1 with one ``path:line`` diagnostic per offender — invoked by
-the tier-1 test suite (tests/test_observability.py) so CI fails the
-moment a rogue logger lands.
+Exit status 0 when clean; 1 with one ``path:line`` diagnostic per
+offender — invoked by the tier-1 suite (tests/test_observability.py).
 """
 
 from __future__ import annotations
 
-import ast
 import os
 import sys
 
-PRAGMA = "logger-ns: ok"
-
-
-def _is_getlogger_call(node: ast.Call) -> bool:
-    f = node.func
-    return (isinstance(f, ast.Attribute) and f.attr == "getLogger"
-            and isinstance(f.value, ast.Name) and f.value.id == "logging")
-
-
-def _arg_ok(node: ast.Call) -> tuple[bool, str]:
-    """(allowed, printable-arg) for the first positional argument."""
-    if not node.args:
-        return False, "<root>"
-    a = node.args[0]
-    if isinstance(a, ast.Name) and a.id == "__name__":
-        return True, "__name__"
-    if isinstance(a, ast.Constant) and isinstance(a.value, str):
-        ok = (a.value == "sparkdq4ml_tpu"
-              or a.value.startswith("sparkdq4ml_tpu."))
-        return ok, repr(a.value)
-    return False, ast.dump(a)
-
-
-def check_file(path: str) -> list[str]:
-    with open(path, encoding="utf-8") as f:
-        text = f.read()
-    try:
-        tree = ast.parse(text, filename=path)
-    except SyntaxError as e:
-        return [f"{path}:{e.lineno or 0}: unparseable ({e.msg})"]
-    lines = text.splitlines()
-
-    def has_pragma(node) -> bool:
-        end = getattr(node, "end_lineno", node.lineno) or node.lineno
-        return any(PRAGMA in lines[i - 1]
-                   for i in range(node.lineno, min(end, len(lines)) + 1))
-
-    problems = []
-    for node in ast.walk(tree):
-        if isinstance(node, ast.ImportFrom) and node.module == "logging" \
-                and any(a.name == "getLogger" for a in node.names):
-            problems.append(
-                f"{path}:{node.lineno}: 'from logging import getLogger'"
-                " hides calls from this lint; use 'import logging' +"
-                " logging.getLogger(...)")
-        elif isinstance(node, ast.Call) and _is_getlogger_call(node):
-            if has_pragma(node):
-                continue
-            ok, arg = _arg_ok(node)
-            if not ok:
-                problems.append(
-                    f"{path}:{node.lineno}: logging.getLogger({arg})"
-                    " is outside the sparkdq4ml_tpu namespace"
-                    " (use 'sparkdq4ml_tpu.<module>', __name__, or a"
-                    f" '# {PRAGMA}' pragma)")
-    return sorted(problems)
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 def main(root: str) -> int:
-    pkg = os.path.join(root, "sparkdq4ml_tpu")
-    problems: list[str] = []
-    for dirpath, _dirs, files in os.walk(pkg):
-        for name in sorted(files):
-            if name.endswith(".py"):
-                problems.extend(check_file(os.path.join(dirpath, name)))
-    for p in problems:
-        print(p)
-    return 1 if problems else 0
+    sys.path.insert(0, REPO)
+    from sparkdq4ml_tpu.analysis import get_rules, run_rules
+
+    findings, _ = run_rules(os.path.abspath(root), get_rules(["logger-ns"]))
+    for f in findings:
+        print(f"{os.path.join(os.path.abspath(root), f.path)}:{f.line}:"
+              f" {f.message}")
+    return 1 if findings else 0
 
 
 if __name__ == "__main__":

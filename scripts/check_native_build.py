@@ -19,13 +19,24 @@ silently until someone rebuilds. This script:
    CPU without AVX-512 the avx512 request falls back cleanly instead of
    SIGILLing.
 
+4. (ISSUE 8) builds and runs **sanitizer arms** over the same sources:
+   an ASan+UBSan binary (smoke_test.cpp + csvparse.cpp compiled
+   together, ``-fno-sanitize-recover=all`` so any finding is fatal)
+   running the full smoke cross-check on a generated multi-thousand-row
+   CSV, and a TSan binary running the smoke's *threaded stream parity
+   grid* (``smoke <file> grid``: {chunk size} x {1,2,4 threads} over
+   the chunk-parallel ``dq_stream`` path) on a multi-MB file so the
+   parse threads, chunk cutting, and cross-chunk integral backfill see
+   a real thread schedule under the race detector. Each arm SKIPs
+   cleanly when the toolchain cannot link that sanitizer.
+
 Exit codes: 0 = pass (or clean SKIP when no C++ toolchain is present —
 the pure-Python engine is a supported configuration), 1 = failure.
 Wired as a tier-1 test in tests/test_ingest.py.
 
 Usage::
 
-    python scripts/check_native_build.py [--keep]
+    python scripts/check_native_build.py [--keep] [--no-sanitize]
 """
 
 from __future__ import annotations
@@ -160,10 +171,71 @@ def check_dispatch(so: str, tmp: str) -> bool:
     return True
 
 
+def _sanitizer_csv(tmp: str, rows: int) -> str:
+    """Mixed-shape numeric CSV big enough to engage the chunk-parallel
+    threads (the native layer budgets ~1 thread per MB)."""
+    path = os.path.join(tmp, f"san_{rows}.csv")
+    if not os.path.exists(path):
+        with open(path, "w") as f:
+            for i in range(rows):
+                f.write(f"{i},{i}.{i % 100:02d},-{i}e-2,,{i * 7 % 997}\n")
+    return path
+
+
+def _sanitizer_supported(cxx: str, tmp: str, flag: str) -> bool:
+    """Can this toolchain compile AND link `flag`? (gcc happily accepts
+    -fsanitize=thread at compile time on hosts with no libtsan)."""
+    probe_src = os.path.join(tmp, "san_probe.cpp")
+    if not os.path.exists(probe_src):
+        with open(probe_src, "w") as f:
+            f.write("int main() { return 0; }\n")
+    p = run([cxx, flag, "-o", os.path.join(tmp, "san_probe"), probe_src])
+    return p.returncode == 0
+
+
+def sanitizer_arm(cxx: str, tmp: str, kind: str) -> bool:
+    """Build smoke+parser under a sanitizer and run it; True = pass/SKIP.
+
+    kind 'asan': address+undefined, full smoke cross-check, SIMD tiers on
+    (``-march=native`` when available) so the AVX kernels' loads/stores
+    get bounds-checked too. kind 'tsan': thread sanitizer over the
+    threaded stream parity grid on a multi-MB file (baseline arch — the
+    racing surface is the thread protocol, not the SIMD kernels).
+    """
+    flag = {"asan": "-fsanitize=address,undefined",
+            "tsan": "-fsanitize=thread"}[kind]
+    if not _sanitizer_supported(cxx, tmp, flag):
+        print(f"SKIP: {kind}: toolchain cannot link {flag}")
+        return True
+    exe = os.path.join(tmp, f"smoke_{kind}")
+    flags = ["-O1", "-g", flag, "-fno-sanitize-recover=all",
+             "-std=c++17", "-pthread"]
+    if kind == "asan":
+        probe = run([cxx, "-march=native", "-E", "-x", "c", "/dev/null"])
+        if probe.returncode == 0:
+            flags.append("-march=native")
+    p = run([cxx, *flags, "-o", exe,
+             os.path.join(NATIVE, "csvparse.cpp"),
+             os.path.join(NATIVE, "smoke_test.cpp")])
+    if p.returncode != 0:
+        print(f"FAIL: {kind} build:\n{p.stderr[-4000:]}")
+        return False
+    csv = _sanitizer_csv(tmp, 60_000 if kind == "asan" else 120_000)
+    argv = [exe, csv] + (["grid"] if kind == "tsan" else [])
+    p = run(argv)
+    if p.returncode != 0:
+        print(f"FAIL: {kind} run:\n{p.stdout[-2000:]}{p.stderr[-4000:]}")
+        return False
+    print(f"{kind} OK: {p.stdout.splitlines()[-1]}")
+    return True
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--keep", action="store_true",
                     help="keep the temp build directory")
+    ap.add_argument("--no-sanitize", action="store_true",
+                    help="skip the ASan/UBSan and TSan arms")
     args = ap.parse_args(argv)
 
     cxx = find_cxx()
@@ -180,7 +252,13 @@ def main(argv=None) -> int:
             return 1
         if not check_dispatch(so, tmp):
             return 1
-        print("PASS: native rebuild + smoke + runtime dispatch")
+        if not args.no_sanitize:
+            if not sanitizer_arm(cxx, tmp, "asan"):
+                return 1
+            if not sanitizer_arm(cxx, tmp, "tsan"):
+                return 1
+        print("PASS: native rebuild + smoke + runtime dispatch"
+              + ("" if args.no_sanitize else " + sanitizer arms"))
         return 0
     finally:
         if args.keep:

@@ -2,21 +2,13 @@
 """Lint: ``ops/segments.py`` must stay numpy-free outside its marked
 host-fallback region.
 
-Why: the module's whole point is that grouped execution never leaves the
-device between frame input and the single group-count sync. A stray
-``np.asarray`` in the compute path silently reintroduces the host
-round-trip this engine was built to remove — and nothing else would
-catch it, because results stay correct. This check keeps the device path
-honest as it grows (the grouped analogue of ``check_logger_ns.py``).
-
-Rules, AST-based (comments/docstrings can't false-positive):
-
-* any ``np.<attr>`` / ``numpy.<attr>`` attribute access, and any
-  ``import numpy`` statement, is only allowed on lines between the
-  literal markers ``# --- BEGIN HOST FALLBACK`` and
-  ``# --- END HOST FALLBACK`` (the object-array gather helpers);
-* ``from numpy import x`` is flagged outright everywhere — a bare-name
-  alias would hide later uses from this check.
+Since ISSUE 8 this is a thin CLI over the dqlint framework's
+``numpy-free`` rule (``sparkdq4ml_tpu/analysis/rules/numpy_free.py``) —
+one rule implementation, two entry points (this legacy script and the
+unified ``scripts/check_static.py`` gate). Semantics are unchanged: any
+``np.<attr>`` / ``numpy.<attr>`` access or ``import numpy`` outside the
+``# --- BEGIN HOST FALLBACK`` / ``# --- END HOST FALLBACK`` markers is
+flagged, and ``from numpy import x`` is flagged outright.
 
 Exit status 0 when clean; 1 with one ``path:line`` diagnostic per
 offender — invoked by the tier-1 suite (tests/test_grouped_exec.py).
@@ -24,67 +16,21 @@ offender — invoked by the tier-1 suite (tests/test_grouped_exec.py).
 
 from __future__ import annotations
 
-import ast
 import os
 import sys
 
-BEGIN = "# --- BEGIN HOST FALLBACK"
-END = "# --- END HOST FALLBACK"
-_NP_NAMES = ("np", "numpy")
-
-
-def _fallback_lines(text: str) -> set[int]:
-    allowed: set[int] = set()
-    inside = False
-    for i, line in enumerate(text.splitlines(), start=1):
-        if line.strip().startswith(BEGIN):
-            inside = True
-        if inside:
-            allowed.add(i)
-        if line.strip().startswith(END):
-            inside = False
-    return allowed
-
-
-def check_file(path: str) -> list[str]:
-    with open(path, encoding="utf-8") as f:
-        text = f.read()
-    try:
-        tree = ast.parse(text, filename=path)
-    except SyntaxError as e:
-        return [f"{path}:{e.lineno or 0}: unparseable ({e.msg})"]
-    allowed = _fallback_lines(text)
-    problems = []
-    for node in ast.walk(tree):
-        if isinstance(node, ast.ImportFrom) and node.module in _NP_NAMES:
-            problems.append(
-                f"{path}:{node.lineno}: 'from numpy import ...' hides"
-                " uses from this lint; use 'import numpy as np' inside"
-                " the host-fallback region")
-        elif isinstance(node, ast.Import) and any(
-                a.name in _NP_NAMES for a in node.names):
-            if node.lineno not in allowed:
-                problems.append(
-                    f"{path}:{node.lineno}: numpy imported outside the"
-                    " host-fallback region")
-        elif isinstance(node, ast.Attribute) \
-                and isinstance(node.value, ast.Name) \
-                and node.value.id in _NP_NAMES:
-            if node.lineno not in allowed:
-                problems.append(
-                    f"{path}:{node.lineno}: np.{node.attr} outside the"
-                    " host-fallback region (device path must stay"
-                    " device-resident; move host work between the"
-                    f" '{BEGIN}' / '{END}' markers)")
-    return sorted(problems)
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 def main(root: str) -> int:
-    target = os.path.join(root, "sparkdq4ml_tpu", "ops", "segments.py")
-    problems = check_file(target)
-    for p in problems:
-        print(p)
-    return 1 if problems else 0
+    sys.path.insert(0, REPO)
+    from sparkdq4ml_tpu.analysis import get_rules, run_rules
+
+    findings, _ = run_rules(os.path.abspath(root), get_rules(["numpy-free"]))
+    for f in findings:
+        print(f"{os.path.join(os.path.abspath(root), f.path)}:{f.line}:"
+              f" {f.message}")
+    return 1 if findings else 0
 
 
 if __name__ == "__main__":

@@ -1,0 +1,102 @@
+#!/usr/bin/env python
+"""dqlint gate: run the full static invariant-analyzer suite over the
+tree — the single tier-1 entry point for every rule in
+``sparkdq4ml_tpu/analysis`` (host-sync, collective-guard, conf-key,
+noop, lock-order, plus the framework ports of the legacy logger-ns and
+numpy-free lints, whose standalone scripts now delegate here too).
+
+Exit status 0 when every rule is clean (baselined findings don't fail
+the gate but are listed); 1 with one ``path:line: [rule] message``
+diagnostic per live finding. Stale baseline entries (matching nothing
+anymore) are reported so the baseline file can only shrink.
+
+Usage::
+
+    python scripts/check_static.py [root] [--rules host-sync,noop]
+                                   [--json] [--baseline PATH]
+                                   [--update-baseline] [--list-rules]
+
+The import path is bootstrapped from the target root, so the script
+also runs against synthetic trees in tests.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("root", nargs="?", default=REPO,
+                    help="tree root containing sparkdq4ml_tpu/ (default:"
+                         " this repo)")
+    ap.add_argument("--rules", default=None,
+                    help="comma-separated rule subset (default: all)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable findings")
+    ap.add_argument("--baseline", default=None,
+                    help="baseline JSON (default: <root>/dqlint_baseline"
+                         ".json when present)")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="write the current live findings to the baseline"
+                         " and exit 0")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule catalog and exit")
+    args = ap.parse_args(argv)
+
+    # The framework always comes from THIS repo (the target root may be a
+    # synthetic offender tree with no analysis package of its own).
+    sys.path.insert(0, REPO)
+    from sparkdq4ml_tpu.analysis import ALL_RULES, Baseline, get_rules, \
+        run_rules
+
+    if args.list_rules:
+        for cls in ALL_RULES:
+            print(f"{cls.name:18s} {cls.description}")
+        return 0
+
+    names = [r.strip() for r in args.rules.split(",")] if args.rules \
+        else None
+    try:
+        rules = get_rules(names)
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+
+    root = os.path.abspath(args.root)
+    baseline_path = args.baseline or os.path.join(root,
+                                                  "dqlint_baseline.json")
+    baseline = Baseline(baseline_path)
+    findings, stale = run_rules(root, rules, baseline)
+
+    if args.update_baseline:
+        baseline.write(findings)
+        print(f"baseline updated: {len(findings)} entr"
+              f"{'y' if len(findings) == 1 else 'ies'} -> {baseline_path}")
+        return 0
+
+    live = [f for f in findings if not f.baselined]
+    if args.as_json:
+        print(json.dumps({
+            "findings": [f.as_dict() for f in findings],
+            "stale_baseline": [list(s) for s in stale],
+        }, indent=1))
+    else:
+        for f in findings:
+            tag = " (baselined)" if f.baselined else ""
+            print(f.render() + tag)
+        for rule, path, fp in stale:
+            print(f"stale baseline entry: [{rule}] {path}: {fp!r}"
+                  " matches nothing — delete it")
+        if not findings and not stale:
+            print(f"dqlint clean: {len(rules)} rule(s), 0 findings")
+    return 1 if live else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,33 @@
+"""dqlint — static invariant analyzers for the engine's standing contracts.
+
+Every PR since the seed has re-enforced the same invariants by hand:
+counted host syncs, ``collective_guard`` on every mesh-bearing jit
+factory, session-scoped ``spark.*`` conf save/restore, the disabled-mode
+observability no-op contract, and consistent lock orderings across the
+threaded layers. This package promotes them from reviewer memory to
+tier-1 tooling ("Memory Safe Computations with XLA", arxiv 2206.14148:
+engine invariants belong in statically checked, first-class constraints).
+
+Architecture (``core.py``):
+
+* one AST parse per file (``SourceFile``), shared by every rule;
+* a rule registry (``rules/``) — each rule is a class with a ``visit``
+  (per-file) and optional ``finalize`` (whole-tree) pass;
+* ``# dqlint: ok(<rule>): reason`` line pragmas and
+  ``# dqlint: ok-file(<rule>): reason`` module pragmas for reasoned
+  exemptions;
+* a JSON baseline for grandfathered findings (fingerprint = stripped
+  source line, so unrelated line drift never invalidates it);
+* structured findings (rule, path, line, message) with text and JSON
+  renderings.
+
+Entry points: ``scripts/check_static.py`` (the tier-1 gate, all rules),
+plus the legacy ``scripts/check_logger_ns.py`` / ``check_segments_np.py``
+CLIs which now delegate to the framework's ports of those lints.
+"""
+
+from .core import (Baseline, Finding, SourceFile, load_tree, run_rules)
+from .rules import ALL_RULES, get_rules
+
+__all__ = ["Baseline", "Finding", "SourceFile", "load_tree", "run_rules",
+           "ALL_RULES", "get_rules"]

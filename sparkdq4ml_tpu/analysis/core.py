@@ -1,0 +1,294 @@
+"""dqlint framework core: shared parse, pragmas, baseline, rule driver.
+
+Design constraints (the reasons this is not five ad-hoc scripts):
+
+* **Single parse per file.** Five AST rules over ~30k lines must not
+  cost five parses; :class:`SourceFile` parses once and every rule walks
+  the same tree.
+* **Reasoned suppression, never silent.** A finding is silenced either
+  by an in-source pragma (visible at the site, carries its reason) or by
+  a baseline entry (grandfathered debt, tracked in one reviewable file).
+  Baseline entries that no longer match anything are reported as stale
+  so the file can only shrink.
+* **Line-drift-proof baseline.** Entries fingerprint the *stripped
+  source line text*, not the line number — reformatting an unrelated
+  region never resurrects grandfathered findings.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import os
+import re
+from typing import Iterable, Optional
+
+#: Line pragma: ``# dqlint: ok(rule)`` or ``# dqlint: ok(rule): reason``
+#: (several rules comma-separate: ``# dqlint: ok(host-sync, noop): ...``).
+_PRAGMA_RE = re.compile(r"#\s*dqlint:\s*ok\(([^)]*)\)")
+#: Module pragma — same syntax with ``ok-file``; applies to every line.
+_FILE_PRAGMA_RE = re.compile(r"#\s*dqlint:\s*ok-file\(([^)]*)\)")
+
+#: Package-root-relative directories every rule skips: the analyzers
+#: must not lint their own rule sources (they embed offender-shaped
+#: strings as documentation and detection patterns). Matched at the top
+#: level only — a future engine subpackage that happens to be named
+#: ``analysis`` deeper in the tree is still linted.
+_SKIP_DIRS = ("analysis",)
+
+
+@dataclasses.dataclass
+class Finding:
+    """One diagnostic: where, which invariant, what to do about it."""
+
+    rule: str
+    path: str          # repo-relative, forward slashes
+    line: int
+    message: str
+    fingerprint: str = ""   # stripped source line (baseline identity)
+    baselined: bool = False
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+    def as_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+class SourceFile:
+    """One parsed module: text, lines, AST, and its pragma index.
+
+    Parsed exactly once; rules receive the same instance. A syntax error
+    does not raise — it becomes a finding from every rule's driver pass
+    (``parse_error``), because an unparseable engine file is itself a
+    tree-health failure.
+    """
+
+    def __init__(self, path: str, rel: str, text: Optional[str] = None):
+        self.path = path
+        self.rel = rel.replace(os.sep, "/")
+        if text is None:
+            with open(path, encoding="utf-8") as f:
+                text = f.read()
+        self.text = text
+        self.lines = text.splitlines()
+        self.tree: Optional[ast.AST] = None
+        self.parse_error: Optional[str] = None
+        try:
+            self.tree = ast.parse(text, filename=path)
+        except SyntaxError as e:   # pragma: no cover - engine files parse
+            self.parse_error = f"unparseable ({e.msg})"
+        self.line_pragmas: dict[int, set[str]] = {}
+        self.file_pragmas: set[str] = set()
+        comment_pragmas: list[tuple[int, set[str]]] = []
+        for i, line in enumerate(self.lines, start=1):
+            m = _PRAGMA_RE.search(line)
+            if m:
+                names = {p.strip() for p in m.group(1).split(",") if p.strip()}
+                self.line_pragmas.setdefault(i, set()).update(names)
+                if line.strip().startswith("#"):
+                    comment_pragmas.append((i, names))
+            m = _FILE_PRAGMA_RE.search(line)
+            if m:
+                self.file_pragmas.update(
+                    p.strip() for p in m.group(1).split(",") if p.strip())
+        # A pragma on a comment-only line covers the whole statement it
+        # precedes or sits inside (a same-line pragma covers only its own
+        # line): collect statement spans once, then widen.
+        if comment_pragmas and self.tree is not None:
+            spans = [(n.lineno, n.end_lineno or n.lineno)
+                     for n in ast.walk(self.tree)
+                     if isinstance(n, ast.stmt)]
+            for p, names in comment_pragmas:
+                nxt = p + 1
+                while nxt <= len(self.lines) and (
+                        not self.lines[nxt - 1].strip()
+                        or self.lines[nxt - 1].strip().startswith("#")):
+                    nxt += 1
+                covered: list[tuple[int, int]] = [
+                    (a, b) for a, b in spans
+                    if (a <= p <= b) or a == nxt]
+                if covered:
+                    # the smallest enclosing/following statement wins (a
+                    # pragma inside a function must not blanket the whole
+                    # function body)
+                    a, b = min(covered, key=lambda s: s[1] - s[0])
+                    for i in range(a, b + 1):
+                        self.line_pragmas.setdefault(i, set()).update(names)
+
+    # -- suppression --------------------------------------------------------
+    def pragma_covers(self, rule: str, node: ast.AST) -> bool:
+        """True when a ``dqlint: ok`` pragma for ``rule`` (or ``*``) sits on
+        any line the node spans, or a file pragma covers the module."""
+        if rule in self.file_pragmas or "*" in self.file_pragmas:
+            return True
+        start = getattr(node, "lineno", 0) or 0
+        end = getattr(node, "end_lineno", start) or start
+        for i in range(start, min(end, len(self.lines)) + 1):
+            names = self.line_pragmas.get(i)
+            if names and (rule in names or "*" in names):
+                return True
+        return False
+
+    def finding(self, rule: str, node: ast.AST, message: str
+                ) -> Optional[Finding]:
+        """Build a finding at ``node`` unless a pragma suppresses it."""
+        if self.pragma_covers(rule, node):
+            return None
+        line = getattr(node, "lineno", 0) or 0
+        fp = self.lines[line - 1].strip() if 0 < line <= len(self.lines) \
+            else ""
+        return Finding(rule=rule, path=self.rel, line=line, message=message,
+                       fingerprint=fp)
+
+
+class Rule:
+    """Base analyzer. ``visit`` runs once per file; ``finalize`` once per
+    tree with every file already seen (for cross-file invariants like the
+    conf-key registry and the lock graph)."""
+
+    name = "rule"
+    description = ""
+
+    def visit(self, src: SourceFile) -> Iterable[Finding]:
+        return ()
+
+    def finalize(self, files: list[SourceFile]) -> Iterable[Finding]:
+        return ()
+
+
+class Baseline:
+    """Grandfathered findings, keyed by (rule, path, stripped line text).
+
+    JSON shape::
+
+        {"entries": [{"rule": ..., "path": ..., "fingerprint": ...}, ...]}
+    """
+
+    def __init__(self, path: Optional[str] = None):
+        self.path = path
+        self.entries: set[tuple[str, str, str]] = set()
+        if path and os.path.exists(path):
+            with open(path, encoding="utf-8") as f:
+                doc = json.load(f)
+            for e in doc.get("entries", []):
+                self.entries.add((e["rule"], e["path"], e["fingerprint"]))
+
+    def key(self, f: Finding) -> tuple[str, str, str]:
+        return (f.rule, f.path, f.fingerprint)
+
+    def apply(self, findings: list[Finding]) -> list[tuple[str, str, str]]:
+        """Mark baselined findings; return entries that matched nothing
+        (stale — candidates for deletion)."""
+        used = set()
+        for f in findings:
+            k = self.key(f)
+            if k in self.entries:
+                f.baselined = True
+                used.add(k)
+        return sorted(self.entries - used)
+
+    def write(self, findings: list[Finding]) -> None:
+        doc = {"entries": [
+            {"rule": f.rule, "path": f.path, "fingerprint": f.fingerprint}
+            for f in sorted(findings, key=lambda f: (f.rule, f.path, f.line))
+        ]}
+        with open(self.path, "w", encoding="utf-8") as f:
+            json.dump(doc, f, indent=1, sort_keys=True)
+            f.write("\n")
+
+
+def load_tree(root: str, package: str = "sparkdq4ml_tpu"
+              ) -> list[SourceFile]:
+    """Parse every ``*.py`` under ``root/package`` once (skipping the
+    analyzer's own sources), sorted for deterministic output."""
+    pkg = os.path.join(root, package)
+    out: list[SourceFile] = []
+    for dirpath, dirs, files in os.walk(pkg):
+        dirs[:] = sorted(d for d in dirs
+                         if d != "__pycache__"
+                         and not (dirpath == pkg and d in _SKIP_DIRS))
+        for name in sorted(files):
+            if not name.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, name)
+            out.append(SourceFile(path, os.path.relpath(path, root)))
+    return out
+
+
+def run_rules(root: str, rules: Iterable[Rule],
+              baseline: Optional[Baseline] = None
+              ) -> tuple[list[Finding], list[tuple[str, str, str]]]:
+    """Drive ``rules`` over the tree at ``root``.
+
+    Returns ``(findings, stale_baseline_entries)``; findings carry a
+    ``baselined`` flag rather than being dropped, so callers can render
+    the full picture and gate only on live ones.
+    """
+    files = load_tree(root)
+    findings: list[Finding] = []
+    rules = list(rules)
+    for src in files:
+        if src.parse_error:
+            findings.append(Finding(rule="parse", path=src.rel, line=0,
+                                    message=src.parse_error))
+            continue
+        for rule in rules:
+            findings.extend(f for f in rule.visit(src) if f is not None)
+    for rule in rules:
+        findings.extend(f for f in rule.finalize(files) if f is not None)
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    stale = baseline.apply(findings) if baseline else []
+    return findings, stale
+
+
+# ---------------------------------------------------------------------------
+# Shared AST helpers (used by several rules)
+# ---------------------------------------------------------------------------
+
+def attr_chain(node: ast.AST) -> Optional[str]:
+    """Dotted name of an attribute/name chain (``a.b.c``) or None."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def call_name(node: ast.Call) -> str:
+    """Rightmost name of the called object (``x.y.z(...)`` → ``z``)."""
+    f = node.func
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    if isinstance(f, ast.Name):
+        return f.id
+    return ""
+
+
+def walk_functions(tree: ast.AST):
+    """Yield every (outermost_function, all_nodes_in_it) pair plus the
+    module-level remainder as ``(None, nodes)``. Nested defs/lambdas are
+    folded into their outermost function — the attribution scope for
+    "does this factory guard its dispatch" style questions."""
+    outer: list[ast.AST] = []
+    module_nodes: list[ast.AST] = []
+
+    def top(node, in_func):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and not in_func:
+                outer.append(child)
+                top(child, True)
+            else:
+                if not in_func:
+                    module_nodes.append(child)
+                top(child, in_func)
+
+    top(tree, False)
+    for fn in outer:
+        yield fn, list(ast.walk(fn))
+    yield None, module_nodes

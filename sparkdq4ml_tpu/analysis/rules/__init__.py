@@ -1,0 +1,41 @@
+"""dqlint rule registry — one module per invariant.
+
+Adding a rule: subclass :class:`..core.Rule`, implement ``visit`` (and
+``finalize`` for cross-file state), list it here. ``scripts/
+check_static.py --list-rules`` renders this catalog.
+"""
+
+from __future__ import annotations
+
+from .collective import CollectiveGuardRule
+from .conf_keys import ConfKeyRule
+from .host_sync import HostSyncRule
+from .locks import LockOrderRule
+from .logger_ns import LoggerNamespaceRule
+from .noop import NoopContractRule
+from .numpy_free import NumpyFreeRule
+
+#: Instantiation order = report order; every rule runs in the tier-1 gate.
+ALL_RULES = (
+    HostSyncRule,
+    CollectiveGuardRule,
+    ConfKeyRule,
+    NoopContractRule,
+    LockOrderRule,
+    LoggerNamespaceRule,
+    NumpyFreeRule,
+)
+
+
+def get_rules(names=None):
+    """Instantiate the requested rules (all by default)."""
+    classes = ALL_RULES
+    if names:
+        wanted = set(names)
+        classes = [c for c in ALL_RULES if c.name in wanted]
+        unknown = wanted - {c.name for c in classes}
+        if unknown:
+            known = ", ".join(c.name for c in ALL_RULES)
+            raise ValueError(
+                f"unknown rule(s) {sorted(unknown)}; known: {known}")
+    return [c() for c in classes]

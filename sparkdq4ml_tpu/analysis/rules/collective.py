@@ -1,0 +1,90 @@
+"""Rule ``collective-guard`` — every mesh-bearing jit factory routes its
+dispatch through the process-wide collective guard.
+
+The invariant this closes statically: XLA:CPU's intra-process
+collectives rendezvous participant threads per (device set, op); two
+overlapping executions of psum-bearing programs interleave their
+participants and BOTH hang forever (the PR-6 serving deadlock, fixed
+then by enumerating every factory by hand). Any function that builds a
+sharded program (``parallel.mesh.shard_map``) or emits a collective
+(``lax.psum`` / ``psum_scatter`` / ``all_gather``) must also route its
+dispatch through ``serialize_collectives`` or ``collective_guard`` —
+otherwise a future concurrent caller re-creates the deadlock class.
+
+Attribution scope is the **outermost enclosing function**: factories
+routinely build the sharded body in a nested helper and wrap the jitted
+program at their tail, which is exactly the sanctioned pattern. A
+factory that intentionally returns an unwrapped program for its caller
+to guard documents that with ``# dqlint: ok(collective-guard): reason``.
+``parallel/mesh.py`` itself (which defines the guard machinery) is
+exempt.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import Finding, Rule, SourceFile, call_name, walk_functions
+
+#: Collective-emitting call names (rightmost attr): building one of these
+#: into a program makes the program mesh-bearing.
+_COLLECTIVE_CALLS = frozenset(
+    {"psum", "psum_scatter", "all_gather", "all_to_all", "pmean", "ppermute"})
+#: Sanctioning call names: routing dispatch through either satisfies the
+#: invariant (``serialize_collectives`` wraps jitted callables; a
+#: ``collective_guard`` context manages the dispatch inline).
+_GUARDS = frozenset({"serialize_collectives", "collective_guard"})
+
+_EXEMPT = ("sparkdq4ml_tpu/parallel/mesh.py",)
+
+
+class CollectiveGuardRule(Rule):
+    name = "collective-guard"
+    description = ("functions that build shard_map/psum programs must "
+                   "route dispatch through serialize_collectives / "
+                   "collective_guard (XLA:CPU overlapping-collective "
+                   "deadlock class)")
+
+    def visit(self, src: SourceFile):
+        if src.rel in _EXEMPT:
+            return ()
+        out: list[Finding] = []
+        for fn, nodes in walk_functions(src.tree):
+            collectives: list[tuple[ast.AST, str]] = []
+            builds_program = False
+            jits = False
+            guarded = False
+            for node in nodes:
+                if isinstance(node, ast.Call):
+                    nm = call_name(node)
+                    if nm in ("shard_map", "pmap"):
+                        builds_program = True
+                        collectives.append((node, nm))
+                    elif nm == "jit":
+                        jits = True
+                    elif nm in _COLLECTIVE_CALLS:
+                        collectives.append((node, nm))
+                    elif nm in _GUARDS:
+                        guarded = True
+                # `with collective_guard(...)` shows up as a Call inside
+                # the withitem, already covered above.
+            # A helper that merely EMITS a collective into a function it
+            # returns (the `_core` local-objective pattern) is not a
+            # dispatch site; the factory that shard_maps / jits it is.
+            triggers = collectives if (builds_program or jits) else []
+            if triggers and not guarded:
+                where = (f"function {fn.name!r}" if fn is not None
+                         else "module level")
+                for node, nm in triggers:
+                    f = src.finding(
+                        self.name, node,
+                        f"{nm}(...) in {where} builds a mesh-bearing "
+                        "program but the function never routes dispatch "
+                        "through parallel.mesh.serialize_collectives / "
+                        "collective_guard — overlapping executions of "
+                        "collective programs deadlock XLA:CPU; wrap the "
+                        "jitted program (or guard the dispatch) before "
+                        "returning it")
+                    if f:
+                        out.append(f)
+        return out

@@ -1,0 +1,195 @@
+"""Rule ``conf-key`` — every ``spark.*`` conf key is declared, scoped,
+and parsed with the shared truthiness vocabulary.
+
+Three past review rounds fixed leaks of exactly this shape: a key read
+somewhere deep in the engine that ``config.py`` never declared, that
+``session._init_pipeline`` never save/restored (so one session's setting
+leaked process-wide), or that grew its own ad-hoc ``("true", "1")``
+spelling which silently diverged from ``config.CONF_TRUE``/``CONF_FALSE``.
+
+Checks (cross-file, so they run in ``finalize``):
+
+1. **Declared**: every ``"spark.*"`` string literal in the package must
+   resolve against the ``config.CONF_KEYS`` registry — an exact key, a
+   declared dynamic prefix (``CONF_KEY_PREFIXES``), or a namespace probe
+   (a literal like ``"spark.pipeline."`` that prefixes declared keys).
+   f-strings resolve by their literal head (``f"spark.serve.{k}"``).
+2. **Session-scoped**: keys the registry tags ``"session"`` must appear
+   inside ``session.py::_init_pipeline`` — the single save/restore point
+   that keeps conf session-scoped instead of a process-wide leak.
+3. **Shared vocabulary**: inside any function that reads conf or
+   environment values, an inline membership test against a literal tuple
+   drawn from the truthiness vocabulary (``("true", "1")``-style) is
+   flagged — spellings must come from ``config.CONF_TRUE`` /
+   ``CONF_FALSE`` so a new spelling cannot diverge between parsers.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from ..core import Finding, Rule, SourceFile, attr_chain
+
+_CONFIG_REL = "sparkdq4ml_tpu/config.py"
+_SESSION_REL = "sparkdq4ml_tpu/session.py"
+
+
+def _literal_head(node: ast.JoinedStr) -> Optional[str]:
+    """Leading literal text of an f-string (before the first hole)."""
+    if node.values and isinstance(node.values[0], ast.Constant) \
+            and isinstance(node.values[0].value, str):
+        return node.values[0].value
+    return None
+
+
+class ConfKeyRule(Rule):
+    name = "conf-key"
+    description = ("spark.* conf keys must be declared in config.CONF_KEYS"
+                   " (session-scoped ones handled by _init_pipeline) and"
+                   " truthiness parsed via config.CONF_TRUE/CONF_FALSE")
+
+    def __init__(self):
+        # (src, node, literal) usages of spark.* string constants
+        self._usages: list[tuple[SourceFile, ast.AST, str]] = []
+        # inline truthiness tuples in conf-reading functions
+        self._vocab_sites: list[tuple[SourceFile, ast.AST, tuple]] = []
+        # spark.* literals that appear inside session._init_pipeline
+        self._init_pipeline_keys: set[str] = set()
+        self._config_src: Optional[SourceFile] = None
+
+    # -- per-file collection ------------------------------------------------
+    def visit(self, src: SourceFile):
+        if src.rel == _CONFIG_REL:
+            self._config_src = src
+            return ()   # declarations, not usages
+        in_init_pipeline = False
+
+        def collect(tree, in_init):
+            for node in ast.iter_child_nodes(tree):
+                is_init = (isinstance(node, ast.FunctionDef)
+                           and node.name == "_init_pipeline"
+                           and src.rel == _SESSION_REL)
+                collect(node, in_init or is_init)
+                if isinstance(node, ast.Constant) \
+                        and isinstance(node.value, str) \
+                        and node.value.startswith("spark."):
+                    self._usages.append((src, node, node.value))
+                    if in_init or is_init:
+                        self._init_pipeline_keys.add(node.value)
+                elif isinstance(node, ast.JoinedStr):
+                    head = _literal_head(node)
+                    if head and head.startswith("spark."):
+                        self._usages.append((src, node, head))
+
+        collect(src.tree, False)
+        del in_init_pipeline
+
+        # vocabulary sites: functions touching conf/environ
+        for fn in ast.walk(src.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            reads_conf = any(
+                (isinstance(n, ast.Attribute) and n.attr in ("conf",
+                                                             "environ"))
+                or (isinstance(n, ast.Name) and n.id in ("conf", "environ"))
+                for n in ast.walk(fn))
+            if not reads_conf:
+                continue
+            for cmp_ in ast.walk(fn):
+                if not isinstance(cmp_, ast.Compare):
+                    continue
+                if not any(isinstance(op, (ast.In, ast.NotIn))
+                           for op in cmp_.ops):
+                    continue
+                for comparator in cmp_.comparators:
+                    if isinstance(comparator, ast.Tuple) \
+                            and len(comparator.elts) >= 2 \
+                            and all(isinstance(e, ast.Constant)
+                                    and isinstance(e.value, str)
+                                    for e in comparator.elts):
+                        vals = tuple(e.value for e in comparator.elts)
+                        self._vocab_sites.append((src, cmp_, vals))
+        return ()
+
+    # -- registry parse -----------------------------------------------------
+    @staticmethod
+    def _parse_registry(src: SourceFile):
+        keys: dict[str, str] = {}
+        prefixes: tuple = ()
+        true_vals: tuple = ()
+        false_vals: tuple = ()
+        for node in src.tree.body:
+            if not isinstance(node, ast.Assign) or len(node.targets) != 1 \
+                    or not isinstance(node.targets[0], ast.Name):
+                continue
+            name = node.targets[0].id
+            try:
+                value = ast.literal_eval(node.value)
+            except (ValueError, SyntaxError):
+                continue
+            if name == "CONF_KEYS" and isinstance(value, dict):
+                keys = value
+            elif name == "CONF_KEY_PREFIXES" and isinstance(value,
+                                                            (tuple, list)):
+                prefixes = tuple(value)
+            elif name == "CONF_TRUE":
+                true_vals = tuple(value)
+            elif name == "CONF_FALSE":
+                false_vals = tuple(value)
+        return keys, prefixes, true_vals, false_vals
+
+    # -- cross-file checks --------------------------------------------------
+    def finalize(self, files):
+        out: list[Finding] = []
+        if self._config_src is None:
+            return out   # nothing to check against (partial trees in tests)
+        keys, prefixes, true_vals, false_vals = self._parse_registry(
+            self._config_src)
+        if not keys:
+            out.append(Finding(
+                rule=self.name, path=self._config_src.rel, line=0,
+                message="config.py declares no CONF_KEYS registry — every"
+                        " spark.* key must be declared there"))
+            return out
+        vocab = set(true_vals) | set(false_vals)
+
+        for src, node, literal in self._usages:
+            # namespace probes must end with '.' — a bare prefix match
+            # would sanction truncated/typo'd keys (e.g. a dropped final
+            # character still prefixes the declared key)
+            is_probe = literal.endswith(".")
+            ok = (literal in keys
+                  or any(literal.startswith(p) for p in prefixes)
+                  or (is_probe and any(k.startswith(literal)
+                                       for k in keys))
+                  or (is_probe and any(p.startswith(literal)
+                                       for p in prefixes)))
+            if not ok:
+                f = src.finding(
+                    self.name, node,
+                    f"conf key {literal!r} is not declared in"
+                    " config.CONF_KEYS (nor covered by a declared"
+                    " CONF_KEY_PREFIXES family) — declare it with its"
+                    " scope tag so save/restore and docs can't drift")
+                if f:
+                    out.append(f)
+
+        for key, tag in keys.items():
+            if tag == "session" and key not in self._init_pipeline_keys:
+                out.append(Finding(
+                    rule=self.name, path=_SESSION_REL, line=0,
+                    message=f"conf key {key!r} is declared session-scoped"
+                            " but session._init_pipeline never handles it"
+                            " — its setting would leak process-wide"))
+
+        for src, node, vals in self._vocab_sites:
+            if vocab and len(vals) >= 2 and all(v in vocab for v in vals):
+                f = src.finding(
+                    self.name, node,
+                    f"inline truthiness tuple {vals!r} in a conf/env"
+                    " parser — use config.CONF_TRUE / config.CONF_FALSE"
+                    " so spellings cannot diverge between parsers")
+                if f:
+                    out.append(f)
+        return out

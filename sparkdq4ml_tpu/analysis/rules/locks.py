@@ -1,0 +1,293 @@
+"""Rule ``lock-order`` — a static lock-acquisition graph over the
+threaded layers, flagging inconsistent orderings and unguarded acquires.
+
+The threaded surface has grown every PR: the serve scheduler condition,
+the metrics series lock, the compiler flush/cache locks, the ingest
+buffer pool, the process-wide collective lock. Each pair of locks taken
+in both orders on different code paths is a latent deadlock that no unit
+test reliably reproduces — the classic "works until the serving load
+finds the interleave" bug. This rule builds the acquisition graph
+statically and fails on cycles while the orderings are still fresh.
+
+Model (intra-procedural with one level of same-module call propagation):
+
+* lock objects: module-level ``NAME = threading.Lock/RLock/Condition()``
+  and ``self.attr = threading.…`` instance locks, identified as
+  ``module::NAME`` / ``module::Class.attr``;
+* acquisition: ``with <lock>:`` items (including multi-item ``with``)
+  and bare ``<lock>.acquire()`` calls;
+* edge A→B: B acquired while A is held — directly nested ``with``, or a
+  call made under A to a same-module function/method that acquires B at
+  its top level;
+* findings: every edge pair {A→B, B→A} (an ordering inversion =
+  potential deadlock), plus bare ``.acquire()`` calls outside a
+  ``try/finally`` release discipline. Reentrant self-edges are ignored
+  (RLock is the documented pattern for them).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from ..core import Finding, Rule, SourceFile, attr_chain, call_name
+
+_LOCK_CTORS = frozenset({"Lock", "RLock", "Condition", "Semaphore",
+                         "BoundedSemaphore"})
+
+
+def _is_lock_ctor(node: ast.AST) -> bool:
+    return (isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in _LOCK_CTORS
+            and isinstance(node.func.value, ast.Name)
+            and node.func.value.id in ("threading", "_threading"))
+
+
+class _FileFacts:
+    def __init__(self, src: SourceFile):
+        self.src = src
+        self.module = src.rel.rsplit("/", 1)[-1][:-3]   # stem
+        self.module_locks: set[str] = set()             # bare names
+        self.class_locks: dict[str, set[str]] = {}      # Class -> attrs
+        # function qualname -> list[(lock_id, node)] acquired directly
+        self.fn_acquires: dict[str, list] = {}
+        # edges: (held_id, acquired_id, node)
+        self.edges: list[tuple[str, str, ast.AST]] = []
+        # calls made while holding a lock: (held_id, callee_name, node)
+        self.held_calls: list[tuple[str, str, ast.AST]] = []
+        # bare .acquire() sites outside try/finally: (node, lock_id)
+        self.bare_acquires: list[tuple[ast.AST, str]] = []
+
+
+class LockOrderRule(Rule):
+    name = "lock-order"
+    description = ("static lock-acquisition graph over the threaded "
+                   "layers; inconsistent lock orderings (A->B and B->A) "
+                   "and unguarded .acquire() calls are flagged")
+
+    def __init__(self):
+        self._facts: list[_FileFacts] = []
+
+    # -- collection ---------------------------------------------------------
+    def visit(self, src: SourceFile):
+        facts = _FileFacts(src)
+        tree = src.tree
+        # 1) lock definitions
+        for node in tree.body:
+            if isinstance(node, ast.Assign) and _is_lock_ctor(node.value):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        facts.module_locks.add(t.id)
+        for cls in ast.walk(tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            for node in ast.walk(cls):
+                if isinstance(node, ast.Assign) \
+                        and _is_lock_ctor(node.value):
+                    for t in node.targets:
+                        if isinstance(t, ast.Attribute) \
+                                and isinstance(t.value, ast.Name) \
+                                and t.value.id == "self":
+                            facts.class_locks.setdefault(
+                                cls.name, set()).add(t.attr)
+
+        # 2) per-function acquisition scan
+        def resolve(expr, cls_name: Optional[str]) -> Optional[str]:
+            """Lock identity of a with/acquire target, or None."""
+            if isinstance(expr, ast.Name) \
+                    and expr.id in facts.module_locks:
+                return f"{facts.module}::{expr.id}"
+            if isinstance(expr, ast.Attribute) \
+                    and isinstance(expr.value, ast.Name):
+                if expr.value.id == "self" and cls_name \
+                        and expr.attr in facts.class_locks.get(cls_name,
+                                                               ()):
+                    return f"{facts.module}::{cls_name}.{expr.attr}"
+                # mod._LOCK style cross-module reference: resolved in
+                # finalize (by module stem), record symbolically
+                chain = attr_chain(expr)
+                if chain and ("LOCK" in expr.attr.upper()
+                              or "COND" in expr.attr.upper()):
+                    return f"?{chain}"
+            return None
+
+        def scan_fn(fn, qualname: str, cls_name: Optional[str]):
+            acquires: list = []
+
+            def walk(stmts, held: tuple):
+                # explicit acquire()/release() within this suite extend /
+                # shrink the held set for the statements that follow, so
+                # ordering edges through acquire-style locking (the
+                # Condition idiom) are seen too
+                held_extra: list = []
+                for stmt in stmts:
+                    held_now = held + tuple(held_extra)
+                    if isinstance(stmt, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                        continue   # nested defs scanned separately
+                    if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                        here = list(held_now)
+                        for item in stmt.items:
+                            lid = resolve(item.context_expr, cls_name)
+                            if lid:
+                                for h in here:
+                                    if h != lid:
+                                        facts.edges.append((h, lid, stmt))
+                                here.append(lid)
+                                acquires.append((lid, stmt))
+                        walk(stmt.body, tuple(here))
+                        continue
+                    # record calls + bare acquires in this statement
+                    for n in ast.walk(stmt):
+                        if not isinstance(n, ast.Call):
+                            continue
+                        if call_name(n) in ("acquire", "release"):
+                            recv = n.func.value if isinstance(
+                                n.func, ast.Attribute) else None
+                            lid = resolve(recv, cls_name) if recv is not \
+                                None else None
+                            if lid is None:
+                                pass
+                            elif call_name(n) == "release":
+                                if lid in held_extra:
+                                    held_extra.remove(lid)
+                            else:
+                                acquires.append((lid, n))
+                                for h in held_now:
+                                    if h != lid:
+                                        facts.edges.append((h, lid, n))
+                                held_extra.append(lid)
+                                if not _in_try_with_release(stmt, stmts):
+                                    facts.bare_acquires.append((n, lid))
+                        elif held_now:
+                            # qualify the callee so dict.clear() on some
+                            # attribute can never alias a lock-taking
+                            # method of another class: propagate only
+                            # self.m() (same class) and bare f() (same
+                            # module) calls
+                            f = n.func
+                            callee = None
+                            if isinstance(f, ast.Name):
+                                callee = f.id
+                            elif isinstance(f, ast.Attribute) \
+                                    and isinstance(f.value, ast.Name) \
+                                    and f.value.id == "self" and cls_name:
+                                callee = f"{cls_name}.{f.attr}"
+                            if callee:
+                                for h in held_now:
+                                    facts.held_calls.append((h, callee, n))
+                    for blocks in _sub_blocks(stmt):
+                        walk(blocks, held_now)
+
+            walk(fn.body, ())
+            facts.fn_acquires.setdefault(qualname, []).extend(acquires)
+            if cls_name:
+                # self.m() resolves as Class.m even under nested prefixes
+                facts.fn_acquires.setdefault(f"{cls_name}.{fn.name}",
+                                             []).extend(acquires)
+
+        def _sub_blocks(stmt):
+            for attr in ("body", "orelse", "finalbody"):
+                b = getattr(stmt, attr, None)
+                if isinstance(b, list) and not isinstance(
+                        stmt, (ast.With, ast.AsyncWith)):
+                    yield b
+            for h in getattr(stmt, "handlers", []) or []:
+                yield h.body
+
+        def _in_try_with_release(stmt, stmts) -> bool:
+            """acquire() sanctioned when a try/finally in the same suite
+            releases, or the acquire is itself inside the try of one."""
+            for s in stmts:
+                if isinstance(s, ast.Try) and any(
+                        isinstance(n, ast.Call)
+                        and call_name(n) == "release"
+                        for fb in [s.finalbody] for st in fb
+                        for n in ast.walk(st)):
+                    return True
+            return any(isinstance(n, ast.Call)
+                       and call_name(n) == "release"
+                       for n in ast.walk(stmt))
+
+        def visit_scope(node, cls_name, prefix):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.ClassDef):
+                    visit_scope(child, child.name, f"{prefix}{child.name}.")
+                elif isinstance(child, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                    scan_fn(child, f"{prefix}{child.name}", cls_name)
+                    visit_scope(child, cls_name, f"{prefix}{child.name}.")
+
+        visit_scope(tree, None, "")
+        self._facts.append(facts)
+        return ()
+
+    # -- graph assembly -----------------------------------------------------
+    def finalize(self, files):
+        out: list[Finding] = []
+        by_rel = {f.src.rel: f for f in self._facts}
+        # resolve symbolic ?mod.NAME references against definitions
+        all_locks: dict[str, list[str]] = {}
+        for facts in self._facts:
+            for name in facts.module_locks:
+                all_locks.setdefault(name, []).append(
+                    f"{facts.module}::{name}")
+
+        def canon(lid: str) -> Optional[str]:
+            if not lid.startswith("?"):
+                return lid
+            chain = lid[1:]
+            base, _, name = chain.rpartition(".")
+            cands = all_locks.get(name, [])
+            if len(cands) == 1:
+                return cands[0]
+            stem = base.rsplit(".", 1)[-1].lstrip("_")
+            for c in cands:
+                if c.split("::")[0] == stem:
+                    return c
+            return None
+
+        edges: dict[tuple[str, str], tuple[str, int]] = {}
+        def add_edge(a, b, src, node):
+            a, b = canon(a), canon(b)
+            if a and b and a != b and (a, b) not in edges:
+                edges[(a, b)] = (src.rel, getattr(node, "lineno", 0))
+
+        for facts in self._facts:
+            for a, b, node in facts.edges:
+                add_edge(a, b, facts.src, node)
+            # one-level call propagation within the module
+            for held, callee, node in facts.held_calls:
+                for lid, _n in facts.fn_acquires.get(callee, []):
+                    add_edge(held, lid, facts.src, node)
+
+        # inversions: both orders present
+        seen = set()
+        for (a, b), (rel, line) in sorted(edges.items()):
+            if (b, a) in edges and frozenset((a, b)) not in seen:
+                seen.add(frozenset((a, b)))
+                rel2, line2 = edges[(b, a)]
+                out.append(Finding(
+                    rule=self.name, path=rel, line=line,
+                    message=f"lock-order inversion: {a} -> {b} here but"
+                            f" {b} -> {a} at {rel2}:{line2} — two threads"
+                            " taking these in opposite orders deadlock;"
+                            " pick one order (or collapse to one lock)"))
+
+        reported: set[tuple[str, int, str]] = set()
+        for facts in self._facts:
+            for node, lid in facts.bare_acquires:
+                key = (facts.src.rel, getattr(node, "lineno", 0), lid)
+                if key in reported:
+                    continue
+                reported.add(key)
+                f = facts.src.finding(
+                    self.name, node,
+                    f"bare {lid}.acquire() without a try/finally release"
+                    " — an exception between acquire and release wedges"
+                    " every future acquirer; use `with` or try/finally")
+                if f:
+                    out.append(f)
+        del by_rel
+        return out

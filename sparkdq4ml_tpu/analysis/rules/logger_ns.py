@@ -1,0 +1,81 @@
+"""Rule ``logger-ns`` — every ``logging.getLogger`` stays in the
+``sparkdq4ml_tpu.`` namespace (framework port of the PR-2
+``scripts/check_logger_ns.py`` lint; that script now delegates here).
+
+Why: ``utils.logging.configure_logging`` tiers log levels by namespace
+(framework at DEBUG, root at INFO, jax at WARNING) — a logger created
+outside ``sparkdq4ml_tpu.*`` silently escapes that tiering and the "one
+namespace to scrape" observability story breaks one module at a time.
+
+Allowed spellings: a string literal starting with ``sparkdq4ml_tpu``,
+``__name__``, or a call carrying the legacy ``# logger-ns: ok`` pragma
+(still honored) or a ``# dqlint: ok(logger-ns)`` pragma.
+``from logging import getLogger`` is flagged outright — a bare-name
+alias would hide later calls from the lint.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import Finding, Rule, SourceFile
+
+LEGACY_PRAGMA = "logger-ns: ok"
+
+
+def _is_getlogger_call(node: ast.Call) -> bool:
+    f = node.func
+    return (isinstance(f, ast.Attribute) and f.attr == "getLogger"
+            and isinstance(f.value, ast.Name) and f.value.id == "logging")
+
+
+def _arg_ok(node: ast.Call) -> tuple[bool, str]:
+    if not node.args:
+        return False, "<root>"
+    a = node.args[0]
+    if isinstance(a, ast.Name) and a.id == "__name__":
+        return True, "__name__"
+    if isinstance(a, ast.Constant) and isinstance(a.value, str):
+        ok = (a.value == "sparkdq4ml_tpu"
+              or a.value.startswith("sparkdq4ml_tpu."))
+        return ok, repr(a.value)
+    return False, ast.dump(a)
+
+
+class LoggerNamespaceRule(Rule):
+    name = "logger-ns"
+    description = ("logging.getLogger must stay in the sparkdq4ml_tpu "
+                   "namespace (or __name__); bare-name getLogger imports "
+                   "are flagged outright")
+
+    def _legacy_pragma(self, src: SourceFile, node: ast.AST) -> bool:
+        end = getattr(node, "end_lineno", node.lineno) or node.lineno
+        return any(LEGACY_PRAGMA in src.lines[i - 1]
+                   for i in range(node.lineno,
+                                  min(end, len(src.lines)) + 1))
+
+    def visit(self, src: SourceFile):
+        out: list[Finding] = []
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.ImportFrom) and node.module == "logging" \
+                    and any(a.name == "getLogger" for a in node.names):
+                f = src.finding(
+                    self.name, node,
+                    "'from logging import getLogger' hides calls from this"
+                    " lint; use 'import logging' + logging.getLogger(...)")
+                if f:
+                    out.append(f)
+            elif isinstance(node, ast.Call) and _is_getlogger_call(node):
+                if self._legacy_pragma(src, node):
+                    continue
+                ok, arg = _arg_ok(node)
+                if not ok:
+                    f = src.finding(
+                        self.name, node,
+                        f"logging.getLogger({arg}) is outside the"
+                        " sparkdq4ml_tpu namespace (use"
+                        " 'sparkdq4ml_tpu.<module>', __name__, or a"
+                        f" '# {LEGACY_PRAGMA}' pragma)")
+                    if f:
+                        out.append(f)
+        return out

@@ -1,0 +1,77 @@
+"""Rule ``numpy-free`` — ``ops/segments.py`` stays numpy-free outside its
+marked host-fallback region (framework port of the PR-4
+``scripts/check_segments_np.py`` lint; that script now delegates here).
+
+Why: the module's whole point is that grouped execution never leaves the
+device between frame input and the single group-count sync. A stray
+``np.asarray`` in the compute path silently reintroduces the host
+round-trip — and nothing else would catch it, because results stay
+correct.
+
+Rules: any ``np.<attr>`` / ``numpy.<attr>`` access and any ``import
+numpy`` is only allowed between the literal ``# --- BEGIN HOST
+FALLBACK`` / ``# --- END HOST FALLBACK`` markers; ``from numpy import
+x`` is flagged outright everywhere.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import Finding, Rule, SourceFile
+
+BEGIN = "# --- BEGIN HOST FALLBACK"
+END = "# --- END HOST FALLBACK"
+_NP_NAMES = ("np", "numpy")
+TARGET = "sparkdq4ml_tpu/ops/segments.py"
+
+
+def _fallback_lines(text: str) -> set[int]:
+    allowed: set[int] = set()
+    inside = False
+    for i, line in enumerate(text.splitlines(), start=1):
+        if line.strip().startswith(BEGIN):
+            inside = True
+        if inside:
+            allowed.add(i)
+        if line.strip().startswith(END):
+            inside = False
+    return allowed
+
+
+class NumpyFreeRule(Rule):
+    name = "numpy-free"
+    description = ("ops/segments.py must not touch numpy outside its "
+                   "marked host-fallback region (device path stays "
+                   "device-resident)")
+
+    def visit(self, src: SourceFile):
+        if src.rel != TARGET:
+            return ()
+        allowed = _fallback_lines(src.text)
+        out: list[Finding] = []
+
+        def emit(node, msg):
+            f = src.finding(self.name, node, msg)
+            if f:
+                out.append(f)
+
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.ImportFrom) and node.module in _NP_NAMES:
+                emit(node, "'from numpy import ...' hides uses from this"
+                     " lint; use 'import numpy as np' inside the"
+                     " host-fallback region")
+            elif isinstance(node, ast.Import) and any(
+                    a.name in _NP_NAMES for a in node.names):
+                if node.lineno not in allowed:
+                    emit(node, "numpy imported outside the host-fallback"
+                         " region")
+            elif isinstance(node, ast.Attribute) \
+                    and isinstance(node.value, ast.Name) \
+                    and node.value.id in _NP_NAMES:
+                if node.lineno not in allowed:
+                    emit(node, f"np.{node.attr} outside the host-fallback"
+                         " region (device path must stay device-resident;"
+                         " move host work between the"
+                         f" '{BEGIN}' / '{END}' markers)")
+        return out

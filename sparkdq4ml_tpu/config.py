@@ -18,6 +18,54 @@ import jax.numpy as jnp
 CONF_FALSE = ("false", "off", "0", "no")
 CONF_TRUE = ("true", "on", "1", "yes")
 
+#: THE ``spark.*`` conf-key registry — every key the engine reads must be
+#: declared here (enforced statically by dqlint's ``conf-key`` rule,
+#: ``sparkdq4ml_tpu/analysis/rules/conf_keys.py``). The tag records who
+#: owns the key's lifecycle:
+#:
+#: * ``"session"`` — applied by ``session._init_pipeline`` with
+#:   save/restore, so one session's setting never leaks process-wide
+#:   (the rule verifies the key literal actually appears there);
+#: * ``"init"`` — read once during session construction/infrastructure
+#:   bring-up (backend probe, compilation cache, observability install,
+#:   fault plan, multi-host bootstrap); restored by ``stop()`` where it
+#:   mutates process state.
+CONF_KEYS = {
+    "spark.pipeline.enabled": "session",
+    "spark.pipeline.minBucket": "session",
+    "spark.pipeline.cacheSize": "session",
+    "spark.groupedExec.enabled": "session",
+    "spark.explain.memory": "session",
+    "spark.explain.caches": "session",
+    "spark.serve.enabled": "session",
+    "spark.ingest.streaming": "session",
+    "spark.ingest.threads": "session",
+    "spark.ingest.chunkBytes": "session",
+    "spark.ingest.prefetch": "session",
+    "spark.ingest.simd": "session",
+    "spark.observability.enabled": "init",
+    "spark.observability.maxSpans": "init",
+    "spark.observability.logSpans": "init",
+    "spark.faults": "init",
+    "spark.faults.seed": "init",
+    "spark.recovery.validate": "init",
+    "spark.backend.probe": "init",
+    "spark.backend.probeTimeout": "init",
+    "spark.compilation.cache": "init",
+    "spark.compilation.cacheDir": "init",
+    "spark.distributed.coordinator": "init",
+    "spark.distributed.numProcesses": "init",
+    "spark.distributed.processId": "init",
+    "spark.serve.sharedPlanCache": "init",
+}
+
+#: Dynamic key families (formatted per site/tenant at runtime): any key
+#: starting with one of these prefixes is declared by the family.
+CONF_KEY_PREFIXES = (
+    "spark.recovery.",   # per-site retry policy (RetryPolicy.from_conf)
+    "spark.serve.",      # QueryServer.from_conf tuning family
+)
+
 
 @dataclasses.dataclass
 class _Config:

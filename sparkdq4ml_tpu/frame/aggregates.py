@@ -534,6 +534,12 @@ def global_agg(frame, aggs: list[AggExpr]):
                             jnp.asarray(jnp.nan, vf.dtype))
             out[agg.name] = (var if agg.fn == "variance" else jnp.sqrt(var))[None]
     if deferred:
+        # the ONE deferred device->host pull per agg call (all empty-input
+        # verdicts batch into a single stacked transfer) — counted, so the
+        # span layer and EXPLAIN ANALYZE see it (dqlint host-sync)
+        from ..utils.profiling import counters
+
+        counters.increment("frame.host_sync")
         counts = np.asarray(jnp.stack([c for _, c, _, _ in deferred]))
         for (name, _, val, nanv), c in zip(deferred, counts):
             out[name] = val if int(c) > 0 else nanv

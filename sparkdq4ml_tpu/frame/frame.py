@@ -1108,7 +1108,7 @@ class Frame:
                 raise ValueError(f"fraction must be >= 0, got {fraction}")
             rng = np.random.default_rng(seed)
             counts = rng.poisson(fraction, self.num_slots)
-            counts = np.where(np.asarray(self._mask), counts, 0)
+            counts = np.where(self._host_mask(), counts, 0)
             idx = np.repeat(np.arange(self.num_slots), counts)
             data = {}
             for name, arr in self._data.items():
@@ -1258,6 +1258,10 @@ class Frame:
     # -- actions -----------------------------------------------------------
     def count(self) -> int:
         """Number of valid (unmasked) rows."""
+        # dqlint: ok(host-sync): deliberately NOT a counted frame host
+        # boundary — the seed contract, pinned by test_explain
+        # TestDisabledModeNoOp (count() is the no-op-path probe there;
+        # counting it would make the probe self-invalidating)
         return int(jnp.sum(self._mask))
 
     def is_empty(self) -> bool:

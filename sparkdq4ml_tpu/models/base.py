@@ -23,6 +23,21 @@ import numpy as np
 _STAGE_REGISTRY: dict[str, type] = {}
 
 
+def host_fetch(x) -> np.ndarray:
+    """THE sanctioned device→host pull for model accessor APIs
+    (``predict(features)`` single points, ``compute_cost``, summary
+    statistics): one counted ``frame.host_sync`` per call, host numpy
+    out. Every such accessor is host-returning by contract, so the
+    transfer is inherent — what the standing ROADMAP constraint requires
+    is that it be *counted*, so EXPLAIN ANALYZE and the span layer's
+    per-op sync deltas see it (dqlint's ``host-sync`` rule pins the
+    discipline statically)."""
+    from ..utils.profiling import counters
+
+    counters.increment("frame.host_sync")
+    return np.asarray(x)
+
+
 def persistable(cls):
     """Class decorator: register for name-based load_stage resolution."""
     _STAGE_REGISTRY[cls.__name__] = cls
@@ -32,6 +47,8 @@ def persistable(cls):
 def _to_jsonable(v):
     if isinstance(v, np.ndarray):
         dt = "object" if v.dtype == object else str(v.dtype)
+        # dqlint: ok(host-sync): isinstance-narrowed to host numpy —
+        # persistence serializes the host copies stored on the stage
         return {"__ndarray__": v.tolist(), "dtype": dt}
     if isinstance(v, (np.integer,)):
         return int(v)

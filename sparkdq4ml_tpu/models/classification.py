@@ -37,7 +37,8 @@ from jax.sharding import Mesh, PartitionSpec as P
 from ..config import float_dtype
 from ..frame.frame import Frame
 from ..parallel.mesh import DATA_AXIS, serialize_collectives, shard_map
-from .base import Estimator, Model, persistable, read_json, write_json
+from .base import (Estimator, Model, host_fetch, persistable, read_json,
+                   write_json)
 from .regression import _extract_xy
 from .solvers import _soft
 
@@ -1146,6 +1147,8 @@ class LogisticRegressionModel(Model):
             "class": "LogisticRegressionModel",
             "multinomial": not self._binary,
             "intercept": (self._intercept if self._binary
+                          # dqlint: ok(host-sync): _intercepts is the host
+                          # numpy copy materialized at fit time
                           else self._intercepts.tolist()),
             "params": self._params,
         })
@@ -1770,7 +1773,7 @@ class NaiveBayesModel(Model):
     def predict(self, features) -> float:
         x = jnp.asarray(np.asarray(features,
                                    np.dtype(float_dtype())).reshape(1, -1))
-        return float(np.asarray(jnp.argmax(self._raw(x), axis=1))[0])
+        return float(host_fetch(jnp.argmax(self._raw(x), axis=1))[0])
 
 
 # ---------------------------------------------------------------------------

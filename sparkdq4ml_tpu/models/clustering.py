@@ -40,7 +40,7 @@ from ..config import float_dtype
 from ..frame import Frame
 from ..parallel.mesh import (DATA_AXIS, normalize_mesh,
                              serialize_collectives, shard_map)
-from .base import Estimator, Model, persistable
+from .base import Estimator, Model, host_fetch, persistable
 
 
 def _pad_and_shard(X, w, mesh, dt):
@@ -288,7 +288,7 @@ class KMeansModel(Model):
 
     def predict(self, features) -> int:
         x = np.asarray(features, np.dtype(float_dtype())).reshape(1, -1)
-        return int(np.asarray(jnp.argmin(self._distances(jnp.asarray(x)))))
+        return int(host_fetch(jnp.argmin(self._distances(jnp.asarray(x)))))
 
     def compute_cost(self, frame: Frame) -> float:
         """Weighted SSE to nearest center over valid rows (MLlib 2.x
@@ -299,7 +299,7 @@ class KMeansModel(Model):
             X = X[:, None]
         w = frame.mask.astype(X.dtype)
         best = jnp.min(self._distances(X), axis=1)
-        return float(jnp.sum(jnp.maximum(best, 0.0) * w))
+        return float(host_fetch(jnp.sum(jnp.maximum(best, 0.0) * w)))
 
     computeCost = compute_cost
 
@@ -604,7 +604,7 @@ class GaussianMixtureModel(Model):
     def predict(self, features) -> int:
         x = jnp.asarray(np.asarray(features, np.float64).reshape(1, -1),
                         float_dtype())
-        return int(np.asarray(jnp.argmax(self._posterior(x), axis=1))[0])
+        return int(host_fetch(jnp.argmax(self._posterior(x), axis=1))[0])
 
     def predict_probability(self, features) -> np.ndarray:
         x = jnp.asarray(np.asarray(features, np.float64).reshape(1, -1),
@@ -889,7 +889,8 @@ class BisectingKMeansModel(Model):
         w = frame.mask.astype(X.dtype)
         nodes = self._predict_nodes(X)
         C = jnp.asarray(self.node_centers, X.dtype)
-        return float(jnp.sum(jnp.sum((X - C[nodes]) ** 2, axis=1) * w))
+        return float(host_fetch(jnp.sum(jnp.sum((X - C[nodes]) ** 2,
+                                                axis=1) * w)))
 
     computeCost = compute_cost
 

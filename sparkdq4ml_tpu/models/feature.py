@@ -25,7 +25,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..config import float_dtype, int_dtype
-from .base import Estimator, Model, Transformer, persistable
+from .base import Estimator, Model, Transformer, host_fetch, persistable
 
 
 @persistable
@@ -417,7 +417,7 @@ class Bucketizer(Transformer):
         invalid = jnp.logical_or(jnp.logical_or(x < s[0], x > s[-1]),
                                  jnp.isnan(x))
         if self.handle_invalid == "error":
-            if bool(np.asarray(jnp.logical_and(invalid, frame.mask)).any()):
+            if bool(host_fetch(jnp.logical_and(invalid, frame.mask)).any()):
                 raise ValueError("Bucketizer: values outside splits; set "
                                  "handle_invalid='keep' or 'skip'")
         elif self.handle_invalid == "keep":
@@ -510,7 +510,7 @@ class StandardScaler(_ScalerBase):
     def fit(self, frame) -> "StandardScalerModel":
         X, w = self._masked_feature_matrix(frame)
         _, mean, var = _masked_moments(X, w)
-        return StandardScalerModel(np.asarray(mean), np.asarray(jnp.sqrt(var)),
+        return StandardScalerModel(np.asarray(mean), host_fetch(jnp.sqrt(var)),
                                    self.with_mean, self.with_std,
                                    self.input_col, self.output_col)
 
@@ -1033,7 +1033,8 @@ class PCA(Estimator):
         signs = np.sign(vecs_np[np.argmax(np.abs(vecs_np), axis=0),
                                 np.arange(self.k)])
         signs[signs == 0] = 1.0
-        total = float(jnp.sum(jnp.clip(jnp.diagonal(cov), 0.0, None)))
+        total = float(host_fetch(jnp.sum(jnp.clip(jnp.diagonal(cov),
+                                                  0.0, None))))
         ev = np.clip(np.asarray(vals), 0.0, None)
         ratios = ev / total if total > 0 else np.zeros_like(ev)
         return PCAModel(vecs_np * signs, ratios, self.k,

@@ -30,7 +30,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from ..config import float_dtype
 from ..frame import Frame
 from ..parallel.mesh import DATA_AXIS, serialize_collectives, shard_map
-from .base import Estimator, Model, persistable
+from .base import Estimator, Model, host_fetch, persistable
 
 _FAMILY_LINKS = {
     "gaussian": ("identity", "log", "inverse"),
@@ -476,7 +476,7 @@ class GeneralizedLinearRegression(Estimator):
                 self.family == "tweedie" and self.variance_power != 0.0)
             mu0 = {"binomial": min(max(mu_bar, 0.01), 0.99)}.get(
                 self.family, max(mu_bar, 0.1) if positive else mu_bar)
-            beta0[p - 1] = float(np.asarray(link_f(jnp.asarray(mu0, dt))))
+            beta0[p - 1] = float(host_fetch(link_f(jnp.asarray(mu0, dt))))
 
         from ..parallel.distributed import pad_and_shard_rows
 
@@ -642,7 +642,7 @@ class GlmTrainingSummary:
         X, _, _ = self._xyw()
         _, link_inv, _ = _link_fns(self._m._p("link"))
         eta = X @ self._m.coefficients + self._m.intercept + self._offset()
-        self._cache["mu"] = np.asarray(_clip_mu(self._m._p("family"),
+        self._cache["mu"] = host_fetch(_clip_mu(self._m._p("family"),
                                                 link_inv(jnp.asarray(eta))))
         return self._cache["mu"]
 
@@ -671,7 +671,7 @@ class GlmTrainingSummary:
             return self._cache["dispersion"]
         X, y, w = self._xyw()
         mu = self._mu()
-        var = np.asarray(_variance_fn(family)(jnp.asarray(mu)))
+        var = host_fetch(_variance_fn(family)(jnp.asarray(mu)))
         pearson = np.sum(w * (y - mu) ** 2 / np.maximum(var, _EPS))
         self._cache["dispersion"] = float(
             pearson / max(self.degrees_of_freedom, 1))
@@ -690,7 +690,7 @@ class GlmTrainingSummary:
             if self._m._p("fit_intercept", True):
                 link_f, _, _ = _link_fns(link)
                 mu_bar = float(np.sum(y * w) / max(w.sum(), _EPS))
-                b0 = float(np.asarray(link_f(jnp.asarray(
+                b0 = float(host_fetch(link_f(jnp.asarray(
                     _clip_mu(family, jnp.asarray(mu_bar, jnp.float64))))))
                 fit_fn = _fit_cached(None, family, link, 50, 1e-10, 0.0,
                                      False)
@@ -698,14 +698,14 @@ class GlmTrainingSummary:
                 res = fit_fn(ones, jnp.asarray(y), jnp.asarray(w),
                              jnp.asarray(off), jnp.asarray([b0]))
                 return float(res.deviance)
-            mu0 = np.asarray(_clip_mu(family, link_inv(jnp.asarray(off))))
+            mu0 = host_fetch(_clip_mu(family, link_inv(jnp.asarray(off))))
         elif self._m._p("fit_intercept", True):
             mu0 = np.full_like(y, np.sum(y * w) / w.sum())
         else:
-            mu0 = np.full_like(y, float(np.asarray(link_inv(
+            mu0 = np.full_like(y, float(host_fetch(link_inv(
                 jnp.asarray(0.0, jnp.float64)))))
-        mu0 = np.asarray(_clip_mu(family, jnp.asarray(mu0)))
-        return float(np.asarray(_deviance(family, jnp.asarray(y),
+        mu0 = host_fetch(_clip_mu(family, jnp.asarray(mu0)))
+        return float(host_fetch(_deviance(family, jnp.asarray(y),
                                           jnp.asarray(mu0),
                                           jnp.asarray(w))))
 
@@ -719,16 +719,16 @@ class GlmTrainingSummary:
         if residuals_type == "response":
             r = y - mu
         elif residuals_type == "pearson":
-            var = np.asarray(_variance_fn(family)(jnp.asarray(mu)))
+            var = host_fetch(_variance_fn(family)(jnp.asarray(mu)))
             r = (y - mu) * np.sqrt(w) / np.sqrt(np.maximum(var, _EPS))
         elif residuals_type == "working":
             _, _, dmu = _link_fns(self._m._p("link"))
             link_f, _, _ = _link_fns(self._m._p("link"))
-            eta = np.asarray(link_f(jnp.asarray(mu)))
-            d = np.asarray(dmu(jnp.asarray(eta)))
+            eta = host_fetch(link_f(jnp.asarray(mu)))
+            d = host_fetch(dmu(jnp.asarray(eta)))
             r = (y - mu) / np.where(np.abs(d) < _EPS, _EPS, d)
         elif residuals_type == "deviance":
-            unit = np.asarray(_unit_deviance(family, jnp.asarray(y),
+            unit = host_fetch(_unit_deviance(family, jnp.asarray(y),
                                              jnp.asarray(mu))) * w
             r = np.sign(y - mu) * np.sqrt(np.maximum(unit, 0.0))
         else:

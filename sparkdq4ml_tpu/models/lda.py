@@ -55,7 +55,7 @@ from ..config import float_dtype
 from ..frame import Frame
 from ..parallel.mesh import (DATA_AXIS, normalize_mesh,
                              serialize_collectives, shard_map)
-from .base import Estimator, Model, persistable
+from .base import Estimator, Model, host_fetch, persistable
 
 _EPS = 1e-30
 
@@ -456,7 +456,8 @@ class LDAModel(Model):
         run = _bound_fn(int(p["k"]), int(p["vocab_size"]),
                         float(p["alpha"]), float(p["eta"]),
                         int(p["inner_iter"]))
-        return float(run(cnts, jnp.asarray(self.topics, cnts.dtype), mask))
+        return float(host_fetch(run(cnts, jnp.asarray(self.topics,
+                                                      cnts.dtype), mask)))
 
     logLikelihood = log_likelihood
 

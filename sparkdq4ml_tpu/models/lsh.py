@@ -26,7 +26,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..config import float_dtype
-from .base import Estimator, Model, persistable
+from .base import Estimator, Model, host_fetch, persistable
 
 _MINHASH_PRIME = 2038074743  # MLlib's MinHashLSH prime
 
@@ -100,7 +100,7 @@ class _LSHModelBase(Model):
         if cand.sum() < num_neighbors:
             cand = valid
         idx = np.nonzero(cand)[0]
-        d = np.asarray(self._distance(X[jnp.asarray(idx)], keyv))
+        d = host_fetch(self._distance(X[jnp.asarray(idx)], keyv))
         k = min(num_neighbors, idx.size)
         top = np.argsort(d, kind="stable")[:k]
         keep = np.zeros(X.shape[0], bool)
@@ -155,7 +155,7 @@ class _LSHModelBase(Model):
         nb = int(rp.max()) + 1
         uniq = np.unique(lp * np.int64(nb) + rp)
         pa, pb = uniq // nb, uniq % nb
-        d = np.asarray(self._distance_rows(Xa[jnp.asarray(ia[pa])],
+        d = host_fetch(self._distance_rows(Xa[jnp.asarray(ia[pa])],
                                            Xb[jnp.asarray(ib[pb])]))
         keep = d <= threshold
         from ..frame import Frame

@@ -334,6 +334,8 @@ class ALS(Estimator):
             rep = replicated_sharding(mesh)
             factors = (jax.device_put(U0, rep), jax.device_put(V0, rep))
         U, V, history = jax.block_until_ready(fit_fn(*args, *factors))
+        # dqlint: ok(host-sync): id vocabularies are host numpy
+        # (np.unique over the input ids), not device arrays
         return ALSModel(np.asarray(U), np.asarray(V), u_ids.tolist(),
                         i_ids.tolist(), self._params_dict(),
                         np.asarray(history, np.float64).tolist())

@@ -36,7 +36,7 @@ import numpy as np
 
 from ..config import float_dtype
 from ..frame import Frame
-from .base import Estimator, Model, persistable
+from .base import Estimator, Model, host_fetch, persistable
 from ..parallel.mesh import serialize_collectives
 
 _NEG = -1e30
@@ -800,7 +800,7 @@ class DecisionTreeClassificationModel(_TreeModelBase):
 
     def predict(self, features) -> float:
         x = np.asarray(features, np.float64).reshape(1, -1)
-        return float(np.asarray(jnp.argmax(self._proba(x), axis=1))[0])
+        return float(host_fetch(jnp.argmax(self._proba(x), axis=1))[0])
 
     def predict_probability(self, features):
         x = np.asarray(features, np.float64).reshape(1, -1)

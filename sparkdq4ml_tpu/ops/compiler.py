@@ -695,6 +695,8 @@ def _lookup_plan(steps, extra, base_schema):
     key, lits, _steps, _extra, _refs = _linearize(steps, extra, base_schema)
     key = plan_namespace_tag() + key
     lit_values = tuple(
+        # dqlint: ok(host-sync): hoisted literals are host scalars (numpy
+        # or python) by Lit construction — never device arrays
         v.value.item() if hasattr(v.value, "item") else v.value
         for v in lits)
     with _CACHE_LOCK:

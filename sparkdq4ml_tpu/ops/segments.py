@@ -1151,6 +1151,8 @@ def _host_sort_plan(key_arrs, specs, mask):
     (host int array)."""
     from ..frame.frame import lexsort_keys
 
+    # dqlint: ok(host-sync): counted by the device-sort entry — the CPU
+    # branch increments frame.host_sync immediately before planning here
     pulled = jax.device_get(tuple(key_arrs) + (mask,))
     m = np.asarray(pulled[-1], bool)
     vi = np.nonzero(m)[0]

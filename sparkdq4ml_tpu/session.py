@@ -299,21 +299,20 @@ class TpuSession:
         # same truthiness vocabulary as the conf key — "SPARKDQ4ML_OBS=off"
         # must not ENABLE tracing
         env_on = os.environ.get(_obs.ENV_VAR, "").strip().lower() not in (
-            "", "0", "false", "off", "no")
-        if conf_val in ("true", "on", "1") or (conf_val == "" and env_on):
+            ("",) + _CONF_FALSE)
+        if conf_val in _CONF_TRUE or (conf_val == "" and env_on):
             _obs.enable(
                 max_spans=int(self.conf.get("spark.observability.maxSpans",
                                             10_000)),
                 log_spans=str(self.conf.get("spark.observability.logSpans",
-                                            "")).lower() in ("true", "on",
-                                                             "1"))
+                                            "")).lower() in _CONF_TRUE)
             self._obs_enabled_here = True
             if getattr(self, "_session_span", None) is None:
                 self._session_span = _obs.TRACER.begin(
                     "session", cat="session", app=self.app_name,
                     devices=self.num_devices,
                     platform=jax.devices()[0].platform)
-        elif conf_val in ("false", "off", "0"):
+        elif conf_val in _CONF_FALSE:
             # explicit opt-out wins over a programmatic/env enable — the
             # same session-scoped-override rule as spark.compilation.cache
             _obs.disable()
@@ -411,8 +410,8 @@ class TpuSession:
         pods, where every process MUST claim its accelerator) with
         ``.config("spark.backend.probe", "off")``; tune the probe window
         with ``.config("spark.backend.probeTimeout", seconds)``."""
-        if str(self.conf.get("spark.backend.probe", "on")).lower() in (
-                "off", "false", "0"):
+        if str(self.conf.get("spark.backend.probe", "on")).lower() \
+                in _CONF_FALSE:
             return
         if self._is_multihost():
             return  # multi-host bootstrap: CPU fallback would desync ranks
@@ -516,8 +515,8 @@ class TpuSession:
 
         from jax.experimental.compilation_cache import compilation_cache as _cc
 
-        if str(self.conf.get("spark.compilation.cache", "on")).lower() in (
-                "off", "false", "0"):
+        if str(self.conf.get("spark.compilation.cache", "on")).lower() \
+                in _CONF_FALSE:
             try:
                 # A previous session may have pointed the process-global
                 # cache at its directory; opting out must actually stop
@@ -627,7 +626,7 @@ class TpuSession:
                 if any(k.startswith("spark.observability.")
                        for k in self._conf):
                     _ACTIVE._init_observability()
-                if any(k.startswith(("spark.pipeline.", "spark.groupedExec",
+                if any(k.startswith(("spark.pipeline.", "spark.groupedExec.",
                                      "spark.explain.", "spark.serve.",
                                      "spark.ingest."))
                        for k in self._conf):

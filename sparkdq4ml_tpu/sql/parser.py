@@ -1142,6 +1142,8 @@ class _OverlayCatalog:
 
 def _pyval(v):
     """numpy scalar → python scalar (Lit dispatches on python types)."""
+    # dqlint: ok(host-sync): SQL literal folding — the values are parsed
+    # host scalars (numpy or python), never device arrays
     return v.item() if hasattr(v, "item") else v
 
 
@@ -1934,7 +1936,9 @@ def _execute_statement(sql: str, catalog=None):
     m = _DDL_RE.match(sql)
     if m:
         name, body = m.group(1), m.group(2)
-        _obs.current_span().set(plan=f"CreateView[{name}]")
+        if _obs.TRACER.enabled:
+            # format only when the span is live (disabled-mode no-op)
+            _obs.current_span().set(plan=f"CreateView[{name}]")
         frame = execute(body, cat)
         cat.register(name, frame)
         from ..frame.frame import Frame
@@ -1943,7 +1947,9 @@ def _execute_statement(sql: str, catalog=None):
     m = _DROP_RE.match(sql)
     if m:
         if_exists, name = bool(m.group(1)), m.group(2)
-        _obs.current_span().set(plan=f"DropView[{name}]")
+        if _obs.TRACER.enabled:
+            # format only when the span is live (disabled-mode no-op)
+            _obs.current_span().set(plan=f"DropView[{name}]")
         existed = cat.drop(name)
         if not existed and not if_exists:
             raise KeyError(f"temp view {name!r} not found")

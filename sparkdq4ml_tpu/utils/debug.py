@@ -173,8 +173,10 @@ def _probe_disabled() -> bool:
     the same reason)."""
     import os
 
-    return os.environ.get("SPARKDQ4ML_BACKEND_PROBE", "").lower() in (
-        "off", "0", "false")
+    from ..config import CONF_FALSE
+
+    return os.environ.get("SPARKDQ4ML_BACKEND_PROBE", "").lower() \
+        in CONF_FALSE
 
 
 def bounded_backend_init(timeout_s: "Optional[float]" = None) -> None:
@@ -207,8 +209,9 @@ def bounded_backend_init(timeout_s: "Optional[float]" = None) -> None:
     if timeout_s is None:
         timeout_s = _probe_timeout()
 
-    if os.environ.get("SPARKDQ4ML_INIT_WATCHDOG", "1") in ("0", "false",
-                                                           "off"):
+    from ..config import CONF_FALSE
+
+    if os.environ.get("SPARKDQ4ML_INIT_WATCHDOG", "1") in CONF_FALSE:
         _jax.devices()
         return
     done = threading.Event()

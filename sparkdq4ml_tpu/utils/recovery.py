@@ -432,9 +432,11 @@ def result_validator() -> Optional[Callable]:
         from ..session import TpuSession
 
         s = TpuSession.active()
+        from ..config import CONF_TRUE
+
         if s is not None and str(
-                s.conf.get("spark.recovery.validate", "off")).lower() in (
-                    "on", "true", "1"):
+                s.conf.get("spark.recovery.validate", "off")).lower() \
+                in CONF_TRUE:
             return check_finite
     except Exception:
         pass
