@@ -1,18 +1,34 @@
 #!/usr/bin/env python
-"""dqlint gate: run the full static invariant-analyzer suite over the
-tree — the single tier-1 entry point for every rule in
-``sparkdq4ml_tpu/analysis`` (host-sync, collective-guard, conf-key,
-noop, lock-order, plus the framework ports of the legacy logger-ns and
-numpy-free lints, whose standalone scripts now delegate here too).
+"""dqlint/dqaudit gate: the static invariant analyzers over the tree —
+the single tier-1 entry point for every rule in
+``sparkdq4ml_tpu/analysis``.
 
-Exit status 0 when every rule is clean (baselined findings don't fail
-the gate but are listed); 1 with one ``path:line: [rule] message``
-diagnostic per live finding. Stale baseline entries (matching nothing
-anymore) are reported so the baseline file can only shrink.
+Two tiers:
+
+* ``--tier source`` (default) — the AST rule suite (host-sync,
+  collective-guard, conf-key, noop, lock-order, plus the framework
+  ports of the legacy logger-ns and numpy-free lints, whose standalone
+  scripts delegate here too). No engine import, no jax.
+* ``--tier program`` — dqaudit (``sparkdq4ml_tpu/analysis/program``):
+  runs the paper's headline DQ+Lasso workload to populate every plan
+  cache, then abstract-evaluates each registry-enumerable cached
+  program (``observability.CACHES.programs()``) under the four
+  jaxpr-level detectors — static-memory bound, hidden-sync,
+  collective-topology, retrace-hazard. Zero compiles and zero device
+  execution during the audit itself; SKIPs cleanly (exit 0, reason
+  printed) when the engine/backend cannot trace at all.
+
+``--tier all`` runs both. Exit status 0 when every selected tier is
+clean (baselined findings don't fail the gate but are listed); 1 with
+one diagnostic per live finding. Stale baseline entries (matching
+nothing anymore) are reported so the baseline file can only shrink.
 
 Usage::
 
-    python scripts/check_static.py [root] [--rules host-sync,noop]
+    python scripts/check_static.py [root] [--tier source|program|all]
+                                   [--rules host-sync,noop]
+                                   [--detectors audit-memory,...]
+                                   [--data path/to.csv] [--no-workload]
                                    [--json] [--baseline PATH]
                                    [--update-baseline] [--list-rules]
 
@@ -30,13 +46,66 @@ import sys
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
+def _run_program_tier(args, out: dict) -> tuple:
+    """dqaudit arm. Returns ``(findings, skip_reason)`` — a non-None
+    skip reason means the environment cannot run the audit (missing
+    engine, untraceable backend) and the gate must pass vacuously."""
+    try:
+        from sparkdq4ml_tpu.analysis.program import (audit_programs,
+                                                     get_detectors,
+                                                     run_headline_workload)
+    except Exception as e:
+        return [], f"engine import failed ({type(e).__name__}: {e})"
+    names = None
+    if args.detectors:
+        names = [d.strip() for d in args.detectors.split(",")]
+    try:
+        detectors = get_detectors(names)
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        raise SystemExit(2)
+    try:
+        if not args.no_workload:
+            data = args.data or os.path.join(REPO, "data",
+                                             "dataset-abstract.csv")
+            golden = run_headline_workload(data)
+            out["workload"] = golden
+        result = audit_programs(detectors=detectors)
+    except Exception as e:
+        return [], f"workload/trace failed ({type(e).__name__}: {e})"
+    out["programs"] = result.programs
+    out["program_stats"] = result.program_stats
+    out["detectors"] = [d.name for d in detectors]
+    for key, err in result.skipped:
+        print(f"dqaudit skipped (trace raised): {key[:100]!r}: {err}")
+    for name, err in result.enum_errors.items():
+        print(f"dqaudit enumerator error [{name}]: {err}")
+    return result.findings, None
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("root", nargs="?", default=REPO,
                     help="tree root containing sparkdq4ml_tpu/ (default:"
                          " this repo)")
+    ap.add_argument("--tier", choices=("source", "program", "all"),
+                    default="source",
+                    help="source = AST rules (default); program ="
+                         " dqaudit over every cached program; all ="
+                         " both")
     ap.add_argument("--rules", default=None,
-                    help="comma-separated rule subset (default: all)")
+                    help="comma-separated source-rule subset"
+                         " (default: all)")
+    ap.add_argument("--detectors", default=None,
+                    help="comma-separated dqaudit detector subset"
+                         " (default: all four)")
+    ap.add_argument("--data", default=None,
+                    help="headline-workload CSV for --tier program"
+                         " (default: <repo>/data/dataset-abstract.csv)")
+    ap.add_argument("--no-workload", action="store_true",
+                    help="--tier program: audit whatever this process"
+                         " already cached instead of running the"
+                         " headline workload")
     ap.add_argument("--json", action="store_true", dest="as_json",
                     help="machine-readable findings")
     ap.add_argument("--baseline", default=None,
@@ -46,7 +115,7 @@ def main(argv=None) -> int:
                     help="write the current live findings to the baseline"
                          " and exit 0")
     ap.add_argument("--list-rules", action="store_true",
-                    help="print the rule catalog and exit")
+                    help="print the rule/detector catalog and exit")
     args = ap.parse_args(argv)
 
     # The framework always comes from THIS repo (the target root may be a
@@ -58,26 +127,73 @@ def main(argv=None) -> int:
     if args.list_rules:
         for cls in ALL_RULES:
             print(f"{cls.name:18s} {cls.description}")
+        # dqaudit catalog comes from a light import (no jax needed for
+        # the listing): fall back silently if the engine is absent
+        try:
+            from sparkdq4ml_tpu.analysis.program import ALL_DETECTORS
+            for cls in ALL_DETECTORS:
+                print(f"{cls.name:18s} {cls.description}")
+        except Exception:
+            pass
         return 0
-
-    names = [r.strip() for r in args.rules.split(",")] if args.rules \
-        else None
-    try:
-        rules = get_rules(names)
-    except ValueError as e:
-        print(f"error: {e}", file=sys.stderr)
-        return 2
 
     root = os.path.abspath(args.root)
     baseline_path = args.baseline or os.path.join(root,
                                                   "dqlint_baseline.json")
     baseline = Baseline(baseline_path)
-    findings, stale = run_rules(root, rules, baseline)
+
+    findings: list = []
+    extra: dict = {}
+    n_rules = 0
+    ran_source = args.tier in ("source", "all")
+    ran_program = False
+    if ran_source:
+        names = [r.strip() for r in args.rules.split(",")] if args.rules \
+            else None
+        try:
+            rules = get_rules(names)
+        except ValueError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+        n_rules = len(rules)
+        src_findings, _ = run_rules(root, rules)
+        findings.extend(src_findings)
+    if args.tier in ("program", "all"):
+        prog_findings, skip = _run_program_tier(args, extra)
+        if skip is not None:
+            print(f"dqaudit SKIP: {skip}")
+        else:
+            ran_program = True
+        findings.extend(prog_findings)
+
+    def _is_program_entry(path: str) -> bool:
+        return path.startswith("program:")
+
+    # one baseline pass over the merged findings; a baseline entry is
+    # only STALE when the tier that owns it actually ran (an entry of a
+    # skipped/un-selected tier matched nothing for environmental
+    # reasons — telling the operator to delete it would drop a valid
+    # suppression)
+    stale = baseline.apply(findings)
+    stale = [s for s in stale
+             if (ran_program if _is_program_entry(s[1]) else ran_source)]
 
     if args.update_baseline:
-        baseline.write(findings)
-        print(f"baseline updated: {len(findings)} entr"
-              f"{'y' if len(findings) == 1 else 'ies'} -> {baseline_path}")
+        from sparkdq4ml_tpu.analysis import Finding
+
+        # preserve the entries of tiers that did NOT run — a
+        # source-only update must not erase grandfathered program
+        # findings from the shared baseline file (and vice versa)
+        preserved = [
+            Finding(rule=r, path=p, line=0, message="", fingerprint=fp)
+            for (r, p, fp) in sorted(baseline.entries)
+            if not (ran_program if _is_program_entry(p) else ran_source)]
+        baseline.write(findings + preserved)
+        n = len(findings) + len(preserved)
+        print(f"baseline updated: {n} entr"
+              f"{'y' if n == 1 else 'ies'} -> {baseline_path}"
+              + (f" ({len(preserved)} preserved from tiers that did not"
+                 " run)" if preserved else ""))
         return 0
 
     live = [f for f in findings if not f.baselined]
@@ -85,6 +201,7 @@ def main(argv=None) -> int:
         print(json.dumps({
             "findings": [f.as_dict() for f in findings],
             "stale_baseline": [list(s) for s in stale],
+            **extra,
         }, indent=1))
     else:
         for f in findings:
@@ -94,7 +211,15 @@ def main(argv=None) -> int:
             print(f"stale baseline entry: [{rule}] {path}: {fp!r}"
                   " matches nothing — delete it")
         if not findings and not stale:
-            print(f"dqlint clean: {len(rules)} rule(s), 0 findings")
+            parts = []
+            if args.tier in ("source", "all"):
+                parts.append(f"dqlint clean: {n_rules} rule(s)")
+            if args.tier in ("program", "all") and "programs" in extra:
+                parts.append(
+                    f"dqaudit clean: {extra['programs']} program(s), "
+                    f"{len(extra.get('detectors', ()))} detector(s)")
+            parts.append("0 findings")
+            print(", ".join(parts))
     return 1 if live else 0
 
 

@@ -1,0 +1,39 @@
+"""dqaudit — the jaxpr-level program-audit tier (ISSUE 9).
+
+dqlint (``analysis/rules``) enforces the engine's invariants at the
+SOURCE level; this package enforces them at the level of the *traced
+program* — the properties that actually burn a serving fleet are in the
+jaxpr, invisible to an AST walk: a fused plan whose intermediates exceed
+HBM, a hidden host callback inside a jitted body, a collective whose
+axis doesn't bind to the mesh, a plan that silently retraces per shape
+bucket. ("Memory Safe Computations with XLA", arxiv 2206.14148: static
+per-program bounds computed from the IR, treated as first-class plan
+constraints.)
+
+The audit surface is ``observability.CACHES.programs()`` — every
+compiled-program cache (pipeline compiler, segment-reduction engine,
+solver jit entries, packed sharded fits) registers traceable
+:class:`~...utils.observability.ProgramHandle` records, so the auditor
+(and the ROADMAP item 4 cost-based optimizer after it) enumerates
+cached programs without private imports.
+
+Everything here is abstract evaluation (``jax.make_jaxpr`` /
+``jax.eval_shape``): zero compiles, zero device execution, zero counted
+host syncs — strictly offline/on-demand, never on the serving hot path
+(test-pinned). Entry points: ``scripts/check_static.py --tier program``
+(the tier-1 gate arm), ``session.audit_report()``, and the EXPLAIN
+``est peak`` column (:mod:`.static_mem`).
+"""
+
+from .audit import (AuditResult, audit_programs, audit_report,
+                    run_headline_workload)
+from .detectors import (ALL_DETECTORS, AuditContext, Detector,
+                        get_detectors, program_finding)
+from .jaxpr_tools import peak_bytes, structural_signature, trace
+
+__all__ = [
+    "ALL_DETECTORS", "AuditContext", "AuditResult", "Detector",
+    "audit_programs", "audit_report", "get_detectors", "peak_bytes",
+    "program_finding", "run_headline_workload", "structural_signature",
+    "trace",
+]

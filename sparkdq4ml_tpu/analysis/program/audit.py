@@ -1,0 +1,148 @@
+"""dqaudit driver — run the four detectors over every enumerable cached
+program.
+
+The auditor is strictly OFFLINE/on-demand: nothing in the serving or
+query hot path imports this package (test-pinned). Entry points:
+
+* :func:`audit_programs` — detectors over a handle list (defaults to
+  ``observability.CACHES.programs()``, i.e. everything the engine has
+  cached so far in this process);
+* :func:`audit_report` — the ``session.audit_report()`` payload;
+* :func:`run_headline_workload` — populate the caches with the paper's
+  headline DQ + Lasso flow (used by ``scripts/check_static.py --tier
+  program`` so the audited program set is the serving-representative
+  one, not whatever happened to run first).
+
+A program whose BASELINE abstract trace raises is reported as *skipped*
+(with the error), not as a finding: on exotic backends tracing may be
+impossible for environmental reasons, and the CLI must SKIP cleanly
+rather than fail the gate. Variant-trace failures after a successful
+baseline trace ARE findings (the retrace detector's job).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+from .detectors import ALL_DETECTORS, AuditContext, get_detectors
+
+__all__ = ["AuditResult", "audit_programs", "audit_report",
+           "run_headline_workload"]
+
+
+@dataclasses.dataclass
+class AuditResult:
+    findings: list            # live Finding records
+    programs: int             # handles audited (traced successfully)
+    skipped: list             # (program_key, error) — baseline trace failed
+    enum_errors: dict         # producer name → enumerator error
+    program_stats: dict       # program_key → detector facts (est peak, …)
+
+    def as_dict(self) -> dict:
+        return {
+            "findings": [f.as_dict() for f in self.findings],
+            "programs": self.programs,
+            "skipped": [list(s) for s in self.skipped],
+            "enum_errors": dict(self.enum_errors),
+            "program_stats": self.program_stats,
+        }
+
+
+def audit_programs(handles=None, detectors=None,
+                   ctx: Optional[AuditContext] = None) -> AuditResult:
+    """Run ``detectors`` (default: all four) over ``handles`` (default:
+    every program in ``observability.CACHES``). Zero device execution,
+    zero compiles, zero counted host syncs — abstract evaluation only."""
+    from ...utils import observability as _obs
+
+    enum_errors: dict = {}
+    if handles is None:
+        handles, enum_errors = _obs.CACHES.programs()
+    if detectors is None:
+        detectors = get_detectors()
+    if ctx is None:
+        ctx = AuditContext.from_config()
+    findings: list = []
+    skipped: list = []
+    traced: list = []
+    for h in handles:
+        try:
+            ctx.trace(h)
+        except Exception as e:
+            skipped.append((h.program_key,
+                            f"{type(e).__name__}: {e}"))
+            continue
+        traced.append(h)
+        for det in detectors:
+            findings.extend(det.check(h, ctx))
+    for det in detectors:
+        findings.extend(det.finalize(traced, ctx))
+    audited = len(traced)
+    findings.sort(key=lambda f: (f.path, f.rule, f.fingerprint))
+    return AuditResult(findings=findings, programs=audited,
+                       skipped=skipped, enum_errors=enum_errors,
+                       program_stats=ctx.program_stats)
+
+
+def audit_report(detectors=None) -> dict:
+    """The ``session.audit_report()`` payload: findings + per-program
+    facts over everything currently cached in this process."""
+    result = audit_programs(detectors=detectors)
+    by_detector: dict = {c.name: 0 for c in ALL_DETECTORS}
+    for f in result.findings:
+        by_detector[f.rule] = by_detector.get(f.rule, 0) + 1
+    doc = result.as_dict()
+    doc["by_detector"] = by_detector
+    doc["clean"] = not result.findings
+    return doc
+
+
+def run_headline_workload(data_path: str) -> dict:
+    """Populate every plan cache with the paper's headline flow — the
+    DQ rules + SQL filters over the pricing CSV, a grouped aggregate,
+    and the Lasso fit (maxIter=40, regParam=1, elasticNetParam=1) — and
+    return the golden observables so the caller can assert the workload
+    actually ran (count 24 on dataset-abstract). Device execution
+    happens HERE, before the audit; the audit itself stays abstract."""
+    import sparkdq4ml_tpu as dq
+    from ...models import LinearRegression, VectorAssembler
+
+    spark = dq.TpuSession.builder().app_name("dqaudit").master(
+        "local[*]").get_or_create()
+    try:
+        dq.register_builtin_rules()
+        df = (spark.read.format("csv")
+              .option("inferSchema", "true").option("header", "false")
+              .load(data_path))
+        df = df.with_column_renamed("_c0", "guest")
+        df = df.with_column_renamed("_c1", "price")
+        df = df.with_column(
+            "price_no_min", dq.call_udf("minimumPriceRule",
+                                        dq.col("price")))
+        df.create_or_replace_temp_view("price")
+        df = spark.sql(
+            "SELECT cast(guest as int) guest, price_no_min AS price "
+            "FROM price WHERE price_no_min > 0")
+        df = df.with_column(
+            "price_correct_correl",
+            dq.call_udf("priceCorrelationRule", dq.col("price"),
+                        dq.col("guest")))
+        df.create_or_replace_temp_view("price")
+        df = spark.sql("SELECT guest, price_correct_correl AS price "
+                       "FROM price WHERE price_correct_correl > 0")
+        count = df.count()
+        # grouped-execution plan (segment reduction) for the audit set
+        df.create_or_replace_temp_view("clean")
+        spark.sql("SELECT guest, count(*) c, avg(price) m FROM clean "
+                  "GROUP BY guest ORDER BY guest").count()
+        df = df.with_column("label", df.col("price"))
+        df = VectorAssembler(["guest"], "features").transform(df)
+        lr = LinearRegression(max_iter=40, reg_param=1.0,
+                              elastic_net_param=1.0)
+        model = lr.fit(df)
+        return {"count": int(count),
+                "coefficients": [float(c)
+                                 for c in model.coefficients.tolist()]}
+    finally:
+        spark.stop()

@@ -1,0 +1,223 @@
+"""Static per-plan memory bounds for EXPLAIN — the ``est peak`` column.
+
+EXPLAIN ANALYZE (PR 5) measures peak bytes *after the fact*; this module
+computes an upper bound BEFORE anything runs, from static shape/dtype
+metadata plus one abstract trace of the fused pipeline stage
+(``jax.make_jaxpr`` — zero compiles, zero device execution, zero counted
+host syncs). The bound is deliberately conservative: every operator's
+working set assumes inputs and outputs coexist, filters keep every row,
+and no buffer aliasing is credited — so ``est peak ≥ measured peak``
+holds on the headline workload (test-pinned, with a documented slack
+factor on CPU).
+
+Per-node model (bytes; ``in`` = sum of child output estimates):
+
+========================  =====================================
+node                      working-set estimate
+========================  =====================================
+Scan                      frame bytes (columns + mask, static)
+FusedStage                ``in`` + traced jaxpr liveness peak
+Filter/Project/Having     ``2 × in`` (input + output)
+Aggregate variants        ``3 × in`` (input + keys/sort + output)
+Sort variants / Distinct  ``3 × in``
+Join                      ``2 × (left + right)``
+Limit/Offset              ``in``
+SetOps                    ``2 × in`` (concatenation)
+========================  =====================================
+
+``est_peak`` at a node is the running maximum over its subtree — the
+root's figure is the whole plan's bound, checked against the device
+budget × ``spark.audit.memoryFraction`` by the caller.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from . import jaxpr_tools as JT
+
+__all__ = ["annotate_plan", "frame_static_bytes"]
+
+_FACTORS = {
+    "Filter": 2.0, "Project": 2.0, "Having": 2.0,
+    "Aggregate": 3.0, "SegmentedAggregate": 3.0,
+    "Sort": 3.0, "DeviceSort": 3.0, "Distinct": 3.0,
+    "Limit": 1.0, "Offset": 1.0,
+    "CreateView": 1.0, "With": 1.0,
+}
+
+
+def frame_static_bytes(frame) -> int:
+    """Static footprint of a frame's device state: stored columns + mask
+    + one engine-float column per pending pipeline step output. Reads
+    ``_data_store``/``_mask_store`` directly — sizing must never flush
+    the pending pipeline (EXPLAIN executes nothing)."""
+    from ...config import float_dtype
+
+    total = 0
+    for arr in frame._data_store.values():
+        shape = getattr(arr, "shape", None)
+        dtype = getattr(arr, "dtype", None)
+        if shape is None or dtype is None:
+            continue
+        try:
+            total += int(np.prod(shape, dtype=np.int64)) \
+                * np.dtype(dtype).itemsize
+        except Exception:
+            continue
+    n = int(frame._n)
+    total += n * np.dtype(bool).itemsize                     # mask
+    total += len(frame._pending_names()) * n \
+        * np.dtype(float_dtype()).itemsize
+    return total
+
+
+def _fused_stage_peak(frame, q) -> Optional[int]:
+    """Abstract-trace the FusedStage program (WHERE + compilable
+    projections) exactly as the pipeline compiler would build it —
+    ``_linearize`` for literal hoisting, ``Expr.eval`` against the
+    tracer-frame shim — and run the liveness walk. Returns None when the
+    stage is not statically traceable (the caller falls back to the
+    factor model)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ...config import float_dtype
+    from ...ops import compiler as C
+    from ...ops import expressions as E
+
+    data = frame._data_store
+    pending = frame._pending_names()
+    n = int(frame._n)
+    b = C.bucket_size(n)
+    fdt = np.dtype(float_dtype())
+    schema: dict = {}
+    for name, arr in data.items():
+        schema[name] = C._col_spec(arr)
+    for name in pending:
+        # pending outputs are engine-float by construction for the
+        # estimation schema: the bound treats them as materialized
+        schema[name] = fdt.str
+    if q.where is None or not C.is_compilable(q.where, schema):
+        return None
+    steps = (("filter", q.where),)
+    extra = tuple(
+        (f"__est{i}", it) for i, it in enumerate(q.items)
+        if not isinstance(it, str) and isinstance(it, E.Expr)
+        and C.is_compilable(it, schema))
+    _key, lits, lsteps, lextra, refs = C._linearize(
+        steps, extra, dict(schema))
+    lit_vals = tuple(
+        v.value.item() if hasattr(v.value, "item") else v.value
+        for v in lits)
+
+    def prog(cols, mask, lit_args):
+        C._RUNTIME_LITS.lits = lit_args
+        try:
+            fr = C._TraceFrame(dict(zip(refs, cols)), b)
+            m = mask
+            for st in lsteps:
+                m = jnp.logical_and(
+                    m, E.predicate_keep_mask(st[1].eval(fr)))
+            return m, tuple(ex.eval(fr) for _name, ex in lextra)
+        finally:
+            C._RUNTIME_LITS.lits = ()
+
+    col_specs = []
+    for name in refs:
+        arr = data.get(name)
+        if arr is not None:
+            shape = (b,) + tuple(arr.shape[1:])
+            col_specs.append(jax.ShapeDtypeStruct(shape, arr.dtype))
+        else:
+            col_specs.append(jax.ShapeDtypeStruct((b,), fdt))
+    closed = jax.make_jaxpr(prog)(
+        tuple(col_specs), jax.ShapeDtypeStruct((b,), np.dtype(bool)),
+        lit_vals)
+    return JT.peak_bytes(closed)
+
+
+def _estimate(node, cat) -> Optional[tuple]:
+    """Bottom-up (out_bytes, peak) per node; annotates ``est_peak`` into
+    ``node.stats``. Returns None when the subtree cannot be sized (an
+    unregistered view, a DDL leaf) — ancestors then stay unannotated
+    rather than reporting a false bound."""
+    child_vals = [_estimate(c, cat) for c in node.children]
+    known = [v for v in child_vals if v is not None]
+    op = node.op
+
+    if op == "Scan":
+        if child_vals and child_vals[0] is not None:
+            out, peak = child_vals[0]       # derived table: its subquery
+        else:
+            view = node.meta.get("view")
+            if not isinstance(view, str):
+                return None
+            try:
+                frame = cat.lookup(view)
+            except Exception:
+                return None
+            out = frame_static_bytes(frame)
+            peak = out
+    elif op == "DropView":
+        out, peak = 0, 0
+    elif op == "Join":
+        if len(known) < len(child_vals) or not known:
+            return None
+        in_bytes = sum(o for o, _p in known)
+        out = in_bytes
+        peak = max(max(p for _o, p in known), 2.0 * in_bytes)
+    elif op == "SetOps":
+        if len(known) < len(child_vals) or not known:
+            return None
+        in_bytes = sum(o for o, _p in known)
+        out = in_bytes
+        peak = max(max(p for _o, p in known), 2.0 * in_bytes)
+    elif op == "FusedStage":
+        if not known:
+            return None
+        in_bytes, child_peak = known[0]
+        stage = None
+        q = node.meta.get("query")
+        frame = node.meta.get("frame")
+        if frame is None and q is not None:
+            view = getattr(q, "view", None)
+            if isinstance(view, str):
+                try:
+                    frame = cat.lookup(view)
+                except Exception:
+                    frame = None
+        if frame is not None and q is not None:
+            try:
+                stage = _fused_stage_peak(frame, q)
+            except Exception:
+                stage = None
+        if stage is not None:
+            node.stats["est_stage"] = int(stage)
+            peak = max(child_peak, in_bytes + stage)
+        else:
+            peak = max(child_peak, 2.0 * in_bytes)
+        out = in_bytes
+    else:
+        if not known:
+            return None
+        in_bytes = sum(o for o, _p in known)
+        factor = _FACTORS.get(op, 2.0)
+        out = in_bytes
+        peak = max(max(p for _o, p in known), factor * in_bytes)
+
+    node.stats["est_peak"] = int(peak)
+    return out, peak
+
+
+def annotate_plan(tree, cat) -> Optional[int]:
+    """Annotate ``est_peak`` bottom-up over an EXPLAIN plan tree;
+    returns the root bound (None when the tree cannot be sized). Never
+    raises — estimation is advisory and must not break EXPLAIN."""
+    try:
+        result = _estimate(tree, cat)
+    except Exception:
+        return None
+    return int(result[1]) if result is not None else None

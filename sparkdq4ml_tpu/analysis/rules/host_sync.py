@@ -17,7 +17,13 @@ Flagged site kinds (in the device-touching layers ``frame/``, ``ops/``,
 * ``float(...)`` / ``int(...)`` / ``bool(...)`` wrapping a ``jnp.*``
   computation — a scalar pull;
 * ``np.asarray/np.array(...)`` of a ``jnp.*`` expression or of frame
-  device state (``._data`` / ``._mask``) — a whole-array pull.
+  device state (``._data`` / ``._mask``) — a whole-array pull;
+* ``jax.pure_callback`` / ``jax.experimental.io_callback`` /
+  ``jax.debug.print``/``debug_callback`` call sites — sync-bearing: a
+  host round-trip EVERY execution of the jitted body they are staged
+  into (the jaxpr-level ``audit-sync`` detector in ``analysis/program``
+  is the ground truth for these; this source rule catches them before
+  the program is ever cached).
 
 A site is sanctioned when its enclosing function is a **counted
 wrapper** — it increments ``frame.host_sync`` itself or delegates to one
@@ -35,7 +41,7 @@ from __future__ import annotations
 import ast
 from typing import Optional
 
-from ..core import Finding, Rule, SourceFile, call_name
+from ..core import Finding, Rule, SourceFile, attr_chain, call_name
 
 _SCOPE_DIRS = ("frame/", "ops/", "models/", "sql/", "parallel/", "serve/")
 _PKG = "sparkdq4ml_tpu/"
@@ -47,6 +53,14 @@ _COUNTED_CALLS = frozenset({"collect", "to_pydict", "_host_pair",
                             "to_pandas"})
 _NP_ROOTS = ("np", "numpy")
 _JNP_ROOTS = ("jnp",)
+
+#: Callback-staging calls: sync-bearing at every execution of the jitted
+#: body. ``debug_print`` covers ``jax.debug.print`` via the attr-chain
+#: check below (bare ``print`` must not match).
+_CALLBACK_CALLS = frozenset({"pure_callback", "io_callback",
+                             "debug_callback"})
+#: Dotted suffixes that make a ``print`` call the jax.debug one.
+_DEBUG_PRINT_CHAINS = ("jax.debug.print", "debug.print")
 
 
 def _in_scope(rel: str) -> bool:
@@ -235,6 +249,16 @@ class HostSyncRule(Rule):
                         and (_contains_jnp_call(node.args[0])
                              or _contains_device_state(node.args[0])):
                     emit(node, f"np.{nm}(<device expression>)")
+                elif nm in _CALLBACK_CALLS:
+                    emit(node, f"{nm}(...) (host callback staged into a"
+                               " jitted body)")
+                elif nm == "print":
+                    chain = attr_chain(node.func) \
+                        if isinstance(node.func, ast.Attribute) else None
+                    if chain and (chain in _DEBUG_PRINT_CHAINS
+                                  or chain.endswith(".debug.print")):
+                        emit(node, "jax.debug.print(...) (host callback"
+                                   " staged into a jitted body)")
             for sub in nested:
                 scan_function(sub, False)
 

@@ -38,6 +38,10 @@ CONF_KEYS = {
     "spark.explain.memory": "session",
     "spark.explain.caches": "session",
     "spark.serve.enabled": "session",
+    "spark.audit.enabled": "session",
+    "spark.audit.memoryFraction": "session",
+    "spark.audit.deviceBudget": "session",
+    "spark.audit.constBytes": "session",
     "spark.ingest.streaming": "session",
     "spark.ingest.threads": "session",
     "spark.ingest.chunkBytes": "session",
@@ -111,6 +115,24 @@ class _Config:
     # server; the layer is otherwise pay-for-use — a process that never
     # starts a QueryServer runs zero serve code (no threads, no metrics).
     serve_enabled: bool = True
+    # dqaudit — the jaxpr-level program-audit tier (analysis/program/):
+    # gates the EXPLAIN `est peak` static-memory column and
+    # session.audit_report() (spark.audit.enabled). The auditor is
+    # strictly offline/on-demand either way — disabling only removes
+    # the EXPLAIN annotation and makes audit_report() refuse.
+    audit_enabled: bool = True
+    # Static per-program peak-bytes bound must fit this fraction of the
+    # device byte budget (spark.audit.memoryFraction).
+    audit_memory_fraction: float = 0.9
+    # Explicit device byte budget for the static-memory detector
+    # (spark.audit.deviceBudget); 0 = use the allocator bytes_limit
+    # where the backend exposes one (XLA:CPU exposes none, so the
+    # memory gate is advisory-only there unless set).
+    audit_device_budget: int = 0
+    # Captured-constant size above which the hidden-sync detector flags
+    # host-constant capture inside a jitted body
+    # (spark.audit.constBytes).
+    audit_const_bytes: int = 4096
     # Streaming CSV ingest (frame/native_csv.py): files larger than one
     # chunk parse through the native dq_stream API in bounded chunks cut
     # on structural record boundaries, with a prefetch thread overlapping

@@ -31,6 +31,7 @@ Numeric convention (validated against SURVEY.md §2.3 golden tables):
 from __future__ import annotations
 
 import functools
+import threading
 from typing import NamedTuple, Optional
 
 import jax
@@ -283,6 +284,8 @@ def solve(A: jnp.ndarray, reg_param: float, elastic_net_param: float,
     _faults.inject("solver")
     name = resolve_solver(solver, reg_param, elastic_net_param)
     counters.increment(f"solver.{name}_calls")
+    _record_solver_example(name, A, reg_param, elastic_net_param,
+                           max_iter, tol, fit_intercept, standardization)
     with _obs.span("solver.solve", cat="solver", solver=name,
                    features=int(A.shape[0]) - 2, max_iter=max_iter):
         if name == "normal":
@@ -313,16 +316,90 @@ def _jit_entry_size(fn) -> Optional[int]:
         return None
 
 
+#: Abstract example calling conventions of the solver jit entry points,
+#: keyed by a stable program key (solver name + Gramian spec + statics).
+#: Recorded at the ``solve()`` dispatch boundary (shape/dtype metadata
+#: only) so the program auditor can re-trace "the solver programs this
+#: process actually serves" without guessing shapes. Bounded: one entry
+#: per distinct (solver, shape, statics) signature.
+_SOLVER_EXAMPLES: dict[str, tuple] = {}
+_SOLVER_EXAMPLES_LOCK = threading.Lock()
+_SOLVER_EXAMPLES_MAX = 64
+
+
+def _record_solver_example(name: str, A, reg_param, elastic_net_param,
+                           max_iter, tol, fit_intercept,
+                           standardization) -> None:
+    if name not in ("fista", "normal"):
+        return            # owlqn is not a single jit entry point
+    shape = tuple(getattr(A, "shape", ()))
+    dtype = getattr(A, "dtype", None)
+    if len(shape) != 2 or dtype is None:
+        return
+    key = (f"{name}_solve|A={shape[0]}x{shape[1]}:{np.dtype(dtype).str}"
+           f"|maxIter={max_iter}|intercept={bool(fit_intercept)}"
+           f"|std={bool(standardization)}")
+    with _SOLVER_EXAMPLES_LOCK:
+        if key in _SOLVER_EXAMPLES \
+                or len(_SOLVER_EXAMPLES) >= _SOLVER_EXAMPLES_MAX:
+            return
+        aspec = jax.ShapeDtypeStruct(shape, dtype)
+        if name == "normal":
+            args = (aspec, float(reg_param), float(elastic_net_param))
+            kwargs = {"fit_intercept": bool(fit_intercept),
+                      "standardization": bool(standardization)}
+            fn = normal_solve
+        else:
+            args = (aspec, float(reg_param), float(elastic_net_param))
+            kwargs = {"max_iter": int(max_iter), "tol": float(tol),
+                      "fit_intercept": bool(fit_intercept),
+                      "standardization": bool(standardization)}
+            fn = fista_solve
+        _SOLVER_EXAMPLES[key] = (fn, args, kwargs)
+
+
+def solver_program_handles() -> list:
+    """Registry callback (CACHES.register_programs): the solver jit
+    entry points at every calling convention this process dispatched.
+    The variant re-traces at the next feature count — solver-loop
+    structure must not depend on the Gramian size."""
+    from ..utils import observability as _obs
+
+    with _SOLVER_EXAMPLES_LOCK:
+        items = list(_SOLVER_EXAMPLES.items())
+    out = []
+    for key, (fn, args, kwargs) in items:
+        a = args[0]
+
+        def wider(extra):
+            return jax.ShapeDtypeStruct(
+                (a.shape[0] + extra, a.shape[1] + extra), a.dtype)
+
+        out.append(_obs.ProgramHandle(
+            "solver", key, fn,
+            args=args, kwargs=kwargs,
+            # two fresh widths compared against each other (never the
+            # possibly trace-cached recorded shape)
+            variants={"shape": [((wider(1),) + args[1:], kwargs),
+                                ((wider(2),) + args[1:], kwargs)]},
+            mesh=None, guarded=None, meta={}))
+    return out
+
+
 def solver_cache_stats() -> dict:
     """Registry callback (observability.CACHES): compiled-program counts
     of the solver jit entry points plus the per-solver call counters —
     ``session.cache_report()['solver']``."""
     from ..utils.profiling import counters
 
+    with _SOLVER_EXAMPLES_LOCK:
+        entries = [{"key": k[:160], "program_key": k}
+                   for k in _SOLVER_EXAMPLES]
     stats: dict = {
         "kind": "jax.jit entry points (sufficient-statistics solvers)",
         "programs": {"fista_solve": _jit_entry_size(fista_solve),
                      "normal_solve": _jit_entry_size(normal_solve)},
+        "entries": entries,
     }
     calls = {name: counters.get(f"solver.{name}_calls")
              for name in ("fista", "normal", "owlqn")}
@@ -337,6 +414,7 @@ def _register_cache_stats() -> None:
     from ..utils import observability as _obs
 
     _obs.CACHES.register("solver", solver_cache_stats)
+    _obs.CACHES.register_programs("solver", solver_program_handles)
 
 
 _register_cache_stats()

@@ -49,6 +49,7 @@ import contextlib
 import contextvars
 import functools
 import math
+import re
 import threading
 import warnings
 from collections import OrderedDict
@@ -592,12 +593,15 @@ class _Plan:
         donated_names = self.donated
         extra_pairs = tuple(lowered_extra)
         step_tuple = tuple(lowered_steps)
+        # Abstract argument specs of the first real execution
+        # (ShapeDtypeStructs + literal scalars) — the auditor's re-trace
+        # surface (observability.ProgramHandle). None until first run.
+        self.example: Optional[tuple] = None
 
-        def program(kept, donated, mask, lit_args):
-            # Body runs at trace time only → this counts XLA compiles.
-            counters.increment("pipeline.compile")
-            with self._trace_lock:
-                self.traces += 1
+        def body(kept, donated, mask, lit_args):
+            # The pure program logic — shared by the jitted entry below
+            # and the auditor's abstract re-trace (which must not count
+            # as a compile nor bump the replay-verdict trace counter).
             _RUNTIME_LITS.lits = lit_args
             try:
                 env = dict(kept)
@@ -625,6 +629,15 @@ class _Plan:
                 return changed, new_mask, extras
             finally:
                 _RUNTIME_LITS.lits = ()
+
+        def program(kept, donated, mask, lit_args):
+            # Body runs at trace time only → this counts XLA compiles.
+            counters.increment("pipeline.compile")
+            with self._trace_lock:
+                self.traces += 1
+            return body(kept, donated, mask, lit_args)
+
+        self.trace_body = body
 
         # Buffer donation (replaced columns + mask) only pays on
         # accelerators, where the donated HBM buffer is reused for the
@@ -784,6 +797,16 @@ def run_pipeline(data: dict, mask, n: int, steps, extra=()):
         donated = tuple(_pad(data[name], b, fresh=plan.donates)
                         for name in plan.donated)
         mask_in = _pad(jnp.asarray(mask, jnp.bool_), b, fresh=plan.donates)
+        if plan.example is None:
+            # Abstract specs only (shape/dtype metadata, no device read);
+            # idempotent, so the benign cross-thread race needs no lock.
+            plan.example = (
+                {k: jax.ShapeDtypeStruct(v.shape, v.dtype)
+                 for k, v in kept.items()},
+                tuple(jax.ShapeDtypeStruct(v.shape, v.dtype)
+                      for v in donated),
+                jax.ShapeDtypeStruct(mask_in.shape, mask_in.dtype),
+                lit_values)
         with warnings.catch_warnings():
             # donation of a replaced column whose output dtype differs
             # (int column replaced by a float expression) is unusable —
@@ -832,10 +855,11 @@ def run_pipeline(data: dict, mask, n: int, steps, extra=()):
 
 def cache_stats() -> dict:
     """Registry callback: size/capacity, hit/miss/eviction counters, and
-    one entry per cached program (plan-key prefix, replay count, bucket
-    histogram) — the per-program lines EXPLAIN ANALYZE prints."""
+    one entry per cached program (stable ``program_key``, replay count,
+    bucket histogram) — the per-program lines EXPLAIN ANALYZE prints."""
     with _CACHE_LOCK:
-        entries = [{"key": p.key[:160], "hits": p.hits,
+        entries = [{"key": p.key[:160], "program_key": p.key,
+                    "hits": p.hits,
                     "compiles": p.compiles, "buckets": dict(p.buckets),
                     "runtime_literals": p.n_lits}
                    for p in _CACHE.values()]
@@ -851,4 +875,62 @@ def cache_stats() -> dict:
     }
 
 
+#: Numeric literal tokens of the plan-key grammar (``V(3)``/``V(3.5)``/
+#: ``V(1e-06)``) — the positions literal hoisting should have emptied.
+#: Bool (``V(True)``), NaN, and string literals stay distinct: the
+#: compiler keys them deliberately (see ``_hoistable_lit``).
+_NUM_LIT_RE = re.compile(r"V\((-?\d+(?:\.\d+)?(?:[eE][+-]?\d+)?)\)")
+
+
+def _bucket_variant(example, factor: int):
+    """The example specs re-bucketed ``factor`` powers-of-two up — every
+    padded input shares the row axis, so scaling the leading dim of each
+    array spec is exactly "the same plan at a later shape bucket". The
+    retrace detector compares TWO such variants (x2 vs x4) so both
+    traces are fresh under the current config — never jax's possibly
+    stale cached trace of the recorded shape."""
+    kept, donated, mask, lits = example
+
+    def up(s):
+        shape = (s.shape[0] * factor,) + tuple(s.shape[1:])
+        return jax.ShapeDtypeStruct(shape, s.dtype)
+
+    return (({k: up(v) for k, v in kept.items()},
+             tuple(up(v) for v in donated), up(mask), lits), {})
+
+
+def program_handles() -> list:
+    """Registry callback (observability.CACHES.register_programs): one
+    :class:`~..utils.observability.ProgramHandle` per cached plan that
+    has executed at least once. ``fn`` is the UN-counted trace body —
+    re-tracing it is invisible to ``pipeline.compile`` and to the
+    per-plan replay-verdict counter. ``expected_traces`` is the number
+    of distinct shape buckets the plan served: a healthy plan compiles
+    once per bucket, so ``observed > expected`` is a retrace leak."""
+    with _CACHE_LOCK:
+        plans = list(_CACHE.values())
+    out = []
+    for p in plans:
+        if p.example is None:
+            continue
+        kept, donated, mask, lits = p.example
+        out.append(_obs.ProgramHandle(
+            "pipeline", p.key, p.trace_body,
+            args=(kept, donated, mask, lits),
+            variants={"bucket": [_bucket_variant(p.example, 2),
+                                 _bucket_variant(p.example, 4)]},
+            mesh=None, guarded=None,
+            meta={"expected_traces": max(len(p.buckets), 1),
+                  "observed_traces": p.traces,
+                  # the literal-erased key: two plans colliding here are
+                  # one program cached per literal VALUE — the hoisting
+                  # regression the retrace detector's finalize pass
+                  # closes (numeric V(...) tokens only; bool/NaN/string
+                  # literals are deliberately key-resident)
+                  "dedup_key": _NUM_LIT_RE.sub("V(#)", p.key),
+                  "runtime_literals": p.n_lits}))
+    return out
+
+
 _obs.CACHES.register("pipeline", cache_stats)
+_obs.CACHES.register_programs("pipeline", program_handles)

@@ -18,6 +18,8 @@ from __future__ import annotations
 
 import functools
 import logging
+import threading
+from collections import namedtuple
 from typing import Optional
 
 import jax
@@ -26,9 +28,88 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..models.solvers import augmented_gram
+from ..ops.segments import abstract_specs
 from .mesh import DATA_AXIS, serialize_collectives, shard_map
 
 logger = logging.getLogger("sparkdq4ml_tpu.distributed")
+
+
+# ---------------------------------------------------------------------------
+# Enumerable jit-factory memo (the lru_cache replacement)
+# ---------------------------------------------------------------------------
+
+_CacheInfo = namedtuple("CacheInfo", ("hits", "misses", "maxsize",
+                                      "currsize"))
+
+
+class _RecordedProgram:
+    """One memoized factory product: the guarded dispatch entry plus the
+    raw trace body and the abstract example calling convention recorded
+    on first execution. ``functools.lru_cache`` could report stats but
+    never LIST its entries — which left the packed sharded fits with no
+    re-trace surface for the program auditor (``observability.
+    ProgramHandle``); this wrapper is that surface."""
+
+    __slots__ = ("dispatch", "trace_body", "jit_fn", "mesh", "example")
+
+    def __init__(self, dispatch, trace_body, jit_fn, mesh):
+        self.dispatch = dispatch
+        self.trace_body = trace_body
+        self.jit_fn = jit_fn
+        self.mesh = mesh
+        self.example = None
+
+    def __call__(self, *args):
+        # One None-check per dispatch on the steady path — this wrapper
+        # sits on the dispatch-lean packed-fit hot loop, so recording
+        # happens exactly once (shape/dtype metadata, no device read).
+        if self.example is None:
+            self.example = abstract_specs(args)
+        return self.dispatch(*args)
+
+
+class _EnumerableFactory:
+    """Memoizing decorator for the jit factories with the
+    ``cache_info()``/``cache_clear()`` surface of ``functools.lru_cache``
+    (the observability trace-probe and the pallas tests use both) PLUS
+    entry enumeration — ``entries()`` yields ``(key, product)`` pairs so
+    the program auditor can re-trace every cached fit program without a
+    private import. Builds serialize on one lock (factory builds are
+    rare trace-time events; a double-build would strand replay stats)."""
+
+    def __init__(self, builder):
+        self._builder = builder
+        self._entries: dict = {}
+        self._hits = 0
+        self._misses = 0
+        self._lock = threading.Lock()
+        functools.update_wrapper(self, builder)
+
+    def __call__(self, *key):
+        with self._lock:
+            hit = self._entries.get(key)
+            if hit is not None:
+                self._hits += 1
+                return hit
+            self._misses += 1
+            product = self._builder(*key)
+            self._entries[key] = product
+            return product
+
+    def entries(self) -> list:
+        with self._lock:
+            return list(self._entries.items())
+
+    def cache_info(self) -> _CacheInfo:
+        with self._lock:
+            return _CacheInfo(self._hits, self._misses, None,
+                              len(self._entries))
+
+    def cache_clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+            self._hits = 0
+            self._misses = 0
 
 
 def pad_rows(X: np.ndarray, y: np.ndarray, mask: np.ndarray, multiple: int):
@@ -48,7 +129,7 @@ def _gram_single(X, y, mask):
     return augmented_gram(X, y, mask)
 
 
-@functools.lru_cache(maxsize=None)
+@_EnumerableFactory
 def _gram_sharded_fn(mesh: Mesh):
     """Build (once per mesh) the jitted sharded Gramian: local matmul + psum."""
 
@@ -59,7 +140,9 @@ def _gram_sharded_fn(mesh: Mesh):
         local, mesh=mesh,
         in_specs=(P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS)),
         out_specs=P())
-    return serialize_collectives(jax.jit(sharded), mesh)
+    jitted = jax.jit(sharded)
+    return _RecordedProgram(serialize_collectives(jitted, mesh), sharded,
+                            jitted, mesh)
 
 
 def _resolve_solve_A(solver: str, max_iter: int, tol: float,
@@ -145,7 +228,7 @@ def place_packed(Z, mesh: Optional[Mesh]):
     return jax.device_put(Z, NamedSharding(mesh, P(DATA_AXIS)))
 
 
-@functools.lru_cache(maxsize=None)
+@_EnumerableFactory
 def fused_linear_fit_packed(mesh: Optional[Mesh], solver: str, max_iter: int,
                             tol: float, fit_intercept: bool,
                             standardization: bool):
@@ -195,24 +278,92 @@ def fused_linear_fit_packed(mesh: Optional[Mesh], solver: str, max_iter: int,
     # overlapping psum executions interleave their participant threads on
     # XLA:CPU and deadlock — the exact workload a concurrent QueryServer
     # produces. Identity wrapper (zero cost) off-mesh.
-    return serialize_collectives(jax.jit(fit), mesh)
+    jitted = jax.jit(fit)
+    return _RecordedProgram(serialize_collectives(jitted, mesh), fit,
+                            jitted, mesh)
+
+
+def _factory_program_key(name: str, key: tuple) -> str:
+    """Stable program key for one factory entry: factory name + the memo
+    key with the mesh summarized structurally (axis names + sizes, not
+    device object reprs)."""
+    parts = []
+    for k in key:
+        if isinstance(k, Mesh):
+            axes = ",".join(f"{a}:{n}" for a, n in
+                            zip(k.axis_names, k.devices.shape))
+            parts.append(f"mesh({axes})")
+        else:
+            parts.append(repr(k))
+    return f"{name}({', '.join(parts)})"
 
 
 def fit_factory_cache_stats() -> dict:
-    """Registry callback (observability.CACHES): lru_cache introspection
-    of the packed/sharded jit factories — the fit-path entries of
+    """Registry callback (observability.CACHES): memo introspection of
+    the packed/sharded jit factories — the fit-path entries of
     ``session.cache_report()``. ``hits`` are factory replays (no new
     trace+compile); ``misses`` are cold builds."""
-    out: dict = {"kind": "lru_cache jit factories (fused linear fit)"}
+    out: dict = {"kind": "memoized jit factories (fused linear fit)"}
     for name, factory in (("fused_linear_fit_packed",
                            fused_linear_fit_packed),
                           ("gram_sharded", _gram_sharded_fn)):
         try:
             info = factory.cache_info()
             out[name] = {"size": info.currsize, "hits": info.hits,
-                         "misses": info.misses}
+                         "misses": info.misses,
+                         "entries": [
+                             {"program_key": _factory_program_key(name, k)}
+                             for k, _ in factory.entries()]}
         except Exception as e:
             out[name] = {"error": str(e)}
+    return out
+
+
+def fit_program_handles() -> list:
+    """Registry callback (CACHES.register_programs): one traceable
+    handle per cached packed/sharded fit program that has executed.
+    ``guarded=True`` by construction — every product of these factories
+    routes dispatch through ``mesh.serialize_collectives`` — so the
+    collective-topology detector can cross-check the jaxpr's collectives
+    against the mesh AND the guard wrapping in one place."""
+    from ..utils import observability as _obs
+
+    out = []
+    for name, factory in (("fused_linear_fit_packed",
+                           fused_linear_fit_packed),
+                          ("gram_sharded", _gram_sharded_fn)):
+        for key, rec in factory.entries():
+            if rec.example is None:
+                continue
+            # Scale only the ROW-indexed inputs (the widest leading dim
+            # = the shared row count): hyperparameter vectors and other
+            # small fixed-shape args keep their calling convention.
+            # Two factors (x2/x4) give the retrace detector a pair of
+            # FRESH traces — jax may serve the recorded shape from a
+            # trace cache predating a config flip (pallas mode).
+            leaves = [s for s in jax.tree_util.tree_leaves(rec.example)
+                      if hasattr(s, "shape") and s.shape]
+            rows = max((s.shape[0] for s in leaves), default=0)
+
+            def scaled(factor):
+                return jax.tree_util.tree_map(
+                    lambda s: jax.ShapeDtypeStruct(
+                        (s.shape[0] * factor,) + tuple(s.shape[1:]),
+                        s.dtype)
+                    if hasattr(s, "shape") and s.shape
+                    and s.shape[0] == rows else s, rec.example)
+            # NO expected/observed trace accounting here: the jit entry
+            # legitimately retraces on input SHARDING layout (row-sharded
+            # vs replicated placements of the same shapes — exactly what
+            # the resilience fallback rungs produce), which the
+            # shape-signature recorder cannot observe. The retrace
+            # detector's variant re-trace still covers shape stability.
+            meta: dict = {}
+            out.append(_obs.ProgramHandle(
+                "fit.factories", _factory_program_key(name, key),
+                rec.trace_body, args=rec.example,
+                variants={"bucket": [(scaled(2), {}), (scaled(4), {})]},
+                mesh=rec.mesh, guarded=True, meta=meta))
     return out
 
 
@@ -220,6 +371,7 @@ def _register_cache_stats() -> None:
     from ..utils import observability as _obs
 
     _obs.CACHES.register("fit.factories", fit_factory_cache_stats)
+    _obs.CACHES.register_programs("fit.factories", fit_program_handles)
 
 
 _register_cache_stats()

@@ -251,12 +251,28 @@ class TpuSession:
                     ("spark.explain.memory", "explain_memory"),
                     ("spark.explain.caches", "explain_caches"),
                     ("spark.serve.enabled", "serve_enabled"),
+                    ("spark.audit.enabled", "audit_enabled"),
                     ("spark.ingest.streaming", "ingest_streaming")):
                 v = str(self.conf.get(conf_key, "")).lower()
                 if v in _CONF_FALSE:
                     _set(attr, False)
                 elif v in _CONF_TRUE:
                     _set(attr, True)
+            # dqaudit thresholds (analysis/program/), session-scoped like
+            # everything above:
+            #     .config("spark.audit.enabled", "false")  # no est peak
+            #     .config("spark.audit.memoryFraction", 0.8)
+            #     .config("spark.audit.deviceBudget", 8 << 30)  # bytes
+            #     .config("spark.audit.constBytes", 65536)
+            if "spark.audit.memoryFraction" in self.conf:
+                _set("audit_memory_fraction",
+                     float(self.conf["spark.audit.memoryFraction"]))
+            if "spark.audit.deviceBudget" in self.conf:
+                _set("audit_device_budget",
+                     int(self.conf["spark.audit.deviceBudget"]))
+            if "spark.audit.constBytes" in self.conf:
+                _set("audit_const_bytes",
+                     int(self.conf["spark.audit.constBytes"]))
             # Streaming-ingest tuning (frame/native_csv.py), session-scoped
             # like everything above:
             #     .config("spark.ingest.streaming", "false") # legacy one-shot
@@ -366,6 +382,27 @@ class TpuSession:
         from .utils import observability as _obs
 
         return _obs.cache_report()
+
+    def audit_report(self) -> dict:
+        """dqaudit over every cached program of this process
+        (``analysis/program``): the four jaxpr-level detectors —
+        static-memory bound, hidden-sync (callback/const capture),
+        collective-topology, retrace-hazard — run by abstract evaluation
+        (zero compiles, zero device execution, zero counted host syncs).
+        Returns findings + per-program facts (``est_peak_bytes``,
+        structural signature, collective/callback counts). Strictly
+        on-demand: the audit package imports only when this is called.
+        ``spark.audit.enabled=false`` makes it refuse."""
+        from .config import config as _cfg
+
+        if not _cfg.audit_enabled:
+            return {"enabled": False, "clean": None, "findings": [],
+                    "programs": 0}
+        from .analysis.program import audit_report as _audit_report
+
+        doc = _audit_report()
+        doc["enabled"] = True
+        return doc
 
     def _init_faults(self) -> None:
         """Install the fault-injection plan (``utils.faults``) from session

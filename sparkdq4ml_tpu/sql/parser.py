@@ -1494,16 +1494,21 @@ class PlanNode:
     """One operator of the structural query plan — the per-operator node
     tree ``plan_summary``'s flat chain is derived from, and the carrier
     of EXPLAIN ANALYZE's measured stats (``stats`` stays empty on the
-    un-analyzed path). ``children[0]`` is the operator's input; a Join's
-    ``children[1]`` is the probe-side Scan."""
+    un-executed ``plan_tree`` output; EXPLAIN adds the static
+    ``est_peak`` column, ANALYZE the measured schema). ``children[0]``
+    is the operator's input; a Join's ``children[1]`` is the probe-side
+    Scan. ``meta`` carries structural facts the static-memory estimator
+    needs (Scan view name, the FusedStage's parsed query) — never
+    rendered."""
 
-    __slots__ = ("op", "detail", "children", "stats")
+    __slots__ = ("op", "detail", "children", "stats", "meta")
 
     def __init__(self, op: str, detail: str = "", children=()):
         self.op = op
         self.detail = detail
         self.children = list(children)
         self.stats: dict = {}
+        self.meta: dict = {}
 
     @property
     def label(self) -> str:
@@ -1547,8 +1552,9 @@ class PlanNode:
         return out
 
     def render(self, analyze: bool = False) -> str:
-        """Indented operator tree; with ``analyze`` each node's measured
-        stats print as a logfmt suffix."""
+        """Indented operator tree; any annotated stats (the static
+        ``est_peak`` column on EXPLAIN, the full measured schema on
+        ANALYZE) print as a logfmt suffix."""
         from ..utils.logging import format_kv
 
         lines: list[str] = []
@@ -1556,7 +1562,7 @@ class PlanNode:
         def emit(node, depth):
             pad = "" if depth == 0 else "   " * (depth - 1) + "+- "
             line = pad + node.label
-            if analyze and node.stats:
+            if node.stats:
                 # unknowns render as "-" so every node shows the full
                 # stat schema (format_kv would elide None)
                 stats = {k: ("-" if node.stats[k] is None
@@ -1585,7 +1591,9 @@ def plan_tree(q: Query) -> PlanNode:
             return PlanNode("Scan", "[(subquery)]",
                             [plan_tree(view.query)])
         if isinstance(view, str):
-            return PlanNode("Scan", f"[{view}]")
+            n = PlanNode("Scan", f"[{view}]")
+            n.meta["view"] = view      # static-memory estimator lookup
+            return n
         return PlanNode("Scan", "[(subquery)]")  # OneRowRelation et al.
 
     node = scan_node(q.view)
@@ -1595,6 +1603,7 @@ def plan_tree(q: Query) -> PlanNode:
     if _structurally_fusable(q):
         node = PlanNode("FusedStage",
                         f"(Project[{len(q.items)}] <- Filter)", [node])
+        node.meta["query"] = q         # abstract-traceable stage
     else:
         if q.where is not None:
             node = PlanNode("Filter", "", [node])
@@ -1812,7 +1821,10 @@ def _cache_lines(before: dict, after: dict) -> list[str]:
                           for k in ("hits", "compiles", "builds"))
             if not touched:
                 continue
-            detail = {k: v for k, v in e.items() if k != "key"}
+            # program_key duplicates key= (it is the un-truncated form
+            # the program auditor addresses) — one rendering is enough
+            detail = {k: v for k, v in e.items()
+                      if k not in ("key", "program_key")}
             from ..utils.logging import format_kv
 
             lines.append(f"  program {format_kv(**detail)} key="
@@ -1836,8 +1848,32 @@ def _execute_explain(body: str, cat, analyze: bool):
     tree, kind, payload = _parse_explain_tree(body)
     _obs.current_span().set(
         plan=("ExplainAnalyze" if analyze else "Explain"))
+    # Static memory bounds (dqaudit tier, analysis/program/static_mem):
+    # the `est peak` column — computed BEFORE execution from shape
+    # metadata + one abstract trace of the fused stage (zero compiles,
+    # zero device work), where EXPLAIN ANALYZE only measures after the
+    # fact. Gated on spark.audit.enabled; the audit package imports
+    # lazily so the default query path never loads it.
+    budget_line = None
+    if _cfg.audit_enabled:
+        from ..analysis.program import static_mem as _static_mem
+        from ..analysis.program.detectors import audit_budget_bytes
+
+        root_est = _static_mem.annotate_plan(tree, cat)
+        if root_est is not None:
+            # the SAME budget policy as the audit-memory detector —
+            # EXPLAIN and session.audit_report() must agree on one plan
+            budget = audit_budget_bytes(int(_cfg.audit_device_budget))
+            if budget is not None and \
+                    root_est > _cfg.audit_memory_fraction * budget:
+                budget_line = (
+                    f"!! est peak {root_est} bytes exceeds "
+                    f"{_cfg.audit_memory_fraction:g} x device limit "
+                    f"{budget} bytes (spark.audit.memoryFraction)")
     if not analyze:
         text = "== Physical Plan ==\n" + tree.render()
+        if budget_line:
+            text += "\n" + budget_line
         return Frame({"plan": [text]})
 
     import time as _time
@@ -1888,6 +1924,8 @@ def _execute_explain(body: str, cat, analyze: bool):
         if cl:
             lines.append("== Caches ==")
             lines.extend(cl)
+    if budget_line:
+        lines.append(budget_line)
     return Frame({"plan": ["\n".join(lines)]})
 
 

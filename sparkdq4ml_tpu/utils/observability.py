@@ -684,19 +684,85 @@ def query_stats(sample_memory: bool = True):
 # ---------------------------------------------------------------------------
 
 
+class ProgramHandle:
+    """One enumerable cached program: a stable ``program_key`` plus a way
+    to RE-TRACE it abstractly (``jax.make_jaxpr`` over the recorded
+    abstract argument specs — zero compiles, zero device execution).
+
+    This is the contract between every compiled-program cache and the
+    jaxpr-level auditor (``analysis/program``, the dqaudit tier) and the
+    future cost-based optimizer: without it, enumerating "every program
+    the engine would replay in serving" needs private imports into four
+    modules. Producers register a zero-arg enumerator via
+    :meth:`CacheRegistry.register_programs`.
+
+    Fields:
+
+    * ``cache`` — the producer's registry name (``pipeline``/``grouped``/
+      ``solver``/``fit.factories``);
+    * ``program_key`` — stable identity, identical to the
+      ``program_key`` field of the matching ``report()`` entry;
+    * ``fn`` / ``args`` / ``kwargs`` — the traceable callable and its
+      abstract example arguments (``jax.ShapeDtypeStruct`` leaves for
+      arrays; concrete host scalars where values are part of the calling
+      convention). ``fn`` is the UN-counted trace body where the
+      producer tracks replay counters — auditing must not distort stats;
+    * ``variants`` — name → ``(args, kwargs)`` (compared against the
+      base trace) or a LIST of such pairs (compared among themselves —
+      the form real producers use, e.g. bucket x2 vs x4, so both traces
+      are fresh under the current config rather than one being jax's
+      cached trace of the recorded shape): alternates the producer
+      declares structurally equivalent; the retrace-hazard detector
+      re-traces each and compares structural jaxpr hashes;
+    * ``mesh`` / ``guarded`` — the installed mesh (``None`` off-mesh)
+      and whether dispatch routes through the process-wide collective
+      guard (``parallel.mesh.serialize_collectives``);
+    * ``meta`` — free-form producer facts (``expected_traces`` /
+      ``observed_traces`` for the retrace detector, ``expect_no_consts``
+      for the literal-hoisting check, …).
+    """
+
+    __slots__ = ("cache", "program_key", "fn", "args", "kwargs",
+                 "variants", "mesh", "guarded", "meta")
+
+    def __init__(self, cache: str, program_key: str, fn,
+                 args: tuple = (), kwargs: Optional[dict] = None,
+                 variants: Optional[dict] = None, mesh=None,
+                 guarded: Optional[bool] = None,
+                 meta: Optional[dict] = None):
+        self.cache = cache
+        self.program_key = str(program_key)
+        self.fn = fn
+        self.args = tuple(args)
+        self.kwargs = dict(kwargs or {})
+        self.variants = dict(variants or {})
+        self.mesh = mesh
+        self.guarded = guarded
+        self.meta = dict(meta or {})
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return (f"ProgramHandle({self.cache!r}, "
+                f"{self.program_key[:60]!r}, variants="
+                f"{sorted(self.variants)})")
+
+
 class CacheRegistry:
     """One registry every compiled-program cache reports into: the
     pipeline compiler (``ops/compiler.py``), the grouped-execution engine
     (``ops/segments.py``), the solver jit entry points
-    (``models/solvers.py``), and the packed-fit lru factory
+    (``models/solvers.py``), and the packed-fit factories
     (``parallel/distributed.py``) each register a zero-arg stats callable
     under a stable name. ``report()`` (surfaced as
     ``session.cache_report()``) returns the merged view; EXPLAIN ANALYZE
     diffs two reports to print one line per cached program the query
-    touched."""
+    touched. Producers additionally register a program enumerator
+    (:meth:`register_programs`) yielding :class:`ProgramHandle` records —
+    the re-trace surface the jaxpr auditor (``analysis/program``) and the
+    future cost-based optimizer consume."""
 
     def __init__(self):
         self._providers: dict[str, Callable[[], dict]] = {}
+        self._program_providers: dict[str, Callable[[], list]] = {}
         self._lock = threading.Lock()
 
     def register(self, name: str, stats_fn: Callable[[], dict]) -> None:
@@ -705,9 +771,35 @@ class CacheRegistry:
         with self._lock:
             self._providers[name] = stats_fn
 
+    def register_programs(self, name: str,
+                          programs_fn: Callable[[], list]) -> None:
+        """Register a zero-arg enumerator returning the producer's
+        currently-cached programs as :class:`ProgramHandle` records.
+        Idempotent like :meth:`register`."""
+        with self._lock:
+            self._program_providers[name] = programs_fn
+
     def unregister(self, name: str) -> None:
         with self._lock:
             self._providers.pop(name, None)
+            self._program_providers.pop(name, None)
+
+    def programs(self) -> tuple[list, dict]:
+        """Every registry-enumerable cached program, merged across
+        producers. Returns ``(handles, errors)`` where ``errors`` maps a
+        producer name to the exception string its enumerator raised —
+        surfaced (never swallowed) so an audit can report partial
+        enumeration instead of silently under-covering."""
+        with self._lock:
+            items = list(self._program_providers.items())
+        handles: list = []
+        errors: dict[str, str] = {}
+        for name, fn in sorted(items):
+            try:
+                handles.extend(fn())
+            except Exception as e:
+                errors[name] = f"{type(e).__name__}: {e}"
+        return handles, errors
 
     def names(self) -> list[str]:
         with self._lock:

@@ -1,0 +1,734 @@
+"""dqaudit — jaxpr-level program auditor (ISSUE 9).
+
+Every detector is proven LIVE by a seeded offender (through the
+``scripts/check_static.py --tier program`` CLI, which must exit 1),
+proven QUIET on healthy programs, and the whole tier is proven clean on
+the real tree through a fresh-process CLI run over the headline
+workload. The accuracy pin asserts the static peak bound brackets the
+measured peak on the headline DQ query within a documented slack
+factor; the hot-path pin asserts the audit package is never imported by
+the default query path.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+import jax
+import numpy as np
+import pytest
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+sys.path.insert(0, REPO)
+
+import sparkdq4ml_tpu as dq  # noqa: E402
+from sparkdq4ml_tpu.analysis.program import (audit_programs,  # noqa: E402
+                                             get_detectors)
+from sparkdq4ml_tpu.analysis.program import jaxpr_tools as JT  # noqa: E402
+from sparkdq4ml_tpu.analysis.program.detectors import \
+    AuditContext  # noqa: E402
+from sparkdq4ml_tpu.config import config  # noqa: E402
+from sparkdq4ml_tpu.frame.frame import Frame  # noqa: E402
+from sparkdq4ml_tpu.utils import observability as obs  # noqa: E402
+from sparkdq4ml_tpu.utils import profiling  # noqa: E402
+from sparkdq4ml_tpu.utils.observability import ProgramHandle  # noqa: E402
+
+from conftest import dataset_path  # noqa: E402
+
+pytestmark = pytest.mark.program_audit
+
+S = jax.ShapeDtypeStruct
+SCRIPT = os.path.join(REPO, "scripts", "check_static.py")
+_ENV = {**os.environ, "JAX_PLATFORMS": "cpu"}
+
+
+def _f32(*shape):
+    return S(tuple(shape), np.float32)
+
+
+def _handle(fn, *args, **kw):
+    return ProgramHandle(kw.pop("cache", "test"),
+                         kw.pop("program_key", "test-plan"), fn,
+                         args=args, **kw)
+
+
+# ---------------------------------------------------------------------------
+# jaxpr tools: signature + static peak bound
+# ---------------------------------------------------------------------------
+
+
+class TestJaxprTools:
+    def test_signature_stable_across_buckets(self):
+        fn = jax.jit(lambda x: (x * 2.0 + 1.0).sum())
+        a = JT.structural_signature(JT.trace(fn, (_f32(8),)))
+        b = JT.structural_signature(JT.trace(fn, (_f32(1024),)))
+        assert a == b
+
+    def test_signature_differs_on_structure(self):
+        a = JT.structural_signature(JT.trace(lambda x: x + 1.0,
+                                             (_f32(8),)))
+        b = JT.structural_signature(JT.trace(lambda x: x * 2.0,
+                                             (_f32(8),)))
+        assert a != b
+
+    def test_signature_sees_weak_type(self):
+        f = lambda x, lit: x * lit  # noqa: E731
+        weak = JT.structural_signature(JT.trace(f, (_f32(8), 2.0)))
+        strong = JT.structural_signature(
+            JT.trace(f, (_f32(8), np.float32(2.0))))
+        assert weak != strong   # the aval weak flag is structural
+
+    def test_peak_bytes_simple_program(self):
+        # x:f32[8] in, one add out: 32 entry + 32 live at the eqn
+        closed = JT.trace(lambda x: x + 1.0, (_f32(8),))
+        assert JT.peak_bytes(closed) == 64
+
+    def test_peak_bytes_liveness_frees_dead_operands(self):
+        # a chain a->b->c->d of same-size ops: peak stays ~2 buffers,
+        # far below the 4-buffer no-free upper bound
+        def chain(x):
+            a = x + 1.0
+            b = a * 2.0
+            c = b - 3.0
+            return c / 4.0
+
+        closed = JT.trace(chain, (_f32(1024),))
+        peak = JT.peak_bytes(closed)
+        assert 2 * 4096 <= peak <= 3 * 4096
+
+    def test_peak_bytes_counts_captured_consts_once(self):
+        big = np.arange(100, dtype=np.float32)          # 400 bytes
+        closed = JT.trace(lambda x: x + jax.numpy.asarray(big),
+                          (_f32(100),))
+        # entry = input 400 + const 400; the add allocates 400 more —
+        # 1200, NOT 1600 (constvars and closed.consts are the same
+        # buffers and must not both be charged)
+        assert JT.peak_bytes(closed) == 1200
+
+    def test_peak_bytes_recurses_into_jitted_bodies(self):
+        inner = jax.jit(lambda x: x @ x.T)
+        closed = JT.trace(lambda x: inner(x).sum(), (_f32(64, 64),))
+        assert JT.peak_bytes(closed) >= 2 * 64 * 64 * 4
+
+    def test_collective_and_callback_scans(self):
+        from jax.sharding import PartitionSpec as P
+
+        from sparkdq4ml_tpu.parallel.mesh import (DATA_AXIS, make_mesh,
+                                                  shard_map)
+
+        mesh = make_mesh(4)
+        sm = shard_map(lambda x: jax.lax.psum(x.sum(), DATA_AXIS),
+                       mesh=mesh, in_specs=(P(DATA_AXIS),),
+                       out_specs=P())
+        colls = JT.collective_eqns(JT.trace(sm, (_f32(8),)))
+        assert colls == [("psum", (DATA_AXIS,))]
+
+        def cb(x):
+            return jax.pure_callback(
+                lambda a: np.asarray(a) * 2, _f32(4), x)
+
+        assert JT.callback_eqns(JT.trace(cb, (_f32(4),))) \
+            == [("pure_callback", "")] or \
+            JT.callback_eqns(JT.trace(cb, (_f32(4),)))[0][0] \
+            == "pure_callback"
+
+
+# ---------------------------------------------------------------------------
+# detectors: seeded offender + sanctioned pair each
+# ---------------------------------------------------------------------------
+
+
+class TestStaticMemoryDetector:
+    def test_over_budget_plan_flagged(self):
+        h = _handle(lambda x: x @ x.T + 1.0, _f32(512, 512))
+        res = audit_programs([h], ctx=AuditContext(device_budget=1 << 16))
+        assert [f.rule for f in res.findings] == ["audit-memory"]
+        assert "exceeds" in res.findings[0].message
+
+    def test_fitting_plan_quiet_and_bound_recorded(self):
+        h = _handle(lambda x: x + 1.0, _f32(8))
+        ctx = AuditContext(device_budget=1 << 20)
+        res = audit_programs([h], ctx=ctx)
+        assert res.findings == []
+        assert res.program_stats["test-plan"]["est_peak_bytes"] == 64
+
+    def test_no_budget_on_cpu_is_advisory_only(self):
+        # XLA:CPU exposes no allocator bytes_limit; with no explicit
+        # budget the bound is computed but not gated
+        h = _handle(lambda x: x @ x.T, _f32(256, 256))
+        res = audit_programs([h], ctx=AuditContext(device_budget=0))
+        assert res.findings == []
+        assert res.program_stats["test-plan"]["est_peak_bytes"] > 0
+
+
+class TestHiddenSyncDetector:
+    def test_pure_callback_in_jitted_body_flagged(self):
+        def prog(x):
+            return jax.pure_callback(
+                lambda a: np.asarray(a) * 2, _f32(4), x)
+
+        res = audit_programs([_handle(prog, _f32(4))],
+                             ctx=AuditContext())
+        assert "audit-sync" in [f.rule for f in res.findings]
+        assert "pure_callback" in res.findings[0].message
+
+    def test_debug_print_flagged(self):
+        def prog(x):
+            jax.debug.print("x={x}", x=x)
+            return x + 1.0
+
+        res = audit_programs([_handle(prog, _f32(4))],
+                             ctx=AuditContext())
+        assert any(f.rule == "audit-sync" and "callback" in f.message
+                   for f in res.findings)
+
+    def test_large_const_capture_flagged_small_quiet(self):
+        big = np.arange(4096, dtype=np.float32)     # 16 KiB
+        res = audit_programs(
+            [_handle(lambda x: x + jax.numpy.asarray(big), _f32(4096))],
+            ctx=AuditContext(const_bytes=4096))
+        assert any(f.rule == "audit-sync"
+                   and "host constant capture" in f.message
+                   for f in res.findings)
+        res = audit_programs(
+            [_handle(lambda x: x + jax.numpy.asarray(big), _f32(4096))],
+            ctx=AuditContext(const_bytes=1 << 20))
+        assert res.findings == []
+
+
+class TestCollectiveTopologyDetector:
+    def _psum_program(self, mesh):
+        from jax.sharding import PartitionSpec as P
+
+        from sparkdq4ml_tpu.parallel.mesh import DATA_AXIS, shard_map
+
+        return shard_map(lambda x: jax.lax.psum(x.sum(), DATA_AXIS),
+                         mesh=mesh, in_specs=(P(DATA_AXIS),),
+                         out_specs=P())
+
+    def test_unguarded_inner_psum_flagged(self):
+        from sparkdq4ml_tpu.parallel.mesh import make_mesh
+
+        mesh = make_mesh(4)
+        h = _handle(self._psum_program(mesh), _f32(8),
+                    mesh=mesh, guarded=False)
+        res = audit_programs([h], ctx=AuditContext())
+        assert [f.rule for f in res.findings] == ["audit-collective"]
+        assert "collective_guard" in res.findings[0].message
+
+    def test_undeclared_guard_flagged_too(self):
+        from sparkdq4ml_tpu.parallel.mesh import make_mesh
+
+        mesh = make_mesh(4)
+        h = _handle(self._psum_program(mesh), _f32(8), mesh=mesh)
+        res = audit_programs([h], ctx=AuditContext())
+        assert [f.rule for f in res.findings] == ["audit-collective"]
+
+    def test_axis_mismatch_flagged(self):
+        from sparkdq4ml_tpu.parallel.mesh import make_mesh
+
+        data_mesh = make_mesh(4)
+        wrong_mesh = make_mesh(4, axis_name="model")
+        h = _handle(self._psum_program(data_mesh), _f32(8),
+                    mesh=wrong_mesh, guarded=True)
+        res = audit_programs([h], ctx=AuditContext())
+        assert any("cannot bind" in f.message for f in res.findings)
+
+    def test_guarded_program_quiet(self):
+        from sparkdq4ml_tpu.parallel.mesh import make_mesh
+
+        mesh = make_mesh(4)
+        h = _handle(self._psum_program(mesh), _f32(8),
+                    mesh=mesh, guarded=True)
+        res = audit_programs([h], ctx=AuditContext())
+        assert res.findings == []
+
+    def test_collective_free_program_ignores_guard_state(self):
+        h = _handle(lambda x: x + 1.0, _f32(8), guarded=False)
+        res = audit_programs([h], ctx=AuditContext())
+        assert res.findings == []
+
+
+class TestRetraceHazardDetector:
+    def test_shape_specialized_plan_flagged(self):
+        def shapey(x):
+            return x * 2.0 if x.shape[0] > 8 else x + 1.0
+
+        h = _handle(shapey, _f32(8),
+                    variants={"bucket": ((_f32(16),), {})})
+        res = audit_programs([h], ctx=AuditContext())
+        assert [f.rule for f in res.findings] == ["audit-retrace"]
+        assert "bucket" in res.findings[0].message
+
+    def test_shape_specialization_between_fresh_variants_flagged(self):
+        # the list form real producers declare: two FRESH traces
+        # compared against each other (stale-trace-cache immune)
+        def shapey(x):
+            return x * 2.0 if x.shape[0] > 16 else x + 1.0
+
+        h = _handle(shapey, _f32(8),
+                    variants={"bucket": [((_f32(16),), {}),
+                                         ((_f32(32),), {})]})
+        res = audit_programs([h], ctx=AuditContext())
+        assert [f.rule for f in res.findings] == ["audit-retrace"]
+        assert "bucket" in res.findings[0].message
+
+    def test_weak_type_leak_flagged(self):
+        def weaky(x, lit):
+            aval = getattr(lit, "aval", None)
+            if aval is not None and aval.weak_type:
+                return x + lit
+            return x * 2.0
+
+        h = _handle(weaky, _f32(8), 3.0,
+                    variants={"weak": ((_f32(8), np.float32(3.0)), {})})
+        res = audit_programs([h], ctx=AuditContext())
+        assert [f.rule for f in res.findings] == ["audit-retrace"]
+
+    def test_excess_observed_traces_flagged(self):
+        h = _handle(lambda x: x + 1.0, _f32(8),
+                    meta={"expected_traces": 2, "observed_traces": 5})
+        res = audit_programs([h], ctx=AuditContext())
+        assert [f.rule for f in res.findings] == ["audit-retrace"]
+        assert "5 observed" in res.findings[0].message
+
+    def test_literal_hoisting_regression_flagged(self):
+        mk = lambda key: _handle(  # noqa: E731
+            lambda x: x + 1.0, _f32(8), cache="pipeline",
+            program_key=key,
+            meta={"dedup_key": "f|F:B(>,C('p'),V(#))"})
+        res = audit_programs(
+            [mk("f|F:B(>,C('p'),V(3))"), mk("f|F:B(>,C('p'),V(4))")],
+            ctx=AuditContext())
+        rules = [f.rule for f in res.findings]
+        assert rules == ["audit-retrace", "audit-retrace"]
+        assert "literal" in res.findings[0].message
+
+    def test_variant_trace_failure_is_a_finding(self):
+        h = _handle(lambda x: x + 1.0, _f32(8),
+                    variants={"bucket": ((_f32(16), _f32(2)), {})})
+        res = audit_programs([h], ctx=AuditContext())
+        assert [f.rule for f in res.findings] == ["audit-retrace"]
+        assert "raised" in res.findings[0].message
+
+    def test_stable_plan_quiet(self):
+        h = _handle(lambda x: (x * 2.0).sum(), _f32(8),
+                    variants={"bucket": ((_f32(16),), {})},
+                    meta={"expected_traces": 2, "observed_traces": 2})
+        res = audit_programs([h], ctx=AuditContext())
+        assert res.findings == []
+
+
+# ---------------------------------------------------------------------------
+# driver: skip semantics, registry enumeration, zero counted syncs
+# ---------------------------------------------------------------------------
+
+
+class TestAuditDriver:
+    def test_untraceable_handle_skips_not_fails(self):
+        def broken(x):
+            raise RuntimeError("no trace for you")
+
+        res = audit_programs([_handle(broken, _f32(4)),
+                              _handle(lambda x: x + 1.0, _f32(4))],
+                             ctx=AuditContext())
+        assert res.findings == []
+        assert res.programs == 1
+        assert len(res.skipped) == 1 and "no trace" in res.skipped[0][1]
+
+    def test_enumerator_errors_surface(self):
+        def bad_provider():
+            raise RuntimeError("enumerator broke")
+
+        obs.CACHES.register_programs("test.bad", bad_provider)
+        try:
+            res = audit_programs()
+            assert "test.bad" in res.enum_errors
+        finally:
+            obs.CACHES.unregister("test.bad")
+
+    def test_registry_enumerates_executed_plans(self, session):
+        Frame({"a": [1.0, 2.0, 3.0, 4.0]}).create_or_replace_temp_view(
+            "audit_t")
+        session.sql("SELECT a * 3 AS b FROM audit_t WHERE a > 1"
+                    ).to_pydict()
+        handles, errors = obs.CACHES.programs()
+        assert errors == {}
+        pipe = [h for h in handles if h.cache == "pipeline"]
+        assert pipe, "pipeline plan not enumerable"
+        report = obs.cache_report()
+        keys = {e["program_key"]
+                for e in report["pipeline"]["entries"]}
+        assert all(h.program_key in keys for h in pipe)
+        # every enumerated handle re-traces abstractly
+        for h in pipe:
+            JT.trace(h.fn, h.args, h.kwargs)
+
+    def test_audit_performs_zero_counted_syncs_and_compiles(self, session):
+        Frame({"a": [1.0, 2.0, 3.0, 4.0]}).create_or_replace_temp_view(
+            "audit_s")
+        session.sql("SELECT a + 1 AS b FROM audit_s WHERE a > 2"
+                    ).to_pydict()
+        before = profiling.counters.snapshot()
+        res = audit_programs()
+        after = profiling.counters.snapshot()
+        for key in ("frame.host_sync", "pipeline.compile",
+                    "grouped.compile", "pipeline.flush"):
+            assert after.get(key, 0) == before.get(key, 0), key
+        assert res.programs >= 1
+
+    def test_session_audit_report_shape_and_conf_gate(self, session):
+        Frame({"a": [1.0, 2.0]}).create_or_replace_temp_view("audit_r")
+        session.sql("SELECT a FROM audit_r WHERE a > 1").to_pydict()
+        doc = session.audit_report()
+        assert doc["enabled"] is True
+        assert doc["clean"] in (True, False)
+        assert set(doc["by_detector"]) == {
+            "audit-memory", "audit-sync", "audit-collective",
+            "audit-retrace"}
+        config.audit_enabled = False
+        try:
+            off = session.audit_report()
+            assert off == {"enabled": False, "clean": None,
+                           "findings": [], "programs": 0}
+        finally:
+            config.audit_enabled = True
+
+    def test_audit_conf_session_scoped(self):
+        assert config.audit_memory_fraction == pytest.approx(0.9)
+        s = dq.TpuSession.builder().app_name("audit-conf").master(
+            "local[*]").config("spark.audit.memoryFraction", "0.5"
+                               ).config("spark.audit.deviceBudget",
+                                        str(1 << 20)
+                                        ).config("spark.audit.constBytes",
+                                                 "128").get_or_create()
+        try:
+            assert config.audit_memory_fraction == pytest.approx(0.5)
+            assert config.audit_device_budget == 1 << 20
+            assert config.audit_const_bytes == 128
+        finally:
+            s.stop()
+        assert config.audit_memory_fraction == pytest.approx(0.9)
+        assert config.audit_device_budget == 0
+        assert config.audit_const_bytes == 4096
+
+
+# ---------------------------------------------------------------------------
+# producer coverage: grouped, solver, fit-factory handles
+# ---------------------------------------------------------------------------
+
+
+class TestProducerHandles:
+    def test_grouped_plan_enumerable_and_stable(self, session):
+        Frame({"k": [1, 1, 2, 2], "v": [1.0, 2.0, 3.0, 4.0]}
+              ).create_or_replace_temp_view("audit_g")
+        session.sql("SELECT k, sum(v) s FROM audit_g GROUP BY k"
+                    ).to_pydict()
+        handles, _ = obs.CACHES.programs()
+        grouped = [h for h in handles if h.cache == "grouped"]
+        assert grouped
+        h = grouped[-1]
+        (v2, kw2), (v4, kw4) = h.variants["bucket"]
+        assert JT.structural_signature(JT.trace(h.fn, v2, kw2)) \
+            == JT.structural_signature(JT.trace(h.fn, v4, kw4))
+
+    def test_solver_entry_enumerable(self):
+        from sparkdq4ml_tpu.models import solvers
+
+        A = jax.numpy.eye(4, dtype=jax.numpy.float64) * 3.0
+        solvers.solve(A, 0.1, 0.0, max_iter=5, tol=1e-6,
+                      fit_intercept=True, standardization=True,
+                      solver="auto")
+        handles, _ = obs.CACHES.programs()
+        solver = [h for h in handles if h.cache == "solver"]
+        assert solver
+        res = audit_programs(solver, ctx=AuditContext())
+        assert res.findings == [] and res.programs == len(solver)
+
+    def test_fit_factory_enumerable_with_mesh_and_guard(self, session):
+        from sparkdq4ml_tpu.models import (LinearRegression,
+                                           VectorAssembler)
+
+        df = Frame({"x": [float(i % 7) for i in range(32)],
+                    "y": [float(i) for i in range(32)]})
+        df = df.with_column("label", df.col("y"))
+        df = VectorAssembler(["x"], "features").transform(df)
+        LinearRegression(max_iter=5, reg_param=0.1,
+                         elastic_net_param=1.0).fit(df, mesh=session.mesh)
+        handles, _ = obs.CACHES.programs()
+        fits = [h for h in handles if h.cache == "fit.factories"
+                and h.mesh is not None]
+        assert fits, "sharded fit handle missing"
+        h = fits[-1]
+        assert h.guarded is True
+        colls = JT.collective_eqns(JT.trace(h.fn, h.args, h.kwargs))
+        assert colls, "sharded fit traced without collectives"
+        res = audit_programs(fits, ctx=AuditContext())
+        assert res.findings == []
+
+    def test_factory_memo_keeps_lru_surface(self):
+        from sparkdq4ml_tpu.parallel import distributed
+
+        info = distributed.fused_linear_fit_packed.cache_info()
+        assert hasattr(info, "hits") and hasattr(info, "misses")
+        assert distributed.fused_linear_fit_packed.entries() is not None
+
+
+# ---------------------------------------------------------------------------
+# EXPLAIN est peak (static, pre-execution)
+# ---------------------------------------------------------------------------
+
+
+class TestExplainEstPeak:
+    def _view(self):
+        Frame({"a": [1.0, 2.0, 3.0, 4.0], "k": [1, 1, 2, 2]}
+              ).create_or_replace_temp_view("audit_e")
+
+    def test_explain_renders_est_peak_zero_execution(self, session):
+        self._view()
+        before = profiling.counters.snapshot()
+        out = session.sql(
+            "EXPLAIN SELECT a, a * 2 AS b FROM audit_e WHERE a > 1")
+        after = profiling.counters.snapshot()
+        text = str(out.to_pydict()["plan"][0])
+        assert "est_peak=" in text
+        for line in text.splitlines()[1:]:
+            if any(op in line for op in ("Scan", "FusedStage", "Sort")):
+                assert "est_peak=" in line, line
+        for key in ("pipeline.flush", "pipeline.compile",
+                    "grouped.compile", "frame.host_sync"):
+            assert after.get(key, 0) == before.get(key, 0), key
+
+    def test_est_peak_monotone_up_the_chain(self, session):
+        self._view()
+        import re
+
+        text = str(session.sql(
+            "EXPLAIN SELECT a FROM audit_e WHERE a > 1 ORDER BY a"
+        ).to_pydict()["plan"][0])
+        peaks = [int(m) for m in re.findall(r"est_peak=(\d+)", text)]
+        assert peaks == sorted(peaks, reverse=True)
+
+    def test_budget_warning_line(self, session):
+        self._view()
+        config.audit_device_budget = 8    # absurd: everything overflows
+        try:
+            text = str(session.sql(
+                "EXPLAIN SELECT a FROM audit_e WHERE a > 1"
+            ).to_pydict()["plan"][0])
+        finally:
+            config.audit_device_budget = 0
+        assert "!! est peak" in text
+        assert "spark.audit.memoryFraction" in text
+
+    def test_audit_disabled_removes_est_column(self, session):
+        self._view()
+        config.audit_enabled = False
+        try:
+            text = str(session.sql(
+                "EXPLAIN SELECT a FROM audit_e WHERE a > 1"
+            ).to_pydict()["plan"][0])
+        finally:
+            config.audit_enabled = True
+        assert "est_peak" not in text
+
+    def test_analyze_carries_both_est_and_measured(self, session):
+        self._view()
+        text = str(session.sql(
+            "EXPLAIN ANALYZE SELECT a FROM audit_e WHERE a > 1"
+        ).to_pydict()["plan"][0])
+        line = next(ln for ln in text.splitlines()
+                    if "FusedStage" in ln)
+        assert "est_peak=" in line and "wall_ms=" in line
+
+
+# ---------------------------------------------------------------------------
+# the CLI gate: seeded offenders exit 1; fresh-process clean run
+# ---------------------------------------------------------------------------
+
+
+def _cli_with_offender(handle, detector) -> tuple:
+    """Run the --tier program arm in-process with ``handle`` seeded into
+    the registry; returns (exit_code, captured findings count)."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location("check_static", SCRIPT)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    obs.CACHES.register_programs("test.offender", lambda: [handle])
+    try:
+        rc = mod.main(["--tier", "program", "--no-workload",
+                       "--detectors", detector])
+    finally:
+        obs.CACHES.unregister("test.offender")
+    return rc
+
+
+class TestCheckStaticProgramTier:
+    def test_memory_offender_exits_1(self, capsys):
+        h = _handle(lambda x: x @ x.T, _f32(512, 512))
+        config.audit_device_budget = 1 << 16
+        try:
+            rc = _cli_with_offender(h, "audit-memory")
+        finally:
+            config.audit_device_budget = 0
+        assert rc == 1
+        assert "audit-memory" in capsys.readouterr().out
+
+    def test_sync_offender_exits_1(self, capsys):
+        def prog(x):
+            return jax.pure_callback(
+                lambda a: np.asarray(a) * 2, _f32(4), x)
+
+        assert _cli_with_offender(_handle(prog, _f32(4)),
+                                  "audit-sync") == 1
+        assert "pure_callback" in capsys.readouterr().out
+
+    def test_collective_offender_exits_1(self, capsys):
+        from jax.sharding import PartitionSpec as P
+
+        from sparkdq4ml_tpu.parallel.mesh import (DATA_AXIS, make_mesh,
+                                                  shard_map)
+
+        mesh = make_mesh(4)
+        sm = shard_map(lambda x: jax.lax.psum(x.sum(), DATA_AXIS),
+                       mesh=mesh, in_specs=(P(DATA_AXIS),),
+                       out_specs=P())
+        h = _handle(sm, _f32(8), mesh=mesh, guarded=False)
+        assert _cli_with_offender(h, "audit-collective") == 1
+        assert "collective_guard" in capsys.readouterr().out
+
+    def test_retrace_offender_exits_1(self, capsys):
+        def shapey(x):
+            return x * 2.0 if x.shape[0] > 8 else x + 1.0
+
+        h = _handle(shapey, _f32(8),
+                    variants={"bucket": ((_f32(16),), {})})
+        assert _cli_with_offender(h, "audit-retrace") == 1
+        assert "audit-retrace" in capsys.readouterr().out
+
+    def test_source_tier_preserves_program_baseline_entries(self, tmp_path):
+        """A source-only --update-baseline must not erase grandfathered
+        program-tier entries from the shared baseline file, and a run
+        where the program tier did not run must not call them stale."""
+        import importlib.util
+
+        bl = tmp_path / "bl.json"
+        bl.write_text(json.dumps({"entries": [
+            {"rule": "audit-retrace", "path": "program:pipeline",
+             "fingerprint": "some-plan-key"}]}))
+        spec = importlib.util.spec_from_file_location("check_static",
+                                                      SCRIPT)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        rc = mod.main([REPO, "--tier", "source", "--baseline", str(bl),
+                       "--update-baseline"])
+        assert rc == 0
+        doc = json.loads(bl.read_text())
+        assert {"rule": "audit-retrace", "path": "program:pipeline",
+                "fingerprint": "some-plan-key"} in doc["entries"]
+        # and a plain source-tier run does not report it stale
+        rc = mod.main([REPO, "--tier", "source", "--baseline", str(bl)])
+        assert rc == 0
+
+    def test_whole_tree_clean_through_cli(self):
+        p = subprocess.run(
+            [sys.executable, SCRIPT, "--tier", "program", "--json"],
+            capture_output=True, text=True, timeout=420, env=_ENV,
+            cwd=REPO)
+        assert p.returncode == 0, p.stdout[-2000:] + p.stderr[-2000:]
+        doc = json.loads(p.stdout[p.stdout.index("{"):])
+        live = [f for f in doc["findings"] if not f["baselined"]]
+        assert live == []
+        assert doc["programs"] >= 4
+        assert len(doc["detectors"]) == 4
+        assert doc["workload"]["count"] == 24          # golden pin
+        assert all("est_peak_bytes" in v
+                   for v in doc["program_stats"].values())
+
+
+# ---------------------------------------------------------------------------
+# accuracy pin + hot-path isolation (fresh processes)
+# ---------------------------------------------------------------------------
+
+_ACCURACY_SCRIPT = r"""
+import sys
+sys.path.insert(0, {repo!r})
+import jax
+jax.config.update("jax_platforms", "cpu")
+import sparkdq4ml_tpu as dq
+from sparkdq4ml_tpu.utils import meminfo
+
+spark = dq.TpuSession.builder().app_name("pin").master(
+    "local[*]").get_or_create()
+dq.register_builtin_rules()
+meminfo.reset_peak()
+df = (spark.read.format("csv").option("inferSchema", "true")
+      .option("header", "false").load({data!r}))
+df = df.with_column_renamed("_c0", "guest")
+df = df.with_column_renamed("_c1", "price")
+df = df.with_column("price_no_min",
+                    dq.call_udf("minimumPriceRule", dq.col("price")))
+df.create_or_replace_temp_view("price")
+est_text = spark.sql(
+    "EXPLAIN SELECT cast(guest as int) guest, price_no_min AS price "
+    "FROM price WHERE price_no_min > 0").to_pydict()["plan"][0]
+import re
+est = max(int(m) for m in re.findall(r"est_peak=(\d+)", est_text))
+out = spark.sql(
+    "SELECT cast(guest as int) guest, price_no_min AS price "
+    "FROM price WHERE price_no_min > 0")
+rows = out.to_pydict()
+meminfo.sample()            # fold the live census into the peak tracker
+measured = meminfo.peak_bytes()
+assert measured > 0
+# the static bound brackets the measured peak: >= (it is a bound) and
+# within the documented CPU slack factor (the census counts every live
+# array incl. the source frame; the bound assumes no aliasing)
+SLACK = 64
+assert est >= measured, (est, measured)
+assert est <= SLACK * measured, (est, measured)
+print("PIN_OK", est, measured)
+"""
+
+_HOTPATH_SCRIPT = r"""
+import sys
+sys.path.insert(0, {repo!r})
+import jax
+jax.config.update("jax_platforms", "cpu")
+import sparkdq4ml_tpu as dq
+from sparkdq4ml_tpu.frame.frame import Frame
+
+spark = dq.TpuSession.builder().app_name("hot").master(
+    "local[*]").get_or_create()
+Frame({{"a": [1.0, 2.0, 3.0, 4.0]}}).create_or_replace_temp_view("t")
+spark.sql("SELECT a * 2 AS b FROM t WHERE a > 1").to_pydict()
+spark.sql("SELECT a, count(*) c FROM t GROUP BY a").to_pydict()
+spark.cache_report()
+assert "sparkdq4ml_tpu.analysis" not in sys.modules, "analysis leaked"
+assert "sparkdq4ml_tpu.analysis.program" not in sys.modules
+spark.stop()
+print("HOTPATH_OK")
+"""
+
+
+class TestOfflineContracts:
+    def test_static_bound_brackets_measured_peak(self):
+        code = _ACCURACY_SCRIPT.format(
+            repo=REPO, data=dataset_path("abstract"))
+        p = subprocess.run([sys.executable, "-c", code],
+                           capture_output=True, text=True, timeout=300,
+                           env=_ENV, cwd=REPO)
+        assert p.returncode == 0, p.stdout[-2000:] + p.stderr[-2000:]
+        assert "PIN_OK" in p.stdout
+
+    def test_audit_package_never_on_the_query_path(self):
+        code = _HOTPATH_SCRIPT.format(repo=REPO)
+        p = subprocess.run([sys.executable, "-c", code],
+                           capture_output=True, text=True, timeout=300,
+                           env=_ENV, cwd=REPO)
+        assert p.returncode == 0, p.stdout[-2000:] + p.stderr[-2000:]
+        assert "HOTPATH_OK" in p.stdout
