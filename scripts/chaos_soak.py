@@ -1,0 +1,397 @@
+#!/usr/bin/env python
+"""Chaos-soak harness — the standing robustness gate (ISSUE 11).
+
+Drives the 32-client concurrent serving workload (the headline DQ+Lasso
+query of the reference app) under N seeded RANDOM fault schedules that
+span every registered fault site — the fused pipeline flush, the grouped
+segment-reduce program, the native streaming ingest, the QueryServer
+worker + admission gates, the model-fit ladder, and memory pressure (the
+``oom`` budget-shrink fault) — and asserts the engine's survival
+contract:
+
+* **zero hangs** — every ``QueryFuture.result()`` returns within a hard
+  bound, whatever died underneath;
+* **zero result corruption** — every SUCCESSFUL query returns the golden
+  numbers (count 24 / RMSE 2.8099 ± 1%); a fault may slow a query or
+  refuse it with a structured status, never change its answer;
+* **breaker recovery** — a tenant breaker tripped by chaos recovers
+  through half-open to closed once the faults stop;
+* **coherent counters** — every admitted job resolves exactly once
+  (``serve.admit`` == complete + error + deadline_exceeded deltas) and
+  every ``recovery.<action>`` counter delta matches the structured
+  ``RECOVERY_LOG`` event stream.
+
+Schedules are pure functions of the seed (the ``utils.faults`` crc32
+discipline), so a failing seed replays exactly with
+``--seeds 1 --base-seed <s>``.
+
+Usage::
+
+    python scripts/chaos_soak.py --seeds 50              # the full gate
+    python scripts/chaos_soak.py --seeds 5 --clients 8   # a quick smoke
+    python scripts/chaos_soak.py --seeds 1 --base-seed 17  # replay seed 17
+
+Conf defaults (overridden by flags): ``spark.chaos.seed`` /
+``spark.chaos.seeds`` / ``spark.chaos.soakSeconds``. Exit 0 = every seed
+held the contract; 1 = a violation (printed per seed).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+GOLDEN_COUNT = 24
+GOLDEN_RMSE = 2.809940          # SURVEY.md §2.3, dataset-abstract
+RESULT_BOUND_S = 300.0          # the zero-hangs bound per result()
+BREAKER_COOLDOWN_S = 0.75
+
+#: Candidate fault specs: (site, kind, max Bernoulli p, extra spec args).
+#: Each seed includes a deterministic subset with deterministic p values;
+#: probabilities stay low enough that most queries succeed (the golden
+#: assertion needs successes to bite on).
+_CANDIDATES = (
+    ("pipeline_flush", "device_error", 0.15, ""),
+    ("pipeline_flush", "nan", 0.08, ""),
+    ("grouped_flush", "device_error", 0.15, ""),
+    ("ingest_native", "io_error", 0.06, ""),
+    ("ingest_native", "torn_chunk", 0.08, ""),
+    ("ingest_native", "thread_death", 0.08, ""),
+    ("ingest_native", "pool_exhaust", 0.15, ""),
+    ("serve_exec", "device_error", 0.10, ""),
+    ("serve_admit", "oom", 0.06, ""),
+    # n=64: a 64-byte budget — far under any real flush estimate, so a
+    # fired oom always forces the row-chunked degrade
+    ("oom", "oom", 0.25, ":n=64"),
+    ("solver", "device_error", 0.05, ""),
+    ("fit_packed", "device_error", 0.05, ""),
+)
+
+
+#: Guaranteed attempt-1 fault per seed (round-robin): even a small smoke
+#: run exercises every ladder, instead of leaving low-p Bernoulli draws
+#: to the dice at low attempt counts.
+_ROTATION = (
+    ("pipeline_flush", "device_error", ""),
+    ("grouped_flush", "device_error", ""),
+    ("serve_exec", "device_error", ""),
+    ("oom", "oom", ":n=64"),
+    ("ingest_native", "io_error", ""),
+    ("ingest_native", "pool_exhaust", ""),
+    ("pipeline_flush", "nan", ""),
+)
+
+
+def build_schedule(seed: int) -> str:
+    """Seeded random fault schedule: a deterministic subset of the
+    candidate (site, kind) pairs, each with a deterministic probability —
+    pure function of ``seed`` — plus one guaranteed attempt-1 fault from
+    the rotation. Every third seed also schedules a
+    ``serve_admit:breaker_trip`` so the trip → shed → half-open → closed
+    lifecycle is exercised regularly, not just when the dice say so."""
+    from sparkdq4ml_tpu.utils.faults import _det_uniform
+
+    specs = []
+    for site, kind, max_p, extra in _CANDIDATES:
+        pick = _det_uniform(seed, f"sched-pick:{site}:{kind}", 1)
+        if pick < 0.5:
+            continue
+        p = 0.01 + max_p * _det_uniform(seed, f"sched-p:{site}:{kind}", 1)
+        specs.append(f"{site}:{kind}:p={p:.4f}{extra}")
+    # appended unconditionally: specs are additive (the plan fires the
+    # first DUE spec per attempt), so a low-p Bernoulli pick for the
+    # same pair must not displace the guaranteed attempt-1 fault
+    site, kind, extra = _ROTATION[seed % len(_ROTATION)]
+    specs.append(f"{site}:{kind}:1{extra}")
+    if seed % 3 == 0:
+        specs.append("serve_admit:breaker_trip:2")
+    return ";".join(specs)
+
+
+def headline_job(data_path: str):
+    """The reference app's DQ+Lasso flow as a tenant-scoped server job
+    (the bench/test_serve workload): CSV ingest, two DQ rules with SQL
+    filters, vector assembly, Lasso fit — touches ingest, the fused
+    pipeline, SQL, and the packed-fit ladder in one query."""
+    import sparkdq4ml_tpu as dq
+    from sparkdq4ml_tpu.models import LinearRegression, VectorAssembler
+
+    def job(ctx):
+        dq.register_builtin_rules()
+        df = (ctx.read.format("csv").option("inferSchema", "true")
+              .option("header", "false").load(data_path))
+        df = df.with_column_renamed("_c0", "guest") \
+               .with_column_renamed("_c1", "price")
+        df = df.with_column("price_no_min",
+                            dq.call_udf("minimumPriceRule", dq.col("price")))
+        ctx.register_view("price", df)
+        df = ctx.sql("SELECT cast(guest as int) guest, price_no_min AS "
+                     "price FROM price WHERE price_no_min > 0")
+        df = df.with_column(
+            "price_correct_correl",
+            dq.call_udf("priceCorrelationRule", dq.col("price"),
+                        dq.col("guest")))
+        ctx.register_view("price", df)
+        df = ctx.sql("SELECT guest, price_correct_correl AS price "
+                     "FROM price WHERE price_correct_correl > 0")
+        # a grouped leg so the segment-reduce ladder (grouped_flush) is
+        # on the soak's execution path; its per-group counts must sum to
+        # the row count whichever lowering (device or host rung) ran
+        ctx.register_view("price_clean", df)
+        grouped = ctx.sql("SELECT guest, count(*) c FROM price_clean "
+                          "GROUP BY guest")
+        group_sum = int(sum(grouped.to_pydict()["c"]))
+        df = df.with_column("label", df.col("price"))
+        df = VectorAssembler(["guest"], "features").transform(df)
+        model = LinearRegression(max_iter=40, reg_param=1.0,
+                                 elastic_net_param=1.0).fit(df)
+        return {"count": df.count(), "group_sum": group_sum,
+                "rmse": float(model.summary.root_mean_squared_error)}
+
+    return job
+
+
+def _golden(value) -> bool:
+    return (isinstance(value, dict) and value.get("count") == GOLDEN_COUNT
+            and value.get("group_sum") == GOLDEN_COUNT
+            and abs(value.get("rmse", 0.0) - GOLDEN_RMSE)
+            / GOLDEN_RMSE < 0.01)
+
+
+def run_seed(session, seed: int, clients: int, queries: int, workers: int,
+             data_path: str, soak_s: float, log=print) -> dict:
+    """One seeded chaos round; returns the per-seed verdict dict with a
+    ``violations`` list (empty = the contract held)."""
+    from sparkdq4ml_tpu.serve import QueryServer, TenantQuota
+    from sparkdq4ml_tpu.utils import faults, profiling
+    from sparkdq4ml_tpu.utils.recovery import RECOVERY_LOG
+
+    schedule = build_schedule(seed)
+    violations: list[str] = []
+    RECOVERY_LOG.clear()
+    before = profiling.counters.snapshot()
+    job = headline_job(data_path)
+    server = QueryServer(
+        session, workers=workers, max_queue=4 * clients,
+        default_quota=TenantQuota(max_in_flight=2, max_queued=queries + 2),
+        breaker_threshold=3, breaker_cooldown=BREAKER_COOLDOWN_S).start()
+    plan = faults.install_plan(faults.parse_plan(schedule, seed=seed))
+    results: list = []
+    res_lock = threading.Lock()
+    hangs = [0]
+    t0 = time.perf_counter()
+
+    def client(i: int) -> None:
+        tenant = f"chaos-{i:02d}"
+        out = []
+        while True:
+            done = len(out)
+            if done >= queries and time.perf_counter() - t0 >= soak_s:
+                break
+            fut = server.submit(job, tenant=tenant)
+            try:
+                out.append(fut.result(timeout=RESULT_BOUND_S))
+            except TimeoutError:
+                with res_lock:
+                    hangs[0] += 1
+                break
+        with res_lock:
+            results.extend(out)
+
+    threads = [threading.Thread(target=client, args=(i,),
+                                name=f"chaos-client-{i}")
+               for i in range(clients)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    fired = list(plan.fired)
+    faults.clear()     # chaos off before the recovery probe
+
+    # breaker recovery: every key chaos tripped or failed must admit a
+    # half-open trial after the cooldown and CLOSE on one clean probe
+    # query (a key whose cooldown already expired mid-workload probes
+    # the same way — the half-open → closed transition is the assertion)
+    recovered = 0
+    tripped = sum(1 for _, k, _ in fired if k == "breaker_trip")
+    open_keys = [k for k, st in server.breaker.snapshot().items()
+                 if st["open"] or st["consecutive_failures"] > 0]
+    for key in open_keys:
+        tenant = key.split("/", 1)[1]
+        deadline = time.monotonic() + 4 * BREAKER_COOLDOWN_S
+        while not server.breaker.allow(key):
+            if time.monotonic() > deadline:
+                violations.append(
+                    f"breaker {key} never reached half-open")
+                break
+            time.sleep(0.05)
+        else:
+            try:
+                probe = server.submit(job, tenant=tenant).result(
+                    timeout=RESULT_BOUND_S)
+            except TimeoutError:
+                violations.append(
+                    f"breaker {key} half-open probe hung past "
+                    f"{RESULT_BOUND_S:.0f}s")
+                continue
+            if not (probe.ok and _golden(probe.value)):
+                violations.append(
+                    f"breaker {key} half-open probe failed: {probe.status}")
+            elif server.breaker.snapshot().get(key, {}).get("open"):
+                violations.append(f"breaker {key} did not close on success")
+            else:
+                recovered += 1
+            results.append(probe)
+    server.stop(drain=True)
+    delta = {k: v - before.get(k, 0)
+             for k, v in profiling.counters.snapshot().items()
+             if v != before.get(k, 0)}
+
+    # -- the contract -------------------------------------------------------
+    if hangs[0]:
+        violations.append(f"{hangs[0]} result() call(s) hung past "
+                          f"{RESULT_BOUND_S:.0f}s")
+    ok = [r for r in results if r.ok]
+    bad_values = [r for r in ok if not _golden(r.value)]
+    if bad_values:
+        violations.append(
+            f"{len(bad_values)} successful quer(ies) returned corrupted "
+            f"results (first: {bad_values[0].value!r})")
+    allowed = {"ok", "rejected", "shed", "error", "deadline_exceeded"}
+    unstructured = [r for r in results if r.status not in allowed]
+    if unstructured:
+        violations.append(f"unstructured statuses: "
+                          f"{[r.status for r in unstructured]}")
+    admitted = delta.get("serve.admit", 0)
+    resolved = (delta.get("serve.complete", 0) + delta.get("serve.error", 0)
+                + delta.get("serve.deadline_exceeded", 0))
+    if admitted != resolved:
+        violations.append(
+            f"serve counter incoherence: admit={admitted} != "
+            f"complete+error+deadline={resolved}")
+    by_action: dict[str, int] = {}
+    for e in RECOVERY_LOG.events():
+        by_action[e.action] = by_action.get(e.action, 0) + 1
+    for action, n in by_action.items():
+        if delta.get(f"recovery.{action}", 0) != n:
+            violations.append(
+                f"recovery counter incoherence: recovery.{action}="
+                f"{delta.get(f'recovery.{action}', 0)} vs {n} logged "
+                "event(s)")
+    row = {
+        "seed": seed, "schedule": schedule, "queries": len(results),
+        "completed": len(ok), "refused_or_failed": len(results) - len(ok),
+        "faults_fired": len(fired),
+        "fault_sites": sorted({s for s, _, _ in fired}),
+        "requeues": delta.get("serve.requeue", 0),
+        "fault_fallbacks": {
+            k: v for k, v in delta.items() if k.endswith("fault_fallback")},
+        "oom_chunked": delta.get("pipeline.oom_chunked", 0),
+        "breakers_tripped": tripped,
+        "breakers_probed": len(open_keys),
+        "breakers_recovered": recovered,
+        "wall_s": round(time.perf_counter() - t0, 2),
+        "violations": violations,
+    }
+    log(("OK  " if not violations else "FAIL") + " " + json.dumps(row))
+    return row
+
+
+def run_soak(seeds=None, clients=None, queries=1, workers=8,
+             base_seed=None, soak_s=None, data_path=None, session=None,
+             log=print) -> dict:
+    """Sweep ``seeds`` seeded chaos rounds; returns the summary dict
+    (``ok`` True = every seed held the survival contract). Arguments left
+    ``None`` fall back to the session conf (``spark.chaos.*``) defaults.
+    """
+    import sparkdq4ml_tpu as dq
+    from sparkdq4ml_tpu.config import config
+
+    created_here = False
+    if session is None:
+        session = (dq.TpuSession.builder().app_name("chaos-soak")
+                   .master("local[*]")
+                   # tiny chunks: the 320-byte headline CSV streams, so
+                   # the mid-stream ingest fault sites are reachable
+                   .config("spark.ingest.chunkBytes", "256")
+                   .get_or_create())
+        created_here = True
+    seeds = int(config.chaos_seeds if seeds is None else seeds)
+    base_seed = int(config.chaos_seed if base_seed is None else base_seed)
+    soak_s = float(config.chaos_soak_s if soak_s is None else soak_s)
+    clients = int(32 if clients is None else clients)
+    data_path = data_path or os.path.join(REPO, "data",
+                                          "dataset-abstract.csv")
+    from sparkdq4ml_tpu.utils import faults
+
+    rows = []
+    try:
+        for s in range(base_seed, base_seed + seeds):
+            rows.append(run_seed(session, s, clients, queries, workers,
+                                 data_path, soak_s, log=log))
+    finally:
+        faults.clear()
+        if created_here:
+            session.stop()
+    bad = [r for r in rows if r["violations"]]
+    summary = {
+        "seeds": seeds, "clients": clients, "queries_per_client": queries,
+        "ok": not bad,
+        "failed_seeds": [r["seed"] for r in bad],
+        "queries": sum(r["queries"] for r in rows),
+        "completed": sum(r["completed"] for r in rows),
+        "faults_fired": sum(r["faults_fired"] for r in rows),
+        "requeues": sum(r["requeues"] for r in rows),
+        "oom_chunked": sum(r["oom_chunked"] for r in rows),
+        "breakers_tripped": sum(r["breakers_tripped"] for r in rows),
+        "breakers_probed": sum(r["breakers_probed"] for r in rows),
+        "breakers_recovered": sum(r["breakers_recovered"] for r in rows),
+        "per_seed": rows,
+    }
+    return summary
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--seeds", type=int, default=None,
+                    help="seeded schedules to sweep (spark.chaos.seeds)")
+    ap.add_argument("--base-seed", type=int, default=None,
+                    help="first seed (spark.chaos.seed); replay one "
+                    "failing seed with --seeds 1 --base-seed S")
+    ap.add_argument("--clients", type=int, default=32)
+    ap.add_argument("--queries", type=int, default=1,
+                    help="queries per client per seed")
+    ap.add_argument("--workers", type=int, default=8)
+    ap.add_argument("--soak-seconds", type=float, default=None,
+                    help="minimum per-seed duration "
+                    "(spark.chaos.soakSeconds)")
+    ap.add_argument("--data", default=None)
+    ap.add_argument("--json", dest="json_path", default=None,
+                    help="write the summary JSON here")
+    args = ap.parse_args(argv)
+    summary = run_soak(seeds=args.seeds, clients=args.clients,
+                       queries=args.queries, workers=args.workers,
+                       base_seed=args.base_seed, soak_s=args.soak_seconds,
+                       data_path=args.data)
+    print(json.dumps({k: v for k, v in summary.items()
+                      if k != "per_seed"}, indent=1))
+    if args.json_path:
+        with open(args.json_path, "w") as f:
+            json.dump(summary, f, indent=1)
+    if not summary["ok"]:
+        print(f"CHAOS SOAK FAILED: seeds {summary['failed_seeds']}")
+        return 1
+    print("chaos soak clean: every seed held the survival contract")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

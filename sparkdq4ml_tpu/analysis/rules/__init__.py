@@ -9,6 +9,7 @@ from __future__ import annotations
 
 from .collective import CollectiveGuardRule
 from .conf_keys import ConfKeyRule
+from .fault_sites import FaultSiteRule
 from .host_sync import HostSyncRule
 from .locks import LockOrderRule
 from .logger_ns import LoggerNamespaceRule
@@ -22,6 +23,7 @@ ALL_RULES = (
     ConfKeyRule,
     NoopContractRule,
     LockOrderRule,
+    FaultSiteRule,
     LoggerNamespaceRule,
     NumpyFreeRule,
 )

@@ -1,0 +1,147 @@
+"""Rule ``fault-site`` — every chaos hook call names a registered site.
+
+The fault plan (``utils/faults.py``) matches sites by STRING equality at
+runtime: a typo'd site in a ``faults.inject("pipleine_flush")`` call
+compiles, runs, and silently never fires — the chaos test asserting that
+degradation ladder then passes vacuously, which is exactly the class of
+rot a robustness gate must not allow. The registry is
+``utils/faults.py::FAULT_SITES`` (a pure literal, parsed statically like
+the conf-key registry parses ``config.CONF_KEYS``).
+
+Checks:
+
+1. **Literal site**: every call to a faults hook — ``inject`` /
+   ``corrupt`` / ``fired`` / ``shrunk_budget`` / ``degrade_mesh`` —
+   whose receiver resolves to the faults module (``faults.X`` /
+   ``_faults.X``, or a name imported from ``utils.faults``) must pass a
+   string LITERAL as the site argument; a computed site cannot be
+   checked and is flagged.
+2. **Registered site**: the literal must be a key of ``FAULT_SITES``.
+3. **Registered kind**: for ``fired(site, kind)``, a literal kind must
+   be among the kinds registered for that site — a hook asking for a
+   kind the site never schedules is the same silent-never-fires bug.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from ..core import Finding, Rule, SourceFile, attr_chain
+
+_FAULTS_REL = "sparkdq4ml_tpu/utils/faults.py"
+
+#: hook name → index of the site argument
+_HOOKS = {"inject": 0, "corrupt": 0, "fired": 0, "shrunk_budget": 0,
+          "degrade_mesh": 0}
+
+
+class FaultSiteRule(Rule):
+    name = "fault-site"
+    description = ("faults.inject/corrupt/fired/shrunk_budget/degrade_mesh"
+                   " call sites must name a string literal registered in"
+                   " faults.FAULT_SITES (typo'd sites silently never fire)")
+
+    def __init__(self):
+        # (src, call_node, hook, site_node, kind_node)
+        self._usages: list = []
+        self._faults_src: Optional[SourceFile] = None
+
+    # -- per-file collection ------------------------------------------------
+    def visit(self, src: SourceFile):
+        if src.rel == _FAULTS_REL:
+            self._faults_src = src
+            return ()   # the registry + hook definitions, not usages
+        # names imported straight from the faults module (aliased or not)
+        local_hooks: dict[str, str] = {}
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.ImportFrom) and node.module \
+                    and node.module.split(".")[-1] == "faults":
+                for alias in node.names:
+                    if alias.name in _HOOKS:
+                        local_hooks[alias.asname or alias.name] = alias.name
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            hook = None
+            f = node.func
+            if isinstance(f, ast.Attribute) and f.attr in _HOOKS:
+                chain = attr_chain(f.value)
+                if chain is not None and chain.split(".")[-1] in (
+                        "faults", "_faults"):
+                    hook = f.attr
+            elif isinstance(f, ast.Name) and f.id in local_hooks:
+                hook = local_hooks[f.id]
+            if hook is None:
+                continue
+            kwargs = {k.arg: k.value for k in node.keywords if k.arg}
+            args = node.args
+            site = (args[_HOOKS[hook]] if len(args) > _HOOKS[hook]
+                    else kwargs.get("site"))
+            kind = None
+            if hook == "fired":
+                kind = args[1] if len(args) > 1 else kwargs.get("kind")
+            self._usages.append((src, node, hook, site, kind))
+        return ()
+
+    # -- registry parse -----------------------------------------------------
+    @staticmethod
+    def _parse_registry(src: SourceFile) -> dict:
+        for node in src.tree.body:
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and node.targets[0].id == "FAULT_SITES":
+                try:
+                    value = ast.literal_eval(node.value)
+                except (ValueError, SyntaxError):
+                    return {}
+                return value if isinstance(value, dict) else {}
+        return {}
+
+    # -- cross-file check ---------------------------------------------------
+    def finalize(self, files):
+        out: list[Finding] = []
+        if self._faults_src is None:
+            return out   # partial trees in tests: nothing to check against
+        sites = self._parse_registry(self._faults_src)
+        if not sites:
+            out.append(Finding(
+                rule=self.name, path=self._faults_src.rel, line=0,
+                message="utils/faults.py declares no FAULT_SITES literal"
+                        " registry — every chaos hook site must be"
+                        " declared there"))
+            return out
+        for src, call, hook, site, kind in self._usages:
+            if not (isinstance(site, ast.Constant)
+                    and isinstance(site.value, str)):
+                f = src.finding(
+                    self.name, call,
+                    f"faults.{hook}(...) site must be a string LITERAL"
+                    " registered in faults.FAULT_SITES — a computed site"
+                    " cannot be statically checked and a typo would"
+                    " silently never fire")
+                if f:
+                    out.append(f)
+                continue
+            if site.value not in sites:
+                f = src.finding(
+                    self.name, call,
+                    f"fault site {site.value!r} is not registered in"
+                    " faults.FAULT_SITES — register it (with its kinds)"
+                    " or fix the typo; an unregistered site silently"
+                    " never fires")
+                if f:
+                    out.append(f)
+                continue
+            if kind is not None and isinstance(kind, ast.Constant) \
+                    and isinstance(kind.value, str) \
+                    and kind.value not in tuple(sites[site.value]):
+                f = src.finding(
+                    self.name, call,
+                    f"fault kind {kind.value!r} is not registered for"
+                    f" site {site.value!r} in faults.FAULT_SITES"
+                    f" (registered: {tuple(sites[site.value])}) — the"
+                    " hook would never fire")
+                if f:
+                    out.append(f)
+        return out

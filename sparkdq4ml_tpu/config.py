@@ -47,6 +47,9 @@ CONF_KEYS = {
     "spark.ingest.chunkBytes": "session",
     "spark.ingest.prefetch": "session",
     "spark.ingest.simd": "session",
+    "spark.chaos.seed": "session",
+    "spark.chaos.seeds": "session",
+    "spark.chaos.soakSeconds": "session",
     "spark.observability.enabled": "init",
     "spark.observability.maxSpans": "init",
     "spark.observability.logSpans": "init",
@@ -156,6 +159,15 @@ class _Config:
     # "avx2", "avx512" — explicit tiers clamp to what the CPU supports
     # (spark.ingest.simd).
     ingest_simd: str = "auto"
+    # Chaos-soak defaults (scripts/chaos_soak.py): base seed of the
+    # seeded random fault schedules (spark.chaos.seed), how many seeds
+    # the soak sweeps (spark.chaos.seeds), and a minimum per-seed soak
+    # duration in seconds — 0 runs each seed's workload exactly once
+    # (spark.chaos.soakSeconds). Session-scoped like every other knob;
+    # the harness CLI flags override.
+    chaos_seed: int = 0
+    chaos_seeds: int = 5
+    chaos_soak_s: float = 0.0
     # Pallas fast-path selection for the hot ops (ops/pallas_kernels.py):
     # the single-device Gramian in solvers.augmented_gram and the fused DQ
     # chain entry point ops/rules.py:dq_rules_fused. "off" = plain XLA

@@ -253,14 +253,36 @@ def read_csv(path: str, header: bool = False, infer_schema: bool = True,
                 raise RuntimeError("native CSV engine supports "
                                    "mode=PERMISSIVE only")
         else:
-            frame = native_csv.try_read_csv(path, header=header,
-                                            infer_schema=infer_schema,
-                                            delimiter=delimiter,
-                                            quote=quote,
-                                            required=(engine == "native"))
+            degraded = False
+            try:
+                frame = native_csv.try_read_csv(
+                    path, header=header, infer_schema=infer_schema,
+                    delimiter=delimiter, quote=quote,
+                    required=(engine == "native"))
+            except FileNotFoundError:
+                raise          # permanent: the python engine can't help
+            except (OSError, MemoryError,
+                    native_csv.NativeIngestError) as e:
+                if engine == "native":
+                    raise      # explicit native request: never degrade
+                # The native → python rung of the ingest degradation
+                # ladder (ISSUE 11): a mid-read I/O error, an allocation
+                # failure, or a dead prefetch producer (real or injected
+                # via utils.faults site "ingest_native") re-reads the
+                # file through the python engine — correctness over
+                # speed, observable via the recovery event + counters.
+                from ..utils.profiling import counters
+                from ..utils.recovery import RECOVERY_LOG
+
+                RECOVERY_LOG.record(
+                    "ingest_native", "fallback", rung="python",
+                    cause=f"{type(e).__name__}: {e}")
+                counters.increment("ingest.fault_fallback")
+                counters.increment("ingest.python_fallback")
+                frame, degraded = None, True
             if frame is not None:
                 return frame
-            if native_csv.available():
+            if native_csv.available() and not degraded:
                 # native was eligible and declined (non-numeric content,
                 # ragged header, multibyte delimiter...): the ingest
                 # telemetry counts the demotion so a fleet-wide scrape can

@@ -49,6 +49,14 @@ logger = logging.getLogger("sparkdq4ml_tpu.frame")
 # _data/_mask getters can never see "no pending" with stale stores.
 _FLUSH_LOCK = threading.RLock()
 
+
+def _is_device_error(e: BaseException) -> bool:
+    """The retryable device-fault class of the flush ladder: exactly what
+    a real XLA fault (OOM, interconnect reset) or an injected
+    ``pipeline_flush:device_error`` surfaces as."""
+    return isinstance(e, jax.errors.JaxRuntimeError)
+
+
 ColumnLike = Union[Expr, jnp.ndarray, np.ndarray, Sequence]
 
 
@@ -378,8 +386,18 @@ class Frame:
         publish the new stores BEFORE clearing ``_pending`` — a reader
         racing the unlocked getter fast-path either re-enters here (and
         finds nothing left to do) or sees the fully flushed state; never
-        stale stores, never a double-applied step."""
+        stale stores, never a double-applied step.
+
+        Degradation ladder (ISSUE 11): a DEVICE fault inside the fused
+        dispatch — a real ``XlaRuntimeError``, or an injected
+        ``pipeline_flush`` fault from ``utils.faults`` — routes through
+        :meth:`_flush_ladder` (retry via the PR-1 recovery engine, then
+        eager per-op replay, counted ``pipeline.fault_fallback``); steps
+        stay in ``_pending`` until a rung succeeds, so a failed rung can
+        never half-apply. With no fault plan installed the extra cost is
+        one ``is None`` check (test-pinned)."""
         from ..ops.compiler import PipelineError, run_pipeline
+        from ..utils import faults as _faults
 
         with _FLUSH_LOCK:
             steps = self._pending
@@ -388,13 +406,127 @@ class Frame:
             try:
                 new_data, new_mask, _ = run_pipeline(
                     self._data_store, self._mask_store, self._n, steps)
+                if _faults.active() is not None:   # chaos armed
+                    # Surface async-dispatched device faults INSIDE this
+                    # try while chaos is armed (jax dispatch is async; an
+                    # unsynced fault would otherwise raise at a later
+                    # host read, past the ladder, with _pending already
+                    # cleared). The no-chaos path deliberately keeps the
+                    # flush un-synced — one sync per flush would
+                    # serialize the async pipeline; a real accelerator
+                    # fault then surfaces at the consumer's first host
+                    # read as a failed (never silently wrong) query, and
+                    # the SERVING tier's requeue ladder still catches it
+                    # there (JaxRuntimeError is its retryable class).
+                    jax.block_until_ready((new_data, new_mask))
+                    new_data, new_mask = self._chaos_validate(
+                        steps, new_data, new_mask)
             except PipelineError as e:
                 logger.debug("pipeline flush fell back to eager replay: %s",
                              e)
                 new_data, new_mask = self._eager_replay(steps)
+            except Exception as e:
+                if not _is_device_error(e):
+                    raise
+                new_data, new_mask = self._flush_ladder(
+                    steps, first_cause=e)
             self._data_store = new_data
             self._mask_store = new_mask
             self._pending = ()
+
+    def _chaos_validate(self, steps, new_data, new_mask):
+        """NaN-corruption arm of the ``pipeline_flush`` ladder — runs only
+        under an installed fault plan with a ``nan`` spec at this site.
+        The produced columns are corrupted through ``faults.corrupt`` (a
+        flaky-transfer model) and checked with ``check_finite``; a
+        detected poisoning re-runs the whole flush through the resilient
+        ladder. The finiteness check is sound for the chaos suite's own
+        workloads (PR-1 convention: chaos tests detect their own injected
+        NaNs); workloads whose flush outputs legitimately carry NaN take
+        the ladder's extra replays but keep their correct eager result."""
+        from ..utils import faults as _faults
+
+        plan = _faults.active()
+        if plan is None or not plan._has("pipeline_flush", ("nan",)):
+            return new_data, new_mask
+        from ..utils import recovery as _rec
+
+        new_data, changed = self._corrupt_changed(new_data)
+        if _rec.check_finite(changed):
+            return new_data, new_mask
+        # rung "dispatch" = the pre-ladder flush attempt, distinct from
+        # the ladder's own rung="primary" retry events (no double-log)
+        _rec.RECOVERY_LOG.record("pipeline_flush", "retry", attempt=1,
+                                 rung="dispatch",
+                                 cause="non-finite result")
+        return self._flush_ladder(steps)
+
+    def _corrupt_changed(self, new_data):
+        """The one corrupt-merge step of the nan arm, shared by the first
+        flush (:meth:`_chaos_validate`) and the ladder's retries: corrupt
+        the columns this flush PRODUCED (identity vs the pre-flush store)
+        and merge any poisoning back. Returns ``(new_data, changed)`` —
+        ``changed`` is the validation target."""
+        from ..utils import faults as _faults
+
+        changed = {k: v for k, v in new_data.items()
+                   if v is not self._data_store.get(k)}
+        poisoned = _faults.corrupt("pipeline_flush", changed)
+        if poisoned is not changed:
+            new_data = {**new_data, **poisoned}
+            changed = poisoned
+        return new_data, changed
+
+    def _flush_ladder(self, steps, first_cause=None):
+        """The ``pipeline_flush`` degradation ladder: retry the fused
+        program under ``recovery.resilient_call`` (per-site
+        ``spark.recovery.pipeline_flush.*`` policy), then degrade one
+        level to eager per-op replay (``pipeline.fault_fallback``) — a
+        fault costs one rung, never the query. Runs under ``_FLUSH_LOCK``
+        (held by the caller), so chaos-path backoff sleeps briefly
+        serialize other frames' flushes — bounded by the retry policy."""
+        from ..ops.compiler import PipelineError, run_pipeline
+        from ..utils import faults as _faults
+        from ..utils import recovery as _rec
+        from ..utils.profiling import counters
+
+        plan = _faults.active()
+        nan_armed = plan is not None and plan._has("pipeline_flush",
+                                                   ("nan",))
+
+        def fused():
+            new_data, new_mask, _ = run_pipeline(
+                self._data_store, self._mask_store, self._n, steps)
+            if not nan_armed:
+                return new_data, new_mask, None
+            new_data, changed = self._corrupt_changed(new_data)
+            return new_data, new_mask, changed
+
+        def eager():
+            counters.increment("pipeline.fault_fallback")
+            d, m = self._eager_replay(steps)
+            return d, m, None
+
+        validate = ((lambda out: out[2] is None
+                     or _rec.check_finite(out[2]))
+                    if nan_armed else None)
+        if first_cause is not None:
+            # the PRE-ladder dispatch that failed — rung "dispatch", so a
+            # persistent fault's ladder retries (rung "primary") never
+            # read as duplicates of this event
+            _rec.RECOVERY_LOG.record(
+                "pipeline_flush", "retry", attempt=1, rung="dispatch",
+                cause=f"{type(first_cause).__name__}: {first_cause}")
+        try:
+            new_data, new_mask, _ = _rec.resilient_call(
+                fused, site="pipeline_flush", validate=validate,
+                fallbacks=(("eager", eager),))
+            return new_data, new_mask
+        except PipelineError:
+            # structural compile failure inside the ladder: eager replay
+            # is the answer on every path
+            d, m, _ = eager()
+            return d, m
 
     def _eager_replay(self, steps):
         """Apply pipeline steps through the eager code paths (fallback)."""
@@ -675,6 +807,19 @@ class Frame:
                     extra)
             except PipelineError as e:
                 logger.debug("fused select fell back to eager: %s", e)
+                return {}
+            except Exception as e:
+                if not _is_device_error(e):
+                    raise
+                # device fault in the fused select: defer to the eager
+                # path (per-expression eval, whose first _data read
+                # re-enters the _flush ladder if the fault persists)
+                from ..utils.recovery import RECOVERY_LOG
+
+                RECOVERY_LOG.record(
+                    "pipeline_flush", "fallback", rung="select",
+                    cause=f"{type(e).__name__}: {e}",
+                    detail="fused select deferred to eager evaluation")
                 return {}
             # stores BEFORE pending — same publish ordering as _flush
             self._data_store = new_data

@@ -43,8 +43,19 @@ from typing import Optional
 import numpy as np
 
 from ..config import config, float_dtype, int_dtype
+from ..utils import faults as _faults
 from ..utils.observability import span
 from ..utils.profiling import counters
+
+
+class NativeIngestError(RuntimeError):
+    """The native streaming layer failed mid-read — a prefetch producer
+    thread died (its exception rides as ``__cause__``), or an injected
+    ``ingest_native`` chaos fault. ``frame/csv.py`` catches this (with
+    ``OSError``/``MemoryError``) and degrades the read to the python
+    engine, which re-reads the file from scratch — the native → python
+    rung of the ingest degradation ladder."""
+
 
 _LIB = None
 _LIB_TRIED = False
@@ -175,6 +186,10 @@ def try_read_csv(path: str, header: bool, infer_schema: bool, delimiter: str,
         if names is None:
             return None
 
+    # chaos hook (one None check without a plan): a due io_error raises
+    # InjectedIOError here — the flaky-disk model — and frame/csv.py
+    # degrades the read to the python engine.
+    _faults.inject("ingest_native")
     if config.ingest_streaming and hasattr(lib, "dq_stream_open"):
         try:
             size = os.path.getsize(path)
@@ -452,6 +467,18 @@ def _stream_pinned(lib, h, nc, names, size):
     # an unterminated tail — where the overallocation stays VIRTUAL
     # (untouched pages are never faulted in) and such buffers simply
     # exceed the pool cap.
+    # chaos hook: a due pool_exhaust fault models an allocation-starved
+    # bind pool — degrade one level to the chunked body (per-chunk
+    # malloc'd blocks, no pooled buffers) instead of dying.
+    if _faults.fired("ingest_native", "pool_exhaust"):
+        from ..utils.recovery import RECOVERY_LOG
+
+        RECOVERY_LOG.record(
+            "ingest_native", "fallback", rung="chunked",
+            cause="pool exhausted",
+            detail="bind-buffer pool exhausted; chunked stream body")
+        counters.increment("ingest.fault_fallback")
+        return _stream_chunked(lib, h, nc, names)
     total_cap = int(lib.dq_stream_total_rows(h))
     if total_cap < 0:
         total_cap = size // 2 + 2
@@ -461,11 +488,25 @@ def _stream_pinned(lib, h, nc, names, size):
     stride = ((max(total_cap, 1) + 15) // 16) * 16
     fbuf, ibuf = _pool_checkout(
         nc * stride, np.float64 if want_f64 else np.float32, nc * stride)
+    # Release-ONCE discipline: the buffers return to the pool on EVERY
+    # exit — success (after the engine finished reading them), the
+    # definitive-None parse failure, the alloc-failure raise, a dead
+    # prefetch producer — via the finally below. The flag stops a double
+    # checkin (two pool entries aliasing one buffer would hand the same
+    # memory to two concurrent readers).
+    released = False
+
+    def _release():
+        nonlocal released
+        if not released:
+            released = True
+            _pool_checkin(fbuf, ibuf)
+
     rc = int(lib.dq_stream_bind(
         h, fbuf.ctypes.data_as(ctypes.c_void_p),
         ibuf.ctypes.data_as(ctypes.c_void_p), stride, 1 if want_f64 else 0))
     if rc != 0:
-        _pool_checkin(fbuf, ibuf)
+        _release()
         return _stream_chunked(lib, h, nc, names)
     # On a real accelerator a column's float rows are device_put as soon
     # as they are KNOWN-float, so host->device DMA overlaps the parse of
@@ -483,53 +524,64 @@ def _stream_pinned(lib, h, nc, names, size):
     # overlap — columns hand over whole at EOF through the probed
     # fastest path (_to_device: dlpack adoption or bulk copy).
     cpu_backend = jax.default_backend() == "cpu"
-    dev_chunks: list[list] = [[] for _ in range(nc)]
-    dev_rows = [0] * nc  # float rows already transferred per column
-    total_rows = 0
-    nchunks = 0
-    for rows, (off, chunk_flags) in _bind_chunk_iter(lib, h, nc):
-        if rows == -2:
-            raise MemoryError("native CSV stream allocation failure")
-        if rows < 0:
-            return None  # non-numeric mid-file → python engine
-        nchunks += 1
-        total_rows += rows
-        if not cpu_backend:
-            for j in range(nc):
-                if chunk_flags[j]:
-                    continue  # i32 lane live: float lane not written yet
-                base = j * stride + dev_rows[j]
-                dev_chunks[j].append(
-                    jax.device_put(fbuf[base:base + total_rows -
-                                        dev_rows[j]]))
-                dev_rows[j] = total_rows
-    flags = _stream_flags(lib, h, nc)
-    data = {}
-    for j in range(nc):
-        name = names[j] if names is not None else f"_c{j}"
-        base = j * stride
-        if flags[j]:
-            col = ibuf[base:base + total_rows]
-            col = col if idt == np.dtype(np.int32) else col.astype(idt)
-            # dlpack commits to the HOST device — correct on the CPU
-            # backend, but on an accelerator it would strand int columns
-            # on the CPU next to float columns living on the accelerator
-            # (mixed-device Frames fail on first use): device_put instead.
-            data[name] = (_to_device(col) if cpu_backend
-                          else jax.device_put(col))
-        elif cpu_backend:
-            data[name] = _to_device(fbuf[base:base + total_rows])
-        else:
-            import jax.numpy as jnp
+    chunks = _bind_chunk_iter(lib, h, nc)
+    try:
+        dev_chunks: list[list] = [[] for _ in range(nc)]
+        dev_rows = [0] * nc  # float rows already transferred per column
+        total_rows = 0
+        nchunks = 0
+        for rows, (off, chunk_flags) in chunks:
+            if rows == -2:
+                raise MemoryError("native CSV stream allocation failure")
+            if rows < 0:
+                return None  # non-numeric mid-file → python engine
+            nchunks += 1
+            total_rows += rows
+            if not cpu_backend:
+                for j in range(nc):
+                    if chunk_flags[j]:
+                        continue  # i32 lane live: float lane unwritten
+                    base = j * stride + dev_rows[j]
+                    dev_chunks[j].append(
+                        jax.device_put(fbuf[base:base + total_rows -
+                                            dev_rows[j]]))
+                    dev_rows[j] = total_rows
+        flags = _stream_flags(lib, h, nc)
+        data = {}
+        for j in range(nc):
+            name = names[j] if names is not None else f"_c{j}"
+            base = j * stride
+            if flags[j]:
+                col = ibuf[base:base + total_rows]
+                col = col if idt == np.dtype(np.int32) else col.astype(idt)
+                # dlpack commits to the HOST device — correct on the CPU
+                # backend, but on an accelerator it would strand int
+                # columns on the CPU next to float columns living on the
+                # accelerator (mixed-device Frames fail on first use):
+                # device_put instead.
+                data[name] = (_to_device(col) if cpu_backend
+                              else jax.device_put(col))
+            elif cpu_backend:
+                data[name] = _to_device(fbuf[base:base + total_rows])
+            else:
+                import jax.numpy as jnp
 
-            data[name] = (dev_chunks[j][0] if len(dev_chunks[j]) == 1
-                          else jnp.concatenate(dev_chunks[j]))
-    # The engine must be done reading the bind buffers before they can be
-    # pooled for the next read (checkin is a no-op in alias mode, where
-    # the columns ARE these buffers).
-    jax.block_until_ready(list(data.values()))
-    _pool_checkin(fbuf, ibuf)
-    return data, total_rows, nchunks
+                data[name] = (dev_chunks[j][0] if len(dev_chunks[j]) == 1
+                              else jnp.concatenate(dev_chunks[j]))
+        # The engine must be done reading the bind buffers before they
+        # can be pooled for the next read (checkin is a no-op in alias
+        # mode, where the columns ARE these buffers).
+        jax.block_until_ready(list(data.values()))
+        return data, total_rows, nchunks
+    finally:
+        # Quiesce the prefetch producer BEFORE pooling the buffers: on a
+        # consumer-side exception the producer may still be parsing a
+        # chunk INTO fbuf/ibuf, and a checkin at that moment would hand
+        # live-written memory to the next reader. Closing the iterator
+        # runs its finally (stop + drain + join); only then is the
+        # checkin safe.
+        chunks.close()
+        _release()
 
 
 def _stream_chunked(lib, h, nc, names):
@@ -602,6 +654,11 @@ def _stream_flags(lib, h, nc) -> bytes:
     return buf.raw[:nc]
 
 
+#: Reserved queue code: the producer thread died and the payload is its
+#: exception (never emitted by the native layer, whose codes stop at -2).
+_PRODUCER_ERROR = -3
+
+
 def _prefetch_iter(next_chunk, release=None):
     """Yield ``(rows, payload)`` chunks from a ``next_chunk()`` callable.
 
@@ -613,6 +670,15 @@ def _prefetch_iter(next_chunk, release=None):
     that cannot be handed over is released via ``release(payload)``
     (malloc'd blocks in chunked mode; bind mode has no ownership to
     reclaim and passes no release).
+
+    A DYING producer must never strand the consumer on the bounded
+    queue: any exception it raises (a ctypes failure, an injected
+    ``ingest_native:thread_death``) is handed through the queue as a
+    ``_PRODUCER_ERROR`` item and re-raised here as
+    :class:`NativeIngestError` (original as ``__cause__``); as
+    belt-and-braces, the consumer's waits are timed and probe
+    ``t.is_alive()``, so even a producer killed without a handoff
+    surfaces as an error, never a hang.
     """
     depth = config.ingest_prefetch
     if depth <= 0:  # synchronous mode: no thread, parse inline
@@ -628,7 +694,12 @@ def _prefetch_iter(next_chunk, release=None):
 
     def produce():
         while True:
-            item = next_chunk()
+            try:
+                if _faults.fired("ingest_native", "thread_death"):
+                    raise RuntimeError("injected prefetch-producer death")
+                item = next_chunk()
+            except BaseException as e:  # surface, never silently die
+                item = (_PRODUCER_ERROR, e)
             rows, payload = item
             while not stop.is_set():
                 try:
@@ -647,7 +718,26 @@ def _prefetch_iter(next_chunk, release=None):
     t.start()
     try:
         while True:
-            rows, payload = q.get()
+            while True:
+                try:
+                    rows, payload = q.get(timeout=0.5)
+                    break
+                except queue.Empty:
+                    if not t.is_alive():
+                        # the producer may have put its final item and
+                        # exited between the Empty and the liveness
+                        # probe: drain once more before declaring death
+                        try:
+                            rows, payload = q.get_nowait()
+                            break
+                        except queue.Empty:
+                            raise NativeIngestError(
+                                "prefetch producer thread died without "
+                                "handing off a chunk") from None
+            if rows == _PRODUCER_ERROR:
+                raise NativeIngestError(
+                    f"prefetch producer thread died: {payload!r}"
+                ) from payload
             if rows <= 0:
                 if rows < 0:
                     yield rows, payload
@@ -671,6 +761,14 @@ def _chunk_iter(lib, h):
     def next_chunk():
         data_p = ctypes.POINTER(ctypes.c_double)()
         rows = int(lib.dq_stream_next(h, ctypes.byref(data_p)))
+        if rows > 0 and _faults.fired("ingest_native", "torn_chunk"):
+            # chaos: a short read / torn chunk — the real parse result
+            # is discarded and the failure raised as the native-layer
+            # error class, so engine=auto degrades to the python engine
+            # while an explicit engine="native" request still raises
+            # (the same contract as io_error/thread_death)
+            lib.dq_free(data_p)
+            raise NativeIngestError("injected short-read/torn chunk")
         return rows, (data_p if rows > 0 else None)
 
     return _prefetch_iter(next_chunk, release=lib.dq_free)
@@ -691,6 +789,13 @@ def _bind_chunk_iter(lib, h, nc):
     def next_chunk():
         off = ctypes.c_longlong(0)
         rows = int(lib.dq_stream_next_into(h, ctypes.byref(off)))
+        if rows > 0 and _faults.fired("ingest_native", "torn_chunk"):
+            # chaos: torn chunk in bind mode — values already written to
+            # the bound buffers are abandoned (the pool checkin in
+            # _stream_pinned's finally reclaims them after the producer
+            # quiesces); raised as the native-layer class so the
+            # engine=auto/"native" degrade contract matches io_error
+            raise NativeIngestError("injected short-read/torn chunk")
         flags = _stream_flags(lib, h, nc) if rows > 0 else b""
         return rows, (off.value if rows > 0 else 0, flags)
 

@@ -60,6 +60,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..config import config, float_dtype, int_dtype
+from ..utils import faults as _faults
 from ..utils import observability as _obs
 from ..utils.profiling import counters
 from . import expressions as E
@@ -575,6 +576,13 @@ class _Plan:
         self.donated = tuple(r for r in refs if r in replaced)
         self.kept = tuple(r for r in refs if r not in replaced)
         self.extra_names = tuple(name for name, _ in lowered_extra)
+        # produced columns + projection outputs — the term the cheap
+        # pre-execution memory estimate (_est_flush_bytes) charges per row
+        self.n_outputs = (
+            sum(1 for s in lowered_steps if s[0] == "with_column")
+            + sum(len(s[1]) for s in lowered_steps
+                  if s[0] == "with_columns")
+            + len(lowered_extra))
         self.key = key
         self.n_lits = len(lits)
         # Introspection (observability.CACHES / EXPLAIN ANALYZE): per-plan
@@ -768,6 +776,142 @@ def _unpad_tree(tree, n: int):
     return jax.tree_util.tree_map(lambda a: a[:n], tree)
 
 
+def _flush_budget() -> Optional[int]:
+    """Device-byte budget for ONE flush, or None (the production default,
+    where the check costs one None check + one int check). Sources, in
+    priority order: an injected ``oom`` fault (``utils.faults`` —
+    deterministic shrunken budget, the chaos arm) and an explicit
+    ``spark.audit.deviceBudget`` conf scaled by
+    ``spark.audit.memoryFraction`` (the PR-9 static-bound threshold,
+    promoted here from an audit-time annotation to a live pre-execution
+    sensor). The allocator ``bytes_limit`` is deliberately NOT consulted
+    on the hot path — reading it per flush is backend-API traffic the
+    no-budget case must not pay."""
+    shrunk = _faults.shrunk_budget("oom")
+    if shrunk is not None:
+        return shrunk
+    budget = int(config.audit_device_budget)
+    if budget > 0:
+        return int(budget * float(config.audit_memory_fraction))
+    return None
+
+
+def _est_flush_bytes(plan, data: dict, b: int) -> int:
+    """Cheap, import-free over-approximation of the flush program's
+    resident bytes at bucket ``b``: padded inputs + mask + 2× one
+    engine-float column per produced output (value + one temporary). The
+    precise instrument is the dqaudit jaxpr bound (``analysis/program``),
+    but the flush hot path must never import the analysis package (the
+    PR-9 hot-path pin), so the degrade decision uses this coarser mirror
+    — linear in referenced columns, no tracing, only over-counts the
+    per-row footprint."""
+    total = b   # bool mask
+    out_itemsize = np.dtype(float_dtype()).itemsize
+    for name in plan.kept + plan.donated:
+        a = data[name]
+        width = a.shape[1] if getattr(a, "ndim", 1) == 2 else 1
+        total += b * width * np.dtype(a.dtype).itemsize
+    total += 2 * b * out_itemsize * max(plan.n_outputs, 1)
+    return total
+
+
+def _run_chunked(plan, lit_values, data: dict, mask, n: int,
+                 budget: int, est: int):
+    """Row-chunked execution of an over-budget flush — degrade to bounded
+    memory BEFORE the allocator dies, instead of an OOM backtrace after.
+
+    Sound because the compilable step surface is purely elementwise
+    (strings/UDFs/aggregates never defer; a filter's mask AND is
+    row-local), so slicing rows, replaying the SAME cached plan per
+    slice, and concatenating is semantics-preserving — the chunk rows are
+    a power of two, so all chunks but the tail share one compiled
+    program. Counted ``pipeline.oom_chunked`` + a ``recovery.fallback``
+    event at site ``oom`` (rung ``chunked``)."""
+    counters.increment("pipeline.oom_chunked")
+    # rows per chunk: scale the estimate down to the budget, snap to a
+    # power of two (bucket reuse), floor at the bucket floor so even a
+    # 1-byte injected budget makes progress
+    per_row = max(1.0, est / float(max(n, 1)))
+    m = max(1, int(budget / per_row))
+    m = 1 << max(m.bit_length() - 1, 0)
+    m = max(m, max(int(config.pipeline_min_bucket), 1))
+    m = min(m, n)
+    nchunks = -(-n // m)
+    from ..utils.recovery import RECOVERY_LOG
+
+    RECOVERY_LOG.record(
+        "oom", "fallback", rung="chunked",
+        detail=f"est {est} B > budget {budget} B; "
+               f"{nchunks} chunk(s) of {m} rows")
+    mask = jnp.asarray(mask, jnp.bool_)
+    before = plan.traces
+    pieces_changed: dict[str, list] = {}
+    pieces_mask: list = []
+    pieces_extras: dict[str, list] = {}
+    bucket_counts: dict[int, int] = {}
+    with _obs.span("frame.pipeline.flush", cat="frame", rows=n, bucket=m,
+                   chunks=nchunks, oom_budget=budget, est_bytes=est):
+        for start in range(0, n, m):
+            rows = min(start + m, n) - start
+            cb = bucket_size(rows)
+            kept = {name: _pad(data[name][start:start + rows], cb,
+                               fresh=False)
+                    for name in plan.kept}
+            donated = tuple(_pad(data[name][start:start + rows], cb,
+                                 fresh=plan.donates)
+                            for name in plan.donated)
+            mask_in = _pad(mask[start:start + rows], cb,
+                           fresh=plan.donates)
+            if plan.example is None:
+                # same idempotent recording as the unchunked path — a
+                # plan whose FIRST execution is chunked must still be
+                # enumerable by the PR-9 program auditor
+                plan.example = (
+                    {k: jax.ShapeDtypeStruct(v.shape, v.dtype)
+                     for k, v in kept.items()},
+                    tuple(jax.ShapeDtypeStruct(v.shape, v.dtype)
+                          for v in donated),
+                    jax.ShapeDtypeStruct(mask_in.shape, mask_in.dtype),
+                    lit_values)
+            with warnings.catch_warnings():
+                # same unusable-donation suppression as the unchunked
+                # dispatch — chunked compiles must not spam stderr
+                warnings.filterwarnings(
+                    "ignore", message=".*[Dd]onated.*",
+                    category=UserWarning)
+                changed, new_mask, extras = plan.fn(
+                    kept, donated, mask_in, lit_values)
+            if cb != rows:
+                changed, new_mask, extras = _unpad_tree(
+                    (changed, new_mask, extras), rows)
+            bucket_counts[cb] = bucket_counts.get(cb, 0) + 1
+            for k, v in changed.items():
+                pieces_changed.setdefault(k, []).append(v)
+            pieces_mask.append(new_mask)
+            for k, v in extras.items():
+                pieces_extras.setdefault(k, []).append(v)
+    compiled = plan.traces - before
+    if nchunks > compiled:
+        counters.increment("pipeline.hit", nchunks - compiled)
+    with _CACHE_LOCK:   # per-entry stats stay dispatch-coherent
+        plan.compiles += compiled
+        plan.hits += nchunks - compiled
+        # per-BUCKET tallies (the tail chunk's smaller bucket included):
+        # the retrace detector's expected_traces is len(buckets), so
+        # folding the tail into m would misread the tail compile as a
+        # retrace leak
+        for cb, c in bucket_counts.items():
+            plan.buckets[cb] = plan.buckets.get(cb, 0) + c
+
+    def cat(vs):
+        return vs[0] if len(vs) == 1 else jnp.concatenate(vs)
+
+    new_data = dict(data)
+    new_data.update({k: cat(vs) for k, vs in pieces_changed.items()})
+    return (new_data, cat(pieces_mask),
+            {k: cat(vs) for k, vs in pieces_extras.items()})
+
+
 def run_pipeline(data: dict, mask, n: int, steps, extra=()):
     """Execute pending ``steps`` (+ ``extra`` projection expressions) over
     the base column dict as one compiled program.
@@ -788,6 +932,21 @@ def run_pipeline(data: dict, mask, n: int, steps, extra=()):
     try:
         b = bucket_size(n)
         plan, lit_values = _lookup_plan(steps, tuple(extra), schema)
+        # Pre-execution memory degrade (ISSUE 11 / arxiv 2206.14148):
+        # when a device-byte budget is known (explicit
+        # spark.audit.deviceBudget conf, or an injected `oom` fault
+        # shrinking it) and the static estimate for this flush exceeds
+        # it, execute row-chunked BEFORE the allocator can die — the
+        # production default (no budget, no fault plan) costs one int
+        # check and one None check.
+        if n > 0:   # n==0 first, so a zero-row flush (where chunking is
+            # meaningless) can never burn a one-shot injected oom fault
+            budget = _flush_budget()
+            if budget is not None:
+                est = _est_flush_bytes(plan, data, b)
+                if est > budget:
+                    return _run_chunked(plan, lit_values, data, mask, n,
+                                        budget, est)
         before = plan.traces
         kept = {name: _pad(data[name], b, fresh=False)
                 for name in plan.kept}
@@ -817,12 +976,19 @@ def run_pipeline(data: dict, mask, n: int, steps, extra=()):
                 "frame.pipeline.flush", cat="frame", steps=len(steps),
                 outputs=len(extra), rows=n, bucket=b)
                 if _obs.TRACER.enabled else None)
+            # chaos hook at the dispatch boundary (one None check without
+            # a plan): a due device_error raises HERE — inside the flush
+            # span, so EXPLAIN ANALYZE attributes the fault to the
+            # operator whose flush absorbed it — and escapes un-wrapped
+            # for the Frame._flush recovery ladder below.
             if span_cm is None:
+                _faults.inject("pipeline_flush")
                 changed, new_mask, extras = plan.fn(
                     kept, donated, mask_in, lit_values)
                 compiled = plan.traces > before
             else:
                 with span_cm as sp:
+                    _faults.inject("pipeline_flush")
                     changed, new_mask, extras = plan.fn(
                         kept, donated, mask_in, lit_values)
                     compiled = plan.traces > before
@@ -843,6 +1009,12 @@ def run_pipeline(data: dict, mask, n: int, steps, extra=()):
         return new_data, new_mask, extras
     except PipelineError:
         counters.increment("pipeline.fallback")
+        raise
+    except jax.errors.JaxRuntimeError:
+        # A DEVICE fault (real or injected), not a compiler failure: it
+        # escapes un-wrapped so the Frame._flush degradation ladder can
+        # retry-then-degrade it through the recovery engine — wrapping it
+        # as PipelineError would silently eat it as an eager fallback.
         raise
     except Exception as e:          # any jax/trace surprise → eager replay
         counters.increment("pipeline.fallback")

@@ -83,6 +83,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..config import config, float_dtype, int_dtype
+from ..utils import faults as _faults
 from ..utils import observability as _obs
 from ..utils.profiling import counters
 from .compiler import bucket_size, dtype_tag, pad_rows, plan_namespace_tag
@@ -108,12 +109,29 @@ def try_device(op: str, thunk):
     pipeline compiler's flush lock: without it, two threads racing the
     same plan key would both trace (one compile wasted) and the
     compile-delta heuristic behind ``grouped.compile``/``grouped.hit``
-    attribution would cross-label their counters and span verdicts."""
+    attribution would cross-label their counters and span verdicts.
+
+    Degradation ladder (ISSUE 11): a DEVICE fault in the segment-reduce
+    program — a real ``XlaRuntimeError`` at the group-count sync, or an
+    injected ``grouped_flush`` fault — degrades THIS op one level to the
+    host-numpy lowering, recorded as a ``recovery.fallback`` event (site
+    ``grouped_flush``, rung ``host``) + ``grouped.fault_fallback``; the
+    query lives. No fault plan installed = one ``is None`` check."""
     if not config.grouped_exec:
         return None
     try:
         with _EXEC_LOCK:
+            _faults.inject("grouped_flush")
             out = thunk()
+    except jax.errors.JaxRuntimeError as e:
+        from ..utils.recovery import RECOVERY_LOG
+
+        RECOVERY_LOG.record(
+            "grouped_flush", "fallback", rung="host",
+            cause=f"{type(e).__name__}: {e}",
+            detail=f"device {op} degraded to the host-numpy lowering")
+        counters.increment("grouped.fault_fallback")
+        out = None
     except Exception as e:
         logger.debug("device %s fell back to host: %s", op, e)
         out = None

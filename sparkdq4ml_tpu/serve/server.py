@@ -68,6 +68,7 @@ import time
 from typing import Any, Optional
 
 from ..config import CONF_FALSE
+from ..utils import faults as _faults
 from ..utils import observability as _obs
 from ..utils.profiling import counters
 from ..utils.recovery import CircuitBreaker
@@ -154,8 +155,8 @@ class _Job:
     attempts are reported back so the loser can record ``late_result``."""
 
     __slots__ = ("work", "tenant", "tag", "deadline_s", "deadline_ts",
-                 "t_submit", "est_bytes", "collect_stats", "_event",
-                 "_lock", "result")
+                 "t_submit", "est_bytes", "collect_stats", "attempts",
+                 "_event", "_lock", "result")
 
     def __init__(self, work, tenant, tag, deadline_s, est_bytes,
                  collect_stats):
@@ -168,6 +169,7 @@ class _Job:
                             else self.t_submit + float(deadline_s))
         self.est_bytes = est_bytes
         self.collect_stats = collect_stats
+        self.attempts = 0      # executions so far (the requeue ladder)
         self._event = threading.Event()
         self._lock = threading.Lock()
         self.result: Optional[QueryResult] = None
@@ -526,6 +528,24 @@ class QueryServer:
             from ..utils import meminfo
 
             live = meminfo.live_bytes()
+        # serve_admit chaos hooks (one None check without a plan), run
+        # BEFORE the scheduler lock — a firing hook logs and annotates,
+        # and log I/O under self._cond would serialize every submitter
+        # and worker (the same lock-hygiene rule that keeps the
+        # live-array census above outside it). A due breaker_trip forces
+        # the tenant's breaker open — THIS submission sheds through the
+        # normal gate and recovery follows the normal half-open path; a
+        # due oom injects an allocator-census-OOM memory rejection
+        # (works without a configured memory limit, so the gate's
+        # refusal path is soak-testable everywhere).
+        injected = None
+        if _faults.active() is not None:
+            if _faults.fired("serve_admit", "breaker_trip"):
+                self.breaker.trip(self.admission.breaker_key(tenant))
+            if _faults.fired("serve_admit", "oom"):
+                injected = AdmissionController._reject(
+                    "memory", "injected allocator-census OOM "
+                    "(serve_admit chaos)")
         with self._cond:
             if not self._accepting:
                 raise RuntimeError("QueryServer is not running "
@@ -536,13 +556,14 @@ class QueryServer:
             # submissions under unique tenant names cannot grow
             # _tenants/_rr (and the scheduler scan) without bound.
             existing = self._tenants.get(tenant)
-            verdict = self.admission.admit(
-                tenant,
-                existing.quota if existing is not None
-                else self.default_quota,
-                self._queued_total,
-                len(existing.queue) if existing is not None else 0,
-                est_bytes=est_bytes, live_bytes=live)
+            verdict = injected if injected is not None \
+                else self.admission.admit(
+                    tenant,
+                    existing.quota if existing is not None
+                    else self.default_quota,
+                    self._queued_total,
+                    len(existing.queue) if existing is not None else 0,
+                    est_bytes=est_bytes, live_bytes=live)
             if verdict is not None:
                 job.resolve(QueryResult(
                     status=verdict.status, tenant=tenant, tag=tag,
@@ -606,15 +627,22 @@ class QueryServer:
                  else _plan_namespace(job.tenant))
         stats = None
         status, value, error = "ok", None, ""
+        job.attempts += 1
         try:
             with ns_cm, _obs.span("serve.query", cat="serve",
                                   tenant=job.tenant, tag=job.tag):
+                # serve_exec chaos hook (one None check without a plan):
+                # a due device_error raises the same XlaRuntimeError
+                # class a real worker device fault would
+                _faults.inject("serve_exec")
                 if job.collect_stats:
                     with _obs.query_stats() as stats:
                         value = _materialize(job.work(state.context))
                 else:
                     value = _materialize(job.work(state.context))
         except Exception as e:    # noqa: BLE001 - a tenant's bad query
+            if self._maybe_requeue(job, state, e):
+                return             # re-enters the tenant queue; no finish
             status, error = "error", f"{type(e).__name__}: {e}"
         t_end = time.perf_counter()
         exec_ms = (t_end - t_start) * 1e3
@@ -632,6 +660,86 @@ class QueryServer:
             stats=stats)
         self._finish(job, result, executed=True, queue_ms=queue_ms,
                      exec_ms=exec_ms, e2e_ms=e2e_ms)
+
+    def _maybe_requeue(self, job: _Job, state: _TenantState,
+                       err: BaseException) -> bool:
+        """Deadline-aware requeue — the serve rung of the degradation
+        ladder (ISSUE 11). A worker exception of the RETRYABLE class
+        (``XlaRuntimeError`` / recovery ``DeadlineExceeded`` — never a
+        tenant's bad SQL, which is deterministic and must fail fast)
+        re-enters the tenant's queue while the per-tenant
+        :class:`~..utils.recovery.RetryPolicy` grants attempts AND the
+        job's deadline has headroom for the policy backoff, which is
+        slept in this worker before the requeue (see below). Every
+        requeued attempt counts against the tenant's breaker, so a
+        persistently faulting tenant still trips to shed. Returns True
+        when the job was requeued (the caller must not resolve it)."""
+        import jax
+
+        from ..utils import recovery as _rec
+
+        if not isinstance(err, (jax.errors.JaxRuntimeError,
+                                _rec.DeadlineExceeded)):
+            return False
+        cause = f"{type(err).__name__}: {err}"
+        policy = self._retry_policy(job.tenant)
+        if job.attempts >= policy.max_attempts:
+            _rec.RECOVERY_LOG.record(
+                "serve_exec", "exhausted", attempt=job.attempts,
+                rung="requeue", cause=cause)
+            return False
+        wait = policy.backoff(job.attempts, "serve_exec")
+        if job.deadline_ts is not None \
+                and time.perf_counter() + wait >= job.deadline_ts:
+            _rec.RECOVERY_LOG.record(
+                "serve_exec", "deadline", attempt=job.attempts,
+                rung="requeue", cause=cause,
+                detail="no deadline headroom; failing instead of requeue")
+            return False
+        if wait > 0.0:
+            # The backoff is served HERE, in the failing worker, before
+            # the job re-enters the queue: with an idle worker slot an
+            # appendleft'ed job would otherwise re-execute within
+            # microseconds and exhaust every attempt while a transient
+            # fault is still present. The job is not yet queued, so no
+            # other worker can grab it early; the cost is one worker
+            # slot for the (policy-bounded, deterministic-jitter) wait —
+            # the same in-place sleep resilient_call makes.
+            policy.sleep(wait)
+        with self._cond:
+            if not self._accepting:
+                return False       # stopping: resolve as the error it is
+            state.queue.appendleft(job)
+            self._queued_total += 1
+            self._update_gauges_locked()
+            self._cond.notify()
+        # Count the failed attempt against the tenant's breaker ONLY for
+        # attempts that actually requeue: a non-requeued failure resolves
+        # as an error result and _finish records it there — counting in
+        # both places charged the final attempt twice and tripped the
+        # breaker ~2x faster than its configured threshold.
+        self.breaker.record_failure(self.admission.breaker_key(job.tenant))
+        counters.increment("serve.requeue")
+        _rec.RECOVERY_LOG.record(
+            "serve_exec", "retry", attempt=job.attempts, rung="requeue",
+            cause=cause, backoff_s=wait)
+        return True
+
+    def _retry_policy(self, tenant: str):
+        """Per-tenant retry policy for the requeue ladder: global
+        ``spark.recovery.*`` keys, overlaid by ``spark.recovery.
+        serve_exec.*``, overlaid by ``spark.recovery.serve_exec.
+        <tenant>.*`` — one misbehaving tenant can be tuned (or starved of
+        retries) without touching the others."""
+        from ..utils.recovery import RetryPolicy
+
+        conf = self.session.conf if self.session is not None else {}
+        kw = RetryPolicy._conf_kwargs(conf, "spark.recovery.")
+        kw.update(RetryPolicy._conf_kwargs(
+            conf, "spark.recovery.serve_exec."))
+        kw.update(RetryPolicy._conf_kwargs(
+            conf, f"spark.recovery.serve_exec.{tenant}."))
+        return RetryPolicy(**kw)
 
     def _finish(self, job: _Job, result: QueryResult, *, executed: bool,
                 queue_ms: Optional[float] = None,

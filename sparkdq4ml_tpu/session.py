@@ -291,6 +291,18 @@ class TpuSession:
             if "spark.ingest.simd" in self.conf:
                 _set("ingest_simd",
                      str(self.conf["spark.ingest.simd"]).lower())
+            # Chaos-soak defaults (scripts/chaos_soak.py), session-scoped
+            # like everything above:
+            #     .config("spark.chaos.seed", 7)        # schedule base
+            #     .config("spark.chaos.seeds", 50)      # seeds to sweep
+            #     .config("spark.chaos.soakSeconds", 30) # per-seed floor
+            if "spark.chaos.seed" in self.conf:
+                _set("chaos_seed", int(self.conf["spark.chaos.seed"]))
+            if "spark.chaos.seeds" in self.conf:
+                _set("chaos_seeds", int(self.conf["spark.chaos.seeds"]))
+            if "spark.chaos.soakSeconds" in self.conf:
+                _set("chaos_soak_s",
+                     float(self.conf["spark.chaos.soakSeconds"]))
             if saved:
                 self._pipeline_saved = saved
 
@@ -665,7 +677,8 @@ class TpuSession:
                     _ACTIVE._init_observability()
                 if any(k.startswith(("spark.pipeline.", "spark.groupedExec.",
                                      "spark.explain.", "spark.serve.",
-                                     "spark.ingest."))
+                                     "spark.ingest.", "spark.audit.",
+                                     "spark.chaos."))
                        for k in self._conf):
                     _ACTIVE._init_pipeline()
                 return _ACTIVE

@@ -1910,8 +1910,11 @@ def _execute_explain(body: str, cat, analyze: bool):
                        + delta.get("grouped.hit", 0)),
         "fallbacks": (delta.get("pipeline.fallback", 0)
                       + delta.get("grouped.fallback", 0)),
+        # action-level keys only: the per-site mirrors
+        # (recovery.retry.<site>) would double-count every event
         "recovery_events": sum(v for k, v in delta.items()
-                               if k.startswith("recovery.")),
+                               if k.startswith("recovery.")
+                               and "." not in k[len("recovery."):]),
     }
     if _cfg.explain_memory:
         from ..utils import meminfo as _meminfo

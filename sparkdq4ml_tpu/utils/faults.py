@@ -24,14 +24,23 @@ Failure classes (``kind``):
   lost-worker scenario; ``parallel.mesh.normalize_mesh`` semantics apply
   to whatever survives.
 
-Sites instrumented in production code: ``gram_sharded``
-(``parallel.distributed.compute_gram``'s sharded path), ``fit_packed``
-(the packed linear-fit dispatch in ``models.regression``), ``solver``
-(``models.solvers.solve`` and the packed fit's result pytree), ``fit``
-(``recovery.fit_or_resume``'s fit call), and ``mesh`` (session mesh
-construction). Injection happens at host-level dispatch boundaries only —
-never inside a traced/jitted function, where a Python-level raise would
-fire at trace time, not run time.
+Sites instrumented in production code are registered in
+:data:`FAULT_SITES` (the dqlint ``fault-site`` rule's vocabulary — a
+hook call naming an unregistered site would silently never fire). The
+model-fit sites (``gram_sharded``/``fit_packed``/``solver``/``fit``/
+``mesh``) came with PR 1; the post-PR-1 subsystems each carry their own:
+``pipeline_flush`` (the fused expression-pipeline dispatch,
+``ops/compiler.py`` + the ``Frame._flush`` ladder), ``grouped_flush``
+(the segment-reduce grouped program, ``ops/segments.try_device``),
+``ingest_native`` (the native streaming CSV reader,
+``frame/native_csv.py``: I/O error, torn chunk, prefetch-thread death,
+bind-pool exhaustion), ``serve_exec``/``serve_admit`` (the QueryServer
+worker and admission gates, ``serve/``), and ``oom`` (memory pressure as
+a schedulable fault: a shrunken device budget makes the pre-execution
+static bound trip and the flush degrade to row-chunked execution).
+Injection happens at host-level dispatch boundaries only — never inside
+a traced/jitted function, where a Python-level raise would fire at trace
+time, not run time.
 
 Activation: programmatic (:func:`install_plan`, or the
 :func:`inject_faults` context manager tests use) or env-driven — set
@@ -67,7 +76,33 @@ logger = logging.getLogger("sparkdq4ml_tpu.faults")
 
 ENV_VAR = "SPARKDQ4ML_FAULTS"
 
-KINDS = ("device_error", "nan", "preempt", "device_drop")
+KINDS = ("device_error", "nan", "preempt", "device_drop",
+         "io_error", "torn_chunk", "thread_death", "pool_exhaust",
+         "breaker_trip", "oom")
+
+#: THE fault-site registry: site → the kinds its production hooks honor.
+#: Every ``inject``/``corrupt``/``fired``/``shrunk_budget``/
+#: ``degrade_mesh`` call site must name a key of this dict — enforced
+#: statically by the dqlint ``fault-site`` rule
+#: (``analysis/rules/fault_sites.py``), because the plan matches sites by
+#: string equality and a typo'd site silently never fires (the chaos test
+#: behind it then passes vacuously). Kept a PURE LITERAL so the rule can
+#: ``ast.literal_eval`` it without importing the engine. The README
+#: "Chaos & degradation ladders" table documents each site's ladder.
+FAULT_SITES = {
+    "gram_sharded": ("device_error", "preempt", "nan"),
+    "fit_packed": ("device_error", "preempt", "nan"),
+    "solver": ("device_error", "preempt", "nan"),
+    "fit": ("device_error", "preempt"),
+    "mesh": ("device_drop",),
+    "pipeline_flush": ("device_error", "nan"),
+    "grouped_flush": ("device_error",),
+    "ingest_native": ("io_error", "torn_chunk", "thread_death",
+                      "pool_exhaust"),
+    "serve_exec": ("device_error",),
+    "serve_admit": ("breaker_trip", "oom"),
+    "oom": ("oom",),
+}
 
 
 def _jax_runtime_error_base():
@@ -82,6 +117,14 @@ class Preemption(RuntimeError):
     Deliberately NOT a ``JaxRuntimeError``: retry loops must not swallow
     it as a transient device fault — ``recovery.fit_or_resume`` owns it
     (checkpoint what is done, resume from the artifact)."""
+
+
+class InjectedIOError(OSError):
+    """Simulated I/O failure in the native ingest layer (a flaky disk, a
+    truncated network mount read). An ``OSError`` subclass — the exact
+    class a real mid-read failure surfaces as — but deliberately NOT a
+    ``FileNotFoundError``: a missing file is a permanent, user-visible
+    condition the ingest ladder must re-raise, not degrade around."""
 
 
 # The injected device error must be catchable exactly where real XLA
@@ -200,6 +243,22 @@ class FaultPlan:
     def _record(self, spec: FaultSpec, attempt: int):
         with self._lock:
             self._fired.append((spec.site, spec.kind, attempt))
+        from . import profiling
+
+        profiling.counters.increment("faults.injected")
+        profiling.counters.increment(f"faults.injected.{spec.site}")
+        # Annotate the enclosing span: EXPLAIN ANALYZE copies every
+        # ``recovery_*`` span attribute onto its operator node, so the
+        # plan shows WHICH operator absorbed the fault (e.g. the
+        # FusedStage whose flush span was live when this fired).
+        try:
+            from . import observability as _obs
+
+            if _obs.TRACER.enabled:
+                _obs.current_span().set(
+                    recovery_fault=f"{spec.site}:{spec.kind}")
+        except Exception:       # annotation must never mask the fault
+            pass
         logger.warning("fault injected: site=%s kind=%s attempt=%d",
                        spec.site, spec.kind, attempt)
 
@@ -274,24 +333,72 @@ class inject_faults:
 
 
 # -- site hooks (the production instrumentation points) ---------------------
+_RAISE_KINDS = ("device_error", "preempt", "io_error")
+
+
 def inject(site: str) -> None:
     """Raise the scheduled failure for ``site``, if any. The per-site
     attempt counter ticks on every call that has a matching raise-class
     spec, so a retry loop naturally walks past an attempt-1-only fault on
-    its second try."""
+    its second try. Raise classes: ``device_error`` →
+    :class:`InjectedDeviceError` (a ``JaxRuntimeError``), ``preempt`` →
+    :class:`Preemption`, ``io_error`` → :class:`InjectedIOError` (an
+    ``OSError``, the native-ingest failure class)."""
     plan = active()
-    if plan is None or not plan._has(site, ("device_error", "preempt")):
+    if plan is None or not plan._has(site, _RAISE_KINDS):
         return
     attempt = plan._tick(site, "raise")
-    spec = plan._due(site, attempt, ("device_error", "preempt"))
+    spec = plan._due(site, attempt, _RAISE_KINDS)
     if spec is None:
         return
     plan._record(spec, attempt)
     if spec.kind == "preempt":
         raise Preemption(
             f"injected preemption at {site!r} (attempt {attempt})")
+    if spec.kind == "io_error":
+        raise InjectedIOError(
+            f"injected I/O error at {site!r} (attempt {attempt})")
     raise injected_device_error_class()(
         f"injected device error at {site!r} (attempt {attempt})")
+
+
+def fired(site: str, kind: str) -> bool:
+    """Generic due-test hook for the non-raising fault kinds — the chaos
+    switchpoints that alter a decision instead of throwing (a torn ingest
+    chunk, a dying prefetch thread, an exhausted buffer pool, a tripped
+    serving breaker, an admission-gate OOM). Each ``kind`` keeps its own
+    per-site attempt counter, so co-located hooks of different kinds
+    never steal each other's attempts. One ``is None`` check when no plan
+    is installed — the same zero-cost contract as :func:`inject`."""
+    plan = active()
+    if plan is None or not plan._has(site, (kind,)):
+        return False
+    attempt = plan._tick(site, kind)
+    spec = plan._due(site, attempt, (kind,))
+    if spec is None:
+        return False
+    plan._record(spec, attempt)
+    return True
+
+
+def shrunk_budget(site: str) -> Optional[int]:
+    """Device-byte budget override when an ``oom`` fault is due at
+    ``site`` — "memory pressure as a schedulable fault" (arxiv
+    2206.14148): the flush path treats the returned budget exactly like a
+    conf-shrunken ``spark.audit.deviceBudget``, so the est-peak-over-
+    budget → row-chunked degrade runs under test without touching real
+    allocator state. The spec's ``n`` parameter carries the budget in
+    bytes (``oom:oom:1:n=65536``); the default ``n=1`` is an always-over
+    1-byte budget (maximum chunking). ``None`` = no fault due."""
+    plan = active()
+    if plan is None or not plan._has(site, ("oom",)):
+        return None
+    attempt = plan._tick(site, "oom")
+    spec = plan._due(site, attempt, ("oom",))
+    if spec is None:
+        return None
+    plan._record(spec, attempt)
+    return max(1, int(spec.n))
 
 
 def corrupt(site: str, tree):

@@ -128,6 +128,12 @@ class RecoveryLog:
         from . import profiling
 
         profiling.counters.increment(f"recovery.{action}")
+        if site:
+            # per-site mirror (recovery.retry.pipeline_flush, …): the
+            # Prometheus scrape can attribute recovery activity to the
+            # subsystem that absorbed it — cardinality bounded by the
+            # FAULT_SITES registry, not by data
+            profiling.counters.increment(f"recovery.{action}.{site}")
         level = (logging.INFO if action in ("resumed", "checkpoint",
                                             "recovered")
                  else logging.WARNING)
@@ -301,6 +307,15 @@ class CircuitBreaker:
                 opened = self._clock()
             self._state[key] = (fails, opened)
             return just_opened
+
+    def trip(self, key: str) -> None:
+        """Force the breaker OPEN for ``key`` now, as if
+        ``failure_threshold`` consecutive failures just landed — the
+        ``serve_admit:breaker_trip`` chaos hook. Recovery follows the
+        normal path: the cooldown admits a half-open trial, and a success
+        closes the key (``record_success``)."""
+        with self._lock:
+            self._state[key] = (self.failure_threshold, self._clock())
 
     def reset(self, key: Optional[str] = None) -> None:
         with self._lock:

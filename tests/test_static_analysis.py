@@ -622,6 +622,123 @@ class TestLegacyPortedRules:
 
 
 # ---------------------------------------------------------------------------
+# fault-site: chaos hook call sites name registered sites/kinds
+# ---------------------------------------------------------------------------
+
+_FAULTS_STUB = """
+    KINDS = ("device_error", "nan", "torn_chunk")
+
+    FAULT_SITES = {
+        "pipeline_flush": ("device_error", "nan"),
+        "ingest_native": ("torn_chunk",),
+    }
+
+    def inject(site):
+        pass
+
+    def corrupt(site, tree):
+        return tree
+
+    def fired(site, kind):
+        return False
+    """
+
+
+class TestFaultSiteRule:
+    def _tree(self, tmp_path, body):
+        return findings_for(tmp_path, {
+            "utils/faults.py": _FAULTS_STUB,
+            "frame/mod.py": body}, ["fault-site"])
+
+    def test_registered_literal_sites_are_quiet(self, tmp_path):
+        f = self._tree(tmp_path, """
+            from ..utils import faults as _faults
+
+            def flush():
+                _faults.inject("pipeline_flush")
+                if _faults.fired("ingest_native", "torn_chunk"):
+                    return None
+                return _faults.corrupt("pipeline_flush", {})
+            """)
+        assert f == []
+
+    def test_typod_site_flagged(self, tmp_path):
+        f = self._tree(tmp_path, """
+            from ..utils import faults as _faults
+
+            def flush():
+                _faults.inject("pipleine_flush")
+            """)
+        assert len(f) == 1 and "not registered" in f[0].message
+
+    def test_computed_site_flagged(self, tmp_path):
+        f = self._tree(tmp_path, """
+            from ..utils import faults as _faults
+
+            def flush(site):
+                _faults.inject(site)
+            """)
+        assert len(f) == 1 and "LITERAL" in f[0].message
+
+    def test_unregistered_kind_flagged(self, tmp_path):
+        f = self._tree(tmp_path, """
+            from ..utils import faults as _faults
+
+            def flush():
+                _faults.fired("ingest_native", "thread_death")
+            """)
+        assert len(f) == 1 and "thread_death" in f[0].message
+
+    def test_keyword_form_is_checked_too(self, tmp_path):
+        f = self._tree(tmp_path, """
+            from ..utils import faults as _faults
+
+            def flush():
+                _faults.inject(site="pipeline_flush")      # ok
+                _faults.fired("ingest_native", kind="thread_deth")
+            """)
+        assert len(f) == 1 and "thread_deth" in f[0].message
+
+    def test_bare_import_form_is_matched(self, tmp_path):
+        f = self._tree(tmp_path, """
+            from ..utils.faults import inject
+
+            def flush():
+                inject("nope_site")
+            """)
+        assert len(f) == 1 and "nope_site" in f[0].message
+
+    def test_pragma_suppresses(self, tmp_path):
+        f = self._tree(tmp_path, """
+            from ..utils import faults as _faults
+
+            def flush():
+                _faults.inject("dynamic_site")  # dqlint: ok(fault-site): test-only site
+            """)
+        assert f == []
+
+    def test_missing_registry_is_a_finding(self, tmp_path):
+        f = findings_for(tmp_path, {
+            "utils/faults.py": "KINDS = ()\n",
+            "frame/mod.py": """
+                from ..utils import faults as _faults
+
+                def flush():
+                    _faults.inject("pipeline_flush")
+                """}, ["fault-site"])
+        assert len(f) == 1 and "FAULT_SITES" in f[0].message
+
+    def test_partial_tree_without_faults_module_is_quiet(self, tmp_path):
+        f = findings_for(tmp_path, {"frame/mod.py": """
+            from ..utils import faults as _faults
+
+            def flush():
+                _faults.inject("whatever")
+            """}, ["fault-site"])
+        assert f == []
+
+
+# ---------------------------------------------------------------------------
 # the tier-1 gate: whole tree clean through the CLI
 # ---------------------------------------------------------------------------
 
@@ -664,5 +781,5 @@ class TestCheckStaticGate:
                            capture_output=True, text=True, timeout=60)
         assert p.returncode == 0
         for name in ("host-sync", "collective-guard", "conf-key", "noop",
-                     "lock-order", "logger-ns", "numpy-free"):
+                     "lock-order", "fault-site", "logger-ns", "numpy-free"):
             assert name in p.stdout
