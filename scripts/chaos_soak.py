@@ -19,7 +19,19 @@ contract:
 * **coherent counters** — every admitted job resolves exactly once
   (``serve.admit`` == complete + error + deadline_exceeded deltas) and
   every ``recovery.<action>`` counter delta matches the structured
-  ``RECOVERY_LOG`` event stream.
+  ``RECOVERY_LOG`` event stream;
+* **live telemetry under fire** — the HTTP observability endpoint
+  (``serve/http.py``) runs on an ephemeral port with a background
+  scraper hitting ``/metrics`` + ``/healthz`` every 100 ms for the whole
+  workload: zero scrape failures/hangs, and the admit == complete +
+  error + deadline identity is asserted from the SCRAPED Prometheus
+  text, not in-process state;
+* **stats persistence degrades, never crashes** — each seed writes the
+  plan-statistics snapshot (``utils/statstore.py``) with the
+  ``stats_persist`` fault site armed: an injected io_error/torn write
+  degrades to in-memory-only with coherent ``recovery.*`` counters, and
+  the on-disk snapshot stays loadable (a torn temp file never replaces
+  it).
 
 Schedules are pure functions of the seed (the ``utils.faults`` crc32
 discipline), so a failing seed replays exactly with
@@ -73,6 +85,8 @@ _CANDIDATES = (
     ("oom", "oom", 0.25, ":n=64"),
     ("solver", "device_error", 0.05, ""),
     ("fit_packed", "device_error", 0.05, ""),
+    ("stats_persist", "io_error", 0.40, ""),
+    ("stats_persist", "torn_chunk", 0.40, ""),
 )
 
 
@@ -87,6 +101,8 @@ _ROTATION = (
     ("ingest_native", "io_error", ""),
     ("ingest_native", "pool_exhaust", ""),
     ("pipeline_flush", "nan", ""),
+    ("stats_persist", "io_error", ""),
+    ("stats_persist", "torn_chunk", ""),
 )
 
 
@@ -166,6 +182,79 @@ def _golden(value) -> bool:
             / GOLDEN_RMSE < 0.01)
 
 
+SCRAPE_INTERVAL_S = 0.1
+
+
+def _parse_scrape(text: str) -> dict:
+    """``{metric_name: value}`` from a Prometheus text scrape (samples
+    only; HELP/TYPE and labelled series skipped)."""
+    out = {}
+    for line in text.splitlines():
+        if line.startswith("#") or "{" in line:
+            continue
+        parts = line.split()
+        if len(parts) == 2:
+            try:
+                out[parts[0]] = float(parts[1])
+            except ValueError:
+                pass
+    return out
+
+
+class _Scraper:
+    """Background scraper hammering the live telemetry endpoint every
+    ``SCRAPE_INTERVAL_S`` for the duration of one seed — the "telemetry
+    under fire" arm: scrapes must keep answering (bounded, never a hang)
+    while 32 clients and the fault plan do their worst, and the final
+    scraped text is what the coherence identity is asserted from."""
+
+    def __init__(self, port: int):
+        self.base = f"http://127.0.0.1:{port}"
+        self.scrapes = 0
+        self.failures: list[str] = []
+        self.last_metrics: dict = {}
+        self.last_health: dict = {}
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="chaos-scraper")
+
+    def scrape_once(self) -> None:
+        import urllib.request
+
+        with urllib.request.urlopen(self.base + "/metrics",
+                                    timeout=10) as resp:
+            self.last_metrics = _parse_scrape(resp.read().decode())
+        with urllib.request.urlopen(self.base + "/healthz",
+                                    timeout=10) as resp:
+            self.last_health = json.loads(resp.read().decode())
+        self.scrapes += 1
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.scrape_once()
+            except Exception as e:
+                # /healthz answers 503 while degraded — that is a VALID
+                # scrape (the balancer semantics), not a failure
+                import urllib.error
+
+                if isinstance(e, urllib.error.HTTPError) \
+                        and e.code == 503:
+                    self.last_health = json.loads(e.read().decode())
+                    self.scrapes += 1
+                else:
+                    self.failures.append(f"{type(e).__name__}: {e}")
+            self._stop.wait(SCRAPE_INTERVAL_S)
+
+    def start(self) -> "_Scraper":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=30)
+
+
 def run_seed(session, seed: int, clients: int, queries: int, workers: int,
              data_path: str, soak_s: float, log=print) -> dict:
     """One seeded chaos round; returns the per-seed verdict dict with a
@@ -182,7 +271,14 @@ def run_seed(session, seed: int, clients: int, queries: int, workers: int,
     server = QueryServer(
         session, workers=workers, max_queue=4 * clients,
         default_quota=TenantQuota(max_in_flight=2, max_queued=queries + 2),
-        breaker_threshold=3, breaker_cooldown=BREAKER_COOLDOWN_S).start()
+        breaker_threshold=3, breaker_cooldown=BREAKER_COOLDOWN_S,
+        metrics_port=0, slo_p99_ms=1000.0).start()
+    scraper = _Scraper(server.telemetry.port).start()
+    try:
+        scraper.scrape_once()          # baseline from the wire
+    except Exception as e:
+        violations.append(f"baseline scrape failed: {e}")
+    scrape0 = dict(scraper.last_metrics)
     plan = faults.install_plan(faults.parse_plan(schedule, seed=seed))
     results: list = []
     res_lock = threading.Lock()
@@ -213,6 +309,27 @@ def run_seed(session, seed: int, clients: int, queries: int, workers: int,
         t.start()
     for t in threads:
         t.join()
+    # stats-persistence arm: write the plan-stats snapshot WHILE the
+    # fault plan is armed — a due stats_persist io_error/torn write must
+    # degrade to in-memory-only (save returns False, recovery event
+    # logged), and whatever is on disk must stay a loadable snapshot
+    from sparkdq4ml_tpu.utils import statstore
+
+    stats_path = os.path.join(REPO, f".chaos_stats_{os.getpid()}.jsonl")
+    try:
+        statstore.STORE.save(stats_path, merge=True)
+    except Exception as e:
+        violations.append(
+            f"stats_persist save raised {type(e).__name__}: {e} "
+            "(must degrade, never crash)")
+    if os.path.exists(stats_path):
+        try:
+            with open(stats_path) as f:
+                header = json.loads(f.readline())
+            assert header.get("version") == statstore.SCHEMA_VERSION
+        except Exception as e:
+            violations.append(
+                f"stats snapshot on disk is torn/corrupt after save: {e}")
     fired = list(plan.fired)
     faults.clear()     # chaos off before the recovery probe
 
@@ -250,6 +367,41 @@ def run_seed(session, seed: int, clients: int, queries: int, workers: int,
             else:
                 recovered += 1
             results.append(probe)
+    # Final scrape AFTER every future resolved and BEFORE the server
+    # (and its telemetry socket) stops: the admit == complete + error +
+    # deadline identity is asserted from the WIRE text. The background
+    # scraper stops FIRST — an in-flight background scrape completing
+    # late would overwrite last_metrics with staler counters than the
+    # foreground read below. A short retry window then absorbs the
+    # microseconds between a waiter unblocking and the worker's counter
+    # increment landing.
+    scraper.stop()
+    scrape_deadline = time.monotonic() + 5.0
+    keys = ("sparkdq4ml_serve_admit", "sparkdq4ml_serve_complete",
+            "sparkdq4ml_serve_error", "sparkdq4ml_serve_deadline_exceeded")
+    while True:
+        try:
+            scraper.scrape_once()
+        except Exception as e:
+            violations.append(f"final scrape failed: {e}")
+            break
+        d = {k: scraper.last_metrics.get(k, 0) - scrape0.get(k, 0)
+             for k in keys}
+        if d[keys[0]] == d[keys[1]] + d[keys[2]] + d[keys[3]]:
+            break
+        if time.monotonic() > scrape_deadline:
+            violations.append(
+                "SCRAPED serve counter incoherence: "
+                f"admit={d[keys[0]]:.0f} != complete+error+deadline="
+                f"{d[keys[1]] + d[keys[2]] + d[keys[3]]:.0f}")
+            break
+        time.sleep(0.05)
+    if scraper.failures:
+        violations.append(
+            f"{len(scraper.failures)} scrape failure(s) under fire; "
+            f"first: {scraper.failures[0]}")
+    if not scraper.last_health.get("status"):
+        violations.append("healthz never answered with a status verdict")
     server.stop(drain=True)
     delta = {k: v - before.get(k, 0)
              for k, v in profiling.counters.snapshot().items()
@@ -298,6 +450,8 @@ def run_seed(session, seed: int, clients: int, queries: int, workers: int,
         "breakers_tripped": tripped,
         "breakers_probed": len(open_keys),
         "breakers_recovered": recovered,
+        "scrapes": scraper.scrapes,
+        "stats_persist_degrades": delta.get("stats.persist_failed", 0),
         "wall_s": round(time.perf_counter() - t0, 2),
         "violations": violations,
     }
@@ -339,6 +493,11 @@ def run_soak(seeds=None, clients=None, queries=1, workers=8,
                                  data_path, soak_s, log=log))
     finally:
         faults.clear()
+        try:
+            os.remove(os.path.join(REPO,
+                                   f".chaos_stats_{os.getpid()}.jsonl"))
+        except OSError:
+            pass
         if created_here:
             session.stop()
     bad = [r for r in rows if r["violations"]]

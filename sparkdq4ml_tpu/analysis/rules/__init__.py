@@ -13,6 +13,7 @@ from .fault_sites import FaultSiteRule
 from .host_sync import HostSyncRule
 from .locks import LockOrderRule
 from .logger_ns import LoggerNamespaceRule
+from .metric_names import MetricNameRule
 from .noop import NoopContractRule
 from .numpy_free import NumpyFreeRule
 
@@ -24,6 +25,7 @@ ALL_RULES = (
     NoopContractRule,
     LockOrderRule,
     FaultSiteRule,
+    MetricNameRule,
     LoggerNamespaceRule,
     NumpyFreeRule,
 )

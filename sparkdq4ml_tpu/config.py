@@ -50,6 +50,10 @@ CONF_KEYS = {
     "spark.chaos.seed": "session",
     "spark.chaos.seeds": "session",
     "spark.chaos.soakSeconds": "session",
+    "spark.stats.enabled": "session",
+    "spark.stats.path": "session",
+    "spark.stats.maxEntries": "session",
+    "spark.stats.flushOnStop": "session",
     "spark.observability.enabled": "init",
     "spark.observability.maxSpans": "init",
     "spark.observability.logSpans": "init",
@@ -168,6 +172,22 @@ class _Config:
     chaos_seed: int = 0
     chaos_seeds: int = 5
     chaos_soak_s: float = 0.0
+    # Plan-statistics observatory (utils/statstore.py): per-plan-key
+    # running stats — observed selectivity, wall/compile-ms digests,
+    # host syncs, est/measured peak bytes — feeding EXPLAIN's history-
+    # informed `est rows` column and (ROADMAP item 4) the cost-based
+    # optimizer. spark.stats.enabled=false reduces every producer hook
+    # to one flag read (test-pinned no-op).
+    stats_enabled: bool = True
+    # Snapshot path for cross-session persistence (spark.stats.path);
+    # empty = in-memory only. Loaded (merge) at session init, written
+    # (merge-don't-clobber, atomic) by stop() when stats_flush_on_stop.
+    stats_path: str = ""
+    # Bounded per-key entry table (spark.stats.maxEntries): past it the
+    # least-recently-updated entry evicts (stats.evict counter).
+    stats_max_entries: int = 512
+    # Persist on session stop() (spark.stats.flushOnStop).
+    stats_flush_on_stop: bool = True
     # Pallas fast-path selection for the hot ops (ops/pallas_kernels.py):
     # the single-device Gramian in solvers.augmented_gram and the fused DQ
     # chain entry point ops/rules.py:dq_rules_fused. "off" = plain XLA

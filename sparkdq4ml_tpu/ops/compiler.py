@@ -48,9 +48,11 @@ from __future__ import annotations
 import contextlib
 import contextvars
 import functools
+import logging
 import math
 import re
 import threading
+import time
 import warnings
 from collections import OrderedDict
 from typing import Optional, Sequence
@@ -70,6 +72,9 @@ __all__ = [
     "run_pipeline", "clear_cache", "cache_len", "PipelineError",
     "plan_namespace", "plan_namespace_tag",
 ]
+
+
+logger = logging.getLogger("sparkdq4ml_tpu.ops.compiler")
 
 
 class PipelineError(RuntimeError):
@@ -585,6 +590,9 @@ class _Plan:
             + len(lowered_extra))
         self.key = key
         self.n_lits = len(lits)
+        # whether this program ANDs a filter into the mask — the flushes
+        # whose output mask carries a selectivity observation (statstore)
+        self.has_filter = any(s[0] == "filter" for s in lowered_steps)
         # Introspection (observability.CACHES / EXPLAIN ANALYZE): per-plan
         # replay count and bucket histogram, updated under _CACHE_LOCK.
         self.hits = 0
@@ -845,12 +853,19 @@ def _run_chunked(plan, lit_values, data: dict, mask, n: int,
                f"{nchunks} chunk(s) of {m} rows")
     mask = jnp.asarray(mask, jnp.bool_)
     before = plan.traces
+    stats_on = config.stats_enabled
+    t_stats = time.perf_counter() if stats_on else 0.0
     pieces_changed: dict[str, list] = {}
     pieces_mask: list = []
     pieces_extras: dict[str, list] = {}
     bucket_counts: dict[int, int] = {}
     with _obs.span("frame.pipeline.flush", cat="frame", rows=n, bucket=m,
                    chunks=nchunks, oom_budget=budget, est_bytes=est):
+        # same chaos hook as the unchunked dispatch (one fire per FLUSH,
+        # inside the flush span): an over-budget flush is still a flush,
+        # and a scheduled pipeline_flush fault must reach the
+        # Frame._flush ladder in the memory-constrained regime too
+        _faults.inject("pipeline_flush")
         for start in range(0, n, m):
             rows = min(start + m, n) - start
             cb = bucket_size(rows)
@@ -908,8 +923,62 @@ def _run_chunked(plan, lit_values, data: dict, mask, n: int,
 
     new_data = dict(data)
     new_data.update({k: cat(vs) for k, vs in pieces_changed.items()})
-    return (new_data, cat(pieces_mask),
+    new_mask = cat(pieces_mask)
+    if stats_on:
+        # one record per flush (the chunked execution IS one logical
+        # execution of this plan) — the heaviest plans are exactly the
+        # history the est-rows/CBO store most needs
+        _record_flush_stats(
+            plan, data, m, n,
+            (time.perf_counter() - t_stats) * 1e3, compiled > 0,
+            new_mask, est=est)
+    return (new_data, new_mask,
             {k: cat(vs) for k, vs in pieces_extras.items()})
+
+
+def _record_flush_stats(plan, data, b: int, n: int,
+                        wall_ms: float, compiled: bool, new_mask,
+                        est=None) -> None:
+    """Plan-stats observatory hand-off (``utils/statstore.py``): one
+    ``record_flush`` per execution of this plan (wall/compile digest,
+    static byte estimate) and — when the flush carried a filter — a
+    DEFERRED selectivity observation: ``sum(new_mask)`` is dispatched as
+    one tiny async device reduction here and pulled in a batched,
+    counted drain on the cold paths (report/EXPLAIN/save), never a sync
+    on this path. Called only when ``spark.stats.enabled``; any failure
+    is swallowed — statistics must never take a flush down."""
+    from ..utils import statstore as _stats
+
+    try:
+        _stats.STORE.record_flush(
+            plan.key, "pipeline", wall_ms=wall_ms, compiled=compiled,
+            est_bytes=(est if est is not None
+                       else _est_flush_bytes(plan, data, b)))
+        if plan.has_filter:
+            skey = _stats.selectivity_key(plan.key)
+            if skey is not None:
+                _stats.STORE.defer_rows(skey, "filter", n,
+                                        jnp.sum(new_mask))
+    except Exception:
+        logger.debug("stats hand-off failed", exc_info=True)
+
+
+def selectivity_key_for(where_steps, schema) -> Optional[str]:
+    """The selectivity-entry key a flush of ``where_steps`` over
+    ``schema`` would record under — computed WITHOUT executing anything
+    (the same ``_linearize`` walk that builds plan keys, then the
+    statstore's filter-part extraction). EXPLAIN uses this to address
+    persisted history from a parsed query's WHERE clause on a fresh
+    session. Returns None when the steps are not structurally
+    compilable (those flushes take the eager path and record nothing)."""
+    from ..utils import statstore as _stats
+
+    try:
+        key, _lits, _s, _e, _r = _linearize(tuple(where_steps), (),
+                                            schema)
+    except Exception:
+        return None
+    return _stats.selectivity_key(key)
 
 
 def run_pipeline(data: dict, mask, n: int, steps, extra=()):
@@ -966,6 +1035,10 @@ def run_pipeline(data: dict, mask, n: int, steps, extra=()):
                       for v in donated),
                 jax.ShapeDtypeStruct(mask_in.shape, mask_in.dtype),
                 lit_values)
+        # Plan-stats observatory gate: ONE flag read; disabled mode pays
+        # nothing else on this path (test-pinned, chaos-pin style).
+        stats_on = config.stats_enabled
+        t_stats = time.perf_counter() if stats_on else 0.0
         with warnings.catch_warnings():
             # donation of a replaced column whose output dtype differs
             # (int column replaced by a float expression) is unusable —
@@ -1004,6 +1077,10 @@ def run_pipeline(data: dict, mask, n: int, steps, extra=()):
         if b != n:
             changed, new_mask, extras = _unpad_tree(
                 (changed, new_mask, extras), n)
+        if stats_on:
+            _record_flush_stats(
+                plan, data, b, n,
+                (time.perf_counter() - t_stats) * 1e3, compiled, new_mask)
         new_data = dict(data)
         new_data.update(changed)
         return new_data, new_mask, extras

@@ -75,6 +75,7 @@ from __future__ import annotations
 
 import logging
 import threading
+import time
 from collections import OrderedDict
 from typing import Optional
 
@@ -138,6 +139,26 @@ def try_device(op: str, thunk):
     if out is None:
         counters.increment("grouped.fallback")
     return out
+
+def _record_grouped_stats(key: str, rows_in: int, rows_out: int,
+                          wall_ms: float, compiles: int,
+                          host_syncs: int) -> None:
+    """Plan-stats observatory hand-off for the grouped engine: the group
+    count is already host-known (the engine's one counted sync), so both
+    the flush digest AND the rows-in→groups-out selectivity record
+    directly — no deferred drain. Called only when
+    ``spark.stats.enabled``; failures never take a flush down."""
+    from ..utils import statstore as _stats
+
+    try:
+        _stats.STORE.record_flush(key, "grouped", wall_ms=wall_ms,
+                                  compiled=compiles > 0,
+                                  host_syncs=host_syncs)
+        if rows_out >= 0:
+            _stats.STORE.record_rows(key, "grouped", rows_in, rows_out)
+    except Exception:
+        logger.debug("stats hand-off failed", exc_info=True)
+
 
 # Aggregates this engine lowers to segment reductions. The names mirror
 # frame.aggregates._AGGS (post `mean`→`avg` normalization).
@@ -1005,6 +1026,13 @@ def grouped_agg(frame, keys, agg_list):
     dense_ok = not any(fn in _DISTINCT_FNS for fn, _, _ in agg_ops)
     S = min(_DENSE_MAX, max(2 * b, 16))
 
+    # Plan-stats observatory gate (ONE flag read; disabled = nothing
+    # else) — the grouped engine records HOST-KNOWN group counts, so its
+    # selectivity evidence needs no deferred drain.
+    stats_on = config.stats_enabled
+    t_stats = time.perf_counter() if stats_on else 0.0
+    c_stats = counters.get("grouped.compile") if stats_on else 0
+    syncs = 0
     with _obs.TRACER.span(
             "frame.grouped.flush", cat="frame", op="group_by",
             keys=len(keys), aggs=len(agg_list), rows=n, bucket=b) as sp:
@@ -1017,6 +1045,7 @@ def grouped_agg(frame, keys, agg_list):
                 fn, args, before, sp)
             # ONE host sync: the fit verdict + group count together
             counters.increment("frame.host_sync")
+            syncs += 1
             fit_h, g_h = jax.device_get((fit, groups))
             if bool(fit_h):
                 g = int(g_h)
@@ -1029,8 +1058,13 @@ def grouped_agg(frame, keys, agg_list):
                 tuple(key_kinds), tuple(agg_ops), tuple(val_kinds)))
             key_outs, agg_outs, groups = _run_plan(fn, args, before, sp)
             counters.increment("frame.host_sync")
+            syncs += 1
             g = int(groups)
             sp.set(groups=g, lowering="sorted")
+    if stats_on:
+        _record_grouped_stats(
+            f"G|{struct}", n, g, (time.perf_counter() - t_stats) * 1e3,
+            counters.get("grouped.compile") - c_stats, syncs)
 
     # per-column eager slices, deliberately NOT compiler._unpad_tree: that
     # helper retraces per static slice length, which for the pipeline is
@@ -1214,6 +1248,8 @@ def device_unique(frame, key_names):
     keys_in = tuple(pad_rows(a, b, fresh=False) for a in key_arrs)
     mask_in = pad_rows(jnp.asarray(frame._mask, jnp.bool_), b, fresh=False)
 
+    stats_on = config.stats_enabled
+    t_stats = time.perf_counter() if stats_on else 0.0
     with _obs.TRACER.span(
             "frame.grouped.flush", cat="frame", op="distinct",
             keys=len(key_arrs), rows=n, bucket=b) as sp:
@@ -1221,6 +1257,10 @@ def device_unique(frame, key_names):
         counters.increment("frame.host_sync")
         g = int(groups)
         sp.set(groups=g)
+    if stats_on:
+        _record_grouped_stats(
+            key, n, g, (time.perf_counter() - t_stats) * 1e3,
+            counters.get("grouped.compile") - before, 1)
     return Frame(_gather_columns(data, keep[:g]))
 
 

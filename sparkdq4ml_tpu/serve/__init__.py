@@ -7,6 +7,7 @@ Entry points: :class:`QueryServer` (or ``session.serve()``),
 """
 
 from .admission import AdmissionController, Rejection, TenantQuota
+from .http import TelemetryServer
 from .server import (MAX_TENANT_SERIES, QueryDeadlineExceeded,
                      QueryExecutionError, QueryFuture, QueryRefused,
                      QueryResult, QueryServer, ServeError, TenantContext)
@@ -15,5 +16,5 @@ __all__ = [
     "AdmissionController", "Rejection", "TenantQuota",
     "QueryServer", "QueryFuture", "QueryResult", "TenantContext",
     "ServeError", "QueryRefused", "QueryDeadlineExceeded",
-    "QueryExecutionError", "MAX_TENANT_SERIES",
+    "QueryExecutionError", "MAX_TENANT_SERIES", "TelemetryServer",
 ]

@@ -1,0 +1,213 @@
+"""Live HTTP observability endpoint — telemetry at the process boundary.
+
+The Snap ML hierarchy (PAPERS.md, arxiv 1803.06333) frames why a serving
+system must export its telemetry OUTSIDE the process: in-process
+``session.metrics_text()`` is useless to the Prometheus scraper, the
+load balancer's health probe, or the operator tailing a wedged box. This
+module is the stdlib-only (``http.server``) answer — one daemon thread,
+four read-only routes over state other subsystems already maintain:
+
+========== ==============================================================
+route      payload
+========== ==============================================================
+/metrics   the Prometheus text snapshot (``observability.
+           prometheus_text()`` — counters, gauges, cumulative-bucket
+           histograms, HELP/TYPE headers), engine + server in one scrape
+/healthz   JSON health verdict: worker liveness, queue depth vs bound,
+           circuit-breaker state — HTTP 200 when serving, 503 when
+           shedding-degraded (load-balancer semantics)
+/plans     the plan-statistics observatory (``utils.statstore``) report:
+           per-plan-key selectivity, wall/compile digests, byte bounds
+/trace     recent finished spans as JSON (bounded tail of the span
+           buffer) — the "what just happened" view
+========== ==============================================================
+
+Security: binds ``127.0.0.1`` by default (``spark.serve.metricsHost`` to
+widen — the routes are read-only but unauthenticated; fronting with a
+real proxy is the operator's job). OFF by default: no
+``spark.serve.metricsPort`` → no socket, no thread, no cost (the
+pay-for-use rule every subsystem here follows).
+
+Every route handler reads lock-protected snapshots only — a scrape can
+never stall a worker, and the 100 ms scraper the chaos soak runs
+alongside 32 clients is the regression gate for that claim.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+logger = logging.getLogger("sparkdq4ml_tpu.serve.http")
+
+#: /trace returns at most this many of the newest finished spans.
+TRACE_TAIL = 256
+
+
+def _json_default(v):
+    return str(v)
+
+
+class TelemetryServer:
+    """The observability HTTP front end. Standalone-usable (``server``
+    may be None — /healthz then reports the engine view only) but
+    normally owned by a :class:`~.server.QueryServer` (started from
+    ``spark.serve.metricsPort``, stopped with the server)."""
+
+    def __init__(self, server=None, host: str = "127.0.0.1",
+                 port: int = 0):
+        self.query_server = server
+        self.host = host
+        self._requested_port = int(port)
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle ----------------------------------------------------------
+    @property
+    def running(self) -> bool:
+        return self._httpd is not None
+
+    @property
+    def port(self) -> Optional[int]:
+        """The BOUND port (resolves a requested port of 0)."""
+        return self._httpd.server_address[1] if self._httpd else None
+
+    def start(self) -> "TelemetryServer":
+        if self._httpd is not None:
+            return self
+        telemetry = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):   # quiet by default
+                logger.debug("telemetry %s", fmt % args)
+
+            def do_GET(self):                     # noqa: N802 (stdlib API)
+                telemetry._handle(self)
+
+        self._httpd = ThreadingHTTPServer(
+            (self.host, self._requested_port), Handler)
+        self._httpd.daemon_threads = True
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True,
+            name="sparkdq4ml-telemetry")
+        self._thread.start()
+        logger.info("telemetry endpoint on http://%s:%d "
+                    "(/metrics /healthz /plans /trace)",
+                    self.host, self.port)
+        return self
+
+    def stop(self) -> None:
+        httpd, self._httpd = self._httpd, None
+        if httpd is not None:
+            httpd.shutdown()
+            httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+    def __enter__(self) -> "TelemetryServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> bool:
+        self.stop()
+        return False
+
+    # -- routes -------------------------------------------------------------
+    def _handle(self, req) -> None:
+        try:
+            path = req.path.split("?", 1)[0].rstrip("/") or "/"
+            if path == "/metrics":
+                body, ctype, code = self._metrics()
+            elif path == "/healthz":
+                body, ctype, code = self._healthz()
+            elif path == "/plans":
+                body, ctype, code = self._plans()
+            elif path == "/trace":
+                body, ctype, code = self._trace()
+            else:
+                body, ctype, code = (
+                    json.dumps({"error": "unknown route", "routes": [
+                        "/metrics", "/healthz", "/plans", "/trace"]}),
+                    "application/json", 404)
+        except Exception as e:   # a route bug must answer, not hang
+            logger.debug("telemetry route failed", exc_info=True)
+            body = json.dumps({"error": f"{type(e).__name__}: {e}"})
+            ctype, code = "application/json", 500
+        payload = body.encode()
+        try:
+            req.send_response(code)
+            req.send_header("Content-Type", ctype)
+            req.send_header("Content-Length", str(len(payload)))
+            req.end_headers()
+            req.wfile.write(payload)
+        except (BrokenPipeError, ConnectionResetError):
+            pass                 # scraper went away mid-answer
+
+    def _metrics(self):
+        from ..utils import observability as _obs
+
+        return (_obs.prometheus_text(),
+                "text/plain; version=0.0.4; charset=utf-8", 200)
+
+    def _healthz(self):
+        doc: dict = {"status": "ok"}
+        srv = self.query_server
+        if srv is not None:
+            stats = srv.stats()
+            open_breakers = sorted(
+                key for key, st in (stats.get("breaker") or {}).items()
+                if st.get("open"))
+            queue_depth = stats.get("queue_depth", 0)
+            saturated = queue_depth >= srv.admission.max_queue
+            doc.update({
+                "serving": stats["running"],
+                "workers": stats["workers"],
+                "queue_depth": queue_depth,
+                "max_queue": srv.admission.max_queue,
+                "tenants": len(stats.get("tenants") or ()),
+                "open_breakers": open_breakers,
+            })
+            if not stats["running"]:
+                doc["status"] = "stopped"
+            elif open_breakers or saturated:
+                # degraded = load is being shed (breaker) or the queue
+                # is at its admission bound — the 503 a balancer should
+                # route around, while /metrics keeps answering 200
+                doc["status"] = "degraded"
+                doc["degraded_because"] = (
+                    ["breaker_open"] if open_breakers else []) + (
+                    ["queue_full"] if saturated else [])
+        else:
+            doc["serving"] = False
+        code = 200 if doc["status"] == "ok" else 503
+        return json.dumps(doc), "application/json", code
+
+    def _plans(self):
+        from ..config import config as _cfg
+        from ..utils import statstore as _stats
+
+        if not _cfg.stats_enabled:
+            return (json.dumps({"enabled": False, "entries": []}),
+                    "application/json", 200)
+        doc = _stats.STORE.report()
+        doc["enabled"] = True
+        return (json.dumps(doc, default=_json_default),
+                "application/json", 200)
+
+    def _trace(self):
+        from ..utils import observability as _obs
+
+        spans = _obs.TRACER.spans()[-TRACE_TAIL:]
+        rows = [{
+            "name": s.name, "cat": s.cat, "trace_id": s.trace_id,
+            "span_id": s.sid, "parent_id": s.parent_id, "tid": s.tid,
+            "ts_us": s.ts_us, "dur_us": s.dur_us,
+            "attrs": {k: v for k, v in s.attrs.items()},
+        } for s in spans]
+        return (json.dumps({"spans": rows, "dropped": _obs.TRACER.dropped,
+                            "enabled": _obs.TRACER.enabled},
+                           default=_json_default),
+                "application/json", 200)

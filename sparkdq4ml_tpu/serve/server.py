@@ -308,7 +308,10 @@ class QueryServer:
                  default_deadline_s: Optional[float] = None,
                  breaker_threshold: int = 5,
                  breaker_cooldown: float = 5.0,
-                 breaker: Optional[CircuitBreaker] = None):
+                 breaker: Optional[CircuitBreaker] = None,
+                 metrics_port: Optional[int] = None,
+                 metrics_host: str = "127.0.0.1",
+                 slo_p99_ms: Optional[float] = None):
         if workers < 1:
             raise ValueError("workers must be >= 1")
         self.session = session
@@ -322,6 +325,22 @@ class QueryServer:
         self.admission = AdmissionController(
             max_queue=max_queue, memory_limit_bytes=memory_limit_bytes,
             breaker=self.breaker)
+        # Live HTTP telemetry (serve/http.py): OFF unless a port is
+        # given (spark.serve.metricsPort) — no socket, no thread, no
+        # cost. 127.0.0.1 by default; port 0 = ephemeral (tests/soak).
+        self.metrics_port = (None if metrics_port is None
+                             else int(metrics_port))
+        self.metrics_host = str(metrics_host)
+        self.telemetry = None          # TelemetryServer once started
+        # Per-tenant SLO burn-rate tracking (spark.serve.sloP99Ms): the
+        # p99 target in ms; None = zero-cost off. Budget = 1% of
+        # requests may exceed the target (a p99 promise); burn rate =
+        # observed over-target fraction / 1%, published as the
+        # serve.slo_burn[.<tenant>] gauges so a scrape shows budget
+        # exhaustion BEFORE the breaker trips.
+        self.slo_p99_ms = None if slo_p99_ms is None else float(slo_p99_ms)
+        self._slo: dict[str, list] = {}    # tenant -> [total, over]
+        self._slo_all = [0, 0]
         self._cond = threading.Condition()
         self._tenants: dict[str, _TenantState] = {}
         self._rr: list[str] = []       # round-robin tenant order
@@ -364,6 +383,10 @@ class QueryServer:
             ).lower() not in CONF_FALSE,
             "breaker_threshold": num("breakerThreshold", 5, int),
             "breaker_cooldown": num("breakerCooldown", 5.0, float),
+            "metrics_port": num("metricsPort", None, int),
+            "metrics_host": str(conf.get("spark.serve.metricsHost",
+                                         "127.0.0.1")),
+            "slo_p99_ms": num("sloP99Ms", None, float),
         }
         kw.update(overrides)
         return cls(session, **kw)
@@ -393,6 +416,12 @@ class QueryServer:
             for t in new:
                 t.start()
             _obs.METRICS.set_gauge("serve.workers", len(self._threads))
+        if self.metrics_port is not None and self.telemetry is None:
+            from .http import TelemetryServer
+
+            self.telemetry = TelemetryServer(
+                self, host=self.metrics_host,
+                port=self.metrics_port).start()
         return self
 
     def stop(self, drain: bool = True,
@@ -432,6 +461,11 @@ class QueryServer:
         # are the honest residue)
         _obs.METRICS.set_gauge("serve.workers", len(self._threads))
         self._update_gauges()
+        # telemetry goes down LAST: the final gauge values above are
+        # scrape-able until the socket closes
+        telemetry, self.telemetry = self.telemetry, None
+        if telemetry is not None:
+            telemetry.stop()
 
     def __enter__(self) -> "QueryServer":
         return self.start()
@@ -789,6 +823,35 @@ class QueryServer:
                     self._series.add(job.tenant)
             if granted:
                 _obs.METRICS.observe(f"serve.e2e_ms.{job.tenant}", e2e_ms)
+            if self.slo_p99_ms is not None:
+                self._record_slo(job.tenant, e2e_ms, granted)
+
+    def _record_slo(self, tenant: str, e2e_ms: float,
+                    granted: bool) -> None:
+        """Incremental SLO burn-rate update, fed by the SAME
+        client-experienced latencies the ``serve.e2e_ms`` histograms
+        record (exactly one observation per job). SLO semantics: p99 ≤
+        ``slo_p99_ms``, i.e. an error budget of 1% of requests over
+        target; burn rate = over-target fraction / 1% — 1.0 burns the
+        budget exactly, >1 exhausts it early, and a scrape of
+        ``serve.slo_burn.<tenant>`` shows that long before the tenant's
+        failure-driven breaker trips. ``granted`` reuses the per-tenant
+        series cap so gauge cardinality is bounded with the histograms."""
+        over = e2e_ms > self.slo_p99_ms
+        with self._series_lock:
+            self._slo_all[0] += 1
+            self._slo_all[1] += over
+            burn_all = (self._slo_all[1] / self._slo_all[0]) / 0.01
+            cell = None
+            if granted:
+                cell = self._slo.setdefault(tenant, [0, 0])
+                cell[0] += 1
+                cell[1] += over
+                burn = (cell[1] / cell[0]) / 0.01
+        _obs.METRICS.set_gauge("serve.slo_burn", round(burn_all, 4))
+        if cell is not None:
+            _obs.METRICS.set_gauge(f"serve.slo_burn.{tenant}",
+                                   round(burn, 4))
 
     def _resolve_deadline(self, job: _Job, where: str) -> None:
         """Waiter-side deadline resolution (``QueryFuture.result``):

@@ -303,8 +303,38 @@ class TpuSession:
             if "spark.chaos.soakSeconds" in self.conf:
                 _set("chaos_soak_s",
                      float(self.conf["spark.chaos.soakSeconds"]))
+            # Plan-stats observatory (utils/statstore.py), session-scoped
+            # like everything above:
+            #     .config("spark.stats.enabled", "false")   # hooks no-op
+            #     .config("spark.stats.path", "/x/stats.jsonl")  # persist
+            #     .config("spark.stats.maxEntries", 1024)   # entry bound
+            #     .config("spark.stats.flushOnStop", "false")
+            sval = str(self.conf.get("spark.stats.enabled", "")).lower()
+            if sval in _CONF_FALSE:
+                _set("stats_enabled", False)
+            elif sval in _CONF_TRUE:
+                _set("stats_enabled", True)
+            if "spark.stats.path" in self.conf:
+                _set("stats_path", str(self.conf["spark.stats.path"]))
+            if "spark.stats.maxEntries" in self.conf:
+                _set("stats_max_entries",
+                     int(self.conf["spark.stats.maxEntries"]))
+            fval = str(self.conf.get("spark.stats.flushOnStop", "")).lower()
+            if fval in _CONF_FALSE:
+                _set("stats_flush_on_stop", False)
+            elif fval in _CONF_TRUE:
+                _set("stats_flush_on_stop", True)
             if saved:
                 self._pipeline_saved = saved
+        # Adopt persisted plan-statistics history (outside _CONF_LOCK —
+        # file I/O never holds the conf lock). Merge is winner-per-key,
+        # so a builder re-init re-loading the same snapshot is a no-op.
+        from .config import config as _cfg2
+
+        if _cfg2.stats_enabled and _cfg2.stats_path:
+            from .utils import statstore as _statstore
+
+            _statstore.STORE.load(_cfg2.stats_path)
 
     def _init_observability(self) -> None:
         """Install the tracing/metrics subsystem (``utils.observability``)
@@ -414,6 +444,27 @@ class TpuSession:
 
         doc = _audit_report()
         doc["enabled"] = True
+        return doc
+
+    def stats_report(self) -> dict:
+        """The plan-statistics observatory view (``utils.statstore``):
+        one row per structural plan key — observed selectivity,
+        wall/compile-ms digest summaries, host syncs, est/measured peak
+        bytes — accumulated across every flush of this process PLUS any
+        history loaded from ``spark.stats.path``. This is the memory the
+        EXPLAIN ``est rows`` column and (ROADMAP item 4) the cost-based
+        optimizer read. Draining the deferred selectivity scalars costs
+        one counted batched device pull. ``spark.stats.enabled=false``
+        makes it refuse."""
+        from .config import config as _cfg
+
+        if not _cfg.stats_enabled:
+            return {"enabled": False, "entries": [], "size": 0}
+        from .utils import statstore as _statstore
+
+        doc = _statstore.STORE.report()
+        doc["enabled"] = True
+        doc["path"] = _cfg.stats_path or None
         return doc
 
     def _init_faults(self) -> None:
@@ -678,7 +729,7 @@ class TpuSession:
                 if any(k.startswith(("spark.pipeline.", "spark.groupedExec.",
                                      "spark.explain.", "spark.serve.",
                                      "spark.ingest.", "spark.audit.",
-                                     "spark.chaos."))
+                                     "spark.chaos.", "spark.stats."))
                        for k in self._conf):
                     _ACTIVE._init_pipeline()
                 return _ACTIVE
@@ -818,6 +869,18 @@ class TpuSession:
         # threading-model doc pins down).
         if server is not None:
             server.stop(drain=True)
+        # Persist the plan-statistics history while the session conf is
+        # still installed (the path/enabled flags restore below). The
+        # save merges-don't-clobber and degrades to in-memory-only on
+        # any I/O failure (stats_persist ladder) — stop() never raises
+        # over statistics.
+        from .config import config as _cfg
+
+        if (_cfg.stats_enabled and _cfg.stats_path
+                and _cfg.stats_flush_on_stop):
+            from .utils import statstore as _statstore
+
+            _statstore.STORE.save(_cfg.stats_path, merge=True)
         self.catalog.clear()
         # Close the root session span and stop recording if THIS session
         # turned tracing on (same session-scoped rule as the fault plan).

@@ -1607,6 +1607,7 @@ def plan_tree(q: Query) -> PlanNode:
     else:
         if q.where is not None:
             node = PlanNode("Filter", "", [node])
+            node.meta["query"] = q     # est-rows history lookup
         node = PlanNode("Project", f"[{len(q.items)}]", [node])
     if q.group_by:
         mode = q.group_mode if q.group_mode != "group" else "groupBy"
@@ -1624,8 +1625,10 @@ def plan_tree(q: Query) -> PlanNode:
                         f"[{len(q.order_by)}]", [node])
     if q.offset:
         node = PlanNode("Offset", f"[{q.offset}]", [node])
+        node.meta["offset"] = q.offset
     if q.limit is not None:
         node = PlanNode("Limit", f"[{q.limit}]", [node])
+        node.meta["limit"] = q.limit
     return node
 
 
@@ -1765,6 +1768,113 @@ def _annotate_plan(tree: PlanNode, qs) -> None:
             parent.stats["rows_in"] = child.stats["rows_out"]
 
 
+def _filter_history_key(q, cat) -> Optional[str]:
+    """The statstore selectivity key a flush of this query's WHERE would
+    record under — computed from the parsed predicate plus the scanned
+    view's REAL column dtypes (catalog lookup; zero execution, zero
+    device reads). None when the view is unregistered, the predicate is
+    not structurally compilable (those flushes run eager and record no
+    history), or the query joins (the flush-time schema then carries
+    joined columns this static walk cannot see)."""
+    view = q.view if isinstance(q.view, str) else None
+    if view is None or q.where is None or q.joins:
+        return None
+    try:
+        frame = cat.lookup(view)
+    except Exception:
+        return None
+    # Mirror the executor's name resolution (qualified ``t.x`` refs
+    # rewrite to flat columns BEFORE the filter defers — the flush-time
+    # history key is recorded against the RESOLVED predicate). Subquery
+    # markers are deliberately NOT resolved here (that would execute
+    # them); they fail the compilability walk below and yield None,
+    # exactly like their flushes record nothing.
+    where = q.where
+    try:
+        scope = {(q.view_alias or view).lower():
+                 {c: c for c in frame.columns}}
+        where = _resolve_qualified(where, scope, frame.columns)
+    except Exception:
+        return None
+    from ..ops import compiler as C
+
+    schema = C.LazySchema(frame._data_store, frame._pending_names())
+    return C.selectivity_key_for((("filter", where),), schema)
+
+
+def _annotate_est_rows(tree: PlanNode, cat) -> None:
+    """History-informed cardinality column (``est_rows``) — the plan-
+    stats observatory's EXPLAIN surface, next to dqaudit's ``est_peak``:
+    Scan rows are static slot counts, Filter/FusedStage apply the
+    HISTORICAL selectivity recorded for the structurally-same filter
+    stack (``utils.statstore``; persisted across sessions), and
+    row-preserving operators propagate. Unknowns stay None and render as
+    ``-``. Zero execution: catalog lookups + one ``_linearize`` walk per
+    filter, never a compile or device read (the deferred-observation
+    drain is a host pull of already-dispatched scalars). Never raises —
+    estimation is advisory."""
+    from ..utils import statstore as _stats
+
+    try:
+        _stats.STORE.drain_pending()
+    except Exception:
+        pass
+
+    def est(node) -> Optional[int]:
+        try:
+            child = est(node.children[0]) if node.children else None
+        except RecursionError:   # pathological depth: stop annotating
+            return None
+        out: Optional[int] = None
+        op = node.op
+        if op == "Scan":
+            view = node.meta.get("view")
+            if isinstance(view, str):
+                try:
+                    out = int(cat.lookup(view).num_slots)
+                except Exception:
+                    out = None
+            else:
+                out = child      # derived table: its subquery's estimate
+        elif op in ("FusedStage", "Filter"):
+            q = node.meta.get("query")
+            if child is not None and q is not None:
+                skey = _filter_history_key(q, cat)
+                if skey is not None:
+                    sel = _stats.STORE.selectivity(skey)
+                    if sel is not None:
+                        out = int(round(sel * child))
+        elif op in ("Project", "Sort", "DeviceSort"):
+            out = child
+        elif op == "Limit":
+            lim = node.meta.get("limit")
+            out = (min(child, int(lim)) if child is not None
+                   and lim is not None else None)
+        elif op == "Offset":
+            off = node.meta.get("offset")
+            out = (max(child - int(off), 0) if child is not None
+                   and off is not None else None)
+        # Aggregate/Distinct/Join/SetOps output cardinality has no
+        # history key yet — stays unknown rather than a guess. DDL and
+        # wrapper nodes have no cardinality at all and stay unannotated.
+        if op not in ("CreateView", "DropView", "With", "SetOps"):
+            node.stats["est_rows"] = out
+        # cardinality propagates along children[0], but side arms (a
+        # Join's probe-side Scan) still deserve their own annotation —
+        # the column must not silently disappear on the right arm
+        for side in node.children[1:]:
+            est(side)
+        return out
+
+    try:
+        for root in ([tree] if tree.op not in ("With", "SetOps",
+                                               "CreateView")
+                     else tree.children[:1]):
+            est(root)
+    except Exception:
+        pass
+
+
 def _parse_explain_tree(body: str):
     """Parse an EXPLAIN'd statement into ``(plan_tree, kind, payload)``:
     ``("query", Query)`` for a SELECT statement, ``("create"|"drop",
@@ -1870,6 +1980,13 @@ def _execute_explain(body: str, cat, analyze: bool):
                     f"!! est peak {root_est} bytes exceeds "
                     f"{_cfg.audit_memory_fraction:g} x device limit "
                     f"{budget} bytes (spark.audit.memoryFraction)")
+    # History-informed `est rows` (plan-stats observatory,
+    # utils/statstore.py): annotated BEFORE any execution — on plain
+    # EXPLAIN this is the whole point (zero-execution cardinality from
+    # persisted history), on ANALYZE it is the *pre-query* historical
+    # view the measured rows are then compared against (drift).
+    if _cfg.stats_enabled:
+        _annotate_est_rows(tree, cat)
     if not analyze:
         text = "== Physical Plan ==\n" + tree.render()
         if budget_line:
@@ -1895,6 +2012,26 @@ def _execute_explain(body: str, cat, analyze: bool):
     top = tree.main_chain()[0]
     if top.stats.get("rows_out") is None:
         top.stats["rows_out"] = out.num_slots
+    rows_valid = None
+    if _cfg.stats_enabled:
+        # Observed-vs-historical drift: the query's TRUE valid-row count
+        # (one mask reduction, outside the stats window so per-operator
+        # attribution is untouched) against the pre-query est_rows. The
+        # same execution's own deferred observation lands in the store,
+        # so the NEXT estimate has already absorbed this drift.
+        try:
+            rows_valid = int(out.count())
+        except Exception:
+            rows_valid = None
+        top.stats["rows_valid"] = rows_valid
+        est = top.stats.get("est_rows")
+        if est is not None and rows_valid is not None:
+            top.stats["est_drift"] = (
+                f"x{est / rows_valid:.2f}" if rows_valid
+                else f"+{est}")
+        from ..utils import statstore as _statstore
+
+        _statstore.STORE.absorb_query_stats(qs)
     delta = qs.counter_delta()
     lines = ["== Analyzed Plan ==", tree.render(analyze=True),
              "== Query Stats =="]

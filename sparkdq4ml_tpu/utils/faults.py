@@ -102,6 +102,7 @@ FAULT_SITES = {
     "serve_exec": ("device_error",),
     "serve_admit": ("breaker_trip", "oom"),
     "oom": ("oom",),
+    "stats_persist": ("io_error", "torn_chunk"),
 }
 
 

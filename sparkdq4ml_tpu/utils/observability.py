@@ -68,6 +68,123 @@ ENV_VAR = "SPARKDQ4ML_OBS"
 DEFAULT_BUCKETS_MS = (0.1, 0.5, 1.0, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0,
                       500.0, 1000.0, 2500.0, 5000.0, 10000.0)
 
+#: THE metric-name registry — every literal name passed to
+#: ``counters.increment`` / ``METRICS.set_gauge`` / ``METRICS.observe``
+#: must be declared here (enforced statically by dqlint's
+#: ``metric-name`` rule, ``analysis/rules/metric_names.py``): a typo'd
+#: counter compiles, runs, and silently creates a ghost series no
+#: dashboard reads. name → (type, help); the Prometheus exporter renders
+#: the declared help text. Kept a PURE LITERAL so the rule can
+#: ``ast.literal_eval`` it without importing the engine (the CONF_KEYS
+#: pattern).
+METRIC_NAMES = {
+    # frame engine
+    "frame.host_sync": ("counter", "counted device->host boundary pulls"),
+    "frame.cache": ("counter", "Frame.cache()/persist() materializations"),
+    # fused expression pipeline (ops/compiler.py)
+    "pipeline.flush": ("counter", "pending-pipeline materializations"),
+    "pipeline.compile": ("counter", "fused programs traced+compiled"),
+    "pipeline.hit": ("counter", "fused-program plan-cache replays"),
+    "pipeline.fallback": ("counter", "flushes degraded to eager replay"),
+    "pipeline.fault_fallback": ("counter",
+                                "flushes eager-replayed by the fault "
+                                "ladder"),
+    "pipeline.evict": ("counter", "plan-cache LRU evictions"),
+    "pipeline.oom_chunked": ("counter",
+                             "over-budget flushes run row-chunked"),
+    # grouped execution (ops/segments.py)
+    "grouped.compile": ("counter", "grouped programs traced+compiled"),
+    "grouped.hit": ("counter", "grouped-program plan-cache replays"),
+    "grouped.fallback": ("counter", "grouped ops on the host path"),
+    "grouped.fault_fallback": ("counter",
+                               "grouped ops host-degraded by the fault "
+                               "ladder"),
+    "grouped.dense_miss": ("counter", "dense lowering misfits rerouted"),
+    "grouped.evict": ("counter", "grouped plan-cache LRU evictions"),
+    # streaming ingest (frame/native_csv.py)
+    "ingest.files": ("counter", "native CSV files read"),
+    "ingest.bytes": ("counter", "native CSV bytes parsed"),
+    "ingest.rows": ("counter", "native CSV rows parsed"),
+    "ingest.chunks": ("counter", "streamed parse chunks"),
+    "ingest.streamed": ("counter", "files read via the streaming path"),
+    "ingest.python_fallback": ("counter",
+                               "files degraded to the python engine"),
+    "ingest.fault_fallback": ("counter",
+                              "native reads degraded by the fault "
+                              "ladder"),
+    # solver / jit layers
+    "solver.fits": ("counter", "model fits dispatched"),
+    "solver.iterations": ("counter", "solver iterations run"),
+    "jit.trace_miss": ("counter", "jit-factory cache misses (new trace)"),
+    "jit.trace_hit": ("counter", "jit-factory cache hits"),
+    # parallel / mesh
+    "parallel.psum_dispatches": ("counter", "collective dispatches"),
+    "parallel.shard_map_builds": ("counter", "shard_map programs built"),
+    "mesh.devices": ("gauge", "devices in the session mesh"),
+    # device memory (utils/meminfo.py)
+    "mem.live_bytes": ("gauge", "live-array census bytes"),
+    "mem.peak_bytes": ("gauge", "process-lifetime census peak bytes"),
+    # tracer internals
+    "trace.dropped_spans": ("counter", "spans evicted by the bounded "
+                                       "buffer"),
+    # fault injection (utils/faults.py)
+    "faults.injected": ("counter", "chaos faults fired"),
+    # serving layer (serve/)
+    "serve.admit": ("counter", "queries admitted"),
+    "serve.reject": ("counter", "queries rejected (all reasons)"),
+    "serve.shed": ("counter", "queries shed by an open breaker"),
+    "serve.complete": ("counter", "queries completed ok"),
+    "serve.error": ("counter", "queries failed in execution"),
+    "serve.deadline_exceeded": ("counter", "queries past their deadline"),
+    "serve.late_result": ("counter", "executed values discarded late"),
+    "serve.requeue": ("counter", "retryable failures requeued"),
+    "serve.tenants_reaped": ("counter", "idle stateless tenants reaped"),
+    "serve.queue_depth": ("gauge", "queued jobs across tenants"),
+    "serve.in_flight": ("gauge", "jobs executing right now"),
+    "serve.tenants": ("gauge", "known tenant states"),
+    "serve.workers": ("gauge", "live worker threads"),
+    "serve.slo_burn": ("gauge", "SLO error-budget burn rate, all "
+                                "tenants (1.0 = burning the 1% budget "
+                                "exactly)"),
+    "serve.queue_ms": ("histogram", "queue wait per executed job"),
+    "serve.exec_ms": ("histogram", "execution wall per job"),
+    "serve.e2e_ms": ("histogram", "client-experienced end-to-end "
+                                  "latency"),
+    # plan-stats observatory (utils/statstore.py)
+    "stats.record": ("counter", "flush observations recorded"),
+    "stats.evict": ("counter", "stats entries evicted (maxEntries)"),
+    "stats.drain_sync": ("counter",
+                         "batched deferred-observation device pulls"),
+    "stats.pending_dropped": ("counter",
+                              "deferred observations dropped at the "
+                              "pending bound"),
+    "stats.loaded": ("counter", "stats entries adopted from a snapshot"),
+    "stats.persisted": ("counter", "stats snapshots written"),
+    "stats.persist_failed": ("counter",
+                             "snapshot writes degraded to in-memory "
+                             "only"),
+    "stats.load_failed": ("counter",
+                          "corrupt/stale snapshots degraded to empty"),
+}
+
+#: Dynamic metric-name families (formatted per site/tenant/category at
+#: runtime): any name starting with one of these prefixes is declared by
+#: the family. prefix → (type, help). Same pure-literal contract as
+#: :data:`METRIC_NAMES`.
+METRIC_NAME_PREFIXES = {
+    "recovery.": ("counter", "resilience-layer event mirror (action and "
+                             "per-site action.site keys)"),
+    "faults.injected.": ("counter", "per-site injected-fault mirror"),
+    "jit.backend.": ("counter", "jax monitoring compile events"),
+    "solver.": ("counter", "per-solver dispatch counters"),
+    "serve.reject.": ("counter", "per-reason admission rejections"),
+    "serve.e2e_ms.": ("histogram", "per-tenant end-to-end latency "
+                                   "(series-capped)"),
+    "serve.slo_burn.": ("gauge", "per-tenant SLO error-budget burn rate "
+                                 "(series-capped)"),
+    "span_ms.": ("histogram", "span wall-clock latency by category"),
+}
+
 
 class Histogram:
     """Fixed-bucket histogram (Prometheus convention: cumulative bucket
@@ -276,6 +393,14 @@ class Tracer:
     land in a bounded buffer (oldest dropped) and their durations feed the
     ``span_ms.<category>`` histograms."""
 
+    #: Minimum spacing of the resource-counter samples the Chrome-trace
+    #: exporter renders as ``"ph": "C"`` tracks (microseconds). Sampling
+    #: is activity-driven (taken at span completion, throttled to this
+    #: interval) so an idle process records nothing.
+    counter_sample_us = 20_000
+    #: Bounded counter-sample history (oldest dropped).
+    max_counter_samples = 4096
+
     def __init__(self, max_spans: int = 10_000):
         self.enabled = False
         self.log_spans = False
@@ -286,6 +411,8 @@ class Tracer:
         self._open: dict[int, Span] = {}
         self._ambient: list[Span] = []   # begun roots (see Span.__init__)
         self._sinks: list = []        # per-query collectors (query_stats)
+        self._csamples: list = []     # (ts_us, {metric: value}) track
+        self._last_csample_us = 0
         self._lock = threading.Lock()
         self._id = 0
         self._epoch_s = time.time()
@@ -320,6 +447,7 @@ class Tracer:
                 sink(s)
             except Exception:   # a broken collector must not break the op
                 logger.debug("span sink failed", exc_info=True)
+        self._maybe_sample_counters()
         METRICS.observe(f"span_ms.{s.cat or 'other'}",
                         (s.dur_us or 0) / 1e3)
         if self.log_spans:
@@ -329,6 +457,38 @@ class Tracer:
                           dur_ms=round((s.dur_us or 0) / 1e3, 3),
                           trace_id=s.trace_id, span_id=s.sid,
                           parent_id=s.parent_id, **s.attrs))
+
+    def _maybe_sample_counters(self) -> None:
+        """Resource-counter sampling for the Chrome-trace ``"ph": "C"``
+        tracks (Perfetto renders them as graphs under the span
+        timeline): the live-bytes census, serving queue depth, and the
+        pipeline hit/compile counters, taken at span completion and
+        throttled to :data:`counter_sample_us`. Runs only while tracing
+        is enabled (we are in ``_finish``) — the disabled path never
+        reaches here."""
+        now = self._now_us()
+        with self._lock:
+            if now - self._last_csample_us < self.counter_sample_us:
+                return
+            self._last_csample_us = now
+        from . import meminfo
+        from . import profiling
+
+        sample = {
+            "mem.live_bytes": meminfo.live_bytes(),
+            "serve.queue_depth": METRICS.get_gauge("serve.queue_depth"),
+            "pipeline.hit": profiling.counters.get("pipeline.hit"),
+            "pipeline.compile": profiling.counters.get("pipeline.compile"),
+        }
+        with self._lock:
+            self._csamples.append((now, sample))
+            if len(self._csamples) > self.max_counter_samples:
+                del self._csamples[: len(self._csamples)
+                                   - self.max_counter_samples]
+
+    def counter_samples(self) -> list:
+        with self._lock:
+            return list(self._csamples)
 
     # -- recording --------------------------------------------------------
     def span(self, name: str, cat: str = "", **attrs):
@@ -381,6 +541,8 @@ class Tracer:
             self._spans.clear()
             self._open.clear()
             self._ambient.clear()
+            self._csamples.clear()
+            self._last_csample_us = 0
             self.dropped = 0
 
 
@@ -867,6 +1029,17 @@ def chrome_trace() -> dict:
             "ts": s.ts_us, "dur": max(int(dur), 1),
             "pid": pid, "tid": tids[s.tid], "args": args,
         })
+    # Counter ("ph": "C") events — Perfetto draws each metric as a
+    # resource track under the span timeline (mem.live_bytes, serving
+    # queue depth, pipeline hit/compile counts; see
+    # Tracer._maybe_sample_counters for the sampling contract).
+    for ts, sample in tracer.counter_samples():
+        for metric, value in sample.items():
+            events.append({
+                "ph": "C", "name": metric, "cat": "resource",
+                "ts": ts, "pid": pid,
+                "args": {"value": value},
+            })
     return {"traceEvents": events,
             "displayTimeUnit": "ms",
             "otherData": {"framework": "sparkdq4ml_tpu",
@@ -951,6 +1124,17 @@ _HELP_PREFIXES = (
 
 
 def _prom_help(name: str) -> str:
+    # declared help first (METRIC_NAMES / the prefix families — the
+    # registry the dqlint metric-name rule enforces), then the legacy
+    # subsystem prefixes, then the name-mapping fallback
+    declared = METRIC_NAMES.get(name)
+    if declared is None:
+        for prefix in METRIC_NAME_PREFIXES:
+            if name.startswith(prefix) and name != prefix:
+                declared = METRIC_NAME_PREFIXES[prefix]
+                break
+    if declared is not None:
+        return f"{name} - {declared[1]}"
     for prefix, text in _HELP_PREFIXES:
         if name.startswith(prefix):
             return f"{name} - {text}"

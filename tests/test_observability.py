@@ -424,10 +424,13 @@ class TestExporters:
             with obs.span("child", cat="frame"):
                 pass
         doc = obs.chrome_trace()
-        events = doc["traceEvents"]
+        all_events = doc["traceEvents"]
+        # span events; counter ("C") resource tracks ride alongside
+        events = [e for e in all_events if e["ph"] == "X"]
         assert {e["name"] for e in events} == {"parent", "child"}
+        for e in all_events:
+            assert e["ph"] in ("X", "C")
         for e in events:
-            assert e["ph"] == "X"
             assert isinstance(e["ts"], int) and isinstance(e["dur"], int)
             assert e["dur"] >= 1
         child = next(e for e in events if e["name"] == "child")

@@ -616,8 +616,9 @@ class TestObservability:
         assert m.get("serve.complete", 0) >= 1
         assert isinstance(m.get("serve.e2e_ms"), dict)
         text = session.metrics_text()
-        assert "# HELP sparkdq4ml_serve_admit serve.admit - query-serving" \
-            in text
+        # HELP text comes from the METRIC_NAMES registry (ISSUE 12)
+        assert "# HELP sparkdq4ml_serve_admit serve.admit - queries " \
+            "admitted" in text
         assert "# TYPE sparkdq4ml_serve_e2e_ms histogram" in text
         assert "sparkdq4ml_serve_queue_depth" in text
         assert "sparkdq4ml_serve_in_flight" in text

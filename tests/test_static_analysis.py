@@ -739,6 +739,120 @@ class TestFaultSiteRule:
 
 
 # ---------------------------------------------------------------------------
+# metric-name: increment/set_gauge/observe literals resolve to the registry
+# ---------------------------------------------------------------------------
+
+_OBS_STUB = """
+    METRIC_NAMES = {
+        "pipeline.hit": ("counter", "replays"),
+        "serve.queue_depth": ("gauge", "queued jobs"),
+        "serve.e2e_ms": ("histogram", "latency"),
+    }
+
+    METRIC_NAME_PREFIXES = {
+        "recovery.": ("counter", "resilience events"),
+        "serve.e2e_ms.": ("histogram", "per-tenant latency"),
+    }
+"""
+
+
+class TestMetricNameRule:
+    def _tree(self, tmp_path, body):
+        return findings_for(tmp_path, {
+            "utils/observability.py": _OBS_STUB,
+            "frame/mod.py": body}, ["metric-name"])
+
+    def test_registered_names_are_quiet(self, tmp_path):
+        f = self._tree(tmp_path, """
+            from ..utils.profiling import counters
+            from ..utils import observability as _obs
+
+            def flush(tenant):
+                counters.increment("pipeline.hit")
+                counters.increment(f"recovery.{'retry'}")
+                _obs.METRICS.set_gauge("serve.queue_depth", 1)
+                _obs.METRICS.observe("serve.e2e_ms", 2.0)
+                _obs.METRICS.observe(f"serve.e2e_ms.{tenant}", 2.0)
+            """)
+        assert f == []
+
+    def test_typod_counter_flagged(self, tmp_path):
+        f = self._tree(tmp_path, """
+            from ..utils.profiling import counters
+
+            def flush():
+                counters.increment("pipleine.hit")
+            """)
+        assert len(f) == 1 and "pipleine.hit" in f[0].message
+
+    def test_unregistered_gauge_flagged(self, tmp_path):
+        f = self._tree(tmp_path, """
+            from ..utils import observability as _obs
+
+            def flush():
+                _obs.METRICS.set_gauge("serve.depth_queue", 1)
+            """)
+        assert len(f) == 1 and "serve.depth_queue" in f[0].message
+
+    def test_undeclared_fstring_family_flagged(self, tmp_path):
+        f = self._tree(tmp_path, """
+            from ..utils.profiling import counters
+
+            def flush(site):
+                counters.increment(f"mystery.{site}")
+            """)
+        assert len(f) == 1 and "METRIC_NAME_PREFIXES" in f[0].message
+
+    def test_computed_name_flagged_conditional_literals_ok(self, tmp_path):
+        f = self._tree(tmp_path, """
+            from ..utils.profiling import counters
+
+            def flush(name, missed):
+                counters.increment(name)
+                counters.increment(
+                    "pipeline.hit" if missed else "serve.queue_depth")
+            """)
+        assert len(f) == 1 and "LITERAL" in f[0].message
+
+    def test_unqualified_receiver_ignored(self, tmp_path):
+        f = self._tree(tmp_path, """
+            def flush(store):
+                store.increment("not.a.metric")
+                store.observe("whatever", 1.0)
+            """)
+        assert f == []
+
+    def test_pragma_suppresses(self, tmp_path):
+        f = self._tree(tmp_path, """
+            from ..utils.profiling import counters
+
+            def flush():
+                counters.increment("adhoc.series")  # dqlint: ok(metric-name): test-only
+            """)
+        assert f == []
+
+    def test_missing_registry_is_a_finding(self, tmp_path):
+        f = findings_for(tmp_path, {
+            "utils/observability.py": "X = 1\n",
+            "frame/mod.py": """
+                from ..utils.profiling import counters
+
+                def flush():
+                    counters.increment("pipeline.hit")
+                """}, ["metric-name"])
+        assert len(f) == 1 and "METRIC_NAMES" in f[0].message
+
+    def test_partial_tree_without_obs_module_is_quiet(self, tmp_path):
+        f = findings_for(tmp_path, {"frame/mod.py": """
+            from ..utils.profiling import counters
+
+            def flush():
+                counters.increment("whatever")
+            """}, ["metric-name"])
+        assert f == []
+
+
+# ---------------------------------------------------------------------------
 # the tier-1 gate: whole tree clean through the CLI
 # ---------------------------------------------------------------------------
 
@@ -781,5 +895,6 @@ class TestCheckStaticGate:
                            capture_output=True, text=True, timeout=60)
         assert p.returncode == 0
         for name in ("host-sync", "collective-guard", "conf-key", "noop",
-                     "lock-order", "fault-site", "logger-ns", "numpy-free"):
+                     "lock-order", "fault-site", "metric-name",
+                     "logger-ns", "numpy-free"):
             assert name in p.stdout

@@ -1,0 +1,781 @@
+"""Plan-stats observatory suite (ISSUE 12, tier-1, ``stats`` marker).
+
+Tentpole coverage: the per-key running-statistics store
+(``utils/statstore.py`` — digests, selectivity, 16-thread hammer,
+atomic/merging persistence, the ``stats_persist`` chaos ladder), the
+history-informed EXPLAIN ``est rows`` column (a fresh session reading a
+prior session's persisted selectivities renders cardinalities with ZERO
+execution, within 2× of what EXPLAIN ANALYZE then measures), the live
+HTTP telemetry endpoint (``serve/http.py`` — /metrics /healthz /plans
+/trace), per-tenant SLO burn-rate gauges, the Chrome-trace counter
+tracks, the Prometheus TYPE/registry satellite, and the disabled-mode
+no-op pins (``spark.stats.enabled=false`` / unset ``metricsPort``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import threading
+import urllib.error
+import urllib.request
+
+import jax.numpy as jnp
+import pytest
+
+import sparkdq4ml_tpu as dq
+from sparkdq4ml_tpu.config import config
+from sparkdq4ml_tpu.frame.frame import Frame
+from sparkdq4ml_tpu.serve import QueryServer, TelemetryServer
+from sparkdq4ml_tpu.utils import faults, observability as obs, profiling
+from sparkdq4ml_tpu.utils import statstore
+from sparkdq4ml_tpu.utils.recovery import RECOVERY_LOG
+from sparkdq4ml_tpu.utils.statstore import Digest, StatStore
+
+from conftest import dataset_path, prepare_features, run_dq_pipeline
+
+pytestmark = pytest.mark.stats
+
+HEADLINE_DQ = ("SELECT cast(guest as int) guest, price_no_min AS price "
+               "FROM price WHERE price_no_min > 0")
+
+
+@pytest.fixture(autouse=True)
+def _clean_stats_state():
+    """The store, chaos plan, and stats conf are process-global state."""
+    statstore.STORE.clear()
+    faults.clear()
+    RECOVERY_LOG.clear()
+    profiling.counters.clear("stats.")
+    saved = (config.stats_enabled, config.stats_path,
+             config.stats_max_entries, config.stats_flush_on_stop)
+    yield
+    obs.disable()
+    (config.stats_enabled, config.stats_path,
+     config.stats_max_entries, config.stats_flush_on_stop) = saved
+    statstore.STORE.clear()
+    faults.clear()
+    RECOVERY_LOG.clear()
+    profiling.counters.clear("stats.")
+
+
+def _get(url: str, timeout: float = 10.0):
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.status, resp.read().decode()
+
+
+# ---------------------------------------------------------------------------
+# Digest
+# ---------------------------------------------------------------------------
+
+
+class TestDigest:
+    def test_observe_mean_quantile_max(self):
+        d = Digest()
+        for v in (0.2, 0.3, 4.0, 90.0):
+            d.observe(v)
+        assert d.count == 4
+        assert d.mean() == pytest.approx((0.2 + 0.3 + 4.0 + 90.0) / 4)
+        assert d.max == 90.0
+        # quantile returns a bucket upper bound at/above the rank
+        assert d.quantile(0.5) <= 5.0
+        assert d.quantile(1.0) >= 90.0
+
+    def test_merge_sums_buckets(self):
+        a, b = Digest(), Digest()
+        a.observe(1.0)
+        b.observe(1.0)
+        b.observe(500.0)
+        a.merge(b)
+        assert a.count == 3
+        assert a.max == 500.0
+        assert a.sum == pytest.approx(502.0)
+
+    def test_doc_roundtrip_and_bucket_mismatch(self):
+        d = Digest()
+        d.observe(3.0)
+        d2 = Digest.from_doc(d.to_doc())
+        assert d2.to_doc() == d.to_doc()
+        with pytest.raises(ValueError):
+            Digest.from_doc({"counts": [1, 2, 3]})
+
+
+# ---------------------------------------------------------------------------
+# StatStore core
+# ---------------------------------------------------------------------------
+
+
+class TestStore:
+    def test_selectivity_and_est_rows(self):
+        s = StatStore()
+        assert s.selectivity("k") is None
+        assert s.est_rows("k", 100) is None
+        s.record_rows("k", "filter", 100, 24)
+        s.record_rows("k", "filter", 50, 12)
+        assert s.selectivity("k") == pytest.approx(36 / 150)
+        assert s.est_rows("k", 1000) == 240
+
+    def test_record_flush_routes_compile_vs_wall(self):
+        s = StatStore()
+        s.record_flush("k", "pipeline", wall_ms=5.0, compiled=True)
+        s.record_flush("k", "pipeline", wall_ms=1.0, compiled=False,
+                       host_syncs=2, est_bytes=640)
+        e = s.entry("k")
+        assert e["flushes"] == 2 and e["compiles"] == 1
+        assert e["compile_ms"]["count"] == 1
+        assert e["wall_ms"]["count"] == 1
+        assert e["host_syncs"] == 2 and e["est_bytes_max"] == 640
+
+    def test_max_entries_evicts_least_recently_updated(self):
+        s = StatStore()
+        config.stats_max_entries = 3
+        for i in range(5):
+            s.record_flush(f"k{i}", "pipeline", wall_ms=1.0)
+        assert len(s) == 3
+        assert profiling.counters.get("stats.evict") == 2
+        # the newest keys survive
+        assert s.entry("k4") is not None and s.entry("k0") is None
+
+    def test_deferred_rows_drain_batches(self):
+        s = StatStore()
+        mask = jnp.asarray([True, False, True, True])
+        s.defer_rows("k", "filter", 4, jnp.sum(mask))
+        assert s.selectivity("k") is None     # not yet drained
+        before = profiling.counters.get("stats.drain_sync")
+        s.drain_pending()
+        assert profiling.counters.get("stats.drain_sync") == before + 1
+        assert s.selectivity("k") == pytest.approx(0.75)
+        s.drain_pending()                     # empty drain: no extra sync
+        assert profiling.counters.get("stats.drain_sync") == before + 1
+
+    def test_pending_bound_drops_oldest(self, monkeypatch):
+        monkeypatch.setattr(statstore, "MAX_PENDING", 2)
+        s = StatStore()
+        for i in range(4):
+            s.defer_rows("k", "filter", 10, jnp.asarray(i))
+        assert profiling.counters.get("stats.pending_dropped") == 2
+        s.drain_pending()
+        assert s.entry("k")["sel_observations"] == 2
+
+    def test_selectivity_key_extraction(self):
+        assert statstore.selectivity_key(
+            "<f4/<i4|F:B(>,C('a':<f4),Lf)") == \
+            "<f4/<i4|F:B(>,C('a':<f4),Lf)"
+        # namespace tag stripped, O/W parts dropped, F parts kept
+        key = "ns:'t'|<f4/<i4|W('x')=B(+)|F:B(>)|O('y')=C"
+        assert statstore.selectivity_key(key) == "<f4/<i4|F:B(>)"
+        assert statstore.selectivity_key("<f4/<i4|O('y')=C") is None
+
+
+class TestConcurrencyHammer:
+    def test_16_thread_mixed_hammer_no_lost_updates(self):
+        s = StatStore()
+        config.stats_max_entries = 64
+        threads_n, iters = 16, 200
+        keys = [f"plan-{i}" for i in range(4)]
+        stop = threading.Event()
+
+        def writer(tid):
+            for i in range(iters):
+                k = keys[(tid + i) % len(keys)]
+                s.record_flush(k, "pipeline", wall_ms=0.5,
+                               compiled=(i % 7 == 0), est_bytes=i)
+                s.record_rows(k, "pipeline", 10, 5)
+
+        def reader():
+            while not stop.is_set():
+                s.report(drain=False)
+
+        r = threading.Thread(target=reader)
+        r.start()
+        ts = [threading.Thread(target=writer, args=(t,))
+              for t in range(threads_n)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        stop.set()
+        r.join()
+        total = threads_n * iters
+        entries = [s.entry(k) for k in keys]
+        assert sum(e["flushes"] for e in entries) == total
+        assert sum(e["sel_observations"] for e in entries) == total
+        assert sum(e["rows_in"] for e in entries) == total * 10
+        assert sum(e["rows_out"] for e in entries) == total * 5
+        # digest coherence: every flush landed in exactly one digest
+        assert sum(e["wall_ms"]["count"] + e["compile_ms"]["count"]
+                   for e in entries) == total
+
+
+# ---------------------------------------------------------------------------
+# Persistence: atomic write, merge, corruption ladder
+# ---------------------------------------------------------------------------
+
+
+class TestPersistence:
+    def _store_with(self, key="k", flushes=3):
+        s = StatStore()
+        for _ in range(flushes):
+            s.record_flush(key, "pipeline", wall_ms=1.0)
+        s.record_rows(key, "pipeline", 100, 40)
+        return s
+
+    def test_save_load_roundtrip(self, tmp_path):
+        p = str(tmp_path / "stats.jsonl")
+        s = self._store_with()
+        assert s.save(p) is True
+        header = json.loads(open(p).readline())
+        assert header["version"] == statstore.SCHEMA_VERSION
+        s2 = StatStore()
+        assert s2.load(p) == 1
+        assert s2.entry("k") == s.entry("k")
+
+    def test_merge_dont_clobber_on_save(self, tmp_path):
+        p = str(tmp_path / "stats.jsonl")
+        rich = self._store_with("shared", flushes=10)
+        rich.record_flush("only-a", "pipeline", wall_ms=1.0)
+        assert rich.save(p)
+        poor = self._store_with("shared", flushes=1)
+        poor.record_flush("only-b", "grouped", wall_ms=1.0)
+        assert poor.save(p, merge=True)
+        merged = StatStore()
+        assert merged.load(p) == 3
+        # winner-per-key: the richer 'shared' entry survived the
+        # less-observed writer; both singletons are present
+        assert merged.entry("shared")["flushes"] == 10
+        assert merged.entry("only-a") and merged.entry("only-b")
+
+    def test_load_save_cycle_is_idempotent(self, tmp_path):
+        p = str(tmp_path / "stats.jsonl")
+        s = self._store_with()
+        s.save(p)
+        s.load(p)          # re-adopting our own snapshot must not double
+        s.save(p, merge=True)
+        s2 = StatStore()
+        s2.load(p)
+        assert s2.entry("k")["flushes"] == 3
+
+    def test_torn_write_never_replaces_snapshot(self, tmp_path):
+        p = str(tmp_path / "stats.jsonl")
+        self._store_with(flushes=2).save(p)
+        good = open(p).read()
+        s = self._store_with("k2", flushes=5)
+        with faults.inject_faults("stats_persist:torn_chunk:1"):
+            assert s.save(p) is False
+        assert open(p).read() == good           # snapshot intact
+        assert not [f for f in os.listdir(tmp_path) if f.endswith(".tmp")]
+        assert profiling.counters.get("stats.persist_failed") == 1
+        ev = [e for e in RECOVERY_LOG.events() if e.site == "stats_persist"]
+        assert ev and ev[-1].action == "fallback"
+
+    def test_io_error_save_degrades_in_memory(self, tmp_path):
+        p = str(tmp_path / "stats.jsonl")
+        s = self._store_with()
+        with faults.inject_faults("stats_persist:io_error:1"):
+            assert s.save(p) is False
+        assert not os.path.exists(p)
+        assert s.entry("k")["flushes"] == 3     # in-memory store intact
+        assert profiling.counters.get("stats.persist_failed") == 1
+
+    def test_corrupt_file_degrades_to_empty_with_recovery(self, tmp_path):
+        p = str(tmp_path / "stats.jsonl")
+        open(p, "w").write("{not json\nat all\n")
+        s = StatStore()
+        assert s.load(p) == 0
+        assert len(s) == 0
+        assert profiling.counters.get("stats.load_failed") == 1
+        ev = [e for e in RECOVERY_LOG.events() if e.site == "stats_persist"]
+        assert ev and ev[-1].rung == "empty"
+
+    def test_stale_version_degrades_to_empty(self, tmp_path):
+        p = str(tmp_path / "stats.jsonl")
+        open(p, "w").write(json.dumps({"version": 999}) + "\n")
+        s = StatStore()
+        assert s.load(p) == 0
+        assert profiling.counters.get("stats.load_failed") == 1
+
+    def test_missing_file_is_clean_zero(self, tmp_path):
+        s = StatStore()
+        assert s.load(str(tmp_path / "nope.jsonl")) == 0
+        assert profiling.counters.get("stats.load_failed") == 0
+
+    def test_load_and_save_respect_max_entries(self, tmp_path):
+        """Review regression: a huge snapshot must neither blow the
+        in-memory maxEntries bound at load nor grow the on-disk file
+        monotonically across save cycles."""
+        p = str(tmp_path / "stats.jsonl")
+        big = StatStore()
+        config.stats_max_entries = 512
+        for i in range(40):
+            big.record_flush(f"k{i}", "pipeline", wall_ms=1.0)
+        assert big.save(p)
+        config.stats_max_entries = 8
+        s = StatStore()
+        s.load(p)
+        assert len(s) == 8
+        assert profiling.counters.get("stats.evict") >= 32
+        # a merging save trims the DISK set to the bound too
+        assert s.save(p, merge=True)
+        with open(p) as f:
+            header = json.loads(f.readline())
+            assert header["entries"] == 8
+
+    def test_concurrent_saves_never_tear_the_snapshot(self, tmp_path):
+        """Review regression: racing in-process saves serialize (shared
+        temp path + read-merge-write cycle) — the promoted snapshot must
+        stay loadable whatever the interleaving."""
+        p = str(tmp_path / "stats.jsonl")
+        s = StatStore()
+        for i in range(12):
+            s.record_flush(f"k{i}", "pipeline", wall_ms=1.0)
+        errors: list = []
+
+        def saver():
+            for _ in range(10):
+                if not s.save(p, merge=True):
+                    errors.append("save degraded without a fault plan")
+
+        ts = [threading.Thread(target=saver) for _ in range(8)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert errors == []
+        fresh = StatStore()
+        assert fresh.load(p) == 12      # loadable, complete, untorn
+        assert not [f for f in os.listdir(tmp_path)
+                    if f.endswith(".tmp")]
+
+
+# ---------------------------------------------------------------------------
+# The acceptance flow: persisted history -> fresh-session EXPLAIN est rows
+# ---------------------------------------------------------------------------
+
+
+class TestHistoryInformedExplain:
+    def _register_price_view(self, session):
+        dq.register_builtin_rules()
+        df = (session.read.format("csv").option("inferSchema", "true")
+              .option("header", "false").load(dataset_path("abstract")))
+        df = df.with_column_renamed("_c0", "guest")
+        df = df.with_column_renamed("_c1", "price")
+        df = df.with_column(
+            "price_no_min",
+            dq.call_udf("minimumPriceRule", dq.col("price")))
+        df.create_or_replace_temp_view("price")
+
+    def test_fresh_session_renders_est_rows_within_2x(self, tmp_path):
+        path = str(tmp_path / "stats.jsonl")
+        # --- prior session: run the headline DQ+Lasso workload and
+        # persist its observed cardinalities on stop()
+        s1 = dq.TpuSession.builder().app_name("stats-1").master(
+            "local[*]").config("spark.stats.path", path).get_or_create()
+        df = run_dq_pipeline(s1, dataset_path("abstract"))
+        assert df.count() == 24                  # golden unchanged
+        from sparkdq4ml_tpu.models import LinearRegression
+
+        model = LinearRegression(max_iter=40, reg_param=1.0,
+                                 elastic_net_param=1.0).fit(
+            prepare_features(df))
+        assert model.summary.root_mean_squared_error == pytest.approx(
+            2.809940, rel=1e-3)                  # golden unchanged
+        s1.stop()
+        assert os.path.exists(path)
+
+        # --- fresh session: empty store, history only via the snapshot
+        statstore.STORE.clear()
+        s2 = dq.TpuSession.builder().app_name("stats-2").master(
+            "local[*]").config("spark.stats.path", path).get_or_create()
+        try:
+            self._register_price_view(s2)
+            before = profiling.counters.snapshot()
+            plan_frame = s2.sql("EXPLAIN " + HEADLINE_DQ)
+            after = profiling.counters.snapshot()
+            text = str(plan_frame.to_pydict()["plan"][0])
+            # plain EXPLAIN executed NOTHING
+            for key in ("pipeline.flush", "pipeline.compile",
+                        "grouped.compile", "frame.host_sync"):
+                assert after.get(key, 0) == before.get(key, 0), key
+            fused = next(ln for ln in text.splitlines()
+                         if "FusedStage" in ln or ln.startswith("Filter"))
+            m = re.search(r"est_rows=(\d+)", fused)
+            assert m, f"no est rows on the stage line: {fused!r}"
+            est = int(m.group(1))
+            # ANALYZE then measures the true valid rows — history must
+            # land within 2x of it
+            atext = str(s2.sql("EXPLAIN ANALYZE " + HEADLINE_DQ)
+                        .to_pydict()["plan"][0])
+            vm = re.search(r"rows_valid=(\d+)", atext)
+            assert vm, atext
+            valid = int(vm.group(1))
+            assert valid > 0
+            assert est <= 2 * valid and valid <= 2 * max(est, 1), \
+                (est, valid)
+            assert "est_drift=" in atext
+        finally:
+            s2.stop()
+
+    def test_scan_est_rows_is_static_slot_count(self, session):
+        Frame({"a": [1.0, 2.0, 3.0]}).create_or_replace_temp_view("t")
+        text = str(session.sql("EXPLAIN SELECT a FROM t WHERE a > 99")
+                   .to_pydict()["plan"][0])
+        scan = next(ln for ln in text.splitlines() if "Scan[t]" in ln)
+        assert "est_rows=3" in scan
+
+    def test_join_probe_scan_gets_est_rows_too(self, session):
+        """Review regression: the est_rows column must not silently
+        disappear on a Join's probe-side (children[1]) Scan."""
+        Frame({"k": [1, 2], "a": [1.0, 2.0]}
+              ).create_or_replace_temp_view("t")
+        Frame({"k": [1, 2, 3], "b": [1.0, 2.0, 3.0]}
+              ).create_or_replace_temp_view("u")
+        text = str(session.sql(
+            "EXPLAIN SELECT t.a, u.b FROM t JOIN u USING (k)")
+            .to_pydict()["plan"][0])
+        left = next(ln for ln in text.splitlines() if "Scan[t]" in ln)
+        right = next(ln for ln in text.splitlines() if "Scan[u]" in ln)
+        assert "est_rows=2" in left
+        assert "est_rows=3" in right
+
+    def test_no_history_renders_dash(self, session):
+        Frame({"a": [1.0, 2.0, 3.0]}).create_or_replace_temp_view("t")
+        text = str(session.sql("EXPLAIN SELECT a FROM t WHERE a > 1")
+                   .to_pydict()["plan"][0])
+        stage = next(ln for ln in text.splitlines()
+                     if "FusedStage" in ln)
+        assert "est_rows=-" in stage
+
+    def test_in_session_history_feeds_next_explain(self, session):
+        Frame({"a": [1.0, 2.0, 3.0, 4.0]}).create_or_replace_temp_view("t")
+        session.sql("SELECT a FROM t WHERE a > 2.5").count()
+        text = str(session.sql("EXPLAIN SELECT a FROM t WHERE a > 2.5")
+                   .to_pydict()["plan"][0])
+        stage = next(ln for ln in text.splitlines()
+                     if "FusedStage" in ln)
+        assert "est_rows=2" in stage
+
+    def test_qualified_where_matches_flush_history(self, session):
+        """Review regression: the executor resolves ``t.x`` to ``x``
+        BEFORE the filter defers, so flush history lands under the
+        resolved predicate — the EXPLAIN-side key must resolve the same
+        way or qualified predicates silently never estimate."""
+        Frame({"x": [float(i) for i in range(16)]}
+              ).create_or_replace_temp_view("t")
+        session.sql("SELECT t.x FROM t WHERE t.x > 2.0").count()
+        text = str(session.sql("EXPLAIN SELECT t.x FROM t WHERE t.x > 2.0")
+                   .to_pydict()["plan"][0])
+        stage = next(ln for ln in text.splitlines()
+                     if "FusedStage" in ln)
+        assert "est_rows=13" in stage, stage
+
+    def test_chunked_flush_records_stats_and_fires_faults(self):
+        """Review regression: an over-budget (row-chunked) flush is
+        still one plan execution — it must record into the statstore
+        AND remain reachable by a scheduled pipeline_flush fault."""
+        f = Frame({"a": [float(i) for i in range(64)]})
+        with faults.inject_faults("oom:oom:1:n=64"):
+            out = f.filter(f.col("a") > 31.5)
+            assert out.count() == 32
+        assert profiling.counters.get("stats.record") >= 1
+        statstore.STORE.drain_pending()
+        doc = statstore.STORE.report(drain=False)
+        pipe = [e for e in doc["entries"] if e["kind"] == "pipeline"]
+        assert pipe and pipe[0]["flushes"] == 1
+        assert pipe[0]["selectivity"] == pytest.approx(0.5)
+        # a fault scheduled at the flush site fires on the CHUNKED path
+        # too, and the Frame._flush ladder still lands the right answer
+        RECOVERY_LOG.clear()
+        f2 = Frame({"a": [float(i) for i in range(64)]})
+        with faults.inject_faults("oom:oom:1:n=64",
+                                  "pipeline_flush:device_error:1"):
+            out2 = f2.filter(f2.col("a") > 31.5)
+            assert out2.count() == 32
+        assert any(e.site == "pipeline_flush"
+                   for e in RECOVERY_LOG.events())
+
+    def test_stats_disabled_omits_est_rows(self, session):
+        Frame({"a": [1.0, 2.0]}).create_or_replace_temp_view("t")
+        config.stats_enabled = False
+        text = str(session.sql("EXPLAIN SELECT a FROM t WHERE a > 1")
+                   .to_pydict()["plan"][0])
+        assert "est_rows" not in text
+
+    def test_stats_report_shape_and_conf_gate(self, session):
+        Frame({"a": [1.0, 2.0, 3.0]}).create_or_replace_temp_view("t")
+        session.sql("SELECT a FROM t WHERE a > 1").count()
+        doc = session.stats_report()
+        assert doc["enabled"] is True
+        pipe = [e for e in doc["entries"] if e["kind"] == "pipeline"]
+        assert pipe and pipe[0]["flushes"] >= 1
+        assert pipe[0]["selectivity"] == pytest.approx(2 / 3)
+        config.stats_enabled = False
+        off = session.stats_report()
+        assert off == {"enabled": False, "entries": [], "size": 0}
+
+    def test_grouped_selectivity_recorded(self, session):
+        Frame({"k": [1, 1, 2, 2], "v": [1.0, 2.0, 3.0, 4.0]}
+              ).create_or_replace_temp_view("g")
+        session.sql("SELECT k, sum(v) s FROM g GROUP BY k").to_pydict()
+        doc = session.stats_report()
+        grouped = [e for e in doc["entries"] if e["kind"] == "grouped"]
+        assert grouped
+        assert grouped[0]["selectivity"] == pytest.approx(0.5)
+        assert grouped[0]["host_syncs"] >= 1
+
+    def test_session_conf_scoping(self):
+        s = dq.TpuSession.builder().app_name("stats-conf").master(
+            "local[*]").config("spark.stats.enabled", "false").config(
+            "spark.stats.maxEntries", "17").get_or_create()
+        try:
+            assert config.stats_enabled is False
+            assert config.stats_max_entries == 17
+        finally:
+            s.stop()
+        assert config.stats_enabled is True
+        assert config.stats_max_entries == 512
+
+
+# ---------------------------------------------------------------------------
+# Disabled-mode pins (PR-10 no-fault-plan style)
+# ---------------------------------------------------------------------------
+
+
+class TestDisabledModePins:
+    def test_disabled_flush_never_touches_the_store(self, monkeypatch):
+        config.stats_enabled = False
+
+        def boom(*a, **k):
+            raise AssertionError("stats hook ran with stats disabled")
+
+        monkeypatch.setattr(statstore.STORE, "record_flush", boom)
+        monkeypatch.setattr(statstore.STORE, "record_rows", boom)
+        monkeypatch.setattr(statstore.STORE, "defer_rows", boom)
+        f = Frame({"a": [1.0, 2.0, 3.0], "k": [1, 1, 2]})
+        out = f.filter(f.col("a") > 1.5)
+        assert out.count() == 2                     # pipeline flush ran
+        g = f.group_by("k").count()
+        assert g.num_slots == 2                     # grouped flush ran
+        d = f.distinct()
+        assert d.num_slots == 3
+
+    def test_disabled_explain_never_annotates(self, monkeypatch, session):
+        config.stats_enabled = False
+        monkeypatch.setattr(
+            statstore.STORE, "drain_pending",
+            lambda *a, **k: (_ for _ in ()).throw(AssertionError("drained")))
+        Frame({"a": [1.0]}).create_or_replace_temp_view("t")
+        session.sql("EXPLAIN SELECT a FROM t WHERE a > 0").to_pydict()
+
+    def test_unset_metrics_port_starts_no_telemetry(self):
+        srv = QueryServer(workers=1).start()
+        try:
+            assert srv.telemetry is None
+        finally:
+            srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# HTTP telemetry endpoint
+# ---------------------------------------------------------------------------
+
+
+class TestHTTPEndpoint:
+    @pytest.fixture
+    def served(self, session):
+        profiling.counters.clear("serve.")
+        srv = QueryServer(session, workers=2, metrics_port=0).start()
+        ctx = srv.context("a")
+        ctx.register_view(
+            "t", Frame({"a": [1.0, 2.0, 3.0], "k": [1, 1, 2]}))
+        srv.submit("SELECT a FROM t WHERE a > 1", tenant="a").result(
+            timeout=60)
+        yield srv, f"http://127.0.0.1:{srv.telemetry.port}"
+        srv.stop()
+
+    def test_metrics_route_serves_prometheus_text(self, served):
+        srv, base = served
+        status, body = _get(base + "/metrics")
+        assert status == 200
+        assert "# TYPE sparkdq4ml_serve_admit counter" in body
+        assert re.search(r"^sparkdq4ml_serve_admit 1(\.0)?$", body,
+                         re.M), body[:400]
+        # histograms render cumulative buckets for a real scraper
+        assert 'sparkdq4ml_serve_e2e_ms_bucket{le="+Inf"}' in body
+        assert "sparkdq4ml_serve_e2e_ms_count" in body
+
+    def test_healthz_ok_then_degraded_on_breaker(self, served):
+        srv, base = served
+        status, body = _get(base + "/healthz")
+        doc = json.loads(body)
+        assert status == 200 and doc["status"] == "ok"
+        assert doc["serving"] is True and doc["workers"] == 2
+        srv.breaker.trip(srv.admission.breaker_key("a"))
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(base + "/healthz")
+        assert ei.value.code == 503
+        doc = json.loads(ei.value.read().decode())
+        assert doc["status"] == "degraded"
+        assert "serve/a" in doc["open_breakers"]
+
+    def test_plans_route_serves_stats_store(self, served):
+        _, base = served
+        status, body = _get(base + "/plans")
+        doc = json.loads(body)
+        assert status == 200 and doc["enabled"] is True
+        pipe = [e for e in doc["entries"] if e["kind"] == "pipeline"]
+        assert pipe and pipe[0]["selectivity"] is not None
+
+    def test_trace_route_serves_recent_spans(self, served):
+        srv, base = served
+        obs.enable()
+        try:
+            srv.submit("SELECT a FROM t", tenant="a").result(timeout=60)
+            status, body = _get(base + "/trace")
+        finally:
+            obs.disable()
+        doc = json.loads(body)
+        assert status == 200
+        assert any(s["name"] == "serve.query" for s in doc["spans"])
+
+    def test_unknown_route_404(self, served):
+        _, base = served
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(base + "/secrets")
+        assert ei.value.code == 404
+
+    def test_session_serve_conf_starts_endpoint(self):
+        s = dq.TpuSession.builder().app_name("stats-http").master(
+            "local[*]").config("spark.serve.metricsPort", "0"
+                               ).get_or_create()
+        try:
+            srv = s.serve()
+            assert srv.telemetry is not None and srv.telemetry.port > 0
+            status, _ = _get(
+                f"http://127.0.0.1:{srv.telemetry.port}/metrics")
+            assert status == 200
+        finally:
+            s.stop()
+
+    def test_standalone_telemetry_without_query_server(self):
+        with TelemetryServer(None, port=0) as t:
+            status, body = _get(f"http://127.0.0.1:{t.port}/healthz")
+            doc = json.loads(body)
+            assert status == 200
+            assert doc == {"status": "ok", "serving": False}
+
+
+# ---------------------------------------------------------------------------
+# SLO burn-rate gauges
+# ---------------------------------------------------------------------------
+
+
+class TestSLOBurn:
+    def _run_queries(self, slo_ms, n=4):
+        srv = QueryServer(workers=2, slo_p99_ms=slo_ms).start()
+        try:
+            ctx = srv.context("ten")
+            ctx.register_view("t", Frame({"a": [1.0, 2.0]}))
+            for _ in range(n):
+                srv.submit("SELECT a FROM t", tenant="ten").result(
+                    timeout=60)
+        finally:
+            srv.stop()
+
+    def test_all_over_target_burns_at_100x(self):
+        self._run_queries(slo_ms=1e-4)
+        assert obs.METRICS.get_gauge("serve.slo_burn") == pytest.approx(
+            100.0)
+        assert obs.METRICS.get_gauge(
+            "serve.slo_burn.ten") == pytest.approx(100.0)
+
+    def test_all_under_target_burns_zero(self):
+        self._run_queries(slo_ms=1e9)
+        assert obs.METRICS.get_gauge("serve.slo_burn") == 0.0
+        assert obs.METRICS.get_gauge("serve.slo_burn.ten") == 0.0
+
+    def test_no_target_no_gauge(self):
+        obs.METRICS.clear()
+        self._run_queries(slo_ms=None)
+        snap = obs.METRICS.snapshot()
+        assert "serve.slo_burn" not in snap
+        assert "serve.slo_burn.ten" not in snap
+
+    def test_burn_appears_in_prometheus_with_declared_help(self):
+        self._run_queries(slo_ms=1e-4)
+        text = obs.prometheus_text()
+        line = next(ln for ln in text.splitlines()
+                    if ln.startswith("# HELP sparkdq4ml_serve_slo_burn "))
+        assert "burn rate" in line
+        assert "# TYPE sparkdq4ml_serve_slo_burn gauge" in text
+
+
+# ---------------------------------------------------------------------------
+# Chrome-trace counter tracks + Prometheus registry satellites
+# ---------------------------------------------------------------------------
+
+
+class TestChromeCounterEvents:
+    def test_counter_events_emitted_and_cleared(self):
+        obs.TRACER.clear()
+        obs.enable()
+        try:
+            with obs.span("op", cat="frame"):
+                pass
+        finally:
+            obs.disable()
+        doc = obs.chrome_trace()
+        cevents = [e for e in doc["traceEvents"] if e["ph"] == "C"]
+        assert cevents, "no counter track events"
+        names = {e["name"] for e in cevents}
+        assert {"mem.live_bytes", "serve.queue_depth", "pipeline.hit",
+                "pipeline.compile"} <= names
+        for e in cevents:
+            assert "value" in e["args"]
+        json.dumps(doc)
+        obs.TRACER.clear()
+        assert not [e for e in obs.chrome_trace()["traceEvents"]
+                    if e["ph"] == "C"]
+
+    def test_sampling_is_throttled(self):
+        obs.TRACER.clear()
+        obs.enable()
+        try:
+            for _ in range(50):       # well inside one 20 ms window
+                with obs.span("op", cat="frame"):
+                    pass
+        finally:
+            obs.disable()
+        assert len(obs.TRACER.counter_samples()) <= 2
+
+
+class TestMetricRegistry:
+    def test_registry_covers_every_live_metric(self):
+        """Every name observable in a real scrape resolves against the
+        registry (exact or family) — the runtime mirror of the static
+        metric-name rule."""
+        from sparkdq4ml_tpu.utils.observability import (METRIC_NAMES,
+                                                        METRIC_NAME_PREFIXES)
+
+        profiling.counters.increment("pipeline.hit")
+        engine_prefixes = ("pipeline.", "grouped.", "serve.", "stats.",
+                           "frame.", "ingest.", "mem.", "trace.",
+                           "faults.", "recovery.", "jit.", "solver.",
+                           "parallel.", "mesh.")
+        for name in profiling.counters.snapshot():
+            if not name.startswith(engine_prefixes):
+                continue          # ad-hoc test counters are not engine series
+            assert name in METRIC_NAMES or any(
+                name.startswith(p) for p in METRIC_NAME_PREFIXES), name
+
+    def test_prometheus_type_lines_all_three_kinds(self):
+        profiling.counters.increment("pipeline.hit")
+        obs.METRICS.set_gauge("mem.live_bytes", 1)
+        obs.METRICS.observe("serve.e2e_ms", 1.0)
+        text = obs.prometheus_text()
+        assert "# TYPE sparkdq4ml_pipeline_hit counter" in text
+        assert "# TYPE sparkdq4ml_mem_live_bytes gauge" in text
+        assert "# TYPE sparkdq4ml_serve_e2e_ms histogram" in text
+        # declared help text wins over the generic prefix fallback
+        assert ("# HELP sparkdq4ml_pipeline_hit pipeline.hit - "
+                "fused-program plan-cache replays") in text
