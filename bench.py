@@ -736,6 +736,222 @@ def bench_serving(session, data_path: str):
     return row
 
 
+_SHARD_WORKER = r'''
+import json, os, sys, time
+n, d, golden = int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3])
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + f" --xla_force_host_platform_device_count={max(d, 1)}"
+    ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import jax.numpy as jnp
+import sparkdq4ml_tpu as dq
+from sparkdq4ml_tpu.frame.frame import Frame
+from sparkdq4ml_tpu.utils.profiling import counters
+import sparkdq4ml_tpu.ops.expressions as E
+from sparkdq4ml_tpu.parallel import shard as shard_mod
+
+sess = (dq.TpuSession.builder().app_name("bench-shard").master("local[*]")
+        .config("spark.shard.enabled", "true" if d > 1 else "false")
+        .config("spark.shard.minRows", "8" if golden else "1024")
+        .get_or_create())
+
+if golden:
+    # headline DQ+Lasso golden workload, sharding per arm: parity is a
+    # RESULT property, not a layout property
+    dq.register_builtin_rules()
+    df = (sess.read.format("csv").option("inferSchema", "true")
+          .load(sys.argv[4]))
+    df = df.with_column_renamed("_c0", "guest") \
+           .with_column_renamed("_c1", "price")
+    df = df.with_column("price_no_min",
+                        dq.call_udf("minimumPriceRule", dq.col("price")))
+    df.create_or_replace_temp_view("price")
+    df = sess.sql("SELECT cast(guest as int) guest, price_no_min AS price "
+                  "FROM price WHERE price_no_min > 0")
+    df = df.with_column("price_correct_correl",
+                        dq.call_udf("priceCorrelationRule",
+                                    dq.col("price"), dq.col("guest")))
+    df.create_or_replace_temp_view("price")
+    df = sess.sql("SELECT guest, price_correct_correl AS price "
+                  "FROM price WHERE price_correct_correl > 0")
+    df = df.with_column("label", df.col("price"))
+    from sparkdq4ml_tpu.models import LinearRegression, VectorAssembler
+    df = VectorAssembler(["guest"], "features").transform(df)
+    model = LinearRegression(max_iter=40, reg_param=1.0,
+                             elastic_net_param=1.0).fit(df)
+    print(json.dumps({
+        "devices": d, "count": df.count(),
+        "rmse": float(model.summary.root_mean_squared_error),
+        "sharded": df._shard is not None}))
+    sys.exit(0)
+
+rng = np.random.default_rng(7)
+f = Frame({"v": rng.normal(size=n),
+           "k": rng.integers(0, 1024, n).astype(np.float64),
+           "w": rng.normal(size=n)})
+if d > 1:
+    f = shard_mod.maybe_shard_frame(f)
+
+def chain(fr):
+    for i in range(10):
+        fr = fr.with_column(f"c{i}", E.col("v") * float(i + 1) + 0.5)
+        fr = fr.filter(E.col(f"c{i}") > float(-1 - i))
+    return fr
+
+def flush():
+    out = chain(f)
+    jax.block_until_ready(list(out._data.values()) + [out._mask])
+    return out
+
+def med(fn, reps=3):
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        fn()
+        ts.append(time.perf_counter() - t0)
+    ts.sort()
+    return ts[len(ts) // 2]
+
+out = flush()                                  # warm: trace + compile
+compiles0 = counters.get("pipeline.compile")
+pipe_ms = med(flush) * 1e3
+steady = counters.get("pipeline.compile") - compiles0
+m = np.asarray(out._mask)
+ck_pipe = float(np.asarray(jnp.asarray(out._data["c9"]))[m].sum())
+
+def grp():
+    return f.group_by("k").agg({"v": "sum", "w": "avg"}).to_pydict()
+
+gp = grp()                                     # warm
+ck_group = [float(np.sum(gp["sum(v)"])), float(np.sum(gp["avg(w)"])),
+            int(len(gp["k"]))]
+group_ms = med(grp) * 1e3
+
+rsz = max(n // 10, 16)
+r = Frame({"k": rng.integers(0, 1024, rsz).astype(np.float64),
+           "z": rng.normal(size=rsz)})
+if d > 1:
+    r = shard_mod.maybe_shard_frame(r)
+
+def jn():
+    return int(f.join(r, "k", "inner").num_slots)
+
+jrows = jn()                                   # warm
+join_ms = med(jn) * 1e3
+print(json.dumps({
+    "rows": n, "devices": d, "pipeline_ms": round(pipe_ms, 3),
+    "groupby_ms": round(group_ms, 3), "join_ms": round(join_ms, 3),
+    "compiles_steady": steady, "ck_pipe": ck_pipe, "ck_group": ck_group,
+    "join_rows": jrows, "sharded": f._shard is not None}))
+'''
+
+
+def bench_sharded(log):
+    """(sharded) Row-sharded frame execution (parallel/shard.py +
+    the shard_map pipeline/grouped lowerings) across forced host device
+    counts: the 20-op fused chain, GROUP BY (sum/avg), and an inner join
+    at each row count × 1/2/4/8 devices, each arm an isolated subprocess
+    (device count is a process-level XLA flag). Parity-asserted — the
+    d>1 arms must reproduce the 1-device checksums (pipeline and join
+    exact; the grouped merge collective at 1e-5 relative, the
+    engine-default float32's reduction-order ULP envelope) — and
+    golden-pinned via the headline DQ+Lasso workload with sharding on.
+    CPU-sandbox honesty: forced host devices share the same cores, so
+    these rows prove structure and scaling SHAPE (plus steady-state
+    zero-recompile), not wall-clock wins — speedup columns are captured
+    for TPU runs where the shards are real chips."""
+    import subprocess
+    import sys
+
+    try:
+        rows_list = [int(x) for x in os.environ.get(
+            "BENCH_SHARD_ROWS", "1000000,10000000").split(",") if x]
+    except ValueError:
+        rows_list = [1_000_000, 10_000_000]
+    devs = [1, 2, 4, 8]
+    section = {"pipeline": [], "groupby": [], "join": [],
+               "parity_ok": True, "parity_failures": []}
+
+    def run_arm(n, d, golden=False, data=""):
+        env = dict(os.environ)
+        env.pop("XLA_FLAGS", None)
+        env["JAX_PLATFORMS"] = "cpu"
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-c", _SHARD_WORKER, str(n), str(d),
+                 "1" if golden else "0", data],
+                cwd=REPO, env=env, capture_output=True, text=True,
+                timeout=1800)
+        except subprocess.SubprocessError as e:
+            log(f"sharded arm n={n} d={d} failed: {e}")
+            return None
+        for line in reversed(proc.stdout.strip().splitlines()):
+            try:
+                return json.loads(line)
+            except ValueError:
+                continue
+        log(f"sharded arm n={n} d={d} produced no JSON "
+            f"(rc={proc.returncode}): {proc.stderr[-400:]}")
+        return None
+
+    for n in rows_list:
+        base = None
+        for d in devs:
+            row = run_arm(n, d)
+            if row is None:
+                continue
+            if d == 1:
+                base = row
+            else:
+                ok = base is not None and (
+                    row["ck_pipe"] == base["ck_pipe"]
+                    and row["join_rows"] == base["join_rows"]
+                    and row["ck_group"][2] == base["ck_group"][2]
+                    # grouped float aggregates merge cross-shard partials
+                    # — reduction order differs, so the engine-default
+                    # float32 checksums compare at ULP-order tolerance
+                    # (pipeline/join checksums stay EXACT-equality)
+                    and all(abs(a - b) <= 1e-5 * max(abs(a), abs(b), 1.0)
+                            for a, b in zip(row["ck_group"][:2],
+                                            base["ck_group"][:2])))
+                if not ok:
+                    section["parity_ok"] = False
+                    section["parity_failures"].append(
+                        {"rows": n, "devices": d})
+            for kind in ("pipeline", "groupby", "join"):
+                entry = {
+                    "config": f"{kind}_r{n}_d{d}",
+                    "rows": n, "devices": d,
+                    f"{kind}_ms": row[f"{kind}_ms"],
+                }
+                if base is not None and d > 1:
+                    entry["speedup_vs_1dev"] = round(
+                        base[f"{kind}_ms"] / row[f"{kind}_ms"], 3) \
+                        if row[f"{kind}_ms"] else None
+                if kind == "pipeline":
+                    entry["compiles_steady"] = row["compiles_steady"]
+                section[kind].append(entry)
+            log(json.dumps({"config": "sharded", "rows": n, "devices": d,
+                            **{k: row[k] for k in ("pipeline_ms",
+                                                   "groupby_ms",
+                                                   "join_ms")}}))
+    gold = run_arm(0, 8, golden=True,
+                   data=os.path.join(REPO, "data", "dataset-abstract.csv"))
+    if gold is not None:
+        section["golden"] = gold
+        section["golden_ok"] = (
+            gold.get("count") == 24
+            and abs(gold.get("rmse", 0.0) - 2.809940) / 2.809940 < 0.01)
+        if not section["golden_ok"]:
+            log(f"sharded golden MISMATCH: {gold}")
+    return section
+
+
 def _acquire_bench_lock(wait_s: float = 1200.0):
     """Serialize bench runs across processes via an exclusive flock.
 
@@ -1239,6 +1455,10 @@ def main():
                             os.path.join(REPO, "data",
                                          "dataset-abstract.csv"))
 
+    if SMOKE and "BENCH_SHARD_ROWS" not in os.environ:
+        os.environ["BENCH_SHARD_ROWS"] = "100000"
+    sharded = bench_sharded(log)
+
     # (e) baseline: sklearn GridSearchCV, same 3x3 grid / folds / family,
     # refit=True to match the in-program best-model refit
     t_e_cpu = None
@@ -1424,6 +1644,7 @@ def main():
         "grouped_ops": grouped_ops,
         "ingest": ingest,
         "serving": serving,
+        "sharded": sharded,
         "sweep": sweep_rows,
         "pallas_max_rel_diff": max((float(d) for _, d in pallas_diffs),
                                    default=None),

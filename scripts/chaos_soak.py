@@ -74,6 +74,8 @@ _CANDIDATES = (
     ("pipeline_flush", "device_error", 0.15, ""),
     ("pipeline_flush", "nan", 0.08, ""),
     ("grouped_flush", "device_error", 0.15, ""),
+    ("shard_flush", "device_error", 0.12, ""),
+    ("shard_merge", "device_error", 0.12, ""),
     ("ingest_native", "io_error", 0.06, ""),
     ("ingest_native", "torn_chunk", 0.08, ""),
     ("ingest_native", "thread_death", 0.08, ""),
@@ -96,6 +98,8 @@ _CANDIDATES = (
 _ROTATION = (
     ("pipeline_flush", "device_error", ""),
     ("grouped_flush", "device_error", ""),
+    ("shard_flush", "device_error", ""),
+    ("shard_merge", "device_error", ""),
     ("serve_exec", "device_error", ""),
     ("oom", "oom", ":n=64"),
     ("ingest_native", "io_error", ""),
@@ -476,6 +480,13 @@ def run_soak(seeds=None, clients=None, queries=1, workers=8,
                    # tiny chunks: the 320-byte headline CSV streams, so
                    # the mid-stream ingest fault sites are reachable
                    .config("spark.ingest.chunkBytes", "256")
+                   # sharding ON (minRows floored so the 40-row headline
+                   # frame actually shards): the soak's survival contract
+                   # covers the shard_flush/shard_merge ladders and the
+                   # sharded serving interplay whenever the backend
+                   # exposes a multi-device mesh (inert on one device)
+                   .config("spark.shard.enabled", "true")
+                   .config("spark.shard.minRows", "8")
                    .get_or_create())
         created_here = True
     seeds = int(config.chaos_seeds if seeds is None else seeds)
@@ -519,6 +530,14 @@ def run_soak(seeds=None, clients=None, queries=1, workers=8,
 
 
 def main(argv=None) -> int:
+    # Standalone runs shard for real: force a multi-device CPU platform
+    # BEFORE the first jax import (a no-op for accelerator backends —
+    # the flag only configures the host CPU platform; in-process tier-1
+    # smoke inherits the conftest's forced 8 devices instead).
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--seeds", type=int, default=None,
                     help="seeded schedules to sweep (spark.chaos.seeds)")

@@ -104,7 +104,8 @@ def load_bench_doc(path: str):
     if not isinstance(raw, dict):
         return None
     if any(k in raw for k in ("configs", "sweep", "frame_pipeline",
-                              "grouped_ops", "serving", "ingest")):
+                              "grouped_ops", "serving", "ingest",
+                              "sharded")):
         return raw
     if isinstance(raw.get("parsed"), dict):
         return raw["parsed"]

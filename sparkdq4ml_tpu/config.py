@@ -54,6 +54,9 @@ CONF_KEYS = {
     "spark.stats.path": "session",
     "spark.stats.maxEntries": "session",
     "spark.stats.flushOnStop": "session",
+    "spark.shard.enabled": "session",
+    "spark.shard.minRows": "session",
+    "spark.shard.devices": "session",
     "spark.observability.enabled": "init",
     "spark.observability.maxSpans": "init",
     "spark.observability.logSpans": "init",
@@ -188,6 +191,22 @@ class _Config:
     stats_max_entries: int = 512
     # Persist on session stop() (spark.stats.flushOnStop).
     stats_flush_on_stop: bool = True
+    # Row-sharded frames (parallel/shard.py): Frame._data/_mask lay out
+    # row-partitioned across the device mesh, the fused pipeline flush
+    # lowers as ONE shard_map program per plan, and grouped execution
+    # merges per-shard segment reductions with one cross-shard
+    # collective. Off by default (spark.shard.enabled): sharding is a
+    # scale feature, activated per session where a multi-device mesh
+    # exists; a trivial mesh leaves it inert either way.
+    shard_enabled: bool = False
+    # Row-count floor below which frames stay single-device
+    # (spark.shard.minRows) — placement traffic and the merge collective
+    # only pay for themselves at scale; joins/distinct likewise
+    # host-fallback below this bound.
+    shard_min_rows: int = 1 << 16
+    # Cap on the shard device count (spark.shard.devices); 0 = the whole
+    # session mesh.
+    shard_devices: int = 0
     # Pallas fast-path selection for the hot ops (ops/pallas_kernels.py):
     # the single-device Gramian in solvers.augmented_gram and the fused DQ
     # chain entry point ops/rules.py:dq_rules_fused. "off" = plain XLA

@@ -380,22 +380,32 @@ class DataFrameReader:
         if self._format == "parquet":
             from .parquet import read_parquet
 
-            return read_parquet(path)
-        if self._format == "json":
+            out = read_parquet(path)
+        elif self._format == "json":
             from .jsonl import read_json
 
-            return read_json(path,
-                             multi_line=self._bool_opt("multiline", False))
-        return read_csv(
-            path,
-            header=self._bool_opt("header", False),
-            infer_schema=self._bool_opt("inferschema", False),
-            delimiter=self._options.get("sep", self._options.get("delimiter", ",")),
-            engine=self._options.get("engine", "auto"),
-            quote=self._options.get("quote", '"'),
-            mode=self._options.get("mode", "PERMISSIVE"),
-            schema=self._schema,
-        )
+            out = read_json(path,
+                            multi_line=self._bool_opt("multiline", False))
+        else:
+            out = read_csv(
+                path,
+                header=self._bool_opt("header", False),
+                infer_schema=self._bool_opt("inferschema", False),
+                delimiter=self._options.get(
+                    "sep", self._options.get("delimiter", ",")),
+                engine=self._options.get("engine", "auto"),
+                quote=self._options.get("quote", '"'),
+                mode=self._options.get("mode", "PERMISSIVE"),
+                schema=self._schema,
+            )
+        # Sharded-frames ingest hand-off (spark.shard.enabled): loaded
+        # frames above the minRows bound land row-sharded, so the whole
+        # downstream pipeline — DQ filters, SQL, fit packing — runs the
+        # sharded lowerings without re-placement. One flag check when
+        # sharding is off.
+        from ..parallel.shard import maybe_shard_frame
+
+        return maybe_shard_frame(out)
 
     def csv(self, path: str, header: bool = False, inferSchema: bool = False) -> Frame:
         return self.option("header", header).option("inferSchema", inferSchema).load(path)

@@ -270,6 +270,16 @@ class Frame:
 
     _alias: Optional[str] = None  # set by .alias(); not inherited by _with
     _pending: tuple = ()          # deferred pipeline steps (see _defer)
+    # Row-shard layout descriptor (parallel/shard.py ShardedStore), or
+    # None for the single-device layout. A sharded frame's columns/mask
+    # are global arrays padded to devices×bucket slots with a False mask
+    # tail, laid out row-sharded over the mesh; masked-slot semantics
+    # make every consumer correct unchanged, while the flush path lowers
+    # pending steps as ONE shard_map program. Propagates through
+    # _with/_defer (same layout); ops that rebuild a compact Frame
+    # (sort, join, groupBy output, explode, union) return single-device
+    # frames — re-shard at the next ingest/explicit shard_frame call.
+    _shard = None
 
     # _data/_mask are flush-on-read properties so EVERY consumer — frame
     # methods, aggregates, models, tests poking internals — sees the
@@ -328,6 +338,7 @@ class Frame:
         f._data = dict(self._data if data is None else data)
         f._mask = self._mask if mask is None else mask
         f._n = self._n
+        f._shard = self._shard
         return f
 
     # -- pipeline compiler plumbing (ops/compiler.py) ----------------------
@@ -345,6 +356,7 @@ class Frame:
             f._data_store = self._data_store
             f._mask_store = self._mask_store
             f._pending = self._pending + (step,)
+            f._shard = self._shard
         f._n = self._n
         return f
 
@@ -405,7 +417,8 @@ class Frame:
                 return
             try:
                 new_data, new_mask, _ = run_pipeline(
-                    self._data_store, self._mask_store, self._n, steps)
+                    self._data_store, self._mask_store, self._n, steps,
+                    shard=self._shard)
                 if _faults.active() is not None:   # chaos armed
                     # Surface async-dispatched device faults INSIDE this
                     # try while chaos is armed (jax dispatch is async; an
@@ -493,10 +506,33 @@ class Frame:
         plan = _faults.active()
         nan_armed = plan is not None and plan._has("pipeline_flush",
                                                    ("nan",))
+        shard_store = self._shard
+        site = "pipeline_flush" if shard_store is None else "shard_flush"
 
         def fused():
             new_data, new_mask, _ = run_pipeline(
-                self._data_store, self._mask_store, self._n, steps)
+                self._data_store, self._mask_store, self._n, steps,
+                shard=shard_store)
+            if not nan_armed:
+                return new_data, new_mask, None
+            new_data, changed = self._corrupt_changed(new_data)
+            return new_data, new_mask, changed
+
+        degraded: list = []
+
+        def gather():
+            # shard_flush ladder rung 2 ("a device fault on one shard"):
+            # re-place the columns single-device and replay the SAME
+            # steps through the unsharded fused program; the frame drops
+            # its sharded layout (the caller below) — a fault costs this
+            # frame its distribution, never the query.
+            from ..parallel.shard import gather_store
+
+            counters.increment("pipeline.shard_gather")
+            data, mask = gather_store(self)
+            new_data, new_mask, _ = run_pipeline(data, mask, self._n,
+                                                 steps)
+            degraded.append(True)
             if not nan_armed:
                 return new_data, new_mask, None
             new_data, changed = self._corrupt_changed(new_data)
@@ -515,12 +551,16 @@ class Frame:
             # persistent fault's ladder retries (rung "primary") never
             # read as duplicates of this event
             _rec.RECOVERY_LOG.record(
-                "pipeline_flush", "retry", attempt=1, rung="dispatch",
+                site, "retry", attempt=1, rung="dispatch",
                 cause=f"{type(first_cause).__name__}: {first_cause}")
+        fallbacks = ((("gather", gather),) if shard_store is not None
+                     else ()) + (("eager", eager),)
         try:
             new_data, new_mask, _ = _rec.resilient_call(
-                fused, site="pipeline_flush", validate=validate,
-                fallbacks=(("eager", eager),))
+                fused, site=site, validate=validate,
+                fallbacks=fallbacks)
+            if degraded:
+                self._shard = None
             return new_data, new_mask
         except PipelineError:
             # structural compile failure inside the ladder: eager replay
@@ -609,6 +649,21 @@ class Frame:
     def _eval(self, expr_or_values):
         if isinstance(expr_or_values, Expr):
             return expr_or_values.eval(self)
+        if self._shard is not None:
+            # raw columns sized to the TRUE row count (what a caller who
+            # never heard of sharding naturally provides) pad + place
+            # into the sharded layout; slot-length arrays pass through
+            arr = _as_column(expr_or_values)
+            if arr.shape[0] == self._shard.rows and \
+                    self._shard.rows != self._n:
+                from ..parallel.shard import place_column
+
+                return place_column(arr, self._shard)
+            if arr.shape[0] != self._n:
+                raise ValueError(f"column length {arr.shape[0]} != frame "
+                                 f"length {self._shard.rows} (sharded "
+                                 f"slots {self._n})")
+            return arr
         return _as_column(expr_or_values, self._n)
 
     # -- transformations (each returns a new Frame) ------------------------
@@ -804,7 +859,7 @@ class Frame:
             try:
                 new_data, new_mask, extras = run_pipeline(
                     self._data_store, self._mask_store, self._n, steps,
-                    extra)
+                    extra, shard=self._shard)
             except PipelineError as e:
                 logger.debug("fused select fell back to eager: %s", e)
                 return {}
@@ -1386,6 +1441,12 @@ class Frame:
         lines = ["== Physical Frame =="]
         lines.append(f"row slots: {self.num_slots} (valid: {n_valid}, "
                      f"masked: {self.num_slots - n_valid})")
+        if self._shard is not None:
+            st = self._shard
+            lines.append(
+                f"layout: row-sharded over {st.devices} device(s), "
+                f"{st.bucket} slot(s)/shard, rows/shard="
+                f"{st.shard_counts()}")
         for name in self.columns:
             arr = self._data[name]
             kind = ("host/object" if _is_string_col(arr)
@@ -1878,7 +1939,23 @@ class Frame:
             plan = None
             if all(not _is_string_col(self._data[k])
                    and not _is_string_col(other._data[k]) for k in keys):
-                plan = _vector_join_plan(lraw, rraw, li, ri, how)
+                # Hash-partition shuffle lowering (sharded frames, above
+                # the spark.shard.minRows host-fallback bound): the plan
+                # computes per key-hash partition and merges back into
+                # the exact unpartitioned emission order — the Exchange
+                # EXPLAIN renders. Any partition bail-out (inexact keys)
+                # falls through to the single plan below.
+                store = self._shard if self._shard is not None \
+                    else other._shard
+                if store is not None and \
+                        max(li.size, ri.size) >= int(config.shard_min_rows):
+                    from ..parallel.shard import partitioned_join_plan
+
+                    plan = partitioned_join_plan(
+                        _vector_join_plan, lraw, rraw, li, ri, how,
+                        store.devices)
+                if plan is None:
+                    plan = _vector_join_plan(lraw, rraw, li, ri, how)
             if plan is not None:
                 lpairs, rpairs = plan
             else:

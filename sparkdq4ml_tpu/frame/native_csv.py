@@ -444,7 +444,14 @@ def _stream_read(lib, path, size, names, header, delimiter, quote):
 
     from .frame import Frame
 
-    return Frame(data)
+    # Sharded ingest hand-off: streamed chunks assembled into the pooled
+    # engine-dtype buffers place straight into the row-sharded layout
+    # (contiguous ranges — chunk order, and with it row order, is
+    # preserved exactly); the prefetch thread keeps overlapping parse
+    # with this device transfer. One flag check when sharding is off.
+    from ..parallel.shard import maybe_shard_frame
+
+    return maybe_shard_frame(Frame(data))
 
 
 def _stream_pinned(lib, h, nc, names, size):

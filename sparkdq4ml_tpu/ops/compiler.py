@@ -566,9 +566,18 @@ def _linearize(steps, extra, base_schema):
 
 class _Plan:
     """One cache entry: the jitted program plus its calling convention
-    (see :func:`_linearize` for the key/lowering walk)."""
+    (see :func:`_linearize` for the key/lowering walk).
 
-    def __init__(self, steps, extra, base_schema):
+    With a :class:`~..parallel.shard.ShardedStore` layout the SAME body
+    lowers as ONE ``shard_map``-wrapped program over the store's mesh —
+    the compilable step surface is purely elementwise, so per-shard
+    execution is bit-identical by construction and the program carries
+    **zero cross-shard traffic** (the one extra output, the per-shard
+    valid-row count, is shard-local too; the statstore drains it host-
+    side later). Sharded plans key with the store's layout tag, so
+    sharded and single-device programs coexist in this cache."""
+
+    def __init__(self, steps, extra, base_schema, shard=None):
         key, lits, lowered_steps, lowered_extra, refs = _linearize(
             steps, extra, base_schema)
         replaced = {s[1] for s in steps if s[0] == "with_column"}
@@ -646,6 +655,49 @@ class _Plan:
             finally:
                 _RUNTIME_LITS.lits = ()
 
+        if shard is not None:
+            # ONE shard_map-wrapped program per flush: rows partition
+            # over the data axis, literals replicate, and every output
+            # (including the filter mask) stays row-sharded. The 4th
+            # output is the per-shard post-filter valid count — shape
+            # (1,) per shard → (devices,) global — so the statstore's
+            # selectivity observation needs no eager cross-shard
+            # reduction on the hot path.
+            from jax.sharding import PartitionSpec as _P
+
+            from ..parallel.mesh import (DATA_AXIS, serialize_collectives,
+                                         shard_map)
+
+            def sharded_body(kept, donated, mask, lit_args):
+                changed, new_mask, extras = body(kept, donated, mask,
+                                                 lit_args)
+                valid = jnp.sum(new_mask, dtype=jnp.int32)[None]
+                return changed, new_mask, extras, valid
+
+            pd = _P(DATA_AXIS)
+            sharded = shard_map(
+                sharded_body, mesh=shard.mesh,
+                in_specs=(pd, pd, pd, _P()),
+                out_specs=(pd, pd, pd, pd))
+
+            def program(kept, donated, mask, lit_args):
+                counters.increment("pipeline.compile")
+                with self._trace_lock:
+                    self.traces += 1
+                return sharded(kept, donated, mask, lit_args)
+
+            self.trace_body = sharded
+            # dispatch-to-completion under the process-wide collective
+            # lock: the program is collective-free, but multi-device
+            # executions on XLA:CPU share the rendezvous machinery and
+            # the PR-6 discipline is "every mesh-bearing program
+            # serializes" — sharded flushes are no exception.
+            self.fn = serialize_collectives(jax.jit(program), shard.mesh)
+            self.donates = False
+            self.mesh = shard.mesh
+            self.guarded = True
+            return
+
         def program(kept, donated, mask, lit_args):
             # Body runs at trace time only → this counts XLA compiles.
             counters.increment("pipeline.compile")
@@ -654,6 +706,8 @@ class _Plan:
             return body(kept, donated, mask, lit_args)
 
         self.trace_body = body
+        self.mesh = None
+        self.guarded = None
 
         # Buffer donation (replaced columns + mask) only pays on
         # accelerators, where the donated HBM buffer is reused for the
@@ -717,11 +771,13 @@ def cache_len() -> int:
         return len(_CACHE)
 
 
-def _lookup_plan(steps, extra, base_schema):
+def _lookup_plan(steps, extra, base_schema, shard=None):
     # Probe via the SAME _linearize walk that builds plans: key equality
     # guarantees the probe's lit order matches the cached program's
     # _ArgLit slots (the lowered trees are discarded on a hit).
     key, lits, _steps, _extra, _refs = _linearize(steps, extra, base_schema)
+    if shard is not None:
+        key = shard.tag() + "|" + key
     key = plan_namespace_tag() + key
     lit_values = tuple(
         # dqlint: ok(host-sync): hoisted literals are host scalars (numpy
@@ -733,7 +789,7 @@ def _lookup_plan(steps, extra, base_schema):
         if plan is not None:
             _CACHE.move_to_end(key)
             return plan, lit_values
-    plan = _Plan(steps, extra, base_schema)
+    plan = _Plan(steps, extra, base_schema, shard)
     plan.key = key                 # namespace rides the cached identity
     with _CACHE_LOCK:
         # Insert-if-absent: two threads can race past the probe and both
@@ -938,7 +994,7 @@ def _run_chunked(plan, lit_values, data: dict, mask, n: int,
 
 def _record_flush_stats(plan, data, b: int, n: int,
                         wall_ms: float, compiled: bool, new_mask,
-                        est=None) -> None:
+                        est=None, sel_scalar=None) -> None:
     """Plan-stats observatory hand-off (``utils/statstore.py``): one
     ``record_flush`` per execution of this plan (wall/compile digest,
     static byte estimate) and — when the flush carried a filter — a
@@ -957,8 +1013,13 @@ def _record_flush_stats(plan, data, b: int, n: int,
         if plan.has_filter:
             skey = _stats.selectivity_key(plan.key)
             if skey is not None:
-                _stats.STORE.defer_rows(skey, "filter", n,
-                                        jnp.sum(new_mask))
+                # sharded flushes hand over the program's own per-shard
+                # valid counts — an eager sum over the sharded mask here
+                # would dispatch a cross-shard collective on the hot path
+                _stats.STORE.defer_rows(
+                    skey, "filter", n,
+                    sel_scalar if sel_scalar is not None
+                    else jnp.sum(new_mask))
     except Exception:
         logger.debug("stats hand-off failed", exc_info=True)
 
@@ -981,7 +1042,7 @@ def selectivity_key_for(where_steps, schema) -> Optional[str]:
     return _stats.selectivity_key(key)
 
 
-def run_pipeline(data: dict, mask, n: int, steps, extra=()):
+def run_pipeline(data: dict, mask, n: int, steps, extra=(), shard=None):
     """Execute pending ``steps`` (+ ``extra`` projection expressions) over
     the base column dict as one compiled program.
 
@@ -992,6 +1053,12 @@ def run_pipeline(data: dict, mask, n: int, steps, extra=()):
     to ``n`` rows. Raises :class:`PipelineError` on any internal failure;
     callers must fall back to the eager path (never lose correctness to
     an optimization layer).
+
+    ``shard`` (a ``parallel.shard.ShardedStore``) selects the sharded
+    lowering: the frame's arrays are already laid out at the store's
+    padded slot count, so ``n == slots``, no bucket padding or unpad
+    slicing happens, and the plan dispatches as one ``shard_map``
+    program under the collective guard — still zero counted host syncs.
     """
     counters.increment("pipeline.flush")
     # BASE schema only (lazy: only referenced columns get dtype probes) —
@@ -999,8 +1066,8 @@ def run_pipeline(data: dict, mask, n: int, steps, extra=()):
     # later step replaces it stays a base input.
     schema = LazySchema(data, ())
     try:
-        b = bucket_size(n)
-        plan, lit_values = _lookup_plan(steps, tuple(extra), schema)
+        b = n if shard is not None else bucket_size(n)
+        plan, lit_values = _lookup_plan(steps, tuple(extra), schema, shard)
         # Pre-execution memory degrade (ISSUE 11 / arxiv 2206.14148):
         # when a device-byte budget is known (explicit
         # spark.audit.deviceBudget conf, or an injected `oom` fault
@@ -1012,10 +1079,37 @@ def run_pipeline(data: dict, mask, n: int, steps, extra=()):
             # meaningless) can never burn a one-shot injected oom fault
             budget = _flush_budget()
             if budget is not None:
-                est = _est_flush_bytes(plan, data, b)
-                if est > budget:
-                    return _run_chunked(plan, lit_values, data, mask, n,
-                                        budget, est)
+                if shard is not None:
+                    # per-SHARD resident bytes against the budget; an
+                    # over-budget sharded flush degrades one rung to
+                    # single-device row-chunked execution (gather first)
+                    est = _est_flush_bytes(plan, data, shard.bucket)
+                    if est > budget:
+                        from ..parallel.shard import gather_arrays
+                        from ..utils.recovery import RECOVERY_LOG
+
+                        RECOVERY_LOG.record(
+                            "shard_flush", "fallback", rung="chunked",
+                            detail=f"per-shard est {est} B > budget "
+                                   f"{budget} B; gathered to "
+                                   "single-device chunked execution")
+                        arrs = gather_arrays(
+                            shard, mask, *(data[name] for name in
+                                           plan.kept + plan.donated))
+                        mask = arrs[0]
+                        data = dict(data)
+                        data.update(zip(plan.kept + plan.donated,
+                                        arrs[1:]))
+                        plan, lit_values = _lookup_plan(
+                            steps, tuple(extra), schema)
+                        est = _est_flush_bytes(plan, data, bucket_size(n))
+                        return _run_chunked(plan, lit_values, data, mask,
+                                            n, budget, est)
+                else:
+                    est = _est_flush_bytes(plan, data, b)
+                    if est > budget:
+                        return _run_chunked(plan, lit_values, data, mask,
+                                            n, budget, est)
         before = plan.traces
         kept = {name: _pad(data[name], b, fresh=False)
                 for name in plan.kept}
@@ -1054,16 +1148,28 @@ def run_pipeline(data: dict, mask, n: int, steps, extra=()):
             # span, so EXPLAIN ANALYZE attributes the fault to the
             # operator whose flush absorbed it — and escapes un-wrapped
             # for the Frame._flush recovery ladder below.
+            shard_valid = None
             if span_cm is None:
                 _faults.inject("pipeline_flush")
-                changed, new_mask, extras = plan.fn(
-                    kept, donated, mask_in, lit_values)
+                if shard is not None:
+                    _faults.inject("shard_flush")
+                    changed, new_mask, extras, shard_valid = plan.fn(
+                        kept, donated, mask_in, lit_values)
+                else:
+                    changed, new_mask, extras = plan.fn(
+                        kept, donated, mask_in, lit_values)
                 compiled = plan.traces > before
             else:
                 with span_cm as sp:
                     _faults.inject("pipeline_flush")
-                    changed, new_mask, extras = plan.fn(
-                        kept, donated, mask_in, lit_values)
+                    if shard is not None:
+                        _faults.inject("shard_flush")
+                        changed, new_mask, extras, shard_valid = plan.fn(
+                            kept, donated, mask_in, lit_values)
+                        sp.set(shards=shard.devices)
+                    else:
+                        changed, new_mask, extras = plan.fn(
+                            kept, donated, mask_in, lit_values)
                     compiled = plan.traces > before
                     sp.set(cache="compile" if compiled else "hit")
         if not compiled:
@@ -1078,9 +1184,15 @@ def run_pipeline(data: dict, mask, n: int, steps, extra=()):
             changed, new_mask, extras = _unpad_tree(
                 (changed, new_mask, extras), n)
         if stats_on:
+            # selectivity baseline = TRUE rows: a sharded frame's n is
+            # the padded slot count, while its single-device twin (which
+            # shares the layout-stripped selectivity entry) reports its
+            # unpadded slots — mixing the two would skew the shared
+            # history by the padding factor
             _record_flush_stats(
-                plan, data, b, n,
-                (time.perf_counter() - t_stats) * 1e3, compiled, new_mask)
+                plan, data, b, shard.rows if shard is not None else n,
+                (time.perf_counter() - t_stats) * 1e3, compiled, new_mask,
+                sel_scalar=shard_valid)
         new_data = dict(data)
         new_data.update(changed)
         return new_data, new_mask, extras
@@ -1168,7 +1280,7 @@ def program_handles() -> list:
             args=(kept, donated, mask, lits),
             variants={"bucket": [_bucket_variant(p.example, 2),
                                  _bucket_variant(p.example, 4)]},
-            mesh=None, guarded=None,
+            mesh=p.mesh, guarded=p.guarded,
             meta={"expected_traces": max(len(p.buckets), 1),
                   "observed_traces": p.traces,
                   # the literal-erased key: two plans colliding here are

@@ -231,10 +231,11 @@ class _PlanEntry:
     program auditor enumerates (it must be able to ``make_jaxpr`` the
     plan without bumping ``grouped.compile`` or the replay stats)."""
 
-    __slots__ = ("fn", "trace_body", "example", "shape_sigs")
+    __slots__ = ("fn", "trace_body", "example", "shape_sigs", "mesh")
 
-    def __init__(self, raw):
+    def __init__(self, raw, mesh=None):
         self.trace_body = raw
+        self.mesh = mesh
 
         def counted(*args):
             # Runs at trace time only → counts XLA compiles (the single
@@ -242,7 +243,15 @@ class _PlanEntry:
             counters.increment("grouped.compile")
             return raw(*args)
 
-        self.fn = jax.jit(counted)
+        jitted = jax.jit(counted)
+        if mesh is not None:
+            # sharded programs (the cross-shard merge collective)
+            # dispatch-to-completion under the process-wide collective
+            # lock — the PR-6 overlapping-psum deadlock discipline
+            from ..parallel.mesh import serialize_collectives
+
+            jitted = serialize_collectives(jitted, mesh)
+        self.fn = jitted
         self.example = None
         self.shape_sigs: set = set()
 
@@ -258,7 +267,7 @@ class _PlanEntry:
         return self.fn(*args)
 
 
-def _cached_plan(key: str, build):
+def _cached_plan(key: str, build, mesh=None):
     # Namespace prefix (ops/compiler.plan_namespace): empty in the shared
     # process-wide mode; the serving layer's isolated-cache mode salts it
     # per tenant so both plan-cache engines partition together.
@@ -270,7 +279,7 @@ def _cached_plan(key: str, build):
             _PLAN_STATS.setdefault(key, {"hits": 0, "builds": 0})[
                 "hits"] += 1
             return fn
-    fn = _PlanEntry(build())
+    fn = _PlanEntry(build(), mesh=mesh)
     with _CACHE_LOCK:
         # Insert-if-absent (same rule as the pipeline cache): a build race
         # keeps the first inserted program so replay stats stay coherent.
@@ -343,7 +352,8 @@ def program_handles() -> list:
             "grouped", key, entry.trace_body, args=entry.example,
             variants={"bucket": [(_scale_rows(entry.example, 2), {}),
                                  (_scale_rows(entry.example, 4), {})]},
-            mesh=None, guarded=None, meta=meta))
+            mesh=entry.mesh,
+            guarded=True if entry.mesh is not None else None, meta=meta))
     return out
 
 
@@ -444,7 +454,7 @@ def _group_scaffold(keys, key_kinds, mask):
 # Dense lowering: pack integer-like keys into one lexicographic slot id
 # ---------------------------------------------------------------------------
 
-def _dense_slots(keys, key_kinds, valid, S: int):
+def _dense_slots(keys, key_kinds, valid, S: int, axis=None):
     """Per-row dense slot ids + the fit verdict.
 
     Each key contributes a digit ``0`` for NULL (NaN) else ``k - lo + 1``
@@ -453,7 +463,12 @@ def _dense_slots(keys, key_kinds, valid, S: int):
     ``decoders`` rebuilds per-key group values from a slot index.
     ``ok`` is a traced scalar: every float key integer-valued and the
     packed size within ``S``; when False the slot ids are garbage and the
-    caller reroutes to the sorted program."""
+    caller reroutes to the sorted program.
+
+    With ``axis`` (the sharded lowering) the per-shard key extremes and
+    fit verdict merge across shards (``pmin``/``pmax``), so every shard
+    derives the SAME globally-consistent slot ids — the precondition for
+    the cross-shard table merge."""
     acc = _acc_dtype()
     ok = jnp.asarray(True)
     sizes = []                       # traced digit counts, key order
@@ -470,7 +485,13 @@ def _dense_slots(keys, key_kinds, valid, S: int):
         big = jnp.asarray(jnp.inf, acc)
         lo = jnp.min(jnp.where(nonnull, af, big))
         hi = jnp.max(jnp.where(nonnull, af, -big))
-        any_nn = jnp.any(nonnull)
+        if axis is not None:
+            # global key range: ±inf identities of empty shards drop out
+            lo = lax.pmin(lo, axis)
+            hi = lax.pmax(hi, axis)
+            any_nn = jnp.isfinite(lo)
+        else:
+            any_nn = jnp.any(nonnull)
         lo = jnp.where(any_nn, lo, jnp.zeros((), acc))
         hi = jnp.where(any_nn, hi, jnp.zeros((), acc) - 1)
         size = hi - lo + 2           # +1 digit offset, +1 null slot
@@ -485,6 +506,11 @@ def _dense_slots(keys, key_kinds, valid, S: int):
     total = sizes[0]
     for s in sizes[1:]:
         total = total * s
+    if axis is not None:
+        # the integrality verdict is per-shard evidence; the slot ids are
+        # only sound when EVERY shard's keys pass (range/size terms are
+        # already global via the merged lo/hi)
+        ok = lax.pmin(ok.astype(jnp.int32), axis) > 0
     ok = jnp.logical_and(ok, jnp.isfinite(total))
     ok = jnp.logical_and(ok, total <= S)
 
@@ -534,22 +560,34 @@ def _compact_index(present, S: int):
     return jnp.searchsorted(cs, lax.iota(jnp.int32, S) + 1, side="left")
 
 
-def _build_dense_agg_program(key_kinds, agg_ops, val_kinds, S: int):
+def _build_dense_agg_program(key_kinds, agg_ops, val_kinds, S: int,
+                             axis=None, world: int = 1):
     """The sort-free grouped lowering (see module docstring): dense slot
     ids, stacked segment reductions, gather compaction.
 
     Integer quantities — counts, integer sums, min/max over int columns,
     and the first/last row indices — reduce in INTEGER stacks: the float
     accumulator is float32 when x64 is off, and routing ints through it
-    would silently round past 2^24 (host parity demands exact ints)."""
+    would silently round past 2^24 (host parity demands exact ints).
+
+    With ``axis``/``world`` (the row-sharded lowering, arxiv 2112.09017
+    reduction pattern) the SAME body runs per shard and the slot tables
+    merge with ONE collective per stack — ``psum`` for the additive
+    stacks (counts, sums — and with them the decomposable avg/variance
+    (sum, count, Σ(v-μ)²) partials), ``pmin``/``pmax`` for the min/max
+    stacks. ``first``/``last`` are not in the sharded surface (their
+    row-index picks are shard-local); the caller gathers those plans."""
     acc = _acc_dtype()
     wide = jax.dtypes.canonicalize_dtype(jnp.int64)
+    if axis is not None and any(fn in ("first", "last")
+                                for fn, _, _ in agg_ops):
+        raise AssertionError("first/last are not sharded-lowerable")
 
     def program(keys, vals, mask):
         n = mask.shape[0]
         idx = lax.iota(jnp.int32, n)
         valid = mask
-        slot, ok, decoders = _dense_slots(keys, key_kinds, valid, S)
+        slot, ok, decoders = _dense_slots(keys, key_kinds, valid, S, axis)
         seg = jnp.where(valid, slot, S)          # invalid → dropped
 
         nonnull = {}
@@ -583,7 +621,10 @@ def _build_dense_agg_program(key_kinds, agg_ops, val_kinds, S: int):
                 index[name] = (stack, len(stacks[stack]))
                 stacks[stack].append(arr)
 
-        small_n = n < (1 << (53 if acc == jnp.float64 else 24))
+        # counts/indices are bounded by the GLOBAL row count (n per shard
+        # × world shards) — the exactness window must hold for the merged
+        # totals, not just one shard's partials
+        small_n = n * world < (1 << (53 if acc == jnp.float64 else 24))
         cstk = "af" if small_n else "ai"
         cdt = acc if small_n else wide
         want(cstk, "present", valid.astype(cdt))
@@ -647,6 +688,15 @@ def _build_dense_agg_program(key_kinds, agg_ops, val_kinds, S: int):
             if stacks[stack]:
                 reduced[stack] = red(jnp.stack(stacks[stack], axis=1),
                                      seg, num_segments=S)
+        if axis is not None:
+            # THE cross-shard merge: one collective per populated stack
+            # (additive → psum, min → pmin, max → pmax); after it every
+            # shard holds the identical global slot tables and the rest
+            # of the program computes replicated
+            _merge = {"ai": lax.psum, "af": lax.psum, "mf": lax.pmin,
+                      "mi": lax.pmin, "xi": lax.pmax}
+            reduced = {stack: _merge[stack](r, axis)
+                       for stack, r in reduced.items()}
 
         def table(name):
             stack, j = index[name]
@@ -678,6 +728,11 @@ def _build_dense_agg_program(key_kinds, agg_ops, val_kinds, S: int):
                 var_cols.append(d * d)
             ssd = jax.ops.segment_sum(
                 jnp.stack(var_cols, axis=1), seg, num_segments=S)
+            if axis is not None:
+                # decomposable variance: the per-shard Σ(v-μ)² partials
+                # (μ already global from the merged sum/count tables)
+                # psum into the global second moment
+                ssd = lax.psum(ssd, axis)
 
         comp = _compact_index(present, S)
         nan = jnp.asarray(jnp.nan, acc)
@@ -742,6 +797,122 @@ def _build_dense_agg_program(key_kinds, agg_ops, val_kinds, S: int):
         return key_outs, tuple(agg_outs), groups, ok
 
     return lambda: program
+
+
+def _build_sharded_dense_agg_program(mesh, key_kinds, agg_ops, val_kinds,
+                                     S: int):
+    """The row-sharded dense lowering: the dense program body runs per
+    shard with globally-consistent slot ids, and the slot tables merge
+    with one collective per stack (see ``_build_dense_agg_program``).
+    Outputs are replicated — every shard computes the identical final
+    tables, so the group-count/fit-verdict sync stays ONE host read."""
+    from jax.sharding import PartitionSpec as _P
+
+    from ..parallel.mesh import DATA_AXIS, shard_map
+
+    def build():
+        program = _build_dense_agg_program(
+            key_kinds, agg_ops, val_kinds, S, axis=DATA_AXIS,
+            world=int(mesh.devices.size))()
+        pd = _P(DATA_AXIS)
+        # dqlint: ok(collective-guard): dispatch routes through
+        # _PlanEntry(mesh=...), which wraps the jitted entry in
+        # serialize_collectives — see _cached_plan.
+        return shard_map(program, mesh=mesh, in_specs=(pd, pd, pd),
+                         out_specs=_P())
+
+    return build
+
+
+# ---------------------------------------------------------------------------
+# Sharded distinct: hash-partition all-to-all exchange + local unique
+# ---------------------------------------------------------------------------
+
+def _mix_hash(h, arr, kind):
+    """Fold one key column into the per-row shard hash. Null-safe and
+    sign-of-zero-safe like the host ``parallel.shard.hash_partition``:
+    NaN (the engine's NULL) folds to one hash class, ``-0.0`` onto
+    ``0.0`` (they compare equal, so they must exchange together)."""
+    a = jnp.asarray(arr)
+    prime = jnp.uint32(0x01000193)
+    if kind == "f":
+        nulls = jnp.isnan(a)
+        z = jnp.where(a == 0, jnp.zeros_like(a), a)
+        z = jnp.where(nulls, jnp.zeros_like(a), z)
+        if a.dtype.itemsize == 8:
+            bits = lax.bitcast_convert_type(z, jnp.int64)
+            c = (bits & 0xFFFFFFFF).astype(jnp.uint32) \
+                ^ (bits >> 32).astype(jnp.uint32)
+        else:
+            c = lax.bitcast_convert_type(z, jnp.int32).astype(jnp.uint32)
+        h = (h * prime) ^ c
+        return (h * prime) ^ nulls.astype(jnp.uint32)
+    return (h * prime) ^ a.astype(jnp.uint32)
+
+
+def _build_sharded_unique_program(mesh, key_kinds):
+    """Distinct over a row-sharded frame: every row hash-partitions by
+    key to an owner shard, ONE static-shape ``all_to_all`` exchanges the
+    (keys, global row index, validity) blocks — each (src, dst) block is
+    a full shard bucket with a per-row validity mask, so the plan is
+    static whatever the key skew — and each shard runs the local sorted
+    unique over its hash class, emitting first-occurrence GLOBAL row
+    indices. The host concatenates + sorts the per-shard candidate sets
+    (ascending global index IS first-occurrence order) in the engine's
+    one counted sync."""
+    from jax.sharding import PartitionSpec as _P
+
+    from ..parallel.mesh import DATA_AXIS, shard_map
+
+    D = int(mesh.devices.size)
+
+    def build():
+        def program(keys, mask):
+            b = mask.shape[0]                       # per-shard slots
+            me = lax.axis_index(DATA_AXIS).astype(jnp.int32)
+            gidx = me * b + lax.iota(jnp.int32, b)  # global slot index
+            h = jnp.full((b,), 0x811C9DC5, jnp.uint32)
+            for k, kind in zip(keys, key_kinds):
+                h = _mix_hash(h, k, kind)
+            t = (h % jnp.uint32(D)).astype(jnp.int32)
+
+            def xchg(blocked):     # (D*b, …): block d → shard d
+                # dqlint: ok(collective-guard): dispatch is guarded by
+                # _PlanEntry(mesh=...) via serialize_collectives
+                return lax.all_to_all(blocked, DATA_AXIS, split_axis=0,
+                                      concat_axis=0, tiled=True)
+
+            def rep(x):            # every destination gets the full rows
+                return jnp.broadcast_to(
+                    x[None], (D,) + x.shape).reshape((D * b,))
+
+            dest = lax.iota(jnp.int32, D)[:, None]
+            send_ok = jnp.logical_and(mask[None, :], t[None, :] == dest)
+            rmask = xchg(send_ok.reshape(D * b))
+            rkeys = [xchg(rep(jnp.asarray(k))) for k in keys]
+            rgidx = xchg(rep(gidx))
+
+            n2 = D * b             # received rows (sparse validity)
+            perm, valid, seg, _boundary, groups = _group_scaffold(
+                rkeys, key_kinds, rmask)
+            sorted_g = jnp.take(rgidx, perm)
+            big = jnp.asarray(n2, jnp.int32)        # > any global index
+            first_g = jax.ops.segment_min(
+                jnp.where(valid, sorted_g, big), seg, num_segments=n2)
+            cand = lax.sort((first_g,), num_keys=1)[0]
+            # dqlint: ok(collective-guard): dispatch is guarded by
+            # _PlanEntry(mesh=...) via serialize_collectives
+            total = lax.psum(groups, DATA_AXIS)
+            return cand, groups[None], total
+
+        pd = _P(DATA_AXIS)
+        # dqlint: ok(collective-guard): dispatch routes through
+        # _PlanEntry(mesh=...), which wraps the jitted entry in
+        # serialize_collectives — see _cached_plan.
+        return shard_map(program, mesh=mesh, in_specs=(pd, pd),
+                         out_specs=(pd, pd, _P()))
+
+    return build
 
 
 # ---------------------------------------------------------------------------
@@ -1017,13 +1188,33 @@ def grouped_agg(frame, keys, agg_list):
                  for k, a in zip(val_kinds, val_arrs)),
     ])
 
-    b = bucket_size(n)
+    dense_ok = not any(fn in _DISTINCT_FNS for fn, _, _ in agg_ops)
+    # Sharded lowering (frame rows laid out over the mesh): local
+    # segment-reduce per shard + ONE cross-shard merge collective. The
+    # surface is the dense program's decomposable aggregate set; plans
+    # outside it (first/last — shard-local row picks — and the distinct
+    # aggregates, which need a global sort) gather one level to the
+    # single-device engine.
+    shard = getattr(frame, "_shard", None)
+    sharded = (shard is not None and dense_ok
+               and not any(fn in ("first", "last")
+                           for fn, _, _ in agg_ops))
+    if shard is not None and not sharded:
+        from ..parallel.shard import gather_arrays
+
+        flat = gather_arrays(shard, jnp.asarray(mask, jnp.bool_),
+                             *(list(key_arrs) + list(val_arrs)))
+        mask = flat[0]
+        key_arrs = list(flat[1:1 + len(key_arrs)])
+        val_arrs = list(flat[1 + len(key_arrs):])
+        shard = None
+
+    b = n if sharded else bucket_size(n)
     keys_in = tuple(pad_rows(a, b, fresh=False) for a in key_arrs)
     vals_in = tuple(pad_rows(a, b, fresh=False) for a in val_arrs)
     mask_in = pad_rows(jnp.asarray(mask, jnp.bool_), b, fresh=False)
     args = (keys_in, vals_in, mask_in)
 
-    dense_ok = not any(fn in _DISTINCT_FNS for fn, _, _ in agg_ops)
     S = min(_DENSE_MAX, max(2 * b, 16))
 
     # Plan-stats observatory gate (ONE flag read; disabled = nothing
@@ -1033,11 +1224,64 @@ def grouped_agg(frame, keys, agg_list):
     t_stats = time.perf_counter() if stats_on else 0.0
     c_stats = counters.get("grouped.compile") if stats_on else 0
     syncs = 0
+    stats_key = f"G|{shard.tag()}|{struct}" if sharded else f"G|{struct}"
     with _obs.TRACER.span(
             "frame.grouped.flush", cat="frame", op="group_by",
             keys=len(keys), aggs=len(agg_list), rows=n, bucket=b) as sp:
         g = -1
-        if dense_ok:
+        run_dense = dense_ok
+        if sharded:
+            before = counters.get("grouped.compile")
+            fn = _cached_plan(
+                f"GDH{S}|{shard.tag()}|{struct}",
+                _build_sharded_dense_agg_program(
+                    shard.mesh, tuple(key_kinds), tuple(agg_ops),
+                    tuple(val_kinds), S),
+                mesh=shard.mesh)
+            try:
+                _faults.inject("shard_merge")
+                key_outs, agg_outs, groups, fit = _run_plan(
+                    fn, args, before, sp)
+                # ONE host sync: fit verdict + group count together
+                counters.increment("frame.host_sync")
+                syncs += 1
+                fit_h, g_h = jax.device_get((fit, groups))
+            except jax.errors.JaxRuntimeError as e:
+                # shard_merge ladder: a device fault in the sharded
+                # merge gathers to single-device grouped execution —
+                # the query keeps its device lowering, minus one rung
+                from ..parallel.shard import gather_arrays
+                from ..utils.recovery import RECOVERY_LOG
+
+                RECOVERY_LOG.record(
+                    "shard_merge", "fallback", rung="gather",
+                    cause=f"{type(e).__name__}: {e}",
+                    detail="sharded grouped merge degraded to "
+                           "single-device execution")
+                counters.increment("grouped.shard_gather")
+                flat = gather_arrays(shard, mask_in,
+                                     *(list(keys_in) + list(vals_in)))
+                args = (tuple(flat[1:1 + len(keys_in)]),
+                        tuple(flat[1 + len(keys_in):]), flat[0])
+            else:
+                if bool(fit_h):
+                    g = int(g_h)
+                    sp.set(groups=g, lowering="sharded-dense",
+                           shards=shard.devices)
+                else:
+                    # global key range overflowed the dense table: the
+                    # sorted program is single-device — gather (same S
+                    # bound would miss again, skip the dense retry)
+                    counters.increment("grouped.dense_miss")
+                    from ..parallel.shard import gather_arrays
+
+                    flat = gather_arrays(shard, mask_in,
+                                         *(list(keys_in)
+                                           + list(vals_in)))
+                    args = (tuple(flat[1:1 + len(keys_in)]),
+                            tuple(flat[1 + len(keys_in):]), flat[0])
+                    run_dense = False
+        if g < 0 and run_dense:
             before = counters.get("grouped.compile")
             fn = _cached_plan(f"GD{S}|{struct}", _build_dense_agg_program(
                 tuple(key_kinds), tuple(agg_ops), tuple(val_kinds), S))
@@ -1063,7 +1307,7 @@ def grouped_agg(frame, keys, agg_list):
             sp.set(groups=g, lowering="sorted")
     if stats_on:
         _record_grouped_stats(
-            f"G|{struct}", n, g, (time.perf_counter() - t_stats) * 1e3,
+            stats_key, n, g, (time.perf_counter() - t_stats) * 1e3,
             counters.get("grouped.compile") - c_stats, syncs)
 
     # per-column eager slices, deliberately NOT compiler._unpad_tree: that
@@ -1136,11 +1380,24 @@ def device_sort(frame, names, ascending, nulls_first):
         key_arrs.append(arr)
         specs.append((kind, not asc, bool(nf)))
 
+    mask = frame._mask
     if jax.default_backend() == "cpu":
         counters.increment("frame.host_sync")
-        take = _host_sort_plan(key_arrs, specs, frame._mask)
+        take = _host_sort_plan(key_arrs, specs, mask)
         return Frame(_gather_columns(data, jnp.asarray(take),
                                      host_idx=take))
+
+    if getattr(frame, "_shard", None) is not None:
+        # A total sort has no shard-local lowering (the permutation is
+        # global); gather the sort inputs one level and run the
+        # single-device program — the output frame is compact and
+        # single-device either way.
+        from ..parallel.shard import gather_arrays
+
+        flat = gather_arrays(frame._shard, jnp.asarray(mask, jnp.bool_),
+                             *key_arrs)
+        mask = flat[0]
+        key_arrs = list(flat[1:])
 
     key = "|".join([
         dtype_tag(), "S",
@@ -1152,7 +1409,7 @@ def device_sort(frame, names, ascending, nulls_first):
     before = counters.get("grouped.compile")
     fn = _cached_plan(key, _build_sort_program(tuple(specs)))
     keys_in = tuple(pad_rows(a, b, fresh=False) for a in key_arrs)
-    mask_in = pad_rows(jnp.asarray(frame._mask, jnp.bool_), b, fresh=False)
+    mask_in = pad_rows(jnp.asarray(mask, jnp.bool_), b, fresh=False)
 
     with _obs.TRACER.span(
             "frame.grouped.flush", cat="frame", op="sort",
@@ -1237,6 +1494,29 @@ def device_unique(frame, key_names):
         key_arrs.append(arr)
         key_kinds.append(kind)
 
+    mask = frame._mask
+    shard_store = getattr(frame, "_shard", None)
+    if shard_store is not None:
+        try:
+            return _sharded_unique(frame, data, key_arrs, key_kinds,
+                                   shard_store)
+        except jax.errors.JaxRuntimeError as e:
+            # shard_merge ladder: a device fault in the exchange program
+            # gathers one level to the single-device unique below
+            from ..parallel.shard import gather_arrays
+            from ..utils.recovery import RECOVERY_LOG
+
+            RECOVERY_LOG.record(
+                "shard_merge", "fallback", rung="gather",
+                cause=f"{type(e).__name__}: {e}",
+                detail="sharded distinct degraded to single-device "
+                       "execution")
+            counters.increment("grouped.shard_gather")
+            flat = gather_arrays(shard_store, jnp.asarray(mask, jnp.bool_),
+                                 *key_arrs)
+            mask = flat[0]
+            key_arrs = list(flat[1:])
+
     key = "|".join([
         dtype_tag(), "U",
         ",".join(f"{k}:{_col_kind_spec(a)}"
@@ -1246,7 +1526,7 @@ def device_unique(frame, key_names):
     before = counters.get("grouped.compile")
     fn = _cached_plan(key, _build_unique_program(tuple(key_kinds)))
     keys_in = tuple(pad_rows(a, b, fresh=False) for a in key_arrs)
-    mask_in = pad_rows(jnp.asarray(frame._mask, jnp.bool_), b, fresh=False)
+    mask_in = pad_rows(jnp.asarray(mask, jnp.bool_), b, fresh=False)
 
     stats_on = config.stats_enabled
     t_stats = time.perf_counter() if stats_on else 0.0
@@ -1297,4 +1577,49 @@ def _host_sort_plan(key_arrs, specs, mask):
         arrays, [not d for _k, d, _f in specs],
         [f for _k, _d, f in specs]))
     return vi[order]
+
+
+def _sharded_unique(frame, data, key_arrs, key_kinds, store):
+    """Sharded :func:`device_unique`: dispatch the hash-partition
+    exchange program (one counted host sync pulls the per-shard
+    first-occurrence candidate sets + counts in one batch), merge-sort
+    the candidates host-side (ascending global index = first-occurrence
+    order, exactly the single-device output order), and gather the kept
+    rows on device. Raises ``JaxRuntimeError`` through to the caller's
+    shard_merge ladder."""
+    from ..frame.frame import Frame
+
+    mesh = store.mesh
+    D = int(mesh.devices.size)
+    n = frame.num_slots
+    key = "|".join([
+        dtype_tag(), f"USH{D}",
+        ",".join(f"{k}:{_col_kind_spec(a)}"
+                 for k, a in zip(key_kinds, key_arrs)),
+    ])
+    before = counters.get("grouped.compile")
+    fn = _cached_plan(key, _build_sharded_unique_program(
+        mesh, tuple(key_kinds)), mesh=mesh)
+    keys_in = tuple(jnp.asarray(a) for a in key_arrs)
+    mask_in = jnp.asarray(frame._mask, jnp.bool_)
+    stats_on = config.stats_enabled
+    t_stats = time.perf_counter() if stats_on else 0.0
+    with _obs.TRACER.span(
+            "frame.grouped.flush", cat="frame", op="distinct",
+            keys=len(key_arrs), rows=n, bucket=store.bucket,
+            shards=D) as sp:
+        _faults.inject("shard_merge")
+        cand, cnts, total = _run_plan(fn, (keys_in, mask_in), before, sp)
+        counters.increment("frame.host_sync")
+        cand_h, cnts_h, g = jax.device_get((cand, cnts, total))
+        g = int(g)
+        sp.set(groups=g, lowering="sharded-exchange")
+    per = np.asarray(cand_h).reshape(D, -1)
+    keep = np.sort(np.concatenate(
+        [per[i, :int(cnts_h[i])] for i in range(D)])).astype(np.int64)
+    if stats_on:
+        _record_grouped_stats(
+            key, n, g, (time.perf_counter() - t_stats) * 1e3,
+            counters.get("grouped.compile") - before, 1)
+    return Frame(_gather_columns(data, jnp.asarray(keep), host_idx=keep))
 # --- END HOST FALLBACK ----------------------------------------------------

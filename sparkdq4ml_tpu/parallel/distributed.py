@@ -390,14 +390,43 @@ def unpack_fit_result(flat, d: int):
         converged=bool(flat[d + 2]))
 
 
+def _pre_sharded(a, mesh) -> bool:
+    """True when ``a`` is a jax array ALREADY row-sharded over exactly
+    ``mesh``'s device list — the sharded-frames fast path (ROADMAP item
+    1 end-to-end leg): fit packing then consumes the frame's shard
+    partials directly instead of gathering to host and re-sharding."""
+    sh = getattr(a, "sharding", None)
+    if not isinstance(sh, NamedSharding):
+        return False
+    spec = tuple(sh.spec)
+    if not spec or spec[0] != DATA_AXIS \
+            or any(s is not None for s in spec[1:]):
+        return False
+    try:
+        return [d.id for d in sh.mesh.devices.flat] \
+            == [d.id for d in mesh.devices.flat]
+    except Exception:
+        return False
+
+
 def pad_and_shard_rows(mesh: Optional[Mesh], *arrays):
     """Zero-pad every array's leading axis to the shard count and
     device_put them row-sharded; with no (or a trivial) mesh, pass through
     as plain device arrays. The generic variadic variant of
     ``place_sharded``, shared by the GLM/clustering fits — zero padding
-    rows carry zero weight by construction in every masked statistic."""
+    rows carry zero weight by construction in every masked statistic.
+
+    Arrays that arrive ALREADY row-sharded over this mesh at a divisible
+    row count (a sharded frame's columns) pass through untouched — no
+    host gather, no re-placement."""
     if mesh is None or mesh.devices.size <= 1:
         return tuple(jnp.asarray(a) for a in arrays)
+    if arrays[0].shape[0] % mesh.devices.size == 0 and \
+            all(_pre_sharded(a, mesh) for a in arrays):
+        from ..utils.profiling import counters
+
+        counters.increment("shard.fit_passthrough")
+        return tuple(arrays)
     rem = (-arrays[0].shape[0]) % mesh.devices.size
     shard = NamedSharding(mesh, P(DATA_AXIS))
     out = []
@@ -412,9 +441,17 @@ def pad_and_shard_rows(mesh: Optional[Mesh], *arrays):
 
 def place_sharded(X, y, mask, mesh: Optional[Mesh]):
     """Pad rows to the shard count and device_put with row sharding.
-    Single-device/no-mesh inputs pass through as device arrays."""
+    Single-device/no-mesh inputs pass through as device arrays; inputs
+    already row-sharded over this mesh (a sharded frame's columns) pass
+    through without the host round trip."""
     if mesh is None or mesh.devices.size <= 1:
         return (jnp.asarray(X), jnp.asarray(y), jnp.asarray(mask, jnp.bool_))
+    if X.shape[0] % mesh.devices.size == 0 and \
+            all(_pre_sharded(a, mesh) for a in (X, y, mask)):
+        from ..utils.profiling import counters
+
+        counters.increment("shard.fit_passthrough")
+        return X, y, mask
     Xh, yh, mh = pad_rows(np.asarray(X), np.asarray(y), np.asarray(mask, bool),
                           mesh.devices.size)
     shard = NamedSharding(mesh, P(DATA_AXIS))
@@ -463,10 +500,17 @@ def compute_gram(X, y, mask, mesh: Optional[Mesh] = None):
     from ..utils.profiling import counters
 
     nshards = mesh.devices.size
-    Xh = np.asarray(X)
-    yh = np.asarray(y)
-    mh = np.asarray(mask, bool)
-    Xp, yp, mp = pad_rows(Xh, yh, mh, nshards)
+    # Sharded-frame fast path: inputs already row-sharded over THIS mesh
+    # consume the frame's shard partials directly — no host gather, no
+    # re-placement (padded slots are mask=False rows, zero weight in A).
+    pre = (getattr(X, "shape", (1,))[0] % nshards == 0
+           and all(_pre_sharded(a, mesh) for a in (X, y, mask)))
+    if pre:
+        counters.increment("shard.fit_passthrough")
+        Xp, yp, mp = X, y, mask
+    else:
+        Xp, yp, mp = pad_rows(np.asarray(X), np.asarray(y),
+                              np.asarray(mask, bool), nshards)
     shard = NamedSharding(mesh, P(DATA_AXIS))
 
     def sharded():
@@ -480,9 +524,9 @@ def compute_gram(X, y, mask, mesh: Optional[Mesh] = None):
                        shards=nshards, rows=int(Xp.shape[0]),
                        rows_per_shard=int(Xp.shape[0]) // nshards,
                        device=mesh.devices.flat[0].platform) as s:
-            Xd = jax.device_put(Xp, shard)
-            yd = jax.device_put(yp, shard)
-            md = jax.device_put(mp, shard)
+            Xd = Xp if pre else jax.device_put(Xp, shard)
+            yd = yp if pre else jax.device_put(yp, shard)
+            md = mp if pre else jax.device_put(mp, shard)
             A = _gram_sharded_fn(mesh)(Xd, yd, md)
             if s is not _obs._NOOP:
                 jax.block_until_ready(A)
@@ -492,11 +536,16 @@ def compute_gram(X, y, mask, mesh: Optional[Mesh] = None):
         logger.warning(
             "sharded Gramian failed on %d devices; falling back to the "
             "single-device CPU path", nshards)
-        return _gram_single_cpu(Xh, yh, mh)
+        # fault-path host pull: the ladder's last rung computes on host
+        # CPU whatever the mesh state is
+        return _gram_single_cpu(np.asarray(Xp), np.asarray(yp),
+                                np.asarray(mp, bool))
 
     mark = _obs.recovery_mark()
+    # np.shape reads metadata only — never a device pull
+    n_rows, n_feats = (int(s) for s in np.shape(X)[:2])
     with _obs.span("parallel.gram", cat="parallel", shards=nshards,
-                   rows=int(Xh.shape[0]), features=int(Xh.shape[1])) as s:
+                   rows=n_rows, features=n_feats) as s:
         A = _recovery.resilient_call(
             sharded, site="gram_sharded",
             policy=_recovery.active_policy("gram_sharded"),

@@ -1,0 +1,346 @@
+"""Row-sharded frame layout — the ``ShardedStore`` behind ``spark.shard.*``.
+
+ROADMAP item 1: every Frame op ran single-device, so the 1e9-row regime
+was capped by one device's HBM and FLOPs. This module is the layout half
+of the sharded-frames refactor (the lowering halves live in
+``ops/compiler.py`` — the ``shard_map``-wrapped pipeline flush — and
+``ops/segments.py`` — local segment-reduce + cross-shard merge
+collective, per "Large Scale Distributed Linear Algebra With TPUs",
+arxiv 2112.09017):
+
+* A sharded frame's ``_data``/``_mask`` are **global jax arrays laid out
+  row-sharded** over the 1-D ``parallel/mesh`` data axis with a
+  ``NamedSharding``. The row axis pads up to ``devices × bucket`` where
+  ``bucket`` reuses the pipeline compiler's power-of-two bucket
+  discipline (:func:`ops.compiler.bucket_size` over the per-shard row
+  count), and the padded tail rides a ``False`` validity mask — the same
+  masked-slot invariant every consumer in the engine already honors, so
+  a sharded frame is semantically indistinguishable from its
+  single-device twin (bit-identical results are a *construction*
+  property, not a test hope).
+* :class:`ShardedStore` is the layout descriptor a frame carries
+  (``Frame._shard``): device count, per-shard padded bucket, true row
+  count, per-shard valid-row counts. Plan keys extend with its
+  :meth:`~ShardedStore.tag` so sharded and single-device programs
+  coexist in the same bounded-LRU jit caches.
+* Placement is **contiguous range partitioning** (shard ``i`` holds row
+  slots ``[i·bucket, (i+1)·bucket)``): global row order — and with it
+  every order-sensitive semantics (first occurrence, sort stability,
+  join output order) — is preserved exactly.
+
+The session context (``configure``/``reset``) is installed by
+``session._init_pipeline`` from ``spark.shard.{enabled,minRows,devices}``
+and torn down on ``stop()`` — session-scoped like every other conf
+family. With sharding disabled (the default), every hook here is one
+flag/None check.
+
+CPU-sandbox honesty (ROADMAP standing constraint): on the forced-host-
+device CPU backend these paths assert *structure* — one fused program
+per flush, one cross-shard merge collective, unchanged host-sync counts
+— not speedups; the wall-clock wins need real chips.
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from ..config import config
+from ..utils.profiling import counters
+from .mesh import DATA_AXIS
+
+logger = logging.getLogger("sparkdq4ml_tpu.parallel.shard")
+
+__all__ = [
+    "ShardedStore", "configure", "reset", "active_mesh", "store_for",
+    "maybe_shard_frame", "shard_frame", "gather_arrays",
+    "partitioned_join_plan", "hash_partition",
+]
+
+
+class ShardedStore:
+    """Layout descriptor of one row-sharded frame: ``devices`` shards of
+    ``bucket`` padded row slots each, holding ``rows`` true rows placed
+    contiguously (shard ``i``'s valid count is
+    ``clip(rows - i*bucket, 0, bucket)``)."""
+
+    __slots__ = ("mesh", "rows", "bucket")
+
+    def __init__(self, mesh: Mesh, rows: int, bucket: int):
+        self.mesh = mesh
+        self.rows = int(rows)
+        self.bucket = int(bucket)
+
+    @property
+    def devices(self) -> int:
+        return int(self.mesh.devices.size)
+
+    @property
+    def slots(self) -> int:
+        """Global padded row slots (= the sharded frame's ``num_slots``)."""
+        return self.devices * self.bucket
+
+    def sharding(self) -> NamedSharding:
+        """Rows over the data axis (leading-dim sharding)."""
+        return NamedSharding(self.mesh, PartitionSpec(DATA_AXIS))
+
+    def shard_counts(self) -> list[int]:
+        """Per-shard valid row counts (EXPLAIN's per-shard rows column)."""
+        return [max(0, min(self.rows - i * self.bucket, self.bucket))
+                for i in range(self.devices)]
+
+    def tag(self) -> str:
+        """Plan-key layout tag: sharded and single-device plans must
+        never share a cache entry (their programs differ), while two
+        sharded frames on the same device count do (bucket size shows up
+        in the argument shapes, which jit already keys on)."""
+        return f"shard[{self.devices}]"
+
+    def __repr__(self) -> str:
+        return (f"ShardedStore(devices={self.devices}, "
+                f"bucket={self.bucket}, rows={self.rows})")
+
+
+# ---------------------------------------------------------------------------
+# Session-scoped context (spark.shard.*)
+# ---------------------------------------------------------------------------
+
+#: The configured shard mesh (None = sharding unavailable). Installed by
+#: session._init_pipeline via :func:`configure`; ``config.shard_enabled``
+#: gates every read so a disabled session costs one flag check.
+_MESH: Optional[Mesh] = None
+
+
+def configure(mesh: Optional[Mesh]) -> Optional[Mesh]:
+    """Install the shard mesh for this process (session-scoped; the
+    session's ``stop()`` restores via :func:`reset`). ``spark.shard.
+    devices`` caps the device count; a trivial (≤1-device) result
+    disables sharding — there is nothing to shard across."""
+    global _MESH
+    if mesh is None:
+        _MESH = None
+        return None
+    devices = list(mesh.devices.flat)
+    limit = int(config.shard_devices)
+    if limit > 0:
+        devices = devices[:limit]
+    if len(devices) <= 1:
+        _MESH = None
+        return None
+    if len(devices) == mesh.devices.size:
+        _MESH = mesh
+    else:
+        from .mesh import make_mesh
+
+        _MESH = make_mesh(devices=devices)
+    return _MESH
+
+
+def reset() -> None:
+    configure(None)
+
+
+def active_mesh() -> Optional[Mesh]:
+    """The shard mesh when sharding is enabled AND multi-device."""
+    if not config.shard_enabled:
+        return None
+    return _MESH
+
+
+def store_for(n: int) -> Optional[ShardedStore]:
+    """The layout a frame of ``n`` true rows would shard into, or None
+    when sharding is inactive or ``n`` is below ``spark.shard.minRows``
+    (the host-fallback threshold: tiny frames are not worth the
+    placement traffic)."""
+    mesh = active_mesh()
+    if mesh is None or n <= 0 or n < int(config.shard_min_rows):
+        return None
+    from ..ops.compiler import bucket_size
+
+    bucket = bucket_size(max(1, math.ceil(n / mesh.devices.size)))
+    return ShardedStore(mesh, n, bucket)
+
+
+# ---------------------------------------------------------------------------
+# Placement / gather
+# ---------------------------------------------------------------------------
+
+def _is_host_col(arr) -> bool:
+    return isinstance(arr, np.ndarray) and arr.dtype == object
+
+
+def _pad_host(arr: np.ndarray, slots: int) -> np.ndarray:
+    out = np.empty(slots, dtype=object)
+    out[: len(arr)] = arr
+    out[len(arr):] = None
+    return out
+
+
+def place_column(arr, store: ShardedStore):
+    """Pad one column to the store's slot count and lay it out
+    row-sharded (host/object columns pad with ``None`` and stay host).
+    Accepts columns already at slot length (re-placement)."""
+    if _is_host_col(arr):
+        if len(arr) == store.slots:
+            return arr
+        return _pad_host(arr, store.slots)
+    a = jnp.asarray(arr)
+    n = a.shape[0]
+    if n != store.slots:
+        fill = jnp.zeros((store.slots - n,) + a.shape[1:], a.dtype)
+        a = jnp.concatenate([a, fill], axis=0)
+    return jax.device_put(a, store.sharding())
+
+
+def shard_frame(frame):
+    """Return a row-sharded twin of ``frame`` (same values, same valid
+    rows; physical slots pad to ``devices × bucket`` with a ``False``
+    mask tail). The input frame is untouched. Raises when sharding is
+    inactive — callers wanting the soft form use
+    :func:`maybe_shard_frame`."""
+    store = store_for(frame.num_slots)
+    if store is None:
+        raise RuntimeError(
+            "sharding is inactive (spark.shard.enabled off, a "
+            "single-device mesh, or the frame is below "
+            "spark.shard.minRows)")
+    return _place(frame, store)
+
+
+def maybe_shard_frame(frame):
+    """Shard ``frame`` when the context says to, else return it
+    unchanged — the ingest/read hand-off hook (one None check when
+    sharding is off)."""
+    if getattr(frame, "_shard", None) is not None:
+        return frame
+    store = store_for(frame.num_slots)
+    if store is None:
+        return frame
+    return _place(frame, store)
+
+
+def _place(frame, store: ShardedStore):
+    from ..frame.frame import Frame
+
+    data = frame._data            # flush-on-read: pending pipeline settles
+    mask = frame._mask
+    placed = {name: place_column(arr, store) for name, arr in data.items()}
+    pmask = jnp.asarray(mask, jnp.bool_)
+    if pmask.shape[0] != store.slots:
+        pmask = jnp.concatenate([
+            pmask, jnp.zeros((store.slots - pmask.shape[0],), jnp.bool_)])
+    pmask = jax.device_put(pmask, store.sharding())
+    out = Frame.__new__(Frame)
+    out._data_store = placed
+    out._mask_store = pmask
+    out._pending = ()
+    out._n = store.slots
+    out._shard = store
+    counters.increment("shard.place")
+    return out
+
+
+def gather_arrays(store: ShardedStore, *arrays):
+    """Re-place arrays on the mesh's first device — the one-level
+    degradation of every sharded ladder (device fault on one shard →
+    single-device execution). A device→device transfer, never a counted
+    host sync."""
+    dev = store.mesh.devices.flat[0]
+    return tuple(jax.device_put(jnp.asarray(a), dev) for a in arrays)
+
+
+def gather_store(frame):
+    """Degrade a sharded frame's columns to single-device placement
+    (host/object columns pass through). Returns ``(data, mask)`` — the
+    caller installs them and drops ``_shard``."""
+    store = frame._shard
+    dev = store.mesh.devices.flat[0]
+    data = {name: (arr if _is_host_col(arr)
+                   else jax.device_put(jnp.asarray(arr), dev))
+            for name, arr in frame._data_store.items()}
+    mask = jax.device_put(jnp.asarray(frame._mask_store, jnp.bool_), dev)
+    counters.increment("shard.gather")
+    return data, mask
+
+
+# ---------------------------------------------------------------------------
+# Hash-partitioned join planning (the shuffle lowering's host realization)
+# ---------------------------------------------------------------------------
+
+def hash_partition(cols: list[np.ndarray], parts: int) -> np.ndarray:
+    """Per-row partition id over float64-converted key columns — the
+    host mirror of the device exchange's key hash. Null-safe: NaN (the
+    engine's SQL NULL) hashes to one partition, ``-0.0`` folds onto
+    ``0.0`` (they compare equal and must land together)."""
+    n = len(cols[0]) if cols else 0
+    h = np.zeros(n, np.uint64)
+    for c in cols:
+        c = np.asarray(c, np.float64)
+        nulls = np.isnan(c)
+        z = np.where(c == 0.0, 0.0, c)          # -0.0 == 0.0 → same bits
+        z = np.where(nulls, 0.0, z)
+        bits = z.view(np.uint64)
+        h = h * np.uint64(0x100000001B3) ^ bits
+        h = h * np.uint64(0x100000001B3) ^ nulls.astype(np.uint64)
+    return (h % np.uint64(max(parts, 1))).astype(np.int64)
+
+
+def partitioned_join_plan(plan_fn, lcols, rcols, li, ri, how: str,
+                          parts: int):
+    """Hash-partition shuffle lowering of the vectorized join plan: rows
+    of each side partition by key hash, ``plan_fn`` (the single-device
+    ``_vector_join_plan``) runs per partition, and the per-partition
+    pair lists merge back into EXACTLY the unpartitioned plan's order —
+    sound because equal keys land in one partition, so every left row's
+    complete match set is partition-local, and a stable sort on the left
+    row index restores the global emission order (unmatched right rows
+    re-sort by right index, the canonical append order).
+
+    Returns ``(lpairs, rpairs)`` or ``None`` when any partition's plan
+    bails (the caller falls back to the unpartitioned plan)."""
+    t_l = hash_partition(lcols, parts)
+    t_r = hash_partition(rcols, parts)
+    lp_all, rp_all = [], []
+    extra_r = []                     # unmatched right rows (right/outer)
+    for p in range(parts):
+        ls = np.nonzero(t_l == p)[0]
+        rs = np.nonzero(t_r == p)[0]
+        if ls.size == 0 and rs.size == 0:
+            continue
+        if rs.size == 0:
+            # fully-determined plans, mirroring Frame.join's empty-right
+            # guard: inner/right/semi match nothing, left/outer/anti
+            # keep every left row null-filled
+            if how in ("inner", "right", "left_semi"):
+                continue
+            lp_all.append(li[ls].astype(np.int64))
+            rp_all.append(np.full(ls.size, -1, np.int64))
+            continue
+        sub = plan_fn([c[ls] for c in lcols], [c[rs] for c in rcols],
+                      li[ls], ri[rs], how)
+        if sub is None:
+            return None
+        lp, rp = sub
+        if how in ("right", "outer"):
+            appended = lp < 0
+            extra_r.append(rp[appended])
+            lp, rp = lp[~appended], rp[~appended]
+        lp_all.append(lp)
+        rp_all.append(rp)
+    lp = np.concatenate(lp_all) if lp_all else np.empty(0, np.int64)
+    rp = np.concatenate(rp_all) if rp_all else np.empty(0, np.int64)
+    order = np.argsort(lp, kind="stable")
+    lp, rp = lp[order], rp[order]
+    if how in ("right", "outer"):
+        ex = (np.sort(np.concatenate(extra_r)) if extra_r
+              else np.empty(0, np.int64))
+        lp = np.concatenate([lp, np.full(ex.size, -1, np.int64)])
+        rp = np.concatenate([rp, ex])
+    counters.increment("shard.join_partitioned")
+    return lp, rp

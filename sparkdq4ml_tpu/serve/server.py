@@ -663,8 +663,9 @@ class QueryServer:
         status, value, error = "ok", None, ""
         job.attempts += 1
         try:
-            with ns_cm, _obs.span("serve.query", cat="serve",
-                                  tenant=job.tenant, tag=job.tag):
+            with ns_cm, _shard_guard(), _obs.span(
+                    "serve.query", cat="serve",
+                    tenant=job.tenant, tag=job.tag):
                 # serve_exec chaos hook (one None check without a plan):
                 # a due device_error raises the same XlaRuntimeError
                 # class a real worker device fault would
@@ -904,6 +905,25 @@ def _plan_namespace(tenant: str):
     from ..ops.compiler import plan_namespace
 
     return plan_namespace(tenant)
+
+
+def _shard_guard():
+    """Serialize served-query EXECUTION while row-sharding is active
+    (``spark.shard.enabled`` on a multi-device mesh): a sharded query's
+    eager host-boundary reductions (``count``'s mask sum, ``limit``'s
+    cumsum) dispatch multi-device programs outside any jit factory's
+    ``serialize_collectives`` wrapper, and overlapping multi-device
+    executions are the XLA:CPU rendezvous-deadlock class PR 6 closed.
+    With sharding active every query already spans the whole mesh, so
+    whole-query serialization is the correct dispatch semantics (the
+    mesh is the unit of concurrency), not a throughput concession. The
+    plan caches stay namespace-partitioned exactly as before — the
+    shard layout tag composes with the tenant namespace prefix inside
+    the plan key. One flag/None check when sharding is off."""
+    from ..parallel.mesh import collective_guard
+    from ..parallel.shard import active_mesh
+
+    return collective_guard(active_mesh())
 
 
 def _materialize(value):

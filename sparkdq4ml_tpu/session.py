@@ -324,8 +324,32 @@ class TpuSession:
                 _set("stats_flush_on_stop", False)
             elif fval in _CONF_TRUE:
                 _set("stats_flush_on_stop", True)
+            # Row-sharded frames (parallel/shard.py), session-scoped
+            # like everything above:
+            #     .config("spark.shard.enabled", "true")  # shard frames
+            #     .config("spark.shard.minRows", 65536)   # host fallback
+            #     .config("spark.shard.devices", 4)       # mesh cap
+            shval = str(self.conf.get("spark.shard.enabled", "")).lower()
+            if shval in _CONF_FALSE:
+                _set("shard_enabled", False)
+            elif shval in _CONF_TRUE:
+                _set("shard_enabled", True)
+            if "spark.shard.minRows" in self.conf:
+                _set("shard_min_rows",
+                     int(self.conf["spark.shard.minRows"]))
+            if "spark.shard.devices" in self.conf:
+                _set("shard_devices",
+                     int(self.conf["spark.shard.devices"]))
             if saved:
                 self._pipeline_saved = saved
+        # Install the shard context over THIS session's mesh (outside
+        # _CONF_LOCK — mesh construction never holds the conf lock;
+        # stop() tears it down via shard.reset()). The enabled flag
+        # gates every read, so configuring with sharding off costs
+        # nothing.
+        from .parallel import shard as _shard_mod
+
+        _shard_mod.configure(self.mesh)
         # Adopt persisted plan-statistics history (outside _CONF_LOCK —
         # file I/O never holds the conf lock). Merge is winner-per-key,
         # so a builder re-init re-loading the same snapshot is a no-op.
@@ -729,7 +753,8 @@ class TpuSession:
                 if any(k.startswith(("spark.pipeline.", "spark.groupedExec.",
                                      "spark.explain.", "spark.serve.",
                                      "spark.ingest.", "spark.audit.",
-                                     "spark.chaos.", "spark.stats."))
+                                     "spark.chaos.", "spark.stats.",
+                                     "spark.shard."))
                        for k in self._conf):
                     _ACTIVE._init_pipeline()
                 return _ACTIVE
@@ -915,6 +940,11 @@ class TpuSession:
                 from .ops import segments as _segments
 
                 _segments.clear_cache()
+        # Tear down the shard context THIS session installed (the mesh
+        # belongs to the session; a later session re-configures its own).
+        from .parallel import shard as _shard_mod
+
+        _shard_mod.reset()
         # Uninstall the fault plan THIS session installed (conf/env):
         # chaos is session-scoped opt-in; a later chaos-free session (or
         # plain library use) must not keep injecting this one's faults.

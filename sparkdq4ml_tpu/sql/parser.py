@@ -1641,6 +1641,8 @@ _EXPLAIN_RE = re.compile(r"^\s*explain(\s+analyze)?\b(.*)$",
 #: joins attributes the first ``frame.join`` span to the first Join node.
 _NODE_SPAN_CANDIDATES = {
     "FusedStage": ("frame.pipeline.flush", "frame.filter", "frame.select"),
+    "ShardedStage": ("frame.pipeline.flush", "frame.filter",
+                     "frame.select"),
     "Filter": ("frame.filter",),
     "Project": ("frame.select",),
     "Aggregate": ("frame.agg",),
@@ -1662,6 +1664,7 @@ _NODE_SPAN_CANDIDATES = {
 #: Project node's program); FusedStage owns both.
 _PIPELINE_NODE_PRED = {
     "FusedStage": lambda a: True,
+    "ShardedStage": lambda a: True,
     "Filter": lambda a: a.get("steps", 0) > 0,
     "Project": lambda a: a.get("outputs", 0) > 0,
 }
@@ -1836,7 +1839,7 @@ def _annotate_est_rows(tree: PlanNode, cat) -> None:
                     out = None
             else:
                 out = child      # derived table: its subquery's estimate
-        elif op in ("FusedStage", "Filter"):
+        elif op in ("FusedStage", "ShardedStage", "Filter"):
             q = node.meta.get("query")
             if child is not None and q is not None:
                 skey = _filter_history_key(q, cat)
@@ -1844,7 +1847,7 @@ def _annotate_est_rows(tree: PlanNode, cat) -> None:
                     sel = _stats.STORE.selectivity(skey)
                     if sel is not None:
                         out = int(round(sel * child))
-        elif op in ("Project", "Sort", "DeviceSort"):
+        elif op in ("Project", "Sort", "DeviceSort", "Exchange"):
             out = child
         elif op == "Limit":
             lim = node.meta.get("limit")
@@ -1871,6 +1874,79 @@ def _annotate_est_rows(tree: PlanNode, cat) -> None:
                                                "CreateView")
                      else tree.children[:1]):
             est(root)
+    except Exception:
+        pass
+
+
+def _annotate_sharded(tree: PlanNode, cat) -> None:
+    """Sharded-frames EXPLAIN markers (``spark.shard.enabled``): when a
+    scanned view's frame is row-sharded, Scan nodes carry the per-shard
+    row counts, the fused stage renders as ``ShardedStage[k]`` (one
+    ``shard_map`` program over ``k`` shards, zero cross-shard traffic),
+    and operators that move rows across shards gain an ``Exchange``
+    child — ``[merge:psum]`` under grouped aggregation (the per-shard
+    slot-table merge collective), ``[hash:all_to_all]`` under DISTINCT
+    and join (the shuffle lowering), ``[gather]`` under a total sort.
+    Pure annotation: zero execution, never raises."""
+    from ..parallel.shard import active_mesh
+
+    mesh = active_mesh()
+    if mesh is None:
+        return
+    k = int(mesh.devices.size)
+
+    def store_of(node):
+        view = node.meta.get("view")
+        if not isinstance(view, str):
+            return None
+        try:
+            return getattr(cat.lookup(view), "_shard", None)
+        except Exception:
+            return None
+
+    def exchange(node, kind):
+        node.children[0] = PlanNode("Exchange", f"[{kind}]",
+                                    [node.children[0]])
+
+    def visit(node) -> bool:
+        """Returns whether the node's OUTPUT rows are shard-resident."""
+        child_sharded = [visit(c) for c in node.children]
+        if node.op == "Scan":
+            store = store_of(node)
+            if store is not None:
+                node.stats["shards"] = store.devices
+                node.stats["rows_per_shard"] = "/".join(
+                    str(c) for c in store.shard_counts())
+                return True
+            return bool(child_sharded) and child_sharded[0]
+        inp = bool(child_sharded) and child_sharded[0]
+        if node.op == "FusedStage" and inp:
+            node.op = "ShardedStage"
+            node.detail = f"[{k}]" + node.detail
+            return True
+        if node.op in ("Filter", "Project", "Having", "Offset",
+                       "Limit") and inp:
+            return True
+        if node.op in ("Aggregate", "SegmentedAggregate") and inp:
+            exchange(node, "merge:psum")
+            return False
+        if node.op == "Distinct" and inp:
+            exchange(node, "hash:all_to_all")
+            return False
+        if node.op in ("Sort", "DeviceSort") and inp:
+            exchange(node, "gather")
+            return False
+        if node.op == "Join" and any(child_sharded):
+            for i, sharded in enumerate(child_sharded):
+                if sharded:
+                    node.children[i] = PlanNode(
+                        "Exchange", "[hash:all_to_all]",
+                        [node.children[i]])
+            return False
+        return False
+
+    try:
+        visit(tree)
     except Exception:
         pass
 
@@ -1956,6 +2032,7 @@ def _execute_explain(body: str, cat, analyze: bool):
     from ..frame.frame import Frame
 
     tree, kind, payload = _parse_explain_tree(body)
+    _annotate_sharded(tree, cat)
     _obs.current_span().set(
         plan=("ExplainAnalyze" if analyze else "Explain"))
     # Static memory bounds (dqaudit tier, analysis/program/static_mem):

@@ -97,6 +97,8 @@ FAULT_SITES = {
     "mesh": ("device_drop",),
     "pipeline_flush": ("device_error", "nan"),
     "grouped_flush": ("device_error",),
+    "shard_flush": ("device_error",),
+    "shard_merge": ("device_error",),
     "ingest_native": ("io_error", "torn_chunk", "thread_death",
                       "pool_exhaust"),
     "serve_exec": ("device_error",),

@@ -92,6 +92,9 @@ METRIC_NAMES = {
     "pipeline.evict": ("counter", "plan-cache LRU evictions"),
     "pipeline.oom_chunked": ("counter",
                              "over-budget flushes run row-chunked"),
+    "pipeline.shard_gather": ("counter",
+                              "sharded flushes gathered to single-device "
+                              "by the shard_flush ladder"),
     # grouped execution (ops/segments.py)
     "grouped.compile": ("counter", "grouped programs traced+compiled"),
     "grouped.hit": ("counter", "grouped-program plan-cache replays"),
@@ -101,6 +104,20 @@ METRIC_NAMES = {
                                "ladder"),
     "grouped.dense_miss": ("counter", "dense lowering misfits rerouted"),
     "grouped.evict": ("counter", "grouped plan-cache LRU evictions"),
+    "grouped.shard_gather": ("counter",
+                             "sharded grouped/distinct programs gathered "
+                             "to single-device by the shard_merge "
+                             "ladder"),
+    # row-sharded frames (parallel/shard.py)
+    "shard.place": ("counter", "frames laid out row-sharded"),
+    "shard.gather": ("counter", "sharded frames degraded to "
+                                "single-device placement"),
+    "shard.join_partitioned": ("counter",
+                               "joins planned via the hash-partition "
+                               "shuffle lowering"),
+    "shard.fit_passthrough": ("counter",
+                              "fit placements consuming shard partials "
+                              "directly (no re-shard)"),
     # streaming ingest (frame/native_csv.py)
     "ingest.files": ("counter", "native CSV files read"),
     "ingest.bytes": ("counter", "native CSV bytes parsed"),

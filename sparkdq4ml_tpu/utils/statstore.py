@@ -328,6 +328,7 @@ class StatStore:
             return
         try:
             import jax
+            import numpy as np
 
             values = jax.device_get([p[3] for p in pending])
             profiling.counters.increment("stats.drain_sync")
@@ -338,7 +339,11 @@ class StatStore:
             return
         for (key, kind, rows_in, _), v in zip(pending, values):
             try:
-                self.record_rows(key, kind, rows_in, int(v))
+                # a deferred observation may be a scalar OR a per-shard
+                # count vector (the sharded flush's (devices,) output) —
+                # the sum is the valid-row total either way
+                self.record_rows(key, kind, rows_in,
+                                 int(np.asarray(v).sum()))
             except Exception:
                 logger.debug("deferred observation discarded", exc_info=True)
 
@@ -597,6 +602,12 @@ def selectivity_key(plan_key: str) -> Optional[str]:
     makes history-informed ``est rows`` possible on a fresh session."""
     parts = plan_key.split("|")
     if parts and parts[0].startswith("ns:"):
+        parts = parts[1:]
+    if parts and parts[0].startswith("shard["):
+        # layout tags stay out of the selectivity identity: a filter's
+        # observed selectivity is a data property, so sharded and
+        # single-device flushes of the same WHERE share one entry (and
+        # EXPLAIN's layout-agnostic probe keeps addressing it)
         parts = parts[1:]
     if not parts:
         return None
