@@ -89,6 +89,9 @@ _CANDIDATES = (
     ("fit_packed", "device_error", 0.05, ""),
     ("stats_persist", "io_error", 0.40, ""),
     ("stats_persist", "torn_chunk", 0.40, ""),
+    # the cost-based optimizer's ladder: a planning fault degrades the
+    # query to its unrewritten parse shape, never fails or changes it
+    ("optimizer", "device_error", 0.25, ""),
 )
 
 
@@ -107,6 +110,7 @@ _ROTATION = (
     ("pipeline_flush", "nan", ""),
     ("stats_persist", "io_error", ""),
     ("stats_persist", "torn_chunk", ""),
+    ("optimizer", "device_error", ""),
 )
 
 

@@ -50,6 +50,8 @@ CONF_KEYS = {
     "spark.chaos.seed": "session",
     "spark.chaos.seeds": "session",
     "spark.chaos.soakSeconds": "session",
+    "spark.optimizer.enabled": "session",
+    "spark.optimizer.level": "session",
     "spark.stats.enabled": "session",
     "spark.stats.path": "session",
     "spark.stats.maxEntries": "session",
@@ -175,6 +177,19 @@ class _Config:
     chaos_seed: int = 0
     chaos_seeds: int = 5
     chaos_soak_s: float = 0.0
+    # Cost-based plan optimizer (sql/optimizer.py + lowering hooks in
+    # ops/compiler.py and ops/segments.py): statstore-driven rewrites
+    # over the parsed Query — predicate/projection pushdown, build-side
+    # selection, grouped dense-skip, history-informed memory chunking —
+    # applied before execution (spark.optimizer.enabled; false runs
+    # every query at its literal parse shape, one flag read per query).
+    optimizer_enabled: bool = True
+    # Rewrite aggressiveness (spark.optimizer.level): 1 = rewrites that
+    # preserve physical emission order bit-for-bit (the default); 2 adds
+    # join reordering and fused-stage boundary splitting — row MULTISETS
+    # stay exact, but physical row order may legally change where SQL
+    # imposes none.
+    optimizer_level: int = 1
     # Plan-statistics observatory (utils/statstore.py): per-plan-key
     # running stats — observed selectivity, wall/compile-ms digests,
     # host syncs, est/measured peak bytes — feeding EXPLAIN's history-

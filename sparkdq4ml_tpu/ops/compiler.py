@@ -1024,6 +1024,68 @@ def _record_flush_stats(plan, data, b: int, n: int,
         logger.debug("stats hand-off failed", exc_info=True)
 
 
+#: Stage-boundary placement (cost-based optimizer, level >= 2): minimum
+#: pending-step count for a chain to count as a "mega-stage" worth
+#: probing, and the minimum recorded compile cost (statstore p50) of the
+#: warm prefix for a split to pay — below it the two extra dispatches
+#: cost more than the avoided recompile.
+_SPLIT_MIN_STEPS = 6
+_SPLIT_MIN_COMPILE_MS = 5.0
+
+
+def _split_point(steps, extra, schema) -> Optional[int]:
+    """Fused-stage boundary placement, informed by recorded compile-cost
+    digests (ISSUE 14 / ``utils.statstore``): when a mega-stage's full
+    plan is COLD (about to compile) but its first-half prefix is already
+    compiled-and-cached with a recorded compile cost that dominates
+    replay savings, split the flush at that boundary — the prefix
+    replays as a cache hit and only the (smaller) tail compiles. The
+    merge direction needs no hook: deferral already coalesces adjacent
+    cheap stages into one program.
+
+    Pure host-side planning: one ``_linearize`` walk plus two cache
+    probes; only reached at ``spark.optimizer.level >= 2``. Returns the
+    step index to split at, or None. Sound for ANY split point: the
+    compilable step surface is purely elementwise-and-mask-AND, so
+    running the same steps as two sequential programs is
+    semantics-preserving (the row-chunked degrade's argument, applied
+    along the step axis instead of the row axis)."""
+    try:
+        key, _lits, _s, _e, _r = _linearize(steps, tuple(extra), schema)
+    except Exception:
+        return None
+    ns = plan_namespace_tag()
+    parts = key.split("|")
+    if len(parts) != 1 + len(steps) + len(extra):
+        return None          # a key fragment embeds '|': stay unsplit
+    with _CACHE_LOCK:
+        if ns + key in _CACHE:
+            return None      # warm mega-plan: replay beats any split
+        k = len(steps) // 2
+        prefix_key = ns + "|".join(parts[:1 + k])
+        if prefix_key not in _CACHE:
+            return None
+    from ..utils import statstore as _stats
+
+    cost = _stats.STORE.compile_ms_p50(prefix_key)
+    if cost is None or cost < _SPLIT_MIN_COMPILE_MS:
+        return None
+    return k
+
+
+def _history_bytes(key: str) -> Optional[int]:
+    """Remembered resident-byte bound for a plan key (max of the static
+    estimate and the measured peak across sessions) — the memory-aware
+    chunking input the optimizer promotes from a fault-ladder rung to a
+    planned decision. None = no history; never raises."""
+    from ..utils import statstore as _stats
+
+    try:
+        return _stats.STORE.bytes_bound(key)
+    except Exception:
+        return None
+
+
 def selectivity_key_for(where_steps, schema) -> Optional[str]:
     """The selectivity-entry key a flush of ``where_steps`` over
     ``schema`` would record under — computed WITHOUT executing anything
@@ -1067,6 +1129,22 @@ def run_pipeline(data: dict, mask, n: int, steps, extra=(), shard=None):
     schema = LazySchema(data, ())
     try:
         b = n if shard is not None else bucket_size(n)
+        # Stage-boundary placement (cost-based optimizer, level >= 2 —
+        # default off): a cold mega-stage with a warm, compile-heavy
+        # prefix splits into prefix-replay + tail-compile. Each half is
+        # a full flush of this same entry point (its own stats, spans,
+        # chunking, ladder).
+        if (shard is None and n > 0
+                and config.optimizer_enabled
+                and int(config.optimizer_level) >= 2
+                and len(steps) >= _SPLIT_MIN_STEPS):
+            k = _split_point(steps, extra, schema)
+            if k:
+                counters.increment("optimizer.split")
+                mid_data, mid_mask, _ = run_pipeline(
+                    data, mask, n, steps[:k], ())
+                return run_pipeline(mid_data, mid_mask, n, steps[k:],
+                                    extra)
         plan, lit_values = _lookup_plan(steps, tuple(extra), schema, shard)
         # Pre-execution memory degrade (ISSUE 11 / arxiv 2206.14148):
         # when a device-byte budget is known (explicit
@@ -1107,6 +1185,17 @@ def run_pipeline(data: dict, mask, n: int, steps, extra=(), shard=None):
                                             n, budget, est)
                 else:
                     est = _est_flush_bytes(plan, data, b)
+                    if (est <= budget and config.optimizer_enabled
+                            and config.stats_enabled):
+                        # memory-aware chunking as a PLANNED decision
+                        # (ISSUE 14): a plan whose REMEMBERED byte bound
+                        # (measured peaks included, persisted across
+                        # sessions) exceeds the budget chunks up front
+                        # even when the cheap static mirror under-counts
+                        hist = _history_bytes(plan.key)
+                        if hist is not None and hist > budget:
+                            counters.increment("optimizer.mem_chunk")
+                            est = hist
                     if est > budget:
                         return _run_chunked(plan, lit_values, data, mask,
                                             n, budget, est)

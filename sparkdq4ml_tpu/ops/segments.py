@@ -1225,11 +1225,29 @@ def grouped_agg(frame, keys, agg_list):
     c_stats = counters.get("grouped.compile") if stats_on else 0
     syncs = 0
     stats_key = f"G|{shard.tag()}|{struct}" if sharded else f"G|{struct}"
+    # Adaptive lowering choice (cost-based optimizer + statstore): a
+    # struct whose dense attempts repeatedly overflowed the slot-table
+    # range skips straight to the sorted program, saving the doomed
+    # dense dispatch AND its extra host sync. Advisory history — the
+    # sorted program is bit-identical to the miss-reroute it replaces,
+    # and fresh data that would fit again just re-earns its dense path
+    # after the history entry evicts.
+    skip_dense = False
+    if (dense_ok and not sharded and stats_on
+            and config.optimizer_enabled):
+        from ..utils import statstore as _stats_store
+
+        try:
+            if _stats_store.STORE.miss_count(f"GD{S}|{struct}") >= 2:
+                skip_dense = True
+                counters.increment("optimizer.dense_skip")
+        except Exception:
+            pass
     with _obs.TRACER.span(
             "frame.grouped.flush", cat="frame", op="group_by",
             keys=len(keys), aggs=len(agg_list), rows=n, bucket=b) as sp:
         g = -1
-        run_dense = dense_ok
+        run_dense = dense_ok and not skip_dense
         if sharded:
             before = counters.get("grouped.compile")
             fn = _cached_plan(
@@ -1296,6 +1314,15 @@ def grouped_agg(frame, keys, agg_list):
                 sp.set(groups=g, lowering="dense")
             else:
                 counters.increment("grouped.dense_miss")
+                if stats_on:
+                    # miss history feeds the optimizer's dense-skip
+                    # decision above (same struct key, next query)
+                    from ..utils import statstore as _stats_store
+
+                    try:
+                        _stats_store.STORE.record_miss(f"GD{S}|{struct}")
+                    except Exception:
+                        pass
         if g < 0:
             before = counters.get("grouped.compile")
             fn = _cached_plan(f"GS|{struct}", _build_sorted_agg_program(

@@ -303,6 +303,19 @@ class TpuSession:
             if "spark.chaos.soakSeconds" in self.conf:
                 _set("chaos_soak_s",
                      float(self.conf["spark.chaos.soakSeconds"]))
+            # Cost-based plan optimizer (sql/optimizer.py), session-scoped
+            # like everything above:
+            #     .config("spark.optimizer.enabled", "false") # literal plans
+            #     .config("spark.optimizer.level", 2)  # + reorder/split
+            oval = str(self.conf.get("spark.optimizer.enabled",
+                                     "")).lower()
+            if oval in _CONF_FALSE:
+                _set("optimizer_enabled", False)
+            elif oval in _CONF_TRUE:
+                _set("optimizer_enabled", True)
+            if "spark.optimizer.level" in self.conf:
+                _set("optimizer_level",
+                     int(self.conf["spark.optimizer.level"]))
             # Plan-stats observatory (utils/statstore.py), session-scoped
             # like everything above:
             #     .config("spark.stats.enabled", "false")   # hooks no-op

@@ -1597,9 +1597,13 @@ def plan_tree(q: Query) -> PlanNode:
         return PlanNode("Scan", "[(subquery)]")  # OneRowRelation et al.
 
     node = scan_node(q.view)
-    for view, how, _keys, _alias in reversed(q.joins):
+    hints = list(getattr(q, "join_build", ()) or ())
+    hints += [None] * (len(q.joins) - len(hints))
+    for (view, how, _keys, _alias), hint in zip(reversed(q.joins),
+                                                reversed(hints)):
         how = how if isinstance(how, str) else "inner"
-        node = PlanNode("Join", f"[{how}]", [node, scan_node(view)])
+        detail = f"[{how},build={hint}]" if hint else f"[{how}]"
+        node = PlanNode("Join", detail, [node, scan_node(view)])
     if _structurally_fusable(q):
         node = PlanNode("FusedStage",
                         f"(Project[{len(q.items)}] <- Filter)", [node])
@@ -1822,6 +1826,10 @@ def _annotate_est_rows(tree: PlanNode, cat) -> None:
         _stats.STORE.drain_pending()
     except Exception:
         pass
+    #: CTE-name -> estimated rows (filled from the With wrapper's CTE
+    #: bodies BEFORE the main query annotates, so a Scan of a CTE name
+    #: resolves history-informed cardinality instead of going "-")
+    cte_est: dict[str, int] = {}
 
     def est(node) -> Optional[int]:
         try:
@@ -1833,10 +1841,13 @@ def _annotate_est_rows(tree: PlanNode, cat) -> None:
         if op == "Scan":
             view = node.meta.get("view")
             if isinstance(view, str):
-                try:
-                    out = int(cat.lookup(view).num_slots)
-                except Exception:
-                    out = None
+                if view.lower() in cte_est:
+                    out = cte_est[view.lower()]
+                else:
+                    try:
+                        out = int(cat.lookup(view).num_slots)
+                    except Exception:
+                        out = None
             else:
                 out = child      # derived table: its subquery's estimate
         elif op in ("FusedStage", "ShardedStage", "Filter"):
@@ -1869,11 +1880,43 @@ def _annotate_est_rows(tree: PlanNode, cat) -> None:
             est(side)
         return out
 
+    def annotate(node) -> Optional[int]:
+        """Wrapper-aware walk: With annotates its CTE bodies first (in
+        registration order — later CTEs may scan earlier ones) and
+        propagates the main query's estimate onto the wrapper; SetOps
+        annotates every branch and folds branch estimates through the
+        operator chain (UNION sums — an upper bound under dedup —,
+        INTERSECT takes the min, EXCEPT keeps the left bound)."""
+        if node.op == "With":
+            for name, sub in zip(node.meta.get("cte_names") or (),
+                                 node.children[1:]):
+                v = annotate(sub)
+                if v is not None:
+                    cte_est[str(name).lower()] = v
+            out = annotate(node.children[0]) if node.children else None
+            node.stats["est_rows"] = out
+            return out
+        if node.op == "SetOps":
+            vals = [annotate(c) for c in node.children]
+            out = vals[0] if vals else None
+            for op, v in zip(node.meta.get("set_ops") or (), vals[1:]):
+                if op in ("union", "union_all"):
+                    out = out + v if out is not None and v is not None \
+                        else None
+                elif op == "intersect":
+                    out = min(out, v) if out is not None and v is not None \
+                        else None
+                # except: the left branch bound stands
+            node.stats["est_rows"] = out
+            return out
+        if node.op == "CreateView":
+            for c in node.children:
+                annotate(c)
+            return None
+        return est(node)
+
     try:
-        for root in ([tree] if tree.op not in ("With", "SetOps",
-                                               "CreateView")
-                     else tree.children[:1]):
-            est(root)
+        annotate(tree)
     except Exception:
         pass
 
@@ -1969,16 +2012,27 @@ def _parse_explain_tree(body: str):
     if m:
         return PlanNode("DropView", f"[{m.group(2)}]"), "drop", body
     q = parse(body)
+    return _wrap_plan_tree(q), "query", q
+
+
+def _wrap_plan_tree(q: Query) -> PlanNode:
+    """Plan tree for a full statement: the main query's tree plus the
+    With/SetOps wrapper nodes. ``meta`` carries the CTE names and the
+    set-operator list so ``_annotate_est_rows`` can propagate
+    cardinality through the wrappers (a Scan of a CTE name resolves
+    against the CTE body's estimate, not the catalog)."""
     tree = plan_tree(q)
     if q.ctes:
         # children[0] = main query; children[1:] = the CTE bodies in
         # registration order (execution_order runs them first)
         tree = PlanNode("With", f"[{len(q.ctes)}]",
                         [tree] + [plan_tree(sub) for _name, sub in q.ctes])
+        tree.meta["cte_names"] = [name for name, _sub in q.ctes]
     if q.unions:
         tree = PlanNode("SetOps", f"[+{len(q.unions)}]",
                         [tree] + [plan_tree(sub) for _op, sub in q.unions])
-    return tree, "query", q
+        tree.meta["set_ops"] = [op for op, _sub in q.unions]
+    return tree
 
 
 def _cache_lines(before: dict, after: dict) -> list[str]:
@@ -2032,6 +2086,23 @@ def _execute_explain(body: str, cat, analyze: bool):
     from ..frame.frame import Frame
 
     tree, kind, payload = _parse_explain_tree(body)
+    # Cost-based optimizer (sql/optimizer.py): rewrite the parsed query
+    # exactly as execution would — zero execution, static metadata +
+    # statstore history only — and render the before/after plan diff
+    # plus one line per applied rewrite. The optimized payload is what
+    # ANALYZE then executes, so the annotated tree matches the plan
+    # that actually ran.
+    opt_rewrites: list[str] = []
+    before_text: Optional[str] = None
+    if kind == "query" and _cfg.optimizer_enabled:
+        from . import optimizer as _optimizer
+
+        q_opt, rewrites = _optimizer.optimize_or_fallback(payload, cat)
+        if rewrites:
+            before_text = tree.render()
+            tree = _wrap_plan_tree(q_opt)
+            payload = q_opt
+            opt_rewrites.extend(str(r) for r in rewrites)
     _annotate_sharded(tree, cat)
     _obs.current_span().set(
         plan=("ExplainAnalyze" if analyze else "Explain"))
@@ -2057,6 +2128,14 @@ def _execute_explain(body: str, cat, analyze: bool):
                     f"!! est peak {root_est} bytes exceeds "
                     f"{_cfg.audit_memory_fraction:g} x device limit "
                     f"{budget} bytes (spark.audit.memoryFraction)")
+                if _cfg.optimizer_enabled:
+                    # the PR-9 static bound, promoted to a PLANNED
+                    # decision: over-budget flushes run row-chunked
+                    # up front (ops/compiler.run_pipeline), not as an
+                    # allocator-fault ladder rung
+                    opt_rewrites.append(
+                        "mem-chunk: planned row-chunked execution "
+                        f"(est peak {root_est} B vs budget {budget} B)")
     # History-informed `est rows` (plan-stats observatory,
     # utils/statstore.py): annotated BEFORE any execution — on plain
     # EXPLAIN this is the whole point (zero-execution cardinality from
@@ -2064,10 +2143,22 @@ def _execute_explain(body: str, cat, analyze: bool):
     # view the measured rows are then compared against (drift).
     if _cfg.stats_enabled:
         _annotate_est_rows(tree, cat)
+    def _opt_sections() -> list[str]:
+        out: list[str] = []
+        if opt_rewrites:
+            out.append("== Rewrites ==")
+            out.extend(opt_rewrites)
+        if before_text is not None:
+            out.append("== Before Optimization ==")
+            out.append(before_text)
+        return out
+
     if not analyze:
         text = "== Physical Plan ==\n" + tree.render()
         if budget_line:
             text += "\n" + budget_line
+        for ln in _opt_sections():
+            text += "\n" + ln
         return Frame({"plan": [text]})
 
     import time as _time
@@ -2143,6 +2234,7 @@ def _execute_explain(body: str, cat, analyze: bool):
             lines.extend(cl)
     if budget_line:
         lines.append(budget_line)
+    lines.extend(_opt_sections())
     return Frame({"plan": ["\n".join(lines)]})
 
 
@@ -2171,14 +2263,34 @@ def execute(sql: str, catalog=None):
         return out
 
 
+def _maybe_optimize(q: Query, cat):
+    """Cost-based rewrite hook (``sql/optimizer.py``), gated on
+    ``spark.optimizer.enabled`` — ONE flag read when disabled. Any
+    optimizer failure (including the injected ``optimizer`` fault)
+    degrades to the unrewritten plan inside ``optimize_or_fallback``."""
+    from ..config import config as _cfg
+
+    if not _cfg.optimizer_enabled or getattr(q, "_optimized", False):
+        return q
+    from . import optimizer as _optimizer
+
+    q2, _rewrites = _optimizer.optimize_or_fallback(q, cat)
+    return q2
+
+
 def _run_parsed(q: Query, cat):
-    """Execute an already-parsed query: CTE overlay + set expression."""
+    """Execute an already-parsed query: CTE overlay + set expression.
+    Each CTE body and the main set expression pass through the
+    cost-based optimizer first (CTE frames are registered in the overlay
+    before the main query optimizes, so its relation metadata resolves
+    CTE names like any view)."""
     if q.ctes:
         cat = _OverlayCatalog(cat)
         for name, sub in q.ctes:
             # Later CTEs may reference earlier ones (executed in order).
-            cat.register(name, _execute_set(sub, cat))
-    return _execute_set(q, cat)
+            cat.register(name, _execute_set(_maybe_optimize(sub, cat),
+                                            cat))
+    return _execute_set(_maybe_optimize(q, cat), cat)
 
 
 def _execute_statement(sql: str, catalog=None):
@@ -2385,12 +2497,15 @@ def _execute_single(q: Query, cat):
         # the alias replaces the name when given (Spark scoping)
         scope[(q.view_alias or q.view).lower()] = \
             {c: c for c in frame.columns}
-    for view, how, keys, jalias in q.joins:
+    build_hints = list(getattr(q, "join_build", ()) or ())
+    for jidx, (view, how, keys, jalias) in enumerate(q.joins):
         right = (_execute_set(view.query, cat)
                  if isinstance(view, DerivedTable) else cat.lookup(view))
         rcols = list(right.columns)
         pre = set(frame.columns)
-        frame = frame.join(right, on=keys or None, how=how)
+        frame = frame.join(right, on=keys or None, how=how,
+                           build=(build_hints[jidx]
+                                  if jidx < len(build_hints) else None))
         name = jalias or (view if isinstance(view, str) else None)
         if name:
             post = set(frame.columns)

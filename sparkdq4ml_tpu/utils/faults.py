@@ -105,6 +105,7 @@ FAULT_SITES = {
     "serve_admit": ("breaker_trip", "oom"),
     "oom": ("oom",),
     "stats_persist": ("io_error", "torn_chunk"),
+    "optimizer": ("device_error",),
 }
 
 

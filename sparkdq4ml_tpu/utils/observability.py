@@ -167,6 +167,17 @@ METRIC_NAMES = {
     "serve.exec_ms": ("histogram", "execution wall per job"),
     "serve.e2e_ms": ("histogram", "client-experienced end-to-end "
                                   "latency"),
+    # cost-based plan optimizer (sql/optimizer.py + lowering hooks)
+    "optimizer.rewrite": ("counter", "plan rewrites applied"),
+    "optimizer.fallback": ("counter",
+                           "queries degraded to the unrewritten plan"),
+    "optimizer.split": ("counter",
+                        "mega-stage flushes split at a warm prefix"),
+    "optimizer.mem_chunk": ("counter",
+                            "flushes chunked by remembered byte bounds"),
+    "optimizer.dense_skip": ("counter",
+                             "grouped dense attempts skipped by miss "
+                             "history"),
     # plan-stats observatory (utils/statstore.py)
     "stats.record": ("counter", "flush observations recorded"),
     "stats.evict": ("counter", "stats entries evicted (maxEntries)"),

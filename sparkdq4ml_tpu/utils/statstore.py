@@ -128,6 +128,15 @@ class Digest:
                         if i < len(DIGEST_BUCKETS_MS) else self.max)
         return self.max
 
+    # Named quantile accessors — THE numbers the cost model
+    # (sql/optimizer.py, ops/compiler._split_point) and stats_report()
+    # both read, so bucket math is derived in exactly one place.
+    def p50(self) -> Optional[float]:
+        return self.quantile(0.5)
+
+    def p90(self) -> Optional[float]:
+        return self.quantile(0.9)
+
     def to_doc(self) -> dict:
         return {"counts": list(self.counts), "sum": self.sum,
                 "count": self.count, "max": self.max}
@@ -362,6 +371,45 @@ class StatStore:
             return None
         return int(round(sel * max(int(rows_in), 0)))
 
+    # -- cost model (the optimizer's read surface) -------------------------
+    def compile_ms_p50(self, key: str) -> Optional[float]:
+        """Median recorded trace+compile cost at ``key`` — the fused-
+        stage boundary-placement input (``ops/compiler._split_point``)."""
+        with self._lock:
+            ks = self._entries.get(key)
+            return ks.compile_ms.p50() if ks is not None else None
+
+    def wall_ms_p50(self, key: str) -> Optional[float]:
+        """Median recorded replay-dispatch cost at ``key``."""
+        with self._lock:
+            ks = self._entries.get(key)
+            return ks.wall_ms.p50() if ks is not None else None
+
+    def bytes_bound(self, key: str) -> Optional[int]:
+        """Remembered resident-byte bound at ``key``: the max of the
+        static flush estimate and the MEASURED peak, across sessions —
+        the memory-aware chunking input (arxiv 2206.14148 as a planned
+        decision, see ``ops/compiler.run_pipeline``)."""
+        with self._lock:
+            ks = self._entries.get(key)
+            if ks is None:
+                return None
+            bound = max(ks.est_bytes_max, ks.peak_bytes_max)
+            return bound or None
+
+    def record_miss(self, key: str) -> None:
+        """One planning miss at ``key`` (e.g. the grouped engine's dense
+        slot-table overflow): accumulates as a ``miss|``-prefixed entry
+        whose flush count is the evidence :meth:`miss_count` reads —
+        persisted like any entry, so the skip decision survives
+        sessions."""
+        self.record_flush(f"miss|{key}", "miss")
+
+    def miss_count(self, key: str) -> int:
+        with self._lock:
+            ks = self._entries.get(f"miss|{key}")
+            return ks.flushes if ks is not None else 0
+
     def entry(self, key: str) -> Optional[dict]:
         with self._lock:
             ks = self._entries.get(key)
@@ -389,8 +437,11 @@ class StatStore:
                     "rows_in": ks.rows_in, "rows_out": ks.rows_out,
                     "sel_observations": ks.sel_observations,
                     "wall_ms_mean": ks.wall_ms.mean(),
+                    "wall_ms_p50": ks.wall_ms.p50(),
+                    "wall_ms_p90": ks.wall_ms.p90(),
                     "wall_ms_p99": ks.wall_ms.quantile(0.99),
                     "compile_ms_mean": ks.compile_ms.mean(),
+                    "compile_ms_p50": ks.compile_ms.p50(),
                     "host_syncs": ks.host_syncs,
                     "est_bytes_max": ks.est_bytes_max,
                     "peak_bytes_max": ks.peak_bytes_max,

@@ -1,0 +1,556 @@
+"""Cost-based plan optimizer suite (ISSUE 14, tier-1, ``optimizer``
+marker).
+
+The acceptance surface:
+
+* **parity across the SQL surface** — filters, joins (both build
+  sides), GROUP BY, CTEs, set ops, LIMIT/OFFSET each pinned EQUAL with
+  ``spark.optimizer.enabled`` on vs off (exact column equality for the
+  order-preserving level-1 rewrites; sorted-row equality for the
+  level-2 join reorder, where SQL imposes no order), plus sharded-mode
+  (``spark.shard.enabled``) parity on the join paths;
+* **EXPLAIN** — the before/after plan diff and per-rewrite annotations
+  render with ZERO execution (compile/flush/sync counters pinned), and
+  ``build=left`` hints show on Join nodes;
+* **degradation** — the ``optimizer`` fault site degrades to the
+  unrewritten plan (recovery event + ``optimizer.fallback``), results
+  unchanged;
+* **lowering hooks** — the compiler's warm-prefix stage split
+  (``optimizer.split``), the statstore-informed planned memory chunking
+  (``optimizer.mem_chunk``), and the grouped engine's dense-skip
+  (``optimizer.dense_skip``), each parity-asserted;
+* **cost-model glue** — ``Digest.p50/p90`` are THE quantile accessors
+  (stats_report and the cost model read the same numbers),
+  ``bytes_bound``/``miss_count``;
+* **satellite** — history-informed ``est_rows`` propagates through
+  With/SetOps wrapper nodes (a Scan of a CTE name resolves against the
+  CTE body's estimate instead of going ``-``).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import sparkdq4ml_tpu as dq
+from sparkdq4ml_tpu.config import config
+from sparkdq4ml_tpu.frame.frame import Frame
+from sparkdq4ml_tpu.ops import compiler
+from sparkdq4ml_tpu.parallel import mesh as pmesh
+from sparkdq4ml_tpu.parallel import shard
+from sparkdq4ml_tpu.sql import optimizer as opt
+from sparkdq4ml_tpu.utils import faults, observability as obs
+from sparkdq4ml_tpu.utils import profiling, statstore
+from sparkdq4ml_tpu.utils.recovery import RECOVERY_LOG
+from sparkdq4ml_tpu.utils.statstore import Digest
+
+from conftest import dataset_path, prepare_features, run_dq_pipeline
+
+pytestmark = pytest.mark.optimizer
+
+
+@pytest.fixture(autouse=True)
+def _clean_optimizer_state():
+    saved = (config.optimizer_enabled, config.optimizer_level,
+             config.audit_device_budget)
+    statstore.STORE.clear()
+    compiler.clear_cache()
+    faults.clear()
+    RECOVERY_LOG.clear()
+    profiling.counters.clear("optimizer.")
+    yield
+    (config.optimizer_enabled, config.optimizer_level,
+     config.audit_device_budget) = saved
+    statstore.STORE.clear()
+    compiler.clear_cache()
+    faults.clear()
+    RECOVERY_LOG.clear()
+    profiling.counters.clear("optimizer.")
+    # EXPLAIN ANALYZE's query_stats window records into the process
+    # tracer buffer; flush it so span-inspecting suites later in the
+    # run never see this file's spans
+    obs.TRACER.clear()
+
+
+def _register(session, n=4000, seed=3, shard_frames=False):
+    """The suite's relations: a fact table, a full dim, a partial dim
+    (64 of 128 keys), and a string-keyed pair."""
+    rng = np.random.default_rng(seed)
+    big = Frame({"k": rng.integers(0, 128, n).astype(np.float64),
+                 "v": rng.normal(size=n),
+                 "x1": rng.normal(size=n), "x2": rng.normal(size=n)})
+    mid = Frame({"k": np.arange(128).astype(np.float64),
+                 "u": rng.normal(size=128)})
+    small = Frame({"k": np.arange(64).astype(np.float64),
+                   "w": rng.normal(size=64)})
+    if shard_frames:
+        big = shard.maybe_shard_frame(big)
+        mid = shard.maybe_shard_frame(mid)
+    for name, f in (("big", big), ("mid", mid), ("small", small)):
+        f.create_or_replace_temp_view(name)
+    return big, mid, small
+
+
+def _exec(session, sql):
+    out = session.sql(sql)
+    jax.block_until_ready(out._mask)
+    return out.to_pydict()
+
+
+def _pair(session, sql, level=1):
+    """(off, on) result dicts for one statement."""
+    config.optimizer_level = level
+    config.optimizer_enabled = False
+    off = _exec(session, sql)
+    config.optimizer_enabled = True
+    on = _exec(session, sql)
+    return off, on
+
+
+def _assert_exact(off, on):
+    assert list(off) == list(on)
+    for c in off:
+        np.testing.assert_array_equal(np.asarray(off[c]),
+                                      np.asarray(on[c]),
+                                      err_msg=f"column {c!r}")
+
+
+def _assert_sorted(off, on):
+    assert sorted(off) == sorted(on)
+    cols = sorted(off)
+    a = np.array([np.asarray(off[c], dtype=np.float64) for c in cols])
+    b = np.array([np.asarray(on[c], dtype=np.float64) for c in cols])
+    assert a.shape == b.shape
+    np.testing.assert_array_equal(a[:, np.lexsort(a[::-1])],
+                                  b[:, np.lexsort(b[::-1])])
+
+
+# ---------------------------------------------------------------------------
+# Parity across the SQL surface (optimizer on vs off)
+# ---------------------------------------------------------------------------
+
+
+class TestParity:
+    def test_plain_filter(self, session):
+        _register(session)
+        off, on = _pair(session,
+                        "SELECT v, x1 FROM big WHERE v < 0 AND k > 5")
+        _assert_exact(off, on)
+
+    def test_join_pushdown_both_sides(self, session):
+        _register(session)
+        off, on = _pair(
+            session,
+            "SELECT k, v, u FROM big JOIN mid USING (k) "
+            "WHERE v < -0.5 AND u > 0")
+        _assert_exact(off, on)
+        assert len(off["k"]) > 0
+
+    def test_join_build_side_small_left(self, session):
+        _register(session)
+        off, on = _pair(session,
+                        "SELECT k, w, v FROM small JOIN big USING (k)")
+        _assert_exact(off, on)
+        plan = _exec(session,
+                     "EXPLAIN SELECT k, w, v FROM small JOIN big "
+                     "USING (k)")["plan"][0]
+        assert "build=left" in plan
+
+    def test_left_join_pushes_left_only(self, session):
+        _register(session)
+        sql = ("SELECT k, v, w FROM big LEFT JOIN small USING (k) "
+               "WHERE v < -0.5 AND x1 > 0")
+        off, on = _pair(session, sql)
+        _assert_exact(off, on)
+        plan = _exec(session, "EXPLAIN " + sql)["plan"][0]
+        # left-side conjuncts pushed; the LEFT join's right side is NOT
+        # a pushdown target (null-extension semantics)
+        assert "pushdown" in plan
+        assert "Scan[small]\n" in plan + "\n"
+
+    def test_group_by_over_join(self, session):
+        _register(session)
+        off, on = _pair(
+            session,
+            "SELECT k, count(*) c, sum(v) s FROM big JOIN small "
+            "USING (k) WHERE v < 0.5 GROUP BY k ORDER BY k")
+        _assert_exact(off, on)
+
+    def test_cte(self, session):
+        _register(session)
+        off, on = _pair(
+            session,
+            "WITH f AS (SELECT k, v FROM big WHERE v < 0) "
+            "SELECT k, v, u FROM f JOIN mid USING (k) WHERE u > 0")
+        _assert_exact(off, on)
+
+    def test_set_ops(self, session):
+        _register(session)
+        off, on = _pair(
+            session,
+            "SELECT k FROM big WHERE v < -1 UNION "
+            "SELECT k FROM small WHERE w > 0")
+        _assert_exact(off, on)
+
+    def test_limit_offset(self, session):
+        _register(session)
+        off, on = _pair(
+            session,
+            "SELECT k, v, u FROM big JOIN mid USING (k) "
+            "WHERE v < 0 ORDER BY v LIMIT 7 OFFSET 2")
+        _assert_exact(off, on)
+        assert len(off["k"]) == 7
+
+    def test_collision_column_referenced_only_via_alias(self, session):
+        # x exists on BOTH sides but is referenced only as b.x: pruning
+        # must keep the collision twin so the output stays named x_right
+        a = Frame({"k": np.arange(8).astype(np.float64),
+                   "x": np.arange(8) * 1.0,
+                   "junk": np.arange(8) * 3.0})
+        b = Frame({"k": np.arange(8).astype(np.float64),
+                   "x": np.arange(8) * 2.0})
+        a.create_or_replace_temp_view("ca")
+        b.create_or_replace_temp_view("cb")
+        off, on = _pair(session,
+                        "SELECT b.x, a.k FROM ca a JOIN cb b USING (k)")
+        assert list(off) == list(on) == ["x_right", "k"]
+        _assert_exact(off, on)
+
+    def test_joined_derived_table_inner_rewrites_apply(self, session):
+        _register(session)
+        sql = ("SELECT big.k, v, sw FROM big JOIN "
+               "(SELECT s.k, w AS sw FROM small s JOIN mid USING (k) "
+               "WHERE u > 0) sub USING (k) WHERE v < 0")
+        off, on = _pair(session, sql)
+        _assert_exact(off, on)
+        config.optimizer_enabled = True
+        plan = _exec(session, "EXPLAIN " + sql)["plan"][0]
+        # the inner join's pushdown lands in the AFTER tree, not just
+        # the rewrite list (regression: joins_out discarded the
+        # recursively optimized derived-table entry)
+        after = plan.split("== Before Optimization ==")[0]
+        assert "pushdown: (u > 0) -> Scan[mid]" in plan
+        assert after.count("Scan[(subquery)]") >= 2
+
+    def test_string_key_join(self, session):
+        left = Frame({"s": np.asarray(["a", "b", "c", "b"], object),
+                      "v": [1.0, 2.0, 3.0, 4.0]})
+        right = Frame({"s": np.asarray(["b", "c", "d"], object),
+                       "w": [10.0, 20.0, 30.0]})
+        left.create_or_replace_temp_view("ls")
+        right.create_or_replace_temp_view("rs")
+        off, on = _pair(session,
+                        "SELECT s, v, w FROM ls JOIN rs USING (s)")
+        _assert_exact(off, on)
+
+    def test_join_reorder_level2(self, session):
+        _register(session)
+        sql = ("SELECT v, u, w FROM big JOIN mid USING (k) "
+               "JOIN small USING (k) WHERE v < 0")
+        off, on = _pair(session, sql, level=2)
+        _assert_sorted(off, on)
+        config.optimizer_enabled = True
+        config.optimizer_level = 2
+        plan = _exec(session, "EXPLAIN " + sql)["plan"][0]
+        assert "join-reorder" in plan
+
+    def test_headline_golden_unchanged(self, session):
+        from sparkdq4ml_tpu.models import LinearRegression
+
+        results = {}
+        for arm in (False, True):
+            config.optimizer_enabled = arm
+            config.optimizer_level = 2
+            df = run_dq_pipeline(session, dataset_path("abstract"))
+            count = df.count()
+            model = LinearRegression(max_iter=40, reg_param=1.0,
+                                     elastic_net_param=1.0).fit(
+                prepare_features(df))
+            results[arm] = (count,
+                            float(model.summary.root_mean_squared_error))
+        assert results[False][0] == results[True][0] == 24
+        assert results[False][1] == results[True][1]
+        assert results[True][1] == pytest.approx(2.809940, rel=1e-3)
+
+
+# ---------------------------------------------------------------------------
+# EXPLAIN: before/after diff, zero execution, annotations
+# ---------------------------------------------------------------------------
+
+
+class TestExplain:
+    SQL = ("SELECT k, v, u FROM big JOIN mid USING (k) "
+           "WHERE v < -0.5 AND u > 0")
+
+    def test_diff_renders_with_zero_execution(self, session):
+        _register(session)
+        config.optimizer_enabled = True
+        before = profiling.counters.snapshot()
+        frame = session.sql("EXPLAIN " + self.SQL)
+        after = profiling.counters.snapshot()
+        plan = frame.to_pydict()["plan"][0]   # the read is outside the
+        #                                       zero-execution window
+        for key in ("pipeline.flush", "pipeline.compile",
+                    "grouped.compile", "frame.host_sync"):
+            assert after.get(key, 0) == before.get(key, 0), key
+        assert "== Rewrites ==" in plan
+        assert "== Before Optimization ==" in plan
+        assert "pushdown" in plan and "prune" in plan
+        # the optimized tree shows the pushed filter under the scan
+        assert "Scan[(subquery)]" in plan
+
+    def test_disabled_mode_renders_literal_plan(self, session):
+        _register(session)
+        config.optimizer_enabled = False
+        plan = _exec(session, "EXPLAIN " + self.SQL)["plan"][0]
+        assert "== Rewrites ==" not in plan
+        assert "Scan[big]" in plan
+
+    def test_explain_analyze_executes_optimized_plan(self, session):
+        _register(session)
+        config.optimizer_enabled = True
+        plan = _exec(session, "EXPLAIN ANALYZE " + self.SQL)["plan"][0]
+        assert "== Rewrites ==" in plan
+        assert "== Query Stats ==" in plan
+
+
+# ---------------------------------------------------------------------------
+# Degradation ladder + disabled-mode contract
+# ---------------------------------------------------------------------------
+
+
+class TestDegradation:
+    def test_fault_degrades_to_unrewritten_plan(self, session):
+        _register(session)
+        sql = "SELECT k, v, u FROM big JOIN mid USING (k) WHERE v < 0"
+        config.optimizer_enabled = False
+        ref = _exec(session, sql)
+        config.optimizer_enabled = True
+        faults.install_plan(faults.parse_plan("optimizer:device_error:1"))
+        before = profiling.counters.get("optimizer.fallback")
+        got = _exec(session, sql)
+        _assert_exact(ref, got)
+        assert profiling.counters.get("optimizer.fallback") == before + 1
+        assert any(getattr(e, "site", None) == "optimizer"
+                   and getattr(e, "action", None) == "fallback"
+                   for e in RECOVERY_LOG.events())
+
+    def test_disabled_applies_no_rewrites(self, session):
+        _register(session)
+        config.optimizer_enabled = False
+        before = profiling.counters.get("optimizer.rewrite")
+        _exec(session,
+              "SELECT k, v, u FROM big JOIN mid USING (k) WHERE v < 0")
+        assert profiling.counters.get("optimizer.rewrite") == before
+
+    def test_session_conf_scoping(self):
+        s = dq.TpuSession.builder().app_name("opt-conf").master(
+            "local[*]").config("spark.optimizer.enabled", "false").config(
+            "spark.optimizer.level", "2").get_or_create()
+        try:
+            assert config.optimizer_enabled is False
+            assert config.optimizer_level == 2
+        finally:
+            s.stop()
+        assert config.optimizer_enabled is True
+        assert config.optimizer_level == 1
+
+
+# ---------------------------------------------------------------------------
+# Lowering hooks
+# ---------------------------------------------------------------------------
+
+
+class TestLoweringHooks:
+    def _chain(self, f, steps, tail_col=None):
+        for i in range(steps):
+            src = tail_col if tail_col and i >= steps // 2 else "v"
+            f = f.with_column(f"c{i}", dq.col(src) * float(i + 1) + 0.5)
+        return f
+
+    def test_stage_split_at_warm_prefix(self, monkeypatch):
+        monkeypatch.setattr(compiler, "_SPLIT_MIN_COMPILE_MS", 0.0)
+        config.optimizer_enabled = True
+        rng = np.random.default_rng(0)
+        f = Frame({"v": rng.normal(size=256),
+                   "y": rng.normal(size=256)})
+        # reference result, literal mega-stage (level 1: no split)
+        config.optimizer_level = 1
+        ref = self._chain(f, 12, "y")
+        jax.block_until_ready(ref._mask)
+        ref_col = np.asarray(ref._data["c11"])
+        compiler.clear_cache()
+        statstore.STORE.clear()
+        # warm the 6-step prefix, then flush the 12-step chain at level 2
+        config.optimizer_level = 2
+        warm = self._chain(f, 6)
+        jax.block_until_ready(warm._mask)
+        before = profiling.counters.get("optimizer.split")
+        out = self._chain(f, 12, "y")
+        jax.block_until_ready(out._mask)
+        assert profiling.counters.get("optimizer.split") == before + 1
+        np.testing.assert_array_equal(np.asarray(out._data["c11"]),
+                                      ref_col)
+
+    def test_planned_memory_chunking_from_history(self):
+        config.optimizer_enabled = True
+        config.optimizer_level = 1
+        rng = np.random.default_rng(1)
+        f = Frame({"v": rng.normal(size=64)})
+        out = f.with_column("d", dq.col("v") * 2.0)
+        jax.block_until_ready(out._mask)
+        ref = np.asarray(out._data["d"])
+        entries = compiler.cache_stats()["entries"]
+        assert len(entries) == 1
+        key = entries[0]["program_key"]
+        # remembered peak far over the budget, static estimate far under
+        statstore.STORE.record_flush(key, "pipeline", est_bytes=1 << 40)
+        config.audit_device_budget = 1 << 20
+        before = profiling.counters.get("pipeline.oom_chunked")
+        mem0 = profiling.counters.get("optimizer.mem_chunk")
+        out2 = f.with_column("d", dq.col("v") * 2.0)
+        jax.block_until_ready(out2._mask)
+        assert profiling.counters.get("optimizer.mem_chunk") == mem0 + 1
+        assert profiling.counters.get("pipeline.oom_chunked") == before + 1
+        np.testing.assert_array_equal(np.asarray(out2._data["d"]), ref)
+
+    def test_grouped_dense_skip_from_miss_history(self):
+        config.optimizer_enabled = True
+        rng = np.random.default_rng(2)
+        vals = rng.normal(size=32)
+
+        # key range 0..1e9 overflows the dense table at 32 rows -> the
+        # dense attempt misses and reroutes; two misses teach the skip
+        def grouped():
+            f = Frame({"k": np.asarray([0.0, 1e9] * 16), "v": vals})
+            return f.group_by("k").agg({"v": "sum"}).to_pydict()
+
+        ref = grouped()
+        grouped()
+        before = profiling.counters.get("optimizer.dense_skip")
+        miss0 = profiling.counters.get("grouped.dense_miss")
+        got = grouped()
+        assert profiling.counters.get("optimizer.dense_skip") == before + 1
+        assert profiling.counters.get("grouped.dense_miss") == miss0
+        _assert_exact(ref, got)
+
+
+# ---------------------------------------------------------------------------
+# Cost-model glue (statstore satellites)
+# ---------------------------------------------------------------------------
+
+
+class TestCostModel:
+    def test_digest_p50_p90(self):
+        d = Digest()
+        assert d.p50() is None and d.p90() is None
+        for v in (0.2, 0.2, 4.0, 90.0):
+            d.observe(v)
+        assert d.p50() == d.quantile(0.5)
+        assert d.p90() == d.quantile(0.9)
+        assert d.p50() <= d.p90()
+
+    def test_report_reads_the_same_accessors(self):
+        store = statstore.StatStore()
+        store.record_flush("k1", "pipeline", wall_ms=3.0)
+        store.record_flush("k1", "pipeline", wall_ms=40.0, compiled=True)
+        row = store.report(drain=False)["entries"][0]
+        with store._lock:
+            ks = store._entries["k1"]
+            assert row["wall_ms_p50"] == ks.wall_ms.p50()
+            assert row["wall_ms_p90"] == ks.wall_ms.p90()
+            assert row["compile_ms_p50"] == ks.compile_ms.p50()
+        assert store.compile_ms_p50("k1") == row["compile_ms_p50"]
+        assert store.wall_ms_p50("k1") == row["wall_ms_p50"]
+
+    def test_bytes_bound_and_miss_count(self):
+        store = statstore.StatStore()
+        assert store.bytes_bound("nope") is None
+        store.record_flush("k1", "pipeline", est_bytes=100,
+                           peak_bytes=900)
+        assert store.bytes_bound("k1") == 900
+        assert store.miss_count("g") == 0
+        store.record_miss("g")
+        store.record_miss("g")
+        assert store.miss_count("g") == 2
+
+
+# ---------------------------------------------------------------------------
+# est_rows through With/SetOps wrappers (satellite)
+# ---------------------------------------------------------------------------
+
+
+class TestEstRowsWrappers:
+    def test_cte_scan_resolves_body_estimate(self, session):
+        _register(session)
+        # teach the store the filter's selectivity, then EXPLAIN a CTE
+        _exec(session, "SELECT v FROM big WHERE v < -1.0")
+        statstore.STORE.drain_pending()
+        plan = _exec(
+            session,
+            "EXPLAIN WITH c AS (SELECT v FROM big WHERE v < -1.0) "
+            "SELECT v FROM c LIMIT 5")["plan"][0]
+        scan_line = next(ln for ln in plan.splitlines()
+                         if "Scan[c]" in ln)
+        assert "est_rows=-" not in scan_line
+        assert "est_rows=" in scan_line
+        with_line = next(ln for ln in plan.splitlines()
+                         if ln.startswith("With["))
+        assert "est_rows=5" in with_line        # LIMIT bound propagated
+
+    def test_setops_branches_annotated(self, session):
+        _register(session)
+        plan = _exec(
+            session,
+            "EXPLAIN SELECT v FROM big UNION ALL "
+            "SELECT w FROM small")["plan"][0]
+        setops_line = next(ln for ln in plan.splitlines()
+                           if ln.startswith("SetOps["))
+        assert "est_rows=4064" in setops_line   # 4000 + 64, static slots
+
+
+# ---------------------------------------------------------------------------
+# Sharded-mode parity on the join paths (satellite)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8,
+                    reason="needs the conftest's 8 forced host devices")
+class TestShardedParity:
+    @contextlib.contextmanager
+    def _sharding(self, min_rows=8):
+        saved = (config.shard_enabled, config.shard_min_rows,
+                 config.shard_devices)
+        config.shard_enabled = True
+        config.shard_min_rows = min_rows
+        config.shard_devices = 0
+        shard.configure(pmesh.make_mesh())
+        try:
+            yield
+        finally:
+            (config.shard_enabled, config.shard_min_rows,
+             config.shard_devices) = saved
+            shard.reset()
+
+    def test_sharded_join_pushdown_parity(self, session):
+        with self._sharding():
+            _register(session, shard_frames=True)
+            off, on = _pair(
+                session,
+                "SELECT k, v, u FROM big JOIN mid USING (k) "
+                "WHERE v < -0.5")
+            _assert_exact(off, on)
+
+    def test_sharded_join_reorder_parity(self, session):
+        with self._sharding():
+            _register(session, shard_frames=True)
+            off, on = _pair(
+                session,
+                "SELECT v, u, w FROM big JOIN mid USING (k) "
+                "JOIN small USING (k) WHERE v < 0", level=2)
+            _assert_sorted(off, on)
