@@ -1165,6 +1165,108 @@ def bench_optimizer(session, log):
     return section
 
 
+def bench_costprof(session, log):
+    """(costprof) Device-cost observatory (utils/costprof.py +
+    analysis/program/costs.py): AOT extraction latency per plan class
+    (one lower+compile per cached program, amortized by the per-key
+    cache + statstore persistence), report-render cost once warm, and
+    the overhead-when-disabled pin — with spark.costprof.enabled=false
+    the hot path pays one flag read, so the disabled-vs-never-loaded
+    flush delta must be ~0 (reported as a ratio, gated by eye + the
+    test-suite pin, not the regress gate: sub-ms deltas are noise).
+
+    Chip-independence: extraction cost is host-side XLA compile time;
+    the extracted flop/byte figures are the compiler's static
+    accounting. Only the ACHIEVED gflops/gbps joins need real silicon."""
+    import time as _time
+
+    import jax
+    import numpy as np
+
+    import sparkdq4ml_tpu as dq
+    from sparkdq4ml_tpu.config import config
+    from sparkdq4ml_tpu.frame.frame import Frame
+    from sparkdq4ml_tpu.utils import costprof
+    from sparkdq4ml_tpu.utils import observability as _obs
+
+    n = 100_000 if SMOKE else 1_000_000
+    rng = np.random.default_rng(23)
+    section = {"rows": n}
+    saved = config.costprof_enabled
+
+    def flush(f):
+        jax.block_until_ready(f._mask)
+        return f
+
+    def chain(f):
+        for i in range(8):
+            f = f.with_column(f"c{i}", dq.col("v") * float(i + 1) + 0.25)
+        return f.filter(dq.col("c7") > 0)
+
+    frame = Frame({"v": rng.normal(size=n),
+                   "k": rng.integers(0, 64, n).astype(np.float64)})
+    try:
+        # populate the caches the extractor will sweep: a fused
+        # pipeline plan + a grouped plan
+        from sparkdq4ml_tpu.frame import aggregates as A
+
+        flush(chain(frame))
+        frame.group_by("k").agg(A.sum("v"))
+
+        # (overhead-when-disabled) steady-state flush wall with the
+        # observatory off vs on — the hot path carries no costprof
+        # hook, so this pins the one-flag-read contract at ~1.0
+        def steady_flush():
+            t0 = _time.perf_counter()
+            flush(chain(frame))
+            return (_time.perf_counter() - t0) * 1e3
+
+        steady_flush()                      # warm
+        config.costprof_enabled = False
+        off = sorted(steady_flush() for _ in range(5))[2]
+        config.costprof_enabled = True
+        on = sorted(steady_flush() for _ in range(5))[2]
+        section["disabled_flush_ms"] = round(off, 3)
+        section["enabled_flush_ms"] = round(on, 3)
+        section["disabled_overhead"] = round(on / off, 3) if off else None
+
+        # (extraction latency per plan class) fresh profile cache; one
+        # timed extract_all sweep, split per producer cache
+        costprof.clear()
+        handles, _errors = _obs.CACHES.programs()
+        by_cache: dict = {}
+        for h in handles:
+            t0 = _time.perf_counter()
+            prof = costprof.profile_for(h.program_key)
+            dt = (_time.perf_counter() - t0) * 1e3
+            row = by_cache.setdefault(
+                h.cache, {"programs": 0, "profiled": 0,
+                          "extract_ms": 0.0})
+            row["programs"] += 1
+            if prof is not None:
+                row["profiled"] += 1
+                row["extract_ms"] += dt
+        for cache, row in sorted(by_cache.items()):
+            row["extract_ms"] = round(row["extract_ms"], 3)
+            entry = {"config": f"costprof_extract_{cache}", **row}
+            log(json.dumps(entry))
+        section["extract"] = by_cache
+
+        # (report render) warm-cache fleet report cost
+        t0 = _time.perf_counter()
+        doc = costprof.report()
+        section["report_ms"] = round((_time.perf_counter() - t0) * 1e3, 3)
+        section["profiles"] = doc["size"]
+        section["pending"] = doc["pending"]
+        log(json.dumps({"config": "costprof_report",
+                        "report_ms": section["report_ms"],
+                        "profiles": section["profiles"],
+                        "disabled_overhead": section["disabled_overhead"]}))
+    finally:
+        config.costprof_enabled = saved
+    return section
+
+
 def _acquire_bench_lock(wait_s: float = 1200.0):
     """Serialize bench runs across processes via an exclusive flock.
 
@@ -1676,6 +1778,10 @@ def main():
     # boundary arms, off-vs-on, parity-asserted, golden-pinned
     optimizer_sec = bench_optimizer(session, log)
 
+    # (costprof) device-cost observatory: extraction latency per plan
+    # class, report-render cost, overhead-when-disabled pinned ~0
+    costprof_sec = bench_costprof(session, log)
+
     # (e) baseline: sklearn GridSearchCV, same 3x3 grid / folds / family,
     # refit=True to match the in-program best-model refit
     t_e_cpu = None
@@ -1863,6 +1969,7 @@ def main():
         "serving": serving,
         "sharded": sharded,
         "optimizer": optimizer_sec,
+        "costprof": costprof_sec,
         "sweep": sweep_rows,
         "pallas_max_rel_diff": max((float(d) for _, d in pallas_diffs),
                                    default=None),

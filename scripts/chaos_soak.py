@@ -92,6 +92,10 @@ _CANDIDATES = (
     # the cost-based optimizer's ladder: a planning fault degrades the
     # query to its unrewritten parse shape, never fails or changes it
     ("optimizer", "device_error", 0.25, ""),
+    # the device-cost observatory's ladder: an extraction fault leaves
+    # that plan unprofiled ("-" on every surface) — /profile keeps
+    # answering (the scraper below asserts zero scrape failures)
+    ("cost_profile", "device_error", 0.30, ""),
 )
 
 
@@ -111,6 +115,7 @@ _ROTATION = (
     ("stats_persist", "io_error", ""),
     ("stats_persist", "torn_chunk", ""),
     ("optimizer", "device_error", ""),
+    ("cost_profile", "device_error", ""),
 )
 
 
@@ -222,6 +227,7 @@ class _Scraper:
         self.failures: list[str] = []
         self.last_metrics: dict = {}
         self.last_health: dict = {}
+        self.last_profile: dict = {}
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="chaos-scraper")
@@ -235,6 +241,13 @@ class _Scraper:
         with urllib.request.urlopen(self.base + "/healthz",
                                     timeout=10) as resp:
             self.last_health = json.loads(resp.read().decode())
+        # the device-cost observatory under fire: /profile must keep
+        # answering (budgeted extraction; injected cost_profile faults
+        # degrade single plans to unprofiled, never the route) — a
+        # 30 s timeout bounds the budgeted lower+compile sweep
+        with urllib.request.urlopen(self.base + "/profile?top=8",
+                                    timeout=30) as resp:
+            self.last_profile = json.loads(resp.read().decode())
         self.scrapes += 1
 
     def _loop(self) -> None:
@@ -410,6 +423,8 @@ def run_seed(session, seed: int, clients: int, queries: int, workers: int,
             f"first: {scraper.failures[0]}")
     if not scraper.last_health.get("status"):
         violations.append("healthz never answered with a status verdict")
+    if scraper.last_profile.get("enabled") is None:
+        violations.append("/profile never answered with a schema verdict")
     server.stop(drain=True)
     delta = {k: v - before.get(k, 0)
              for k, v in profiling.counters.snapshot().items()

@@ -16,6 +16,7 @@ from .logger_ns import LoggerNamespaceRule
 from .metric_names import MetricNameRule
 from .noop import NoopContractRule
 from .numpy_free import NumpyFreeRule
+from .program_handles import ProgramHandleRule
 
 #: Instantiation order = report order; every rule runs in the tier-1 gate.
 ALL_RULES = (
@@ -26,6 +27,7 @@ ALL_RULES = (
     LockOrderRule,
     FaultSiteRule,
     MetricNameRule,
+    ProgramHandleRule,
     LoggerNamespaceRule,
     NumpyFreeRule,
 )

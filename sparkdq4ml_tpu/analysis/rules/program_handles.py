@@ -1,0 +1,117 @@
+"""Rule ``program-handle`` — no silently unprofilable program caches.
+
+The program auditor (dqaudit) and the device-cost observatory
+(``utils/costprof.py``) both consume ``observability.CACHES.programs()``:
+every compiled-program cache must register an enumerator whose
+:class:`~...utils.observability.ProgramHandle` records carry a traceable,
+UN-counted body (``trace_body``) — that body is what gets abstractly
+re-traced by the auditor and AOT lower+compiled by the cost extractor.
+Two ways a producer silently drops out of both surfaces:
+
+1. **Stats without programs**: a module calls ``CACHES.register(name,
+   stats_fn)`` but never ``CACHES.register_programs`` — the cache shows
+   up in ``cache_report()`` yet none of its programs can be audited or
+   cost-profiled. Flagged per module (receiver-qualified on the
+   ``CACHES`` chain tail, so an unrelated registry cannot trip it).
+
+2. **The counted entry instead of the trace body**: a
+   ``ProgramHandle(...)`` construction whose ``fn`` argument is missing,
+   a literal ``None``, or an attribute access ending in ``.fn`` — the
+   producers' convention is that ``.fn`` is the COUNTED jitted dispatch
+   entry (replay verdicts + compile counters hang off it), while the
+   handle must carry the raw body (``.trace_body`` / the un-wrapped
+   callable): auditing through ``.fn`` distorts the very statistics the
+   observatory reads (phantom compiles, fake replay hits).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import Finding, Rule, SourceFile, attr_chain
+
+#: receiver-chain tails that qualify a CACHES registration call
+_REGISTRY_TAILS = ("CACHES",)
+
+
+class ProgramHandleRule(Rule):
+    name = "program-handle"
+    description = ("every CACHES.register(...) producer module must also"
+                   " register_programs(...), and every ProgramHandle must"
+                   " carry a traceable UN-counted body (not the counted"
+                   " .fn entry) — an unprofilable cache is invisible to"
+                   " dqaudit and the device-cost observatory")
+
+    def visit(self, src: SourceFile):
+        out = []
+        registers = []              # CACHES.register(...) call nodes
+        has_programs = False
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if isinstance(f, ast.Attribute):
+                chain = attr_chain(f.value)
+                tail = chain.split(".")[-1] if chain else ""
+                if tail in _REGISTRY_TAILS:
+                    if f.attr == "register":
+                        registers.append(node)
+                    elif f.attr == "register_programs":
+                        has_programs = True
+            if self._is_handle_ctor(node):
+                bad = self._bad_fn_arg(node)
+                if bad is not None:
+                    finding = src.finding(self.name, node, bad)
+                    if finding:
+                        out.append(finding)
+        if registers and not has_programs:
+            for node in registers:
+                finding = src.finding(
+                    self.name, node,
+                    "CACHES.register(...) without a matching"
+                    " CACHES.register_programs(...) in this module —"
+                    " the cache's programs cannot be audited (dqaudit)"
+                    " or cost-profiled (utils/costprof): register an"
+                    " enumerator yielding ProgramHandle records with"
+                    " their un-counted trace bodies")
+                if finding:
+                    out.append(finding)
+        return out
+
+    @staticmethod
+    def _is_handle_ctor(node: ast.Call) -> bool:
+        f = node.func
+        if isinstance(f, ast.Name):
+            return f.id == "ProgramHandle"
+        if isinstance(f, ast.Attribute):
+            return f.attr == "ProgramHandle"
+        return False
+
+    @staticmethod
+    def _bad_fn_arg(node: ast.Call):
+        """None = fine; else the finding message for a missing/None/
+        counted-entry ``fn`` argument (signature:
+        ``ProgramHandle(cache, program_key, fn, ...)``)."""
+        fn_arg = None
+        if len(node.args) >= 3:
+            fn_arg = node.args[2]
+        else:
+            for kw in node.keywords:
+                if kw.arg == "fn":
+                    fn_arg = kw.value
+                    break
+        if fn_arg is None:
+            return ("ProgramHandle(...) without an fn argument — the"
+                    " handle is untraceable: pass the producer's"
+                    " un-counted trace body")
+        if isinstance(fn_arg, ast.Constant) and fn_arg.value is None:
+            return ("ProgramHandle(..., fn=None) — the handle is"
+                    " untraceable: pass the producer's un-counted trace"
+                    " body")
+        if isinstance(fn_arg, ast.Attribute) and fn_arg.attr == "fn":
+            return ("ProgramHandle fn argument is the COUNTED '.fn'"
+                    " dispatch entry — auditing/cost-extracting through"
+                    " it distorts compile counters and replay verdicts;"
+                    " hand over '.trace_body' (the raw un-counted"
+                    " program)")
+        return None

@@ -59,6 +59,9 @@ CONF_KEYS = {
     "spark.shard.enabled": "session",
     "spark.shard.minRows": "session",
     "spark.shard.devices": "session",
+    "spark.costprof.enabled": "session",
+    "spark.costprof.ridge": "session",
+    "spark.profiling.maxCaptures": "session",
     "spark.observability.enabled": "init",
     "spark.observability.maxSpans": "init",
     "spark.observability.logSpans": "init",
@@ -222,6 +225,25 @@ class _Config:
     # Cap on the shard device count (spark.shard.devices); 0 = the whole
     # session mesh.
     shard_devices: int = 0
+    # Device-cost observatory (utils/costprof.py + analysis/program/
+    # costs.py): AOT cost-analysis extraction over every cached program,
+    # roofline verdicts in EXPLAIN ANALYZE, shard-skew/exchange-volume
+    # accounting, and the /profile telemetry route. Extraction runs
+    # lazily on cold surfaces only (report/EXPLAIN/save/scrape);
+    # spark.costprof.enabled=false reduces every hook to one flag read
+    # and restores byte-identical PR-14 EXPLAIN output.
+    costprof_enabled: bool = True
+    # Roofline ridge point in FLOPs per byte accessed
+    # (spark.costprof.ridge): an operator whose arithmetic intensity is
+    # at or above this is verdicted compute-bound, below it
+    # memory-bound. The default 8 is a generic accelerator-class ridge;
+    # calibrate per chip from a TPU capture (the CPU-sandbox verdicts
+    # are structural, not absolute — see README).
+    costprof_ridge: float = 8.0
+    # Bounded retention of managed jax-profiler captures
+    # (spark.profiling.maxCaptures): utils/profiling.start_capture
+    # prunes the oldest capture directories past this count.
+    profiling_max_captures: int = 4
     # Pallas fast-path selection for the hot ops (ops/pallas_kernels.py):
     # the single-device Gramian in solvers.augmented_gram and the fused DQ
     # chain entry point ops/rules.py:dq_rules_fused. "off" = plain XLA

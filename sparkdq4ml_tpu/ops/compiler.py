@@ -916,7 +916,8 @@ def _run_chunked(plan, lit_values, data: dict, mask, n: int,
     pieces_extras: dict[str, list] = {}
     bucket_counts: dict[int, int] = {}
     with _obs.span("frame.pipeline.flush", cat="frame", rows=n, bucket=m,
-                   chunks=nchunks, oom_budget=budget, est_bytes=est):
+                   chunks=nchunks, oom_budget=budget, est_bytes=est,
+                   plan_key=plan.key):
         # same chaos hook as the unchunked dispatch (one fire per FLUSH,
         # inside the flush span): an over-budget flush is still a flush,
         # and a scheduled pipeline_flush fault must reach the
@@ -1230,7 +1231,11 @@ def run_pipeline(data: dict, mask, n: int, steps, extra=(), shard=None):
                 "ignore", message=".*[Dd]onated.*", category=UserWarning)
             span_cm = (_obs.TRACER.span(
                 "frame.pipeline.flush", cat="frame", steps=len(steps),
-                outputs=len(extra), rows=n, bucket=b)
+                outputs=len(extra), rows=n, bucket=b,
+                # the cost-observatory join handle: EXPLAIN ANALYZE maps
+                # this span's operator node to its cached CostProfile by
+                # plan key (an attribute read, never formatting)
+                plan_key=plan.key)
                 if _obs.TRACER.enabled else None)
             # chaos hook at the dispatch boundary (one None check without
             # a plan): a due device_error raises HERE — inside the flush

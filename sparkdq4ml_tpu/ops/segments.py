@@ -142,11 +142,16 @@ def try_device(op: str, thunk):
 
 def _record_grouped_stats(key: str, rows_in: int, rows_out: int,
                           wall_ms: float, compiles: int,
-                          host_syncs: int) -> None:
+                          host_syncs: int,
+                          card_key: Optional[str] = None) -> None:
     """Plan-stats observatory hand-off for the grouped engine: the group
     count is already host-known (the engine's one counted sync), so both
     the flush digest AND the rows-in→groups-out selectivity record
-    directly — no deferred drain. Called only when
+    directly — no deferred drain. ``card_key`` additionally records the
+    observed OUTPUT CARDINALITY under a query-addressable name+dtype key
+    (:func:`cardinality_history_key`) — the aggregate/distinct
+    ``est_rows`` evidence ROADMAP item 4 named as headroom (only filters
+    carried selectivity history before). Called only when
     ``spark.stats.enabled``; failures never take a flush down."""
     from ..utils import statstore as _stats
 
@@ -156,8 +161,32 @@ def _record_grouped_stats(key: str, rows_in: int, rows_out: int,
                                   host_syncs=host_syncs)
         if rows_out >= 0:
             _stats.STORE.record_rows(key, "grouped", rows_in, rows_out)
+            if card_key is not None:
+                _stats.STORE.record_rows(card_key, "cardinality",
+                                         rows_in, rows_out)
     except Exception:
         logger.debug("stats hand-off failed", exc_info=True)
+
+
+def cardinality_history_key(op: str, names, arrs) -> Optional[str]:
+    """Query-addressable output-cardinality key: ``op`` (``g`` group-by /
+    ``d`` distinct) + the SORTED key column names with their device
+    dtypes + the engine dtype tag. Name-addressed (unlike the structural
+    plan keys) so EXPLAIN can rebuild the same key from a parsed query's
+    GROUP BY / DISTINCT list against the catalog frame — zero execution.
+    Like the filter-selectivity entries, cardinality is treated as a
+    data property: the same key names/dtypes on two views share one
+    entry (accepted estimation noise; the estimate is advisory). None
+    when any column is missing or host-typed (those plans fall back and
+    record nothing)."""
+    parts = []
+    for name, arr in sorted(zip(names, arrs), key=lambda p: p[0]):
+        if arr is None or _is_host_col(arr):
+            return None
+        parts.append(f"{name}:{_col_kind_spec(arr)}")
+    if not parts:
+        return None
+    return f"card|{dtype_tag()}|{op}|" + ",".join(parts)
 
 
 # Aggregates this engine lowers to segment reductions. The names mirror
@@ -231,11 +260,21 @@ class _PlanEntry:
     program auditor enumerates (it must be able to ``make_jaxpr`` the
     plan without bumping ``grouped.compile`` or the replay stats)."""
 
-    __slots__ = ("fn", "trace_body", "example", "shape_sigs", "mesh")
+    __slots__ = ("fn", "trace_body", "example", "shape_sigs", "mesh",
+                 "key", "stats_key")
 
     def __init__(self, raw, mesh=None):
         self.trace_body = raw
         self.mesh = mesh
+        # full cache key (namespace-prefixed) — set by _cached_plan;
+        # the cost observatory's join handle (flush spans carry it)
+        self.key = ""
+        # the statstore key this plan's flushes record under (grouped
+        # aggregation keys stats by struct, "G|...", across the
+        # dense/sorted lowerings) — the cost observatory joins wall
+        # history through it; set at the execution sites, "" until the
+        # plan has run under stats
+        self.stats_key = ""
 
         def counted(*args):
             # Runs at trace time only → counts XLA compiles (the single
@@ -280,6 +319,7 @@ def _cached_plan(key: str, build, mesh=None):
                 "hits"] += 1
             return fn
     fn = _PlanEntry(build(), mesh=mesh)
+    fn.key = key
     with _CACHE_LOCK:
         # Insert-if-absent (same rule as the pipeline cache): a build race
         # keeps the first inserted program so replay stats stay coherent.
@@ -348,6 +388,11 @@ def program_handles() -> list:
         meta = {"expected_traces": max(len(entry.shape_sigs), 1)}
         if observed is not None:
             meta["observed_traces"] = observed
+        if entry.stats_key:
+            # grouped flushes record wall history under the struct key
+            # ("G|..."), not the per-lowering cache key — declare the
+            # join handle so the cost observatory's report can find it
+            meta["stats_key"] = entry.stats_key
         out.append(_obs.ProgramHandle(
             "grouped", key, entry.trace_body, args=entry.example,
             variants={"bucket": [(_scale_rows(entry.example, 2), {}),
@@ -1118,7 +1163,9 @@ def _build_sorted_agg_program(key_kinds, agg_ops, val_kinds):
 def _run_plan(fn, args, before, sp):
     out = fn(*args)
     compiled = counters.get("grouped.compile") > before
-    sp.set(cache="compile" if compiled else "hit")
+    # plan_key: the cost-observatory join handle (attribute read, no
+    # formatting — the noop contract holds on the disabled no-op span)
+    sp.set(cache="compile" if compiled else "hit", plan_key=fn.key)
     if not compiled:
         counters.increment("grouped.hit")
     return out
@@ -1256,6 +1303,7 @@ def grouped_agg(frame, keys, agg_list):
                     shard.mesh, tuple(key_kinds), tuple(agg_ops),
                     tuple(val_kinds), S),
                 mesh=shard.mesh)
+            fn.stats_key = stats_key
             try:
                 _faults.inject("shard_merge")
                 key_outs, agg_outs, groups, fit = _run_plan(
@@ -1286,6 +1334,17 @@ def grouped_agg(frame, keys, agg_list):
                     g = int(g_h)
                     sp.set(groups=g, lowering="sharded-dense",
                            shards=shard.devices)
+                    if config.costprof_enabled:
+                        # exchange-volume accounting (device-cost
+                        # observatory): the merge collective reduces the
+                        # stacked S-slot tables — static shapes, so the
+                        # aggregate payload is sized without any sync
+                        from ..parallel.shard import record_exchange
+
+                        record_exchange(
+                            "psum",
+                            S * max(len(agg_ops), 1)
+                            * _acc_dtype().itemsize * shard.devices)
                 else:
                     # global key range overflowed the dense table: the
                     # sorted program is single-device — gather (same S
@@ -1303,6 +1362,7 @@ def grouped_agg(frame, keys, agg_list):
             before = counters.get("grouped.compile")
             fn = _cached_plan(f"GD{S}|{struct}", _build_dense_agg_program(
                 tuple(key_kinds), tuple(agg_ops), tuple(val_kinds), S))
+            fn.stats_key = stats_key
             key_outs, agg_outs, groups, fit = _run_plan(
                 fn, args, before, sp)
             # ONE host sync: the fit verdict + group count together
@@ -1327,6 +1387,7 @@ def grouped_agg(frame, keys, agg_list):
             before = counters.get("grouped.compile")
             fn = _cached_plan(f"GS|{struct}", _build_sorted_agg_program(
                 tuple(key_kinds), tuple(agg_ops), tuple(val_kinds)))
+            fn.stats_key = stats_key
             key_outs, agg_outs, groups = _run_plan(fn, args, before, sp)
             counters.increment("frame.host_sync")
             syncs += 1
@@ -1335,7 +1396,8 @@ def grouped_agg(frame, keys, agg_list):
     if stats_on:
         _record_grouped_stats(
             stats_key, n, g, (time.perf_counter() - t_stats) * 1e3,
-            counters.get("grouped.compile") - c_stats, syncs)
+            counters.get("grouped.compile") - c_stats, syncs,
+            card_key=cardinality_history_key("g", keys, key_arrs))
 
     # per-column eager slices, deliberately NOT compiler._unpad_tree: that
     # helper retraces per static slice length, which for the pipeline is
@@ -1522,11 +1584,14 @@ def device_unique(frame, key_names):
         key_kinds.append(kind)
 
     mask = frame._mask
+    card_key = (cardinality_history_key(
+        "d", key_names, [data.get(k) for k in key_names])
+        if config.stats_enabled else None)
     shard_store = getattr(frame, "_shard", None)
     if shard_store is not None:
         try:
             return _sharded_unique(frame, data, key_arrs, key_kinds,
-                                   shard_store)
+                                   shard_store, card_key=card_key)
         except jax.errors.JaxRuntimeError as e:
             # shard_merge ladder: a device fault in the exchange program
             # gathers one level to the single-device unique below
@@ -1552,6 +1617,7 @@ def device_unique(frame, key_names):
     b = bucket_size(n)
     before = counters.get("grouped.compile")
     fn = _cached_plan(key, _build_unique_program(tuple(key_kinds)))
+    fn.stats_key = key
     keys_in = tuple(pad_rows(a, b, fresh=False) for a in key_arrs)
     mask_in = pad_rows(jnp.asarray(mask, jnp.bool_), b, fresh=False)
 
@@ -1567,7 +1633,8 @@ def device_unique(frame, key_names):
     if stats_on:
         _record_grouped_stats(
             key, n, g, (time.perf_counter() - t_stats) * 1e3,
-            counters.get("grouped.compile") - before, 1)
+            counters.get("grouped.compile") - before, 1,
+            card_key=card_key)
     return Frame(_gather_columns(data, keep[:g]))
 
 
@@ -1606,7 +1673,8 @@ def _host_sort_plan(key_arrs, specs, mask):
     return vi[order]
 
 
-def _sharded_unique(frame, data, key_arrs, key_kinds, store):
+def _sharded_unique(frame, data, key_arrs, key_kinds, store,
+                    card_key=None):
     """Sharded :func:`device_unique`: dispatch the hash-partition
     exchange program (one counted host sync pulls the per-shard
     first-occurrence candidate sets + counts in one batch), merge-sort
@@ -1627,6 +1695,7 @@ def _sharded_unique(frame, data, key_arrs, key_kinds, store):
     before = counters.get("grouped.compile")
     fn = _cached_plan(key, _build_sharded_unique_program(
         mesh, tuple(key_kinds)), mesh=mesh)
+    fn.stats_key = key
     keys_in = tuple(jnp.asarray(a) for a in key_arrs)
     mask_in = jnp.asarray(frame._mask, jnp.bool_)
     stats_on = config.stats_enabled
@@ -1641,12 +1710,23 @@ def _sharded_unique(frame, data, key_arrs, key_kinds, store):
         cand_h, cnts_h, g = jax.device_get((cand, cnts, total))
         g = int(g)
         sp.set(groups=g, lowering="sharded-exchange")
+    if config.costprof_enabled:
+        # exchange-volume accounting (device-cost observatory): the
+        # hash-partition exchange ships FULL padded key blocks to every
+        # owner shard — static shapes, sized without any sync
+        from ..parallel.shard import record_exchange
+
+        record_exchange(
+            "all_to_all",
+            sum(a.size * a.dtype.itemsize for a in keys_in) * D
+            + mask_in.size * mask_in.dtype.itemsize * D)
     per = np.asarray(cand_h).reshape(D, -1)
     keep = np.sort(np.concatenate(
         [per[i, :int(cnts_h[i])] for i in range(D)])).astype(np.int64)
     if stats_on:
         _record_grouped_stats(
             key, n, g, (time.perf_counter() - t_stats) * 1e3,
-            counters.get("grouped.compile") - before, 1)
+            counters.get("grouped.compile") - before, 1,
+            card_key=card_key)
     return Frame(_gather_columns(data, jnp.asarray(keep), host_idx=keep))
 # --- END HOST FALLBACK ----------------------------------------------------

@@ -52,6 +52,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from ..config import config
+from ..utils import observability as _obs
 from ..utils.profiling import counters
 from .mesh import DATA_AXIS
 
@@ -60,8 +61,41 @@ logger = logging.getLogger("sparkdq4ml_tpu.parallel.shard")
 __all__ = [
     "ShardedStore", "configure", "reset", "active_mesh", "store_for",
     "maybe_shard_frame", "shard_frame", "gather_arrays",
-    "partitioned_join_plan", "hash_partition",
+    "partitioned_join_plan", "hash_partition", "record_exchange",
+    "record_skew",
 ]
+
+
+def record_exchange(kind: str, nbytes: int) -> None:
+    """Exchange-volume accounting (device-cost observatory,
+    ``utils/costprof.py``): one counter bump per cross-shard data
+    movement, sized STATICALLY from the participating array shapes —
+    never a sync. Kinds mirror the EXPLAIN ``Exchange`` markers:
+    ``psum`` (the grouped merge collective), ``all_to_all`` (the
+    hash-partition distinct exchange), ``gather`` (a ladder/sort
+    degrade to single-device placement). One flag read when the
+    observatory is disabled."""
+    if not config.costprof_enabled:
+        return
+    nb = max(int(nbytes), 0)
+    counters.increment("shard.exchange_bytes", nb)
+    counters.increment(f"shard.exchange_bytes.{kind}", nb)
+
+
+def record_skew(store: "ShardedStore") -> None:
+    """Row-balance gauge (device-cost observatory): worst/mean valid
+    rows per shard of the most recent placement, from the layout's own
+    HOST-KNOWN counts (contiguous range partitioning — no sync). 1.0 =
+    perfectly balanced; ``devices`` = all rows on one shard. One flag
+    read when the observatory is disabled."""
+    if not config.costprof_enabled:
+        return
+    counts = store.shard_counts()
+    mean = store.rows / max(store.devices, 1)
+    if mean <= 0:
+        return
+    _obs.METRICS.set_gauge("shard.skew",
+                           round(max(counts) / mean, 4))
 
 
 class ShardedStore:
@@ -243,6 +277,7 @@ def _place(frame, store: ShardedStore):
     out._n = store.slots
     out._shard = store
     counters.increment("shard.place")
+    record_skew(store)
     return out
 
 
@@ -252,7 +287,10 @@ def gather_arrays(store: ShardedStore, *arrays):
     single-device execution). A device→device transfer, never a counted
     host sync."""
     dev = store.mesh.devices.flat[0]
-    return tuple(jax.device_put(jnp.asarray(a), dev) for a in arrays)
+    out = tuple(jax.device_put(jnp.asarray(a), dev) for a in arrays)
+    record_exchange("gather",
+                    sum(a.size * a.dtype.itemsize for a in out))
+    return out
 
 
 def gather_store(frame):
@@ -266,6 +304,10 @@ def gather_store(frame):
             for name, arr in frame._data_store.items()}
     mask = jax.device_put(jnp.asarray(frame._mask_store, jnp.bool_), dev)
     counters.increment("shard.gather")
+    record_exchange(
+        "gather",
+        sum(a.size * a.dtype.itemsize for a in data.values()
+            if not _is_host_col(a)) + mask.size * mask.dtype.itemsize)
     return data, mask
 
 

@@ -20,6 +20,17 @@ route      payload
            per-plan-key selectivity, wall/compile digests, byte bounds
 /trace     recent finished spans as JSON (bounded tail of the span
            buffer) — the "what just happened" view
+/profile   the device-cost observatory (``utils.costprof``) report:
+           per-plan AOT cost profile (flops/bytes/collective traffic)
+           joined with statstore wall history into achieved GFLOP/s /
+           GB/s, roofline verdicts, top-N by device-time share, plus
+           the newest managed profiler-capture path. ``?top=N`` bounds
+           the entry list; extraction is budgeted per scrape (pending
+           entries fill in on later scrapes) so a scrape latency stays
+           bounded by a constant, not the cache population
+/profile/  arms one managed jax-profiler capture for ``?seconds=N``
+trace      (``utils.profiling.start_capture`` — bounded retention,
+           timestamp+context naming); 409 while a capture is running
 ========== ==============================================================
 
 Security: binds ``127.0.0.1`` by default (``spark.serve.metricsHost`` to
@@ -127,10 +138,15 @@ class TelemetryServer:
                 body, ctype, code = self._plans()
             elif path == "/trace":
                 body, ctype, code = self._trace()
+            elif path == "/profile":
+                body, ctype, code = self._profile(req.path)
+            elif path == "/profile/trace":
+                body, ctype, code = self._profile_trace(req.path)
             else:
                 body, ctype, code = (
                     json.dumps({"error": "unknown route", "routes": [
-                        "/metrics", "/healthz", "/plans", "/trace"]}),
+                        "/metrics", "/healthz", "/plans", "/trace",
+                        "/profile", "/profile/trace"]}),
                     "application/json", 404)
         except Exception as e:   # a route bug must answer, not hang
             logger.debug("telemetry route failed", exc_info=True)
@@ -195,6 +211,53 @@ class TelemetryServer:
         doc = _stats.STORE.report()
         doc["enabled"] = True
         return (json.dumps(doc, default=_json_default),
+                "application/json", 200)
+
+    @staticmethod
+    def _query_params(raw_path: str) -> dict:
+        from urllib.parse import parse_qs, urlsplit
+
+        qs = parse_qs(urlsplit(raw_path).query)
+        return {k: v[-1] for k, v in qs.items() if v}
+
+    def _profile(self, raw_path: str):
+        from ..config import config as _cfg
+        from ..utils import costprof as _costprof
+        from ..utils import observability as _obs
+        from ..utils.profiling import counters as _counters
+
+        if not _cfg.costprof_enabled:
+            return (json.dumps({"enabled": False, "entries": []}),
+                    "application/json", 200)
+        params = self._query_params(raw_path)
+        try:
+            top = int(params.get("top", 32))
+        except ValueError:
+            top = 32
+        doc = _costprof.report(top=top)
+        doc["skew"] = _obs.METRICS.get_gauge("shard.skew") or None
+        doc["exchange_bytes"] = _counters.snapshot("shard.exchange_bytes")
+        return (json.dumps(doc, default=_json_default),
+                "application/json", 200)
+
+    def _profile_trace(self, raw_path: str):
+        from ..utils import profiling as _profiling
+
+        params = self._query_params(raw_path)
+        try:
+            seconds = float(params.get("seconds", 1.0))
+        except ValueError:
+            seconds = 1.0
+        label = params.get("label", "http")
+        try:
+            path = _profiling.start_capture(seconds, label=label)
+        except RuntimeError as e:
+            # one capture at a time (the jax profiler is process-global)
+            return (json.dumps({"armed": False, "error": str(e)}),
+                    "application/json", 409)
+        return (json.dumps({"armed": True, "path": path,
+                            "seconds": min(seconds,
+                                           _profiling.MAX_CAPTURE_S)}),
                 "application/json", 200)
 
     def _trace(self):

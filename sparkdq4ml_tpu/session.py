@@ -353,6 +353,23 @@ class TpuSession:
             if "spark.shard.devices" in self.conf:
                 _set("shard_devices",
                      int(self.conf["spark.shard.devices"]))
+            # Device-cost observatory (utils/costprof.py), session-scoped
+            # like everything above:
+            #     .config("spark.costprof.enabled", "false") # no profiles
+            #     .config("spark.costprof.ridge", 12.0)  # flops/byte
+            #     .config("spark.profiling.maxCaptures", 8)
+            cval = str(self.conf.get("spark.costprof.enabled",
+                                     "")).lower()
+            if cval in _CONF_FALSE:
+                _set("costprof_enabled", False)
+            elif cval in _CONF_TRUE:
+                _set("costprof_enabled", True)
+            if "spark.costprof.ridge" in self.conf:
+                _set("costprof_ridge",
+                     float(self.conf["spark.costprof.ridge"]))
+            if "spark.profiling.maxCaptures" in self.conf:
+                _set("profiling_max_captures",
+                     int(self.conf["spark.profiling.maxCaptures"]))
             if saved:
                 self._pipeline_saved = saved
         # Install the shard context over THIS session's mesh (outside
@@ -503,6 +520,27 @@ class TpuSession:
         doc["enabled"] = True
         doc["path"] = _cfg.stats_path or None
         return doc
+
+    def profile_report(self, top: Optional[int] = None) -> dict:
+        """The device-cost observatory's fleet-wide roofline table
+        (``utils.costprof``): one row per registry-enumerable cached
+        program — AOT-extracted flops/bytes/collective traffic, the
+        statstore-joined achieved GFLOP/s / GB/s, and the roofline
+        ``bound`` verdict — ranked by device-time share. COLD surface:
+        a first call may pay bounded lower+compile extractions (zero
+        device execution, zero counted host syncs/compiles) and one
+        counted statstore drain. ``spark.costprof.enabled=false`` makes
+        it refuse. Achieved numbers are structural on the CPU sandbox
+        and meaningful on TPU captures (README "Device-cost
+        observatory")."""
+        from .config import config as _cfg
+
+        if not _cfg.costprof_enabled:
+            return {"enabled": False, "entries": [], "size": 0,
+                    "pending": 0}
+        from .utils import costprof as _costprof
+
+        return _costprof.report(top=top)
 
     def _init_faults(self) -> None:
         """Install the fault-injection plan (``utils.faults``) from session
@@ -767,7 +805,8 @@ class TpuSession:
                                      "spark.explain.", "spark.serve.",
                                      "spark.ingest.", "spark.audit.",
                                      "spark.chaos.", "spark.stats.",
-                                     "spark.shard."))
+                                     "spark.shard.", "spark.costprof.",
+                                     "spark.profiling."))
                        for k in self._conf):
                     _ACTIVE._init_pipeline()
                 return _ACTIVE

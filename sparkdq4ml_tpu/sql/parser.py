@@ -1618,10 +1618,12 @@ def plan_tree(q: Query) -> PlanNode:
         op = ("SegmentedAggregate" if _structurally_segmented(q)
               else "Aggregate")
         node = PlanNode(op, f"[{mode}:{len(q.group_by)}]", [node])
+        node.meta["query"] = q         # cardinality-history lookup
     if q.having is not None:
         node = PlanNode("Having", "", [node])
     if q.distinct:
         node = PlanNode("Distinct", "", [node])
+        node.meta["query"] = q         # cardinality-history lookup
     if q.order_by:
         from ..config import config as _cfg
 
@@ -1723,6 +1725,13 @@ def _annotate_plan(tree: PlanNode, qs) -> None:
         stats = node.stats
         if primary is not None:
             a = primary.attrs
+            # cost-observatory join handles: the plan key (when the
+            # span's program has one) addresses the CostProfile cache;
+            # "measured" marks operators that actually ran (the roofline
+            # `host` verdict's evidence). meta, never rendered.
+            node.meta["measured"] = True
+            if a.get("plan_key"):
+                node.meta["plan_key"] = a["plan_key"]
             if "rows_in" in a:
                 stats["rows_in"] = a.get("rows_in")
                 stats["rows_out"] = a.get("rows_out")
@@ -1753,6 +1762,8 @@ def _annotate_plan(tree: PlanNode, qs) -> None:
                 if flush is not None:
                     verdict = flush.attrs.get("cache")
                     stats["flush_ms"] = round((flush.dur_us or 0) / 1e3, 3)
+                    if flush.attrs.get("plan_key"):
+                        node.meta["plan_key"] = flush.attrs["plan_key"]
             if verdict is not None:
                 stats["compile"] = verdict
             for k, v in a.items():
@@ -1868,9 +1879,24 @@ def _annotate_est_rows(tree: PlanNode, cat) -> None:
             off = node.meta.get("offset")
             out = (max(child - int(off), 0) if child is not None
                    and off is not None else None)
-        # Aggregate/Distinct/Join/SetOps output cardinality has no
-        # history key yet — stays unknown rather than a guess. DDL and
-        # wrapper nodes have no cardinality at all and stay unannotated.
+        elif op in ("Aggregate", "SegmentedAggregate", "Distinct"):
+            # output-cardinality history (ROADMAP item 4's named
+            # headroom): the grouped engine records observed
+            # rows-in → groups-out under a name+dtype-addressed key
+            # (ops/segments.cardinality_history_key), so aggregates no
+            # longer estimate blind — the recorded group ratio scales
+            # the input estimate. Still advisory; unknown stays "-".
+            q = node.meta.get("query")
+            if child is not None and q is not None:
+                ckey = _cardinality_history_key(q, cat,
+                                                op == "Distinct")
+                if ckey is not None:
+                    sel = _stats.STORE.selectivity(ckey)
+                    if sel is not None:
+                        out = int(round(sel * child))
+        # Join/SetOps output cardinality has no history key yet —
+        # stays unknown rather than a guess. DDL and wrapper nodes
+        # have no cardinality at all and stay unannotated.
         if op not in ("CreateView", "DropView", "With", "SetOps"):
             node.stats["est_rows"] = out
         # cardinality propagates along children[0], but side arms (a
@@ -1917,6 +1943,95 @@ def _annotate_est_rows(tree: PlanNode, cat) -> None:
 
     try:
         annotate(tree)
+    except Exception:
+        pass
+
+
+def _cardinality_history_key(q, cat, distinct: bool):
+    """The statstore output-cardinality key a grouped/distinct flush of
+    this query would record under (``ops/segments.
+    cardinality_history_key`` — name+dtype addressed, zero execution).
+    None when the view is unregistered, the query joins (the flush-time
+    frame carries joined columns this static walk cannot see), or any
+    key is not a plain resolvable column."""
+    view = q.view if isinstance(q.view, str) else None
+    if view is None or q.joins:
+        return None
+    try:
+        frame = cat.lookup(view)
+    except Exception:
+        return None
+    if distinct:
+        names = []
+        for it in q.items:
+            # plain column projections only (str or a bare Col ref) —
+            # computed items change the distinct key surface in ways
+            # this static probe cannot mirror
+            if isinstance(it, str) and it != "*":
+                names.append(it)
+            elif isinstance(it, E.Col):
+                names.append(it.name)
+            else:
+                return None
+        if not names:
+            return None
+    else:
+        names = [k for k in q.group_by if isinstance(k, str)]
+        if len(names) != len(q.group_by) or not names:
+            return None
+    arrs = [frame._data_store.get(n) for n in names]
+    if any(a is None for a in arrs):
+        return None
+    from ..ops import segments as _segments
+
+    return _segments.cardinality_history_key(
+        "d" if distinct else "g", names, arrs)
+
+
+def _annotate_costs(tree: PlanNode) -> None:
+    """Device-cost observatory columns (``utils/costprof.py``) for
+    EXPLAIN ANALYZE: per operator node, the AOT cost profile addressed
+    by the plan key its flush span carried (``est_flops``/``est_bytes``),
+    achieved throughput against the node's measured wall
+    (``gflops``/``gbps`` — structural on the CPU sandbox, meaningful on
+    TPU captures), and the roofline ``bound`` verdict
+    (compute|memory|sync|host). COLD surface: a cache-miss profile can
+    cost one XLA compile of the un-counted trace body — zero device
+    execution, zero counted host syncs, zero counted compiles
+    (test-pinned). A degraded extraction (the ``cost_profile`` fault
+    ladder) leaves every column "-". Never raises — cost annotation is
+    advisory."""
+    from ..utils import costprof as _costprof
+
+    try:
+        # ONE batched resolution (one registry enumeration) for every
+        # keyed node, then a second walk annotates
+        profiles = _costprof.profiles_for(
+            n.meta.get("plan_key") for n in tree.execution_order())
+        for node in tree.execution_order():
+            stats = node.stats
+            if "wall_ms" not in stats:
+                continue              # un-analyzed node (no stat schema)
+            key = node.meta.get("plan_key")
+            prof = profiles.get(key) if key else None
+            wall = stats.get("flush_ms") or stats.get("wall_ms")
+            gflops, gbps = _costprof.achieved(prof, wall)
+            if prof is not None:
+                bound = _costprof.roofline(
+                    prof, int(stats.get("host_syncs") or 0))
+            elif key:
+                bound = None          # extraction degraded: render "-"
+            elif node.meta.get("measured"):
+                bound = "host"        # ran, but with no device program
+            else:
+                bound = None
+            stats["est_flops"] = (None if prof is None
+                                  else int(prof.flops))
+            stats["est_bytes"] = (None if prof is None
+                                  else int(prof.bytes_accessed))
+            stats["gflops"] = gflops
+            stats["gbps"] = gbps
+            stats["bound"] = bound
     except Exception:
         pass
 
@@ -2177,6 +2292,11 @@ def _execute_explain(body: str, cat, analyze: bool):
         _jax.block_until_ready(out._mask)
         wall_ms = (_time.perf_counter() - t0) * 1e3
     _annotate_plan(tree, qs)
+    # Device-cost observatory columns (utils/costprof.py) — gated on
+    # ONE flag read; disabled restores the exact pre-observatory
+    # ANALYZE schema (acceptance-pinned byte-identical).
+    if _cfg.costprof_enabled:
+        _annotate_costs(tree)
     top = tree.main_chain()[0]
     if top.stats.get("rows_out") is None:
         top.stats["rows_out"] = out.num_slots

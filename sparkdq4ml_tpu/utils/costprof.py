@@ -1,0 +1,433 @@
+"""Device-cost observatory — per-plan AOT cost profiles + roofline math.
+
+The engine measures *when* programs run (spans, EXPLAIN ANALYZE,
+statstore wall-ms digests) and statically bounds *how much memory* they
+may touch (dqaudit), but until this module no plan ever learned its
+compute cost: achieved GFLOP/s, bytes moved, and collective traffic were
+invisible. Here every ``observability.CACHES``-enumerable program
+(pipeline plans, grouped lowerings, sharded stages/exchanges,
+solver/fit programs) gets a :class:`CostProfile` extracted by the AOT
+path in ``analysis/program/costs.py`` — ``jit(...).lower(...).compile()``
+against the recorded abstract example args, zero device execution, zero
+counted host syncs, zero counted compiles — cached per structural key
+and persisted into the statstore so one extraction serves every later
+session.
+
+Joining a profile with the statstore's wall-ms history yields the
+derived surfaces wired through four layers:
+
+* EXPLAIN ANALYZE — ``est_flops`` / ``est_bytes`` / achieved ``gflops``
+  / ``gbps`` and a roofline ``bound=compute|memory|sync|host`` verdict
+  per operator node (``sql/parser.py``);
+* sharded execution — the ``shard.skew`` balance gauge and
+  ``shard.exchange_bytes[.<kind>]`` volume counters
+  (``parallel/shard.py`` / ``ops/segments.py``);
+* the TelemetryServer — ``/profile`` (per-plan cost + achieved JSON,
+  top-N by device-time share) and ``/profile/trace?seconds=N`` (arms
+  the managed ``utils/profiling`` jax-profiler capture);
+* ``session.profile_report()`` — the fleet-wide roofline table.
+
+Standing contracts honored: ``spark.costprof.enabled=false`` is a
+one-flag-read no-op on every hook, the flush hot path never imports
+this module (or ``analysis/``), extraction runs lazily on COLD surfaces
+only (report/EXPLAIN/save/scrape) with a per-call budget so a scrape
+never stalls behind an unbounded compile sweep, and the
+``cost_profile`` fault site degrades extraction to "-" (unprofiled)
+through the recovery engine instead of failing the surface.
+
+Roofline semantics (see README "Device-cost observatory"): arithmetic
+intensity = flops / bytes accessed, compared against the
+``spark.costprof.ridge`` ridge point (flops/byte) — at/above is
+``compute``-bound, below is ``memory``-bound; a program that pays a
+host sync while moving almost nothing (< the sync floors) is
+``sync``-bound; an operator with no device program at all is ``host``.
+On the CPU sandbox the achieved numbers are structural (wall-clock is
+host dispatch); TPU captures make them real.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Optional
+
+from ..config import config
+from .profiling import counters
+
+logger = logging.getLogger("sparkdq4ml_tpu.costprof")
+
+#: A profile below BOTH floors that still paid a host sync is verdicted
+#: ``sync``-bound: the device work is too small for either roofline axis
+#: to be the binding constraint — the boundary crossing is.
+SYNC_FLOOR_BYTES = 1 << 16
+SYNC_FLOOR_FLOPS = 1e5
+
+#: Default extraction budget per cold-surface call (``/profile`` scrape,
+#: EXPLAIN): at most this many NEW lower+compile extractions run; the
+#: rest report as pending and fill in on later calls. Keeps a scrape's
+#: latency bounded by a constant, not by the cache population.
+EXTRACT_BUDGET = 8
+
+
+class CostProfile:
+    """One program's static cost profile — the ``cost_analysis()`` /
+    ``memory_analysis()`` figures plus the trace-derived per-collective
+    bytes. Structural per plan key: literals are hoisted out of keys, so
+    one profile covers every literal/row-count the plan serves (at the
+    recorded example bucket)."""
+
+    __slots__ = ("flops", "transcendentals", "bytes_accessed",
+                 "output_bytes", "collectives", "peak_bytes",
+                 "argument_bytes", "devices", "extract_ms")
+
+    def __init__(self, flops=0.0, transcendentals=0.0, bytes_accessed=0.0,
+                 output_bytes=0.0, collectives=None, peak_bytes=None,
+                 argument_bytes=None, devices=1, extract_ms=None):
+        self.flops = float(flops)
+        self.transcendentals = float(transcendentals)
+        self.bytes_accessed = float(bytes_accessed)
+        self.output_bytes = float(output_bytes)
+        self.collectives = dict(collectives or {})
+        self.peak_bytes = None if peak_bytes is None else int(peak_bytes)
+        self.argument_bytes = (None if argument_bytes is None
+                               else int(argument_bytes))
+        self.devices = int(devices)
+        self.extract_ms = extract_ms
+
+    @property
+    def collective_bytes(self) -> int:
+        return int(sum(self.collectives.values()))
+
+    @property
+    def intensity(self) -> float:
+        """Arithmetic intensity, flops per byte accessed."""
+        return self.flops / max(self.bytes_accessed, 1.0)
+
+    def to_doc(self) -> dict:
+        doc = {
+            "flops": self.flops,
+            "transcendentals": self.transcendentals,
+            "bytes_accessed": self.bytes_accessed,
+            "output_bytes": self.output_bytes,
+            "devices": self.devices,
+        }
+        if self.collectives:
+            doc["collectives"] = dict(self.collectives)
+        if self.peak_bytes is not None:
+            doc["peak_bytes"] = self.peak_bytes
+        if self.argument_bytes is not None:
+            doc["argument_bytes"] = self.argument_bytes
+        if self.extract_ms is not None:
+            doc["extract_ms"] = self.extract_ms
+        return doc
+
+    @classmethod
+    def from_doc(cls, doc: dict) -> "CostProfile":
+        return cls(
+            flops=doc.get("flops", 0.0),
+            transcendentals=doc.get("transcendentals", 0.0),
+            bytes_accessed=doc.get("bytes_accessed", 0.0),
+            output_bytes=doc.get("output_bytes", 0.0),
+            collectives=doc.get("collectives"),
+            peak_bytes=doc.get("peak_bytes"),
+            argument_bytes=doc.get("argument_bytes"),
+            devices=doc.get("devices", 1),
+            extract_ms=doc.get("extract_ms"))
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return (f"CostProfile(flops={self.flops:g}, "
+                f"bytes={self.bytes_accessed:g}, "
+                f"collectives={self.collectives})")
+
+
+#: Extraction-failed sentinel: cached so a program that cannot lower is
+#: not re-compiled on every scrape; surfaces render "-" for it (the
+#: cost_profile degradation ladder's terminal rung).
+_FAILED = object()
+
+_PROFILES: dict = {}
+_LOCK = threading.Lock()
+
+
+def enabled() -> bool:
+    return bool(config.costprof_enabled)
+
+
+def clear() -> None:
+    """Drop every cached profile (tests; conf flips)."""
+    with _LOCK:
+        _PROFILES.clear()
+
+
+def achieved(profile: Optional[CostProfile],
+             wall_ms: Optional[float]) -> tuple:
+    """``(gflops, gbps)`` achieved at a measured wall time — None/None
+    when either side is unknown. Structural on the CPU sandbox,
+    meaningful on TPU captures (module docstring)."""
+    if profile is None or not wall_ms or wall_ms <= 0:
+        return (None, None)
+    secs = wall_ms / 1e3
+    return (round(profile.flops / secs / 1e9, 3),
+            round(profile.bytes_accessed / secs / 1e9, 3))
+
+
+def roofline(profile: Optional[CostProfile],
+             host_syncs: int = 0) -> Optional[str]:
+    """The ``bound`` verdict: ``host`` when the operator ran without a
+    device program, ``sync`` when it paid a host sync over near-zero
+    device work, else ``compute``/``memory`` by arithmetic intensity vs
+    the ``spark.costprof.ridge`` ridge point."""
+    if profile is None:
+        return "host"
+    if host_syncs and profile.bytes_accessed < SYNC_FLOOR_BYTES \
+            and profile.flops < SYNC_FLOOR_FLOPS:
+        return "sync"
+    if profile.intensity >= float(config.costprof_ridge):
+        return "compute"
+    return "memory"
+
+
+def _record_statstore(key: str, cache: str, doc: dict) -> None:
+    if not config.stats_enabled:
+        return
+    try:
+        from . import statstore as _stats
+
+        _stats.STORE.record_cost(key, f"cost:{cache}", doc)
+    except Exception:
+        logger.debug("cost-profile statstore hand-off failed",
+                     exc_info=True)
+
+
+def _stats_key(handle) -> str:
+    """The statstore key this program's flushes record under — the
+    producer declares it in ``meta["stats_key"]`` when it differs from
+    the program key (the grouped engine keys stats by struct across its
+    dense/sorted lowerings); the program key otherwise."""
+    return handle.meta.get("stats_key") or handle.program_key
+
+
+def _extract(handle) -> Optional[CostProfile]:
+    """One extraction through the ``cost_profile`` fault site and its
+    degradation ladder: ANY failure — injected or real — degrades to an
+    unprofiled plan (surfaces render "-") with a recovery event; the
+    observatory can go blind on a plan, never take a surface down."""
+    from . import faults as _faults
+
+    try:
+        _faults.inject("cost_profile")
+        from ..analysis.program import costs as _costs
+
+        doc = _costs.extract(handle)
+    except Exception as e:
+        counters.increment("costprof.failed")
+        from .recovery import RECOVERY_LOG
+
+        RECOVERY_LOG.record(
+            "cost_profile", "fallback", rung="unprofiled",
+            cause=f"{type(e).__name__}: {e}",
+            detail=f"cost extraction degraded; plan "
+                   f"{handle.program_key[:80]!r} reports no profile")
+        logger.debug("cost extraction failed for %r",
+                     handle.program_key[:80], exc_info=True)
+        return None
+    if doc is None:
+        return None
+    counters.increment("costprof.extracted")
+    # persist under the STATS key: that is the entry that accumulates
+    # this program's wall/byte history, so the cost doc and the digests
+    # it joins against live (and merge) together
+    _record_statstore(_stats_key(handle), handle.cache, doc)
+    return CostProfile.from_doc(doc)
+
+
+def _cache_get(key: str):
+    """(hit, profile) — hit False means never attempted."""
+    with _LOCK:
+        if key in _PROFILES:
+            p = _PROFILES[key]
+            return True, (None if p is _FAILED else p)
+    return False, None
+
+
+def _cache_put(key: str, profile: Optional[CostProfile]) -> None:
+    with _LOCK:
+        _PROFILES[key] = _FAILED if profile is None else profile
+
+
+def _from_statstore(key: str) -> Optional[CostProfile]:
+    """Persisted-profile fast path: a snapshot loaded at session init
+    may already carry this key's cost doc — no lower+compile needed."""
+    if not config.stats_enabled:
+        return None
+    try:
+        from . import statstore as _stats
+
+        doc = _stats.STORE.cost(key)
+    except Exception:
+        return None
+    return CostProfile.from_doc(doc) if doc else None
+
+
+def profiles_for(keys) -> dict:
+    """``{key: CostProfile|None}`` for a batch of plan keys — cached,
+    else adopted from the statstore, else extracted live; the registry
+    is enumerated at most ONCE per call (EXPLAIN ANALYZE resolves every
+    operator's key through one batch instead of one registry scan per
+    node). COLD surfaces only: a miss can cost one XLA compile per key.
+    A key with no live handle resolves None without being cached — its
+    plan may land in a cache later (e.g. after an eviction cycle)."""
+    out: dict = {}
+    if not enabled():
+        return {k: None for k in keys if k}
+    missing: list = []
+    for key in dict.fromkeys(k for k in keys if k):
+        hit, prof = _cache_get(key)
+        if hit:
+            out[key] = prof
+            continue
+        prof = _from_statstore(key)
+        if prof is not None:
+            _cache_put(key, prof)
+            out[key] = prof
+        else:
+            missing.append(key)
+    if missing:
+        from . import observability as _obs
+
+        handles, _errors = _obs.CACHES.programs()
+        by_key = {h.program_key: h for h in handles}
+        for key in missing:
+            h = by_key.get(key)
+            if h is None:
+                out[key] = None
+                continue
+            prof = _extract(h)
+            _cache_put(key, prof)
+            out[key] = prof
+    return out
+
+
+def profile_for(key: Optional[str]) -> Optional[CostProfile]:
+    """The cost profile at one plan key (see :func:`profiles_for`).
+    Returns None when disabled, unknown, or degraded."""
+    if not key:
+        return None
+    return profiles_for((key,)).get(key)
+
+
+def extract_all(budget: Optional[int] = None) -> dict:
+    """Extract every registry-enumerable program's profile (cached keys
+    are free; at most ``budget`` NEW extractions run — the rest stay
+    pending for the next call). Returns ``{key: {"cache", "profile"}}``
+    with ``profile`` None for degraded/pending entries, plus the
+    pending count under ``extract_all.pending`` in :func:`report`."""
+    out: dict = {}
+    if not enabled():
+        return out
+    budget = EXTRACT_BUDGET if budget is None else max(int(budget), 0)
+    from . import observability as _obs
+
+    handles, _errors = _obs.CACHES.programs()
+    fresh = 0
+    for h in handles:
+        key = h.program_key
+        if key in out:
+            continue
+        hit, prof = _cache_get(key)
+        pending = False
+        if not hit:
+            prof = _from_statstore(key) or _from_statstore(_stats_key(h))
+            if prof is not None:
+                _cache_put(key, prof)
+            elif fresh < budget:
+                prof = _extract(h)
+                _cache_put(key, prof)
+                fresh += 1
+            else:
+                pending = True
+        out[key] = {"cache": h.cache, "profile": prof,
+                    "pending": pending, "stats_key": _stats_key(h)}
+    return out
+
+
+def report(top: Optional[int] = None,
+           budget: Optional[int] = None) -> dict:
+    """The fleet-wide roofline view (``session.profile_report()`` and
+    the HTTP ``/profile`` route): one row per enumerable program —
+    static cost, statstore-joined achieved throughput, roofline verdict
+    — ranked by device-time share (each key's recorded wall-ms mass over
+    the fleet total). Cold surface: may extract (bounded by
+    ``budget``) and drains the statstore's deferred observations."""
+    if not enabled():
+        return {"enabled": False, "entries": [], "size": 0, "pending": 0}
+    entries = extract_all(budget=budget)
+    stats_entry = None
+    if config.stats_enabled:
+        try:
+            from . import statstore as _stats
+
+            _stats.STORE.drain_pending()
+            stats_entry = _stats.STORE.entry
+        except Exception:
+            stats_entry = None
+    rows = []
+    total_wall = 0.0
+    for key, info in entries.items():
+        prof = info["profile"]
+        st = (stats_entry(info["stats_key"])
+              if stats_entry is not None else None)
+        wall = (st or {}).get("wall_ms") or {}
+        wall_sum = float(wall.get("sum") or 0.0)
+        wall_count = int(wall.get("count") or 0)
+        wall_p50 = None
+        if st is not None:
+            try:
+                from .statstore import Digest as _Digest
+
+                wall_p50 = _Digest.from_doc(wall).p50() if wall_count \
+                    else None
+            except Exception:
+                wall_p50 = None
+        total_wall += wall_sum
+        syncs = int((st or {}).get("host_syncs") or 0)
+        gflops, gbps = achieved(prof, wall_p50)
+        rows.append({
+            "key": key[:160], "cache": info["cache"],
+            "pending": info["pending"],
+            "flops": None if prof is None else prof.flops,
+            "transcendentals": (None if prof is None
+                                else prof.transcendentals),
+            "bytes": None if prof is None else prof.bytes_accessed,
+            "output_bytes": (None if prof is None
+                             else prof.output_bytes),
+            "collectives": ({} if prof is None
+                            else dict(prof.collectives)),
+            "peak_bytes": None if prof is None else prof.peak_bytes,
+            "devices": 1 if prof is None else prof.devices,
+            "flushes": int((st or {}).get("flushes") or 0),
+            "wall_ms_sum": round(wall_sum, 3),
+            "wall_ms_p50": wall_p50,
+            "gflops": gflops, "gbps": gbps,
+            # every enumerable entry IS a device program, so a missing
+            # profile here means pending/degraded — render null, never
+            # the roofline's "host" verdict (that one is EXPLAIN's, for
+            # operators that ran with no device program at all)
+            "bound": (roofline(prof, syncs) if prof is not None
+                      else None),
+            "_wall": wall_sum,
+        })
+    for r in rows:
+        wall_sum = r.pop("_wall")
+        r["device_time_share"] = (round(wall_sum / total_wall, 4)
+                                  if total_wall > 0 else None)
+    rows.sort(key=lambda r: -(r["device_time_share"] or 0.0))
+    pending = sum(1 for r in rows if r["pending"])
+    if top is not None:
+        rows = rows[:max(int(top), 0)]
+    from .profiling import latest_capture
+
+    return {"enabled": True, "entries": rows, "size": len(entries),
+            "pending": pending, "total_wall_ms": round(total_wall, 3),
+            "ridge_flops_per_byte": float(config.costprof_ridge),
+            "capture": latest_capture()}

@@ -106,6 +106,7 @@ FAULT_SITES = {
     "oom": ("oom",),
     "stats_persist": ("io_error", "torn_chunk"),
     "optimizer": ("device_error",),
+    "cost_profile": ("device_error",),
 }
 
 

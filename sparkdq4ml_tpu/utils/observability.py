@@ -193,6 +193,20 @@ METRIC_NAMES = {
                              "only"),
     "stats.load_failed": ("counter",
                           "corrupt/stale snapshots degraded to empty"),
+    # device-cost observatory (utils/costprof.py)
+    "costprof.extracted": ("counter",
+                           "AOT cost profiles extracted (lower+compile, "
+                           "zero device execution)"),
+    "costprof.failed": ("counter",
+                        "cost extractions degraded to unprofiled "
+                        "(surfaces render '-')"),
+    "shard.skew": ("gauge", "worst/mean shard row-balance ratio of the "
+                            "most recent sharded placement"),
+    "shard.exchange_bytes": ("counter",
+                             "statically-sized cross-shard exchange "
+                             "volume, all kinds"),
+    "profiling.captures": ("counter",
+                           "managed jax-profiler captures armed"),
 }
 
 #: Dynamic metric-name families (formatted per site/tenant/category at
@@ -211,6 +225,10 @@ METRIC_NAME_PREFIXES = {
     "serve.slo_burn.": ("gauge", "per-tenant SLO error-budget burn rate "
                                  "(series-capped)"),
     "span_ms.": ("histogram", "span wall-clock latency by category"),
+    "costprof.": ("counter", "device-cost observatory activity"),
+    "shard.exchange_bytes.": ("counter",
+                              "per-kind cross-shard exchange volume "
+                              "(psum/all_to_all/gather)"),
 }
 
 

@@ -163,7 +163,7 @@ class KeyStats:
     __slots__ = ("key", "kind", "flushes", "compiles", "rows_in",
                  "rows_out", "sel_observations", "wall_ms", "compile_ms",
                  "host_syncs", "est_bytes_max", "peak_bytes_max",
-                 "updated_at")
+                 "cost", "updated_at")
 
     def __init__(self, key: str, kind: str):
         self.key = key
@@ -178,6 +178,11 @@ class KeyStats:
         self.host_syncs = 0
         self.est_bytes_max = 0
         self.peak_bytes_max = 0
+        # AOT cost profile (utils/costprof.py CostProfile.to_doc():
+        # flops / bytes / per-collective bytes / generated-code peak) —
+        # structural per key, so one extraction serves every session
+        # that loads this snapshot. None until an extraction lands.
+        self.cost: Optional[dict] = None
         self.updated_at = 0.0
 
     @property
@@ -203,10 +208,12 @@ class KeyStats:
         self.host_syncs += other.host_syncs
         self.est_bytes_max = max(self.est_bytes_max, other.est_bytes_max)
         self.peak_bytes_max = max(self.peak_bytes_max, other.peak_bytes_max)
+        if self.cost is None:
+            self.cost = other.cost
         self.updated_at = max(self.updated_at, other.updated_at)
 
     def to_doc(self) -> dict:
-        return {
+        doc = {
             "key": self.key, "kind": self.kind, "flushes": self.flushes,
             "compiles": self.compiles, "rows_in": self.rows_in,
             "rows_out": self.rows_out,
@@ -218,6 +225,9 @@ class KeyStats:
             "peak_bytes_max": self.peak_bytes_max,
             "updated_at": self.updated_at,
         }
+        if self.cost is not None:
+            doc["cost"] = self.cost
+        return doc
 
     @classmethod
     def from_doc(cls, doc: dict) -> "KeyStats":
@@ -232,6 +242,8 @@ class KeyStats:
         ks.host_syncs = int(doc.get("host_syncs", 0))
         ks.est_bytes_max = int(doc.get("est_bytes_max", 0))
         ks.peak_bytes_max = int(doc.get("peak_bytes_max", 0))
+        cost = doc.get("cost")
+        ks.cost = dict(cost) if isinstance(cost, dict) else None
         ks.updated_at = float(doc.get("updated_at", 0.0))
         return ks
 
@@ -387,15 +399,34 @@ class StatStore:
 
     def bytes_bound(self, key: str) -> Optional[int]:
         """Remembered resident-byte bound at ``key``: the max of the
-        static flush estimate and the MEASURED peak, across sessions —
-        the memory-aware chunking input (arxiv 2206.14148 as a planned
-        decision, see ``ops/compiler.run_pipeline``)."""
+        static flush estimate, the MEASURED peak, and — when an AOT cost
+        profile landed (``record_cost``) — XLA's own compiled-program
+        peak (temp + output + generated code), across sessions — the
+        memory-aware chunking input (arxiv 2206.14148 as a planned
+        decision, see ``ops/compiler.run_pipeline``). Folding the cost
+        profile in upgrades the optimizer's byte model from the coarse
+        flush mirror to the compiler's accounting."""
         with self._lock:
             ks = self._entries.get(key)
             if ks is None:
                 return None
-            bound = max(ks.est_bytes_max, ks.peak_bytes_max)
+            cost_peak = int((ks.cost or {}).get("peak_bytes") or 0)
+            bound = max(ks.est_bytes_max, ks.peak_bytes_max, cost_peak)
             return bound or None
+
+    def record_cost(self, key: str, kind: str, cost: dict) -> None:
+        """Attach an AOT cost profile (``utils/costprof.py``) to the
+        entry at ``key`` — structural, so later sessions loading the
+        snapshot skip the lower+compile extraction entirely."""
+        with self._lock:
+            ks = self._entry_locked(key, kind)
+            ks.cost = dict(cost)
+            ks.updated_at = time.time()
+
+    def cost(self, key: str) -> Optional[dict]:
+        with self._lock:
+            ks = self._entries.get(key)
+            return dict(ks.cost) if ks is not None and ks.cost else None
 
     def record_miss(self, key: str) -> None:
         """One planning miss at ``key`` (e.g. the grouped engine's dense
@@ -445,6 +476,7 @@ class StatStore:
                     "host_syncs": ks.host_syncs,
                     "est_bytes_max": ks.est_bytes_max,
                     "peak_bytes_max": ks.peak_bytes_max,
+                    "cost": ks.cost,
                 })
         return {"entries": rows, "size": len(rows),
                 "version": SCHEMA_VERSION}
@@ -489,7 +521,14 @@ class StatStore:
             if cur is None or ks.observations() > cur.observations() or (
                     ks.observations() == cur.observations()
                     and ks.updated_at > cur.updated_at):
+                if cur is not None and ks.cost is None:
+                    # the cost profile is structural per key — a winner
+                    # that never extracted one must not drop the
+                    # loser's (re-extraction costs a real XLA compile)
+                    ks.cost = cur.cost
                 target[ks.key] = ks
+            elif cur.cost is None and ks.cost is not None:
+                cur.cost = ks.cost
 
     @staticmethod
     def _trim(target: dict, bound: int) -> int:
