@@ -29,6 +29,9 @@ Covers the five BASELINE.json configs plus a synthetic scale sweep:
       clients driving the headline DQ+Lasso query through the QueryServer,
       sustained QPS + p50/p99 latency, shared plan/jit cache on vs off,
       cross-tenant program-reuse pin, golden numbers asserted per query,
+      plus a real-socket arm (serve/net.py + the resilient client, frame
+      and HTTP framings mixed) whose QPS/latency delta vs the in-process
+      arm prices the wire overhead,
 (sweep) the masked-Gramian data pass at n ∈ {1e5, 1e6, 1e7} × d ∈ {16, 128,
       512} (HBM-bounded subset), XLA vs compiled Pallas, with on-device
       numerics assertions — the MXU/HBM throughput story behind every fit.
@@ -715,12 +718,89 @@ def bench_serving(session, data_path: str):
                               and len(ok) == len(results)),
         }
 
+    def run_socket_arm():
+        # Same closed-loop workload through REAL sockets (serve/net.py):
+        # half the clients speak the length-prefixed frame protocol,
+        # half HTTP/1.1 chunked streaming, all via the resilient client.
+        # Latencies are CLIENT-side wall time per logical call, so the
+        # delta vs the in-process arm IS the wire + framing overhead.
+        from sparkdq4ml_tpu.serve import NetServer, ResilientClient
+
+        compiler.clear_cache()
+        segments.clear_cache()
+        server = QueryServer(
+            session, workers=workers, max_queue=4 * clients,
+            default_quota=TenantQuota(max_in_flight=2,
+                                      max_queued=per_client + 2),
+            shared_plan_cache=True).start()
+        net = NetServer(server, host="127.0.0.1", port=0).start()
+        net.register_job("headline", job)
+        warm = ResilientClient("127.0.0.1", net.port, transport="frame")
+        r0 = warm.call_job("headline", tenant="tenant-00",
+                           deadline_s=300.0)
+        warm.close()
+
+        results: list = []
+        lats: list = []
+        res_lock = threading.Lock()
+
+        def wire_client(i: int):
+            tenant = f"tenant-{i:02d}"
+            wire = ResilientClient(
+                "127.0.0.1", net.port,
+                transport="frame" if i % 2 else "http", tenant=tenant)
+            out, took = [], []
+            try:
+                for _ in range(per_client):
+                    t_call = time.perf_counter()
+                    out.append(wire.call_job("headline", tenant=tenant,
+                                             deadline_s=300.0))
+                    took.append((time.perf_counter() - t_call) * 1e3)
+            finally:
+                wire.close()
+            with res_lock:
+                results.extend(out)
+                lats.extend(took)
+
+        threads = [threading.Thread(target=wire_client, args=(i,))
+                   for i in range(clients)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        net.stop()
+        server.stop()
+        ok = [r for r in results if r.ok]
+        golden_ok = all(
+            r.ok
+            and r.value["count"] == 24
+            and abs(r.value["rmse"] - golden_rmse) / golden_rmse < 0.01
+            for r in ok + [r0])
+        lat_sorted = sorted(lats)
+
+        def pct(p):
+            return (round(lat_sorted[min(len(lat_sorted) - 1,
+                                         int(p * (len(lat_sorted) - 1)))],
+                          2) if lat_sorted else None)
+
+        return {
+            "queries": len(results), "completed": len(ok),
+            "qps": round(len(ok) / wall, 2), "wall_s": round(wall, 3),
+            "p50_ms": pct(0.50), "p99_ms": pct(0.99),
+            "golden_ok": bool(golden_ok and r0.ok
+                              and len(ok) == len(results)),
+        }
+
     shared = run_arm(True)
     isolated = run_arm(False)
+    socket_arm = run_socket_arm()
     # drop the tenant-namespaced plans the isolated arm salted in
     compiler.clear_cache()
     segments.clear_cache()
-    if not (shared["golden_ok"] and isolated["golden_ok"]):
+    if not (shared["golden_ok"] and isolated["golden_ok"]
+            and socket_arm["golden_ok"]):
         log("ERROR: serving bench: a served query missed the golden "
             "numbers (count 24 / RMSE 2.8099) or failed outright")
         sys.exit(1)
@@ -728,9 +808,13 @@ def bench_serving(session, data_path: str):
         "config": "serving", "clients": clients,
         "queries_per_client": per_client, "workers": workers,
         "shared_cache": shared, "isolated_cache": isolated,
+        "socket": socket_arm,
         "shared_vs_isolated_qps": round(
             shared["qps"] / isolated["qps"], 2)
         if isolated["qps"] else None,
+        "socket_vs_inproc_qps": round(
+            socket_arm["qps"] / shared["qps"], 2)
+        if shared["qps"] else None,
     }
     log(json.dumps(row))
     return row

@@ -40,8 +40,18 @@ discipline), so a failing seed replays exactly with
 Usage::
 
     python scripts/chaos_soak.py --seeds 50              # the full gate
+    python scripts/chaos_soak.py --seeds 50 --transport socket  # over TCP
     python scripts/chaos_soak.py --seeds 5 --clients 8   # a quick smoke
     python scripts/chaos_soak.py --seeds 1 --base-seed 17  # replay seed 17
+
+``--transport socket`` runs the SAME workload through real sockets
+(``serve/net.py``): every client speaks the wire protocol (half frames,
+half HTTP) via the resilient client, with the ``net_accept`` /
+``net_read`` / ``net_write`` fault sites in candidate rotation — the
+gate additionally asserts that every injected net fault resolved
+through a ladder rung (a structured recovery event at its site: retry,
+timeout cut, counted disconnect — never a silent drop) and that the
+``net.*`` counters cohere with the delivered results.
 
 Conf defaults (overridden by flags): ``spark.chaos.seed`` /
 ``spark.chaos.seeds`` / ``spark.chaos.soakSeconds``. Exit 0 = every seed
@@ -99,6 +109,20 @@ _CANDIDATES = (
 )
 
 
+#: Extra candidates for ``--transport socket``: the network fault sites
+#: (serve/net.py). Probabilities stay low — most wire exchanges must
+#: succeed so the golden assertion and the idempotent-retry path both
+#: get exercised on the same run.
+_NET_CANDIDATES = (
+    ("net_accept", "conn_reset", 0.05, ""),
+    ("net_read", "conn_reset", 0.05, ""),
+    ("net_read", "stall", 0.04, ""),
+    ("net_read", "slow_client", 0.04, ""),
+    ("net_write", "conn_reset", 0.05, ""),
+    ("net_write", "partial_write", 0.05, ""),
+    ("net_write", "stall", 0.04, ""),
+)
+
 #: Guaranteed attempt-1 fault per seed (round-robin): even a small smoke
 #: run exercises every ladder, instead of leaving low-p Bernoulli draws
 #: to the dice at low attempt counts.
@@ -118,18 +142,36 @@ _ROTATION = (
     ("cost_profile", "device_error", ""),
 )
 
+#: Guaranteed net faults for the socket arm, rotated alongside
+#: ``_ROTATION`` (independent index stream, so every (compute, net)
+#: pairing eventually occurs across a 50-seed sweep).
+_NET_ROTATION = (
+    ("net_accept", "conn_reset", ""),
+    ("net_read", "conn_reset", ""),
+    ("net_read", "stall", ""),
+    ("net_read", "slow_client", ""),
+    ("net_write", "conn_reset", ""),
+    ("net_write", "partial_write", ""),
+    ("net_write", "stall", ""),
+)
 
-def build_schedule(seed: int) -> str:
+
+def build_schedule(seed: int, transport: str = "inproc") -> str:
     """Seeded random fault schedule: a deterministic subset of the
     candidate (site, kind) pairs, each with a deterministic probability —
-    pure function of ``seed`` — plus one guaranteed attempt-1 fault from
-    the rotation. Every third seed also schedules a
-    ``serve_admit:breaker_trip`` so the trip → shed → half-open → closed
-    lifecycle is exercised regularly, not just when the dice say so."""
+    pure function of ``(seed, transport)`` — plus one guaranteed
+    attempt-1 fault from the rotation (and, for ``--transport socket``,
+    the net candidates and one guaranteed net fault). Every third seed
+    also schedules a ``serve_admit:breaker_trip`` so the trip → shed →
+    half-open → closed lifecycle is exercised regularly, not just when
+    the dice say so."""
     from sparkdq4ml_tpu.utils.faults import _det_uniform
 
+    candidates = _CANDIDATES
+    if transport == "socket":
+        candidates = _CANDIDATES + _NET_CANDIDATES
     specs = []
-    for site, kind, max_p, extra in _CANDIDATES:
+    for site, kind, max_p, extra in candidates:
         pick = _det_uniform(seed, f"sched-pick:{site}:{kind}", 1)
         if pick < 0.5:
             continue
@@ -140,6 +182,9 @@ def build_schedule(seed: int) -> str:
     # same pair must not displace the guaranteed attempt-1 fault
     site, kind, extra = _ROTATION[seed % len(_ROTATION)]
     specs.append(f"{site}:{kind}:1{extra}")
+    if transport == "socket":
+        site, kind, extra = _NET_ROTATION[seed % len(_NET_ROTATION)]
+        specs.append(f"{site}:{kind}:1{extra}")
     if seed % 3 == 0:
         specs.append("serve_admit:breaker_trip:2")
     return ";".join(specs)
@@ -277,14 +322,19 @@ class _Scraper:
 
 
 def run_seed(session, seed: int, clients: int, queries: int, workers: int,
-             data_path: str, soak_s: float, log=print) -> dict:
+             data_path: str, soak_s: float, transport: str = "inproc",
+             log=print) -> dict:
     """One seeded chaos round; returns the per-seed verdict dict with a
-    ``violations`` list (empty = the contract held)."""
+    ``violations`` list (empty = the contract held). ``transport=
+    "socket"`` drives the same workload through real sockets
+    (serve/net.py), clients alternating the frame and HTTP framings via
+    :class:`~sparkdq4ml_tpu.serve.ResilientClient`, with the net fault
+    sites in rotation."""
     from sparkdq4ml_tpu.serve import QueryServer, TenantQuota
     from sparkdq4ml_tpu.utils import faults, profiling
-    from sparkdq4ml_tpu.utils.recovery import RECOVERY_LOG
+    from sparkdq4ml_tpu.utils.recovery import RECOVERY_LOG, RetryPolicy
 
-    schedule = build_schedule(seed)
+    schedule = build_schedule(seed, transport)
     violations: list[str] = []
     RECOVERY_LOG.clear()
     before = profiling.counters.snapshot()
@@ -294,6 +344,15 @@ def run_seed(session, seed: int, clients: int, queries: int, workers: int,
         default_quota=TenantQuota(max_in_flight=2, max_queued=queries + 2),
         breaker_threshold=3, breaker_cooldown=BREAKER_COOLDOWN_S,
         metrics_port=0, slo_p99_ms=1000.0).start()
+    net = None
+    if transport == "socket":
+        from sparkdq4ml_tpu.serve import NetServer
+
+        # a tight connTimeoutMs keeps the injected stall/slow_client
+        # ladders (and any real slow peer) cheap per occurrence
+        net = NetServer(server, host="127.0.0.1", port=0,
+                        conn_timeout_s=2.0).start()
+        net.register_job("headline", job)
     scraper = _Scraper(server.telemetry.port).start()
     try:
         scraper.scrape_once()          # baseline from the wire
@@ -323,13 +382,55 @@ def run_seed(session, seed: int, clients: int, queries: int, workers: int,
         with res_lock:
             results.extend(out)
 
-    threads = [threading.Thread(target=client, args=(i,),
+    def socket_client(i: int) -> None:
+        # half the clients speak the frame protocol, half HTTP; the
+        # zero-hangs contract is asserted on WALL TIME per logical call
+        # (the resilient client itself must never wedge)
+        from sparkdq4ml_tpu.serve import ResilientClient
+
+        tenant = f"chaos-{i:02d}"
+        out = []
+        wire = ResilientClient(
+            "127.0.0.1", net.port,
+            transport="frame" if i % 2 else "http", tenant=tenant,
+            policy=RetryPolicy(
+                max_attempts=4, backoff_base=0.05,
+                attempt_deadline=RESULT_BOUND_S / 3.0,
+                total_deadline=RESULT_BOUND_S - 10.0))
+        try:
+            while True:
+                done = len(out)
+                if done >= queries and time.perf_counter() - t0 >= soak_s:
+                    break
+                t_call = time.perf_counter()
+                r = wire.call_job("headline", tenant=tenant)
+                if time.perf_counter() - t_call > RESULT_BOUND_S:
+                    with res_lock:
+                        hangs[0] += 1
+                    break
+                out.append(r)
+        finally:
+            wire.close()
+        with res_lock:
+            results.extend(out)
+
+    runner = socket_client if transport == "socket" else client
+    threads = [threading.Thread(target=runner, args=(i,),
                                 name=f"chaos-client-{i}")
                for i in range(clients)]
     for t in threads:
         t.start()
     for t in threads:
         t.join()
+    # Wire results the SERVER must have read a request for: a result cut
+    # at the read rung (conn_timeout before the request parse counted
+    # it) or synthesized client-side (retries exhausted, client-side
+    # deadline) is real resilience output, but no net.requests tick owes
+    # it anything. Captured before the breaker probes append in-process
+    # results.
+    n_wire = len([r for r in results
+                  if getattr(r, "where", None) != "client"
+                  and getattr(r, "reason", None) != "conn_timeout"])
     # stats-persistence arm: write the plan-stats snapshot WHILE the
     # fault plan is armed — a due stats_persist io_error/torn write must
     # degrade to in-memory-only (save returns False, recovery event
@@ -417,6 +518,8 @@ def run_seed(session, seed: int, clients: int, queries: int, workers: int,
                 f"{d[keys[1]] + d[keys[2]] + d[keys[3]]:.0f}")
             break
         time.sleep(0.05)
+    if net is not None:
+        net.stop(drain=True)
     if scraper.failures:
         violations.append(
             f"{len(scraper.failures)} scrape failure(s) under fire; "
@@ -461,8 +564,30 @@ def run_seed(session, seed: int, clients: int, queries: int, workers: int,
                 f"recovery counter incoherence: recovery.{action}="
                 f"{delta.get(f'recovery.{action}', 0)} vs {n} logged "
                 "event(s)")
+    net_fired: dict[str, int] = {}
+    for s, _, _ in fired:
+        if s.startswith("net_"):
+            net_fired[s] = net_fired.get(s, 0) + 1
+    if transport == "socket":
+        # ladder-rung proof: every injected net fault left at least one
+        # structured recovery event at ITS site — a fault the ladder
+        # silently dropped leaves the count short
+        for site, n in net_fired.items():
+            logged = len(RECOVERY_LOG.events(site=site))
+            if logged < n:
+                violations.append(
+                    f"net fault ladder gap at {site}: {n} fault(s) "
+                    f"fired but only {logged} recovery event(s) logged")
+        if delta.get("net.accept", 0) <= 0:
+            violations.append("socket transport ran but net.accept "
+                              "never moved")
+        if delta.get("net.requests", 0) < n_wire:
+            violations.append(
+                f"net.requests={delta.get('net.requests', 0)} below the "
+                f"{n_wire} wire results delivered")
     row = {
-        "seed": seed, "schedule": schedule, "queries": len(results),
+        "seed": seed, "transport": transport,
+        "schedule": schedule, "queries": len(results),
         "completed": len(ok), "refused_or_failed": len(results) - len(ok),
         "faults_fired": len(fired),
         "fault_sites": sorted({s for s, _, _ in fired}),
@@ -474,6 +599,10 @@ def run_seed(session, seed: int, clients: int, queries: int, workers: int,
         "breakers_probed": len(open_keys),
         "breakers_recovered": recovered,
         "scrapes": scraper.scrapes,
+        "net_faults_fired": sum(net_fired.values()),
+        "net_client_retries": delta.get("net.client_retry", 0),
+        "net_idem_hits": delta.get("net.idem_hit", 0),
+        "net_client_gone": delta.get("net.client_gone", 0),
         "stats_persist_degrades": delta.get("stats.persist_failed", 0),
         "wall_s": round(time.perf_counter() - t0, 2),
         "violations": violations,
@@ -484,7 +613,7 @@ def run_seed(session, seed: int, clients: int, queries: int, workers: int,
 
 def run_soak(seeds=None, clients=None, queries=1, workers=8,
              base_seed=None, soak_s=None, data_path=None, session=None,
-             log=print) -> dict:
+             transport="inproc", log=print) -> dict:
     """Sweep ``seeds`` seeded chaos rounds; returns the summary dict
     (``ok`` True = every seed held the survival contract). Arguments left
     ``None`` fall back to the session conf (``spark.chaos.*``) defaults.
@@ -520,7 +649,8 @@ def run_soak(seeds=None, clients=None, queries=1, workers=8,
     try:
         for s in range(base_seed, base_seed + seeds):
             rows.append(run_seed(session, s, clients, queries, workers,
-                                 data_path, soak_s, log=log))
+                                 data_path, soak_s, transport=transport,
+                                 log=log))
     finally:
         faults.clear()
         try:
@@ -533,7 +663,11 @@ def run_soak(seeds=None, clients=None, queries=1, workers=8,
     bad = [r for r in rows if r["violations"]]
     summary = {
         "seeds": seeds, "clients": clients, "queries_per_client": queries,
+        "transport": transport,
         "ok": not bad,
+        "net_faults_fired": sum(r["net_faults_fired"] for r in rows),
+        "net_client_retries": sum(r["net_client_retries"] for r in rows),
+        "net_idem_hits": sum(r["net_idem_hits"] for r in rows),
         "failed_seeds": [r["seed"] for r in bad],
         "queries": sum(r["queries"] for r in rows),
         "completed": sum(r["completed"] for r in rows),
@@ -570,6 +704,11 @@ def main(argv=None) -> int:
     ap.add_argument("--soak-seconds", type=float, default=None,
                     help="minimum per-seed duration "
                     "(spark.chaos.soakSeconds)")
+    ap.add_argument("--transport", choices=("inproc", "socket"),
+                    default="inproc",
+                    help="inproc: submit() futures (the classic arm); "
+                    "socket: real sockets via serve/net.py with the "
+                    "net_* fault sites in rotation")
     ap.add_argument("--data", default=None)
     ap.add_argument("--json", dest="json_path", default=None,
                     help="write the summary JSON here")
@@ -577,7 +716,7 @@ def main(argv=None) -> int:
     summary = run_soak(seeds=args.seeds, clients=args.clients,
                        queries=args.queries, workers=args.workers,
                        base_seed=args.base_seed, soak_s=args.soak_seconds,
-                       data_path=args.data)
+                       data_path=args.data, transport=args.transport)
     print(json.dumps({k: v for k, v in summary.items()
                       if k != "per_seed"}, indent=1))
     if args.json_path:

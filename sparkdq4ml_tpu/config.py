@@ -38,6 +38,16 @@ CONF_KEYS = {
     "spark.explain.memory": "session",
     "spark.explain.caches": "session",
     "spark.serve.enabled": "session",
+    "spark.serve.net.enabled": "session",
+    "spark.serve.net.port": "session",
+    "spark.serve.net.host": "session",
+    "spark.serve.net.backlog": "session",
+    "spark.serve.net.connTimeoutMs": "session",
+    "spark.serve.net.maxFrameBytes": "session",
+    "spark.serve.net.streamPageRows": "session",
+    "spark.serve.client.retries": "session",
+    "spark.serve.client.backoffMs": "session",
+    "spark.serve.client.hedging": "session",
     "spark.audit.enabled": "session",
     "spark.audit.memoryFraction": "session",
     "spark.audit.deviceBudget": "session",
@@ -130,6 +140,41 @@ class _Config:
     # server; the layer is otherwise pay-for-use — a process that never
     # starts a QueryServer runs zero serve code (no threads, no metrics).
     serve_enabled: bool = True
+    # Network serving front end (serve/net.py): the asyncio socket
+    # protocol over the QueryServer — HTTP/1.1 with chunked streaming
+    # pages plus the length-prefixed frame protocol. OFF by default
+    # (spark.serve.net.enabled): QueryServer.start() reads exactly this
+    # one flag when disabled — no socket, no event loop, no thread.
+    serve_net_enabled: bool = False
+    # Bind point (spark.serve.net.{host,port}): 127.0.0.1 by default —
+    # the same unauthenticated-endpoint security posture as the
+    # telemetry server; port 0 = ephemeral (tests/soak).
+    serve_net_host: str = "127.0.0.1"
+    serve_net_port: int = 0
+    # Listen backlog (spark.serve.net.backlog).
+    serve_net_backlog: int = 64
+    # Per-connection read/write timeout in ms
+    # (spark.serve.net.connTimeoutMs) — the slow-loris guard: a peer
+    # that stalls a request read or a response drain past this is cut
+    # with a net.conn_timeout recovery event, never held open.
+    serve_net_conn_timeout_ms: int = 10_000
+    # Bound on one wire request (frame payload / HTTP head+body) in
+    # bytes (spark.serve.net.maxFrameBytes): past it the request is
+    # refused with a structured error, bounding per-connection buffers.
+    serve_net_max_frame_bytes: int = 4 << 20
+    # Rows per streamed result page (spark.serve.net.streamPageRows):
+    # a large SELECT leaves the server one page at a time instead of
+    # materializing the whole response per client.
+    serve_net_stream_page_rows: int = 4096
+    # Resilient-client defaults (serve/client.py, RetryPolicy-backed):
+    # attempts per call (spark.serve.client.retries), first backoff in
+    # ms (spark.serve.client.backoffMs), and opt-in hedging — a second
+    # connection racing the first after one backoff interval
+    # (spark.serve.client.hedging; idempotency keys keep the hedge
+    # exactly-once server-side).
+    serve_client_retries: int = 3
+    serve_client_backoff_ms: float = 50.0
+    serve_client_hedging: bool = False
     # dqaudit — the jaxpr-level program-audit tier (analysis/program/):
     # gates the EXPLAIN `est peak` static-memory column and
     # session.audit_report() (spark.audit.enabled). The auditor is

@@ -2,12 +2,16 @@
 a shared plan/jit cache, admission control, and SLO observability.
 
 Entry points: :class:`QueryServer` (or ``session.serve()``),
-:class:`TenantQuota`, and the structured :class:`QueryResult`. See
+:class:`TenantQuota`, and the structured :class:`QueryResult`; the
+network front end is :class:`NetServer` + :class:`ResilientClient`
+(``spark.serve.net.*`` — see README § "Network serving"). See
 ``serve/server.py`` for the architecture and README § "Serving".
 """
 
 from .admission import AdmissionController, Rejection, TenantQuota
+from .client import ClientResult, ResilientClient, WireError
 from .http import TelemetryServer
+from .net import NetServer
 from .server import (MAX_TENANT_SERIES, QueryDeadlineExceeded,
                      QueryExecutionError, QueryFuture, QueryRefused,
                      QueryResult, QueryServer, ServeError, TenantContext)
@@ -17,4 +21,5 @@ __all__ = [
     "QueryServer", "QueryFuture", "QueryResult", "TenantContext",
     "ServeError", "QueryRefused", "QueryDeadlineExceeded",
     "QueryExecutionError", "MAX_TENANT_SERIES", "TelemetryServer",
+    "NetServer", "ResilientClient", "ClientResult", "WireError",
 ]

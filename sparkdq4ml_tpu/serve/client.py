@@ -1,0 +1,492 @@
+"""Resilient wire client for the network serving front end.
+
+The other half of :mod:`~.net`: a synchronous, dependency-free client
+that speaks both framings (``DQW1`` length-prefixed frames, or HTTP/1.1
+with chunked ndjson streaming) and wraps every request in the engine's
+own :class:`~..utils.recovery.RetryPolicy` — exponential backoff with
+deterministic jitter, a per-attempt socket timeout, and a total budget
+past which the caller gets a structured ``deadline_exceeded`` rather
+than a longer wait.
+
+**Exactly-once across retries** is the idempotency-key contract: every
+logical query carries one ``idem`` key (``uuid4``, constant across all
+retries AND hedges of that query); the server dedups on it, so an
+attempt that died after the server admitted the query — torn frame,
+reset mid-stream — re-attaches to the ORIGINAL job on retry instead of
+executing it a second time. Only a wire failure is retried; a
+structured server answer (rejection, shed, execution error, deadline)
+is final, with one exception — ``conn_timeout``, the server cutting a
+connection it judged too slow, which is a transport verdict and retries
+like any other wire fault.
+
+**The client never raises and never hangs** for request-shaped
+failures: every path returns a :class:`ClientResult` (wire faults
+exhaust into ``status="error", reason="net_exhausted"``), mirroring the
+``QueryResult``-never-raises contract server-side. Every retry/hedge
+lands in :data:`~..utils.recovery.RECOVERY_LOG` under site
+``net_client`` plus the ``net.client_retry`` / ``net.client_hedge``
+counters, so client-side resilience is as observable as the server's.
+
+**Hedging** (``spark.serve.client.hedging``, off by default): after one
+backoff interval without a response the client races a second
+connection carrying the SAME idempotency key; the first finished
+attempt wins and the dedup makes the loser harmless. Tail-latency
+insurance for read-mostly traffic — leave it off when queries are
+expensive, every hedge occupies a server waiter slot.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import socket
+import struct
+import threading
+import time
+import uuid
+from concurrent.futures import FIRST_COMPLETED, ThreadPoolExecutor, wait
+from typing import Any, Optional
+
+from ..config import config as _cfg
+from ..utils.profiling import counters
+from ..utils.recovery import RECOVERY_LOG, RetryPolicy
+from .net import MAGIC
+
+#: Statuses a server answer can carry; anything else on the wire is a
+#: protocol violation and treated as a wire fault (retried).
+_KNOWN_STATUSES = ("ok", "rejected", "shed", "deadline_exceeded", "error")
+
+
+class WireError(Exception):
+    """A transport-level failure of one attempt (reset, timeout, torn
+    frame, unparseable payload) — retried by the policy loop, never
+    surfaced to the caller directly."""
+
+
+@dataclasses.dataclass
+class ClientResult:
+    """Structured outcome of one logical query — ALWAYS returned, never
+    raised, whatever happened on the wire."""
+
+    status: str                  # ok | rejected | shed |
+    #                              deadline_exceeded | error
+    tenant: str = ""
+    value: Any = None            # merged pages (column dict) or scalar
+    pages: int = 0               # result pages streamed
+    reason: str = ""
+    detail: str = ""
+    error: str = ""
+    where: str = ""              # "client" when synthesized client-side
+    tag: Optional[str] = None
+    attempts: int = 1            # wire attempts spent (incl. hedges)
+    e2e_ms: Optional[float] = None   # server-side figure when present
+
+    @property
+    def ok(self) -> bool:
+        return self.status == "ok"
+
+
+class ResilientClient:
+    """One logical client over the :class:`~.net.NetServer` socket.
+
+    ``transport="frame"`` keeps ONE connection alive across queries
+    (reconnecting transparently after a wire fault); ``transport=
+    "http"`` opens one connection per request (the framing is
+    ``Connection: close``). Thread-safe per instance via a request
+    lock — for N concurrent client threads use N instances (the soak's
+    shape), not one shared one."""
+
+    def __init__(self, host: str, port: int, *,
+                 transport: str = "frame",
+                 tenant: str = "default",
+                 policy: Optional[RetryPolicy] = None,
+                 hedging: Optional[bool] = None,
+                 connect_timeout: float = 5.0):
+        if transport not in ("frame", "http"):
+            raise ValueError(f"transport must be 'frame' or 'http', "
+                             f"got {transport!r}")
+        self.host = host
+        self.port = int(port)
+        self.transport = transport
+        self.tenant = tenant
+        self.policy = policy if policy is not None else RetryPolicy(
+            max_attempts=max(1, int(_cfg.serve_client_retries)),
+            backoff_base=float(_cfg.serve_client_backoff_ms) / 1e3)
+        self.hedging = (bool(_cfg.serve_client_hedging)
+                        if hedging is None else bool(hedging))
+        self.connect_timeout = float(connect_timeout)
+        self._sock: Optional[socket.socket] = None
+        self._lock = threading.Lock()
+        self._hedge_pool: Optional[ThreadPoolExecutor] = None
+
+    # -- public API ----------------------------------------------------------
+    def query(self, sql: str, *, tenant: Optional[str] = None,
+              deadline_s: Optional[float] = None,
+              tag: Optional[str] = None) -> ClientResult:
+        """Run one SQL query; blocks until a structured result."""
+        return self._run({"sql": sql}, tenant=tenant,
+                         deadline_s=deadline_s, tag=tag)
+
+    def call_job(self, name: str, *, tenant: Optional[str] = None,
+                 deadline_s: Optional[float] = None,
+                 tag: Optional[str] = None) -> ClientResult:
+        """Invoke a server-side job registered via
+        :meth:`~.net.NetServer.register_job`."""
+        return self._run({"job": name}, tenant=tenant,
+                         deadline_s=deadline_s, tag=tag)
+
+    def healthz(self) -> dict:
+        """One HTTP health probe (works against either transport's
+        port — healthz is HTTP-only). Raises :class:`WireError` on a
+        dead endpoint; returns the decoded doc plus ``http_code``."""
+        try:
+            code, _, body = self._http_roundtrip(
+                b"GET /healthz HTTP/1.1\r\nHost: dq\r\n"
+                b"Connection: close\r\n\r\n",
+                timeout=self.connect_timeout)
+            doc = json.loads(body.decode() or "{}")
+            doc["http_code"] = code
+            return doc
+        except (OSError, ValueError) as e:
+            raise WireError(f"healthz probe failed: {e}") from e
+
+    def close(self) -> None:
+        with self._lock:
+            if self._sock is not None:
+                try:
+                    self._sock.close()
+                except OSError:
+                    pass
+                self._sock = None
+        if self._hedge_pool is not None:
+            self._hedge_pool.shutdown(wait=False)
+            self._hedge_pool = None
+
+    def __enter__(self) -> "ResilientClient":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.close()
+        return False
+
+    # -- retry engine --------------------------------------------------------
+    def _run(self, doc: dict, *, tenant: Optional[str],
+             deadline_s: Optional[float],
+             tag: Optional[str]) -> ClientResult:
+        doc = dict(doc)
+        doc["tenant"] = tenant if tenant is not None else self.tenant
+        if tag is not None:
+            doc["tag"] = tag
+        if deadline_s is not None:
+            # RELATIVE budget on the wire — clock-skew tolerant by
+            # construction (the server re-anchors on its own clock)
+            doc["deadline_ms"] = max(1.0, float(deadline_s) * 1e3)
+        doc["idem"] = uuid.uuid4().hex   # constant across retries+hedges
+        policy = self.policy
+        started = time.monotonic()
+        budget = policy.total_deadline
+        if deadline_s is not None:
+            # the wire deadline bounds the whole logical query too:
+            # past it the server answers deadline_exceeded anyway
+            slack = float(deadline_s) + 2.0 * policy.max_attempts
+            budget = slack if budget is None else min(budget, slack)
+        last_err = "no attempt ran"
+        attempts = 0
+        for attempt in range(1, policy.max_attempts + 1):
+            remaining = (None if budget is None
+                         else budget - (time.monotonic() - started))
+            if remaining is not None and remaining <= 0:
+                return ClientResult(
+                    status="deadline_exceeded", tenant=doc["tenant"],
+                    where="client", tag=tag, attempts=attempts,
+                    detail=f"client budget of {budget:.3g}s exhausted "
+                           f"after {attempts} attempt(s)")
+            attempts += 1
+            try:
+                result = self._hedged_attempt(doc, attempt, remaining)
+            except WireError as e:
+                last_err = str(e)
+                backoff = policy.backoff(attempt, "net_client")
+                action = ("retry" if attempt < policy.max_attempts
+                          else "exhausted")
+                RECOVERY_LOG.record("net_client", action,
+                                    attempt=attempt, cause=last_err,
+                                    backoff_s=backoff)
+                if action == "retry":
+                    counters.increment("net.client_retry")
+                    policy.sleep(backoff)
+                continue
+            if result.reason == "conn_timeout" \
+                    and attempt < policy.max_attempts:
+                # the server's slow-connection verdict: a transport
+                # outcome, retried like a reset
+                last_err = "server cut the connection (conn_timeout)"
+                backoff = policy.backoff(attempt, "net_client")
+                RECOVERY_LOG.record("net_client", "retry",
+                                    attempt=attempt, cause=last_err,
+                                    backoff_s=backoff)
+                counters.increment("net.client_retry")
+                policy.sleep(backoff)
+                continue
+            if attempt > 1:
+                RECOVERY_LOG.record("net_client", "recovered",
+                                    attempt=attempt)
+            result.attempts = attempts
+            return result
+        return ClientResult(
+            status="error", tenant=doc["tenant"], reason="net_exhausted",
+            where="client", tag=tag, attempts=attempts,
+            error=f"wire failed {attempts} attempt(s); last: {last_err}")
+
+    def _hedged_attempt(self, doc: dict, attempt: int,
+                        remaining: Optional[float]) -> ClientResult:
+        timeout = self._attempt_timeout(doc, remaining)
+        if not self.hedging:
+            return self._attempt(doc, timeout)
+        if self._hedge_pool is None:
+            self._hedge_pool = ThreadPoolExecutor(
+                max_workers=2, thread_name_prefix="sparkdq4ml-hedge")
+        primary = self._hedge_pool.submit(self._attempt, doc, timeout,
+                                          fresh=False)
+        done, _ = wait([primary],
+                       timeout=self.policy.backoff(max(1, attempt),
+                                                   "net_client") or 0.05,
+                       return_when=FIRST_COMPLETED)
+        if done:
+            return primary.result()
+        counters.increment("net.client_hedge")
+        RECOVERY_LOG.record("net_client", "hedge", attempt=attempt,
+                            detail="racing a second connection "
+                                   "(same idempotency key)")
+        hedge = self._hedge_pool.submit(self._attempt, doc, timeout,
+                                        fresh=True)
+        done, _ = wait([primary, hedge], timeout=timeout + 5.0,
+                       return_when=FIRST_COMPLETED)
+        for fut in (tuple(done) or (primary,)):
+            try:
+                return fut.result()
+            except WireError:
+                continue
+        # whichever finished raised; block on the other within budget
+        rest = [f for f in (primary, hedge) if not f.done()]
+        if rest:
+            done2, _ = wait(rest, timeout=timeout + 5.0)
+            for fut in done2:
+                try:
+                    return fut.result()
+                except WireError:
+                    continue
+        raise WireError("both hedged attempts failed")
+
+    def _attempt_timeout(self, doc: dict,
+                         remaining: Optional[float]) -> float:
+        timeout = self.policy.attempt_deadline
+        if timeout is None:
+            timeout = 30.0
+            if doc.get("deadline_ms") is not None:
+                timeout = doc["deadline_ms"] / 1e3 + 5.0
+        if remaining is not None:
+            timeout = max(0.1, min(timeout, remaining))
+        return timeout
+
+    # -- single attempt ------------------------------------------------------
+    def _attempt(self, doc: dict, timeout: float,
+                 fresh: bool = False) -> ClientResult:
+        try:
+            if self.transport == "frame":
+                end, pages, n = self._frame_roundtrip(doc, timeout, fresh)
+            else:
+                end, pages, n = self._http_query(doc, timeout)
+        except (OSError, ValueError, struct.error, WireError) as e:
+            raise WireError(f"{type(e).__name__}: {e}") from e
+        status = str(end.get("status", ""))
+        if status not in _KNOWN_STATUSES:
+            raise WireError(f"protocol violation: unknown status "
+                            f"{status!r} in end frame")
+        return ClientResult(
+            status=status, tenant=str(end.get("tenant", "")),
+            value=self._merge(pages, end), pages=n,
+            reason=str(end.get("reason", "")),
+            detail=str(end.get("detail", "")),
+            error=str(end.get("error", "")),
+            where=str(end.get("where", "")),
+            tag=end.get("tag"), e2e_ms=end.get("e2e_ms"))
+
+    @staticmethod
+    def _merge(pages: list, end: dict):
+        """Merged result value: row pages concatenate column-wise in
+        page order; a scalar rides in its single ``value`` page (or the
+        end doc)."""
+        if not pages:
+            return end.get("value")
+        if "value" in pages[0] and "rows" not in pages[0]:
+            return pages[0]["value"]
+        cols: dict[str, list] = {}
+        for page in pages:
+            for k, v in page.get("rows", {}).items():
+                cols.setdefault(k, []).extend(v)
+        return cols
+
+    # -- frame transport -----------------------------------------------------
+    def _frame_roundtrip(self, doc: dict, timeout: float, fresh: bool):
+        with self._lock if not fresh else _NoopLock():
+            sock = None
+            try:
+                if fresh:
+                    sock = self._connect()
+                else:
+                    if self._sock is None:
+                        self._sock = self._connect()
+                    sock = self._sock
+                sock.settimeout(timeout)
+                payload = json.dumps(doc).encode()
+                sock.sendall(struct.pack(">I", len(payload)) + payload)
+                pages: list = []
+                while True:
+                    frame = self._read_frame(sock)
+                    if frame.get("end"):
+                        return frame, pages, len(pages)
+                    pages.append(frame)
+            except (WireError, OSError, ValueError, struct.error) as e:
+                # the persistent connection is poisoned mid-exchange
+                # (truncated frame, reset, torn JSON): drop it so the
+                # retry reconnects clean instead of reusing a dead peer
+                if not fresh and sock is self._sock:
+                    self._sock = None
+                if sock is not None:
+                    try:
+                        sock.close()
+                    except OSError:
+                        pass
+                if isinstance(e, WireError):
+                    raise
+                raise WireError(f"{type(e).__name__}: {e}") from e
+            finally:
+                if fresh and sock is not None:
+                    try:
+                        sock.close()
+                    except OSError:
+                        pass
+
+    def _connect(self) -> socket.socket:
+        sock = socket.create_connection(
+            (self.host, self.port), timeout=self.connect_timeout)
+        sock.sendall(MAGIC)
+        return sock
+
+    @staticmethod
+    def _read_frame(sock: socket.socket) -> dict:
+        head = _read_exactly(sock, 4)
+        (length,) = struct.unpack(">I", head)
+        body = _read_exactly(sock, length)
+        frame = json.loads(body.decode())
+        if not isinstance(frame, dict):
+            raise WireError(f"non-object frame: {frame!r}")
+        return frame
+
+    # -- HTTP transport ------------------------------------------------------
+    def _http_query(self, doc: dict, timeout: float):
+        body = json.dumps(doc).encode()
+        head = (f"POST /query HTTP/1.1\r\nHost: dq\r\n"
+                "Content-Type: application/json\r\n"
+                f"Content-Length: {len(body)}\r\n"
+                "Connection: close\r\n\r\n").encode("latin-1")
+        code, headers, payload = self._http_roundtrip(head + body,
+                                                      timeout=timeout)
+        if "chunked" in headers.get("transfer-encoding", ""):
+            payload = _dechunk(payload)
+        lines = [ln for ln in payload.split(b"\n") if ln.strip()]
+        if not lines:
+            raise WireError(f"empty HTTP {code} response")
+        docs = [json.loads(ln.decode()) for ln in lines]
+        end = docs[-1]
+        if not isinstance(end, dict) or "status" not in end:
+            raise WireError(f"no status in HTTP {code} terminal line")
+        return end, docs[:-1], len(docs) - 1
+
+    def _http_roundtrip(self, request: bytes, timeout: float):
+        """One raw HTTP/1.1 exchange (Connection: close — read to EOF).
+        Hand-rolled over a plain socket rather than http.client so torn
+        responses surface as the wire faults they are."""
+        sock = socket.create_connection(
+            (self.host, self.port), timeout=self.connect_timeout)
+        try:
+            sock.settimeout(timeout)
+            sock.sendall(request)
+            raw = b""
+            while b"\r\n\r\n" not in raw:
+                chunk = sock.recv(65536)
+                if not chunk:
+                    raise WireError("connection closed in HTTP head")
+                raw += chunk
+            head, _, body = raw.partition(b"\r\n\r\n")
+            lines = head.decode("latin-1").split("\r\n")
+            code = int(lines[0].split()[1])
+            headers: dict[str, str] = {}
+            for line in lines[1:]:
+                name, _, value = line.partition(":")
+                headers[name.strip().lower()] = value.strip()
+            length = headers.get("content-length")
+            while True:
+                if length is not None and len(body) >= int(length):
+                    break
+                chunk = sock.recv(65536)
+                if not chunk:
+                    if length is not None and len(body) < int(length):
+                        raise WireError(
+                            f"truncated HTTP body ({len(body)}"
+                            f"/{length} bytes)")
+                    break
+                body += chunk
+            return code, headers, body
+        finally:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+
+class _NoopLock:
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+def _read_exactly(sock: socket.socket, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise WireError(f"connection closed mid-frame "
+                            f"({len(buf)}/{n} bytes)")
+        buf += chunk
+    return buf
+
+
+def _dechunk(payload: bytes) -> bytes:
+    """Decode a chunked transfer body; a missing terminal 0-chunk is a
+    torn stream (the partial_write fault made visible) → WireError."""
+    out, rest = b"", payload
+    while True:
+        line, sep, rest = rest.partition(b"\r\n")
+        if not sep:
+            raise WireError("torn chunked stream (no size line)")
+        try:
+            size = int(line.strip() or b"0", 16)
+        except ValueError as e:
+            raise WireError(f"bad chunk size {line!r}") from e
+        if size == 0:
+            return out
+        if len(rest) < size + 2:
+            raise WireError(f"torn chunk ({len(rest)}/{size} bytes)")
+        out += rest[:size]
+        rest = rest[size + 2:]
+
+
+def from_conf(host: str, port: int, **overrides) -> ResilientClient:
+    """Client wired from the active session's ``spark.serve.client.*``
+    conf (retries, backoffMs, hedging) — the conf-first construction
+    path mirroring ``QueryServer.from_conf``."""
+    return ResilientClient(host, port, **overrides)

@@ -186,7 +186,13 @@ class TelemetryServer:
                 "tenants": len(stats.get("tenants") or ()),
                 "open_breakers": open_breakers,
             })
-            if not stats["running"]:
+            if stats.get("draining"):
+                # drain window (begin_drain()/stop() in progress): the
+                # balancer must stop routing here NOW, even though
+                # in-flight work is still finishing — 503 from the
+                # first moment of the drain, not only once stopped
+                doc["status"] = "draining"
+            elif not stats["running"]:
                 doc["status"] = "stopped"
             elif open_breakers or saturated:
                 # degraded = load is being shed (breaker) or the queue

@@ -68,6 +68,7 @@ import time
 from typing import Any, Optional
 
 from ..config import CONF_FALSE
+from ..config import config as _cfg
 from ..utils import faults as _faults
 from ..utils import observability as _obs
 from ..utils.profiling import counters
@@ -347,7 +348,9 @@ class QueryServer:
         self._rr_idx = 0
         self._queued_total = 0
         self._accepting = False
+        self._draining = False         # stop()/begin_drain() in progress
         self._threads: list[threading.Thread] = []
+        self.net = None                # NetServer once started (net.py)
         # tenants granted a per-tenant latency series (MAX_TENANT_SERIES
         # cap); own lock — _finish runs while stop() may hold self._cond
         self._series_lock = threading.Lock()
@@ -396,12 +399,20 @@ class QueryServer:
     def running(self) -> bool:
         return self._accepting
 
+    @property
+    def draining(self) -> bool:
+        """True from drain start (``begin_drain``/``stop``) until a
+        stop completes — the window where /healthz answers 503 while
+        in-flight work still finishes."""
+        return self._draining
+
     def start(self) -> "QueryServer":
         """Spin up the worker pool (idempotent)."""
         with self._cond:
             if self._accepting:
                 return self
             self._accepting = True
+            self._draining = False
             # Stragglers a timed-out stop() left wedged in a device call
             # rejoin the pool the moment accepting flips back on (their
             # loop re-enters _next_job) — spawn only the difference, or
@@ -422,7 +433,25 @@ class QueryServer:
             self.telemetry = TelemetryServer(
                 self, host=self.metrics_host,
                 port=self.metrics_port).start()
+        # Network front end (serve/net.py): exactly ONE flag read when
+        # disabled — no import, no socket, no event loop, no thread
+        # (the same zero-cost-off contract as telemetry above).
+        if _cfg.serve_net_enabled and self.net is None:
+            from .net import NetServer
+
+            self.net = NetServer(self).start()
         return self
+
+    def begin_drain(self) -> None:
+        """Enter the drain window WITHOUT stopping: new submissions are
+        refused (structured shutdown rejection), /healthz flips to 503
+        so balancers stop routing here, but workers keep finishing
+        queued + in-flight jobs and the sockets stay up to deliver
+        their results. ``stop()`` completes the shutdown."""
+        with self._cond:
+            self._draining = True
+            self._accepting = False
+            self._cond.notify_all()
 
     def stop(self, drain: bool = True,
              timeout: Optional[float] = 30.0) -> None:
@@ -437,6 +466,15 @@ class QueryServer:
             if not self._accepting and not self._threads:
                 return
             self._accepting = False
+            self._draining = True
+        # The network front end drains FIRST, while the worker pool is
+        # still alive: its in-flight connections hold futures whose jobs
+        # the workers must still execute — stopping the pool first would
+        # strand every connected client on a dead queue.
+        net, self.net = self.net, None
+        if net is not None:
+            net.stop(drain=drain, timeout=timeout)
+        with self._cond:
             if not drain:
                 for state in self._tenants.values():
                     while state.queue:
@@ -466,6 +504,7 @@ class QueryServer:
         telemetry, self.telemetry = self.telemetry, None
         if telemetry is not None:
             telemetry.stop()
+        self._draining = False         # drain window over: fully stopped
 
     def __enter__(self) -> "QueryServer":
         return self.start()
@@ -887,6 +926,7 @@ class QueryServer:
             queued_total = self._queued_total
         return {
             "running": self.running,
+            "draining": self.draining,
             "workers": self.workers,
             "queue_depth": queued_total,
             "shared_plan_cache": self.shared_plan_cache,

@@ -258,6 +258,53 @@ class TpuSession:
                     _set(attr, False)
                 elif v in _CONF_TRUE:
                     _set(attr, True)
+            # Network serving front end (serve/net.py + serve/client.py),
+            # session-scoped like everything above:
+            #     .config("spark.serve.net.enabled", "true")  # socket on
+            #     .config("spark.serve.net.port", 8765)       # 0=ephemeral
+            #     .config("spark.serve.net.host", "0.0.0.0")  # widen bind
+            #     .config("spark.serve.net.connTimeoutMs", 5000)
+            #     .config("spark.serve.net.maxFrameBytes", 1 << 20)
+            #     .config("spark.serve.net.streamPageRows", 1024)
+            #     .config("spark.serve.client.retries", 5)
+            #     .config("spark.serve.client.backoffMs", 25)
+            #     .config("spark.serve.client.hedging", "true")
+            nval = str(self.conf.get("spark.serve.net.enabled",
+                                     "")).lower()
+            if nval in _CONF_FALSE:
+                _set("serve_net_enabled", False)
+            elif nval in _CONF_TRUE:
+                _set("serve_net_enabled", True)
+            if "spark.serve.net.port" in self.conf:
+                _set("serve_net_port",
+                     int(self.conf["spark.serve.net.port"]))
+            if "spark.serve.net.host" in self.conf:
+                _set("serve_net_host",
+                     str(self.conf["spark.serve.net.host"]))
+            if "spark.serve.net.backlog" in self.conf:
+                _set("serve_net_backlog",
+                     int(self.conf["spark.serve.net.backlog"]))
+            if "spark.serve.net.connTimeoutMs" in self.conf:
+                _set("serve_net_conn_timeout_ms",
+                     int(self.conf["spark.serve.net.connTimeoutMs"]))
+            if "spark.serve.net.maxFrameBytes" in self.conf:
+                _set("serve_net_max_frame_bytes",
+                     int(self.conf["spark.serve.net.maxFrameBytes"]))
+            if "spark.serve.net.streamPageRows" in self.conf:
+                _set("serve_net_stream_page_rows",
+                     int(self.conf["spark.serve.net.streamPageRows"]))
+            if "spark.serve.client.retries" in self.conf:
+                _set("serve_client_retries",
+                     int(self.conf["spark.serve.client.retries"]))
+            if "spark.serve.client.backoffMs" in self.conf:
+                _set("serve_client_backoff_ms",
+                     float(self.conf["spark.serve.client.backoffMs"]))
+            hval = str(self.conf.get("spark.serve.client.hedging",
+                                     "")).lower()
+            if hval in _CONF_FALSE:
+                _set("serve_client_hedging", False)
+            elif hval in _CONF_TRUE:
+                _set("serve_client_hedging", True)
             # dqaudit thresholds (analysis/program/), session-scoped like
             # everything above:
             #     .config("spark.audit.enabled", "false")  # no est peak

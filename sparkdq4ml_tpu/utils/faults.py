@@ -78,7 +78,8 @@ ENV_VAR = "SPARKDQ4ML_FAULTS"
 
 KINDS = ("device_error", "nan", "preempt", "device_drop",
          "io_error", "torn_chunk", "thread_death", "pool_exhaust",
-         "breaker_trip", "oom")
+         "breaker_trip", "oom", "conn_reset", "partial_write",
+         "stall", "slow_client")
 
 #: THE fault-site registry: site → the kinds its production hooks honor.
 #: Every ``inject``/``corrupt``/``fired``/``shrunk_budget``/
@@ -107,6 +108,9 @@ FAULT_SITES = {
     "stats_persist": ("io_error", "torn_chunk"),
     "optimizer": ("device_error",),
     "cost_profile": ("device_error",),
+    "net_accept": ("conn_reset",),
+    "net_read": ("conn_reset", "stall", "slow_client"),
+    "net_write": ("conn_reset", "partial_write", "stall"),
 }
 
 

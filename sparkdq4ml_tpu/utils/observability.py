@@ -167,6 +167,30 @@ METRIC_NAMES = {
     "serve.exec_ms": ("histogram", "execution wall per job"),
     "serve.e2e_ms": ("histogram", "client-experienced end-to-end "
                                   "latency"),
+    # network serving front end (serve/net.py + serve/client.py)
+    "net.accept": ("counter", "socket connections accepted"),
+    "net.requests": ("counter", "wire requests parsed (both framings)"),
+    "net.pages": ("counter", "result pages streamed"),
+    "net.bytes_in": ("counter", "request bytes read off the wire"),
+    "net.bytes_out": ("counter", "response bytes written to the wire"),
+    "net.conn_reset": ("counter", "connections dropped by a reset "
+                                  "(injected or real)"),
+    "net.conn_timeout": ("counter", "connections closed by the "
+                                    "read/write timeout (slow-loris "
+                                    "guard)"),
+    "net.partial_write": ("counter", "responses truncated mid-write"),
+    "net.frame_overflow": ("counter", "requests refused over "
+                                      "maxFrameBytes"),
+    "net.client_gone": ("counter", "mid-stream client disconnects "
+                                   "(result discarded via "
+                                   "serve.late_result)"),
+    "net.idem_hit": ("counter", "idempotency-key dedup hits (no "
+                                "re-execution)"),
+    "net.error_frames": ("counter", "structured error frames/responses "
+                                    "sent"),
+    "net.active": ("gauge", "open socket connections"),
+    "net.client_retry": ("counter", "resilient-client attempt retries"),
+    "net.client_hedge": ("counter", "resilient-client hedged attempts"),
     # cost-based plan optimizer (sql/optimizer.py + lowering hooks)
     "optimizer.rewrite": ("counter", "plan rewrites applied"),
     "optimizer.fallback": ("counter",
@@ -1154,6 +1178,8 @@ def _prom_num(v: float) -> str:
 _HELP_PREFIXES = (
     ("serve.", "query-serving layer: admission, queueing, per-tenant SLO "
      "(serve/)"),
+    ("net.", "network serving front end: socket protocol + resilient "
+     "client (serve/net.py, serve/client.py)"),
     ("recovery.", "resilience-layer event count (utils.recovery)"),
     ("pipeline.", "fused expression-pipeline compiler (ops/compiler.py)"),
     ("grouped.", "device-resident grouped execution (ops/segments.py)"),

@@ -83,6 +83,9 @@ class RecoveryEvent:
     action: str          # retry | fallback | recovered | exhausted |
     #                      circuit_open | circuit_skip | deadline |
     #                      preempted | resumed | checkpoint
+    # wire sites (net_accept/net_read/net_write in serve/net.py, and the
+    # client's net_client) add: conn_reset | partial_write | timeout |
+    # hedge — one event per fault the network ladder absorbed
     attempt: int = 0     # 1-based attempt within the current rung
     rung: str = ""       # ladder rung label ("primary", "single_device", …)
     cause: str = ""      # exception repr / "non-finite" / ""
