@@ -718,16 +718,25 @@ def bench_serving(session, data_path: str):
                               and len(ok) == len(results)),
         }
 
-    def run_socket_arm():
+    def run_socket_arm(tracing: bool = False):
         # Same closed-loop workload through REAL sockets (serve/net.py):
         # half the clients speak the length-prefixed frame protocol,
         # half HTTP/1.1 chunked streaming, all via the resilient client.
         # Latencies are CLIENT-side wall time per logical call, so the
         # delta vs the in-process arm IS the wire + framing overhead.
+        # ``tracing=True`` runs the identical workload with distributed
+        # tracing ON (context propagation, span trees, tail sampling) —
+        # the enabled-vs-disabled QPS pair is the tracing-overhead arm.
         from sparkdq4ml_tpu.serve import NetServer, ResilientClient
+        from sparkdq4ml_tpu.utils import observability as _obs
 
         compiler.clear_cache()
         segments.clear_cache()
+        was_tracing = _obs.TRACER.enabled
+        if tracing:
+            _obs.enable()
+        else:
+            _obs.disable()
         server = QueryServer(
             session, workers=workers, max_queue=4 * clients,
             default_quota=TenantQuota(max_in_flight=2,
@@ -772,6 +781,10 @@ def bench_serving(session, data_path: str):
         wall = time.perf_counter() - t0
         net.stop()
         server.stop()
+        if was_tracing:
+            _obs.enable()
+        else:
+            _obs.disable()
         ok = [r for r in results if r.ok]
         golden_ok = all(
             r.ok
@@ -796,11 +809,21 @@ def bench_serving(session, data_path: str):
     shared = run_arm(True)
     isolated = run_arm(False)
     socket_arm = run_socket_arm()
+    # (tracing overhead) the same socket workload with distributed
+    # tracing ON, then OFF again: tracing_enabled_qps is what the span
+    # pipeline costs live; the disabled repeat vs the baseline socket
+    # arm pins the one-flag-read contract — with tracing off the wire
+    # path is byte-identical, so the ratio must sit at ~1.0 (gated by
+    # eye + the test-suite no-op pin, not the regress gate: run-to-run
+    # QPS noise swamps a one-branch delta)
+    traced_arm = run_socket_arm(tracing=True)
+    untraced_arm = run_socket_arm(tracing=False)
     # drop the tenant-namespaced plans the isolated arm salted in
     compiler.clear_cache()
     segments.clear_cache()
     if not (shared["golden_ok"] and isolated["golden_ok"]
-            and socket_arm["golden_ok"]):
+            and socket_arm["golden_ok"] and traced_arm["golden_ok"]
+            and untraced_arm["golden_ok"]):
         log("ERROR: serving bench: a served query missed the golden "
             "numbers (count 24 / RMSE 2.8099) or failed outright")
         sys.exit(1)
@@ -815,6 +838,11 @@ def bench_serving(session, data_path: str):
         "socket_vs_inproc_qps": round(
             socket_arm["qps"] / shared["qps"], 2)
         if shared["qps"] else None,
+        "tracing_enabled_qps": traced_arm["qps"],
+        "tracing_disabled_qps": untraced_arm["qps"],
+        "tracing_disabled_overhead": round(
+            socket_arm["qps"] / untraced_arm["qps"], 3)
+        if untraced_arm["qps"] else None,
     }
     log(json.dumps(row))
     return row

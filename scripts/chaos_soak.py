@@ -26,6 +26,15 @@ contract:
   workload: zero scrape failures/hangs, and the admit == complete +
   error + deadline identity is asserted from the SCRAPED Prometheus
   text, not in-process state;
+* **tracing under fire** — the soak session runs with distributed
+  tracing ON (``spark.trace.*`` sized to hold a full sweep) and the
+  incident flight recorder armed (``spark.incident.dir``, cooldown
+  off): the scraper hits ``/trace`` + ``/incidents`` alongside
+  ``/metrics``, every wire-delivered result's ``trace_id`` must
+  resolve through ``/trace/<trace_id>`` (client-synthesized and
+  conn_timeout-cut results excluded — no server-side tree exists), and
+  every third seed's injected ``serve_admit:breaker_trip`` must leave
+  at least one incident bundle behind;
 * **stats persistence degrades, never crashes** — each seed writes the
   plan-statistics snapshot (``utils/statstore.py``) with the
   ``stats_persist`` fault site armed: an injected io_error/torn write
@@ -273,6 +282,8 @@ class _Scraper:
         self.last_metrics: dict = {}
         self.last_health: dict = {}
         self.last_profile: dict = {}
+        self.last_trace: dict = {}
+        self.last_incidents: dict = {}
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="chaos-scraper")
@@ -293,6 +304,15 @@ class _Scraper:
         with urllib.request.urlopen(self.base + "/profile?top=8",
                                     timeout=30) as resp:
             self.last_profile = json.loads(resp.read().decode())
+        # the tracing tier under fire: the span feed and the incident
+        # index must keep answering while the fault plan churns the
+        # tail sampler and the flight recorder underneath them
+        with urllib.request.urlopen(self.base + "/trace?limit=8",
+                                    timeout=10) as resp:
+            self.last_trace = json.loads(resp.read().decode())
+        with urllib.request.urlopen(self.base + "/incidents",
+                                    timeout=10) as resp:
+            self.last_incidents = json.loads(resp.read().decode())
         self.scrapes += 1
 
     def _loop(self) -> None:
@@ -359,6 +379,8 @@ def run_seed(session, seed: int, clients: int, queries: int, workers: int,
     except Exception as e:
         violations.append(f"baseline scrape failed: {e}")
     scrape0 = dict(scraper.last_metrics)
+    incidents0 = {r.get("id") for r in
+                  scraper.last_incidents.get("incidents", ())}
     plan = faults.install_plan(faults.parse_plan(schedule, seed=seed))
     results: list = []
     res_lock = threading.Lock()
@@ -518,6 +540,48 @@ def run_seed(session, seed: int, clients: int, queries: int, workers: int,
                 f"{d[keys[1]] + d[keys[2]] + d[keys[3]]:.0f}")
             break
         time.sleep(0.05)
+    # tracing arm: every wire result the SERVER delivered must resolve
+    # through /trace/<trace_id> on the live endpoint (client-synthesized
+    # and conn_timeout-cut results never reached a server-side tree —
+    # the same exclusion as n_wire above); new incident bundles are
+    # read from the scraped /incidents index, and every third seed's
+    # injected breaker_trip must have produced at least one
+    from sparkdq4ml_tpu.utils import observability as _obs_soak
+
+    traces_resolved = 0
+    new_incidents = 0
+    if _obs_soak.TRACER.enabled:
+        import urllib.request
+
+        wire_tids = {r.trace_id for r in results
+                     if getattr(r, "trace_id", None) is not None
+                     and getattr(r, "where", None) != "client"
+                     and getattr(r, "reason", None) != "conn_timeout"}
+        for tid in wire_tids:
+            # the wire layer finalizes a tree AFTER the client sees the
+            # end frame — a short poll absorbs that finally-block race
+            deadline = time.monotonic() + 5.0
+            while True:
+                try:
+                    with urllib.request.urlopen(
+                            f"{scraper.base}/trace/{tid}",
+                            timeout=10) as resp:
+                        json.loads(resp.read().decode())
+                    traces_resolved += 1
+                    break
+                except Exception as e:
+                    if time.monotonic() > deadline:
+                        violations.append(
+                            f"wire trace_id {tid} never resolved via "
+                            f"/trace/<id>: {type(e).__name__}: {e}")
+                        break
+                    time.sleep(0.05)
+        new_incidents = len(
+            {r.get("id") for r in
+             scraper.last_incidents.get("incidents", ())} - incidents0)
+        if seed % 3 == 0 and new_incidents < 1:
+            violations.append(
+                "injected breaker_trip seed wrote no incident bundle")
     if net is not None:
         net.stop(drain=True)
     if scraper.failures:
@@ -599,6 +663,8 @@ def run_seed(session, seed: int, clients: int, queries: int, workers: int,
         "breakers_probed": len(open_keys),
         "breakers_recovered": recovered,
         "scrapes": scraper.scrapes,
+        "traces_resolved": traces_resolved,
+        "incidents_written": new_incidents,
         "net_faults_fired": sum(net_fired.values()),
         "net_client_retries": delta.get("net.client_retry", 0),
         "net_idem_hits": delta.get("net.idem_hit", 0),
@@ -622,7 +688,11 @@ def run_soak(seeds=None, clients=None, queries=1, workers=8,
     from sparkdq4ml_tpu.config import config
 
     created_here = False
+    incident_dir = None
     if session is None:
+        import tempfile
+
+        incident_dir = tempfile.mkdtemp(prefix="chaos_incidents_")
         session = (dq.TpuSession.builder().app_name("chaos-soak")
                    .master("local[*]")
                    # tiny chunks: the 320-byte headline CSV streams, so
@@ -635,6 +705,17 @@ def run_soak(seeds=None, clients=None, queries=1, workers=8,
                    # exposes a multi-device mesh (inert on one device)
                    .config("spark.shard.enabled", "true")
                    .config("spark.shard.minRows", "8")
+                   # the tracing tier rides the whole soak: every wire
+                   # result must resolve via /trace/<id>, so the ring
+                   # holds a full sweep's worth of healthy trees, and
+                   # the flight recorder (cooldown off) must bundle
+                   # every third seed's injected breaker trip
+                   .config("spark.observability.enabled", "true")
+                   .config("spark.trace.ringSize", "8192")
+                   .config("spark.trace.retainedSize", "4096")
+                   .config("spark.incident.dir", incident_dir)
+                   .config("spark.incident.maxBundles", "256")
+                   .config("spark.incident.cooldownS", "0")
                    .get_or_create())
         created_here = True
     seeds = int(config.chaos_seeds if seeds is None else seeds)
@@ -660,6 +741,10 @@ def run_soak(seeds=None, clients=None, queries=1, workers=8,
             pass
         if created_here:
             session.stop()
+            if incident_dir is not None:
+                import shutil
+
+                shutil.rmtree(incident_dir, ignore_errors=True)
     bad = [r for r in rows if r["violations"]]
     summary = {
         "seeds": seeds, "clients": clients, "queries_per_client": queries,
@@ -668,6 +753,8 @@ def run_soak(seeds=None, clients=None, queries=1, workers=8,
         "net_faults_fired": sum(r["net_faults_fired"] for r in rows),
         "net_client_retries": sum(r["net_client_retries"] for r in rows),
         "net_idem_hits": sum(r["net_idem_hits"] for r in rows),
+        "traces_resolved": sum(r["traces_resolved"] for r in rows),
+        "incidents_written": sum(r["incidents_written"] for r in rows),
         "failed_seeds": [r["seed"] for r in bad],
         "queries": sum(r["queries"] for r in rows),
         "completed": sum(r["completed"] for r in rows),

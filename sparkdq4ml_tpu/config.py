@@ -72,6 +72,14 @@ CONF_KEYS = {
     "spark.costprof.enabled": "session",
     "spark.costprof.ridge": "session",
     "spark.profiling.maxCaptures": "session",
+    "spark.trace.ringSize": "session",
+    "spark.trace.retainedSize": "session",
+    "spark.trace.exemplars": "session",
+    "spark.incident.enabled": "session",
+    "spark.incident.dir": "session",
+    "spark.incident.maxBundles": "session",
+    "spark.incident.cooldownS": "session",
+    "spark.incident.sloBurnThreshold": "session",
     "spark.observability.enabled": "init",
     "spark.observability.maxSpans": "init",
     "spark.observability.logSpans": "init",
@@ -289,6 +297,33 @@ class _Config:
     # (spark.profiling.maxCaptures): utils/profiling.start_capture
     # prunes the oldest capture directories past this count.
     profiling_max_captures: int = 4
+    # Tail-based request-tree retention (utils/observability.TailSampler):
+    # bounded ring of recently completed serving request trees
+    # (spark.trace.ringSize) and bounded retained store of keep-policy
+    # promoted trees keyed by wire trace id (spark.trace.retainedSize).
+    # Only populated while observability is enabled — disabled mode
+    # registers nothing.
+    trace_ring_size: int = 256
+    trace_retained_size: int = 64
+    # Emit OpenMetrics exemplars on histogram buckets (the last kept
+    # trace id per serve.e2e_ms bucket) in the Prometheus exporter
+    # (spark.trace.exemplars) — off by default: exemplar suffixes are an
+    # OpenMetrics extension some plain-Prometheus scrapers reject.
+    trace_exemplars: bool = False
+    # Incident flight recorder (utils/incidents.py): on a trigger
+    # (breaker trip, fault-ladder engagement, SLO burn crossing
+    # spark.incident.sloBurnThreshold) snapshot a correlated incident
+    # bundle — request span tree, metrics deltas, RECOVERY_LOG slice,
+    # plan/stats rows. Active only while observability is enabled AND
+    # (spark.incident.enabled or spark.incident.dir is set); bundles
+    # persist atomically to spark.incident.dir (empty = in-memory only),
+    # retention-capped at spark.incident.maxBundles, rate-limited per
+    # trigger kind by spark.incident.cooldownS.
+    incident_enabled: bool = False
+    incident_dir: str = ""
+    incident_max_bundles: int = 32
+    incident_cooldown_s: float = 5.0
+    incident_slo_burn_threshold: float = 8.0
     # Pallas fast-path selection for the hot ops (ops/pallas_kernels.py):
     # the single-device Gramian in solvers.augmented_gram and the fused DQ
     # chain entry point ops/rules.py:dq_rules_fused. "off" = plain XLA

@@ -39,6 +39,7 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import os
 import socket
 import struct
 import threading
@@ -48,6 +49,7 @@ from concurrent.futures import FIRST_COMPLETED, ThreadPoolExecutor, wait
 from typing import Any, Optional
 
 from ..config import config as _cfg
+from ..utils import observability as _obs
 from ..utils.profiling import counters
 from ..utils.recovery import RECOVERY_LOG, RetryPolicy
 from .net import MAGIC
@@ -80,6 +82,11 @@ class ClientResult:
     tag: Optional[str] = None
     attempts: int = 1            # wire attempts spent (incl. hedges)
     e2e_ms: Optional[float] = None   # server-side figure when present
+    #: Wire trace id of the logical query (constant across retries and
+    #: hedges) — joins this result to the server-side span tree via
+    #: ``/trace/<trace_id>``. None when tracing was off client-side AND
+    #: the server echoed none.
+    trace_id: Optional[str] = None
 
     @property
     def ok(self) -> bool:
@@ -182,6 +189,10 @@ class ResilientClient:
             # construction (the server re-anchors on its own clock)
             doc["deadline_ms"] = max(1.0, float(deadline_s) * 1e3)
         doc["idem"] = uuid.uuid4().hex   # constant across retries+hedges
+        # One flag read: with tracing off no context is minted and the
+        # wire doc stays byte-identical to the untraced protocol.
+        trace = _obs.TraceContext.mint() if _obs.TRACER.enabled else None
+        trace_id = trace.trace_id if trace is not None else None
         policy = self.policy
         started = time.monotonic()
         budget = policy.total_deadline
@@ -199,11 +210,19 @@ class ResilientClient:
                 return ClientResult(
                     status="deadline_exceeded", tenant=doc["tenant"],
                     where="client", tag=tag, attempts=attempts,
+                    trace_id=trace_id,
                     detail=f"client budget of {budget:.3g}s exhausted "
                            f"after {attempts} attempt(s)")
             attempts += 1
+            attempt_doc = doc
+            if trace is not None:
+                # same trace id every attempt, a FRESH child span id per
+                # attempt — the server tells retries and hedges apart
+                attempt_doc = dict(doc)
+                attempt_doc["traceparent"] = trace.child_traceparent()
             try:
-                result = self._hedged_attempt(doc, attempt, remaining)
+                result = self._hedged_attempt(attempt_doc, attempt,
+                                              remaining)
             except WireError as e:
                 last_err = str(e)
                 backoff = policy.backoff(attempt, "net_client")
@@ -232,10 +251,13 @@ class ResilientClient:
                 RECOVERY_LOG.record("net_client", "recovered",
                                     attempt=attempt)
             result.attempts = attempts
+            if result.trace_id is None:
+                result.trace_id = trace_id
             return result
         return ClientResult(
             status="error", tenant=doc["tenant"], reason="net_exhausted",
             where="client", tag=tag, attempts=attempts,
+            trace_id=trace_id,
             error=f"wire failed {attempts} attempt(s); last: {last_err}")
 
     def _hedged_attempt(self, doc: dict, attempt: int,
@@ -258,7 +280,8 @@ class ResilientClient:
         RECOVERY_LOG.record("net_client", "hedge", attempt=attempt,
                             detail="racing a second connection "
                                    "(same idempotency key)")
-        hedge = self._hedge_pool.submit(self._attempt, doc, timeout,
+        hedge = self._hedge_pool.submit(self._attempt,
+                                        self._hedge_doc(doc), timeout,
                                         fresh=True)
         done, _ = wait([primary, hedge], timeout=timeout + 5.0,
                        return_when=FIRST_COMPLETED)
@@ -277,6 +300,18 @@ class ResilientClient:
                 except WireError:
                     continue
         raise WireError("both hedged attempts failed")
+
+    @staticmethod
+    def _hedge_doc(doc: dict) -> dict:
+        """The hedge carries the same trace id but its own child span id
+        (it IS a distinct wire attempt); without a traceparent the doc
+        passes through untouched."""
+        tp = doc.get("traceparent")
+        if not tp:
+            return doc
+        hedged = dict(doc)
+        hedged["traceparent"] = f"00-{tp[3:35]}-{os.urandom(8).hex()}-01"
+        return hedged
 
     def _attempt_timeout(self, doc: dict,
                          remaining: Optional[float]) -> float:
@@ -310,7 +345,8 @@ class ResilientClient:
             detail=str(end.get("detail", "")),
             error=str(end.get("error", "")),
             where=str(end.get("where", "")),
-            tag=end.get("tag"), e2e_ms=end.get("e2e_ms"))
+            tag=end.get("tag"), e2e_ms=end.get("e2e_ms"),
+            trace_id=end.get("trace_id"))
 
     @staticmethod
     def _merge(pages: list, end: dict):
@@ -386,10 +422,15 @@ class ResilientClient:
 
     # -- HTTP transport ------------------------------------------------------
     def _http_query(self, doc: dict, timeout: float):
+        doc = dict(doc)
+        # HTTP carries the context in the standard header, not the body
+        traceparent = doc.pop("traceparent", None)
         body = json.dumps(doc).encode()
         head = (f"POST /query HTTP/1.1\r\nHost: dq\r\n"
                 "Content-Type: application/json\r\n"
-                f"Content-Length: {len(body)}\r\n"
+                + (f"traceparent: {traceparent}\r\n"
+                   if traceparent else "")
+                + f"Content-Length: {len(body)}\r\n"
                 "Connection: close\r\n\r\n").encode("latin-1")
         code, headers, payload = self._http_roundtrip(head + body,
                                                       timeout=timeout)

@@ -19,7 +19,15 @@ route      payload
 /plans     the plan-statistics observatory (``utils.statstore``) report:
            per-plan-key selectivity, wall/compile digests, byte bounds
 /trace     recent finished spans as JSON (bounded tail of the span
-           buffer) — the "what just happened" view
+           buffer) — the "what just happened" view. ``?trace_id=``
+           filters to one wire trace, ``?limit=N`` bounds the tail
+/trace/    every completed span TREE for one wire trace id (the id a
+<id>       client holds from its ``ClientResult.trace_id``) from the
+           tail sampler — retained store first, recent ring fallback;
+           404 when the id aged out of both
+/incidents flight-recorder index: bounded listing of captured incident
+           bundles (id, trigger, time, trace id); ``/incidents/<id>``
+           returns one full bundle (404 on miss)
 /profile   the device-cost observatory (``utils.costprof``) report:
            per-plan AOT cost profile (flops/bytes/collective traffic)
            joined with statstore wall history into achieved GFLOP/s /
@@ -106,7 +114,7 @@ class TelemetryServer:
             name="sparkdq4ml-telemetry")
         self._thread.start()
         logger.info("telemetry endpoint on http://%s:%d "
-                    "(/metrics /healthz /plans /trace)",
+                    "(/metrics /healthz /plans /trace /incidents)",
                     self.host, self.port)
         return self
 
@@ -137,16 +145,26 @@ class TelemetryServer:
             elif path == "/plans":
                 body, ctype, code = self._plans()
             elif path == "/trace":
-                body, ctype, code = self._trace()
+                body, ctype, code = self._trace(req.path)
             elif path == "/profile":
                 body, ctype, code = self._profile(req.path)
             elif path == "/profile/trace":
                 body, ctype, code = self._profile_trace(req.path)
+            elif path.startswith("/trace/"):
+                body, ctype, code = self._trace_tree(
+                    path[len("/trace/"):])
+            elif path == "/incidents":
+                body, ctype, code = self._incidents()
+            elif path.startswith("/incidents/"):
+                body, ctype, code = self._incident(
+                    path[len("/incidents/"):])
             else:
                 body, ctype, code = (
                     json.dumps({"error": "unknown route", "routes": [
                         "/metrics", "/healthz", "/plans", "/trace",
-                        "/profile", "/profile/trace"]}),
+                        "/trace/<trace_id>", "/incidents",
+                        "/incidents/<id>", "/profile",
+                        "/profile/trace"]}),
                     "application/json", 404)
         except Exception as e:   # a route bug must answer, not hang
             logger.debug("telemetry route failed", exc_info=True)
@@ -266,17 +284,61 @@ class TelemetryServer:
                                            _profiling.MAX_CAPTURE_S)}),
                 "application/json", 200)
 
-    def _trace(self):
+    def _trace(self, raw_path: str):
         from ..utils import observability as _obs
 
-        spans = _obs.TRACER.spans()[-TRACE_TAIL:]
+        params = self._query_params(raw_path)
+        try:
+            limit = min(int(params.get("limit", TRACE_TAIL)),
+                        TRACE_TAIL)
+        except ValueError:
+            limit = TRACE_TAIL
+        wanted = params.get("trace_id")
+        spans = _obs.TRACER.spans()
+        if wanted:
+            # the filter matches the WIRE trace id (what a client holds)
+            # as well as the internal one, so either join key works
+            spans = [s for s in spans
+                     if str(s.trace_id) == wanted
+                     or s.attrs.get("wire_trace_id") == wanted]
         rows = [{
             "name": s.name, "cat": s.cat, "trace_id": s.trace_id,
             "span_id": s.sid, "parent_id": s.parent_id, "tid": s.tid,
             "ts_us": s.ts_us, "dur_us": s.dur_us,
             "attrs": {k: v for k, v in s.attrs.items()},
-        } for s in spans]
+        } for s in spans[-max(0, limit):]]
         return (json.dumps({"spans": rows, "dropped": _obs.TRACER.dropped,
                             "enabled": _obs.TRACER.enabled},
                            default=_json_default),
+                "application/json", 200)
+
+    def _trace_tree(self, trace_id: str):
+        from ..utils import observability as _obs
+
+        trees = _obs.TAIL.lookup(trace_id)
+        if not trees:
+            return (json.dumps({"error": "unknown trace_id",
+                                "trace_id": trace_id}),
+                    "application/json", 404)
+        return (json.dumps({"trace_id": trace_id, "trees": trees},
+                           default=_json_default),
+                "application/json", 200)
+
+    def _incidents(self):
+        from ..utils import incidents as _incidents
+
+        return (json.dumps({"incidents": _incidents.RECORDER.list(),
+                            "recorder": _incidents.RECORDER.report()},
+                           default=_json_default),
+                "application/json", 200)
+
+    def _incident(self, incident_id: str):
+        from ..utils import incidents as _incidents
+
+        bundle = _incidents.RECORDER.get(incident_id)
+        if bundle is None:
+            return (json.dumps({"error": "unknown incident",
+                                "id": incident_id}),
+                    "application/json", 404)
+        return (json.dumps(bundle, default=_json_default),
                 "application/json", 200)

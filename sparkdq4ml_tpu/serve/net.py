@@ -81,6 +81,7 @@ from typing import Callable, Optional
 
 from ..config import config as _cfg
 from ..utils import faults as _faults
+from ..utils import incidents as _incidents
 from ..utils import observability as _obs
 from ..utils.profiling import counters
 from ..utils.recovery import RECOVERY_LOG
@@ -476,6 +477,14 @@ class NetServer:
                 counters.increment("net.error_frames")
             except Exception:
                 pass
+        if _obs.TRACER.enabled:
+            # the timeout ladder cut a connection — flight-recorder
+            # trigger (per-trigger cooldown bounds repeat captures)
+            _incidents.RECORDER.record(
+                "fault_ladder",
+                detail=f"net conn_timeout cut "
+                       f"({self.conn_timeout_s:.3g}s, "
+                       f"proto {conn.proto})")
         self._abort(conn)
 
     # -- frame protocol ------------------------------------------------------
@@ -509,14 +518,23 @@ class NetServer:
                     error=f"unparseable frame: {e}"), pages=0)
                 return
             result, fut = await self._submit_and_wait(conn, req)
+            ctx = self._trace_ctx(fut)
+            t_stream = time.perf_counter()
             pages = 0
-            if result.status == "ok":
-                for page in self._pages(result.value):
-                    page["page"] = pages
-                    await self._send_frame(conn, page)
-                    pages += 1
-                    counters.increment("net.pages")
-            await self._send_end(conn, result, pages=pages)
+            try:
+                if result.status == "ok":
+                    for page in self._pages(result.value):
+                        page["page"] = pages
+                        await self._send_frame(conn, page)
+                        pages += 1
+                        counters.increment("net.pages")
+                await self._send_end(
+                    conn, result, pages=pages,
+                    trace_id=ctx.trace_id if ctx is not None else None)
+            finally:
+                self._finish_trace(
+                    ctx, pages=pages, proto="frame",
+                    stream_ms=(time.perf_counter() - t_stream) * 1e3)
 
     async def _send_frame(self, conn: _Conn, doc: dict) -> None:
         payload = json.dumps(doc, default=_json_default).encode()
@@ -524,10 +542,16 @@ class NetServer:
         await self._write(conn, data)
 
     async def _send_end(self, conn: _Conn, result: QueryResult,
-                        pages: int) -> None:
+                        pages: int,
+                        trace_id: Optional[str] = None) -> None:
         doc = self._end_doc(result)
         doc["end"] = True
         doc["pages"] = pages
+        if trace_id is not None:
+            # echo the wire trace id so every client-held result is
+            # joinable with the server-side tree; with tracing disabled
+            # the frame stays byte-identical (no trace_id key at all)
+            doc["trace_id"] = trace_id
         if result.status != "ok":
             counters.increment("net.error_frames")
         await self._send_frame(conn, doc)
@@ -572,17 +596,34 @@ class NetServer:
         for header, field in (("x-dq-tenant", "tenant"),
                               ("x-dq-deadline-ms", "deadline_ms"),
                               ("x-dq-idempotency-key", "idem"),
-                              ("x-dq-tag", "tag")):
+                              ("x-dq-tag", "tag"),
+                              ("traceparent", "traceparent")):
             if header in headers:
                 req[field] = headers[header]
         result, fut = await self._submit_and_wait(conn, req)
+        ctx = self._trace_ctx(fut)
+        trace_id = ctx.trace_id if ctx is not None else None
         if result.status != "ok":
             counters.increment("net.error_frames")
-            await self._send_http_doc(
-                conn, _STATUS_HTTP.get(result.status, 500),
-                self._end_doc(result))
+            doc = self._end_doc(result)
+            if trace_id is not None:
+                doc["trace_id"] = trace_id
+            try:
+                await self._send_http_doc(
+                    conn, _STATUS_HTTP.get(result.status, 500), doc)
+            finally:
+                self._finish_trace(ctx, pages=0, stream_ms=0.0,
+                                   proto="http")
             return
-        await self._stream_http(conn, result)
+        t_stream = time.perf_counter()
+        pages = 0
+        try:
+            pages = await self._stream_http(conn, result,
+                                            trace_id=trace_id)
+        finally:
+            self._finish_trace(
+                ctx, pages=pages, proto="http",
+                stream_ms=(time.perf_counter() - t_stream) * 1e3)
 
     async def _read_http(self, conn: _Conn):
         request_line = (await conn.read_line(self.max_frame_bytes)) \
@@ -630,8 +671,8 @@ class NetServer:
             return
         await self._write(conn, head + payload)
 
-    async def _stream_http(self, conn: _Conn,
-                           result: QueryResult) -> None:
+    async def _stream_http(self, conn: _Conn, result: QueryResult,
+                           trace_id: Optional[str] = None) -> int:
         head = ("HTTP/1.1 200 OK\r\n"
                 "Content-Type: application/x-ndjson\r\n"
                 "Transfer-Encoding: chunked\r\n"
@@ -647,8 +688,11 @@ class NetServer:
         end = self._end_doc(result)
         end["end"] = True        # same self-describing marker as frames
         end["pages"] = pages
+        if trace_id is not None:
+            end["trace_id"] = trace_id
         await self._write_chunk(conn, end)
         await self._write(conn, b"0\r\n\r\n")
+        return pages
 
     async def _write_chunk(self, conn: _Conn, doc: dict) -> None:
         line = json.dumps(doc, default=_json_default).encode() + b"\n"
@@ -715,6 +759,10 @@ class NetServer:
             counters.increment("net.client_gone")
             self._abandon(fut)
             await res_task
+            # the abandon verdict is in; nobody will stream, so the
+            # deferred tree finalizes here (no-op if never opened)
+            self._finish_trace(self._trace_ctx(fut), pages=0,
+                               stream_ms=0.0, proto=conn.proto or "")
             raise _Abort()
         finally:
             if watch is not None and not watch.done():
@@ -729,6 +777,30 @@ class NetServer:
                 status="error", tenant=job.tenant, tag=job.tag,
                 reason="result_bound",
                 error=f"no result within the {bound:.0f}s wire bound")
+
+    # -- tracing bridge ------------------------------------------------------
+    @staticmethod
+    def _trace_ctx(fut) -> Optional["_obs.TraceContext"]:
+        """The request's adopted trace context (None for pre-admission
+        refusals, which never reached ``submit``)."""
+        if fut is None:
+            return None
+        trace = getattr(getattr(fut, "_job", None), "trace", None)
+        return trace if isinstance(trace, _obs.TraceContext) else None
+
+    @staticmethod
+    def _finish_trace(ctx, *, pages: int, stream_ms: float,
+                      proto: str) -> None:
+        """Wire-side finalization of a deferred request tree: a
+        back-dated ``serve.stream`` span for the page write-out, then
+        the tail sampler's keep-policy completion. Idempotent."""
+        if ctx is None or not _obs.TRACER.enabled:
+            return
+        if ctx.root_sid is not None and pages:
+            _obs.emit_span("serve.stream", cat="serve",
+                           dur_ms=stream_ms, ctx=ctx, pages=pages,
+                           proto=proto)
+        _obs.TAIL.complete(ctx)
 
     def _abandon(self, fut: QueryFuture) -> None:
         job = fut._job
@@ -764,9 +836,17 @@ class NetServer:
                 raise _BadRequest(
                     "bad_request",
                     f"bad deadline_ms {req['deadline_ms']!r}")
+        # ONE flag read: with tracing on, the wire traceparent (frame doc
+        # field / HTTP header) becomes the request's context — malformed
+        # or absent degrades to a locally-minted root, NEVER an error.
+        # defer=True: this wire layer finalizes the tree after streaming.
+        trace = (_obs.TraceContext.adopt(req.get("traceparent"),
+                                         defer=True)
+                 if _obs.TRACER.enabled else None)
         fut = self.server.submit(
             work, tenant=tenant, deadline_s=deadline_s,
-            tag=str(req["tag"]) if req.get("tag") is not None else None)
+            tag=str(req["tag"]) if req.get("tag") is not None else None,
+            trace=trace)
         if idem:
             with self._idem_lock:
                 self._idem[idem] = fut

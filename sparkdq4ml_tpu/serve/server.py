@@ -70,6 +70,7 @@ from typing import Any, Optional
 from ..config import CONF_FALSE
 from ..config import config as _cfg
 from ..utils import faults as _faults
+from ..utils import incidents as _incidents
 from ..utils import observability as _obs
 from ..utils.profiling import counters
 from ..utils.recovery import CircuitBreaker
@@ -157,13 +158,16 @@ class _Job:
 
     __slots__ = ("work", "tenant", "tag", "deadline_s", "deadline_ts",
                  "t_submit", "est_bytes", "collect_stats", "attempts",
-                 "_event", "_lock", "result")
+                 "trace", "_event", "_lock", "result")
 
     def __init__(self, work, tenant, tag, deadline_s, est_bytes,
-                 collect_stats):
+                 collect_stats, trace=None):
         self.work = work
         self.tenant = tenant
         self.tag = tag
+        # wire trace context (observability.TraceContext once adopted by
+        # _execute; None with tracing off — the disabled-mode no-op)
+        self.trace = trace
         self.deadline_s = deadline_s
         self.t_submit = time.perf_counter()
         self.deadline_ts = (None if deadline_s is None
@@ -571,7 +575,8 @@ class QueryServer:
                deadline_s: Optional[float] = None,
                est_bytes: Optional[int] = None,
                collect_stats: bool = False,
-               tag: Optional[str] = None) -> QueryFuture:
+               tag: Optional[str] = None,
+               trace=None) -> QueryFuture:
         """Submit one query for ``tenant``.
 
         ``work`` is either a SQL string (run against the tenant's
@@ -581,7 +586,9 @@ class QueryServer:
         declares the job's estimated device footprint for the memory
         gate; ``deadline_s`` (default ``default_deadline_s``) bounds the
         query end-to-end; ``collect_stats`` attaches a per-query
-        ``QueryStatsCollector`` to the result."""
+        ``QueryStatsCollector`` to the result; ``trace`` carries the
+        wire trace context (a ``TraceContext`` or raw ``traceparent``
+        string) the executing span tree adopts as its root."""
         if isinstance(work, str):
             sql_text = work
             work = lambda ctx: ctx.sql(sql_text)   # noqa: E731
@@ -590,7 +597,8 @@ class QueryServer:
                             f"taking a TenantContext, got {type(work)}")
         if deadline_s is None:
             deadline_s = self.default_deadline_s
-        job = _Job(work, tenant, tag, deadline_s, est_bytes, collect_stats)
+        job = _Job(work, tenant, tag, deadline_s, est_bytes, collect_stats,
+                   trace=trace)
         # Take the memory-gate census BEFORE the scheduler lock: it walks
         # every live jax array, and holding self._cond through that scan
         # would stall every worker and submitter. Advisory gate — the
@@ -615,6 +623,13 @@ class QueryServer:
         if _faults.active() is not None:
             if _faults.fired("serve_admit", "breaker_trip"):
                 self.breaker.trip(self.admission.breaker_key(tenant))
+                # a breaker transition is a flight-recorder trigger
+                # whether the trip was organic or injected
+                if _obs.TRACER.enabled:
+                    _incidents.RECORDER.record(
+                        "breaker_trip",
+                        detail=f"injected trip, tenant {tenant!r}",
+                        extra={"breaker": self.breaker.snapshot()})
             if _faults.fired("serve_admit", "oom"):
                 injected = AdmissionController._reject(
                     "memory", "injected allocator-census OOM "
@@ -638,6 +653,22 @@ class QueryServer:
                     len(existing.queue) if existing is not None else 0,
                     est_bytes=est_bytes, live_bytes=live)
             if verdict is not None:
+                if _obs.TRACER.enabled and trace is not None:
+                    # a refused wire request still gets a (one-span)
+                    # tree: its echoed trace_id must resolve via
+                    # /trace/<id> like any admitted request's — opened
+                    # BEFORE resolve() so the wire layer's completion
+                    # hook cannot race an unregistered context
+                    ctx = _obs.TraceContext.adopt(trace)
+                    job.trace = ctx
+                    with _obs.request_span("serve.query", ctx,
+                                           tenant=tenant,
+                                           rejected=verdict.status):
+                        pass
+                    _obs.TAIL.finish_request(
+                        ctx, status=verdict.status,
+                        reason=verdict.reason, e2e_ms=None,
+                        breaker_opened=False, slo_ms=self.slo_p99_ms)
                 job.resolve(QueryResult(
                     status=verdict.status, tenant=tenant, tag=tag,
                     reason=verdict.reason, detail=verdict.detail))
@@ -689,7 +720,19 @@ class QueryServer:
     def _execute(self, job: _Job, state: _TenantState) -> None:
         t_start = time.perf_counter()
         queue_ms = (t_start - job.t_submit) * 1e3
+        # ONE flag read adopts (or locally mints) the request's wire
+        # trace context; disabled mode allocates nothing and the span
+        # below is the shared no-op.
+        trace = (_obs.TraceContext.adopt(job.trace)
+                 if _obs.TRACER.enabled else None)
+        job.trace = trace
         if job.deadline_ts is not None and t_start >= job.deadline_ts:
+            # queue-expired jobs still register a (minimal) request tree
+            # so the client-held trace id resolves server-side
+            with _obs.request_span("serve.query", trace,
+                                   tenant=job.tenant, tag=job.tag,
+                                   expired="queue"):
+                pass
             self._finish(job, QueryResult(
                 status="deadline_exceeded", tenant=job.tenant, tag=job.tag,
                 where="queue", queue_ms=queue_ms,
@@ -702,9 +745,18 @@ class QueryServer:
         status, value, error = "ok", None, ""
         job.attempts += 1
         try:
-            with ns_cm, _shard_guard(), _obs.span(
-                    "serve.query", cat="serve",
-                    tenant=job.tenant, tag=job.tag):
+            with ns_cm, _shard_guard(), _obs.request_span(
+                    "serve.query", trace,
+                    tenant=job.tenant, tag=job.tag,
+                    attempt=job.attempts):
+                if trace is not None:
+                    # admission and queueing happened before this span
+                    # opened (caller thread / queue wait) — record them
+                    # as back-dated children of the request root
+                    _obs.emit_span("serve.admit", cat="serve",
+                                   ctx=trace, tenant=job.tenant)
+                    _obs.emit_span("serve.queue", cat="serve",
+                                   dur_ms=queue_ms, ctx=trace)
                 # serve_exec chaos hook (one None check without a plan):
                 # a due device_error raises the same XlaRuntimeError
                 # class a real worker device fault would
@@ -761,6 +813,15 @@ class QueryServer:
             _rec.RECOVERY_LOG.record(
                 "serve_exec", "exhausted", attempt=job.attempts,
                 rung="requeue", cause=cause)
+            if _obs.TRACER.enabled:
+                # fault-ladder engagement exhausted its rung — capture
+                # the evidence while the recovery log still has it
+                _incidents.RECORDER.record(
+                    "fault_ladder",
+                    trace=job.trace if isinstance(
+                        job.trace, _obs.TraceContext) else None,
+                    detail=f"serve_exec requeue exhausted after "
+                           f"{job.attempts} attempts: {cause}")
             return False
         wait = policy.backoff(job.attempts, "serve_exec")
         if job.deadline_ts is not None \
@@ -820,6 +881,7 @@ class QueryServer:
                 exec_ms: Optional[float] = None,
                 e2e_ms: Optional[float] = None) -> None:
         won = job.resolve(result)
+        breaker_opened = False
         if won:
             key = self.admission.breaker_key(job.tenant)
             if result.status == "ok":
@@ -827,10 +889,10 @@ class QueryServer:
                 self.breaker.record_success(key)
             elif result.status == "error":
                 counters.increment("serve.error")
-                self.breaker.record_failure(key)
+                breaker_opened = self.breaker.record_failure(key)
             elif result.status == "deadline_exceeded":
                 counters.increment("serve.deadline_exceeded")
-                self.breaker.record_failure(key)
+                breaker_opened = self.breaker.record_failure(key)
             # rejected/shed counters were recorded at admission (or at
             # the drain=False shutdown site)
         elif executed:
@@ -865,6 +927,36 @@ class QueryServer:
                 _obs.METRICS.observe(f"serve.e2e_ms.{job.tenant}", e2e_ms)
             if self.slo_p99_ms is not None:
                 self._record_slo(job.tenant, e2e_ms, granted)
+        if _obs.TRACER.enabled \
+                and isinstance(job.trace, _obs.TraceContext):
+            if won:
+                # hand the completion verdict to the tail sampler; the
+                # tree finalizes here unless the wire layer deferred
+                # (stream spans still to come — it completes after the
+                # page write-out)
+                _obs.TAIL.finish_request(
+                    job.trace, status=result.status,
+                    reason=result.reason, e2e_ms=e2e_ms,
+                    breaker_opened=breaker_opened,
+                    slo_ms=self.slo_p99_ms)
+                if breaker_opened:
+                    _incidents.RECORDER.record(
+                        "breaker_trip", trace=job.trace,
+                        detail=f"tenant {job.tenant!r}, "
+                               f"status {result.status}",
+                        extra={"breaker": self.breaker.snapshot()})
+            else:
+                # lost race: the winning resolution carried the client-
+                # visible verdict, but it may have landed BEFORE this
+                # execution opened the tree — record this resolution as
+                # the verdict only if none is stored yet, then finalize
+                # so a late execution cannot leak a pending tree
+                # (idempotent once the bucket is gone)
+                _obs.TAIL.finish_request(
+                    job.trace, status=result.status,
+                    reason=result.reason, e2e_ms=None,
+                    breaker_opened=False, slo_ms=self.slo_p99_ms)
+                _obs.TAIL.complete(job.trace)
 
     def _record_slo(self, tenant: str, e2e_ms: float,
                     granted: bool) -> None:
@@ -892,6 +984,16 @@ class QueryServer:
         if cell is not None:
             _obs.METRICS.set_gauge(f"serve.slo_burn.{tenant}",
                                    round(burn, 4))
+        # flight-recorder trigger: sustained burn over the configured
+        # threshold (min 100 samples so a cold start can't fire it);
+        # the recorder's per-trigger cooldown bounds repeat captures
+        if _obs.TRACER.enabled and self._slo_all[0] >= 100 \
+                and burn_all >= _incidents.RECORDER.slo_burn_threshold:
+            _incidents.RECORDER.record(
+                "slo_burn",
+                detail=f"burn {burn_all:.2f} over "
+                       f"{self._slo_all[0]} samples",
+                extra={"slo_p99_ms": self.slo_p99_ms})
 
     def _resolve_deadline(self, job: _Job, where: str) -> None:
         """Waiter-side deadline resolution (``QueryFuture.result``):

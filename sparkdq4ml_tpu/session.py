@@ -417,6 +417,47 @@ class TpuSession:
             if "spark.profiling.maxCaptures" in self.conf:
                 _set("profiling_max_captures",
                      int(self.conf["spark.profiling.maxCaptures"]))
+            # Tail sampler + incident flight recorder (utils/observability
+            # .py, utils/incidents.py), session-scoped like everything
+            # above:
+            #     .config("spark.trace.ringSize", 256)     # recent trees
+            #     .config("spark.trace.retainedSize", 64)  # kept trees
+            #     .config("spark.trace.exemplars", "true") # /metrics ids
+            #     .config("spark.incident.enabled", "true")
+            #     .config("spark.incident.dir", "/x/incidents")
+            #     .config("spark.incident.maxBundles", 32)
+            #     .config("spark.incident.cooldownS", 5.0)
+            #     .config("spark.incident.sloBurnThreshold", 8.0)
+            if "spark.trace.ringSize" in self.conf:
+                _set("trace_ring_size",
+                     int(self.conf["spark.trace.ringSize"]))
+            if "spark.trace.retainedSize" in self.conf:
+                _set("trace_retained_size",
+                     int(self.conf["spark.trace.retainedSize"]))
+            xval = str(self.conf.get("spark.trace.exemplars",
+                                     "")).lower()
+            if xval in _CONF_FALSE:
+                _set("trace_exemplars", False)
+            elif xval in _CONF_TRUE:
+                _set("trace_exemplars", True)
+            ival = str(self.conf.get("spark.incident.enabled",
+                                     "")).lower()
+            if ival in _CONF_FALSE:
+                _set("incident_enabled", False)
+            elif ival in _CONF_TRUE:
+                _set("incident_enabled", True)
+            if "spark.incident.dir" in self.conf:
+                _set("incident_dir",
+                     str(self.conf["spark.incident.dir"]))
+            if "spark.incident.maxBundles" in self.conf:
+                _set("incident_max_bundles",
+                     int(self.conf["spark.incident.maxBundles"]))
+            if "spark.incident.cooldownS" in self.conf:
+                _set("incident_cooldown_s",
+                     float(self.conf["spark.incident.cooldownS"]))
+            if "spark.incident.sloBurnThreshold" in self.conf:
+                _set("incident_slo_burn_threshold",
+                     float(self.conf["spark.incident.sloBurnThreshold"]))
             if saved:
                 self._pipeline_saved = saved
         # Install the shard context over THIS session's mesh (outside
@@ -436,6 +477,20 @@ class TpuSession:
             from .utils import statstore as _statstore
 
             _statstore.STORE.load(_cfg2.stats_path)
+        # Apply the (possibly just-overridden) trace/incident bounds to
+        # the process-global tail sampler and flight recorder (outside
+        # _CONF_LOCK — both take only their own locks).
+        from .utils import incidents as _incidents
+        from .utils import observability as _obs3
+
+        _obs3.TAIL.configure(ring_size=_cfg2.trace_ring_size,
+                             retained_size=_cfg2.trace_retained_size)
+        _incidents.RECORDER.configure(
+            enabled=_cfg2.incident_enabled,
+            directory=_cfg2.incident_dir,
+            max_bundles=_cfg2.incident_max_bundles,
+            cooldown_s=_cfg2.incident_cooldown_s,
+            slo_burn_threshold=_cfg2.incident_slo_burn_threshold)
 
     def _init_observability(self) -> None:
         """Install the tracing/metrics subsystem (``utils.observability``)
@@ -507,6 +562,20 @@ class TpuSession:
         from .utils import observability as _obs
 
         return _obs.dump_chrome_trace(path)
+
+    def incident_report(self) -> dict:
+        """Flight-recorder view: recorder state (dir, disk-ladder rung,
+        bundle counts), the bounded incident index (id, trigger, time,
+        joining trace id), and the tail sampler's retention counters.
+        Full bundles come from ``utils.incidents.RECORDER.get(id)`` or
+        the telemetry server's ``/incidents/<id>`` route."""
+        from .utils import incidents as _incidents
+        from .utils import observability as _obs
+
+        doc = _incidents.RECORDER.report()
+        doc["incidents"] = _incidents.RECORDER.list()
+        doc["tail"] = _obs.TAIL.report()
+        return doc
 
     def memory_report(self, top: int = 5) -> dict:
         """Device-memory accounting snapshot (``utils.meminfo``): live/
@@ -853,7 +922,8 @@ class TpuSession:
                                      "spark.ingest.", "spark.audit.",
                                      "spark.chaos.", "spark.stats.",
                                      "spark.shard.", "spark.costprof.",
-                                     "spark.profiling."))
+                                     "spark.profiling.", "spark.trace.",
+                                     "spark.incident."))
                        for k in self._conf):
                     _ACTIVE._init_pipeline()
                 return _ACTIVE

@@ -106,6 +106,7 @@ FAULT_SITES = {
     "serve_admit": ("breaker_trip", "oom"),
     "oom": ("oom",),
     "stats_persist": ("io_error", "torn_chunk"),
+    "incident": ("io_error",),
     "optimizer": ("device_error",),
     "cost_profile": ("device_error",),
     "net_accept": ("conn_reset",),

@@ -1,0 +1,329 @@
+"""Incident flight recorder — correlated evidence for the requests that
+matter.
+
+Counters tell an operator THAT a breaker tripped; they do not say which
+request tree tripped it, what the recovery ladder did, or what the plan
+cache looked like at that moment. On a trigger — a breaker transition, a
+fault-ladder engagement, SLO burn crossing a threshold — the recorder
+snapshots ONE self-contained incident bundle:
+
+* the triggering request's span tree(s) from the tail sampler (joined by
+  the wire trace id the client also holds),
+* the metrics delta since the previous incident (what moved, not the
+  whole registry),
+* a bounded slice of the structured recovery log,
+* plan evidence: statstore rows and device-cost-profile rows (bounded).
+
+Bundles persist to a bounded on-disk incident dir (atomic tmp +
+``os.replace``, oldest files pruned past ``spark.incident.maxBundles``)
+behind the ``incident`` fault site with the standard degradation ladder:
+a failed write falls back to in-memory retention (``incident.failed``),
+and repeated failures disable the disk rung for the recorder's lifetime
+so a dead volume cannot stall serving. With no dir configured the
+recorder is purely in-memory.
+
+Disabled-mode contract: every trigger hook guards on ``TRACER.enabled``
+at the call site and :meth:`IncidentRecorder.record` re-checks
+:meth:`active` first — with observability off (or ``spark.incident.*``
+unset) no bundle is built, no disk is touched.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+from typing import Optional
+
+from . import faults as _faults
+from . import observability as _obs
+from . import profiling
+from .recovery import RECOVERY_LOG
+
+logger = logging.getLogger("sparkdq4ml_tpu.incidents")
+
+#: Recovery-log events included per bundle (newest last).
+RECOVERY_SLICE = 50
+#: Statstore / cost-profile rows included per bundle.
+PLAN_ROWS = 8
+#: Consecutive disk-write failures before the disk rung is disabled.
+DISK_FAIL_LIMIT = 3
+#: In-memory bundle bound when disk is absent or degraded.
+MEMORY_BUNDLES = 32
+
+
+def _metrics_delta(mark: dict, now: dict) -> dict:
+    """``{name: change}`` for every scalar metric that moved since
+    ``mark`` (histogram summaries compare by their ``count``)."""
+    out = {}
+    for k, v in now.items():
+        v0 = mark.get(k)
+        if isinstance(v, dict):
+            c0 = v0.get("count", 0) if isinstance(v0, dict) else 0
+            d = v.get("count", 0) - c0
+            if d:
+                out[k] = {"count": d}
+        elif isinstance(v, (int, float)):
+            d = v - (v0 if isinstance(v0, (int, float)) else 0)
+            if d:
+                out[k] = d
+    return out
+
+
+class IncidentRecorder:
+    """Bounded flight recorder; one process-global instance
+    (:data:`RECORDER`). Thread-safe: triggers fire from worker threads,
+    the asyncio wire thread, and the telemetry scrape thread."""
+
+    def __init__(self):
+        self.enabled = False
+        self.directory = ""
+        self.max_bundles = MEMORY_BUNDLES
+        self.cooldown_s = 5.0
+        self.slo_burn_threshold = 8.0
+        self._memory: list = []       # bundles without a disk home
+        self._index: dict = {}        # incident id -> "disk" | "memory"
+        self._last_fire: dict = {}    # trigger -> monotonic seconds
+        self._mark = None             # metrics snapshot at last bundle
+        self._seq = 0
+        self._disk_failures = 0
+        self._disk_disabled = False
+        self._lock = threading.Lock()
+
+    # -- lifecycle --------------------------------------------------------
+    def configure(self, enabled: Optional[bool] = None,
+                  directory: Optional[str] = None,
+                  max_bundles: Optional[int] = None,
+                  cooldown_s: Optional[float] = None,
+                  slo_burn_threshold: Optional[float] = None) -> None:
+        with self._lock:
+            if enabled is not None:
+                self.enabled = bool(enabled)
+            if directory is not None:
+                self.directory = str(directory)
+                self._disk_failures = 0
+                self._disk_disabled = False
+            if max_bundles is not None:
+                self.max_bundles = max(1, int(max_bundles))
+            if cooldown_s is not None:
+                self.cooldown_s = max(0.0, float(cooldown_s))
+            if slo_burn_threshold is not None:
+                self.slo_burn_threshold = float(slo_burn_threshold)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._memory.clear()
+            self._index.clear()
+            self._last_fire.clear()
+            self._mark = None
+            self._seq = 0
+            self._disk_failures = 0
+            self._disk_disabled = False
+
+    def active(self) -> bool:
+        """Triggers only fire while observability is on AND the recorder
+        is opted in (``spark.incident.enabled`` or a configured dir)."""
+        return _obs.TRACER.enabled and (self.enabled
+                                        or bool(self.directory))
+
+    # -- recording --------------------------------------------------------
+    def record(self, trigger: str, trace=None, detail: str = "",
+               extra: Optional[dict] = None) -> Optional[str]:
+        """Snapshot one incident bundle. Returns the incident id, or
+        ``None`` when inactive or inside the trigger's cooldown window.
+        Never raises — a broken recorder must not take serving down."""
+        if not self.active():
+            return None
+        now_mono = time.monotonic()
+        with self._lock:
+            last = self._last_fire.get(trigger)
+            if last is not None and now_mono - last < self.cooldown_s:
+                return None
+            self._last_fire[trigger] = now_mono
+            self._seq += 1
+            seq = self._seq
+        try:
+            return self._build_and_store(trigger, seq, trace, detail,
+                                         extra)
+        except Exception:
+            logger.debug("incident recorder failed", exc_info=True)
+            profiling.counters.increment("incident.failed")
+            return None
+
+    def _build_and_store(self, trigger, seq, trace, detail, extra):
+        trace_id = getattr(trace, "trace_id", None) if trace is not None \
+            else None
+        incident_id = f"inc-{int(time.time())}-{seq:04d}-{trigger}"
+        snap = _obs.metrics_snapshot()
+        with self._lock:
+            mark = self._mark or {}
+            self._mark = snap
+        bundle = {
+            "id": incident_id,
+            "time_s": time.time(),
+            "trigger": trigger,
+            "detail": detail,
+            "trace_id": trace_id,
+            # completed trees first; a trigger that fires mid-request
+            # (breaker trip, requeue exhaustion) snapshots the still
+            # in-flight bucket as a partial tree instead
+            "trace_trees": (_obs.TAIL.lookup(trace_id)
+                            or [t for t in
+                                (_obs.TAIL.pending_tree(trace_id),)
+                                if t])
+            if trace_id else [],
+            "retained_trace_ids": _obs.TAIL.retained_ids()[-16:],
+            "metrics_delta": _metrics_delta(mark, snap),
+            "recovery": [e.as_kv() for e in
+                         RECOVERY_LOG.events()[-RECOVERY_SLICE:]],
+            "plan_stats": self._plan_rows(),
+            "cost_profile": self._cost_rows(),
+        }
+        if extra:
+            bundle.update(extra)
+        where = self._persist(incident_id, bundle)
+        with self._lock:
+            self._index[incident_id] = where
+            if where == "memory":
+                self._memory.append(bundle)
+                del self._memory[:max(0, len(self._memory)
+                                      - self.max_bundles)]
+        return incident_id
+
+    @staticmethod
+    def _plan_rows():
+        try:
+            from .statstore import STORE
+
+            rep = STORE.report(drain=False)
+            rows = rep.get("rows", rep) if isinstance(rep, dict) else rep
+            if isinstance(rows, list):
+                return rows[:PLAN_ROWS]
+            return rows
+        except Exception:
+            return []
+
+    @staticmethod
+    def _cost_rows():
+        try:
+            from . import costprof
+
+            rep = costprof.report(top=PLAN_ROWS, budget=0)
+            rows = rep.get("rows", []) if isinstance(rep, dict) else []
+            return rows[:PLAN_ROWS]
+        except Exception:
+            return []
+
+    # -- persistence ladder -----------------------------------------------
+    def _persist(self, incident_id: str, bundle: dict) -> str:
+        """Atomic disk write under the ``incident`` fault site; any
+        failure degrades this bundle to in-memory retention, and repeated
+        failures disable the disk rung entirely (the ladder's terminal
+        rung — serving must never block on a dead volume)."""
+        with self._lock:
+            directory = self.directory
+            disk_ok = bool(directory) and not self._disk_disabled
+        if not disk_ok:
+            return "memory"
+        path = os.path.join(directory, f"{incident_id}.json")
+        tmp = f"{path}.{os.getpid()}.tmp"
+        try:
+            _faults.inject("incident")
+            os.makedirs(directory, exist_ok=True)
+            with open(tmp, "w") as f:
+                json.dump(bundle, f, default=repr)
+            os.replace(tmp, path)
+        except OSError as e:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            with self._lock:
+                self._disk_failures += 1
+                exhausted = self._disk_failures >= DISK_FAIL_LIMIT
+                if exhausted:
+                    self._disk_disabled = True
+            profiling.counters.increment("incident.failed")
+            RECOVERY_LOG.record(
+                "incident", "fallback",
+                rung="disabled" if exhausted else "memory",
+                cause=f"{type(e).__name__}: {e}",
+                detail=("disk rung disabled after "
+                        f"{DISK_FAIL_LIMIT} consecutive failures"
+                        if exhausted else
+                        "bundle retained in-memory only"))
+            return "memory"
+        with self._lock:
+            self._disk_failures = 0
+        profiling.counters.increment("incident.written")
+        self._prune(directory)
+        return "disk"
+
+    def _prune(self, directory: str) -> None:
+        try:
+            files = sorted(
+                f for f in os.listdir(directory)
+                if f.startswith("inc-") and f.endswith(".json"))
+            for f in files[:max(0, len(files) - self.max_bundles)]:
+                os.unlink(os.path.join(directory, f))
+        except OSError:
+            pass
+
+    # -- views ------------------------------------------------------------
+    def list(self) -> list:
+        """Bounded listing, newest last: id, trigger, time, trace id,
+        where the bundle lives."""
+        out = []
+        with self._lock:
+            index = dict(self._index)
+            memory = {b["id"]: b for b in self._memory}
+            directory = self.directory
+        for incident_id in sorted(index):
+            row = {"id": incident_id, "stored": index[incident_id]}
+            b = memory.get(incident_id)
+            if b is None and index[incident_id] == "disk":
+                b = self._load_disk(directory, incident_id)
+            if b is not None:
+                row.update({"trigger": b.get("trigger"),
+                            "time_s": b.get("time_s"),
+                            "trace_id": b.get("trace_id"),
+                            "detail": b.get("detail")})
+            out.append(row)
+        return out[-self.max_bundles:]
+
+    def get(self, incident_id: str) -> Optional[dict]:
+        with self._lock:
+            where = self._index.get(incident_id)
+            memory = {b["id"]: b for b in self._memory}
+            directory = self.directory
+        if where is None:
+            return None
+        if incident_id in memory:
+            return memory[incident_id]
+        return self._load_disk(directory, incident_id)
+
+    @staticmethod
+    def _load_disk(directory: str, incident_id: str) -> Optional[dict]:
+        if not directory:
+            return None
+        path = os.path.join(directory, f"{incident_id}.json")
+        try:
+            with open(path) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
+    def report(self) -> dict:
+        with self._lock:
+            return {"active": self.active(),
+                    "dir": self.directory,
+                    "disk_disabled": self._disk_disabled,
+                    "max_bundles": self.max_bundles,
+                    "count": len(self._index),
+                    "in_memory": len(self._memory)}
+
+
+#: Process-global incident recorder.
+RECORDER = IncidentRecorder()

@@ -144,6 +144,16 @@ METRIC_NAMES = {
     # tracer internals
     "trace.dropped_spans": ("counter", "spans evicted by the bounded "
                                        "buffer"),
+    # tail-based request-tree retention (TailSampler)
+    "trace.kept": ("counter", "request trees promoted to the retained "
+                              "store by the tail keep-policy"),
+    "trace.dropped": ("counter", "request trees aged out of the tail "
+                                 "ring without being kept"),
+    # incident flight recorder (utils/incidents.py)
+    "incident.written": ("counter", "incident bundles persisted to the "
+                                    "incident dir"),
+    "incident.failed": ("counter", "incident bundle writes degraded to "
+                                   "in-memory retention"),
     # fault injection (utils/faults.py)
     "faults.injected": ("counter", "chaos faults fired"),
     # serving layer (serve/)
@@ -640,10 +650,12 @@ def disable() -> None:
 
 
 def reset() -> None:
-    """Clear spans, gauges, histograms, and the device-memory peak tracker
-    (counters have their own ``profiling.counters.clear``)."""
+    """Clear spans, gauges, histograms, the tail sampler's request trees,
+    and the device-memory peak tracker (counters have their own
+    ``profiling.counters.clear``)."""
     TRACER.clear()
     METRICS.clear()
+    TAIL.clear()
     from . import meminfo
 
     meminfo.reset_peak()
@@ -681,6 +693,369 @@ def current_ids() -> tuple:
         except IndexError:
             return (None, None)
     return (s.trace_id, s.sid)
+
+
+# ---------------------------------------------------------------------------
+# Distributed trace context (W3C traceparent) + tail-based retention
+# ---------------------------------------------------------------------------
+
+#: Exact length of a version-00 ``traceparent`` value
+#: (``"00-" + 32 hex + "-" + 16 hex + "-" + 2 hex``). The length bound is
+#: checked FIRST, so a hostile megabyte header costs one ``len()``.
+_TP_LEN = 55
+_HEX_DIGITS = frozenset("0123456789abcdef")
+
+
+def _is_lower_hex(s: str) -> bool:
+    return bool(s) and all(c in _HEX_DIGITS for c in s)
+
+
+class TraceContext:
+    """Wire-level trace identity of ONE served request.
+
+    The client mints one per logical query (``trace_id`` constant across
+    retries AND hedges; each attempt carries a fresh child span id so the
+    server can tell attempts apart) and sends it W3C-``traceparent``-style
+    in both framings. The server adopts it — or, on absent/malformed/
+    hostile input, degrades to a locally-minted root (NEVER an error) — and
+    echoes ``trace_id`` in the end frame so every ``ClientResult`` is
+    joinable to the server-side span tree.
+
+    ``root_trace``/``root_sid`` are filled by :func:`request_span` with the
+    INTERNAL integer ids of the adopted root span: the tail sampler keys
+    its pending request trees by them, and late stream spans (emitted from
+    the wire layer after the execute span closed) parent through them.
+    """
+
+    __slots__ = ("trace_id", "parent_id", "remote", "defer",
+                 "root_trace", "root_sid")
+
+    def __init__(self, trace_id: str, parent_id: Optional[str] = None,
+                 remote: bool = False, defer: bool = False):
+        self.trace_id = trace_id
+        self.parent_id = parent_id
+        self.remote = remote
+        #: when True the wire layer finalizes the request tree (it still
+        #: has stream spans to record after the server-side verdict).
+        self.defer = defer
+        self.root_trace: Optional[int] = None
+        self.root_sid: Optional[int] = None
+
+    @classmethod
+    def mint(cls) -> "TraceContext":
+        """A fresh locally-minted root context."""
+        return cls(os.urandom(16).hex(), None, remote=False)
+
+    @classmethod
+    def parse(cls, value) -> Optional["TraceContext"]:
+        """Strict parse of a version-00 ``traceparent``; ``None`` on ANY
+        deviation (wrong type/length/version, non-hex, all-zero ids)."""
+        if not isinstance(value, str) or len(value) != _TP_LEN:
+            return None
+        parts = value.split("-")
+        if len(parts) != 4:
+            return None
+        version, trace, parent, flags = parts
+        if version != "00":
+            return None
+        if len(trace) != 32 or not _is_lower_hex(trace) \
+                or trace == "0" * 32:
+            return None
+        if len(parent) != 16 or not _is_lower_hex(parent) \
+                or parent == "0" * 16:
+            return None
+        if len(flags) != 2 or not _is_lower_hex(flags):
+            return None
+        return cls(trace, parent, remote=True)
+
+    @classmethod
+    def adopt(cls, value, defer: bool = False) -> "TraceContext":
+        """Parse ``value`` or degrade to a locally-minted root. Passing an
+        existing context through is idempotent (``defer`` only widens)."""
+        if isinstance(value, cls):
+            value.defer = value.defer or defer
+            return value
+        ctx = cls.parse(value)
+        if ctx is None:
+            ctx = cls.mint()
+        ctx.defer = defer
+        return ctx
+
+    def child_traceparent(self) -> str:
+        """A fresh per-attempt traceparent under this trace — retries and
+        hedges stay distinguishable server-side by their span id."""
+        return f"00-{self.trace_id}-{os.urandom(8).hex()}-01"
+
+
+def _span_doc(s) -> dict:
+    """JSON-safe dict view of one span (the /trace wire schema)."""
+    return {"name": s.name, "cat": s.cat or "other", "span_id": s.sid,
+            "parent_id": s.parent_id, "trace_id": s.trace_id,
+            "ts_us": s.ts_us,
+            "dur_ms": round((s.dur_us or 0) / 1e3, 3),
+            "attrs": {k: (v if isinstance(v, (str, int, float, bool,
+                                              type(None))) else repr(v))
+                      for k, v in s.attrs.items()}}
+
+
+class TailSampler:
+    """Tail-based retention of completed request span trees.
+
+    Every served request registers its root span here; the tracer sink
+    buckets the request's finished spans by the root's internal trace id.
+    On completion the tree lands in a bounded ring (recent context, kept
+    or not) and the keep-policy — error, deadline_exceeded, any
+    ``recovery_fault`` annotation, a breaker transition, or e2e latency
+    over the serving SLO — promotes it to the retained store keyed by the
+    WIRE trace id (what the client holds). Healthy-path cost when
+    observability is disabled stays zero: nothing registers, the sink
+    sees an empty pending map."""
+
+    #: Pending-bucket bound: a wire layer that dies before finalizing must
+    #: not leak request buckets forever (oldest dropped).
+    MAX_PENDING = 1024
+
+    def __init__(self, ring_size: int = 256, retained_size: int = 64):
+        self.ring_size = int(ring_size)
+        self.retained_size = int(retained_size)
+        self._pending: dict = {}    # internal root trace id -> bucket
+        self._ring: list = []       # completed tree docs, oldest first
+        self._retained: dict = {}   # wire trace id -> [tree docs]
+        self._exemplars: dict = {}  # histogram name -> {le: (tid, value)}
+        self._lock = threading.Lock()
+
+    def configure(self, ring_size: Optional[int] = None,
+                  retained_size: Optional[int] = None) -> None:
+        with self._lock:
+            if ring_size is not None:
+                self.ring_size = max(1, int(ring_size))
+            if retained_size is not None:
+                self.retained_size = max(1, int(retained_size))
+
+    # -- collection -------------------------------------------------------
+    def open_request(self, root, ctx: TraceContext) -> None:
+        bucket = {"ctx": ctx, "spans": [], "verdict": None}
+        prior = getattr(ctx, "root_trace", None)
+        with self._lock:
+            if prior is not None:
+                # a requeued attempt re-roots the same context: carry the
+                # earlier attempt's spans into the new bucket so the full
+                # retry history stays one tree
+                old = self._pending.pop(prior, None)
+                if old is not None:
+                    bucket["spans"] = old["spans"]
+            self._pending[root.trace_id] = bucket
+            while len(self._pending) > self.MAX_PENDING:
+                self._pending.pop(next(iter(self._pending)))
+
+    def _on_span(self, s) -> None:
+        # tracer sink — one dict lookup per finished span; request spans
+        # only (everything else misses the pending map).
+        b = self._pending.get(s.trace_id)
+        if b is not None:
+            b["spans"].append(s)
+
+    def finish_request(self, ctx, *, status=None, reason=None,
+                       e2e_ms=None, breaker_opened: bool = False,
+                       slo_ms=None) -> None:
+        """Attach the server-side completion verdict. Finalizes the tree
+        immediately unless the context defers to the wire layer (stream
+        spans still to come — it calls :meth:`complete` when done)."""
+        key = getattr(ctx, "root_trace", None)
+        if key is None:
+            return
+        with self._lock:
+            b = self._pending.get(key)
+        if b is None:
+            return
+        if b["verdict"] is None:
+            # first verdict wins: the winning resolution is what the
+            # client saw — a lost-race worker's later value must not
+            # rewrite a deadline verdict as "ok"
+            b["verdict"] = {"status": status, "reason": reason,
+                            "e2e_ms": e2e_ms,
+                            "breaker_opened": bool(breaker_opened),
+                            "slo_ms": slo_ms}
+        if not getattr(ctx, "defer", False):
+            self.complete(ctx)
+
+    def complete(self, ctx) -> Optional[dict]:
+        """Finalize one request tree: evaluate the keep-policy, land the
+        doc in the ring, promote to the retained store when kept.
+        Idempotent — the second call for a context is a no-op."""
+        key = getattr(ctx, "root_trace", None)
+        if key is None:
+            return None
+        with self._lock:
+            b = self._pending.pop(key, None)
+        if b is None:
+            return None
+        v = b["verdict"] or {}
+        spans = b["spans"]
+        reasons = []
+        if v.get("status") == "error":
+            reasons.append("error")
+        if v.get("status") == "deadline_exceeded" \
+                or v.get("reason") == "deadline":
+            reasons.append("deadline_exceeded")
+        if any("recovery_fault" in s.attrs for s in spans):
+            reasons.append("recovery_fault")
+        if v.get("breaker_opened"):
+            reasons.append("breaker_transition")
+        slo_ms, e2e_ms = v.get("slo_ms"), v.get("e2e_ms")
+        if slo_ms and e2e_ms and e2e_ms > slo_ms:
+            reasons.append("slow")
+        doc = {"trace_id": ctx.trace_id, "remote": ctx.remote,
+               "status": v.get("status"), "reason": v.get("reason"),
+               "e2e_ms": e2e_ms, "kept": bool(reasons),
+               "keep_reasons": reasons,
+               "spans": [_span_doc(s) for s in spans]}
+        aged_unkept = 0
+        with self._lock:
+            self._ring.append(doc)
+            while len(self._ring) > self.ring_size:
+                if not self._ring.pop(0)["kept"]:
+                    aged_unkept += 1
+            if reasons:
+                self._retained.setdefault(ctx.trace_id, []).append(doc)
+                while len(self._retained) > self.retained_size:
+                    self._retained.pop(next(iter(self._retained)))
+        if reasons:
+            profiling.counters.increment("trace.kept")
+            if e2e_ms is not None:
+                # last kept trace per latency bucket backs the
+                # OpenMetrics exemplars on serve.e2e_ms
+                self.exemplar("serve.e2e_ms", e2e_ms, ctx.trace_id)
+        if aged_unkept:
+            profiling.counters.increment("trace.dropped", aged_unkept)
+        return doc
+
+    # -- exemplars --------------------------------------------------------
+    def exemplar(self, hist_name: str, value: float, trace_id: str,
+                 buckets=DEFAULT_BUCKETS_MS) -> None:
+        """Remember ``trace_id`` as the last kept trace for the histogram
+        bucket ``value`` falls into (OpenMetrics exemplar source)."""
+        le = float("inf")
+        for b in buckets:
+            if value <= b:
+                le = float(b)
+                break
+        with self._lock:
+            self._exemplars.setdefault(hist_name, {})[le] = (
+                trace_id, float(value))
+
+    def exemplars(self, hist_name: str) -> dict:
+        with self._lock:
+            return dict(self._exemplars.get(hist_name, ()))
+
+    def pending_tree(self, trace_id: str) -> Optional[dict]:
+        """Snapshot an IN-FLIGHT request tree by its wire trace id — the
+        flight recorder fires mid-request (breaker trip, requeue
+        exhaustion), before the wire layer finalizes the bucket, so the
+        completed-tree views come up empty exactly when an incident
+        bundle wants the tree most."""
+        with self._lock:
+            for b in self._pending.values():
+                ctx = b["ctx"]
+                if getattr(ctx, "trace_id", None) == trace_id:
+                    v = b["verdict"] or {}
+                    return {"trace_id": trace_id,
+                            "remote": getattr(ctx, "remote", False),
+                            "status": v.get("status"),
+                            "reason": v.get("reason"),
+                            "e2e_ms": v.get("e2e_ms"),
+                            "partial": True,
+                            "spans": [_span_doc(s) for s in b["spans"]]}
+        return None
+
+    # -- views ------------------------------------------------------------
+    def lookup(self, trace_id: str) -> list:
+        """Every completed tree for one WIRE trace id (retries/hedges of
+        one logical query share it) — retained store first, then the
+        recent ring."""
+        with self._lock:
+            trees = list(self._retained.get(trace_id, ()))
+            if not trees:
+                trees = [d for d in self._ring
+                         if d["trace_id"] == trace_id]
+        return trees
+
+    def recent(self, limit: int = 50, trace_id: Optional[str] = None) \
+            -> list:
+        with self._lock:
+            ring = list(self._ring)
+        if trace_id is not None:
+            ring = [d for d in ring if d["trace_id"] == trace_id]
+        return ring[-max(0, int(limit)):]
+
+    def retained_ids(self) -> list:
+        with self._lock:
+            return list(self._retained)
+
+    def report(self) -> dict:
+        with self._lock:
+            return {"pending": len(self._pending),
+                    "ring": len(self._ring),
+                    "retained": len(self._retained),
+                    "ring_size": self.ring_size,
+                    "retained_size": self.retained_size}
+
+    def clear(self) -> None:
+        with self._lock:
+            self._pending.clear()
+            self._ring.clear()
+            self._retained.clear()
+            self._exemplars.clear()
+
+
+#: Process-global tail sampler; its sink rides the tracer (only called
+#: while tracing is enabled — the disabled path never reaches sinks).
+TAIL = TailSampler()
+TRACER._sinks.append(TAIL._on_span)
+
+
+def request_span(name: str, ctx: Optional[TraceContext],
+                 cat: str = "serve", **attrs):
+    """Root span for one served request: detached from any ambient/session
+    parent so the request tree owns its internal trace id, stamped with
+    the wire trace identity, and registered with the tail sampler.
+    Returns the shared no-op when tracing is off or no context given."""
+    t = TRACER
+    if not t.enabled or ctx is None:
+        return _NOOP
+    s = Span(t, name, cat, attrs)
+    s.parent_id = None
+    s.trace_id = s.sid
+    wire = {"wire_trace_id": ctx.trace_id}
+    if ctx.remote:
+        wire["wire_parent_id"] = ctx.parent_id
+        wire["remote"] = True
+    s.attrs = {**s.attrs, **wire}
+    # open BEFORE re-rooting the context: the sampler reads the previous
+    # root to merge a requeued attempt's spans into the new bucket
+    TAIL.open_request(s, ctx)
+    ctx.root_trace = s.sid
+    ctx.root_sid = s.sid
+    return s
+
+
+def emit_span(name: str, cat: str = "", dur_ms: float = 0.0,
+              ctx: Optional[TraceContext] = None, **attrs) -> None:
+    """Record an already-elapsed interval as a finished span, back-dated
+    by ``dur_ms``. The serving layer's admission/queue/stream stages run
+    outside the execute context (caller thread, asyncio thread) — this is
+    how they still land in the request tree: ``ctx`` parents the span
+    under the adopted request root."""
+    t = TRACER
+    if not t.enabled:
+        return
+    s = Span(t, name, cat, attrs)
+    if ctx is not None and getattr(ctx, "root_sid", None) is not None:
+        s.parent_id = ctx.root_sid
+        s.trace_id = ctx.root_trace
+    s.dur_us = int(max(float(dur_ms), 0.0) * 1000)
+    s.ts_us = t._now_us() - s.dur_us
+    t._finish(s)
 
 
 def op_span(name: str, cat: str = "frame"):
@@ -1213,6 +1588,17 @@ def _prom_help(name: str) -> str:
     return f"{name} - sparkdq4ml_tpu metric"
 
 
+def _exemplars_enabled() -> bool:
+    """Render-time read of the ``spark.trace.exemplars`` conf flag (late
+    import keeps this module free of a config dependency cycle)."""
+    try:
+        from ..config import config as _cfg
+
+        return bool(getattr(_cfg, "trace_exemplars", False))
+    except Exception:   # pragma: no cover - config always importable
+        return False
+
+
 def prometheus_text() -> str:
     """Prometheus text-format snapshot: every counter (including
     ``recovery.*``), every gauge, and every histogram (cumulative
@@ -1228,14 +1614,24 @@ def prometheus_text() -> str:
         lines.append(f"# TYPE {pn} counter")
         lines.append(f"{pn} {_prom_num(v)}")
     snap = METRICS.snapshot()
+    exemplars_on = _exemplars_enabled()
     for name in sorted(snap):
         v = snap[name]
         pn = _prom_name(name)
         lines.append(f"# HELP {pn} {_prom_help(name)}")
         if isinstance(v, dict):      # histogram summary
             lines.append(f"# TYPE {pn} histogram")
+            ex = TAIL.exemplars(name) if exemplars_on else {}
             for le, c in v["buckets"].items():
-                lines.append(f'{pn}_bucket{{le="{_prom_num(le)}"}} {c}')
+                line = f'{pn}_bucket{{le="{_prom_num(le)}"}} {c}'
+                e = ex.get(float(le))
+                if e is not None:
+                    # OpenMetrics exemplar: the last KEPT trace id that
+                    # landed in this bucket — a scrape reader can jump
+                    # straight from a latency bucket to /trace/<id>.
+                    line += (f' # {{trace_id="{e[0]}"}} '
+                             f'{_prom_num(e[1])}')
+                lines.append(line)
             lines.append(f"{pn}_sum {_prom_num(v['sum'])}")
             lines.append(f"{pn}_count {v['count']}")
         else:
