@@ -618,13 +618,22 @@ def bench_serving(session, data_path: str):
     replays the first tenant's compiled programs with zero new pipeline/
     grouped compiles (cache_report diff). Every served query must return
     the golden numbers (count=24, RMSE 2.8099 ± 1%) or the bench exits
-    1 — concurrency must never change results."""
+    1 — concurrency must never change results.
+
+    The ``coalesced`` arm (ISSUE-18) repeats the shared-cache closed
+    loop with cross-request plan coalescing ON: identical-plan flushes
+    from concurrent clients rendezvous inside the hold window and run
+    as ONE stacked (vmapped) device dispatch. ``cross_request_dispatches``
+    is the batched-dispatch count (must sit well below ``queries`` —
+    otherwise nothing coalesced) and ``batch_size_hist`` is the padded
+    member-bucket histogram from the batched-plan cache."""
     import threading
 
     import sparkdq4ml_tpu as dq
     from sparkdq4ml_tpu.models import LinearRegression, VectorAssembler
     from sparkdq4ml_tpu.ops import compiler, segments
     from sparkdq4ml_tpu.serve import QueryServer, TenantQuota
+    from sparkdq4ml_tpu.utils.profiling import counters
 
     clients = 8 if SMOKE else 32
     per_client = 2 if SMOKE else 6
@@ -662,21 +671,39 @@ def bench_serving(session, data_path: str):
         return sum(int(report.get(k, {}).get("misses", 0))
                    for k in ("pipeline", "grouped"))
 
-    def run_arm(shared: bool):
+    def run_arm(shared: bool, coalesce: bool = False):
         compiler.clear_cache()
         segments.clear_cache()
         server = QueryServer(
             session, workers=workers, max_queue=4 * clients,
             default_quota=TenantQuota(max_in_flight=2,
                                       max_queued=per_client + 2),
-            shared_plan_cache=shared).start()
+            shared_plan_cache=shared, coalesce=coalesce,
+            coalesce_max_delay_ms=5.0, coalesce_max_batch=8,
+            coalesce_min_queue_depth=2).start()
         # Cold warm-up on tenant-00, then the cross-tenant pin: does
         # tenant-01's FIRST query need any new compiled plan?
         r0 = server.submit(job, tenant="tenant-00").result()
         rep0 = plan_compiles(server.cache_report())
         r1 = server.submit(job, tenant="tenant-01").result()
         cross_new = plan_compiles(server.cache_report()) - rep0
+        if coalesce:
+            # untimed concurrent burst: rendezvous real batches so the
+            # vmapped (plan, member-bucket) programs compile BEFORE the
+            # timed loop — the arm measures steady-state coalesced QPS,
+            # same warm-plan footing the uncoalesced arms get from r0/r1
+            for _ in range(2):
+                warm_threads = [
+                    threading.Thread(target=lambda i=i: server.submit(
+                        job, tenant=f"tenant-{i:02d}").result())
+                    for i in range(clients)]
+                for t in warm_threads:
+                    t.start()
+                for t in warm_threads:
+                    t.join()
 
+        co0 = counters.get("serve.coalesce.dispatches")
+        co0_members = counters.get("serve.coalesce.batched")
         results: list = []
         res_lock = threading.Lock()
 
@@ -695,6 +722,13 @@ def bench_serving(session, data_path: str):
         for t in threads:
             t.join()
         wall = time.perf_counter() - t0
+        # batched-plan cache state BEFORE stop/clear: one row per
+        # (plan, member bucket), hits+compiles = dispatches through it
+        hist: dict = {}
+        for e in compiler.coalesce_cache_stats()["entries"]:
+            k = f"x{e['batch']}"
+            hist[k] = (hist.get(k, 0) + int(e["hits"])
+                       + int(e["compiles"]))
         server.stop()
         ok = [r for r in results if r.ok]
         golden_ok = all(
@@ -709,7 +743,7 @@ def bench_serving(session, data_path: str):
                                    int(p * (len(lats) - 1)))], 2)
                     if lats else None)
 
-        return {
+        arm = {
             "queries": len(results), "completed": len(ok),
             "qps": round(len(ok) / wall, 2), "wall_s": round(wall, 3),
             "p50_ms": pct(0.50), "p99_ms": pct(0.99),
@@ -717,6 +751,13 @@ def bench_serving(session, data_path: str):
             "golden_ok": bool(golden_ok and r0.ok and r1.ok
                               and len(ok) == len(results)),
         }
+        if coalesce:
+            arm["cross_request_dispatches"] = (
+                counters.get("serve.coalesce.dispatches") - co0)
+            arm["coalesced_members"] = (
+                counters.get("serve.coalesce.batched") - co0_members)
+            arm["batch_size_hist"] = hist
+        return arm
 
     def run_socket_arm(tracing: bool = False):
         # Same closed-loop workload through REAL sockets (serve/net.py):
@@ -808,6 +849,7 @@ def bench_serving(session, data_path: str):
 
     shared = run_arm(True)
     isolated = run_arm(False)
+    coalesced = run_arm(True, coalesce=True)
     socket_arm = run_socket_arm()
     # (tracing overhead) the same socket workload with distributed
     # tracing ON, then OFF again: tracing_enabled_qps is what the span
@@ -821,20 +863,28 @@ def bench_serving(session, data_path: str):
     # drop the tenant-namespaced plans the isolated arm salted in
     compiler.clear_cache()
     segments.clear_cache()
-    if not (shared["golden_ok"] and isolated["golden_ok"]
-            and socket_arm["golden_ok"] and traced_arm["golden_ok"]
-            and untraced_arm["golden_ok"]):
+    arms = {"shared": shared, "isolated": isolated,
+            "coalesced": coalesced, "socket": socket_arm,
+            "traced": traced_arm, "untraced": untraced_arm}
+    failed = [name for name, arm in arms.items()
+              if not arm["golden_ok"]]
+    if failed:
         log("ERROR: serving bench: a served query missed the golden "
-            "numbers (count 24 / RMSE 2.8099) or failed outright")
+            "numbers (count 24 / RMSE 2.8099) or failed outright in "
+            f"arm(s): {', '.join(failed)}")
         sys.exit(1)
     row = {
         "config": "serving", "clients": clients,
         "queries_per_client": per_client, "workers": workers,
         "shared_cache": shared, "isolated_cache": isolated,
+        "coalesced": coalesced,
         "socket": socket_arm,
         "shared_vs_isolated_qps": round(
             shared["qps"] / isolated["qps"], 2)
         if isolated["qps"] else None,
+        "coalesced_vs_uncoalesced_qps": round(
+            coalesced["qps"] / shared["qps"], 2)
+        if shared["qps"] else None,
         "socket_vs_inproc_qps": round(
             socket_arm["qps"] / shared["qps"], 2)
         if shared["qps"] else None,

@@ -5,9 +5,10 @@ Drives the 32-client concurrent serving workload (the headline DQ+Lasso
 query of the reference app) under N seeded RANDOM fault schedules that
 span every registered fault site — the fused pipeline flush, the grouped
 segment-reduce program, the native streaming ingest, the QueryServer
-worker + admission gates, the model-fit ladder, and memory pressure (the
-``oom`` budget-shrink fault) — and asserts the engine's survival
-contract:
+worker + admission gates, the cross-request coalescer's stacked batch
+dispatch (coalescing runs LIVE for the whole soak), the model-fit
+ladder, and memory pressure (the ``oom`` budget-shrink fault) — and
+asserts the engine's survival contract:
 
 * **zero hangs** — every ``QueryFuture.result()`` returns within a hard
   bound, whatever died underneath;
@@ -115,6 +116,14 @@ _CANDIDATES = (
     # that plan unprofiled ("-" on every surface) — /profile keeps
     # answering (the scraper below asserts zero scrape failures)
     ("cost_profile", "device_error", 0.30, ""),
+    # the cross-request coalescer's ladder (serve/coalesce.py): a fault
+    # on the STACKED batch dispatch degrades the whole batch to
+    # per-request replay of the same cached plans — every member still
+    # returns the golden numbers; n=64 under-budgets the stacked bytes
+    # so a fired oom always forces the degrade
+    ("coalesce", "device_error", 0.12, ""),
+    ("coalesce", "stall", 0.08, ""),
+    ("coalesce", "oom", 0.12, ":n=64"),
 )
 
 
@@ -149,6 +158,8 @@ _ROTATION = (
     ("stats_persist", "torn_chunk", ""),
     ("optimizer", "device_error", ""),
     ("cost_profile", "device_error", ""),
+    ("coalesce", "device_error", ""),
+    ("coalesce", "oom", ":n=64"),
 )
 
 #: Guaranteed net faults for the socket arm, rotated alongside
@@ -359,11 +370,18 @@ def run_seed(session, seed: int, clients: int, queries: int, workers: int,
     RECOVERY_LOG.clear()
     before = profiling.counters.snapshot()
     job = headline_job(data_path)
+    # coalesce=True: the soak runs with cross-request coalescing LIVE,
+    # so the ``coalesce`` fault site in the rotation actually lands on
+    # stacked batches (min_queue_depth=1 — 32 clients over 8 workers
+    # keep the queue deep enough without it, but a small --clients
+    # smoke must exercise the ladder too)
     server = QueryServer(
         session, workers=workers, max_queue=4 * clients,
         default_quota=TenantQuota(max_in_flight=2, max_queued=queries + 2),
         breaker_threshold=3, breaker_cooldown=BREAKER_COOLDOWN_S,
-        metrics_port=0, slo_p99_ms=1000.0).start()
+        metrics_port=0, slo_p99_ms=1000.0, coalesce=True,
+        coalesce_max_delay_ms=5.0, coalesce_max_batch=8,
+        coalesce_min_queue_depth=1).start()
     net = None
     if transport == "socket":
         from sparkdq4ml_tpu.serve import NetServer

@@ -48,6 +48,10 @@ CONF_KEYS = {
     "spark.serve.client.retries": "session",
     "spark.serve.client.backoffMs": "session",
     "spark.serve.client.hedging": "session",
+    "spark.serve.coalesce.enabled": "session",
+    "spark.serve.coalesce.maxDelayMs": "session",
+    "spark.serve.coalesce.maxBatch": "session",
+    "spark.serve.coalesce.minQueueDepth": "session",
     "spark.audit.enabled": "session",
     "spark.audit.memoryFraction": "session",
     "spark.audit.deviceBudget": "session",
@@ -183,6 +187,23 @@ class _Config:
     serve_client_retries: int = 3
     serve_client_backoff_ms: float = 50.0
     serve_client_hedging: bool = False
+    # Cross-request plan coalescing (serve/coalesce.py): OFF by default
+    # (spark.serve.coalesce.enabled) — QueryServer.start() reads exactly
+    # this one flag when disabled, and the per-request dispatch path is
+    # byte-for-byte PR-17 behavior (one None check in run_pipeline).
+    serve_coalesce_enabled: bool = False
+    # Hold window in ms (spark.serve.coalesce.maxDelayMs): how long a
+    # batch leader waits for same-plan followers before dispatching; cut
+    # short the moment the batch fills.
+    serve_coalesce_max_delay_ms: float = 2.0
+    # Member cap per batched dispatch (spark.serve.coalesce.maxBatch),
+    # clamped further by the admission memory gate pricing the STACKED
+    # batch bytes.
+    serve_coalesce_max_batch: int = 8
+    # Load trigger (spark.serve.coalesce.minQueueDepth): a worker arms
+    # the coalescing scope only when the queue depth at pop time is at
+    # least this — light load keeps the pure per-request path.
+    serve_coalesce_min_queue_depth: int = 2
     # dqaudit — the jaxpr-level program-audit tier (analysis/program/):
     # gates the EXPLAIN `est peak` static-memory column and
     # session.audit_report() (spark.audit.enabled). The auditor is

@@ -43,11 +43,26 @@ from ..utils.profiling import counters
 
 logger = logging.getLogger("sparkdq4ml_tpu.frame")
 
-# Serializes pipeline flushes: frames were thread-safe-immutable before
-# the lazy layer, and must stay observably so. Inside the lock, stores
-# publish BEFORE _pending clears, so the unlocked fast-path check in the
-# _data/_mask getters can never see "no pending" with stale stores.
-_FLUSH_LOCK = threading.RLock()
+# Pipeline flushes serialize PER FRAME (``Frame._lock``): frames were
+# thread-safe-immutable before the lazy layer, and must stay observably
+# so — but that is a per-object invariant, and a frame's flush touches
+# only its own ``_data_store``/``_mask_store``/``_pending`` (stores are
+# immutable snapshots; sibling frames replaying a shared prefix each
+# publish their OWN result). A global flush lock would also serialize
+# UNRELATED frames' flushes across serving workers — exactly the
+# overlap the cross-request coalescer (serve/coalesce.py) exists to
+# exploit: its batch leader holds its frame's lock through the hold
+# window, and followers must be able to reach their own dispatches
+# meanwhile. Inside a frame's lock, stores publish BEFORE _pending
+# clears, so the unlocked fast-path check in the _data/_mask getters
+# can never see "no pending" with stale stores. Concurrency of the
+# device work itself needs no global lock: unsharded programs are
+# single-device (thread-safe jit dispatch), and sharded flushes
+# serialize on the collective lock (parallel/mesh.py) like every other
+# multi-device program. _LOCK_FILL guards only the lazy per-frame lock
+# creation — frames are minted on every op, so the hot construction
+# paths must not pay an RLock allocation each.
+_LOCK_FILL = threading.Lock()
 
 
 def _is_device_error(e: BaseException) -> bool:
@@ -287,6 +302,7 @@ class Frame:
 
     _alias: Optional[str] = None  # set by .alias(); not inherited by _with
     _pending: tuple = ()          # deferred pipeline steps (see _defer)
+    _flush_lock = None            # per-frame flush serializer (see _lock)
     # Row-shard layout descriptor (parallel/shard.py ShardedStore), or
     # None for the single-device layout. A sharded frame's columns/mask
     # are global arrays padded to devices×bucket slots with a False mask
@@ -359,17 +375,33 @@ class Frame:
         return f
 
     # -- pipeline compiler plumbing (ops/compiler.py) ----------------------
+    def _lock(self):
+        """This frame's flush serializer, created on first need (every
+        frame op mints frames — the construction paths must not pay an
+        RLock each). Reentrant: the flush ladder re-enters through eager
+        replay on the same frame. Per-frame by design — see the
+        _LOCK_FILL comment at module top."""
+        lk = self._flush_lock
+        if lk is None:
+            with _LOCK_FILL:
+                lk = self._flush_lock
+                if lk is None:
+                    lk = self._flush_lock = threading.RLock()
+        return lk
+
     def _defer(self, step) -> "Frame":
         """New frame sharing this one's base columns/mask with ``step``
         appended to the pending pipeline. Flush never mutates a shared
         store in place, so sharing is safe; compilable steps are pure, so
         sibling frames replaying a shared prefix stay correct."""
         f = Frame.__new__(Frame)
-        with _FLUSH_LOCK:
+        with self._lock():
             # consistent (stores, pending) snapshot: racing a concurrent
             # flush of this frame unlocked could pair the POST-flush
             # stores with the PRE-flush step list — the child would then
-            # double-apply every step
+            # double-apply every step. The PARENT's lock is the right
+            # one (it serializes this read against the parent's own
+            # flush); the child lazily mints its own.
             f._data_store = self._data_store
             f._mask_store = self._mask_store
             f._pending = self._pending + (step,)
@@ -411,7 +443,9 @@ class Frame:
         if even the eager replay raises (a genuinely bad expression), the
         exception propagates with the steps intact, so every subsequent
         read raises the same error instead of silently serving the
-        pre-op frame state. Flushes serialize on ``_FLUSH_LOCK`` and
+        pre-op frame state. Flushes serialize on this frame's own lock
+        (``_lock`` — per frame, so UNRELATED frames' flushes overlap and
+        the serving tier's coalescer can rendezvous them) and
         publish the new stores BEFORE clearing ``_pending`` — a reader
         racing the unlocked getter fast-path either re-enters here (and
         finds nothing left to do) or sees the fully flushed state; never
@@ -428,7 +462,7 @@ class Frame:
         from ..ops.compiler import PipelineError, run_pipeline
         from ..utils import faults as _faults
 
-        with _FLUSH_LOCK:
+        with self._lock():
             steps = self._pending
             if not steps:
                 return
@@ -512,9 +546,10 @@ class Frame:
         program under ``recovery.resilient_call`` (per-site
         ``spark.recovery.pipeline_flush.*`` policy), then degrade one
         level to eager per-op replay (``pipeline.fault_fallback``) — a
-        fault costs one rung, never the query. Runs under ``_FLUSH_LOCK``
-        (held by the caller), so chaos-path backoff sleeps briefly
-        serialize other frames' flushes — bounded by the retry policy."""
+        fault costs one rung, never the query. Runs under this frame's
+        flush lock (held by the caller), so chaos-path backoff sleeps
+        briefly serialize THIS frame's other flushes — bounded by the
+        retry policy; unrelated frames are unaffected."""
         from ..ops.compiler import PipelineError, run_pipeline
         from ..utils import faults as _faults
         from ..utils import recovery as _rec
@@ -871,7 +906,7 @@ class Frame:
         if not cand or (not self._pending and len(cand) < 2):
             return {}
         extra = [(f"__sel_{i}", e) for i, e in enumerate(cand)]
-        with _FLUSH_LOCK:
+        with self._lock():
             steps = self._pending
             try:
                 new_data, new_mask, extras = run_pipeline(

@@ -71,6 +71,7 @@ __all__ = [
     "bucket_size", "pad_rows", "dtype_tag", "is_compilable",
     "run_pipeline", "clear_cache", "cache_len", "PipelineError",
     "plan_namespace", "plan_namespace_tag",
+    "coalesce_scope", "run_batched", "coalesce_batch_bucket",
 ]
 
 
@@ -761,9 +762,12 @@ def plan_namespace(ns: str):
 
 
 def clear_cache() -> None:
-    """Drop every compiled plan (tests; conf flips)."""
+    """Drop every compiled plan (tests; conf flips) — the coalesced
+    batched-dispatch cache too, since its entries close over base plans
+    this cache just dropped."""
     with _CACHE_LOCK:
         _CACHE.clear()
+        _BATCHED.clear()
 
 
 def cache_len() -> int:
@@ -1223,6 +1227,13 @@ def run_pipeline(data: dict, mask, n: int, steps, extra=(), shard=None):
         # nothing else on this path (test-pinned, chaos-pin style).
         stats_on = config.stats_enabled
         t_stats = time.perf_counter() if stats_on else 0.0
+        # Cross-request coalescing scope (serve/coalesce.py): the serving
+        # worker arms it per job; everywhere else (and in serve's
+        # disabled / light-load modes) it is None and the dispatch below
+        # is byte-for-byte the per-request path — ONE None check,
+        # test-pinned like the chaos hooks. Sharded flushes never
+        # coalesce (they already serialize on the mesh).
+        coal = _COALESCE.get()
         with warnings.catch_warnings():
             # donation of a replaced column whose output dtype differs
             # (int column replaced by a float expression) is unusable —
@@ -1249,6 +1260,9 @@ def run_pipeline(data: dict, mask, n: int, steps, extra=(), shard=None):
                     _faults.inject("shard_flush")
                     changed, new_mask, extras, shard_valid = plan.fn(
                         kept, donated, mask_in, lit_values)
+                elif coal is not None:
+                    changed, new_mask, extras = coal.dispatch(
+                        plan, b, kept, donated, mask_in, lit_values)
                 else:
                     changed, new_mask, extras = plan.fn(
                         kept, donated, mask_in, lit_values)
@@ -1261,6 +1275,10 @@ def run_pipeline(data: dict, mask, n: int, steps, extra=(), shard=None):
                         changed, new_mask, extras, shard_valid = plan.fn(
                             kept, donated, mask_in, lit_values)
                         sp.set(shards=shard.devices)
+                    elif coal is not None:
+                        changed, new_mask, extras = coal.dispatch(
+                            plan, b, kept, donated, mask_in, lit_values)
+                        sp.set(coalesce=True)
                     else:
                         changed, new_mask, extras = plan.fn(
                             kept, donated, mask_in, lit_values)
@@ -1387,5 +1405,261 @@ def program_handles() -> list:
     return out
 
 
+# ---------------------------------------------------------------------------
+# Cross-request coalescing: vmapped batched dispatch (serve/coalesce.py)
+# ---------------------------------------------------------------------------
+
+#: Coalescing scope for the CURRENT execution context. None (the
+#: default, and the only state outside an armed serving worker) keeps
+#: ``run_pipeline``'s dispatch byte-for-byte the per-request path — one
+#: None check, test-pinned. A serving worker whose job qualifies for
+#: coalescing (conf-enabled, queue depth at/over ``minQueueDepth``,
+#: deadline headroom) sets a sink whose ``dispatch()`` may rendezvous
+#: this flush with concurrent same-plan flushes into ONE stacked device
+#: program (see :func:`run_batched`). A contextvar, not a global: each
+#: worker scopes its own job without affecting concurrent ones.
+_COALESCE: contextvars.ContextVar = contextvars.ContextVar(
+    "sparkdq4ml_coalesce", default=None)
+
+
+@contextlib.contextmanager
+def coalesce_scope(sink):
+    """Route this context's unsharded pipeline flushes through ``sink``
+    (an object with ``dispatch(plan, b, kept, donated, mask, lits)`` —
+    the serving layer's :class:`~..serve.coalesce.Coalescer` member
+    handle) for the duration of the block. ``sink=None`` restores the
+    per-request path."""
+    token = _COALESCE.set(sink)
+    try:
+        yield
+    finally:
+        _COALESCE.reset(token)
+
+
+def coalesce_batch_bucket(n: int) -> int:
+    """Member-count bucket for a coalesced batch: the next power of two,
+    so a burst of 3 and a burst of 4 share one batched program (the pad
+    member rides along and its outputs are discarded, exactly the row-
+    padding argument applied to the member axis)."""
+    return 1 << max(int(n) - 1, 0).bit_length()
+
+
+class _BatchedPlan:
+    """One coalesced-dispatch cache entry: ``jax.vmap`` of the base
+    plan's UN-counted trace body over a new leading member axis, jitted
+    once per (plan key, member-count bucket). The vmapped body is the
+    auditor's re-trace surface (:func:`coalesce_program_handles`);
+    the jitted entry counts its own traces for the retrace verdict —
+    never the base plan's, whose replay stats stay per-request.
+
+    The jitted entry takes the MEMBERS' argument tuples directly and
+    does the stack, the vmapped body, and the per-member de-interleave
+    inside ONE program: host-side ``jnp.stack`` per input array plus a
+    separate split dispatch would cost a framework round-trip per array
+    — more per-dispatch overhead than the solo flushes it replaces on
+    dispatch-bound backends. XLA fuses the concatenates and slices into
+    the body, so a coalesced flush is exactly one host->device call."""
+
+    __slots__ = ("base", "batch", "key", "vbody", "fn", "hits",
+                 "compiles", "traces", "buckets", "example",
+                 "_trace_lock")
+
+    def __init__(self, plan: _Plan, batch: int):
+        self.base = plan
+        self.batch = int(batch)
+        self.key = f"coalesce[x{self.batch}]|{plan.key}"
+        vbody = jax.vmap(plan.trace_body)
+        self.vbody = vbody
+        self.hits = 0
+        self.compiles = 0
+        self.traces = 0
+        self.buckets: dict[int, int] = {}
+        self.example: Optional[tuple] = None
+        self._trace_lock = threading.Lock()
+        n_don = len(plan.donated)
+        n_lits = plan.n_lits
+        kept_names = tuple(plan.kept)
+
+        def program(members):
+            with self._trace_lock:
+                self.traces += 1
+            kept_s = {name: jnp.stack([m[0][name] for m in members])
+                      for name in kept_names}
+            donated_s = tuple(jnp.stack([m[1][i] for m in members])
+                              for i in range(n_don))
+            mask_s = jnp.stack([m[2] for m in members])
+            lits_s = tuple(jnp.stack([m[3][i] for m in members])
+                           for i in range(n_lits))
+            out = vbody(kept_s, donated_s, mask_s, lits_s)
+            return [jax.tree_util.tree_map(lambda a, i=i: a[i], out)
+                    for i in range(len(members))]
+
+        # No donation even on accelerators: the member buffers must
+        # survive for the degrade path's per-request replay.
+        self.fn = jax.jit(program)
+
+
+_BATCHED: "OrderedDict[tuple, _BatchedPlan]" = OrderedDict()
+_BATCHED_EVICTIONS = 0
+
+
+def _lookup_batched(plan: _Plan, batch: int) -> _BatchedPlan:
+    global _BATCHED_EVICTIONS
+    key = (plan.key, batch)
+    with _CACHE_LOCK:
+        bp = _BATCHED.get(key)
+        if bp is not None:
+            _BATCHED.move_to_end(key)
+            return bp
+    bp = _BatchedPlan(plan, batch)
+    with _CACHE_LOCK:
+        # same insert-if-absent discipline as _lookup_plan: the FIRST
+        # inserted object keeps the stats every later dispatch lands on
+        existing = _BATCHED.get(key)
+        if existing is not None:
+            _BATCHED.move_to_end(key)
+            return existing
+        _BATCHED[key] = bp
+        while len(_BATCHED) > int(config.pipeline_cache_size):
+            _BATCHED.popitem(last=False)
+            _BATCHED_EVICTIONS += 1
+    return bp
+
+
+def est_member_bytes(plan: _Plan, kept: dict, donated, b: int) -> int:
+    """Per-member resident-byte estimate of a coalesced flush, computed
+    from the already-padded member inputs (the coalescer prices the
+    STACKED batch as ``members × this`` against the admission budget —
+    the same cheap static mirror as :func:`_est_flush_bytes`, fed from
+    buffers instead of the frame dict)."""
+    total = b   # bool mask
+    out_itemsize = np.dtype(float_dtype()).itemsize
+    for a in list(kept.values()) + list(donated):
+        total += int(np.prod(a.shape)) * np.dtype(a.dtype).itemsize
+    total += 2 * b * out_itemsize * max(plan.n_outputs, 1)
+    return total
+
+
+def run_batched(plan: _Plan, b: int, members):
+    """Execute ``members`` — each ``(kept, donated, mask, lit_values)``,
+    every one already padded to row bucket ``b`` by its own
+    ``run_pipeline`` frame — as ONE stacked device dispatch of the
+    vmapped plan body, and return the per-member ``(changed, new_mask,
+    extras)`` list in member order.
+
+    Inputs stack along a new leading member axis (hoisted literals
+    included: each scalar slot becomes a ``(batch,)`` argument the
+    vmapped ``_ArgLit`` broadcasts per member, so queries differing only
+    in literal VALUES still share the one batched program). The member
+    count pads up to :func:`coalesce_batch_bucket` by repeating member
+    0, whose extra outputs are dropped at the de-interleave."""
+    n = len(members)
+    batch = coalesce_batch_bucket(n)
+    if batch > n:
+        members = list(members) + [members[0]] * (batch - n)
+    # normalized pytree structure (dict / tuple / leaf / tuple per
+    # member): a list-vs-tuple drift between callers must not retrace
+    margs = tuple((dict(m[0]), tuple(m[1]), m[2], tuple(m[3]))
+                  for m in members)
+    bp = _lookup_batched(plan, batch)
+    before = bp.traces
+    out = bp.fn(margs)
+    if bp.example is None:
+        # abstract specs of the STACKED form the vmapped body consumes
+        # (the auditor re-traces ``bp.vbody``, not the member-tuple
+        # wrapper), idempotent (the benign cross-thread race needs no
+        # lock) — literals are (batch,) ARRAY specs here, not the base
+        # plan's host scalars: the batched calling convention
+        m0 = margs[0]
+
+        def stacked(v):
+            a = jnp.asarray(v)
+            return jax.ShapeDtypeStruct((batch,) + tuple(a.shape),
+                                        a.dtype)
+
+        bp.example = (
+            {k: stacked(v) for k, v in m0[0].items()},
+            tuple(stacked(v) for v in m0[1]),
+            stacked(m0[2]),
+            tuple(stacked(v) for v in m0[3]))
+    compiled = bp.traces > before
+    with _CACHE_LOCK:   # per-entry stats stay dispatch-coherent
+        if compiled:
+            bp.compiles += 1
+        else:
+            bp.hits += 1
+        bp.buckets[b] = bp.buckets.get(b, 0) + 1
+    return out[:n]
+
+
+def coalesce_cache_stats() -> dict:
+    """Registry callback (observability.CACHES): the coalesced-dispatch
+    cache next to the per-request plan cache in ``cache_report()`` /
+    ``/metrics`` — one entry per (plan key, member-count bucket), its
+    program key carrying the ``coalesce[xN]`` batch-bucket tag."""
+    with _CACHE_LOCK:
+        entries = [{"key": bp.key[:160], "program_key": bp.key,
+                    "hits": bp.hits, "compiles": bp.compiles,
+                    "buckets": dict(bp.buckets), "batch": bp.batch,
+                    "runtime_literals": bp.base.n_lits}
+                   for bp in _BATCHED.values()]
+        evicts = _BATCHED_EVICTIONS
+    return {
+        "kind": "coalesced batched-dispatch cache (vmapped plans)",
+        "size": len(entries),
+        "capacity": int(config.pipeline_cache_size),
+        "hits": sum(e["hits"] for e in entries),
+        "misses": sum(e["compiles"] for e in entries),
+        "evictions": evicts,
+        "entries": entries,
+    }
+
+
+def _coalesce_variant(example, factor: int):
+    """The batched example specs scaled ``factor`` up along the MEMBER
+    axis (every stacked input shares it, literal columns included) —
+    "the same vmapped plan at a later batch bucket", the structural-
+    stability probe the retrace detector compares x2 vs x4."""
+    kept, donated, mask, lits = example
+
+    def up(s):
+        shape = (s.shape[0] * factor,) + tuple(s.shape[1:])
+        return jax.ShapeDtypeStruct(shape, s.dtype)
+
+    return (({k: up(v) for k, v in kept.items()},
+             tuple(up(v) for v in donated), up(mask),
+             tuple(up(v) for v in lits)), {})
+
+
+def coalesce_program_handles() -> list:
+    """Registry callback (observability.CACHES.register_programs): one
+    ProgramHandle per executed batched plan, so dqaudit's program tier
+    and the costprof observatory enumerate the coalesced hot path
+    exactly like per-request plans — ``fn`` is the un-counted vmapped
+    body; ``expected_traces`` is the row buckets served at this batch
+    bucket (each is one legitimate trace of the one jitted entry)."""
+    with _CACHE_LOCK:
+        plans = list(_BATCHED.values())
+    out = []
+    for bp in plans:
+        if bp.example is None:
+            continue
+        out.append(_obs.ProgramHandle(
+            "coalesce", bp.key, bp.vbody,
+            args=bp.example,
+            variants={"bucket": [_coalesce_variant(bp.example, 2),
+                                 _coalesce_variant(bp.example, 4)]},
+            meta={"expected_traces": max(len(bp.buckets), 1),
+                  "observed_traces": bp.traces,
+                  # literal-erased like the pipeline handles; the
+                  # coalesce[xN] tag stays, so batch buckets are
+                  # distinct programs, not dedup collisions
+                  "dedup_key": _NUM_LIT_RE.sub("V(#)", bp.key),
+                  "runtime_literals": bp.base.n_lits}))
+    return out
+
+
 _obs.CACHES.register("pipeline", cache_stats)
 _obs.CACHES.register_programs("pipeline", program_handles)
+_obs.CACHES.register("coalesce", coalesce_cache_stats)
+_obs.CACHES.register_programs("coalesce", coalesce_program_handles)

@@ -10,6 +10,7 @@ network front end is :class:`NetServer` + :class:`ResilientClient`
 
 from .admission import AdmissionController, Rejection, TenantQuota
 from .client import ClientResult, ResilientClient, WireError
+from .coalesce import Coalescer
 from .http import TelemetryServer
 from .net import NetServer
 from .server import (MAX_TENANT_SERIES, QueryDeadlineExceeded,
@@ -22,4 +23,5 @@ __all__ = [
     "ServeError", "QueryRefused", "QueryDeadlineExceeded",
     "QueryExecutionError", "MAX_TENANT_SERIES", "TelemetryServer",
     "NetServer", "ResilientClient", "ClientResult", "WireError",
+    "Coalescer",
 ]

@@ -27,7 +27,10 @@ Four gates, applied in order (first refusal wins):
    over-budget job is rejected with ``"memory"`` *before* it can OOM the
    device mid-flight. Advisory (the census is a lower bound on allocator
    pressure), and only applied when both the limit and the estimate are
-   known — a job with no estimate is admitted.
+   known — a job with no estimate is admitted. The coalescing layer
+   (``serve/coalesce.py``) sizes its STACKED batches against the same
+   budget via :meth:`AdmissionController.batch_limit` — members that
+   each fit individually must not stack N× over the gate.
 
 Per-tenant **in-flight** quotas (``quota.max_in_flight``) are enforced by
 the server's scheduler, not here: an admitted job waits in its tenant's
@@ -130,6 +133,26 @@ class AdmissionController:
                     f"estimated {int(est_bytes)} B + live {live} B exceeds "
                     f"the {self.memory_limit_bytes} B device-memory limit")
         return None
+
+    def batch_limit(self, per_member_bytes: Optional[int], max_batch: int,
+                    live_bytes: Optional[int] = None) -> int:
+        """Largest coalesced-batch member count whose STACKED footprint
+        (``members × per_member_bytes``) still passes the memory gate —
+        the batched-dispatch complement of :meth:`admit`, which prices
+        one request at a time. Without it, N admitted jobs that each fit
+        individually could stack into one dispatch ``N×`` over the very
+        budget their admissions were checked against. Floor 1: a solo
+        dispatch is exactly the footprint the member's own admission
+        already cleared. ``live_bytes`` reuses a census the caller took
+        (``None`` = census here); no limit or no estimate = no clamp."""
+        max_batch = max(1, int(max_batch))
+        if (self.memory_limit_bytes is None or per_member_bytes is None
+                or per_member_bytes <= 0):
+            return max_batch
+        live = meminfo.live_bytes() if live_bytes is None else int(live_bytes)
+        headroom = self.memory_limit_bytes - live
+        return max(1, min(max_batch,
+                          int(headroom // int(per_member_bytes))))
 
     @staticmethod
     def _reject(reason: str, detail: str) -> Rejection:

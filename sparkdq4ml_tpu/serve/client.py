@@ -129,18 +129,24 @@ class ResilientClient:
     # -- public API ----------------------------------------------------------
     def query(self, sql: str, *, tenant: Optional[str] = None,
               deadline_s: Optional[float] = None,
-              tag: Optional[str] = None) -> ClientResult:
-        """Run one SQL query; blocks until a structured result."""
+              tag: Optional[str] = None,
+              est_bytes: Optional[int] = None) -> ClientResult:
+        """Run one SQL query; blocks until a structured result.
+        ``est_bytes`` declares the device footprint for the server's
+        admission memory gate and coalesced-batch sizing."""
         return self._run({"sql": sql}, tenant=tenant,
-                         deadline_s=deadline_s, tag=tag)
+                         deadline_s=deadline_s, tag=tag,
+                         est_bytes=est_bytes)
 
     def call_job(self, name: str, *, tenant: Optional[str] = None,
                  deadline_s: Optional[float] = None,
-                 tag: Optional[str] = None) -> ClientResult:
+                 tag: Optional[str] = None,
+                 est_bytes: Optional[int] = None) -> ClientResult:
         """Invoke a server-side job registered via
         :meth:`~.net.NetServer.register_job`."""
         return self._run({"job": name}, tenant=tenant,
-                         deadline_s=deadline_s, tag=tag)
+                         deadline_s=deadline_s, tag=tag,
+                         est_bytes=est_bytes)
 
     def healthz(self) -> dict:
         """One HTTP health probe (works against either transport's
@@ -179,11 +185,14 @@ class ResilientClient:
     # -- retry engine --------------------------------------------------------
     def _run(self, doc: dict, *, tenant: Optional[str],
              deadline_s: Optional[float],
-             tag: Optional[str]) -> ClientResult:
+             tag: Optional[str],
+             est_bytes: Optional[int] = None) -> ClientResult:
         doc = dict(doc)
         doc["tenant"] = tenant if tenant is not None else self.tenant
         if tag is not None:
             doc["tag"] = tag
+        if est_bytes is not None:
+            doc["est_bytes"] = int(est_bytes)
         if deadline_s is not None:
             # RELATIVE budget on the wire — clock-skew tolerant by
             # construction (the server re-anchors on its own clock)

@@ -1,0 +1,341 @@
+"""Cross-request plan coalescing: adaptive micro-batching of
+identical-plan queries into one stacked device dispatch.
+
+ROADMAP item 2's raw-speed half. The shared plan cache (PR 13) already
+proves cross-tenant structural plan identity — N concurrent requests
+whose flushes hash to the same plan key are provably running the SAME
+compiled program — yet each still pays its own device dispatch. This
+module is the Snap ML hierarchy argument (PAPERS.md, arxiv 1803.06333:
+amortize per-dispatch overhead by batching work at every level) applied
+to the serving tier: a short, load-triggered hold window groups those
+flushes, stacks their padded inputs along a new leading member axis,
+executes ONE vmapped program (``ops/compiler.run_batched``), and
+de-interleaves the results to each waiter.
+
+**Grouping key** — ``(plan key, row bucket, literal type signature)``.
+The plan key already embeds the dtype tag, the cache namespace, and the
+shard tag, so different dtypes, isolated tenants, and sharded flushes
+never coalesce by construction. Hoisted numeric literals are NOT in the
+key: ``price < 3`` and ``price < 4`` share one plan and DO coalesce —
+each literal slot stacks into a ``(batch,)`` argument the vmapped body
+broadcasts per member. The literal TYPE signature rides the group key
+so an int and a float in the same slot (different weak-type promotion)
+dispatch separately rather than risk a dtype drift.
+
+**Rendezvous** — the first flush to arrive for a key becomes the batch
+LEADER: it waits up to ``maxDelayMs`` (cut short the moment the batch
+fills) for followers, closes the batch, and executes. Followers deposit
+their padded inputs and block on the batch's done event; the leader
+always resolves it (success, degrade, or per-member error). A batch of
+one executes the plain per-request program — no batched machinery, no
+counters.
+
+**Adaptivity** — the server arms a scope only when the queue depth at
+pop time is at least ``minQueueDepth`` AND the job's deadline has
+headroom for the window (a near-deadline job dispatches solo, never
+waits). Below that the contextvar stays None and ``run_pipeline`` is
+byte-for-byte the per-request path (one None check, test-pinned).
+
+**Sizing** — the batch cap is ``min(maxBatch, admission.batch_limit)``:
+the admission memory gate prices the STACKED batch (members ×
+per-member estimate) against the same budget single requests pass, so
+coalescing cannot OOM a gate the members individually cleared.
+
+**Fault ladder** — site ``coalesce`` (``device_error`` / ``stall`` /
+``oom``): any batched-dispatch failure, injected or real, degrades the
+WHOLE batch to per-request replay of the same cached plan — golden
+results on every rung — counted ``serve.coalesce.degraded`` with a
+``recovery.fallback`` event; a member whose replay itself fails gets
+that error delivered individually (its own Frame ladder takes over).
+
+Observability: ``serve.coalesce.batched/dispatches/degraded`` counters,
+``serve.coalesce.batch_size/window_ms`` histograms, and — with tracing
+on — one shared ``serve.coalesce`` span per member tree carrying the
+batch id and the full member trace-id list, so every ``/trace/<id>``
+lookup shows which requests rode which batch.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import itertools
+import threading
+import time
+from typing import Optional
+
+from ..ops import compiler as _compiler
+from ..utils import faults as _faults
+from ..utils import observability as _obs
+from ..utils.profiling import counters
+
+__all__ = ["Coalescer"]
+
+#: Follower safety bound (s): the leader resolves every batch in a
+#: ``finally``, so this only fires if a leader thread is killed mid-
+#: dispatch — same order as the wire layer's RESULT_BOUND_S.
+_FOLLOWER_BOUND_S = 600.0
+
+#: Deadline headroom multiple: a job enters a scope only when its
+#: remaining budget exceeds this many hold windows, so waiting one full
+#: window can never be what blows the deadline.
+_HEADROOM_WINDOWS = 4.0
+
+_BATCH_IDS = itertools.count(1)
+
+
+class _Member:
+    """One flush waiting in a batch: the padded calling convention plus
+    the request's trace context (for the shared batch span)."""
+
+    __slots__ = ("kept", "donated", "mask", "lits", "ctx")
+
+    def __init__(self, kept, donated, mask, lits, ctx):
+        self.kept = kept
+        self.donated = donated
+        self.mask = mask
+        self.lits = lits
+        self.ctx = ctx
+
+
+class _Batch:
+    """One rendezvous: members join while ``open``; the leader closes,
+    executes, fills ``results`` (one ``("ok", value) | ("err", exc)``
+    per member, member order) and sets ``done``."""
+
+    __slots__ = ("members", "open", "limit", "full", "done", "results")
+
+    def __init__(self, limit: int):
+        self.members: list[_Member] = []
+        self.open = True
+        self.limit = int(limit)
+        self.full = threading.Event()
+        self.done = threading.Event()
+        self.results: Optional[list] = None
+
+
+class _Sink:
+    """Per-job handle the compiler's coalesce scope holds: binds the
+    job's trace context to the shared :class:`Coalescer`."""
+
+    __slots__ = ("co", "ctx")
+
+    def __init__(self, co: "Coalescer", ctx):
+        self.co = co
+        self.ctx = ctx
+
+    def dispatch(self, plan, b, kept, donated, mask, lits):
+        return self.co._dispatch(self.ctx, plan, b, kept, donated,
+                                 mask, lits)
+
+
+class Coalescer:
+    """The serving tier's cross-request batcher (module docstring).
+
+    One instance per :class:`~.server.QueryServer`, shared by every
+    worker; stateless apart from the open-batch table. Thread-safe: the
+    one lock guards only list/dict membership — stacking, device
+    execution, metrics, and spans all happen outside it (the serve
+    layer's lock-hygiene rule)."""
+
+    def __init__(self, admission=None, max_delay_ms: float = 2.0,
+                 max_batch: int = 8, min_queue_depth: int = 2):
+        self.admission = admission
+        self.max_delay_s = max(float(max_delay_ms), 0.0) / 1e3
+        self.max_batch = max(int(max_batch), 1)
+        self.min_queue_depth = max(int(min_queue_depth), 0)
+        self._lock = threading.Lock()
+        self._open: dict[tuple, _Batch] = {}
+
+    # -- scope (the server's per-job arming decision) -----------------------
+    def scope(self, job, queue_depth: int):
+        """The context manager ``_execute`` wraps a job's work in: the
+        compiler coalesce scope when this job qualifies, else the shared
+        nullcontext (light load / no headroom / degenerate conf — the
+        per-request path, untouched)."""
+        if (queue_depth < self.min_queue_depth or self.max_batch <= 1
+                or self.max_delay_s <= 0.0):
+            return contextlib.nullcontext()
+        if job.deadline_ts is not None and (
+                job.deadline_ts - time.perf_counter()
+                < _HEADROOM_WINDOWS * self.max_delay_s):
+            # a job this close to its (wire) deadline must never sit in
+            # a hold window: dispatch solo, exactly the uncoalesced path
+            return contextlib.nullcontext()
+        return _compiler.coalesce_scope(_Sink(self, job.trace))
+
+    # -- member dispatch (called from inside run_pipeline) ------------------
+    def _dispatch(self, ctx, plan, b, kept, donated, mask, lits):
+        cap = self.max_batch
+        if (self.admission is not None
+                and self.admission.memory_limit_bytes is not None):
+            # price the STACKED batch against the memory gate BEFORE the
+            # rendezvous lock (the census walks every live array)
+            per = _compiler.est_member_bytes(plan, kept, donated, b)
+            cap = self.admission.batch_limit(per, cap)
+        member = _Member(kept, donated, mask, lits, ctx)
+        key = (plan.key, b, tuple(type(v).__name__ for v in lits))
+        with self._lock:
+            batch = self._open.get(key)
+            if batch is not None and batch.open \
+                    and len(batch.members) < batch.limit:
+                batch.members.append(member)
+                idx = len(batch.members) - 1
+                if len(batch.members) >= batch.limit:
+                    batch.open = False
+                    del self._open[key]
+                    batch.full.set()
+                leader = False
+            else:
+                batch = _Batch(cap)
+                batch.members.append(member)
+                idx = 0
+                leader = True
+                if cap > 1:
+                    self._open[key] = batch
+        if not leader:
+            batch.done.wait(_FOLLOWER_BOUND_S)
+            return self._take(batch, idx)
+        return self._lead(key, batch, plan, b)
+
+    def _lead(self, key, batch, plan, b):
+        t0 = time.perf_counter()
+        if batch.limit > 1:
+            batch.full.wait(self.max_delay_s)
+        with self._lock:
+            batch.open = False
+            if self._open.get(key) is batch:
+                del self._open[key]
+        window_ms = (time.perf_counter() - t0) * 1e3
+        try:
+            if len(batch.members) == 1:
+                m = batch.members[0]
+                # no partner arrived: the plain per-request program —
+                # bit-identical, uncounted, and any error is simply this
+                # flush's own error
+                batch.results = [None]
+                return plan.fn(m.kept, m.donated, m.mask, m.lits)
+            self._run_batch(batch, plan, b, window_ms)
+            return self._take(batch, 0)
+        finally:
+            if batch.results is None:
+                # leader died before filling results (a non-Exception
+                # unwind): fail the followers rather than wedge them
+                batch.results = [
+                    ("err", RuntimeError("coalesced batch abandoned"))
+                ] * len(batch.members)
+            batch.done.set()
+
+    def _run_batch(self, batch, plan, b, window_ms: float) -> None:
+        members = batch.members
+        n = len(members)
+        t0 = time.perf_counter()
+        try:
+            # chaos hooks at the batched-dispatch boundary (one None
+            # check without a plan): a due device_error raises the same
+            # JaxRuntimeError class a real batched device fault would; a
+            # due stall marks the batched program wedged; a due oom
+            # shrinks the stacked-bytes budget under this batch
+            _faults.inject("coalesce")
+            if _faults.fired("coalesce", "stall"):
+                raise _Stalled("injected coalesce stall")
+            budget = _faults.shrunk_budget("coalesce")
+            if budget is not None:
+                per = _compiler.est_member_bytes(
+                    plan, members[0].kept, members[0].donated, b)
+                if n * per > budget:
+                    raise _OverBudget(
+                        f"stacked est {n * per} B > budget {budget} B")
+            outs = _compiler.run_batched(
+                plan, b, [(m.kept, m.donated, m.mask, m.lits)
+                          for m in members])
+        except Exception as e:   # noqa: BLE001 — every rung degrades
+            self._degrade(batch, plan, e)
+            return
+        batch.results = [("ok", o) for o in outs]
+        counters.increment("serve.coalesce.dispatches")
+        counters.increment("serve.coalesce.batched", n)
+        _obs.METRICS.observe("serve.coalesce.batch_size", float(n))
+        _obs.METRICS.observe("serve.coalesce.window_ms", window_ms)
+        self._emit_spans(members, plan, n, window_ms,
+                         (time.perf_counter() - t0) * 1e3,
+                         degraded=False)
+
+    def _degrade(self, batch, plan, cause: BaseException) -> None:
+        """The whole-batch fault rung: per-request replay of the SAME
+        cached plan — golden results by construction (each member runs
+        exactly the program it would have run uncoalesced); a member
+        whose replay fails gets that error individually."""
+        from ..utils.recovery import RECOVERY_LOG
+
+        members = batch.members
+        counters.increment("serve.coalesce.degraded")
+        RECOVERY_LOG.record(
+            "coalesce", "fallback", rung="per_request",
+            cause=f"{type(cause).__name__}: {cause}",
+            detail=f"batched dispatch of {len(members)} member(s) "
+                   "degraded to per-request replay")
+        results = []
+        for m in members:
+            try:
+                results.append(
+                    ("ok", plan.fn(m.kept, m.donated, m.mask, m.lits)))
+            except Exception as e:   # noqa: BLE001 — per-member verdict
+                results.append(("err", e))
+        batch.results = results
+        self._emit_spans(members, plan, len(members), 0.0, 0.0,
+                         degraded=True)
+
+    @staticmethod
+    def _take(batch, idx: int):
+        res = batch.results[idx] if batch.results is not None else None
+        if res is None:
+            raise RuntimeError("coalesced batch never resolved")
+        kind, payload = res
+        if kind == "err":
+            raise payload
+        return payload
+
+    @staticmethod
+    def _emit_spans(members, plan, n: int, window_ms: float,
+                    exec_ms: float, *, degraded: bool) -> None:
+        """One shared ``serve.coalesce`` span per member request tree —
+        same batch id and member trace-id list on each, so any member's
+        ``/trace/<id>`` shows the whole rendezvous."""
+        if not _obs.TRACER.enabled:
+            return
+        bid = next(_BATCH_IDS)
+        ids = ",".join(m.ctx.trace_id for m in members
+                       if m.ctx is not None)
+        for m in members:
+            if m.ctx is None:
+                continue
+            _obs.emit_span(
+                "serve.coalesce", cat="serve", dur_ms=exec_ms, ctx=m.ctx,
+                batch_id=bid, batch=n, members=ids,
+                window_ms=round(window_ms, 3),
+                plan_key=plan.key[:160], degraded=degraded)
+
+    # -- introspection ------------------------------------------------------
+    def stats(self) -> dict:
+        with self._lock:
+            open_batches = len(self._open)
+        return {
+            "max_delay_ms": self.max_delay_s * 1e3,
+            "max_batch": self.max_batch,
+            "min_queue_depth": self.min_queue_depth,
+            "open_batches": open_batches,
+            "batched": counters.get("serve.coalesce.batched"),
+            "dispatches": counters.get("serve.coalesce.dispatches"),
+            "degraded": counters.get("serve.coalesce.degraded"),
+        }
+
+
+class _Stalled(RuntimeError):
+    """Injected ``coalesce:stall`` — the batched program is treated as
+    wedged and the batch degrades; deliberately NOT a JaxRuntimeError
+    (nothing device-side failed, so nothing should retry device-side)."""
+
+
+class _OverBudget(RuntimeError):
+    """Stacked batch priced over the (fault-shrunk) byte budget — the
+    memory rung of the coalesce ladder."""

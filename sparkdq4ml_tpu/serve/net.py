@@ -12,9 +12,11 @@ first four bytes:
 
 * **HTTP/1.1** (``POST /query``) — the interoperable framing. The
   request body is a JSON document (``sql`` or a registered ``job``
-  name, ``tenant``, ``deadline_ms``, ``idem``, ``tag``); the
-  ``X-DQ-Tenant`` / ``X-DQ-Deadline-Ms`` / ``X-DQ-Idempotency-Key`` /
-  ``X-DQ-Tag`` headers override. Responses stream as
+  name, ``tenant``, ``deadline_ms``, ``idem``, ``tag``,
+  ``est_bytes`` — the declared device footprint the admission memory
+  gate and the coalescer's batch sizing price); the ``X-DQ-Tenant`` /
+  ``X-DQ-Deadline-Ms`` / ``X-DQ-Idempotency-Key`` / ``X-DQ-Tag`` /
+  ``X-DQ-Est-Bytes`` headers override. Responses stream as
   ``Transfer-Encoding: chunked`` ndjson — one JSON line per result
   page, then one terminal line with the structured status — so a large
   SELECT never materializes per client. ``GET /healthz`` answers the
@@ -597,6 +599,7 @@ class NetServer:
                               ("x-dq-deadline-ms", "deadline_ms"),
                               ("x-dq-idempotency-key", "idem"),
                               ("x-dq-tag", "tag"),
+                              ("x-dq-est-bytes", "est_bytes"),
                               ("traceparent", "traceparent")):
             if header in headers:
                 req[field] = headers[header]
@@ -836,6 +839,14 @@ class NetServer:
                 raise _BadRequest(
                     "bad_request",
                     f"bad deadline_ms {req['deadline_ms']!r}")
+        est_bytes = None
+        if req.get("est_bytes") is not None:
+            try:
+                est_bytes = max(0, int(req["est_bytes"]))
+            except (TypeError, ValueError):
+                raise _BadRequest(
+                    "bad_request",
+                    f"bad est_bytes {req['est_bytes']!r}")
         # ONE flag read: with tracing on, the wire traceparent (frame doc
         # field / HTTP header) becomes the request's context — malformed
         # or absent degrades to a locally-minted root, NEVER an error.
@@ -846,7 +857,7 @@ class NetServer:
         fut = self.server.submit(
             work, tenant=tenant, deadline_s=deadline_s,
             tag=str(req["tag"]) if req.get("tag") is not None else None,
-            trace=trace)
+            est_bytes=est_bytes, trace=trace)
         if idem:
             with self._idem_lock:
                 self._idem[idem] = fut

@@ -316,7 +316,11 @@ class QueryServer:
                  breaker: Optional[CircuitBreaker] = None,
                  metrics_port: Optional[int] = None,
                  metrics_host: str = "127.0.0.1",
-                 slo_p99_ms: Optional[float] = None):
+                 slo_p99_ms: Optional[float] = None,
+                 coalesce: Optional[bool] = None,
+                 coalesce_max_delay_ms: Optional[float] = None,
+                 coalesce_max_batch: Optional[int] = None,
+                 coalesce_min_queue_depth: Optional[int] = None):
         if workers < 1:
             raise ValueError("workers must be >= 1")
         self.session = session
@@ -355,6 +359,13 @@ class QueryServer:
         self._draining = False         # stop()/begin_drain() in progress
         self._threads: list[threading.Thread] = []
         self.net = None                # NetServer once started (net.py)
+        # Cross-request coalescing (serve/coalesce.py): explicit kwargs
+        # win; None defers to the spark.serve.coalesce.* conf at start()
+        # (the same deferred one-flag read as the net front end).
+        self._coalesce_conf = (coalesce, coalesce_max_delay_ms,
+                               coalesce_max_batch,
+                               coalesce_min_queue_depth)
+        self.coalescer = None          # Coalescer once started
         # tenants granted a per-tenant latency series (MAX_TENANT_SERIES
         # cap); own lock — _finish runs while stop() may hold self._cond
         self._series_lock = threading.Lock()
@@ -394,6 +405,15 @@ class QueryServer:
             "metrics_host": str(conf.get("spark.serve.metricsHost",
                                          "127.0.0.1")),
             "slo_p99_ms": num("sloP99Ms", None, float),
+            "coalesce": (
+                None if "spark.serve.coalesce.enabled" not in conf
+                else str(conf["spark.serve.coalesce.enabled"]).lower()
+                not in CONF_FALSE),
+            "coalesce_max_delay_ms": num("coalesce.maxDelayMs", None,
+                                         float),
+            "coalesce_max_batch": num("coalesce.maxBatch", None, int),
+            "coalesce_min_queue_depth": num("coalesce.minQueueDepth",
+                                            None, int),
         }
         kw.update(overrides)
         return cls(session, **kw)
@@ -444,6 +464,24 @@ class QueryServer:
             from .net import NetServer
 
             self.net = NetServer(self).start()
+        # Cross-request coalescer (serve/coalesce.py): the same
+        # zero-cost-off contract — disabled mode reads exactly one flag,
+        # builds nothing, and every dispatch stays per-request.
+        co_on = self._coalesce_conf[0]
+        if co_on is None:
+            co_on = _cfg.serve_coalesce_enabled
+        if co_on and self.coalescer is None:
+            from .coalesce import Coalescer
+
+            _, delay, batch, depth = self._coalesce_conf
+            self.coalescer = Coalescer(
+                admission=self.admission,
+                max_delay_ms=(_cfg.serve_coalesce_max_delay_ms
+                              if delay is None else float(delay)),
+                max_batch=(_cfg.serve_coalesce_max_batch
+                           if batch is None else int(batch)),
+                min_queue_depth=(_cfg.serve_coalesce_min_queue_depth
+                                 if depth is None else int(depth)))
         return self
 
     def begin_drain(self) -> None:
@@ -741,11 +779,19 @@ class QueryServer:
             return
         ns_cm = (contextlib.nullcontext() if self.shared_plan_cache
                  else _plan_namespace(job.tenant))
+        # Adaptive coalescing arm (ONE None check when the coalescer is
+        # off): the queue depth REMAINING at pop time is the load
+        # signal — below minQueueDepth, or without deadline headroom for
+        # a hold window, the scope is the shared nullcontext and every
+        # dispatch below is byte-for-byte the per-request path.
+        co = self.coalescer
+        co_cm = (contextlib.nullcontext() if co is None
+                 else co.scope(job, self._queued_total))
         stats = None
         status, value, error = "ok", None, ""
         job.attempts += 1
         try:
-            with ns_cm, _shard_guard(), _obs.request_span(
+            with ns_cm, co_cm, _shard_guard(), _obs.request_span(
                     "serve.query", trace,
                     tenant=job.tenant, tag=job.tag,
                     attempt=job.attempts):
@@ -1035,6 +1081,8 @@ class QueryServer:
             "tenants": tenants,
             "breaker": self.breaker.snapshot(),
             "counters": counters.snapshot("serve."),
+            "coalesce": (None if self.coalescer is None
+                         else self.coalescer.stats()),
         }
 
     def cache_report(self) -> dict:

@@ -305,6 +305,27 @@ class TpuSession:
                 _set("serve_client_hedging", False)
             elif hval in _CONF_TRUE:
                 _set("serve_client_hedging", True)
+            # Cross-request plan coalescing (serve/coalesce.py),
+            # session-scoped like the net front end above:
+            #     .config("spark.serve.coalesce.enabled", "true")
+            #     .config("spark.serve.coalesce.maxDelayMs", 2)
+            #     .config("spark.serve.coalesce.maxBatch", 8)
+            #     .config("spark.serve.coalesce.minQueueDepth", 2)
+            coval = str(self.conf.get("spark.serve.coalesce.enabled",
+                                      "")).lower()
+            if coval in _CONF_FALSE:
+                _set("serve_coalesce_enabled", False)
+            elif coval in _CONF_TRUE:
+                _set("serve_coalesce_enabled", True)
+            if "spark.serve.coalesce.maxDelayMs" in self.conf:
+                _set("serve_coalesce_max_delay_ms",
+                     float(self.conf["spark.serve.coalesce.maxDelayMs"]))
+            if "spark.serve.coalesce.maxBatch" in self.conf:
+                _set("serve_coalesce_max_batch",
+                     int(self.conf["spark.serve.coalesce.maxBatch"]))
+            if "spark.serve.coalesce.minQueueDepth" in self.conf:
+                _set("serve_coalesce_min_queue_depth",
+                     int(self.conf["spark.serve.coalesce.minQueueDepth"]))
             # dqaudit thresholds (analysis/program/), session-scoped like
             # everything above:
             #     .config("spark.audit.enabled", "false")  # no est peak

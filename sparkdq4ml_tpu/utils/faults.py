@@ -35,7 +35,10 @@ model-fit sites (``gram_sharded``/``fit_packed``/``solver``/``fit``/
 ``ingest_native`` (the native streaming CSV reader,
 ``frame/native_csv.py``: I/O error, torn chunk, prefetch-thread death,
 bind-pool exhaustion), ``serve_exec``/``serve_admit`` (the QueryServer
-worker and admission gates, ``serve/``), and ``oom`` (memory pressure as
+worker and admission gates, ``serve/``), ``coalesce`` (the cross-request
+batched dispatch, ``serve/coalesce.py``: device error, wedged batch
+stall, stacked-bytes OOM — every rung degrades the whole batch to
+per-request replay of the same cached plan), and ``oom`` (memory pressure as
 a schedulable fault: a shrunken device budget makes the pre-execution
 static bound trip and the flush degrade to row-chunked execution).
 Injection happens at host-level dispatch boundaries only — never inside
@@ -104,6 +107,7 @@ FAULT_SITES = {
                       "pool_exhaust"),
     "serve_exec": ("device_error",),
     "serve_admit": ("breaker_trip", "oom"),
+    "coalesce": ("device_error", "stall", "oom"),
     "oom": ("oom",),
     "stats_persist": ("io_error", "torn_chunk"),
     "incident": ("io_error",),

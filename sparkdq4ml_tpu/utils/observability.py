@@ -177,6 +177,18 @@ METRIC_NAMES = {
     "serve.exec_ms": ("histogram", "execution wall per job"),
     "serve.e2e_ms": ("histogram", "client-experienced end-to-end "
                                   "latency"),
+    # cross-request plan coalescing (serve/coalesce.py)
+    "serve.coalesce.batched": ("counter", "queries served by a "
+                                          "cross-request batched "
+                                          "dispatch"),
+    "serve.coalesce.dispatches": ("counter", "cross-request batched "
+                                             "device dispatches"),
+    "serve.coalesce.degraded": ("counter", "batches degraded to "
+                                           "per-request replay"),
+    "serve.coalesce.batch_size": ("histogram", "members per batched "
+                                               "dispatch"),
+    "serve.coalesce.window_ms": ("histogram", "hold-window wait per "
+                                              "batched dispatch"),
     # network serving front end (serve/net.py + serve/client.py)
     "net.accept": ("counter", "socket connections accepted"),
     "net.requests": ("counter", "wire requests parsed (both framings)"),

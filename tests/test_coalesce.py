@@ -1,0 +1,452 @@
+"""Cross-request plan coalescing (serve/coalesce.py + compiler
+run_batched) — adaptive micro-batching of identical-plan queries into
+one stacked device dispatch.
+
+Covers: grouping-key identity (same plan+bucket coalesces, different
+literal VALUES still coalesce via hoisting, different buckets/dtypes
+never do), de-interleave bit-parity against the sequential path, the
+memory-gate batch clamp, deadline-headroom solo dispatch, the
+disabled/light-load byte-identical pins (batch machinery monkeypatched
+to raise), the whole fault ladder with golden results on every rung
+(device_error / stall / oom), batched-program registration in the
+cache/program registries, per-member trace resolution through
+``/trace/<id>``, and the 32-thread hammer's counter coherence.
+"""
+
+import contextlib
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from conftest import dataset_path
+from sparkdq4ml_tpu.frame.frame import Frame
+from sparkdq4ml_tpu.ops import compiler
+from sparkdq4ml_tpu.ops import expressions as E
+from sparkdq4ml_tpu.serve import AdmissionController, Coalescer, QueryServer
+from sparkdq4ml_tpu.serve import coalesce as coalesce_mod
+from sparkdq4ml_tpu.utils import faults
+from sparkdq4ml_tpu.utils import observability as obs
+from sparkdq4ml_tpu.utils.profiling import counters
+from sparkdq4ml_tpu.utils.recovery import RECOVERY_LOG
+from test_serve import GOLDEN_COUNT, GOLDEN_RMSE, headline_job
+
+pytestmark = pytest.mark.coalesce
+
+
+@pytest.fixture(autouse=True)
+def _coalesce_clean():
+    faults.clear()
+    RECOVERY_LOG.clear()
+    yield
+    faults.clear()
+    RECOVERY_LOG.clear()
+    obs.disable()
+    obs.reset()
+
+
+def _job(deadline_ts=None, trace=None):
+    """The two attributes of a serve ``_Job`` the coalescer's arming
+    decision reads."""
+    return SimpleNamespace(deadline_ts=deadline_ts, trace=trace)
+
+
+def _mk(lit, n=64, dtype=np.float64):
+    """One lazy frame whose flush is the coalescible unit: a compilable
+    with_column + filter chain over ``n`` rows."""
+    f = Frame({"v": np.arange(float(n)).astype(dtype)})
+    return f.with_column("c", E.col("v") * 2.0) \
+            .filter(E.col("c") > float(lit))
+
+
+def _expect_count(lit, n=64):
+    return int(np.sum(np.arange(float(n)) * 2.0 > float(lit)))
+
+
+def _coalesced(co, thunks, depth=99, jobs=None, timeout=30.0):
+    """Run each thunk on its own thread inside the coalescer's scope
+    (barrier-released so the flushes overlap); returns results in thunk
+    order, re-raising the first per-thread exception."""
+    res = [None] * len(thunks)
+    errs = [None] * len(thunks)
+    barrier = threading.Barrier(len(thunks))
+
+    def run(i, fn):
+        try:
+            job = jobs[i] if jobs is not None else _job()
+            with co.scope(job, depth):
+                barrier.wait()
+                res[i] = fn()
+        except Exception as e:   # noqa: BLE001 — re-raised below
+            errs[i] = e
+
+    threads = [threading.Thread(target=run, args=(i, fn))
+               for i, fn in enumerate(thunks)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout)
+    assert not any(t.is_alive() for t in threads), "coalesced flush hung"
+    for e in errs:
+        if e is not None:
+            raise e
+    return res
+
+
+class _Deltas:
+    """Before/after counter deltas (the global counters are shared with
+    every other test in the process — never assert absolutes)."""
+
+    NAMES = ("serve.coalesce.dispatches", "serve.coalesce.batched",
+             "serve.coalesce.degraded", "serve.admit", "serve.complete",
+             "serve.error", "serve.deadline_exceeded")
+
+    def __init__(self):
+        self._before = {n: counters.get(n) for n in self.NAMES}
+
+    def __getitem__(self, name):
+        return counters.get(name) - self._before[name]
+
+
+# ---------------------------------------------------------------------------
+# Grouping-key identity
+# ---------------------------------------------------------------------------
+
+class TestGrouping:
+    def test_identical_plans_coalesce_across_literal_values(self):
+        """Four requests whose filters differ only in the hoisted
+        literal VALUE share one plan and must ride ONE stacked dispatch
+        — each member still gets its own literal's answer."""
+        compiler.clear_cache()
+        lits = (6.0, 8.0, 10.0, 12.0)
+        co = Coalescer(max_delay_ms=2000.0, max_batch=len(lits),
+                       min_queue_depth=0)
+        d = _Deltas()
+        res = _coalesced(
+            co, [lambda lit=lit: _mk(lit).count() for lit in lits])
+        assert res == [_expect_count(lit) for lit in lits]
+        assert d["serve.coalesce.dispatches"] == 1
+        assert d["serve.coalesce.batched"] == len(lits)
+        assert d["serve.coalesce.degraded"] == 0
+
+    def test_different_row_buckets_never_coalesce(self):
+        compiler.clear_cache()
+        co = Coalescer(max_delay_ms=60.0, max_batch=2, min_queue_depth=0)
+        d = _Deltas()
+        res = _coalesced(co, [lambda: _mk(6.0, n=64).count(),
+                              lambda: _mk(6.0, n=200).count()])
+        assert res == [_expect_count(6.0, 64), _expect_count(6.0, 200)]
+        assert d["serve.coalesce.dispatches"] == 0
+        assert d["serve.coalesce.batched"] == 0
+
+    def test_different_dtypes_never_coalesce(self):
+        """The plan key embeds the column dtype tag, so a float32 and a
+        float64 request can never stack (stacking would promote)."""
+        compiler.clear_cache()
+        co = Coalescer(max_delay_ms=60.0, max_batch=2, min_queue_depth=0)
+        d = _Deltas()
+        res = _coalesced(
+            co, [lambda: _mk(6.0, dtype=np.float64).count(),
+                 lambda: _mk(6.0, dtype=np.float32).count()])
+        assert res == [_expect_count(6.0), _expect_count(6.0)]
+        assert d["serve.coalesce.dispatches"] == 0
+        assert d["serve.coalesce.batched"] == 0
+
+
+# ---------------------------------------------------------------------------
+# De-interleave parity + registration
+# ---------------------------------------------------------------------------
+
+class TestParity:
+    def test_deinterleave_bit_parity_vs_sequential(self):
+        """The stacked dispatch is pure vmap over the same trace body:
+        every member's columns and mask must be BIT-identical to the
+        uncoalesced flush of the same pipeline."""
+        compiler.clear_cache()
+        lits = (5.0, 9.0, 21.0)
+        sequential = [_mk(lit).to_pydict() for lit in lits]
+        co = Coalescer(max_delay_ms=2000.0, max_batch=len(lits),
+                       min_queue_depth=0)
+        d = _Deltas()
+        coalesced = _coalesced(
+            co, [lambda lit=lit: _mk(lit).to_pydict() for lit in lits])
+        assert d["serve.coalesce.dispatches"] == 1
+        for got, want in zip(coalesced, sequential):
+            assert set(got) == set(want)
+            for name in want:
+                assert got[name].dtype == want[name].dtype
+                assert np.array_equal(got[name], want[name])
+
+    def test_batched_programs_registered_for_audit(self):
+        """A batched dispatch registers its vmapped program in the
+        'coalesce' cache/program registries, so cache_report, dqaudit,
+        and the cost observatory enumerate it like any plan."""
+        compiler.clear_cache()
+        co = Coalescer(max_delay_ms=2000.0, max_batch=2,
+                       min_queue_depth=0)
+        _coalesced(co, [lambda: _mk(3.0).count(),
+                        lambda: _mk(7.0).count()])
+        stats = compiler.coalesce_cache_stats()
+        assert stats["size"] >= 1
+        assert any(e["program_key"].startswith("coalesce[x2]|")
+                   for e in stats["entries"])
+        report = obs.cache_report()
+        assert "coalesce" in report
+        handles, errors = obs.CACHES.programs()
+        assert not errors
+        keys = [h.program_key for h in handles]
+        assert any(k.startswith("coalesce[x2]|") for k in keys)
+
+
+# ---------------------------------------------------------------------------
+# Sizing + arming decisions
+# ---------------------------------------------------------------------------
+
+class TestSizing:
+    def test_batch_limit_prices_stacked_bytes(self):
+        adm = AdmissionController(memory_limit_bytes=10_000)
+        assert adm.batch_limit(1000, 8, live_bytes=0) == 8
+        assert adm.batch_limit(3000, 8, live_bytes=4000) == 2
+        assert adm.batch_limit(3000, 8, live_bytes=99_999) == 1
+        assert adm.batch_limit(None, 8) == 8
+        assert AdmissionController().batch_limit(1 << 30, 8) == 8
+
+    def test_memory_gate_forces_solo_dispatch(self):
+        """A 1-byte budget clamps every batch to one member: both
+        requests run the plain per-request program (results exact, no
+        batched counters)."""
+        compiler.clear_cache()
+        adm = AdmissionController(memory_limit_bytes=1)
+        co = Coalescer(admission=adm, max_delay_ms=60.0, max_batch=4,
+                       min_queue_depth=0)
+        d = _Deltas()
+        res = _coalesced(co, [lambda: _mk(6.0).count(),
+                              lambda: _mk(8.0).count()])
+        assert res == [_expect_count(6.0), _expect_count(8.0)]
+        assert d["serve.coalesce.dispatches"] == 0
+        assert d["serve.coalesce.batched"] == 0
+
+    def test_near_deadline_job_dispatches_solo(self, monkeypatch):
+        """A job without window headroom never waits: its scope is the
+        shared nullcontext and the batch machinery is never touched."""
+        co = Coalescer(max_delay_ms=20.0, max_batch=4, min_queue_depth=0)
+        job = _job(deadline_ts=time.perf_counter() + 0.005)
+        cm = co.scope(job, queue_depth=99)
+        assert isinstance(cm, contextlib.nullcontext)
+        monkeypatch.setattr(
+            compiler, "run_batched",
+            lambda *a, **k: pytest.fail("batched machinery touched"))
+        with cm:
+            assert _mk(6.0).count() == _expect_count(6.0)
+
+    def test_light_load_scope_is_nullcontext(self):
+        co = Coalescer(max_delay_ms=20.0, max_batch=4, min_queue_depth=3)
+        assert isinstance(co.scope(_job(), 2), contextlib.nullcontext)
+        assert not isinstance(co.scope(_job(), 3),
+                              contextlib.nullcontext)
+        # degenerate conf disables outright
+        assert isinstance(
+            Coalescer(max_batch=1).scope(_job(), 99),
+            contextlib.nullcontext)
+        assert isinstance(
+            Coalescer(max_delay_ms=0.0).scope(_job(), 99),
+            contextlib.nullcontext)
+
+
+# ---------------------------------------------------------------------------
+# Disabled / light-load no-op pins through the server
+# ---------------------------------------------------------------------------
+
+class TestNoOpPins:
+    def test_disabled_server_never_builds_coalescer(self, session,
+                                                    monkeypatch):
+        monkeypatch.setattr(
+            coalesce_mod.Coalescer, "_dispatch",
+            lambda *a, **k: pytest.fail("coalesce dispatch on the "
+                                        "disabled path"))
+        monkeypatch.setattr(
+            compiler, "run_batched",
+            lambda *a, **k: pytest.fail("batched machinery touched"))
+        with QueryServer(session, workers=2) as srv:
+            assert srv.coalescer is None
+            r = srv.submit(lambda ctx: _mk(6.0).count(),
+                           tenant="solo").result()
+        assert r.ok and r.value == _expect_count(6.0)
+
+    def test_light_load_is_per_request_path(self, session, monkeypatch):
+        """Coalescing ON but queue depth below minQueueDepth: dispatches
+        must ride the per-request path (machinery poisoned to prove no
+        touch)."""
+        monkeypatch.setattr(
+            coalesce_mod.Coalescer, "_dispatch",
+            lambda *a, **k: pytest.fail("coalesce dispatch under light "
+                                        "load"))
+        monkeypatch.setattr(
+            compiler, "run_batched",
+            lambda *a, **k: pytest.fail("batched machinery touched"))
+        with QueryServer(session, workers=2, coalesce=True,
+                         coalesce_min_queue_depth=64) as srv:
+            assert srv.coalescer is not None
+            for lit in (6.0, 8.0):
+                r = srv.submit(lambda ctx, lit=lit: _mk(lit).count(),
+                               tenant="light").result()
+                assert r.ok and r.value == _expect_count(lit)
+            assert srv.stats()["coalesce"]["dispatches"] == \
+                counters.get("serve.coalesce.dispatches")
+
+
+# ---------------------------------------------------------------------------
+# Fault ladder: every rung degrades to per-request replay, goldens exact
+# ---------------------------------------------------------------------------
+
+class TestFaultLadder:
+    @pytest.mark.parametrize("spec", [
+        "coalesce:device_error:1",
+        "coalesce:stall:1",
+        "coalesce:oom:1:n=64",
+    ])
+    def test_batch_degrades_to_per_request_replay(self, spec):
+        compiler.clear_cache()
+        co = Coalescer(max_delay_ms=2000.0, max_batch=2,
+                       min_queue_depth=0)
+        d = _Deltas()
+        with faults.inject_faults(spec, seed=7):
+            res = _coalesced(co, [lambda: _mk(6.0).count(),
+                                  lambda: _mk(8.0).count()])
+        assert res == [_expect_count(6.0), _expect_count(8.0)]
+        assert d["serve.coalesce.degraded"] == 1
+        assert d["serve.coalesce.dispatches"] == 0
+        events = RECOVERY_LOG.events(site="coalesce")
+        assert events and events[-1].action == "fallback"
+        assert events[-1].rung == "per_request"
+
+    def test_degraded_headline_results_stay_golden(self, session):
+        """Chaos through the whole serving stack: coalescing live, the
+        coalesce site faulted on its first attempts — every client still
+        reads count=24 / RMSE 2.80994."""
+        job = headline_job(dataset_path("abstract"))
+        d = _Deltas()
+        with faults.inject_faults("coalesce:device_error:1,2",
+                                  seed=11):
+            with QueryServer(session, workers=8, max_queue=128,
+                             coalesce=True, coalesce_max_delay_ms=10.0,
+                             coalesce_max_batch=8,
+                             coalesce_min_queue_depth=1) as srv:
+                futs = [srv.submit(job, tenant=f"chaos-{i:02d}")
+                        for i in range(8)]
+                results = [f.result(timeout=300) for f in futs]
+        assert all(r.ok for r in results), \
+            [r.error for r in results if not r.ok]
+        for r in results:
+            assert r.value["count"] == GOLDEN_COUNT
+            assert r.value["rmse"] == pytest.approx(GOLDEN_RMSE,
+                                                    abs=1e-4)
+        assert d["serve.admit"] == (d["serve.complete"]
+                                    + d["serve.error"]
+                                    + d["serve.deadline_exceeded"])
+
+
+# ---------------------------------------------------------------------------
+# Tracing: the shared batch span resolves per member
+# ---------------------------------------------------------------------------
+
+class TestTracing:
+    @staticmethod
+    def _get(port, path):
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}{path}") as resp:
+                return resp.status, json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            return e.code, json.loads(e.read() or b"{}")
+
+    def test_member_trace_ids_resolve_with_batch_span(self):
+        from sparkdq4ml_tpu.serve.http import TelemetryServer
+
+        obs.enable()
+        compiler.clear_cache()
+        co = Coalescer(max_delay_ms=2000.0, max_batch=2,
+                       min_queue_depth=0)
+        ctxs = [obs.TraceContext.mint() for _ in range(2)]
+
+        def traced(lit, ctx):
+            def run():
+                with obs.request_span("serve.query", ctx, tenant="tr"):
+                    out = _mk(lit).count()
+                obs.TAIL.finish_request(
+                    ctx, status="error", reason="keep", e2e_ms=1.0,
+                    breaker_opened=False, slo_ms=None)
+                return out
+            return run
+
+        res = _coalesced(
+            co,
+            [traced(6.0, ctxs[0]), traced(8.0, ctxs[1])],
+            jobs=[_job(trace=ctxs[0]), _job(trace=ctxs[1])])
+        assert res == [_expect_count(6.0), _expect_count(8.0)]
+        t = TelemetryServer(None, port=0).start()
+        try:
+            docs = []
+            for ctx in ctxs:
+                code, doc = self._get(t.port, f"/trace/{ctx.trace_id}")
+                assert code == 200 and doc["trace_id"] == ctx.trace_id
+                docs.append(doc)
+        finally:
+            t.stop()
+        spans = [s for doc in docs for tree in doc["trees"]
+                 for s in tree["spans"]
+                 if s["name"] == "serve.coalesce"]
+        assert len(spans) == 2, "one shared batch span per member tree"
+        ids = {ctx.trace_id for ctx in ctxs}
+        for s in spans:
+            assert s["attrs"]["batch"] == 2
+            assert set(s["attrs"]["members"].split(",")) == ids
+        assert len({s["attrs"]["batch_id"] for s in spans}) == 1
+
+
+# ---------------------------------------------------------------------------
+# Hammer: coherence under real contention
+# ---------------------------------------------------------------------------
+
+class TestHammer:
+    def test_32_thread_hammer_counter_coherence(self, session):
+        compiler.clear_cache()
+        d = _Deltas()
+        with QueryServer(session, workers=8, max_queue=256,
+                         coalesce=True, coalesce_max_delay_ms=25.0,
+                         coalesce_max_batch=8,
+                         coalesce_min_queue_depth=1) as srv:
+            results = [None] * 32
+            barrier = threading.Barrier(32)
+
+            def client(i):
+                barrier.wait()
+                fut = srv.submit(
+                    lambda ctx, i=i: _mk(6.0 + (i % 4)).count(),
+                    tenant=f"h{i % 4}", deadline_s=120.0)
+                results[i] = fut.result(timeout=120)
+
+            threads = [threading.Thread(target=client, args=(i,))
+                       for i in range(32)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(120)
+        assert all(r is not None and r.ok for r in results), \
+            [r.error for r in results if r is not None and not r.ok]
+        for i, r in enumerate(results):
+            assert r.value == _expect_count(6.0 + (i % 4))
+        assert d["serve.admit"] == (d["serve.complete"]
+                                    + d["serve.error"]
+                                    + d["serve.deadline_exceeded"])
+        assert d["serve.admit"] == 32
+        # queue pressure (32 clients, 8 workers, shared plan) must have
+        # produced at least one genuine cross-request stacking
+        assert d["serve.coalesce.batched"] >= 2
+        assert d["serve.coalesce.dispatches"] < \
+            d["serve.coalesce.batched"]
