@@ -124,6 +124,11 @@ _CANDIDATES = (
     ("coalesce", "device_error", 0.12, ""),
     ("coalesce", "stall", 0.08, ""),
     ("coalesce", "oom", 0.12, ":n=64"),
+    # the adaptive executor's ladder (sql/adaptive.py): a fault at a
+    # re-plan DECISION point degrades that decision to the static plan
+    # the query already holds — results stay golden on every rung
+    ("aqe", "device_error", 0.20, ""),
+    ("aqe", "stall", 0.10, ""),
 )
 
 
@@ -160,6 +165,7 @@ _ROTATION = (
     ("cost_profile", "device_error", ""),
     ("coalesce", "device_error", ""),
     ("coalesce", "oom", ":n=64"),
+    ("aqe", "device_error", ""),
 )
 
 #: Guaranteed net faults for the socket arm, rotated alongside

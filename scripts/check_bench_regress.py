@@ -105,7 +105,8 @@ def load_bench_doc(path: str):
         return None
     if any(k in raw for k in ("configs", "sweep", "frame_pipeline",
                               "grouped_ops", "serving", "ingest",
-                              "sharded", "optimizer", "costprof")):
+                              "sharded", "optimizer", "costprof",
+                              "aqe")):
         return raw
     if isinstance(raw.get("parsed"), dict):
         return raw["parsed"]

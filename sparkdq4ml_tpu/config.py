@@ -66,6 +66,10 @@ CONF_KEYS = {
     "spark.chaos.soakSeconds": "session",
     "spark.optimizer.enabled": "session",
     "spark.optimizer.level": "session",
+    "spark.aqe.enabled": "session",
+    "spark.aqe.driftFactor": "session",
+    "spark.aqe.broadcastThreshold": "session",
+    "spark.aqe.skewFactor": "session",
     "spark.stats.enabled": "session",
     "spark.stats.path": "session",
     "spark.stats.maxEntries": "session",
@@ -267,6 +271,29 @@ class _Config:
     # stay exact, but physical row order may legally change where SQL
     # imposes none.
     optimizer_level: int = 1
+    # Adaptive query execution (sql/adaptive.py + stage-boundary hooks):
+    # mid-query re-planning from the rows/bytes THIS execution just
+    # observed — build-side flips and broadcast shuffle-skips at the join
+    # boundary, downstream re-bucketing after a misestimated filter,
+    # skewed-exchange partition splits, and the grouped engine's
+    # estimate-informed lowering choice. Every transform is bit-identical
+    # by construction (the masked-slot invariant + the partitioned plan's
+    # stable order merge); spark.aqe.enabled=false reduces every hook to
+    # one flag read and runs the static plan end to end.
+    aqe_enabled: bool = True
+    # Drift ratio (observed vs estimate, either direction) that triggers
+    # a re-plan decision (spark.aqe.driftFactor). Below it the static
+    # plan stands — estimates are advisory, re-planning has real cost.
+    aqe_drift_factor: float = 4.0
+    # Observed build-side byte bound under which a drift-triggered join
+    # skips the hash-partition shuffle entirely and runs the single
+    # (broadcast-style) plan (spark.aqe.broadcastThreshold).
+    aqe_broadcast_threshold: int = 8 << 20
+    # Live partition-balance ratio (largest/mean probe rows within one
+    # exchange) past which a skewed partition splits into balanced
+    # chunks (spark.aqe.skewFactor) — the PR-13 decomposable merge
+    # re-sorts the chunk plans back into the exact global order.
+    aqe_skew_factor: float = 4.0
     # Plan-statistics observatory (utils/statstore.py): per-plan-key
     # running stats — observed selectivity, wall/compile-ms digests,
     # host syncs, est/measured peak bytes — feeding EXPLAIN's history-

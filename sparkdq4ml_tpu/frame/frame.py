@@ -1930,7 +1930,8 @@ class Frame:
 
     @op_span("frame.join")
     def join(self, other: "Frame", on, how: str = "inner",
-             build: Optional[str] = None) -> "Frame":
+             build: Optional[str] = None,
+             est: Optional[tuple] = None) -> "Frame":
         """Relational join on key column(s) present in both frames.
 
         ``how``: ``inner`` | ``left`` | ``right`` | ``outer``/``full`` |
@@ -1945,6 +1946,15 @@ class Frame:
         the left is the small side. Result is bit-identical, emission
         order included (see ``_vector_join_plan``); any other value or
         join type ignores the hint.
+
+        ``est=(left_rows, right_rows)`` (adaptive execution input,
+        ``sql/adaptive.py``): the optimizer's pre-execution row
+        estimates for the two sides. When ``spark.aqe.enabled`` and the
+        OBSERVED valid-row counts drift past ``spark.aqe.driftFactor``,
+        the build side re-decides mid-query and a small-enough observed
+        build side skips the hash-partition shuffle (both transforms
+        bit-identical by construction). ``None`` (or AQE off) keeps the
+        static plan.
 
         Design: only valid (mask=True) rows participate. The match *plan*
         (row-index pairs) is computed host-side with a hash join — the
@@ -1970,6 +1980,53 @@ class Frame:
 
         li = np.nonzero(self._host_mask())[0]
         ri = np.nonzero(other._host_mask())[0]
+
+        # Adaptive re-planning (sql/adaptive.py): the host plan already
+        # holds both sides' TRUE valid-row counts — zero extra syncs —
+        # so when either side drifted past spark.aqe.driftFactor from
+        # the optimizer's estimate, the build side re-decides from the
+        # observed counts, and an observed build side under
+        # spark.aqe.broadcastThreshold bytes skips the hash-partition
+        # shuffle entirely (the partitioned plan reproduces the
+        # unpartitioned emission order exactly, so skipping it is the
+        # identity transform). One conf read when AQE is off; a cold
+        # estimate (est None) changes nothing.
+        aqe_skip_shuffle = False
+        if est is not None and how == "inner" and config.aqe_enabled:
+            from ..sql import adaptive as _aqe
+
+            left_est, right_est = est
+            if _aqe.drift(left_est, li.size) \
+                    or _aqe.drift(right_est, ri.size):
+                want_left = li.size * _aqe.BUILD_RATIO <= ri.size
+                if want_left != build_left and _aqe.guard("build-flip"):
+                    _aqe.record(
+                        "build-flip",
+                        f"join build={'left' if want_left else 'right'}"
+                        f" (observed {li.size} vs {ri.size} rows)",
+                        est_before=(left_est if want_left
+                                    else right_est),
+                        est_after=(int(li.size) if want_left
+                                   else int(ri.size)))
+                    build_left = want_left
+                store_hint = (self._shard if self._shard is not None
+                              else other._shard)
+                if store_hint is not None and \
+                        max(li.size, ri.size) >= int(config.shard_min_rows):
+                    b_rows = int(min(li.size, ri.size))
+                    b_frame = self if li.size <= ri.size else other
+                    b_bytes = b_rows * _aqe.row_nbytes(b_frame)
+                    if b_bytes <= int(config.aqe_broadcast_threshold) \
+                            and _aqe.guard("broadcast"):
+                        _aqe.record(
+                            "broadcast",
+                            "hash-partition Exchange skipped (observed"
+                            f" build side {b_rows} rows ~{b_bytes} B "
+                            "fits spark.aqe.broadcastThreshold)",
+                            est_before=(left_est if li.size <= ri.size
+                                        else right_est),
+                            est_after=b_rows)
+                        aqe_skip_shuffle = True
 
         if how == "cross":
             lpairs = np.repeat(li, len(ri))
@@ -2010,7 +2067,7 @@ class Frame:
                 planner = ((lambda *a: _vector_join_plan(
                     *a, build_left=True)) if build_left
                     else _vector_join_plan)
-                if store is not None and \
+                if store is not None and not aqe_skip_shuffle and \
                         max(li.size, ri.size) >= int(config.shard_min_rows):
                     from ..parallel.shard import partitioned_join_plan
 

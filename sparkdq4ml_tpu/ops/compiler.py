@@ -864,6 +864,15 @@ def _flush_budget() -> Optional[int]:
     return None
 
 
+def flush_budget() -> Optional[int]:
+    """Public read of the per-flush device-byte budget (None = no bound
+    configured): the adaptive re-planner (``sql/adaptive.py``) re-checks
+    a re-bucketed stage's static byte bound against the SAME budget the
+    flush-time chunking ladder enforces, so the two layers can never
+    disagree on what fits."""
+    return _flush_budget()
+
+
 def _est_flush_bytes(plan, data: dict, b: int) -> int:
     """Cheap, import-free over-approximation of the flush program's
     resident bytes at bucket ``b``: padded inputs + mask + 2× one

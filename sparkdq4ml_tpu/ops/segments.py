@@ -1290,6 +1290,34 @@ def grouped_agg(frame, keys, agg_list):
                 counters.increment("optimizer.dense_skip")
         except Exception:
             pass
+    # Adaptive lowering re-plan (sql/adaptive.py): the recorded output-
+    # cardinality history for THESE key columns estimates the group
+    # count; more estimated groups than the dense table has slots means
+    # the dense program MUST miss (g groups need g slots), so the
+    # doomed dispatch and its extra host sync are skipped for this
+    # query — live estimate evidence, where the miss-history skip above
+    # needs two recorded failures first. Bit-identical: the sorted
+    # program is exactly the reroute a dense miss would have taken.
+    if (dense_ok and not sharded and not skip_dense and stats_on
+            and config.aqe_enabled):
+        from ..sql import adaptive as _aqe
+        from ..utils import statstore as _stats_store
+
+        est_g = None
+        try:
+            ckey = cardinality_history_key("g", keys, key_arrs)
+            if ckey is not None:
+                est_g = _stats_store.STORE.est_rows(ckey, n)
+        except Exception:
+            est_g = None
+        if est_g is not None and est_g > S \
+                and _aqe.guard("grouped-lowering"):
+            skip_dense = True
+            _aqe.record(
+                "grouped-lowering",
+                f"est {est_g} groups > dense range {S}; sorted "
+                "program directly",
+                est_before=S, est_after=est_g)
     with _obs.TRACER.span(
             "frame.grouped.flush", cat="frame", op="group_by",
             keys=len(keys), aggs=len(agg_list), rows=n, bucket=b) as sp:

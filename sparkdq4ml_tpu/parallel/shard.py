@@ -345,9 +345,23 @@ def partitioned_join_plan(plan_fn, lcols, rcols, li, ri, how: str,
     re-sort by right index, the canonical append order).
 
     Returns ``(lpairs, rpairs)`` or ``None`` when any partition's plan
-    bails (the caller falls back to the unpartitioned plan)."""
+    bails (the caller falls back to the unpartitioned plan).
+
+    Adaptive skew split (``sql/adaptive.py``): a probe-side partition
+    whose row count crosses ``spark.aqe.skewFactor`` x the mean — the
+    live per-exchange analogue of the ``shard.skew`` placement gauge —
+    splits into balanced probe chunks, each planned against the
+    partition's FULL build side. Bit-identical: every left row's
+    complete match set is chunk-local (the build side never splits) and
+    the stable left-index sort below already restores the global
+    emission order regardless of which sub-plan emitted a pair. Gated
+    to join types whose unmatched-right detection is not cross-chunk
+    (a right row unmatched in one chunk may match in another); one
+    conf read when AQE is off."""
     t_l = hash_partition(lcols, parts)
     t_r = hash_partition(rcols, parts)
+    aqe_on = config.aqe_enabled
+    mean_rows = li.size / max(parts, 1)
     lp_all, rp_all = [], []
     extra_r = []                     # unmatched right rows (right/outer)
     for p in range(parts):
@@ -364,6 +378,37 @@ def partitioned_join_plan(plan_fn, lcols, rcols, li, ri, how: str,
             lp_all.append(li[ls].astype(np.int64))
             rp_all.append(np.full(ls.size, -1, np.int64))
             continue
+        if (aqe_on and mean_rows > 0
+                and how in ("inner", "left", "left_semi", "left_anti")
+                and ls.size >= mean_rows
+                * max(float(config.aqe_skew_factor), 1.0)
+                and ls.size >= 2):
+            from ..sql import adaptive as _aqe
+
+            if _aqe.guard("skew-split"):
+                target = max(int(math.ceil(mean_rows)), 1)
+                chunks = range(0, ls.size, target)
+                for c0 in chunks:
+                    lc = ls[c0: c0 + target]
+                    sub = plan_fn([c[lc] for c in lcols],
+                                  [c[rs] for c in rcols],
+                                  li[lc], ri[rs], how)
+                    if sub is None:
+                        # a chunk plan bailed: the caller falls back to
+                        # the UNPARTITIONED plan, so no split happened —
+                        # record nothing
+                        return None
+                    lp_c, rp_c = sub
+                    lp_all.append(lp_c)
+                    rp_all.append(rp_c)
+                _aqe.record(
+                    "skew-split",
+                    f"Exchange partition {p}: {ls.size} probe rows >= "
+                    f"{float(config.aqe_skew_factor):g}x mean "
+                    f"{mean_rows:.0f}; split into {len(chunks)} chunks",
+                    est_before=int(round(mean_rows)),
+                    est_after=int(ls.size))
+                continue
         sub = plan_fn([c[ls] for c in lcols], [c[rs] for c in rcols],
                       li[ls], ri[rs], how)
         if sub is None:

@@ -525,7 +525,12 @@ class NetServer:
             pages = 0
             try:
                 if result.status == "ok":
+                    dts = self._stream_deadline(fut)
                     for page in self._pages(result.value):
+                        if dts is not None \
+                                and time.perf_counter() > dts:
+                            result = self._page_deadline(result, pages)
+                            break
                         page["page"] = pages
                         await self._send_frame(conn, page)
                         pages += 1
@@ -622,7 +627,7 @@ class NetServer:
         pages = 0
         try:
             pages = await self._stream_http(conn, result,
-                                            trace_id=trace_id)
+                                            trace_id=trace_id, fut=fut)
         finally:
             self._finish_trace(
                 ctx, pages=pages, proto="http",
@@ -675,7 +680,8 @@ class NetServer:
         await self._write(conn, head + payload)
 
     async def _stream_http(self, conn: _Conn, result: QueryResult,
-                           trace_id: Optional[str] = None) -> int:
+                           trace_id: Optional[str] = None,
+                           fut=None) -> int:
         head = ("HTTP/1.1 200 OK\r\n"
                 "Content-Type: application/x-ndjson\r\n"
                 "Transfer-Encoding: chunked\r\n"
@@ -683,7 +689,11 @@ class NetServer:
         await self._write(conn, head)
         conn.streaming = True
         pages = 0
+        dts = self._stream_deadline(fut)
         for page in self._pages(result.value):
+            if dts is not None and time.perf_counter() > dts:
+                result = self._page_deadline(result, pages)
+                break
             page["page"] = pages
             await self._write_chunk(conn, page)
             pages += 1
@@ -883,6 +893,32 @@ class NetServer:
                 yield {"rows": {k: [] for k in cols}}
             return
         yield {"value": value}
+
+    @staticmethod
+    def _stream_deadline(fut) -> Optional[float]:
+        """The job's wire deadline carried INTO streaming: ``deadline_s``
+        bounds queueing and execution, but a large SELECT's result could
+        page out past it indefinitely — each page send re-checks this
+        ``perf_counter`` bound, so the deadline covers the stream end to
+        end. None (no wire deadline, or a dedup/reject path without a
+        job) streams unbounded as before."""
+        job = getattr(fut, "_job", None)
+        return getattr(job, "deadline_ts", None)
+
+    @staticmethod
+    def _page_deadline(result: QueryResult, pages: int) -> QueryResult:
+        """Truncate a result stream at the wire deadline: the pages
+        already sent stand, the rest are dropped, and the terminal frame
+        carries a structured ``deadline_exceeded`` (site ``stream``) —
+        the client sees a clean refusal, never a wedged socket."""
+        counters.increment("net.page_deadline")
+        return QueryResult(
+            status="deadline_exceeded", tenant=result.tenant,
+            reason="deadline", where="stream", tag=result.tag,
+            queue_ms=result.queue_ms, exec_ms=result.exec_ms,
+            e2e_ms=result.e2e_ms,
+            detail=f"wire deadline expired mid-stream after {pages} "
+                   "page(s); remaining pages dropped")
 
     @staticmethod
     def _end_doc(result: QueryResult) -> dict:

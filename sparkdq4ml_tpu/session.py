@@ -384,6 +384,26 @@ class TpuSession:
             if "spark.optimizer.level" in self.conf:
                 _set("optimizer_level",
                      int(self.conf["spark.optimizer.level"]))
+            # Adaptive query execution (sql/adaptive.py), session-scoped
+            # like everything above:
+            #     .config("spark.aqe.enabled", "false")  # static plans
+            #     .config("spark.aqe.driftFactor", 8.0)  # replan trigger
+            #     .config("spark.aqe.broadcastThreshold", 1 << 20)
+            #     .config("spark.aqe.skewFactor", 2.0)   # split trigger
+            aval = str(self.conf.get("spark.aqe.enabled", "")).lower()
+            if aval in _CONF_FALSE:
+                _set("aqe_enabled", False)
+            elif aval in _CONF_TRUE:
+                _set("aqe_enabled", True)
+            if "spark.aqe.driftFactor" in self.conf:
+                _set("aqe_drift_factor",
+                     float(self.conf["spark.aqe.driftFactor"]))
+            if "spark.aqe.broadcastThreshold" in self.conf:
+                _set("aqe_broadcast_threshold",
+                     int(self.conf["spark.aqe.broadcastThreshold"]))
+            if "spark.aqe.skewFactor" in self.conf:
+                _set("aqe_skew_factor",
+                     float(self.conf["spark.aqe.skewFactor"]))
             # Plan-stats observatory (utils/statstore.py), session-scoped
             # like everything above:
             #     .config("spark.stats.enabled", "false")   # hooks no-op

@@ -1,0 +1,289 @@
+"""Adaptive query execution — mid-query re-planning from observed stats.
+
+ROADMAP item 4's second half (the first half is ``sql/optimizer.py``):
+the cost-based optimizer picks a plan from *persisted* history, but
+until now the plan chosen before execution was the plan executed to the
+end — even when the first stage just proved its cardinality estimates
+wrong. This module is the decision layer the stage-boundary hooks call
+at every point where a running query already holds fresh evidence on
+host (classic Spark-AQE territory, applied to this engine's
+static-shape discipline):
+
+* **build-side flip** (``Frame.join``) — the join's host plan knows the
+  TRUE valid-row counts of both sides (``li``/``ri`` — host-known, zero
+  extra syncs) before it builds anything. When either side drifted past
+  ``spark.aqe.driftFactor`` from the optimizer's estimate, the build
+  hint is re-decided from the observed counts. Bit-identical: both
+  build directions re-canonicalize to the same emission order.
+* **broadcast shuffle-skip** (``Frame.join``) — when drift fired and
+  the observed build side fits ``spark.aqe.broadcastThreshold`` bytes,
+  the hash-partition Exchange is skipped entirely and the single
+  (broadcast-style) plan runs. Bit-identical by construction: the
+  partitioned plan merges back into EXACTLY the unpartitioned plan's
+  order, so not partitioning is the identity transform.
+* **skew split** (``parallel/shard.partitioned_join_plan``) — a probe-
+  side partition whose row count crosses ``spark.aqe.skewFactor`` x the
+  mean splits into balanced chunks, each planned against the partition's
+  full build side; the PR-13 stable left-index merge re-sorts the chunk
+  plans into the exact global order (gated to join types whose
+  unmatched-right detection is not cross-chunk).
+* **downstream re-bucket** (``sql/parser._execute_single`` after the
+  WHERE filter) — when the observed valid-row count lands a power-of-two
+  bucket (``ops/compiler.bucket_size``) below the static slot count and
+  past the drift factor, the surviving rows compact into the smaller
+  bucket so every downstream stage (grouped lowering, device sort,
+  distinct) runs with fewer padded slots — the arxiv 2206.14148 memory
+  bound applied *during* the query; the static flush-byte bound is
+  re-checked against the device budget at the boundary. Semantics-
+  preserving by the masked-slot invariant (padded tails ride ``False``
+  masks everywhere already).
+* **grouped lowering choice** (``ops/segments.grouped_agg``) — when the
+  recorded output-cardinality history says the group count exceeds the
+  dense slot-table range, the doomed dense dispatch (and its extra host
+  sync) is skipped for THIS query, not just after two recorded misses.
+
+Every decision point runs behind :func:`guard` — the ``aqe`` fault site
+(``device_error`` raises, ``stall`` is a due-test) degrades the
+DECISION to the static plan with a ``recovery.fallback`` event (rung
+``static``) and an ``aqe.fallback`` counter; results stay golden on
+every rung because the static plan is always the fallback, never an
+error. Re-planned remainders compile through the normal
+``ProgramHandle``-registered caches (a re-bucketed stage is just a
+smaller-bucket entry of the same registered cache, warm across queries
+with the same drift signature).
+
+EXPLAIN ANALYZE renders applied events as an ``== Adaptive ==`` section
+(the :func:`capture` scope); ``aqe.replans``/``aqe.replans.<trigger>``
+count them. ``spark.aqe.enabled=false`` reduces every hook to one conf
+read and pins EXPLAIN output byte-identical to the static engine.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import logging
+from typing import Optional
+
+import numpy as np
+
+from ..config import config
+from ..utils.profiling import counters
+
+logger = logging.getLogger("sparkdq4ml_tpu.sql.adaptive")
+
+__all__ = [
+    "enabled", "guard", "drift", "record", "capture", "render",
+    "rebucket_candidate", "maybe_rebucket", "row_nbytes",
+    "BUILD_RATIO",
+]
+
+#: Build-side hysteresis, mirroring the static optimizer's
+#: ``_BUILD_RATIO``: the observed-count re-decision must clear the same
+#: bar the estimate-based hint did, or drift would flip marginal joins
+#: back and forth between runs.
+BUILD_RATIO = 2
+
+
+class ReplanEvent:
+    """One applied mid-query re-plan — the ``== Adaptive ==`` line."""
+
+    __slots__ = ("trigger", "detail", "est_before", "est_after")
+
+    def __init__(self, trigger: str, detail: str,
+                 est_before: Optional[int], est_after: Optional[int]):
+        self.trigger = trigger
+        self.detail = detail
+        self.est_before = est_before
+        self.est_after = est_after
+
+    def __str__(self):
+        def fmt(v):
+            return "-" if v is None else str(v)
+
+        return (f"{self.trigger}: {self.detail} "
+                f"(est_rows {fmt(self.est_before)} -> "
+                f"{fmt(self.est_after)})")
+
+
+#: EXPLAIN ANALYZE's capture scope: a list the execution under
+#: :func:`capture` appends applied events into. Context-local so
+#: concurrent serving queries never interleave sections.
+_CAPTURE: contextvars.ContextVar = contextvars.ContextVar(
+    "aqe_capture", default=None)
+
+
+def enabled() -> bool:
+    return bool(config.aqe_enabled)
+
+
+def guard(decision: str) -> bool:
+    """Fault-laddered admission of ONE re-plan decision point: returns
+    True when the adaptive decision may proceed. The ``aqe`` fault site
+    injects here — ``device_error`` raises, ``stall`` fires the due-test
+    — and EITHER kind degrades this decision to the static plan (rung
+    ``static``: the query finishes on the plan it already had, results
+    golden) with an ``aqe.fallback`` counter. Never raises."""
+    from ..utils import faults as _faults
+
+    try:
+        _faults.inject("aqe")
+        if _faults.fired("aqe", "stall"):
+            raise TimeoutError("injected stall at 'aqe'")
+        return True
+    except Exception as e:
+        from ..utils.recovery import RECOVERY_LOG
+
+        counters.increment("aqe.fallback")
+        RECOVERY_LOG.record(
+            "aqe", "fallback", rung="static",
+            cause=f"{type(e).__name__}: {e}",
+            detail=f"{decision} re-plan skipped; the static plan "
+                   "finishes the query")
+        logger.debug("aqe %s decision degraded to the static plan",
+                     decision, exc_info=True)
+        return False
+
+
+def drift(est: Optional[int], observed: int) -> bool:
+    """Whether ``observed`` crossed ``spark.aqe.driftFactor`` away from
+    ``est`` in EITHER direction (an estimate can be wrong both ways; a
+    too-small estimate flips build sides, a too-large one shrinks
+    buckets). A cold estimate (None) never triggers — adaptivity needs
+    an expectation to drift FROM."""
+    if est is None:
+        return False
+    f = max(float(config.aqe_drift_factor), 1.0)
+    a = max(int(observed), 1)
+    b = max(int(est), 1)
+    return a >= b * f or b >= a * f
+
+
+def record(trigger: str, detail: str, est_before: Optional[int],
+           est_after: Optional[int]) -> None:
+    """Count one APPLIED re-plan and surface it: ``aqe.replans`` (+ the
+    per-trigger mirror), the active span's ``aqe`` annotation, and the
+    EXPLAIN ANALYZE capture scope when one is open."""
+    counters.increment("aqe.replans")
+    counters.increment(f"aqe.replans.{trigger}")
+    try:
+        from ..utils import observability as _obs
+
+        _obs.current_span().set(aqe=trigger)
+    except Exception:
+        pass
+    events = _CAPTURE.get()
+    if events is not None:
+        events.append(ReplanEvent(trigger, detail, est_before, est_after))
+
+
+@contextlib.contextmanager
+def capture():
+    """Scope under which applied re-plan events collect into the yielded
+    list — EXPLAIN ANALYZE's ``== Adaptive ==`` source."""
+    events: list = []
+    token = _CAPTURE.set(events)
+    try:
+        yield events
+    finally:
+        _CAPTURE.reset(token)
+
+
+def render(events) -> list[str]:
+    """The ``== Adaptive ==`` body lines (header is the caller's)."""
+    return [str(e) for e in events]
+
+
+# ---------------------------------------------------------------------------
+# Byte model (host metadata only — never a device read)
+# ---------------------------------------------------------------------------
+
+def row_nbytes(frame) -> int:
+    """Per-row resident-byte width of a frame: column itemsizes (2-D
+    columns count their row width) + the mask byte; host/object columns
+    count one pointer. Shape metadata only — the broadcast decision must
+    never sync."""
+    total = 1    # bool mask
+    for name in frame.columns:
+        arr = frame._data[name]
+        if isinstance(arr, np.ndarray) and arr.dtype == object:
+            total += 8
+        else:
+            width = arr.shape[1] if getattr(arr, "ndim", 1) == 2 else 1
+            total += width * np.dtype(arr.dtype).itemsize
+    return total
+
+
+# ---------------------------------------------------------------------------
+# Downstream re-bucketing (the stage-boundary memory re-plan)
+# ---------------------------------------------------------------------------
+
+def rebucket_candidate(est: Optional[int], slots: int) -> bool:
+    """Cheap pre-check (no sync): history estimates enough shrink that
+    observing the true count could pay — the estimate drifted below the
+    slot count AND lands a strictly smaller power-of-two bucket."""
+    if est is None or slots <= 0:
+        return False
+    from ..ops.compiler import bucket_size
+
+    if not drift(est, slots):
+        return False
+    return bucket_size(max(int(est), 1)) < bucket_size(slots)
+
+
+def maybe_rebucket(frame, est: Optional[int]):
+    """Re-bucket a just-filtered frame to its OBSERVED valid-row count
+    when the static slot count drifted past ``spark.aqe.driftFactor``
+    above it and a strictly smaller power-of-two bucket results: the
+    surviving rows compact (device ``take`` in mask order — row order
+    preserved exactly) into an all-valid frame, so every downstream
+    stage runs with fewer padded slots and its static flush-byte bound
+    (re-checked here against the device budget, arxiv 2206.14148)
+    shrinks to what the data actually needs.
+
+    Semantics-preserving by the masked-slot invariant: masked rows are
+    invisible to every consumer already, so dropping their slots cannot
+    change any downstream result. Sharded frames pass through untouched
+    (their layout owns slot placement). Costs ONE counted host sync —
+    paid only after :func:`rebucket_candidate` said the shrink is
+    plausible. Returns the (possibly new) frame."""
+    from ..frame.frame import Frame
+    from ..ops.compiler import bucket_size
+
+    slots = frame.num_slots
+    if getattr(frame, "_shard", None) is not None or slots <= 0:
+        return frame
+    if not rebucket_candidate(est, slots):
+        return frame
+    if not guard("re-bucket"):
+        return frame
+    host_mask = frame._host_mask()        # counted device->host pull
+    keep = np.nonzero(host_mask)[0]
+    observed = int(keep.size)
+    new_bucket = bucket_size(max(observed, 1))
+    if new_bucket >= bucket_size(slots) or not drift(observed, slots):
+        return frame                      # history lied small: keep plan
+    import jax.numpy as jnp
+
+    from ..ops.compiler import flush_budget
+
+    per_row = row_nbytes(frame)
+    budget = flush_budget()
+    if budget is not None and new_bucket * per_row > budget:
+        # the shrunk stage STILL exceeds the device budget — the
+        # compiler's row-chunked ladder owns that regime; re-bucketing
+        # on top would just add a compaction gather
+        return frame
+    keep_dev = jnp.asarray(keep)
+    data = {}
+    for name in frame.columns:
+        arr = frame._data[name]
+        if isinstance(arr, np.ndarray) and arr.dtype == object:
+            data[name] = arr[keep]
+        else:
+            data[name] = jnp.take(jnp.asarray(arr), keep_dev, axis=0)
+    record("re-bucket",
+           f"{slots} -> {new_bucket} padded slots "
+           f"(observed {observed} rows; est {est})",
+           est_before=slots, est_after=observed)
+    return Frame(data)

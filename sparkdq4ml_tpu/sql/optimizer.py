@@ -307,6 +307,19 @@ def _pushable(rel: _Rel, rels: list) -> bool:
 # Cost model (statstore-informed, static fallback)
 # ---------------------------------------------------------------------------
 
+def _rel_sel_key(rel: _Rel, cat) -> Optional[str]:
+    """The filter-structural statstore key for a relation's pushed
+    filter stack — the address both the selectivity estimate and the
+    flop-cost term read. None when nothing was pushed."""
+    if not rel.pushed:
+        return None
+    from .parser import Query, _conjoin, _filter_history_key
+
+    probe = Query(["*"], rel.view,
+                  _conjoin([_strip_qualifier(c, rel) for c in rel.pushed]))
+    return _filter_history_key(probe, cat)
+
+
 def _est_rel_rows(rel: _Rel, cat) -> Optional[int]:
     """History-informed output-row estimate for one relation AFTER its
     pushed filters: the statstore selectivity recorded for the same
@@ -322,15 +335,26 @@ def _est_rel_rows(rel: _Rel, cat) -> Optional[int]:
     if not rel.pushed:
         return slots
     from ..utils import statstore as _stats
-    from .parser import Query, _conjoin, _filter_history_key
 
-    probe = Query(["*"], rel.view,
-                  _conjoin([_strip_qualifier(c, rel) for c in rel.pushed]))
-    skey = _filter_history_key(probe, cat)
+    skey = _rel_sel_key(rel, cat)
     sel = _stats.STORE.selectivity(skey) if skey is not None else None
     if sel is None:
         return slots
     return int(round(sel * slots))
+
+
+def _est_rel_flops(rel: _Rel, cat) -> Optional[float]:
+    """The PR-15 AOT cost profile's flop count for the relation's pushed
+    filter-stack program (largest recorded extraction at the same
+    filter-structural key the selectivity estimate uses). None when cold
+    or nothing was pushed — the reorder's flop term then contributes
+    zero and ranking degrades to rows alone, exactly the pre-flop
+    behavior."""
+    if rel.cols is None or not isinstance(rel.view, str):
+        return None
+    from ..utils import statstore as _stats
+
+    return _stats.STORE.flops_for_selectivity(_rel_sel_key(rel, cat))
 
 
 # ---------------------------------------------------------------------------
@@ -430,14 +454,25 @@ def _needed_columns(q, rels: list, residual_where) -> bool:
     return True
 
 
-def _maybe_reorder(q, rels: list, ests: dict, rewrites: list
-                   ) -> Optional[list]:
-    """Join reordering (level >= 2): greedy smallest-estimate-first over
-    INNER joins, honoring key availability. Returns the new join order
-    (indices into ``q.joins``) or None. Gated to shapes where the output
-    row multiset is provably preserved and nothing downstream observes
-    physical order (no LIMIT/OFFSET) and the ``_right``-suffix structure
-    cannot change (non-key column names unique across relations)."""
+#: Relative weight of the flop-cost term in the join-reorder ranking:
+#: with profiles present, a relation's rank is its row estimate scaled
+#: by up to 1 + _FLOP_WEIGHT depending on how its filter-program flops
+#: compare to the heaviest candidate's. Row estimates stay dominant —
+#: the flop term only breaks near-ties toward the cheaper scan.
+_FLOP_WEIGHT = 0.5
+
+
+def _maybe_reorder(q, rels: list, ests: dict, flops: dict,
+                   rewrites: list) -> Optional[list]:
+    """Join reordering (level >= 2): greedy smallest-cost-first over
+    INNER joins, honoring key availability — cost is the row estimate
+    scaled by the relation's recorded filter-program flops (the PR-15
+    AOT cost profiles) when any candidate has one, rows alone otherwise.
+    Returns the new join order (indices into ``q.joins``) or None. Gated
+    to shapes where the output row multiset is provably preserved and
+    nothing downstream observes physical order (no LIMIT/OFFSET) and the
+    ``_right``-suffix structure cannot change (non-key column names
+    unique across relations)."""
     joins = [r for r in rels if r.idx >= 0]
     if len(joins) < 2 or q.limit is not None or getattr(q, "offset", 0):
         return None
@@ -458,6 +493,15 @@ def _maybe_reorder(q, rels: list, ests: dict, rewrites: list
             seen[c] = r.idx
     if any(ests.get(r.idx) is None for r in joins):
         return None
+    fmax = max((flops.get(r.idx) or 0.0) for r in joins)
+
+    def _rank(r: _Rel) -> float:
+        rows = float(ests[r.idx])
+        if fmax <= 0.0:
+            return rows
+        return rows * (1.0 + _FLOP_WEIGHT * (flops.get(r.idx) or 0.0)
+                       / fmax)
+
     available = set(base.cols)
     order: list[int] = []
     remaining = list(joins)
@@ -465,7 +509,7 @@ def _maybe_reorder(q, rels: list, ests: dict, rewrites: list
         cands = [r for r in remaining if set(r.keys) <= available]
         if not cands:
             return None
-        pick = min(cands, key=lambda r: ests[r.idx])
+        pick = min(cands, key=_rank)
         order.append(pick.idx)
         available |= set(pick.cols)
         remaining.remove(pick)
@@ -474,7 +518,8 @@ def _maybe_reorder(q, rels: list, ests: dict, rewrites: list
     rewrites.append(Rewrite(
         "join-reorder",
         ", ".join(f"{rels[i + 1].view}~{ests[i]}r" for i in order)
-        + " (smallest estimate first)"))
+        + (" (smallest rows x flop cost first)" if fmax > 0.0
+           else " (smallest estimate first)")))
     return order
 
 
@@ -533,6 +578,7 @@ def _optimize_single(q, cat, rewrites: list):
     where = q.where
     order = None
     hints: list = []
+    join_ests: list = []
     if rels is not None:
         n_rw = len(rewrites)
         where = _split_where(q, rels, rewrites)
@@ -543,8 +589,12 @@ def _optimize_single(q, cat, rewrites: list):
             _needed_columns(q, rels, where)
         ests = {r.idx: _est_rel_rows(r, cat) for r in rels}
         if int(config.optimizer_level) >= 2:
-            order = _maybe_reorder(q, rels, ests, rewrites)
-        # build-side hints over the FINAL join order
+            flops = {r.idx: _est_rel_flops(r, cat) for r in rels}
+            order = _maybe_reorder(q, rels, ests, flops, rewrites)
+        # build-side hints over the FINAL join order; the per-join
+        # (left, right) estimate pairs ride along as ``join_est`` — the
+        # drift baseline the adaptive hooks (sql/adaptive.py) compare
+        # observed counts against at run time
         joined = ([next(r for r in rels if r.idx == i) for i in order]
                   if order is not None
                   else [r for r in rels if r.idx >= 0])
@@ -552,6 +602,7 @@ def _optimize_single(q, cat, rewrites: list):
         for r in joined:
             hint = None
             right_est = ests.get(r.idx)
+            join_ests.append((left_est, right_est))
             if (r.how == "inner" and r.keys and left_est is not None
                     and right_est is not None
                     and left_est * _BUILD_RATIO <= right_est):
@@ -589,7 +640,13 @@ def _optimize_single(q, cat, rewrites: list):
             new_joins = joins_out
         changed = (changed or len(rewrites) > n_rw
                    or where is not q.where)
+    has_ests = any(e is not None
+                   for pair in join_ests for e in pair)
     if not changed:
+        if has_ests:
+            # advisory only — never affects planning or EXPLAIN, just
+            # gives the runtime hooks a drift baseline
+            q.join_est = join_ests
         return q
     q2 = _clone(q)
     q2.view = new_view
@@ -599,6 +656,8 @@ def _optimize_single(q, cat, rewrites: list):
         q2.view_alias = None
     if any(hints):
         q2.join_build = hints
+    if has_ests:
+        q2.join_est = join_ests
     return q2
 
 

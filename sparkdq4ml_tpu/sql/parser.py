@@ -1257,7 +1257,13 @@ def _decorrelate_one(sub: Query, extra_outer_cols, outer_scope, cat):
                    for o, ie in eq_pairs],
                   sub.view, _conjoin(rest), joins=sub.joins, distinct=True)
     inner.view_alias = sub.view_alias
-    return _execute_set(inner, cat), names
+    # Decorrelation-aware pushdown: the subquery branch is a full SELECT
+    # over its own relation scope (correlated conjuncts are already
+    # lifted into ``eq_pairs`` above, so only decorrelated predicates
+    # remain) — route it through the cost-based optimizer like any other
+    # executed query so its residual filters push into the scans and its
+    # projection prunes, instead of scanning the branch unoptimized.
+    return _execute_set(_maybe_optimize(inner, cat), cat), names
 
 
 def _decorrelate_where(where, scope: dict, cat):
@@ -2280,8 +2286,16 @@ def _execute_explain(body: str, cat, analyze: bool):
 
     import jax as _jax
 
+    from . import adaptive as _adaptive
+
     caches_before = _obs.cache_report() if _cfg.explain_caches else {}
-    with _obs.query_stats(sample_memory=_cfg.explain_memory) as qs:
+    # ANALYZE executes under the adaptive capture scope: any mid-query
+    # re-plan the hooks apply (sql/adaptive.py) records an event here
+    # and renders as the `== Adaptive ==` section. No events (AQE off,
+    # or simply no drift) -> no section — output stays byte-identical
+    # to the static engine.
+    with _adaptive.capture() as aqe_events, \
+            _obs.query_stats(sample_memory=_cfg.explain_memory) as qs:
         t0 = _time.perf_counter()
         if kind == "query":
             out = _run_parsed(payload, cat)
@@ -2355,6 +2369,9 @@ def _execute_explain(body: str, cat, analyze: bool):
     if budget_line:
         lines.append(budget_line)
     lines.extend(_opt_sections())
+    if aqe_events:
+        lines.append("== Adaptive ==")
+        lines.extend(_adaptive.render(aqe_events))
     return Frame({"plan": ["\n".join(lines)]})
 
 
@@ -2618,6 +2635,9 @@ def _execute_single(q: Query, cat):
         scope[(q.view_alias or q.view).lower()] = \
             {c: c for c in frame.columns}
     build_hints = list(getattr(q, "join_build", ()) or ())
+    # optimizer-attached (left, right) row-estimate pairs per join — the
+    # drift baseline the adaptive hooks compare observed counts against
+    join_ests = list(getattr(q, "join_est", ()) or ())
     for jidx, (view, how, keys, jalias) in enumerate(q.joins):
         right = (_execute_set(view.query, cat)
                  if isinstance(view, DerivedTable) else cat.lookup(view))
@@ -2625,7 +2645,9 @@ def _execute_single(q: Query, cat):
         pre = set(frame.columns)
         frame = frame.join(right, on=keys or None, how=how,
                            build=(build_hints[jidx]
-                                  if jidx < len(build_hints) else None))
+                                  if jidx < len(build_hints) else None),
+                           est=(join_ests[jidx]
+                                if jidx < len(join_ests) else None))
         name = jalias or (view if isinstance(view, str) else None)
         if name:
             post = set(frame.columns)
@@ -2679,6 +2701,26 @@ def _execute_single(q: Query, cat):
                else _resolve_subqueries(it, cat) for it in q.items]
     if q.where is not None:
         frame = frame.filter(q.where)
+        # Stage boundary (sql/adaptive.py): the WHERE filter just
+        # defined the TRUE survivor set behind the mask. When history
+        # says far fewer rows survive than the static slot count and a
+        # downstream stage exists to profit, compact into the smaller
+        # power-of-two bucket so grouping/sort/distinct run with fewer
+        # padded slots. ONE conf read when AQE is off.
+        from ..config import config as _aqe_cfg
+
+        if _aqe_cfg.aqe_enabled and isinstance(q.view, str) \
+                and not q.joins \
+                and (q.group_by or q.order_by or q.distinct
+                     or any(isinstance(it, AggExpr) for it in q.items)):
+            from ..utils import statstore as _statstore
+            from . import adaptive as _adaptive
+
+            _skey = _filter_history_key(q, cat)
+            if _skey is not None:
+                frame = _adaptive.maybe_rebucket(
+                    frame,
+                    _statstore.STORE.est_rows(_skey, frame.num_slots))
 
     # ORDER BY <position>: 1-based index into the select list (Spark/ANSI)
     if any(isinstance(k, int) for k, _ in q.order_by):

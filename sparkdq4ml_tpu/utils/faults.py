@@ -112,6 +112,7 @@ FAULT_SITES = {
     "stats_persist": ("io_error", "torn_chunk"),
     "incident": ("io_error",),
     "optimizer": ("device_error",),
+    "aqe": ("device_error", "stall"),
     "cost_profile": ("device_error",),
     "net_accept": ("conn_reset",),
     "net_read": ("conn_reset", "stall", "slow_client"),

@@ -193,6 +193,8 @@ METRIC_NAMES = {
     "net.accept": ("counter", "socket connections accepted"),
     "net.requests": ("counter", "wire requests parsed (both framings)"),
     "net.pages": ("counter", "result pages streamed"),
+    "net.page_deadline": ("counter", "result streams truncated by the "
+                                     "wire deadline between pages"),
     "net.bytes_in": ("counter", "request bytes read off the wire"),
     "net.bytes_out": ("counter", "response bytes written to the wire"),
     "net.conn_reset": ("counter", "connections dropped by a reset "
@@ -224,6 +226,12 @@ METRIC_NAMES = {
     "optimizer.dense_skip": ("counter",
                              "grouped dense attempts skipped by miss "
                              "history"),
+    # adaptive query execution (sql/adaptive.py + boundary hooks)
+    "aqe.replans": ("counter", "mid-query re-plan events applied, all "
+                               "triggers"),
+    "aqe.fallback": ("counter", "re-plan decision points degraded to "
+                                "the static plan by the aqe fault "
+                                "ladder"),
     # plan-stats observatory (utils/statstore.py)
     "stats.record": ("counter", "flush observations recorded"),
     "stats.evict": ("counter", "stats entries evicted (maxEntries)"),
@@ -272,6 +280,9 @@ METRIC_NAME_PREFIXES = {
                                  "(series-capped)"),
     "span_ms.": ("histogram", "span wall-clock latency by category"),
     "costprof.": ("counter", "device-cost observatory activity"),
+    "aqe.replans.": ("counter", "per-trigger mid-query re-plan events "
+                                "(build-flip/broadcast/skew-split/"
+                                "re-bucket/grouped-lowering)"),
     "shard.exchange_bytes.": ("counter",
                               "per-kind cross-shard exchange volume "
                               "(psum/all_to_all/gather)"),

@@ -428,6 +428,30 @@ class StatStore:
             ks = self._entries.get(key)
             return dict(ks.cost) if ks is not None and ks.cost else None
 
+    def flops_for_selectivity(self, sel_key: Optional[str]
+                              ) -> Optional[float]:
+        """Largest recorded AOT-profile flop count over the entries whose
+        plan key reduces (:func:`selectivity_key`) to ``sel_key`` — the
+        join-reorder flop-cost term. Cost profiles land on FULL plan keys
+        (``record_cost``) while selectivity evidence lands on the reduced
+        key, so this is the bridge between the two; a linear scan over a
+        bounded table (``spark.stats.maxEntries``), paid once per plan.
+        None until an extraction lands, so rows-only ranking stays in
+        charge on cold history."""
+        if sel_key is None:
+            return None
+        best = None
+        with self._lock:
+            for ks in self._entries.values():
+                if not ks.cost:
+                    continue
+                if selectivity_key(ks.key) != sel_key:
+                    continue
+                flops = float(ks.cost.get("flops") or 0.0)
+                if flops > 0.0 and (best is None or flops > best):
+                    best = flops
+        return best
+
     def record_miss(self, key: str) -> None:
         """One planning miss at ``key`` (e.g. the grouped engine's dense
         slot-table overflow): accumulates as a ``miss|``-prefixed entry

@@ -1,0 +1,693 @@
+"""Adaptive query execution suite (ISSUE 19, tier-1, ``aqe`` marker).
+
+The acceptance surface:
+
+* **build-side flip** — a join whose optimizer estimate drifted past
+  ``spark.aqe.driftFactor`` re-decides the hash-build side from the
+  OBSERVED valid-row counts, bit-identical to the static plan;
+* **broadcast shuffle-skip** — a drifted sharded join whose observed
+  build side fits ``spark.aqe.broadcastThreshold`` bytes skips the
+  hash-partition Exchange entirely (``shard.join_partitioned`` pinned
+  unchanged), results exact;
+* **skew split** — an Exchange partition crossing ``spark.aqe.
+  skewFactor`` x the mean splits into balanced probe chunks; the plan
+  equals both the unsplit partitioned plan AND the unpartitioned plan
+  (the PR-13 stable left-index merge), gated off for right/outer;
+* **downstream re-bucket** — a WHERE whose history says far fewer rows
+  survive compacts into the smaller power-of-two bucket (fewer padded
+  slots downstream), bit-parity with AQE off, device-budget re-check;
+* **grouped-lowering dense-skip** — cardinality history above the dense
+  slot-table range skips the doomed dense dispatch, parity pinned;
+* **disabled mode** — ``spark.aqe.enabled=false`` reduces every hook to
+  one conf read (decision functions monkeypatched to RAISE stay
+  uncalled) and pins EXPLAIN byte-identical to the static engine;
+* **degradation** — the ``aqe`` fault site (``device_error`` raise and
+  ``stall`` due-test) degrades each DECISION to the static plan
+  (``aqe.fallback`` + recovery event, rung ``static``), results golden
+  on every rung;
+* **satellites** — the flop-cost term in the level-2 join reorder
+  (``flops_for_selectivity`` bridge + the re-ranked pick), the
+  decorrelation-aware pushdown into correlated-subquery branches
+  (outer EXPLAIN pinned, branch rewrite counted), the ``spark.aqe.*``
+  session-conf scoping, and the per-page wire-deadline re-check on the
+  serving stream paths (``net.page_deadline``).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import sparkdq4ml_tpu as dq
+from sparkdq4ml_tpu.config import config
+from sparkdq4ml_tpu.frame.frame import Frame, _vector_join_plan
+from sparkdq4ml_tpu.ops import compiler
+from sparkdq4ml_tpu.ops.compiler import bucket_size
+from sparkdq4ml_tpu.parallel import mesh as pmesh
+from sparkdq4ml_tpu.parallel import shard
+from sparkdq4ml_tpu.sql import adaptive
+from sparkdq4ml_tpu.sql import optimizer as opt
+from sparkdq4ml_tpu.utils import faults, observability as obs
+from sparkdq4ml_tpu.utils import profiling, statstore
+from sparkdq4ml_tpu.utils.recovery import RECOVERY_LOG
+
+from conftest import dataset_path, prepare_features, run_dq_pipeline
+
+pytestmark = pytest.mark.aqe
+
+
+@pytest.fixture(autouse=True)
+def _clean_aqe_state():
+    saved = (config.aqe_enabled, config.aqe_drift_factor,
+             config.aqe_broadcast_threshold, config.aqe_skew_factor,
+             config.optimizer_enabled, config.optimizer_level)
+    statstore.STORE.clear()
+    compiler.clear_cache()
+    faults.clear()
+    RECOVERY_LOG.clear()
+    profiling.counters.clear("aqe.")
+    yield
+    (config.aqe_enabled, config.aqe_drift_factor,
+     config.aqe_broadcast_threshold, config.aqe_skew_factor,
+     config.optimizer_enabled, config.optimizer_level) = saved
+    statstore.STORE.clear()
+    compiler.clear_cache()
+    faults.clear()
+    RECOVERY_LOG.clear()
+    profiling.counters.clear("aqe.")
+    obs.TRACER.clear()
+
+
+def _exec(session, sql):
+    out = session.sql(sql)
+    jax.block_until_ready(out._mask)
+    return out.to_pydict()
+
+
+def _assert_exact(off, on):
+    assert list(off) == list(on)
+    for c in off:
+        np.testing.assert_array_equal(np.asarray(off[c]),
+                                      np.asarray(on[c]),
+                                      err_msg=f"column {c!r}")
+
+
+def _assert_sorted(off, on):
+    assert sorted(off) == sorted(on)
+    cols = sorted(off)
+    a = np.array([np.asarray(off[c], dtype=np.float64) for c in cols])
+    b = np.array([np.asarray(on[c], dtype=np.float64) for c in cols])
+    assert a.shape == b.shape
+    np.testing.assert_array_equal(a[:, np.lexsort(a[::-1])],
+                                  b[:, np.lexsort(b[::-1])])
+
+
+def _replans(trigger=None):
+    name = "aqe.replans" + (f".{trigger}" if trigger else "")
+    return profiling.counters.get(name)
+
+
+# ---------------------------------------------------------------------------
+# Build-side flip (Frame.join est= hook)
+# ---------------------------------------------------------------------------
+
+
+class TestBuildFlip:
+    def _frames(self):
+        rng = np.random.default_rng(11)
+        left = Frame({"k": np.arange(30, dtype=np.float64),
+                      "v": rng.normal(size=30)})
+        right = Frame({"k": (np.arange(4096) % 64).astype(np.float64),
+                       "w": rng.normal(size=4096)})
+        return left, right
+
+    def test_drift_flips_build_side_bit_identical(self):
+        left, right = self._frames()
+        config.aqe_enabled = False
+        ref = left.join(right, on="k").to_pydict()
+        config.aqe_enabled = True
+        # the estimate claims the LEFT side is huge; the observed 30
+        # valid rows drift past the factor, so the build side re-decides
+        got = left.join(right, on="k", est=(30 * 4096, 4096)).to_pydict()
+        assert _replans("build-flip") == 1
+        _assert_exact(ref, got)
+
+    def test_no_drift_keeps_static_plan(self):
+        left, right = self._frames()
+        config.aqe_enabled = True
+        left.join(right, on="k", est=(30, 4096))
+        assert _replans() == 0
+
+    def test_cold_estimate_never_triggers(self):
+        left, right = self._frames()
+        config.aqe_enabled = True
+        left.join(right, on="k", est=(None, None))
+        left.join(right, on="k")
+        assert _replans() == 0
+
+
+# ---------------------------------------------------------------------------
+# Skew split (partitioned exchange)
+# ---------------------------------------------------------------------------
+
+
+def _skewed_plan_inputs(n=2000, keys=512, seed=5):
+    """~70% of probe rows land one (continuous-float) key — that key's
+    Exchange partition crosses 2x the mean while the rest stay near it.
+    Continuous keys matter: integer-valued doubles share their low
+    mantissa bits and would all hash into one partition anyway."""
+    rng = np.random.default_rng(seed)
+    rk = rng.random(keys) * 100.0
+    lk = np.where(rng.random(n) < 0.7, rk[7], rk[rng.integers(0, keys, n)])
+    li = np.arange(n, dtype=np.int64)
+    ri = np.arange(keys, dtype=np.int64)
+    return [lk], [rk], li, ri
+
+
+class TestSkewSplit:
+    def test_split_plan_is_bit_identical(self):
+        lcols, rcols, li, ri = _skewed_plan_inputs()
+        config.aqe_skew_factor = 2.0
+        config.aqe_enabled = False
+        ref = shard.partitioned_join_plan(
+            _vector_join_plan, lcols, rcols, li, ri, "inner", 4)
+        config.aqe_enabled = True
+        got = shard.partitioned_join_plan(
+            _vector_join_plan, lcols, rcols, li, ri, "inner", 4)
+        assert _replans("skew-split") >= 1
+        flat = _vector_join_plan(lcols, rcols, li, ri, "inner")
+        for a, b in ((ref, got), (flat, got)):
+            np.testing.assert_array_equal(a[0], b[0])
+            np.testing.assert_array_equal(a[1], b[1])
+
+    def test_left_join_split_parity(self):
+        lcols, rcols, li, ri = _skewed_plan_inputs(seed=6)
+        config.aqe_skew_factor = 2.0
+        config.aqe_enabled = False
+        ref = shard.partitioned_join_plan(
+            _vector_join_plan, lcols, rcols, li, ri, "left", 4)
+        config.aqe_enabled = True
+        got = shard.partitioned_join_plan(
+            _vector_join_plan, lcols, rcols, li, ri, "left", 4)
+        assert _replans("skew-split") >= 1
+        np.testing.assert_array_equal(ref[0], got[0])
+        np.testing.assert_array_equal(ref[1], got[1])
+
+    def test_outer_join_never_splits(self):
+        # unmatched-right detection is cross-chunk for right/outer —
+        # the split must stay gated off no matter the skew
+        lcols, rcols, li, ri = _skewed_plan_inputs()
+        config.aqe_enabled = True
+        shard.partitioned_join_plan(
+            _vector_join_plan, lcols, rcols, li, ri, "outer", 4)
+        assert _replans("skew-split") == 0
+
+    def test_below_skew_factor_never_splits(self):
+        rng = np.random.default_rng(9)
+        rk = rng.random(512) * 100.0            # balanced continuous keys
+        lk = rk[rng.integers(0, 512, 2000)]
+        config.aqe_enabled = True
+        shard.partitioned_join_plan(
+            _vector_join_plan, [lk], [rk],
+            np.arange(2000, dtype=np.int64),
+            np.arange(512, dtype=np.int64), "inner", 4)
+        assert _replans("skew-split") == 0
+
+
+# ---------------------------------------------------------------------------
+# Broadcast shuffle-skip (sharded exchange elision)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8,
+                    reason="needs the conftest's 8 forced host devices")
+class TestBroadcastSkip:
+    @contextlib.contextmanager
+    def _sharding(self, min_rows=8):
+        saved = (config.shard_enabled, config.shard_min_rows,
+                 config.shard_devices)
+        config.shard_enabled = True
+        config.shard_min_rows = min_rows
+        config.shard_devices = 0
+        shard.configure(pmesh.make_mesh())
+        try:
+            yield
+        finally:
+            (config.shard_enabled, config.shard_min_rows,
+             config.shard_devices) = saved
+            shard.reset()
+
+    def test_small_observed_build_side_skips_exchange(self):
+        rng = np.random.default_rng(21)
+        with self._sharding():
+            big = shard.maybe_shard_frame(Frame({
+                "k": (np.arange(4096) % 60).astype(np.float64),
+                "v": rng.normal(size=4096)}))
+            assert big._shard is not None
+            small = Frame({"k": np.arange(60, dtype=np.float64),
+                           "w": rng.normal(size=60)})
+            config.aqe_enabled = False
+            before = profiling.counters.get("shard.join_partitioned")
+            ref = big.join(small, on="k").to_pydict()
+            assert profiling.counters.get(
+                "shard.join_partitioned") == before + 1
+            # estimates said both sides were big; the observed 60-row
+            # build side fits the broadcast threshold → no Exchange
+            config.aqe_enabled = True
+            mid = profiling.counters.get("shard.join_partitioned")
+            got = big.join(small, on="k",
+                           est=(4096, 4096)).to_pydict()
+            assert profiling.counters.get(
+                "shard.join_partitioned") == mid
+            assert _replans("broadcast") == 1
+            _assert_exact(ref, got)
+
+    def test_over_threshold_build_side_keeps_exchange(self):
+        rng = np.random.default_rng(22)
+        with self._sharding():
+            big = shard.maybe_shard_frame(Frame({
+                "k": (np.arange(4096) % 60).astype(np.float64),
+                "v": rng.normal(size=4096)}))
+            small = Frame({"k": np.arange(60, dtype=np.float64),
+                           "w": rng.normal(size=60)})
+            config.aqe_enabled = True
+            config.aqe_broadcast_threshold = 16   # nothing fits 16 bytes
+            before = profiling.counters.get("shard.join_partitioned")
+            big.join(small, on="k", est=(4096, 4096))
+            assert profiling.counters.get(
+                "shard.join_partitioned") == before + 1
+            assert _replans("broadcast") == 0
+
+
+# ---------------------------------------------------------------------------
+# Downstream re-bucket (fewer padded slots after the WHERE boundary)
+# ---------------------------------------------------------------------------
+
+
+def _rebucket_view(session, name="aqe_t", n=4096, seed=17):
+    rng = np.random.default_rng(seed)
+    f = Frame({"k": rng.integers(0, 32, n).astype(np.float64),
+               "v": rng.normal(size=n)})
+    f.create_or_replace_temp_view(name)
+    return f
+
+
+REBUCKET_SQL = "SELECT k, sum(v) AS s FROM aqe_t WHERE v > 2.0 GROUP BY k"
+
+
+def _seed_filter_history(session, sql=REBUCKET_SQL):
+    """One AQE-off run records the WHERE's observed selectivity; the
+    drain makes it readable. Returns the off-arm (reference) result."""
+    config.aqe_enabled = False
+    ref = _exec(session, sql)
+    statstore.STORE.drain_pending()
+    return ref
+
+
+class TestRebucket:
+    def test_unit_shrink_preserves_rows_and_slots(self):
+        rng = np.random.default_rng(3)
+        f = Frame({"k": rng.integers(0, 8, 4096).astype(np.float64),
+                   "v": rng.normal(size=4096)}).filter(dq.col("v") > 2.0)
+        ref = f.to_pydict()
+        observed = len(ref["v"])
+        assert 0 < observed < 200
+        config.aqe_enabled = True
+        out = adaptive.maybe_rebucket(f, est=observed)
+        # the survivors compact to their true count; every downstream
+        # flush pads to the (much smaller) power-of-two bucket
+        assert out.num_slots == observed
+        assert bucket_size(out.num_slots) < 4096
+        assert _replans("re-bucket") == 1
+        _assert_exact(ref, out.to_pydict())
+
+    def test_unit_respects_device_budget(self, monkeypatch):
+        rng = np.random.default_rng(4)
+        f = Frame({"v": rng.normal(size=4096)}).filter(dq.col("v") > 2.0)
+        f._host_mask()
+        config.aqe_enabled = True
+        monkeypatch.setattr(compiler, "flush_budget", lambda: 8)
+        out = adaptive.maybe_rebucket(f, est=64)
+        assert out is f                      # shrunk stage still over budget
+        assert _replans() == 0
+
+    def test_no_history_means_static_plan(self):
+        rng = np.random.default_rng(5)
+        f = Frame({"v": rng.normal(size=4096)}).filter(dq.col("v") > 2.0)
+        config.aqe_enabled = True
+        assert adaptive.maybe_rebucket(f, est=None) is f
+        assert _replans() == 0
+
+    def test_sql_rebucket_bit_parity(self, session):
+        _rebucket_view(session)
+        ref = _seed_filter_history(session)
+        config.aqe_enabled = True
+        got = _exec(session, REBUCKET_SQL)
+        assert _replans("re-bucket") == 1
+        _assert_exact(ref, got)
+
+    def test_seeded_workload_fewer_padded_slots(self, session):
+        """The acceptance workload: seeded history + a skewed exchange;
+        the on-arm re-plans at least once and the re-bucketed stage
+        provably runs with fewer padded slots."""
+        _rebucket_view(session)
+        ref = _seed_filter_history(session)
+        config.aqe_enabled = True
+        config.aqe_skew_factor = 2.0
+        with adaptive.capture() as events:
+            got = _exec(session, REBUCKET_SQL)
+            lcols, rcols, li, ri = _skewed_plan_inputs()
+            shard.partitioned_join_plan(
+                _vector_join_plan, lcols, rcols, li, ri, "inner", 4)
+        _assert_exact(ref, got)
+        assert _replans() >= 2
+        rebuckets = [e for e in events if e.trigger == "re-bucket"]
+        assert rebuckets and any(e.trigger == "skew-split" for e in events)
+        ev = rebuckets[0]
+        assert bucket_size(max(ev.est_after, 1)) < ev.est_before
+
+
+# ---------------------------------------------------------------------------
+# Grouped-lowering dense-skip from cardinality history
+# ---------------------------------------------------------------------------
+
+
+class TestGroupedLowering:
+    def test_history_above_dense_range_skips_dense(self, session):
+        rng = np.random.default_rng(31)
+        f = Frame({"k": rng.integers(0, 64, 1024).astype(np.float64),
+                   "v": rng.normal(size=1024)})
+        f.create_or_replace_temp_view("aqe_g")
+        sql = "SELECT k, sum(v) AS s FROM aqe_g GROUP BY k"
+        config.aqe_enabled = False
+        ref = _exec(session, sql)
+        # the off-run recorded the real output cardinality under the
+        # executor's own card| key; inflate that SAME entry until the
+        # estimated group count clears any dense slot-table range — the
+        # dense dispatch (and its host sync) must then be skipped
+        cards = [k for k in list(statstore.STORE._entries)
+                 if k.startswith("card|")]
+        assert cards, "the grouped flush should record cardinality"
+        statstore.STORE.record_rows(cards[0], "cardinality",
+                                    1, 1_000_000)
+        config.aqe_enabled = True
+        got = _exec(session, sql)
+        assert _replans("grouped-lowering") == 1
+        _assert_sorted(ref, got)
+
+
+# ---------------------------------------------------------------------------
+# EXPLAIN surface: == Adaptive == and the disabled-mode pins
+# ---------------------------------------------------------------------------
+
+
+class TestExplain:
+    def test_analyze_renders_adaptive_section(self, session):
+        _rebucket_view(session)
+        _seed_filter_history(session)
+        config.aqe_enabled = True
+        plan = _exec(session, "EXPLAIN ANALYZE " + REBUCKET_SQL)["plan"][0]
+        assert "== Adaptive ==" in plan
+        assert "re-bucket:" in plan
+
+    def test_no_replan_renders_no_section(self, session):
+        _rebucket_view(session)
+        config.aqe_enabled = True     # no history → nothing drifts
+        plan = _exec(session, "EXPLAIN ANALYZE " + REBUCKET_SQL)["plan"][0]
+        assert "== Adaptive ==" not in plan
+
+    def test_disabled_mode_explain_byte_identical(self, session):
+        _rebucket_view(session)
+        _seed_filter_history(session)
+        config.aqe_enabled = False
+        off = _exec(session, "EXPLAIN " + REBUCKET_SQL)["plan"][0]
+        config.aqe_enabled = True
+        on = _exec(session, "EXPLAIN " + REBUCKET_SQL)["plan"][0]
+        assert off == on
+
+
+class TestDisabledMode:
+    def test_hooks_reduce_to_one_conf_read(self, session, monkeypatch):
+        """With AQE off every hook is a single flag read: the decision
+        functions are monkeypatched to RAISE, so reaching any of them
+        fails the test outright."""
+        def boom(*a, **kw):
+            raise AssertionError("adaptive hook entered with AQE off")
+
+        _rebucket_view(session)
+        _seed_filter_history(session)       # leaves aqe_enabled False
+        for fn in ("guard", "drift", "record", "maybe_rebucket"):
+            monkeypatch.setattr(adaptive, fn, boom)
+        # join est hook + exchange skew hook + re-bucket + grouped hook
+        rng = np.random.default_rng(41)
+        left = Frame({"k": np.arange(30, dtype=np.float64),
+                      "v": rng.normal(size=30)})
+        right = Frame({"k": (np.arange(512) % 30).astype(np.float64),
+                       "w": rng.normal(size=512)})
+        left.join(right, on="k", est=(30 * 4096, 512))
+        lcols, rcols, li, ri = _skewed_plan_inputs()
+        shard.partitioned_join_plan(
+            _vector_join_plan, lcols, rcols, li, ri, "inner", 4)
+        plan = _exec(session, "EXPLAIN ANALYZE " + REBUCKET_SQL)["plan"][0]
+        assert "== Adaptive ==" not in plan
+        assert _replans() == 0
+
+
+# ---------------------------------------------------------------------------
+# Degradation ladder: the aqe fault site
+# ---------------------------------------------------------------------------
+
+
+class TestFaultLadder:
+    def _flip_scenario(self):
+        rng = np.random.default_rng(51)
+        left = Frame({"k": np.arange(30, dtype=np.float64),
+                      "v": rng.normal(size=30)})
+        right = Frame({"k": (np.arange(4096) % 64).astype(np.float64),
+                       "w": rng.normal(size=4096)})
+        config.aqe_enabled = False
+        ref = left.join(right, on="k").to_pydict()
+        config.aqe_enabled = True
+        return left, right, ref
+
+    @pytest.mark.parametrize("kind", ["device_error", "stall"])
+    def test_fault_degrades_decision_to_static_plan(self, kind):
+        left, right, ref = self._flip_scenario()
+        faults.install_plan(faults.parse_plan(f"aqe:{kind}:1"))
+        before = profiling.counters.get("aqe.fallback")
+        got = left.join(right, on="k", est=(30 * 4096, 4096)).to_pydict()
+        _assert_exact(ref, got)              # golden on the static rung
+        assert profiling.counters.get("aqe.fallback") == before + 1
+        assert _replans() == 0
+        assert any(getattr(e, "site", None) == "aqe"
+                   and getattr(e, "action", None) == "fallback"
+                   and getattr(e, "rung", None) == "static"
+                   for e in RECOVERY_LOG.events())
+
+    def test_fault_degrades_rebucket(self, session):
+        _rebucket_view(session)
+        ref = _seed_filter_history(session)
+        config.aqe_enabled = True
+        faults.install_plan(faults.parse_plan("aqe:device_error:1"))
+        got = _exec(session, REBUCKET_SQL)
+        _assert_exact(ref, got)
+        assert _replans("re-bucket") == 0
+        assert profiling.counters.get("aqe.fallback") >= 1
+
+    def test_headline_golden_on_every_rung(self, session):
+        from sparkdq4ml_tpu.models import LinearRegression
+
+        config.aqe_enabled = True
+        faults.install_plan(faults.parse_plan("aqe:device_error:3"))
+        df = run_dq_pipeline(session, dataset_path("abstract"))
+        assert df.count() == 24
+        model = LinearRegression(max_iter=40, reg_param=1.0,
+                                 elastic_net_param=1.0).fit(
+            prepare_features(df))
+        assert float(model.summary.root_mean_squared_error) == \
+            pytest.approx(2.809940, rel=1e-3)
+
+
+# ---------------------------------------------------------------------------
+# Satellite: flop-cost term in the level-2 join reorder
+# ---------------------------------------------------------------------------
+
+
+class TestFlopCostReorder:
+    def test_flops_for_selectivity_bridges_plan_keys(self):
+        # cost profiles land on FULL pipeline plan keys; the optimizer
+        # probes by REDUCED selectivity key — the bridge must connect
+        # the two and keep the largest recorded program
+        statstore.STORE.record_cost("ns:t|f32|F:gt|P:proj", "pipeline",
+                                    {"flops": 123.0})
+        statstore.STORE.record_cost("f32|F:gt|P:other", "pipeline",
+                                    {"flops": 60.0})
+        statstore.STORE.record_cost("f32|F:lt|P:proj", "pipeline",
+                                    {"flops": 999.0})
+        assert statstore.STORE.flops_for_selectivity("f32|F:gt") == 123.0
+        assert statstore.STORE.flops_for_selectivity("f32|F:nope") is None
+        assert statstore.STORE.flops_for_selectivity(None) is None
+
+    def _register(self, session):
+        rng = np.random.default_rng(61)
+        big = Frame({"k": rng.integers(0, 64, 2000).astype(np.float64),
+                     "v": rng.normal(size=2000)})
+        d1 = Frame({"k": np.arange(64, dtype=np.float64),
+                    "a": rng.normal(size=64)})
+        d2 = Frame({"k": np.arange(64, dtype=np.float64),
+                    "b": rng.normal(size=64)})
+        for name, f in (("big2", big), ("d1", d1), ("d2", d2)):
+            f.create_or_replace_temp_view(name)
+
+    SQL = "SELECT v, a, b FROM big2 JOIN d1 USING (k) JOIN d2 USING (k)"
+
+    def test_equal_rows_cold_flops_keeps_order(self, session):
+        self._register(session)
+        config.optimizer_enabled = True
+        config.optimizer_level = 2
+        plan = _exec(session, "EXPLAIN " + self.SQL)["plan"][0]
+        assert "join-reorder" not in plan    # 64r vs 64r, no tiebreaker
+
+    def test_flop_term_breaks_row_tie(self, session, monkeypatch):
+        self._register(session)
+        config.optimizer_enabled = False
+        config.optimizer_level = 2
+        off = _exec(session, self.SQL)
+        # d1's (hypothetical) filter program is the expensive one — the
+        # rank term must demote it behind the flop-free d2
+        monkeypatch.setattr(
+            opt, "_est_rel_flops",
+            lambda rel, cat: 1e6 if rel.view == "d1" else None)
+        config.optimizer_enabled = True
+        on = _exec(session, self.SQL)
+        _assert_sorted(off, on)
+        plan = _exec(session, "EXPLAIN " + self.SQL)["plan"][0]
+        assert "join-reorder" in plan
+        assert "smallest rows x flop cost first" in plan
+
+
+# ---------------------------------------------------------------------------
+# Satellite: decorrelation-aware pushdown into subquery branches
+# ---------------------------------------------------------------------------
+
+
+class TestDecorrelatedPushdown:
+    SQL = ("SELECT k, v FROM o WHERE EXISTS "
+           "(SELECT j FROM i JOIN d USING (j) "
+           "WHERE i.k = o.k AND w > 0)")
+
+    def _register(self, session):
+        rng = np.random.default_rng(71)
+        Frame({"k": rng.integers(0, 40, 200).astype(np.float64),
+               "v": rng.normal(size=200)}).create_or_replace_temp_view("o")
+        Frame({"k": rng.integers(0, 40, 300).astype(np.float64),
+               "j": rng.integers(0, 16, 300).astype(np.float64)}
+              ).create_or_replace_temp_view("i")
+        Frame({"j": np.arange(16, dtype=np.float64),
+               "w": rng.normal(size=16)}).create_or_replace_temp_view("d")
+
+    def test_branch_pushdown_parity_and_counter(self, session):
+        self._register(session)
+        config.optimizer_enabled = False
+        before = profiling.counters.get("optimizer.rewrite")
+        off = _exec(session, self.SQL)
+        assert profiling.counters.get("optimizer.rewrite") == before
+        config.optimizer_enabled = True
+        on = _exec(session, self.SQL)
+        # the branch is a full SELECT over its own scope: its residual
+        # filter pushes into the scan like any executed query's would
+        assert profiling.counters.get("optimizer.rewrite") > before
+        _assert_exact(off, on)
+
+    def test_outer_explain_pinned_branch_diff_renders(self, session):
+        self._register(session)
+        config.optimizer_enabled = False
+        off = _exec(session, "EXPLAIN " + self.SQL)["plan"][0]
+        config.optimizer_enabled = True
+        on = _exec(session, "EXPLAIN " + self.SQL)["plan"][0]
+        assert off == on                      # outer plan: no rewrites
+        branch = _exec(session, "EXPLAIN SELECT j FROM i JOIN d "
+                                "USING (j) WHERE w > 0")["plan"][0]
+        assert "pushdown: (w > 0) -> Scan[d]" in branch
+
+
+# ---------------------------------------------------------------------------
+# Satellite: session-conf scoping + metric vocabulary
+# ---------------------------------------------------------------------------
+
+
+class TestConfAndMetrics:
+    def test_session_conf_scoping(self):
+        s = dq.TpuSession.builder().app_name("aqe-conf").master(
+            "local[*]").config("spark.aqe.enabled", "false").config(
+            "spark.aqe.driftFactor", "2.5").config(
+            "spark.aqe.broadcastThreshold", "1234").config(
+            "spark.aqe.skewFactor", "9").get_or_create()
+        try:
+            assert config.aqe_enabled is False
+            assert config.aqe_drift_factor == 2.5
+            assert config.aqe_broadcast_threshold == 1234
+            assert config.aqe_skew_factor == 9.0
+        finally:
+            s.stop()
+        assert config.aqe_enabled is True
+        assert config.aqe_drift_factor == 4.0
+        assert config.aqe_broadcast_threshold == 8 << 20
+        assert config.aqe_skew_factor == 4.0
+
+    def test_metric_vocabulary_registered(self):
+        assert "aqe.replans" in obs.METRIC_NAMES
+        assert "aqe.fallback" in obs.METRIC_NAMES
+        assert "net.page_deadline" in obs.METRIC_NAMES
+        assert "aqe.replans." in obs.METRIC_NAME_PREFIXES
+
+    def test_fault_site_registered(self):
+        assert "aqe" in faults.FAULT_SITES
+        assert set(faults.FAULT_SITES["aqe"]) == {"device_error", "stall"}
+
+
+# ---------------------------------------------------------------------------
+# Satellite: per-page wire-deadline re-check on the stream paths
+# ---------------------------------------------------------------------------
+
+
+class TestPageDeadline:
+    @pytest.fixture
+    def served(self):
+        from sparkdq4ml_tpu.serve import NetServer, QueryServer
+
+        srv = QueryServer(workers=2).start()
+        net = NetServer(srv, host="127.0.0.1", port=0,
+                        conn_timeout_s=5.0).start()
+        srv.net = net
+        yield srv, net
+        srv.stop()
+
+    @pytest.mark.parametrize("transport", ["frame", "http"])
+    def test_expired_deadline_truncates_stream(self, session, served,
+                                               monkeypatch, transport):
+        from sparkdq4ml_tpu.serve import NetServer, ResilientClient
+
+        srv, net = served
+        net.page_rows = 16
+        ctx = srv.context("aqetenant")
+        ctx.register_view("t", Frame({"x": np.arange(100.0)}))
+        # the deadline expired while the result was still streaming —
+        # every page boundary re-checks it, so the stream truncates
+        # with a structured terminal status instead of running on
+        monkeypatch.setattr(
+            NetServer, "_stream_deadline",
+            staticmethod(lambda fut: time.perf_counter() - 1.0))
+        before = profiling.counters.get("net.page_deadline")
+        with ResilientClient("127.0.0.1", net.port, transport=transport,
+                             tenant="aqetenant") as c:
+            r = c.query("SELECT x FROM t")
+        assert r.status == "deadline_exceeded"
+        assert not r.ok
+        assert r.attempts == 1               # terminal: never retried
+        assert profiling.counters.get("net.page_deadline") == before + 1
