@@ -1429,6 +1429,95 @@ def bench_costprof(session, log):
     return section
 
 
+def bench_dqprof(session, log):
+    """(dqprof) Data-quality observatory (utils/dqprof.py): steady-state
+    flush throughput with profiling ON (deferred sketch dispatch, zero
+    host syncs) vs OFF, the overhead-when-disabled pin — with
+    spark.dq.profile.enabled=false the hot path pays one flag read, so
+    the disabled-vs-never-loaded flush delta must be ~1.0 (reported as
+    a ratio, gated by eye + the test-suite pin, not the regress gate:
+    sub-ms deltas are noise) — plus the cold drain + report-render
+    cost once sketches have accumulated.
+
+    Chip-independence: sketch reductions are tiny device programs; the
+    profiled-vs-unprofiled ratio is the structural figure, the absolute
+    walls are sandbox-dependent."""
+    import time as _time
+
+    import jax
+    import numpy as np
+
+    import sparkdq4ml_tpu as dq
+    from sparkdq4ml_tpu.config import config
+    from sparkdq4ml_tpu.frame.frame import Frame
+    from sparkdq4ml_tpu.utils import dqprof
+
+    n = 100_000 if SMOKE else 1_000_000
+    rng = np.random.default_rng(29)
+    section = {"rows": n}
+    saved = config.dq_profile_enabled
+
+    def flush(f):
+        jax.block_until_ready(f._mask)
+        return f
+
+    def chain(f):
+        for i in range(8):
+            f = f.with_column(f"c{i}", dq.col("v") * float(i + 1) + 0.25)
+        return f.filter(dq.col("c7") > 0)
+
+    frame = Frame({"v": rng.normal(size=n)})
+
+    def steady_flush():
+        t0 = _time.perf_counter()
+        flush(chain(frame))
+        return (_time.perf_counter() - t0) * 1e3
+
+    try:
+        # warm both plan variants (hook on/off traces the same fused
+        # program — the sketch programs are separate dispatches)
+        config.dq_profile_enabled = True
+        steady_flush()
+        config.dq_profile_enabled = False
+        steady_flush()
+
+        # (overhead-when-disabled) the one-flag-read contract at ~1.0:
+        # two interleaved disabled batches must agree (the per-flush
+        # conf read neither accumulates nor drifts — the structural
+        # zero-work pin is the raise-monkeypatch in tests/test_dqprof),
+        # then profiled-vs-unprofiled prices the deferred sketch
+        # dispatches themselves
+        off_a = sorted(steady_flush() for _ in range(5))[2]
+        off_b = sorted(steady_flush() for _ in range(5))[2]
+        config.dq_profile_enabled = True
+        dqprof.clear()
+        on = sorted(steady_flush() for _ in range(5))[2]
+        off = min(off_a, off_b)
+        section["disabled_flush_ms"] = round(off, 3)
+        section["profiled_flush_ms"] = round(on, 3)
+        section["disabled_overhead"] = (round(off_b / off_a, 3)
+                                        if off_a else None)
+        section["profiled_overhead"] = round(on / off, 3) if off else None
+
+        # (cold drain + report render) pull the accumulated deferred
+        # sketches in the module's one batched counted sync, then the
+        # warm report
+        t0 = _time.perf_counter()
+        doc = dqprof.report()
+        section["report_ms"] = round((_time.perf_counter() - t0) * 1e3, 3)
+        section["columns"] = doc["size"]
+        section["pending"] = doc["pending"]
+        log(json.dumps({"config": "dqprof_report",
+                        "report_ms": section["report_ms"],
+                        "columns": section["columns"],
+                        "profiled_overhead": section["profiled_overhead"],
+                        "disabled_flush_ms": section["disabled_flush_ms"],
+                        "profiled_flush_ms": section["profiled_flush_ms"]}))
+    finally:
+        config.dq_profile_enabled = saved
+    return section
+
+
 def bench_aqe(session, log):
     """(aqe) Adaptive query execution (sql/adaptive.py): the two drift
     workloads, each run with AQE OFF (static plan to the end) vs ON,
@@ -2092,6 +2181,10 @@ def main():
     # class, report-render cost, overhead-when-disabled pinned ~0
     costprof_sec = bench_costprof(session, log)
 
+    # (dqprof) data-quality observatory: profiled-vs-unprofiled flush
+    # throughput, overhead-when-disabled pinned ~1.0, cold drain cost
+    dqprof_sec = bench_dqprof(session, log)
+
     # (aqe) adaptive execution: skewed-join + misestimated-filter arms,
     # off-vs-on, bit-parity + structural assertions, replans counted
     aqe_sec = bench_aqe(session, log)
@@ -2284,6 +2377,7 @@ def main():
         "sharded": sharded,
         "optimizer": optimizer_sec,
         "costprof": costprof_sec,
+        "dqprof": dqprof_sec,
         "aqe": aqe_sec,
         "sweep": sweep_rows,
         "pallas_max_rel_diff": max((float(d) for _, d in pallas_diffs),

@@ -116,6 +116,10 @@ _CANDIDATES = (
     # that plan unprofiled ("-" on every surface) — /profile keeps
     # answering (the scraper below asserts zero scrape failures)
     ("cost_profile", "device_error", 0.30, ""),
+    # the data-quality observatory's ladder (utils/dqprof.py): a sketch
+    # fault degrades that flush to unprofiled — the flush itself and
+    # the /dq route keep answering (the scraper below asserts it)
+    ("dq_profile", "device_error", 0.30, ""),
     # the cross-request coalescer's ladder (serve/coalesce.py): a fault
     # on the STACKED batch dispatch degrades the whole batch to
     # per-request replay of the same cached plans — every member still
@@ -163,6 +167,7 @@ _ROTATION = (
     ("stats_persist", "torn_chunk", ""),
     ("optimizer", "device_error", ""),
     ("cost_profile", "device_error", ""),
+    ("dq_profile", "device_error", ""),
     ("coalesce", "device_error", ""),
     ("coalesce", "oom", ":n=64"),
     ("aqe", "device_error", ""),
@@ -299,6 +304,7 @@ class _Scraper:
         self.last_metrics: dict = {}
         self.last_health: dict = {}
         self.last_profile: dict = {}
+        self.last_dq: dict = {}
         self.last_trace: dict = {}
         self.last_incidents: dict = {}
         self._stop = threading.Event()
@@ -321,6 +327,13 @@ class _Scraper:
         with urllib.request.urlopen(self.base + "/profile?top=8",
                                     timeout=30) as resp:
             self.last_profile = json.loads(resp.read().decode())
+        # the data-quality observatory under fire: /dq must keep
+        # answering its schema (its drain is the module's counted
+        # cold-path sync; injected dq_profile faults degrade single
+        # flushes to unprofiled, never the route)
+        with urllib.request.urlopen(self.base + "/dq?top=8",
+                                    timeout=10) as resp:
+            self.last_dq = json.loads(resp.read().decode())
         # the tracing tier under fire: the span feed and the incident
         # index must keep answering while the fault plan churns the
         # tail sampler and the flight recorder underneath them
@@ -616,6 +629,8 @@ def run_seed(session, seed: int, clients: int, queries: int, workers: int,
         violations.append("healthz never answered with a status verdict")
     if scraper.last_profile.get("enabled") is None:
         violations.append("/profile never answered with a schema verdict")
+    if scraper.last_dq.get("enabled") is None:
+        violations.append("/dq never answered with a schema verdict")
     server.stop(drain=True)
     delta = {k: v - before.get(k, 0)
              for k, v in profiling.counters.snapshot().items()

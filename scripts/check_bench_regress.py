@@ -106,7 +106,7 @@ def load_bench_doc(path: str):
     if any(k in raw for k in ("configs", "sweep", "frame_pipeline",
                               "grouped_ops", "serving", "ingest",
                               "sharded", "optimizer", "costprof",
-                              "aqe")):
+                              "dqprof", "aqe")):
         return raw
     if isinstance(raw.get("parsed"), dict):
         return raw["parsed"]

@@ -88,6 +88,10 @@ CONF_KEYS = {
     "spark.incident.maxBundles": "session",
     "spark.incident.cooldownS": "session",
     "spark.incident.sloBurnThreshold": "session",
+    "spark.dq.profile.enabled": "session",
+    "spark.dq.histogramBins": "session",
+    "spark.dq.driftThreshold": "session",
+    "spark.dq.baselineMode": "session",
     "spark.observability.enabled": "init",
     "spark.observability.maxSpans": "init",
     "spark.observability.logSpans": "init",
@@ -372,6 +376,25 @@ class _Config:
     incident_max_bundles: int = 32
     incident_cooldown_s: float = 5.0
     incident_slo_burn_threshold: float = 8.0
+    # Data-quality observatory (utils/dqprof.py): per-column profile
+    # sketches + per-rule violation accounting dispatched as deferred
+    # device reductions from the flush hook, drained only on cold paths
+    # (report / the /dq route / EXPLAIN ANALYZE) — the hot path adds
+    # zero counted host syncs. spark.dq.profile.enabled=false reduces
+    # every hook to one conf read and pins EXPLAIN byte-identical.
+    dq_profile_enabled: bool = True
+    # Fixed-bucket histogram resolution over the log-compressed domain
+    # (spark.dq.histogramBins) — identical bins values merge
+    # bucket-for-bucket across flushes, shards, and sessions.
+    dq_histogram_bins: int = 32
+    # PSI drift score past this captures an incident bundle and tags
+    # the span for tail-keep (spark.dq.driftThreshold).
+    dq_drift_threshold: float = 0.25
+    # Drift reference policy (spark.dq.baselineMode): "first" adopts a
+    # persisted statstore snapshot when present else pins the first
+    # drained profile; "persisted" only ever adopts; "off" disables
+    # drift scoring.
+    dq_baseline_mode: str = "first"
     # Pallas fast-path selection for the hot ops (ops/pallas_kernels.py):
     # the single-device Gramian in solvers.augmented_gram and the fused DQ
     # chain entry point ops/rules.py:dq_rules_fused. "off" = plain XLA

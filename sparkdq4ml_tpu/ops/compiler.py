@@ -1038,6 +1038,43 @@ def _record_flush_stats(plan, data, b: int, n: int,
         logger.debug("stats hand-off failed", exc_info=True)
 
 
+def _record_dq_profile(steps, changed, new_mask, mask_in, b: int,
+                       shard) -> None:
+    """Data-quality observatory hand-off (``utils/dqprof.py``): enqueue
+    deferred column-sketch reductions over this flush's outputs, plus
+    per-rule pass/fail reductions for every ``with_column`` step whose
+    expression is a registered DQ UDF — counted against the flush's
+    INPUT mask, because the reference app fuses ``rule`` and
+    ``WHERE rule > 0`` into one flush and the output mask has already
+    swallowed the violations. Called only when
+    ``spark.dq.profile.enabled``; any failure is swallowed — profiling
+    must never take a flush down (dqprof degrades itself through the
+    ``dq_profile`` fault ladder besides)."""
+    from ..utils import dqprof as _dqprof
+
+    try:
+        from . import expressions as E
+        from . import udf as _udf
+
+        registry = _udf.default_registry()
+        rules = []
+        for step in steps:
+            if step[0] == "with_column":
+                pairs = [(step[1], step[2])]
+            elif step[0] == "with_columns":
+                pairs = list(step[1])
+            else:
+                continue
+            for name, ex in pairs:
+                if (isinstance(ex, E.UdfCall) and name in changed
+                        and ex.udf_name in registry):
+                    rules.append((ex.udf_name, name))
+        _dqprof.observe_flush(changed, new_mask, b, shard=shard,
+                              rules=rules, mask_in=mask_in)
+    except Exception:
+        logger.debug("dq-profile hand-off failed", exc_info=True)
+
+
 #: Stage-boundary placement (cost-based optimizer, level >= 2): minimum
 #: pending-step count for a chain to count as a "mega-stage" worth
 #: probing, and the minimum recorded compile cost (statstore p50) of the
@@ -1301,6 +1338,14 @@ def run_pipeline(data: dict, mask, n: int, steps, extra=(), shard=None):
             else:
                 plan.hits += 1
             plan.buckets[b] = plan.buckets.get(b, 0) + 1
+        # Data-quality observatory gate (utils/dqprof.py): ONE flag
+        # read; disabled mode pays nothing else on this path
+        # (test-pinned, chaos-pin style). Runs on the PADDED bucket
+        # arrays so sketch programs retrace per power-of-two bucket,
+        # never per raw row count.
+        if config.dq_profile_enabled:
+            _record_dq_profile(steps, changed, new_mask, mask_in, b,
+                               shard)
         if b != n:
             changed, new_mask, extras = _unpad_tree(
                 (changed, new_mask, extras), n)

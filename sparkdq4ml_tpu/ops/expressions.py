@@ -596,6 +596,15 @@ class UdfCall(Expr):
         out = fn(*vals)
         if return_dtype is not None:
             out = jnp.asarray(out, return_dtype)
+        # Data-quality observatory gate (utils/dqprof.py): ONE flag
+        # read; record_eval skips tracers itself, so a traced flush
+        # accounts through the compiler hook instead — never twice.
+        from ..config import config as _cfg
+
+        if _cfg.dq_profile_enabled:
+            from ..utils import dqprof as _dqprof
+
+            _dqprof.record_eval(self.udf_name, out)
         return out
 
     @property

@@ -153,6 +153,8 @@ class TelemetryServer:
             elif path.startswith("/trace/"):
                 body, ctype, code = self._trace_tree(
                     path[len("/trace/"):])
+            elif path == "/dq":
+                body, ctype, code = self._dq(req.path)
             elif path == "/incidents":
                 body, ctype, code = self._incidents()
             elif path.startswith("/incidents/"):
@@ -164,7 +166,7 @@ class TelemetryServer:
                         "/metrics", "/healthz", "/plans", "/trace",
                         "/trace/<trace_id>", "/incidents",
                         "/incidents/<id>", "/profile",
-                        "/profile/trace"]}),
+                        "/profile/trace", "/dq"]}),
                     "application/json", 404)
         except Exception as e:   # a route bug must answer, not hang
             logger.debug("telemetry route failed", exc_info=True)
@@ -243,6 +245,26 @@ class TelemetryServer:
 
         qs = parse_qs(urlsplit(raw_path).query)
         return {k: v[-1] for k, v in qs.items() if v}
+
+    def _dq(self, raw_path: str):
+        """Data-quality observatory view (``utils/dqprof.py``): column
+        profiles + drift scores + per-rule violation tallies. The drain
+        this triggers is the module's own counted cold-path sync."""
+        from ..config import config as _cfg
+        from ..utils import dqprof as _dqprof
+
+        if not _cfg.dq_profile_enabled:
+            return (json.dumps({"enabled": False, "columns": [],
+                                "rules": []}),
+                    "application/json", 200)
+        params = self._query_params(raw_path)
+        try:
+            top = int(params.get("top", 64))
+        except ValueError:
+            top = 64
+        return (json.dumps(_dqprof.report(top=top),
+                           default=_json_default),
+                "application/json", 200)
 
     def _profile(self, raw_path: str):
         from ..config import config as _cfg

@@ -499,6 +499,27 @@ class TpuSession:
             if "spark.incident.sloBurnThreshold" in self.conf:
                 _set("incident_slo_burn_threshold",
                      float(self.conf["spark.incident.sloBurnThreshold"]))
+            # Data-quality observatory (utils/dqprof.py), session-scoped
+            # like everything above:
+            #     .config("spark.dq.profile.enabled", "false")
+            #     .config("spark.dq.histogramBins", 32)
+            #     .config("spark.dq.driftThreshold", 0.25)
+            #     .config("spark.dq.baselineMode", "persisted")
+            dval = str(self.conf.get("spark.dq.profile.enabled",
+                                     "")).lower()
+            if dval in _CONF_FALSE:
+                _set("dq_profile_enabled", False)
+            elif dval in _CONF_TRUE:
+                _set("dq_profile_enabled", True)
+            if "spark.dq.histogramBins" in self.conf:
+                _set("dq_histogram_bins",
+                     int(self.conf["spark.dq.histogramBins"]))
+            if "spark.dq.driftThreshold" in self.conf:
+                _set("dq_drift_threshold",
+                     float(self.conf["spark.dq.driftThreshold"]))
+            if "spark.dq.baselineMode" in self.conf:
+                _set("dq_baseline_mode",
+                     str(self.conf["spark.dq.baselineMode"]))
             if saved:
                 self._pipeline_saved = saved
         # Install the shard context over THIS session's mesh (outside
@@ -698,6 +719,23 @@ class TpuSession:
         from .utils import costprof as _costprof
 
         return _costprof.report(top=top)
+
+    def dq_report(self, top: Optional[int] = None) -> dict:
+        """The data-quality observatory view (``utils.dqprof``): one
+        row per profiled column — count/null/min/max/mean/variance
+        sketch fields, fixed-bucket histogram, PSI drift vs the pinned
+        baseline — plus per-rule violation tallies and rates. COLD
+        surface: pays the module's one counted deferred-sketch drain
+        (``dq.drain_sync``). ``spark.dq.profile.enabled=false`` makes
+        it refuse (README "Data-quality observatory")."""
+        from .config import config as _cfg
+
+        if not _cfg.dq_profile_enabled:
+            return {"enabled": False, "columns": [], "rules": [],
+                    "size": 0, "pending": 0}
+        from .utils import dqprof as _dqprof
+
+        return _dqprof.report(top=top)
 
     def _init_faults(self) -> None:
         """Install the fault-injection plan (``utils.faults``) from session
@@ -964,7 +1002,7 @@ class TpuSession:
                                      "spark.chaos.", "spark.stats.",
                                      "spark.shard.", "spark.costprof.",
                                      "spark.profiling.", "spark.trace.",
-                                     "spark.incident."))
+                                     "spark.incident.", "spark.dq."))
                        for k in self._conf):
                     _ACTIVE._init_pipeline()
                 return _ACTIVE

@@ -2289,6 +2289,16 @@ def _execute_explain(body: str, cat, analyze: bool):
     from . import adaptive as _adaptive
 
     caches_before = _obs.cache_report() if _cfg.explain_caches else {}
+    # Data-quality observatory marks (utils/dqprof.py) — gated on ONE
+    # flag read; disabled restores the exact pre-observatory ANALYZE
+    # schema (acceptance-pinned byte-identical). The pre-execution
+    # drain is this cold surface's own counted sync, outside the
+    # query-stats window so per-query attribution is untouched.
+    dq_marks = None
+    if _cfg.dq_profile_enabled:
+        from ..utils import dqprof as _dqprof
+
+        dq_marks = _dqprof.rule_marks()
     # ANALYZE executes under the adaptive capture scope: any mid-query
     # re-plan the hooks apply (sql/adaptive.py) records an event here
     # and renders as the `== Adaptive ==` section. No events (AQE off,
@@ -2372,6 +2382,12 @@ def _execute_explain(body: str, cat, analyze: bool):
     if aqe_events:
         lines.append("== Adaptive ==")
         lines.extend(_adaptive.render(aqe_events))
+    if dq_marks is not None:
+        from ..utils import dqprof as _dqprof
+
+        # renders only when this query evaluated a registered DQ rule
+        # (delta over dq_marks) — rule-free ANALYZE stays byte-identical
+        lines.extend(_dqprof.explain_lines(dq_marks))
     return Frame({"plan": ["\n".join(lines)]})
 
 

@@ -114,6 +114,7 @@ FAULT_SITES = {
     "optimizer": ("device_error",),
     "aqe": ("device_error", "stall"),
     "cost_profile": ("device_error",),
+    "dq_profile": ("device_error",),
     "net_accept": ("conn_reset",),
     "net_read": ("conn_reset", "stall", "slow_client"),
     "net_write": ("conn_reset", "partial_write", "stall"),

@@ -180,6 +180,7 @@ class IncidentRecorder:
                          RECOVERY_LOG.events()[-RECOVERY_SLICE:]],
             "plan_stats": self._plan_rows(),
             "cost_profile": self._cost_rows(),
+            "dq": self._dq_rows(),
         }
         if extra:
             bundle.update(extra)
@@ -215,6 +216,23 @@ class IncidentRecorder:
             return rows[:PLAN_ROWS]
         except Exception:
             return []
+
+    @staticmethod
+    def _dq_rows():
+        """DQ observatory snapshot (utils/dqprof.py) — drain_first=False:
+        a dq-triggered incident fires DURING a drain, and the already-
+        folded state is exactly the evidence worth capturing."""
+        try:
+            from . import dqprof
+
+            rep = dqprof.report(top=PLAN_ROWS, drain_first=False)
+            if not rep.get("enabled"):
+                return {"enabled": False}
+            return {"enabled": True,
+                    "columns": rep.get("columns", [])[:PLAN_ROWS],
+                    "rules": rep.get("rules", [])[:PLAN_ROWS]}
+        except Exception:
+            return {"enabled": False}
 
     # -- persistence ladder -----------------------------------------------
     def _persist(self, incident_id: str, bundle: dict) -> str:

@@ -261,6 +261,32 @@ METRIC_NAMES = {
                              "volume, all kinds"),
     "profiling.captures": ("counter",
                            "managed jax-profiler captures armed"),
+    # data-quality observatory (utils/dqprof.py)
+    "dq.sketches": ("counter",
+                    "column/rule sketch reductions dispatched from "
+                    "flush hooks"),
+    "dq.drain_sync": ("counter",
+                      "batched cold-path drains of deferred dq "
+                      "sketches (the only dq host syncs)"),
+    "dq.pending_dropped": ("counter",
+                           "deferred dq observations dropped at the "
+                           "pending bound"),
+    "dq.profile_failed": ("counter",
+                          "flushes degraded to unprofiled by the "
+                          "dq_profile fault ladder"),
+    "dq.rule_evals": ("counter",
+                      "eager DQ-rule evaluations accounted"),
+    "dq.baseline_pinned": ("counter",
+                           "drift baselines pinned (first drain or "
+                           "persisted snapshot adoption)"),
+    "dq.drift_breach": ("counter",
+                        "column drift scores past "
+                        "spark.dq.driftThreshold"),
+    "dq.violation_spike": ("counter",
+                           "per-drain rule violation-rate spikes"),
+    "dq.program_evict": ("counter",
+                         "dq sketch programs evicted at the cache "
+                         "bound"),
 }
 
 #: Dynamic metric-name families (formatted per site/tenant/category at
@@ -286,6 +312,11 @@ METRIC_NAME_PREFIXES = {
     "shard.exchange_bytes.": ("counter",
                               "per-kind cross-shard exchange volume "
                               "(psum/all_to_all/gather)"),
+    "dq.violations.": ("counter", "per-rule DQ violation rows"),
+    "dq.violation_rate.": ("gauge", "per-rule cumulative violation "
+                                    "fraction"),
+    "dq.drift.": ("gauge", "per-column PSI drift vs the pinned "
+                           "baseline"),
 }
 
 
@@ -923,6 +954,8 @@ class TailSampler:
             reasons.append("deadline_exceeded")
         if any("recovery_fault" in s.attrs for s in spans):
             reasons.append("recovery_fault")
+        if any("dq_drift" in s.attrs for s in spans):
+            reasons.append("dq_drift")
         if v.get("breaker_opened"):
             reasons.append("breaker_transition")
         slo_ms, e2e_ms = v.get("slo_ms"), v.get("e2e_ms")

@@ -163,7 +163,7 @@ class KeyStats:
     __slots__ = ("key", "kind", "flushes", "compiles", "rows_in",
                  "rows_out", "sel_observations", "wall_ms", "compile_ms",
                  "host_syncs", "est_bytes_max", "peak_bytes_max",
-                 "cost", "updated_at")
+                 "cost", "profile", "updated_at")
 
     def __init__(self, key: str, kind: str):
         self.key = key
@@ -183,6 +183,12 @@ class KeyStats:
         # structural per key, so one extraction serves every session
         # that loads this snapshot. None until an extraction lands.
         self.cost: Optional[dict] = None
+        # DQ column-profile snapshot (utils/dqprof.py
+        # ColumnProfile.to_doc(): versioned sketch fields + fixed-bucket
+        # histogram) under ``dqprof|<column>`` keys — the cross-session
+        # drift baseline. None until a profile drain lands. Optional
+        # field: pre-dq snapshots load unchanged (back-compatible).
+        self.profile: Optional[dict] = None
         self.updated_at = 0.0
 
     @property
@@ -210,6 +216,8 @@ class KeyStats:
         self.peak_bytes_max = max(self.peak_bytes_max, other.peak_bytes_max)
         if self.cost is None:
             self.cost = other.cost
+        if self.profile is None:
+            self.profile = other.profile
         self.updated_at = max(self.updated_at, other.updated_at)
 
     def to_doc(self) -> dict:
@@ -227,6 +235,8 @@ class KeyStats:
         }
         if self.cost is not None:
             doc["cost"] = self.cost
+        if self.profile is not None:
+            doc["profile"] = self.profile
         return doc
 
     @classmethod
@@ -244,6 +254,8 @@ class KeyStats:
         ks.peak_bytes_max = int(doc.get("peak_bytes_max", 0))
         cost = doc.get("cost")
         ks.cost = dict(cost) if isinstance(cost, dict) else None
+        profile = doc.get("profile")
+        ks.profile = dict(profile) if isinstance(profile, dict) else None
         ks.updated_at = float(doc.get("updated_at", 0.0))
         return ks
 
@@ -428,6 +440,21 @@ class StatStore:
             ks = self._entries.get(key)
             return dict(ks.cost) if ks is not None and ks.cost else None
 
+    def record_profile(self, key: str, kind: str, profile: dict) -> None:
+        """Attach a DQ column-profile snapshot (``utils/dqprof.py``) to
+        the entry at ``key`` (``dqprof|<column>``) — the persisted drift
+        baseline later sessions adopt instead of re-learning one."""
+        with self._lock:
+            ks = self._entry_locked(key, kind)
+            ks.profile = dict(profile)
+            ks.updated_at = time.time()
+
+    def profile(self, key: str) -> Optional[dict]:
+        with self._lock:
+            ks = self._entries.get(key)
+            return dict(ks.profile) \
+                if ks is not None and ks.profile else None
+
     def flops_for_selectivity(self, sel_key: Optional[str]
                               ) -> Optional[float]:
         """Largest recorded AOT-profile flop count over the entries whose
@@ -550,9 +577,16 @@ class StatStore:
                     # that never extracted one must not drop the
                     # loser's (re-extraction costs a real XLA compile)
                     ks.cost = cur.cost
+                if cur is not None and ks.profile is None:
+                    # same for the DQ profile snapshot: dropping it
+                    # would silently reset the drift baseline
+                    ks.profile = cur.profile
                 target[ks.key] = ks
-            elif cur.cost is None and ks.cost is not None:
-                cur.cost = ks.cost
+            else:
+                if cur.cost is None and ks.cost is not None:
+                    cur.cost = ks.cost
+                if cur.profile is None and ks.profile is not None:
+                    cur.profile = ks.profile
 
     @staticmethod
     def _trim(target: dict, bound: int) -> int:
